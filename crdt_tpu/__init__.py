@@ -1,0 +1,73 @@
+"""crdt_tpu — a TPU-native CRDT framework.
+
+A ground-up re-design of the capabilities of the reference Rust crate
+``crdts`` (rust-crdt, see `/root/reference/src/lib.rs`) for TPU hardware:
+
+* ``crdt_tpu.scalar`` — the scalar engine: dict-based, bit-exact reference
+  semantics (the parity oracle and the per-op path).
+* ``crdt_tpu.ops`` — dense JAX/XLA join kernels over columnar SoA buffers
+  (``u64[N, A]`` clocks etc.), the TPU hot path.
+* ``crdt_tpu.batch`` — batched CRDT types wrapping those kernels behind the
+  same merge/apply/value contracts.
+* ``crdt_tpu.parallel`` — device-mesh sharding and collective lattice joins
+  (all-reduce-max over ICI/DCN via ``shard_map``).
+* ``crdt_tpu.native`` — C++ scalar kernels (ctypes) mirroring the hot VClock
+  arithmetic for a native host path.
+* ``crdt_tpu.utils`` — actor/member interning, binary serde, pretty-printing.
+
+Public API mirrors the reference re-exports (`lib.rs:6-15`).
+"""
+
+# NOTE: importing the package must NOT import JAX or flip global JAX flags —
+# the scalar engine is pure Python.  The batch/ops/parallel modules call
+# config.enable_x64() themselves when first imported.
+from .error import ConflictingMarker, CrdtError, MergeConflict, NestedOpFailed
+from .traits import Causal, CmRDT, CvRDT, FunkyCmRDT, FunkyCvRDT
+from .scalar import (
+    Actor,
+    AddCtx,
+    Dot,
+    GCounter,
+    GSet,
+    LWWReg,
+    Map,
+    MVReg,
+    Orswot,
+    PNCounter,
+    ReadCtx,
+    RmCtx,
+    VClock,
+)
+from .config import CrdtConfig, DEFAULT_CONFIG
+from .utils.serde import from_binary, to_binary
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Actor",
+    "AddCtx",
+    "Causal",
+    "CmRDT",
+    "ConflictingMarker",
+    "CrdtConfig",
+    "CrdtError",
+    "CvRDT",
+    "DEFAULT_CONFIG",
+    "Dot",
+    "FunkyCmRDT",
+    "FunkyCvRDT",
+    "GCounter",
+    "GSet",
+    "LWWReg",
+    "Map",
+    "MergeConflict",
+    "MVReg",
+    "NestedOpFailed",
+    "Orswot",
+    "PNCounter",
+    "ReadCtx",
+    "RmCtx",
+    "VClock",
+    "from_binary",
+    "to_binary",
+]

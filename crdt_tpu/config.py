@@ -1,0 +1,65 @@
+"""Global configuration for the crdt_tpu framework.
+
+The reference library (`/root/reference/src/vclock.rs:23`) fixes
+``Counter = u64``.  JAX needs ``jax_enable_x64`` for 64-bit integers, so we
+enable it at import time (gate with ``CRDT_TPU_NO_X64=1`` to opt out, e.g.
+for pure-f32 TPU perf experiments where counters fit in uint32).
+
+The reference has no runtime configuration at all (no features, env vars or
+flags — see SURVEY.md §5 "Config"); its only knobs are compile-time generics.
+The TPU build replaces those generics with :class:`CrdtConfig`: capacities of
+the dense SoA buffers (actor universe, member slots, deferred slots,
+multi-value slots) and the counter dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+_X64_ENABLED = False
+
+
+def enable_x64() -> bool:
+    """Enable 64-bit types in JAX (idempotent). Returns True if enabled."""
+    global _X64_ENABLED
+    if os.environ.get("CRDT_TPU_NO_X64") == "1":
+        return False
+    if not _X64_ENABLED:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _X64_ENABLED = True
+    return _X64_ENABLED
+
+
+def counter_dtype():
+    """The dtype used for dense counters (reference: u64, vclock.rs:23)."""
+    import jax.numpy as jnp
+
+    return jnp.uint64 if enable_x64() else jnp.uint32
+
+
+@dataclasses.dataclass(frozen=True)
+class CrdtConfig:
+    """Static capacities for dense SoA CRDT batches.
+
+    The reference stores unbounded BTreeMaps/HashMaps; XLA requires static
+    shapes, so each axis gets a capacity.  Overflow policy: raising on the
+    host at ingest time (capacities are checked when ops/states are packed,
+    never on device).
+    """
+
+    num_actors: int = 64  # actor-universe size A (dense interned ids)
+    member_capacity: int = 32  # Orswot member slots per object
+    deferred_capacity: int = 8  # deferred (clock, member) rows per object
+    mv_capacity: int = 8  # MVReg antichain slots per register
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not isinstance(v, int) or v <= 0:
+                raise ValueError(f"CrdtConfig.{f.name} must be a positive int, got {v!r}")
+
+
+DEFAULT_CONFIG = CrdtConfig()

@@ -1,0 +1,43 @@
+"""CRDT error codes.
+
+Mirrors the reference error enum (`/root/reference/src/error.rs:8-18`):
+``ConflictingMarker``, ``MergeConflict``, ``NestedOpFailed``.  The reference
+returns ``Result<T, Error>`` from the Funky (fallible) traits
+(`/root/reference/src/traits.rs:53-75`); in Python the idiomatic equivalent
+is raising — the funky merge/apply/update entry points raise these.
+
+Batched TPU kernels cannot raise per-element; they surface a conflict bitmap
+instead (see ``crdt_tpu.ops.lww_ops``), which the host converts into a
+:class:`ConflictingMarker` for scalar-path error parity (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+
+class CrdtError(Exception):
+    """Base class for all CRDT errors."""
+
+
+class ConflictingMarker(CrdtError):
+    """A conflicting change witnessed by a marker/dot that already exists.
+
+    Reference: `error.rs:9-13` — "Dot's are used exactly once for the
+    lifetime of a CRDT".
+    """
+
+    def __str__(self) -> str:
+        return "Dot's are used exactly once for the lifetime of a CRDT"
+
+
+class MergeConflict(CrdtError):
+    """A generic error for any unmergable conflict (`error.rs:14-15`)."""
+
+    def __str__(self) -> str:
+        return "There was a conflict while merging"
+
+
+class NestedOpFailed(CrdtError):
+    """We failed to apply a nested op to a nested CRDT (`error.rs:16-17`)."""
+
+    def __str__(self) -> str:
+        return "We failed to apply a nested op to a nested CRDT"

@@ -1,0 +1,47 @@
+"""The scalar engine: bit-exact reference semantics on the host.
+
+This is the parity oracle for the TPU batch engine (``crdt_tpu.batch``) —
+both engines implement the same ``merge`` / ``apply`` / ``value`` contracts
+(`/root/reference/src/traits.rs:9-41`), so every test runs against either.
+"""
+
+from .ctx import AddCtx, ReadCtx, RmCtx
+from .gcounter import GCounter
+from .gset import GSet
+from .lwwreg import LWWReg
+from .map import Entry, Map
+from .map import Nop as MapNop
+from .map import Rm as MapRm
+from .map import Up as MapUp
+from .mvreg import MVReg, Put
+from .orswot import Add, Orswot
+from .orswot import Rm as OrswotRm
+from .pncounter import Dir, Op as PNOp, PNCounter
+from .vclock import Actor, ClockKey, Counter, Dot, VClock
+
+__all__ = [
+    "Actor",
+    "Add",
+    "AddCtx",
+    "ClockKey",
+    "Counter",
+    "Dir",
+    "Dot",
+    "Entry",
+    "GCounter",
+    "GSet",
+    "LWWReg",
+    "Map",
+    "MapNop",
+    "MapRm",
+    "MapUp",
+    "MVReg",
+    "Orswot",
+    "OrswotRm",
+    "PNCounter",
+    "PNOp",
+    "Put",
+    "ReadCtx",
+    "RmCtx",
+    "VClock",
+]

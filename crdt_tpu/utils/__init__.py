@@ -1,0 +1,5 @@
+"""Host-side utilities: interning, serde, pretty-printing, tracing."""
+
+from .serde import from_binary, to_binary
+
+__all__ = ["from_binary", "to_binary"]

@@ -1,0 +1,26 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding paths
+(`crdt_tpu.parallel`) are exercised without TPU hardware, and enables x64 so
+counters are u64 like the reference (`/root/reference/src/vclock.rs:23`).
+
+Must set env vars before the first ``import jax`` anywhere in the test run.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+from hypothesis import HealthCheck, settings
+
+# quickcheck's default is 100 cases per property (SURVEY.md §6); mirror that.
+settings.register_profile(
+    "crdt",
+    max_examples=100,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+settings.load_profile("crdt")

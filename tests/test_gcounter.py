@@ -1,0 +1,61 @@
+"""GCounter tests — mirrors `/root/reference/test/gcounter.rs` plus the
+doc-test from `/root/reference/src/gcounter.rs:9-23`."""
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import GCounter
+
+
+def test_basic():
+    a, b = GCounter(), GCounter()
+    a_op = a.inc("A")
+    b_op = b.inc("B")
+    a.apply(a_op)
+    b.apply(b_op)
+    assert a.value() == b.value()
+    assert a == b
+
+    a_op2 = a.inc("A")
+    a.apply(a_op2)
+    assert a > b
+
+
+def test_doc_example():
+    """`gcounter.rs:9-23`: an unapplied inc does not mutate."""
+    a, b = GCounter(), GCounter()
+    op_a1 = a.inc("A")
+    op_b = b.inc("B")
+    a.apply(op_a1)
+    b.apply(op_b)
+    assert a.value() == b.value()
+    assert a == b
+    op_a2 = a.inc("A")
+    a.inc("A")  # pure: doesn't mutate
+    a.apply(op_a2)
+    assert a > b
+
+
+@given(st.lists(st.integers(0, 10), max_size=30))
+def test_prop_value_is_sum_and_merge_idempotent(actors):
+    a = GCounter()
+    for actor in actors:
+        a.apply(a.inc(actor))
+    assert a.value() == len(actors)
+    snapshot = a.clone()
+    a.merge(snapshot)
+    assert a == snapshot
+
+
+@given(st.lists(st.integers(0, 5), max_size=20), st.lists(st.integers(0, 5), max_size=20))
+def test_prop_merge_commutative(xs, ys):
+    a, b = GCounter(), GCounter()
+    for actor in xs:
+        a.apply(a.inc(actor))
+    for actor in ys:
+        b.apply(b.inc(actor))
+    ab = a.clone()
+    ab.merge(b)
+    ba = b.clone()
+    ba.merge(a)
+    assert ab.inner == ba.inner

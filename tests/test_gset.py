@@ -1,0 +1,52 @@
+"""GSet tests.
+
+The reference's `test/gset.rs` is an empty stub; these cover the doctests in
+`/root/reference/src/gset.rs:19-62` plus basic lattice properties.
+"""
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import GSet
+
+elems = st.lists(st.integers(0, 255), max_size=20)
+
+
+def test_doc_examples():
+    a, b = GSet(), GSet()
+    a.insert(1)
+    b.insert(2)
+    a.merge(b)
+    assert a.contains(1)
+    assert a.contains(2)
+
+
+@given(elems)
+def test_prop_merge_idempotent(xs):
+    a = GSet(set(xs))
+    snapshot = a.clone()
+    a.merge(snapshot)
+    assert a == snapshot
+
+
+@given(elems, elems)
+def test_prop_merge_commutative(xs, ys):
+    a, b = GSet(set(xs)), GSet(set(ys))
+    ab = a.clone()
+    ab.merge(b)
+    ba = b.clone()
+    ba.merge(a)
+    assert ab == ba
+
+
+@given(elems, elems, elems)
+def test_prop_merge_associative(xs, ys, zs):
+    a, b, c = GSet(set(xs)), GSet(set(ys)), GSet(set(zs))
+    left = a.clone()
+    left.merge(b)
+    left.merge(c)
+    bc = b.clone()
+    bc.merge(c)
+    right = a.clone()
+    right.merge(bc)
+    assert left == right

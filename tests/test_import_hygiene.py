@@ -1,0 +1,23 @@
+"""Importing crdt_tpu (scalar engine) must not mutate global JAX config.
+
+Note: this environment preloads jax into every interpreter (axon site hook),
+so we can't assert jax is absent from sys.modules — instead assert that the
+import leaves ``jax_enable_x64`` untouched.  x64 is flipped lazily by the
+batch/ops/parallel modules via :func:`crdt_tpu.config.enable_x64`.
+"""
+
+import subprocess
+import sys
+
+
+def test_import_does_not_flip_x64():
+    code = (
+        "import crdt_tpu\n"
+        "import jax\n"
+        "assert not jax.config.jax_enable_x64, 'import crdt_tpu flipped x64'\n"
+        "import crdt_tpu.config as c\n"
+        "c.enable_x64()\n"
+        "assert jax.config.jax_enable_x64, 'enable_x64() did not flip x64'\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr

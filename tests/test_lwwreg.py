@@ -1,0 +1,99 @@
+"""LWWReg tests — mirrors `/root/reference/test/lwwreg.rs` and the doctests
+in `/root/reference/src/lwwreg.rs:49-55,84-103`."""
+
+import pytest
+from hypothesis import assume, given
+from hypothesis import strategies as st
+
+from crdt_tpu import ConflictingMarker, LWWReg
+
+
+def test_default():
+    reg = LWWReg(val="", marker=0)
+    assert reg == LWWReg("", 0)
+
+
+def test_update():
+    """`test/lwwreg.rs:15-37`."""
+    reg = LWWReg(val=123, marker=0)
+
+    # normal update: new marker descends the current marker
+    reg.update(32, 2)
+    assert reg == LWWReg(32, 2)
+
+    # stale update: marker is an ancestor — no-op
+    reg.update(57, 1)
+    assert reg == LWWReg(32, 2)
+
+    # redundant update: same marker and val — no-op
+    reg.update(32, 2)
+    assert reg == LWWReg(32, 2)
+
+    # bad update: same marker, different val — error
+    with pytest.raises(ConflictingMarker):
+        reg.update(4000, 2)
+    assert reg == LWWReg(32, 2)
+
+
+def test_merge_conflict_doc():
+    """`lwwreg.rs:49-55`: equal marker, different val errors."""
+    l1 = LWWReg(val=1, marker=2)
+    l2 = LWWReg(val=3, marker=2)
+    with pytest.raises(ConflictingMarker):
+        l1.merge(l2)
+
+
+def build_from_prim(prim):
+    """`test/lwwreg.rs:39-45`: tuple marker avoids conflicts."""
+    val, m = prim
+    return LWWReg(val=val, marker=(m, val))
+
+
+prims = st.tuples(st.integers(0, 255), st.integers(0, 2**16 - 1))
+
+
+def _conflicting(r1, r2):
+    return r1.marker == r2.marker and r1.val != r2.val
+
+
+@given(prims, prims, prims)
+def test_prop_associative(p1, p2, p3):
+    r1, r2, r3 = build_from_prim(p1), build_from_prim(p2), build_from_prim(p3)
+    assume(not (_conflicting(r1, r2) or _conflicting(r1, r3) or _conflicting(r2, r3)))
+
+    r1_snapshot = r1.clone()
+
+    # (r1 ^ r2) ^ r3
+    r1.merge(r2)
+    r1.merge(r3)
+
+    # r1 ^ (r2 ^ r3)
+    r2.merge(r3)
+    r1_snapshot.merge(r2)
+
+    assert r1 == r1_snapshot
+
+
+@given(prims, prims)
+def test_prop_commutative(p1, p2):
+    r1, r2 = build_from_prim(p1), build_from_prim(p2)
+    assume(not _conflicting(r1, r2))
+    r1_snapshot = r1.clone()
+    r1.merge(r2)
+    r2.merge(r1_snapshot)
+    assert r1 == r2
+
+
+@given(prims)
+def test_prop_idempotent(p):
+    r = build_from_prim(p)
+    r_snapshot = r.clone()
+    r.merge(r_snapshot)
+    assert r == r_snapshot
+
+
+def test_default_constructed_is_usable():
+    """LWWReg() must behave like the reference Default (marker = 0)."""
+    reg = LWWReg()
+    reg.update(5, 1)
+    assert reg == LWWReg(5, 1)

@@ -1,0 +1,594 @@
+"""Map tests — mirrors `/root/reference/test/map.rs` (all 13 unit/regression
+tests and 9 quickcheck properties) plus the in-module suite
+`/root/reference/src/map.rs:353-434`.
+
+TestMap is the nested ``Map<u8, Map<u8, MVReg<u8, u8>, u8>, u8>``
+(`test/map.rs:8`); op vectors are generated exactly as `test/map.rs:13-46`.
+"""
+
+from hypothesis import assume, given
+from hypothesis import strategies as st
+
+from crdt_tpu import Dot, Map, MVReg, VClock
+from crdt_tpu.scalar.map import Nop, Rm, Up
+from crdt_tpu.scalar.mvreg import Put
+from crdt_tpu.utils.serde import MapOf
+
+
+def new_test_map() -> Map:
+    return Map(MapOf(MVReg))
+
+
+def new_inner_map() -> Map:
+    return Map(MVReg)
+
+
+def build_opvec(prims):
+    """`test/map.rs:13-46`."""
+    actor, ops_data = prims
+    ops = []
+    for i, (choice, inner_choice, key, inner_key, val) in enumerate(ops_data):
+        clock = Dot(actor, i).to_vclock()
+        if choice % 3 == 0:
+            if inner_choice % 3 == 0:
+                inner = Up(dot=clock.inc(actor), key=inner_key, op=Put(clock=clock, val=val))
+            elif inner_choice % 3 == 1:
+                inner = Rm(clock=clock, key=inner_key)
+            else:
+                inner = Nop()
+            op = Up(dot=clock.inc(actor), key=key, op=inner)
+        elif choice % 3 == 1:
+            op = Rm(clock=clock, key=key)
+        else:
+            op = Nop()
+        ops.append(op)
+    return actor, ops
+
+
+def apply_ops(m, ops):
+    for op in ops:
+        m.apply(op)
+
+
+op_prims = st.tuples(
+    st.integers(0, 255),
+    st.lists(
+        st.tuples(*(st.integers(0, 255) for _ in range(5))),
+        max_size=8,
+    ),
+)
+
+
+# -- unit / regression tests -------------------------------------------------
+
+
+def test_new():
+    m = new_test_map()
+    assert m.len().val == 0
+
+
+def test_update():
+    """`test/map.rs:55-106`."""
+    m = new_test_map()
+
+    # constructs a default value if the key does not exist
+    ctx = m.get(101).derive_add_ctx(1)
+    op = m.update(101, ctx, lambda inner, c: inner.update(110, c, lambda r, c2: r.set(2, c2)))
+
+    assert op == Up(
+        dot=Dot(1, 1),
+        key=101,
+        op=Up(dot=Dot(1, 1), key=110, op=Put(clock=Dot(1, 1).to_vclock(), val=2)),
+    )
+
+    assert m == new_test_map()
+
+    m.apply(op)
+
+    inner = m.get(101).val
+    assert inner is not None
+    assert inner.get(110).val.read().val == [2]
+
+    # the map gives the latest val to the closure
+    def updater(inner_map, c):
+        def reg_updater(reg, c2):
+            assert reg.read().val == [2]
+            return reg.set(6, c2)
+
+        return inner_map.update(110, c, reg_updater)
+
+    op2 = m.update(101, m.get(101).derive_add_ctx(1), updater)
+    m.apply(op2)
+
+    assert m.get(101).val.get(110).val.read().val == [6]
+
+
+def test_remove():
+    """`test/map.rs:109-133`."""
+    m = new_test_map()
+    add_ctx = m.get(101).derive_add_ctx(1)
+    op = m.update(101, add_ctx.clone(), lambda mm, c: mm.update(110, c, lambda r, c2: r.set(0, c2)))
+
+    inner_map = new_inner_map()
+    inner_op = inner_map.update(110, add_ctx, lambda r, c: r.set(0, c))
+    inner_map.apply(inner_op)
+
+    m.apply(op)
+
+    read_ctx = m.get(101)
+    assert read_ctx.val == inner_map
+    assert m.len().val == 1
+    rm_op = m.rm(101, read_ctx.derive_rm_ctx())
+
+    m.apply(rm_op)
+    assert m.get(101).val is None
+    assert m.len().val == 0
+
+
+def test_reset_remove_semantics():
+    """`test/map.rs:136-169`."""
+    m1 = new_test_map()
+    op1 = m1.update(
+        101,
+        m1.get(101).derive_add_ctx(74),
+        lambda mm, c: mm.update(110, c, lambda r, c2: r.set(32, c2)),
+    )
+    m1.apply(op1)
+
+    m2 = m1.clone()
+
+    read_ctx = m1.get(101)
+    op2 = m1.rm(101, read_ctx.derive_rm_ctx())
+    m1.apply(op2)
+
+    op3 = m2.update(
+        101,
+        m2.get(101).derive_add_ctx(37),
+        lambda mm, c: mm.update(220, c, lambda r, c2: r.set(5, c2)),
+    )
+    m2.apply(op3)
+
+    m1_snapshot = m1.clone()
+    m1.merge(m2)
+    m2.merge(m1_snapshot)
+    assert m1 == m2
+
+    inner_map = m1.get(101).val
+    assert inner_map.get(220).val.read().val == [5]
+    assert inner_map.get(110).val is None
+    assert inner_map.len().val == 1
+
+
+def test_updating_with_current_clock_should_be_a_nop():
+    """`test/map.rs:172-190`: a dot with counter 0 is already seen."""
+    m1 = new_test_map()
+    m1.apply(
+        Up(
+            dot=Dot(1, 0),
+            key=0,
+            op=Up(dot=Dot(1, 0), key=1, op=Put(clock=VClock(), val=235)),
+        )
+    )
+    assert m1 == new_test_map()
+
+
+def test_concurrent_update_and_remove_add_bias():
+    """`test/map.rs:193-223`."""
+    m1 = new_test_map()
+    m2 = new_test_map()
+
+    op1 = Rm(clock=Dot(1, 1).to_vclock(), key=102)
+    op2 = m2.update(102, m2.get(102).derive_add_ctx(2), lambda _, __: Nop())
+
+    m1.apply(op1)
+    m2.apply(op2)
+
+    m1_clone = m1.clone()
+    m2_clone = m2.clone()
+
+    m1_clone.merge(m2)
+    m2_clone.merge(m1)
+
+    assert m1_clone == m2_clone
+
+    m1.apply(op2)
+    m2.apply(op1)
+
+    assert m1 == m2
+    assert m1 == m1_clone
+
+    # we bias towards adds
+    assert m1.get(102).val is not None
+
+
+def test_op_exchange_commutes_quickcheck1():
+    """`test/map.rs:226-249`: needs a true causal register (MVReg)."""
+    m1 = new_inner_map()
+    m2 = new_inner_map()
+
+    m1_op1 = m1.update(0, m1.get(0).derive_add_ctx(1), lambda r, c: r.set(0, c))
+    m1.apply(m1_op1)
+
+    m1_op2 = m1.rm(0, m1.get(0).derive_rm_ctx())
+    m1.apply(m1_op2)
+
+    m2_op1 = m2.update(0, m2.get(0).derive_add_ctx(2), lambda r, c: r.set(0, c))
+    m2.apply(m2_op1)
+
+    m1.apply(m2_op1)
+    m2.apply(m1_op1)
+    m2.apply(m1_op2)
+
+    assert m1 == m2
+
+
+def test_op_deferred_remove():
+    """`test/map.rs:252-295`."""
+    m1 = new_inner_map()
+    m2 = m1.clone()
+    m3 = m1.clone()
+
+    m1_up1 = m1.update(0, m1.get(0).derive_add_ctx(1), lambda r, c: r.set(0, c))
+    m1.apply(m1_up1)
+
+    m1_up2 = m1.update(1, m1.get(1).derive_add_ctx(1), lambda r, c: r.set(1, c))
+    m1.apply(m1_up2)
+
+    m2.apply(m1_up1)
+    m2.apply(m1_up2)
+
+    read_ctx = m2.get(0)
+    m2_rm = m2.rm(0, read_ctx.derive_rm_ctx())
+    m2.apply(m2_rm)
+
+    assert m2.get(0).val is None
+    m3.apply(m2_rm)
+    m3.apply(m1_up1)
+    m3.apply(m1_up2)
+
+    m1.apply(m2_rm)
+
+    assert m2.get(0).val is None
+    assert m3.get(1).val.read().val == [1]
+
+    assert m2 == m3
+    assert m1 == m2
+    assert m1 == m3
+
+
+def test_merge_deferred_remove():
+    """`test/map.rs:298-342`."""
+    m1 = new_test_map()
+    m2 = new_test_map()
+    m3 = new_test_map()
+
+    m1_up1 = m1.update(
+        0, m1.get(0).derive_add_ctx(1), lambda mm, c: mm.update(0, c, lambda r, c2: r.set(0, c2))
+    )
+    m1.apply(m1_up1)
+
+    m1_up2 = m1.update(
+        1, m1.get(1).derive_add_ctx(1), lambda mm, c: mm.update(1, c, lambda r, c2: r.set(1, c2))
+    )
+    m1.apply(m1_up2)
+
+    m2.apply(m1_up1)
+    m2.apply(m1_up2)
+
+    m2_rm = m2.rm(0, m2.get(0).derive_rm_ctx())
+    m2.apply(m2_rm)
+
+    m3.merge(m2)
+    m3.merge(m1)
+    m1.merge(m2)
+
+    assert m2.get(0).val is None
+    assert m3.get(1).val.get(1).val.read().val == [1]
+
+    assert m2 == m3
+    assert m1 == m2
+    assert m1 == m3
+
+
+def test_commute_quickcheck_bug():
+    """`test/map.rs:345-372`."""
+    ops = [
+        Rm(clock=Dot(45, 1).to_vclock(), key=0),
+        Up(
+            dot=Dot(45, 2),
+            key=0,
+            op=Up(dot=Dot(45, 1), key=0, op=Put(clock=VClock(), val=0)),
+        ),
+    ]
+    m = new_test_map()
+    apply_ops(m, ops)
+
+    m_snapshot = m.clone()
+    empty_m = new_test_map()
+    m.merge(empty_m)
+    empty_m.merge(m_snapshot)
+
+    assert m == empty_m
+
+
+def test_idempotent_quickcheck_bug1():
+    """`test/map.rs:375-400`."""
+    ops = [
+        Up(dot=Dot(21, 5), key=0, op=Nop()),
+        Up(
+            dot=Dot(21, 6),
+            key=1,
+            op=Up(dot=Dot(21, 1), key=0, op=Put(clock=VClock(), val=0)),
+        ),
+    ]
+    m = new_test_map()
+    apply_ops(m, ops)
+
+    m_snapshot = m.clone()
+    m.merge(m_snapshot)
+    assert m == m_snapshot
+
+
+def test_idempotent_quickcheck_bug2():
+    """`test/map.rs:403-422`."""
+    m = new_test_map()
+    m.apply(
+        Up(
+            dot=Dot(32, 5),
+            key=0,
+            op=Up(dot=Dot(32, 5), key=0, op=Put(clock=VClock(), val=0)),
+        )
+    )
+    m_snapshot = m.clone()
+    m.merge(m_snapshot)
+    assert m == m_snapshot
+
+
+def test_nop_on_new_map_should_remain_a_new_map():
+    m = new_test_map()
+    m.apply(Nop())
+    assert m == new_test_map()
+
+
+def test_op_exchange_same_as_merge_quickcheck1():
+    """`test/map.rs:432-471`."""
+    op1 = Up(dot=Dot(38, 4), key=216, op=Nop())
+    op2 = Up(
+        dot=Dot(91, 9),
+        key=216,
+        op=Up(dot=Dot(91, 1), key=37, op=Put(clock=Dot(91, 1).to_vclock(), val=94)),
+    )
+    m1 = new_test_map()
+    m2 = new_test_map()
+    m1.apply(op1)
+    m2.apply(op2)
+
+    m1_merge = m1.clone()
+    m1_merge.merge(m2)
+
+    m2_merge = m2.clone()
+    m2_merge.merge(m1)
+
+    m1.apply(op2)
+    m2.apply(op1)
+
+    assert m1 == m2
+    assert m1_merge == m2_merge
+    assert m1 == m1_merge
+    assert m2 == m2_merge
+    assert m1 == m2_merge
+    assert m2 == m1_merge
+
+
+def test_idempotent_quickcheck1():
+    """`test/map.rs:474-510`."""
+    ops = [
+        Up(
+            dot=Dot(62, 9),
+            key=47,
+            op=Up(dot=Dot(62, 1), key=65, op=Put(clock=Dot(62, 1).to_vclock(), val=240)),
+        ),
+        Up(
+            dot=Dot(62, 11),
+            key=60,
+            op=Up(dot=Dot(62, 1), key=193, op=Put(clock=Dot(62, 1).to_vclock(), val=28)),
+        ),
+    ]
+    m = new_test_map()
+    apply_ops(m, ops)
+    m_snapshot = m.clone()
+    m.merge(m_snapshot)
+    assert m == m_snapshot
+
+
+# -- in-module tests (`src/map.rs:353-434`) ---------------------------------
+
+
+def test_get():
+    """`src/map.rs:363-378`."""
+    from crdt_tpu.scalar.map import Entry
+
+    m = new_test_map()
+    assert m.get(0).val is None
+
+    op_1 = m.clock.inc(1)
+    m.clock.apply(op_1)
+
+    m.entries[0] = Entry(clock=m.clock.clone(), val=new_inner_map())
+    assert m.get(0).val == new_inner_map()
+
+
+def test_op_exchange_converges_quickcheck1():
+    """`src/map.rs:380-433`."""
+    op_actor1 = Up(
+        dot=Dot(0, 3),
+        key=9,
+        op=Up(dot=Dot(0, 3), key=0, op=Put(clock=Dot(0, 3).to_vclock(), val=0)),
+    )
+    op_1_actor2 = Up(dot=Dot(1, 1), key=9, op=Rm(clock=Dot(1, 1).to_vclock(), key=0))
+    op_2_actor2 = Rm(clock=Dot(1, 2).to_vclock(), key=9)
+
+    m1 = new_test_map()
+    m2 = new_test_map()
+
+    m1.apply(op_actor1)
+    assert m1.clock == Dot(0, 3).to_vclock()
+    assert m1.entries[9].clock == Dot(0, 3).to_vclock()
+    assert len(m1.entries[9].val.deferred) == 0
+
+    m2.apply(op_1_actor2)
+    m2.apply(op_2_actor2)
+    assert m2.clock == Dot(1, 1).to_vclock()
+    assert 9 not in m2.entries
+    assert m2.deferred.get(Dot(1, 2).to_vclock().key()) == {9}
+
+    # m1 <- m2
+    m1.apply(op_1_actor2)
+    m1.apply(op_2_actor2)
+
+    # m2 <- m1
+    m2.apply(op_actor1)
+
+    assert m1 == m2
+
+
+# -- quickcheck properties (`test/map.rs:518-745`) ---------------------------
+
+
+@given(op_prims, op_prims)
+def test_prop_op_exchange_same_as_merge(p1, p2):
+    a1, ops1 = build_opvec(p1)
+    a2, ops2 = build_opvec(p2)
+    assume(a1 != a2)
+
+    m1, m2 = new_test_map(), new_test_map()
+    apply_ops(m1, ops1)
+    apply_ops(m2, ops2)
+
+    m_merged = m1.clone()
+    m_merged.merge(m2)
+
+    apply_ops(m1, ops2)
+    apply_ops(m2, ops1)
+
+    assert m1 == m_merged
+    assert m2 == m_merged
+
+
+@given(op_prims, op_prims)
+def test_prop_op_exchange_converges(p1, p2):
+    a1, ops1 = build_opvec(p1)
+    a2, ops2 = build_opvec(p2)
+    assume(a1 != a2)
+
+    m1, m2 = new_test_map(), new_test_map()
+    apply_ops(m1, ops1)
+    apply_ops(m2, ops2)
+    apply_ops(m1, ops2)
+    apply_ops(m2, ops1)
+    assert m1 == m2
+
+
+@given(op_prims, op_prims, op_prims)
+def test_prop_op_exchange_associative(p1, p2, p3):
+    a1, ops1 = build_opvec(p1)
+    a2, ops2 = build_opvec(p2)
+    a3, ops3 = build_opvec(p3)
+    assume(a1 != a2 and a1 != a3 and a2 != a3)
+
+    m1, m2, m3 = new_test_map(), new_test_map(), new_test_map()
+    apply_ops(m1, ops1)
+    apply_ops(m2, ops2)
+    apply_ops(m3, ops3)
+
+    apply_ops(m1, ops2)
+    apply_ops(m1, ops3)
+
+    apply_ops(m2, ops3)
+    apply_ops(m2, ops1)
+
+    assert m1 == m2
+
+
+@given(op_prims)
+def test_prop_op_idempotent(p):
+    _, ops = build_opvec(p)
+    m = new_test_map()
+    apply_ops(m, ops)
+    m_snapshot = m.clone()
+    apply_ops(m, ops)
+    assert m == m_snapshot
+
+
+@given(op_prims, op_prims, op_prims)
+def test_prop_merge_associative(p1, p2, p3):
+    a1, ops1 = build_opvec(p1)
+    a2, ops2 = build_opvec(p2)
+    a3, ops3 = build_opvec(p3)
+    assume(a1 != a2 and a1 != a3 and a2 != a3)
+
+    m1, m2, m3 = new_test_map(), new_test_map(), new_test_map()
+    apply_ops(m1, ops1)
+    apply_ops(m2, ops2)
+    apply_ops(m3, ops3)
+
+    m1_snapshot = m1.clone()
+
+    # (m1 ^ m2) ^ m3
+    m1.merge(m2)
+    m1.merge(m3)
+
+    # m1 ^ (m2 ^ m3)
+    m2.merge(m3)
+    m1_snapshot.merge(m2)
+
+    assert m1 == m1_snapshot
+
+
+@given(op_prims, op_prims)
+def test_prop_merge_commutative(p1, p2):
+    a1, ops1 = build_opvec(p1)
+    a2, ops2 = build_opvec(p2)
+    assume(a1 != a2)
+
+    m1, m2 = new_test_map(), new_test_map()
+    apply_ops(m1, ops1)
+    apply_ops(m2, ops2)
+
+    m1_snapshot = m1.clone()
+    m1.merge(m2)
+    m2.merge(m1_snapshot)
+    assert m1 == m2
+
+
+@given(op_prims)
+def test_prop_merge_idempotent(p):
+    _, ops = build_opvec(p)
+    m = new_test_map()
+    apply_ops(m, ops)
+    m_snapshot = m.clone()
+    m.merge(m_snapshot)
+    assert m == m_snapshot
+
+
+@given(op_prims)
+def test_prop_truncate_with_empty_vclock_is_nop(p):
+    _, ops = build_opvec(p)
+    m = new_test_map()
+    apply_ops(m, ops)
+    m_snapshot = m.clone()
+    m.truncate(VClock())
+    assert m == m_snapshot
+
+
+def test_raising_nested_op_does_not_lose_entry():
+    """A malformed nested op must not delete the key's accumulated state."""
+    import pytest
+
+    m = new_inner_map()
+    m.apply(m.update(0, m.get(0).derive_add_ctx(1), lambda r, c: r.set(7, c)))
+    snapshot_val = m.get(0).val
+    with pytest.raises(TypeError):
+        m.apply(Up(dot=Dot(1, 99), key=0, op="not an op"))
+    assert m.get(0).val is not None
+    assert m.get(0).val.read().val == snapshot_val.read().val

@@ -1,0 +1,226 @@
+"""MVReg tests — mirrors `/root/reference/test/mvreg.rs`.
+
+Includes the op-compatibility filter (`test/mvreg.rs:120-143`), the
+no-collapse-of-equal-concurrent-values regressions (`test/mvreg.rs:36-79`),
+and the seven quickcheck properties (`test/mvreg.rs:157-320`).
+"""
+
+import dataclasses
+
+from hypothesis import assume, given
+from hypothesis import strategies as st
+
+from crdt_tpu import Dot, MVReg, VClock
+from crdt_tpu.scalar.mvreg import Put
+
+
+@dataclasses.dataclass
+class RegFixture:
+    reg: MVReg
+    ops: list
+
+
+def build_test_reg(prim_ops):
+    """`test/mvreg.rs:145-155`."""
+    reg = MVReg()
+    ops = []
+    for val, actor in prim_ops:
+        ctx = reg.read().derive_add_ctx(actor)
+        op = reg.set(val, ctx)
+        reg.apply(op)
+        ops.append(op)
+    return RegFixture(reg=reg, ops=ops)
+
+
+def ops_are_not_compatible(opss):
+    """`test/mvreg.rs:120-143`: reject op sequences that reuse an actor
+    version across registers."""
+    for a_ops in opss:
+        for b_ops in opss:
+            if b_ops is a_ops:
+                continue
+            a_clock, b_clock = VClock(), VClock()
+            for (_, a_actor), (_, b_actor) in zip(a_ops, b_ops):
+                a_clock.apply(a_clock.inc(a_actor))
+                b_clock.apply(b_clock.inc(b_actor))
+                if b_clock.get(a_actor) == a_clock.get(a_actor):
+                    return True
+    return False
+
+
+prim_ops = st.lists(st.tuples(st.integers(0, 255), st.integers(0, 255)), max_size=10)
+
+
+def test_apply():
+    reg = MVReg()
+    clock = Dot(2, 1).to_vclock()
+    reg.apply(Put(clock=clock.clone(), val=71))
+    read_ctx = reg.read()
+    assert read_ctx.add_clock == clock
+    assert read_ctx.val == [71]
+
+
+def test_set_should_not_mutate_reg():
+    reg = MVReg()
+    ctx = reg.read().derive_add_ctx(1)
+    op = reg.set(32, ctx)
+    assert reg == MVReg()
+    reg.apply(op)
+
+    read_ctx = reg.read()
+    assert read_ctx.val == [32]
+    assert read_ctx.add_clock == Dot(1, 1).to_vclock()
+
+
+def test_concurrent_update_with_same_value_dont_collapse_on_merge():
+    """`test/mvreg.rs:36-57`: collapsing breaks commutativity."""
+    r1, r2 = MVReg(), MVReg()
+    ctx_4 = r1.read().derive_add_ctx(4)
+    ctx_7 = r2.read().derive_add_ctx(7)
+
+    r1.apply(r1.set(23, ctx_4))
+    r2.apply(r2.set(23, ctx_7))
+
+    r1.merge(r2)
+    read_ctx = r1.read()
+    assert read_ctx.val == [23, 23]
+    assert read_ctx.add_clock == VClock.from_iter([(4, 1), (7, 1)])
+
+
+def test_concurrent_update_with_same_value_dont_collapse_on_apply():
+    """`test/mvreg.rs:59-79`."""
+    r1, r2 = MVReg(), MVReg()
+    ctx_4 = r1.read().derive_add_ctx(4)
+    ctx_7 = r2.read().derive_add_ctx(7)
+
+    r1.apply(r1.set(23, ctx_4))
+    r1.apply(r2.set(23, ctx_7))
+
+    read_ctx = r1.read()
+    assert read_ctx.val == [23, 23]
+    assert read_ctx.add_clock == VClock.from_iter([(4, 1), (7, 1)])
+
+
+def test_multi_val():
+    r1, r2 = MVReg(), MVReg()
+    ctx_1 = r1.read().derive_add_ctx(1)
+    ctx_2 = r2.read().derive_add_ctx(2)
+    r1.apply(r1.set(32, ctx_1))
+    r2.apply(r2.set(82, ctx_2))
+    r1.merge(r2)
+    assert sorted(r1.read().val) == [32, 82]
+
+
+def test_op_commute_quickcheck1():
+    reg1, reg2 = MVReg(), MVReg()
+    op1 = Put(clock=Dot(1, 1).to_vclock(), val=1)
+    op2 = Put(clock=Dot(2, 1).to_vclock(), val=2)
+
+    reg2.apply(op2)
+    reg2.apply(op1)
+    reg1.apply(op1)
+    reg1.apply(op2)
+
+    assert reg1 == reg2
+
+
+@given(prim_ops, st.integers(0, 255))
+def test_prop_set_with_ctx_from_read(r_ops, a):
+    reg = build_test_reg(r_ops).reg
+    write_ctx = reg.read().derive_add_ctx(a)
+    reg.apply(reg.set(23, write_ctx))
+    assert reg.read().val == [23]
+
+
+@given(prim_ops)
+def test_prop_merge_idempotent(r_ops):
+    r = build_test_reg(r_ops).reg
+    r_snapshot = r.clone()
+    r.merge(r_snapshot)
+    assert r == r_snapshot
+
+
+@given(prim_ops, prim_ops)
+def test_prop_merge_commutative(r1_ops, r2_ops):
+    assume(not ops_are_not_compatible([r1_ops, r2_ops]))
+    r1 = build_test_reg(r1_ops).reg
+    r2 = build_test_reg(r2_ops).reg
+
+    r1_snapshot = r1.clone()
+    r1.merge(r2)
+    r2.merge(r1_snapshot)
+    assert r1 == r2
+
+
+@given(prim_ops, prim_ops, prim_ops)
+def test_prop_merge_associative(r1_ops, r2_ops, r3_ops):
+    assume(not ops_are_not_compatible([r1_ops, r2_ops, r3_ops]))
+    r1 = build_test_reg(r1_ops).reg
+    r2 = build_test_reg(r2_ops).reg
+    r3 = build_test_reg(r3_ops).reg
+    r1_snapshot = r1.clone()
+
+    r1.merge(r2)  # r1 ^ r2
+    r1.merge(r3)  # (r1 ^ r2) ^ r3
+    r2.merge(r3)  # r2 ^ r3
+    r2.merge(r1_snapshot)  # r1 ^ (r2 ^ r3)
+
+    assert r1 == r2
+
+
+@given(prim_ops)
+def test_prop_truncate(r_ops):
+    r = build_test_reg(r_ops).reg
+    r_snapshot = r.clone()
+
+    # truncating with the empty clock is a no-op
+    r.truncate(VClock())
+    assert r == r_snapshot
+
+    # truncating with the merge of all val clocks empties the register
+    clock = r.read().add_clock
+    r.truncate(clock)
+    assert r == MVReg()
+
+
+@given(prim_ops)
+def test_prop_op_idempotent(r_ops):
+    test = build_test_reg(r_ops)
+    r = test.reg
+    r_snapshot = r.clone()
+    for op in test.ops:
+        r.apply(op)
+    assert r == r_snapshot
+
+
+@given(prim_ops, prim_ops)
+def test_prop_op_commutative(o1_ops, o2_ops):
+    assume(not ops_are_not_compatible([o1_ops, o2_ops]))
+    o1 = build_test_reg(o1_ops)
+    o2 = build_test_reg(o2_ops)
+    r1, r2 = o1.reg, o2.reg
+
+    for op in o2.ops:
+        r1.apply(op)
+    for op in o1.ops:
+        r2.apply(op)
+    assert r1 == r2
+
+
+@given(prim_ops, prim_ops, prim_ops)
+def test_prop_op_associative(o1_ops, o2_ops, o3_ops):
+    assume(not ops_are_not_compatible([o1_ops, o2_ops, o3_ops]))
+    o1 = build_test_reg(o1_ops)
+    o2 = build_test_reg(o2_ops)
+    o3 = build_test_reg(o3_ops)
+    r1, r2 = o1.reg, o2.reg
+
+    for op in o2.ops:
+        r1.apply(op)
+    for op in o3.ops:
+        r1.apply(op)
+    for op in o3.ops:
+        r2.apply(op)
+    for op in o1.ops:
+        r2.apply(op)
+    assert r1 == r2

@@ -1,0 +1,58 @@
+"""PNCounter tests — mirrors `/root/reference/test/pncounter.rs`."""
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import Dot, PNCounter
+from crdt_tpu.scalar.pncounter import Dir, Op
+
+ACTOR_MAX = 11
+
+
+def build_op(prims):
+    """`test/pncounter.rs:9-19`."""
+    actor, counter, dir_choice = prims
+    return Op(dot=Dot(actor, counter), dir=Dir.POS if dir_choice else Dir.NEG)
+
+
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(0, 255),
+            st.integers(0, 2**64 - 1),
+            st.booleans(),
+        ),
+        max_size=20,
+    )
+)
+def test_prop_merge_converges(op_prims):
+    """`test/pncounter.rs:22-51`: interleaving over 2..11 witnesses converges."""
+    ops = [build_op(p) for p in op_prims]
+    results = set()
+    for i in range(2, ACTOR_MAX):
+        witnesses = [PNCounter() for _ in range(i)]
+        for op in ops:
+            witnesses[op.dot.actor % i].apply(op)
+        merged = PNCounter()
+        for witness in witnesses:
+            merged.merge(witness)
+        results.add(merged.value())
+    assert len(results) == 1
+
+
+def test_basic():
+    """`test/pncounter.rs:55-74`."""
+    a = PNCounter()
+    assert a.value() == 0
+
+    a.apply(a.inc("A"))
+    assert a.value() == 1
+
+    a.apply(a.inc("A"))
+    assert a.value() == 2
+
+    a.apply(a.dec("A"))
+    assert a.value() == 1
+
+    a.apply(a.inc("A"))
+    assert a.value() == 2

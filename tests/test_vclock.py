@@ -1,0 +1,205 @@
+"""VClock tests — mirrors `/root/reference/test/vclock.rs`.
+
+Six quickcheck properties (`test/vclock.rs:14-67`) as hypothesis properties,
+plus the unit tests including the full ordering matrix
+(`test/vclock.rs:134-189`).
+"""
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import VClock
+
+actors = st.integers(min_value=0, max_value=255)
+counters = st.integers(min_value=0, max_value=2**64 - 1)
+
+
+def build_vclock(prims):
+    """`test/vclock.rs:5-12`: inc+apply per listed actor."""
+    v = VClock()
+    for actor in prims:
+        op = v.inc(actor)
+        v.apply(op)
+    return v
+
+
+@given(st.lists(actors))
+def test_prop_from_iter_of_iter_is_nop(prims):
+    clock = build_vclock(prims)
+    assert clock == VClock.from_iter(iter(clock.clone()))
+
+
+@given(st.lists(st.tuples(actors, counters)))
+def test_prop_from_iter_order_of_dots_should_not_matter(dots):
+    reverse = VClock.from_iter(reversed(dots))
+    forward = VClock.from_iter(dots)
+    assert reverse == forward
+
+
+@given(st.lists(st.tuples(actors, counters)))
+def test_prop_from_iter_dots_should_be_idempotent(dots):
+    single = VClock.from_iter(dots)
+    double = VClock.from_iter(list(dots) + list(dots))
+    assert single == double
+
+
+@given(st.lists(actors))
+def test_prop_truncate_self_is_nop(prims):
+    clock = build_vclock(prims)
+    clock_truncated = clock.clone()
+    clock_truncated.truncate(clock)
+    assert clock_truncated == clock
+
+
+@given(st.lists(actors))
+def test_prop_subtract_with_empty_is_nop(prims):
+    clock = build_vclock(prims)
+    subbed = clock.clone()
+    subbed.subtract(VClock())
+    assert subbed == clock
+
+
+@given(st.lists(actors))
+def test_prop_subtract_self_is_empty(prims):
+    clock = build_vclock(prims)
+    subbed = clock.clone()
+    subbed.subtract(clock)
+    assert subbed == VClock()
+
+
+def test_subtract():
+    a = VClock.from_iter([(1, 4), (2, 3), (5, 9)])
+    b = VClock.from_iter([(1, 5), (2, 3), (5, 8)])
+    expected = VClock.from_iter([(5, 9)])
+    a.subtract(b)
+    assert a == expected
+
+
+def test_merge():
+    a = VClock.from_iter([(1, 1), (2, 2), (4, 4)])
+    b = VClock.from_iter([(3, 3), (4, 3)])
+    a.merge(b)
+    c = VClock.from_iter([(1, 1), (2, 2), (3, 3), (4, 4)])
+    assert a == c
+
+
+def test_merge_less_left():
+    a, b = VClock(), VClock()
+    a.witness(5, 5)
+    b.witness(6, 6)
+    b.witness(7, 7)
+    a.merge(b)
+    assert a.get(5) == 5
+    assert a.get(6) == 6
+    assert a.get(7) == 7
+
+
+def test_merge_less_right():
+    a, b = VClock(), VClock()
+    a.witness(6, 6)
+    a.witness(7, 7)
+    b.witness(5, 5)
+    a.merge(b)
+    assert a.get(5) == 5
+    assert a.get(6) == 6
+    assert a.get(7) == 7
+
+
+def test_merge_same_id():
+    a, b = VClock(), VClock()
+    a.witness(1, 1)
+    a.witness(2, 1)
+    b.witness(1, 1)
+    b.witness(3, 1)
+    a.merge(b)
+    assert a.get(1) == 1
+    assert a.get(2) == 1
+    assert a.get(3) == 1
+
+
+def test_vclock_ordering():
+    assert VClock() == VClock()
+
+    a, b = VClock(), VClock()
+    a.witness("A", 1)
+    a.witness("A", 2)
+    a.witness("A", 0)
+    b.witness("A", 1)
+    # a {A:2}, b {A:1} — a dominates
+    assert a > b
+    assert b < a
+    assert a != b
+
+    b.witness("A", 3)
+    # a {A:2}, b {A:3} — b dominates
+    assert b > a
+    assert a < b
+    assert a != b
+
+    a.witness("B", 1)
+    # a {A:2, B:1}, b {A:3} — concurrent
+    assert a != b
+    assert not (a > b)
+    assert not (b > a)
+    assert a.concurrent(b)
+
+    a.witness("A", 3)
+    # a {A:3, B:1}, b {A:3} — a dominates
+    assert a > b
+    assert b < a
+    assert a != b
+
+    b.witness("B", 2)
+    # a {A:3, B:1}, b {A:3, B:2} — b dominates
+    assert b > a
+    assert a < b
+    assert a != b
+
+    a.witness("B", 2)
+    # equal
+    assert not (b > a)
+    assert not (a > b)
+    assert a == b
+
+
+def test_truncate_doc_example():
+    """Doctest from `vclock.rs:88-102`."""
+    c = VClock()
+    c.witness(23, 6)
+    c.witness(89, 14)
+    c2 = c.clone()
+
+    c.truncate(c2)  # no-op
+    assert c == c2
+
+    c.witness(43, 1)
+    assert c.get(43) == 1
+    c.truncate(c2)  # removes the 43 => 1 entry
+    assert c.get(43) == 0
+
+
+def test_witness_dominated_is_ignored():
+    """Doctest from `vclock.rs:148-163`."""
+    a, b = VClock(), VClock()
+    a.witness("A", 2)
+    a.witness("A", 0)  # ignored — 2 dominates 0
+    b.witness("A", 1)
+    assert a > b
+
+
+def test_concurrent_doc_example():
+    """Doctest from `vclock.rs:189-199`."""
+    a, b = VClock(), VClock()
+    a_op = a.inc("A")
+    a.apply(a_op)
+    b_op = b.inc("B")
+    b.apply(b_op)
+    assert a.concurrent(b)
+
+
+def test_from_dot():
+    from crdt_tpu import Dot
+
+    clock = Dot("A", 3).to_vclock()
+    assert clock.get("A") == 3
+    assert len(clock) == 1

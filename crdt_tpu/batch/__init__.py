@@ -1,0 +1,33 @@
+"""The TPU batch engine: SoA CRDT batches behind the scalar contracts.
+
+Each type here is a frozen pytree (``flax.struct``) of dense device arrays —
+N CRDT replicas/objects per batch — whose ``merge`` is a jitted lattice-join
+kernel from :mod:`crdt_tpu.ops`, vectorized over the object axis and sharded
+over a device mesh by :mod:`crdt_tpu.parallel`.
+
+Conversion to/from the scalar engine (``from_scalar`` / ``to_scalar``) is the
+parity boundary: tests pack random scalar states, merge on device, unpack,
+and compare bit-for-bit with the scalar merge (SURVEY.md §7.0).
+"""
+
+from ..config import enable_x64 as _enable_x64
+
+_enable_x64()
+
+from .vclock_batch import VClockBatch
+from .gcounter_batch import GCounterBatch
+from .pncounter_batch import PNCounterBatch
+from .lwwreg_batch import LWWRegBatch
+from .mvreg_batch import MVRegBatch
+from .orswot_batch import OrswotBatch
+from .gset_batch import GSetBatch
+
+__all__ = [
+    "GCounterBatch",
+    "GSetBatch",
+    "LWWRegBatch",
+    "MVRegBatch",
+    "OrswotBatch",
+    "PNCounterBatch",
+    "VClockBatch",
+]

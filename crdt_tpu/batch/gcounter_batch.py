@@ -1,0 +1,56 @@
+"""GCounterBatch — N grow-only counters (`/root/reference/src/gcounter.rs`).
+
+A GCounter *is* a VClock (`gcounter.rs:26-28`); the batch reuses the clock
+buffer and adds the sum reduction for ``value`` (`gcounter.rs:76-78`).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from flax import struct
+
+from ..ops import clock_ops, counter_ops
+from ..scalar.gcounter import GCounter
+from ..utils.interning import Universe
+from .vclock_batch import VClockBatch
+
+
+@struct.dataclass
+class GCounterBatch:
+    clocks: jax.Array  # u64[N, A]
+
+    @classmethod
+    def zeros(cls, n: int, universe: Universe) -> "GCounterBatch":
+        return cls(clocks=clock_ops.zeros((n, universe.config.num_actors)))
+
+    @classmethod
+    def from_scalar(cls, states: Sequence[GCounter], universe: Universe) -> "GCounterBatch":
+        inner = VClockBatch.from_scalar([g.inner for g in states], universe)
+        return cls(clocks=inner.clocks)
+
+    def to_scalar(self, universe: Universe) -> list[GCounter]:
+        return [GCounter(vc) for vc in VClockBatch(clocks=self.clocks).to_scalar(universe)]
+
+    def merge(self, other: "GCounterBatch") -> "GCounterBatch":
+        """`gcounter.rs:58-62`."""
+        return GCounterBatch(clocks=_merge(self.clocks, other.clocks))
+
+    def inc(self, actor_idx) -> "GCounterBatch":
+        """Increment each counter at the given actor column (apply of the
+        ``inc`` dot, `gcounter.rs:71-73`)."""
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(actor_idx)
+        counter = clock_ops.inc_counter(self.clocks, idx)
+        return GCounterBatch(clocks=clock_ops.witness(self.clocks, idx, counter))
+
+    def value(self):
+        """`gcounter.rs:76-78`."""
+        return counter_ops.gcounter_value(self.clocks)
+
+
+@jax.jit
+def _merge(a, b):
+    return counter_ops.gcounter_merge(a, b)

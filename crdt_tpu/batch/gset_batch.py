@@ -1,0 +1,83 @@
+"""GSetBatch — N grow-only sets as a membership bitmap.
+
+The reference GSet (`/root/reference/src/gset.rs`) is a BTreeSet with
+merge = union; the dense form is ``bool[N, U]`` over the interned member
+universe, so merge is a single elementwise OR — the simplest possible
+lattice join on the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..scalar.gset import GSet
+from ..utils.interning import Universe
+
+
+@struct.dataclass
+class GSetBatch:
+    bits: jax.Array  # bool[N, U]
+
+    @classmethod
+    def zeros(cls, n: int, member_capacity: int) -> "GSetBatch":
+        return cls(bits=jnp.zeros((n, member_capacity), dtype=bool))
+
+    @classmethod
+    def from_scalar(
+        cls, states: Sequence[GSet], universe: Universe, member_capacity: int
+    ) -> "GSetBatch":
+        import numpy as np
+
+        buf = np.zeros((len(states), member_capacity), dtype=bool)
+        for i, s in enumerate(states):
+            for e in s.value:
+                mid = universe.member_id(e)
+                if mid >= member_capacity:
+                    raise ValueError(
+                        f"member universe overflow: id {mid} >= capacity {member_capacity}"
+                    )
+                buf[i, mid] = True
+        return cls(bits=jnp.asarray(buf))
+
+    def to_scalar(self, universe: Universe) -> list[GSet]:
+        import numpy as np
+
+        out = []
+        for row in np.asarray(self.bits):
+            out.append(GSet({universe.members.lookup(int(i)) for i in np.nonzero(row)[0]}))
+        return out
+
+    def merge(self, other: "GSetBatch") -> "GSetBatch":
+        """Union (`gset.rs:30-34`)."""
+        return GSetBatch(bits=_merge(self.bits, other.bits))
+
+    def _check_ids(self, member_ids):
+        """The member registry is unbounded; the bitmap is not.  Reject ids
+        past the bitmap width instead of silently dropping them (insert)
+        or reading clamped garbage (contains)."""
+        import numpy as np
+
+        ids = np.asarray(member_ids)
+        cap = self.bits.shape[-1]
+        if (ids < 0).any() or (ids >= cap).any():
+            bad = ids[(ids < 0) | (ids >= cap)]
+            raise ValueError(f"member id(s) {bad.tolist()} out of bitmap capacity {cap}")
+        return jnp.asarray(member_ids)
+
+    def insert(self, member_ids) -> "GSetBatch":
+        ids = self._check_ids(member_ids)
+        onehot = jnp.arange(self.bits.shape[-1]) == ids[..., None]
+        return GSetBatch(bits=self.bits | onehot)
+
+    def contains(self, member_ids):
+        ids = self._check_ids(member_ids)
+        return jnp.take_along_axis(self.bits, ids[..., None], axis=-1)[..., 0]
+
+
+@jax.jit
+def _merge(a, b):
+    return a | b

@@ -1,0 +1,77 @@
+"""LWWRegBatch — N last-write-wins registers (`/root/reference/src/lwwreg.rs`).
+
+Columns ``(vals u64[N], markers u64[N])``.  Values are interned payload ids
+(any hashable Python value) or raw u64s; markers are unsigned ints (the
+reference allows any Ord marker — the 10M-register benchmark uses u64
+timestamps).  ``merge`` surfaces per-element conflicts as a bitmap and the
+host raises :class:`ConflictingMarker`, keeping scalar error parity
+(`lwwreg.rs:56-66`, SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import counter_dtype
+from ..error import ConflictingMarker
+from ..ops import lww_ops
+from ..scalar.lwwreg import LWWReg
+from ..utils.interning import Universe
+
+
+@struct.dataclass
+class LWWRegBatch:
+    vals: jax.Array  # u64[N] — payload ids (interned via universe.members)
+    markers: jax.Array  # u64[N]
+
+    @classmethod
+    def from_scalar(cls, states: Sequence[LWWReg], universe: Universe) -> "LWWRegBatch":
+        import numpy as np
+
+        dt = counter_dtype()
+        vals = np.asarray([universe.member_id(s.val) for s in states], dtype=dt)
+        markers = np.asarray([s.marker for s in states], dtype=dt)
+        return cls(vals=jnp.asarray(vals), markers=jnp.asarray(markers))
+
+    def to_scalar(self, universe: Universe) -> list[LWWReg]:
+        import numpy as np
+
+        vals = np.asarray(self.vals)
+        markers = np.asarray(self.markers)
+        return [
+            LWWReg(val=universe.members.lookup(int(v)), marker=int(m))
+            for v, m in zip(vals, markers)
+        ]
+
+    def merge(self, other: "LWWRegBatch", check: bool = True) -> "LWWRegBatch":
+        """Pairwise merge; raises :class:`ConflictingMarker` if any element
+        hit an equal-marker/different-value conflict (`lwwreg.rs:56-66`).
+
+        Pass ``check=False`` to skip the host sync and fetch the bitmap
+        later via :meth:`merge_with_conflicts` semantics."""
+        vals, markers, conflict = _merge(self.vals, self.markers, other.vals, other.markers)
+        if check and bool(jnp.any(conflict)):
+            idx = jnp.nonzero(conflict)[0]
+            raise ConflictingMarker(f"{idx.shape[0]} conflicting marker(s), first at {int(idx[0])}")
+        return LWWRegBatch(vals=vals, markers=markers)
+
+    def merge_with_conflicts(self, other: "LWWRegBatch"):
+        """Returns ``(merged, conflict_bitmap)`` without host sync."""
+        vals, markers, conflict = _merge(self.vals, self.markers, other.vals, other.markers)
+        return LWWRegBatch(vals=vals, markers=markers), conflict
+
+    def update(self, new_vals, new_markers):
+        """Batched ``update`` (`lwwreg.rs:104-118`); raises on conflict."""
+        vals, markers, conflict = _merge(self.vals, self.markers, jnp.asarray(new_vals), jnp.asarray(new_markers))
+        if bool(jnp.any(conflict)):
+            raise ConflictingMarker()
+        return LWWRegBatch(vals=vals, markers=markers)
+
+
+@jax.jit
+def _merge(va, ma, vb, mb):
+    return lww_ops.merge(va, ma, vb, mb)

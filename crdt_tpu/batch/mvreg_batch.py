@@ -1,0 +1,105 @@
+"""MVRegBatch — N multi-value registers (`/root/reference/src/mvreg.rs`).
+
+Padded antichain per register: ``clocks u64[N, K, A]`` + payload ids
+``vals u64[N, K]``; a slot is live iff its clock is non-empty.  Merge keeps
+mutually-undominated values from both sides deduped by clock
+(`mvreg.rs:121-153`) and re-packs into K slots, raising on overflow.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import counter_dtype
+from ..ops import clock_ops, mvreg_ops
+from ..scalar.mvreg import MVReg
+from ..utils.interning import Universe
+from .vclock_batch import VClockBatch
+
+
+@struct.dataclass
+class MVRegBatch:
+    clocks: jax.Array  # u64[N, K, A]
+    vals: jax.Array  # u64[N, K] — interned payload ids
+
+    @classmethod
+    def zeros(cls, n: int, universe: Universe) -> "MVRegBatch":
+        cfg = universe.config
+        return cls(
+            clocks=clock_ops.zeros((n, cfg.mv_capacity, cfg.num_actors)),
+            vals=jnp.zeros((n, cfg.mv_capacity), dtype=counter_dtype()),
+        )
+
+    @classmethod
+    def from_scalar(cls, states: Sequence[MVReg], universe: Universe) -> "MVRegBatch":
+        import numpy as np
+
+        cfg = universe.config
+        k, a = cfg.mv_capacity, cfg.num_actors
+        dt = counter_dtype()
+        clocks = np.zeros((len(states), k, a), dtype=dt)
+        vals = np.zeros((len(states), k), dtype=dt)
+        for i, reg in enumerate(states):
+            if len(reg.vals) > k:
+                raise ValueError(f"register {i} has {len(reg.vals)} values > mv_capacity {k}")
+            for j, (vc, val) in enumerate(reg.vals):
+                for actor, counter in vc.dots.items():
+                    clocks[i, j, universe.actor_idx(actor)] = counter
+                vals[i, j] = universe.member_id(val)
+        return cls(clocks=jnp.asarray(clocks), vals=jnp.asarray(vals))
+
+    def to_scalar(self, universe: Universe) -> list[MVReg]:
+        import numpy as np
+
+        from .vclock_batch import row_to_vclock
+
+        clocks = np.asarray(self.clocks)
+        vals = np.asarray(self.vals)
+        out = []
+        for i in range(clocks.shape[0]):
+            pairs = [
+                (row_to_vclock(clocks[i, j], universe), universe.members.lookup(int(vals[i, j])))
+                for j in range(clocks.shape[1])
+                if clocks[i, j].any()
+            ]
+            out.append(MVReg(pairs))
+        return out
+
+    def merge(self, other: "MVRegBatch", check: bool = True) -> "MVRegBatch":
+        """`mvreg.rs:121-153`; raises on antichain overflow past K."""
+        k = self.clocks.shape[-2]
+        clocks, vals, overflow = _merge(self.clocks, self.vals, other.clocks, other.vals, k)
+        if check and bool(jnp.any(overflow)):
+            raise ValueError("MVReg antichain overflow: raise CrdtConfig.mv_capacity")
+        return MVRegBatch(clocks=clocks, vals=vals)
+
+    def apply_put(self, op_clocks, op_vals, check: bool = True) -> "MVRegBatch":
+        """Batched ``Op::Put`` (`mvreg.rs:158-186`), one op per register."""
+        k = self.clocks.shape[-2]
+        clocks, vals, overflow = _apply_put(
+            self.clocks, self.vals, jnp.asarray(op_clocks), jnp.asarray(op_vals), k
+        )
+        if check and bool(jnp.any(overflow)):
+            raise ValueError("MVReg antichain overflow: raise CrdtConfig.mv_capacity")
+        return MVRegBatch(clocks=clocks, vals=vals)
+
+    def read_clock(self):
+        """Folded clock per register (`mvreg.rs:216-222`)."""
+        return mvreg_ops.read_clock(self.clocks)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _merge(ca, va, cb, vb, k_cap):
+    clocks, vals, keep = mvreg_ops.merge(ca, va, cb, vb)
+    return mvreg_ops.compact(clocks, vals, keep, k_cap)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _apply_put(clocks, vals, op_clock, op_val, k_cap):
+    clocks2, vals2, keep = mvreg_ops.apply_put(clocks, vals, op_clock, op_val)
+    return mvreg_ops.compact(clocks2, vals2, keep, k_cap)

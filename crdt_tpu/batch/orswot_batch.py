@@ -1,0 +1,189 @@
+"""OrswotBatch — N add-wins OR-sets on device (the flagship type).
+
+Dense form of `/root/reference/src/orswot.rs:26-30`: set clock, member-slot
+tables (interned ids + per-member dot clocks) and a deferred-remove table.
+``merge`` runs the vectorized dot-algebra kernel
+(:func:`crdt_tpu.ops.orswot_ops.merge`); the op path (`apply_add` /
+`apply_remove`) applies one op per object across the batch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import counter_dtype
+from ..ops import orswot_ops
+from ..scalar.orswot import Orswot
+from ..scalar.vclock import VClock
+from ..utils.interning import Universe
+from .vclock_batch import VClockBatch
+
+
+@struct.dataclass
+class OrswotBatch:
+    clock: jax.Array  # u64[N, A]
+    ids: jax.Array  # int32[N, M]  (-1 = empty)
+    dots: jax.Array  # u64[N, M, A]
+    d_ids: jax.Array  # int32[N, D] (-1 = empty)
+    d_clocks: jax.Array  # u64[N, D, A]
+
+    @classmethod
+    def zeros(cls, n: int, universe: Universe) -> "OrswotBatch":
+        cfg = universe.config
+        a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
+        dt = counter_dtype()
+        return cls(
+            clock=jnp.zeros((n, a), dtype=dt),
+            ids=jnp.full((n, m), orswot_ops.EMPTY, dtype=jnp.int32),
+            dots=jnp.zeros((n, m, a), dtype=dt),
+            d_ids=jnp.full((n, d), orswot_ops.EMPTY, dtype=jnp.int32),
+            d_clocks=jnp.zeros((n, d, a), dtype=dt),
+        )
+
+    @classmethod
+    def from_scalar(cls, states: Sequence[Orswot], universe: Universe) -> "OrswotBatch":
+        import numpy as np
+
+        cfg = universe.config
+        n = len(states)
+        a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
+        dt = counter_dtype()
+        clock = np.zeros((n, a), dtype=dt)
+        ids = np.full((n, m), orswot_ops.EMPTY, dtype=np.int32)
+        dots = np.zeros((n, m, a), dtype=dt)
+        d_ids = np.full((n, d), orswot_ops.EMPTY, dtype=np.int32)
+        d_clocks = np.zeros((n, d, a), dtype=dt)
+
+        for i, s in enumerate(states):
+            for actor, counter in s.clock.dots.items():
+                clock[i, universe.actor_idx(actor)] = counter
+            if len(s.entries) > m:
+                raise ValueError(f"object {i}: {len(s.entries)} members > member_capacity {m}")
+            for j, (member, vc) in enumerate(s.entries.items()):
+                ids[i, j] = universe.member_id(member)
+                for actor, counter in vc.dots.items():
+                    dots[i, j, universe.actor_idx(actor)] = counter
+            rows = [
+                (ck, member) for ck, members in s.deferred.items() for member in members
+            ]
+            if len(rows) > d:
+                raise ValueError(f"object {i}: {len(rows)} deferred rows > deferred_capacity {d}")
+            for j, (ck, member) in enumerate(rows):
+                d_ids[i, j] = universe.member_id(member)
+                for actor, counter in ck:
+                    d_clocks[i, j, universe.actor_idx(actor)] = counter
+
+        return cls(
+            clock=jnp.asarray(clock),
+            ids=jnp.asarray(ids),
+            dots=jnp.asarray(dots),
+            d_ids=jnp.asarray(d_ids),
+            d_clocks=jnp.asarray(d_clocks),
+        )
+
+    def to_scalar(self, universe: Universe) -> list[Orswot]:
+        import numpy as np
+
+        clock = np.asarray(self.clock)
+        ids = np.asarray(self.ids)
+        dots = np.asarray(self.dots)
+        d_ids = np.asarray(self.d_ids)
+        d_clocks = np.asarray(self.d_clocks)
+
+        from .vclock_batch import row_to_vclock
+
+        out = []
+        for i in range(clock.shape[0]):
+            s = Orswot()
+            s.clock = row_to_vclock(clock[i], universe)
+            for j in range(ids.shape[1]):
+                if ids[i, j] != orswot_ops.EMPTY:
+                    s.entries[universe.members.lookup(int(ids[i, j]))] = row_to_vclock(
+                        dots[i, j], universe
+                    )
+            for j in range(d_ids.shape[1]):
+                if d_ids[i, j] != orswot_ops.EMPTY:
+                    ck = row_to_vclock(d_clocks[i, j], universe).key()
+                    s.deferred.setdefault(ck, set()).add(
+                        universe.members.lookup(int(d_ids[i, j]))
+                    )
+            out.append(s)
+        return out
+
+    # -- state path -------------------------------------------------------
+
+    def merge(self, other: "OrswotBatch", check: bool = True) -> "OrswotBatch":
+        """Pairwise ORSWOT merge (`orswot.rs:89-156`)."""
+        m_cap = self.ids.shape[-1]
+        d_cap = self.d_ids.shape[-1]
+        clock, ids, dots, d_ids, d_clocks, overflow = _merge(
+            self.clock, self.ids, self.dots, self.d_ids, self.d_clocks,
+            other.clock, other.ids, other.dots, other.d_ids, other.d_clocks,
+            m_cap, d_cap,
+        )
+        if check and bool(jnp.any(overflow)):
+            raise ValueError(
+                "Orswot capacity overflow in merge: raise member_capacity/deferred_capacity"
+            )
+        return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
+
+    # -- op path ----------------------------------------------------------
+
+    def apply_add(self, actor_idx, counter, member_id, check: bool = True) -> "OrswotBatch":
+        """One ``Op::Add`` per object (`orswot.rs:66-79`)."""
+        clock, ids, dots, d_ids, d_clocks, overflow = _apply_add(
+            self.clock, self.ids, self.dots, self.d_ids, self.d_clocks,
+            jnp.asarray(actor_idx), jnp.asarray(counter), jnp.asarray(member_id),
+        )
+        if check and bool(jnp.any(overflow)):
+            raise ValueError("Orswot member_capacity overflow in apply_add")
+        return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
+
+    def apply_remove(self, rm_clock, member_id, check: bool = True) -> "OrswotBatch":
+        """One ``Op::Rm`` per object (`orswot.rs:80-83,195-211`)."""
+        clock, ids, dots, d_ids, d_clocks, overflow = _apply_remove(
+            self.clock, self.ids, self.dots, self.d_ids, self.d_clocks,
+            jnp.asarray(rm_clock), jnp.asarray(member_id),
+        )
+        if check and bool(jnp.any(overflow)):
+            raise ValueError("Orswot deferred_capacity overflow in apply_remove")
+        return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
+
+    # -- reads ------------------------------------------------------------
+
+    def contains(self, member_id):
+        """Membership bitmap (`orswot.rs:214-224`)."""
+        return orswot_ops.contains(self.ids, jnp.asarray(member_id))
+
+    def member_count(self):
+        return jnp.sum(self.ids != orswot_ops.EMPTY, axis=-1)
+
+    def value_sets(self, universe: Universe) -> list[set]:
+        """``value()`` per object (`orswot.rs:227-233`)."""
+        import numpy as np
+
+        ids = np.asarray(self.ids)
+        return [
+            {universe.members.lookup(int(x)) for x in row if x != orswot_ops.EMPTY}
+            for row in ids
+        ]
+
+
+@functools.partial(jax.jit, static_argnums=(10, 11))
+def _merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap):
+    return orswot_ops.merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap)
+
+
+@jax.jit
+def _apply_add(clock, ids, dots, d_ids, d_clocks, actor_idx, counter, member_id):
+    return orswot_ops.apply_add(clock, ids, dots, d_ids, d_clocks, actor_idx, counter, member_id)
+
+
+@jax.jit
+def _apply_remove(clock, ids, dots, d_ids, d_clocks, rm_clock, member_id):
+    return orswot_ops.apply_remove(clock, ids, dots, d_ids, d_clocks, rm_clock, member_id)

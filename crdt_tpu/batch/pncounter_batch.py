@@ -1,0 +1,66 @@
+"""PNCounterBatch — N inc/dec counters (`/root/reference/src/pncounter.rs`).
+
+Two stacked GCounter planes: ``u64[N, 2, A]`` (P = plane 0, N = plane 1,
+`pncounter.rs:33-36`); merge is one fused max, value is P − N.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..ops import clock_ops, counter_ops
+from ..scalar.pncounter import PNCounter
+from ..utils.interning import Universe
+from .vclock_batch import VClockBatch
+
+
+@struct.dataclass
+class PNCounterBatch:
+    planes: jax.Array  # u64[N, 2, A]
+
+    @classmethod
+    def zeros(cls, n: int, universe: Universe) -> "PNCounterBatch":
+        return cls(planes=clock_ops.zeros((n, 2, universe.config.num_actors)))
+
+    @classmethod
+    def from_scalar(cls, states: Sequence[PNCounter], universe: Universe) -> "PNCounterBatch":
+        p = VClockBatch.from_scalar([s.p.inner for s in states], universe)
+        n = VClockBatch.from_scalar([s.n.inner for s in states], universe)
+        return cls(planes=jnp.stack([p.clocks, n.clocks], axis=1))
+
+    def to_scalar(self, universe: Universe) -> list[PNCounter]:
+        from ..scalar.gcounter import GCounter
+
+        p = VClockBatch(clocks=self.planes[:, 0]).to_scalar(universe)
+        n = VClockBatch(clocks=self.planes[:, 1]).to_scalar(universe)
+        return [PNCounter(GCounter(pi), GCounter(ni)) for pi, ni in zip(p, n)]
+
+    def merge(self, other: "PNCounterBatch") -> "PNCounterBatch":
+        """`pncounter.rs:90-95`."""
+        return PNCounterBatch(planes=_merge(self.planes, other.planes))
+
+    def inc(self, actor_idx) -> "PNCounterBatch":
+        return self._bump(actor_idx, 0)
+
+    def dec(self, actor_idx) -> "PNCounterBatch":
+        return self._bump(actor_idx, 1)
+
+    def _bump(self, actor_idx, plane: int) -> "PNCounterBatch":
+        idx = jnp.asarray(actor_idx)
+        target = self.planes[:, plane]
+        counter = clock_ops.inc_counter(target, idx)
+        updated = clock_ops.witness(target, idx, counter)
+        return PNCounterBatch(planes=self.planes.at[:, plane].set(updated))
+
+    def value(self):
+        """`pncounter.rs:117-119`."""
+        return counter_ops.pncounter_value(self.planes)
+
+
+@jax.jit
+def _merge(a, b):
+    return counter_ops.pncounter_merge(a, b)

@@ -1,0 +1,92 @@
+"""VClockBatch — N dense vector clocks on device.
+
+The dense equivalent of `/root/reference/src/vclock.rs`: shape ``[N, A]``,
+actor columns assigned by a :class:`crdt_tpu.utils.interning.Universe`.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..config import counter_dtype
+from ..ops import clock_ops
+from ..scalar.vclock import VClock
+from ..utils.interning import Universe
+
+
+def row_to_vclock(row, universe: Universe) -> VClock:
+    """Convert one dense numpy clock row back to a scalar VClock.
+
+    Shared by every batch type's ``to_scalar`` — operates on host numpy
+    data, no device round-trips."""
+    import numpy as np
+
+    vc = VClock()
+    for idx in np.nonzero(row)[0]:
+        vc.dots[universe.actors.lookup(int(idx))] = int(row[idx])
+    return vc
+
+
+@struct.dataclass
+class VClockBatch:
+    clocks: jax.Array  # u64[N, A]
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def zeros(cls, n: int, universe: Universe) -> "VClockBatch":
+        return cls(clocks=clock_ops.zeros((n, universe.config.num_actors)))
+
+    @classmethod
+    def from_scalar(cls, states: Sequence[VClock], universe: Universe) -> "VClockBatch":
+        import numpy as np
+
+        a = universe.config.num_actors
+        buf = np.zeros((len(states), a), dtype=counter_dtype())
+        for i, vc in enumerate(states):
+            for actor, counter in vc.dots.items():
+                buf[i, universe.actor_idx(actor)] = counter
+        return cls(clocks=jnp.asarray(buf))
+
+    def to_scalar(self, universe: Universe) -> list[VClock]:
+        import numpy as np
+
+        return [row_to_vclock(row, universe) for row in np.asarray(self.clocks)]
+
+    # -- CRDT contracts ---------------------------------------------------
+
+    def merge(self, other: "VClockBatch") -> "VClockBatch":
+        """Pairwise lattice join (`vclock.rs:131-137`)."""
+        return VClockBatch(clocks=_merge(self.clocks, other.clocks))
+
+    def witness(self, actor_idx, counter) -> "VClockBatch":
+        return VClockBatch(
+            clocks=clock_ops.witness(self.clocks, jnp.asarray(actor_idx), jnp.asarray(counter))
+        )
+
+    def subtract(self, other: "VClockBatch") -> "VClockBatch":
+        return VClockBatch(clocks=clock_ops.subtract(self.clocks, other.clocks))
+
+    def intersection(self, other: "VClockBatch") -> "VClockBatch":
+        return VClockBatch(clocks=clock_ops.intersection(self.clocks, other.clocks))
+
+    def truncate(self, other: "VClockBatch") -> "VClockBatch":
+        return VClockBatch(clocks=clock_ops.truncate(self.clocks, other.clocks))
+
+    def leq(self, other: "VClockBatch"):
+        return clock_ops.leq(self.clocks, other.clocks)
+
+    def concurrent(self, other: "VClockBatch"):
+        return clock_ops.concurrent(self.clocks, other.clocks)
+
+    def is_empty(self):
+        return clock_ops.is_empty(self.clocks)
+
+
+@jax.jit
+def _merge(a, b):
+    return clock_ops.merge(a, b)

@@ -1,0 +1,13 @@
+"""Dense JAX/XLA join kernels over columnar SoA buffers — the TPU hot path.
+
+Representation (SURVEY.md §7.0): actors are interned to dense int32 indices;
+a vector clock batch is ``u64[..., A]`` with 0 meaning "absent" (the implied
+-zero rule, `/root/reference/src/vclock.rs:206-210`).  Every kernel here is a
+pure function over arrays, safe under ``jit`` / ``vmap`` / ``shard_map``.
+"""
+
+from ..config import enable_x64 as _enable_x64
+
+_enable_x64()
+
+from . import clock_ops, counter_ops, lww_ops, mvreg_ops, orswot_ops

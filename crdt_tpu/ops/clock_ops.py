@@ -1,0 +1,107 @@
+"""Dense vector-clock arithmetic — the batched causality kernel (L1 on TPU).
+
+A clock batch is an unsigned integer array whose **last axis is the actor
+axis** (size A, dense interned actor ids); leading axes are free batch axes.
+Absent actors hold 0 (`/root/reference/src/vclock.rs:206-210`), which makes
+every VClock operation an elementwise arithmetic op:
+
+=====================  =====================================================
+reference               dense kernel
+=====================  =====================================================
+``merge``               pointwise max                  (`vclock.rs:131-137`)
+``intersection``        ``where(a == b, a, 0)``        (`vclock.rs:219-228`)
+``subtract``            ``where(a > b, a, 0)``         (`vclock.rs:236-242`)
+``truncate`` (GLB)      pointwise min                  (`vclock.rs:103-120`)
+``partial_cmp``         all/any reductions over A      (`vclock.rs:59-71`)
+``witness``             scatter-max                    (`vclock.rs:159-163`)
+=====================  =====================================================
+
+These six primitives are the entire inner loop of Orswot/Map/MVReg merge
+(SURVEY.md §3.2) — on TPU they vectorize over both the object and actor axes
+and fuse into single VPU passes under XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import counter_dtype
+
+
+def zeros(shape, dtype=None):
+    """An empty clock batch (all actors absent)."""
+    return jnp.zeros(shape, dtype=dtype or counter_dtype())
+
+
+def merge(a, b):
+    """Lattice join: pointwise max (`vclock.rs:131-137`)."""
+    return jnp.maximum(a, b)
+
+
+def intersection(a, b):
+    """Common dots: same actor AND same counter (`vclock.rs:219-228`)."""
+    return jnp.where(a == b, a, 0)
+
+
+def subtract(a, b):
+    """Forget actors whose dots in ``b`` descend ``a``'s: keep ``a[i]`` iff
+    ``a[i] > b[i]`` (`vclock.rs:236-242`; with absent==0 the reference's
+    "actor present in other with counter >= ours" collapses to ``>``)."""
+    return jnp.where(a > b, a, 0)
+
+
+def truncate(a, b):
+    """Causal truncate: greatest lower bound, pointwise min
+    (`vclock.rs:103-120`; min with 0 removes, matching implied-zero)."""
+    return jnp.minimum(a, b)
+
+
+def is_empty(a):
+    """True where the clock has no dots, reduced over the actor axis."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def dominates_or_eq(a, b):
+    """``a >= b`` in the lattice partial order: every dot of ``b`` is covered
+    (`vclock.rs:63`). Reduced over the actor axis."""
+    return jnp.all(a >= b, axis=-1)
+
+
+def eq(a, b):
+    """Structural equality (same dots), reduced over the actor axis."""
+    return jnp.all(a == b, axis=-1)
+
+
+def leq(a, b):
+    """``a <= b``: b covers every dot of a (`vclock.rs:65`)."""
+    return jnp.all(a <= b, axis=-1)
+
+
+def lt(a, b):
+    """Strict ``a < b``: covered and not equal."""
+    return leq(a, b) & ~eq(a, b)
+
+
+def concurrent(a, b):
+    """Diverged: neither covers the other (`vclock.rs:200-202`)."""
+    return ~leq(a, b) & ~dominates_or_eq(a, b)
+
+
+def witness(clock, actor_idx, counter):
+    """Scatter-max a dot into a clock batch (`vclock.rs:159-163`).
+
+    ``clock``: ``[..., A]``; ``actor_idx``/``counter``: scalars or ``[...]``.
+    """
+    current = jnp.take_along_axis(clock, actor_idx[..., None], axis=-1)
+    new = jnp.maximum(current, counter[..., None]).astype(clock.dtype)
+    return jnp.put_along_axis(clock, actor_idx[..., None], new, axis=-1, inplace=False)
+
+
+def inc_counter(clock, actor_idx):
+    """Next counter for an actor: ``get + 1`` (`vclock.rs:182-185`)."""
+    return jnp.take_along_axis(clock, actor_idx[..., None], axis=-1)[..., 0] + 1
+
+
+def value_sum(a):
+    """Sum of all counters — GCounter ``value`` (`gcounter.rs:76-78`)."""
+    return jnp.sum(a, axis=-1)

@@ -1,0 +1,29 @@
+"""Batched counter kernels.
+
+GCounter is a VClock newtype (`/root/reference/src/gcounter.rs:26-28`);
+PNCounter stacks two of them (`/root/reference/src/pncounter.rs:33-36`).
+A PNCounter batch is ``u64[..., 2, A]`` — plane 0 = P (increments),
+plane 1 = N (decrements).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import clock_ops
+
+# GCounter: merge is the clock join, value is the actor-axis sum
+gcounter_merge = clock_ops.merge
+gcounter_value = clock_ops.value_sum
+
+
+def pncounter_merge(a, b):
+    """Merge P with P and N with N (`pncounter.rs:90-95`) — one max over
+    the stacked planes."""
+    return jnp.maximum(a, b)
+
+
+def pncounter_value(pn):
+    """P − N as signed (`pncounter.rs:117-119`)."""
+    sums = jnp.sum(pn, axis=-1).astype(jnp.int64)
+    return sums[..., 0] - sums[..., 1]

@@ -1,0 +1,36 @@
+"""Batched last-write-wins register kernel.
+
+Reference semantics (`/root/reference/src/lwwreg.rs:43-67`): keep the value
+with the larger marker; equal markers with different values is an error.
+Batched kernels can't raise per-element (SURVEY.md §7.3), so ``merge``
+returns the merged ``(val, marker)`` plus a **conflict bitmap** the host
+surfaces as :class:`crdt_tpu.error.ConflictingMarker` — keeping scalar-path
+error parity.
+
+Markers are unsigned ints (the 10M-register benchmark config uses u64
+timestamps); values are any array dtype with elementwise equality.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def merge(val_a, marker_a, val_b, marker_b):
+    """Pairwise merge. Returns ``(val, marker, conflict)``.
+
+    ``conflict[i]`` is True where ``marker_a == marker_b`` but the values
+    differ (`lwwreg.rs:61-62`); the merged value there is ``val_a``
+    (self-biased, matching the reference which leaves self untouched before
+    erroring).
+    """
+    take_b = marker_b > marker_a
+    val = jnp.where(take_b, val_b, val_a)
+    marker = jnp.where(take_b, marker_b, marker_a)
+    conflict = (marker_a == marker_b) & (val_a != val_b)
+    return val, marker, conflict
+
+
+def update(val, marker, new_val, new_marker):
+    """Batched ``update`` (`lwwreg.rs:104-118`): same lattice rule as merge."""
+    return merge(val, marker, new_val, new_marker)

@@ -1,0 +1,105 @@
+"""Batched multi-value register kernel.
+
+A register batch is a padded antichain: ``clocks u64[..., K, A]`` with a
+payload array ``vals[..., K]``.  A slot is active iff its clock is non-empty
+(a ``Put`` with an empty clock is a no-op, `/root/reference/src/mvreg.rs:161-163`,
+and live values always carry dots).
+
+``merge`` (`mvreg.rs:121-153`): keep each side's values not strictly
+dominated by any value on the other side; values from ``other`` additionally
+dedup against kept ``self`` values by clock equality.  Dominance is O(K²)
+pairwise clock comparisons — fine for small K with masking discipline
+(SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import clock_ops
+
+
+def active(clocks):
+    """Slot-occupancy mask ``[..., K]``."""
+    return ~clock_ops.is_empty(clocks)
+
+
+def merge(clocks_a, vals_a, clocks_b, vals_b):
+    """Pairwise antichain merge.
+
+    Returns ``(clocks, vals, keep)`` with 2K slots (self's survivors first,
+    then other's); ``keep[..., 2K]`` marks live slots.  Use
+    :func:`compact` to re-pack into K_cap slots.
+    """
+    act_a = active(clocks_a)  # [..., K]
+    act_b = active(clocks_b)
+
+    # pair[i, j] over the K axes: does b_j strictly dominate a_i?
+    a_exp = clocks_a[..., :, None, :]  # [..., K, 1, A]
+    b_exp = clocks_b[..., None, :, :]  # [..., 1, K, A]
+    a_lt_b = clock_ops.lt(a_exp, b_exp)  # [..., K, K]
+    b_lt_a = clock_ops.lt(b_exp, a_exp)
+    a_eq_b = clock_ops.eq(a_exp, b_exp)
+
+    # keep self vals with no dominating other val (`mvreg.rs:124-131`)
+    keep_a = act_a & ~jnp.any(a_lt_b & act_b[..., None, :], axis=-1)
+    # keep other vals with no dominating self val (`mvreg.rs:133-138`),
+    # deduped by clock-equality against *kept* self vals (`mvreg.rs:139-148`)
+    keep_b = act_b & ~jnp.any(b_lt_a & act_a[..., :, None], axis=-2)
+    keep_b &= ~jnp.any(a_eq_b & keep_a[..., :, None], axis=-2)
+
+    clocks = jnp.concatenate([clocks_a, clocks_b], axis=-2)
+    vals = jnp.concatenate([vals_a, vals_b], axis=-1)
+    keep = jnp.concatenate([keep_a, keep_b], axis=-1)
+    clocks = jnp.where(keep[..., None], clocks, 0)
+    vals = jnp.where(keep, vals, 0)
+    return clocks, vals, keep
+
+
+def compact(clocks, vals, keep, k_cap):
+    """Pack live slots to the front and truncate to ``k_cap``.
+
+    Returns ``(clocks, vals, overflow)`` where ``overflow`` flags registers
+    whose live-slot count exceeded ``k_cap`` (host raises; capacities are a
+    static-shape concession, `SURVEY.md §7.0`)."""
+    order = jnp.argsort(~keep, axis=-1, stable=True)  # live slots first
+    clocks = jnp.take_along_axis(clocks, order[..., None], axis=-2)[..., :k_cap, :]
+    vals = jnp.take_along_axis(vals, order, axis=-1)[..., :k_cap]
+    overflow = jnp.sum(keep, axis=-1) > k_cap
+    return clocks, vals, overflow
+
+
+def apply_put(clocks, vals, op_clock, op_val):
+    """Batched ``Op::Put`` (`mvreg.rs:158-186`).
+
+    Drops slots dominated-or-equal to the op clock, then adds the op value
+    unless an existing (surviving) slot strictly dominates it.  The op slot
+    reuses the first freed position via compaction by the caller; here we
+    return 2K-slot outputs like :func:`merge` for uniformity: K existing
+    slots (masked) + the op in slot K.
+    """
+    op_empty = clock_ops.is_empty(op_clock)  # [...]
+    act = active(clocks)
+
+    dominated = clock_ops.leq(clocks, op_clock[..., None, :])  # [..., K]
+    retained = act & ~dominated
+    # does any retained slot strictly dominate the op?
+    dominates_op = clock_ops.lt(op_clock[..., None, :], clocks) & retained
+    should_add = ~jnp.any(dominates_op, axis=-1) & ~op_empty
+
+    # where the op is a no-op (empty clock), keep the original state
+    keep_exist = jnp.where(op_empty[..., None], act, retained)
+    out_clocks = jnp.where(keep_exist[..., None], clocks, 0)
+    out_vals = jnp.where(keep_exist, vals, 0)
+
+    add_clock = jnp.where(should_add[..., None], op_clock, 0)
+    add_val = jnp.where(should_add, op_val, 0)
+    clocks2 = jnp.concatenate([out_clocks, add_clock[..., None, :]], axis=-2)
+    vals2 = jnp.concatenate([out_vals, add_val[..., None]], axis=-1)
+    keep = jnp.concatenate([keep_exist, should_add[..., None]], axis=-1)
+    return clocks2, vals2, keep
+
+
+def read_clock(clocks):
+    """Fold of every slot clock (`mvreg.rs:216-222`)."""
+    return jnp.max(clocks, axis=-2)

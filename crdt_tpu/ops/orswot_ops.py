@@ -1,0 +1,264 @@
+"""Batched ORSWOT kernels — the flagship merge (SURVEY.md §3.2, §7.3).
+
+Dense per-object state (leading axes are free batch axes):
+
+* ``clock   u64[..., A]``       — the set clock
+* ``ids     int32[..., M]``     — interned member ids, ``-1`` = empty slot
+* ``dots    u64[..., M, A]``    — per-member dot clocks (add-witnesses)
+* ``d_ids   int32[..., D]``     — deferred-remove member ids, ``-1`` = empty
+* ``d_clocks u64[..., D, A]``   — deferred-remove witnessing clocks
+
+A member slot is live iff its id != -1; live members always carry non-empty
+dot clocks (the reference never stores an entry with an empty clock —
+`/root/reference/src/orswot.rs:132-138,205-210`).
+
+``merge`` reproduces `/root/reference/src/orswot.rs:89-156` bit-exactly,
+including the asymmetry: members only in *self* keep their **full** clock
+when any dot is novel (`orswot.rs:94-103`), members only in *other* keep the
+**subtracted** clock (`orswot.rs:132-138`).  The HashMap alignment of the
+reference becomes a sort + adjacent-duplicate match over the concatenated
+member tables — no hashing on device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import clock_ops
+
+EMPTY = -1
+_SORT_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _align(ids_a, dots_a, ids_b, dots_b):
+    """Align the two member tables on member id.
+
+    Returns ``(ids, e1, e2, valid)`` over 2M slots: for each distinct member
+    id, ``e1`` is self's dot clock (0 if absent) and ``e2`` other's.
+    """
+    ids_cat = jnp.concatenate([ids_a, ids_b], axis=-1)  # [..., 2M]
+    dots_cat = jnp.concatenate([dots_a, dots_b], axis=-2)  # [..., 2M, A]
+    m = ids_a.shape[-1]
+    side = jnp.concatenate(
+        [jnp.zeros_like(ids_a), jnp.ones_like(ids_b)], axis=-1
+    )  # 0 = self, 1 = other
+
+    key = jnp.where(ids_cat == EMPTY, _SORT_MAX, ids_cat)
+    order = jnp.argsort(key, axis=-1, stable=True)
+    s_ids = jnp.take_along_axis(ids_cat, order, axis=-1)
+    s_dots = jnp.take_along_axis(dots_cat, order[..., None], axis=-2)
+    s_side = jnp.take_along_axis(side, order, axis=-1)
+
+    valid = s_ids != EMPTY
+    # runs have length <= 2 (ids unique within each side)
+    nxt_same = jnp.concatenate(
+        [(s_ids[..., 1:] == s_ids[..., :-1]) & valid[..., 1:],
+         jnp.zeros_like(valid[..., :1])],
+        axis=-1,
+    )
+    prv_same = jnp.concatenate(
+        [jnp.zeros_like(valid[..., :1]),
+         (s_ids[..., 1:] == s_ids[..., :-1]) & valid[..., :-1]],
+        axis=-1,
+    )
+    first = valid & ~prv_same
+
+    from_a = jnp.where((s_side == 0)[..., None], s_dots, 0)
+    from_b = jnp.where((s_side == 1)[..., None], s_dots, 0)
+    nxt = lambda x: jnp.concatenate([x[..., 1:, :], jnp.zeros_like(x[..., :1, :])], axis=-2)
+    take_nxt = nxt_same[..., None]
+    e1 = jnp.maximum(from_a, jnp.where(take_nxt, nxt(from_a), 0))
+    e2 = jnp.maximum(from_b, jnp.where(take_nxt, nxt(from_b), 0))
+    out_ids = jnp.where(first, s_ids, EMPTY)
+    return out_ids, e1, e2, first
+
+
+def _merge_aligned(e1, e2, present1, present2, self_clock, other_clock):
+    """The per-member dot-algebra rule (`orswot.rs:92-138`), elementwise
+    over the actor axis.  ``e1``/``e2``: ``[..., S, A]``; clocks ``[..., A]``."""
+    sc = self_clock[..., None, :]
+    oc = other_clock[..., None, :]
+
+    # present in both (`orswot.rs:105-129`)
+    common = clock_ops.intersection(e1, e2)
+    c1 = clock_ops.subtract(clock_ops.subtract(e1, common), oc)
+    c2 = clock_ops.subtract(clock_ops.subtract(e2, common), sc)
+    out_both = jnp.maximum(common, jnp.maximum(c1, c2))
+
+    # only in self (`orswot.rs:94-103`): keep FULL clock iff not dominated
+    keep1 = ~clock_ops.leq(e1, oc)  # [..., S]
+    out_only1 = jnp.where(keep1[..., None], e1, 0)
+
+    # only in other (`orswot.rs:132-138`): keep the SUBTRACTED clock
+    out_only2 = clock_ops.subtract(e2, sc)
+
+    both = (present1 & present2)[..., None]
+    only1 = (present1 & ~present2)[..., None]
+    out = jnp.where(both, out_both, jnp.where(only1, out_only1, out_only2))
+    return jnp.where((present1 | present2)[..., None], out, 0)
+
+
+def _dedup_deferred(d_ids, d_clocks):
+    """Drop exact (member, clock) duplicate rows, keeping the first.
+
+    The reference's deferred map is ``{clock: {members}}``
+    (`orswot.rs:29`) — pairs are unique by construction; after
+    concatenating two tables we restore that invariant.  O(D²) pairwise
+    compare — D is small."""
+    same_member = d_ids[..., :, None] == d_ids[..., None, :]  # [..., D, D]
+    same_clock = clock_ops.eq(d_clocks[..., :, None, :], d_clocks[..., None, :, :])
+    valid = d_ids != EMPTY
+    dup_pair = same_member & same_clock & valid[..., :, None] & valid[..., None, :]
+    d = d_ids.shape[-1]
+    earlier = jnp.tril(jnp.ones((d, d), dtype=bool), k=-1)
+    is_dup = jnp.any(dup_pair & earlier, axis=-1)
+    keep = valid & ~is_dup
+    return jnp.where(keep, d_ids, EMPTY), jnp.where(keep[..., None], d_clocks, 0)
+
+
+def _apply_deferred(clock, ids, dots, d_ids, d_clocks):
+    """Replay buffered removes (`orswot.rs:195-243`), single pass.
+
+    For each member, subtract the join of all matching deferred clocks
+    (sequential subtracts compose into subtract-by-max); drop emptied
+    members; retain only deferred rows still ahead of the set clock."""
+    d_valid = d_ids != EMPTY
+    match = ids[..., :, None] == jnp.where(d_valid, d_ids, EMPTY - 1)[..., None, :]
+    # [..., M, A]: per-member join of matching deferred clocks
+    rm = jnp.max(
+        jnp.where(match[..., None], d_clocks[..., None, :, :], 0), axis=-2
+    ) if d_ids.shape[-1] > 0 else jnp.zeros_like(dots)
+    new_dots = clock_ops.subtract(dots, rm)
+    live = ~clock_ops.is_empty(new_dots) & (ids != EMPTY)
+    new_ids = jnp.where(live, ids, EMPTY)
+    new_dots = jnp.where(live[..., None], new_dots, 0)
+
+    # keep deferred rows whose clock is still not covered (`orswot.rs:197`)
+    still_ahead = ~clock_ops.leq(d_clocks, clock[..., None, :]) & d_valid
+    out_d_ids = jnp.where(still_ahead, d_ids, EMPTY)
+    out_d_clocks = jnp.where(still_ahead[..., None], d_clocks, 0)
+    return new_ids, new_dots, out_d_ids, out_d_clocks
+
+
+def compact(ids, payload, cap):
+    """Pack live slots first and truncate to ``cap`` slots.
+
+    ``payload`` has one extra trailing axis (the actor axis).  Returns
+    ``(ids, payload, overflow)``."""
+    live = ids != EMPTY
+    order = jnp.argsort(~live, axis=-1, stable=True)
+    ids = jnp.take_along_axis(ids, order, axis=-1)[..., :cap]
+    payload = jnp.take_along_axis(payload, order[..., None], axis=-2)[..., :cap, :]
+    overflow = jnp.sum(live, axis=-1) > cap
+    return ids, payload, overflow
+
+
+def merge(
+    clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int,
+):
+    """Full pairwise ORSWOT merge (`orswot.rs:89-156`).
+
+    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)``; overflow is a
+    per-object flag set when survivors exceed ``m_cap`` or deferred rows
+    exceed ``d_cap`` (host raises — capacity is the static-shape concession).
+    """
+    ids, e1, e2, valid = _align(ids_a, dots_a, ids_b, dots_b)
+    p1 = ~clock_ops.is_empty(e1) & valid
+    p2 = ~clock_ops.is_empty(e2) & valid
+    out_dots = _merge_aligned(e1, e2, p1, p2, clock_a, clock_b)
+    survive = ~clock_ops.is_empty(out_dots)
+    ids = jnp.where(survive, ids, EMPTY)
+    out_dots = jnp.where(survive[..., None], out_dots, 0)
+
+    # union + dedup the deferred tables (`orswot.rs:141-148`)
+    d_ids = jnp.concatenate([dids_a, dids_b], axis=-1)
+    d_clocks = jnp.concatenate([dclocks_a, dclocks_b], axis=-2)
+    d_ids, d_clocks = _dedup_deferred(d_ids, d_clocks)
+
+    # clock join (`orswot.rs:153`), then replay deferred (`orswot.rs:155`)
+    clock = clock_ops.merge(clock_a, clock_b)
+    ids, out_dots, d_ids, d_clocks = _apply_deferred(clock, ids, out_dots, d_ids, d_clocks)
+
+    ids, out_dots, m_over = compact(ids, out_dots, m_cap)
+    d_ids, d_clocks, d_over = compact(d_ids, d_clocks, d_cap)
+    return clock, ids, out_dots, d_ids, d_clocks, m_over | d_over
+
+
+def apply_add(clock, ids, dots, dids, dclocks, actor_idx, counter, member_id):
+    """Batched ``Op::Add`` (`orswot.rs:66-79`): one add per object.
+
+    Returns updated state + overflow flag (no free member slot)."""
+    seen = jnp.take_along_axis(clock, actor_idx[..., None], axis=-1)[..., 0] >= counter
+
+    existing = ids == member_id[..., None]  # [..., M]
+    has_slot = jnp.any(existing, axis=-1)
+    free = ids == EMPTY
+    has_free = jnp.any(free, axis=-1)
+    slot = jnp.where(
+        has_slot, jnp.argmax(existing, axis=-1), jnp.argmax(free, axis=-1)
+    )
+    overflow = ~seen & ~has_slot & ~has_free
+
+    do = (~seen & (has_slot | has_free))[..., None]
+    onehot = jnp.arange(ids.shape[-1]) == slot[..., None]
+    new_ids = jnp.where(do & onehot, member_id[..., None], ids)
+    # witness the dot on the member clock and the set clock
+    dot_update = (do & onehot)[..., None] & (
+        jnp.arange(dots.shape[-1]) == actor_idx[..., None, None]
+    )
+    new_dots = jnp.where(dot_update, jnp.maximum(dots, counter[..., None, None]), dots)
+    new_clock = jnp.where(
+        do & (jnp.arange(clock.shape[-1]) == actor_idx[..., None]),
+        jnp.maximum(clock, counter[..., None]),
+        clock,
+    )
+    new_ids2, new_dots2, d_ids, d_clocks = _apply_deferred(
+        new_clock, new_ids, new_dots, dids, dclocks
+    )
+    return new_clock, new_ids2, new_dots2, d_ids, d_clocks, overflow
+
+
+def apply_remove(clock, ids, dots, dids, dclocks, rm_clock, member_id):
+    """Batched ``Op::Rm`` → ``apply_remove`` (`orswot.rs:195-211`).
+
+    Defers when the remove clock is ahead of the set clock, and always
+    subtracts the remove clock from the member's dots.  Returns updated
+    state + overflow flag (deferred table full)."""
+    ahead = ~clock_ops.leq(rm_clock, clock)  # [...]
+
+    # dedup: an identical (member, clock) row may already be buffered
+    d_valid = dids != EMPTY
+    same = (dids == member_id[..., None]) & clock_ops.eq(
+        dclocks, rm_clock[..., None, :]
+    ) & d_valid
+    already = jnp.any(same, axis=-1)
+    want_defer = ahead & ~already
+    free = ~d_valid
+    has_free = jnp.any(free, axis=-1)
+    slot = jnp.argmax(free, axis=-1)
+    overflow = want_defer & ~has_free
+    do = (want_defer & has_free)[..., None]
+    onehot = jnp.arange(dids.shape[-1]) == slot[..., None]
+    new_dids = jnp.where(do & onehot, member_id[..., None], dids)
+    new_dclocks = jnp.where((do & onehot)[..., None], rm_clock[..., None, :], dclocks)
+
+    # subtract the remove clock from the member's dots (`orswot.rs:205-210`)
+    target = ids == member_id[..., None]
+    sub = clock_ops.subtract(dots, rm_clock[..., None, :])
+    new_dots = jnp.where(target[..., None], sub, dots)
+    live = ~clock_ops.is_empty(new_dots) & (ids != EMPTY)
+    new_ids = jnp.where(live, ids, EMPTY)
+    new_dots = jnp.where(live[..., None], new_dots, 0)
+    return clock, new_ids, new_dots, new_dids, new_dclocks, overflow
+
+
+def contains(ids, member_id):
+    """Membership bitmap (`orswot.rs:214-224`)."""
+    return jnp.any(ids == member_id[..., None], axis=-1)
+
+
+def member_mask(ids):
+    """Live-member mask — ``value()`` as a bitmap over slots."""
+    return ids != EMPTY

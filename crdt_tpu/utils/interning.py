@@ -1,0 +1,72 @@
+"""Actor/member interning — host-side registries for dense device buffers.
+
+The reference allows any ``Ord + Hash`` actor (`/root/reference/src/vclock.rs:27-28`)
+and any hashable member (`orswot.rs:19-20`); XLA wants dense integer axes.
+Interning maps arbitrary Python values to stable dense indices losslessly
+(SURVEY.md §7.0): actors → ``[0, A)`` columns of the actor axis, members →
+int32 ids (with ``-1`` reserved for empty slots).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, Iterable, List
+
+
+class Registry:
+    """A bidirectional value ↔ dense-index map."""
+
+    __slots__ = ("_to_idx", "_to_val", "capacity")
+
+    def __init__(self, capacity: int | None = None):
+        self._to_idx: Dict[Hashable, int] = {}
+        self._to_val: List[Hashable] = []
+        self.capacity = capacity
+
+    def __len__(self) -> int:
+        return len(self._to_val)
+
+    def __contains__(self, value: Hashable) -> bool:
+        return value in self._to_idx
+
+    def intern(self, value: Hashable) -> int:
+        idx = self._to_idx.get(value)
+        if idx is None:
+            idx = len(self._to_val)
+            if self.capacity is not None and idx >= self.capacity:
+                raise ValueError(
+                    f"registry capacity {self.capacity} exhausted interning {value!r}"
+                )
+            self._to_idx[value] = idx
+            self._to_val.append(value)
+        return idx
+
+    def intern_all(self, values: Iterable[Hashable]) -> List[int]:
+        return [self.intern(v) for v in values]
+
+    def lookup(self, idx: int) -> Any:
+        return self._to_val[idx]
+
+    def values(self) -> List[Hashable]:
+        return list(self._to_val)
+
+
+class Universe:
+    """The interning context shared by a family of batch CRDTs.
+
+    Holds the actor registry (dense columns of the actor axis) and the
+    member registry (Orswot member ids / MVReg payload ids), plus the static
+    capacities (:class:`crdt_tpu.config.CrdtConfig`).
+    """
+
+    def __init__(self, config=None):
+        from ..config import DEFAULT_CONFIG
+
+        self.config = config or DEFAULT_CONFIG
+        self.actors = Registry(capacity=self.config.num_actors)
+        self.members = Registry()
+
+    def actor_idx(self, actor) -> int:
+        return self.actors.intern(actor)
+
+    def member_id(self, member) -> int:
+        return self.members.intern(member)

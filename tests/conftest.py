@@ -9,10 +9,19 @@ Must set env vars before the first ``import jax`` anywhere in the test run.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the environment presets JAX_PLATFORMS=axon (a remote TPU
+# tunnel) whose per-op latency makes property tests pathologically slow;
+# kernels are platform-agnostic.  The site hook preloads jax before this
+# conftest runs, so setting the env var is not enough — update the live
+# config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 from hypothesis import HealthCheck, settings
 

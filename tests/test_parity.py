@@ -1,0 +1,334 @@
+"""Scalar ↔ batch parity — the engine-split contract (SURVEY.md §7.0).
+
+For every type: generate random scalar states from op sequences (the same
+generators as the reference property tests), pack them into SoA batches,
+merge on device (jit), unpack, and require **bit-identical** state vs the
+scalar merge — clocks, entries, and deferred buffers, not just ``value()``.
+
+These run on the CPU backend (conftest forces ``JAX_PLATFORMS=cpu``); the
+same kernels run unchanged on TPU.
+"""
+
+import jax.numpy as jnp
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from crdt_tpu import Dot, GCounter, LWWReg, MVReg, Orswot, PNCounter, RmCtx, VClock
+from crdt_tpu.batch import (
+    GCounterBatch,
+    LWWRegBatch,
+    MVRegBatch,
+    OrswotBatch,
+    PNCounterBatch,
+    VClockBatch,
+)
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.scalar.orswot import Add, Rm
+from crdt_tpu.utils.interning import Universe
+
+
+def small_universe(**kw):
+    defaults = dict(num_actors=8, member_capacity=24, deferred_capacity=16, mv_capacity=12)
+    defaults.update(kw)
+    return Universe(CrdtConfig(**defaults))
+
+
+# -- strategies -------------------------------------------------------------
+
+actors = st.integers(0, 7)
+counters = st.integers(0, 9)
+
+vclocks = st.lists(st.tuples(actors, counters), max_size=10).map(VClock.from_iter)
+
+
+@st.composite
+def orswots(draw):
+    """Random Orswot built from an op sequence (mirrors `test/orswot.rs:14-34`)."""
+    s = Orswot()
+    for actor, member, choice, counter in draw(
+        st.lists(st.tuples(actors, st.integers(0, 9), st.integers(0, 3), st.integers(1, 9)), max_size=12)
+    ):
+        if choice % 2 == 0:
+            s.apply(Add(dot=Dot(actor, counter), member=member))
+        else:
+            s.apply(Rm(clock=Dot(actor, counter).to_vclock(), member=member))
+    return s
+
+
+@st.composite
+def mvregs(draw):
+    r = MVReg()
+    for val, actor in draw(st.lists(st.tuples(st.integers(0, 20), actors), max_size=6)):
+        r.apply(r.set(val, r.read().derive_add_ctx(actor)))
+    return r
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def scalar_merge(a, b):
+    out = a.clone()
+    out.merge(b)
+    return out
+
+
+# -- VClock / counters ------------------------------------------------------
+
+
+@given(st.lists(st.tuples(vclocks, vclocks), min_size=4, max_size=4))
+def test_vclock_merge_parity(pairs):
+    uni = small_universe()
+    lhs = [a for a, _ in pairs]
+    rhs = [b for _, b in pairs]
+    expected = [scalar_merge(a, b) for a, b in pairs]
+
+    ba = VClockBatch.from_scalar(lhs, uni)
+    bb = VClockBatch.from_scalar(rhs, uni)
+    got = ba.merge(bb).to_scalar(uni)
+    assert got == expected
+
+    # partial-order predicates agree too
+    import numpy as np
+
+    leq = np.asarray(ba.leq(bb))
+    conc = np.asarray(ba.concurrent(bb))
+    for i, (a, b) in enumerate(pairs):
+        assert bool(leq[i]) == (a <= b)
+        assert bool(conc[i]) == a.concurrent(b)
+
+
+@given(st.lists(st.tuples(vclocks, vclocks), min_size=4, max_size=4))
+def test_gcounter_merge_parity(pairs):
+    uni = small_universe()
+    lhs = [GCounter(a.clone()) for a, _ in pairs]
+    rhs = [GCounter(b.clone()) for _, b in pairs]
+    expected = [scalar_merge(a, b) for a, b in zip(lhs, rhs)]
+
+    got = (
+        GCounterBatch.from_scalar(lhs, uni)
+        .merge(GCounterBatch.from_scalar(rhs, uni))
+        .to_scalar(uni)
+    )
+    assert [g.value() for g in got] == [e.value() for e in expected]
+    assert [g.inner for g in got] == [e.inner for e in expected]
+
+
+@given(st.lists(st.tuples(vclocks, vclocks, vclocks, vclocks), min_size=4, max_size=4))
+def test_pncounter_merge_parity(quads):
+    from crdt_tpu.scalar.gcounter import GCounter as G
+
+    uni = small_universe()
+    lhs = [PNCounter(G(p.clone()), G(n.clone())) for p, n, _, _ in quads]
+    rhs = [PNCounter(G(p.clone()), G(n.clone())) for _, _, p, n in quads]
+    expected = [scalar_merge(a, b) for a, b in zip(lhs, rhs)]
+
+    batch = PNCounterBatch.from_scalar(lhs, uni).merge(PNCounterBatch.from_scalar(rhs, uni))
+    got = batch.to_scalar(uni)
+    assert [g.value() for g in got] == [e.value() for e in expected]
+    import numpy as np
+
+    assert list(np.asarray(batch.value())) == [e.value() for e in expected]
+
+
+# -- LWWReg -----------------------------------------------------------------
+
+
+@given(
+    st.lists(
+        st.tuples(st.integers(0, 30), st.integers(0, 9), st.integers(0, 30), st.integers(0, 9)),
+        min_size=6,
+        max_size=6,
+    )
+)
+def test_lwwreg_merge_parity(prims):
+    from crdt_tpu.error import ConflictingMarker
+
+    uni = small_universe()
+    lhs = [LWWReg(val=v1, marker=m1) for v1, m1, _, _ in prims]
+    rhs = [LWWReg(val=v2, marker=m2) for _, _, v2, m2 in prims]
+
+    expected, conflicts = [], []
+    for a, b in zip(lhs, rhs):
+        out = a.clone()
+        try:
+            out.merge(b)
+            conflicts.append(False)
+        except ConflictingMarker:
+            conflicts.append(True)
+        expected.append(out)
+
+    ba = LWWRegBatch.from_scalar(lhs, uni)
+    bb = LWWRegBatch.from_scalar(rhs, uni)
+    merged, bitmap = ba.merge_with_conflicts(bb)
+    import numpy as np
+
+    assert list(np.asarray(bitmap)) == conflicts
+    got = merged.to_scalar(uni)
+    for g, e, c in zip(got, expected, conflicts):
+        if not c:
+            assert g == e
+
+    if any(conflicts):
+        try:
+            ba.merge(bb)
+            assert False, "expected ConflictingMarker"
+        except ConflictingMarker:
+            pass
+
+
+# -- MVReg ------------------------------------------------------------------
+
+
+@given(st.lists(st.tuples(mvregs(), mvregs()), min_size=3, max_size=3))
+@settings(max_examples=50)
+def test_mvreg_merge_parity(pairs):
+    uni = small_universe()
+    lhs = [a for a, _ in pairs]
+    rhs = [b for _, b in pairs]
+    expected = [scalar_merge(a, b) for a, b in pairs]
+
+    got = (
+        MVRegBatch.from_scalar(lhs, uni)
+        .merge(MVRegBatch.from_scalar(rhs, uni))
+        .to_scalar(uni)
+    )
+    for g, e in zip(got, expected):
+        assert g == e  # MVReg __eq__ is set-equality over (clock, val)
+
+
+@given(mvregs(), st.integers(0, 20), actors)
+@settings(max_examples=50)
+def test_mvreg_apply_put_parity(reg, val, actor):
+    uni = small_universe()
+    ctx = reg.read().derive_add_ctx(actor)
+    op = reg.set(val, ctx)
+
+    expected = reg.clone()
+    expected.apply(op)
+
+    batch = MVRegBatch.from_scalar([reg], uni)
+    op_clock = VClockBatch.from_scalar([op.clock], uni).clocks
+    op_val = jnp.asarray([uni.member_id(op.val)])
+    got = batch.apply_put(op_clock, op_val).to_scalar(uni)[0]
+    assert got == expected
+
+
+# -- Orswot -----------------------------------------------------------------
+
+
+@given(st.lists(st.tuples(orswots(), orswots()), min_size=3, max_size=3))
+@settings(max_examples=60)
+def test_orswot_merge_parity(pairs):
+    uni = small_universe()
+    lhs = [a for a, _ in pairs]
+    rhs = [b for _, b in pairs]
+    expected = [scalar_merge(a, b) for a, b in pairs]
+
+    got = (
+        OrswotBatch.from_scalar(lhs, uni)
+        .merge(OrswotBatch.from_scalar(rhs, uni))
+        .to_scalar(uni)
+    )
+    for g, e in zip(got, expected):
+        assert g == e, f"\nbatch:  {g!r}\nscalar: {e!r}"
+
+
+@given(orswots(), actors, st.integers(0, 9))
+@settings(max_examples=60)
+def test_orswot_apply_add_parity(s, actor, member):
+    uni = small_universe()
+    ctx = s.value().derive_add_ctx(actor)
+    op = s.add(member, ctx)
+
+    expected = s.clone()
+    expected.apply(op)
+
+    batch = OrswotBatch.from_scalar([s], uni)
+    got = batch.apply_add(
+        jnp.asarray([uni.actor_idx(op.dot.actor)]),
+        jnp.asarray([op.dot.counter]),
+        jnp.asarray([uni.member_id(op.member)]),
+    ).to_scalar(uni)[0]
+    assert got == expected, f"\nbatch:  {got!r}\nscalar: {expected!r}"
+
+
+@given(orswots(), st.integers(0, 9), vclocks)
+@settings(max_examples=60)
+def test_orswot_apply_remove_parity(s, member, rm_clock)    :
+    uni = small_universe()
+    op = s.remove(member, RmCtx(clock=rm_clock))
+
+    expected = s.clone()
+    expected.apply(op)
+
+    batch = OrswotBatch.from_scalar([s], uni)
+    got = batch.apply_remove(
+        VClockBatch.from_scalar([op.clock], uni).clocks,
+        jnp.asarray([uni.member_id(op.member)]),
+    ).to_scalar(uni)[0]
+    assert got == expected, f"\nbatch:  {got!r}\nscalar: {expected!r}"
+
+
+def test_orswot_regressions_on_batch():
+    """The riak_dt regression scenarios, replayed through the batch engine:
+    pack → merge → unpack at each merge point (`test/orswot.rs:193-230`)."""
+    uni = small_universe()
+
+    def bmerge(a, b):
+        return (
+            OrswotBatch.from_scalar([a], uni)
+            .merge(OrswotBatch.from_scalar([b], uni))
+            .to_scalar(uni)[0]
+        )
+
+    # test_no_dots_left
+    a, b = Orswot(), Orswot()
+    a.apply(a.add(0, a.value().derive_add_ctx(1)))
+    b.apply(b.add(0, b.value().derive_add_ctx(2)))
+    c = a.clone()
+    a.apply(a.remove(0, a.contains(0).derive_rm_ctx()))
+    a = bmerge(a, b)
+    assert a.value().val == {0}
+    b.apply(b.remove(0, b.contains(0).derive_rm_ctx()))
+    b = bmerge(b, c)
+    assert b.value().val == {0}
+    b = bmerge(b, a)
+    b = bmerge(b, c)
+    assert b.value().val == set()
+
+
+# -- GSet -------------------------------------------------------------------
+
+
+@given(
+    st.lists(st.sets(st.integers(0, 15)), min_size=4, max_size=4),
+    st.lists(st.sets(st.integers(0, 15)), min_size=4, max_size=4),
+)
+def test_gset_merge_parity(xs, ys):
+    from crdt_tpu import GSet
+    from crdt_tpu.batch import GSetBatch
+
+    uni = small_universe()
+    lhs = [GSet(x) for x in xs]
+    rhs = [GSet(y) for y in ys]
+    expected = [scalar_merge(a, b) for a, b in zip(lhs, rhs)]
+
+    cap = 16
+    got = (
+        GSetBatch.from_scalar(lhs, uni, cap)
+        .merge(GSetBatch.from_scalar(rhs, uni, cap))
+        .to_scalar(uni)
+    )
+    assert got == expected
+
+
+def test_gset_rejects_out_of_capacity_ids():
+    import pytest
+
+    from crdt_tpu.batch import GSetBatch
+
+    b = GSetBatch.zeros(2, 4)
+    with pytest.raises(ValueError):
+        b.insert(jnp.asarray([4, 0]))
+    with pytest.raises(ValueError):
+        b.contains(jnp.asarray([9, 0]))

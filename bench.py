@@ -1,0 +1,237 @@
+"""Benchmark harness — the BASELINE.md configs on the live JAX backend.
+
+Prints ONE JSON line to stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Everything else (per-config results, parity anchor) goes to stderr.
+
+Configs (BASELINE.md / BASELINE.json):
+  1. GCounter::merge  — 2 replicas, 4 actors (scalar CPU parity anchor)
+  2. VClock::merge    — 1k clocks × 64 actors
+  3. PNCounter::merge — 1M replicas × 32 actors
+  4. Orswot::merge    — 100k sets × 16 actors
+  5. LWWReg::merge    — 10M registers
+  ★  North star: N-way Orswot anti-entropy to fixpoint, 64 actors,
+     reported as merges/sec (pairwise object-merges per second), with
+     value() parity vs the scalar engine asserted on a sample.
+
+The reference publishes no numbers (BASELINE.md); vs_baseline is reported
+against the BASELINE.json target of 10M merged replicas in <1s ⇒ 1e7
+merges/sec ⇒ vs_baseline = value / 1e7.
+
+Set CRDT_BENCH_SMALL=1 for a quick smoke run (CI / laptops).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+SMALL = os.environ.get("CRDT_BENCH_SMALL") == "1"
+
+
+def timeit(fn, *args, iters=5):
+    """Median wall time of jitted fn over `iters` runs (post-warmup)."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warmup
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), out
+
+
+def rand_clocks(rng, shape, hi=1000):
+    return rng.randint(0, hi, size=shape).astype(np.uint32)
+
+
+def bench_clock_merges():
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import clock_ops
+
+    rng = np.random.RandomState(0)
+
+    # config 2: VClock 1k × 64
+    n, a = (1000, 64) if not SMALL else (100, 16)
+    x = jnp.asarray(rand_clocks(rng, (n, a)))
+    y = jnp.asarray(rand_clocks(rng, (n, a)))
+    t, _ = timeit(jax.jit(clock_ops.merge), x, y)
+    log(f"config2 vclock_merge   n={n} A={a}: {t*1e6:.1f}us  {n/t/1e6:.2f}M merges/s")
+
+    # config 3: PNCounter 1M × 32 (planes [N, 2, A])
+    n, a = (1_000_000, 32) if not SMALL else (10_000, 8)
+    p = jnp.asarray(rand_clocks(rng, (n, 2, a)))
+    q = jnp.asarray(rand_clocks(rng, (n, 2, a)))
+    t, _ = timeit(jax.jit(clock_ops.merge), p, q)
+    log(f"config3 pncounter_merge n={n} A={a}: {t*1e3:.2f}ms  {n/t/1e6:.2f}M merges/s")
+
+    # config 5: LWWReg 10M
+    from crdt_tpu.ops import lww_ops
+
+    n = 10_000_000 if not SMALL else 100_000
+    va = jnp.asarray(rng.randint(0, 1 << 30, size=n).astype(np.uint32))
+    ma = jnp.asarray(rng.randint(0, 1 << 30, size=n).astype(np.uint32))
+    vb = jnp.asarray(rng.randint(0, 1 << 30, size=n).astype(np.uint32))
+    mb = jnp.asarray(rng.randint(0, 1 << 30, size=n).astype(np.uint32))
+    t, _ = timeit(jax.jit(lww_ops.merge), va, ma, vb, mb)
+    log(f"config5 lwwreg_merge   n={n}: {t*1e3:.2f}ms  {n/t/1e6:.2f}M merges/s")
+
+
+from crdt_tpu.utils.testdata import random_orswot_arrays
+
+
+def bench_orswot_pairwise():
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import orswot_ops
+
+    rng = np.random.RandomState(1)
+    # config 4: 100k sets × 16 actors
+    n, a, m, d = (100_000, 16, 8, 4) if not SMALL else (2_000, 8, 4, 2)
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+
+    merge = jax.jit(
+        lambda L, R: orswot_ops.merge(*L, *R, m, d)[:5]
+    )
+    t, _ = timeit(merge, lhs, rhs)
+    log(f"config4 orswot_merge   n={n} A={a} M={m}: {t*1e3:.2f}ms  {n/t/1e6:.2f}M merges/s")
+    return n / t
+
+
+def bench_north_star():
+    """N-way anti-entropy to fixpoint: R replica fleets of N objects each,
+    left-fold join + plunger rounds, all on device."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import orswot_ops
+
+    rng = np.random.RandomState(2)
+    if SMALL:
+        n, a, m, d, r = 2_000, 16, 4, 2, 4
+    else:
+        n, a, m, d, r = 125_000, 64, 4, 2, 8
+
+    replicas = [
+        tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+        for _ in range(r)
+    ]
+    stacked = tuple(jnp.stack([rep[i] for rep in replicas]) for i in range(5))
+
+    def fold_join(stack):
+        acc = tuple(x[0] for x in stack)
+        for i in range(1, r):
+            acc = orswot_ops.merge(*acc, *(x[i] for x in stack), m, d)[:5]
+        # defer plunger: one self-merge pass flushes deferred removes
+        acc = orswot_ops.merge(*acc, *acc, m, d)[:5]
+        return acc
+
+    t, joined = timeit(jax.jit(fold_join), stacked, iters=3)
+    merges = n * r  # r-1 fold merges + 1 plunger, each over n objects
+    rate = merges / t
+    log(
+        f"north★  orswot anti-entropy fixpoint n={n} R={r} A={a} M={m}: "
+        f"{t*1e3:.2f}ms  {rate/1e6:.2f}M merges/s"
+    )
+    return rate
+
+
+def parity_anchor():
+    """Config 1 + value() parity: scalar CPU reference vs batch path."""
+    from crdt_tpu import GCounter, Orswot
+    from crdt_tpu.batch import GCounterBatch, OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.utils.interning import Universe
+
+    # GCounter: 2 replicas, 4 actors (config 1)
+    uni = Universe(CrdtConfig(num_actors=4, member_capacity=8, deferred_capacity=4))
+    a, b = GCounter(), GCounter()
+    for actor in ("A", "B", "A", "C"):
+        a.apply(a.inc(actor))
+    for actor in ("B", "D"):
+        b.apply(b.inc(actor))
+    expected = a.clone()
+    expected.merge(b)
+    got = (
+        GCounterBatch.from_scalar([a], uni)
+        .merge(GCounterBatch.from_scalar([b], uni))
+        .to_scalar(uni)[0]
+    )
+    # a = {A:2, B:1, C:1}, b = {B:1, D:1} ⇒ join value 2+1+1+1 = 5
+    assert got.value() == expected.value() == 5, (got.value(), expected.value())
+
+    # Orswot sample: batch N-way join value() == scalar N-way join value()
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=16, deferred_capacity=8))
+    rng = np.random.RandomState(3)
+    fleets = []
+    for _ in range(4):
+        row = []
+        for _ in range(8):
+            s = Orswot()
+            for _ in range(rng.randint(0, 6)):
+                actor, member = int(rng.randint(0, 8)), int(rng.randint(0, 9))
+                ctx = s.value().derive_add_ctx(actor)
+                s.apply(s.add(member, ctx))
+            row.append(s)
+        fleets.append(row)
+    batches = [OrswotBatch.from_scalar(row, uni) for row in fleets]
+    acc = batches[0]
+    for nxt in batches[1:]:
+        acc = acc.merge(nxt)
+    got_sets = acc.value_sets(uni)
+    expected_sets = []
+    for i in range(8):
+        merged = Orswot()
+        for row in fleets:
+            merged.merge(row[i])
+        merged.merge(Orswot())
+        expected_sets.append(merged.value().val)
+    assert got_sets == expected_sets, "value() parity violation"
+    log("config1 parity anchor: scalar == batch (GCounter value, Orswot value sets)")
+
+
+def main():
+    import jax
+
+    # local smoke runs force a platform (the ambient axon plugin overrides
+    # the JAX_PLATFORMS env var, so use the config knob directly)
+    plat = os.environ.get("CRDT_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    log(f"backend: {jax.default_backend()}  devices: {len(jax.devices())}  small={SMALL}")
+    parity_anchor()
+    bench_clock_merges()
+    bench_orswot_pairwise()
+    rate = bench_north_star()
+
+    print(
+        json.dumps(
+            {
+                "metric": "orswot_merges_per_sec_to_fixpoint",
+                "value": round(rate, 1),
+                "unit": "merges/s",
+                "vs_baseline": round(rate / 1e7, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Device-mesh parallelism: sharded object axes + collective lattice joins.
+
+The reference has no comm backend — replication is user-transported bytes
+(SURVEY.md §2.3).  The TPU-native equivalent: every CvRDT merge is an
+associative, commutative, idempotent join, so an N-replica global join *is*
+an all-reduce with merge as the combiner — `lax.pmax` over ICI for the
+clock-shaped types, an all-gather + canonical-order fold for ORSWOT state
+(whose reference merge is order-sensitive; see collective.py).  Objects
+shard over the mesh's data axis; replicas reduce over the replica axis.
+"""
+
+from ..config import enable_x64 as _enable_x64
+
+_enable_x64()
+
+from .mesh import make_mesh, shard_batch
+from .collective import (
+    all_reduce_clock_join,
+    allgather_join_orswot,
+    anti_entropy,
+    fold_reduce_merge,
+    gather_fold_orswot,
+    ring_join_orswot,
+    tree_reduce_merge,
+)
+
+__all__ = [
+    "all_reduce_clock_join",
+    "allgather_join_orswot",
+    "gather_fold_orswot",
+    "anti_entropy",
+    "fold_reduce_merge",
+    "make_mesh",
+    "ring_join_orswot",
+    "shard_batch",
+    "tree_reduce_merge",
+]

@@ -1,0 +1,228 @@
+"""Collective lattice joins — anti-entropy as an all-reduce (SURVEY.md §5).
+
+Because ``CvRDT::merge`` is associative, commutative, and idempotent
+(`/root/reference/src/traits.rs:9-12`), the global join of N replicas is a
+reduction with merge as the combiner:
+
+* **clock-shaped state** (VClock / GCounter / PNCounter): merge is pointwise
+  max (`vclock.rs:131-137`), so the cross-device join is literally
+  ``lax.pmax`` — one XLA collective riding ICI.
+* **ORSWOT state**: merge is the dot-algebra kernel; the cross-device join
+  is an **all-gather + canonical-order fold** with merge as the combiner —
+  see :func:`allgather_join_orswot` for why a ppermute ring is *unsafe*
+  for this type (the reference merge is merge-order-sensitive).
+* **replica-axis stacks on one device**: a binary tree of pairwise merges
+  (log2 R kernel launches, all fused under one jit).
+
+Anti-entropy-to-fixpoint (`BASELINE.md` config ★) = fold/collective join +
+one extra self-merge pass to flush deferred removes (the reference's
+"defer plunger", `test/orswot.rs:61-62`), iterated until stable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops import orswot_ops
+
+
+# -- clock-shaped types ------------------------------------------------------
+
+
+def all_reduce_clock_join(clocks, mesh: Mesh, axis: str = "replicas"):
+    """Global VClock/GCounter/PNCounter join across a mesh axis.
+
+    ``clocks``: an array whose leading axis is the replica axis, sharded
+    one replica per device over ``axis`` (leading size must equal the mesh
+    axis size); the join is an all-reduce-max — the direct ICI collective
+    form of N-way ``VClock::merge``.  Every replica row of the output holds
+    the global join."""
+    if clocks.shape[0] != mesh.shape[axis]:
+        raise ValueError(
+            f"leading replica axis {clocks.shape[0]} != mesh axis "
+            f"{axis}={mesh.shape[axis]} (one replica shard per device)"
+        )
+    spec = P(axis, *([None] * (clocks.ndim - 1)))
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
+    )
+    def _join(local):
+        # reduce the local replicas, then all-reduce across devices
+        local_join = jnp.max(local, axis=0, keepdims=True)
+        return jax.lax.pmax(local_join, axis_name=axis)
+
+    return jax.jit(_join)(clocks)
+
+
+# -- generic tree reduction over a replica axis ------------------------------
+
+
+def tree_reduce_merge(stack, merge_fn: Callable):
+    """Reduce a replica-stacked pytree (leading axis R on every leaf) to a
+    single state with a binary merge tree — log2(R) pairwise batch merges,
+    all inside one jit trace.
+
+    ``merge_fn(a, b) -> merged`` takes and returns the pytree without the
+    replica axis.
+
+    CAVEAT: safe for types whose merge is truly commutative (clocks,
+    counters, LWW, MVReg).  For ORSWOT, merge order leaves different stale
+    dots in entry clocks (`orswot.rs:94-103` asymmetry), so use the
+    sequential left fold (:func:`fold_reduce_merge`) when bit-parity with
+    the scalar N-way join matters."""
+    leaves = jax.tree_util.tree_leaves(stack)
+    r = leaves[0].shape[0]
+
+    def take(i):
+        return jax.tree_util.tree_map(lambda x: x[i], stack)
+
+    # tree via repeated halving over python ints (static under jit)
+    parts = [take(i) for i in range(r)]
+    while len(parts) > 1:
+        nxt = []
+        for i in range(0, len(parts) - 1, 2):
+            nxt.append(merge_fn(parts[i], parts[i + 1]))
+        if len(parts) % 2 == 1:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+def fold_reduce_merge(stack, merge_fn: Callable):
+    """Sequential left fold over the replica axis — replica order 0..R-1,
+    bit-matching the scalar idiom ``for w in witnesses: merged.merge(w)``
+    (`test/orswot.rs:53-56`).  R-1 batch merges, each fully parallel over
+    the object axis."""
+    leaves = jax.tree_util.tree_leaves(stack)
+    r = leaves[0].shape[0]
+
+    def take(i):
+        return jax.tree_util.tree_map(lambda x: x[i], stack)
+
+    acc = take(0)
+    for i in range(1, r):
+        acc = merge_fn(acc, take(i))
+    return acc
+
+
+# -- ORSWOT collective join --------------------------------------------------
+
+
+def _orswot_pair_merge(a, b, m_cap: int, d_cap: int):
+    """Pairwise merge over state tuples; returns (state5, overflow)."""
+    *state, overflow = orswot_ops.merge(
+        a[0], a[1], a[2], a[3], a[4], b[0], b[1], b[2], b[3], b[4], m_cap, d_cap
+    )
+    return tuple(state), overflow
+
+
+def gather_fold_orswot(local, axis: str, n_dev: int, m_cap: int, d_cap: int):
+    """The ORSWOT cross-device join body, for use INSIDE shard_map: all-gather
+    each state array over ``axis`` and fold in canonical device order 0..D-1.
+
+    ``local``: 5-tuple of per-device state arrays (no leading replica axis).
+    Returns ``(state5, overflow)`` where overflow is the OR of every pairwise
+    merge's capacity-overflow flags.  The canonical order keeps the result
+    identical on every device AND bit-equal to the scalar left-fold oracle —
+    a ppermute ring (different fold origin per device) breaks both, because
+    the reference merge is order-sensitive (`orswot.rs:94-103` asymmetry)."""
+    gathered = tuple(jax.lax.all_gather(x, axis) for x in local)  # [D, ...]
+    acc = tuple(g[0] for g in gathered)
+    overflow = jnp.zeros(local[0].shape[:1], dtype=bool)
+    for d in range(1, n_dev):
+        acc, over = _orswot_pair_merge(acc, tuple(g[d] for g in gathered), m_cap, d_cap)
+        overflow |= over
+    return acc, overflow
+
+
+def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
+    """All-reduce ORSWOT state across a mesh axis with merge as the
+    combiner; result is identical on every device and bit-equal to the
+    scalar left-fold join in device order 0..D-1 (see
+    :func:`gather_fold_orswot` for why the fold order is canonical and a
+    ppermute ring is not used).
+
+    ``batch``: an :class:`OrswotBatch` whose leading axis is the replica
+    axis, sharded one replica per device over ``axis``.  Raises on
+    capacity overflow when ``check`` (pass ``check=False`` to skip the
+    host sync)."""
+    from ..batch.orswot_batch import OrswotBatch
+
+    m_cap = batch.ids.shape[-1]
+    d_cap = batch.d_ids.shape[-1]
+    n_dev = mesh.shape[axis]
+    if batch.clock.shape[0] != n_dev:
+        raise ValueError(
+            f"leading replica axis {batch.clock.shape[0]} != mesh axis "
+            f"{axis}={n_dev} (one replica shard per device)"
+        )
+    arrays = (batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks)
+    specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+    over_spec = P(axis)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=(specs, over_spec),
+        check_vma=False,
+    )
+    def _join(local):
+        acc, overflow = gather_fold_orswot(
+            tuple(x[0] for x in local), axis, n_dev, m_cap, d_cap
+        )
+        return tuple(x[None] for x in acc), jnp.any(overflow)[None]
+
+    (clock, ids, dots, d_ids, d_clocks), overflow = jax.jit(_join)(arrays)
+    if check and bool(jnp.any(overflow)):
+        raise ValueError(
+            "Orswot capacity overflow in collective join: raise "
+            "member_capacity/deferred_capacity"
+        )
+    return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
+
+
+# backwards-compatible alias (the join is NOT a ppermute ring — see above)
+ring_join_orswot = allgather_join_orswot
+
+
+# -- anti-entropy to fixpoint ------------------------------------------------
+
+
+def anti_entropy(stack, max_rounds: int = 3):
+    """Converge a replica-stacked :class:`OrswotBatch` (leading axis R) to
+    its fixpoint on one device/shard: tree-join the replicas, then keep
+    self-merging (the "defer plunger") until the state stops changing or
+    ``max_rounds`` is hit.  Returns ``(merged, rounds_used)``.
+
+    Deferred removes make a single pass insufficient in general: a remove
+    buffered under a future clock applies only once the joined clock covers
+    it (`orswot.rs:195-211`)."""
+    m_cap = stack.ids.shape[-1]
+    d_cap = stack.d_ids.shape[-1]
+
+    def pair(a, b):
+        # check=True surfaces capacity overflow instead of silently
+        # truncating the joined member set
+        return a.merge(b, check=True)
+
+    merged = fold_reduce_merge(stack, pair)
+    rounds = 1
+    for _ in range(max_rounds - 1):
+        nxt = pair(merged, merged)
+        same = all(
+            bool(jnp.array_equal(x, y))
+            for x, y in zip(jax.tree_util.tree_leaves(nxt), jax.tree_util.tree_leaves(merged))
+        )
+        merged = nxt
+        rounds += 1
+        if same:
+            break
+    return merged, rounds

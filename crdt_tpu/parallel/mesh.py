@@ -1,0 +1,52 @@
+"""Mesh construction and batch sharding helpers.
+
+Axis conventions (SURVEY.md §2.3):
+
+* ``"objects"`` — the data-parallel axis: independent CRDT objects shard
+  across devices (the analogue of DP; no cross-device traffic for pairwise
+  merges).
+* ``"replicas"`` — the replica axis: N copies of the *same* objects whose
+  global join needs cross-device collectives over ICI (the analogue of a
+  comm backend's all-reduce).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Dict[str, int] | None = None, devices: Sequence | None = None) -> Mesh:
+    """Build a mesh from ``{axis_name: size}``.
+
+    Defaults to a 1-D ``objects`` mesh over all local devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    if axes is None:
+        axes = {"objects": len(devices)}
+    sizes = list(axes.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(f"mesh axes {axes} need {np.prod(sizes)} devices, have {len(devices)}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "objects"):
+    """Shard every array of a batch pytree along its leading (object) axis."""
+
+    def put(x):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def replicate(batch, mesh: Mesh):
+    """Fully replicate a batch pytree over the mesh."""
+
+    def put(x):
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(put, batch)

@@ -1,0 +1,40 @@
+"""Random dense CRDT state generators for benchmarks and smoke tests.
+
+Shared by ``bench.py`` and ``__graft_entry__.py`` so the state-layout
+invariants live in one place.  Invariants a valid ORSWOT batch must hold
+(`/root/reference/src/orswot.rs:26-30` via the dense mapping in
+``crdt_tpu/ops/orswot_ops.py``):
+
+* member ids are unique within an object (the sort/align kernel assumes
+  runs of length <= 2);
+* live member slots carry non-empty dot clocks;
+* the set clock covers every entry dot (op-generated states always do).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_orswot_arrays(rng, n, a, m, d, dtype=np.uint32, max_counter=100):
+    """Random valid dense ORSWOT batch of ``n`` objects as numpy arrays
+    ``(clock, ids, dots, d_ids, d_clocks)``."""
+    ids = np.full((n, m), -1, dtype=np.int32)
+    dots = np.zeros((n, m, a), dtype=dtype)
+    live = rng.randint(1, m + 1, size=n)
+    # unique-within-object member ids: random base + strictly increasing
+    # slot offsets (uniqueness is an alignment-kernel invariant)
+    base = rng.randint(0, 1 << 20, size=n)
+    stride = rng.randint(1, 64, size=n)
+    for j in range(m):
+        mask = live > j
+        k = int(mask.sum())
+        if k == 0:
+            continue
+        ids[mask, j] = (base[mask] + j * stride[mask]) % (1 << 24)
+        actor = rng.randint(0, a, size=k)
+        dots[mask, j, actor] = rng.randint(1, max_counter, size=k)
+    clock = dots.max(axis=1)  # set clock covers every entry dot
+    d_ids = np.full((n, d), -1, dtype=np.int32)
+    d_clocks = np.zeros((n, d, a), dtype=dtype)
+    return clock, ids, dots, d_ids, d_clocks

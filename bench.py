@@ -91,14 +91,12 @@ def bench_clock_merges():
     log(f"config5 lwwreg_merge   n={n}: {t*1e3:.2f}ms  {n/t/1e6:.2f}M merges/s")
 
 
-from crdt_tpu.utils.testdata import random_orswot_arrays
-
-
 def bench_orswot_pairwise():
     import jax
     import jax.numpy as jnp
 
     from crdt_tpu.ops import orswot_ops
+    from crdt_tpu.utils.testdata import random_orswot_arrays
 
     rng = np.random.RandomState(1)
     # config 4: 100k sets × 16 actors
@@ -121,6 +119,7 @@ def bench_north_star():
     import jax.numpy as jnp
 
     from crdt_tpu.ops import orswot_ops
+    from crdt_tpu.utils.testdata import random_orswot_arrays
 
     rng = np.random.RandomState(2)
     if SMALL:

@@ -13,14 +13,13 @@ from ..config import enable_x64 as _enable_x64
 
 _enable_x64()
 
-from .mesh import make_mesh, shard_batch
+from .mesh import make_mesh, replicate, shard_batch
 from .collective import (
     all_reduce_clock_join,
     allgather_join_orswot,
     anti_entropy,
     fold_reduce_merge,
     gather_fold_orswot,
-    ring_join_orswot,
     tree_reduce_merge,
 )
 
@@ -31,7 +30,7 @@ __all__ = [
     "anti_entropy",
     "fold_reduce_merge",
     "make_mesh",
-    "ring_join_orswot",
+    "replicate",
     "shard_batch",
     "tree_reduce_merge",
 ]

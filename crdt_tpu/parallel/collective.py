@@ -123,9 +123,26 @@ def _orswot_pair_merge(a, b, m_cap: int, d_cap: int):
     return tuple(state), overflow
 
 
-def gather_fold_orswot(local, axis: str, n_dev: int, m_cap: int, d_cap: int):
+def _fold_orswot_stack(stack5, m_cap: int, d_cap: int):
+    """Canonical left fold over a replica-stacked ORSWOT state 5-tuple
+    (leading axis R on every array), ORing capacity overflow across every
+    pairwise merge.  THE one place the canonical-order + overflow invariant
+    lives; both the collective join and on-device anti-entropy fold through
+    here."""
+    r = stack5[0].shape[0]
+    acc = tuple(x[0] for x in stack5)
+    overflow = jnp.zeros(stack5[0].shape[1:2], dtype=bool)
+    for i in range(1, r):
+        acc, over = _orswot_pair_merge(acc, tuple(x[i] for x in stack5), m_cap, d_cap)
+        overflow |= over
+    return acc, overflow
+
+
+def gather_fold_orswot(local, axis: str, m_cap: int, d_cap: int):
     """The ORSWOT cross-device join body, for use INSIDE shard_map: all-gather
-    each state array over ``axis`` and fold in canonical device order 0..D-1.
+    each state array over ``axis`` and fold in canonical device order 0..D-1
+    (D is the all-gather's leading axis — derived, not caller-supplied, so a
+    wrong device count can't silently truncate the fold).
 
     ``local``: 5-tuple of per-device state arrays (no leading replica axis).
     Returns ``(state5, overflow)`` where overflow is the OR of every pairwise
@@ -134,12 +151,7 @@ def gather_fold_orswot(local, axis: str, n_dev: int, m_cap: int, d_cap: int):
     a ppermute ring (different fold origin per device) breaks both, because
     the reference merge is order-sensitive (`orswot.rs:94-103` asymmetry)."""
     gathered = tuple(jax.lax.all_gather(x, axis) for x in local)  # [D, ...]
-    acc = tuple(g[0] for g in gathered)
-    overflow = jnp.zeros(local[0].shape[:1], dtype=bool)
-    for d in range(1, n_dev):
-        acc, over = _orswot_pair_merge(acc, tuple(g[d] for g in gathered), m_cap, d_cap)
-        overflow |= over
-    return acc, overflow
+    return _fold_orswot_stack(gathered, m_cap, d_cap)
 
 
 def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
@@ -176,7 +188,7 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
     )
     def _join(local):
         acc, overflow = gather_fold_orswot(
-            tuple(x[0] for x in local), axis, n_dev, m_cap, d_cap
+            tuple(x[0] for x in local), axis, m_cap, d_cap
         )
         return tuple(x[None] for x in acc), jnp.any(overflow)[None]
 
@@ -189,40 +201,59 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
     return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
 
-# backwards-compatible alias (the join is NOT a ppermute ring — see above)
-ring_join_orswot = allgather_join_orswot
-
-
 # -- anti-entropy to fixpoint ------------------------------------------------
 
 
-def anti_entropy(stack, max_rounds: int = 3):
+def anti_entropy(stack, max_rounds: int = 3, check: bool = True):
     """Converge a replica-stacked :class:`OrswotBatch` (leading axis R) to
-    its fixpoint on one device/shard: tree-join the replicas, then keep
-    self-merging (the "defer plunger") until the state stops changing or
-    ``max_rounds`` is hit.  Returns ``(merged, rounds_used)``.
+    its fixpoint on one device/shard: left-fold-join the replicas in order
+    0..R-1 (bit-parity with the scalar N-way join — see
+    :func:`fold_reduce_merge`), then keep self-merging (the "defer
+    plunger") until the state stops changing or ``max_rounds`` is hit.
+    Returns ``(merged, rounds_used)``.
 
     Deferred removes make a single pass insufficient in general: a remove
     buffered under a future clock applies only once the joined clock covers
-    it (`orswot.rs:195-211`)."""
+    it (`orswot.rs:195-211`).
+
+    Capacity overflow across every merge is accumulated in-graph and raised
+    once at the end when ``check`` — one host sync per round (the
+    changed/overflow scalars), not one per merge."""
+    from ..batch.orswot_batch import OrswotBatch
+
     m_cap = stack.ids.shape[-1]
     d_cap = stack.d_ids.shape[-1]
+    arrays = (stack.clock, stack.ids, stack.dots, stack.d_ids, stack.d_clocks)
 
-    def pair(a, b):
-        # check=True surfaces capacity overflow instead of silently
-        # truncating the joined member set
-        return a.merge(b, check=True)
+    @jax.jit
+    def _fold(arrays):
+        acc, overflow = _fold_orswot_stack(arrays, m_cap, d_cap)
+        return acc, jnp.any(overflow)
 
-    merged = fold_reduce_merge(stack, pair)
+    @jax.jit
+    def _plunge(acc):
+        nxt, over = _orswot_pair_merge(acc, acc, m_cap, d_cap)
+        same = jnp.array(True)
+        for x, y in zip(nxt, acc):
+            same &= jnp.array_equal(x, y)
+        return nxt, same, jnp.any(over)
+
+    acc, over_dev = _fold(arrays)
+    overflow = bool(over_dev)
     rounds = 1
     for _ in range(max_rounds - 1):
-        nxt = pair(merged, merged)
-        same = all(
-            bool(jnp.array_equal(x, y))
-            for x, y in zip(jax.tree_util.tree_leaves(nxt), jax.tree_util.tree_leaves(merged))
-        )
-        merged = nxt
+        acc, same_dev, over_dev = _plunge(acc)
         rounds += 1
+        same, over = jax.device_get((same_dev, over_dev))
+        overflow |= bool(over)
         if same:
             break
+    if check and overflow:
+        raise ValueError(
+            "Orswot capacity overflow in anti-entropy: raise "
+            "member_capacity/deferred_capacity"
+        )
+    merged = OrswotBatch(
+        clock=acc[0], ids=acc[1], dots=acc[2], d_ids=acc[3], d_clocks=acc[4]
+    )
     return merged, rounds

@@ -1,0 +1,120 @@
+"""Checkpoint / resume for batch (SoA) CRDT states.
+
+The reference's checkpoint story is ``to_binary``/``from_binary`` over the
+full CRDT state (`/root/reference/src/lib.rs:62-83`) — state-based CRDTs make
+checkpointing trivial: the state *is* the checkpoint, and resuming is just a
+merge (idempotent redelivery, `traits.rs:36`; SURVEY.md §5).
+
+Scalar states already round-trip through :mod:`crdt_tpu.utils.serde`.  This
+module covers the **device-side** half: a batch pytree (one of the
+:mod:`crdt_tpu.batch` ``flax.struct`` dataclasses) plus its interning
+:class:`~crdt_tpu.utils.interning.Universe` are written to a single
+``.npz``-format file — the SoA buffers as named numpy arrays, the universe
+registries and the :class:`~crdt_tpu.config.CrdtConfig` as a serde-encoded
+byte blob.  Loading restores an identical batch (bit-exact buffers) and an
+equivalent universe, so ``load(save(x)) == x`` and resume-by-merge works
+across process restarts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Any, Tuple
+
+import numpy as np
+
+from ..config import CrdtConfig
+from .interning import Universe
+from . import serde
+
+FORMAT_VERSION = 1
+
+# Registry of checkpointable batch types by class name.  Populated lazily to
+# keep import order flexible (batch imports jax; checkpoint shouldn't force
+# device init just to read metadata).
+
+
+def _batch_types():
+    from .. import batch
+
+    return {
+        name: getattr(batch, name)
+        for name in batch.__all__
+    }
+
+
+def _universe_blob(universe: Universe) -> bytes:
+    cfg = universe.config
+    payload = {
+        "config": {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)},
+        "actors": universe.actors.values(),
+        "members": universe.members.values(),
+    }
+    return serde.to_binary(payload)
+
+
+def _universe_from_blob(blob: bytes) -> Universe:
+    payload = serde.from_binary(bytes(blob))
+    universe = Universe(CrdtConfig(**payload["config"]))
+    universe.actors.intern_all(payload["actors"])
+    universe.members.intern_all(payload["members"])
+    return universe
+
+
+def save(path, batch_state: Any, universe: Universe) -> None:
+    """Write ``batch_state`` (a :mod:`crdt_tpu.batch` pytree) + its universe.
+
+    ``path`` is a filename or file-like object; the container is numpy's
+    ``.npz`` (zip of ``.npy`` members), readable by any numpy without this
+    package.
+    """
+    cls_name = type(batch_state).__name__
+    if cls_name not in _batch_types():
+        raise TypeError(f"not a checkpointable batch type: {cls_name}")
+    arrays = {
+        f.name: np.asarray(getattr(batch_state, f.name))
+        for f in dataclasses.fields(batch_state)
+    }
+    meta = serde.to_binary({"version": FORMAT_VERSION, "type": cls_name})
+    np.savez(
+        path,
+        __meta__=np.frombuffer(meta, dtype=np.uint8),
+        __universe__=np.frombuffer(_universe_blob(universe), dtype=np.uint8),
+        **arrays,
+    )
+
+
+def load(path) -> Tuple[Any, Universe]:
+    """Load a checkpoint written by :func:`save`.
+
+    Returns ``(batch_state, universe)`` with bit-exact buffers.
+    """
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        meta = serde.from_binary(z["__meta__"].tobytes())
+        if meta.get("version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint version: {meta.get('version')!r}")
+        cls = _batch_types().get(meta.get("type"))
+        if cls is None:
+            raise ValueError(f"unknown batch type in checkpoint: {meta.get('type')!r}")
+        universe = _universe_from_blob(z["__universe__"].tobytes())
+        fields = {
+            f.name: jnp.asarray(z[f.name]) for f in dataclasses.fields(cls)
+        }
+    return cls(**fields), universe
+
+
+def save_bytes(batch_state: Any, universe: Universe) -> bytes:
+    """:func:`save` into an in-memory byte string (for transport: a batch
+    checkpoint doubles as the state-based replication payload — ship it and
+    ``merge`` on the other side)."""
+    buf = io.BytesIO()
+    save(buf, batch_state, universe)
+    return buf.getvalue()
+
+
+def load_bytes(data: bytes) -> Tuple[Any, Universe]:
+    """Inverse of :func:`save_bytes`."""
+    return load(io.BytesIO(data))

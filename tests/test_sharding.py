@@ -1,8 +1,8 @@
 """Multi-device sharding tests on the virtual 8-device CPU mesh.
 
 Validates the collective-join layer (SURVEY.md §2.3, §5): the all-reduce-max
-clock join, the ORSWOT ring all-reduce with merge as the combiner, and
-anti-entropy-to-fixpoint — all against scalar N-way merges.
+clock join, the ORSWOT all-gather + canonical-fold join with merge as the
+combiner, and anti-entropy-to-fixpoint — all against scalar N-way merges.
 """
 
 import jax
@@ -15,12 +15,13 @@ from crdt_tpu.batch import OrswotBatch, VClockBatch
 from crdt_tpu.config import CrdtConfig
 from crdt_tpu.parallel import (
     all_reduce_clock_join,
+    allgather_join_orswot,
     anti_entropy,
     make_mesh,
-    ring_join_orswot,
+    replicate,
+    shard_batch,
     tree_reduce_merge,
 )
-from crdt_tpu.parallel.mesh import shard_batch
 from crdt_tpu.scalar.orswot import Add, Rm
 from crdt_tpu.utils.interning import Universe
 
@@ -90,8 +91,9 @@ def test_all_reduce_clock_join():
         np.testing.assert_array_equal(np.asarray(joined[r]), np.asarray(expected))
 
 
-def test_ring_join_orswot_matches_scalar():
-    """Ring all-reduce with ORSWOT merge combiner == scalar N-way merge."""
+def test_allgather_join_orswot_matches_scalar():
+    """All-gather + canonical fold with ORSWOT merge combiner == scalar
+    N-way merge."""
     mesh = make_mesh({"replicas": 8})
     uni = small_universe()
     fleet = random_orswots(seed=3, n_replicas=8, n_objects=6)
@@ -99,9 +101,9 @@ def test_ring_join_orswot_matches_scalar():
     batches = [OrswotBatch.from_scalar(row, uni) for row in fleet]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
 
-    joined = ring_join_orswot(stacked, mesh, axis="replicas")
+    joined = allgather_join_orswot(stacked, mesh, axis="replicas")
 
-    # ring result must be fully reduced on every device; flush deferred with
+    # the join must be fully reduced on every device; flush deferred with
     # one plunger merge, then compare against the scalar N-way join
     expected = scalar_global_join(fleet)
     for r in range(8):
@@ -147,6 +149,48 @@ def test_fold_reduce_matches_sequential():
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+def test_tree_reduce_matches_fold_for_commutative_merge():
+    """tree_reduce_merge == fold_reduce_merge for clock-shaped (truly
+    commutative) joins, including odd replica counts (the halving carry)."""
+    from crdt_tpu.parallel import fold_reduce_merge
+
+    uni = small_universe()
+    rng = np.random.RandomState(17)
+    for n_replicas in (2, 5, 8):  # even, odd (carry path), power of two
+        stacks = jnp.stack(
+            [
+                VClockBatch.from_scalar(
+                    [
+                        VClock.from_iter(
+                            [(int(a), int(rng.randint(1, 9))) for a in rng.choice(8, 3)]
+                        )
+                        for _ in range(6)
+                    ],
+                    uni,
+                ).clocks
+                for _ in range(n_replicas)
+            ]
+        )  # [R, N, A]
+        tree = tree_reduce_merge(stacks, jnp.maximum)
+        fold = fold_reduce_merge(stacks, jnp.maximum)
+        np.testing.assert_array_equal(np.asarray(tree), np.asarray(fold))
+        np.testing.assert_array_equal(
+            np.asarray(tree), np.asarray(jnp.max(stacks, axis=0))
+        )
+
+
+def test_replicate_places_full_copy_everywhere():
+    mesh = make_mesh({"objects": 8})
+    uni = small_universe()
+    fleet = random_orswots(seed=21, n_replicas=1, n_objects=4)
+    batch = OrswotBatch.from_scalar(fleet[0], uni)
+    rep = replicate(batch, mesh)
+    # fully-replicated sharding: every leaf is addressable whole on each device
+    for leaf in jax.tree_util.tree_leaves(rep):
+        assert leaf.sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(rep.clock), np.asarray(batch.clock))
+
+
 def test_sharded_pairwise_merge_no_collectives():
     """Object-axis sharding: pairwise merge of two sharded batches runs
     SPMD with zero cross-device traffic and matches the unsharded result."""
@@ -161,3 +205,20 @@ def test_sharded_pairwise_merge_no_collectives():
     b_sharded = shard_batch(b, mesh, "objects")
     got = a_sharded.merge(b_sharded).to_scalar(uni)
     assert got == expected
+
+    # the headline claim: the compiled merge contains no cross-device
+    # collectives (objects are independent; XLA must not reshard)
+    m_cap, d_cap = a.ids.shape[-1], a.d_ids.shape[-1]
+    from crdt_tpu.ops import orswot_ops
+
+    compiled = (
+        jax.jit(lambda x, y: orswot_ops.merge(*x, *y, m_cap, d_cap)[:5])
+        .lower(
+            tuple(jax.tree_util.tree_leaves(a_sharded)),
+            tuple(jax.tree_util.tree_leaves(b_sharded)),
+        )
+        .compile()
+    )
+    hlo = compiled.as_text()
+    for collective in ("all-gather", "all-reduce", "collective-permute", "all-to-all"):
+        assert collective not in hlo, f"sharded pairwise merge emitted {collective}"

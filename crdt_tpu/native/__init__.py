@@ -1,0 +1,16 @@
+"""The native (C++) kernel engine.
+
+The reference is 100% Rust (SURVEY.md §2) — its performance-critical
+equivalents here are C++ batch kernels over the same dense SoA layouts the
+JAX engine uses, loaded through a plain C ABI with ctypes (no pybind11 in
+this environment).  The library self-builds on first use via ``make``; use
+:func:`available` to probe without raising.
+
+Import is lazy and jax-free: this package must be importable (and usable)
+without initializing any accelerator backend — it is the host-side engine.
+"""
+
+from .loader import available, load
+from . import engine
+
+__all__ = ["available", "engine", "load"]

@@ -1,0 +1,492 @@
+// crdt_core — native scalar/batch CRDT kernels over dense SoA buffers.
+//
+// The reference implementation language is Rust (SURVEY.md §2: no Python in
+// the reference at all), so the native half of this framework is C++: the
+// same dense layouts as the JAX batch engine (crdt_tpu/ops/*.py), computed
+// on the host with bit-exact outputs — including slot ordering — so the
+// Python parity tests can compare arrays byte-for-byte across all three
+// engines (scalar Python, JAX/XLA, C++).
+//
+// Dense layouts (row-major, one object per row):
+//   VClock     counters[N, A]        absent actor == 0    (vclock.rs:206-210)
+//   LWWReg     val[N], marker[N]                          (lwwreg.rs:27-32)
+//   MVReg      clocks[N, K, A], vals[N, K]                (mvreg.rs:44-46)
+//   ORSWOT     clock[N, A], ids[N, M] (-1 = empty),
+//              dots[N, M, A], d_ids[N, D], d_clocks[N, D, A]
+//                                                         (orswot.rs:26-30)
+//
+// Counter type C is instantiated for uint32_t and uint64_t (reference:
+// u64, vclock.rs:23; u32 for memory-lean TPU configs).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <utility>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr int32_t kEmpty = -1;
+
+// ---- VClock primitives (vclock.rs:59-71,103-137,219-242) -------------------
+
+template <typename C>
+inline bool clock_is_empty(const C* c, int64_t a) {
+  for (int64_t i = 0; i < a; ++i)
+    if (c[i]) return false;
+  return true;
+}
+
+template <typename C>
+inline bool clock_leq(const C* x, const C* y, int64_t a) {  // x <= y
+  for (int64_t i = 0; i < a; ++i)
+    if (x[i] > y[i]) return false;
+  return true;
+}
+
+template <typename C>
+inline bool clock_eq(const C* x, const C* y, int64_t a) {
+  for (int64_t i = 0; i < a; ++i)
+    if (x[i] != y[i]) return false;
+  return true;
+}
+
+template <typename C>
+inline void clock_max_into(C* acc, const C* x, int64_t a) {  // merge
+  for (int64_t i = 0; i < a; ++i) acc[i] = std::max(acc[i], x[i]);
+}
+
+// out = dot-algebra rule for a member present in BOTH sides
+// (orswot.rs:105-129): common ∪ (e1 − common − other_clock)
+//                             ∪ (e2 − common − self_clock)
+// where ∩ is same-counter match and − is the keep-iff-greater subtract.
+template <typename C>
+inline void dot_rule_both(const C* e1, const C* e2, const C* sc, const C* oc,
+                          C* out, int64_t a) {
+  for (int64_t i = 0; i < a; ++i) {
+    C common = (e1[i] == e2[i]) ? e1[i] : 0;
+    C c1 = (e1[i] > common) ? e1[i] : 0;  // subtract(e1, common)
+    c1 = (c1 > oc[i]) ? c1 : 0;           // subtract(-, other_clock)
+    C c2 = (e2[i] > common) ? e2[i] : 0;
+    c2 = (c2 > sc[i]) ? c2 : 0;
+    out[i] = std::max(common, std::max(c1, c2));
+  }
+}
+
+}  // namespace
+
+// ==== elementwise VClock batch ops (count = N*A flattened) ==================
+
+#define DEFINE_ELEMENTWISE(SUF, C)                                            \
+  void vclock_merge_##SUF(const C* x, const C* y, C* out, int64_t count) {    \
+    _Pragma("omp parallel for")                                               \
+    for (int64_t i = 0; i < count; ++i) out[i] = x[i] > y[i] ? x[i] : y[i];   \
+  }                                                                           \
+  void vclock_intersect_##SUF(const C* x, const C* y, C* out, int64_t count) {\
+    _Pragma("omp parallel for")                                               \
+    for (int64_t i = 0; i < count; ++i) out[i] = (x[i] == y[i]) ? x[i] : 0;   \
+  }                                                                           \
+  void vclock_subtract_##SUF(const C* x, const C* y, C* out, int64_t count) { \
+    _Pragma("omp parallel for")                                               \
+    for (int64_t i = 0; i < count; ++i) out[i] = (x[i] > y[i]) ? x[i] : 0;    \
+  }                                                                           \
+  void vclock_truncate_##SUF(const C* x, const C* y, C* out, int64_t count) { \
+    _Pragma("omp parallel for")                                               \
+    for (int64_t i = 0; i < count; ++i) out[i] = x[i] < y[i] ? x[i] : y[i];   \
+  }                                                                           \
+  /* per-row lattice partial order over [n, a]: leq/geq bitmaps */            \
+  void vclock_compare_##SUF(const C* x, const C* y, int64_t n, int64_t a,     \
+                            uint8_t* leq, uint8_t* geq) {                     \
+    _Pragma("omp parallel for")                                               \
+    for (int64_t r = 0; r < n; ++r) {                                         \
+      leq[r] = clock_leq(x + r * a, y + r * a, a);                            \
+      geq[r] = clock_leq(y + r * a, x + r * a, a);                            \
+    }                                                                         \
+  }
+
+// ==== LWWReg merge (lwwreg.rs:43-67) =======================================
+// Values are opaque 64-bit payloads; conflict = equal marker, different val.
+
+#define DEFINE_LWW(SUF, C)                                                    \
+  void lww_merge_##SUF(const int64_t* va, const C* ma, const int64_t* vb,     \
+                       const C* mb, int64_t* vo, C* mo, uint8_t* conflict,    \
+                       int64_t n) {                                           \
+    _Pragma("omp parallel for")                                               \
+    for (int64_t i = 0; i < n; ++i) {                                         \
+      bool take_b = mb[i] > ma[i];                                            \
+      vo[i] = take_b ? vb[i] : va[i];                                         \
+      mo[i] = take_b ? mb[i] : ma[i];                                         \
+      conflict[i] = (ma[i] == mb[i]) && (va[i] != vb[i]);                     \
+    }                                                                         \
+  }
+
+// ==== MVReg merge (mvreg.rs:121-153) =======================================
+// Output order matches crdt_tpu/ops/mvreg_ops.py merge+compact: self's
+// surviving slots (in slot order) first, then other's, packed to k_cap.
+
+template <typename C>
+static void mvreg_merge_impl(const C* ca, const int64_t* va, const C* cb,
+                             const int64_t* vb, int64_t n, int64_t k,
+                             int64_t a, int64_t k_cap, C* co, int64_t* vo,
+                             uint8_t* overflow) {
+#pragma omp parallel for
+  for (int64_t r = 0; r < n; ++r) {
+    const C* A_ = ca + r * k * a;
+    const C* B_ = cb + r * k * a;
+    std::vector<bool> act_a(k), act_b(k), keep_a(k), keep_b(k);
+    for (int64_t i = 0; i < k; ++i) act_a[i] = !clock_is_empty(A_ + i * a, a);
+    for (int64_t j = 0; j < k; ++j) act_b[j] = !clock_is_empty(B_ + j * a, a);
+    // keep self vals not strictly dominated by any other val (mvreg.rs:124-131)
+    for (int64_t i = 0; i < k; ++i) {
+      bool keep = act_a[i];
+      for (int64_t j = 0; keep && j < k; ++j)
+        if (act_b[j] && clock_leq(A_ + i * a, B_ + j * a, a) &&
+            !clock_eq(A_ + i * a, B_ + j * a, a))
+          keep = false;
+      keep_a[i] = keep;
+    }
+    // keep other vals not strictly dominated, deduped by clock equality
+    // against KEPT self vals (mvreg.rs:133-148)
+    for (int64_t j = 0; j < k; ++j) {
+      bool keep = act_b[j];
+      for (int64_t i = 0; keep && i < k; ++i)
+        if (act_a[i] && clock_leq(B_ + j * a, A_ + i * a, a) &&
+            !clock_eq(B_ + j * a, A_ + i * a, a))
+          keep = false;
+      for (int64_t i = 0; keep && i < k; ++i)
+        if (keep_a[i] && clock_eq(A_ + i * a, B_ + j * a, a)) keep = false;
+      keep_b[j] = keep;
+    }
+    C* out_c = co + r * k_cap * a;
+    int64_t* out_v = vo + r * k_cap;
+    std::memset(out_c, 0, sizeof(C) * k_cap * a);
+    std::memset(out_v, 0, sizeof(int64_t) * k_cap);
+    int64_t w = 0, live = 0;
+    for (int64_t i = 0; i < k; ++i)
+      if (keep_a[i]) {
+        ++live;
+        if (w < k_cap) {
+          std::memcpy(out_c + w * a, A_ + i * a, sizeof(C) * a);
+          out_v[w++] = va[r * k + i];
+        }
+      }
+    for (int64_t j = 0; j < k; ++j)
+      if (keep_b[j]) {
+        ++live;
+        if (w < k_cap) {
+          std::memcpy(out_c + w * a, B_ + j * a, sizeof(C) * a);
+          out_v[w++] = vb[r * k + j];
+        }
+      }
+    overflow[r] = live > k_cap;
+  }
+}
+
+#define DEFINE_MVREG(SUF, C)                                                  \
+  void mvreg_merge_##SUF(const C* ca, const int64_t* va, const C* cb,         \
+                         const int64_t* vb, int64_t n, int64_t k, int64_t a,  \
+                         int64_t k_cap, C* co, int64_t* vo,                   \
+                         uint8_t* overflow) {                                 \
+    mvreg_merge_impl<C>(ca, va, cb, vb, n, k, a, k_cap, co, vo, overflow);    \
+  }
+
+// ==== ORSWOT ================================================================
+
+namespace {
+
+// Replay buffered removes (orswot.rs:195-243), single pass, matching
+// crdt_tpu/ops/orswot_ops.py::_apply_deferred: per member subtract the join
+// of all matching deferred clocks, drop emptied members, retain deferred
+// rows still ahead of the set clock.
+template <typename C>
+void apply_deferred_row(const C* clock, std::vector<int32_t>& ids,
+                        std::vector<C>& dots, std::vector<int32_t>& d_ids,
+                        std::vector<C>& d_clocks, int64_t a) {
+  std::vector<C> rm(a);
+  for (size_t e = 0; e < ids.size(); ++e) {
+    if (ids[e] == kEmpty) continue;
+    std::fill(rm.begin(), rm.end(), 0);
+    bool any = false;
+    for (size_t q = 0; q < d_ids.size(); ++q) {
+      if (d_ids[q] != kEmpty && d_ids[q] == ids[e]) {
+        clock_max_into(rm.data(), d_clocks.data() + q * a, a);
+        any = true;
+      }
+    }
+    if (!any) continue;
+    C* ed = dots.data() + e * a;
+    for (int64_t i = 0; i < a; ++i) ed[i] = (ed[i] > rm[i]) ? ed[i] : 0;
+    if (clock_is_empty(ed, a)) {
+      ids[e] = kEmpty;
+      std::memset(ed, 0, sizeof(C) * a);
+    }
+  }
+  // keep only rows whose clock is not yet covered (orswot.rs:197)
+  for (size_t q = 0; q < d_ids.size(); ++q) {
+    if (d_ids[q] == kEmpty) continue;
+    if (clock_leq(d_clocks.data() + q * a, clock, a)) {
+      d_ids[q] = kEmpty;
+      std::memset(d_clocks.data() + q * a, 0, sizeof(C) * a);
+    }
+  }
+}
+
+template <typename C>
+void orswot_merge_impl(
+    const C* clock_a, const int32_t* ids_a, const C* dots_a,
+    const int32_t* dids_a, const C* dclocks_a, const C* clock_b,
+    const int32_t* ids_b, const C* dots_b, const int32_t* dids_b,
+    const C* dclocks_b, int64_t n, int64_t a, int64_t m, int64_t d,
+    int64_t m_cap, int64_t d_cap, C* clock_o, int32_t* ids_o, C* dots_o,
+    int32_t* dids_o, C* dclocks_o, uint8_t* overflow) {
+#pragma omp parallel for
+  for (int64_t r = 0; r < n; ++r) {
+    const C* sc = clock_a + r * a;
+    const C* oc = clock_b + r * a;
+
+    // align live members of both sides by id, ascending (the JAX kernel's
+    // stable sort over the concatenated tables gives the same order)
+    struct Slot { int32_t id; int8_t side; int64_t idx; };
+    std::vector<Slot> slots;
+    slots.reserve(2 * m);
+    for (int64_t j = 0; j < m; ++j)
+      if (ids_a[r * m + j] != kEmpty) slots.push_back({ids_a[r * m + j], 0, j});
+    for (int64_t j = 0; j < m; ++j)
+      if (ids_b[r * m + j] != kEmpty) slots.push_back({ids_b[r * m + j], 1, j});
+    std::stable_sort(slots.begin(), slots.end(),
+                     [](const Slot& x, const Slot& y) { return x.id < y.id; });
+
+    std::vector<int32_t> out_ids;
+    std::vector<C> out_dots;
+    out_ids.reserve(slots.size());
+    out_dots.reserve(slots.size() * a);
+    std::vector<C> merged(a);
+    for (size_t s = 0; s < slots.size();) {
+      int32_t id = slots[s].id;
+      const C* e1 = nullptr;
+      const C* e2 = nullptr;
+      while (s < slots.size() && slots[s].id == id) {
+        if (slots[s].side == 0)
+          e1 = dots_a + (r * m + slots[s].idx) * a;
+        else
+          e2 = dots_b + (r * m + slots[s].idx) * a;
+        ++s;
+      }
+      if (e1 && e2) {
+        dot_rule_both(e1, e2, sc, oc, merged.data(), a);
+      } else if (e1) {
+        // only in self: keep the FULL clock iff not dominated by other's
+        // set clock (orswot.rs:94-103)
+        if (clock_leq(e1, oc, a)) continue;
+        std::copy(e1, e1 + a, merged.begin());
+      } else {
+        // only in other: keep the SUBTRACTED clock (orswot.rs:132-138)
+        for (int64_t i = 0; i < a; ++i) merged[i] = (e2[i] > sc[i]) ? e2[i] : 0;
+      }
+      if (clock_is_empty(merged.data(), a)) continue;
+      out_ids.push_back(id);
+      out_dots.insert(out_dots.end(), merged.begin(), merged.end());
+    }
+
+    // deferred union, exact-duplicate rows dropped keeping the first
+    // (orswot.rs:141-148; the reference map is keyed (clock → members))
+    std::vector<int32_t> dq;
+    std::vector<C> dqc;
+    auto push_deferred = [&](const int32_t* dids, const C* dclocks) {
+      for (int64_t q = 0; q < d; ++q) {
+        int32_t id = dids[r * d + q];
+        if (id == kEmpty) continue;
+        const C* ck = dclocks + (r * d + q) * a;
+        bool dup = false;
+        for (size_t p = 0; !dup && p < dq.size(); ++p)
+          dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
+        if (!dup) {
+          dq.push_back(id);
+          dqc.insert(dqc.end(), ck, ck + a);
+        }
+      }
+    };
+    push_deferred(dids_a, dclocks_a);
+    push_deferred(dids_b, dclocks_b);
+
+    // clock join (orswot.rs:153), then replay deferred (orswot.rs:155)
+    C* out_clock = clock_o + r * a;
+    for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
+    apply_deferred_row(out_clock, out_ids, out_dots, dq, dqc, a);
+
+    // compact into the output capacities, live-first stable order
+    int32_t* oi = ids_o + r * m_cap;
+    C* od = dots_o + r * m_cap * a;
+    std::fill(oi, oi + m_cap, kEmpty);
+    std::memset(od, 0, sizeof(C) * m_cap * a);
+    int64_t w = 0, live = 0;
+    for (size_t e = 0; e < out_ids.size(); ++e) {
+      if (out_ids[e] == kEmpty) continue;
+      ++live;
+      if (w < m_cap) {
+        oi[w] = out_ids[e];
+        std::memcpy(od + w * a, out_dots.data() + e * a, sizeof(C) * a);
+        ++w;
+      }
+    }
+    int32_t* oq = dids_o + r * d_cap;
+    C* oqc = dclocks_o + r * d_cap * a;
+    std::fill(oq, oq + d_cap, kEmpty);
+    std::memset(oqc, 0, sizeof(C) * d_cap * a);
+    int64_t wq = 0, live_q = 0;
+    for (size_t q = 0; q < dq.size(); ++q) {
+      if (dq[q] == kEmpty) continue;
+      ++live_q;
+      if (wq < d_cap) {
+        oq[wq] = dq[q];
+        std::memcpy(oqc + wq * a, dqc.data() + q * a, sizeof(C) * a);
+        ++wq;
+      }
+    }
+    overflow[r] = (live > m_cap) || (live_q > d_cap);
+  }
+}
+
+// One Op::Add per object (orswot.rs:66-79), slot positions untouched —
+// matching crdt_tpu/ops/orswot_ops.py::apply_add (existing slot, else first
+// free slot; dedup on clock[actor] >= counter; then replay deferred).
+template <typename C>
+void orswot_apply_add_impl(C* clock, int32_t* ids, C* dots, int32_t* dids,
+                           C* dclocks, const int32_t* actor_idx,
+                           const C* counter, const int32_t* member_id,
+                           int64_t n, int64_t a, int64_t m, int64_t d,
+                           uint8_t* overflow) {
+#pragma omp parallel for
+  for (int64_t r = 0; r < n; ++r) {
+    C* ck = clock + r * a;
+    int32_t* id_row = ids + r * m;
+    C* dt = dots + r * m * a;
+    int32_t act = actor_idx[r];
+    C cnt = counter[r];
+    overflow[r] = 0;
+    bool seen = ck[act] >= cnt;
+    if (!seen) {
+      int64_t slot = -1;
+      for (int64_t j = 0; j < m && slot < 0; ++j)
+        if (id_row[j] == member_id[r]) slot = j;
+      if (slot < 0)
+        for (int64_t j = 0; j < m && slot < 0; ++j)
+          if (id_row[j] == kEmpty) slot = j;
+      if (slot < 0) {
+        overflow[r] = 1;
+      } else {
+        id_row[slot] = member_id[r];
+        C* ed = dt + slot * a;
+        ed[act] = std::max(ed[act], cnt);
+        ck[act] = std::max(ck[act], cnt);
+      }
+    }
+    // replay deferred against the (possibly) advanced clock
+    std::vector<int32_t> ids_v(id_row, id_row + m);
+    std::vector<C> dots_v(dt, dt + m * a);
+    std::vector<int32_t> dq(dids + r * d, dids + (r + 1) * d);
+    std::vector<C> dqc(dclocks + r * d * a, dclocks + (r + 1) * d * a);
+    apply_deferred_row(ck, ids_v, dots_v, dq, dqc, a);
+    std::copy(ids_v.begin(), ids_v.end(), id_row);
+    std::copy(dots_v.begin(), dots_v.end(), dt);
+    std::copy(dq.begin(), dq.end(), dids + r * d);
+    std::copy(dqc.begin(), dqc.end(), dclocks + r * d * a);
+  }
+}
+
+// One Op::Rm per object (orswot.rs:195-211), matching
+// crdt_tpu/ops/orswot_ops.py::apply_remove: buffer when the remove clock is
+// ahead (deduped), always subtract it from the member's dots.
+template <typename C>
+void orswot_apply_remove_impl(const C* clock, int32_t* ids, C* dots,
+                              int32_t* dids, C* dclocks, const C* rm_clock,
+                              const int32_t* member_id, int64_t n, int64_t a,
+                              int64_t m, int64_t d, uint8_t* overflow) {
+#pragma omp parallel for
+  for (int64_t r = 0; r < n; ++r) {
+    const C* ck = clock + r * a;
+    const C* rc = rm_clock + r * a;
+    int32_t* id_row = ids + r * m;
+    C* dt = dots + r * m * a;
+    int32_t* dq = dids + r * d;
+    C* dqc = dclocks + r * d * a;
+    overflow[r] = 0;
+
+    bool ahead = !clock_leq(rc, ck, a);
+    if (ahead) {
+      bool already = false;
+      for (int64_t q = 0; !already && q < d; ++q)
+        already = dq[q] == member_id[r] && clock_eq(dqc + q * a, rc, a);
+      if (!already) {
+        int64_t slot = -1;
+        for (int64_t q = 0; q < d && slot < 0; ++q)
+          if (dq[q] == kEmpty) slot = q;
+        if (slot < 0) {
+          overflow[r] = 1;
+        } else {
+          dq[slot] = member_id[r];
+          std::memcpy(dqc + slot * a, rc, sizeof(C) * a);
+        }
+      }
+    }
+    for (int64_t j = 0; j < m; ++j) {
+      if (id_row[j] != member_id[r]) continue;
+      C* ed = dt + j * a;
+      for (int64_t i = 0; i < a; ++i) ed[i] = (ed[i] > rc[i]) ? ed[i] : 0;
+      if (clock_is_empty(ed, a)) {
+        id_row[j] = kEmpty;
+        std::memset(ed, 0, sizeof(C) * a);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+#define DEFINE_ORSWOT(SUF, C)                                                 \
+  void orswot_merge_##SUF(                                                    \
+      const C* clock_a, const int32_t* ids_a, const C* dots_a,                \
+      const int32_t* dids_a, const C* dclocks_a, const C* clock_b,            \
+      const int32_t* ids_b, const C* dots_b, const int32_t* dids_b,           \
+      const C* dclocks_b, int64_t n, int64_t a, int64_t m, int64_t d,         \
+      int64_t m_cap, int64_t d_cap, C* clock_o, int32_t* ids_o, C* dots_o,    \
+      int32_t* dids_o, C* dclocks_o, uint8_t* overflow) {                     \
+    orswot_merge_impl<C>(clock_a, ids_a, dots_a, dids_a, dclocks_a, clock_b,  \
+                         ids_b, dots_b, dids_b, dclocks_b, n, a, m, d, m_cap, \
+                         d_cap, clock_o, ids_o, dots_o, dids_o, dclocks_o,    \
+                         overflow);                                           \
+  }                                                                           \
+  void orswot_apply_add_##SUF(C* clock, int32_t* ids, C* dots, int32_t* dids, \
+                              C* dclocks, const int32_t* actor_idx,           \
+                              const C* counter, const int32_t* member_id,     \
+                              int64_t n, int64_t a, int64_t m, int64_t d,     \
+                              uint8_t* overflow) {                            \
+    orswot_apply_add_impl<C>(clock, ids, dots, dids, dclocks, actor_idx,      \
+                             counter, member_id, n, a, m, d, overflow);       \
+  }                                                                           \
+  void orswot_apply_remove_##SUF(                                             \
+      const C* clock, int32_t* ids, C* dots, int32_t* dids, C* dclocks,       \
+      const C* rm_clock, const int32_t* member_id, int64_t n, int64_t a,      \
+      int64_t m, int64_t d, uint8_t* overflow) {                              \
+    orswot_apply_remove_impl<C>(clock, ids, dots, dids, dclocks, rm_clock,    \
+                                member_id, n, a, m, d, overflow);             \
+  }
+
+#define DEFINE_ALL(SUF, C) \
+  DEFINE_ELEMENTWISE(SUF, C) \
+  DEFINE_LWW(SUF, C) \
+  DEFINE_MVREG(SUF, C) \
+  DEFINE_ORSWOT(SUF, C)
+
+extern "C" {
+
+DEFINE_ALL(u32, uint32_t)
+DEFINE_ALL(u64, uint64_t)
+
+int crdt_core_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,281 @@
+"""Numpy-facing wrappers over the native C++ kernels.
+
+Same dense SoA layouts and bit-exact outputs (including slot order) as the
+JAX batch kernels in :mod:`crdt_tpu.ops` — the three engines (scalar Python,
+JAX/XLA, native C++) are interchangeable behind the same array contracts,
+and the parity suite compares them byte-for-byte.
+
+Counter dtype may be uint32 or uint64 (reference: u64, `vclock.rs:23`); the
+two instantiations are separate C symbols picked by dtype.  LWWReg values
+and MVReg payloads cross the ABI as int64 (interned ids / opaque payloads).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import loader
+
+_SUFFIX = {np.dtype(np.uint32): "u32", np.dtype(np.uint64): "u64"}
+
+
+def _fn(name: str, dtype) -> "ctypes._CFuncPtr":
+    suf = _SUFFIX.get(np.dtype(dtype))
+    if suf is None:
+        raise TypeError(f"unsupported counter dtype {dtype!r} (uint32/uint64)")
+    return getattr(loader.load(), f"{name}_{suf}")
+
+
+def _ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def _contig(*arrays):
+    return tuple(np.ascontiguousarray(x) for x in arrays)
+
+
+def _check_counters(*arrays):
+    dt = np.dtype(arrays[0].dtype)
+    for x in arrays[1:]:
+        if np.dtype(x.dtype) != dt:
+            raise TypeError(f"counter dtype mismatch: {dt} vs {x.dtype}")
+    return dt
+
+
+# -- VClock ------------------------------------------------------------------
+
+
+def _elementwise(name: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    a, b = _contig(a, b)
+    dt = _check_counters(a, b)
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
+    out = np.empty_like(a)
+    _fn(name, dt)(_ptr(a), _ptr(b), _ptr(out), ctypes.c_int64(a.size))
+    return out
+
+
+def vclock_merge(a, b):
+    """Pointwise max (`vclock.rs:131-137`)."""
+    return _elementwise("vclock_merge", a, b)
+
+
+def vclock_intersection(a, b):
+    """Common dots (`vclock.rs:219-228`)."""
+    return _elementwise("vclock_intersect", a, b)
+
+
+def vclock_subtract(a, b):
+    """Keep a's dots ahead of b's (`vclock.rs:236-242`)."""
+    return _elementwise("vclock_subtract", a, b)
+
+
+def vclock_truncate(a, b):
+    """GLB, pointwise min (`vclock.rs:103-120`)."""
+    return _elementwise("vclock_truncate", a, b)
+
+
+def vclock_compare(a, b):
+    """Per-row lattice partial order over ``[n, A]``: ``(leq, geq)`` bool
+    arrays (`vclock.rs:59-71`)."""
+    a, b = _contig(a, b)
+    dt = _check_counters(a, b)
+    if a.shape != b.shape or a.ndim < 1:
+        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
+    n = int(np.prod(a.shape[:-1], dtype=np.int64)) if a.ndim > 1 else 1
+    actors = a.shape[-1]
+    leq = np.empty(n, dtype=np.uint8)
+    geq = np.empty(n, dtype=np.uint8)
+    _fn("vclock_compare", dt)(
+        _ptr(a), _ptr(b), ctypes.c_int64(n), ctypes.c_int64(actors),
+        _ptr(leq), _ptr(geq),
+    )
+    shape = a.shape[:-1]
+    return leq.astype(bool).reshape(shape), geq.astype(bool).reshape(shape)
+
+
+# -- LWWReg ------------------------------------------------------------------
+
+
+def lww_merge(val_a, marker_a, val_b, marker_b):
+    """Batched LWW merge; returns ``(val, marker, conflict)``
+    (`lwwreg.rs:43-67`; conflict surfaced as a bitmap, SURVEY.md §7.3)."""
+    val_a, val_b = _contig(
+        np.asarray(val_a, dtype=np.int64), np.asarray(val_b, dtype=np.int64)
+    )
+    marker_a, marker_b = _contig(marker_a, marker_b)
+    dt = _check_counters(marker_a, marker_b)
+    if not (val_a.shape == val_b.shape == marker_a.shape == marker_b.shape):
+        raise ValueError(
+            f"lww_merge: shape mismatch {val_a.shape}/{marker_a.shape}/"
+            f"{val_b.shape}/{marker_b.shape}"
+        )
+    n = marker_a.size
+    val = np.empty_like(val_a)
+    marker = np.empty_like(marker_a)
+    conflict = np.empty(n, dtype=np.uint8)
+    _fn("lww_merge", dt)(
+        _ptr(val_a), _ptr(marker_a), _ptr(val_b), _ptr(marker_b),
+        _ptr(val), _ptr(marker), _ptr(conflict), ctypes.c_int64(n),
+    )
+    return val, marker, conflict.astype(bool).reshape(marker_a.shape)
+
+
+# -- MVReg -------------------------------------------------------------------
+
+
+def mvreg_merge(clocks_a, vals_a, clocks_b, vals_b, k_cap: int | None = None):
+    """Batched antichain merge (`mvreg.rs:121-153`); returns
+    ``(clocks, vals, overflow)`` packed to ``k_cap`` slots, self's survivors
+    first — the same order as the JAX ``merge`` + ``compact``."""
+    clocks_a, clocks_b = _contig(clocks_a, clocks_b)
+    vals_a, vals_b = _contig(
+        np.asarray(vals_a, dtype=np.int64), np.asarray(vals_b, dtype=np.int64)
+    )
+    dt = _check_counters(clocks_a, clocks_b)
+    if clocks_a.shape != clocks_b.shape or clocks_a.ndim < 2:
+        raise ValueError(f"shape mismatch: {clocks_a.shape} vs {clocks_b.shape}")
+    if vals_a.shape != clocks_a.shape[:-1] or vals_b.shape != clocks_b.shape[:-1]:
+        raise ValueError(
+            f"mvreg_merge: vals shapes {vals_a.shape}/{vals_b.shape} don't "
+            f"match clocks {clocks_a.shape[:-1]}"
+        )
+    *lead, k, a = clocks_a.shape
+    n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    k_cap = k if k_cap is None else k_cap
+    clocks = np.zeros((*lead, k_cap, a), dtype=dt)
+    vals = np.zeros((*lead, k_cap), dtype=np.int64)
+    overflow = np.empty(n, dtype=np.uint8)
+    _fn("mvreg_merge", dt)(
+        _ptr(clocks_a), _ptr(vals_a), _ptr(clocks_b), _ptr(vals_b),
+        ctypes.c_int64(n), ctypes.c_int64(k), ctypes.c_int64(a),
+        ctypes.c_int64(k_cap), _ptr(clocks), _ptr(vals), _ptr(overflow),
+    )
+    return clocks, vals, overflow.astype(bool).reshape(lead)
+
+
+# -- ORSWOT ------------------------------------------------------------------
+
+
+def _orswot_state(clock, ids, dots, d_ids, d_clocks):
+    clock, dots, d_clocks = _contig(clock, dots, d_clocks)
+    ids, d_ids = _contig(
+        np.asarray(ids, dtype=np.int32), np.asarray(d_ids, dtype=np.int32)
+    )
+    # full cross-field shape check: the C kernels index with raw pointer
+    # arithmetic, so any inconsistency here is an out-of-bounds read there
+    *lead, a = clock.shape
+    m = ids.shape[-1]
+    d = d_ids.shape[-1]
+    expect = {
+        "ids": (*lead, m),
+        "dots": (*lead, m, a),
+        "d_ids": (*lead, d),
+        "d_clocks": (*lead, d, a),
+    }
+    got = {"ids": ids.shape, "dots": dots.shape,
+           "d_ids": d_ids.shape, "d_clocks": d_clocks.shape}
+    if got != expect:
+        raise ValueError(f"inconsistent ORSWOT state shapes: {got} != {expect}")
+    return clock, ids, dots, d_ids, d_clocks
+
+
+def orswot_merge(
+    clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int | None = None, d_cap: int | None = None,
+):
+    """Full pairwise ORSWOT merge (`orswot.rs:89-156`), bit-exact with
+    :func:`crdt_tpu.ops.orswot_ops.merge` including output slot order
+    (members ascending by id, deferred rows in self-then-other order).
+
+    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)``."""
+    A = _orswot_state(clock_a, ids_a, dots_a, dids_a, dclocks_a)
+    B = _orswot_state(clock_b, ids_b, dots_b, dids_b, dclocks_b)
+    dt = _check_counters(A[0], B[0])
+    if any(x.shape != y.shape for x, y in zip(A, B)):
+        raise ValueError(
+            f"orswot_merge: side shapes differ: "
+            f"{[x.shape for x in A]} vs {[y.shape for y in B]}"
+        )
+    *lead, a = A[0].shape
+    n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    m = A[1].shape[-1]
+    d = A[3].shape[-1]
+    m_cap = m if m_cap is None else m_cap
+    d_cap = d if d_cap is None else d_cap
+
+    clock = np.empty((*lead, a), dtype=dt)
+    ids = np.empty((*lead, m_cap), dtype=np.int32)
+    dots = np.empty((*lead, m_cap, a), dtype=dt)
+    d_ids = np.empty((*lead, d_cap), dtype=np.int32)
+    d_clocks = np.empty((*lead, d_cap, a), dtype=dt)
+    overflow = np.empty(n, dtype=np.uint8)
+    _fn("orswot_merge", dt)(
+        _ptr(A[0]), _ptr(A[1]), _ptr(A[2]), _ptr(A[3]), _ptr(A[4]),
+        _ptr(B[0]), _ptr(B[1]), _ptr(B[2]), _ptr(B[3]), _ptr(B[4]),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(m),
+        ctypes.c_int64(d), ctypes.c_int64(m_cap), ctypes.c_int64(d_cap),
+        _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
+        _ptr(overflow),
+    )
+    return clock, ids, dots, d_ids, d_clocks, overflow.astype(bool).reshape(lead)
+
+
+def orswot_apply_add(clock, ids, dots, dids, dclocks, actor_idx, counter, member_id):
+    """Batched ``Op::Add`` (`orswot.rs:66-79`), in-place semantics returned
+    as fresh arrays; bit-exact with the JAX ``apply_add`` (slot positions
+    untouched).  Returns the 5 state arrays + overflow."""
+    state = _orswot_state(clock, ids, dots, dids, dclocks)
+    state = tuple(x.copy() for x in state)
+    dt = _check_counters(state[0])
+    *lead, a = state[0].shape
+    n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    m = state[1].shape[-1]
+    d = state[3].shape[-1]
+    actor_idx = np.ascontiguousarray(np.asarray(actor_idx, dtype=np.int32))
+    counter = np.ascontiguousarray(np.asarray(counter, dtype=dt))
+    member_id = np.ascontiguousarray(np.asarray(member_id, dtype=np.int32))
+    for name, arr in (("actor_idx", actor_idx), ("counter", counter),
+                      ("member_id", member_id)):
+        if arr.shape != tuple(lead):
+            raise ValueError(f"apply_add: {name} shape {arr.shape} != {tuple(lead)}")
+    if np.any(actor_idx < 0) or np.any(actor_idx >= a):
+        raise ValueError(f"apply_add: actor_idx out of range [0, {a})")
+    overflow = np.empty(n, dtype=np.uint8)
+    _fn("orswot_apply_add", dt)(
+        _ptr(state[0]), _ptr(state[1]), _ptr(state[2]), _ptr(state[3]),
+        _ptr(state[4]), _ptr(actor_idx), _ptr(counter), _ptr(member_id),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(m),
+        ctypes.c_int64(d), _ptr(overflow),
+    )
+    return (*state, overflow.astype(bool).reshape(lead))
+
+
+def orswot_apply_remove(clock, ids, dots, dids, dclocks, rm_clock, member_id):
+    """Batched ``Op::Rm`` (`orswot.rs:195-211`); returns the 5 state arrays
+    + overflow (deferred table full), bit-exact with the JAX
+    ``apply_remove``."""
+    state = _orswot_state(clock, ids, dots, dids, dclocks)
+    state = tuple(x.copy() for x in state)
+    dt = _check_counters(state[0])
+    *lead, a = state[0].shape
+    n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    m = state[1].shape[-1]
+    d = state[3].shape[-1]
+    rm_clock = np.ascontiguousarray(np.asarray(rm_clock, dtype=dt))
+    member_id = np.ascontiguousarray(np.asarray(member_id, dtype=np.int32))
+    if rm_clock.shape != (*lead, a):
+        raise ValueError(f"apply_remove: rm_clock shape {rm_clock.shape} != {(*lead, a)}")
+    if member_id.shape != tuple(lead):
+        raise ValueError(f"apply_remove: member_id shape {member_id.shape} != {tuple(lead)}")
+    overflow = np.empty(n, dtype=np.uint8)
+    _fn("orswot_apply_remove", dt)(
+        _ptr(state[0]), _ptr(state[1]), _ptr(state[2]), _ptr(state[3]),
+        _ptr(state[4]), _ptr(rm_clock), _ptr(member_id),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(m),
+        ctypes.c_int64(d), _ptr(overflow),
+    )
+    return (*state, overflow.astype(bool).reshape(lead))

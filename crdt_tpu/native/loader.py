@@ -1,0 +1,90 @@
+"""Build + load the native kernel library.
+
+The shared library is compiled from ``crdt_core.cpp`` on first use (one
+``make`` invocation, cached as ``libcrdt_core.so`` next to this file).  No
+pybind11 — the kernels use a plain C ABI over numpy buffers via ctypes
+(build-environment constraint; the CPython C API buys nothing here since all
+arguments are flat arrays)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libcrdt_core.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_error: str | None = None
+
+
+def _build() -> str | None:
+    """Compile the library; returns an error string or None."""
+    src = os.path.join(_HERE, "crdt_core.cpp")
+    if not os.path.exists(src):
+        return f"native source missing: {src}"
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _HERE],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"native build failed to run: {e}"
+    if proc.returncode != 0:
+        return f"native build failed:\n{proc.stdout}\n{proc.stderr}"
+    return None
+
+
+def load() -> ctypes.CDLL:
+    """The loaded library, building it if needed.  Raises RuntimeError with
+    the build log when the toolchain is unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise RuntimeError(_build_error)
+        if not (os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(
+            os.path.join(_HERE, "crdt_core.cpp")
+        )):
+            err = _build()
+            if err is not None:
+                _build_error = err
+                raise RuntimeError(err)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as first:
+            # stale/truncated .so (e.g. foreign arch, interrupted build):
+            # force a rebuild once (make skips by mtime, so remove first),
+            # then give up with a cached error
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            err = _build()
+            if err is None:
+                try:
+                    lib = ctypes.CDLL(_SO)
+                except OSError as second:
+                    err = f"native library unloadable after rebuild: {second}"
+            if err is not None:
+                _build_error = f"{err} (initial load error: {first})"
+                raise RuntimeError(_build_error)
+        if lib.crdt_core_abi_version() != 1:
+            _build_error = "native ABI version mismatch; run make clean"
+            raise RuntimeError(_build_error)
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    """True when the native library can be loaded (building if needed)."""
+    try:
+        load()
+        return True
+    except (RuntimeError, OSError):
+        return False

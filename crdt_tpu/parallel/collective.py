@@ -204,6 +204,29 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
 # -- anti-entropy to fixpoint ------------------------------------------------
 
 
+@functools.lru_cache(maxsize=None)
+def _anti_entropy_kernels(m_cap: int, d_cap: int):
+    """Jitted fold/plunge kernels, cached per capacity so repeated
+    anti_entropy calls hit the XLA compile cache instead of retracing
+    (jax.jit caches by function identity; a per-call closure defeats it).
+    Shapes (R, N, A) still key the underlying jit cache as usual."""
+
+    @jax.jit
+    def _fold(arrays):
+        acc, overflow = _fold_orswot_stack(arrays, m_cap, d_cap)
+        return acc, jnp.any(overflow)
+
+    @jax.jit
+    def _plunge(acc):
+        nxt, over = _orswot_pair_merge(acc, acc, m_cap, d_cap)
+        same = jnp.array(True)
+        for x, y in zip(nxt, acc):
+            same &= jnp.array_equal(x, y)
+        return nxt, same, jnp.any(over)
+
+    return _fold, _plunge
+
+
 def anti_entropy(stack, max_rounds: int = 3, check: bool = True):
     """Converge a replica-stacked :class:`OrswotBatch` (leading axis R) to
     its fixpoint on one device/shard: left-fold-join the replicas in order
@@ -225,19 +248,7 @@ def anti_entropy(stack, max_rounds: int = 3, check: bool = True):
     d_cap = stack.d_ids.shape[-1]
     arrays = (stack.clock, stack.ids, stack.dots, stack.d_ids, stack.d_clocks)
 
-    @jax.jit
-    def _fold(arrays):
-        acc, overflow = _fold_orswot_stack(arrays, m_cap, d_cap)
-        return acc, jnp.any(overflow)
-
-    @jax.jit
-    def _plunge(acc):
-        nxt, over = _orswot_pair_merge(acc, acc, m_cap, d_cap)
-        same = jnp.array(True)
-        for x, y in zip(nxt, acc):
-            same &= jnp.array_equal(x, y)
-        return nxt, same, jnp.any(over)
-
+    _fold, _plunge = _anti_entropy_kernels(m_cap, d_cap)
     acc, over_dev = _fold(arrays)
     overflow = bool(over_dev)
     rounds = 1

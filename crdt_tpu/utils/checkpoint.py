@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import io
+import os
 from typing import Any, Tuple
 
 import numpy as np
@@ -67,8 +68,14 @@ def save(path, batch_state: Any, universe: Universe) -> None:
 
     ``path`` is a filename or file-like object; the container is numpy's
     ``.npz`` (zip of ``.npy`` members), readable by any numpy without this
-    package.
+    package.  A filename without the ``.npz`` extension gets it appended
+    (``np.savez`` does this silently; normalizing here keeps
+    ``load(p)`` symmetric with ``save(p)``).
     """
+    if isinstance(path, (str, os.PathLike)):
+        p = os.fspath(path)
+        if not p.endswith(".npz"):
+            path = p + ".npz"
     cls_name = type(batch_state).__name__
     if cls_name not in _batch_types():
         raise TypeError(f"not a checkpointable batch type: {cls_name}")
@@ -92,6 +99,10 @@ def load(path) -> Tuple[Any, Universe]:
     """
     import jax.numpy as jnp
 
+    if isinstance(path, (str, os.PathLike)):
+        p = os.fspath(path)
+        if not p.endswith(".npz") and not os.path.exists(p):
+            path = p + ".npz"
     with np.load(path) as z:
         meta = serde.from_binary(z["__meta__"].tobytes())
         if meta.get("version") != FORMAT_VERSION:

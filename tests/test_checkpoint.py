@@ -85,6 +85,15 @@ def test_lwwreg_batch_roundtrip(tmp_path):
     _assert_batch_equal(batch, loaded)
 
 
+def test_extensionless_path_roundtrips(tmp_path):
+    """np.savez silently appends .npz; save/load must stay symmetric."""
+    batch, universe, _ = _orswot_fixture()
+    path = tmp_path / "ck"  # no extension
+    checkpoint.save(path, batch, universe)
+    loaded, _ = checkpoint.load(path)
+    _assert_batch_equal(batch, loaded)
+
+
 def test_rejects_unknown_type():
     universe = Universe()
     try:

@@ -1,0 +1,325 @@
+"""Native (C++) engine parity tests.
+
+The three engines — scalar Python, JAX/XLA batch, native C++ — implement the
+same dense-array contracts; here every native kernel is compared
+byte-for-byte against the JAX kernels (which the rest of the suite pins to
+the scalar reference semantics), over randomized states, both counter
+dtypes, and the op paths.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.native import available
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native toolchain unavailable (g++/make)"
+)
+
+
+@pytest.fixture(scope="module")
+def engines():
+    import jax.numpy as jnp
+
+    from crdt_tpu.native import engine
+    from crdt_tpu.ops import clock_ops, lww_ops, mvreg_ops, orswot_ops
+
+    return engine, clock_ops, lww_ops, mvreg_ops, orswot_ops, jnp
+
+
+DTYPES = [np.uint32, np.uint64]
+
+
+def rand_clocks(rng, shape, dtype, p_zero=0.4):
+    x = rng.randint(0, 50, size=shape).astype(dtype)
+    return np.where(rng.rand(*shape) < p_zero, np.zeros_like(x), x)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_vclock_ops_parity(engines, dtype):
+    engine, clock_ops, *_ = engines
+    rng = np.random.RandomState(0)
+    a = rand_clocks(rng, (64, 16), dtype)
+    b = rand_clocks(rng, (64, 16), dtype)
+    for native_fn, jax_fn in [
+        (engine.vclock_merge, clock_ops.merge),
+        (engine.vclock_intersection, clock_ops.intersection),
+        (engine.vclock_subtract, clock_ops.subtract),
+        (engine.vclock_truncate, clock_ops.truncate),
+    ]:
+        np.testing.assert_array_equal(
+            native_fn(a, b), np.asarray(jax_fn(a, b)).astype(dtype)
+        )
+    leq, geq = engine.vclock_compare(a, b)
+    np.testing.assert_array_equal(leq, np.asarray(clock_ops.leq(a, b)))
+    np.testing.assert_array_equal(geq, np.asarray(clock_ops.dominates_or_eq(a, b)))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_lww_merge_parity(engines, dtype):
+    engine, _, lww_ops, *_ = engines
+    rng = np.random.RandomState(1)
+    n = 1000
+    va = rng.randint(0, 5, size=n).astype(np.int64)
+    vb = rng.randint(0, 5, size=n).astype(np.int64)
+    ma = rng.randint(0, 10, size=n).astype(dtype)  # small range forces ties
+    mb = rng.randint(0, 10, size=n).astype(dtype)
+    val, marker, conflict = engine.lww_merge(va, ma, vb, mb)
+    jval, jmarker, jconflict = lww_ops.merge(va, ma, vb, mb)
+    np.testing.assert_array_equal(val, np.asarray(jval))
+    np.testing.assert_array_equal(marker, np.asarray(jmarker).astype(dtype))
+    np.testing.assert_array_equal(conflict, np.asarray(jconflict))
+    assert conflict.any(), "test vector should include real conflicts"
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_mvreg_merge_parity(engines, dtype):
+    engine, _, _, mvreg_ops, _, jnp = engines
+    rng = np.random.RandomState(2)
+    n, k, a = 200, 4, 6
+    ca = rand_clocks(rng, (n, k, a), dtype, p_zero=0.6)
+    cb = rand_clocks(rng, (n, k, a), dtype, p_zero=0.6)
+    # make some slots exact duplicates across sides (the dedup path)
+    dup = rng.rand(n) < 0.3
+    cb[dup, 0] = ca[dup, 0]
+    va = rng.randint(1, 100, size=(n, k)).astype(np.int64)
+    vb = rng.randint(1, 100, size=(n, k)).astype(np.int64)
+    vb[dup, 0] = va[dup, 0]
+    # zero the payload of dead slots (the JAX kernel masks them to 0)
+    va = np.where((ca != 0).any(-1), va, 0)
+    vb = np.where((cb != 0).any(-1), vb, 0)
+
+    k_cap = 2 * k  # no truncation: compare full survivor sets
+    clocks, vals, overflow = engine.mvreg_merge(ca, va, cb, vb, k_cap=k_cap)
+    jc, jv, keep = mvreg_ops.merge(ca, va, cb, vb)
+    jc, jv, joverflow = mvreg_ops.compact(jc, jv, keep, k_cap)
+    np.testing.assert_array_equal(clocks, np.asarray(jc).astype(dtype))
+    np.testing.assert_array_equal(vals, np.asarray(jv))
+    np.testing.assert_array_equal(overflow, np.asarray(joverflow))
+    assert not overflow.any()
+
+
+def random_orswot_pair(rng, n, a, m, d, dtype):
+    from crdt_tpu.utils.testdata import random_orswot_arrays
+
+    lhs = random_orswot_arrays(rng, n, a, m, d, dtype=dtype)
+    rhs = random_orswot_arrays(rng, n, a, m, d, dtype=dtype)
+    return lhs, rhs
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_orswot_merge_parity(engines, dtype):
+    engine, *_, orswot_ops, jnp = engines
+    rng = np.random.RandomState(3)
+    n, a, m, d = 128, 8, 6, 3
+    lhs, rhs = random_orswot_pair(rng, n, a, m, d, dtype)
+    # output capacity 2m so nothing truncates and slot order is fully checked
+    got = engine.orswot_merge(*lhs, *rhs, m_cap=2 * m, d_cap=2 * d)
+    exp = orswot_ops.merge(*[jnp.asarray(x) for x in lhs],
+                           *[jnp.asarray(x) for x in rhs], 2 * m, 2 * d)
+    names = ["clock", "ids", "dots", "d_ids", "d_clocks", "overflow"]
+    for g, e, name in zip(got, exp, names):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(e), err_msg=f"orswot merge field {name}"
+        )
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_orswot_merge_with_deferred_parity(engines, dtype):
+    """Deferred rows exercise dedup, replay, and the still-ahead filter."""
+    engine, *_, orswot_ops, jnp = engines
+    rng = np.random.RandomState(4)
+    n, a, m, d = 64, 6, 5, 3
+    lhs, rhs = random_orswot_pair(rng, n, a, m, d, dtype)
+    lhs, rhs = list(lhs), list(rhs)
+
+    # inject deferred removes: some targeting existing members with clocks
+    # ahead of the set clock, some duplicated on both sides
+    for side in (lhs, rhs):
+        ids, d_ids, d_clocks = side[1], side[3], side[4]
+        d_ids[:, 0] = ids[:, 0]  # remove the first member...
+        d_clocks[:, 0, :] = rand_clocks(rng, (n, a), dtype, p_zero=0.3) + 1
+    # duplicate row 0 of lhs into rhs for half the objects (dedup path)
+    half = rng.rand(n) < 0.5
+    rhs[3][half, 1] = lhs[3][half, 0]
+    rhs[4][half, 1] = lhs[4][half, 0]
+
+    got = engine.orswot_merge(*lhs, *rhs, m_cap=2 * m, d_cap=2 * d)
+    exp = orswot_ops.merge(*[jnp.asarray(x) for x in lhs],
+                           *[jnp.asarray(x) for x in rhs], 2 * m, 2 * d)
+    for g, e, name in zip(got, exp, ["clock", "ids", "dots", "d_ids", "d_clocks", "overflow"]):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(e), err_msg=f"field {name}"
+        )
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_orswot_apply_add_parity(engines, dtype):
+    engine, *_, orswot_ops, jnp = engines
+    rng = np.random.RandomState(5)
+    n, a, m, d = 100, 6, 5, 2
+    (state, _) = random_orswot_pair(rng, n, a, m, d, dtype)
+    actor = rng.randint(0, a, size=n).astype(np.int32)
+    # mix of novel counters (apply) and stale ones (dedup no-op)
+    counter = rng.randint(1, 150, size=n).astype(dtype)
+    member = rng.randint(0, 1 << 20, size=n).astype(np.int32)
+
+    got = engine.orswot_apply_add(*state, actor, counter, member)
+    exp = orswot_ops.apply_add(*[jnp.asarray(x) for x in state],
+                               jnp.asarray(actor), jnp.asarray(counter),
+                               jnp.asarray(member))
+    for g, e, name in zip(got, exp, ["clock", "ids", "dots", "d_ids", "d_clocks", "overflow"]):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(e), err_msg=name)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_orswot_apply_remove_parity(engines, dtype):
+    engine, *_, orswot_ops, jnp = engines
+    rng = np.random.RandomState(6)
+    n, a, m, d = 100, 6, 5, 3
+    (state, _) = random_orswot_pair(rng, n, a, m, d, dtype)
+    # remove an existing member for half the objects, a random id otherwise
+    member = np.where(
+        rng.rand(n) < 0.5, state[1][:, 0], rng.randint(0, 1 << 20, size=n)
+    ).astype(np.int32)
+    # rm clocks: mix of covered (apply now) and ahead (defer)
+    rm_clock = rand_clocks(rng, (n, a), dtype, p_zero=0.5)
+    ahead = rng.rand(n) < 0.4
+    rm_clock[ahead] += 200
+
+    got = engine.orswot_apply_remove(*state, rm_clock, member)
+    exp = orswot_ops.apply_remove(*[jnp.asarray(x) for x in state],
+                                  jnp.asarray(rm_clock), jnp.asarray(member))
+    for g, e, name in zip(got, exp, ["clock", "ids", "dots", "d_ids", "d_clocks", "overflow"]):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(e), err_msg=name)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_orswot_merge_overflow_flag(engines, dtype):
+    """Truncation must be flagged, never silent."""
+    engine, *_, orswot_ops, jnp = engines
+    rng = np.random.RandomState(7)
+    n, a, m, d = 16, 4, 4, 2
+    lhs, rhs = random_orswot_pair(rng, n, a, m, d, dtype)
+    got = engine.orswot_merge(*lhs, *rhs, m_cap=1, d_cap=d)
+    exp = orswot_ops.merge(*[jnp.asarray(x) for x in lhs],
+                           *[jnp.asarray(x) for x in rhs], 1, d)
+    np.testing.assert_array_equal(np.asarray(got[5]), np.asarray(exp[5]))
+    assert np.asarray(got[5]).any()
+
+
+def test_shape_validation_rejects_mismatches(engines):
+    """The C kernels do raw pointer arithmetic; the wrappers must reject
+    inconsistent shapes instead of reading out of bounds."""
+    engine, *_ = engines
+    rng = np.random.RandomState(9)
+    n, a, m, d = 8, 4, 3, 2
+    lhs, _ = random_orswot_pair(rng, n, a, m, d, np.uint64)
+    short, _ = random_orswot_pair(rng, n // 2, a, m, d, np.uint64)
+    with pytest.raises(ValueError, match="side shapes differ"):
+        engine.orswot_merge(*lhs, *short)
+    with pytest.raises(ValueError, match="inconsistent ORSWOT state"):
+        engine.orswot_merge(*lhs[:2], lhs[2][:, :1], *lhs[3:], *lhs)
+    with pytest.raises(ValueError, match="actor_idx"):
+        engine.orswot_apply_add(
+            *lhs, np.zeros(n // 2, np.int32), np.ones(n, np.uint64),
+            np.zeros(n, np.int32),
+        )
+    with pytest.raises(ValueError, match="out of range"):
+        engine.orswot_apply_add(
+            *lhs, np.full(n, a, np.int32), np.ones(n, np.uint64),
+            np.zeros(n, np.int32),
+        )
+    with pytest.raises(ValueError, match="rm_clock"):
+        engine.orswot_apply_remove(
+            *lhs, np.zeros((n, a + 1), np.uint64), np.zeros(n, np.int32)
+        )
+    with pytest.raises(ValueError, match="shape mismatch"):
+        engine.lww_merge(
+            np.zeros(4, np.int64), np.zeros(4, np.uint64),
+            np.zeros(5, np.int64), np.zeros(5, np.uint64),
+        )
+
+
+def test_lww_merge_preserves_lead_shape(engines):
+    engine, _, lww_ops, *_ = engines
+    rng = np.random.RandomState(10)
+    shape = (16, 8)
+    va = rng.randint(0, 3, size=shape).astype(np.int64)
+    vb = rng.randint(0, 3, size=shape).astype(np.int64)
+    ma = rng.randint(0, 5, size=shape).astype(np.uint64)
+    mb = rng.randint(0, 5, size=shape).astype(np.uint64)
+    val, marker, conflict = engine.lww_merge(va, ma, vb, mb)
+    assert val.shape == marker.shape == conflict.shape == shape
+    jval, jmarker, jconflict = lww_ops.merge(va, ma, vb, mb)
+    np.testing.assert_array_equal(conflict, np.asarray(jconflict))
+
+
+def test_native_fold_matches_scalar_orswot():
+    """End-to-end: native N-way left-fold join == scalar engine join."""
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.native import engine
+    from crdt_tpu.scalar.orswot import Orswot
+    from crdt_tpu.scalar.vclock import Dot
+    from crdt_tpu.utils.interning import Universe
+
+    rng = np.random.RandomState(8)
+    uni = Universe(CrdtConfig(num_actors=6, member_capacity=12, deferred_capacity=6))
+    n_rep, n_obj = 5, 4
+    fleet = []
+    for r in range(n_rep):
+        row = []
+        for i in range(n_obj):
+            s = Orswot()
+            for _ in range(rng.randint(0, 6)):
+                actor = int(rng.randint(0, 6))
+                counter = int(rng.randint(1, 5))
+                member = int(rng.randint(0, 6))
+                if rng.rand() < 0.7:
+                    s.apply(
+                        __import__("crdt_tpu.scalar.orswot", fromlist=["Add"]).Add(
+                            dot=Dot(actor, counter), member=member
+                        )
+                    )
+                else:
+                    s.apply_remove(member, Dot(actor, counter).to_vclock())
+            row.append(s)
+        fleet.append(row)
+
+    batches = [OrswotBatch.from_scalar(row, uni) for row in fleet]
+    arrays = [
+        tuple(np.asarray(x) for x in (b.clock, b.ids, b.dots, b.d_ids, b.d_clocks))
+        for b in batches
+    ]
+    acc = arrays[0]
+    for nxt in arrays[1:]:
+        out = engine.orswot_merge(*acc, *nxt)
+        assert not out[5].any()
+        acc = out[:5]
+    # defer plunger
+    zero = tuple(
+        np.asarray(x)
+        for x in (
+            np.zeros_like(acc[0]), np.full_like(acc[1], -1), np.zeros_like(acc[2]),
+            np.full_like(acc[3], -1), np.zeros_like(acc[4]),
+        )
+    )
+    acc = engine.orswot_merge(*acc, *zero)[:5]
+
+    import jax.numpy as jnp
+
+    merged_batch = OrswotBatch(
+        clock=jnp.asarray(acc[0]), ids=jnp.asarray(acc[1]), dots=jnp.asarray(acc[2]),
+        d_ids=jnp.asarray(acc[3]), d_clocks=jnp.asarray(acc[4]),
+    )
+    got = merged_batch.to_scalar(uni)
+
+    expected = []
+    for i in range(n_obj):
+        merged = Orswot()
+        for row in fleet:
+            merged.merge(row[i])
+        merged.merge(Orswot())
+        expected.append(merged)
+    assert got == expected

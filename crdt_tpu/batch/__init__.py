@@ -21,13 +21,19 @@ from .lwwreg_batch import LWWRegBatch
 from .mvreg_batch import MVRegBatch
 from .orswot_batch import OrswotBatch
 from .gset_batch import GSetBatch
+from .map_batch import MapBatch
+from .val_kernels import MapKernel, MVRegKernel, OrswotKernel
 
 __all__ = [
     "GCounterBatch",
     "GSetBatch",
     "LWWRegBatch",
+    "MapBatch",
+    "MapKernel",
     "MVRegBatch",
+    "MVRegKernel",
     "OrswotBatch",
+    "OrswotKernel",
     "PNCounterBatch",
     "VClockBatch",
 ]

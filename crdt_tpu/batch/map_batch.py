@@ -1,0 +1,244 @@
+"""MapBatch — N reset-remove CRDT maps on device (L4 composition).
+
+Dense form of `/root/reference/src/map.rs:83-99`: map clock, key-slot tables
+(interned key ids + per-key entry clocks + nested value state) and a
+deferred-remove table.  The nested value type is a value kernel
+(:mod:`crdt_tpu.batch.val_kernels`) — ``MVRegKernel``, ``OrswotKernel`` or a
+nested ``MapKernel`` — so ``Map<K, MVReg>``, ``Map<K, Orswot>`` and
+``Map<K, Map<K2, V>>`` (`/root/reference/test/map.rs:8`) each compile to one
+fused merge kernel.
+
+``merge`` runs the vectorized per-key dot algebra + recursive value join
+(:func:`crdt_tpu.ops.map_ops.merge`); ``apply_up`` / ``apply_rm`` apply one
+op per object across the batch.  Keys are interned through the shared member
+registry (any hashable key, `map.rs:12-13`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from flax import struct
+
+from ..config import counter_dtype
+from ..ops import map_ops
+from ..ops.orswot_ops import EMPTY
+from ..scalar.map import Entry, Map
+from ..scalar.vclock import VClock
+from ..utils.interning import Universe
+from .val_kernels import MapKernel
+from .vclock_batch import row_to_vclock
+
+
+def _clock_to_row(vc: VClock, row, universe: Universe) -> None:
+    for actor, counter in vc.dots.items():
+        row[universe.actor_idx(actor)] = counter
+
+
+@struct.dataclass
+class MapBatch:
+    clock: jax.Array  # u64[N, A]
+    keys: jax.Array  # int32[N, K]  (-1 = empty)
+    entry_clocks: jax.Array  # u64[N, K, A]
+    vals: Any  # nested value state, leaves [N, K, *inner]
+    d_keys: jax.Array  # int32[N, D] (-1 = empty)
+    d_clocks: jax.Array  # u64[N, D, A]
+    kernel: MapKernel = struct.field(pytree_node=False)
+
+    @property
+    def state(self):
+        return (
+            self.clock,
+            self.keys,
+            self.entry_clocks,
+            self.vals,
+            self.d_keys,
+            self.d_clocks,
+        )
+
+    @classmethod
+    def from_state(cls, state, kernel: MapKernel) -> "MapBatch":
+        clock, keys, eclocks, vals, d_keys, d_clocks = state
+        return cls(
+            clock=clock,
+            keys=keys,
+            entry_clocks=eclocks,
+            vals=vals,
+            d_keys=d_keys,
+            d_clocks=d_clocks,
+            kernel=kernel,
+        )
+
+    @classmethod
+    def zeros(cls, n: int, universe: Universe, val_kernel) -> "MapBatch":
+        kernel = MapKernel.from_config(universe.config, val_kernel)
+        return cls.from_state(kernel.zeros((n,)), kernel)
+
+    @classmethod
+    def from_scalar(
+        cls, states: Sequence[Map], universe: Universe, val_kernel
+    ) -> "MapBatch":
+        import jax.numpy as jnp
+
+        cfg = universe.config
+        kernel = MapKernel.from_config(cfg, val_kernel)
+        n, k, d, a = len(states), cfg.key_capacity, cfg.deferred_capacity, cfg.num_actors
+        dt = counter_dtype()
+        clock = np.zeros((n, a), dtype=dt)
+        keys = np.full((n, k), EMPTY, dtype=np.int32)
+        eclocks = np.zeros((n, k, a), dtype=dt)
+        d_keys = np.full((n, d), EMPTY, dtype=np.int32)
+        d_clocks = np.zeros((n, d, a), dtype=dt)
+        vals_flat = []
+        for i, m in enumerate(states):
+            if len(m.entries) > k:
+                raise ValueError(f"map {i} has {len(m.entries)} keys > key_capacity {k}")
+            _clock_to_row(m.clock, clock[i], universe)
+            slot_vals = [val_kernel.default_scalar() for _ in range(k)]
+            for j, (key, entry) in enumerate(m.entries.items()):
+                keys[i, j] = universe.member_id(key)
+                _clock_to_row(entry.clock, eclocks[i, j], universe)
+                slot_vals[j] = entry.val
+            vals_flat.extend(slot_vals)
+            rows = [
+                (clock_key, key)
+                for clock_key, key_set in m.deferred.items()
+                for key in key_set
+            ]
+            if len(rows) > d:
+                raise ValueError(
+                    f"map {i} has {len(rows)} deferred rows > deferred_capacity {d}"
+                )
+            for j, (clock_key, key) in enumerate(rows):
+                d_keys[i, j] = universe.member_id(key)
+                _clock_to_row(VClock.from_key(clock_key), d_clocks[i, j], universe)
+
+        leaves = val_kernel.from_scalar_vals(vals_flat, universe)
+        vals = jax.tree.map(lambda l: l.reshape(n, k, *l.shape[1:]), leaves)
+        return cls(
+            clock=jnp.asarray(clock),
+            keys=jnp.asarray(keys),
+            entry_clocks=jnp.asarray(eclocks),
+            vals=vals,
+            d_keys=jnp.asarray(d_keys),
+            d_clocks=jnp.asarray(d_clocks),
+            kernel=kernel,
+        )
+
+    def to_scalar(self, universe: Universe) -> list[Map]:
+        kernel = self.kernel
+        vk = kernel.val_kernel
+        clock = np.asarray(self.clock)
+        keys = np.asarray(self.keys)
+        eclocks = np.asarray(self.entry_clocks)
+        d_keys = np.asarray(self.d_keys)
+        d_clocks = np.asarray(self.d_clocks)
+        n, k = keys.shape
+        flat = jax.tree.map(lambda l: l.reshape(n * k, *l.shape[2:]), self.vals)
+        scalar_vals = vk.to_scalar_vals(flat, universe)
+
+        out = []
+        for i in range(n):
+            m = Map(vk.default_scalar)
+            m.clock = row_to_vclock(clock[i], universe)
+            for j in range(k):
+                if keys[i, j] == EMPTY:
+                    continue
+                key = universe.members.lookup(int(keys[i, j]))
+                m.entries[key] = Entry(
+                    clock=row_to_vclock(eclocks[i, j], universe),
+                    val=scalar_vals[i * k + j],
+                )
+            for j in range(d_keys.shape[1]):
+                if d_keys[i, j] == EMPTY:
+                    continue
+                key = universe.members.lookup(int(d_keys[i, j]))
+                ck = row_to_vclock(d_clocks[i, j], universe).key()
+                m.deferred.setdefault(ck, set()).add(key)
+            out.append(m)
+        return out
+
+    # -- state path ---------------------------------------------------------
+
+    def merge(self, other: "MapBatch", check: bool = True) -> "MapBatch":
+        """`map.rs:192-269`; raises on any capacity overflow."""
+        state, overflow = _merge(self.state, other.state, self.kernel)
+        if check and bool(np.any(np.asarray(overflow))):
+            raise ValueError(
+                "MapBatch merge overflow: raise key/deferred/value capacities"
+            )
+        return MapBatch.from_state(state, self.kernel)
+
+    def truncate(self, clock: jax.Array, check: bool = True) -> "MapBatch":
+        """``Causal::truncate`` (`map.rs:131-158`); ``clock``: u64[N, A]."""
+        state, overflow = _truncate(self.state, clock, self.kernel)
+        if check and bool(np.any(np.asarray(overflow))):
+            raise ValueError("MapBatch truncate overflow")
+        return MapBatch.from_state(state, self.kernel)
+
+    # -- op path ------------------------------------------------------------
+
+    def apply_rm(self, rm_clock, key_id, check: bool = True) -> "MapBatch":
+        """Batched ``Op::Rm`` (`map.rs:166-168`)."""
+        state, overflow = _apply_rm(self.state, rm_clock, key_id, self.kernel)
+        if check and bool(np.any(np.asarray(overflow))):
+            raise ValueError("MapBatch apply_rm overflow: raise deferred_capacity")
+        return MapBatch.from_state(state, self.kernel)
+
+    def apply_up(
+        self, actor_idx, counter, key_id, nested_op: str, nested_args: tuple,
+        check: bool = True,
+    ) -> "MapBatch":
+        """Batched ``Op::Up`` (`map.rs:169-187`).
+
+        ``nested_op`` names a value-kernel op method (``"apply_put"``,
+        ``"apply_add"``, ``"apply_remove"``); ``nested_args`` are its
+        per-object array arguments.  The (static op, traced args) split
+        keeps the whole update one jitted XLA program per op kind."""
+        state, overflow = _apply_up(
+            self.state, actor_idx, counter, key_id, nested_args, nested_op, self.kernel
+        )
+        if check and bool(np.any(np.asarray(overflow))):
+            raise ValueError("MapBatch apply_up overflow: raise key_capacity")
+        return MapBatch.from_state(state, self.kernel)
+
+    # -- reads (`map.rs:271-302`) -------------------------------------------
+
+    def len_counts(self) -> jax.Array:
+        """Entry counts per object (`map.rs:282-288`)."""
+        import jax.numpy as jnp
+
+        return jnp.sum(self.keys != EMPTY, axis=-1)
+
+    def contains(self, key_id) -> jax.Array:
+        """Key-presence bitmap."""
+        import jax.numpy as jnp
+
+        return jnp.any(self.keys == key_id[..., None], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _merge(state_a, state_b, kernel: MapKernel):
+    return kernel.merge(state_a, state_b)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _truncate(state, clock, kernel: MapKernel):
+    return kernel.truncate(state, clock)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _apply_rm(state, rm_clock, key_id, kernel: MapKernel):
+    return map_ops.apply_rm(state, rm_clock, key_id, kernel.val_kernel)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _apply_up(state, actor_idx, counter, key_id, nested_args, nested_op, kernel):
+    vk = kernel.val_kernel
+    nested = getattr(vk, nested_op)
+    return map_ops.apply_up(
+        state, actor_idx, counter, key_id, lambda v: nested(v, *nested_args), vk
+    )

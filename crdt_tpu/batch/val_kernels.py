@@ -1,0 +1,284 @@
+"""Value kernels — the batched analogue of the ``V: Val<A>`` bound.
+
+The reference's Map accepts any causal CRDT as its value type
+(`/root/reference/src/map.rs:16-25`).  On device that generic bound becomes
+a *value kernel*: a small frozen (hashable, jit-static) object that knows
+how to ``merge``, ``truncate`` and zero its dense value state, with every
+operation rank-polymorphic over leading batch axes so the same kernel works
+at any nesting depth.  :mod:`crdt_tpu.ops.map_ops` consumes these; nesting a
+:class:`MapKernel` inside another reproduces ``Map<K, Map<K2, V>>``
+(`/root/reference/test/map.rs:8`) as one fused XLA program per nesting shape
+(SURVEY.md §7.0 "host recursion + monomorphic fused kernels").
+
+Device protocol (value state ``v`` is a tuple-pytree; ``clock``/``overflow``
+shapes follow the leading batch axes):
+
+* ``zeros(batch_shape) -> v`` / ``zeros_like(v) -> v`` — the ``Default``
+  bound (`map.rs:22`), with sentinel-aware empties (ids use ``-1``)
+* ``merge(va, vb) -> (v, overflow)`` — ``CvRDT::merge``
+* ``truncate(v, clock) -> (v, overflow)`` — ``Causal::truncate``; must be a
+  no-op for an all-zero clock (deferred settling relies on it)
+
+Host protocol (scalar ↔ dense conversion, parity/test path):
+
+* ``default_scalar()`` — a fresh scalar CRDT of the value type
+* ``from_scalar_vals(scalars, universe) -> v`` with leaves ``[n, *inner]``
+* ``to_scalar_vals(v, universe) -> list`` of scalar CRDTs
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..config import CrdtConfig, counter_dtype
+from ..ops import clock_ops, map_ops, mvreg_ops, orswot_ops
+from ..ops.orswot_ops import EMPTY
+
+
+@dataclasses.dataclass(frozen=True)
+class MVRegKernel:
+    """Nested multi-value register (`/root/reference/src/mvreg.rs`)."""
+
+    mv_capacity: int
+    num_actors: int
+
+    @classmethod
+    def from_config(cls, cfg: CrdtConfig) -> "MVRegKernel":
+        return cls(mv_capacity=cfg.mv_capacity, num_actors=cfg.num_actors)
+
+    def zeros(self, batch_shape):
+        dt = counter_dtype()
+        return (
+            jnp.zeros((*batch_shape, self.mv_capacity, self.num_actors), dt),
+            jnp.zeros((*batch_shape, self.mv_capacity), dt),
+        )
+
+    def zeros_like(self, v):
+        return jax.tree.map(jnp.zeros_like, v)
+
+    def merge(self, va, vb):
+        clocks, vals, keep = mvreg_ops.merge(va[0], va[1], vb[0], vb[1])
+        clocks, vals, over = mvreg_ops.compact(clocks, vals, keep, self.mv_capacity)
+        return (clocks, vals), over
+
+    def truncate(self, v, clock):
+        """`mvreg.rs:100-113`: subtract from every val clock, drop emptied."""
+        clocks, vals = v
+        new = clock_ops.subtract(clocks, clock[..., None, :])
+        live = ~clock_ops.is_empty(new)
+        out = (jnp.where(live[..., None], new, 0), jnp.where(live, vals, 0))
+        return out, jnp.zeros(clocks.shape[:-2], bool)
+
+    def apply_put(self, v, op_clock, op_val):
+        """Nested ``Op::Put`` (`mvreg.rs:158-186`) for Map ``Op::Up``."""
+        c2, v2, keep = mvreg_ops.apply_put(v[0], v[1], op_clock, op_val)
+        c2, v2, over = mvreg_ops.compact(c2, v2, keep, self.mv_capacity)
+        return (c2, v2), over
+
+    # -- host conversion ----------------------------------------------------
+
+    def default_scalar(self):
+        from ..scalar.mvreg import MVReg
+
+        return MVReg()
+
+    def from_scalar_vals(self, scalars, universe):
+        from .mvreg_batch import MVRegBatch
+
+        b = MVRegBatch.from_scalar(list(scalars), universe)
+        return (b.clocks, b.vals)
+
+    def to_scalar_vals(self, v, universe):
+        from .mvreg_batch import MVRegBatch
+
+        return MVRegBatch(clocks=v[0], vals=v[1]).to_scalar(universe)
+
+
+@dataclasses.dataclass(frozen=True)
+class OrswotKernel:
+    """Nested add-wins OR-Set (`/root/reference/src/orswot.rs`)."""
+
+    member_capacity: int
+    deferred_capacity: int
+    num_actors: int
+
+    @classmethod
+    def from_config(cls, cfg: CrdtConfig) -> "OrswotKernel":
+        return cls(
+            member_capacity=cfg.member_capacity,
+            deferred_capacity=cfg.deferred_capacity,
+            num_actors=cfg.num_actors,
+        )
+
+    def zeros(self, batch_shape):
+        dt = counter_dtype()
+        m, d, a = self.member_capacity, self.deferred_capacity, self.num_actors
+        return (
+            jnp.zeros((*batch_shape, a), dt),
+            jnp.full((*batch_shape, m), EMPTY, jnp.int32),
+            jnp.zeros((*batch_shape, m, a), dt),
+            jnp.full((*batch_shape, d), EMPTY, jnp.int32),
+            jnp.zeros((*batch_shape, d, a), dt),
+        )
+
+    def zeros_like(self, v):
+        clock, ids, dots, d_ids, d_clocks = v
+        return (
+            jnp.zeros_like(clock),
+            jnp.full_like(ids, EMPTY),
+            jnp.zeros_like(dots),
+            jnp.full_like(d_ids, EMPTY),
+            jnp.zeros_like(d_clocks),
+        )
+
+    def merge(self, va, vb):
+        out = orswot_ops.merge(
+            *va, *vb, self.member_capacity, self.deferred_capacity
+        )
+        return out[:5], out[5]
+
+    def truncate(self, v, clock):
+        """`orswot.rs:159-172`: merge with an empty set carrying ``clock``,
+        then subtract ``clock`` from the set clock and every member clock."""
+        empty = self.zeros_like(v)
+        merged, over = self.merge(v, (clock,) + empty[1:])
+        mclock, ids, dots, d_ids, d_clocks = merged
+        mclock = clock_ops.subtract(mclock, clock)
+        dots = clock_ops.subtract(dots, clock[..., None, :])
+        live = ~clock_ops.is_empty(dots) & (ids != EMPTY)
+        ids = jnp.where(live, ids, EMPTY)
+        dots = jnp.where(live[..., None], dots, 0)
+        return (mclock, ids, dots, d_ids, d_clocks), over
+
+    def apply_add(self, v, actor_idx, counter, member_id):
+        """Nested ``Op::Add`` (`orswot.rs:66-79`) for Map ``Op::Up``."""
+        out = orswot_ops.apply_add(*v, actor_idx, counter, member_id)
+        return out[:5], out[5]
+
+    def apply_remove(self, v, rm_clock, member_id):
+        """Nested ``Op::Rm`` (`orswot.rs:195-211`) for Map ``Op::Up``."""
+        out = orswot_ops.apply_remove(*v, rm_clock, member_id)
+        return out[:5], out[5]
+
+    # -- host conversion ----------------------------------------------------
+
+    def default_scalar(self):
+        from ..scalar.orswot import Orswot
+
+        return Orswot()
+
+    def from_scalar_vals(self, scalars, universe):
+        from .orswot_batch import OrswotBatch
+
+        b = OrswotBatch.from_scalar(list(scalars), universe)
+        return (b.clock, b.ids, b.dots, b.d_ids, b.d_clocks)
+
+    def to_scalar_vals(self, v, universe):
+        from .orswot_batch import OrswotBatch
+
+        return OrswotBatch(
+            clock=v[0], ids=v[1], dots=v[2], d_ids=v[3], d_clocks=v[4]
+        ).to_scalar(universe)
+
+
+@dataclasses.dataclass(frozen=True)
+class MapKernel:
+    """Nested Map — recursion into :mod:`crdt_tpu.ops.map_ops`
+    (`map.rs:16-25` admits another Map as ``V``)."""
+
+    key_capacity: int
+    deferred_capacity: int
+    num_actors: int
+    val_kernel: Any
+
+    @classmethod
+    def from_config(cls, cfg: CrdtConfig, val_kernel) -> "MapKernel":
+        return cls(
+            key_capacity=cfg.key_capacity,
+            deferred_capacity=cfg.deferred_capacity,
+            num_actors=cfg.num_actors,
+            val_kernel=val_kernel,
+        )
+
+    def zeros(self, batch_shape):
+        dt = counter_dtype()
+        k, d, a = self.key_capacity, self.deferred_capacity, self.num_actors
+        return (
+            jnp.zeros((*batch_shape, a), dt),
+            jnp.full((*batch_shape, k), EMPTY, jnp.int32),
+            jnp.zeros((*batch_shape, k, a), dt),
+            self.val_kernel.zeros((*batch_shape, k)),
+            jnp.full((*batch_shape, d), EMPTY, jnp.int32),
+            jnp.zeros((*batch_shape, d, a), dt),
+        )
+
+    def zeros_like(self, v):
+        clock, keys, eclocks, vals, d_keys, d_clocks = v
+        return (
+            jnp.zeros_like(clock),
+            jnp.full_like(keys, EMPTY),
+            jnp.zeros_like(eclocks),
+            self.val_kernel.zeros_like(vals),
+            jnp.full_like(d_keys, EMPTY),
+            jnp.zeros_like(d_clocks),
+        )
+
+    def merge(self, va, vb):
+        return map_ops.merge(
+            va, vb, self.val_kernel, self.key_capacity, self.deferred_capacity
+        )
+
+    def truncate(self, v, clock):
+        return map_ops.truncate(v, clock, self.val_kernel)
+
+    # -- host conversion ----------------------------------------------------
+
+    def default_scalar(self):
+        from ..scalar.map import Map
+
+        return Map(self.val_kernel.default_scalar)
+
+    def from_scalar_vals(self, scalars, universe):
+        from .map_batch import MapBatch
+
+        b = MapBatch.from_scalar(list(scalars), universe, self.val_kernel)
+        return b.state
+
+    def to_scalar_vals(self, v, universe):
+        from .map_batch import MapBatch
+
+        return MapBatch.from_state(v, self).to_scalar(universe)
+
+
+# -- kernel (de)serialization for checkpoints --------------------------------
+
+_KERNEL_CLASSES = {
+    "MVRegKernel": MVRegKernel,
+    "OrswotKernel": OrswotKernel,
+    "MapKernel": MapKernel,
+}
+
+
+def kernel_to_spec(kernel) -> dict:
+    """A plain-dict description of a (possibly nested) value kernel, for the
+    checkpoint metadata blob (`crdt_tpu.utils.checkpoint`)."""
+    spec = {"cls": type(kernel).__name__}
+    for f in dataclasses.fields(kernel):
+        v = getattr(kernel, f.name)
+        spec[f.name] = kernel_to_spec(v) if dataclasses.is_dataclass(v) else v
+    return spec
+
+
+def kernel_from_spec(spec: dict):
+    """Inverse of :func:`kernel_to_spec`."""
+    cls = _KERNEL_CLASSES[spec["cls"]]
+    kwargs = {
+        k: (kernel_from_spec(v) if isinstance(v, dict) else v)
+        for k, v in spec.items()
+        if k != "cls"
+    }
+    return cls(**kwargs)

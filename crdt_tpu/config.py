@@ -54,6 +54,7 @@ class CrdtConfig:
     member_capacity: int = 32  # Orswot member slots per object
     deferred_capacity: int = 8  # deferred (clock, member) rows per object
     mv_capacity: int = 8  # MVReg antichain slots per register
+    key_capacity: int = 16  # Map key slots per object
 
     def __post_init__(self):
         for f in dataclasses.fields(self):

@@ -1,0 +1,362 @@
+"""Batched reset-remove Map kernels — CRDT composition on device (L4 on TPU).
+
+Dense per-object state for ``Map<K, V>`` (`/root/reference/src/map.rs:83-99`):
+
+* ``clock    u64[..., A]``    — the map clock
+* ``keys     int32[..., K]``  — interned key ids, ``-1`` = empty slot
+* ``eclocks  u64[..., K, A]`` — per-key entry clocks (add-witnesses)
+* ``vals``                    — nested value state: a pytree whose leaves all
+  carry the key axis right after the batch axes (``[..., K, *inner]``)
+* ``d_keys   int32[..., D]``  — deferred-remove key ids
+* ``d_clocks u64[..., D, A]`` — deferred-remove witnessing clocks
+
+The nested value type is abstracted as a *value kernel* ``vk`` (duck-typed —
+see :mod:`crdt_tpu.batch.val_kernels`): ``merge(va, vb) -> (v, overflow)``,
+``truncate(v, clock) -> (v, overflow)`` and ``zeros_like(v)``, all
+rank-polymorphic over leading batch axes.  Passing a Map kernel as ``vk``
+nests maps to any static depth (`map.rs:16-25` admits any causal ``V``,
+including another Map); the host-side recursion unrolls into one fused XLA
+program per nesting shape (SURVEY.md §7.0).
+
+``merge`` mirrors `/root/reference/src/map.rs:192-269` exactly: the Orswot
+dot algebra per key, recursive ``val.merge`` plus reset-remove
+``val.truncate``, the **asymmetric** deferred replay — other's deferred rows
+already covered by self's clock are discarded without effect, because
+`map.rs:256-260` replays them against the *pre-merge* entries which are then
+overwritten by ``keep`` — and the final ``apply_deferred`` against the
+joined clock (`map.rs:265-267`).  Sequential per-row clock subtracts compose
+into a single subtract-by-join over the actor axis (``sub(sub(x, a), b) ==
+sub(x, max(a, b))`` pointwise), which is what lets the replay vectorize.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import clock_ops
+from .orswot_ops import EMPTY, _dedup_deferred, compact
+
+_SORT_MAX = jnp.iinfo(jnp.int32).max
+
+
+# -- pytree helpers over the key-slot axis ----------------------------------
+
+
+def tree_gather(v, idx):
+    """Gather value-state slots along the key axis (position ``idx.ndim-1``)."""
+    ax = idx.ndim - 1
+
+    def g(leaf):
+        ii = idx.reshape(idx.shape + (1,) * (leaf.ndim - idx.ndim))
+        return jnp.take_along_axis(leaf, ii, axis=ax)
+
+    return jax.tree.map(g, v)
+
+
+def tree_where(mask, v, w):
+    """Slot-wise select between two value states; ``mask`` broadcasts from
+    the left (leading axes)."""
+
+    def s(a, b):
+        mm = mask.reshape(mask.shape + (1,) * (a.ndim - mask.ndim))
+        return jnp.where(mm, a, b)
+
+    return jax.tree.map(s, v, w)
+
+
+def tree_slice(v, ax, cap):
+    """Slice the first ``cap`` slots along axis ``ax`` of every leaf."""
+    return jax.tree.map(lambda leaf: jax.lax.slice_in_dim(leaf, 0, cap, axis=ax), v)
+
+
+def tree_scatter_slot(v, slot, upd, do, num_slots):
+    """Write ``upd`` (leaves ``[..., *inner]``) into key slot ``slot`` of
+    ``v`` (leaves ``[..., K, *inner]``) for objects where ``do``."""
+    onehot = (jnp.arange(num_slots) == slot[..., None]) & do[..., None]  # [..., K]
+
+    def s(leaf, u):
+        m = onehot.reshape(onehot.shape + (1,) * (leaf.ndim - onehot.ndim))
+        return jnp.where(m, jnp.expand_dims(u, slot.ndim), leaf)
+
+    return jax.tree.map(s, v, upd)
+
+
+# -- key alignment ----------------------------------------------------------
+
+
+def align_keyed(keys_a, keys_b):
+    """Align two key tables on key id (the BTreeMap lookup of
+    `map.rs:196-197` as a sort + adjacent-run match — no hashing on device).
+
+    Returns ``(keys, idx_a, p_a, idx_b, p_b)`` over ``S = Ka + Kb`` slots:
+    for each distinct key, ``idx_a``/``p_a`` give its slot in self's table
+    and presence there, ``idx_b``/``p_b`` the same for other.  Gather
+    payloads with :func:`tree_gather` and mask by presence.
+    """
+    k_a = keys_a.shape[-1]
+    cat = jnp.concatenate([keys_a, keys_b], axis=-1)
+    side = jnp.concatenate([jnp.zeros_like(keys_a), jnp.ones_like(keys_b)], axis=-1)
+    src = jnp.broadcast_to(jnp.arange(cat.shape[-1]), cat.shape)
+
+    order = jnp.argsort(jnp.where(cat == EMPTY, _SORT_MAX, cat), axis=-1, stable=True)
+    s_ids = jnp.take_along_axis(cat, order, axis=-1)
+    s_side = jnp.take_along_axis(side, order, axis=-1)
+    s_src = jnp.take_along_axis(src, order, axis=-1)
+
+    valid = s_ids != EMPTY
+    adj = (s_ids[..., 1:] == s_ids[..., :-1]) & valid[..., 1:]
+    same_as_prev = jnp.concatenate([jnp.zeros_like(valid[..., :1]), adj], axis=-1)
+    same_as_next = jnp.concatenate([adj, jnp.zeros_like(valid[..., :1])], axis=-1)
+    first = valid & ~same_as_prev
+
+    # keys are unique within each side and the sort is stable, so a run is
+    # [a], [b] or [a, b] — never longer, never [b, a]
+    nxt_src = jnp.roll(s_src, -1, axis=-1)
+    p_a = first & (s_side == 0)
+    p_b = first & ((s_side == 1) | same_as_next)
+    idx_a = jnp.where(p_a, s_src, 0)
+    idx_b = jnp.where(s_side == 1, s_src, nxt_src) - k_a
+    idx_b = jnp.clip(jnp.where(p_b, idx_b, 0), 0, max(cat.shape[-1] - k_a - 1, 0))
+    keys = jnp.where(first, s_ids, EMPTY)
+    return keys, idx_a, p_a, idx_b, p_b
+
+
+# -- deferred settling ------------------------------------------------------
+
+
+def _settle_deferred(clock, keys, eclocks, vals, d_keys, d_clocks, vk):
+    """``apply_deferred`` (`map.rs:325-333`): replay every buffered
+    ``(clock, key)`` row via ``apply_rm`` against the current clock; rows
+    still ahead of it stay buffered (`map.rs:336-350`).  Matching rows'
+    sequential subtracts compose into one subtract-by-join."""
+    d_valid = d_keys != EMPTY
+    match = keys[..., :, None] == jnp.where(d_valid, d_keys, EMPTY - 1)[..., None, :]
+    rm = jnp.max(
+        jnp.where(match[..., None], d_clocks[..., None, :, :], 0), axis=-2
+    )  # [..., K, A]
+    new_e = clock_ops.subtract(eclocks, rm)
+    live = ~clock_ops.is_empty(new_e) & (keys != EMPTY)
+    vals, over = vk.truncate(vals, rm)
+    keys = jnp.where(live, keys, EMPTY)
+    new_e = jnp.where(live[..., None], new_e, 0)
+    vals = tree_where(live, vals, vk.zeros_like(vals))
+
+    still_ahead = ~clock_ops.leq(d_clocks, clock[..., None, :]) & d_valid
+    d_keys = jnp.where(still_ahead, d_keys, EMPTY)
+    d_clocks = jnp.where(still_ahead[..., None], d_clocks, 0)
+    return keys, new_e, vals, d_keys, d_clocks, jnp.any(over, axis=-1)
+
+
+def compact_keyed(keys, eclocks, vals, vk, cap):
+    """Pack live key slots first and truncate to ``cap`` slots.
+
+    Returns ``(keys, eclocks, vals, overflow)``."""
+    live = keys != EMPTY
+    order = jnp.argsort(~live, axis=-1, stable=True)
+    out_keys = jnp.take_along_axis(keys, order, axis=-1)[..., :cap]
+    out_e = jnp.take_along_axis(eclocks, order[..., None], axis=-2)[..., :cap, :]
+    out_v = tree_slice(tree_gather(vals, order), order.ndim - 1, cap)
+    overflow = jnp.sum(live, axis=-1) > cap
+    return out_keys, out_e, out_v, overflow
+
+
+# -- state path -------------------------------------------------------------
+
+
+def merge(state_a, state_b, vk, k_cap: int, d_cap: int):
+    """Full pairwise Map merge (`map.rs:192-269`).
+
+    ``state`` = ``(clock, keys, eclocks, vals, d_keys, d_clocks)``.  Returns
+    ``(state, overflow)``; overflow is a per-object flag set when surviving
+    keys exceed ``k_cap``, deferred rows exceed ``d_cap``, or a nested value
+    kernel overflowed (host raises — capacity is the static-shape
+    concession, SURVEY.md §7.3)."""
+    clock_a, keys_a, ec_a, vals_a, dk_a, dc_a = state_a
+    clock_b, keys_b, ec_b, vals_b, dk_b, dc_b = state_b
+
+    keys, idx_a, p_a, idx_b, p_b = align_keyed(keys_a, keys_b)
+    e1 = jnp.where(
+        p_a[..., None], jnp.take_along_axis(ec_a, idx_a[..., None], axis=-2), 0
+    )
+    e2 = jnp.where(
+        p_b[..., None], jnp.take_along_axis(ec_b, idx_b[..., None], axis=-2), 0
+    )
+    g1 = tree_gather(vals_a, idx_a)
+    v1 = tree_where(p_a, g1, vk.zeros_like(g1))
+    g2 = tree_gather(vals_b, idx_b)
+    v2 = tree_where(p_b, g2, vk.zeros_like(g2))
+
+    sc = clock_a[..., None, :]
+    oc = clock_b[..., None, :]
+
+    # present in both (`map.rs:213-240`)
+    common0 = clock_ops.intersection(e1, e2)
+    c1 = clock_ops.subtract(clock_ops.subtract(e1, common0), oc)
+    c2 = clock_ops.subtract(clock_ops.subtract(e2, common0), sc)
+    e_both = jnp.maximum(common0, jnp.maximum(c1, c2))
+    # `map.rs:229-235` literally: deleters = (c1 ∨ c2) − merged entry clock.
+    # c1, c2 ≤ e_both pointwise, so this is always empty and the nested
+    # truncate in the both-branch is a no-op — exactly as in the reference.
+    del_both = clock_ops.subtract(jnp.maximum(c1, c2), e_both)
+
+    # only in self (`map.rs:198-211`): keep the SUBTRACTED clock (unlike
+    # Orswot, which keeps the full clock — orswot.rs:94-103)
+    e_only1 = clock_ops.subtract(e1, oc)
+    del_only1 = clock_ops.subtract(oc, e_only1)
+
+    # only in other (`map.rs:244-253`)
+    e_only2 = clock_ops.subtract(e2, sc)
+    del_only2 = clock_ops.subtract(sc, e_only2)
+
+    both = p_a & p_b
+    only1 = p_a & ~p_b
+    eclocks = jnp.where(
+        both[..., None], e_both, jnp.where(only1[..., None], e_only1, e_only2)
+    )
+    eclocks = jnp.where((p_a | p_b)[..., None], eclocks, 0)
+    deleters = jnp.where(
+        both[..., None], del_both, jnp.where(only1[..., None], del_only1, del_only2)
+    )
+
+    v_merged, over_vm = vk.merge(v1, v2)
+    vals = tree_where(both, v_merged, tree_where(only1, v1, v2))
+    vals, over_vt = vk.truncate(vals, deleters)
+
+    survive = ~clock_ops.is_empty(eclocks) & (p_a | p_b)
+    keys = jnp.where(survive, keys, EMPTY)
+    eclocks = jnp.where(survive[..., None], eclocks, 0)
+    vals = tree_where(survive, vals, vk.zeros_like(vals))
+
+    # deferred: adopt other's rows NOT already covered by self's clock
+    # (`map.rs:256-260` — covered rows are replayed against the pre-merge
+    # entries, which `keep` then discards, so they have no effect), keep all
+    # of self's rows, dedup exact (key, clock) pairs
+    adopt = ~clock_ops.leq(dc_b, clock_a[..., None, :]) & (dk_b != EMPTY)
+    d_keys = jnp.concatenate([dk_a, jnp.where(adopt, dk_b, EMPTY)], axis=-1)
+    d_clocks = jnp.concatenate([dc_a, jnp.where(adopt[..., None], dc_b, 0)], axis=-2)
+    d_keys, d_clocks = _dedup_deferred(d_keys, d_clocks)
+
+    # clock join (`map.rs:265`), then apply_deferred (`map.rs:267`)
+    clock = clock_ops.merge(clock_a, clock_b)
+    keys, eclocks, vals, d_keys, d_clocks, over_def = _settle_deferred(
+        clock, keys, eclocks, vals, d_keys, d_clocks, vk
+    )
+
+    keys, eclocks, vals, k_over = compact_keyed(keys, eclocks, vals, vk, k_cap)
+    d_keys, d_clocks, d_over = compact(d_keys, d_clocks, d_cap)
+    overflow = (
+        jnp.any(over_vm & both, axis=-1)
+        | jnp.any(over_vt & survive, axis=-1)
+        | over_def
+        | k_over
+        | d_over
+    )
+    return (clock, keys, eclocks, vals, d_keys, d_clocks), overflow
+
+
+def truncate(state, clock, vk):
+    """``Causal::truncate`` (`map.rs:131-158`): subtract ``clock`` from every
+    entry clock (dropping emptied keys, truncating surviving values), filter
+    deferred rows, subtract from the map clock."""
+    mclock, keys, eclocks, vals, d_keys, d_clocks = state
+    new_e = clock_ops.subtract(eclocks, clock[..., None, :])
+    live = ~clock_ops.is_empty(new_e) & (keys != EMPTY)
+    vals, over = vk.truncate(
+        vals, jnp.broadcast_to(clock[..., None, :], eclocks.shape)
+    )
+    keys = jnp.where(live, keys, EMPTY)
+    new_e = jnp.where(live[..., None], new_e, 0)
+    vals = tree_where(live, vals, vk.zeros_like(vals))
+
+    d_new = clock_ops.subtract(d_clocks, clock[..., None, :])
+    d_live = ~clock_ops.is_empty(d_new) & (d_keys != EMPTY)
+    d_keys = jnp.where(d_live, d_keys, EMPTY)
+    d_new = jnp.where(d_live[..., None], d_new, 0)
+
+    out_clock = clock_ops.subtract(mclock, clock)
+    return (out_clock, keys, new_e, vals, d_keys, d_new), jnp.any(over, axis=-1)
+
+
+# -- op path ----------------------------------------------------------------
+
+
+def apply_up(state, actor_idx, counter, key_id, nested_apply, vk):
+    """Batched ``Op::Up`` (`map.rs:163-189`): one nested update per object.
+
+    ``nested_apply(v) -> (v, overflow)`` applies the per-object nested op to
+    the gathered value-slot state (leaves ``[..., *inner]``); objects whose
+    op is a dedup skip (`map.rs:170-173`) keep their original slot."""
+    clock, keys, eclocks, vals, d_keys, d_clocks = state
+    seen = jnp.take_along_axis(clock, actor_idx[..., None], axis=-1)[..., 0] >= counter
+
+    existing = keys == key_id[..., None]
+    has_slot = jnp.any(existing, axis=-1)
+    free = keys == EMPTY
+    has_free = jnp.any(free, axis=-1)
+    slot = jnp.where(has_slot, jnp.argmax(existing, axis=-1), jnp.argmax(free, axis=-1))
+    overflow = ~seen & ~has_slot & ~has_free
+    do = ~seen & (has_slot | has_free)
+
+    k = keys.shape[-1]
+    onehot = jnp.arange(k) == slot[..., None]
+    new_keys = jnp.where(do[..., None] & onehot, key_id[..., None], keys)
+    # witness the dot on the entry clock and the map clock (`map.rs:181-185`)
+    upd = (do[..., None] & onehot)[..., None] & (
+        jnp.arange(eclocks.shape[-1]) == actor_idx[..., None, None]
+    )
+    new_e = jnp.where(upd, jnp.maximum(eclocks, counter[..., None, None]), eclocks)
+    new_clock = jnp.where(
+        do[..., None] & (jnp.arange(clock.shape[-1]) == actor_idx[..., None]),
+        jnp.maximum(clock, counter[..., None]),
+        clock,
+    )
+
+    v_slot = tree_gather(vals, slot[..., None])
+    v_slot = jax.tree.map(lambda l: jnp.squeeze(l, axis=slot.ndim), v_slot)
+    v_new, v_over = nested_apply(v_slot)
+    vals = tree_scatter_slot(vals, slot, v_new, do, k)
+
+    keys2, e2, vals2, dk2, dc2, over_def = _settle_deferred(
+        new_clock, new_keys, new_e, vals, d_keys, d_clocks, vk
+    )
+    return (new_clock, keys2, e2, vals2, dk2, dc2), overflow | (v_over & do) | over_def
+
+
+def apply_rm(state, rm_clock, key_id, vk):
+    """Batched ``Op::Rm`` → ``apply_rm`` (`map.rs:336-350`): buffer the
+    remove when its clock is ahead of the map clock, and always subtract it
+    from the entry — dropping the key if emptied, truncating the nested
+    value otherwise."""
+    clock, keys, eclocks, vals, d_keys, d_clocks = state
+    ahead = ~clock_ops.leq(rm_clock, clock)
+
+    d_valid = d_keys != EMPTY
+    same = (
+        (d_keys == key_id[..., None])
+        & clock_ops.eq(d_clocks, rm_clock[..., None, :])
+        & d_valid
+    )
+    already = jnp.any(same, axis=-1)
+    want = ahead & ~already
+    free = ~d_valid
+    has_free = jnp.any(free, axis=-1)
+    dslot = jnp.argmax(free, axis=-1)
+    overflow = want & ~has_free
+    do_buf = (want & has_free)[..., None]
+    onehot = jnp.arange(d_keys.shape[-1]) == dslot[..., None]
+    new_dk = jnp.where(do_buf & onehot, key_id[..., None], d_keys)
+    new_dc = jnp.where((do_buf & onehot)[..., None], rm_clock[..., None, :], d_clocks)
+
+    target = keys == key_id[..., None]
+    sub = clock_ops.subtract(eclocks, rm_clock[..., None, :])
+    new_e = jnp.where(target[..., None], sub, eclocks)
+    live = ~clock_ops.is_empty(new_e) & (keys != EMPTY)
+    rm_slots = jnp.where(target[..., None], rm_clock[..., None, :], 0)
+    vals, over_t = vk.truncate(vals, rm_slots)
+    new_keys = jnp.where(live, keys, EMPTY)
+    new_e = jnp.where(live[..., None], new_e, 0)
+    vals = tree_where(live, vals, vk.zeros_like(vals))
+    return (clock, new_keys, new_e, vals, new_dk, new_dc), overflow | jnp.any(
+        over_t, axis=-1
+    )

@@ -63,6 +63,37 @@ def _universe_from_blob(blob: bytes) -> Universe:
     return universe
 
 
+def _is_static_field(f) -> bool:
+    """flax.struct fields marked ``pytree_node=False`` (e.g. MapBatch's
+    value kernel) — serialized as metadata, not arrays."""
+    return not f.metadata.get("pytree_node", True)
+
+
+def _flatten_field(name: str, value, arrays: dict) -> None:
+    """Store a field's leaves under path-encoded names: a plain array under
+    ``name``, a nested-tuple pytree (MapBatch ``vals``) under
+    ``name__i_j_k`` keys that encode the tuple path."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(value)[0]
+    for path, leaf in leaves:
+        if path == ():
+            arrays[name] = np.asarray(leaf)
+        else:
+            suffix = "_".join(str(p.idx) for p in path)
+            arrays[f"{name}__{suffix}"] = np.asarray(leaf)
+
+
+def _rebuild_tuple(rows):
+    """Rebuild a nested tuple from ``(index_path, leaf)`` rows."""
+    if len(rows) == 1 and rows[0][0] == ():
+        return rows[0][1]
+    groups: dict = {}
+    for path, leaf in rows:
+        groups.setdefault(path[0], []).append((path[1:], leaf))
+    return tuple(_rebuild_tuple(groups[i]) for i in range(len(groups)))
+
+
 def save(path, batch_state: Any, universe: Universe) -> None:
     """Write ``batch_state`` (a :mod:`crdt_tpu.batch` pytree) + its universe.
 
@@ -79,11 +110,19 @@ def save(path, batch_state: Any, universe: Universe) -> None:
     cls_name = type(batch_state).__name__
     if cls_name not in _batch_types():
         raise TypeError(f"not a checkpointable batch type: {cls_name}")
-    arrays = {
-        f.name: np.asarray(getattr(batch_state, f.name))
-        for f in dataclasses.fields(batch_state)
-    }
-    meta = serde.to_binary({"version": FORMAT_VERSION, "type": cls_name})
+    arrays: dict = {}
+    static: dict = {}
+    for f in dataclasses.fields(batch_state):
+        value = getattr(batch_state, f.name)
+        if _is_static_field(f):
+            from ..batch.val_kernels import kernel_to_spec
+
+            static[f.name] = kernel_to_spec(value)
+        else:
+            _flatten_field(f.name, value, arrays)
+    meta = serde.to_binary(
+        {"version": FORMAT_VERSION, "type": cls_name, "static": static}
+    )
     np.savez(
         path,
         __meta__=np.frombuffer(meta, dtype=np.uint8),
@@ -101,8 +140,11 @@ def load(path) -> Tuple[Any, Universe]:
 
     if isinstance(path, (str, os.PathLike)):
         p = os.fspath(path)
-        if not p.endswith(".npz") and not os.path.exists(p):
-            path = p + ".npz"
+        if not p.endswith(".npz"):
+            # prefer the sibling save() actually wrote; fall back to the
+            # bare path only when no .npz exists
+            if os.path.exists(p + ".npz") or not os.path.exists(p):
+                path = p + ".npz"
     with np.load(path) as z:
         meta = serde.from_binary(z["__meta__"].tobytes())
         if meta.get("version") != FORMAT_VERSION:
@@ -111,9 +153,23 @@ def load(path) -> Tuple[Any, Universe]:
         if cls is None:
             raise ValueError(f"unknown batch type in checkpoint: {meta.get('type')!r}")
         universe = _universe_from_blob(z["__universe__"].tobytes())
-        fields = {
-            f.name: jnp.asarray(z[f.name]) for f in dataclasses.fields(cls)
-        }
+        static = meta.get("static", {})
+        fields = {}
+        for f in dataclasses.fields(cls):
+            if _is_static_field(f):
+                from ..batch.val_kernels import kernel_from_spec
+
+                fields[f.name] = kernel_from_spec(static[f.name])
+            elif f.name in z:
+                fields[f.name] = jnp.asarray(z[f.name])
+            else:
+                prefix = f.name + "__"
+                rows = []
+                for key in z.files:
+                    if key.startswith(prefix):
+                        idx_path = tuple(int(s) for s in key[len(prefix):].split("_"))
+                        rows.append((idx_path, jnp.asarray(z[key])))
+                fields[f.name] = _rebuild_tuple(sorted(rows))
     return cls(**fields), universe
 
 

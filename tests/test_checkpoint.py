@@ -111,3 +111,44 @@ def test_container_is_plain_npz(tmp_path):
     checkpoint.save(path, batch, universe)
     with np.load(path, allow_pickle=False) as z:
         assert "clock" in z.files and "__meta__" in z.files
+
+
+def test_map_batch_roundtrip(tmp_path):
+    """MapBatch: nested vals pytree + static kernel survive the checkpoint
+    (leaves stored under path-encoded names, kernel as a metadata spec)."""
+    from crdt_tpu import Dot, Map, MVReg, VClock
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+    from crdt_tpu.batch.val_kernels import MapKernel
+    from crdt_tpu.scalar.map import Up
+    from crdt_tpu.scalar.mvreg import Put
+
+    universe = Universe(
+        CrdtConfig(num_actors=4, member_capacity=8, deferred_capacity=4,
+                   mv_capacity=4, key_capacity=4)
+    )
+    maps = []
+    for i in range(3):
+        m = Map(MVReg)
+        for j in range(i + 1):
+            clock = VClock.from_iter([(f"a{j}", j + 1)])
+            m.apply(Up(dot=Dot(f"a{j}", j + 1), key=j,
+                       op=Put(clock=clock, val=i * 10 + j)))
+        maps.append(m)
+    mv = MVRegKernel.from_config(universe.config)
+    batch = MapBatch.from_scalar(maps, universe, mv)
+    path = tmp_path / "mapck"
+    checkpoint.save(path, batch, universe)
+    loaded, uni2 = checkpoint.load(path)
+    assert type(loaded) is MapBatch
+    assert loaded.kernel == batch.kernel
+    np.testing.assert_array_equal(np.asarray(loaded.keys), np.asarray(batch.keys))
+    assert loaded.to_scalar(uni2) == maps
+
+    # nested Map<K, Map<K2, MVReg>> kernel spec round-trips too
+    nested_kernel = MapKernel.from_config(universe.config, mv)
+    nested = MapBatch.from_scalar(
+        [Map(lambda: Map(MVReg)) for _ in range(2)], universe, nested_kernel
+    )
+    buf = checkpoint.save_bytes(nested, universe)
+    loaded2, _ = checkpoint.load_bytes(buf)
+    assert loaded2.kernel == nested.kernel

@@ -1,0 +1,308 @@
+"""Batched Map parity — the L4 composition kernel vs the scalar engine.
+
+Random scalar Maps built from op sequences (the `test/map.rs:13-46` idiom)
+are packed into :class:`crdt_tpu.batch.MapBatch`, merged on device, unpacked,
+and compared for **full state equality** (clock, entries incl. nested values,
+deferred buffers) against the scalar merge — for ``Map<K, MVReg>``,
+``Map<K, Orswot>`` and the nested ``Map<K, Map<K2, MVReg>>``
+(`/root/reference/test/map.rs:8`).  Plus the CRDT algebra (commutativity,
+associativity, idempotence — `test/map.rs:654-730`) directly on the batch
+engine, reset-remove (`test/map.rs:136-169`) through the batch path, and the
+batched op path vs scalar ``apply``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given
+from hypothesis import strategies as st
+
+from crdt_tpu import Dot, Map, MVReg, Orswot, VClock
+from crdt_tpu.batch import MapBatch, MVRegKernel, OrswotKernel
+from crdt_tpu.batch.val_kernels import MapKernel
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.scalar.map import Rm as MapRm, Up
+from crdt_tpu.scalar.mvreg import Put
+from crdt_tpu.scalar.orswot import Add as OrswotAdd, Rm as OrswotRm
+from crdt_tpu.utils.interning import Universe
+
+
+def small_universe(**kw):
+    defaults = dict(
+        num_actors=8,
+        member_capacity=16,
+        deferred_capacity=24,
+        mv_capacity=16,
+        key_capacity=16,
+    )
+    defaults.update(kw)
+    return Universe(CrdtConfig(**defaults))
+
+
+actors = st.integers(0, 7)
+keys = st.integers(0, 5)
+counters = st.integers(1, 6)
+vals = st.integers(0, 9)
+
+
+@st.composite
+def mvreg_maps(draw, actor_strategy=actors):
+    """Random ``Map<int, MVReg>`` from raw ops (`test/map.rs:13-46` idiom)."""
+    m = Map(MVReg)
+    for actor, choice, key, val, counter in draw(
+        st.lists(
+            st.tuples(actor_strategy, st.integers(0, 3), keys, vals, counters),
+            max_size=10,
+        )
+    ):
+        clock = VClock.from_iter([(actor, counter)])
+        if choice != 1:
+            m.apply(Up(dot=Dot(actor, counter), key=key, op=Put(clock=clock, val=val)))
+        else:
+            m.apply(MapRm(clock=clock, key=key))
+    return m
+
+
+@st.composite
+def orswot_maps(draw):
+    """Random ``Map<int, Orswot>``."""
+    m = Map(Orswot)
+    for actor, choice, key, member, counter in draw(
+        st.lists(st.tuples(actors, st.integers(0, 3), keys, vals, counters), max_size=10)
+    ):
+        dot = Dot(actor, counter)
+        if choice == 1:
+            m.apply(MapRm(clock=dot.to_vclock(), key=key))
+        elif choice == 2:
+            inner = OrswotRm(clock=dot.to_vclock(), member=member)
+            m.apply(Up(dot=dot, key=key, op=inner))
+        else:
+            m.apply(Up(dot=dot, key=key, op=OrswotAdd(dot=dot, member=member)))
+    return m
+
+
+@st.composite
+def nested_maps(draw):
+    """Random ``Map<int, Map<int, MVReg>>`` (`test/map.rs:8`)."""
+    m = Map(lambda: Map(MVReg))
+    for actor, choice, inner_choice, key, ikey, val, counter in draw(
+        st.lists(
+            st.tuples(actors, st.integers(0, 2), st.integers(0, 2), keys, keys, vals, counters),
+            max_size=10,
+        )
+    ):
+        dot = Dot(actor, counter)
+        clock = dot.to_vclock()
+        if choice == 1:
+            m.apply(MapRm(clock=clock, key=key))
+        else:
+            if inner_choice == 1:
+                inner = MapRm(clock=clock, key=ikey)
+            else:
+                inner = Up(dot=dot, key=ikey, op=Put(clock=clock, val=val))
+            m.apply(Up(dot=dot, key=key, op=inner))
+    return m
+
+
+def mv_kernel(uni):
+    return MVRegKernel.from_config(uni.config)
+
+
+def or_kernel(uni):
+    return OrswotKernel.from_config(uni.config)
+
+
+def inner_map_kernel(uni):
+    return MapKernel.from_config(uni.config, MVRegKernel.from_config(uni.config))
+
+
+CASES = [
+    (mvreg_maps, mv_kernel),
+    (orswot_maps, or_kernel),
+    (nested_maps, inner_map_kernel),
+]
+
+
+# -- round-trip -------------------------------------------------------------
+
+
+@given(mvreg_maps(), orswot_maps(), nested_maps())
+def test_roundtrip(m1, m2, m3):
+    for m, mk in [(m1, mv_kernel), (m2, or_kernel), (m3, inner_map_kernel)]:
+        uni = small_universe()
+        back = MapBatch.from_scalar([m], uni, mk(uni)).to_scalar(uni)[0]
+        assert back == m
+
+
+# -- merge parity (the contract) --------------------------------------------
+
+
+def _merge_parity(a, b, make_kernel):
+    uni = small_universe()
+    expected = a.clone()
+    expected.merge(b)
+    kernel = make_kernel(uni)
+    got = (
+        MapBatch.from_scalar([a], uni, kernel)
+        .merge(MapBatch.from_scalar([b], uni, kernel))
+        .to_scalar(uni)[0]
+    )
+    assert got == expected
+
+
+@given(mvreg_maps(), mvreg_maps())
+def test_merge_parity_mvreg(a, b):
+    _merge_parity(a, b, mv_kernel)
+
+
+@given(orswot_maps(), orswot_maps())
+def test_merge_parity_orswot(a, b):
+    _merge_parity(a, b, or_kernel)
+
+
+@given(nested_maps(), nested_maps())
+def test_merge_parity_nested(a, b):
+    _merge_parity(a, b, inner_map_kernel)
+
+
+# -- algebra on the batch engine (`test/map.rs:654-730`) ---------------------
+
+
+@given(
+    mvreg_maps(st.integers(0, 2)),
+    mvreg_maps(st.integers(3, 5)),
+    mvreg_maps(st.integers(6, 7)),
+)
+def test_batch_merge_associative_commutative_idempotent(a, b, c):
+    """Replicas get disjoint actor pools, like the reference props — merging
+    states that reused a dot for different payloads is undefined behavior and
+    quickcheck discards it (`test/map.rs:527-529`, `test/mvreg.rs:120-143`)."""
+    uni = small_universe()
+    k = mv_kernel(uni)
+    ba = MapBatch.from_scalar([a], uni, k)
+    bb = MapBatch.from_scalar([b], uni, k)
+    bc = MapBatch.from_scalar([c], uni, k)
+
+    ab_c = ba.merge(bb).merge(bc).to_scalar(uni)[0]
+    a_bc = ba.merge(bb.merge(bc)).to_scalar(uni)[0]
+    assert ab_c == a_bc, "associativity"
+
+    ab = ba.merge(bb).to_scalar(uni)[0]
+    ba_ = bb.merge(ba).to_scalar(uni)[0]
+    assert ab == ba_, "commutativity"
+
+    aa = ba.merge(ba).to_scalar(uni)[0]
+    assert aa == ba.to_scalar(uni)[0], "idempotence"
+
+
+# -- truncate parity (`map.rs:131-158`) -------------------------------------
+
+
+@given(mvreg_maps(), st.lists(st.tuples(actors, counters), max_size=5))
+def test_truncate_parity(m, clock_pairs):
+    uni = small_universe()
+    clock = VClock.from_iter(clock_pairs)
+    expected = m.clone()
+    expected.truncate(clock)
+
+    k = mv_kernel(uni)
+    batch = MapBatch.from_scalar([m], uni, k)
+    row = np.zeros((1, uni.config.num_actors), dtype=np.asarray(batch.clock).dtype)
+    for actor, counter in clock.dots.items():
+        row[0, uni.actor_idx(actor)] = counter
+    got = batch.truncate(jnp.asarray(row)).to_scalar(uni)[0]
+    assert got == expected
+
+
+# -- batched op path vs scalar apply ----------------------------------------
+
+
+@given(
+    mvreg_maps(),
+    st.lists(st.tuples(actors, counters, keys, vals), min_size=1, max_size=6),
+)
+def test_apply_up_parity(m, ops):
+    """One batch = one map per op; each op applied on device vs scalar."""
+    uni = small_universe()
+    vk = mv_kernel(uni)
+    n = len(ops)
+    scalars = [m.clone() for _ in range(n)]
+    batch = MapBatch.from_scalar(scalars, uni, vk)
+
+    actor_idx = jnp.asarray([uni.actor_idx(a) for a, _, _, _ in ops], dtype=jnp.int32)
+    counter = jnp.asarray([c for _, c, _, _ in ops], dtype=batch.clock.dtype)
+    key_id = jnp.asarray([uni.member_id(key) for _, _, key, _ in ops], dtype=jnp.int32)
+    a_dim = uni.config.num_actors
+    op_clocks = np.zeros((n, a_dim), dtype=np.asarray(batch.clock).dtype)
+    for i, (a, c, _, _) in enumerate(ops):
+        op_clocks[i, uni.actor_idx(a)] = c
+    op_vals = jnp.asarray(
+        [uni.member_id(v) for _, _, _, v in ops], dtype=batch.clock.dtype
+    )
+    op_clocks = jnp.asarray(op_clocks)
+
+    got = batch.apply_up(
+        actor_idx, counter, key_id, "apply_put", (op_clocks, op_vals)
+    ).to_scalar(uni)
+
+    for i, (a, c, key, val) in enumerate(ops):
+        clock = VClock.from_iter([(a, c)])
+        scalars[i].apply(Up(dot=Dot(a, c), key=key, op=Put(clock=clock, val=val)))
+        assert got[i] == scalars[i], f"op {i}"
+
+
+@given(
+    mvreg_maps(),
+    st.lists(st.tuples(st.lists(st.tuples(actors, counters), max_size=3), keys), min_size=1, max_size=6),
+)
+def test_apply_rm_parity(m, rms):
+    uni = small_universe()
+    k = mv_kernel(uni)
+    n = len(rms)
+    scalars = [m.clone() for _ in range(n)]
+    batch = MapBatch.from_scalar(scalars, uni, k)
+
+    a_dim = uni.config.num_actors
+    rm_clocks = np.zeros((n, a_dim), dtype=np.asarray(batch.clock).dtype)
+    for i, (pairs, _) in enumerate(rms):
+        vc = VClock.from_iter(pairs)
+        for actor, counter in vc.dots.items():
+            rm_clocks[i, uni.actor_idx(actor)] = counter
+    key_id = jnp.asarray([uni.member_id(key) for _, key in rms], dtype=jnp.int32)
+
+    got = batch.apply_rm(jnp.asarray(rm_clocks), key_id).to_scalar(uni)
+
+    for i, (pairs, key) in enumerate(rms):
+        scalars[i].apply(MapRm(clock=VClock.from_iter(pairs), key=key))
+        assert got[i] == scalars[i], f"rm {i}"
+
+
+# -- reset-remove through the batch engine (`test/map.rs:136-169`) -----------
+
+
+def test_reset_remove_batch():
+    """Concurrent remove-map-entry vs nested update: the entry survives but
+    edits seen by the remover are gone — replayed through MapBatch."""
+    m1 = Map(MVReg)
+    ctx = m1.get(101).derive_add_ctx("A")
+    m1.apply(m1.update(101, ctx, lambda r, c: r.set(1, c)))
+
+    m2 = m1.clone()
+    # A removes the key; B concurrently writes a fresh value under it
+    rm_op = m1.rm(101, m1.get(101).derive_rm_ctx())
+    up_op = m2.update(101, m2.get(101).derive_add_ctx("B"), lambda r, c: r.set(2, c))
+    m1.apply(rm_op)
+    m2.apply(up_op)
+
+    expected = m1.clone()
+    expected.merge(m2)
+    assert expected.get(101).val is not None
+    assert expected.get(101).val.read().val == [2]  # A's edit is gone, B's survives
+
+    uni = small_universe()
+    k = mv_kernel(uni)
+    got = (
+        MapBatch.from_scalar([m1], uni, k)
+        .merge(MapBatch.from_scalar([m2], uni, k))
+        .to_scalar(uni)[0]
+    )
+    assert got == expected

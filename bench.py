@@ -133,6 +133,22 @@ def bench_north_star():
     ]
     stacked = tuple(jnp.stack([rep[i] for rep in replicas]) for i in range(5))
 
+    if os.environ.get("CRDT_PALLAS") == "1":
+        # fused Pallas fold: accumulator stays in VMEM across all R joins.
+        # Opt-in only — Mosaic does not lower through remote-TPU tunnels
+        # (see crdt_tpu/ops/orswot_pallas.py deployment note).
+        from crdt_tpu.ops import orswot_pallas
+
+        fold = lambda stack: orswot_pallas.fold_merge(*stack, m, d, interpret=False)
+        t, joined = timeit(fold, stacked, iters=3)
+        merges = n * r
+        rate = merges / t
+        log(
+            f"north★  (pallas fused fold) n={n} R={r} A={a} M={m}: "
+            f"{t*1e3:.2f}ms  {rate/1e6:.2f}M merges/s"
+        )
+        return rate
+
     def fold_join(stack):
         acc = tuple(x[0] for x in stack)
         for i in range(1, r):

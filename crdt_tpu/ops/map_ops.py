@@ -246,7 +246,7 @@ def merge(state_a, state_b, vk, k_cap: int, d_cap: int):
     keys, eclocks, vals, k_over = compact_keyed(keys, eclocks, vals, vk, k_cap)
     d_keys, d_clocks, d_over = compact(d_keys, d_clocks, d_cap)
     overflow = (
-        jnp.any(over_vm & both, axis=-1)
+        jnp.any(over_vm & both & survive, axis=-1)
         | jnp.any(over_vt & survive, axis=-1)
         | over_def
         | k_over

@@ -1,0 +1,368 @@
+"""Fused Pallas TPU kernels for the ORSWOT merge hot path.
+
+The jnp path (:mod:`crdt_tpu.ops.orswot_ops`) expresses the merge as
+concat → argsort → gather → dot-algebra → compact; under XLA that is
+several HBM round-trips over the ``[N, 2M, A]`` tables per merge.  These
+kernels run the **entire** pairwise merge — alignment, dot algebra,
+deferred union/dedup/replay, canonical compaction — for a tile of objects
+inside VMEM, with exactly one HBM read of the inputs and one HBM write of
+the outputs per object:
+
+* :func:`merge` — fused pairwise merge, drop-in for
+  ``orswot_ops.merge`` (bit-identical outputs, same signature).
+* :func:`fold_merge` — the anti-entropy fold: joins ``R`` stacked replica
+  fleets to fixpoint (left fold + defer-plunger self-merge,
+  `/root/reference/test/orswot.rs:45-62`) while the accumulator lives in
+  registers/VMEM across all ``R`` steps — the jnp fold re-reads the
+  accumulator from HBM every step, so this saves ``~R×`` accumulator
+  bandwidth, which dominates the north-star benchmark.
+
+Design notes (vs the jnp path):
+
+* Member alignment is O(M²) masked compares instead of a 2M argsort —
+  there is no sort primitive in Mosaic, and for the padded member
+  capacities this framework targets (M ≤ 64) the quadratic match is a
+  handful of VPU passes over data already in VMEM.
+* Canonical output order (ascending member id, then free slots — what the
+  argsort path produces) is restored by *rank selection*: each survivor's
+  output slot is the count of live members with a smaller id, and output
+  slot ``k`` gathers its row with a one-hot masked reduction.  Deferred
+  rows keep first-occurrence order (the jnp path's stable pack), via the
+  same rank trick with slot index as the key.
+* Counters are ``uint32`` on the Pallas path (Mosaic has no 64-bit
+  vectors); the scalar/u64 path remains the parity oracle for u64.
+
+Deployment note: remote-TPU tunnels that proxy a single chip (the "axon"
+platform plugin in this dev environment) hang in Mosaic lowering even for
+trivial kernels, so the benchmark harness only engages this path when
+``CRDT_PALLAS=1`` is set on hardware with native Mosaic support; the jnp
+path is the portable default and the two are bit-identical
+(``tests/test_orswot_pallas.py``).
+
+Semantics follow `/root/reference/src/orswot.rs:89-156` exactly — the
+asymmetric keep rules (`orswot.rs:94-103` vs `:132-138`), deferred-map
+union (`:141-148`), clock join (`:153`) and deferred replay (`:155`) — see
+``orswot_ops`` for the rule-by-rule citations; parity with that path (and
+transitively with the scalar engine) is asserted in
+``tests/test_orswot_pallas.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+EMPTY = -1
+
+
+# ---------------------------------------------------------------------------
+# tile math (plain jnp on VMEM-resident values; shared by both kernels)
+# ---------------------------------------------------------------------------
+
+
+def _align_against(ids_a, dots_a, ids_b, dots_b):
+    """For each a-slot, the matching b dot clock (0 if unmatched), plus the
+    mask of b-slots consumed by a match.  O(M_a · M_b) masked compares."""
+    m_b = ids_b.shape[-1]
+    valid_a = ids_a != EMPTY
+    e2 = jnp.zeros_like(dots_a)
+    b_matched = jnp.zeros(ids_b.shape, dtype=bool)
+    for j in range(m_b):
+        mj = valid_a & (ids_a == ids_b[..., j : j + 1])  # [T, M_a]
+        e2 = jnp.maximum(e2, jnp.where(mj[..., None], dots_b[..., j : j + 1, :], 0))
+        b_matched = b_matched.at[..., j].set(jnp.any(mj, axis=-1))
+    return e2, b_matched
+
+
+def _merge_rule(e1, e2, p1, p2, valid, self_clock, other_clock):
+    """The three-way per-member dot-algebra (`orswot.rs:92-138`)."""
+    sc = self_clock[..., None, :]
+    oc = other_clock[..., None, :]
+    common = jnp.where(e1 == e2, e1, 0)
+    c1 = _sub(_sub(e1, common), oc)
+    c2 = _sub(_sub(e2, common), sc)
+    out_both = jnp.maximum(common, jnp.maximum(c1, c2))
+    keep1 = ~jnp.all(e1 <= oc, axis=-1)
+    out_only1 = jnp.where(keep1[..., None], e1, 0)
+    out_only2 = _sub(e2, sc)
+    both = (p1 & p2)[..., None]
+    only1 = (p1 & ~p2)[..., None]
+    out = jnp.where(both, out_both, jnp.where(only1, out_only1, out_only2))
+    return jnp.where(valid[..., None], out, 0)
+
+
+def _sub(a, b):
+    return jnp.where(a > b, a, 0)
+
+
+def _nonempty(clock):
+    return jnp.any(clock != 0, axis=-1)
+
+
+def _rank_select(keys, live, payload_ids, payload_clocks, cap):
+    """Pack live slots in ascending-``keys`` order into ``cap`` output slots.
+
+    ``keys`` must be unique among live slots.  Returns
+    ``(ids[T, cap], clocks[T, cap, A], overflow[T])``."""
+    s = keys.shape[-1]
+    rank = jnp.zeros(keys.shape, dtype=jnp.int32)
+    for j in range(s):
+        smaller = live & live[..., j : j + 1] & (keys[..., j : j + 1] < keys)
+        rank = rank + smaller.astype(jnp.int32)
+    # rank[j] = #live slots with key < key[j]  (only meaningful where live)
+    out_ids = []
+    out_clocks = []
+    for k in range(cap):
+        sel = live & (rank == k)  # [T, S], at most one hot
+        out_ids.append(
+            jnp.sum(jnp.where(sel, payload_ids + 1, 0), axis=-1, dtype=jnp.int32) - 1
+        )
+        out_clocks.append(
+            jnp.max(jnp.where(sel[..., None], payload_clocks, 0), axis=-2)
+        )
+    ids = jnp.stack(out_ids, axis=-1)
+    clocks = jnp.stack(out_clocks, axis=-2)
+    overflow = jnp.sum(live, axis=-1, dtype=jnp.int32) > cap
+    return ids, clocks, overflow
+
+
+def _merge_tile(sa, sb, m_cap: int, d_cap: int):
+    """Full pairwise merge of two tile states.
+
+    A state is ``(clock[T,A], ids[T,M], dots[T,M,A], d_ids[T,D],
+    d_clocks[T,D,A])``; output uses ``m_cap``/``d_cap`` slots."""
+    ca, ids_a, dots_a, dida, dca = sa
+    cb, ids_b, dots_b, didb, dcb = sb
+
+    # --- member alignment + dot algebra (`orswot.rs:92-138`) ---
+    e2_for_a, b_matched = _align_against(ids_a, dots_a, ids_b, dots_b)
+    valid_a = ids_a != EMPTY
+    valid_b = ids_b != EMPTY
+    out_a = _merge_rule(
+        dots_a, e2_for_a, valid_a & _nonempty(dots_a), valid_a & _nonempty(e2_for_a),
+        valid_a, ca, cb,
+    )
+    # unmatched b members: the only-in-other rule (`orswot.rs:132-138`)
+    b_only = valid_b & ~b_matched
+    out_b = jnp.where(b_only[..., None], _sub(dots_b, ca[..., None, :]), 0)
+
+    ids_cat = jnp.concatenate(
+        [jnp.where(valid_a, ids_a, EMPTY), jnp.where(b_only, ids_b, EMPTY)], axis=-1
+    )
+    dots_cat = jnp.concatenate([out_a, out_b], axis=-2)  # [T, Ma+Mb, A]
+
+    # --- deferred union + dedup, keep first (`orswot.rs:141-148`) ---
+    d_ids = jnp.concatenate([dida, didb], axis=-1)  # [T, Da+Db]
+    d_clocks = jnp.concatenate([dca, dcb], axis=-2)
+    dn = d_ids.shape[-1]
+    d_valid = d_ids != EMPTY
+    is_dup = jnp.zeros(d_ids.shape, dtype=bool)
+    for j in range(1, dn):
+        dup_j = jnp.zeros(d_ids.shape[:-1], dtype=bool)
+        for i in range(j):
+            same = (
+                d_valid[..., i]
+                & d_valid[..., j]
+                & (d_ids[..., i] == d_ids[..., j])
+                & jnp.all(d_clocks[..., i, :] == d_clocks[..., j, :], axis=-1)
+            )
+            dup_j = dup_j | same
+        is_dup = is_dup.at[..., j].set(dup_j)
+    d_live = d_valid & ~is_dup
+    d_ids = jnp.where(d_live, d_ids, EMPTY)
+    d_clocks = jnp.where(d_live[..., None], d_clocks, 0)
+
+    # --- clock join (`orswot.rs:153`) then deferred replay (`:155`) ---
+    clock = jnp.maximum(ca, cb)
+    rm = jnp.zeros_like(dots_cat)
+    for k in range(dn):
+        match = (ids_cat == d_ids[..., k : k + 1]) & d_live[..., k : k + 1]
+        rm = jnp.maximum(rm, jnp.where(match[..., None], d_clocks[..., k : k + 1, :], 0))
+    new_dots = _sub(dots_cat, rm)
+    live = _nonempty(new_dots) & (ids_cat != EMPTY)
+    still_ahead = d_live & ~jnp.all(d_clocks <= clock[..., None, :], axis=-1)
+
+    # --- canonical compaction ---
+    big = jnp.iinfo(jnp.int32).max
+    m_keys = jnp.where(live, ids_cat, big)
+    ids_out, dots_out, m_over = _rank_select(m_keys, live, ids_cat, new_dots, m_cap)
+    slot_keys = jax.lax.broadcasted_iota(jnp.int32, d_ids.shape, d_ids.ndim - 1)
+    dids_out, dclk_out, d_over = _rank_select(
+        slot_keys, still_ahead, d_ids, d_clocks, d_cap
+    )
+    return (clock, ids_out, dots_out, dids_out, dclk_out), m_over | d_over
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+
+def _check_dtypes(clock):
+    if clock.dtype.itemsize > 4:
+        raise TypeError(
+            f"Pallas ORSWOT kernels need <=32-bit counters, got {clock.dtype}; "
+            "use the jnp path (orswot_ops) for u64"
+        )
+
+
+def _tile_size(a, m, d, n_states=2, vmem_budget=8 * 1024 * 1024):
+    """Largest power-of-two tile whose working set fits the VMEM budget.
+
+    ``n_states`` is how many full states are live per tile object: 2 for a
+    pairwise merge, R+1 for the fold (all R replica blocks plus the
+    accumulator); the remaining terms bound ``_merge_tile``'s temporaries."""
+    state_bytes = 4 * (a + m + m * a + d + d * a)
+    tmp_bytes = 4 * (6 * m * a + 4 * d * a)
+    bytes_per_obj = n_states * state_bytes + tmp_bytes
+    t = 256
+    while t > 8 and t * bytes_per_obj > vmem_budget:
+        t //= 2
+    return t
+
+
+def _pad_to(x, t, axis=0, fill=0):
+    n = x.shape[axis]
+    pad = (-n) % t
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+def _state_specs(t, shapes, batch_axes=1):
+    """BlockSpecs blocking the leading object axis into tiles of ``t``."""
+    specs = []
+    for shp in shapes:
+        block = (t,) + shp[batch_axes:]
+        rest = len(shp) - batch_axes
+        specs.append(pl.BlockSpec(block, lambda i, _r=rest: (i,) + (0,) * _r))
+    return specs
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret"))
+def merge(
+    clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int, interpret: bool | None = None,
+):
+    """Fused pairwise merge — drop-in for ``orswot_ops.merge`` (2-D batch
+    ``[N, ...]`` states, uint32 counters).  Returns
+    ``(clock, ids, dots, d_ids, d_clocks, overflow)``."""
+    _check_dtypes(clock_a)
+    if interpret is None:
+        interpret = _interpret_default()
+    n, a = clock_a.shape
+    m, d = ids_a.shape[-1], dids_a.shape[-1]
+    t = _tile_size(a, m, d)
+    sa = (clock_a, ids_a, dots_a, dids_a, dclocks_a)
+    sb = (clock_b, ids_b, dots_b, dids_b, dclocks_b)
+    sa = tuple(_pad_to(x, t, fill=EMPTY if x.dtype == jnp.int32 else 0) for x in sa)
+    sb = tuple(_pad_to(x, t, fill=EMPTY if x.dtype == jnp.int32 else 0) for x in sb)
+    n_pad = sa[0].shape[0]
+    cdt = clock_a.dtype
+
+    def kernel(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, oc, oi, od, odi, odc, oover):
+        out, over = _merge_tile(
+            tuple(r[...] for r in (ca, ia, da, dia, dca)),
+            tuple(r[...] for r in (cb, ib, db, dib, dcb)),
+            m_cap, d_cap,
+        )
+        for ref, val in zip((oc, oi, od, odi, odc), out):
+            ref[...] = val
+        oover[...] = over[..., None].astype(jnp.int32)
+
+    in_shapes = [x.shape for x in sa] * 2
+    out_shape = (
+        jax.ShapeDtypeStruct((n_pad, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, m_cap), jnp.int32),
+        jax.ShapeDtypeStruct((n_pad, m_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, d_cap), jnp.int32),
+        jax.ShapeDtypeStruct((n_pad, d_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // t,),
+        in_specs=_state_specs(t, in_shapes),
+        out_specs=_state_specs(t, [s.shape for s in out_shape]),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*sa, *sb)
+    clock, ids, dots, dids, dclk, over = (x[:n] for x in out)
+    return clock, ids, dots, dids, dclk, over[:, 0].astype(bool)
+
+
+@functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret", "plunger"))
+def fold_merge(
+    clock, ids, dots, dids, dclocks,
+    m_cap: int, d_cap: int, interpret: bool | None = None, plunger: bool = True,
+):
+    """Anti-entropy fold: join ``R`` stacked replica fleets (arrays are
+    ``[R, N, ...]``) into one ``[N, ...]`` state, entirely in VMEM.
+
+    Left-folds replica ``r`` into the accumulator for ``r = 1..R-1`` and
+    finishes with a defer-plunger self-merge
+    (`/root/reference/test/orswot.rs:61-62`) so buffered removes flush —
+    matching ``r`` sequential ``orswot_ops.merge`` calls bit-exactly, but
+    with the accumulator never leaving the chip."""
+    _check_dtypes(clock)
+    if interpret is None:
+        interpret = _interpret_default()
+    r, n, a = clock.shape
+    m, d = ids.shape[-1], dids.shape[-1]
+    # all R replica blocks plus the accumulator are live in VMEM per tile
+    t = _tile_size(a, m, d, n_states=r + 1)
+    state = (clock, ids, dots, dids, dclocks)
+    state = tuple(
+        _pad_to(x, t, axis=1, fill=EMPTY if x.dtype == jnp.int32 else 0) for x in state
+    )
+    n_pad = state[0].shape[1]
+    cdt = clock.dtype
+
+    def kernel(ca, ia, da, dia, dca, oc, oi, od, odi, odc, oover):
+        refs = (ca, ia, da, dia, dca)
+        acc = tuple(ref[0] for ref in refs)
+        over_any = jnp.zeros((acc[0].shape[0],), dtype=bool)
+        for rr in range(1, r):
+            acc, over = _merge_tile(acc, tuple(ref[rr] for ref in refs), m_cap, d_cap)
+            over_any = over_any | over
+        if plunger:
+            acc, over = _merge_tile(acc, acc, m_cap, d_cap)
+            over_any = over_any | over
+        for ref, val in zip((oc, oi, od, odi, odc), acc):
+            ref[...] = val
+        oover[...] = over_any[..., None].astype(jnp.int32)
+
+    in_specs = []
+    for x in state:
+        rest = x.ndim - 2
+        in_specs.append(
+            pl.BlockSpec((r, t) + x.shape[2:], lambda i, _r=rest: (0, i) + (0,) * _r)
+        )
+    out_shape = (
+        jax.ShapeDtypeStruct((n_pad, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, m_cap), jnp.int32),
+        jax.ShapeDtypeStruct((n_pad, m_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, d_cap), jnp.int32),
+        jax.ShapeDtypeStruct((n_pad, d_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // t,),
+        in_specs=in_specs,
+        out_specs=_state_specs(t, [s.shape for s in out_shape]),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*state)
+    c, i, dts, di, dc, over = (x[:n] for x in out)
+    return c, i, dts, di, dc, over[:, 0].astype(bool)

@@ -39,9 +39,12 @@ FORMAT_VERSION = 1
 def _batch_types():
     from .. import batch
 
+    # only the *Batch state types are checkpointable — the value-kernel
+    # helpers (MapKernel &c.) in batch.__all__ are not serializable states
     return {
         name: getattr(batch, name)
         for name in batch.__all__
+        if name.endswith("Batch")
     }
 
 

@@ -104,6 +104,22 @@ def test_rejects_unknown_type():
         raise AssertionError("expected TypeError")
 
 
+def test_rejects_value_kernels():
+    """Value-kernel helpers are exported from ``batch`` but are config, not
+    state — save() must not treat them as checkpointable batch types."""
+    from crdt_tpu.batch import MVRegKernel
+
+    universe = Universe()
+    try:
+        checkpoint.save(
+            io.BytesIO(), MVRegKernel.from_config(universe.config), universe
+        )
+    except TypeError as e:
+        assert "checkpointable" in str(e)
+    else:
+        raise AssertionError("expected TypeError")
+
+
 def test_container_is_plain_npz(tmp_path):
     """The container must be readable by plain numpy (no pickle)."""
     batch, universe, _ = _orswot_fixture()

@@ -173,7 +173,13 @@ class VClock:
     # -- display (`vclock.rs:73-84`) --------------------------------------
 
     def __str__(self) -> str:
-        inner = ", ".join(f"{a}->{c}" for a, c in sorted(self.dots.items(), key=lambda kv: repr(kv[0])))
+        # BTreeMap iteration order = sorted by actor (`vclock.rs:76`);
+        # mixed-type actor sets (untypical) fall back to repr order
+        try:
+            items = sorted(self.dots.items())
+        except TypeError:
+            items = sorted(self.dots.items(), key=lambda kv: repr(kv[0]))
+        inner = ", ".join(f"{a}->{c}" for a, c in items)
         return f"({inner})"
 
     def __repr__(self) -> str:

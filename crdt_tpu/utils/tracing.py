@@ -1,0 +1,197 @@
+"""Tracing & profiling — the observability subsystem (SURVEY.md §5).
+
+The reference has no tracing at all (no logging crates in
+`/root/reference/Cargo.toml:17-25`; its only observability is ``Display``
+impls driven by `examples/pprint.rs`).  On TPU the equivalent first-class
+needs are (a) wall-time accounting per kernel invocation — merges are
+dispatched asynchronously, so timing must block on the result — and (b)
+XLA profiler capture for inspecting fusion/HBM behavior.  This module
+provides both, dependency-free:
+
+* :func:`span` / :class:`Tracer` — nestable wall-time spans aggregated
+  into per-name statistics (count / total / mean / min / max).  When JAX
+  is importable each span also emits a ``jax.profiler.TraceAnnotation``
+  so spans line up with XLA ops in captured traces.
+* :func:`timed_kernel` — decorator that wraps a jitted kernel so every
+  call is traced as a span (blocking on the outputs, so the time is the
+  device time + dispatch, not just the enqueue).
+* :func:`profile` — context manager around ``jax.profiler.trace`` writing
+  a TensorBoard-loadable XLA trace directory; no-ops cleanly when the
+  backend can't profile.
+
+Everything is opt-in and zero-cost when unused; the global tracer is
+disabled by default and enabled with :func:`enable` (or the
+``CRDT_TRACE=1`` environment variable, read at import).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Optional
+
+
+@dataclass
+class SpanStats:
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = float("inf")
+    max_s: float = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.min_s = min(self.min_s, dt)
+        self.max_s = max(self.max_s, dt)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+@dataclass
+class Tracer:
+    """Aggregates named wall-time spans; thread-safe."""
+
+    enabled: bool = True
+    stats: Dict[str, SpanStats] = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        annot = _trace_annotation(name)
+        t0 = time.perf_counter()
+        try:
+            with annot:
+                yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.stats.setdefault(name, SpanStats()).add(dt)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.stats.clear()
+
+    def report(self) -> str:
+        """Human-readable table, longest total first."""
+        with self._lock:
+            # snapshot under the lock so rows aren't torn by concurrent adds
+            rows = sorted(
+                ((name, dataclasses.replace(s)) for name, s in self.stats.items()),
+                key=lambda kv: kv[1].total_s,
+                reverse=True,
+            )
+        if not rows:
+            return "(no spans recorded)"
+        lines = [
+            f"{'span':<32} {'count':>7} {'total':>10} {'mean':>10} "
+            f"{'min':>10} {'max':>10}"
+        ]
+        for name, s in rows:
+            lines.append(
+                f"{name:<32} {s.count:>7} {s.total_s*1e3:>9.2f}ms "
+                f"{s.mean_s*1e3:>9.3f}ms {s.min_s*1e3:>9.3f}ms "
+                f"{s.max_s*1e3:>9.3f}ms"
+            )
+        return "\n".join(lines)
+
+
+def _trace_annotation(name: str):
+    """A jax.profiler.TraceAnnotation when JAX is importable, else a no-op.
+
+    Only attaches annotations if jax is ALREADY imported — tracing scalar
+    code must not drag the device runtime in."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return contextlib.nullcontext()
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+# -- global tracer -----------------------------------------------------------
+
+_GLOBAL = Tracer(enabled=os.environ.get("CRDT_TRACE") == "1")
+
+
+def get_tracer() -> Tracer:
+    return _GLOBAL
+
+
+def enable(on: bool = True) -> None:
+    _GLOBAL.enabled = on
+
+
+def span(name: str):
+    """``with tracing.span("orswot.merge"): ...`` on the global tracer."""
+    return _GLOBAL.span(name)
+
+
+def report() -> str:
+    return _GLOBAL.report()
+
+
+def reset() -> None:
+    _GLOBAL.reset()
+
+
+def timed_kernel(name: Optional[str] = None) -> Callable:
+    """Wrap a (jitted) kernel so each call is a blocking span.
+
+    Blocks on the outputs via ``jax.block_until_ready`` so the recorded
+    time covers device execution, not just async dispatch — without this,
+    XLA's async dispatch makes per-call wall times meaningless."""
+
+    def deco(fn: Callable) -> Callable:
+        label = name or getattr(fn, "__name__", "kernel")
+
+        def wrapped(*args: Any, **kwargs: Any):
+            if not _GLOBAL.enabled:
+                return fn(*args, **kwargs)
+            import jax
+
+            with _GLOBAL.span(label):
+                out = fn(*args, **kwargs)
+                jax.block_until_ready(out)
+            return out
+
+        wrapped.__name__ = getattr(fn, "__name__", "kernel")
+        wrapped.__doc__ = fn.__doc__
+        return wrapped
+
+    return deco
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``log_dir`` (TensorBoard format).
+
+    Swallows backend "profiling unsupported" errors (e.g. remote-TPU
+    tunnels) so callers can leave this on unconditionally — caller
+    exceptions still propagate."""
+    import jax
+
+    try:
+        ctx = jax.profiler.trace(log_dir)
+        ctx.__enter__()
+    except Exception:
+        ctx = None
+    try:
+        yield
+    finally:
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception:
+                pass

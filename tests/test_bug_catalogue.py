@@ -1,0 +1,174 @@
+"""Named fixtures for the reference's historical convergence bugs.
+
+`/root/reference/quickcheck_evolution.log` documents six classes of
+convergence bugs quickcheck/EQC found in riak_dt and the reference port
+(SURVEY.md §4).  Each becomes a named fixture here, asserted on BOTH
+engines: the scalar path directly, and the batch/TPU path by packing the
+same witnesses through ``OrswotBatch`` and comparing full unpacked state.
+
+Log line references below are to `quickcheck_evolution.log`.
+"""
+
+from crdt_tpu import Orswot, VClock
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.scalar.ctx import AddCtx, RmCtx
+from crdt_tpu.scalar.vclock import Dot
+from crdt_tpu.utils.interning import Universe
+
+
+def _universe():
+    return Universe(
+        CrdtConfig(num_actors=8, member_capacity=16, deferred_capacity=8)
+    )
+
+
+def _clock(*pairs):
+    c = VClock()
+    for actor, counter in pairs:
+        c.witness(actor, counter)
+    return c
+
+
+def _add(s, member, actor, counter, seen=None):
+    """Apply an Add with an explicit dot (and optionally explicit ctx clock)."""
+    clock = seen.clone() if seen is not None else s.value().add_clock.clone()
+    dot = Dot(actor, counter)
+    clock.apply(dot)
+    op = s.add(member, AddCtx(clock=clock, dot=dot))
+    s.apply(op)
+    return op
+
+
+def _scalar_join(witnesses):
+    acc = Orswot()
+    for w in witnesses:
+        acc.merge(w)
+    acc.merge(Orswot())  # defer plunger (`test/orswot.rs:61-62`)
+    return acc
+
+
+def _batch_join(witnesses, uni):
+    batches = [OrswotBatch.from_scalar([w], uni) for w in witnesses]
+    acc = OrswotBatch.from_scalar([Orswot()], uni)
+    for b in batches:
+        acc = acc.merge(b)
+    acc = acc.merge(OrswotBatch.from_scalar([Orswot()], uni))
+    return acc.to_scalar(uni)[0]
+
+
+def _assert_convergent(witnesses):
+    """All merge orders agree, scalar and batch produce identical state."""
+    expected = _scalar_join([w.clone() for w in witnesses])
+    reversed_join = _scalar_join([w.clone() for w in reversed(witnesses)])
+    assert expected == reversed_join, "merge order changed the join"
+    uni = _universe()
+    got = _batch_join([w.clone() for w in witnesses], uni)
+    assert got == expected, f"batch != scalar\nbatch:  {got!r}\nscalar: {expected!r}"
+    return expected
+
+
+def test_same_dot_adds_from_different_replicas():
+    """log:51-57 — two replicas applying the SAME dot's add must not look
+    like a delete ('when both clocks are the same but the element is not
+    present')."""
+    a, b = Orswot(), Orswot()
+    op = _add(a, "m", actor=0, counter=1)
+    b.apply(op)  # same op (same dot) routed to a second replica
+    joined = _assert_convergent([a, b])
+    assert joined.value().val == {"m"}
+
+
+def test_context_free_removes_do_not_diverge():
+    """log:83-87 — removing an element a replica never saw is safe exactly
+    because removes carry their read context ('always use context')."""
+    a, b = Orswot(), Orswot()
+    _add(a, "m", actor=0, counter=1)
+    # b never saw the add; it removes with a's read ctx (shipped over)
+    rm = b.remove("m", a.contains("m").derive_rm_ctx())
+    b.apply(rm)
+    joined = _assert_convergent([a, b])
+    assert joined.value().val == set()
+
+
+def test_entry_clock_vs_set_clock_in_merge():
+    """log:117-120 — common entries with disjoint per-entry dots must
+    converge to the union of the dots ({a:1},{b:1} → {a:1,b:1}); comparing
+    against the other's SET clock instead of the entry clock drops them."""
+    a, b = Orswot(), Orswot()
+    _add(a, "foo", actor=0, counter=1)
+    _add(b, "foo", actor=1, counter=1)
+    joined = _assert_convergent([a, b])
+    assert joined.value().val == {"foo"}
+    assert joined.entries["foo"] == _clock((0, 1), (1, 1))
+
+
+def test_deferred_only_in_other_survives_merge():
+    """log:189-193 — a deferred remove present only in the OTHER set must
+    be adopted by merge, and must fire once the add catches up."""
+    a, b = Orswot(), Orswot()
+    # b holds a deferred remove for "A" at a clock it hasn't witnessed
+    rm_clock = _clock((0, 3), (5, 7))
+    rm = b.remove("A", RmCtx(clock=rm_clock))
+    b.apply(rm)
+    assert b.deferred, "fixture must actually defer"
+    merged = a.clone()
+    merged.merge(b)
+    assert merged.deferred, "deferred-only-in-other was dropped by merge"
+    # when the adds catch up, the buffered remove must land
+    catchup = Orswot()
+    for counter in (1, 2, 3):
+        _add(catchup, "A", actor=0, counter=counter, seen=_clock((0, counter - 1)))
+    late = Orswot()
+    for counter in range(1, 8):
+        _add(late, "A", actor=5, counter=counter, seen=_clock((5, counter - 1)))
+    joined = _assert_convergent([a, b, catchup, late])
+    assert joined.value().val == set(), "deferred remove failed to fire"
+
+
+def test_deferred_partial_dots_not_descendence():
+    """log:426-428 — deferred clocks that are CONCURRENT with the merged
+    clock (partially unseen dots) must survive the merge; testing for full
+    descendence instead silently drops them."""
+    holder, other = Orswot(), Orswot()
+    rm = holder.remove(1, RmCtx(clock=_clock((0, 3), (1, 5), (2, 4))))
+    holder.apply(rm)
+    _add(other, 1, actor=5, counter=1)
+    merged = other.clone()
+    merged.merge(holder)
+    # merged clock {5:1} is concurrent with the rm clock — not dominated,
+    # not dominating — so the row must still be buffered
+    assert merged.deferred, "concurrent deferred clock dropped"
+    joined = _assert_convergent([holder, other])
+    assert joined.value().val == {1}, "member with unseen dots must survive"
+
+
+def test_add_does_not_blindly_overwrite_causality():
+    """log:491-492 — adds for the same element on one replica must extend
+    the member's dot clock (witness), never overwrite it."""
+    a = Orswot()
+    _add(a, 2, actor=0, counter=1)
+    _add(a, 2, actor=7, counter=1, seen=_clock((7, 0)))
+    assert a.entries[2] == _clock((0, 1), (7, 1)), "second add lost the first dot"
+    # a remove that only saw the first dot must not kill the member
+    b = Orswot()
+    rm = b.remove(2, RmCtx(clock=_clock((0, 1))))
+    b.apply(rm)
+    joined = _assert_convergent([a, b])
+    assert joined.value().val == {2}
+
+
+def test_catalogue_cases_converge_pairwise_with_batch():
+    """Cross-check: every pair of fixture states converges identically on
+    scalar and batch paths (a mini interleaving sweep over the catalogue)."""
+    states = []
+    s1 = Orswot(); _add(s1, "x", 0, 1); states.append(s1)
+    s2 = Orswot(); s2.apply(s2.remove("x", RmCtx(clock=_clock((0, 2))))); states.append(s2)
+    s3 = Orswot(); _add(s3, "y", 1, 1); _add(s3, "x", 2, 1); states.append(s3)
+    s4 = Orswot(); states.append(s4)
+    uni = _universe()
+    for i in range(len(states)):
+        for j in range(len(states)):
+            sc = states[i].clone(); sc.merge(states[j]); sc.merge(Orswot())
+            got = _batch_join([states[i].clone(), states[j].clone()], uni)
+            assert got == sc, (i, j)

@@ -1,0 +1,44 @@
+"""Display parity (`vclock.rs:73-84`, `mvreg.rs:61-72`) + the pprint example
+(`examples/pprint.rs:1-21`)."""
+
+import pathlib
+import subprocess
+import sys
+
+from crdt_tpu import MVReg, VClock
+
+
+def test_vclock_display_sorted_by_actor():
+    c = VClock()
+    c.witness(31231, 2)
+    c.witness(4829, 9)
+    c.witness(87132, 32)
+    # BTreeMap order: numerically sorted actors
+    assert str(c) == "(4829->9, 31231->2, 87132->32)"
+
+
+def test_vclock_display_empty():
+    assert str(VClock()) == "()"
+
+
+def test_mvreg_display_concurrent_vals():
+    reg = MVReg()
+    op1 = reg.set("some val", reg.read().derive_add_ctx(9742820))
+    op2 = reg.set("some other val", reg.read().derive_add_ctx(648572))
+    reg.apply(op1)
+    reg.apply(op2)
+    assert str(reg) == "|some val@(9742820->1), some other val@(648572->1)|"
+
+
+def test_pprint_example_runs():
+    root = pathlib.Path(__file__).resolve().parents[1]
+    out = subprocess.run(
+        [sys.executable, str(root / "examples" / "pprint.py")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "vclock:\t(4829->9, 31231->2, 87132->32)" in out.stdout
+    assert "reg:\t|some val@" in out.stdout
+    assert "orswot[0]:\t{apple, pear}" in out.stdout

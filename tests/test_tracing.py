@@ -1,0 +1,88 @@
+"""Tracing subsystem (SURVEY.md §5): spans, kernel timing, profiler capture."""
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.utils import tracing
+
+
+def test_span_aggregation():
+    tr = tracing.Tracer()
+    for _ in range(3):
+        with tr.span("work"):
+            pass
+    with tr.span("other"):
+        pass
+    assert tr.stats["work"].count == 3
+    assert tr.stats["other"].count == 1
+    assert tr.stats["work"].total_s >= tr.stats["work"].max_s
+    rep = tr.report()
+    assert "work" in rep and "other" in rep
+
+
+def test_disabled_tracer_records_nothing():
+    tr = tracing.Tracer(enabled=False)
+    with tr.span("work"):
+        pass
+    assert tr.stats == {}
+
+
+def test_span_records_on_exception():
+    tr = tracing.Tracer()
+    try:
+        with tr.span("boom"):
+            raise ValueError("x")
+    except ValueError:
+        pass
+    assert tr.stats["boom"].count == 1
+
+
+def test_timed_kernel_blocks_and_records():
+    tracing.reset()
+    tracing.enable(True)
+    try:
+        @tracing.timed_kernel("add1")
+        def add1(x):
+            return x + 1
+
+        out = add1(jnp.zeros((8,)))
+        assert out[0] == 1
+        assert tracing.get_tracer().stats["add1"].count == 1
+    finally:
+        tracing.enable(False)
+        tracing.reset()
+
+
+def test_timed_kernel_zero_cost_when_disabled():
+    tracing.enable(False)
+    tracing.reset()
+
+    @tracing.timed_kernel("noop")
+    def f(x):
+        return x
+
+    f(jnp.zeros((2,)))
+    assert tracing.get_tracer().stats == {}
+
+
+def test_profile_context_tolerates_unsupported_backend(tmp_path):
+    from crdt_tpu.ops import clock_ops
+
+    with tracing.profile(str(tmp_path / "trace")):
+        out = jax.jit(clock_ops.merge)(jnp.zeros((4, 4), jnp.uint32),
+                                       jnp.ones((4, 4), jnp.uint32))
+        jax.block_until_ready(out)
+
+
+def test_profile_propagates_caller_exceptions(tmp_path):
+    try:
+        with tracing.profile(str(tmp_path / "trace2")):
+            raise RuntimeError("inner")
+    except RuntimeError as e:
+        assert str(e) == "inner"
+    else:
+        raise AssertionError("exception swallowed")
+
+
+def test_empty_report():
+    assert "no spans" in tracing.Tracer().report()

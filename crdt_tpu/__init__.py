@@ -21,7 +21,13 @@ Public API mirrors the reference re-exports (`lib.rs:6-15`).
 # NOTE: importing the package must NOT import JAX or flip global JAX flags —
 # the scalar engine is pure Python.  The batch/ops/parallel modules call
 # config.enable_x64() themselves when first imported.
-from .error import ConflictingMarker, CrdtError, MergeConflict, NestedOpFailed
+from .error import (
+    CapacityOverflowError,
+    ConflictingMarker,
+    CrdtError,
+    MergeConflict,
+    NestedOpFailed,
+)
 from .traits import Causal, CmRDT, CvRDT, FunkyCmRDT, FunkyCvRDT
 from .scalar import (
     Actor,
@@ -48,6 +54,7 @@ __all__ = [
     "AddCtx",
     "Causal",
     "CmRDT",
+    "CapacityOverflowError",
     "ConflictingMarker",
     "CrdtConfig",
     "CrdtError",

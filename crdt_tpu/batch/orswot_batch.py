@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..config import counter_dtype
+from ..error import CapacityOverflowError
 from ..ops import orswot_ops
 from ..scalar.orswot import Orswot
 from ..scalar.vclock import VClock
@@ -115,6 +116,39 @@ class OrswotBatch:
             out.append(s)
         return out
 
+    @property
+    def member_capacity(self) -> int:
+        return self.ids.shape[-1]
+
+    @property
+    def deferred_capacity(self) -> int:
+        return self.d_ids.shape[-1]
+
+    def with_capacity(
+        self, member_capacity: int | None = None, deferred_capacity: int | None = None
+    ) -> "OrswotBatch":
+        """Regrow the padded slot axes (elastic recovery from overflow).
+
+        Capacities are this framework's static-shape concession (SURVEY.md
+        §7.3); growing them pads with empty slots, which is semantically a
+        no-op — empty slots are 'absent' (`orswot.rs` stores no entry at
+        all), so the regrown batch is the same CRDT state."""
+        m_new = self.member_capacity if member_capacity is None else member_capacity
+        d_new = self.deferred_capacity if deferred_capacity is None else deferred_capacity
+        if m_new < self.member_capacity or d_new < self.deferred_capacity:
+            raise ValueError("with_capacity cannot shrink (would drop live slots)")
+        pad_m = m_new - self.member_capacity
+        pad_d = d_new - self.deferred_capacity
+        if pad_m == 0 and pad_d == 0:
+            return self
+        return OrswotBatch(
+            clock=self.clock,
+            ids=jnp.pad(self.ids, ((0, 0), (0, pad_m)), constant_values=orswot_ops.EMPTY),
+            dots=jnp.pad(self.dots, ((0, 0), (0, pad_m), (0, 0))),
+            d_ids=jnp.pad(self.d_ids, ((0, 0), (0, pad_d)), constant_values=orswot_ops.EMPTY),
+            d_clocks=jnp.pad(self.d_clocks, ((0, 0), (0, pad_d), (0, 0))),
+        )
+
     # -- state path -------------------------------------------------------
 
     def merge(self, other: "OrswotBatch", check: bool = True) -> "OrswotBatch":
@@ -126,10 +160,23 @@ class OrswotBatch:
             other.clock, other.ids, other.dots, other.d_ids, other.d_clocks,
             m_cap, d_cap,
         )
-        if check and bool(jnp.any(overflow)):
-            raise ValueError(
-                "Orswot capacity overflow in merge: raise member_capacity/deferred_capacity"
-            )
+        if check:
+            m_over = bool(jnp.any(overflow[..., 0]))
+            d_over = bool(jnp.any(overflow[..., 1]))
+            if m_over or d_over:
+                raise CapacityOverflowError(
+                    "Orswot capacity overflow in merge: raise "
+                    + "/".join(
+                        axis
+                        for axis, hit in (
+                            ("member_capacity", m_over),
+                            ("deferred_capacity", d_over),
+                        )
+                        if hit
+                    ),
+                    member=m_over,
+                    deferred=d_over,
+                )
         return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
     # -- op path ----------------------------------------------------------
@@ -141,7 +188,11 @@ class OrswotBatch:
             jnp.asarray(actor_idx), jnp.asarray(counter), jnp.asarray(member_id),
         )
         if check and bool(jnp.any(overflow)):
-            raise ValueError("Orswot member_capacity overflow in apply_add")
+            raise CapacityOverflowError(
+                "Orswot member_capacity overflow in apply_add",
+                member=True,
+                deferred=False,
+            )
         return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
     def apply_remove(self, rm_clock, member_id, check: bool = True) -> "OrswotBatch":
@@ -151,7 +202,11 @@ class OrswotBatch:
             jnp.asarray(rm_clock), jnp.asarray(member_id),
         )
         if check and bool(jnp.any(overflow)):
-            raise ValueError("Orswot deferred_capacity overflow in apply_remove")
+            raise CapacityOverflowError(
+                "Orswot deferred_capacity overflow in apply_remove",
+                member=False,
+                deferred=True,
+            )
         return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
     # -- reads ------------------------------------------------------------

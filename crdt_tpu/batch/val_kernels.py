@@ -139,7 +139,9 @@ class OrswotKernel:
         out = orswot_ops.merge(
             *va, *vb, self.member_capacity, self.deferred_capacity
         )
-        return out[:5], out[5]
+        # protocol: one overflow flag per object (the Map layer has no
+        # per-axis elastic recovery) — collapse the member/deferred pair
+        return out[:5], jnp.any(out[5], axis=-1)
 
     def truncate(self, v, clock):
         """`orswot.rs:159-172`: merge with an empty set carrying ``clock``,

@@ -36,6 +36,22 @@ class MergeConflict(CrdtError):
         return "There was a conflict while merging"
 
 
+class CapacityOverflowError(CrdtError, ValueError):
+    """A batched join outgrew its padded slot capacity.
+
+    No reference counterpart — capacities are the TPU build's static-shape
+    concession (SURVEY.md §7.3).  Carries which axis overflowed so elastic
+    recovery (``crdt_tpu.parallel.JoinExecutor``) grows only that axis.
+    Subclasses ``ValueError`` for backward compatibility with callers that
+    catch the old error type.
+    """
+
+    def __init__(self, message: str, member: bool = True, deferred: bool = True):
+        super().__init__(message)
+        self.member = member
+        self.deferred = deferred
+
+
 class NestedOpFailed(CrdtError):
     """We failed to apply a nested op to a nested CRDT (`error.rs:16-17`)."""
 

@@ -347,7 +347,10 @@ void orswot_merge_impl(
         ++wq;
       }
     }
-    overflow[r] = (live > m_cap) || (live_q > d_cap);
+    // two flags per object — member / deferred axis, matching the jnp
+    // kernel's bool[..., 2] so elastic recovery grows only the hit axis
+    overflow[r * 2] = live > m_cap;
+    overflow[r * 2 + 1] = live_q > d_cap;
   }
 }
 
@@ -487,6 +490,6 @@ extern "C" {
 DEFINE_ALL(u32, uint32_t)
 DEFINE_ALL(u64, uint64_t)
 
-int crdt_core_abi_version() { return 1; }
+int crdt_core_abi_version() { return 2; }
 
 }  // extern "C"

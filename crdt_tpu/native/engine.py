@@ -191,7 +191,9 @@ def orswot_merge(
     :func:`crdt_tpu.ops.orswot_ops.merge` including output slot order
     (members ascending by id, deferred rows in self-then-other order).
 
-    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)``."""
+    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)`` with
+    ``overflow`` = ``bool[..., 2]`` (member / deferred axis flags, matching
+    the jnp kernel)."""
     A = _orswot_state(clock_a, ids_a, dots_a, dids_a, dclocks_a)
     B = _orswot_state(clock_b, ids_b, dots_b, dids_b, dclocks_b)
     dt = _check_counters(A[0], B[0])
@@ -212,7 +214,7 @@ def orswot_merge(
     dots = np.empty((*lead, m_cap, a), dtype=dt)
     d_ids = np.empty((*lead, d_cap), dtype=np.int32)
     d_clocks = np.empty((*lead, d_cap, a), dtype=dt)
-    overflow = np.empty(n, dtype=np.uint8)
+    overflow = np.empty(n * 2, dtype=np.uint8)
     _fn("orswot_merge", dt)(
         _ptr(A[0]), _ptr(A[1]), _ptr(A[2]), _ptr(A[3]), _ptr(A[4]),
         _ptr(B[0]), _ptr(B[1]), _ptr(B[2]), _ptr(B[3]), _ptr(B[4]),
@@ -221,7 +223,10 @@ def orswot_merge(
         _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
         _ptr(overflow),
     )
-    return clock, ids, dots, d_ids, d_clocks, overflow.astype(bool).reshape(lead)
+    return (
+        clock, ids, dots, d_ids, d_clocks,
+        overflow.astype(bool).reshape(*lead, 2),
+    )
 
 
 def orswot_apply_add(clock, ids, dots, dids, dclocks, actor_idx, counter, member_id):

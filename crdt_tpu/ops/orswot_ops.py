@@ -160,9 +160,12 @@ def merge(
 ):
     """Full pairwise ORSWOT merge (`orswot.rs:89-156`).
 
-    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)``; overflow is a
-    per-object flag set when survivors exceed ``m_cap`` or deferred rows
-    exceed ``d_cap`` (host raises — capacity is the static-shape concession).
+    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)``; overflow is
+    ``bool[..., 2]`` — ``[..., 0]`` set where survivors exceed ``m_cap``,
+    ``[..., 1]`` where deferred rows exceed ``d_cap`` (host raises a
+    :class:`~crdt_tpu.error.CapacityOverflowError` naming the axis —
+    capacity is the static-shape concession, and elastic recovery grows
+    only the overflowed axis).
     """
     ids, e1, e2, valid = _align(ids_a, dots_a, ids_b, dots_b)
     p1 = ~clock_ops.is_empty(e1) & valid
@@ -183,7 +186,7 @@ def merge(
 
     ids, out_dots, m_over = compact(ids, out_dots, m_cap)
     d_ids, d_clocks, d_over = compact(d_ids, d_clocks, d_cap)
-    return clock, ids, out_dots, d_ids, d_clocks, m_over | d_over
+    return clock, ids, out_dots, d_ids, d_clocks, jnp.stack([m_over, d_over], axis=-1)
 
 
 def apply_add(clock, ids, dots, dids, dclocks, actor_idx, counter, member_id):

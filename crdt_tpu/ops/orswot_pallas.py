@@ -193,7 +193,9 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
     dids_out, dclk_out, d_over = _rank_select(
         slot_keys, still_ahead, d_ids, d_clocks, d_cap
     )
-    return (clock, ids_out, dots_out, dids_out, dclk_out), m_over | d_over
+    return (clock, ids_out, dots_out, dids_out, dclk_out), jnp.stack(
+        [m_over, d_over], axis=-1
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -278,7 +280,7 @@ def merge(
         )
         for ref, val in zip((oc, oi, od, odi, odc), out):
             ref[...] = val
-        oover[...] = over[..., None].astype(jnp.int32)
+        oover[...] = over.astype(jnp.int32)
 
     in_shapes = [x.shape for x in sa] * 2
     out_shape = (
@@ -287,7 +289,7 @@ def merge(
         jax.ShapeDtypeStruct((n_pad, m_cap, a), cdt),
         jax.ShapeDtypeStruct((n_pad, d_cap), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, d_cap, a), cdt),
-        jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
     out = pl.pallas_call(
         kernel,
@@ -298,7 +300,7 @@ def merge(
         interpret=interpret,
     )(*sa, *sb)
     clock, ids, dots, dids, dclk, over = (x[:n] for x in out)
-    return clock, ids, dots, dids, dclk, over[:, 0].astype(bool)
+    return clock, ids, dots, dids, dclk, over.astype(bool)
 
 
 @functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret", "plunger"))
@@ -331,7 +333,7 @@ def fold_merge(
     def kernel(ca, ia, da, dia, dca, oc, oi, od, odi, odc, oover):
         refs = (ca, ia, da, dia, dca)
         acc = tuple(ref[0] for ref in refs)
-        over_any = jnp.zeros((acc[0].shape[0],), dtype=bool)
+        over_any = jnp.zeros((acc[0].shape[0], 2), dtype=bool)
         for rr in range(1, r):
             acc, over = _merge_tile(acc, tuple(ref[rr] for ref in refs), m_cap, d_cap)
             over_any = over_any | over
@@ -340,7 +342,7 @@ def fold_merge(
             over_any = over_any | over
         for ref, val in zip((oc, oi, od, odi, odc), acc):
             ref[...] = val
-        oover[...] = over_any[..., None].astype(jnp.int32)
+        oover[...] = over_any.astype(jnp.int32)
 
     in_specs = []
     for x in state:
@@ -354,7 +356,7 @@ def fold_merge(
         jax.ShapeDtypeStruct((n_pad, m_cap, a), cdt),
         jax.ShapeDtypeStruct((n_pad, d_cap), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, d_cap, a), cdt),
-        jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
     out = pl.pallas_call(
         kernel,
@@ -365,4 +367,4 @@ def fold_merge(
         interpret=interpret,
     )(*state)
     c, i, dts, di, dc, over = (x[:n] for x in out)
-    return c, i, dts, di, dc, over[:, 0].astype(bool)
+    return c, i, dts, di, dc, over.astype(bool)

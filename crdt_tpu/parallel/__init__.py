@@ -14,6 +14,7 @@ from ..config import enable_x64 as _enable_x64
 _enable_x64()
 
 from .mesh import make_mesh, replicate, shard_batch
+from .executor import JoinError, JoinExecutor, JoinStats, join_all
 from .collective import (
     all_reduce_clock_join,
     allgather_join_orswot,
@@ -29,6 +30,10 @@ __all__ = [
     "gather_fold_orswot",
     "anti_entropy",
     "fold_reduce_merge",
+    "join_all",
+    "JoinError",
+    "JoinExecutor",
+    "JoinStats",
     "make_mesh",
     "replicate",
     "shard_batch",

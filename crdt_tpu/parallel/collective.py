@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from ..error import CapacityOverflowError
 from ..ops import orswot_ops
 
 
@@ -131,7 +132,8 @@ def _fold_orswot_stack(stack5, m_cap: int, d_cap: int):
     here."""
     r = stack5[0].shape[0]
     acc = tuple(x[0] for x in stack5)
-    overflow = jnp.zeros(stack5[0].shape[1:2], dtype=bool)
+    # [..., 2]: member / deferred overflow flags (orswot_ops.merge)
+    overflow = jnp.zeros(stack5[0].shape[1:2] + (2,), dtype=bool)
     for i in range(1, r):
         acc, over = _orswot_pair_merge(acc, tuple(x[i] for x in stack5), m_cap, d_cap)
         overflow |= over
@@ -177,7 +179,7 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
         )
     arrays = (batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks)
     specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
-    over_spec = P(axis)
+    over_spec = P(axis, None)
 
     @functools.partial(
         shard_map,
@@ -190,14 +192,18 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
         acc, overflow = gather_fold_orswot(
             tuple(x[0] for x in local), axis, m_cap, d_cap
         )
-        return tuple(x[None] for x in acc), jnp.any(overflow)[None]
+        return tuple(x[None] for x in acc), jnp.any(overflow, axis=0)[None]
 
     (clock, ids, dots, d_ids, d_clocks), overflow = jax.jit(_join)(arrays)
-    if check and bool(jnp.any(overflow)):
-        raise ValueError(
-            "Orswot capacity overflow in collective join: raise "
-            "member_capacity/deferred_capacity"
-        )
+    if check:
+        m_over, d_over = (bool(x) for x in jnp.any(overflow, axis=tuple(range(overflow.ndim - 1))))
+        if m_over or d_over:
+            raise CapacityOverflowError(
+                "Orswot capacity overflow in collective join: raise "
+                "member_capacity/deferred_capacity",
+                member=m_over,
+                deferred=d_over,
+            )
     return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
 
@@ -214,7 +220,7 @@ def _anti_entropy_kernels(m_cap: int, d_cap: int):
     @jax.jit
     def _fold(arrays):
         acc, overflow = _fold_orswot_stack(arrays, m_cap, d_cap)
-        return acc, jnp.any(overflow)
+        return acc, jnp.any(overflow, axis=0)
 
     @jax.jit
     def _plunge(acc):
@@ -222,7 +228,7 @@ def _anti_entropy_kernels(m_cap: int, d_cap: int):
         same = jnp.array(True)
         for x, y in zip(nxt, acc):
             same &= jnp.array_equal(x, y)
-        return nxt, same, jnp.any(over)
+        return nxt, same, jnp.any(over, axis=0)
 
     return _fold, _plunge
 
@@ -250,19 +256,22 @@ def anti_entropy(stack, max_rounds: int = 3, check: bool = True):
 
     _fold, _plunge = _anti_entropy_kernels(m_cap, d_cap)
     acc, over_dev = _fold(arrays)
-    overflow = bool(over_dev)
+    m_over, d_over = (bool(x) for x in jax.device_get(over_dev))
     rounds = 1
     for _ in range(max_rounds - 1):
         acc, same_dev, over_dev = _plunge(acc)
         rounds += 1
         same, over = jax.device_get((same_dev, over_dev))
-        overflow |= bool(over)
+        m_over |= bool(over[0])
+        d_over |= bool(over[1])
         if same:
             break
-    if check and overflow:
-        raise ValueError(
+    if check and (m_over or d_over):
+        raise CapacityOverflowError(
             "Orswot capacity overflow in anti-entropy: raise "
-            "member_capacity/deferred_capacity"
+            "member_capacity/deferred_capacity",
+            member=m_over,
+            deferred=d_over,
         )
     merged = OrswotBatch(
         clock=acc[0], ids=acc[1], dots=acc[2], d_ids=acc[3], d_clocks=acc[4]

@@ -1,0 +1,156 @@
+"""Host-level join executor — elastic recovery for device batches.
+
+SURVEY.md §5: the reference's fault-tolerance story is purely algebraic —
+idempotent merge makes redelivery safe (`/root/reference/src/traits.rs:36`),
+deferred removes buffer causally-future ops (`orswot.rs:195-203`) — and the
+TPU build adds "a host-level retry/requeue for failed device batches" on
+top.  This module is that component.
+
+On TPU the two batch failure modes are:
+
+* **capacity overflow** — the static-shape concession (SURVEY.md §7.3):
+  a join's survivor set outgrows the padded member/deferred slot axes.
+  The kernels report this as a per-object overflow bitmap; recovery is to
+  regrow the slot axes (``with_capacity``) and re-run the join.  Because
+  merge is idempotent and the regrown batch is the same CRDT state, the
+  retry is always algebraically safe.
+* **transient device failure** — a dispatch raising ``RuntimeError``
+  (device OOM, a remote-TPU tunnel dropping, preemption).  Recovery is to
+  requeue the same join up to ``max_retries`` times.
+
+The executor left-folds a queue of batches into one joined state with both
+recoveries applied per step, finishing with a defer-plunger self-merge
+(`/root/reference/test/orswot.rs:61-62`) so buffered removes flush.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+from ..error import CapacityOverflowError
+from ..utils import tracing
+
+
+@dataclasses.dataclass
+class JoinStats:
+    """What happened during a ``join_all`` run."""
+
+    joins: int = 0
+    overflow_regrows: int = 0
+    transient_retries: int = 0
+    final_member_capacity: Optional[int] = None
+    final_deferred_capacity: Optional[int] = None
+
+
+class JoinError(RuntimeError):
+    """A join could not be completed within the executor's limits."""
+
+
+@dataclasses.dataclass
+class JoinExecutor:
+    """Left-fold join driver with overflow regrowth and transient retry.
+
+    Works with any batch type exposing ``merge(other, check=True)`` that
+    raises :class:`~crdt_tpu.error.CapacityOverflowError` on capacity
+    overflow; elastic regrowth additionally needs ``with_capacity``/
+    ``member_capacity``/``deferred_capacity`` (``OrswotBatch`` has all
+    three; types without capacities — counters, registers — simply never
+    overflow).  Only the axis the error names is regrown.
+
+    ``max_capacity`` bounds geometric regrowth (×2 per overflow);
+    ``max_retries`` bounds requeues of a join whose dispatch raised
+    ``RuntimeError``.
+    """
+
+    max_capacity: int = 1 << 16
+    max_retries: int = 2
+    grow_factor: int = 2
+
+    def join_all(
+        self,
+        batches: Sequence[Any],
+        plunger: bool = True,
+        stats: Optional[JoinStats] = None,
+    ) -> Any:
+        """Fold ``batches`` into one joined batch (anti-entropy)."""
+        if not batches:
+            raise ValueError("join_all needs at least one batch")
+        stats = stats if stats is not None else JoinStats()
+        acc = batches[0]
+        with tracing.span("executor.join_all"):
+            for nxt in batches[1:]:
+                acc, nxt = self._equalize(acc, nxt)
+                acc = self._merge_recovering(acc, nxt, stats)
+            if plunger:
+                acc = self._merge_recovering(acc, acc, stats)
+        stats.final_member_capacity = getattr(acc, "member_capacity", None)
+        stats.final_deferred_capacity = getattr(acc, "deferred_capacity", None)
+        return acc
+
+    # -- internals ---------------------------------------------------------
+
+    def _equalize(self, a: Any, b: Any):
+        """Bring two batches to a common capacity before merging."""
+        if not hasattr(a, "with_capacity") or not hasattr(b, "with_capacity"):
+            return a, b
+        m = max(a.member_capacity, b.member_capacity)
+        d = max(a.deferred_capacity, b.deferred_capacity)
+        if (a.member_capacity, a.deferred_capacity) == (m, d) == (
+            b.member_capacity,
+            b.deferred_capacity,
+        ):
+            return a, b
+        return a.with_capacity(m, d), b.with_capacity(m, d)
+
+    def _merge_recovering(self, acc: Any, nxt: Any, stats: JoinStats) -> Any:
+        retries = 0
+        while True:
+            try:
+                with tracing.span("executor.merge"):
+                    out = acc.merge(nxt, check=True)
+                stats.joins += 1
+                return out
+            except CapacityOverflowError as overflow:
+                # capacity overflow: regrow the overflowed axes and requeue
+                if not hasattr(acc, "with_capacity"):
+                    raise
+                m = getattr(acc, "member_capacity", 0)
+                d = getattr(acc, "deferred_capacity", 0)
+
+                def _grown(cur, hit):
+                    if not hit:
+                        return cur
+                    # never shrink: a batch may already exceed max_capacity
+                    return max(cur, min(max(1, cur) * self.grow_factor, self.max_capacity))
+
+                new_m = _grown(m, overflow.member)
+                new_d = _grown(d, overflow.deferred)
+                if new_m == m and new_d == d:
+                    raise JoinError(
+                        f"join overflowed at max_capacity={self.max_capacity} "
+                        f"(member_capacity={m}, deferred_capacity={d})"
+                    ) from overflow
+                stats.overflow_regrows += 1
+                with tracing.span("executor.regrow"):
+                    acc = acc.with_capacity(new_m, new_d)
+                    nxt = nxt.with_capacity(new_m, new_d)
+            except RuntimeError as transient:
+                if isinstance(transient, JoinError):
+                    raise
+                retries += 1
+                if retries > self.max_retries:
+                    raise JoinError(
+                        f"join failed after {self.max_retries} retries"
+                    ) from transient
+                stats.transient_retries += 1
+
+
+def join_all(batches: Sequence[Any], **kwargs: Any) -> Any:
+    """One-shot convenience: ``JoinExecutor().join_all(batches)``."""
+    executor_kwargs = {
+        k: kwargs.pop(k)
+        for k in ("max_capacity", "max_retries", "grow_factor")
+        if k in kwargs
+    }
+    return JoinExecutor(**executor_kwargs).join_all(batches, **kwargs)

@@ -1,0 +1,190 @@
+"""Host-level join executor: elastic capacity recovery + transient retry
+(SURVEY.md §5 'failure detection / elastic recovery')."""
+
+import numpy as np
+import pytest
+
+from crdt_tpu import Orswot
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.parallel import JoinError, JoinExecutor, JoinStats, join_all
+from crdt_tpu.utils.interning import Universe
+
+
+def _universe(m=2, d=2, a=8):
+    return Universe(CrdtConfig(num_actors=a, member_capacity=m, deferred_capacity=d))
+
+
+def _fleet(uni, rows):
+    """rows: list of lists of (member, actor) adds — one Orswot per list."""
+    out = []
+    for row in rows:
+        s = Orswot()
+        for member, actor in row:
+            s.apply(s.add(member, s.value().derive_add_ctx(actor)))
+        out.append(s)
+    return out
+
+
+def test_join_all_matches_scalar_fold():
+    uni = _universe(m=8)
+    fleets = [
+        _fleet(uni, [[("a", 0), ("b", 0)]]),
+        _fleet(uni, [[("c", 1)]]),
+        _fleet(uni, [[("a", 2), ("d", 2)]]),
+    ]
+    batches = [OrswotBatch.from_scalar(f, uni) for f in fleets]
+    stats = JoinStats()
+    joined = JoinExecutor().join_all(batches, stats=stats)
+    assert stats.joins == 3  # 2 folds + plunger
+    assert stats.overflow_regrows == 0
+    expected = Orswot()
+    for f in fleets:
+        expected.merge(f[0])
+    expected.merge(Orswot())
+    assert joined.to_scalar(uni)[0] == expected
+
+
+def test_overflow_triggers_regrowth():
+    # capacity 2, but the union of members is 6 → must regrow to succeed
+    uni = _universe(m=2)
+    rows = [
+        [[("a", 0), ("b", 0)]],
+        [[("c", 1), ("d", 1)]],
+        [[("e", 2), ("f", 2)]],
+    ]
+    batches = [OrswotBatch.from_scalar(_fleet(uni, r), uni) for r in rows]
+    stats = JoinStats()
+    joined = JoinExecutor().join_all(batches, stats=stats)
+    assert stats.overflow_regrows >= 1
+    assert stats.final_member_capacity >= 6
+    assert joined.value_sets(uni)[0] == {"a", "b", "c", "d", "e", "f"}
+
+
+def test_only_overflowed_axis_regrows():
+    """A deferred-table overflow must not double the (much larger) member
+    axis — the error names the axis and the executor grows only it."""
+    from crdt_tpu.scalar.ctx import RmCtx
+    from crdt_tpu.scalar.vclock import VClock
+
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=4, deferred_capacity=1))
+
+    def deferred_state(actor, counter, member):
+        s = Orswot()
+        c = VClock()
+        c.witness(actor, counter)
+        s.apply(s.remove(member, RmCtx(clock=c)))
+        assert s.deferred
+        return s
+
+    batches = [
+        OrswotBatch.from_scalar([deferred_state(1, 5, "x")], uni),
+        OrswotBatch.from_scalar([deferred_state(2, 5, "y")], uni),
+    ]
+    stats = JoinStats()
+    joined = JoinExecutor().join_all(batches, stats=stats)
+    assert stats.overflow_regrows >= 1
+    assert stats.final_deferred_capacity > 1
+    assert stats.final_member_capacity == 4, "member axis grew needlessly"
+    assert len([i for i in joined.to_scalar(uni)[0].deferred]) == 2
+
+
+def test_overflow_beyond_max_capacity_raises():
+    uni = _universe(m=2)
+    rows = [
+        [[("a", 0), ("b", 0)]],
+        [[("c", 1), ("d", 1)]],
+        [[("e", 2), ("f", 2)]],
+    ]
+    batches = [OrswotBatch.from_scalar(_fleet(uni, r), uni) for r in rows]
+    with pytest.raises(JoinError, match="max_capacity"):
+        JoinExecutor(max_capacity=4).join_all(batches)
+
+
+def test_transient_failures_requeued():
+    uni = _universe(m=8)
+    batches = [
+        OrswotBatch.from_scalar(_fleet(uni, [[("a", 0)]]), uni),
+        OrswotBatch.from_scalar(_fleet(uni, [[("b", 1)]]), uni),
+    ]
+
+    class Flaky:
+        """Duck-typed batch whose merge fails transiently twice."""
+
+        def __init__(self, inner, failures):
+            self.inner = inner
+            self.failures = failures
+
+        member_capacity = property(lambda self: self.inner.member_capacity)
+        deferred_capacity = property(lambda self: self.inner.deferred_capacity)
+
+        def with_capacity(self, m, d):
+            return Flaky(self.inner.with_capacity(m, d), self.failures)
+
+        def merge(self, other, check=True):
+            if self.failures:
+                self.failures.pop()
+                raise RuntimeError("simulated device preemption")
+            inner = other.inner if isinstance(other, Flaky) else other
+            return Flaky(self.inner.merge(inner, check=check), self.failures)
+
+    stats = JoinStats()
+    joined = JoinExecutor(max_retries=2).join_all(
+        [Flaky(batches[0], ["x", "y"]), Flaky(batches[1], [])], stats=stats
+    )
+    assert stats.transient_retries == 2
+    assert joined.inner.value_sets(uni)[0] == {"a", "b"}
+
+
+def test_transient_failures_exhaust_retries():
+    uni = _universe(m=8)
+    b = OrswotBatch.from_scalar(_fleet(uni, [[("a", 0)]]), uni)
+
+    class AlwaysDown:
+        member_capacity = 8
+        deferred_capacity = 2
+
+        def with_capacity(self, m, d):
+            return self
+
+        def merge(self, other, check=True):
+            raise RuntimeError("device gone")
+
+    with pytest.raises(JoinError, match="retries"):
+        JoinExecutor(max_retries=1).join_all([AlwaysDown(), b])
+
+
+def test_mismatched_capacities_equalized():
+    uni = _universe(m=4)
+    b_small = OrswotBatch.from_scalar(_fleet(uni, [[("a", 0)]]), uni)
+    b_big = OrswotBatch.from_scalar(
+        _fleet(uni, [[("b", 1), ("c", 1), ("d", 1)]]), uni
+    ).with_capacity(8, 4)
+    joined = join_all([b_small, b_big])
+    assert joined.member_capacity == 8  # equalized up, not down
+    assert joined.value_sets(uni)[0] == {"a", "b", "c", "d"}
+
+
+def test_with_capacity_cannot_shrink():
+    uni = _universe(m=4)
+    b = OrswotBatch.from_scalar(_fleet(uni, [[("a", 0)]]), uni)
+    with pytest.raises(ValueError, match="shrink"):
+        b.with_capacity(2, 2)
+
+
+def test_non_overflow_value_errors_propagate():
+    uni = _universe(m=8)
+    b = OrswotBatch.from_scalar(_fleet(uni, [[("a", 0)]]), uni)
+
+    class Broken:
+        member_capacity = 8
+        deferred_capacity = 2
+
+        def with_capacity(self, m, d):
+            return self
+
+        def merge(self, other, check=True):
+            raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError, match="shape mismatch"):
+        JoinExecutor().join_all([Broken(), b])

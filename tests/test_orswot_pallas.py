@@ -62,7 +62,7 @@ def test_fold_merge_matches_sequential_fold():
     ]
     stacked = tuple(jnp.stack([rep[i] for rep in reps]) for i in range(5))
     acc = tuple(x[0] for x in stacked)
-    over = jnp.zeros((n,), bool)
+    over = jnp.zeros((n, 2), bool)
     for i in range(1, r):
         out = orswot_ops.merge(*acc, *(x[i] for x in stacked), m, d)
         acc, over = out[:5], over | out[5]

@@ -221,12 +221,43 @@ def parity_anchor():
     log("config1 parity anchor: scalar == batch (GCounter value, Orswot value sets)")
 
 
+def _probe_backend(timeout_s: float) -> bool:
+    """True when the default JAX backend initializes in a fresh process.
+
+    Remote-TPU tunnels can wedge so hard that ``jax.devices()`` blocks
+    forever; probing in a killable subprocess lets the harness fall back
+    to CPU instead of hanging the whole benchmark run."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    plat = os.environ.get("CRDT_BENCH_PLATFORM")
+    fallback = False
+    probe_timeout = float(os.environ.get("CRDT_BENCH_PROBE_TIMEOUT", "300"))
+    if not plat and not _probe_backend(probe_timeout):
+        log(
+            f"WARNING: default backend unreachable within {probe_timeout:.0f}s "
+            "(wedged tunnel?) — falling back to cpu; numbers are NOT accelerator "
+            "numbers (platform recorded in the JSON line)"
+        )
+        plat = "cpu"
+        fallback = True
+
     import jax
 
     # local smoke runs force a platform (the ambient axon plugin overrides
     # the JAX_PLATFORMS env var, so use the config knob directly)
-    plat = os.environ.get("CRDT_BENCH_PLATFORM")
     if plat:
         jax.config.update("jax_platforms", plat)
 
@@ -243,6 +274,8 @@ def main():
                 "value": round(rate, 1),
                 "unit": "merges/s",
                 "vs_baseline": round(rate / 1e7, 4),
+                "platform": jax.default_backend(),
+                "backend_fallback": fallback,
             }
         )
     )

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..config import counter_dtype
-from ..error import CapacityOverflowError
+from ..error import CapacityOverflowError, raise_for_overflow
 from ..ops import orswot_ops
 from ..scalar.orswot import Orswot
 from ..scalar.vclock import VClock
@@ -141,12 +141,20 @@ class OrswotBatch:
         pad_d = d_new - self.deferred_capacity
         if pad_m == 0 and pad_d == 0:
             return self
+
+        def pad_slots(x, pad, tail_axes, fill=0):
+            # slot axis is ndim-1-tail_axes; arbitrary leading batch axes
+            # (replica-stacked batches are rank 3+, tests/test_sharding.py)
+            widths = [(0, 0)] * x.ndim
+            widths[x.ndim - 1 - tail_axes] = (0, pad)
+            return jnp.pad(x, widths, constant_values=fill)
+
         return OrswotBatch(
             clock=self.clock,
-            ids=jnp.pad(self.ids, ((0, 0), (0, pad_m)), constant_values=orswot_ops.EMPTY),
-            dots=jnp.pad(self.dots, ((0, 0), (0, pad_m), (0, 0))),
-            d_ids=jnp.pad(self.d_ids, ((0, 0), (0, pad_d)), constant_values=orswot_ops.EMPTY),
-            d_clocks=jnp.pad(self.d_clocks, ((0, 0), (0, pad_d), (0, 0))),
+            ids=pad_slots(self.ids, pad_m, 0, orswot_ops.EMPTY),
+            dots=pad_slots(self.dots, pad_m, 1),
+            d_ids=pad_slots(self.d_ids, pad_d, 0, orswot_ops.EMPTY),
+            d_clocks=pad_slots(self.d_clocks, pad_d, 1),
         )
 
     # -- state path -------------------------------------------------------
@@ -161,22 +169,7 @@ class OrswotBatch:
             m_cap, d_cap,
         )
         if check:
-            m_over = bool(jnp.any(overflow[..., 0]))
-            d_over = bool(jnp.any(overflow[..., 1]))
-            if m_over or d_over:
-                raise CapacityOverflowError(
-                    "Orswot capacity overflow in merge: raise "
-                    + "/".join(
-                        axis
-                        for axis, hit in (
-                            ("member_capacity", m_over),
-                            ("deferred_capacity", d_over),
-                        )
-                        if hit
-                    ),
-                    member=m_over,
-                    deferred=d_over,
-                )
+            raise_for_overflow(overflow, "merge")
         return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
     # -- op path ----------------------------------------------------------
@@ -189,7 +182,7 @@ class OrswotBatch:
         )
         if check and bool(jnp.any(overflow)):
             raise CapacityOverflowError(
-                "Orswot member_capacity overflow in apply_add",
+                "Orswot capacity overflow in apply_add: raise member_capacity",
                 member=True,
                 deferred=False,
             )
@@ -203,7 +196,7 @@ class OrswotBatch:
         )
         if check and bool(jnp.any(overflow)):
             raise CapacityOverflowError(
-                "Orswot deferred_capacity overflow in apply_remove",
+                "Orswot capacity overflow in apply_remove: raise deferred_capacity",
                 member=False,
                 deferred=True,
             )

@@ -52,6 +52,28 @@ class CapacityOverflowError(CrdtError, ValueError):
         self.deferred = deferred
 
 
+def raise_for_overflow(overflow, context: str) -> None:
+    """Reduce an ORSWOT overflow bitmap (``bool[..., 2]``, member/deferred
+    flags in the last axis) and raise :class:`CapacityOverflowError` naming
+    the overflowed axes.  One host sync; no-op when nothing overflowed."""
+    import numpy as np
+
+    flags = np.asarray(overflow).reshape(-1, 2).any(axis=0)
+    m_over, d_over = bool(flags[0]), bool(flags[1])
+    if not (m_over or d_over):
+        return
+    axes = "/".join(
+        name
+        for name, hit in (("member_capacity", m_over), ("deferred_capacity", d_over))
+        if hit
+    )
+    raise CapacityOverflowError(
+        f"Orswot capacity overflow in {context}: raise {axes}",
+        member=m_over,
+        deferred=d_over,
+    )
+
+
 class NestedOpFailed(CrdtError):
     """We failed to apply a nested op to a nested CRDT (`error.rs:16-17`)."""
 

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from ..error import CapacityOverflowError
+from ..error import raise_for_overflow
 from ..ops import orswot_ops
 
 
@@ -196,14 +196,7 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
 
     (clock, ids, dots, d_ids, d_clocks), overflow = jax.jit(_join)(arrays)
     if check:
-        m_over, d_over = (bool(x) for x in jnp.any(overflow, axis=tuple(range(overflow.ndim - 1))))
-        if m_over or d_over:
-            raise CapacityOverflowError(
-                "Orswot capacity overflow in collective join: raise "
-                "member_capacity/deferred_capacity",
-                member=m_over,
-                deferred=d_over,
-            )
+        raise_for_overflow(overflow, "collective join")
     return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
 
@@ -254,25 +247,21 @@ def anti_entropy(stack, max_rounds: int = 3, check: bool = True):
     d_cap = stack.d_ids.shape[-1]
     arrays = (stack.clock, stack.ids, stack.dots, stack.d_ids, stack.d_clocks)
 
+    import numpy as np
+
     _fold, _plunge = _anti_entropy_kernels(m_cap, d_cap)
     acc, over_dev = _fold(arrays)
-    m_over, d_over = (bool(x) for x in jax.device_get(over_dev))
+    overflow = np.array(jax.device_get(over_dev), dtype=bool)  # writable copy
     rounds = 1
     for _ in range(max_rounds - 1):
         acc, same_dev, over_dev = _plunge(acc)
         rounds += 1
         same, over = jax.device_get((same_dev, over_dev))
-        m_over |= bool(over[0])
-        d_over |= bool(over[1])
+        overflow |= np.asarray(over, dtype=bool)
         if same:
             break
-    if check and (m_over or d_over):
-        raise CapacityOverflowError(
-            "Orswot capacity overflow in anti-entropy: raise "
-            "member_capacity/deferred_capacity",
-            member=m_over,
-            deferred=d_over,
-        )
+    if check:
+        raise_for_overflow(overflow, "anti-entropy")
     merged = OrswotBatch(
         clock=acc[0], ids=acc[1], dots=acc[2], d_ids=acc[3], d_clocks=acc[4]
     )

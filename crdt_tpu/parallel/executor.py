@@ -26,6 +26,7 @@ recoveries applied per step, finishing with a defer-plunger self-merge
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Optional, Sequence
 
 from ..error import CapacityOverflowError
@@ -66,6 +67,7 @@ class JoinExecutor:
     max_capacity: int = 1 << 16
     max_retries: int = 2
     grow_factor: int = 2
+    retry_backoff_s: float = 0.5  # doubles per retry; 0 disables sleeping
 
     def join_all(
         self,
@@ -136,6 +138,9 @@ class JoinExecutor:
                     acc = acc.with_capacity(new_m, new_d)
                     nxt = nxt.with_capacity(new_m, new_d)
             except RuntimeError as transient:
+                # deliberately broad: XLA surfaces tunnel drops, preemption
+                # AND deterministic failures as RuntimeError subclasses; the
+                # bounded retry budget caps the cost of retrying the latter
                 if isinstance(transient, JoinError):
                     raise
                 retries += 1
@@ -144,6 +149,8 @@ class JoinExecutor:
                         f"join failed after {self.max_retries} retries"
                     ) from transient
                 stats.transient_retries += 1
+                if self.retry_backoff_s > 0:
+                    time.sleep(self.retry_backoff_s * (2 ** (retries - 1)))
 
 
 def join_all(batches: Sequence[Any], **kwargs: Any) -> Any:

@@ -129,7 +129,7 @@ def test_transient_failures_requeued():
             return Flaky(self.inner.merge(inner, check=check), self.failures)
 
     stats = JoinStats()
-    joined = JoinExecutor(max_retries=2).join_all(
+    joined = JoinExecutor(max_retries=2, retry_backoff_s=0).join_all(
         [Flaky(batches[0], ["x", "y"]), Flaky(batches[1], [])], stats=stats
     )
     assert stats.transient_retries == 2
@@ -151,7 +151,7 @@ def test_transient_failures_exhaust_retries():
             raise RuntimeError("device gone")
 
     with pytest.raises(JoinError, match="retries"):
-        JoinExecutor(max_retries=1).join_all([AlwaysDown(), b])
+        JoinExecutor(max_retries=1, retry_backoff_s=0).join_all([AlwaysDown(), b])
 
 
 def test_mismatched_capacities_equalized():
@@ -163,6 +163,22 @@ def test_mismatched_capacities_equalized():
     joined = join_all([b_small, b_big])
     assert joined.member_capacity == 8  # equalized up, not down
     assert joined.value_sets(uni)[0] == {"a", "b", "c", "d"}
+
+
+def test_with_capacity_replica_stacked():
+    """Regrowth must handle arbitrary leading batch axes (replica stacks)."""
+    import jax
+    import jax.numpy as jnp
+
+    uni = _universe(m=2)
+    rows = [OrswotBatch.from_scalar(_fleet(uni, [[("a", 0)]]), uni) for _ in range(3)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *rows)
+    grown = stacked.with_capacity(4, 4)
+    assert grown.ids.shape == (3, 1, 4)
+    assert grown.dots.shape == (3, 1, 4, uni.config.num_actors)
+    assert grown.d_clocks.shape == (3, 1, 4, uni.config.num_actors)
+    # live slots untouched
+    assert jnp.array_equal(grown.ids[..., :2], stacked.ids)
 
 
 def test_with_capacity_cannot_shrink():

@@ -157,7 +157,7 @@ def join_all(batches: Sequence[Any], **kwargs: Any) -> Any:
     """One-shot convenience: ``JoinExecutor().join_all(batches)``."""
     executor_kwargs = {
         k: kwargs.pop(k)
-        for k in ("max_capacity", "max_retries", "grow_factor")
+        for k in ("max_capacity", "max_retries", "grow_factor", "retry_backoff_s")
         if k in kwargs
     }
     return JoinExecutor(**executor_kwargs).join_all(batches, **kwargs)

@@ -1,0 +1,63 @@
+"""The two member-alignment strategies (O(M²) match vs sort+gather) must
+produce identical merges — `compact_by_id` canonicalizes slot order, so the
+dispatch threshold is purely a performance knob, never a semantics one."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from crdt_tpu.ops import orswot_ops
+from crdt_tpu.utils.testdata import random_orswot_arrays
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_match_and_sorted_align_agree(monkeypatch, seed):
+    rng = np.random.RandomState(seed)
+    n, a, m, d = 64, 8, 6, 3
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d, np.uint32))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d, np.uint32))
+
+    monkeypatch.setattr(orswot_ops, "_ALIGN_MATCH_MAX_M", 1 << 30)
+    via_match = orswot_ops.merge(*lhs, *rhs, m, d)
+    monkeypatch.setattr(orswot_ops, "_ALIGN_MATCH_MAX_M", 0)
+    via_sort = orswot_ops.merge(*lhs, *rhs, m, d)
+
+    names = ("clock", "ids", "dots", "d_ids", "d_clocks", "overflow")
+    for name, x, y in zip(names, via_match, via_sort):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=name)
+
+
+def test_large_m_sorted_path_is_pad_invariant():
+    """Above the threshold merge dispatches to the sorted alignment; the
+    result on slot-padded inputs must equal the small-M merge of the same
+    logical states (padding with empty slots is semantically a no-op)."""
+    rng = np.random.RandomState(2)
+    n, a, m_small, d = 8, 4, 6, 2
+    big_m = orswot_ops._ALIGN_MATCH_MAX_M + 8
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m_small, d, np.uint32))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m_small, d, np.uint32))
+
+    def pad(state):
+        clock, ids, dots, d_ids, d_clocks = state
+        extra = big_m - m_small
+        return (
+            clock,
+            jnp.pad(ids, ((0, 0), (0, extra)), constant_values=-1),
+            jnp.pad(dots, ((0, 0), (0, extra), (0, 0))),
+            d_ids,
+            d_clocks,
+        )
+
+    cap = 2 * m_small  # union always fits
+    out_big = orswot_ops.merge(*pad(lhs), *pad(rhs), big_m, d)
+    out_small = orswot_ops.merge(*lhs, *rhs, cap, d)
+    np.testing.assert_array_equal(np.asarray(out_big[0]), np.asarray(out_small[0]))
+    np.testing.assert_array_equal(
+        np.asarray(out_big[1])[..., :cap], np.asarray(out_small[1])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_big[2])[..., :cap, :], np.asarray(out_small[2])
+    )
+    assert not (np.asarray(out_big[1])[..., cap:] != -1).any()
+    assert not np.asarray(out_big[5]).any(), "padded merge must not overflow"

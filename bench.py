@@ -133,9 +133,10 @@ def bench_north_star():
     ]
     stacked = tuple(jnp.stack([rep[i] for rep in replicas]) for i in range(5))
 
-    if os.environ.get("CRDT_PALLAS") == "1":
+    if os.environ.get("CRDT_PALLAS") == "1" and jax.default_backend() == "tpu":
         # fused Pallas fold: accumulator stays in VMEM across all R joins.
-        # Opt-in only — Mosaic does not lower through remote-TPU tunnels
+        # Opt-in only, and only on a real TPU backend — Mosaic cannot lower
+        # on CPU, so the flag degrades to the jnp fold after a CPU fallback
         # (see crdt_tpu/ops/orswot_pallas.py deployment note).
         from crdt_tpu.ops import orswot_pallas
 

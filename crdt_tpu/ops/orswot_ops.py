@@ -16,11 +16,20 @@ dot clocks (the reference never stores an entry with an empty clock —
 including the asymmetry: members only in *self* keep their **full** clock
 when any dot is novel (`orswot.rs:94-103`), members only in *other* keep the
 **subtracted** clock (`orswot.rs:132-138`).  The HashMap alignment of the
-reference becomes an O(M²) masked broadcast match over the two member
-tables — no hashing and no sorting on device (a single argsort remains in
-the canonical ascending-id output compaction); for padded capacities
-M ≤ 64 the quadratic match fuses into a few VPU passes and beats
-sort+gather alignment ~2× at the BASELINE.md shapes.
+reference becomes a boolean O(M²) member-id match (the actor axis never
+enters the quadratic term) for padded capacities M ≤ 64, and sort+gather
+alignment above that.
+
+Narrow-table merges dispatch on ``lax.cond(any deferred row exists)``:
+the deferred-free fast path decides each slot's survival with
+OR-reductions over the actor axis, rank-selects the winning ``m_cap``
+member ids with a counting-rank sort (``_stable_order`` — O(S²) bool
+compares + one scatter, far cheaper than a comparison sort at slot counts
+≤ 128), and computes the dot algebra only for the selected slots; the
+2M-wide merged table of the classic pipeline is never materialized.
+Deferred-bearing batches take the full-width pipeline with dedup + replay.
+See `reports/ORSWOT_PROFILE.md` for the measured effect (5.9× on the
+BASELINE.md config-4 shapes).
 """
 
 from __future__ import annotations
@@ -33,61 +42,19 @@ EMPTY = -1
 _SORT_MAX = jnp.iinfo(jnp.int32).max
 
 
-# above this member capacity the O(M²·A) broadcast in the match alignment
-# costs more than sort+gather (and its [..., M, M, A] masked-select
-# intermediate stops fitting on chip — elastic regrowth can push M to 2^16)
+# above this member capacity the O(M²) boolean match matrix costs more
+# than sort+gather alignment (elastic regrowth can push M to 2^16, where
+# the quadratic term would dominate even without the actor axis)
 _ALIGN_MATCH_MAX_M = 64
-
-
-def _align(ids_a, dots_a, ids_b, dots_b):
-    """Member-table alignment; static dispatch on M (shape-level, so each
-    jit specialization compiles exactly one strategy)."""
-    if ids_a.shape[-1] <= _ALIGN_MATCH_MAX_M:
-        return _align_match(ids_a, dots_a, ids_b, dots_b)
-    return _align_sorted(ids_a, dots_a, ids_b, dots_b)
-
-
-def _align_match(ids_a, dots_a, ids_b, dots_b):
-    """Align the two member tables on member id — O(M²) masked match.
-
-    For each a-slot, gather the matching b dot clock (0 if unmatched); each
-    b-slot not consumed by a match survives as a b-only slot.  Returns
-    ``(ids, e1, e2, valid)`` over 2M slots (a's M slots first, then b's,
-    b-matched slots blanked) — the same contract the previous sort-based
-    alignment produced, but without the 2M argsort: the broadcast compare +
-    masked-max reduce fuses into a handful of VPU passes and measures
-    1.6-2.4× faster than sort+gather at the BASELINE.md shapes (M ≤ 32)
-    on both CPU and TPU backends.
-    """
-    valid_a = ids_a != EMPTY
-    valid_b = ids_b != EMPTY
-    # [..., Ma, Mb]: a-slot i matches b-slot j (ids unique within a side)
-    match = valid_a[..., :, None] & (ids_a[..., :, None] == ids_b[..., None, :])
-    e2_for_a = jnp.max(
-        jnp.where(match[..., None], dots_b[..., None, :, :], 0), axis=-2
-    )
-    b_matched = jnp.any(match, axis=-2)
-
-    b_only = valid_b & ~b_matched
-    out_ids = jnp.concatenate(
-        [jnp.where(valid_a, ids_a, EMPTY), jnp.where(b_only, ids_b, EMPTY)], axis=-1
-    )
-    e1 = jnp.concatenate([dots_a, jnp.zeros_like(dots_b)], axis=-2)
-    e2 = jnp.concatenate(
-        [e2_for_a, jnp.where(b_only[..., None], dots_b, 0)], axis=-2
-    )
-    e1 = jnp.where((out_ids != EMPTY)[..., None], e1, 0)
-    valid = out_ids != EMPTY
-    return out_ids, e1, e2, valid
 
 
 def _align_sorted(ids_a, dots_a, ids_b, dots_b):
     """Sort+gather alignment — O(M log M), used above
-    ``_ALIGN_MATCH_MAX_M`` where the quadratic match's ``[..., M, M, A]``
-    intermediate would dominate.  Concatenate both tables, sort by member
-    id, and match adjacent duplicates (runs have length ≤ 2 since ids are
-    unique within each side).  Same output contract as ``_align_match`` up
-    to slot order, which ``compact_by_id`` canonicalizes anyway."""
+    ``_ALIGN_MATCH_MAX_M`` where the quadratic match matrix would
+    dominate.  Concatenate both tables, sort by member id, and match
+    adjacent duplicates (runs have length ≤ 2 since ids are unique within
+    each side).  Returns ``(ids, e1, e2, valid)`` over the 2M slots in
+    sorted order, which ``compact_by_id`` canonicalizes anyway."""
     ids_cat = jnp.concatenate([ids_a, ids_b], axis=-1)  # [..., 2M]
     dots_cat = jnp.concatenate([dots_a, dots_b], axis=-2)  # [..., 2M, A]
     side = jnp.concatenate(
@@ -171,7 +138,11 @@ def _apply_deferred(clock, ids, dots, d_ids, d_clocks):
 
     For each member, subtract the join of all matching deferred clocks
     (sequential subtracts compose into subtract-by-max); drop emptied
-    members; retain only deferred rows still ahead of the set clock."""
+    members; retain only deferred rows still ahead of the set clock.
+
+    The member×deferred cross product makes this the most bandwidth-heavy
+    stage, which is why ``merge`` only enters it when a deferred row
+    exists in the batch at all."""
     d_valid = d_ids != EMPTY
     match = ids[..., :, None] == jnp.where(d_valid, d_ids, EMPTY - 1)[..., None, :]
     # [..., M, A]: per-member join of matching deferred clocks
@@ -190,13 +161,44 @@ def _apply_deferred(clock, ids, dots, d_ids, d_clocks):
     return new_ids, new_dots, out_d_ids, out_d_clocks
 
 
+# counting-rank sort is O(S²) bools per object; above this slot count the
+# quadratic term loses to XLA's comparison sort
+_RANK_SORT_MAX_S = 128
+
+
+def _stable_order(key):
+    """Permutation that stably sorts ``key`` ascending along the last axis.
+
+    For the small static slot counts of the member/deferred tables this is
+    a counting rank (``rank[i]`` = number of slots ordered before slot i,
+    ties broken by slot index) inverted with one scatter — a handful of
+    fused elementwise passes over an ``[..., S, S]`` bool, which beats
+    XLA's generic comparison sort by a wide margin at S ≤ ~128 on both CPU
+    and TPU.  Larger S falls back to ``argsort``."""
+    s = key.shape[-1]
+    if s > _RANK_SORT_MAX_S:
+        return jnp.argsort(key, axis=-1, stable=True)
+    idx = jnp.arange(s, dtype=jnp.int32)
+    ki = key[..., :, None]
+    kj = key[..., None, :]
+    before = (kj < ki) | ((kj == ki) & (idx[None, :] < idx[:, None]))
+    rank = jnp.sum(before, axis=-1).astype(jnp.int32)  # position of slot i
+    return jnp.put_along_axis(
+        jnp.zeros(rank.shape, jnp.int32),
+        rank,
+        jnp.broadcast_to(idx, rank.shape),
+        axis=-1,
+        inplace=False,
+    )
+
+
 def compact(ids, payload, cap):
     """Pack live slots first (original slot order) and truncate to ``cap``.
 
     ``payload`` has one extra trailing axis (the actor axis).  Returns
     ``(ids, payload, overflow)``."""
     live = ids != EMPTY
-    order = jnp.argsort(~live, axis=-1, stable=True)
+    order = _stable_order((~live).astype(jnp.int32))
     ids = jnp.take_along_axis(ids, order, axis=-1)[..., :cap]
     payload = jnp.take_along_axis(payload, order[..., None], axis=-2)[..., :cap, :]
     overflow = jnp.sum(live, axis=-1) > cap
@@ -209,7 +211,7 @@ def compact_by_id(ids, payload, cap):
     `crdt_core.cpp` ORSWOT merge; Pallas restores it by rank selection)."""
     live = ids != EMPTY
     key = jnp.where(live, ids, _SORT_MAX)
-    order = jnp.argsort(key, axis=-1, stable=True)
+    order = _stable_order(key)
     ids = jnp.take_along_axis(ids, order, axis=-1)[..., :cap]
     payload = jnp.take_along_axis(payload, order[..., None], axis=-2)[..., :cap, :]
     overflow = jnp.sum(live, axis=-1) > cap
@@ -229,8 +231,178 @@ def merge(
     :class:`~crdt_tpu.error.CapacityOverflowError` naming the axis —
     capacity is the static-shape concession, and elastic recovery grows
     only the overflowed axis).
+
+    Narrow member tables dispatch on "any deferred row in the batch"
+    (``lax.cond``): the deferred-free fast path — the common case — never
+    materializes the 2M-wide merged table at all.  It decides survival
+    with cheap reductions, rank-selects the ``m_cap`` winning slots, and
+    computes the dot algebra only for those; deferred-bearing batches take
+    the full-width pipeline.
     """
-    ids, e1, e2, valid = _align(ids_a, dots_a, ids_b, dots_b)
+    if ids_a.shape[-1] > _ALIGN_MATCH_MAX_M:
+        return _merge_wide(
+            clock_a, ids_a, dots_a, dids_a, dclocks_a,
+            clock_b, ids_b, dots_b, dids_b, dclocks_b,
+            m_cap, d_cap,
+        )
+    from jax import lax
+
+    clock = clock_ops.merge(clock_a, clock_b)
+    any_deferred = jnp.any(dids_a != EMPTY) | jnp.any(dids_b != EMPTY)
+    operands = (
+        clock, clock_a, ids_a, dots_a, dids_a, dclocks_a,
+        clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    )
+    ids, out_dots, d_ids, d_clocks, over = lax.cond(
+        any_deferred,
+        lambda args: _merge_narrow_deferred(*args, m_cap, d_cap),
+        lambda args: _merge_narrow_fast(*args, m_cap, d_cap),
+        operands,
+    )
+    return clock, ids, out_dots, d_ids, d_clocks, over
+
+
+def _member_match(ids_a, ids_b):
+    """Boolean member alignment: match matrix reductions only (no clock
+    data enters the quadratic term)."""
+    valid_a = ids_a != EMPTY
+    valid_b = ids_b != EMPTY
+    match = valid_a[..., :, None] & (ids_a[..., :, None] == ids_b[..., None, :])
+    a_matched = jnp.any(match, axis=-1)
+    j_idx = jnp.argmax(match, axis=-1).astype(jnp.int32)
+    b_only = valid_b & ~jnp.any(match, axis=-2)
+    return valid_a, a_matched, j_idx, b_only
+
+
+def _merge_narrow_fast(
+    clock, clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int,
+):
+    """Deferred-free merge: survival reduces → rank-select → compute.
+
+    Survival of every slot is decidable from OR-reductions over the actor
+    axis (no merged clock is ever written), so the only ``[..., *, A]``
+    arrays materialized are the gathers feeding the final ``m_cap``-slot
+    algebra.  Bit-exact with the full-width pipeline; the deferred tables
+    are untouched empty tables by construction of the dispatch."""
+    ma = ids_a.shape[-1]
+    valid_a, a_matched, j_idx, b_only = _member_match(ids_a, ids_b)
+    sc = clock_a[..., None, :]
+    oc = clock_b[..., None, :]
+
+    # per-(slot, actor) survival predicates, OR-reduced over actors:
+    # matched  — the dot-algebra output has a non-zero lane
+    #            (`orswot.rs:105-129`)
+    # a-only   — some dot is novel wrt other's set clock (`orswot.rs:94-103`)
+    # b-only   — some dot is novel wrt self's set clock  (`orswot.rs:132-138`)
+    e2 = jnp.take_along_axis(dots_b, j_idx[..., None], axis=-2)
+    same = dots_a == e2
+    both_lane = (same & (dots_a > 0)) | (~same & ((dots_a > oc) | (e2 > sc)))
+    a_novel = jnp.any(dots_a > oc, axis=-1)
+    a_surv = valid_a & jnp.where(a_matched, jnp.any(both_lane, axis=-1), a_novel)
+    b_surv = b_only & jnp.any(dots_b > sc, axis=-1)
+
+    n_surv = jnp.sum(a_surv, axis=-1) + jnp.sum(b_surv, axis=-1)
+    m_over = n_surv > m_cap
+
+    # rank-select the m_cap smallest surviving member ids (canonical
+    # ascending-id order, same as compact_by_id)
+    keys = jnp.concatenate(
+        [jnp.where(a_surv, ids_a, _SORT_MAX), jnp.where(b_surv, ids_b, _SORT_MAX)],
+        axis=-1,
+    )
+    sel = _stable_order(keys)[..., :m_cap]  # concat-space source slot
+    out_ids_key = jnp.take_along_axis(keys, sel, axis=-1)
+    live = out_ids_key != _SORT_MAX
+    out_ids = jnp.where(live, out_ids_key, EMPTY)
+
+    # gather algebra inputs for the selected slots only; the "other side"
+    # clock is one combined gather from dots_b — the b-only slot's own
+    # dots and the matched a-slot's counterpart live in the same table
+    is_b = sel >= ma
+    sel_a = jnp.where(is_b, 0, sel)
+    src_a = jnp.take_along_axis(dots_a, sel_a[..., None], axis=-2)
+    sel_matched = jnp.take_along_axis(a_matched, sel_a, axis=-1) & ~is_b
+    j_sel = jnp.take_along_axis(j_idx, sel_a, axis=-1)
+    j_comb = jnp.where(is_b, sel - ma, j_sel)
+    src_other = jnp.take_along_axis(dots_b, j_comb[..., None], axis=-2)
+
+    # dot algebra on [..., m_cap, A] (`orswot.rs:105-138`)
+    common = clock_ops.intersection(src_a, src_other)
+    c1 = clock_ops.subtract(clock_ops.subtract(src_a, common), oc)
+    c2 = clock_ops.subtract(clock_ops.subtract(src_other, common), sc)
+    out_both = jnp.maximum(common, jnp.maximum(c1, c2))
+    out_a = jnp.where(sel_matched[..., None], out_both, src_a)
+    out_dots = jnp.where(is_b[..., None], clock_ops.subtract(src_other, sc), out_a)
+    out_dots = jnp.where(live[..., None], out_dots, 0)
+
+    d_shape = dids_a.shape[:-1] + (d_cap,)
+    d_ids = jnp.full(d_shape, EMPTY, dids_a.dtype)
+    d_clocks = jnp.zeros(d_shape + dclocks_a.shape[-1:], dclocks_a.dtype)
+    d_over = jnp.zeros(m_over.shape, bool)
+    return out_ids, out_dots, d_ids, d_clocks, jnp.stack([m_over, d_over], axis=-1)
+
+
+def _merge_narrow_deferred(
+    clock, clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int,
+):
+    """Full-width merge pipeline for batches carrying deferred rows:
+    materialize the 2M-wide merged table, union + dedup + replay the
+    deferred tables (`orswot.rs:141-155`), then compact."""
+    ma = ids_a.shape[-1]
+    valid_a, a_matched, j_idx, b_only = _member_match(ids_a, ids_b)
+    sc = clock_a[..., None, :]
+    oc = clock_b[..., None, :]
+
+    e2 = jnp.take_along_axis(dots_b, j_idx[..., None], axis=-2)
+    e2 = jnp.where(a_matched[..., None], e2, 0)
+
+    # a-side slots: both-branch dot algebra (`orswot.rs:105-129`) where
+    # matched, only-in-self rule (`orswot.rs:94-103`) where not
+    common = clock_ops.intersection(dots_a, e2)
+    c1 = clock_ops.subtract(clock_ops.subtract(dots_a, common), oc)
+    c2 = clock_ops.subtract(clock_ops.subtract(e2, common), sc)
+    out_both = jnp.maximum(common, jnp.maximum(c1, c2))
+    keep1 = ~clock_ops.leq(dots_a, oc)
+    out_only1 = jnp.where(keep1[..., None], dots_a, 0)
+    a_dots = jnp.where(a_matched[..., None], out_both, out_only1)
+    a_dots = jnp.where(valid_a[..., None], a_dots, 0)
+    a_live = valid_a & ~clock_ops.is_empty(a_dots)
+    a_ids = jnp.where(a_live, ids_a, EMPTY)
+    a_dots = jnp.where(a_live[..., None], a_dots, 0)
+
+    # novel-in-other slots keep the subtracted clock (`orswot.rs:132-138`)
+    b_dots = jnp.where(b_only[..., None], clock_ops.subtract(dots_b, sc), 0)
+    b_live = b_only & ~clock_ops.is_empty(b_dots)
+    b_ids = jnp.where(b_live, ids_b, EMPTY)
+    b_dots = jnp.where(b_live[..., None], b_dots, 0)
+
+    ids = jnp.concatenate([a_ids, b_ids], axis=-1)
+    out_dots = jnp.concatenate([a_dots, b_dots], axis=-2)
+
+    # union + dedup the deferred tables (`orswot.rs:141-148`), replay
+    # after the clock join (`orswot.rs:153-155`)
+    d_ids = jnp.concatenate([dids_a, dids_b], axis=-1)
+    d_clocks = jnp.concatenate([dclocks_a, dclocks_b], axis=-2)
+    d_ids, d_clocks = _dedup_deferred(d_ids, d_clocks)
+    ids, out_dots, d_ids, d_clocks = _apply_deferred(clock, ids, out_dots, d_ids, d_clocks)
+
+    ids, out_dots, m_over = compact_by_id(ids, out_dots, m_cap)
+    d_ids, d_clocks, d_over = compact(d_ids, d_clocks, d_cap)
+    return ids, out_dots, d_ids, d_clocks, jnp.stack([m_over, d_over], axis=-1)
+
+
+def _merge_wide(
+    clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int,
+):
+    """Sort-aligned merge pipeline for member tables wider than
+    ``_ALIGN_MATCH_MAX_M`` (same semantics, O(M log M) alignment)."""
+    ids, e1, e2, valid = _align_sorted(ids_a, dots_a, ids_b, dots_b)
     p1 = ~clock_ops.is_empty(e1) & valid
     p2 = ~clock_ops.is_empty(e2) & valid
     out_dots = _merge_aligned(e1, e2, p1, p2, clock_a, clock_b)
@@ -238,12 +410,10 @@ def merge(
     ids = jnp.where(survive, ids, EMPTY)
     out_dots = jnp.where(survive[..., None], out_dots, 0)
 
-    # union + dedup the deferred tables (`orswot.rs:141-148`)
     d_ids = jnp.concatenate([dids_a, dids_b], axis=-1)
     d_clocks = jnp.concatenate([dclocks_a, dclocks_b], axis=-2)
     d_ids, d_clocks = _dedup_deferred(d_ids, d_clocks)
 
-    # clock join (`orswot.rs:153`), then replay deferred (`orswot.rs:155`)
     clock = clock_ops.merge(clock_a, clock_b)
     ids, out_dots, d_ids, d_clocks = _apply_deferred(clock, ids, out_dots, d_ids, d_clocks)
 

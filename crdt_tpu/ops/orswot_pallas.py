@@ -223,6 +223,13 @@ def _tile_size(a, m, d, n_states=2, vmem_budget=8 * 1024 * 1024):
     t = 256
     while t > 8 and t * bytes_per_obj > vmem_budget:
         t //= 2
+    if t * bytes_per_obj > vmem_budget:
+        raise ValueError(
+            f"ORSWOT working set ({t * bytes_per_obj} bytes at the minimum "
+            f"tile of {t} objects, n_states={n_states}) exceeds the "
+            f"{vmem_budget}-byte VMEM budget; use the jnp path "
+            "(orswot_ops.merge) or a smaller fold width R"
+        )
     return t
 
 

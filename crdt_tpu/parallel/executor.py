@@ -48,6 +48,30 @@ class JoinError(RuntimeError):
     """A join could not be completed within the executor's limits."""
 
 
+# substrings that mark a RuntimeError as plausibly transient (device-side,
+# worth requeueing); anything else is treated as deterministic and raised
+# without burning the retry budget on backoff sleeps
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline",
+    "aborted",
+    "cancelled",
+    "preempt",
+    "connection",
+    "socket",
+    "tunnel",
+    "device gone",
+    "device lost",
+    "out of memory",
+    "resource exhausted",
+)
+
+
+def _is_transient(err: BaseException) -> bool:
+    msg = str(err).lower()
+    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+
+
 @dataclasses.dataclass
 class JoinExecutor:
     """Left-fold join driver with overflow regrowth and transient retry.
@@ -138,10 +162,11 @@ class JoinExecutor:
                     acc = acc.with_capacity(new_m, new_d)
                     nxt = nxt.with_capacity(new_m, new_d)
             except RuntimeError as transient:
-                # deliberately broad: XLA surfaces tunnel drops, preemption
-                # AND deterministic failures as RuntimeError subclasses; the
-                # bounded retry budget caps the cost of retrying the latter
-                if isinstance(transient, JoinError):
+                # XLA surfaces tunnel drops, preemption AND deterministic
+                # failures (shape/compile errors) as RuntimeError subclasses;
+                # only messages carrying transient markers are requeued —
+                # deterministic failures surface immediately
+                if isinstance(transient, JoinError) or not _is_transient(transient):
                     raise
                 retries += 1
                 if retries > self.max_retries:

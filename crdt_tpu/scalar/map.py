@@ -148,12 +148,13 @@ class Map(CvRDT, CmRDT, Causal):
         for key, entry in list(self.entries.items()):
             entry = entry.clone()
             if key not in other.entries:
-                # other doesn't contain this entry because it:
-                #  1. has witnessed it and dropped it
-                #  2. hasn't witnessed it             (`map.rs:198-211`)
+                # A key the peer lacks was either removed there (peer clock
+                # covers every dot ⇒ drop) or never replicated (novel dots
+                # remain ⇒ keep, truncating the nested value by whatever the
+                # peer *did* witness — reset-remove).  (`map.rs:198-211`)
                 entry.clock.subtract(other.clock)
                 if entry.clock.is_empty():
-                    pass  # other has seen this entry and dropped it
+                    pass
                 else:
                     deleters = other.clock.clone()
                     deleters.subtract(entry.clock)

@@ -124,16 +124,18 @@ class Orswot(CvRDT, CmRDT, Causal):
         for entry, clock in list(self.entries.items()):
             clock = clock.clone()
             if entry not in other.entries:
-                # other doesn't contain this entry because it:
-                #  1. has witnessed it and dropped it
-                #  2. hasn't witnessed it               (`orswot.rs:94-103`)
+                # Absence on the other side is ambiguous: either the peer
+                # observed the add and removed it (its set clock covers our
+                # dots ⇒ drop), or the add simply never reached it (some dot
+                # is novel ⇒ survive, with the full clock — the asymmetry
+                # vs the novel-in-other branch below).  (`orswot.rs:94-103`)
                 if clock <= other.clock:
-                    pass  # other has seen this entry and dropped it
+                    pass
                 else:
-                    keep[entry] = clock  # keeps the FULL clock (asymmetry)
+                    keep[entry] = clock
             else:
-                # present in both — but that doesn't mean we keep it
-                # (`orswot.rs:105-129`)
+                # Both sides hold the member; survival still depends on the
+                # dot algebra, not mere presence.  (`orswot.rs:105-129`)
                 other_entry_clock = other.entries[entry].clone()
                 common = clock.intersection(other_entry_clock)
                 clock.subtract(common)

@@ -41,16 +41,38 @@ class SpanStats:
     total_s: float = 0.0
     min_s: float = float("inf")
     max_s: float = 0.0
+    bytes_total: int = 0
 
-    def add(self, dt: float) -> None:
+    def add(self, dt: float, nbytes: int = 0) -> None:
         self.count += 1
         self.total_s += dt
         self.min_s = min(self.min_s, dt)
         self.max_s = max(self.max_s, dt)
+        self.bytes_total += nbytes
 
     @property
     def mean_s(self) -> float:
         return self.total_s / self.count if self.count else 0.0
+
+    @property
+    def gbps(self) -> float:
+        """Effective memory bandwidth (bytes moved / wall time) — the
+        roofline coordinate for bandwidth-bound merge kernels."""
+        return self.bytes_total / self.total_s / 1e9 if self.total_s else 0.0
+
+
+def pytree_bytes(*trees: Any) -> int:
+    """Total array bytes across pytrees — feed as a span's ``nbytes`` to
+    get bytes-moved / effective-GB/s accounting in the report."""
+    import jax
+
+    total = 0
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            nb = getattr(leaf, "nbytes", None)
+            if nb is not None:
+                total += int(nb)
+    return total
 
 
 @dataclass
@@ -61,8 +83,13 @@ class Tracer:
     stats: Dict[str, SpanStats] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
+    def add(self, name: str, dt: float, nbytes: int = 0) -> None:
+        """Record one observation for ``name`` (thread-safe)."""
+        with self._lock:
+            self.stats.setdefault(name, SpanStats()).add(dt, nbytes)
+
     @contextlib.contextmanager
-    def span(self, name: str) -> Iterator[None]:
+    def span(self, name: str, nbytes: int = 0) -> Iterator[None]:
         if not self.enabled:
             yield
             return
@@ -72,9 +99,7 @@ class Tracer:
             with annot:
                 yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self.stats.setdefault(name, SpanStats()).add(dt)
+            self.add(name, time.perf_counter() - t0, nbytes)
 
     def reset(self) -> None:
         with self._lock:
@@ -93,13 +118,14 @@ class Tracer:
             return "(no spans recorded)"
         lines = [
             f"{'span':<32} {'count':>7} {'total':>10} {'mean':>10} "
-            f"{'min':>10} {'max':>10}"
+            f"{'min':>10} {'max':>10} {'GB/s':>8}"
         ]
         for name, s in rows:
+            gbps = f"{s.gbps:>7.2f}" if s.bytes_total else f"{'—':>7}"
             lines.append(
                 f"{name:<32} {s.count:>7} {s.total_s*1e3:>9.2f}ms "
                 f"{s.mean_s*1e3:>9.3f}ms {s.min_s*1e3:>9.3f}ms "
-                f"{s.max_s*1e3:>9.3f}ms"
+                f"{s.max_s*1e3:>9.3f}ms {gbps}"
             )
         return "\n".join(lines)
 
@@ -146,12 +172,16 @@ def reset() -> None:
     _GLOBAL.reset()
 
 
-def timed_kernel(name: Optional[str] = None) -> Callable:
+def timed_kernel(name: Optional[str] = None, count_bytes: bool = False) -> Callable:
     """Wrap a (jitted) kernel so each call is a blocking span.
 
     Blocks on the outputs via ``jax.block_until_ready`` so the recorded
     time covers device execution, not just async dispatch — without this,
-    XLA's async dispatch makes per-call wall times meaningless."""
+    XLA's async dispatch makes per-call wall times meaningless.
+
+    With ``count_bytes=True`` each call also records input + output array
+    bytes (a lower bound on HBM traffic), so the report's GB/s column
+    places the kernel on the bandwidth roofline."""
 
     def deco(fn: Callable) -> Callable:
         label = name or getattr(fn, "__name__", "kernel")
@@ -161,10 +191,18 @@ def timed_kernel(name: Optional[str] = None) -> Callable:
                 return fn(*args, **kwargs)
             import jax
 
-            with _GLOBAL.span(label):
-                out = fn(*args, **kwargs)
-                jax.block_until_ready(out)
-            return out
+            out = None
+            t0 = time.perf_counter()
+            try:
+                with _trace_annotation(label):
+                    out = fn(*args, **kwargs)
+                    jax.block_until_ready(out)
+                return out
+            finally:
+                # record failing calls too — a raising kernel (overflow,
+                # device error) must not vanish from the report
+                nbytes = pytree_bytes(args, kwargs, out) if count_bytes else 0
+                _GLOBAL.add(label, time.perf_counter() - t0, nbytes)
 
         wrapped.__name__ = getattr(fn, "__name__", "kernel")
         wrapped.__doc__ = fn.__doc__

@@ -48,36 +48,77 @@ class OrswotBatch:
 
     @classmethod
     def from_scalar(cls, states: Sequence[Orswot], universe: Universe) -> "OrswotBatch":
+        """Bulk ingest: one Python pass collects flat COO coordinates
+        (object, slot, actor, counter) into append-only lists, then four
+        vectorized numpy scatters build the dense tables.  Scales to
+        millions of objects (the per-element numpy scalar stores of the
+        naive construction dominate end-to-end time at north-star sizes
+        — see ``bench.py`` ``ingest`` line)."""
         import numpy as np
 
         cfg = universe.config
         n = len(states)
         a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
         dt = counter_dtype()
+        aidx = universe.actors.intern
+        midx = universe.members.intern
+
+        co, ca, cc = [], [], []  # set clock (obj, actor, counter)
+        eo, es, em = [], [], []  # entries (obj, slot, member-id)
+        go, gs, ga, gc = [], [], [], []  # entry dots (obj, slot, actor, counter)
+        qo, qs, qm = [], [], []  # deferred ids
+        ho, hs, ha, hc = [], [], [], []  # deferred clocks
+
+        for i, s in enumerate(states):
+            for actor, counter in s.clock.dots.items():
+                co.append(i)
+                ca.append(aidx(actor))
+                cc.append(counter)
+            if len(s.entries) > m:
+                raise ValueError(
+                    f"object {i}: {len(s.entries)} members > member_capacity {m}"
+                )
+            for j, (member, vc) in enumerate(s.entries.items()):
+                eo.append(i)
+                es.append(j)
+                em.append(midx(member))
+                for actor, counter in vc.dots.items():
+                    go.append(i)
+                    gs.append(j)
+                    ga.append(aidx(actor))
+                    gc.append(counter)
+            rows = [
+                (ck, member) for ck, members in s.deferred.items() for member in members
+            ]
+            if len(rows) > d:
+                raise ValueError(
+                    f"object {i}: {len(rows)} deferred rows > deferred_capacity {d}"
+                )
+            for j, (ck, member) in enumerate(rows):
+                qo.append(i)
+                qs.append(j)
+                qm.append(midx(member))
+                for actor, counter in ck:
+                    ho.append(i)
+                    hs.append(j)
+                    ha.append(aidx(actor))
+                    hc.append(counter)
+
         clock = np.zeros((n, a), dtype=dt)
         ids = np.full((n, m), orswot_ops.EMPTY, dtype=np.int32)
         dots = np.zeros((n, m, a), dtype=dt)
         d_ids = np.full((n, d), orswot_ops.EMPTY, dtype=np.int32)
         d_clocks = np.zeros((n, d, a), dtype=dt)
-
-        for i, s in enumerate(states):
-            for actor, counter in s.clock.dots.items():
-                clock[i, universe.actor_idx(actor)] = counter
-            if len(s.entries) > m:
-                raise ValueError(f"object {i}: {len(s.entries)} members > member_capacity {m}")
-            for j, (member, vc) in enumerate(s.entries.items()):
-                ids[i, j] = universe.member_id(member)
-                for actor, counter in vc.dots.items():
-                    dots[i, j, universe.actor_idx(actor)] = counter
-            rows = [
-                (ck, member) for ck, members in s.deferred.items() for member in members
-            ]
-            if len(rows) > d:
-                raise ValueError(f"object {i}: {len(rows)} deferred rows > deferred_capacity {d}")
-            for j, (ck, member) in enumerate(rows):
-                d_ids[i, j] = universe.member_id(member)
-                for actor, counter in ck:
-                    d_clocks[i, j, universe.actor_idx(actor)] = counter
+        if co:
+            clock[np.asarray(co), np.asarray(ca)] = np.asarray(cc, dtype=dt)
+        if eo:
+            ids[np.asarray(eo), np.asarray(es)] = np.asarray(em, dtype=np.int32)
+        if go:
+            dots[np.asarray(go), np.asarray(gs), np.asarray(ga)] = np.asarray(gc, dtype=dt)
+        if qo:
+            d_ids[np.asarray(qo), np.asarray(qs)] = np.asarray(qm, dtype=np.int32)
+        if ho:
+            d_clocks[np.asarray(ho), np.asarray(hs), np.asarray(ha)] = np.asarray(hc, dtype=dt)
 
         return cls(
             clock=jnp.asarray(clock),
@@ -88,7 +129,12 @@ class OrswotBatch:
         )
 
     def to_scalar(self, universe: Universe) -> list[Orswot]:
+        """Bulk egress: ``np.nonzero`` extracts every populated cell in
+        four vectorized passes; the Python loop only walks actual dots
+        (sparse), never the dense ``[N, M, A]`` volume."""
         import numpy as np
+
+        from ..scalar.vclock import VClock
 
         clock = np.asarray(self.clock)
         ids = np.asarray(self.ids)
@@ -96,24 +142,46 @@ class OrswotBatch:
         d_ids = np.asarray(self.d_ids)
         d_clocks = np.asarray(self.d_clocks)
 
-        from .vclock_batch import row_to_vclock
+        n = clock.shape[0]
+        actor_of = universe.actors.lookup
+        member_of = universe.members.lookup
+        out = [Orswot() for _ in range(n)]
 
-        out = []
-        for i in range(clock.shape[0]):
-            s = Orswot()
-            s.clock = row_to_vclock(clock[i], universe)
-            for j in range(ids.shape[1]):
-                if ids[i, j] != orswot_ops.EMPTY:
-                    s.entries[universe.members.lookup(int(ids[i, j]))] = row_to_vclock(
-                        dots[i, j], universe
-                    )
-            for j in range(d_ids.shape[1]):
-                if d_ids[i, j] != orswot_ops.EMPTY:
-                    ck = row_to_vclock(d_clocks[i, j], universe).key()
-                    s.deferred.setdefault(ck, set()).add(
-                        universe.members.lookup(int(d_ids[i, j]))
-                    )
-            out.append(s)
+        oi, ai = np.nonzero(clock)
+        for i, aix, v in zip(oi.tolist(), ai.tolist(), clock[oi, ai].tolist()):
+            out[i].clock.dots[actor_of(aix)] = v
+
+        # entries in slot order (np.nonzero is row-major), matching the
+        # insertion order the naive path produced
+        oi, si = np.nonzero(ids != orswot_ops.EMPTY)
+        entry_clocks = {}
+        for i, j, mid in zip(oi.tolist(), si.tolist(), ids[oi, si].tolist()):
+            vc = VClock()
+            out[i].entries[member_of(mid)] = vc
+            entry_clocks[(i, j)] = vc
+        oi, si, ai = np.nonzero(dots)
+        for i, j, aix, v in zip(
+            oi.tolist(), si.tolist(), ai.tolist(), dots[oi, si, ai].tolist()
+        ):
+            entry_clocks[(i, j)].dots[actor_of(aix)] = v
+
+        oi, si = np.nonzero(d_ids != orswot_ops.EMPTY)
+        if oi.size:
+            deferred_clocks = {}
+            deferred_members = {}
+            for i, j, mid in zip(oi.tolist(), si.tolist(), d_ids[oi, si].tolist()):
+                deferred_clocks[(i, j)] = VClock()
+                deferred_members[(i, j)] = member_of(mid)
+            oi, si, ai = np.nonzero(d_clocks)
+            for i, j, aix, v in zip(
+                oi.tolist(), si.tolist(), ai.tolist(), d_clocks[oi, si, ai].tolist()
+            ):
+                if (i, j) in deferred_clocks:
+                    deferred_clocks[(i, j)].dots[actor_of(aix)] = v
+            for (i, _j), vc in deferred_clocks.items():
+                out[i].deferred.setdefault(vc.key(), set()).add(
+                    deferred_members[(i, _j)]
+                )
         return out
 
     @property

@@ -274,18 +274,16 @@ def _member_match(ids_a, ids_b):
     return valid_a, a_matched, j_idx, b_only
 
 
-def _merge_narrow_fast(
-    clock, clock_a, ids_a, dots_a, dids_a, dclocks_a,
-    clock_b, ids_b, dots_b, dids_b, dclocks_b,
-    m_cap: int, d_cap: int,
+def _rank_select_merge(
+    clock_a, ids_a, dots_a, clock_b, ids_b, dots_b, m_cap: int,
 ):
-    """Deferred-free merge: survival reduces → rank-select → compute.
+    """Shared merge core: survival reduces → rank-select → compute.
 
     Survival of every slot is decidable from OR-reductions over the actor
     axis (no merged clock is ever written), so the only ``[..., *, A]``
     arrays materialized are the gathers feeding the final ``m_cap``-slot
-    algebra.  Bit-exact with the full-width pipeline; the deferred tables
-    are untouched empty tables by construction of the dispatch."""
+    algebra.  Returns ``(out_ids, out_dots, n_survivors)`` — the member
+    table in canonical ascending-id order, pre-deferred-replay."""
     ma = ids_a.shape[-1]
     valid_a, a_matched, j_idx, b_only = _member_match(ids_a, ids_b)
     sc = clock_a[..., None, :]
@@ -304,7 +302,6 @@ def _merge_narrow_fast(
     b_surv = b_only & jnp.any(dots_b > sc, axis=-1)
 
     n_surv = jnp.sum(a_surv, axis=-1) + jnp.sum(b_surv, axis=-1)
-    m_over = n_surv > m_cap
 
     # rank-select the m_cap smallest surviving member ids (canonical
     # ascending-id order, same as compact_by_id)
@@ -336,7 +333,22 @@ def _merge_narrow_fast(
     out_a = jnp.where(sel_matched[..., None], out_both, src_a)
     out_dots = jnp.where(is_b[..., None], clock_ops.subtract(src_other, sc), out_a)
     out_dots = jnp.where(live[..., None], out_dots, 0)
+    return out_ids, out_dots, n_surv
 
+
+def _merge_narrow_fast(
+    clock, clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int,
+):
+    """Deferred-free merge — the rank-select core alone.  Bit-exact with
+    the deferred pipeline because replay over empty deferred tables is the
+    identity; the output deferred tables are empty by construction of the
+    dispatch."""
+    out_ids, out_dots, n_surv = _rank_select_merge(
+        clock_a, ids_a, dots_a, clock_b, ids_b, dots_b, m_cap
+    )
+    m_over = n_surv > m_cap
     d_shape = dids_a.shape[:-1] + (d_cap,)
     d_ids = jnp.full(d_shape, EMPTY, dids_a.dtype)
     d_clocks = jnp.zeros(d_shape + dclocks_a.shape[-1:], dclocks_a.dtype)
@@ -349,50 +361,36 @@ def _merge_narrow_deferred(
     clock_b, ids_b, dots_b, dids_b, dclocks_b,
     m_cap: int, d_cap: int,
 ):
-    """Full-width merge pipeline for batches carrying deferred rows:
-    materialize the 2M-wide merged table, union + dedup + replay the
-    deferred tables (`orswot.rs:141-155`), then compact."""
-    ma = ids_a.shape[-1]
-    valid_a, a_matched, j_idx, b_only = _member_match(ids_a, ids_b)
-    sc = clock_a[..., None, :]
-    oc = clock_b[..., None, :]
+    """Merge for batches carrying deferred rows: the rank-select core,
+    then union + dedup + replay of the deferred tables
+    (`orswot.rs:141-155`) at ``m_cap`` width, then a repack of whatever
+    the replay emptied.
 
-    e2 = jnp.take_along_axis(dots_b, j_idx[..., None], axis=-2)
-    e2 = jnp.where(a_matched[..., None], e2, 0)
-
-    # a-side slots: both-branch dot algebra (`orswot.rs:105-129`) where
-    # matched, only-in-self rule (`orswot.rs:94-103`) where not
-    common = clock_ops.intersection(dots_a, e2)
-    c1 = clock_ops.subtract(clock_ops.subtract(dots_a, common), oc)
-    c2 = clock_ops.subtract(clock_ops.subtract(e2, common), sc)
-    out_both = jnp.maximum(common, jnp.maximum(c1, c2))
-    keep1 = ~clock_ops.leq(dots_a, oc)
-    out_only1 = jnp.where(keep1[..., None], dots_a, 0)
-    a_dots = jnp.where(a_matched[..., None], out_both, out_only1)
-    a_dots = jnp.where(valid_a[..., None], a_dots, 0)
-    a_live = valid_a & ~clock_ops.is_empty(a_dots)
-    a_ids = jnp.where(a_live, ids_a, EMPTY)
-    a_dots = jnp.where(a_live[..., None], a_dots, 0)
-
-    # novel-in-other slots keep the subtracted clock (`orswot.rs:132-138`)
-    b_dots = jnp.where(b_only[..., None], clock_ops.subtract(dots_b, sc), 0)
-    b_live = b_only & ~clock_ops.is_empty(b_dots)
-    b_ids = jnp.where(b_live, ids_b, EMPTY)
-    b_dots = jnp.where(b_live[..., None], b_dots, 0)
-
-    ids = jnp.concatenate([a_ids, b_ids], axis=-1)
-    out_dots = jnp.concatenate([a_dots, b_dots], axis=-2)
+    Replaying after compaction is exact whenever the survivor set fits
+    ``m_cap``; when it does not, the member-overflow flag is already set
+    (from the pre-replay survivor count — marginally more conservative
+    than counting post-replay, in the rare case a replay would have freed
+    enough slots) and the host discards the state and regrows, so the
+    truncated replay is never observed."""
+    out_ids, out_dots, n_surv = _rank_select_merge(
+        clock_a, ids_a, dots_a, clock_b, ids_b, dots_b, m_cap
+    )
+    m_over = n_surv > m_cap
 
     # union + dedup the deferred tables (`orswot.rs:141-148`), replay
     # after the clock join (`orswot.rs:153-155`)
     d_ids = jnp.concatenate([dids_a, dids_b], axis=-1)
     d_clocks = jnp.concatenate([dclocks_a, dclocks_b], axis=-2)
     d_ids, d_clocks = _dedup_deferred(d_ids, d_clocks)
-    ids, out_dots, d_ids, d_clocks = _apply_deferred(clock, ids, out_dots, d_ids, d_clocks)
+    out_ids, out_dots, d_ids, d_clocks = _apply_deferred(
+        clock, out_ids, out_dots, d_ids, d_clocks
+    )
 
-    ids, out_dots, m_over = compact_by_id(ids, out_dots, m_cap)
+    # repack slots the replay emptied (canonical ascending-id order is
+    # preserved — subtraction never changes ids)
+    out_ids, out_dots, _ = compact_by_id(out_ids, out_dots, m_cap)
     d_ids, d_clocks, d_over = compact(d_ids, d_clocks, d_cap)
-    return ids, out_dots, d_ids, d_clocks, jnp.stack([m_over, d_over], axis=-1)
+    return out_ids, out_dots, d_ids, d_clocks, jnp.stack([m_over, d_over], axis=-1)
 
 
 def _merge_wide(

@@ -124,6 +124,44 @@ def _orswot_pair_merge(a, b, m_cap: int, d_cap: int):
     return tuple(state), overflow
 
 
+@functools.lru_cache(maxsize=64)
+def shard_local_merge_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int):
+    """Cached jitted shard-local pairwise merge over state 5-tuples —
+    cache keyed on (mesh, axis, capacities) so loop-heavy callers compile
+    once, not per call."""
+    spec = P(axis)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=((spec,) * 5, (spec,) * 5),
+        out_specs=((spec,) * 5, spec),
+        check_vma=False,
+    )
+    def _local(sa, sb):
+        return _orswot_pair_merge(sa, sb, m_cap, d_cap)
+
+    return _local
+
+
+def shard_local_pairwise_merge(a, b, mesh: Mesh, axis: str = "objects"):
+    """Pairwise ORSWOT merge of two object-sharded batches with a
+    **zero-collective guarantee**: each device merges only its own object
+    shard under ``shard_map``, so the compiled program provably moves no
+    data across devices — and the merge kernel's deferred/deferred-free
+    dispatch (`orswot_ops.merge`) is decided *per shard*, so shards whose
+    objects carry no deferred rows stay on the fast path even when other
+    shards don't.
+
+    ``a``/``b``: OrswotBatch-shaped pytrees sharded over ``axis``.
+    Returns ``(merged_state5, overflow)`` with the same sharding."""
+    m_cap, d_cap = a.ids.shape[-1], a.d_ids.shape[-1]
+    state_a = (a.clock, a.ids, a.dots, a.d_ids, a.d_clocks)
+    state_b = (b.clock, b.ids, b.dots, b.d_ids, b.d_clocks)
+    return shard_local_merge_fn(mesh, axis, m_cap, d_cap)(state_a, state_b)
+
+
 def _fold_orswot_stack(stack5, m_cap: int, d_cap: int):
     """Canonical left fold over a replica-stacked ORSWOT state 5-tuple
     (leading axis R on every array), ORing capacity overflow across every

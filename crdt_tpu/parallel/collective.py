@@ -238,6 +238,90 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
     return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
 
+def _fold_map_stack(stack_state, kernel):
+    """Canonical left fold over a replica-stacked Map state pytree (leading
+    axis R on every leaf), ORing overflow across every pairwise merge —
+    the Map analogue of :func:`_fold_orswot_stack`, recursing through the
+    nested value state via the (static) value kernel."""
+    leaves, treedef = jax.tree_util.tree_flatten(stack_state)
+    r = leaves[0].shape[0]
+
+    def take(i):
+        return jax.tree_util.tree_unflatten(treedef, [x[i] for x in leaves])
+
+    acc = take(0)
+    overflow = None
+    for i in range(1, r):
+        acc, over = kernel.merge(acc, take(i))
+        overflow = over if overflow is None else overflow | over
+    if overflow is None:
+        overflow = jnp.zeros((), dtype=bool)
+    return acc, overflow
+
+
+@functools.lru_cache(maxsize=64)
+def _map_join_fn(mesh: Mesh, axis: str, kernel, flat_specs, spec_tree):
+    """Cached jitted Map collective join — bounded like the sibling
+    compiled-fn caches so long-lived drivers creating fresh meshes or
+    kernels don't pin executables forever."""
+    specs = jax.tree_util.tree_unflatten(spec_tree, list(flat_specs))
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=(specs, P(axis)),
+        check_vma=False,
+    )
+    def _join(local_state):
+        local = jax.tree_util.tree_map(lambda x: x[0], local_state)
+        gathered = jax.tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axis), local
+        )
+        acc, overflow = _fold_map_stack(gathered, kernel)
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], acc),
+            jnp.any(overflow)[None],
+        )
+
+    return _join
+
+
+def allgather_join_map(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
+    """All-reduce Map state across a mesh axis with the recursive
+    reset-remove merge (`/root/reference/src/map.rs:192-269`) as the
+    combiner — same canonical-fold contract as
+    :func:`allgather_join_orswot`: all-gather every state leaf (including
+    the nested value state) over ``axis``, fold in device order 0..D-1,
+    result identical on every device and bit-equal to the scalar N-way
+    left fold.
+
+    ``batch``: a :class:`~crdt_tpu.batch.map_batch.MapBatch` whose leading
+    axis is the replica axis, one replica shard per device over ``axis``."""
+    from ..batch.map_batch import MapBatch
+
+    kernel = batch.kernel
+    n_dev = mesh.shape[axis]
+    if batch.clock.shape[0] != n_dev:
+        raise ValueError(
+            f"leading replica axis {batch.clock.shape[0]} != mesh axis "
+            f"{axis}={n_dev} (one replica shard per device)"
+        )
+    state = batch.state
+    specs = jax.tree_util.tree_map(
+        lambda x: P(axis, *([None] * (x.ndim - 1))), state
+    )
+    flat_specs, spec_tree = jax.tree_util.tree_flatten(specs)
+    join = _map_join_fn(mesh, axis, kernel, tuple(flat_specs), spec_tree)
+    joined, overflow = join(state)
+    if check and bool(jnp.any(overflow)):
+        raise ValueError(
+            "Map collective join overflow: raise key/deferred/value capacities"
+        )
+    return MapBatch.from_state(joined, kernel)
+
+
 # -- anti-entropy to fixpoint ------------------------------------------------
 
 

@@ -136,38 +136,22 @@ def unpartition_dense(s_clock, s_ids, s_dots, s_dids, s_dclocks,
     return clock, ids, dots, d_ids, d_clocks
 
 
-@functools.lru_cache(maxsize=64)
-def _merge_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int):
-    """Cached jitted shard-local merge — re-tracing per call would dwarf
-    the kernel time on loop-heavy anti-entropy rounds."""
-    spec = P(axis)
-
-    @jax.jit
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=((spec,) * 5, (spec,) * 5),
-        out_specs=((spec,) * 5, spec),
-        check_vma=False,
-    )
-    def _local(sa, sb):
-        *state, over = orswot_ops.merge(*sa, *sb, m_cap, d_cap)
-        return tuple(state), over
-
-    return _local
-
-
 def member_sharded_merge(state_a, state_b, mesh: Mesh, axis: str = "members",
                          check: bool = True):
     """Pairwise merge of two member-sharded states — fully shard-local
     (zero collectives): each device runs the standard merge kernel on its
-    member partition with the replicated set clocks.
+    member partition with the replicated set clocks.  Reuses the cached
+    jitted shard-local merge from :mod:`crdt_tpu.parallel.collective`
+    (member sharding IS object-axis sharding over the shard dimension —
+    the member-specific work is the routing/partition layer around it).
 
     ``state_a``/``state_b``: 5-tuples of ``[S, N, ...]`` arrays sharded
     over ``axis``.  Returns the merged 5-tuple (same sharding).  With
     ``check=True`` the per-shard overflow bitmap is raised host-side."""
+    from .collective import shard_local_merge_fn
+
     m_cap, d_cap = state_a[1].shape[-1], state_a[3].shape[-1]
-    state, overflow = _merge_fn(mesh, axis, m_cap, d_cap)(
+    state, overflow = shard_local_merge_fn(mesh, axis, m_cap, d_cap)(
         tuple(state_a), tuple(state_b)
     )
     if check:

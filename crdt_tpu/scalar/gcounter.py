@@ -13,6 +13,18 @@ from .vclock import Actor, Dot, VClock
 
 
 class GCounter(CvRDT, CmRDT):
+    """
+    >>> a, b = GCounter(), GCounter()
+    >>> a.apply(a.inc("A"))
+    >>> b.apply(b.inc("B"))
+    >>> a.apply(a.inc("A"))
+    >>> a.merge(b)               # state-based replication
+    >>> a.value()
+    3
+    >>> a.merge(b); a.value()    # idempotent: re-delivery is safe
+    3
+    """
+
     __slots__ = ("inner",)
 
     def __init__(self, inner: VClock | None = None):

@@ -12,6 +12,14 @@ from typing import Hashable, Set
 
 
 class GSet:
+    """
+    >>> a, b = GSet(), GSet()
+    >>> a.insert(1); b.insert(2)
+    >>> a.merge(b)                         # union
+    >>> a.contains(1) and a.contains(2)
+    True
+    """
+
     __slots__ = ("value",)
 
     def __init__(self, value: Set[Hashable] | None = None):

@@ -16,6 +16,23 @@ from ..traits import FunkyCmRDT, FunkyCvRDT
 
 
 class LWWReg(FunkyCvRDT, FunkyCmRDT):
+    """
+    Runnable mirror of `/root/reference/src/lwwreg.rs:84-103`:
+
+    >>> r = LWWReg()
+    >>> r.update("draft", marker=1)
+    >>> r.update("final", marker=9)
+    >>> r.update("stale", marker=3)      # older marker: ignored
+    >>> r.val
+    'final'
+    >>> other = LWWReg("conflict!", 9)   # same marker, different value
+    >>> try:
+    ...     r.merge(other)
+    ... except ConflictingMarker:
+    ...     print("conflict detected")
+    conflict detected
+    """
+
     __slots__ = ("val", "marker")
 
     def __init__(self, val=None, marker=0):

@@ -60,6 +60,27 @@ class Up:
 
 
 class Map(CvRDT, CmRDT, Causal):
+    """
+    Runnable mirror of the reference's doc example (`map.rs:35-80`) —
+    nested updates build one op, applied atomically under one dot:
+
+    >>> from .mvreg import MVReg
+    >>> m = Map(lambda: Map(MVReg))
+    >>> ctx = m.get("config").derive_add_ctx("admin")
+    >>> op = m.update(
+    ...     "config", ctx,
+    ...     lambda inner, c: inner.update("theme", c,
+    ...                                   lambda reg, c2: reg.set("dark", c2)),
+    ... )
+    >>> m.apply(op)
+    >>> m.get("config").val.get("theme").val.read().val
+    ['dark']
+    >>> rm = m.rm("config", m.get("config").derive_rm_ctx())
+    >>> m.apply(rm)
+    >>> m.get("config").val is None
+    True
+    """
+
     __slots__ = ("val_type", "clock", "entries", "deferred")
 
     def __init__(self, val_type: Callable[[], Any]):

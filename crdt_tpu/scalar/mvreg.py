@@ -29,6 +29,20 @@ class Put:
 
 
 class MVReg(CvRDT, CmRDT, Causal):
+    """
+    Concurrent writes both survive; a causally-later write collapses them:
+
+    >>> a, b = MVReg(), MVReg()
+    >>> a.apply(a.set("ok", a.read().derive_add_ctx("alice")))
+    >>> b.apply(b.set("no", b.read().derive_add_ctx("bob")))
+    >>> a.merge(b)
+    >>> sorted(a.read().val)               # concurrent: both values
+    ['no', 'ok']
+    >>> a.apply(a.set("done", a.read().derive_add_ctx("alice")))
+    >>> a.read().val                       # dominates both: collapses
+    ['done']
+    """
+
     __slots__ = ("vals",)
 
     def __init__(self, vals: List[Tuple[VClock, Any]] | None = None):

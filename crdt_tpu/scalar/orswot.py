@@ -47,6 +47,23 @@ class Rm:
 
 
 class Orswot(CvRDT, CmRDT, Causal):
+    """
+    The causal read-modify-write protocol (`ctx.rs:5-9` usage pattern):
+
+    >>> s = Orswot()
+    >>> add_op = s.add("apple", s.value().derive_add_ctx("alice"))
+    >>> s.apply(add_op)                    # mutators are pure; apply commits
+    >>> replica = Orswot()
+    >>> replica.apply(add_op)              # ship the op, not the state
+    >>> sorted(replica.value().val)
+    ['apple']
+    >>> rm_op = s.remove("apple", s.contains("apple").derive_rm_ctx())
+    >>> s.apply(rm_op)
+    >>> s.merge(replica)                   # remove wins: replica never re-adds
+    >>> sorted(s.value().val)
+    []
+    """
+
     __slots__ = ("clock", "entries", "deferred")
 
     def __init__(self):

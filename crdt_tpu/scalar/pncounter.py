@@ -32,6 +32,16 @@ class Op:
 
 
 class PNCounter(CvRDT, CmRDT):
+    """
+    >>> a, b = PNCounter(), PNCounter()
+    >>> a.apply(a.inc("A"))
+    >>> a.apply(a.inc("A"))
+    >>> b.apply(b.dec("B"))
+    >>> a.merge(b)
+    >>> a.value()                # 2 increments - 1 decrement
+    1
+    """
+
     __slots__ = ("p", "n")
 
     def __init__(self, p: GCounter | None = None, n: GCounter | None = None):

@@ -39,7 +39,22 @@ class Dot:
 
 
 class VClock:
-    """A standard vector clock: a mapping from actors to counters."""
+    """A standard vector clock: a mapping from actors to counters.
+
+    The causal partial order mirrors `/root/reference/src/vclock.rs:59-71`
+    (and the runnable example style of `vclock.rs:88-102`):
+
+    >>> a, b = VClock(), VClock()
+    >>> a.apply(a.inc("A"))
+    >>> b.apply(b.inc("B"))
+    >>> a.concurrent(b)          # neither saw the other's event
+    True
+    >>> a.merge(b)               # lattice join: pointwise max
+    >>> a >= b and a.get("A") == 1 and a.get("B") == 1
+    True
+    >>> b <= a and not a <= b    # b is now strictly dominated
+    True
+    """
 
     __slots__ = ("dots",)
 

@@ -323,3 +323,139 @@ def test_native_fold_matches_scalar_orswot():
         merged.merge(Orswot())
         expected.append(merged)
     assert got == expected
+
+
+# -- Map<K, MVReg> merge (map.rs:192-269) ------------------------------------
+
+
+def _random_map_batch_arrays(seed, n_obj, uni):
+    """Random op-built Map<int, MVReg> fleet packed to dense arrays, plus
+    the scalar states (for building the batch on both engines)."""
+    import random as pyrandom
+
+    from crdt_tpu import Dot, Map, MVReg, VClock
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+    from crdt_tpu.scalar.map import Rm as MapRm, Up
+    from crdt_tpu.scalar.mvreg import Put
+
+    rng = pyrandom.Random(seed)
+    states = []
+    for _ in range(n_obj):
+        m = Map(MVReg)
+        for _ in range(rng.randrange(0, 10)):
+            actor = rng.randrange(0, 6)
+            counter = rng.randrange(1, 6)
+            key = rng.randrange(0, 5)
+            clock = VClock.from_iter([(actor, counter)])
+            if rng.random() < 0.3:
+                m.apply(MapRm(clock=clock, key=key))
+            else:
+                m.apply(Up(dot=Dot(actor, counter), key=key,
+                           op=Put(clock=clock, val=rng.randrange(0, 9))))
+        states.append(m)
+    vk = MVRegKernel.from_config(uni.config)
+    batch = MapBatch.from_scalar(states, uni, vk)
+    mv_clocks, mv_vals = batch.vals
+    return (
+        np.asarray(batch.clock), np.asarray(batch.keys),
+        np.asarray(batch.entry_clocks), np.asarray(mv_clocks),
+        np.asarray(mv_vals), np.asarray(batch.d_keys),
+        np.asarray(batch.d_clocks),
+    ), batch
+
+
+def test_map_mvreg_merge_parity(engines):
+    """Native Map<K, MVReg> merge == jnp map_ops.merge, byte-for-byte —
+    the composition path (`map.rs:192-269`) through the C++ oracle."""
+    engine = engines[0]
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.ops import map_ops
+    from crdt_tpu.utils.interning import Universe
+
+    uni = Universe(CrdtConfig(
+        num_actors=6, member_capacity=8, deferred_capacity=6,
+        mv_capacity=8, key_capacity=8,
+    ))
+    vk = MVRegKernel.from_config(uni.config)
+    n_obj = 32
+    A, batch_a = _random_map_batch_arrays(101, n_obj, uni)
+    B, batch_b = _random_map_batch_arrays(202, n_obj, uni)
+
+    k_cap = A[1].shape[-1]
+    d_cap = A[5].shape[-1]
+    got_state, got_over = engine.map_mvreg_merge(A, B, k_cap, d_cap)
+
+    state_a = (batch_a.clock, batch_a.keys, batch_a.entry_clocks,
+               batch_a.vals, batch_a.d_keys, batch_a.d_clocks)
+    state_b = (batch_b.clock, batch_b.keys, batch_b.entry_clocks,
+               batch_b.vals, batch_b.d_keys, batch_b.d_clocks)
+    want_state, want_over = map_ops.merge(state_a, state_b, vk, k_cap, d_cap)
+    w_clock, w_keys, w_e, (w_mvc, w_mvv), w_dk, w_dc = want_state
+
+    np.testing.assert_array_equal(got_state[0], np.asarray(w_clock))
+    np.testing.assert_array_equal(got_state[1], np.asarray(w_keys))
+    np.testing.assert_array_equal(got_state[2], np.asarray(w_e))
+    np.testing.assert_array_equal(got_state[3], np.asarray(w_mvc))
+    np.testing.assert_array_equal(got_state[4], np.asarray(w_mvv))
+    np.testing.assert_array_equal(got_state[5], np.asarray(w_dk))
+    np.testing.assert_array_equal(got_state[6], np.asarray(w_dc))
+    np.testing.assert_array_equal(got_over, np.asarray(want_over))
+
+
+def test_map_mvreg_merge_deferred_parity(engines):
+    """Causally-future Map removes buffer and replay identically in the
+    C++ and jnp engines (`map.rs:256-267`)."""
+    engine = engines[0]
+
+    from crdt_tpu import Dot, Map, MVReg, VClock
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.ops import map_ops
+    from crdt_tpu.scalar.map import Rm as MapRm, Up
+    from crdt_tpu.scalar.mvreg import Put
+    from crdt_tpu.utils.interning import Universe
+
+    uni = Universe(CrdtConfig(
+        num_actors=6, member_capacity=8, deferred_capacity=6,
+        mv_capacity=8, key_capacity=8,
+    ))
+    vk = MVRegKernel.from_config(uni.config)
+
+    writer = Map(MVReg)
+    clock = VClock.from_iter([(0, 3)])
+    writer.apply(Up(dot=Dot(0, 3), key=1, op=Put(clock=clock, val=7)))
+
+    remover = Map(MVReg)
+    remover.apply(MapRm(clock=VClock.from_iter([(0, 3)]), key=1))  # future
+    assert remover.deferred
+
+    ba = MapBatch.from_scalar([writer], uni, vk)
+    bb = MapBatch.from_scalar([remover], uni, vk)
+
+    def arrays(b):
+        mvc, mvv = b.vals
+        return (np.asarray(b.clock), np.asarray(b.keys),
+                np.asarray(b.entry_clocks), np.asarray(mvc), np.asarray(mvv),
+                np.asarray(b.d_keys), np.asarray(b.d_clocks))
+
+    got_state, got_over = engine.map_mvreg_merge(arrays(ba), arrays(bb))
+    want_state, want_over = map_ops.merge(
+        (ba.clock, ba.keys, ba.entry_clocks, ba.vals, ba.d_keys, ba.d_clocks),
+        (bb.clock, bb.keys, bb.entry_clocks, bb.vals, bb.d_keys, bb.d_clocks),
+        vk, ba.keys.shape[-1], ba.d_keys.shape[-1],
+    )
+    w_clock, w_keys, w_e, (w_mvc, w_mvv), w_dk, w_dc = want_state
+    for got, want in zip(
+        got_state,
+        (w_clock, w_keys, w_e, w_mvc, w_mvv, w_dk, w_dc),
+    ):
+        np.testing.assert_array_equal(got, np.asarray(want))
+    # the asymmetric discard (`map.rs:256-260`): the remover's buffered row
+    # is already covered by the writer's clock, so it is dropped WITHOUT
+    # effect — the key survives and the deferred buffer drains
+    assert np.any(got_state[1] != -1), "covered deferred row must not remove"
+    assert np.all(got_state[5] == -1), "covered deferred row must drain"
+    np.testing.assert_array_equal(got_over, np.asarray(want_over))

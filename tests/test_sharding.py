@@ -116,6 +116,59 @@ def test_allgather_join_orswot_matches_scalar():
         assert got == expected, f"replica shard {r} diverged"
 
 
+def test_allgather_join_map_matches_scalar():
+    """Map collective join (`map.rs:192-269` combiner incl. nested value
+    merge + reset-remove) == scalar N-way left fold, on every device."""
+    import random as pyrandom
+
+    from crdt_tpu import Dot, Map, MVReg, VClock
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+    from crdt_tpu.parallel.collective import allgather_join_map
+    from crdt_tpu.scalar.map import Rm as MapRm, Up
+    from crdt_tpu.scalar.mvreg import Put
+
+    mesh = make_mesh({"replicas": 8})
+    uni = small_universe()
+    rng = pyrandom.Random(23)
+    n_objects = 4
+
+    def random_map():
+        m = Map(MVReg)
+        for _ in range(rng.randrange(0, 8)):
+            actor = rng.randrange(0, 8)
+            counter = rng.randrange(1, 6)
+            key = rng.randrange(0, 5)
+            clock = VClock.from_iter([(actor, counter)])
+            if rng.random() < 0.25:
+                m.apply(MapRm(clock=clock, key=key))
+            else:
+                m.apply(
+                    Up(dot=Dot(actor, counter), key=key,
+                       op=Put(clock=clock, val=rng.randrange(0, 9)))
+                )
+        return m
+
+    fleet = [[random_map() for _ in range(n_objects)] for _ in range(8)]
+    val_kernel = MVRegKernel.from_config(uni.config)
+    batches = [MapBatch.from_scalar(row, uni, val_kernel) for row in fleet]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+
+    joined = allgather_join_map(stacked, mesh, axis="replicas")
+
+    expected = []
+    for i in range(n_objects):
+        acc = fleet[0][i].clone()
+        for r in range(1, 8):
+            acc.merge(fleet[r][i])
+        expected.append(acc)
+
+    for r in range(8):
+        shard_state = jax.tree_util.tree_map(lambda x: x[r], joined.state)
+        shard = MapBatch.from_state(shard_state, joined.kernel)
+        got = shard.to_scalar(uni)
+        assert got == expected, f"replica shard {r} diverged"
+
+
 def test_anti_entropy_fixpoint_matches_scalar():
     uni = small_universe()
     fleet = random_orswots(seed=11, n_replicas=5, n_objects=8)

@@ -356,6 +356,61 @@ def bench_bulk_ingest():
     )
 
 
+def bench_tpu_validation():
+    """On a real TPU backend: compiled-Pallas parity + timing and
+    accel-vs-CPU merge parity, in a killable subprocess (a Mosaic hang
+    through the remote tunnel must not wedge the bench).  Failures leave a
+    captured repro in ``reports/PALLAS_TPU_ATTEMPT.txt``."""
+    import subprocess
+    import sys
+
+    import jax
+
+    if jax.default_backend() != "tpu":
+        log("tpu-validate: skipped (backend is not tpu)")
+        return
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "tpu_validate.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            timeout=float(os.environ.get("CRDT_TPU_VALIDATE_TIMEOUT", "900")),
+            capture_output=True,
+            text=True,
+        )
+        for line in proc.stdout.strip().splitlines():
+            log(f"tpu-validate: {line}")
+        if proc.returncode != 0:
+            _write_pallas_repro(
+                f"rc={proc.returncode}\nstdout:\n{proc.stdout}\n"
+                f"stderr tail:\n{proc.stderr[-4000:]}"
+            )
+    except subprocess.TimeoutExpired as te:
+        err = te.stderr or b""
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        log("tpu-validate: TIMED OUT (Mosaic hang? repro captured)")
+        _write_pallas_repro(
+            f"timeout after {te.timeout}s — the compiled-Pallas attempt hung "
+            f"through the tunnel\nstderr tail:\n{err[-4000:]}"
+        )
+
+
+def _write_pallas_repro(body: str) -> None:
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "reports", "PALLAS_TPU_ATTEMPT.txt")
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(
+                "# compiled-Pallas TPU attempt — captured failure\n"
+                "# repro: python scripts/tpu_validate.py --pallas\n" + body + "\n"
+            )
+        log(f"tpu-validate: failure details written to {path}")
+    except OSError:
+        pass
+
+
 def _probe_backend(total_budget_s: float) -> bool:
     """True when the default JAX backend initializes in a fresh process.
 
@@ -456,6 +511,7 @@ def main():
     bench_clock_merges()
     bench_orswot_pairwise()
     bench_bulk_ingest()
+    bench_tpu_validation()
     rate = bench_north_star()
 
     print(

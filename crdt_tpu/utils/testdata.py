@@ -122,3 +122,26 @@ def anti_entropy_fleets(
             )
         fleets.append((clock, ids, dots, d_ids, d_clocks))
     return fleets
+
+
+def random_mvreg_map(rng, n_keys=5, n_actors=6, max_ops=10, rm_p=0.3,
+                     max_counter=6, max_val=9):
+    """Random op-built scalar ``Map<int, MVReg>`` (`test/map.rs:13-46`
+    idiom) — the shared generator for batch-parity tests, collective-join
+    tests and the multichip dryrun.  ``rng``: ``np.random.RandomState``."""
+    from ..scalar.map import Map, Rm as MapRm, Up
+    from ..scalar.mvreg import MVReg, Put
+    from ..scalar.vclock import Dot, VClock
+
+    m = Map(MVReg)
+    for _ in range(int(rng.randint(0, max_ops))):
+        actor = int(rng.randint(0, n_actors))
+        counter = int(rng.randint(1, max_counter))
+        key = int(rng.randint(0, n_keys))
+        clock = VClock.from_iter([(actor, counter)])
+        if rng.rand() < rm_p:
+            m.apply(MapRm(clock=clock, key=key))
+        else:
+            m.apply(Up(dot=Dot(actor, counter), key=key,
+                       op=Put(clock=clock, val=int(rng.randint(0, max_val)))))
+    return m

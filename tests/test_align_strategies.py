@@ -61,3 +61,26 @@ def test_large_m_sorted_path_is_pad_invariant():
     )
     assert not (np.asarray(out_big[1])[..., cap:] != -1).any()
     assert not np.asarray(out_big[5]).any(), "padded merge must not overflow"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fast_and_deferred_paths_agree_without_deferred(seed):
+    """Differential invariant behind the lax.cond dispatch: on
+    deferred-free inputs the rank-select fast path and the full deferred
+    pipeline must be bit-identical (replay over empty tables is the
+    identity)."""
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import clock_ops, orswot_ops
+    from crdt_tpu.utils.testdata import random_orswot_arrays
+
+    rng = np.random.RandomState(seed)
+    n, a, m, d = 64, 8, 6, 3
+    L = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    R = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    clock = clock_ops.merge(L[0], R[0])
+
+    fast = orswot_ops._merge_narrow_fast(clock, *L, *R, m, d)
+    slow = orswot_ops._merge_narrow_deferred(clock, *L, *R, m, d)
+    for f, s in zip(fast, slow):
+        np.testing.assert_array_equal(np.asarray(f), np.asarray(s))

@@ -31,10 +31,22 @@ Design notes (vs the jnp path):
   same rank trick with slot index as the key.
 * Counters are ``uint32`` on the Pallas path (Mosaic has no 64-bit
   vectors); the scalar/u64 path remains the parity oracle for u64.
+  Inside the kernel counters are held as **bias-mapped int32** —
+  ``x ^ 0x8000_0000`` bitcast to int32 — because Mosaic has no
+  unsigned-integer reductions.  The XOR bias is an order-preserving
+  bijection uint32→int32, and this kernel only ever *compares, maxes
+  and selects* counters (never adds them), so signed-domain arithmetic
+  is exact over the full uint32 range; counter ``0`` becomes the
+  sentinel :data:`ZERO` (= INT32_MIN) inside the kernel.  The
+  entry/exit bias is one fused XOR outside the kernel.
 
-Deployment note: remote-TPU tunnels that proxy a single chip (the "axon"
-platform plugin in this dev environment) hang in Mosaic lowering even for
-trivial kernels, so the benchmark harness only engages this path when
+Deployment note: the kernels lower to Mosaic cleanly (see
+``reports/PALLAS_TPU_ATTEMPT.txt`` for the x64 pitfalls this required:
+32-bit trace mode, signed-domain reductions, int32 index-map constants).
+Remote-TPU tunnels that proxy a single chip (the "axon" plugin in this
+dev environment) currently cannot *execute* them — the terminal's
+compile helper is env-cleared and its runtime libtpu predates the client
+AOT libtpu — so the benchmark harness only engages this path when
 ``CRDT_PALLAS=1`` is set on hardware with native Mosaic support; the jnp
 path is the portable default and the two are bit-identical
 (``tests/test_orswot_pallas.py``).
@@ -53,9 +65,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 EMPTY = -1
+# biased-int32 representation of counter 0 (see module docstring): the
+# kernel-internal "absent / empty clock lane" sentinel
+ZERO = np.int32(-(2**31))
+_BIAS = np.uint32(0x8000_0000)
 
 
 # ---------------------------------------------------------------------------
@@ -64,42 +81,46 @@ EMPTY = -1
 
 
 def _align_against(ids_a, dots_a, ids_b, dots_b):
-    """For each a-slot, the matching b dot clock (0 if unmatched), plus the
-    mask of b-slots consumed by a match.  O(M_a · M_b) masked compares."""
+    """For each a-slot, the matching b dot clock (``ZERO`` — the biased
+    empty lane — if unmatched), plus the mask of b-slots consumed by a
+    match.  O(M_a · M_b) masked compares."""
     m_b = ids_b.shape[-1]
     valid_a = ids_a != EMPTY
-    e2 = jnp.zeros_like(dots_a)
-    b_matched = jnp.zeros(ids_b.shape, dtype=bool)
+    e2 = jnp.full_like(dots_a, ZERO)
+    # columns are collected and stacked rather than written with
+    # ``.at[..., j].set`` — under jax_enable_x64 the scatter's literal
+    # start indices trace as int64 scalars, which Mosaic cannot lower
+    b_cols = []
     for j in range(m_b):
         mj = valid_a & (ids_a == ids_b[..., j : j + 1])  # [T, M_a]
-        e2 = jnp.maximum(e2, jnp.where(mj[..., None], dots_b[..., j : j + 1, :], 0))
-        b_matched = b_matched.at[..., j].set(jnp.any(mj, axis=-1))
-    return e2, b_matched
+        e2 = jnp.maximum(e2, jnp.where(mj[..., None], dots_b[..., j : j + 1, :], ZERO))
+        b_cols.append(jnp.any(mj, axis=-1))
+    return e2, jnp.stack(b_cols, axis=-1)
 
 
 def _merge_rule(e1, e2, p1, p2, valid, self_clock, other_clock):
     """The three-way per-member dot-algebra (`orswot.rs:92-138`)."""
     sc = self_clock[..., None, :]
     oc = other_clock[..., None, :]
-    common = jnp.where(e1 == e2, e1, 0)
+    common = jnp.where(e1 == e2, e1, ZERO)
     c1 = _sub(_sub(e1, common), oc)
     c2 = _sub(_sub(e2, common), sc)
     out_both = jnp.maximum(common, jnp.maximum(c1, c2))
     keep1 = ~jnp.all(e1 <= oc, axis=-1)
-    out_only1 = jnp.where(keep1[..., None], e1, 0)
+    out_only1 = jnp.where(keep1[..., None], e1, ZERO)
     out_only2 = _sub(e2, sc)
     both = (p1 & p2)[..., None]
     only1 = (p1 & ~p2)[..., None]
     out = jnp.where(both, out_both, jnp.where(only1, out_only1, out_only2))
-    return jnp.where(valid[..., None], out, 0)
+    return jnp.where(valid[..., None], out, ZERO)
 
 
 def _sub(a, b):
-    return jnp.where(a > b, a, 0)
+    return jnp.where(a > b, a, ZERO)
 
 
 def _nonempty(clock):
-    return jnp.any(clock != 0, axis=-1)
+    return jnp.any(clock != ZERO, axis=-1)
 
 
 def _rank_select(keys, live, payload_ids, payload_clocks, cap):
@@ -121,7 +142,7 @@ def _rank_select(keys, live, payload_ids, payload_clocks, cap):
             jnp.sum(jnp.where(sel, payload_ids + 1, 0), axis=-1, dtype=jnp.int32) - 1
         )
         out_clocks.append(
-            jnp.max(jnp.where(sel[..., None], payload_clocks, 0), axis=-2)
+            jnp.max(jnp.where(sel[..., None], payload_clocks, ZERO), axis=-2)
         )
     ids = jnp.stack(out_ids, axis=-1)
     clocks = jnp.stack(out_clocks, axis=-2)
@@ -147,7 +168,7 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
     )
     # unmatched b members: the only-in-other rule (`orswot.rs:132-138`)
     b_only = valid_b & ~b_matched
-    out_b = jnp.where(b_only[..., None], _sub(dots_b, ca[..., None, :]), 0)
+    out_b = jnp.where(b_only[..., None], _sub(dots_b, ca[..., None, :]), ZERO)
 
     ids_cat = jnp.concatenate(
         [jnp.where(valid_a, ids_a, EMPTY), jnp.where(b_only, ids_b, EMPTY)], axis=-1
@@ -159,7 +180,8 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
     d_clocks = jnp.concatenate([dca, dcb], axis=-2)
     dn = d_ids.shape[-1]
     d_valid = d_ids != EMPTY
-    is_dup = jnp.zeros(d_ids.shape, dtype=bool)
+    # column-stack instead of .at[].set — see _align_against
+    dup_cols = [jnp.zeros(d_ids.shape[:-1], dtype=bool)]
     for j in range(1, dn):
         dup_j = jnp.zeros(d_ids.shape[:-1], dtype=bool)
         for i in range(j):
@@ -170,17 +192,20 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
                 & jnp.all(d_clocks[..., i, :] == d_clocks[..., j, :], axis=-1)
             )
             dup_j = dup_j | same
-        is_dup = is_dup.at[..., j].set(dup_j)
+        dup_cols.append(dup_j)
+    is_dup = jnp.stack(dup_cols, axis=-1)
     d_live = d_valid & ~is_dup
     d_ids = jnp.where(d_live, d_ids, EMPTY)
-    d_clocks = jnp.where(d_live[..., None], d_clocks, 0)
+    d_clocks = jnp.where(d_live[..., None], d_clocks, ZERO)
 
     # --- clock join (`orswot.rs:153`) then deferred replay (`:155`) ---
     clock = jnp.maximum(ca, cb)
-    rm = jnp.zeros_like(dots_cat)
+    rm = jnp.full_like(dots_cat, ZERO)
     for k in range(dn):
         match = (ids_cat == d_ids[..., k : k + 1]) & d_live[..., k : k + 1]
-        rm = jnp.maximum(rm, jnp.where(match[..., None], d_clocks[..., k : k + 1, :], 0))
+        rm = jnp.maximum(
+            rm, jnp.where(match[..., None], d_clocks[..., k : k + 1, :], ZERO)
+        )
     new_dots = _sub(dots_cat, rm)
     live = _nonempty(new_dots) & (ids_cat != EMPTY)
     still_ahead = d_live & ~jnp.all(d_clocks <= clock[..., None, :], axis=-1)
@@ -209,6 +234,26 @@ def _check_dtypes(clock):
             f"Pallas ORSWOT kernels need <=32-bit counters, got {clock.dtype}; "
             "use the jnp path (orswot_ops) for u64"
         )
+
+
+def _to_kernel_dtype(state):
+    """Bias-map the clock-valued planes to int32 for the kernel.
+
+    ``state`` is the canonical 5-tuple ``(clock, ids, dots, d_ids,
+    d_clocks)``; planes 0/2/4 carry counters and get the order-preserving
+    ``x ^ 0x8000_0000`` bitcast (exact over the full uint32 range — the
+    kernel only compares/maxes/selects counters), planes 1/3 are already
+    int32 member ids."""
+    clock, ids, dots, d_ids, d_clocks = state
+    bias = lambda x: jax.lax.bitcast_convert_type(
+        x.astype(jnp.uint32) ^ _BIAS, jnp.int32
+    )
+    return bias(clock), ids, bias(dots), d_ids, bias(d_clocks)
+
+
+def _from_kernel_dtype(x, cdt):
+    """Invert :func:`_to_kernel_dtype`'s bias on one counter plane."""
+    return (jax.lax.bitcast_convert_type(x, jnp.uint32) ^ _BIAS).astype(cdt)
 
 
 def _tile_size(a, m, d, n_states=2, vmem_budget=8 * 1024 * 1024):
@@ -243,13 +288,19 @@ def _pad_to(x, t, axis=0, fill=0):
     return jnp.pad(x, widths, constant_values=fill)
 
 
+_ZERO = np.int32(0)  # index-map constants must be 32-bit: under
+# jax_enable_x64 a literal ``0`` traces as an int64 scalar, and Mosaic has
+# no 64-bit support (the int64→int32 truncation recurses forever in its
+# convert helper)
+
+
 def _state_specs(t, shapes, batch_axes=1):
     """BlockSpecs blocking the leading object axis into tiles of ``t``."""
     specs = []
     for shp in shapes:
         block = (t,) + shp[batch_axes:]
         rest = len(shp) - batch_axes
-        specs.append(pl.BlockSpec(block, lambda i, _r=rest: (i,) + (0,) * _r))
+        specs.append(pl.BlockSpec(block, lambda i, _r=rest: (i,) + (_ZERO,) * _r))
     return specs
 
 
@@ -267,6 +318,7 @@ def merge(
     ``[N, ...]`` states, uint32 counters).  Returns
     ``(clock, ids, dots, d_ids, d_clocks, overflow)``."""
     _check_dtypes(clock_a)
+    _check_dtypes(clock_b)
     if interpret is None:
         interpret = _interpret_default()
     n, a = clock_a.shape
@@ -276,6 +328,7 @@ def merge(
     sb = (clock_b, ids_b, dots_b, dids_b, dclocks_b)
     sa = tuple(_pad_to(x, t, fill=EMPTY if x.dtype == jnp.int32 else 0) for x in sa)
     sb = tuple(_pad_to(x, t, fill=EMPTY if x.dtype == jnp.int32 else 0) for x in sb)
+    sa, sb = _to_kernel_dtype(sa), _to_kernel_dtype(sb)
     n_pad = sa[0].shape[0]
     cdt = clock_a.dtype
 
@@ -291,23 +344,31 @@ def merge(
 
     in_shapes = [x.shape for x in sa] * 2
     out_shape = (
-        jax.ShapeDtypeStruct((n_pad, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, a), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, m_cap), jnp.int32),
-        jax.ShapeDtypeStruct((n_pad, m_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, m_cap, a), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, d_cap), jnp.int32),
-        jax.ShapeDtypeStruct((n_pad, d_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, d_cap, a), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_pad // t,),
-        in_specs=_state_specs(t, in_shapes),
-        out_specs=_state_specs(t, [s.shape for s in out_shape]),
-        out_shape=out_shape,
-        interpret=interpret,
-    )(*sa, *sb)
+    # the kernel must trace in 32-bit mode: under jax_enable_x64 every
+    # Python-int literal (the `0`s in jnp.where etc.) becomes an i64[]
+    # scalar operand, and Mosaic has no 64-bit support — its convert
+    # helper recurses forever on the i64→i32 truncation
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_pad // t,),
+            in_specs=_state_specs(t, in_shapes),
+            out_specs=_state_specs(t, [s.shape for s in out_shape]),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*sa, *sb)
     clock, ids, dots, dids, dclk, over = (x[:n] for x in out)
-    return clock, ids, dots, dids, dclk, over.astype(bool)
+    return (
+        _from_kernel_dtype(clock, cdt), ids, _from_kernel_dtype(dots, cdt),
+        dids, _from_kernel_dtype(dclk, cdt), over.astype(bool),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret", "plunger"))
@@ -334,6 +395,7 @@ def fold_merge(
     state = tuple(
         _pad_to(x, t, axis=1, fill=EMPTY if x.dtype == jnp.int32 else 0) for x in state
     )
+    state = _to_kernel_dtype(state)
     n_pad = state[0].shape[1]
     cdt = clock.dtype
 
@@ -355,23 +417,31 @@ def fold_merge(
     for x in state:
         rest = x.ndim - 2
         in_specs.append(
-            pl.BlockSpec((r, t) + x.shape[2:], lambda i, _r=rest: (0, i) + (0,) * _r)
+            pl.BlockSpec(
+                (r, t) + x.shape[2:],
+                lambda i, _r=rest: (_ZERO, i) + (_ZERO,) * _r,
+            )
         )
     out_shape = (
-        jax.ShapeDtypeStruct((n_pad, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, a), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, m_cap), jnp.int32),
-        jax.ShapeDtypeStruct((n_pad, m_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, m_cap, a), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, d_cap), jnp.int32),
-        jax.ShapeDtypeStruct((n_pad, d_cap, a), cdt),
+        jax.ShapeDtypeStruct((n_pad, d_cap, a), jnp.int32),
         jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_pad // t,),
-        in_specs=in_specs,
-        out_specs=_state_specs(t, [s.shape for s in out_shape]),
-        out_shape=out_shape,
-        interpret=interpret,
-    )(*state)
+    # 32-bit trace mode — see the matching comment in merge()
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_pad // t,),
+            in_specs=in_specs,
+            out_specs=_state_specs(t, [s.shape for s in out_shape]),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(*state)
     c, i, dts, di, dc, over = (x[:n] for x in out)
-    return c, i, dts, di, dc, over.astype(bool)
+    return (
+        _from_kernel_dtype(c, cdt), i, _from_kernel_dtype(dts, cdt), di,
+        _from_kernel_dtype(dc, cdt), over.astype(bool),
+    )

@@ -127,8 +127,9 @@ def anti_entropy_fleets(
 def random_mvreg_map(rng, n_keys=5, n_actors=6, max_ops=10, rm_p=0.3,
                      max_counter=6, max_val=9):
     """Random op-built scalar ``Map<int, MVReg>`` (`test/map.rs:13-46`
-    idiom) — the shared generator for batch-parity tests, collective-join
-    tests and the multichip dryrun.  ``rng``: ``np.random.RandomState``."""
+    idiom), used by the multichip dryrun.  (The batch-parity and
+    collective-join tests still carry their own inline op generators.)
+    ``rng``: ``np.random.RandomState``."""
     from ..scalar.map import Map, Rm as MapRm, Up
     from ..scalar.mvreg import MVReg, Put
     from ..scalar.vclock import Dot, VClock

@@ -1,0 +1,103 @@
+"""Bisect the Mosaic RecursionError seen in compiled orswot_pallas.
+
+Runs a ladder of probes on the default backend, printing PASS/FAIL per
+probe, so the offending primitive/dtype pair is pinned down.  Temporary
+diagnostic tool; safe to run on CPU (interpret) or TPU (compiled).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.setrecursionlimit(2000)
+
+
+def probe(name, fn):
+    try:
+        out = fn()
+        jax.block_until_ready(out)
+        print(f"PASS {name}")
+        return True
+    except Exception as e:  # noqa: BLE001
+        tb = traceback.format_exc()
+        first = "\n".join(tb.splitlines()[:3])
+        last = "\n".join(tb.splitlines()[-3:])
+        print(f"FAIL {name}: {type(e).__name__}\n{first}\n...\n{last}")
+        return False
+
+
+def run_kernel(body, outs, *args):
+    def kernel(*refs):
+        ins = refs[: len(args)]
+        os = refs[len(args):]
+        vals = body(*[r[...] for r in ins])
+        if not isinstance(vals, tuple):
+            vals = (vals,)
+        for r, v in zip(os, vals):
+            r[...] = v
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=outs,
+        interpret=False,
+    )(*args)
+
+
+def main():
+    print("backend:", jax.default_backend())
+    t, a = 8, 128
+    u = jnp.ones((t, a), jnp.uint32)
+    i = jnp.ones((t, a), jnp.int32)
+    b = jnp.ones((t, a), bool)
+
+    probe("trivial add u32", lambda: run_kernel(
+        lambda x, y: x + y, jax.ShapeDtypeStruct((t, a), jnp.uint32), u, u))
+    probe("bool.astype(int32)", lambda: run_kernel(
+        lambda x: x.astype(jnp.int32), jax.ShapeDtypeStruct((t, a), jnp.int32), b))
+    probe("bool sum dtype=int32", lambda: run_kernel(
+        lambda x: jnp.sum(x, axis=-1, dtype=jnp.int32, keepdims=True),
+        jax.ShapeDtypeStruct((t, 1), jnp.int32), b))
+    probe("uint32.astype(int32)", lambda: run_kernel(
+        lambda x: x.astype(jnp.int32), jax.ShapeDtypeStruct((t, a), jnp.int32), u))
+    probe("int32.astype(uint32)", lambda: run_kernel(
+        lambda x: x.astype(jnp.uint32), jax.ShapeDtypeStruct((t, a), jnp.uint32), i))
+    probe("bool.astype(uint32)", lambda: run_kernel(
+        lambda x: x.astype(jnp.uint32), jax.ShapeDtypeStruct((t, a), jnp.uint32), b))
+    probe("where(bool,u32,0)", lambda: run_kernel(
+        lambda x, y: jnp.where(y, x, 0), jax.ShapeDtypeStruct((t, a), jnp.uint32), u, b))
+    probe("max-reduce u32", lambda: run_kernel(
+        lambda x: jnp.max(x, axis=-1, keepdims=True),
+        jax.ShapeDtypeStruct((t, 1), jnp.uint32), u))
+    probe("bool any-reduce", lambda: run_kernel(
+        lambda x: jnp.any(x, axis=-1, keepdims=True),
+        jax.ShapeDtypeStruct((t, 1), bool), b))
+
+    # the real kernels at bench shapes
+    from crdt_tpu.ops import orswot_pallas
+    from crdt_tpu.utils.testdata import anti_entropy_fleets, random_orswot_arrays
+
+    rng = np.random.RandomState(5)
+    n, aa, m, d = 256, 16, 8, 2
+    L = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, aa, m, d))
+    R = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, aa, m, d))
+    probe("orswot_pallas.merge compiled", lambda: orswot_pallas.merge(
+        *L, *R, m, d, interpret=False))
+
+    fleets = anti_entropy_fleets(rng, n, aa, m, d, 4, base=5, novel=0)
+    stacked = tuple(
+        jnp.stack([jnp.asarray(rep[k]) for rep in fleets]) for k in range(5)
+    )
+    probe("orswot_pallas.fold_merge compiled", lambda: orswot_pallas.fold_merge(
+        *stacked, m, d, interpret=False))
+
+
+if __name__ == "__main__":
+    main()

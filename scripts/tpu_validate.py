@@ -22,10 +22,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
 def _timeit(fn, *args, iters=3):
     import jax
 

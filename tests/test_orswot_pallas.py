@@ -90,3 +90,27 @@ def test_u64_counters_rejected():
     )
     with pytest.raises(TypeError, match="32-bit"):
         orswot_pallas.merge(*lhs, *lhs, 3, 2, interpret=True)
+
+
+def test_full_uint32_counter_range_parity():
+    """Counters at and above 2**31 must merge bit-identically — the kernel
+    works in a bias-mapped signed domain (x ^ 0x8000_0000) precisely so
+    the full uint32 range stays exact (a plain int32 cast would wrap and
+    silently corrupt the merge)."""
+    rng = np.random.RandomState(6)
+    n, a, m, d = 16, 4, 4, 2
+    lhs, rhs = _pair(rng, n, a, m, d)
+
+    def inflate(state):
+        clock, ids, dots, dids, dclocks = state
+        big = jnp.uint32(1 << 31)
+        # preserve the 0 = absent-lane invariant while pushing every live
+        # counter into the high half of the uint32 range
+        up = lambda x: jnp.where(x > 0, x + big, x)
+        return up(clock), ids, up(dots), dids, up(dclocks)
+
+    lhs, rhs = inflate(lhs), inflate(rhs)
+    ref = orswot_ops.merge(*lhs, *rhs, m, d)
+    got = orswot_pallas.merge(*lhs, *rhs, m, d, interpret=True)
+    _assert_same(ref, got)
+    assert int(np.asarray(got[0]).max()) >= 1 << 31, "fixture must exercise the high half"

@@ -94,7 +94,7 @@ def _align_against(ids_a, dots_a, ids_b, dots_b):
     for j in range(m_b):
         mj = valid_a & (ids_a == ids_b[..., j : j + 1])  # [T, M_a]
         e2 = jnp.maximum(e2, jnp.where(mj[..., None], dots_b[..., j : j + 1, :], ZERO))
-        b_cols.append(jnp.any(mj, axis=-1))
+        b_cols.append(_any(mj))
     return e2, jnp.stack(b_cols, axis=-1)
 
 
@@ -106,7 +106,7 @@ def _merge_rule(e1, e2, p1, p2, valid, self_clock, other_clock):
     c1 = _sub(_sub(e1, common), oc)
     c2 = _sub(_sub(e2, common), sc)
     out_both = jnp.maximum(common, jnp.maximum(c1, c2))
-    keep1 = ~jnp.all(e1 <= oc, axis=-1)
+    keep1 = ~_all(e1 <= oc)
     out_only1 = jnp.where(keep1[..., None], e1, ZERO)
     out_only2 = _sub(e2, sc)
     both = (p1 & p2)[..., None]
@@ -119,8 +119,21 @@ def _sub(a, b):
     return jnp.where(a > b, a, ZERO)
 
 
+def _any(x, axis=-1):
+    """Bool any-reduce in the int32 domain.  JAX's Mosaic lowering proxies
+    ``reduce_or`` through float literals (``jnp.where(b, 1.0, 0.0)`` +
+    ``maximumf``), which become unsupported f64 under jax_enable_x64; an
+    int32 max-reduce lowers natively (MAXSI)."""
+    return jnp.max(x.astype(jnp.int32), axis=axis) > 0
+
+
+def _all(x, axis=-1):
+    """Bool all-reduce in the int32 domain (see :func:`_any`)."""
+    return jnp.min(x.astype(jnp.int32), axis=axis) > 0
+
+
 def _nonempty(clock):
-    return jnp.any(clock != ZERO, axis=-1)
+    return _any(clock != ZERO)
 
 
 def _rank_select(keys, live, payload_ids, payload_clocks, cap):
@@ -189,7 +202,7 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
                 d_valid[..., i]
                 & d_valid[..., j]
                 & (d_ids[..., i] == d_ids[..., j])
-                & jnp.all(d_clocks[..., i, :] == d_clocks[..., j, :], axis=-1)
+                & _all(d_clocks[..., i, :] == d_clocks[..., j, :])
             )
             dup_j = dup_j | same
         dup_cols.append(dup_j)
@@ -208,7 +221,7 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
         )
     new_dots = _sub(dots_cat, rm)
     live = _nonempty(new_dots) & (ids_cat != EMPTY)
-    still_ahead = d_live & ~jnp.all(d_clocks <= clock[..., None, :], axis=-1)
+    still_ahead = d_live & ~_all(d_clocks <= clock[..., None, :])
 
     # --- canonical compaction ---
     big = jnp.iinfo(jnp.int32).max

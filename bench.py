@@ -223,17 +223,26 @@ def bench_north_star():
             tuple(jnp.stack([rep[k] for rep in reps]) for k in range(5))
         )
 
-    def fold_join(stack):
-        acc = tuple(x[0] for x in stack)
-        for i in range(1, r):
-            acc = orswot_ops.merge(*acc, *(x[i] for x in stack), m, d)[:5]
-        # defer plunger: one self-merge pass flushes deferred removes
-        acc = orswot_ops.merge(*acc, *acc, m, d)[:5]
-        return acc
+    if os.environ.get("CRDT_TREE_FOLD") == "1":
+        # pairwise tree reduction: same R-1 merges, log-depth dependency
+        # chain, each level one batched call.  Opt-in: measured 2.3x
+        # SLOWER than the sequential fold on the CPU backend (the [R/2,
+        # chunk] level-1 working set blows the cache hierarchy), so the
+        # default stays sequential until the tree is measured faster on
+        # the target backend.
+        def fold_join(stack):
+            return orswot_ops.fold_merge_tree(*stack, m, d)[:5]
+    else:
+        def fold_join(stack):
+            acc = tuple(x[0] for x in stack)
+            for i in range(1, r):
+                acc = orswot_ops.merge(*acc, *(x[i] for x in stack), m, d)[:5]
+            # defer plunger: one self-merge pass flushes deferred removes
+            return orswot_ops.merge(*acc, *acc, m, d)[:5]
 
-    # parity sample: batch fold of the first template's first objects must
-    # reproduce the scalar engine's N-way merge value() exactly
-    _north_star_parity(templates[0], r, a, m, d)
+    # parity sample: the SELECTED fold on the first template's first
+    # objects must reproduce the scalar engine's N-way merge value()
+    _north_star_parity(templates[0], r, a, m, d, fold_join)
 
     n_chunks = max(2, n // chunk)
 
@@ -339,49 +348,27 @@ def bench_north_star():
     return rate
 
 
-def _dense_row_to_scalar(clock_row, ids_row, dots_row, dids_row, dclocks_row):
-    """Scalar Orswot from one dense object's rows — actors are the dense
-    column indices, members the raw interned ids (no Universe needed)."""
-    from crdt_tpu.scalar.orswot import Orswot
-    from crdt_tpu.scalar.vclock import VClock
-
-    o = Orswot()
-    o.clock = VClock({i: int(c) for i, c in enumerate(clock_row) if int(c)})
-    for s, mid in enumerate(ids_row):
-        if int(mid) != -1:
-            o.entries[int(mid)] = VClock(
-                {i: int(c) for i, c in enumerate(dots_row[s]) if int(c)}
-            )
-    for s, mid in enumerate(dids_row):
-        if int(mid) != -1:
-            vc = VClock({i: int(c) for i, c in enumerate(dclocks_row[s]) if int(c)})
-            o.deferred.setdefault(vc.key(), set()).add(int(mid))
-    return o
-
-
-def _north_star_parity(template, r, a, m, d):
-    """Cross-check the device fold against the scalar oracle on a sample."""
+def _north_star_parity(template, r, a, m, d, fold_join):
+    """Cross-check THE fold being timed (sequential or tree, whichever
+    ``fold_join`` the bench selected) against the scalar oracle on a
+    sample — a fold regression must fail here, not publish timings."""
     import jax.numpy as jnp
 
-    from crdt_tpu.ops import orswot_ops
     from crdt_tpu.scalar.orswot import Orswot
+    from crdt_tpu.utils.testdata import dense_row_to_scalar
 
     sample = 8
     small = tuple(np.asarray(x[:, :sample]) for x in template)
-
-    def fold(stack):
-        acc = tuple(jnp.asarray(x[0]) for x in stack)
-        for i in range(1, r):
-            acc = orswot_ops.merge(*acc, *(jnp.asarray(x[i]) for x in stack), m, d)[:5]
-        return orswot_ops.merge(*acc, *acc, m, d)[:5]
-
-    got = [np.asarray(x) for x in fold(small)]
+    got = [
+        np.asarray(x)
+        for x in fold_join(tuple(jnp.asarray(x) for x in small))
+    ]
 
     for obj in range(sample):
         merged = Orswot()
         for i in range(r):
             merged.merge(
-                _dense_row_to_scalar(*(x[i, obj] for x in small))
+                dense_row_to_scalar(*(x[i, obj] for x in small))
             )
         merged.merge(Orswot())  # defer plunger
         got_members = {int(mid) for mid in got[1][obj] if int(mid) != -1}

@@ -240,6 +240,42 @@ class OrswotBatch:
             raise_for_overflow(overflow, "merge")
         return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
+    @classmethod
+    def join_fleet(
+        cls, fleets: Sequence["OrswotBatch"], check: bool = True,
+        plunger: bool = True,
+    ) -> "OrswotBatch":
+        """N-way anti-entropy join of replica fleets holding the same
+        objects — the device-shaped form of the reference's merge-all
+        loop (`/root/reference/test/orswot.rs:45-62`).
+
+        Stacks the fleets on a new leading axis and reduces them as a
+        pairwise tree (:func:`crdt_tpu.ops.orswot_ops.fold_merge_tree`):
+        log-depth dependency chain, each level one batched merge.  The
+        optional defer plunger flushes buffered removes at the end."""
+        if len(fleets) == 0:
+            raise ValueError("join_fleet needs at least one fleet")
+        if len(fleets) == 1:
+            # still run the plunger self-merge so the output is canonical
+            # (ascending-id slot order, deferred flushed) regardless of
+            # fleet count
+            f = fleets[0]
+            if not plunger:
+                return f
+            return f.merge(f, check=check)
+        m_cap = fleets[0].ids.shape[-1]
+        d_cap = fleets[0].d_ids.shape[-1]
+        stacked = [
+            jnp.stack([getattr(f, name) for f in fleets])
+            for name in ("clock", "ids", "dots", "d_ids", "d_clocks")
+        ]
+        clock, ids, dots, d_ids, d_clocks, overflow = _fold_tree(
+            *stacked, m_cap, d_cap, plunger
+        )
+        if check:
+            raise_for_overflow(overflow, "join_fleet")
+        return cls(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
+
     # -- op path ----------------------------------------------------------
 
     def apply_add(self, actor_idx, counter, member_id, check: bool = True) -> "OrswotBatch":
@@ -293,6 +329,13 @@ class OrswotBatch:
 @functools.partial(jax.jit, static_argnums=(10, 11))
 def _merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap):
     return orswot_ops.merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
+def _fold_tree(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger):
+    return orswot_ops.fold_merge_tree(
+        clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger=plunger
+    )
 
 
 @jax.jit

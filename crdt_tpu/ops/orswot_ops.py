@@ -420,6 +420,63 @@ def _merge_wide(
     return clock, ids, out_dots, d_ids, d_clocks, jnp.stack([m_over, d_over], axis=-1)
 
 
+def fold_merge_tree(
+    clock, ids, dots, dids, dclocks, m_cap: int, d_cap: int,
+    plunger: bool = True,
+):
+    """Join ``R`` stacked replica fleets (arrays ``[R, N, ...]``) into one
+    ``[N, ...]`` state by pairwise tree reduction.
+
+    Same R-1 merges (plus an optional defer-plunger self-merge,
+    `/root/reference/test/orswot.rs:61-62`) as the sequential left fold,
+    but tree level ``l`` executes its ``R / 2**l`` pairwise merges as ONE
+    batched :func:`merge` call over a ``[R/2**l, N, ...]`` leading axis —
+    a log-depth dependency chain with maximal batch per launch, which is
+    the shape accelerators want.
+
+    Equivalence to the left fold: for deferred-free states the merge is
+    a pure lattice join (`orswot.rs:89-156`) over a canonical encoding
+    (ascending-id member order, pointwise-max clocks), so tree and left
+    fold are **bit-identical**.  When causally-future removes are in
+    flight, the reference's own semantics are fold-order-sensitive in
+    the *dot tables*: ``apply_deferred`` (`orswot.rs:195-211,235-243`)
+    subtracts the remove clock during every intermediate merge, so which
+    dots it erases depends on which partner states have already been
+    joined — the scalar engine reproduces exactly this (verified in
+    ``tests/test_orswot.py::TestFoldMergeTree``).  ``value()``, the set
+    clock, and the member table remain order-independent, which is the
+    CRDT convergence guarantee; this function is bit-faithful to the
+    scalar engine folding in the same tree order.
+
+    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)`` with
+    ``overflow`` OR-reduced over every merge in the tree.
+    """
+    state = (clock, ids, dots, dids, dclocks)
+    r = clock.shape[0]
+    over_acc = jnp.zeros(clock.shape[1:-1] + (2,), bool)
+    while r > 1:
+        half = r // 2
+        lhs = tuple(x[0 : 2 * half : 2] for x in state)
+        rhs = tuple(x[1 : 2 * half : 2] for x in state)
+        out = merge(*lhs, *rhs, m_cap, d_cap)
+        merged, over = out[:5], out[5]
+        over_acc = over_acc | jnp.any(over, axis=0)
+        if r % 2:
+            # odd fleet carries through to the next level
+            merged = tuple(
+                jnp.concatenate([m, x[-1:]], axis=0)
+                for m, x in zip(merged, state)
+            )
+        state = merged
+        r = half + r % 2
+    state = tuple(x[0] for x in state)
+    if plunger:
+        out = merge(*state, *state, m_cap, d_cap)
+        state, over = out[:5], out[5]
+        over_acc = over_acc | over
+    return state + (over_acc,)
+
+
 def apply_add(clock, ids, dots, dids, dclocks, actor_idx, counter, member_id):
     """Batched ``Op::Add`` (`orswot.rs:66-79`): one add per object.
 

@@ -146,3 +146,25 @@ def random_mvreg_map(rng, n_keys=5, n_actors=6, max_ops=10, rm_p=0.3,
             m.apply(Up(dot=Dot(actor, counter), key=key,
                        op=Put(clock=clock, val=int(rng.randint(0, max_val)))))
     return m
+
+
+def dense_row_to_scalar(clock_row, ids_row, dots_row, dids_row, dclocks_row):
+    """Scalar Orswot from one dense object's rows — actors are the dense
+    column indices, members the raw interned ids (no Universe needed).
+    The shared oracle-side converter for the bench parity sample and the
+    fold-order tests."""
+    from ..scalar.orswot import Orswot
+    from ..scalar.vclock import VClock
+
+    o = Orswot()
+    o.clock = VClock({i: int(c) for i, c in enumerate(clock_row) if int(c)})
+    for s, mid in enumerate(ids_row):
+        if int(mid) != -1:
+            o.entries[int(mid)] = VClock(
+                {i: int(c) for i, c in enumerate(dots_row[s]) if int(c)}
+            )
+    for s, mid in enumerate(dids_row):
+        if int(mid) != -1:
+            vc = VClock({i: int(c) for i, c in enumerate(dclocks_row[s]) if int(c)})
+            o.deferred.setdefault(vc.key(), set()).add(int(mid))
+    return o

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Poll the axon tunnel; when it revives, immediately capture a full TPU
+# bench run and a compiled-Pallas attempt before it can wedge again.
+cd /root/repo
+for i in $(seq 1 200); do
+    if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing bench" | tee -a /tmp/tunnel_watch.log
+        timeout 3000 python bench.py > /tmp/bench_tpu3.log 2>&1
+        echo "bench exit: $? (log: /tmp/bench_tpu3.log)" | tee -a /tmp/tunnel_watch.log
+        tail -1 /tmp/bench_tpu3.log | tee -a /tmp/tunnel_watch.log
+        exit 0
+    fi
+    echo "$(date -u +%H:%M:%S) tunnel down (attempt $i)" >> /tmp/tunnel_watch.log
+    sleep 60
+done

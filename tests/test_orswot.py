@@ -6,6 +6,7 @@ Covers: convergence under interleavings across 2..10 simulated replicas
 preservation, and reset-remove semantics via Map (`test/orswot.rs:270-307`).
 """
 
+import pytest
 from hypothesis import given
 from hypothesis import strategies as st
 
@@ -276,3 +277,110 @@ def test_present_but_removed():
     a.merge(b)
     a.merge(c)
     assert a.value().val == set()
+
+
+class TestFoldMergeTree:
+    """fold_merge_tree vs the sequential left fold.
+
+    The ORSWOT join is associative in its *observable* state — value(),
+    set clock, member table — which is the CRDT convergence guarantee.
+    The dot tables are NOT bit-associative in the reference semantics:
+    the only-in-self rule keeps the member's FULL clock when any dot is
+    novel (`orswot.rs:94-103`), so which dominated lanes survive depends
+    on which partner's clock was present at that pairing, and
+    apply_deferred subtracts during every intermediate merge
+    (`orswot.rs:195-211,235-243`).  The scalar engine reproduces both
+    effects, so the contract tested here is: order-independent pieces
+    bit-equal vs the sequential fold, and the full state bit-faithful to
+    the SCALAR engine folding in the same tree order."""
+
+    def _fleets(self, rng, n, a, m, d, r, deferred_frac):
+        import jax.numpy as jnp
+
+        from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+        fleets = anti_entropy_fleets(
+            rng, n, a, m, d, r, base=4, novel=1, deferred_frac=deferred_frac
+        )
+        return tuple(
+            jnp.stack([jnp.asarray(rep[k]) for rep in fleets]) for k in range(5)
+        )
+
+    @staticmethod
+    def _seq_fold(stacked, r, m, d):
+        from crdt_tpu.ops import orswot_ops
+
+        acc = tuple(x[0] for x in stacked)
+        for i in range(1, r):
+            acc = orswot_ops.merge(*acc, *(x[i] for x in stacked), m, d)[:5]
+        return orswot_ops.merge(*acc, *acc, m, d)[:5]
+
+    @pytest.mark.parametrize("deferred_frac", [0.0, 0.5])
+    @pytest.mark.parametrize("r", [2, 3, 5, 8])
+    def test_tree_fold_parity(self, r, deferred_frac):
+        import numpy as np
+
+        from crdt_tpu.ops import orswot_ops
+        from crdt_tpu.scalar.orswot import Orswot
+        from crdt_tpu.utils.testdata import dense_row_to_scalar
+
+        rng = np.random.RandomState(100 + r)
+        n, a, m, d = 17, 8, 5 + r, 3
+        stacked = self._fleets(rng, n, a, m, d, r, deferred_frac)
+        acc = self._seq_fold(stacked, r, m, d)
+        got = orswot_ops.fold_merge_tree(*stacked, m, d)[:5]
+
+        # order-independent pieces: set clock and canonical member table
+        assert np.array_equal(np.asarray(got[0]), np.asarray(acc[0]))
+        assert np.array_equal(np.asarray(got[1]), np.asarray(acc[1]))
+
+        # full state must be bit-faithful to the scalar engine folding in
+        # the same tree order (evens-with-odds, odd fleet carries)
+        for obj in range(n):
+            lvl = [
+                dense_row_to_scalar(*(np.asarray(x[i, obj]) for x in stacked))
+                for i in range(r)
+            ]
+            while len(lvl) > 1:
+                nxt = []
+                for i in range(0, len(lvl) - 1, 2):
+                    lvl[i].merge(lvl[i + 1])
+                    nxt.append(lvl[i])
+                if len(lvl) % 2:
+                    nxt.append(lvl[-1])
+                lvl = nxt
+            oracle = lvl[0]
+            oracle.merge(Orswot())
+
+            want = {
+                mid: {
+                    i: int(c)
+                    for i, c in enumerate(np.asarray(got[2][obj][s]))
+                    if int(c)
+                }
+                for s, mid in enumerate(int(x) for x in np.asarray(got[1][obj]))
+                if mid != -1
+            }
+            have = {k: dict(v.dots) for k, v in oracle.entries.items()}
+            assert want == have, f"object {obj}: dense tree != scalar tree"
+
+    def test_overflow_flag_propagates(self):
+        import numpy as np
+
+        from crdt_tpu.ops import orswot_ops
+        from crdt_tpu.utils.testdata import random_orswot_arrays
+
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(7)
+        # disjoint member universes force m_cap overflow somewhere in the tree
+        reps = []
+        for i in range(4):
+            arrs = list(random_orswot_arrays(rng, 16, 4, 4, 2))
+            ids = np.asarray(arrs[1])
+            ids = np.where(ids != -1, ids + 100 * i, ids)
+            arrs[1] = ids
+            reps.append(tuple(jnp.asarray(x) for x in arrs))
+        stacked = tuple(jnp.stack([rep[k] for rep in reps]) for k in range(5))
+        out = orswot_ops.fold_merge_tree(*stacked, 2, 2)
+        assert bool(np.asarray(out[5]).any()), "tree fold must surface overflow"

@@ -332,3 +332,49 @@ def test_gset_rejects_out_of_capacity_ids():
         b.insert(jnp.asarray([4, 0]))
     with pytest.raises(ValueError):
         b.contains(jnp.asarray([9, 0]))
+
+
+def test_orswot_join_fleet_parity():
+    """OrswotBatch.join_fleet (tree reduction) value()-parity vs the
+    scalar engine's merge-all loop (`test/orswot.rs:45-62`), including
+    deferred removes flushed by the plunger."""
+    import numpy as np
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.scalar.orswot import Orswot
+    from crdt_tpu.utils.interning import Universe
+
+    rng = np.random.RandomState(11)
+    uni = Universe(CrdtConfig(num_actors=6, member_capacity=16, deferred_capacity=8))
+    n, r = 9, 5
+    fleets = []
+    for _ in range(r):
+        row = []
+        for _ in range(n):
+            s = Orswot()
+            for _ in range(int(rng.randint(0, 6))):
+                actor, member = int(rng.randint(0, 6)), int(rng.randint(0, 10))
+                ctx = s.value().derive_add_ctx(actor)
+                s.apply(s.add(member, ctx))
+            if rng.rand() < 0.4 and s.entries:
+                member = next(iter(s.entries))
+                ctx = s.contains(member).derive_rm_ctx()
+                ctx.clock.witness(int(rng.randint(0, 6)), int(rng.randint(50, 60)))
+                s.apply(s.remove(member, ctx))  # causally-future: defers
+            row.append(s)
+        fleets.append(row)
+
+    joined = OrswotBatch.join_fleet(
+        [OrswotBatch.from_scalar(row, uni) for row in fleets]
+    )
+    got_sets = joined.value_sets(uni)
+
+    expected = []
+    for i in range(n):
+        merged = Orswot()
+        for row in fleets:
+            merged.merge(row[i].clone())
+        merged.merge(Orswot())  # plunger
+        expected.append(merged.value().val)
+    assert got_sets == expected

@@ -1,0 +1,135 @@
+"""Stage-level profile of the ORSWOT merge at north-star shapes.
+
+Times each kernel stage as a device-side chain (the only honest timing
+through the remote-TPU tunnel — reports/TPU_LATENCY.md), plus a raw
+`jnp.maximum` bandwidth probe over the same footprint, so "optimize the
+merge" has a concrete target on the platform that matters.  Works on any
+backend; run on TPU when the tunnel is up:
+
+    python scripts/profile_stages.py            # north-star chunk shapes
+    python scripts/profile_stages.py --config4  # BASELINE config-4 shapes
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from crdt_tpu.ops import clock_ops, orswot_ops
+    from crdt_tpu.utils.testdata import random_orswot_arrays
+
+    if "--config4" in sys.argv:
+        n, a, m, d = 100_000, 16, 8, 4
+        iters = 20
+    else:  # one north-star chunk
+        n, a, m, d = 62_500, 64, 16, 2
+        iters = 20
+
+    rng = np.random.RandomState(0)
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(
+        rng, n, a, m, d, min_live=m, deferred_frac=0.25))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(
+        rng, n, a, m, d, min_live=m))
+    clock_a, ids_a, dots_a, dids_a, dclocks_a = lhs
+    clock_b, ids_b, dots_b, dids_b, dclocks_b = rhs
+    state_bytes = sum(x.nbytes for x in lhs)
+    print(f"backend={jax.default_backend()} n={n} a={a} m={m} d={d} "
+          f"state={state_bytes/1e6:.0f} MB/side")
+
+    def sync_overhead():
+        tiny = jax.jit(lambda x: x + 1)
+        tone = jnp.zeros((8,), jnp.uint32)
+        np.asarray(tiny(tone))
+        t0 = time.perf_counter()
+        np.asarray(tiny(tone))
+        return time.perf_counter() - t0
+
+    sync = sync_overhead()
+    print(f"sync overhead: {sync*1e3:.1f} ms")
+
+    def chain_time(step, init, label, bytes_moved=None):
+        """step: state -> state (same pytree shape), chained iters times."""
+        @jax.jit
+        def run(s0):
+            return lax.scan(lambda c, _: (step(c), None), s0, None,
+                            length=iters)[0]
+        out = run(init)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = run(init)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        t = max(time.perf_counter() - t0 - sync, 1e-9) / iters
+        bw = f"  {bytes_moved/t/1e9:6.1f} GB/s" if bytes_moved else ""
+        print(f"{label:34s} {t*1e3:9.2f} ms{bw}")
+        return t
+
+    # raw bandwidth floor: elementwise max over the dots footprint
+    chain_time(lambda s: (jnp.maximum(s[0], dots_b),),
+               (dots_a,), "bandwidth: maximum(dots,dots)",
+               bytes_moved=3 * dots_a.nbytes)
+
+    # full pairwise merge (the real thing, deferred rows present)
+    chain_time(
+        lambda s: orswot_ops.merge(*s, *rhs, m, d)[:5], lhs,
+        "full merge (deferred present)",
+        bytes_moved=3 * state_bytes)
+
+    # deferred-free merge → rank-select fast path via the cond
+    lhs_nd = (clock_a, ids_a, dots_a,
+              jnp.full_like(dids_a, -1), jnp.zeros_like(dclocks_a))
+    chain_time(
+        lambda s: orswot_ops.merge(*s, *lhs_nd[:2], s[2], *lhs_nd[3:], m, d)[:5]
+        if False else orswot_ops.merge(*s, *lhs_nd, m, d)[:5],
+        lhs_nd, "merge fast path (no deferred)",
+        bytes_moved=3 * state_bytes)
+
+    # stage: member match (quadratic bool)
+    def step_match(s):
+        va, am, j_idx, bo = orswot_ops._member_match(s[0], ids_b)
+        # consume every output so nothing is DCE'd out of the chain
+        return (jnp.where(am & va & ~bo, s[0], j_idx),)
+    chain_time(step_match, (ids_a,), "_member_match [N,M,M] bool")
+
+    # stage: rank-select core alone (survival reduces + rank + gathers)
+    def step_core(s):
+        clock, ids, dots = s
+        out_ids, out_dots, n_surv = orswot_ops._rank_select_merge(
+            clock, ids, dots, clock_b, ids_b, dots_b, m)
+        clock2 = clock_ops.merge(clock, jnp.max(out_dots, axis=-2))
+        return (clock2, out_ids, out_dots)
+    chain_time(step_core, (clock_a, ids_a, dots_a), "_rank_select_merge core")
+
+    # stage: counting-rank order over 2M keys, vs XLA argsort
+    keys = jnp.concatenate([ids_a, ids_b], axis=-1)
+    def step_order(s):
+        o = orswot_ops._stable_order(s[0])
+        return (jnp.take_along_axis(s[0], o, axis=-1),)
+    chain_time(step_order, (keys,), "_stable_order [N,2M] + gather")
+
+    def step_sort(s):
+        o = jnp.argsort(s[0], axis=-1, stable=True)
+        return (jnp.take_along_axis(s[0], o, axis=-1),)
+    chain_time(step_sort, (keys,), "jnp.argsort [N,2M] + gather")
+
+    # stage: deferred pipeline (dedup + replay)
+    def step_deferred(s):
+        d_ids, d_clocks = orswot_ops._dedup_deferred(s[0], s[1])
+        ids2, dots2, d_ids2, d_clocks2 = orswot_ops._apply_deferred(
+            clock_a, ids_a, dots_a, d_ids, d_clocks)
+        # keep the member-side replay (dots2) live in the carry
+        return (d_ids2, jnp.maximum(d_clocks2, dots2[..., :d, :]))
+    chain_time(step_deferred, (dids_a, dclocks_a), "deferred dedup+replay")
+
+
+if __name__ == "__main__":
+    main()

@@ -166,15 +166,33 @@ def _apply_deferred(clock, ids, dots, d_ids, d_clocks):
 _RANK_SORT_MAX_S = 128
 
 
+def _scatterless_default():
+    """Whether to invert the rank permutation without a scatter.
+
+    ``put_along_axis`` lowers to an XLA scatter, which TPUs execute far
+    less efficiently than dense one-hot reductions at these tiny slot
+    counts; CPUs prefer the scatter.  ``CRDT_SCATTERLESS=0/1`` forces a
+    path for A/B measurements (`scripts/tpu_experiments.py`)."""
+    import os
+
+    force = os.environ.get("CRDT_SCATTERLESS")
+    if force is not None:
+        return force == "1"
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 def _stable_order(key):
     """Permutation that stably sorts ``key`` ascending along the last axis.
 
     For the small static slot counts of the member/deferred tables this is
     a counting rank (``rank[i]`` = number of slots ordered before slot i,
-    ties broken by slot index) inverted with one scatter — a handful of
-    fused elementwise passes over an ``[..., S, S]`` bool, which beats
-    XLA's generic comparison sort by a wide margin at S ≤ ~128 on both CPU
-    and TPU.  Larger S falls back to ``argsort``."""
+    ties broken by slot index) — a handful of fused elementwise passes
+    over an ``[..., S, S]`` bool, which beats XLA's generic comparison
+    sort by a wide margin at S ≤ ~128.  The rank is inverted either with
+    one scatter (CPU) or a one-hot sum (TPU — see
+    :func:`_scatterless_default`).  Larger S falls back to ``argsort``."""
     s = key.shape[-1]
     if s > _RANK_SORT_MAX_S:
         return jnp.argsort(key, axis=-1, stable=True)
@@ -183,6 +201,12 @@ def _stable_order(key):
     kj = key[..., None, :]
     before = (kj < ki) | ((kj == ki) & (idx[None, :] < idx[:, None]))
     rank = jnp.sum(before, axis=-1).astype(jnp.int32)  # position of slot i
+    if _scatterless_default():
+        # out[k] = i with rank[i] == k, as a one-hot masked sum — reuses
+        # the [..., S, S] shape already materialized for `before`, and
+        # avoids an XLA scatter entirely
+        onehot = rank[..., None, :] == idx[:, None]  # [..., k, i]
+        return jnp.sum(jnp.where(onehot, idx, 0), axis=-1, dtype=jnp.int32)
     return jnp.put_along_axis(
         jnp.zeros(rank.shape, jnp.int32),
         rank,

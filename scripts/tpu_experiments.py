@@ -1,0 +1,143 @@
+"""One-window TPU kernel A/B menu.
+
+The remote-TPU tunnel comes and goes; when a window opens, this script
+collects every pending kernel decision in one run (chained device-side
+timing throughout — reports/TPU_LATENCY.md):
+
+  1. sequential vs tree fold at a north-star chunk (fold shape choice)
+  2. scatter vs scatterless rank inversion inside the full merge
+  3. counting-rank vs XLA argsort at merge slot counts
+  4. u32 vs u64 counter planes (64-bit emulation cost on TPU)
+
+Each experiment subprocesses with its own env so jit caches can't leak
+between variants.  Results print as one table; exit 0 even if individual
+experiments fail (a partial table beats none).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+WORKER = r'''
+import os, sys, time
+sys.path.insert(0, %(repo)r)
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from crdt_tpu.ops import orswot_ops
+from crdt_tpu.utils.testdata import anti_entropy_fleets, random_orswot_arrays
+
+mode = os.environ["EXP_MODE"]
+rng = np.random.RandomState(0)
+
+def sync_overhead():
+    tiny = jax.jit(lambda x: x + 1)
+    tone = jnp.zeros((8,), jnp.uint32)
+    np.asarray(tiny(tone))
+    t0 = time.perf_counter(); np.asarray(tiny(tone))
+    return time.perf_counter() - t0
+
+def chain(step, init, iters):
+    @jax.jit
+    def run(s0):
+        return lax.scan(lambda c, _: (step(c), None), s0, None, length=iters)[0]
+    out = run(init); jax.block_until_ready(out)
+    sync = sync_overhead()
+    t0 = time.perf_counter(); out = run(init)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    return max(time.perf_counter() - t0 - sync, 1e-9) / iters
+
+if mode in ("fold_seq", "fold_tree"):
+    n, a, m, d, r = 62_500, 64, 16, 2, 8
+    fleets = anti_entropy_fleets(rng, n, a, m, d, r, base=6, novel=1,
+                                 deferred_frac=0.25)
+    stacked = tuple(jnp.stack([jnp.asarray(rep[k]) for rep in fleets])
+                    for k in range(5))
+    if mode == "fold_tree":
+        def fold(stack):
+            return orswot_ops.fold_merge_tree(*stack, m, d)[:5]
+    else:
+        def fold(stack):
+            acc = tuple(x[0] for x in stack)
+            for i in range(1, r):
+                acc = orswot_ops.merge(*acc, *(x[i] for x in stack), m, d)[:5]
+            return orswot_ops.merge(*acc, *acc, m, d)[:5]
+    def step(carry):
+        salt, _ = carry
+        out = fold((stacked[0] ^ salt,) + stacked[1:])
+        return ((jnp.max(out[2]) & jnp.uint32(7)) | jnp.uint32(1), out)
+    init = (jnp.uint32(1), tuple(x[0] for x in stacked))
+    t = chain(step, init, iters=4)
+    print(f"RESULT {mode}: {t*1e3:.1f} ms/chunk-fold "
+          f"({n*r/t/1e6:.2f}M merges/s equiv)")
+
+elif mode in ("merge_scatter", "merge_scatterless"):
+    # CRDT_SCATTERLESS set by the parent
+    n, a, m, d = 100_000, 16, 8, 4
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    t = chain(lambda acc: orswot_ops.merge(*acc, *rhs, m, d)[:5], lhs, iters=20)
+    print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
+
+elif mode in ("order_rank", "order_argsort"):
+    n, s = 200_000, 32
+    keys = jnp.asarray(rng.randint(0, 1 << 20, size=(n, s)).astype(np.int32))
+    if mode == "order_rank":
+        def step(c):
+            o = orswot_ops._stable_order(c[0])
+            return (jnp.take_along_axis(c[0], o, axis=-1),)
+    else:
+        def step(c):
+            o = jnp.argsort(c[0], axis=-1, stable=True)
+            return (jnp.take_along_axis(c[0], o, axis=-1),)
+    t = chain(step, (keys,), iters=20)
+    print(f"RESULT {mode}: {t*1e3:.2f} ms")
+
+elif mode in ("dtype_u32", "dtype_u64"):
+    dt = np.uint32 if mode == "dtype_u32" else np.uint64
+    n, a, m, d = 100_000, 16, 8, 4
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d, dtype=dt))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d, dtype=dt))
+    t = chain(lambda acc: orswot_ops.merge(*acc, *rhs, m, d)[:5], lhs, iters=10)
+    print(f"RESULT {mode}: {t*1e3:.2f} ms/merge")
+''' % {"repo": REPO}
+
+
+def run(mode, env_extra=None, timeout=900):
+    env = dict(os.environ)
+    env["EXP_MODE"] = mode
+    env.update(env_extra or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-u", "-c", WORKER],
+            timeout=timeout, capture_output=True, text=True, env=env,
+        )
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT"):
+                print(line, flush=True)
+                return
+        print(f"RESULT {mode}: FAILED rc={proc.returncode} "
+              f"{proc.stderr.strip().splitlines()[-1][:160] if proc.stderr.strip() else ''}",
+              flush=True)
+    except subprocess.TimeoutExpired:
+        print(f"RESULT {mode}: TIMEOUT after {timeout}s", flush=True)
+
+
+def main():
+    print(f"tpu_experiments on backend env JAX_PLATFORMS="
+          f"{os.environ.get('JAX_PLATFORMS')!r}", flush=True)
+    run("merge_scatter", {"CRDT_SCATTERLESS": "0"})
+    run("merge_scatterless", {"CRDT_SCATTERLESS": "1"})
+    run("order_rank")
+    run("order_argsort")
+    run("dtype_u32")
+    run("dtype_u64")
+    run("fold_seq", timeout=1500)
+    run("fold_tree", timeout=1500)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,10 @@ for i in $(seq 1 200); do
         timeout 3000 python bench.py > /tmp/bench_tpu3.log 2>&1
         echo "bench exit: $? (log: /tmp/bench_tpu3.log)" | tee -a /tmp/tunnel_watch.log
         tail -1 /tmp/bench_tpu3.log | tee -a /tmp/tunnel_watch.log
+        timeout 1200 python scripts/profile_stages.py > /tmp/profile_tpu.log 2>&1
+        echo "profile exit: $?" | tee -a /tmp/tunnel_watch.log
+        timeout 9000 python scripts/tpu_experiments.py > /tmp/experiments_tpu.log 2>&1
+        echo "experiments exit: $?" | tee -a /tmp/tunnel_watch.log
         exit 0
     fi
     echo "$(date -u +%H:%M:%S) tunnel down (attempt $i)" >> /tmp/tunnel_watch.log

@@ -84,3 +84,19 @@ def test_fast_and_deferred_paths_agree_without_deferred(seed):
     slow = orswot_ops._merge_narrow_deferred(clock, *L, *R, m, d)
     for f, s in zip(fast, slow):
         np.testing.assert_array_equal(np.asarray(f), np.asarray(s))
+
+
+def test_stable_order_scatterless_matches_scatter(monkeypatch):
+    """Both permutation-inverse paths must agree on random keys with
+    duplicates (stability ties broken by slot index)."""
+    rng = np.random.RandomState(3)
+    keys = jnp.asarray(rng.randint(0, 7, size=(64, 24)).astype(np.int32))
+
+    monkeypatch.setenv("CRDT_SCATTERLESS", "0")
+    want = np.asarray(orswot_ops._stable_order(keys))
+    monkeypatch.setenv("CRDT_SCATTERLESS", "1")
+    got = np.asarray(orswot_ops._stable_order(keys))
+    assert np.array_equal(got, want)
+    # and it really is the stable ascending order
+    gathered = np.take_along_axis(np.asarray(keys), got, axis=-1)
+    assert (np.diff(gathered, axis=-1) >= 0).all()

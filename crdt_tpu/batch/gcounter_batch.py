@@ -14,6 +14,7 @@ from flax import struct
 from ..ops import clock_ops, counter_ops
 from ..scalar.gcounter import GCounter
 from ..utils.interning import Universe
+from ..config import counter_dtype
 from .vclock_batch import VClockBatch
 
 
@@ -23,7 +24,10 @@ class GCounterBatch:
 
     @classmethod
     def zeros(cls, n: int, universe: Universe) -> "GCounterBatch":
-        return cls(clocks=clock_ops.zeros((n, universe.config.num_actors)))
+        return cls(clocks=clock_ops.zeros(
+            (n, universe.config.num_actors),
+            dtype=counter_dtype(universe.config),
+        ))
 
     @classmethod
     def from_scalar(cls, states: Sequence[GCounter], universe: Universe) -> "GCounterBatch":

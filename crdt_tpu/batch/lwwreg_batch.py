@@ -32,7 +32,7 @@ class LWWRegBatch:
     def from_scalar(cls, states: Sequence[LWWReg], universe: Universe) -> "LWWRegBatch":
         import numpy as np
 
-        dt = counter_dtype()
+        dt = counter_dtype(universe.config)
         vals = np.asarray([universe.member_id(s.val) for s in states], dtype=dt)
         markers = np.asarray([s.marker for s in states], dtype=dt)
         return cls(vals=jnp.asarray(vals), markers=jnp.asarray(markers))

@@ -86,7 +86,7 @@ class MapBatch:
         cfg = universe.config
         kernel = MapKernel.from_config(cfg, val_kernel)
         n, k, d, a = len(states), cfg.key_capacity, cfg.deferred_capacity, cfg.num_actors
-        dt = counter_dtype()
+        dt = counter_dtype(cfg)
         clock = np.zeros((n, a), dtype=dt)
         keys = np.full((n, k), EMPTY, dtype=np.int32)
         eclocks = np.zeros((n, k, a), dtype=dt)

@@ -31,8 +31,8 @@ class MVRegBatch:
     def zeros(cls, n: int, universe: Universe) -> "MVRegBatch":
         cfg = universe.config
         return cls(
-            clocks=clock_ops.zeros((n, cfg.mv_capacity, cfg.num_actors)),
-            vals=jnp.zeros((n, cfg.mv_capacity), dtype=counter_dtype()),
+            clocks=clock_ops.zeros((n, cfg.mv_capacity, cfg.num_actors), dtype=counter_dtype(cfg)),
+            vals=jnp.zeros((n, cfg.mv_capacity), dtype=counter_dtype(cfg)),
         )
 
     @classmethod
@@ -41,7 +41,7 @@ class MVRegBatch:
 
         cfg = universe.config
         k, a = cfg.mv_capacity, cfg.num_actors
-        dt = counter_dtype()
+        dt = counter_dtype(cfg)
         clocks = np.zeros((len(states), k, a), dtype=dt)
         vals = np.zeros((len(states), k), dtype=dt)
         for i, reg in enumerate(states):

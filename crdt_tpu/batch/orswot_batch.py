@@ -37,7 +37,7 @@ class OrswotBatch:
     def zeros(cls, n: int, universe: Universe) -> "OrswotBatch":
         cfg = universe.config
         a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
-        dt = counter_dtype()
+        dt = counter_dtype(cfg)
         return cls(
             clock=jnp.zeros((n, a), dtype=dt),
             ids=jnp.full((n, m), orswot_ops.EMPTY, dtype=jnp.int32),
@@ -59,7 +59,7 @@ class OrswotBatch:
         cfg = universe.config
         n = len(states)
         a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
-        dt = counter_dtype()
+        dt = counter_dtype(cfg)
         aidx = universe.actors.intern
         midx = universe.members.intern
 

@@ -15,6 +15,7 @@ from flax import struct
 from ..ops import clock_ops, counter_ops
 from ..scalar.pncounter import PNCounter
 from ..utils.interning import Universe
+from ..config import counter_dtype
 from .vclock_batch import VClockBatch
 
 
@@ -24,7 +25,10 @@ class PNCounterBatch:
 
     @classmethod
     def zeros(cls, n: int, universe: Universe) -> "PNCounterBatch":
-        return cls(planes=clock_ops.zeros((n, 2, universe.config.num_actors)))
+        return cls(planes=clock_ops.zeros(
+            (n, 2, universe.config.num_actors),
+            dtype=counter_dtype(universe.config),
+        ))
 
     @classmethod
     def from_scalar(cls, states: Sequence[PNCounter], universe: Universe) -> "PNCounterBatch":

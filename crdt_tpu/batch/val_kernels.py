@@ -34,7 +34,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ..config import CrdtConfig, counter_dtype
+from ..config import CrdtConfig, dtype_for_bits
 from ..ops import clock_ops, map_ops, mvreg_ops, orswot_ops
 from ..ops.orswot_ops import EMPTY
 
@@ -45,13 +45,15 @@ class MVRegKernel:
 
     mv_capacity: int
     num_actors: int
+    counter_bits: int = 64
 
     @classmethod
     def from_config(cls, cfg: CrdtConfig) -> "MVRegKernel":
-        return cls(mv_capacity=cfg.mv_capacity, num_actors=cfg.num_actors)
+        return cls(mv_capacity=cfg.mv_capacity, num_actors=cfg.num_actors,
+                   counter_bits=cfg.counter_bits)
 
     def zeros(self, batch_shape):
-        dt = counter_dtype()
+        dt = dtype_for_bits(self.counter_bits)
         return (
             jnp.zeros((*batch_shape, self.mv_capacity, self.num_actors), dt),
             jnp.zeros((*batch_shape, self.mv_capacity), dt),
@@ -105,6 +107,7 @@ class OrswotKernel:
     member_capacity: int
     deferred_capacity: int
     num_actors: int
+    counter_bits: int = 64
 
     @classmethod
     def from_config(cls, cfg: CrdtConfig) -> "OrswotKernel":
@@ -112,10 +115,11 @@ class OrswotKernel:
             member_capacity=cfg.member_capacity,
             deferred_capacity=cfg.deferred_capacity,
             num_actors=cfg.num_actors,
+            counter_bits=cfg.counter_bits,
         )
 
     def zeros(self, batch_shape):
-        dt = counter_dtype()
+        dt = dtype_for_bits(self.counter_bits)
         m, d, a = self.member_capacity, self.deferred_capacity, self.num_actors
         return (
             jnp.zeros((*batch_shape, a), dt),
@@ -196,6 +200,7 @@ class MapKernel:
     deferred_capacity: int
     num_actors: int
     val_kernel: Any
+    counter_bits: int = 64
 
     @classmethod
     def from_config(cls, cfg: CrdtConfig, val_kernel) -> "MapKernel":
@@ -204,10 +209,11 @@ class MapKernel:
             deferred_capacity=cfg.deferred_capacity,
             num_actors=cfg.num_actors,
             val_kernel=val_kernel,
+            counter_bits=cfg.counter_bits,
         )
 
     def zeros(self, batch_shape):
-        dt = counter_dtype()
+        dt = dtype_for_bits(self.counter_bits)
         k, d, a = self.key_capacity, self.deferred_capacity, self.num_actors
         return (
             jnp.zeros((*batch_shape, a), dt),

@@ -39,14 +39,17 @@ class VClockBatch:
 
     @classmethod
     def zeros(cls, n: int, universe: Universe) -> "VClockBatch":
-        return cls(clocks=clock_ops.zeros((n, universe.config.num_actors)))
+        return cls(clocks=clock_ops.zeros(
+            (n, universe.config.num_actors),
+            dtype=counter_dtype(universe.config),
+        ))
 
     @classmethod
     def from_scalar(cls, states: Sequence[VClock], universe: Universe) -> "VClockBatch":
         import numpy as np
 
         a = universe.config.num_actors
-        buf = np.zeros((len(states), a), dtype=counter_dtype())
+        buf = np.zeros((len(states), a), dtype=counter_dtype(universe.config))
         for i, vc in enumerate(states):
             for actor, counter in vc.dots.items():
                 buf[i, universe.actor_idx(actor)] = counter

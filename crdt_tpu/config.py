@@ -33,10 +33,26 @@ def enable_x64() -> bool:
     return _X64_ENABLED
 
 
-def counter_dtype():
-    """The dtype used for dense counters (reference: u64, vclock.rs:23)."""
+def counter_dtype(config=None):
+    """The dtype used for dense counters.
+
+    The reference fixes ``Counter = u64`` (`vclock.rs:23`) and that is
+    the default.  TPUs have no native 64-bit integers — XLA emulates
+    them as register pairs, roughly doubling both arithmetic and HBM
+    traffic — so :class:`CrdtConfig` can opt a batch universe into
+    ``counter_bits=32`` where counters are known to fit (2^32 ops per
+    actor); the scalar/u64 engines remain the parity oracle.
+    """
+    return dtype_for_bits(config.counter_bits if config is not None else 64)
+
+
+def dtype_for_bits(bits: int):
+    """Counter dtype for an explicit width (kernel dataclasses carry the
+    width as a plain int so they stay hashable/static under jit)."""
     import jax.numpy as jnp
 
+    if bits == 32:
+        return jnp.uint32
     return jnp.uint64 if enable_x64() else jnp.uint32
 
 
@@ -55,12 +71,19 @@ class CrdtConfig:
     deferred_capacity: int = 8  # deferred (clock, member) rows per object
     mv_capacity: int = 8  # MVReg antichain slots per register
     key_capacity: int = 16  # Map key slots per object
+    # counter width: 64 = reference parity (u64, vclock.rs:23), 32 = the
+    # TPU-native width (no 64-bit emulation; counters must fit 2^32)
+    counter_bits: int = 64
 
     def __post_init__(self):
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
             if not isinstance(v, int) or v <= 0:
                 raise ValueError(f"CrdtConfig.{f.name} must be a positive int, got {v!r}")
+        if self.counter_bits not in (32, 64):
+            raise ValueError(
+                f"CrdtConfig.counter_bits must be 32 or 64, got {self.counter_bits!r}"
+            )
 
 
 DEFAULT_CONFIG = CrdtConfig()

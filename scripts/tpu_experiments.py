@@ -133,8 +133,8 @@ def main():
     run("merge_scatterless", {"CRDT_SCATTERLESS": "1"})
     run("order_rank")
     run("order_argsort")
-    run("dtype_u32")
-    run("dtype_u64")
+    run("dtype_u32", {"CRDT_TPU_NO_X64": "0"})
+    run("dtype_u64", {"CRDT_TPU_NO_X64": "0"})
     run("fold_seq", timeout=1500)
     run("fold_tree", timeout=1500)
 

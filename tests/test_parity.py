@@ -378,3 +378,55 @@ def test_orswot_join_fleet_parity():
         merged.merge(Orswot())  # plunger
         expected.append(merged.value().val)
     assert got_sets == expected
+
+
+def test_counter_bits_32_parity():
+    """counter_bits=32 — the TPU-native width (no 64-bit emulation) —
+    must produce identical value() results through Orswot, MVReg and
+    nested Map batch paths, with every counter plane actually uint32."""
+    import numpy as np
+
+    from crdt_tpu.batch import MapBatch, OrswotBatch
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+    from crdt_tpu.scalar.orswot import Orswot
+    from crdt_tpu.utils.interning import Universe
+    from crdt_tpu.utils.testdata import random_mvreg_map
+
+    rng = np.random.RandomState(21)
+    cfg32 = CrdtConfig(num_actors=8, member_capacity=12, deferred_capacity=4,
+                       mv_capacity=6, key_capacity=8, counter_bits=32)
+    uni = Universe(cfg32)
+
+    # Orswot
+    rows_a, rows_b = [], []
+    for _ in range(12):
+        x, y = Orswot(), Orswot()
+        for j in range(int(rng.randint(1, 5))):
+            x.apply(x.add(int(rng.randint(0, 9)), x.value().derive_add_ctx(j % 8)))
+            y.apply(y.add(int(rng.randint(0, 9)), y.value().derive_add_ctx((j + 3) % 8)))
+        rows_a.append(x)
+        rows_b.append(y)
+    ba = OrswotBatch.from_scalar(rows_a, uni)
+    assert ba.clock.dtype == jnp.uint32 and ba.dots.dtype == jnp.uint32
+    got = ba.merge(OrswotBatch.from_scalar(rows_b, uni)).value_sets(uni)
+    for i in range(12):
+        want = rows_a[i].clone()
+        want.merge(rows_b[i])
+        assert got[i] == want.value().val, i
+
+    # nested Map<int, MVReg> through the value-kernel protocol
+    maps_a = [random_mvreg_map(rng) for _ in range(6)]
+    maps_b = [random_mvreg_map(rng) for _ in range(6)]
+    kern = MVRegKernel.from_config(cfg32)
+    assert kern.counter_bits == 32
+    ma = MapBatch.from_scalar(maps_a, uni, kern)
+    assert ma.clock.dtype == jnp.uint32
+    merged = ma.merge(MapBatch.from_scalar(maps_b, uni, kern))
+    back = merged.to_scalar(uni)
+    for i in range(6):
+        want = maps_a[i].clone()
+        want.merge(maps_b[i])
+        assert back[i] == want, i

@@ -32,7 +32,10 @@ class LWWRegBatch:
     def from_scalar(cls, states: Sequence[LWWReg], universe: Universe) -> "LWWRegBatch":
         import numpy as np
 
-        dt = counter_dtype(universe.config)
+        # markers are TIMESTAMPS (u64 in the reference, lwwreg.rs:16-24),
+        # not per-actor op counters — CrdtConfig.counter_bits deliberately
+        # does NOT apply here (an epoch-millis marker overflows uint32)
+        dt = counter_dtype()
         vals = np.asarray([universe.member_id(s.val) for s in states], dtype=dt)
         markers = np.asarray([s.marker for s in states], dtype=dt)
         return cls(vals=jnp.asarray(vals), markers=jnp.asarray(markers))

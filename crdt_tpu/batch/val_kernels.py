@@ -204,6 +204,13 @@ class MapKernel:
 
     @classmethod
     def from_config(cls, cfg: CrdtConfig, val_kernel) -> "MapKernel":
+        vk_bits = getattr(val_kernel, "counter_bits", cfg.counter_bits)
+        if vk_bits != cfg.counter_bits:
+            raise ValueError(
+                f"value kernel counter_bits={vk_bits} != config "
+                f"counter_bits={cfg.counter_bits}; nested planes must share "
+                "one width (build the value kernel with from_config)"
+            )
         return cls(
             key_capacity=cfg.key_capacity,
             deferred_capacity=cfg.deferred_capacity,

@@ -1,0 +1,154 @@
+"""End-to-end anti-entropy walkthrough: ops over the wire, batched
+joins on device, and a sharded collective join over a device mesh.
+
+The reference library stops at "serialize the op/state and transport it
+however you like" (`/root/reference/src/lib.rs:62-83`; its only example,
+`examples/pprint.rs`, pretty-prints two values).  This example shows the
+same protocol end to end in the TPU-native framework, then scales it:
+
+  1. op-based replication between scalar replicas over `to_binary` bytes
+     (read → derive ctx → mutate → ship — `/root/reference/src/ctx.rs:5-9`);
+  2. a causally-future remove that buffers in the deferred table and
+     resolves after anti-entropy (`orswot.rs:195-211`);
+  3. the same fleet packed into dense batches and joined on device with
+     one pairwise-tree reduction (`OrswotBatch.join_fleet`);
+  4. the join re-run as a *collective* over a device mesh — one replica
+     shard per device, merge as the all-reduce combiner riding ICI
+     (`parallel.allgather_join_orswot`).
+
+Run on CPU with a virtual 8-device mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/anti_entropy.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# dev environments that preload a remote-accelerator plugin ignore the
+# JAX_PLATFORMS env var once jax is initialized; force it through the
+# live config exactly like tests/conftest.py does
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np  # noqa: E402
+
+from crdt_tpu import Orswot, from_binary, to_binary  # noqa: E402
+from crdt_tpu.batch import OrswotBatch  # noqa: E402
+from crdt_tpu.config import CrdtConfig  # noqa: E402
+from crdt_tpu.utils.interning import Universe  # noqa: E402
+
+
+def step1_op_replication():
+    """Three replicas exchanging serialized ops (no shared memory)."""
+    replicas = {name: Orswot() for name in ("alice", "bob", "carol")}
+
+    def broadcast(op):
+        wire = to_binary(op)  # what would cross the network
+        for r in replicas.values():
+            r.apply(from_binary(wire))
+
+    a = replicas["alice"]
+    broadcast(a.add("apple", a.value().derive_add_ctx("alice")))
+    b = replicas["bob"]
+    broadcast(b.add("pear", b.value().derive_add_ctx("bob")))
+    values = {frozenset(r.value().val) for r in replicas.values()}
+    assert values == {frozenset({"apple", "pear"})}
+    print("1. op replication over the wire:", sorted(a.value().val))
+    return replicas
+
+
+def step2_deferred_remove(replicas):
+    """A remove whose context is causally ahead buffers, then resolves."""
+    carol = replicas["carol"]
+    ctx = carol.contains("apple").derive_rm_ctx()
+    ctx.clock.witness("dave", 1)  # dave's write hasn't reached carol yet
+    rm = carol.remove("apple", ctx)
+
+    bob = replicas["bob"]
+    bob.apply(rm)
+    assert len(bob.deferred) == 1  # buffered, not lost (orswot.rs:195-203)
+
+    # dave's write arrives; anti-entropy flushes the buffered remove
+    dave = Orswot()
+    dave.apply(dave.add("fig", dave.value().derive_add_ctx("dave")))
+    bob.merge(dave)
+    bob.merge(Orswot())  # defer plunger (test/orswot.rs:61-62)
+    assert "apple" not in bob.value().val and "fig" in bob.value().val
+    print("2. deferred remove resolved after anti-entropy:",
+          sorted(bob.value().val))
+
+
+def step3_batched_join():
+    """A fleet of replicas × objects joined as one device reduction."""
+    rng = np.random.RandomState(0)
+    # counter_bits=32 is the TPU-native width; u64 is the parity default
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=16,
+                              deferred_capacity=4, counter_bits=32))
+    n_objects, n_replicas = 256, 8
+    fleets = []
+    for r in range(n_replicas):
+        row = []
+        for i in range(n_objects):
+            s = Orswot()
+            for j in range(int(rng.randint(1, 5))):
+                member = f"item{(i * 7 + j * 3) % 11}"
+                s.apply(s.add(member, s.value().derive_add_ctx(f"node{r}")))
+            row.append(s)
+        fleets.append(OrswotBatch.from_scalar(row, uni))
+
+    joined = OrswotBatch.join_fleet(fleets)  # log-depth pairwise tree
+    sets = joined.value_sets(uni)
+    print(f"3. batched join: {n_replicas} fleets × {n_objects} objects → "
+          f"e.g. object 0 = {sorted(sets[0])}")
+    return uni, fleets, sets
+
+
+def step4_collective_join(uni, fleets, expected_sets):
+    """The same join as a mesh collective: one replica shard per device,
+    merge as the all-reduce combiner (the ICI path on real hardware)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.parallel import allgather_join_orswot, make_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < len(fleets):
+        print(f"4. collective join skipped ({n_dev} devices < {len(fleets)})")
+        return
+    mesh = make_mesh({"replicas": len(fleets)})
+    stacked = OrswotBatch(
+        clock=jnp.stack([f.clock for f in fleets]),
+        ids=jnp.stack([f.ids for f in fleets]),
+        dots=jnp.stack([f.dots for f in fleets]),
+        d_ids=jnp.stack([f.d_ids for f in fleets]),
+        d_clocks=jnp.stack([f.d_clocks for f in fleets]),
+    )
+    joined = allgather_join_orswot(stacked, mesh, axis="replicas")
+    # every device holds the same joined state; check shard 0
+    first = OrswotBatch(
+        clock=joined.clock[0], ids=joined.ids[0], dots=joined.dots[0],
+        d_ids=joined.d_ids[0], d_clocks=joined.d_clocks[0],
+    )
+    assert first.value_sets(uni) == expected_sets
+    print(f"4. collective join over a {len(fleets)}-device mesh axis "
+          "matches the batched join on every shard")
+
+
+def main():
+    replicas = step1_op_replication()
+    step2_deferred_remove(replicas)
+    uni, fleets, sets = step3_batched_join()
+    step4_collective_join(uni, fleets, sets)
+    print("anti-entropy walkthrough: OK")
+
+
+if __name__ == "__main__":
+    main()

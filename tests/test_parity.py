@@ -430,3 +430,19 @@ def test_counter_bits_32_parity():
         want = maps_a[i].clone()
         want.merge(maps_b[i])
         assert back[i] == want, i
+
+
+def test_lww_markers_stay_64bit_under_counter_bits_32():
+    """Markers are timestamps (u64, `lwwreg.rs:16-24`), not op counters:
+    counter_bits=32 must not narrow them."""
+    from crdt_tpu.batch import LWWRegBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.scalar.lwwreg import LWWReg
+    from crdt_tpu.utils.interning import Universe
+
+    uni = Universe(CrdtConfig(num_actors=4, counter_bits=32))
+    epoch_micros = 1_785_375_612_441_000  # > 2**32
+    regs = [LWWReg("v", epoch_micros)]
+    batch = LWWRegBatch.from_scalar(regs, uni)
+    assert batch.markers.dtype == jnp.uint64
+    assert batch.to_scalar(uni)[0].marker == epoch_micros

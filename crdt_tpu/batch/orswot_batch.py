@@ -143,41 +143,55 @@ class OrswotBatch:
         d_clocks = np.asarray(self.d_clocks)
 
         n = clock.shape[0]
-        actor_of = universe.actors.lookup
+        # registry lookups hoisted out of the per-cell loops: the actor
+        # universe is dense (one list index per cell instead of a method
+        # call; only interned columns can carry data, the rest stay None),
+        # and member ids resolve once per UNIQUE id present
+        n_interned = len(universe.actors)
+        actor_name = [
+            universe.actors.lookup(i) if i < n_interned else None
+            for i in range(clock.shape[1])
+        ]
         member_of = universe.members.lookup
         out = [Orswot() for _ in range(n)]
 
         oi, ai = np.nonzero(clock)
         for i, aix, v in zip(oi.tolist(), ai.tolist(), clock[oi, ai].tolist()):
-            out[i].clock.dots[actor_of(aix)] = v
+            out[i].clock.dots[actor_name[aix]] = v
 
         # entries in slot order (np.nonzero is row-major), matching the
         # insertion order the naive path produced
         oi, si = np.nonzero(ids != orswot_ops.EMPTY)
+        mids = ids[oi, si]
+        uniq, inv = np.unique(mids, return_inverse=True)
+        uniq_names = [member_of(int(m)) for m in uniq]
         entry_clocks = {}
-        for i, j, mid in zip(oi.tolist(), si.tolist(), ids[oi, si].tolist()):
+        for i, j, u in zip(oi.tolist(), si.tolist(), inv.tolist()):
             vc = VClock()
-            out[i].entries[member_of(mid)] = vc
+            out[i].entries[uniq_names[u]] = vc
             entry_clocks[(i, j)] = vc
         oi, si, ai = np.nonzero(dots)
         for i, j, aix, v in zip(
             oi.tolist(), si.tolist(), ai.tolist(), dots[oi, si, ai].tolist()
         ):
-            entry_clocks[(i, j)].dots[actor_of(aix)] = v
+            entry_clocks[(i, j)].dots[actor_name[aix]] = v
 
         oi, si = np.nonzero(d_ids != orswot_ops.EMPTY)
         if oi.size:
             deferred_clocks = {}
             deferred_members = {}
-            for i, j, mid in zip(oi.tolist(), si.tolist(), d_ids[oi, si].tolist()):
+            d_mids = d_ids[oi, si]
+            d_uniq, d_inv = np.unique(d_mids, return_inverse=True)
+            d_names = [member_of(int(m)) for m in d_uniq]
+            for i, j, u in zip(oi.tolist(), si.tolist(), d_inv.tolist()):
                 deferred_clocks[(i, j)] = VClock()
-                deferred_members[(i, j)] = member_of(mid)
+                deferred_members[(i, j)] = d_names[u]
             oi, si, ai = np.nonzero(d_clocks)
             for i, j, aix, v in zip(
                 oi.tolist(), si.tolist(), ai.tolist(), d_clocks[oi, si, ai].tolist()
             ):
                 if (i, j) in deferred_clocks:
-                    deferred_clocks[(i, j)].dots[actor_of(aix)] = v
+                    deferred_clocks[(i, j)].dots[actor_name[aix]] = v
             for (i, _j), vc in deferred_clocks.items():
                 out[i].deferred.setdefault(vc.key(), set()).add(
                     deferred_members[(i, _j)]

@@ -38,21 +38,6 @@ def log(*args):
 SMALL = os.environ.get("CRDT_BENCH_SMALL") == "1"
 
 
-def timeit(fn, *args, iters=5):
-    """Median wall time of jitted fn over `iters` runs (post-warmup)."""
-    import jax
-
-    out = fn(*args)
-    jax.block_until_ready(out)  # compile + warmup
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times)), out
-
-
 def _sync_overhead():
     """The tunnel's fixed host↔device sync round-trip (~65 ms through the
     axon relay — reports/TPU_LATENCY.md), measured with a warm tiny op +

@@ -48,9 +48,12 @@ def _sync_overhead():
     tiny = jax.jit(lambda x: x + 1)
     tone = jnp.zeros((8,), jnp.uint32)
     np.asarray(tiny(tone))  # warm
-    t0 = time.perf_counter()
-    np.asarray(tiny(tone))
-    return time.perf_counter() - t0
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(tiny(tone))
+        samples.append(time.perf_counter() - t0)
+    return float(np.median(samples))
 
 
 def timeit_chained(step, init, iters=None, sync_overhead_s=None):

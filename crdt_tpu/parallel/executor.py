@@ -18,9 +18,12 @@ On TPU the two batch failure modes are:
   (device OOM, a remote-TPU tunnel dropping, preemption).  Recovery is to
   requeue the same join up to ``max_retries`` times.
 
-The executor left-folds a queue of batches into one joined state with both
-recoveries applied per step, finishing with a defer-plunger self-merge
-(`/root/reference/test/orswot.rs:61-62`) so buffered removes flush.
+The executor joins a queue of batches into one state — as a left fold
+(one recoverable pair merge per step) or, on TPU backends by default, as
+the type's pairwise-tree reduction with recovery at whole-tree
+granularity (``strategy`` field) — finishing with a defer-plunger
+self-merge (`/root/reference/test/orswot.rs:61-62`) so buffered removes
+flush.
 """
 
 from __future__ import annotations
@@ -74,7 +77,11 @@ def _is_transient(err: BaseException) -> bool:
 
 @dataclasses.dataclass
 class JoinExecutor:
-    """Left-fold join driver with overflow regrowth and transient retry.
+    """Join driver with overflow regrowth and transient retry.
+
+    The schedule is the ``strategy`` field: a left fold (recovery per
+    pair merge) or the batch type's pairwise-tree reduction (recovery
+    re-runs the whole tree — safe because merge is idempotent).
 
     Works with any batch type exposing ``merge(other, check=True)`` that
     raises :class:`~crdt_tpu.error.CapacityOverflowError` on capacity
@@ -92,6 +99,13 @@ class JoinExecutor:
     max_retries: int = 2
     grow_factor: int = 2
     retry_backoff_s: float = 0.5  # doubles per retry; 0 disables sleeping
+    # join schedule: "sequential" = left fold, one recoverable pair merge
+    # at a time; "tree" = the type's pairwise-tree reduction
+    # (``join_fleet``) — log-depth, each level one batched call, recovery
+    # at whole-tree granularity; "auto" = tree on TPU backends (the
+    # launch shape accelerators want), sequential elsewhere (measured
+    # faster on a single CPU core — PERF.md)
+    strategy: str = "auto"
 
     def join_all(
         self,
@@ -103,6 +117,8 @@ class JoinExecutor:
         if not batches:
             raise ValueError("join_all needs at least one batch")
         stats = stats if stats is not None else JoinStats()
+        if self._use_tree(batches):
+            return self._join_tree(list(batches), plunger, stats)
         acc = batches[0]
         with tracing.span("executor.join_all"):
             for nxt in batches[1:]:
@@ -113,6 +129,92 @@ class JoinExecutor:
         stats.final_member_capacity = getattr(acc, "member_capacity", None)
         stats.final_deferred_capacity = getattr(acc, "deferred_capacity", None)
         return acc
+
+    def _use_tree(self, batches: Sequence[Any]) -> bool:
+        if self.strategy not in ("sequential", "tree", "auto"):
+            raise ValueError(
+                f"unknown strategy {self.strategy!r}; use 'sequential', "
+                "'tree' or 'auto'"
+            )
+        if self.strategy == "sequential" or len(batches) < 2:
+            return False
+        if not hasattr(type(batches[0]), "join_fleet"):
+            if self.strategy == "tree":
+                raise ValueError(
+                    f"strategy='tree' requires {type(batches[0]).__name__} to "
+                    "implement join_fleet; use 'sequential' or 'auto'"
+                )
+            return False
+        if self.strategy == "tree":
+            return True
+        import jax
+
+        return jax.default_backend() == "tpu"
+
+    def _join_tree(self, batches: list, plunger: bool, stats: JoinStats) -> Any:
+        """Whole-tree join with the same two recoveries as the fold:
+        capacity overflow regrows every fleet and re-runs the tree
+        (idempotent merge makes the re-run algebraically safe), transient
+        RuntimeErrors requeue up to ``max_retries``."""
+        # equalize all fleets to the max capacities up front
+        if hasattr(batches[0], "with_capacity"):
+            m = max(b.member_capacity for b in batches)
+            d = max(b.deferred_capacity for b in batches)
+            batches = [
+                b if (b.member_capacity, b.deferred_capacity) == (m, d)
+                else b.with_capacity(m, d)
+                for b in batches
+            ]
+        retries = 0
+        with tracing.span("executor.join_all_tree"):
+            while True:
+                try:
+                    out = type(batches[0]).join_fleet(
+                        batches, check=True, plunger=plunger
+                    )
+                    stats.joins += len(batches) - 1 + (1 if plunger else 0)
+                    stats.final_member_capacity = getattr(
+                        out, "member_capacity", None
+                    )
+                    stats.final_deferred_capacity = getattr(
+                        out, "deferred_capacity", None
+                    )
+                    return out
+                except CapacityOverflowError as overflow:
+                    if not hasattr(batches[0], "with_capacity"):
+                        raise
+                    m = batches[0].member_capacity
+                    d = batches[0].deferred_capacity
+                    new_m = self._grown(m, overflow.member)
+                    new_d = self._grown(d, overflow.deferred)
+                    if new_m == m and new_d == d:
+                        raise JoinError(
+                            f"tree join overflowed at max_capacity="
+                            f"{self.max_capacity} (member_capacity={m}, "
+                            f"deferred_capacity={d})"
+                        ) from overflow
+                    stats.overflow_regrows += 1
+                    with tracing.span("executor.regrow"):
+                        batches = [b.with_capacity(new_m, new_d) for b in batches]
+                except RuntimeError as transient:
+                    if isinstance(transient, JoinError) or not _is_transient(
+                        transient
+                    ):
+                        raise
+                    retries += 1
+                    if retries > self.max_retries:
+                        raise JoinError(
+                            f"tree join failed after {self.max_retries} retries"
+                        ) from transient
+                    stats.transient_retries += 1
+                    if self.retry_backoff_s > 0:
+                        time.sleep(self.retry_backoff_s * (2 ** (retries - 1)))
+
+    def _grown(self, cur: int, hit: bool) -> int:
+        if not hit:
+            return cur
+        # never shrink: a batch may already exceed max_capacity
+        return max(cur, min(max(1, cur) * self.grow_factor, self.max_capacity))
 
     # -- internals ---------------------------------------------------------
 
@@ -143,15 +245,8 @@ class JoinExecutor:
                     raise
                 m = getattr(acc, "member_capacity", 0)
                 d = getattr(acc, "deferred_capacity", 0)
-
-                def _grown(cur, hit):
-                    if not hit:
-                        return cur
-                    # never shrink: a batch may already exceed max_capacity
-                    return max(cur, min(max(1, cur) * self.grow_factor, self.max_capacity))
-
-                new_m = _grown(m, overflow.member)
-                new_d = _grown(d, overflow.deferred)
+                new_m = self._grown(m, overflow.member)
+                new_d = self._grown(d, overflow.deferred)
                 if new_m == m and new_d == d:
                     raise JoinError(
                         f"join overflowed at max_capacity={self.max_capacity} "
@@ -182,7 +277,10 @@ def join_all(batches: Sequence[Any], **kwargs: Any) -> Any:
     """One-shot convenience: ``JoinExecutor().join_all(batches)``."""
     executor_kwargs = {
         k: kwargs.pop(k)
-        for k in ("max_capacity", "max_retries", "grow_factor", "retry_backoff_s")
+        for k in (
+            "max_capacity", "max_retries", "grow_factor", "retry_backoff_s",
+            "strategy",
+        )
         if k in kwargs
     }
     return JoinExecutor(**executor_kwargs).join_all(batches, **kwargs)

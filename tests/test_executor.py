@@ -204,3 +204,116 @@ def test_non_overflow_value_errors_propagate():
 
     with pytest.raises(ValueError, match="shape mismatch"):
         JoinExecutor().join_all([Broken(), b])
+
+
+class TestTreeStrategy:
+    """join_all with strategy='tree' — the join_fleet schedule behind the
+    same elastic recoveries as the sequential fold."""
+
+    def _fleets(self, member_lists, uni):
+        from crdt_tpu.batch import OrswotBatch
+        from crdt_tpu.scalar.orswot import Orswot
+
+        fleets = []
+        for r, members in enumerate(member_lists):
+            row = []
+            for i, ms in enumerate(members):
+                s = Orswot()
+                for m in ms:
+                    s.apply(s.add(m, s.value().derive_add_ctx(f"n{r}")))
+                row.append(s)
+            fleets.append(OrswotBatch.from_scalar(row, uni))
+        return fleets
+
+    def test_matches_sequential_strategy(self):
+        from crdt_tpu.config import CrdtConfig
+        from crdt_tpu.parallel.executor import JoinExecutor, JoinStats
+        from crdt_tpu.utils.interning import Universe
+
+        uni = Universe(CrdtConfig(num_actors=8, member_capacity=16,
+                                  deferred_capacity=4))
+        members = [
+            [[f"a{i}", f"b{(i + r) % 5}"] for i in range(6)] for r in range(5)
+        ]
+        seq = JoinExecutor(strategy="sequential").join_all(
+            self._fleets(members, uni)
+        )
+        stats = JoinStats()
+        tree = JoinExecutor(strategy="tree").join_all(
+            self._fleets(members, uni), stats=stats
+        )
+        assert tree.value_sets(uni) == seq.value_sets(uni)
+        assert stats.joins == 5  # 4 tree merges + plunger
+
+    def test_tree_overflow_regrows_all_fleets(self):
+        from crdt_tpu.config import CrdtConfig
+        from crdt_tpu.parallel.executor import JoinExecutor, JoinStats
+        from crdt_tpu.utils.interning import Universe
+
+        # disjoint members force the union past the starting capacity
+        uni = Universe(CrdtConfig(num_actors=8, member_capacity=2,
+                                  deferred_capacity=2))
+        members = [[[f"r{r}m{j}" for j in range(2)] for _ in range(3)]
+                   for r in range(4)]
+        stats = JoinStats()
+        out = JoinExecutor(strategy="tree").join_all(
+            self._fleets(members, uni), stats=stats
+        )
+        assert stats.overflow_regrows >= 1
+        assert out.member_capacity > 2
+        got = out.value_sets(uni)
+        want = {f"r{r}m{j}" for r in range(4) for j in range(2)}
+        assert all(s == want for s in got)
+
+    def test_auto_resolves_by_backend(self):
+        from crdt_tpu.parallel.executor import JoinExecutor
+
+        ex = JoinExecutor(strategy="auto")
+
+        class HasFleet:
+            @classmethod
+            def join_fleet(cls, *a, **k):  # pragma: no cover - marker only
+                raise NotImplementedError
+
+        import jax
+
+        expected = jax.default_backend() == "tpu"
+        assert ex._use_tree([HasFleet(), HasFleet()]) is expected
+        assert JoinExecutor(strategy="sequential")._use_tree(
+            [HasFleet(), HasFleet()]
+        ) is False
+        import pytest
+
+        with pytest.raises(ValueError, match="strategy"):
+            JoinExecutor(strategy="bogus")._use_tree([HasFleet(), HasFleet()])
+
+    def test_forced_tree_without_join_fleet_raises(self):
+        import pytest
+
+        from crdt_tpu.parallel.executor import JoinExecutor
+
+        class NoFleet:
+            pass
+
+        with pytest.raises(ValueError, match="join_fleet"):
+            JoinExecutor(strategy="tree")._use_tree([NoFleet(), NoFleet()])
+
+    def test_module_level_join_all_forwards_strategy(self):
+        from crdt_tpu.batch import OrswotBatch
+        from crdt_tpu.config import CrdtConfig
+        from crdt_tpu.parallel.executor import join_all
+        from crdt_tpu.scalar.orswot import Orswot
+        from crdt_tpu.utils.interning import Universe
+
+        uni = Universe(CrdtConfig(num_actors=4, member_capacity=8,
+                                  deferred_capacity=2))
+        def fleet(tag):
+            row = []
+            for i in range(3):
+                s = Orswot()
+                s.apply(s.add(f"{tag}{i}", s.value().derive_add_ctx(tag)))
+                row.append(s)
+            return OrswotBatch.from_scalar(row, uni)
+
+        out = join_all([fleet("x"), fleet("y")], strategy="tree")
+        assert out.value_sets(uni) == [{f"x{i}", f"y{i}"} for i in range(3)]

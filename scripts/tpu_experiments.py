@@ -96,6 +96,35 @@ elif mode in ("order_rank", "order_argsort"):
     t = chain(step, (keys,), iters=20)
     print(f"RESULT {mode}: {t*1e3:.2f} ms")
 
+elif mode in ("gather_take", "gather_onehot", "scatter_put"):
+    # primitive isolation at merge shapes: the rank-select core's gathers
+    # (take_along_axis over the slot axis) and the scatter the CPU path
+    # uses for rank inversion are the prime TPU-inefficiency suspects
+    n, s_slots, a = 62_500, 32, 64
+    payload = jnp.asarray(rng.randint(0, 1000, size=(n, s_slots, a)).astype(np.uint32))
+    idx = jnp.asarray(rng.randint(0, s_slots, size=(n, 16)).astype(np.int32))
+    if mode == "gather_take":
+        def step(c):
+            g = jnp.take_along_axis(c[0], idx[..., None], axis=-2)  # [n,16,a]
+            return (jnp.concatenate(
+                [jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
+    elif mode == "gather_onehot":
+        onehot = (idx[..., None] == jnp.arange(s_slots)[None, None, :])
+        def step(c):
+            g = jnp.einsum("nks,nsa->nka", onehot.astype(jnp.uint32), c[0])
+            return (jnp.concatenate([jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
+    else:  # scatter_put
+        ranks = jnp.asarray(
+            np.argsort(rng.rand(n, s_slots), axis=-1).astype(np.int32))
+        iota = jnp.arange(s_slots, dtype=jnp.int32)
+        def step(c):
+            perm = jnp.put_along_axis(
+                jnp.zeros(ranks.shape, jnp.int32), ranks,
+                jnp.broadcast_to(iota, ranks.shape), axis=-1, inplace=False)
+            return (c[0] ^ perm[..., None].astype(c[0].dtype),)
+    t = chain(step, (payload,), iters=20)
+    print(f"RESULT {mode}: {t*1e3:.2f} ms")
+
 elif mode in ("dtype_u32", "dtype_u64"):
     dt = np.uint32 if mode == "dtype_u32" else np.uint64
     n, a, m, d = 100_000, 16, 8, 4
@@ -133,6 +162,9 @@ def main():
     run("merge_scatterless", {"CRDT_SCATTERLESS": "1"})
     run("order_rank")
     run("order_argsort")
+    run("gather_take")
+    run("gather_onehot")
+    run("scatter_put")
     run("dtype_u32", {"CRDT_TPU_NO_X64": "0"})
     run("dtype_u64", {"CRDT_TPU_NO_X64": "0"})
     run("fold_seq", timeout=1500)

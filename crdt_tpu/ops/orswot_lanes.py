@@ -1,0 +1,260 @@
+"""Experimental ORSWOT merge variants for TPU layout tuning.
+
+The production jnp merge (:func:`crdt_tpu.ops.orswot_ops.merge`) leans on
+``take_along_axis`` gathers and a counting-rank permutation — primitives
+XLA:TPU executes far from the HBM roofline (measured ~8.5 GB/s effective
+vs ~819 GB/s peak on v5e; ``reports/TPU_LATENCY.md``).  This module holds
+the two candidate replacements, both *gather- and sort-free*: every
+alignment and compaction step is expressed as unrolled one-hot selects
+and max-reductions over the small static slot axes, exactly the style of
+the Pallas tile math (:mod:`crdt_tpu.ops.orswot_pallas`), which XLA can
+fuse into dense elementwise passes.
+
+* :func:`merge_unrolled` — the Pallas tile math run as plain jnp on full
+  ``[N, ...]`` arrays in the standard layout.  Zero new semantics: it IS
+  ``orswot_pallas._merge_tile``, so parity with the production merge is
+  inherited from ``tests/test_orswot_pallas.py`` and re-asserted in
+  ``tests/test_orswot_lanes.py``.
+* :func:`merge_lanes` / the ``*_t`` functions — the same math with every
+  array transposed so the **object axis is minor**: ``clock[A, N]``,
+  ``ids[M, N]``, ``dots[M, A, N]``.  On TPU the minor axis maps to the
+  128-wide vector lanes; with ``N`` minor every elementwise op runs at
+  full lane utilization regardless of how small ``A``/``M`` are (the
+  standard layout wastes half the lanes at ``A = 64`` and worse below),
+  and the per-slot one-hot selects become broadcasts over ``[A, N]``
+  planes.  A fold should transpose once at ingest (:func:`to_lanes`),
+  stay transposed across all ``R`` joins, and transpose back at egress
+  (:func:`from_lanes`).
+
+Semantics are `/root/reference/src/orswot.rs:89-156` throughout — the
+rule-by-rule citations live in ``orswot_ops``/``orswot_pallas``; these
+variants only change execution layout, never the algebra.  Counters are
+uint32 (the bias-to-int32 trick of the Pallas path — order-preserving
+``x ^ 0x8000_0000``; exact, since the merge only compares/maxes/selects).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import orswot_pallas as _op
+
+EMPTY = _op.EMPTY
+ZERO = _op.ZERO
+
+
+def merge_unrolled(
+    clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int,
+):
+    """Pairwise merge via the unrolled (gather/sort-free) tile math in the
+    standard ``[N, ...]`` layout.  Drop-in for ``orswot_ops.merge``."""
+    _op._check_dtypes(clock_a)
+    _op._check_dtypes(clock_b)
+    cdt = clock_a.dtype
+    sa = _op._to_kernel_dtype((clock_a, ids_a, dots_a, dids_a, dclocks_a))
+    sb = _op._to_kernel_dtype((clock_b, ids_b, dots_b, dids_b, dclocks_b))
+    (clock, ids, dots, dids, dclk), over = _op._merge_tile(sa, sb, m_cap, d_cap)
+    return (
+        _op._from_kernel_dtype(clock, cdt), ids,
+        _op._from_kernel_dtype(dots, cdt), dids,
+        _op._from_kernel_dtype(dclk, cdt), over,
+    )
+
+
+# ---------------------------------------------------------------------------
+# lanes-last (object-axis-minor) tile math
+#
+# Layout: clock[A, N], ids[M, N], dots[M, A, N], d_ids[D, N],
+# d_clocks[D, A, N] — slot and actor axes lead, the batch axis is minor.
+# Counter planes are bias-mapped int32 (see module docstring).
+# ---------------------------------------------------------------------------
+
+
+def to_lanes(state):
+    """Transpose a standard ``[N, ...]`` state 5-tuple to lanes-last."""
+    clock, ids, dots, d_ids, d_clocks = state
+    return (
+        clock.T, ids.T, jnp.transpose(dots, (1, 2, 0)),
+        d_ids.T, jnp.transpose(d_clocks, (1, 2, 0)),
+    )
+
+
+def from_lanes(state):
+    """Invert :func:`to_lanes`."""
+    clock, ids, dots, d_ids, d_clocks = state
+    return (
+        clock.T, ids.T, jnp.transpose(dots, (2, 0, 1)),
+        d_ids.T, jnp.transpose(d_clocks, (2, 0, 1)),
+    )
+
+
+# int32-domain bool reduces and clock subtract, shared with the Pallas
+# tile math (one copy to keep in sync if the lowering trick changes)
+_any_t = _op._any
+_all_t = _op._all
+_sub_t = _op._sub
+
+
+def _align_against_t(ids_a, dots_a, ids_b, dots_b):
+    """Per a-slot, the matching b dot clock (``ZERO`` if unmatched), plus
+    the mask of b-slots consumed.  ``ids[M, N]``, ``dots[M, A, N]``."""
+    m_b = ids_b.shape[0]
+    valid_a = ids_a != EMPTY  # [Ma, N]
+    e2 = jnp.full_like(dots_a, ZERO)
+    b_cols = []
+    for j in range(m_b):
+        mj = valid_a & (ids_a == ids_b[j][None, :])  # [Ma, N]
+        e2 = jnp.maximum(e2, jnp.where(mj[:, None, :], dots_b[j][None], ZERO))
+        b_cols.append(_any_t(mj, axis=0))  # [N]
+    return e2, jnp.stack(b_cols, axis=0)  # [Mb, N]
+
+
+def _merge_rule_t(e1, e2, p1, p2, valid, self_clock, other_clock):
+    """Three-way per-member dot algebra; ``e[M, A, N]``, masks ``[M, N]``,
+    clocks ``[A, N]``."""
+    sc = self_clock[None]  # [1, A, N]
+    oc = other_clock[None]
+    common = jnp.where(e1 == e2, e1, ZERO)
+    c1 = _sub_t(_sub_t(e1, common), oc)
+    c2 = _sub_t(_sub_t(e2, common), sc)
+    out_both = jnp.maximum(common, jnp.maximum(c1, c2))
+    keep1 = ~_all_t(e1 <= oc, axis=1)  # [M, N]
+    out_only1 = jnp.where(keep1[:, None, :], e1, ZERO)
+    out_only2 = _sub_t(e2, sc)
+    both = (p1 & p2)[:, None, :]
+    only1 = (p1 & ~p2)[:, None, :]
+    out = jnp.where(both, out_both, jnp.where(only1, out_only1, out_only2))
+    return jnp.where(valid[:, None, :], out, ZERO)
+
+
+def _rank_select_t(keys, live, payload_ids, payload_clocks, cap):
+    """Pack live slots in ascending-``keys`` order into ``cap`` output
+    slots; ``keys``/``live``/``payload_ids [S, N]``, clocks ``[S, A, N]``."""
+    s = keys.shape[0]
+    rank = jnp.zeros(keys.shape, dtype=jnp.int32)
+    for j in range(s):
+        smaller = live & live[j][None] & (keys[j][None] < keys)
+        rank = rank + smaller.astype(jnp.int32)
+    out_ids, out_clocks = [], []
+    for k in range(cap):
+        sel = live & (rank == k)  # [S, N], at most one hot per column
+        out_ids.append(
+            jnp.sum(jnp.where(sel, payload_ids + 1, 0), axis=0, dtype=jnp.int32) - 1
+        )
+        out_clocks.append(
+            jnp.max(jnp.where(sel[:, None, :], payload_clocks, ZERO), axis=0)
+        )
+    ids = jnp.stack(out_ids, axis=0)  # [cap, N]
+    clocks = jnp.stack(out_clocks, axis=0)  # [cap, A, N]
+    overflow = jnp.sum(live, axis=0, dtype=jnp.int32) > cap  # [N]
+    return ids, clocks, overflow
+
+
+def _merge_tile_t(sa, sb, m_cap: int, d_cap: int):
+    """Full pairwise merge of two lanes-last states (biased-int32 planes).
+
+    Mirrors ``orswot_pallas._merge_tile`` stage for stage; returns the
+    merged 5-tuple plus ``overflow[2, N]``."""
+    ca, ids_a, dots_a, dida, dca = sa
+    cb, ids_b, dots_b, didb, dcb = sb
+
+    # member alignment + dot algebra (`orswot.rs:92-138`)
+    e2_for_a, b_matched = _align_against_t(ids_a, dots_a, ids_b, dots_b)
+    valid_a = ids_a != EMPTY
+    valid_b = ids_b != EMPTY
+    nonempty = lambda clocks: _any_t(clocks != ZERO, axis=1)  # [S, N]
+    out_a = _merge_rule_t(
+        dots_a, e2_for_a,
+        valid_a & nonempty(dots_a), valid_a & nonempty(e2_for_a),
+        valid_a, ca, cb,
+    )
+    b_only = valid_b & ~b_matched
+    out_b = jnp.where(b_only[:, None, :], _sub_t(dots_b, ca[None]), ZERO)
+
+    ids_cat = jnp.concatenate(
+        [jnp.where(valid_a, ids_a, EMPTY), jnp.where(b_only, ids_b, EMPTY)], axis=0
+    )  # [Ma+Mb, N]
+    dots_cat = jnp.concatenate([out_a, out_b], axis=0)  # [Ma+Mb, A, N]
+
+    # deferred union + dedup, keep first (`orswot.rs:141-148`)
+    d_ids = jnp.concatenate([dida, didb], axis=0)  # [Dn, N]
+    d_clocks = jnp.concatenate([dca, dcb], axis=0)  # [Dn, A, N]
+    dn = d_ids.shape[0]
+    d_valid = d_ids != EMPTY
+    dup_cols = [jnp.zeros(d_ids.shape[1:], dtype=bool)]
+    for j in range(1, dn):
+        dup_j = jnp.zeros(d_ids.shape[1:], dtype=bool)
+        for i in range(j):
+            same = (
+                d_valid[i]
+                & d_valid[j]
+                & (d_ids[i] == d_ids[j])
+                & _all_t(d_clocks[i] == d_clocks[j], axis=0)
+            )
+            dup_j = dup_j | same
+        dup_cols.append(dup_j)
+    is_dup = jnp.stack(dup_cols, axis=0)
+    d_live = d_valid & ~is_dup
+    d_ids = jnp.where(d_live, d_ids, EMPTY)
+    d_clocks = jnp.where(d_live[:, None, :], d_clocks, ZERO)
+
+    # clock join (`orswot.rs:153`) then deferred replay (`:155`)
+    clock = jnp.maximum(ca, cb)
+    rm = jnp.full_like(dots_cat, ZERO)
+    for k in range(dn):
+        match = (ids_cat == d_ids[k][None]) & d_live[k][None]  # [Mcat, N]
+        rm = jnp.maximum(rm, jnp.where(match[:, None, :], d_clocks[k][None], ZERO))
+    new_dots = _sub_t(dots_cat, rm)
+    live = nonempty(new_dots) & (ids_cat != EMPTY)
+    still_ahead = d_live & ~_all_t(d_clocks <= clock[None], axis=1)
+
+    # canonical compaction (ascending member id / first-occurrence order)
+    big = jnp.iinfo(jnp.int32).max
+    m_keys = jnp.where(live, ids_cat, big)
+    ids_out, dots_out, m_over = _rank_select_t(m_keys, live, ids_cat, new_dots, m_cap)
+    slot_keys = jax.lax.broadcasted_iota(jnp.int32, d_ids.shape, 0)
+    dids_out, dclk_out, d_over = _rank_select_t(
+        slot_keys, still_ahead, d_ids, d_clocks, d_cap
+    )
+    return (clock, ids_out, dots_out, dids_out, dclk_out), jnp.stack(
+        [m_over, d_over], axis=0
+    )
+
+
+def merge_t(sa, sb, m_cap: int, d_cap: int):
+    """Pairwise merge of two lanes-last **uint32** states (5-tuples as
+    produced by :func:`to_lanes`).  Returns ``(state, overflow[2, N])`` —
+    stay in this layout across a fold and :func:`from_lanes` at the end."""
+    _op._check_dtypes(sa[0])
+    _op._check_dtypes(sb[0])
+    cdt = sa[0].dtype
+    out, over = _merge_tile_t(
+        _op._to_kernel_dtype(sa), _op._to_kernel_dtype(sb), m_cap, d_cap
+    )
+    clock, ids, dots, dids, dclk = out
+    return (
+        _op._from_kernel_dtype(clock, cdt), ids,
+        _op._from_kernel_dtype(dots, cdt), dids,
+        _op._from_kernel_dtype(dclk, cdt),
+    ), over
+
+
+def merge_lanes(
+    clock_a, ids_a, dots_a, dids_a, dclocks_a,
+    clock_b, ids_b, dots_b, dids_b, dclocks_b,
+    m_cap: int, d_cap: int,
+):
+    """Drop-in for ``orswot_ops.merge`` (single ``[N, ...]`` batch axis)
+    that executes lanes-last: transpose in, merge, transpose out.  For
+    real folds keep the state transposed instead (:func:`merge_t`) — the
+    boundary transposes here exist so parity tests and one-shot callers
+    can use the standard layout."""
+    _op._check_dtypes(clock_a)
+    sa = to_lanes((clock_a, ids_a, dots_a, dids_a, dclocks_a))
+    sb = to_lanes((clock_b, ids_b, dots_b, dids_b, dclocks_b))
+    out, over = merge_t(sa, sb, m_cap, d_cap)
+    clock, ids, dots, dids, dclk = from_lanes(out)
+    return clock, ids, dots, dids, dclk, over.T

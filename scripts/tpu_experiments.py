@@ -82,6 +82,28 @@ elif mode in ("merge_scatter", "merge_scatterless"):
     t = chain(lambda acc: orswot_ops.merge(*acc, *rhs, m, d)[:5], lhs, iters=20)
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
 
+elif mode in ("merge_unrolled", "merge_lanes"):
+    # gather/sort-free layout candidates (crdt_tpu/ops/orswot_lanes.py):
+    # the unrolled tile math in standard layout, and the lanes-last
+    # (object-axis-minor) variant timed in its steady state — the carry
+    # stays transposed, as a real fold would keep it
+    from crdt_tpu.ops import orswot_lanes
+    n, a, m, d = 100_000, 16, 8, 4
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    if mode == "merge_unrolled":
+        t = chain(
+            lambda acc: orswot_lanes.merge_unrolled(*acc, *rhs, m, d)[:5],
+            lhs, iters=20,
+        )
+    else:
+        rhs_t = orswot_lanes.to_lanes(rhs)
+        t = chain(
+            lambda acc: orswot_lanes.merge_t(acc, rhs_t, m, d)[0],
+            orswot_lanes.to_lanes(lhs), iters=20,
+        )
+    print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
+
 elif mode in ("order_rank", "order_argsort"):
     n, s = 200_000, 32
     keys = jnp.asarray(rng.randint(0, 1 << 20, size=(n, s)).astype(np.int32))
@@ -158,17 +180,33 @@ def run(mode, env_extra=None, timeout=900):
 def main():
     print(f"tpu_experiments on backend env JAX_PLATFORMS="
           f"{os.environ.get('JAX_PLATFORMS')!r}", flush=True)
-    run("merge_scatter", {"CRDT_SCATTERLESS": "0"})
-    run("merge_scatterless", {"CRDT_SCATTERLESS": "1"})
-    run("order_rank")
-    run("order_argsort")
-    run("gather_take")
-    run("gather_onehot")
-    run("scatter_put")
-    run("dtype_u32", {"CRDT_TPU_NO_X64": "0"})
-    run("dtype_u64", {"CRDT_TPU_NO_X64": "0"})
-    run("fold_seq", timeout=1500)
-    run("fold_tree", timeout=1500)
+    menu = [
+        ("merge_scatter", {"CRDT_SCATTERLESS": "0"}, 900),
+        ("merge_scatterless", {"CRDT_SCATTERLESS": "1"}, 900),
+        ("merge_unrolled", None, 900),
+        ("merge_lanes", None, 900),
+        ("order_rank", None, 900),
+        ("order_argsort", None, 900),
+        ("gather_take", None, 900),
+        ("gather_onehot", None, 900),
+        ("scatter_put", None, 900),
+        ("dtype_u32", {"CRDT_TPU_NO_X64": "0"}, 900),
+        ("dtype_u64", {"CRDT_TPU_NO_X64": "0"}, 900),
+        ("fold_seq", None, 1500),
+        ("fold_tree", None, 1500),
+    ]
+    # CRDT_EXP_MODES=comma,separated,subset restricts the menu (tunnel
+    # windows are short — spend them on the undecided experiments)
+    subset = os.environ.get("CRDT_EXP_MODES")
+    if subset:
+        wanted = set(subset.split(","))
+        known = {row[0] for row in menu}
+        for name in sorted(wanted - known):
+            print(f"WARNING: unknown CRDT_EXP_MODES entry {name!r} "
+                  f"(known: {','.join(sorted(known))})", flush=True)
+        menu = [row for row in menu if row[0] in wanted]
+    for mode, env_extra, timeout in menu:
+        run(mode, env_extra, timeout=timeout)
 
 
 if __name__ == "__main__":

@@ -1,17 +1,22 @@
 #!/bin/bash
-# Poll the axon tunnel; when it revives, immediately capture a full TPU
-# bench run and a compiled-Pallas attempt before it can wedge again.
+# Poll the axon tunnel; when it revives, immediately capture the pending
+# TPU measurements before it can wedge again.  Order matters: everything
+# that needs the tunnel's remote-compile helper runs BEFORE the
+# compiled-Pallas attempt (inside bench.py's validation step) — a Mosaic
+# crash has been observed to take the compile helper down with it
+# (reports/TPU_LATENCY.md), so the bench goes last.
 cd /root/repo
 for i in $(seq 1 200); do
     if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-        echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing bench" | tee -a /tmp/tunnel_watch.log
-        timeout 3000 python bench.py > /tmp/bench_tpu3.log 2>&1
+        echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing" | tee -a /tmp/tunnel_watch.log
+        timeout 2400 python scripts/profile_stages.py > /tmp/profile_tpu.log 2>&1
+        echo "profile exit: $?" | tee -a /tmp/tunnel_watch.log
+        CRDT_EXP_MODES=${CRDT_EXP_MODES:-merge_scatter,merge_scatterless,merge_unrolled,merge_lanes,gather_take,gather_onehot,scatter_put} \
+            timeout 5400 python scripts/tpu_experiments.py > /tmp/experiments_tpu.log 2>&1
+        echo "experiments exit: $?" | tee -a /tmp/tunnel_watch.log
+        timeout 4500 python bench.py > /tmp/bench_tpu3.log 2>&1
         echo "bench exit: $? (log: /tmp/bench_tpu3.log)" | tee -a /tmp/tunnel_watch.log
         tail -1 /tmp/bench_tpu3.log | tee -a /tmp/tunnel_watch.log
-        timeout 1200 python scripts/profile_stages.py > /tmp/profile_tpu.log 2>&1
-        echo "profile exit: $?" | tee -a /tmp/tunnel_watch.log
-        timeout 9000 python scripts/tpu_experiments.py > /tmp/experiments_tpu.log 2>&1
-        echo "experiments exit: $?" | tee -a /tmp/tunnel_watch.log
         exit 0
     fi
     echo "$(date -u +%H:%M:%S) tunnel down (attempt $i)" >> /tmp/tunnel_watch.log

@@ -1,0 +1,125 @@
+"""Parity: layout-experiment ORSWOT merges vs the production jnp path.
+
+Both variants in ``crdt_tpu.ops.orswot_lanes`` — the unrolled standard-
+layout merge and the lanes-last (object-axis-minor) merge — must be
+bit-identical to ``orswot_ops.merge``, which is itself bit-exact against
+the scalar engine (``tests/test_parity.py``) and thereby the reference
+(`/root/reference/src/orswot.rs:89-156`).  Deferred-bearing states are
+included: ``random_orswot_arrays(deferred_frac=...)`` plants causally-
+future remove rows, so the replay path is exercised, not just the fast
+path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from crdt_tpu.ops import orswot_lanes, orswot_ops
+from crdt_tpu.utils.testdata import random_orswot_arrays
+
+
+def _pair(rng, n, a, m, d, deferred_frac=0.0):
+    lhs = tuple(
+        jnp.asarray(x)
+        for x in random_orswot_arrays(
+            rng, n, a, m, d, np.uint32, deferred_frac=deferred_frac
+        )
+    )
+    rhs = tuple(
+        jnp.asarray(x)
+        for x in random_orswot_arrays(
+            rng, n, a, m, d, np.uint32, deferred_frac=deferred_frac
+        )
+    )
+    return lhs, rhs
+
+
+def _assert_same(ref, got):
+    """Bit-equality on every object the production path doesn't flag as
+    overflowed.  ``orswot_ops`` counts member survivors *pre*-replay (the
+    conservative contract — the host discards flagged objects and
+    regrows), while the unrolled tile math replays before compaction and
+    only overflows when the *post*-replay survivors exceed capacity, so
+    on ref-flagged objects the two legitimately diverge; everywhere else
+    they must agree exactly, and the unrolled flag must never fire where
+    the conservative one didn't."""
+    ref_over = np.asarray(ref[5])
+    got_over = np.asarray(got[5])
+    ok = ~ref_over.any(axis=-1)
+    assert not (got_over & ~ref_over).any(), "unrolled overflow without ref overflow"
+    names = ("clock", "ids", "dots", "d_ids", "d_clocks")
+    for name, r, g in zip(names, ref[:5], got[:5]):
+        np.testing.assert_array_equal(
+            np.asarray(r)[ok], np.asarray(g)[ok], err_msg=name
+        )
+
+
+@pytest.mark.parametrize("deferred_frac", [0.0, 0.4])
+@pytest.mark.parametrize("shape", [(17, 4, 3, 2), (33, 8, 4, 2), (21, 16, 8, 4)])
+def test_unrolled_merge_parity(shape, deferred_frac):
+    n, a, m, d = shape
+    rng = np.random.RandomState(11)
+    lhs, rhs = _pair(rng, n, a, m, d, deferred_frac)
+    _assert_same(
+        orswot_ops.merge(*lhs, *rhs, m, d),
+        orswot_lanes.merge_unrolled(*lhs, *rhs, m, d),
+    )
+
+
+@pytest.mark.parametrize("deferred_frac", [0.0, 0.4])
+@pytest.mark.parametrize("shape", [(17, 4, 3, 2), (33, 8, 4, 2), (21, 16, 8, 4)])
+def test_lanes_merge_parity(shape, deferred_frac):
+    n, a, m, d = shape
+    rng = np.random.RandomState(13)
+    lhs, rhs = _pair(rng, n, a, m, d, deferred_frac)
+    _assert_same(
+        orswot_ops.merge(*lhs, *rhs, m, d),
+        orswot_lanes.merge_lanes(*lhs, *rhs, m, d),
+    )
+
+
+def test_lanes_roundtrip():
+    rng = np.random.RandomState(17)
+    state = tuple(
+        jnp.asarray(x)
+        for x in random_orswot_arrays(rng, 9, 4, 3, 2, np.uint32, deferred_frac=0.5)
+    )
+    back = orswot_lanes.from_lanes(orswot_lanes.to_lanes(state))
+    for want, got in zip(state, back):
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_lanes_fold_stays_transposed():
+    """A left fold in the transposed layout (transpose once, fold R, egress
+    once) matches the production fold — the deployment shape for TPU."""
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(19)
+    n, a, m, d, r = 15, 8, 8, 2, 4
+    fleets = [
+        tuple(jnp.asarray(x) for x in rep)
+        for rep in anti_entropy_fleets(
+            rng, n, a, m, d, r, base=4, novel=1, deferred_frac=0.3
+        )
+    ]
+
+    want = fleets[0]
+    over = np.zeros((n,), bool)
+    for nxt in fleets[1:]:
+        *want, o = orswot_ops.merge(*want, *nxt, m, d)
+        over |= np.asarray(o).any(axis=-1)
+    *want, o = orswot_ops.merge(*want, *want, m, d)  # defer plunger
+    over |= np.asarray(o).any(axis=-1)
+    ok = ~over  # conservative-overflow objects diverge by contract
+
+    acc = orswot_lanes.to_lanes(fleets[0])
+    for nxt in fleets[1:]:
+        acc, _ = orswot_lanes.merge_t(acc, orswot_lanes.to_lanes(nxt), m, d)
+    acc, _ = orswot_lanes.merge_t(acc, acc, m, d)
+    got = orswot_lanes.from_lanes(acc)
+    assert ok.sum() >= n // 2, "fold test data mostly overflowed; regenerate"
+    for name, w, g in zip(("clock", "ids", "dots", "d_ids", "d_clocks"), want, got):
+        np.testing.assert_array_equal(
+            np.asarray(w)[ok], np.asarray(g)[ok], err_msg=name
+        )

@@ -242,6 +242,44 @@ def merge_t(sa, sb, m_cap: int, d_cap: int):
     ), over
 
 
+def stacked_to_lanes(stack):
+    """Transpose stacked replica fleets ``[R, N, ...]`` (the
+    ``fold_merge_tree``/bench layout) to lanes-last per fleet:
+    ``clock[R, A, N]``, ``ids[R, M, N]``, ``dots[R, M, A, N]``, ... —
+    :func:`to_lanes` mapped over the fleet axis, so the layout has one
+    source of truth."""
+    return jax.vmap(to_lanes)(tuple(stack))
+
+
+def fold_merge_t(stack, m_cap: int, d_cap: int, plunger: bool = True):
+    """Anti-entropy left fold over ``R`` stacked lanes-last fleets (from
+    :func:`stacked_to_lanes`): fold fleet ``i`` into the accumulator for
+    ``i = 1..R-1``, optionally finishing with the defer-plunger self-merge
+    (`/root/reference/test/orswot.rs:61-62`) — the lanes-layout equivalent
+    of the sequential jnp fold the bench times.  The whole fold runs in
+    the biased-int32 kernel domain (one conversion in, one out — not one
+    per merge).  Returns ``(state, overflow[2, N])`` with overflow
+    OR-reduced over every merge."""
+    _op._check_dtypes(stack[0])
+    cdt = stack[0].dtype
+    r = stack[0].shape[0]
+    kstack = _op._to_kernel_dtype(stack)
+    acc = tuple(x[0] for x in kstack)
+    over_acc = jnp.zeros((2, stack[0].shape[-1]), bool)
+    for i in range(1, r):
+        acc, over = _merge_tile_t(acc, tuple(x[i] for x in kstack), m_cap, d_cap)
+        over_acc = over_acc | over
+    if plunger:
+        acc, over = _merge_tile_t(acc, acc, m_cap, d_cap)
+        over_acc = over_acc | over
+    clock, ids, dots, dids, dclk = acc
+    return (
+        _op._from_kernel_dtype(clock, cdt), ids,
+        _op._from_kernel_dtype(dots, cdt), dids,
+        _op._from_kernel_dtype(dclk, cdt),
+    ), over_acc
+
+
 def merge_lanes(
     clock_a, ids_a, dots_a, dids_a, dclocks_a,
     clock_b, ids_b, dots_b, dids_b, dclocks_b,

@@ -123,3 +123,17 @@ def test_lanes_fold_stays_transposed():
         np.testing.assert_array_equal(
             np.asarray(w)[ok], np.asarray(g)[ok], err_msg=name
         )
+
+    # the stacked fold driver (the bench's CRDT_LANES=1 path) must match
+    # the manual per-fleet fold above
+    stack = tuple(
+        jnp.stack([fleet[k] for fleet in fleets]) for k in range(5)
+    )
+    out, _ = orswot_lanes.fold_merge_t(
+        orswot_lanes.stacked_to_lanes(stack), m, d
+    )
+    got2 = orswot_lanes.from_lanes(out)
+    for name, w, g in zip(("clock", "ids", "dots", "d_ids", "d_clocks"), got, got2):
+        np.testing.assert_array_equal(
+            np.asarray(w), np.asarray(g), err_msg=f"stacked fold {name}"
+        )

@@ -118,6 +118,44 @@ elif mode in ("order_rank", "order_argsort"):
     t = chain(step, (keys,), iters=20)
     print(f"RESULT {mode}: {t*1e3:.2f} ms")
 
+elif mode in ("gather_mxu", "gather_mxu8"):
+    # one-hot gather as an MXU matmul; traffic is near-minimal because
+    # the MXU reuses the [S, A] operand across the 16 output slots.
+    # TPU matmuls round f32 inputs to bf16 at default precision, so
+    # exactness needs one of:
+    #   gather_mxu  — 16-bit halves in f32 with Precision.HIGHEST
+    #                 (multi-pass f32 emulation; 2 einsums)
+    #   gather_mxu8 — 8-bit bytes at DEFAULT precision: 0..255 operands
+    #                 and 0/1 one-hots are bf16-exact, and each output
+    #                 sums exactly one nonzero product (4 einsums at
+    #                 native MXU speed)
+    n, s_slots, a = 62_500, 32, 64
+    payload = jnp.asarray(rng.randint(0, 1 << 31, size=(n, s_slots, a)).astype(np.uint32))
+    idx = jnp.asarray(rng.randint(0, s_slots, size=(n, 16)).astype(np.int32))
+    onehot = (idx[..., None] == jnp.arange(s_slots)[None, None, :]).astype(jnp.float32)
+    if mode == "gather_mxu":
+        def step(c):
+            lo = (c[0] & jnp.uint32(0xFFFF)).astype(jnp.float32)
+            hi = (c[0] >> 16).astype(jnp.float32)
+            glo = jnp.einsum("nks,nsa->nka", onehot, lo,
+                             precision=jax.lax.Precision.HIGHEST)
+            ghi = jnp.einsum("nks,nsa->nka", onehot, hi,
+                             precision=jax.lax.Precision.HIGHEST)
+            g = (ghi.astype(jnp.uint32) << 16) | glo.astype(jnp.uint32)
+            return (jnp.concatenate(
+                [jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
+    else:
+        def step(c):
+            g = jnp.zeros((n, 16, a), jnp.uint32)
+            for shift in (0, 8, 16, 24):
+                byte = ((c[0] >> shift) & jnp.uint32(0xFF)).astype(jnp.float32)
+                gb = jnp.einsum("nks,nsa->nka", onehot, byte)
+                g = g | (gb.astype(jnp.uint32) << shift)
+            return (jnp.concatenate(
+                [jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
+    t = chain(step, (payload,), iters=20)
+    print(f"RESULT {mode}: {t*1e3:.2f} ms")
+
 elif mode in ("gather_take", "gather_onehot", "scatter_put"):
     # primitive isolation at merge shapes: the rank-select core's gathers
     # (take_along_axis over the slot axis) and the scatter the CPU path
@@ -189,6 +227,8 @@ def main():
         ("order_argsort", None, 900),
         ("gather_take", None, 900),
         ("gather_onehot", None, 900),
+        ("gather_mxu", None, 900),
+        ("gather_mxu8", None, 900),
         ("scatter_put", None, 900),
         ("dtype_u32", {"CRDT_TPU_NO_X64": "0"}, 900),
         ("dtype_u64", {"CRDT_TPU_NO_X64": "0"}, 900),

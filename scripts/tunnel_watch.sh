@@ -11,9 +11,12 @@ for i in $(seq 1 200); do
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing" | tee -a /tmp/tunnel_watch.log
         timeout 2400 python scripts/profile_stages.py > /tmp/profile_tpu.log 2>&1
         echo "profile exit: $?" | tee -a /tmp/tunnel_watch.log
-        CRDT_EXP_MODES=${CRDT_EXP_MODES:-merge_scatter,merge_scatterless,merge_unrolled,merge_lanes,gather_take,gather_onehot,scatter_put} \
+        CRDT_EXP_MODES=${CRDT_EXP_MODES:-merge_scatter,merge_scatterless,merge_unrolled,merge_lanes,gather_take,gather_onehot,gather_mxu,scatter_put} \
             timeout 5400 python scripts/tpu_experiments.py > /tmp/experiments_tpu.log 2>&1
         echo "experiments exit: $?" | tee -a /tmp/tunnel_watch.log
+        CRDT_LANES=1 CRDT_SKIP_TPU_VALIDATE=1 timeout 2400 python bench.py > /tmp/bench_tpu_lanes.log 2>&1
+        echo "lanes bench exit: $?" | tee -a /tmp/tunnel_watch.log
+        tail -1 /tmp/bench_tpu_lanes.log | tee -a /tmp/tunnel_watch.log
         timeout 4500 python bench.py > /tmp/bench_tpu3.log 2>&1
         echo "bench exit: $? (log: /tmp/bench_tpu3.log)" | tee -a /tmp/tunnel_watch.log
         tail -1 /tmp/bench_tpu3.log | tee -a /tmp/tunnel_watch.log

@@ -242,6 +242,23 @@ def compact_by_id(ids, payload, cap):
     return ids, payload, overflow
 
 
+def _merge_impl_default():
+    """Which pairwise-merge implementation ``merge`` dispatches to.
+
+    ``CRDT_MERGE_IMPL`` ∈ ``rank`` (the rank-select pipeline below, CPU
+    default), ``unrolled`` (gather/sort-free tile math, standard layout)
+    or ``lanes`` (tile math with the object axis in the vector lanes) —
+    the last two live in :mod:`crdt_tpu.ops.orswot_lanes` and are exact
+    for uint32 counters only (bit-equal outside the conservative-overflow
+    objects; see ``tests/test_orswot_lanes.py``).  The unset default is
+    ``rank`` on every backend until the TPU layout A/B
+    (`scripts/tpu_experiments.py`) picks a winner; flipping the TPU
+    default is then this function's one-line change."""
+    import os
+
+    return os.environ.get("CRDT_MERGE_IMPL", "rank")
+
+
 def merge(
     clock_a, ids_a, dots_a, dids_a, dclocks_a,
     clock_b, ids_b, dots_b, dids_b, dclocks_b,
@@ -263,6 +280,36 @@ def merge(
     computes the dot algebra only for those; deferred-bearing batches take
     the full-width pipeline.
     """
+    impl = _merge_impl_default()
+    if impl not in ("rank", "unrolled", "lanes"):
+        raise ValueError(
+            f"CRDT_MERGE_IMPL={impl!r} is not one of rank/unrolled/lanes"
+        )
+    if (
+        impl != "rank"
+        and clock_a.dtype.itemsize <= 4
+        and ids_a.shape[-1] <= _ALIGN_MATCH_MAX_M
+    ):
+        # the tile math unrolls Python loops over the slot axes, so wide
+        # member tables (elastic regrowth) stay on the rank path's
+        # sort-aligned _merge_wide below
+        from . import orswot_lanes
+
+        if impl == "unrolled":
+            # rank-polymorphic (ellipsis-based tile math): any batch shape
+            return orswot_lanes.merge_unrolled(
+                clock_a, ids_a, dots_a, dids_a, dclocks_a,
+                clock_b, ids_b, dots_b, dids_b, dclocks_b,
+                m_cap, d_cap,
+            )
+        if clock_a.ndim == 2:
+            # the lanes transpose assumes exactly one batch axis; other
+            # ranks (e.g. the tree fold's [R/2, N, ...]) fall through
+            return orswot_lanes.merge_lanes(
+                clock_a, ids_a, dots_a, dids_a, dclocks_a,
+                clock_b, ids_b, dots_b, dids_b, dclocks_b,
+                m_cap, d_cap,
+            )
     if ids_a.shape[-1] > _ALIGN_MATCH_MAX_M:
         return _merge_wide(
             clock_a, ids_a, dots_a, dids_a, dclocks_a,

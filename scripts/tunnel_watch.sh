@@ -6,6 +6,10 @@
 # crash has been observed to take the compile helper down with it
 # (reports/TPU_LATENCY.md), so the bench goes last.
 cd /root/repo
+# persistent XLA compilation cache: repeated captures across tunnel
+# windows skip recompiling unchanged programs, so a window spends its
+# minutes measuring instead of compiling
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}
 for i in $(seq 1 200); do
     if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing" | tee -a /tmp/tunnel_watch.log

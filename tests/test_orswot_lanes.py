@@ -79,6 +79,35 @@ def test_lanes_merge_parity(shape, deferred_frac):
     )
 
 
+def test_merge_impl_dispatch(monkeypatch):
+    """CRDT_MERGE_IMPL routes orswot_ops.merge to the layout variants;
+    all three implementations agree on non-overflow objects, and the
+    lanes route falls back to rank for batch ranks it cannot transpose."""
+    rng = np.random.RandomState(23)
+    lhs, rhs = _pair(rng, 19, 4, 3, 2, deferred_frac=0.3)
+    outs = {}
+    for impl in ("rank", "unrolled", "lanes"):
+        monkeypatch.setenv("CRDT_MERGE_IMPL", impl)
+        outs[impl] = orswot_ops.merge(*lhs, *rhs, 3, 2)
+    for impl in ("unrolled", "lanes"):
+        _assert_same(outs["rank"], outs[impl])
+
+    # rank > 2 under lanes: must fall through, not mis-transpose
+    monkeypatch.setenv("CRDT_MERGE_IMPL", "lanes")
+    stacked_l = tuple(jnp.stack([x, x]) for x in lhs)
+    stacked_r = tuple(jnp.stack([x, x]) for x in rhs)
+    got = orswot_ops.merge(*stacked_l, *stacked_r, 3, 2)
+    monkeypatch.setenv("CRDT_MERGE_IMPL", "rank")
+    want = orswot_ops.merge(*stacked_l, *stacked_r, 3, 2)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+    # unknown impl names error instead of silently picking a variant
+    monkeypatch.setenv("CRDT_MERGE_IMPL", "pallas")
+    with pytest.raises(ValueError, match="CRDT_MERGE_IMPL"):
+        orswot_ops.merge(*lhs, *rhs, 3, 2)
+
+
 def test_lanes_roundtrip():
     rng = np.random.RandomState(17)
     state = tuple(

@@ -108,6 +108,27 @@ def test_merge_impl_dispatch(monkeypatch):
         orswot_ops.merge(*lhs, *rhs, 3, 2)
 
 
+def test_full_uint32_counter_range_parity():
+    """The lanes tile math works in the bias-mapped signed domain
+    (``x ^ 0x8000_0000``); counters at and above ``2**31`` must stay
+    bit-exact through both layout variants."""
+    rng = np.random.RandomState(29)
+    n, a, m, d = 16, 4, 4, 2
+    lhs, rhs = _pair(rng, n, a, m, d, deferred_frac=0.4)
+
+    def inflate(state):
+        clock, ids, dots, dids, dclocks = state
+        big = jnp.uint32(1 << 31)
+        up = lambda x: jnp.where(x > 0, x + big, x)  # keep 0 = absent
+        return up(clock), ids, up(dots), dids, up(dclocks)
+
+    lhs, rhs = inflate(lhs), inflate(rhs)
+    ref = orswot_ops.merge(*lhs, *rhs, m, d)
+    _assert_same(ref, orswot_lanes.merge_unrolled(*lhs, *rhs, m, d))
+    _assert_same(ref, orswot_lanes.merge_lanes(*lhs, *rhs, m, d))
+    assert int(np.asarray(ref[0]).max()) >= 1 << 31
+
+
 def test_lanes_roundtrip():
     rng = np.random.RandomState(17)
     state = tuple(

@@ -116,6 +116,32 @@ def test_allgather_join_orswot_matches_scalar():
         assert got == expected, f"replica shard {r} diverged"
 
 
+@pytest.mark.parametrize("impl", ["unrolled", "lanes"])
+def test_allgather_join_orswot_merge_impl_variants(impl, monkeypatch):
+    """The CRDT_MERGE_IMPL layout variants compose with the collective
+    join: the combiner inside the all-gather fold routes through
+    orswot_ops.merge, whose dispatch must behave identically under
+    shard_map's per-shard (rank-2) views.  u32 counters — the variants'
+    supported width."""
+    monkeypatch.setenv("CRDT_MERGE_IMPL", impl)
+    mesh = make_mesh({"replicas": 8})
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=16,
+                              deferred_capacity=8, counter_bits=32))
+    fleet = random_orswots(seed=5, n_replicas=8, n_objects=6)
+
+    batches = [OrswotBatch.from_scalar(row, uni) for row in fleet]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+    joined = allgather_join_orswot(stacked, mesh, axis="replicas")
+
+    expected = scalar_global_join(fleet)
+    shard = OrswotBatch(
+        clock=joined.clock[0], ids=joined.ids[0], dots=joined.dots[0],
+        d_ids=joined.d_ids[0], d_clocks=joined.d_clocks[0],
+    )
+    plunged = shard.merge(OrswotBatch.zeros(6, uni))
+    assert plunged.to_scalar(uni) == expected
+
+
 def test_allgather_join_map_matches_scalar():
     """Map collective join (`map.rs:192-269` combiner incl. nested value
     merge + reset-remove) == scalar N-way left fold, on every device."""

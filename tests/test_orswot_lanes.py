@@ -108,6 +108,44 @@ def test_merge_impl_dispatch(monkeypatch):
         orswot_ops.merge(*lhs, *rhs, 3, 2)
 
 
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+
+import functools
+
+import jax as _jax
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(impl, m, d):
+    """One compiled merge per (impl, caps): example iterations then cost
+    dispatch, not tracing (eager tiny-shape merges are ~1s each)."""
+    fn = {
+        "rank": orswot_ops.merge,  # traced with CRDT_MERGE_IMPL unset
+        "unrolled": orswot_lanes.merge_unrolled,
+        "lanes": orswot_lanes.merge_lanes,
+    }[impl]
+    return _jax.jit(lambda lhs, rhs: fn(*lhs, *rhs, m, d))
+
+
+@pytest.mark.parametrize(
+    "shape", [(7, 1, 1, 1), (7, 3, 2, 1), (7, 8, 5, 3)]
+)
+@settings(max_examples=25)  # shapes fixed → 3 compiles per impl, data varies
+@given(seed=st.integers(0, 2**31 - 1), deferred_frac=st.sampled_from([0.0, 0.5]))
+def test_impl_agreement_property(shape, seed, deferred_frac):
+    """All three merge implementations agree on random states across the
+    shape grid (incl. single-slot tables and deferred-bearing batches) —
+    the randomized analogue of the fixed-seed parity cases above."""
+    n, a, m, d = shape
+    rng = np.random.RandomState(seed)
+    lhs, rhs = _pair(rng, n, a, m, d, deferred_frac)
+    ref = _jitted("rank", m, d)(lhs, rhs)
+    _assert_same(ref, _jitted("unrolled", m, d)(lhs, rhs))
+    _assert_same(ref, _jitted("lanes", m, d)(lhs, rhs))
+
+
 def test_full_uint32_counter_range_parity():
     """The lanes tile math works in the bias-mapped signed domain
     (``x ^ 0x8000_0000``); counters at and above ``2**31`` must stay

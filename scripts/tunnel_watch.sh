@@ -1,31 +1,50 @@
 #!/bin/bash
-# Poll the axon tunnel; when it revives, immediately capture the pending
-# TPU measurements before it can wedge again.  Order matters: everything
-# that needs the tunnel's remote-compile helper runs BEFORE the
-# compiled-Pallas attempt (inside bench.py's validation step) — a Mosaic
-# crash has been observed to take the compile helper down with it
-# (reports/TPU_LATENCY.md), so the bench goes last.
+# Poll the axon tunnel; whenever it is alive, run every capture step that
+# has not yet succeeded (marker files under /tmp/tw_done), until all have.
+# A window that closes mid-capture just means the remaining steps retry
+# on the next window.  Order matters: everything that needs the tunnel's
+# remote-compile helper runs BEFORE the compiled-Pallas attempt (inside
+# the final bench.py's validation step) — a Mosaic crash has been
+# observed to take the compile helper down with it (reports/TPU_LATENCY.md).
 cd /root/repo
 # persistent XLA compilation cache: repeated captures across tunnel
 # windows skip recompiling unchanged programs, so a window spends its
 # minutes measuring instead of compiling
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}
+MARK=/tmp/tw_done
+mkdir -p "$MARK"
+
+step() {  # step <name> <timeout> <log> <cmd...>
+    local name=$1 tmo=$2 log=$3; shift 3
+    [ -e "$MARK/$name" ] && return 0
+    echo "$(date -u +%H:%M:%S) step $name starting" | tee -a /tmp/tunnel_watch.log
+    timeout "$tmo" "$@" > "$log" 2>&1
+    local rc=$?
+    echo "$(date -u +%H:%M:%S) step $name exit $rc (log: $log)" | tee -a /tmp/tunnel_watch.log
+    tail -1 "$log" | tee -a /tmp/tunnel_watch.log
+    [ $rc -eq 0 ] && touch "$MARK/$name"
+    return $rc
+}
+
 for i in $(seq 1 200); do
     if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing" | tee -a /tmp/tunnel_watch.log
-        timeout 2400 python scripts/profile_stages.py > /tmp/profile_tpu.log 2>&1
-        echo "profile exit: $?" | tee -a /tmp/tunnel_watch.log
-        CRDT_EXP_MODES=${CRDT_EXP_MODES:-merge_scatter,merge_scatterless,merge_unrolled,merge_lanes,gather_take,gather_onehot,gather_mxu,scatter_put} \
-            timeout 5400 python scripts/tpu_experiments.py > /tmp/experiments_tpu.log 2>&1
-        echo "experiments exit: $?" | tee -a /tmp/tunnel_watch.log
-        CRDT_LANES=1 CRDT_SKIP_TPU_VALIDATE=1 timeout 2400 python bench.py > /tmp/bench_tpu_lanes.log 2>&1
-        echo "lanes bench exit: $?" | tee -a /tmp/tunnel_watch.log
-        tail -1 /tmp/bench_tpu_lanes.log | tee -a /tmp/tunnel_watch.log
-        timeout 4500 python bench.py > /tmp/bench_tpu3.log 2>&1
-        echo "bench exit: $? (log: /tmp/bench_tpu3.log)" | tee -a /tmp/tunnel_watch.log
-        tail -1 /tmp/bench_tpu3.log | tee -a /tmp/tunnel_watch.log
-        exit 0
+        step profile 2400 /tmp/profile_tpu.log \
+            python scripts/profile_stages.py
+        step experiments 5400 /tmp/experiments_tpu.log \
+            env CRDT_EXP_MODES=merge_scatter,merge_scatterless,merge_unrolled,merge_lanes,gather_take,gather_onehot,gather_mxu,gather_mxu8,scatter_put \
+            python scripts/tpu_experiments.py
+        step bench_lanes 2400 /tmp/bench_tpu_lanes.log \
+            env CRDT_LANES=1 CRDT_SKIP_TPU_VALIDATE=1 python bench.py
+        step bench 4500 /tmp/bench_tpu3.log \
+            python bench.py
+        if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
+           [ -e "$MARK/bench_lanes" ] && [ -e "$MARK/bench" ]; then
+            echo "$(date -u +%H:%M:%S) all captures done" | tee -a /tmp/tunnel_watch.log
+            exit 0
+        fi
+    else
+        echo "$(date -u +%H:%M:%S) tunnel down (attempt $i)" >> /tmp/tunnel_watch.log
     fi
-    echo "$(date -u +%H:%M:%S) tunnel down (attempt $i)" >> /tmp/tunnel_watch.log
     sleep 60
 done

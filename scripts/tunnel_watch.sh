@@ -27,7 +27,7 @@ step() {  # step <name> <timeout> <log> <cmd...>
 }
 
 for i in $(seq 1 200); do
-    if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing" | tee -a /tmp/tunnel_watch.log
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py

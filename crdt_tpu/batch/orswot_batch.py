@@ -153,12 +153,16 @@ class OrswotBatch:
           deferred table directly (a row is one buffered
           (member, clock) remove, `orswot.rs:29`).
 
-        Duplicate coordinates join by ``max`` (the lattice's own rule, so
-        re-ingesting overlapping exports is idempotent).  Actor indices
-        must already be dense (``universe.actor_idx``); member ids are the
-        interned int32 ids (``universe.member_id``).  Raises ``ValueError``
-        on a negative member id (the ``EMPTY`` sentinel leaking from an
-        upstream export), when an object's distinct members exceed
+        Duplicate *counter* coordinates (clock, dot, deferred-clock cells)
+        join by ``max`` — the lattice's own rule, so re-ingesting
+        overlapping exports is idempotent.  ``deferred_members`` rows are
+        assignments, not lattice cells: two entries naming the same
+        ``(obj, row)`` with different member ids are a conflict and raise.
+        Actor indices must already be dense (``universe.actor_idx``);
+        member ids are the interned int32 ids (``universe.member_id``).
+        Raises ``ValueError`` on a negative member id (the ``EMPTY``
+        sentinel leaking from an upstream export) in either ``dot_coords``
+        or ``deferred_members``, when an object's distinct members exceed
         ``member_capacity``, when a deferred row index falls outside
         ``[0, deferred_capacity)``, or when only one of the two deferred
         argument pairs is supplied."""
@@ -215,6 +219,26 @@ class OrswotBatch:
             qo, qr, qm = (np.asarray(x) for x in deferred_members)
             _check_rows(qr, "deferred_members")
             if qo.size:
+                if qm.min(initial=0) < 0:
+                    raise ValueError(
+                        f"negative member id {int(qm.min())} in "
+                        "deferred_members (EMPTY sentinel leaking from an "
+                        "export?) — the row would be invisible to kernels "
+                        "while its clock still scatters into d_clocks"
+                    )
+                # duplicate (obj, row) keys are assignments, not lattice
+                # cells: silently last-write-winning would drop a remove
+                key = qo.astype(np.int64) * d + qr.astype(np.int64)
+                order = np.argsort(key, kind="stable")
+                sk, sm = key[order], qm[order]
+                dup = sk[1:] == sk[:-1]
+                if np.any(dup & (sm[1:] != sm[:-1])):
+                    i = int(np.nonzero(dup & (sm[1:] != sm[:-1]))[0][0])
+                    raise ValueError(
+                        f"conflicting deferred_members assignments for "
+                        f"(obj={int(sk[i]) // d}, row={int(sk[i]) % d}): "
+                        f"member ids {int(sm[i])} and {int(sm[i + 1])}"
+                    )
                 d_ids[qo, qr] = qm.astype(np.int32)
             ho, hr, ha, hc = (np.asarray(x) for x in deferred_coords)
             _check_rows(hr, "deferred_coords")

@@ -26,7 +26,10 @@ class ConflictingMarker(CrdtError):
     """
 
     def __str__(self) -> str:
-        return "Dot's are used exactly once for the lifetime of a CRDT"
+        base = "Dot's are used exactly once for the lifetime of a CRDT"
+        # keep the reference's Display string (error.rs:9-13) but don't
+        # swallow caller detail (e.g. which register conflicted in a join)
+        return f"{base}: {self.args[0]}" if self.args else base
 
 
 class MergeConflict(CrdtError):

@@ -253,7 +253,14 @@ def _merge_impl_default():
     objects; see ``tests/test_orswot_lanes.py``).  The unset default is
     ``rank`` on every backend until the TPU layout A/B
     (`scripts/tpu_experiments.py`) picks a winner; flipping the TPU
-    default is then this function's one-line change."""
+    default is then this function's one-line change.
+
+    The env var is read at **trace time**: jit caches are keyed on
+    shapes/dtypes only, so flipping ``CRDT_MERGE_IMPL`` after a caller's
+    first compile keeps the previously traced impl for same-shaped
+    inputs.  Callers that must re-dispatch (tests parametrized over
+    impls, A/B harnesses) clear jit caches (``jax.clear_caches()``) or
+    use distinctly shaped inputs per impl."""
     import os
 
     return os.environ.get("CRDT_MERGE_IMPL", "rank")

@@ -1,23 +1,24 @@
 #!/bin/bash
 # Poll the axon tunnel; whenever it is alive, run every capture step that
-# has not yet succeeded (marker files under /tmp/tw_done), until all have.
-# A window that closes mid-capture just means the remaining steps retry
-# on the next window.  Order matters: everything that needs the tunnel's
-# remote-compile helper runs BEFORE the compiled-Pallas attempt (inside
-# the final bench.py's validation step) — a Mosaic crash has been
-# observed to take the compile helper down with it (reports/TPU_LATENCY.md).
+# has not yet succeeded (marker files under /tmp/tw_done.<rev>), until all
+# have.  A window that closes mid-capture just means the remaining steps
+# retry on the next window.  Order matters: everything that needs the
+# tunnel's remote-compile helper runs BEFORE the compiled-Pallas attempt —
+# a Mosaic crash has been observed to take the compile helper down with it
+# (reports/TPU_LATENCY.md).
+#
+# Markers are keyed to the git rev so a capture from an older build never
+# satisfies a step after bench/kernel changes (advisor finding r2).
 cd /root/repo
 # persistent XLA compilation cache: repeated captures across tunnel
 # windows skip recompiling unchanged programs, so a window spends its
 # minutes measuring instead of compiling
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}
-MARK=/tmp/tw_done
-mkdir -p "$MARK"
 
 step() {  # step <name> <timeout> <log> <cmd...>
     local name=$1 tmo=$2 log=$3; shift 3
     [ -e "$MARK/$name" ] && return 0
-    echo "$(date -u +%H:%M:%S) step $name starting" | tee -a /tmp/tunnel_watch.log
+    echo "$(date -u +%H:%M:%S) step $name starting (rev $REV)" | tee -a /tmp/tunnel_watch.log
     timeout "$tmo" "$@" > "$log" 2>&1
     local rc=$?
     echo "$(date -u +%H:%M:%S) step $name exit $rc (log: $log)" | tee -a /tmp/tunnel_watch.log
@@ -26,9 +27,41 @@ step() {  # step <name> <timeout> <log> <cmd...>
     return $rc
 }
 
-for i in $(seq 1 200); do
+publish_bench() {  # publish_bench <log>
+    # Persist the captured on-chip bench line as a repo artifact so a
+    # mid-round window survives even if the driver's end-of-round probe
+    # misses the next window (the driver commits uncommitted files).
+    python - "$1" "$REV" <<'EOF'
+import json, sys, time
+lines = [l for l in open(sys.argv[1]) if l.startswith('{"metric"')]
+if lines:
+    rec = json.loads(lines[-1])
+    rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rec["captured_rev"] = sys.argv[2]
+    with open("BENCH_tpu_window.json", "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    print("published BENCH_tpu_window.json:", json.dumps(rec))
+EOF
+}
+
+for i in $(seq 1 600); do
+    # re-key markers every iteration: a commit OR working-tree edit
+    # mid-watch invalidates earlier captures and the steps re-run on the
+    # next window.  The key hashes HEAD + the dirty diff + untracked file
+    # contents (deterministic, unlike `git stash create` whose commit
+    # hash embeds a timestamp), so a capture is never attributed to code
+    # that didn't run.
+    # hash only the paths that determine what a capture measures — the
+    # published artifact / report files must not invalidate the markers
+    CODE="crdt_tpu scripts bench.py __graft_entry__.py"
+    DIRTY=$( { git diff HEAD -- $CODE 2>/dev/null; \
+               git ls-files -o --exclude-standard -z -- $CODE 2>/dev/null \
+                 | xargs -0 cat 2>/dev/null; } | sha1sum | cut -c1-8 )
+    REV="$(git rev-parse --short HEAD 2>/dev/null || echo norev).$DIRTY"
+    MARK=/tmp/tw_done.$REV
+    mkdir -p "$MARK"
     if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-        echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing" | tee -a /tmp/tunnel_watch.log
+        echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing (rev $REV)" | tee -a /tmp/tunnel_watch.log
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
         step experiments 5400 /tmp/experiments_tpu.log \
@@ -36,11 +69,25 @@ for i in $(seq 1 200); do
             python scripts/tpu_experiments.py
         step bench_lanes 2400 /tmp/bench_tpu_lanes.log \
             env CRDT_LANES=1 CRDT_SKIP_TPU_VALIDATE=1 python bench.py
-        step bench 4500 /tmp/bench_tpu3.log \
-            python bench.py
+        # publish only when this iteration actually ran the bench (marker
+        # absent before the call) — a marker short-circuit must not
+        # re-stamp the artifact's capture time
+        if [ ! -e "$MARK/bench" ] && step bench 4500 /tmp/bench_tpu3.log \
+            env CRDT_SKIP_TPU_VALIDATE=1 python bench.py; then
+            publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
+        fi
+        step validate_merge 900 /tmp/validate_merge_tpu.log \
+            python scripts/tpu_validate.py --merge
+        # Compiled-Pallas attempt LAST: a Mosaic crash can wedge the
+        # remote compile helper for the rest of the window.  Workaround
+        # env from the captured failure log (PALLAS_TPU_ATTEMPT.txt:12-14).
+        step pallas 1800 /tmp/pallas_tpu.log \
+            env TPU_ACCELERATOR_TYPE=v5litepod-1 TPU_WORKER_HOSTNAMES=localhost \
+            python scripts/tpu_validate.py --pallas
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
-           [ -e "$MARK/bench_lanes" ] && [ -e "$MARK/bench" ]; then
-            echo "$(date -u +%H:%M:%S) all captures done" | tee -a /tmp/tunnel_watch.log
+           [ -e "$MARK/bench_lanes" ] && [ -e "$MARK/bench" ] && \
+           [ -e "$MARK/validate_merge" ] && [ -e "$MARK/pallas" ]; then
+            echo "$(date -u +%H:%M:%S) all captures done (rev $REV)" | tee -a /tmp/tunnel_watch.log
             exit 0
         fi
     else

@@ -164,3 +164,46 @@ def test_from_coo_deferred_row_overflow_raises():
             deferred_coords=(np.array([0]), np.array([1]),
                              np.array([0]), np.array([7])),
         )
+
+def test_from_coo_rejects_negative_deferred_member():
+    """A -1 (EMPTY) deferred member id would make the row invisible to
+    kernels while its clock still scatters into d_clocks (advisor r2)."""
+    uni = _universe()
+    empty3 = (np.array([]), np.array([]), np.array([]))
+    with pytest.raises(ValueError, match="negative member id.*deferred"):
+        OrswotBatch.from_coo(
+            1, uni, clock_coords=empty3,
+            dot_coords=empty3 + (np.array([]),),
+            deferred_members=(np.array([0]), np.array([0]), np.array([-1])),
+            deferred_coords=(np.array([0]), np.array([0]),
+                             np.array([0]), np.array([5])),
+        )
+
+
+def test_from_coo_rejects_conflicting_deferred_member_assignment():
+    """Duplicate (obj, row) keys naming different members must raise, not
+    silently last-write-win (deferred rows are assignments, not lattice
+    cells — advisor r2)."""
+    uni = _universe()
+    empty3 = (np.array([]), np.array([]), np.array([]))
+    with pytest.raises(ValueError, match="conflicting deferred_members"):
+        OrswotBatch.from_coo(
+            2, uni, clock_coords=empty3,
+            dot_coords=empty3 + (np.array([]),),
+            deferred_members=(np.array([1, 0, 1]), np.array([0, 0, 0]),
+                              np.array([3, 2, 4])),
+            deferred_coords=(np.array([1, 0, 1]), np.array([0, 0, 0]),
+                             np.array([0, 1, 2]), np.array([5, 5, 5])),
+        )
+    # duplicate (obj, row) with the SAME member id is idempotent re-ingest,
+    # not a conflict
+    b = OrswotBatch.from_coo(
+        1, uni, clock_coords=empty3,
+        dot_coords=empty3 + (np.array([]),),
+        deferred_members=(np.array([0, 0]), np.array([0, 0]),
+                          np.array([3, 3])),
+        deferred_coords=(np.array([0, 0]), np.array([0, 0]),
+                         np.array([0, 0]), np.array([5, 9])),
+    )
+    assert int(np.asarray(b.d_ids)[0, 0]) == 3
+    assert int(np.asarray(b.d_clocks)[0, 0, 0]) == 9

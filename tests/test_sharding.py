@@ -123,6 +123,10 @@ def test_allgather_join_orswot_merge_impl_variants(impl, monkeypatch):
     orswot_ops.merge, whose dispatch must behave identically under
     shard_map's per-shard (rank-2) views.  u32 counters — the variants'
     supported width."""
+    # CRDT_MERGE_IMPL is read at trace time and jit caches key on shapes
+    # only: without clearing, the second param would silently reuse the
+    # first param's traced impl (both params use identical shapes)
+    jax.clear_caches()
     monkeypatch.setenv("CRDT_MERGE_IMPL", impl)
     mesh = make_mesh({"replicas": 8})
     uni = Universe(CrdtConfig(num_actors=8, member_capacity=16,
@@ -307,3 +311,150 @@ def test_sharded_pairwise_merge_no_collectives():
     hlo = compiled.as_text()
     for collective in ("all-gather", "all-reduce", "collective-permute", "all-to-all"):
         assert collective not in hlo, f"shard-local merge emitted {collective}"
+
+
+# -- LWWReg / MVReg / GSet collective joins ----------------------------------
+
+
+def test_allgather_join_lww_matches_scalar():
+    """Marker-argmax collective join (`lwwreg.rs:43-67`) == scalar N-way
+    left fold, on every device (BASELINE config 5's join path)."""
+    from crdt_tpu.batch import LWWRegBatch
+    from crdt_tpu.parallel import allgather_join_lww
+    from crdt_tpu.scalar.lwwreg import LWWReg
+
+    mesh = make_mesh({"replicas": 8})
+    uni = small_universe()
+    rng = np.random.RandomState(11)
+    n = 24
+    # distinct markers per (replica, object) => no conflicts; value is a
+    # function of the marker so ties (none here) would agree anyway
+    markers = rng.permutation(8 * n).reshape(8, n) + 1
+    fleet = [
+        [LWWReg(val=int(markers[r, i]) * 7, marker=int(markers[r, i]))
+         for i in range(n)]
+        for r in range(8)
+    ]
+
+    batches = [LWWRegBatch.from_scalar(row, uni) for row in fleet]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+    joined, conflict = allgather_join_lww(stacked, mesh, axis="replicas")
+    assert not bool(jnp.any(conflict))
+
+    expected = []
+    for i in range(n):
+        acc = fleet[0][i].clone()
+        for r in range(1, 8):
+            acc.merge(fleet[r][i])
+        expected.append(acc)
+    for r in range(8):
+        shard = LWWRegBatch(vals=joined.vals[r], markers=joined.markers[r])
+        assert shard.to_scalar(uni) == expected, f"replica shard {r} diverged"
+
+
+def test_allgather_join_lww_conflict_surfaces():
+    """An equal-marker/different-value pair anywhere in the fold raises
+    host-side and the bitmap pinpoints the register — including the
+    intermediate-max case where the global max marker is unique but two
+    earlier replicas collide (`lwwreg.rs:56-66` pairwise semantics)."""
+    from crdt_tpu.batch import LWWRegBatch
+    from crdt_tpu.error import ConflictingMarker
+    from crdt_tpu.parallel import allgather_join_lww
+    from crdt_tpu.scalar.lwwreg import LWWReg
+
+    mesh = make_mesh({"replicas": 8})
+    uni = small_universe()
+    n = 4
+    fleet = [[LWWReg(val=100 + r, marker=1 + r) for _ in range(n)] for r in range(8)]
+    # register 2: replicas 3 and 4 share marker 50 with different values,
+    # replica 7 holds the unique global max 99
+    fleet[3][2] = LWWReg(val=111, marker=50)
+    fleet[4][2] = LWWReg(val=222, marker=50)
+    fleet[7][2] = LWWReg(val=333, marker=99)
+
+    batches = [LWWRegBatch.from_scalar(row, uni) for row in fleet]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+    with pytest.raises(ConflictingMarker):
+        allgather_join_lww(stacked, mesh, axis="replicas")
+
+    joined, conflict = allgather_join_lww(stacked, mesh, axis="replicas", check=False)
+    bitmap = np.asarray(conflict[0])
+    assert bitmap.tolist() == [False, False, True, False]
+    # scalar fold agrees that the walk conflicts at register 2
+    acc = fleet[0][2].clone()
+    with pytest.raises(ConflictingMarker):
+        for r in range(1, 8):
+            acc.merge(fleet[r][2])
+
+
+def test_allgather_join_mvreg_matches_scalar():
+    """Antichain gather-fold join (`mvreg.rs:121-153`) == scalar N-way left
+    fold on every device; concurrent values from different replicas all
+    survive, dominated ones collapse."""
+    from crdt_tpu.batch import MVRegBatch
+    from crdt_tpu.parallel import allgather_join_mvreg
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    mesh = make_mesh({"replicas": 8})
+    uni = small_universe()
+    rng = np.random.RandomState(13)
+    n = 6
+    fleet = []
+    for r in range(8):
+        row = []
+        for i in range(n):
+            reg = MVReg()
+            for _ in range(rng.randint(0, 3)):
+                actor = int(rng.randint(0, 8))
+                ctx = reg.read().derive_add_ctx(actor)
+                reg.apply(reg.set(int(rng.randint(0, 50)), ctx))
+            row.append(reg)
+        fleet.append(row)
+
+    batches = [MVRegBatch.from_scalar(row, uni) for row in fleet]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+    joined = allgather_join_mvreg(stacked, mesh, axis="replicas")
+
+    expected = []
+    for i in range(n):
+        acc = fleet[0][i].clone()
+        for r in range(1, 8):
+            acc.merge(fleet[r][i])
+        expected.append(acc)
+    for r in range(8):
+        shard = MVRegBatch(clocks=joined.clocks[r], vals=joined.vals[r])
+        got = shard.to_scalar(uni)
+        # MVReg equality is set-equality over (clock, val) pairs
+        # (`mvreg.rs:74-96`)
+        assert got == expected, f"replica shard {r} diverged"
+
+
+def test_allgather_join_gset_matches_scalar():
+    """Bitmap-OR all-reduce == scalar N-way union (`gset.rs:30-34`)."""
+    from crdt_tpu.batch import GSetBatch
+    from crdt_tpu.parallel import allgather_join_gset
+    from crdt_tpu.scalar.gset import GSet
+
+    mesh = make_mesh({"replicas": 8})
+    uni = small_universe()
+    rng = np.random.RandomState(17)
+    n, cap = 10, 16
+    fleet = [
+        [GSet({int(m) for m in rng.choice(12, rng.randint(0, 6), replace=False)})
+         for _ in range(n)]
+        for _ in range(8)
+    ]
+
+    batches = [GSetBatch.from_scalar(row, uni, cap) for row in fleet]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
+    joined = allgather_join_gset(stacked, mesh, axis="replicas")
+
+    expected = []
+    for i in range(n):
+        acc = fleet[0][i].clone()
+        for r in range(1, 8):
+            acc.merge(fleet[r][i])
+        expected.append(acc)
+    for r in range(8):
+        shard = GSetBatch(bits=joined.bits[r])
+        assert shard.to_scalar(uni) == expected, f"replica shard {r} diverged"

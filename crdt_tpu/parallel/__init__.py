@@ -17,6 +17,10 @@ from .mesh import make_mesh, replicate, shard_batch
 from .executor import JoinError, JoinExecutor, JoinStats, join_all
 from .collective import (
     all_reduce_clock_join,
+    allgather_join_gset,
+    allgather_join_lww,
+    allgather_join_map,
+    allgather_join_mvreg,
     allgather_join_orswot,
     anti_entropy,
     fold_reduce_merge,
@@ -26,6 +30,10 @@ from .collective import (
 
 __all__ = [
     "all_reduce_clock_join",
+    "allgather_join_gset",
+    "allgather_join_lww",
+    "allgather_join_map",
+    "allgather_join_mvreg",
     "allgather_join_orswot",
     "gather_fold_orswot",
     "anti_entropy",

@@ -36,6 +36,16 @@ from ..ops import orswot_ops
 # -- clock-shaped types ------------------------------------------------------
 
 
+def _check_replica_axis(leading: int, mesh: Mesh, axis: str) -> None:
+    """Every collective join shards one replica per device over ``axis``;
+    a mismatched leading axis means the caller stacked the fleet wrong."""
+    if leading != mesh.shape[axis]:
+        raise ValueError(
+            f"leading replica axis {leading} != mesh axis "
+            f"{axis}={mesh.shape[axis]} (one replica shard per device)"
+        )
+
+
 def all_reduce_clock_join(clocks, mesh: Mesh, axis: str = "replicas"):
     """Global VClock/GCounter/PNCounter join across a mesh axis.
 
@@ -44,13 +54,17 @@ def all_reduce_clock_join(clocks, mesh: Mesh, axis: str = "replicas"):
     axis size); the join is an all-reduce-max — the direct ICI collective
     form of N-way ``VClock::merge``.  Every replica row of the output holds
     the global join."""
-    if clocks.shape[0] != mesh.shape[axis]:
-        raise ValueError(
-            f"leading replica axis {clocks.shape[0]} != mesh axis "
-            f"{axis}={mesh.shape[axis]} (one replica shard per device)"
-        )
-    spec = P(axis, *([None] * (clocks.ndim - 1)))
+    _check_replica_axis(clocks.shape[0], mesh, axis)
+    return _clock_join_fn(mesh, axis, clocks.ndim)(clocks)
 
+
+@functools.lru_cache(maxsize=64)
+def _clock_join_fn(mesh: Mesh, axis: str, ndim: int):
+    """Cached jitted clock all-reduce (jax.jit caches by function identity;
+    a per-call closure would retrace+recompile every call)."""
+    spec = P(axis, *([None] * (ndim - 1)))
+
+    @jax.jit
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec,), out_specs=spec, check_vma=False
     )
@@ -59,7 +73,7 @@ def all_reduce_clock_join(clocks, mesh: Mesh, axis: str = "replicas"):
         local_join = jnp.max(local, axis=0, keepdims=True)
         return jax.lax.pmax(local_join, axis_name=axis)
 
-    return jax.jit(_join)(clocks)
+    return _join
 
 
 # -- generic tree reduction over a replica axis ------------------------------
@@ -209,16 +223,24 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
 
     m_cap = batch.ids.shape[-1]
     d_cap = batch.d_ids.shape[-1]
-    n_dev = mesh.shape[axis]
-    if batch.clock.shape[0] != n_dev:
-        raise ValueError(
-            f"leading replica axis {batch.clock.shape[0]} != mesh axis "
-            f"{axis}={n_dev} (one replica shard per device)"
-        )
+    _check_replica_axis(batch.clock.shape[0], mesh, axis)
     arrays = (batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks)
-    specs = tuple(P(axis, *([None] * (a.ndim - 1))) for a in arrays)
+    join = _orswot_join_fn(
+        mesh, axis, m_cap, d_cap, tuple(a.ndim for a in arrays)
+    )
+    (clock, ids, dots, d_ids, d_clocks), overflow = join(arrays)
+    if check:
+        raise_for_overflow(overflow, "collective join")
+    return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
+
+
+@functools.lru_cache(maxsize=64)
+def _orswot_join_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int, ndims: tuple):
+    """Cached jitted ORSWOT collective join (see :func:`_clock_join_fn`)."""
+    specs = tuple(P(axis, *([None] * (nd - 1))) for nd in ndims)
     over_spec = P(axis, None)
 
+    @jax.jit
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -232,10 +254,7 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
         )
         return tuple(x[None] for x in acc), jnp.any(overflow, axis=0)[None]
 
-    (clock, ids, dots, d_ids, d_clocks), overflow = jax.jit(_join)(arrays)
-    if check:
-        raise_for_overflow(overflow, "collective join")
-    return OrswotBatch(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
+    return _join
 
 
 def _fold_map_stack(stack_state, kernel):
@@ -302,12 +321,7 @@ def allgather_join_map(batch, mesh: Mesh, axis: str = "replicas", check: bool = 
     from ..batch.map_batch import MapBatch
 
     kernel = batch.kernel
-    n_dev = mesh.shape[axis]
-    if batch.clock.shape[0] != n_dev:
-        raise ValueError(
-            f"leading replica axis {batch.clock.shape[0]} != mesh axis "
-            f"{axis}={n_dev} (one replica shard per device)"
-        )
+    _check_replica_axis(batch.clock.shape[0], mesh, axis)
     state = batch.state
     specs = jax.tree_util.tree_map(
         lambda x: P(axis, *([None] * (x.ndim - 1))), state
@@ -320,6 +334,165 @@ def allgather_join_map(batch, mesh: Mesh, axis: str = "replicas", check: bool = 
             "Map collective join overflow: raise key/deferred/value capacities"
         )
     return MapBatch.from_state(joined, kernel)
+
+
+# -- LWWReg / MVReg / GSet collective joins ----------------------------------
+
+
+def _fold_lww_stack(vals, markers):
+    """Canonical left fold of a replica-stacked LWW state ``(vals[R, N],
+    markers[R, N])`` with the pairwise rule (`lwwreg.rs:43-67`), ORing the
+    equal-marker/different-value conflict bitmap across every step.
+
+    The fold — not a one-shot argmax over the stack — is deliberate: the
+    scalar N-way join errors on *any* pairwise equal-marker conflict it
+    encounters en route (e.g. markers ``[5, 5, 9]`` with different values
+    conflicts at step 1 even though the global max is unique), so bit- and
+    error-parity require replaying the same prefix-max walk."""
+    from ..ops import lww_ops
+
+    r = vals.shape[0]
+    acc_v, acc_m = vals[0], markers[0]
+    conflict = jnp.zeros(vals.shape[1:], dtype=bool)
+    for i in range(1, r):
+        acc_v, acc_m, c = lww_ops.merge(acc_v, acc_m, vals[i], markers[i])
+        conflict |= c
+    return acc_v, acc_m, conflict
+
+
+@functools.lru_cache(maxsize=64)
+def _lww_join_fn(mesh: Mesh, axis: str, ndim: int):
+    """Cached jitted LWW collective join (jax.jit caches by function
+    identity — a per-call closure would retrace+recompile every call)."""
+    spec = P(axis, *([None] * (ndim - 1)))
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=(spec, spec, spec),
+        check_vma=False,
+    )
+    def _join(vals, markers):
+        vg = jax.lax.all_gather(vals[0], axis)  # [D, N]
+        mg = jax.lax.all_gather(markers[0], axis)
+        v, m, conflict = _fold_lww_stack(vg, mg)
+        return v[None], m[None], conflict[None]
+
+    return _join
+
+
+def allgather_join_lww(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
+    """All-reduce LWW register state across a mesh axis: all-gather the
+    ``(vals, markers)`` columns over ``axis`` and left-fold in canonical
+    device order 0..D-1 with the marker-max select (`lwwreg.rs:43-67`) —
+    BASELINE config 5's 10M-register fleet joined in one collective.
+
+    ``batch``: an :class:`~crdt_tpu.batch.lwwreg_batch.LWWRegBatch` whose
+    leading axis is the replica axis, one replica shard per device.
+    Returns ``(joined, conflict_bitmap)``; when ``check``, raises
+    :class:`~crdt_tpu.error.ConflictingMarker` if any element hit an
+    equal-marker/different-value pair mid-fold (batched kernels cannot
+    raise per-element — SURVEY.md §7.3 — so the bitmap surfaces
+    host-side).  The joined rows are identical on every device."""
+    from ..batch.lwwreg_batch import LWWRegBatch
+    from ..error import ConflictingMarker
+
+    _check_replica_axis(batch.vals.shape[0], mesh, axis)
+    join = _lww_join_fn(mesh, axis, batch.vals.ndim)
+    vals, markers, conflict = join(batch.vals, batch.markers)
+    if check and bool(jnp.any(conflict)):
+        idx = jnp.nonzero(conflict[0])[0]
+        raise ConflictingMarker(
+            f"{idx.shape[0]} conflicting marker(s) in collective join, "
+            f"first at {int(idx[0])}"
+        )
+    return LWWRegBatch(vals=vals, markers=markers), conflict
+
+
+def _fold_mvreg_stack(clocks, vals, k_cap: int):
+    """Canonical left fold of a replica-stacked MVReg antichain
+    ``(clocks[R, N, K, A], vals[R, N, K])``: pairwise keep-undominated
+    merge + re-pack each step (`mvreg.rs:121-153`), ORing antichain
+    overflow across steps."""
+    from ..ops import mvreg_ops
+
+    r = clocks.shape[0]
+    acc_c, acc_v = clocks[0], vals[0]
+    overflow = jnp.zeros(clocks.shape[1:2], dtype=bool)
+    for i in range(1, r):
+        c2, v2, keep = mvreg_ops.merge(acc_c, acc_v, clocks[i], vals[i])
+        acc_c, acc_v, over = mvreg_ops.compact(c2, v2, keep, k_cap)
+        overflow |= over
+    return acc_c, acc_v, overflow
+
+
+@functools.lru_cache(maxsize=64)
+def _mvreg_join_fn(mesh: Mesh, axis: str, k_cap: int, c_ndim: int, v_ndim: int):
+    """Cached jitted MVReg collective join (see :func:`_lww_join_fn`)."""
+    c_spec = P(axis, *([None] * (c_ndim - 1)))
+    v_spec = P(axis, *([None] * (v_ndim - 1)))
+    o_spec = P(axis, None)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(c_spec, v_spec),
+        out_specs=(c_spec, v_spec, o_spec),
+        check_vma=False,
+    )
+    def _join(clocks, vals):
+        cg = jax.lax.all_gather(clocks[0], axis)  # [D, N, K, A]
+        vg = jax.lax.all_gather(vals[0], axis)
+        c, v, overflow = _fold_mvreg_stack(cg, vg, k_cap)
+        return c[None], v[None], overflow[None]
+
+    return _join
+
+
+def allgather_join_mvreg(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
+    """All-reduce MVReg antichain state across a mesh axis: all-gather the
+    ``(clocks, vals)`` planes over ``axis`` and left-fold in canonical
+    device order 0..D-1 with the keep-mutually-undominated merge
+    (`mvreg.rs:121-153`), re-packing to K slots per step.
+
+    ``batch``: an :class:`~crdt_tpu.batch.mvreg_batch.MVRegBatch` whose
+    leading axis is the replica axis, one replica shard per device.
+    Raises on antichain overflow past ``mv_capacity`` when ``check``.
+    The joined rows are identical on every device; set-equality (not slot
+    order) is the reference's own equality (`mvreg.rs:74-96`), but the
+    canonical fold keeps even slot order bit-equal to the scalar N-way
+    left fold."""
+    from ..batch.mvreg_batch import MVRegBatch
+
+    k_cap = batch.clocks.shape[-2]
+    _check_replica_axis(batch.clocks.shape[0], mesh, axis)
+    join = _mvreg_join_fn(mesh, axis, k_cap, batch.clocks.ndim, batch.vals.ndim)
+    clocks, vals, overflow = join(batch.clocks, batch.vals)
+    if check and bool(jnp.any(overflow)):
+        raise ValueError(
+            "MVReg collective-join antichain overflow: raise CrdtConfig.mv_capacity"
+        )
+    return MVRegBatch(clocks=clocks, vals=vals)
+
+
+def allgather_join_gset(batch, mesh: Mesh, axis: str = "replicas"):
+    """Global GSet join across a mesh axis.  Union is commutative and
+    idempotent with no order sensitivity (`gset.rs:30-34`), so unlike the
+    ORSWOT/LWW/MVReg folds this is a direct all-reduce: one ``pmax`` over
+    the membership bitmap (bool max ≡ OR) riding ICI.
+
+    ``batch``: a :class:`~crdt_tpu.batch.gset_batch.GSetBatch` whose
+    leading axis is the replica axis, one replica shard per device.
+    Every replica row of the output holds the global union."""
+    from ..batch.gset_batch import GSetBatch
+
+    # bool max ≡ OR, so the bitmap union IS the clock join over u8
+    # (collectives don't take bool); one shard_map body to maintain
+    joined = all_reduce_clock_join(batch.bits.astype(jnp.uint8), mesh, axis)
+    return GSetBatch(bits=joined.astype(bool))
 
 
 # -- anti-entropy to fixpoint ------------------------------------------------

@@ -56,12 +56,15 @@ class OrswotBatch:
 
     @classmethod
     def from_scalar(cls, states: Sequence[Orswot], universe: Universe) -> "OrswotBatch":
-        """Bulk ingest: one Python pass collects flat COO coordinates
-        (object, slot, actor, counter) into append-only lists, then four
-        vectorized numpy scatters build the dense tables.  Scales to
-        millions of objects (the per-element numpy scalar stores of the
-        naive construction dominate end-to-end time at north-star sizes
-        — see ``bench.py`` ``ingest`` line)."""
+        """Bulk ingest: one Python pass per object collects the flat COO
+        value columns with C-level ``list.extend(map(...))`` loops — never
+        a per-dot Python append — plus per-object/per-entry *counts*; the
+        (object, slot) coordinate columns are then synthesized in bulk
+        with ``np.repeat``/``np.arange`` and four vectorized scatters
+        build the dense tables.  The per-dot Python bytecode of the
+        append-based walk is what bounded ingest at ~30k obj/s at 1M
+        scale (``bench.py`` ``ingest`` line); this path keeps the
+        unavoidable O(total dots) work in C."""
         import numpy as np
 
         cfg = universe.config
@@ -71,58 +74,80 @@ class OrswotBatch:
         aidx = universe.actors.intern
         midx = universe.members.intern
 
-        co, ca, cc = [], [], []  # set clock (obj, actor, counter)
-        eo, es, em = [], [], []  # entries (obj, slot, member-id)
-        go, gs, ga, gc = [], [], [], []  # entry dots (obj, slot, actor, counter)
-        qo, qs, qm = [], [], []  # deferred ids
-        ho, hs, ha, hc = [], [], [], []  # deferred clocks
+        ca, cc = [], []  # set-clock columns (actor, counter)
+        c_counts = np.empty(n, dtype=np.int64)  # clock dots per object
+        em = []  # entry member ids, object-major / insertion order
+        e_counts = np.empty(n, dtype=np.int64)  # entries per object
+        ga, gc = [], []  # entry-dot columns (actor, counter)
+        g_counts = []  # dots per entry, aligned with em
+        qm = []  # deferred member ids
+        q_counts = np.empty(n, dtype=np.int64)  # deferred rows per object
+        ha, hc = [], []  # deferred-clock columns
+        h_counts = []  # clock dots per deferred row, aligned with qm
 
         for i, s in enumerate(states):
-            for actor, counter in s.clock.dots.items():
-                co.append(i)
-                ca.append(aidx(actor))
-                cc.append(counter)
-            if len(s.entries) > m:
+            cd = s.clock.dots
+            c_counts[i] = len(cd)
+            ca.extend(map(aidx, cd))
+            cc.extend(cd.values())
+
+            ents = s.entries
+            if len(ents) > m:
                 raise ValueError(
-                    f"object {i}: {len(s.entries)} members > member_capacity {m}"
+                    f"object {i}: {len(ents)} members > member_capacity {m}"
                 )
-            for j, (member, vc) in enumerate(s.entries.items()):
-                eo.append(i)
-                es.append(j)
-                em.append(midx(member))
-                for actor, counter in vc.dots.items():
-                    go.append(i)
-                    gs.append(j)
-                    ga.append(aidx(actor))
-                    gc.append(counter)
-            rows = [
-                (ck, member) for ck, members in s.deferred.items() for member in members
-            ]
-            if len(rows) > d:
+            e_counts[i] = len(ents)
+            em.extend(map(midx, ents))
+            for vc in ents.values():
+                vd = vc.dots
+                g_counts.append(len(vd))
+                ga.extend(map(aidx, vd))
+                gc.extend(vd.values())
+
+            nrows = sum(len(members) for members in s.deferred.values())
+            if nrows > d:
                 raise ValueError(
-                    f"object {i}: {len(rows)} deferred rows > deferred_capacity {d}"
+                    f"object {i}: {nrows} deferred rows > deferred_capacity {d}"
                 )
-            for j, (ck, member) in enumerate(rows):
-                qo.append(i)
-                qs.append(j)
-                qm.append(midx(member))
-                for actor, counter in ck:
-                    ho.append(i)
-                    hs.append(j)
-                    ha.append(aidx(actor))
-                    hc.append(counter)
+            q_counts[i] = nrows
+            for ck, members in s.deferred.items():
+                # one interned column pair per witnessing clock, shared by
+                # every member row buffered under it
+                pa = [aidx(actor) for actor, _ in ck]
+                pc = [counter for _, counter in ck]
+                for member in members:
+                    qm.append(midx(member))
+                    h_counts.append(len(pa))
+                    ha.extend(pa)
+                    hc.extend(pc)
+
+        def _obj_slot(counts):
+            """(object, within-object slot) coordinate columns for rows
+            laid out object-major with ``counts`` rows per object."""
+            obj = np.repeat(np.arange(counts.shape[0]), counts)
+            starts = np.repeat(np.cumsum(counts) - counts, counts)
+            return obj, np.arange(obj.shape[0]) - starts
 
         clock, ids, dots, d_ids, d_clocks = _np_planes(n, cfg)
-        if co:
-            clock[np.asarray(co), np.asarray(ca)] = np.asarray(cc, dtype=dt)
-        if eo:
-            ids[np.asarray(eo), np.asarray(es)] = np.asarray(em, dtype=np.int32)
-        if go:
-            dots[np.asarray(go), np.asarray(gs), np.asarray(ga)] = np.asarray(gc, dtype=dt)
-        if qo:
-            d_ids[np.asarray(qo), np.asarray(qs)] = np.asarray(qm, dtype=np.int32)
-        if ho:
-            d_clocks[np.asarray(ho), np.asarray(hs), np.asarray(ha)] = np.asarray(hc, dtype=dt)
+        if ca:
+            co = np.repeat(np.arange(n), c_counts)
+            clock[co, np.asarray(ca)] = np.asarray(cc, dtype=dt)
+        if em:
+            eo, es = _obj_slot(e_counts)
+            ids[eo, es] = np.asarray(em, dtype=np.int32)
+            if ga:
+                g_counts_arr = np.asarray(g_counts)
+                go = np.repeat(eo, g_counts_arr)
+                gs = np.repeat(es, g_counts_arr)
+                dots[go, gs, np.asarray(ga)] = np.asarray(gc, dtype=dt)
+        if qm:
+            qo, qs = _obj_slot(q_counts)
+            d_ids[qo, qs] = np.asarray(qm, dtype=np.int32)
+            if ha:
+                h_counts_arr = np.asarray(h_counts)
+                ho = np.repeat(qo, h_counts_arr)
+                hs = np.repeat(qs, h_counts_arr)
+                d_clocks[ho, hs, np.asarray(ha)] = np.asarray(hc, dtype=dt)
 
         return cls(
             clock=jnp.asarray(clock),
@@ -140,7 +165,8 @@ class OrswotBatch:
         """Columnar bulk ingest — build ``n`` dense states straight from
         COO coordinate arrays, without materializing any scalar objects
         (the per-object Python walk is what bounds :meth:`from_scalar` at
-        ~150k obj/s; this path is pure numpy scatters).
+        ~130k obj/s — ``reports/INGEST_PROFILE.md``; this path is pure
+        numpy scatters).
 
         * ``clock_coords`` — ``(obj, actor_idx, counter)`` arrays for the
           set clocks.

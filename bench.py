@@ -257,6 +257,7 @@ def bench_north_star():
         _north_star_parity(templates[0], r, a, m, d, fold_join)
 
     n_chunks = max(2, n // chunk)
+    elision = {"elision_check": "skipped"}  # per-step-dispatch paths can't hoist
 
     if os.environ.get("CRDT_LANES") == "1" and os.environ.get("CRDT_PALLAS") == "1":
         # the lanes templates above are transposed; the Pallas fold wants
@@ -332,17 +333,57 @@ def bench_north_star():
             t0 = time.perf_counter()
             out = run_chunks(t0_, t1_)
             np.asarray(out[0].ravel()[0])  # scalar fetch forces completion
-            return max(time.perf_counter() - t0 - sync_s, 1e-9)
+            return max(time.perf_counter() - t0 - sync_s, 1e-9), out
 
-        t = None
+        t = scan_out = None
         for attempt in range(2):
             try:
-                t = run_scan_timed()
+                t, scan_out = run_scan_timed()
                 break
             except Exception as e:  # transient remote-compile outage
                 log(f"north★ scan attempt {attempt + 1} failed: {str(e)[:200]}")
                 if attempt == 0:
                     time.sleep(20)
+        if t is not None and os.environ.get("CRDT_SKIP_ELISION_CHECK") != "1":
+            # Work-elision check (VERDICT r2 weak #4): replay the exact
+            # salt chain as per-step host dispatches — a separately
+            # compiled program XLA cannot hoist across — and demand
+            # bit-equality with the scan's final output.  If the scan's
+            # while-loop had been invariant-hoisted or partially DCE'd
+            # into computing fewer folds, the replay would diverge (salts
+            # are data-dependent on every fold output) and its wall time
+            # would dwarf the scan's.  A transient tunnel/compile outage
+            # here must not crash a bench whose timing already landed —
+            # only an actual mismatch is fatal.
+            try:
+                sf = jax.jit(salted_fold)
+                ns_j = jax.jit(next_salt)
+                t0r = time.perf_counter()
+                salt = jnp.uint32(1)
+                out_r = None
+                for _ in range(n_chunks // 2):
+                    o0 = sf(t0_, salt)
+                    o1 = sf(t1_, ns_j(o0))
+                    salt = ns_j(o1)
+                    out_r = o1
+                jax.block_until_ready(out_r)
+                t_replay = time.perf_counter() - t0r
+                same = all(
+                    bool(jnp.array_equal(x, y)) for x, y in zip(scan_out, out_r)
+                )
+            except Exception as e:
+                log(f"north★ elision check errored (transient?): {str(e)[:200]}")
+                elision = {"elision_check": "error"}
+            else:
+                assert same, (
+                    "north★ elision check FAILED: scan output != per-step replay"
+                )
+                log(
+                    f"north★ elision check: scan == per-step replay (bit-equal); "
+                    f"scan {t:.2f}s vs replay {t_replay:.2f}s"
+                )
+                elision = {"elision_check": "bit_equal",
+                           "replay_s": round(t_replay, 2)}
         if t is None:
             # last resort: per-chunk host loop (pays the tunnel sync per
             # chunk — slower but never a crashed bench)
@@ -364,7 +405,93 @@ def bench_north_star():
         f"{t:.2f}s  {rate/1e6:.2f}M merges/s  "
         f"(device working set {state_bytes/1e9:.2f} GB/chunk-fold)"
     )
-    return rate
+    return rate, elision
+
+
+def bench_north_star_resident():
+    """The north star as a REAL resident fleet (VERDICT r2 weak #4): 10M
+    DISTINCT replica-objects — no template recycling — generated as
+    compact columns on the host (~200x smaller than dense state), shipped
+    to the device, expanded to dense planes THERE (`build_fleet_planes`
+    under jit — the ingest is genuinely paid and timed), folded chunk by
+    chunk, every converged chunk kept device-resident, one digest fetch
+    forcing full completion.  Reports end-to-end seconds including
+    generation + ingest + fold.
+
+    Parity is asserted on the warmup chunk before anything is timed."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops import orswot_ops
+    from crdt_tpu.utils.testdata import build_fleet_planes, fleet_columns
+
+    if SMALL:
+        chunk, n_chunks, a, m, d, r, base, novel = 1_000, 4, 16, 8, 2, 4, 4, 1
+    else:
+        chunk, n_chunks, a, m, d, r, base, novel = 62_500, 20, 64, 16, 2, 8, 6, 1
+    deferred_frac = 0.25
+
+    build = jax.jit(
+        functools.partial(
+            build_fleet_planes, a=a, m_cap=m, d=d, base=base, novel=novel
+        )
+    )
+
+    @jax.jit
+    def fold_digest(planes):
+        acc = tuple(x[0] for x in planes)
+        for i in range(1, r):
+            acc = orswot_ops.merge(*acc, *(x[i] for x in planes), m, d)[:5]
+        acc = orswot_ops.merge(*acc, *acc, m, d)[:5]  # defer plunger
+        # cheap full-state digest: forces the whole fold without fetching
+        # the converged planes off-device
+        digest = jnp.max(acc[0]).astype(jnp.uint32) ^ (
+            jnp.sum(acc[2].astype(jnp.uint32)) & jnp.uint32(0xFFFF)
+        )
+        return acc, digest
+
+    def chunk_cols(c):
+        # one independent stream per chunk: every object in the 10M fleet
+        # is distinct data, generated reproducibly
+        return fleet_columns(
+            np.random.RandomState(1000 + c), chunk, a, m, d, r,
+            base=base, novel=novel, deferred_frac=deferred_frac,
+        )
+
+    # warmup compiles build+fold AND runs the parity sample (untimed)
+    warm_planes = build(chunk_cols(0))
+    warm_out, warm_digest = fold_digest(warm_planes)
+    jax.block_until_ready(warm_digest)
+    sample_template = tuple(np.asarray(x[:, :8]) for x in warm_planes)
+    _north_star_parity(
+        tuple(jnp.asarray(x) for x in sample_template), r, a, m, d,
+        lambda stack: fold_digest(tuple(x for x in stack))[0],
+    )
+
+    resident = []
+    t0 = time.perf_counter()
+    digest = jnp.uint32(0)
+    for c in range(n_chunks):
+        planes = build(jax.device_put(chunk_cols(c)))
+        out, dg = fold_digest(planes)
+        resident.append(out)  # converged chunk stays on device
+        digest = digest ^ dg
+    final = int(np.asarray(digest))  # one fetch forces every chunk
+    e2e = time.perf_counter() - t0
+    merges = n_chunks * chunk * r
+    log(
+        f"north★ resident fleet: {n_chunks * chunk} distinct objects × {r} "
+        f"replicas = {merges} replica-objects, A={a} M={m} "
+        f"deferred_frac={deferred_frac}: e2e {e2e:.2f}s incl. column ingest "
+        f"({merges / e2e / 1e6:.2f}M merges/s end-to-end; digest {final:#x})"
+    )
+    return {
+        "distinct_replica_objects": merges,
+        "e2e_s": round(e2e, 2),
+        "resident_merges_per_sec": round(merges / e2e, 1),
+    }
 
 
 def _north_star_parity(template, r, a, m, d, fold_join):
@@ -661,7 +788,8 @@ def main():
     # north star BEFORE the Pallas validation attempt: a Mosaic compile
     # crash can take the tunnel's remote-compile helper down with it,
     # which must not be able to cost us the headline metric
-    rate = bench_north_star()
+    rate, elision = bench_north_star()
+    resident = bench_north_star_resident()
     bench_tpu_validation()
 
     print(
@@ -673,6 +801,10 @@ def main():
                 "vs_baseline": round(rate / 1e7, 4),
                 "platform": jax.default_backend(),
                 "backend_fallback": fallback,
+                "distinct_objects": resident["distinct_replica_objects"],
+                "e2e_s": resident["e2e_s"],
+                "resident_merges_per_sec": resident["resident_merges_per_sec"],
+                **elision,
             }
         )
     )

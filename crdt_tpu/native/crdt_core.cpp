@@ -608,6 +608,543 @@ bool orswot_value_merge(const C* vca, const int32_t* vida, const C* vdota,
   return over;
 }
 
+
+// ---- generic reset-remove Map merge skeleton (map.rs:192-269) --------------
+//
+// One ROW-level skeleton drives key alignment, the entry-clock dot dance,
+// the deferred-key table, clock join, deferred settle, and key compaction;
+// a value-row policy VRow supplies the nested value semantics.  Operating
+// on row pointers keeps the skeleton nestable: the Map<K, Map<K2, MVReg>>
+// policy recurses back into this function for its value merges.
+//
+// VRow contract (all byte-parity with the jnp value-kernel flow; `del`/`rm`
+// are actor-length clocks; slot indices index this row's side tables):
+//   bool merge_both(int64_t ia, int64_t ib, const C* del);
+//       nested merge of a-slot ia with b-slot ib, then truncate by del,
+//       into the staging buffer; returns nested overflow
+//   bool copy_truncate(int side, int64_t idx, const C* del);
+//       stage side's slot idx truncated by del
+//   void push();                  // append staging buffer to the row acc
+//   bool settle(size_t e, const C* rm, bool matched);
+//       deferred-replay truncate of acc entry e (matched = some deferred
+//       row named this key; policies whose zero-truncate is a byte no-op
+//       skip unmatched entries, the Orswot policy must not — see
+//       orswot_value_truncate's plunger note)
+//   void kill(size_t e);          // acc entry e -> zeros_like
+//   void init_out();              // fill this row's output with zeros_like
+//   void write_out(int64_t w, size_t e);  // acc entry e -> output slot w
+template <typename C, typename VRow>
+bool map_row_merge(const C* sc, const int32_t* keys_a, const C* ec_a,
+                   const int32_t* dk_a, const C* dc_a,
+                   const C* oc, const int32_t* keys_b, const C* ec_b,
+                   const int32_t* dk_b, const C* dc_b,
+                   int64_t a, int64_t k_a, int64_t k_b, int64_t d_a,
+                   int64_t d_b, int64_t k_cap, int64_t d_cap,
+                   C* out_clock, int32_t* keys_o, C* ec_o,
+                   int32_t* dk_o, C* dc_o, VRow& v) {
+  bool over = false;
+
+  // key alignment in ascending id order (map.rs:196-197 BTreeMap walk;
+  // the jnp align_keyed's stable sort gives the same order)
+  struct Slot { int32_t id; int8_t side; int64_t idx; };
+  std::vector<Slot> slots;
+  slots.reserve(k_a + k_b);
+  for (int64_t j = 0; j < k_a; ++j)
+    if (keys_a[j] != kEmpty) slots.push_back({keys_a[j], 0, j});
+  for (int64_t j = 0; j < k_b; ++j)
+    if (keys_b[j] != kEmpty) slots.push_back({keys_b[j], 1, j});
+  std::stable_sort(slots.begin(), slots.end(),
+                   [](const Slot& x, const Slot& y) { return x.id < y.id; });
+
+  std::vector<int32_t> out_keys;
+  std::vector<C> out_e;
+  std::vector<C> e_merged(a), deleters(a);
+  for (size_t s = 0; s < slots.size();) {
+    int32_t id = slots[s].id;
+    int64_t ia = -1, ib = -1;
+    while (s < slots.size() && slots[s].id == id) {
+      (slots[s].side == 0 ? ia : ib) = slots[s].idx;
+      ++s;
+    }
+    const C* e1 = ia >= 0 ? ec_a + ia * a : nullptr;
+    const C* e2 = ib >= 0 ? ec_b + ib * a : nullptr;
+    if (e1 && e2) {
+      // both present (map.rs:213-240): dot dance + nested value merge;
+      // deleters = (c1 v c2) - merged clock, empty in practice
+      dot_rule_both(e1, e2, sc, oc, e_merged.data(), a);
+      for (int64_t i = 0; i < a; ++i) {
+        C common = (e1[i] == e2[i]) ? e1[i] : 0;
+        C c1 = (e1[i] > common) ? e1[i] : 0;
+        c1 = (c1 > oc[i]) ? c1 : 0;
+        C c2 = (e2[i] > common) ? e2[i] : 0;
+        c2 = (c2 > sc[i]) ? c2 : 0;
+        C mx = std::max(c1, c2);
+        deleters[i] = (mx > e_merged[i]) ? mx : 0;
+      }
+      if (clock_is_empty(e_merged.data(), a)) continue;
+      over |= v.merge_both(ia, ib, deleters.data());
+    } else {
+      // one-sided (map.rs:198-211 / :244-253): keep the SUBTRACTED entry
+      // clock (unlike Orswot's full-clock asymmetry), truncate the value
+      // by what the other side witnessed beyond it (reset-remove)
+      const C* e = e1 ? e1 : e2;
+      const C* other_clock = e1 ? oc : sc;
+      for (int64_t i = 0; i < a; ++i)
+        e_merged[i] = (e[i] > other_clock[i]) ? e[i] : 0;
+      if (clock_is_empty(e_merged.data(), a)) continue;
+      for (int64_t i = 0; i < a; ++i)
+        deleters[i] = (other_clock[i] > e_merged[i]) ? other_clock[i] : 0;
+      over |= v.copy_truncate(e1 ? 0 : 1, e1 ? ia : ib, deleters.data());
+    }
+    out_keys.push_back(id);
+    out_e.insert(out_e.end(), e_merged.begin(), e_merged.end());
+    v.push();
+  }
+
+  // deferred: keep all of self's rows; adopt other's only when NOT
+  // already covered by self's clock (map.rs:256-260 - covered rows are
+  // replayed against pre-merge entries which `keep` then discards);
+  // dedup exact (key, clock) pairs keeping the first
+  std::vector<int32_t> dq;
+  std::vector<C> dqc;
+  auto push_deferred = [&](const int32_t* dks, const C* dcs, int64_t d,
+                           bool adopt_filter) {
+    for (int64_t q = 0; q < d; ++q) {
+      int32_t id = dks[q];
+      if (id == kEmpty) continue;
+      const C* ck = dcs + q * a;
+      if (adopt_filter && clock_leq(ck, sc, a)) continue;
+      bool dup = false;
+      for (size_t p = 0; !dup && p < dq.size(); ++p)
+        dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
+      if (!dup) {
+        dq.push_back(id);
+        dqc.insert(dqc.end(), ck, ck + a);
+      }
+    }
+  };
+  push_deferred(dk_a, dc_a, d_a, false);
+  push_deferred(dk_b, dc_b, d_b, true);
+
+  // clock join (map.rs:265), then apply_deferred (map.rs:267): subtract
+  // the join of matching rows from each entry clock, truncate the value
+  // the same way, drop emptied keys; rows the joined clock now covers
+  // are dropped from the buffer
+  for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
+  std::vector<C> rm(a);
+  for (size_t e = 0; e < out_keys.size(); ++e) {
+    std::fill(rm.begin(), rm.end(), 0);
+    bool matched = false;
+    for (size_t q = 0; q < dq.size(); ++q)
+      if (dq[q] != kEmpty && dq[q] == out_keys[e]) {
+        clock_max_into(rm.data(), dqc.data() + q * a, a);
+        matched = true;
+      }
+    C* er = out_e.data() + e * a;
+    if (matched)
+      for (int64_t i = 0; i < a; ++i) er[i] = (er[i] > rm[i]) ? er[i] : 0;
+    over |= v.settle(e, rm.data(), matched);
+    if (clock_is_empty(er, a)) {
+      out_keys[e] = kEmpty;
+      std::memset(er, 0, sizeof(C) * a);
+      v.kill(e);
+    }
+  }
+  for (size_t q = 0; q < dq.size(); ++q)
+    if (dq[q] != kEmpty && clock_leq(dqc.data() + q * a, out_clock, a)) {
+      dq[q] = kEmpty;
+      std::memset(dqc.data() + q * a, 0, sizeof(C) * a);
+    }
+
+  // compact into output capacities, live-first (ascending-key) order
+  std::fill(keys_o, keys_o + k_cap, kEmpty);
+  std::memset(ec_o, 0, sizeof(C) * k_cap * a);
+  v.init_out();
+  int64_t w = 0, live = 0;
+  for (size_t e = 0; e < out_keys.size(); ++e) {
+    if (out_keys[e] == kEmpty) continue;
+    ++live;
+    if (w < k_cap) {
+      keys_o[w] = out_keys[e];
+      std::memcpy(ec_o + w * a, out_e.data() + e * a, sizeof(C) * a);
+      v.write_out(w, e);
+      ++w;
+    }
+  }
+  std::fill(dk_o, dk_o + d_cap, kEmpty);
+  std::memset(dc_o, 0, sizeof(C) * d_cap * a);
+  int64_t wq = 0, live_q = 0;
+  for (size_t q = 0; q < dq.size(); ++q) {
+    if (dq[q] == kEmpty) continue;
+    ++live_q;
+    if (wq < d_cap) {
+      dk_o[wq] = dq[q];
+      std::memcpy(dc_o + wq * a, dqc.data() + q * a, sizeof(C) * a);
+      ++wq;
+    }
+  }
+  return over || live > k_cap || live_q > d_cap;
+}
+
+// ---- value-row policies ----------------------------------------------------
+
+// MVReg values: zero-clock truncate is a byte no-op, so settle skips
+// unmatched entries (mvreg_value_truncate is a plain subtract + zero)
+template <typename C>
+struct MvregValRow {
+  const C *mvc_a, *mvv_a, *mvc_b, *mvv_b;  // row bases [k, v_cap, ...]
+  C *mvc_o, *mvv_o;                        // output row base [k_cap, ...]
+  int64_t v_cap, a, k_cap;
+  std::vector<C> mc_buf, mv_buf, out_mc, out_mv;
+
+  MvregValRow(const C* mvca, const C* mvva, const C* mvcb, const C* mvvb,
+              C* mvco, C* mvvo, int64_t v_cap_, int64_t a_, int64_t k_cap_)
+      : mvc_a(mvca), mvv_a(mvva), mvc_b(mvcb), mvv_b(mvvb), mvc_o(mvco),
+        mvv_o(mvvo), v_cap(v_cap_), a(a_), k_cap(k_cap_),
+        mc_buf(v_cap_ * a_), mv_buf(v_cap_) {}
+
+  bool merge_both(int64_t ia, int64_t ib, const C* del) {
+    return mvreg_value_merge(mvc_a + ia * v_cap * a, mvv_a + ia * v_cap,
+                             mvc_b + ib * v_cap * a, mvv_b + ib * v_cap, del,
+                             mc_buf.data(), mv_buf.data(), v_cap, a);
+  }
+  bool copy_truncate(int side, int64_t idx, const C* del) {
+    const C* smc = side == 0 ? mvc_a + idx * v_cap * a : mvc_b + idx * v_cap * a;
+    const C* smv = side == 0 ? mvv_a + idx * v_cap : mvv_b + idx * v_cap;
+    std::memcpy(mc_buf.data(), smc, sizeof(C) * v_cap * a);
+    std::memcpy(mv_buf.data(), smv, sizeof(C) * v_cap);
+    mvreg_value_truncate(mc_buf.data(), mv_buf.data(), del, v_cap, a);
+    return false;
+  }
+  void push() {
+    out_mc.insert(out_mc.end(), mc_buf.begin(), mc_buf.end());
+    out_mv.insert(out_mv.end(), mv_buf.begin(), mv_buf.end());
+  }
+  bool settle(size_t e, const C* rm, bool matched) {
+    if (!matched) return false;
+    mvreg_value_truncate(out_mc.data() + e * v_cap * a,
+                         out_mv.data() + e * v_cap, rm, v_cap, a);
+    return false;
+  }
+  void kill(size_t e) {
+    std::memset(out_mc.data() + e * v_cap * a, 0, sizeof(C) * v_cap * a);
+    std::memset(out_mv.data() + e * v_cap, 0, sizeof(C) * v_cap);
+  }
+  void init_out() {
+    std::memset(mvc_o, 0, sizeof(C) * k_cap * v_cap * a);
+    std::memset(mvv_o, 0, sizeof(C) * k_cap * v_cap);
+  }
+  void write_out(int64_t w, size_t e) {
+    std::memcpy(mvc_o + w * v_cap * a, out_mc.data() + e * v_cap * a,
+                sizeof(C) * v_cap * a);
+    std::memcpy(mvv_o + w * v_cap, out_mv.data() + e * v_cap,
+                sizeof(C) * v_cap);
+  }
+};
+
+// Orswot values: the truncate is a plunger merge even with a zero clock
+// (it re-compacts slots and settles nested deferred rows), so settle runs
+// for EVERY surviving key — see orswot_value_truncate
+template <typename C>
+struct OrswotValRow {
+  const C *vc_a, *vdot_a, *vdclk_a;
+  const int32_t *vid_a, *vdid_a;
+  const C *vc_b, *vdot_b, *vdclk_b;
+  const int32_t *vid_b, *vdid_b;
+  C *vc_o, *vdot_o, *vdclk_o;
+  int32_t *vid_o, *vdid_o;
+  int64_t m, d2, a, k_cap;
+  std::vector<C> vc_buf, vdot_buf, vdclk_buf, out_vc, out_vdot, out_vdclk;
+  std::vector<int32_t> vid_buf, vdid_buf, out_vid, out_vdid;
+  OrswotValScratch<C> scratch;
+
+  OrswotValRow(const C* vca, const int32_t* vida, const C* vdota,
+               const int32_t* vdida, const C* vdclka, const C* vcb,
+               const int32_t* vidb, const C* vdotb, const int32_t* vdidb,
+               const C* vdclkb, C* vco, int32_t* vido, C* vdoto,
+               int32_t* vdido, C* vdclko, int64_t m_, int64_t d2_, int64_t a_,
+               int64_t k_cap_)
+      : vc_a(vca), vdot_a(vdota), vdclk_a(vdclka), vid_a(vida), vdid_a(vdida),
+        vc_b(vcb), vdot_b(vdotb), vdclk_b(vdclkb), vid_b(vidb), vdid_b(vdidb),
+        vc_o(vco), vdot_o(vdoto), vdclk_o(vdclko), vid_o(vido), vdid_o(vdido),
+        m(m_), d2(d2_), a(a_), k_cap(k_cap_), vc_buf(a_), vdot_buf(m_ * a_),
+        vdclk_buf(d2_ * a_), vid_buf(m_), vdid_buf(d2_), scratch(a_, m_, d2_) {}
+
+  bool merge_both(int64_t ia, int64_t ib, const C* del) {
+    return orswot_value_merge(
+        vc_a + ia * a, vid_a + ia * m, vdot_a + ia * m * a, vdid_a + ia * d2,
+        vdclk_a + ia * d2 * a, vc_b + ib * a, vid_b + ib * m,
+        vdot_b + ib * m * a, vdid_b + ib * d2, vdclk_b + ib * d2 * a, del,
+        vc_buf.data(), vid_buf.data(), vdot_buf.data(), vdid_buf.data(),
+        vdclk_buf.data(), a, m, d2, scratch);
+  }
+  bool copy_truncate(int side, int64_t idx, const C* del) {
+    const C* svc = side == 0 ? vc_a + idx * a : vc_b + idx * a;
+    const int32_t* svid = side == 0 ? vid_a + idx * m : vid_b + idx * m;
+    const C* svdot = side == 0 ? vdot_a + idx * m * a : vdot_b + idx * m * a;
+    const int32_t* svdid = side == 0 ? vdid_a + idx * d2 : vdid_b + idx * d2;
+    const C* svdclk =
+        side == 0 ? vdclk_a + idx * d2 * a : vdclk_b + idx * d2 * a;
+    std::copy(svc, svc + a, vc_buf.begin());
+    std::copy(svid, svid + m, vid_buf.begin());
+    std::copy(svdot, svdot + m * a, vdot_buf.begin());
+    std::copy(svdid, svdid + d2, vdid_buf.begin());
+    std::copy(svdclk, svdclk + d2 * a, vdclk_buf.begin());
+    return orswot_value_truncate(vc_buf.data(), vid_buf.data(),
+                                 vdot_buf.data(), vdid_buf.data(),
+                                 vdclk_buf.data(), del, a, m, d2, scratch);
+  }
+  void push() {
+    out_vc.insert(out_vc.end(), vc_buf.begin(), vc_buf.end());
+    out_vid.insert(out_vid.end(), vid_buf.begin(), vid_buf.end());
+    out_vdot.insert(out_vdot.end(), vdot_buf.begin(), vdot_buf.end());
+    out_vdid.insert(out_vdid.end(), vdid_buf.begin(), vdid_buf.end());
+    out_vdclk.insert(out_vdclk.end(), vdclk_buf.begin(), vdclk_buf.end());
+  }
+  bool settle(size_t e, const C* rm, bool) {
+    return orswot_value_truncate(
+        out_vc.data() + e * a, out_vid.data() + e * m,
+        out_vdot.data() + e * m * a, out_vdid.data() + e * d2,
+        out_vdclk.data() + e * d2 * a, rm, a, m, d2, scratch);
+  }
+  void kill(size_t e) {
+    std::memset(out_vc.data() + e * a, 0, sizeof(C) * a);
+    std::fill(out_vid.begin() + e * m, out_vid.begin() + (e + 1) * m, kEmpty);
+    std::memset(out_vdot.data() + e * m * a, 0, sizeof(C) * m * a);
+    std::fill(out_vdid.begin() + e * d2, out_vdid.begin() + (e + 1) * d2,
+              kEmpty);
+    std::memset(out_vdclk.data() + e * d2 * a, 0, sizeof(C) * d2 * a);
+  }
+  void init_out() {
+    std::memset(vc_o, 0, sizeof(C) * k_cap * a);
+    std::fill(vid_o, vid_o + k_cap * m, kEmpty);
+    std::memset(vdot_o, 0, sizeof(C) * k_cap * m * a);
+    std::fill(vdid_o, vdid_o + k_cap * d2, kEmpty);
+    std::memset(vdclk_o, 0, sizeof(C) * k_cap * d2 * a);
+  }
+  void write_out(int64_t w, size_t e) {
+    std::memcpy(vc_o + w * a, out_vc.data() + e * a, sizeof(C) * a);
+    std::memcpy(vid_o + w * m, out_vid.data() + e * m, sizeof(int32_t) * m);
+    std::memcpy(vdot_o + w * m * a, out_vdot.data() + e * m * a,
+                sizeof(C) * m * a);
+    std::memcpy(vdid_o + w * d2, out_vdid.data() + e * d2,
+                sizeof(int32_t) * d2);
+    std::memcpy(vdclk_o + w * d2 * a, out_vdclk.data() + e * d2 * a,
+                sizeof(C) * d2 * a);
+  }
+};
+
+// ---- Map<K, Map<K2, MVReg>> value ops --------------------------------------
+// An inner-map value state per outer key slot: clock[A], keys[K2],
+// eclocks[K2, A], mv_clocks[K2, V, A], mv_vals[K2, V], d_keys[D3],
+// d_clocks[D3, A].  The nested merge recurses into map_row_merge with an
+// MvregValRow; the nested truncate mirrors crdt_tpu/ops/map_ops.py::truncate
+// (plain subtracts + recursive value truncate + deferred filter), which IS a
+// byte no-op for a zero clock, so settle may skip unmatched entries.
+
+template <typename C>
+struct InnerMapDims {
+  int64_t a, k2, v_cap, d3;
+  int64_t clock_sz() const { return a; }
+  int64_t keys_sz() const { return k2; }
+  int64_t ec_sz() const { return k2 * a; }
+  int64_t mvc_sz() const { return k2 * v_cap * a; }
+  int64_t mvv_sz() const { return k2 * v_cap; }
+  int64_t dk_sz() const { return d3; }
+  int64_t dc_sz() const { return d3 * a; }
+};
+
+// in-place inner-map truncate (map.rs:131-158 / map_ops.truncate)
+template <typename C>
+void map_mvreg_value_truncate(C* clock, int32_t* keys, C* ec, C* mvc, C* mvv,
+                              int32_t* dk, C* dc, const C* del,
+                              const InnerMapDims<C>& dm) {
+  const int64_t a = dm.a;
+  for (int64_t i = 0; i < a; ++i)
+    clock[i] = (clock[i] > del[i]) ? clock[i] : 0;
+  for (int64_t j = 0; j < dm.k2; ++j) {
+    C* er = ec + j * a;
+    for (int64_t i = 0; i < a; ++i) er[i] = (er[i] > del[i]) ? er[i] : 0;
+    bool live = keys[j] != kEmpty && !clock_is_empty(er, a);
+    if (live) {
+      mvreg_value_truncate(mvc + j * dm.v_cap * a, mvv + j * dm.v_cap, del,
+                           dm.v_cap, a);
+    } else {
+      keys[j] = kEmpty;
+      std::memset(er, 0, sizeof(C) * a);
+      std::memset(mvc + j * dm.v_cap * a, 0, sizeof(C) * dm.v_cap * a);
+      std::memset(mvv + j * dm.v_cap, 0, sizeof(C) * dm.v_cap);
+    }
+  }
+  for (int64_t q = 0; q < dm.d3; ++q) {
+    C* qr = dc + q * a;
+    for (int64_t i = 0; i < a; ++i) qr[i] = (qr[i] > del[i]) ? qr[i] : 0;
+    if (dk[q] == kEmpty || clock_is_empty(qr, a)) {
+      dk[q] = kEmpty;
+      std::memset(qr, 0, sizeof(C) * a);
+    }
+  }
+}
+
+template <typename C>
+struct InnerMapValRow {
+  // side/outputs: row bases over the OUTER key axis
+  const C *clk_a, *ec_a, *mvc_a, *mvv_a, *dc_a;
+  const int32_t *keys_a, *dk_a;
+  const C *clk_b, *ec_b, *mvc_b, *mvv_b, *dc_b;
+  const int32_t *keys_b, *dk_b;
+  C *clk_o, *ec_o, *mvc_o, *mvv_o, *dc_o;
+  int32_t *keys_o, *dk_o;
+  InnerMapDims<C> dm;
+  int64_t k_cap;  // OUTER key capacity (output row width)
+
+  // staging buffers for one inner-map value
+  std::vector<C> b_clk, b_ec, b_mvc, b_mvv, b_dc;
+  std::vector<int32_t> b_keys, b_dk;
+  // row accumulator
+  std::vector<C> o_clk, o_ec, o_mvc, o_mvv, o_dc;
+  std::vector<int32_t> o_keys, o_dk;
+  // inner value-row reused across keys (its side pointers are re-aimed per
+  // merge_both; fresh construction per key would malloc per key)
+  MvregValRow<C> inner;
+
+  InnerMapValRow(const C* clka, const int32_t* keysa, const C* eca,
+                 const C* mvca, const C* mvva, const int32_t* dka,
+                 const C* dca, const C* clkb, const int32_t* keysb,
+                 const C* ecb, const C* mvcb, const C* mvvb,
+                 const int32_t* dkb, const C* dcb, C* clko, int32_t* keyso,
+                 C* eco, C* mvco, C* mvvo, int32_t* dko, C* dco,
+                 const InnerMapDims<C>& dm_, int64_t k_cap_)
+      : clk_a(clka), ec_a(eca), mvc_a(mvca), mvv_a(mvva), dc_a(dca),
+        keys_a(keysa), dk_a(dka), clk_b(clkb), ec_b(ecb), mvc_b(mvcb),
+        mvv_b(mvvb), dc_b(dcb), keys_b(keysb), dk_b(dkb), clk_o(clko),
+        ec_o(eco), mvc_o(mvco), mvv_o(mvvo), dc_o(dco), keys_o(keyso),
+        dk_o(dko), dm(dm_), k_cap(k_cap_), b_clk(dm_.clock_sz()),
+        b_ec(dm_.ec_sz()), b_mvc(dm_.mvc_sz()), b_mvv(dm_.mvv_sz()),
+        b_dc(dm_.dc_sz()), b_keys(dm_.keys_sz()), b_dk(dm_.dk_sz()),
+        inner(nullptr, nullptr, nullptr, nullptr, b_mvc.data(), b_mvv.data(),
+              dm_.v_cap, dm_.a, dm_.k2) {}
+
+  bool merge_both(int64_t ia, int64_t ib, const C* del) {
+    // recursive nested merge: the inner Map<K2, MVReg> row merge writes
+    // straight into the staging buffers
+    inner.mvc_a = mvc_a + ia * dm.mvc_sz();
+    inner.mvv_a = mvv_a + ia * dm.mvv_sz();
+    inner.mvc_b = mvc_b + ib * dm.mvc_sz();
+    inner.mvv_b = mvv_b + ib * dm.mvv_sz();
+    inner.out_mc.clear();
+    inner.out_mv.clear();
+    bool over = map_row_merge<C, MvregValRow<C>>(
+        clk_a + ia * dm.a, keys_a + ia * dm.k2, ec_a + ia * dm.ec_sz(),
+        dk_a + ia * dm.d3, dc_a + ia * dm.dc_sz(), clk_b + ib * dm.a,
+        keys_b + ib * dm.k2, ec_b + ib * dm.ec_sz(), dk_b + ib * dm.d3,
+        dc_b + ib * dm.dc_sz(), dm.a, dm.k2, dm.k2, dm.d3, dm.d3, dm.k2,
+        dm.d3, b_clk.data(), b_keys.data(), b_ec.data(), b_dk.data(),
+        b_dc.data(), inner);
+    map_mvreg_value_truncate(b_clk.data(), b_keys.data(), b_ec.data(),
+                             b_mvc.data(), b_mvv.data(), b_dk.data(),
+                             b_dc.data(), del, dm);
+    return over;
+  }
+  bool copy_truncate(int side, int64_t idx, const C* del) {
+    auto pick = [&](auto* a_ptr, auto* b_ptr, int64_t sz, auto& buf) {
+      auto* src = side == 0 ? a_ptr + idx * sz : b_ptr + idx * sz;
+      std::copy(src, src + sz, buf.begin());
+    };
+    pick(clk_a, clk_b, dm.clock_sz(), b_clk);
+    pick(keys_a, keys_b, dm.keys_sz(), b_keys);
+    pick(ec_a, ec_b, dm.ec_sz(), b_ec);
+    pick(mvc_a, mvc_b, dm.mvc_sz(), b_mvc);
+    pick(mvv_a, mvv_b, dm.mvv_sz(), b_mvv);
+    pick(dk_a, dk_b, dm.dk_sz(), b_dk);
+    pick(dc_a, dc_b, dm.dc_sz(), b_dc);
+    map_mvreg_value_truncate(b_clk.data(), b_keys.data(), b_ec.data(),
+                             b_mvc.data(), b_mvv.data(), b_dk.data(),
+                             b_dc.data(), del, dm);
+    return false;
+  }
+  void push() {
+    o_clk.insert(o_clk.end(), b_clk.begin(), b_clk.end());
+    o_keys.insert(o_keys.end(), b_keys.begin(), b_keys.end());
+    o_ec.insert(o_ec.end(), b_ec.begin(), b_ec.end());
+    o_mvc.insert(o_mvc.end(), b_mvc.begin(), b_mvc.end());
+    o_mvv.insert(o_mvv.end(), b_mvv.begin(), b_mvv.end());
+    o_dk.insert(o_dk.end(), b_dk.begin(), b_dk.end());
+    o_dc.insert(o_dc.end(), b_dc.begin(), b_dc.end());
+  }
+  bool settle(size_t e, const C* rm, bool matched) {
+    if (!matched) return false;
+    map_mvreg_value_truncate(
+        o_clk.data() + e * dm.clock_sz(), o_keys.data() + e * dm.keys_sz(),
+        o_ec.data() + e * dm.ec_sz(), o_mvc.data() + e * dm.mvc_sz(),
+        o_mvv.data() + e * dm.mvv_sz(), o_dk.data() + e * dm.dk_sz(),
+        o_dc.data() + e * dm.dc_sz(), rm, dm);
+    return false;
+  }
+  void kill(size_t e) {
+    std::memset(o_clk.data() + e * dm.clock_sz(), 0, sizeof(C) * dm.clock_sz());
+    std::fill(o_keys.begin() + e * dm.keys_sz(),
+              o_keys.begin() + (e + 1) * dm.keys_sz(), kEmpty);
+    std::memset(o_ec.data() + e * dm.ec_sz(), 0, sizeof(C) * dm.ec_sz());
+    std::memset(o_mvc.data() + e * dm.mvc_sz(), 0, sizeof(C) * dm.mvc_sz());
+    std::memset(o_mvv.data() + e * dm.mvv_sz(), 0, sizeof(C) * dm.mvv_sz());
+    std::fill(o_dk.begin() + e * dm.dk_sz(),
+              o_dk.begin() + (e + 1) * dm.dk_sz(), kEmpty);
+    std::memset(o_dc.data() + e * dm.dc_sz(), 0, sizeof(C) * dm.dc_sz());
+  }
+  void init_out() {
+    std::memset(clk_o, 0, sizeof(C) * k_cap * dm.clock_sz());
+    std::fill(keys_o, keys_o + k_cap * dm.keys_sz(), kEmpty);
+    std::memset(ec_o, 0, sizeof(C) * k_cap * dm.ec_sz());
+    std::memset(mvc_o, 0, sizeof(C) * k_cap * dm.mvc_sz());
+    std::memset(mvv_o, 0, sizeof(C) * k_cap * dm.mvv_sz());
+    std::fill(dk_o, dk_o + k_cap * dm.dk_sz(), kEmpty);
+    std::memset(dc_o, 0, sizeof(C) * k_cap * dm.dc_sz());
+  }
+  void write_out(int64_t w, size_t e) {
+    std::memcpy(clk_o + w * dm.clock_sz(), o_clk.data() + e * dm.clock_sz(),
+                sizeof(C) * dm.clock_sz());
+    std::memcpy(keys_o + w * dm.keys_sz(), o_keys.data() + e * dm.keys_sz(),
+                sizeof(int32_t) * dm.keys_sz());
+    std::memcpy(ec_o + w * dm.ec_sz(), o_ec.data() + e * dm.ec_sz(),
+                sizeof(C) * dm.ec_sz());
+    std::memcpy(mvc_o + w * dm.mvc_sz(), o_mvc.data() + e * dm.mvc_sz(),
+                sizeof(C) * dm.mvc_sz());
+    std::memcpy(mvv_o + w * dm.mvv_sz(), o_mvv.data() + e * dm.mvv_sz(),
+                sizeof(C) * dm.mvv_sz());
+    std::memcpy(dk_o + w * dm.dk_sz(), o_dk.data() + e * dm.dk_sz(),
+                sizeof(int32_t) * dm.dk_sz());
+    std::memcpy(dc_o + w * dm.dc_sz(), o_dc.data() + e * dm.dc_sz(),
+                sizeof(C) * dm.dc_sz());
+  }
+};
+
+// ---- batch drivers ---------------------------------------------------------
+
+template <typename C>
+void map_mvreg_merge_impl(
+    const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* mvc_a,
+    const C* mvv_a, const int32_t* dk_a, const C* dc_a, const C* clock_b,
+    const int32_t* keys_b, const C* ec_b, const C* mvc_b, const C* mvv_b,
+    const int32_t* dk_b, const C* dc_b, int64_t n, int64_t a, int64_t k,
+    int64_t v_cap, int64_t d, int64_t k_cap, int64_t d_cap, C* clock_o,
+    int32_t* keys_o, C* ec_o, C* mvc_o, C* mvv_o, int32_t* dk_o, C* dc_o,
+    uint8_t* overflow) {
+#pragma omp parallel for
+  for (int64_t r = 0; r < n; ++r) {
+    MvregValRow<C> v(mvc_a + r * k * v_cap * a, mvv_a + r * k * v_cap,
+                     mvc_b + r * k * v_cap * a, mvv_b + r * k * v_cap,
+                     mvc_o + r * k_cap * v_cap * a, mvv_o + r * k_cap * v_cap,
+                     v_cap, a, k_cap);
+    overflow[r] = map_row_merge<C, MvregValRow<C>>(
+        clock_a + r * a, keys_a + r * k, ec_a + r * k * a, dk_a + r * d,
+        dc_a + r * d * a, clock_b + r * a, keys_b + r * k, ec_b + r * k * a,
+        dk_b + r * d, dc_b + r * d * a, a, k, k, d, d, k_cap, d_cap,
+        clock_o + r * a, keys_o + r * k_cap, ec_o + r * k_cap * a,
+        dk_o + r * d_cap, dc_o + r * d_cap * a, v);
+  }
+}
+
 template <typename C>
 void map_orswot_merge_impl(
     const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* ovc_a,
@@ -621,386 +1158,56 @@ void map_orswot_merge_impl(
     int32_t* odid_o, C* odclk_o, int32_t* dk_o, C* dc_o, uint8_t* overflow) {
 #pragma omp parallel for
   for (int64_t r = 0; r < n; ++r) {
-    const C* sc = clock_a + r * a;
-    const C* oc = clock_b + r * a;
-    bool over = false;
-
-    // key alignment in ascending id order (map.rs:196-197 BTreeMap walk)
-    struct Slot { int32_t id; int8_t side; int64_t idx; };
-    std::vector<Slot> slots;
-    slots.reserve(2 * k);
-    for (int64_t j = 0; j < k; ++j)
-      if (keys_a[r * k + j] != kEmpty) slots.push_back({keys_a[r * k + j], 0, j});
-    for (int64_t j = 0; j < k; ++j)
-      if (keys_b[r * k + j] != kEmpty) slots.push_back({keys_b[r * k + j], 1, j});
-    std::stable_sort(slots.begin(), slots.end(),
-                     [](const Slot& x, const Slot& y) { return x.id < y.id; });
-
-    std::vector<int32_t> out_keys;
-    std::vector<C> out_e, out_vc, out_vdot, out_vdclk;
-    std::vector<int32_t> out_vid, out_vdid;
-    std::vector<C> e_merged(a), deleters(a);
-    std::vector<C> vc_buf(a), vdot_buf(m * a), vdclk_buf(d2 * a);
-    std::vector<int32_t> vid_buf(m), vdid_buf(d2);
-    OrswotValScratch<C> scratch(a, m, d2);
-    auto val_ptr = [&](int64_t side_idx, const C* vc, const int32_t* vid,
-                       const C* vdot, const int32_t* vdid, const C* vdclk) {
-      int64_t s = r * k + side_idx;
-      return std::make_tuple(vc + s * a, vid + s * m, vdot + s * m * a,
-                             vdid + s * d2, vdclk + s * d2 * a);
-    };
-    for (size_t s = 0; s < slots.size();) {
-      int32_t id = slots[s].id;
-      int64_t ia = -1, ib = -1;
-      while (s < slots.size() && slots[s].id == id) {
-        (slots[s].side == 0 ? ia : ib) = slots[s].idx;
-        ++s;
-      }
-      const C* e1 = ia >= 0 ? ec_a + (r * k + ia) * a : nullptr;
-      const C* e2 = ib >= 0 ? ec_b + (r * k + ib) * a : nullptr;
-      if (e1 && e2) {
-        // both present (map.rs:213-240): dot dance + nested value merge;
-        // deleters = (c1 ∨ c2) − merged clock, empty in practice
-        dot_rule_both(e1, e2, sc, oc, e_merged.data(), a);
-        for (int64_t i = 0; i < a; ++i) {
-          C common = (e1[i] == e2[i]) ? e1[i] : 0;
-          C c1 = (e1[i] > common) ? e1[i] : 0;
-          c1 = (c1 > oc[i]) ? c1 : 0;
-          C c2 = (e2[i] > common) ? e2[i] : 0;
-          c2 = (c2 > sc[i]) ? c2 : 0;
-          C mx = std::max(c1, c2);
-          deleters[i] = (mx > e_merged[i]) ? mx : 0;
-        }
-        if (clock_is_empty(e_merged.data(), a)) continue;
-        auto [vca, vida, vdota, vdida, vdclka] =
-            val_ptr(ia, ovc_a, oid_a, odot_a, odid_a, odclk_a);
-        auto [vcb, vidb, vdotb, vdidb, vdclkb] =
-            val_ptr(ib, ovc_b, oid_b, odot_b, odid_b, odclk_b);
-        over |= orswot_value_merge(
-            vca, vida, vdota, vdida, vdclka, vcb, vidb, vdotb, vdidb, vdclkb,
-            deleters.data(), vc_buf.data(), vid_buf.data(), vdot_buf.data(),
-            vdid_buf.data(), vdclk_buf.data(), a, m, d2, scratch);
-      } else {
-        // one-sided (map.rs:198-211 / :244-253): keep the SUBTRACTED entry
-        // clock, truncate the value by what the other side witnessed
-        // beyond it (reset-remove)
-        const C* e = e1 ? e1 : e2;
-        const C* other_clock = e1 ? oc : sc;
-        for (int64_t i = 0; i < a; ++i)
-          e_merged[i] = (e[i] > other_clock[i]) ? e[i] : 0;
-        if (clock_is_empty(e_merged.data(), a)) continue;
-        for (int64_t i = 0; i < a; ++i)
-          deleters[i] = (other_clock[i] > e_merged[i]) ? other_clock[i] : 0;
-        auto [svc, svid, svdot, svdid, svdclk] =
-            e1 ? val_ptr(ia, ovc_a, oid_a, odot_a, odid_a, odclk_a)
-               : val_ptr(ib, ovc_b, oid_b, odot_b, odid_b, odclk_b);
-        std::copy(svc, svc + a, vc_buf.begin());
-        std::copy(svid, svid + m, vid_buf.begin());
-        std::copy(svdot, svdot + m * a, vdot_buf.begin());
-        std::copy(svdid, svdid + d2, vdid_buf.begin());
-        std::copy(svdclk, svdclk + d2 * a, vdclk_buf.begin());
-        over |= orswot_value_truncate(vc_buf.data(), vid_buf.data(),
-                                      vdot_buf.data(), vdid_buf.data(),
-                                      vdclk_buf.data(), deleters.data(), a, m,
-                                      d2, scratch);
-      }
-      out_keys.push_back(id);
-      out_e.insert(out_e.end(), e_merged.begin(), e_merged.end());
-      out_vc.insert(out_vc.end(), vc_buf.begin(), vc_buf.end());
-      out_vid.insert(out_vid.end(), vid_buf.begin(), vid_buf.end());
-      out_vdot.insert(out_vdot.end(), vdot_buf.begin(), vdot_buf.end());
-      out_vdid.insert(out_vdid.end(), vdid_buf.begin(), vdid_buf.end());
-      out_vdclk.insert(out_vdclk.end(), vdclk_buf.begin(), vdclk_buf.end());
-    }
-
-    // deferred: keep all of self's rows; adopt other's only when NOT
-    // already covered by self's clock (map.rs:256-260); dedup exact pairs
-    std::vector<int32_t> dq;
-    std::vector<C> dqc;
-    auto push_deferred = [&](const int32_t* dks, const C* dcs, bool adopt_filter) {
-      for (int64_t q = 0; q < d; ++q) {
-        int32_t id = dks[r * d + q];
-        if (id == kEmpty) continue;
-        const C* ck = dcs + (r * d + q) * a;
-        if (adopt_filter && clock_leq(ck, sc, a)) continue;
-        bool dup = false;
-        for (size_t p = 0; !dup && p < dq.size(); ++p)
-          dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
-        if (!dup) {
-          dq.push_back(id);
-          dqc.insert(dqc.end(), ck, ck + a);
-        }
-      }
-    };
-    push_deferred(dk_a, dc_a, false);
-    push_deferred(dk_b, dc_b, true);
-
-    // clock join (map.rs:265), then apply_deferred (map.rs:267).  The
-    // value truncate runs for EVERY surviving key — with a zero rm it is
-    // still the jnp kernel's plunger/compaction pass (see note above)
-    C* out_clock = clock_o + r * a;
-    for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
-    std::vector<C> rm(a);
-    for (size_t e = 0; e < out_keys.size(); ++e) {
-      std::fill(rm.begin(), rm.end(), 0);
-      for (size_t q = 0; q < dq.size(); ++q)
-        if (dq[q] != kEmpty && dq[q] == out_keys[e])
-          clock_max_into(rm.data(), dqc.data() + q * a, a);
-      C* er = out_e.data() + e * a;
-      for (int64_t i = 0; i < a; ++i) er[i] = (er[i] > rm[i]) ? er[i] : 0;
-      over |= orswot_value_truncate(
-          out_vc.data() + e * a, out_vid.data() + e * m,
-          out_vdot.data() + e * m * a, out_vdid.data() + e * d2,
-          out_vdclk.data() + e * d2 * a, rm.data(), a, m, d2, scratch);
-      if (clock_is_empty(er, a)) {
-        out_keys[e] = kEmpty;
-        std::memset(er, 0, sizeof(C) * a);
-        std::memset(out_vc.data() + e * a, 0, sizeof(C) * a);
-        std::fill(out_vid.begin() + e * m, out_vid.begin() + (e + 1) * m, kEmpty);
-        std::memset(out_vdot.data() + e * m * a, 0, sizeof(C) * m * a);
-        std::fill(out_vdid.begin() + e * d2, out_vdid.begin() + (e + 1) * d2,
-                  kEmpty);
-        std::memset(out_vdclk.data() + e * d2 * a, 0, sizeof(C) * d2 * a);
-      }
-    }
-    for (size_t q = 0; q < dq.size(); ++q)
-      if (dq[q] != kEmpty && clock_leq(dqc.data() + q * a, out_clock, a)) {
-        dq[q] = kEmpty;
-        std::memset(dqc.data() + q * a, 0, sizeof(C) * a);
-      }
-
-    // compact into output capacities, live-first (ascending-key) order;
-    // empty value slots are zeros_like — id tables filled with EMPTY
-    int32_t* ok = keys_o + r * k_cap;
-    C* oe = ec_o + r * k_cap * a;
-    C* o_vc = ovc_o + r * k_cap * a;
-    int32_t* o_vid = oid_o + r * k_cap * m;
-    C* o_vdot = odot_o + r * k_cap * m * a;
-    int32_t* o_vdid = odid_o + r * k_cap * d2;
-    C* o_vdclk = odclk_o + r * k_cap * d2 * a;
-    std::fill(ok, ok + k_cap, kEmpty);
-    std::memset(oe, 0, sizeof(C) * k_cap * a);
-    std::memset(o_vc, 0, sizeof(C) * k_cap * a);
-    std::fill(o_vid, o_vid + k_cap * m, kEmpty);
-    std::memset(o_vdot, 0, sizeof(C) * k_cap * m * a);
-    std::fill(o_vdid, o_vdid + k_cap * d2, kEmpty);
-    std::memset(o_vdclk, 0, sizeof(C) * k_cap * d2 * a);
-    int64_t w = 0, live = 0;
-    for (size_t e = 0; e < out_keys.size(); ++e) {
-      if (out_keys[e] == kEmpty) continue;
-      ++live;
-      if (w < k_cap) {
-        ok[w] = out_keys[e];
-        std::memcpy(oe + w * a, out_e.data() + e * a, sizeof(C) * a);
-        std::memcpy(o_vc + w * a, out_vc.data() + e * a, sizeof(C) * a);
-        std::memcpy(o_vid + w * m, out_vid.data() + e * m,
-                    sizeof(int32_t) * m);
-        std::memcpy(o_vdot + w * m * a, out_vdot.data() + e * m * a,
-                    sizeof(C) * m * a);
-        std::memcpy(o_vdid + w * d2, out_vdid.data() + e * d2,
-                    sizeof(int32_t) * d2);
-        std::memcpy(o_vdclk + w * d2 * a, out_vdclk.data() + e * d2 * a,
-                    sizeof(C) * d2 * a);
-        ++w;
-      }
-    }
-    int32_t* oq = dk_o + r * d_cap;
-    C* oqc = dc_o + r * d_cap * a;
-    std::fill(oq, oq + d_cap, kEmpty);
-    std::memset(oqc, 0, sizeof(C) * d_cap * a);
-    int64_t wq = 0, live_q = 0;
-    for (size_t q = 0; q < dq.size(); ++q) {
-      if (dq[q] == kEmpty) continue;
-      ++live_q;
-      if (wq < d_cap) {
-        oq[wq] = dq[q];
-        std::memcpy(oqc + wq * a, dqc.data() + q * a, sizeof(C) * a);
-        ++wq;
-      }
-    }
-    overflow[r] = over || live > k_cap || live_q > d_cap;
+    OrswotValRow<C> v(
+        ovc_a + r * k * a, oid_a + r * k * m, odot_a + r * k * m * a,
+        odid_a + r * k * d2, odclk_a + r * k * d2 * a, ovc_b + r * k * a,
+        oid_b + r * k * m, odot_b + r * k * m * a, odid_b + r * k * d2,
+        odclk_b + r * k * d2 * a, ovc_o + r * k_cap * a,
+        oid_o + r * k_cap * m, odot_o + r * k_cap * m * a,
+        odid_o + r * k_cap * d2, odclk_o + r * k_cap * d2 * a, m, d2, a,
+        k_cap);
+    overflow[r] = map_row_merge<C, OrswotValRow<C>>(
+        clock_a + r * a, keys_a + r * k, ec_a + r * k * a, dk_a + r * d,
+        dc_a + r * d * a, clock_b + r * a, keys_b + r * k, ec_b + r * k * a,
+        dk_b + r * d, dc_b + r * d * a, a, k, k, d, d, k_cap, d_cap,
+        clock_o + r * a, keys_o + r * k_cap, ec_o + r * k_cap * a,
+        dk_o + r * d_cap, dc_o + r * d_cap * a, v);
   }
 }
 
 template <typename C>
-void map_mvreg_merge_impl(
-    const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* mvc_a,
-    const C* mvv_a, const int32_t* dk_a, const C* dc_a, const C* clock_b,
-    const int32_t* keys_b, const C* ec_b, const C* mvc_b, const C* mvv_b,
-    const int32_t* dk_b, const C* dc_b, int64_t n, int64_t a, int64_t k,
-    int64_t v_cap, int64_t d, int64_t k_cap, int64_t d_cap, C* clock_o,
-    int32_t* keys_o, C* ec_o, C* mvc_o, C* mvv_o, int32_t* dk_o, C* dc_o,
-    uint8_t* overflow) {
+void map_map_mvreg_merge_impl(
+    const C* clock_a, const int32_t* keys_a, const C* ec_a, const C* iclk_a,
+    const int32_t* ikeys_a, const C* iec_a, const C* imvc_a, const C* imvv_a,
+    const int32_t* idk_a, const C* idc_a, const int32_t* dk_a, const C* dc_a,
+    const C* clock_b, const int32_t* keys_b, const C* ec_b, const C* iclk_b,
+    const int32_t* ikeys_b, const C* iec_b, const C* imvc_b, const C* imvv_b,
+    const int32_t* idk_b, const C* idc_b, const int32_t* dk_b, const C* dc_b,
+    int64_t n, int64_t a, int64_t k, int64_t k2, int64_t v_cap, int64_t d3,
+    int64_t d, int64_t k_cap, int64_t d_cap, C* clock_o, int32_t* keys_o,
+    C* ec_o, C* iclk_o, int32_t* ikeys_o, C* iec_o, C* imvc_o, C* imvv_o,
+    int32_t* idk_o, C* idc_o, int32_t* dk_o, C* dc_o, uint8_t* overflow) {
+  InnerMapDims<C> dm{a, k2, v_cap, d3};
 #pragma omp parallel for
   for (int64_t r = 0; r < n; ++r) {
-    const C* sc = clock_a + r * a;
-    const C* oc = clock_b + r * a;
-    bool over = false;
-
-    // key alignment in ascending id order (map.rs:196-197 BTreeMap walk;
-    // the jnp align_keyed's stable sort gives the same order)
-    struct Slot { int32_t id; int8_t side; int64_t idx; };
-    std::vector<Slot> slots;
-    slots.reserve(2 * k);
-    for (int64_t j = 0; j < k; ++j)
-      if (keys_a[r * k + j] != kEmpty) slots.push_back({keys_a[r * k + j], 0, j});
-    for (int64_t j = 0; j < k; ++j)
-      if (keys_b[r * k + j] != kEmpty) slots.push_back({keys_b[r * k + j], 1, j});
-    std::stable_sort(slots.begin(), slots.end(),
-                     [](const Slot& x, const Slot& y) { return x.id < y.id; });
-
-    std::vector<int32_t> out_keys;
-    std::vector<C> out_e, out_mc, out_mv;
-    std::vector<C> e_merged(a), deleters(a);
-    std::vector<C> mc_buf(v_cap * a), mv_buf(v_cap);
-    for (size_t s = 0; s < slots.size();) {
-      int32_t id = slots[s].id;
-      int64_t ia = -1, ib = -1;
-      while (s < slots.size() && slots[s].id == id) {
-        (slots[s].side == 0 ? ia : ib) = slots[s].idx;
-        ++s;
-      }
-      const C* e1 = ia >= 0 ? ec_a + (r * k + ia) * a : nullptr;
-      const C* e2 = ib >= 0 ? ec_b + (r * k + ib) * a : nullptr;
-      if (e1 && e2) {
-        // both present (map.rs:213-240): dot dance + nested value merge;
-        // deleters = (c1 ∨ c2) − merged clock, empty in practice
-        dot_rule_both(e1, e2, sc, oc, e_merged.data(), a);
-        for (int64_t i = 0; i < a; ++i) {
-          C common = (e1[i] == e2[i]) ? e1[i] : 0;
-          C c1 = (e1[i] > common) ? e1[i] : 0;
-          c1 = (c1 > oc[i]) ? c1 : 0;
-          C c2 = (e2[i] > common) ? e2[i] : 0;
-          c2 = (c2 > sc[i]) ? c2 : 0;
-          C mx = std::max(c1, c2);
-          deleters[i] = (mx > e_merged[i]) ? mx : 0;
-        }
-        if (clock_is_empty(e_merged.data(), a)) continue;
-        over |= mvreg_value_merge(
-            mvc_a + (r * k + ia) * v_cap * a, mvv_a + (r * k + ia) * v_cap,
-            mvc_b + (r * k + ib) * v_cap * a, mvv_b + (r * k + ib) * v_cap,
-            deleters.data(), mc_buf.data(), mv_buf.data(), v_cap, a);
-      } else {
-        // one-sided (map.rs:198-211 / :244-253): keep the SUBTRACTED entry
-        // clock (unlike Orswot's full-clock asymmetry), truncate the value
-        // by what the other side witnessed beyond it (reset-remove)
-        const C* e = e1 ? e1 : e2;
-        const C* other_clock = e1 ? oc : sc;
-        for (int64_t i = 0; i < a; ++i)
-          e_merged[i] = (e[i] > other_clock[i]) ? e[i] : 0;
-        if (clock_is_empty(e_merged.data(), a)) continue;
-        for (int64_t i = 0; i < a; ++i)
-          deleters[i] = (other_clock[i] > e_merged[i]) ? other_clock[i] : 0;
-        const C* smc = e1 ? mvc_a + (r * k + ia) * v_cap * a
-                          : mvc_b + (r * k + ib) * v_cap * a;
-        const C* smv = e1 ? mvv_a + (r * k + ia) * v_cap
-                          : mvv_b + (r * k + ib) * v_cap;
-        std::memcpy(mc_buf.data(), smc, sizeof(C) * v_cap * a);
-        std::memcpy(mv_buf.data(), smv, sizeof(C) * v_cap);
-        mvreg_value_truncate(mc_buf.data(), mv_buf.data(), deleters.data(),
-                             v_cap, a);
-      }
-      out_keys.push_back(id);
-      out_e.insert(out_e.end(), e_merged.begin(), e_merged.end());
-      out_mc.insert(out_mc.end(), mc_buf.begin(), mc_buf.end());
-      out_mv.insert(out_mv.end(), mv_buf.begin(), mv_buf.end());
-    }
-
-    // deferred: keep all of self's rows; adopt other's only when NOT
-    // already covered by self's clock (map.rs:256-260 — covered rows are
-    // replayed against pre-merge entries which `keep` then discards);
-    // dedup exact (key, clock) pairs keeping the first
-    std::vector<int32_t> dq;
-    std::vector<C> dqc;
-    auto push_deferred = [&](const int32_t* dks, const C* dcs, bool adopt_filter) {
-      for (int64_t q = 0; q < d; ++q) {
-        int32_t id = dks[r * d + q];
-        if (id == kEmpty) continue;
-        const C* ck = dcs + (r * d + q) * a;
-        if (adopt_filter && clock_leq(ck, sc, a)) continue;
-        bool dup = false;
-        for (size_t p = 0; !dup && p < dq.size(); ++p)
-          dup = dq[p] == id && clock_eq(dqc.data() + p * a, ck, a);
-        if (!dup) {
-          dq.push_back(id);
-          dqc.insert(dqc.end(), ck, ck + a);
-        }
-      }
-    };
-    push_deferred(dk_a, dc_a, false);
-    push_deferred(dk_b, dc_b, true);
-
-    // clock join (map.rs:265), then apply_deferred (map.rs:267): subtract
-    // the join of matching rows from each entry clock, truncate the value
-    // the same way, drop emptied keys; rows the joined clock now covers
-    // are dropped from the buffer
-    C* out_clock = clock_o + r * a;
-    for (int64_t i = 0; i < a; ++i) out_clock[i] = std::max(sc[i], oc[i]);
-    std::vector<C> rm(a);
-    for (size_t e = 0; e < out_keys.size(); ++e) {
-      std::fill(rm.begin(), rm.end(), 0);
-      bool any = false;
-      for (size_t q = 0; q < dq.size(); ++q)
-        if (dq[q] != kEmpty && dq[q] == out_keys[e]) {
-          clock_max_into(rm.data(), dqc.data() + q * a, a);
-          any = true;
-        }
-      if (!any) continue;
-      C* er = out_e.data() + e * a;
-      for (int64_t i = 0; i < a; ++i) er[i] = (er[i] > rm[i]) ? er[i] : 0;
-      mvreg_value_truncate(out_mc.data() + e * v_cap * a,
-                           out_mv.data() + e * v_cap, rm.data(), v_cap, a);
-      if (clock_is_empty(er, a)) {
-        out_keys[e] = kEmpty;
-        std::memset(er, 0, sizeof(C) * a);
-        std::memset(out_mc.data() + e * v_cap * a, 0, sizeof(C) * v_cap * a);
-        std::memset(out_mv.data() + e * v_cap, 0, sizeof(C) * v_cap);
-      }
-    }
-    for (size_t q = 0; q < dq.size(); ++q)
-      if (dq[q] != kEmpty && clock_leq(dqc.data() + q * a, out_clock, a)) {
-        dq[q] = kEmpty;
-        std::memset(dqc.data() + q * a, 0, sizeof(C) * a);
-      }
-
-    // compact into output capacities, live-first (ascending-key) order
-    int32_t* ok = keys_o + r * k_cap;
-    C* oe = ec_o + r * k_cap * a;
-    C* omc = mvc_o + r * k_cap * v_cap * a;
-    C* omv = mvv_o + r * k_cap * v_cap;
-    std::fill(ok, ok + k_cap, kEmpty);
-    std::memset(oe, 0, sizeof(C) * k_cap * a);
-    std::memset(omc, 0, sizeof(C) * k_cap * v_cap * a);
-    std::memset(omv, 0, sizeof(C) * k_cap * v_cap);
-    int64_t w = 0, live = 0;
-    for (size_t e = 0; e < out_keys.size(); ++e) {
-      if (out_keys[e] == kEmpty) continue;
-      ++live;
-      if (w < k_cap) {
-        ok[w] = out_keys[e];
-        std::memcpy(oe + w * a, out_e.data() + e * a, sizeof(C) * a);
-        std::memcpy(omc + w * v_cap * a, out_mc.data() + e * v_cap * a,
-                    sizeof(C) * v_cap * a);
-        std::memcpy(omv + w * v_cap, out_mv.data() + e * v_cap,
-                    sizeof(C) * v_cap);
-        ++w;
-      }
-    }
-    int32_t* oq = dk_o + r * d_cap;
-    C* oqc = dc_o + r * d_cap * a;
-    std::fill(oq, oq + d_cap, kEmpty);
-    std::memset(oqc, 0, sizeof(C) * d_cap * a);
-    int64_t wq = 0, live_q = 0;
-    for (size_t q = 0; q < dq.size(); ++q) {
-      if (dq[q] == kEmpty) continue;
-      ++live_q;
-      if (wq < d_cap) {
-        oq[wq] = dq[q];
-        std::memcpy(oqc + wq * a, dqc.data() + q * a, sizeof(C) * a);
-        ++wq;
-      }
-    }
-    overflow[r] = over || live > k_cap || live_q > d_cap;
+    InnerMapValRow<C> v(
+        iclk_a + r * k * dm.clock_sz(), ikeys_a + r * k * dm.keys_sz(),
+        iec_a + r * k * dm.ec_sz(), imvc_a + r * k * dm.mvc_sz(),
+        imvv_a + r * k * dm.mvv_sz(), idk_a + r * k * dm.dk_sz(),
+        idc_a + r * k * dm.dc_sz(), iclk_b + r * k * dm.clock_sz(),
+        ikeys_b + r * k * dm.keys_sz(), iec_b + r * k * dm.ec_sz(),
+        imvc_b + r * k * dm.mvc_sz(), imvv_b + r * k * dm.mvv_sz(),
+        idk_b + r * k * dm.dk_sz(), idc_b + r * k * dm.dc_sz(),
+        iclk_o + r * k_cap * dm.clock_sz(), ikeys_o + r * k_cap * dm.keys_sz(),
+        iec_o + r * k_cap * dm.ec_sz(), imvc_o + r * k_cap * dm.mvc_sz(),
+        imvv_o + r * k_cap * dm.mvv_sz(), idk_o + r * k_cap * dm.dk_sz(),
+        idc_o + r * k_cap * dm.dc_sz(), dm, k_cap);
+    overflow[r] = map_row_merge<C, InnerMapValRow<C>>(
+        clock_a + r * a, keys_a + r * k, ec_a + r * k * a, dk_a + r * d,
+        dc_a + r * d * a, clock_b + r * a, keys_b + r * k, ec_b + r * k * a,
+        dk_b + r * d, dc_b + r * d * a, a, k, k, d, d, k_cap, d_cap,
+        clock_o + r * a, keys_o + r * k_cap, ec_o + r * k_cap * a,
+        dk_o + r * d_cap, dc_o + r * d_cap * a, v);
   }
 }
 
@@ -1042,6 +1249,28 @@ void map_mvreg_merge_impl(
                              odid_o, odclk_o, dk_o, dc_o, overflow);          \
   }
 
+#define DEFINE_MAP_MAP_MVREG(SUF, C)                                          \
+  void map_map_mvreg_merge_##SUF(                                             \
+      const C* clock_a, const int32_t* keys_a, const C* ec_a,                 \
+      const C* iclk_a, const int32_t* ikeys_a, const C* iec_a,                \
+      const C* imvc_a, const C* imvv_a, const int32_t* idk_a, const C* idc_a, \
+      const int32_t* dk_a, const C* dc_a, const C* clock_b,                   \
+      const int32_t* keys_b, const C* ec_b, const C* iclk_b,                  \
+      const int32_t* ikeys_b, const C* iec_b, const C* imvc_b,                \
+      const C* imvv_b, const int32_t* idk_b, const C* idc_b,                  \
+      const int32_t* dk_b, const C* dc_b, int64_t n, int64_t a, int64_t kk,   \
+      int64_t k2, int64_t v_cap, int64_t d3, int64_t d, int64_t k_cap,        \
+      int64_t d_cap, C* clock_o, int32_t* keys_o, C* ec_o, C* iclk_o,         \
+      int32_t* ikeys_o, C* iec_o, C* imvc_o, C* imvv_o, int32_t* idk_o,       \
+      C* idc_o, int32_t* dk_o, C* dc_o, uint8_t* overflow) {                  \
+    map_map_mvreg_merge_impl<C>(                                              \
+        clock_a, keys_a, ec_a, iclk_a, ikeys_a, iec_a, imvc_a, imvv_a,        \
+        idk_a, idc_a, dk_a, dc_a, clock_b, keys_b, ec_b, iclk_b, ikeys_b,     \
+        iec_b, imvc_b, imvv_b, idk_b, idc_b, dk_b, dc_b, n, a, kk, k2,        \
+        v_cap, d3, d, k_cap, d_cap, clock_o, keys_o, ec_o, iclk_o, ikeys_o,   \
+        iec_o, imvc_o, imvv_o, idk_o, idc_o, dk_o, dc_o, overflow);           \
+  }
+
 #define DEFINE_ORSWOT(SUF, C)                                                 \
   void orswot_merge_##SUF(                                                    \
       const C* clock_a, const int32_t* ids_a, const C* dots_a,                \
@@ -1077,13 +1306,14 @@ void map_mvreg_merge_impl(
   DEFINE_MVREG(SUF, C) \
   DEFINE_ORSWOT(SUF, C) \
   DEFINE_MAP_MVREG(SUF, C) \
-  DEFINE_MAP_ORSWOT(SUF, C)
+  DEFINE_MAP_ORSWOT(SUF, C) \
+  DEFINE_MAP_MAP_MVREG(SUF, C)
 
 extern "C" {
 
 DEFINE_ALL(u32, uint32_t)
 DEFINE_ALL(u64, uint64_t)
 
-int crdt_core_abi_version() { return 4; }
+int crdt_core_abi_version() { return 5; }
 
 }  // extern "C"

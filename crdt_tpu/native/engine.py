@@ -375,6 +375,104 @@ def map_orswot_merge(
     )
 
 
+# -- Map<K, Map<K2, MVReg>> --------------------------------------------------
+
+
+def map_map_mvreg_merge(
+    state_a, state_b, k_cap: int | None = None, d_cap: int | None = None
+):
+    """Full pairwise ``Map<K, Map<K2, MVReg>>`` merge — nested reset-remove
+    composition (`map.rs:192-269` recursing into itself at `:229`, the
+    `test/map.rs:8` shape), bit-exact with :func:`crdt_tpu.ops.map_ops.merge`
+    under a ``MapKernel(val_kernel=MVRegKernel)``.
+
+    ``state`` = ``(clock[N,A], keys i32[N,K], eclocks[N,K,A],
+    (i_clock[N,K,A], i_keys i32[N,K,K2], i_eclocks[N,K,K2,A],
+    (mv_clocks[N,K,K2,V,A], mv_vals[N,K,K2,V]), i_dkeys i32[N,K,D3],
+    i_dclocks[N,K,D3,A]), d_keys i32[N,D], d_clocks[N,D,A])`` — the nested
+    6-tuple is the inner MapKernel value state.  Returns
+    ``(state, overflow)`` with one flag per object."""
+    def unpack(state):
+        clock, keys, eclocks, vals, d_keys, d_clocks = state
+        iclk, ikeys, iec, (imvc, imvv), idk, idc = vals
+        clock, eclocks, iclk, iec, imvc, imvv, idc, d_clocks = _contig(
+            clock, eclocks, iclk, iec, imvc, imvv, idc, d_clocks
+        )
+        keys, ikeys, idk, d_keys = _contig(
+            np.asarray(keys, dtype=np.int32), np.asarray(ikeys, dtype=np.int32),
+            np.asarray(idk, dtype=np.int32), np.asarray(d_keys, dtype=np.int32),
+        )
+        return (clock, keys, eclocks, iclk, ikeys, iec, imvc, imvv, idk, idc,
+                d_keys, d_clocks)
+
+    A = unpack(state_a)
+    B = unpack(state_b)
+    dt = _check_counters(A[0], B[0], A[2], B[2], A[3], B[3], A[5], B[5],
+                         A[6], B[6], A[7], B[7], A[9], B[9], A[11], B[11])
+    if any(x.shape != y.shape for x, y in zip(A, B)):
+        raise ValueError(
+            f"map_map_mvreg_merge: side shapes differ: "
+            f"{[x.shape for x in A]} vs {[y.shape for y in B]}"
+        )
+    (clk, keys_, ec, iclk_, ikeys_, iec_, imvc_, imvv_, idk_, idc_,
+     dk_, dc_) = A
+    *lead, a = clk.shape
+    lead_t = tuple(lead)
+    k = keys_.shape[-1]
+    k2 = ikeys_.shape[-1]
+    v_cap = imvc_.shape[-2]
+    d3 = idk_.shape[-1]
+    d = dk_.shape[-1]
+    if (
+        keys_.shape != (*lead_t, k)
+        or ec.shape != (*lead_t, k, a)
+        or iclk_.shape != (*lead_t, k, a)
+        or ikeys_.shape != (*lead_t, k, k2)
+        or iec_.shape != (*lead_t, k, k2, a)
+        or imvc_.shape != (*lead_t, k, k2, v_cap, a)
+        or imvv_.shape != (*lead_t, k, k2, v_cap)
+        or idk_.shape != (*lead_t, k, d3)
+        or idc_.shape != (*lead_t, k, d3, a)
+        or dk_.shape != (*lead_t, d)
+        or dc_.shape != (*lead_t, d, a)
+    ):
+        raise ValueError(
+            f"map_map_mvreg_merge: inconsistent state shapes: "
+            f"{[x.shape for x in A]}"
+        )
+    n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    k_cap = k if k_cap is None else k_cap
+    d_cap = d if d_cap is None else d_cap
+
+    clock = np.empty((*lead, a), dtype=dt)
+    keys = np.empty((*lead, k_cap), dtype=np.int32)
+    eclocks = np.empty((*lead, k_cap, a), dtype=dt)
+    iclk = np.empty((*lead, k_cap, a), dtype=dt)
+    ikeys = np.empty((*lead, k_cap, k2), dtype=np.int32)
+    iec = np.empty((*lead, k_cap, k2, a), dtype=dt)
+    imvc = np.empty((*lead, k_cap, k2, v_cap, a), dtype=dt)
+    imvv = np.empty((*lead, k_cap, k2, v_cap), dtype=dt)
+    idk = np.empty((*lead, k_cap, d3), dtype=np.int32)
+    idc = np.empty((*lead, k_cap, d3, a), dtype=dt)
+    d_keys = np.empty((*lead, d_cap), dtype=np.int32)
+    d_clocks = np.empty((*lead, d_cap, a), dtype=dt)
+    overflow = np.empty(n, dtype=np.uint8)
+    _fn("map_map_mvreg_merge", dt)(
+        *(_ptr(x) for x in A), *(_ptr(x) for x in B),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(k),
+        ctypes.c_int64(k2), ctypes.c_int64(v_cap), ctypes.c_int64(d3),
+        ctypes.c_int64(d), ctypes.c_int64(k_cap), ctypes.c_int64(d_cap),
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(iclk), _ptr(ikeys),
+        _ptr(iec), _ptr(imvc), _ptr(imvv), _ptr(idk), _ptr(idc),
+        _ptr(d_keys), _ptr(d_clocks), _ptr(overflow),
+    )
+    return (
+        (clock, keys, eclocks,
+         (iclk, ikeys, iec, (imvc, imvv), idk, idc), d_keys, d_clocks),
+        overflow.astype(bool).reshape(lead),
+    )
+
+
 # -- Map<K, MVReg> -----------------------------------------------------------
 
 

@@ -573,3 +573,117 @@ def test_map_orswot_three_engine_agreement():
             m.merge(states[i])
         expected.append(m)
     assert got == expected
+
+
+# -- Map<K, Map<K2, MVReg>> merge (map.rs:192-269 recursing at :229) ----------
+
+
+def _random_nested_map_states(seed, n_obj, uni):
+    """Random op-built Map<int, Map<int, MVReg>> fleet (`test/map.rs:8`
+    shape) + its dense MapBatch under a nested MapKernel."""
+    import random as pyrandom
+
+    from crdt_tpu import Dot, Map, MVReg
+    from crdt_tpu.batch import MapBatch, MapKernel, MVRegKernel
+    from crdt_tpu.scalar.map import Rm as MapRm, Up
+    from crdt_tpu.scalar.mvreg import Put
+    from crdt_tpu.scalar.vclock import VClock
+
+    rng = pyrandom.Random(seed)
+    states = []
+    for _ in range(n_obj):
+        m = Map(lambda: Map(MVReg))
+        for _ in range(rng.randrange(0, 12)):
+            actor = rng.randrange(0, 6)
+            counter = rng.randrange(1, 6)
+            key = rng.randrange(0, 4)
+            ikey = rng.randrange(0, 4)
+            dot = Dot(actor, counter)
+            clock = VClock.from_iter([(actor, counter)])
+            p = rng.random()
+            if p < 0.2:
+                m.apply(MapRm(clock=clock, key=key))
+            elif p < 0.4:
+                m.apply(Up(dot=dot, key=key, op=MapRm(clock=clock, key=ikey)))
+            else:
+                m.apply(Up(dot=dot, key=key,
+                           op=Up(dot=dot, key=ikey,
+                                 op=Put(clock=clock,
+                                        val=rng.randrange(0, 9)))))
+        states.append(m)
+    inner = MapKernel.from_config(uni.config, MVRegKernel.from_config(uni.config))
+    batch = MapBatch.from_scalar(states, uni, inner)
+    state = (batch.clock, batch.keys, batch.entry_clocks, batch.vals,
+             batch.d_keys, batch.d_clocks)
+    import jax
+
+    arrays = jax.tree_util.tree_map(np.asarray, state)
+    return arrays, state, states, inner
+
+
+def _nested_map_uni():
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.utils.interning import Universe
+
+    return Universe(CrdtConfig(
+        num_actors=6, mv_capacity=6, deferred_capacity=5, key_capacity=6,
+    ))
+
+
+def test_map_map_mvreg_merge_parity(engines):
+    """Native nested-map merge == jnp map_ops.merge under a nested
+    MapKernel, byte-for-byte — all three engines now cover Map-in-Map."""
+    engine = engines[0]
+
+    from crdt_tpu.ops import map_ops
+
+    uni = _nested_map_uni()
+    A, state_a, _, vk = _random_nested_map_states(606, 24, uni)
+    B, state_b, _, _ = _random_nested_map_states(707, 24, uni)
+
+    k_cap = A[1].shape[-1]
+    d_cap = A[4].shape[-1]
+    got_state, got_over = engine.map_map_mvreg_merge(A, B, k_cap, d_cap)
+    want_state, want_over = map_ops.merge(state_a, state_b, vk, k_cap, d_cap)
+
+    import jax
+
+    got_flat = jax.tree_util.tree_leaves(got_state)
+    want_flat = jax.tree_util.tree_leaves(want_state)
+    assert len(got_flat) == len(want_flat) == 12
+    for g, w in zip(got_flat, want_flat):
+        np.testing.assert_array_equal(g, np.asarray(w))
+    np.testing.assert_array_equal(got_over, np.asarray(want_over))
+
+
+def test_map_map_mvreg_three_engine_agreement():
+    """C++ N-way nested-map fold == scalar Python N-way merge, with the JAX
+    engine pinned byte-for-byte above — three engines on the deepest
+    composition shape the reference tests (`test/map.rs:8`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch import MapBatch, MapKernel
+    from crdt_tpu.native import engine
+
+    uni = _nested_map_uni()
+    rows = [_random_nested_map_states(800 + i, 6, uni) for i in range(4)]
+
+    acc_arrays = rows[0][0]
+    for arrays, *_ in rows[1:]:
+        acc_arrays, over = engine.map_map_mvreg_merge(acc_arrays, arrays)
+        assert not over.any()
+
+    mk = MapKernel.from_config(uni.config, rows[0][3])
+    merged = MapBatch.from_state(
+        jax.tree_util.tree_map(jnp.asarray, acc_arrays), mk
+    )
+    got = merged.to_scalar(uni)
+
+    expected = []
+    for i in range(6):
+        m = rows[0][2][i].clone()
+        for _, _, states, _ in rows[1:]:
+            m.merge(states[i])
+        expected.append(m)
+    assert got == expected

@@ -168,3 +168,42 @@ def test_map_batch_roundtrip(tmp_path):
     buf = checkpoint.save_bytes(nested, universe)
     loaded2, _ = checkpoint.load_bytes(buf)
     assert loaded2.kernel == nested.kernel
+
+
+def test_mvreg_batch_roundtrip(tmp_path):
+    from crdt_tpu.batch import MVRegBatch
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    universe = Universe()
+    regs = []
+    for i in range(4):
+        r = MVReg()
+        r.apply(r.set(f"v{i}", r.read().derive_add_ctx(i % 3)))
+        if i % 2:
+            # concurrent write from another actor -> a real antichain
+            r2 = MVReg()
+            r2.apply(r2.set(f"w{i}", r2.read().derive_add_ctx(5)))
+            r.merge(r2)
+        regs.append(r)
+    batch = MVRegBatch.from_scalar(regs, universe)
+    path = tmp_path / "mv.npz"
+    checkpoint.save(path, batch, universe)
+    loaded, uni2 = checkpoint.load(path)
+    assert type(loaded) is MVRegBatch
+    _assert_batch_equal(batch, loaded)
+    assert loaded.to_scalar(uni2) == regs
+
+
+def test_gset_batch_roundtrip(tmp_path):
+    from crdt_tpu.batch import GSetBatch
+    from crdt_tpu.scalar.gset import GSet
+
+    universe = Universe()
+    sets = [GSet({f"m{j}" for j in range(i + 1)}) for i in range(4)]
+    batch = GSetBatch.from_scalar(sets, universe, member_capacity=8)
+    path = tmp_path / "gs.npz"
+    checkpoint.save(path, batch, universe)
+    loaded, uni2 = checkpoint.load(path)
+    assert type(loaded) is GSetBatch
+    _assert_batch_equal(batch, loaded)
+    assert loaded.to_scalar(uni2) == sets

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..config import counter_dtype
+from ..error import CapacityOverflowError
 from ..ops import clock_ops, mvreg_ops
 from ..scalar.mvreg import MVReg
 from ..utils.interning import Universe
@@ -71,11 +72,16 @@ class MVRegBatch:
         return out
 
     def merge(self, other: "MVRegBatch", check: bool = True) -> "MVRegBatch":
-        """`mvreg.rs:121-153`; raises on antichain overflow past K."""
+        """`mvreg.rs:121-153`; raises :class:`CapacityOverflowError` on
+        antichain overflow past K (the executor's elastic recovery regrows
+        via :meth:`with_capacity` and requeues)."""
         k = self.clocks.shape[-2]
         clocks, vals, overflow = _merge(self.clocks, self.vals, other.clocks, other.vals, k)
         if check and bool(jnp.any(overflow)):
-            raise ValueError("MVReg antichain overflow: raise CrdtConfig.mv_capacity")
+            raise CapacityOverflowError(
+                "MVReg antichain overflow: raise CrdtConfig.mv_capacity",
+                member=True, deferred=False,
+            )
         return MVRegBatch(clocks=clocks, vals=vals)
 
     def apply_put(self, op_clocks, op_vals, check: bool = True) -> "MVRegBatch":
@@ -85,12 +91,51 @@ class MVRegBatch:
             self.clocks, self.vals, jnp.asarray(op_clocks), jnp.asarray(op_vals), k
         )
         if check and bool(jnp.any(overflow)):
-            raise ValueError("MVReg antichain overflow: raise CrdtConfig.mv_capacity")
+            raise CapacityOverflowError(
+                "MVReg antichain overflow: raise CrdtConfig.mv_capacity",
+                member=True, deferred=False,
+            )
         return MVRegBatch(clocks=clocks, vals=vals)
 
     def read_clock(self):
         """Folded clock per register (`mvreg.rs:216-222`)."""
         return mvreg_ops.read_clock(self.clocks)
+
+    # -- elastic-capacity protocol (crdt_tpu.parallel.JoinExecutor) ----------
+    # The executor's generic slot-axis names are member/deferred; for a
+    # register batch the one growable axis is the antichain (mv_capacity),
+    # exposed under the protocol's "member" slot.  There is no deferred
+    # axis — it reports 0 and with_capacity rejects attempts to grow it.
+
+    @property
+    def member_capacity(self) -> int:
+        return self.clocks.shape[-2]
+
+    @property
+    def deferred_capacity(self) -> int:
+        return 0
+
+    def with_capacity(
+        self, member_capacity: int | None = None,
+        deferred_capacity: int | None = None,
+    ) -> "MVRegBatch":
+        """Pad the antichain axis to ``member_capacity`` slots (elastic
+        regrowth; never shrinks — dominated-value compaction happens in
+        merge, not here)."""
+        if deferred_capacity:
+            raise ValueError("MVRegBatch has no deferred axis to grow")
+        k = self.clocks.shape[-2]
+        new_k = k if member_capacity is None else member_capacity
+        if new_k < k:
+            raise ValueError("with_capacity cannot shrink (would drop live slots)")
+        if new_k == k:
+            return self
+        pad = new_k - k
+        lead = self.clocks.ndim - 2
+        return MVRegBatch(
+            clocks=jnp.pad(self.clocks, [(0, 0)] * lead + [(0, pad), (0, 0)]),
+            vals=jnp.pad(self.vals, [(0, 0)] * lead + [(0, pad)]),
+        )
 
 
 @functools.partial(jax.jit, static_argnums=(4,))

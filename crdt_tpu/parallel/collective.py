@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
-from ..error import raise_for_overflow
+from ..error import CapacityOverflowError, raise_for_overflow
 from ..ops import orswot_ops
 
 
@@ -472,8 +472,9 @@ def allgather_join_mvreg(batch, mesh: Mesh, axis: str = "replicas", check: bool 
     join = _mvreg_join_fn(mesh, axis, k_cap, batch.clocks.ndim, batch.vals.ndim)
     clocks, vals, overflow = join(batch.clocks, batch.vals)
     if check and bool(jnp.any(overflow)):
-        raise ValueError(
-            "MVReg collective-join antichain overflow: raise CrdtConfig.mv_capacity"
+        raise CapacityOverflowError(
+            "MVReg collective-join antichain overflow: raise CrdtConfig.mv_capacity",
+            member=True, deferred=False,
         )
     return MVRegBatch(clocks=clocks, vals=vals)
 

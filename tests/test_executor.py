@@ -317,3 +317,64 @@ class TestTreeStrategy:
 
         out = join_all([fleet("x"), fleet("y")], strategy="tree")
         assert out.value_sets(uni) == [{f"x{i}", f"y{i}"} for i in range(3)]
+
+
+# -- MVReg elasticity (the antichain axis under the generic protocol) --------
+
+
+def _concurrent_regs(n_actors):
+    """One register per replica, all written concurrently by distinct
+    actors — the N-way join's antichain holds all N values."""
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    regs = []
+    for actor in range(n_actors):
+        r = MVReg()
+        r.apply(r.set(f"v{actor}", r.read().derive_add_ctx(actor)))
+        regs.append(r)
+    return regs
+
+
+def test_mvreg_overflow_triggers_regrowth():
+    """mv_capacity 2, five concurrent values: the executor must regrow the
+    antichain axis (reported under the protocol's member slot) and the
+    joined register must hold all five concurrent values."""
+    from crdt_tpu.batch import MVRegBatch
+
+    uni = Universe(CrdtConfig(num_actors=8, mv_capacity=2))
+    regs = _concurrent_regs(5)
+    batches = [MVRegBatch.from_scalar([r], uni) for r in regs]
+    stats = JoinStats()
+    joined = JoinExecutor().join_all(batches, plunger=False, stats=stats)
+    assert stats.overflow_regrows >= 1
+    assert stats.final_member_capacity >= 5
+    assert stats.final_deferred_capacity == 0
+
+    expected = regs[0].clone()
+    for r in regs[1:]:
+        expected.merge(r)
+    got = joined.to_scalar(uni)[0]
+    assert got == expected and len(got.vals) == 5
+
+
+def test_mvreg_with_capacity_contract():
+    from crdt_tpu.batch import MVRegBatch
+    from crdt_tpu.error import CapacityOverflowError
+
+    uni = Universe(CrdtConfig(num_actors=8, mv_capacity=2))
+    regs = _concurrent_regs(3)
+    a = MVRegBatch.from_scalar([regs[0]], uni)
+    b = MVRegBatch.from_scalar([regs[1]], uni)
+    c = MVRegBatch.from_scalar([regs[2]], uni)
+    with pytest.raises(CapacityOverflowError) as ei:
+        a.merge(b).merge(c)
+    assert ei.value.member and not ei.value.deferred
+
+    grown = a.with_capacity(4)
+    assert grown.member_capacity == 4 and grown.deferred_capacity == 0
+    # padded slots are dead (empty clocks); state is unchanged
+    assert grown.to_scalar(uni) == a.to_scalar(uni)
+    with pytest.raises(ValueError, match="cannot shrink"):
+        grown.with_capacity(2)
+    with pytest.raises(ValueError, match="no deferred axis"):
+        a.with_capacity(4, 2)

@@ -23,6 +23,7 @@ import jax
 import numpy as np
 from flax import struct
 
+from ..error import CapacityOverflowError
 from ..config import counter_dtype
 from ..ops import map_ops
 from ..ops.orswot_ops import EMPTY
@@ -164,13 +165,56 @@ class MapBatch:
     # -- state path ---------------------------------------------------------
 
     def merge(self, other: "MapBatch", check: bool = True) -> "MapBatch":
-        """`map.rs:192-269`; raises on any capacity overflow."""
+        """`map.rs:192-269`; raises :class:`CapacityOverflowError` on any
+        capacity overflow (key, deferred, or nested value — the kernel's
+        flag is collapsed, so elastic recovery grows the whole envelope
+        via :meth:`with_capacity`)."""
+        if self.kernel != other.kernel:
+            raise ValueError(
+                "MapBatch merge: kernels differ (equalize capacities first)"
+            )
         state, overflow = _merge(self.state, other.state, self.kernel)
         if check and bool(np.any(np.asarray(overflow))):
-            raise ValueError(
-                "MapBatch merge overflow: raise key/deferred/value capacities"
+            raise CapacityOverflowError(
+                "MapBatch merge overflow: raise key/deferred/value capacities",
+                member=True, deferred=True,
             )
         return MapBatch.from_state(state, self.kernel)
+
+    # -- elastic-capacity protocol (crdt_tpu.parallel.JoinExecutor) ----------
+    # Generic slot-axis names: the key axis reports as member_capacity, the
+    # map-level deferred table as deferred_capacity.  Because the merge's
+    # overflow flag does not name the overflowed axis (it may be a NESTED
+    # value capacity), with_capacity scales the nested value kernel's
+    # capacities by the same factor as the key axis — growth always makes
+    # progress no matter which axis actually overflowed.
+
+    @property
+    def member_capacity(self) -> int:
+        return self.keys.shape[-1]
+
+    @property
+    def deferred_capacity(self) -> int:
+        return self.d_keys.shape[-1]
+
+    def with_capacity(
+        self, member_capacity: int | None = None,
+        deferred_capacity: int | None = None,
+    ) -> "MapBatch":
+        """Pad the key/deferred axes (and the nested value axes, scaled by
+        the key-growth factor) to at least the requested capacities; never
+        shrinks."""
+        k, d = self.member_capacity, self.deferred_capacity
+        new_k = k if member_capacity is None else member_capacity
+        new_d = d if deferred_capacity is None else deferred_capacity
+        if new_k < k or new_d < d:
+            raise ValueError("with_capacity cannot shrink (would drop live slots)")
+        if (new_k, new_d) == (k, d):
+            return self
+        factor = max(-(-new_k // k), -(-new_d // d), 1)
+        target = self.kernel.grown(factor)
+        state = self.kernel.grow_state(self.state, target)
+        return MapBatch.from_state(state, target)
 
     def truncate(self, clock: jax.Array, check: bool = True) -> "MapBatch":
         """``Causal::truncate`` (`map.rs:131-158`); ``clock``: u64[N, A]."""

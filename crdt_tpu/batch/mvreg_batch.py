@@ -124,18 +124,23 @@ class MVRegBatch:
         merge, not here)."""
         if deferred_capacity:
             raise ValueError("MVRegBatch has no deferred axis to grow")
+        import dataclasses
+
+        from .val_kernels import MVRegKernel
+
         k = self.clocks.shape[-2]
         new_k = k if member_capacity is None else member_capacity
         if new_k < k:
             raise ValueError("with_capacity cannot shrink (would drop live slots)")
         if new_k == k:
             return self
-        pad = new_k - k
-        lead = self.clocks.ndim - 2
-        return MVRegBatch(
-            clocks=jnp.pad(self.clocks, [(0, 0)] * lead + [(0, pad), (0, 0)]),
-            vals=jnp.pad(self.vals, [(0, 0)] * lead + [(0, pad)]),
+        # one padding implementation for standalone AND map-nested
+        # registers: the kernel's grow_state
+        cur = MVRegKernel(mv_capacity=k, num_actors=self.clocks.shape[-1])
+        clocks, vals = cur.grow_state(
+            (self.clocks, self.vals), dataclasses.replace(cur, mv_capacity=new_k)
         )
+        return MVRegBatch(clocks=clocks, vals=vals)
 
 
 @functools.partial(jax.jit, static_argnums=(4,))

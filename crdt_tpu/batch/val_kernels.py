@@ -81,6 +81,26 @@ class MVRegKernel:
         c2, v2, over = mvreg_ops.compact(c2, v2, keep, self.mv_capacity)
         return (c2, v2), over
 
+    # -- elastic growth (MapBatch.with_capacity) -----------------------------
+
+    def grown(self, factor: int) -> "MVRegKernel":
+        """A kernel with every capacity axis scaled by ``factor``."""
+        return dataclasses.replace(self, mv_capacity=self.mv_capacity * factor)
+
+    def grow_state(self, v, target: "MVRegKernel"):
+        """Pad value state built under ``self`` to ``target``'s shapes
+        (new antichain slots are dead: empty clocks, zero payloads)."""
+        clocks, vals = v
+        pad = target.mv_capacity - self.mv_capacity
+        if pad < 0:
+            raise ValueError("grow_state cannot shrink")
+        if pad == 0:
+            return v
+        return (
+            jnp.pad(clocks, [(0, 0)] * (clocks.ndim - 2) + [(0, pad), (0, 0)]),
+            jnp.pad(vals, [(0, 0)] * (vals.ndim - 1) + [(0, pad)]),
+        )
+
     # -- host conversion ----------------------------------------------------
 
     def default_scalar(self):
@@ -170,6 +190,25 @@ class OrswotKernel:
         out = orswot_ops.apply_remove(*v, rm_clock, member_id)
         return out[:5], out[5]
 
+    # -- elastic growth (MapBatch.with_capacity) -----------------------------
+
+    def grown(self, factor: int) -> "OrswotKernel":
+        return dataclasses.replace(
+            self,
+            member_capacity=self.member_capacity * factor,
+            deferred_capacity=self.deferred_capacity * factor,
+        )
+
+    def grow_state(self, v, target: "OrswotKernel"):
+        # one padding implementation for standalone AND map-nested sets:
+        # OrswotBatch.with_capacity is rank-polymorphic over leading axes
+        from .orswot_batch import OrswotBatch
+
+        b = OrswotBatch(clock=v[0], ids=v[1], dots=v[2], d_ids=v[3],
+                        d_clocks=v[4])
+        g = b.with_capacity(target.member_capacity, target.deferred_capacity)
+        return (g.clock, g.ids, g.dots, g.d_ids, g.d_clocks)
+
     # -- host conversion ----------------------------------------------------
 
     def default_scalar(self):
@@ -249,6 +288,50 @@ class MapKernel:
 
     def truncate(self, v, clock):
         return map_ops.truncate(v, clock, self.val_kernel)
+
+    # -- elastic growth (MapBatch.with_capacity) -----------------------------
+
+    def grown(self, factor: int) -> "MapKernel":
+        """Scale every capacity axis — key, deferred, and the nested value
+        kernel's — by ``factor``.  The Map merge's overflow flag is
+        collapsed (key / deferred / nested value), so elastic recovery
+        grows the whole capacity envelope together."""
+        return dataclasses.replace(
+            self,
+            key_capacity=self.key_capacity * factor,
+            deferred_capacity=self.deferred_capacity * factor,
+            val_kernel=self.val_kernel.grown(factor),
+        )
+
+    def grow_state(self, v, target: "MapKernel"):
+        clock, keys, eclocks, vals, d_keys, d_clocks = v
+        pk = target.key_capacity - self.key_capacity
+        pd = target.deferred_capacity - self.deferred_capacity
+        if pk < 0 or pd < 0:
+            raise ValueError("grow_state cannot shrink")
+
+        def pad_axis(x, ax, pad, fill=0):
+            if pad == 0:
+                return x
+            cfg = [(0, 0)] * x.ndim
+            cfg[ax] = (0, pad)
+            return jnp.pad(x, cfg, constant_values=fill)
+
+        keys = pad_axis(keys, keys.ndim - 1, pk, EMPTY)
+        eclocks = pad_axis(eclocks, eclocks.ndim - 2, pk)
+        d_keys = pad_axis(d_keys, d_keys.ndim - 1, pd, EMPTY)
+        d_clocks = pad_axis(d_clocks, d_clocks.ndim - 2, pd)
+        # value leaves: new key slots filled with the value kernel's empty
+        # state, then the nested capacity axes grown leaf-wise
+        key_ax = keys.ndim - 1
+        if pk:
+            batch_shape = keys.shape[:-1] + (pk,)
+            empties = self.val_kernel.zeros(batch_shape)
+            vals = jax.tree.map(
+                lambda x, e: jnp.concatenate([x, e], axis=key_ax), vals, empties
+            )
+        vals = self.val_kernel.grow_state(vals, target.val_kernel)
+        return (clock, keys, eclocks, vals, d_keys, d_clocks)
 
     # -- host conversion ----------------------------------------------------
 

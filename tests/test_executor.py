@@ -378,3 +378,87 @@ def test_mvreg_with_capacity_contract():
         grown.with_capacity(2)
     with pytest.raises(ValueError, match="no deferred axis"):
         a.with_capacity(4, 2)
+
+
+# -- Map elasticity (key + deferred + NESTED value axes grow together) -------
+
+
+def _map_writer(key_vals, actor):
+    """A Map<int, MVReg> with one Put per (key, val), all by ``actor``."""
+    from crdt_tpu import Map, MVReg
+    from crdt_tpu.scalar.map import Up
+    from crdt_tpu.scalar.mvreg import Put
+    from crdt_tpu.scalar.vclock import Dot, VClock
+
+    m = Map(MVReg)
+    for c, (key, val) in enumerate(key_vals, start=1):
+        m.apply(Up(dot=Dot(actor, c), key=key,
+                   op=Put(clock=VClock({actor: c}), val=val)))
+    return m
+
+
+def test_map_key_overflow_triggers_regrowth():
+    """key_capacity 2, six distinct keys across the fleet: the executor
+    regrows the key axis and the joined map matches the scalar fold."""
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+
+    uni = Universe(CrdtConfig(num_actors=8, key_capacity=2, mv_capacity=4,
+                              deferred_capacity=2))
+    vk = MVRegKernel.from_config(uni.config)
+    maps = [
+        _map_writer([(0, 1), (1, 2)], actor=0),
+        _map_writer([(2, 3), (3, 4)], actor=1),
+        _map_writer([(4, 5), (5, 6)], actor=2),
+    ]
+    batches = [MapBatch.from_scalar([m], uni, vk) for m in maps]
+    stats = JoinStats()
+    joined = JoinExecutor().join_all(batches, plunger=False, stats=stats)
+    assert stats.overflow_regrows >= 1
+    assert stats.final_member_capacity >= 6
+
+    expected = maps[0].clone()
+    for m in maps[1:]:
+        expected.merge(m)
+    assert joined.to_scalar(uni)[0] == expected
+
+
+def test_map_nested_value_overflow_triggers_regrowth():
+    """mv_capacity 1, three concurrent writers to the SAME key: the
+    overflow is in the NESTED antichain, which only the scaled value
+    kernel can absorb — the collapsed flag must still converge."""
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+
+    uni = Universe(CrdtConfig(num_actors=8, key_capacity=4, mv_capacity=1,
+                              deferred_capacity=2))
+    vk = MVRegKernel.from_config(uni.config)
+    maps = [_map_writer([(7, 10 + actor)], actor=actor) for actor in range(3)]
+    batches = [MapBatch.from_scalar([m], uni, vk) for m in maps]
+    stats = JoinStats()
+    joined = JoinExecutor().join_all(batches, plunger=False, stats=stats)
+    assert stats.overflow_regrows >= 1
+
+    expected = maps[0].clone()
+    for m in maps[1:]:
+        expected.merge(m)
+    got = joined.to_scalar(uni)[0]
+    assert got == expected
+    # all three concurrent values survive in the nested antichain
+    assert sorted(got.entries[7].val.read().val) == [10, 11, 12]
+
+
+def test_map_with_capacity_contract():
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+
+    uni = Universe(CrdtConfig(num_actors=8, key_capacity=2, mv_capacity=2,
+                              deferred_capacity=2))
+    vk = MVRegKernel.from_config(uni.config)
+    b = MapBatch.from_scalar([_map_writer([(0, 1)], actor=0)], uni, vk)
+    grown = b.with_capacity(5, 2)
+    # factor ceil(5/2)=3: key axis 6, deferred 6, nested antichain 6
+    assert grown.member_capacity == 6 and grown.deferred_capacity == 6
+    assert grown.kernel.val_kernel.mv_capacity == 6
+    assert grown.to_scalar(uni) == b.to_scalar(uni)
+    with pytest.raises(ValueError, match="cannot shrink"):
+        grown.with_capacity(2, 2)
+    with pytest.raises(ValueError, match="kernels differ"):
+        grown.merge(b)

@@ -201,9 +201,13 @@ class MapBatch:
         self, member_capacity: int | None = None,
         deferred_capacity: int | None = None,
     ) -> "MapBatch":
-        """Pad the key/deferred axes (and the nested value axes, scaled by
-        the key-growth factor) to at least the requested capacities; never
-        shrinks."""
+        """Pad the key/deferred axes EXACTLY to the requested capacities
+        (so an executor's ``max_capacity`` bound holds for the named
+        axes); the nested value axes scale by the key-growth factor —
+        inherent overshoot the collapsed overflow flag forces, since the
+        overflow may live in a nested capacity.  Never shrinks."""
+        import dataclasses
+
         k, d = self.member_capacity, self.deferred_capacity
         new_k = k if member_capacity is None else member_capacity
         new_d = d if deferred_capacity is None else deferred_capacity
@@ -212,7 +216,12 @@ class MapBatch:
         if (new_k, new_d) == (k, d):
             return self
         factor = max(-(-new_k // k), -(-new_d // d), 1)
-        target = self.kernel.grown(factor)
+        target = dataclasses.replace(
+            self.kernel,
+            key_capacity=new_k,
+            deferred_capacity=new_d,
+            val_kernel=self.kernel.val_kernel.grown(factor),
+        )
         state = self.kernel.grow_state(self.state, target)
         return MapBatch.from_state(state, target)
 

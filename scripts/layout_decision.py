@@ -1,0 +1,164 @@
+"""Turn a captured TPU layout A/B into a committed decision report.
+
+The tunnel watcher (`scripts/tunnel_watch.sh`) runs this after its capture
+steps succeed.  It parses the A/B menu output (`RESULT <mode>: ... ms`),
+the two bench logs' JSON lines, applies the decision rule from
+`reports/ORSWOT_PROFILE.md` ("Layout candidates staged for the next tunnel
+window"), and writes `reports/LAYOUT_AB_TPU.md` with the ranked table and
+the EXACT flip to make — so a window that opens with no builder session
+attached still produces an actionable, committable analysis artifact (the
+driver commits uncommitted files at round end).
+
+The flip itself is deliberately NOT automated: a detached process must not
+edit kernel source mid-round.
+
+Usage: python scripts/layout_decision.py [experiments_log] [bench_log]
+       [lanes_bench_log]   (defaults: the watcher's /tmp paths)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the pairwise-merge contenders the decision rule ranks (everything else in
+# the menu — gathers, scatters, sort primitives — is diagnostic context)
+MERGE_MODES = ("merge_scatter", "merge_scatterless", "merge_unrolled", "merge_lanes")
+# mode -> the one-line change that makes it the TPU default
+FLIP = {
+    "merge_scatter": "no change (rank path with scatter is already the default)",
+    "merge_scatterless": (
+        "no change (scatterless is already the TPU default via "
+        "orswot_ops._scatterless_default backend dispatch)"
+    ),
+    "merge_unrolled": (
+        "crdt_tpu/ops/orswot_ops.py::_merge_impl_default — return 'unrolled' "
+        "when jax.default_backend() == 'tpu'"
+    ),
+    "merge_lanes": (
+        "crdt_tpu/ops/orswot_ops.py::_merge_impl_default — return 'lanes' "
+        "when jax.default_backend() == 'tpu'"
+    ),
+}
+
+
+def parse_results(path):
+    """``RESULT <mode>: <float> ms...`` lines -> {mode: ms | None}."""
+    out = {}
+    if not os.path.exists(path):
+        return out
+    for line in open(path, errors="replace"):
+        m = re.match(r"RESULT (\w+): ([0-9.]+) ms", line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+        else:
+            m = re.match(r"RESULT (\w+): (FAILED|TIMEOUT)", line)
+            if m:
+                out[m.group(1)] = None
+    return out
+
+
+def parse_bench(path):
+    """Last ``{"metric": ...}`` JSON line of a bench log, or None."""
+    if not os.path.exists(path):
+        return None
+    rec = None
+    for line in open(path, errors="replace"):
+        if line.startswith('{"metric"'):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    return rec
+
+
+def main():
+    args = sys.argv[1:]
+    exp_log = args[0] if len(args) > 0 else "/tmp/experiments_tpu.log"
+    bench_log = args[1] if len(args) > 1 else "/tmp/bench_tpu3.log"
+    lanes_log = args[2] if len(args) > 2 else "/tmp/bench_tpu_lanes.log"
+
+    results = parse_results(exp_log)
+    bench = parse_bench(bench_log)
+    lanes_bench = parse_bench(lanes_log)
+
+    merge_rows = [(m, results.get(m)) for m in MERGE_MODES if m in results]
+    ranked = sorted(
+        (r for r in merge_rows if r[1] is not None), key=lambda r: r[1]
+    )
+
+    lines = [
+        "# TPU layout A/B — decision report",
+        "",
+        f"Generated {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} by "
+        "`scripts/layout_decision.py` from the tunnel watcher's captures "
+        f"(`{exp_log}`).  Decision rule: `reports/ORSWOT_PROFILE.md` "
+        '"Layout candidates staged for the next tunnel window".',
+        "",
+        "## Pairwise-merge contenders (config-4 shapes)",
+        "",
+        "| mode | ms/merge |",
+        "|---|---|",
+    ]
+    for mode, ms in merge_rows:
+        lines.append(f"| {mode} | {'FAILED/TIMEOUT' if ms is None else f'{ms:.2f}'} |")
+    if ranked:
+        winner = ranked[0][0]
+        lines += [
+            "",
+            f"**Winner: `{winner}`"
+            + (
+                f" ({ranked[0][1]:.2f} ms vs runner-up {ranked[1][1]:.2f} ms)"
+                if len(ranked) > 1
+                else ""
+            )
+            + ".**",
+            "",
+            f"Flip to apply: {FLIP[winner]}",
+        ]
+    else:
+        lines += ["", "**No merge contender completed — no decision.**"]
+
+    diag = {m: v for m, v in results.items() if m not in MERGE_MODES}
+    if diag:
+        lines += ["", "## Diagnostic modes", "", "| mode | ms |", "|---|---|"]
+        for mode, ms in sorted(diag.items()):
+            lines.append(
+                f"| {mode} | {'FAILED/TIMEOUT' if ms is None else f'{ms:.2f}'} |"
+            )
+
+    lines += ["", "## North-star fold (bench captures)", ""]
+    for name, rec in (("default fold", bench), ("CRDT_LANES=1 fold", lanes_bench)):
+        if rec is None:
+            lines.append(f"* {name}: no captured JSON line")
+        else:
+            lines.append(
+                f"* {name}: {rec.get('value', '?')} {rec.get('unit', '')} on "
+                f"platform={rec.get('platform')} "
+                f"(vs_baseline {rec.get('vs_baseline')})"
+            )
+    if bench and lanes_bench and bench.get("platform") == "tpu" \
+            and lanes_bench.get("platform") == "tpu":
+        faster = "lanes" if lanes_bench["value"] > bench["value"] else "default"
+        lines.append(
+            f"* fold-layout verdict: **{faster}** is faster at north-star "
+            "scale (flip CRDT_LANES default only if lanes won here AND in "
+            "the pairwise table, per the decision rule)"
+        )
+
+    out_path = os.path.join(REPO, "reports", "LAYOUT_AB_TPU.md")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out_path}")
+    for line in lines:
+        print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,18 @@ for i in $(seq 1 600); do
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \
             python scripts/tpu_validate.py --merge
+        # distill the captures into a committable decision report (the
+        # driver commits uncommitted files at round end, so the analysis
+        # survives even if no builder session sees this window).  Only
+        # logs whose marker exists for THIS rev are fed in — a stale
+        # /tmp bench log from an older build must not color the verdict.
+        if [ -e "$MARK/experiments" ]; then
+            BLOG=/dev/null; LLOG=/dev/null
+            [ -e "$MARK/bench" ] && BLOG=/tmp/bench_tpu3.log
+            [ -e "$MARK/bench_lanes" ] && LLOG=/tmp/bench_tpu_lanes.log
+            python scripts/layout_decision.py /tmp/experiments_tpu.log \
+                "$BLOG" "$LLOG" >> /tmp/tunnel_watch.log 2>&1 || true
+        fi
         # Compiled-Pallas attempt LAST: a Mosaic crash can wedge the
         # remote compile helper for the rest of the window.  Workaround
         # env from the captured failure log (PALLAS_TPU_ATTEMPT.txt:12-14).

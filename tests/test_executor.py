@@ -454,8 +454,9 @@ def test_map_with_capacity_contract():
     vk = MVRegKernel.from_config(uni.config)
     b = MapBatch.from_scalar([_map_writer([(0, 1)], actor=0)], uni, vk)
     grown = b.with_capacity(5, 2)
-    # factor ceil(5/2)=3: key axis 6, deferred 6, nested antichain 6
-    assert grown.member_capacity == 6 and grown.deferred_capacity == 6
+    # named axes pad EXACTLY (executor max_capacity bound holds for them);
+    # nested antichain scales by the key factor ceil(5/2)=3
+    assert grown.member_capacity == 5 and grown.deferred_capacity == 2
     assert grown.kernel.val_kernel.mv_capacity == 6
     assert grown.to_scalar(uni) == b.to_scalar(uni)
     with pytest.raises(ValueError, match="cannot shrink"):

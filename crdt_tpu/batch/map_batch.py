@@ -170,9 +170,17 @@ class MapBatch:
         flag is collapsed, so elastic recovery grows the whole envelope
         via :meth:`with_capacity`)."""
         if self.kernel != other.kernel:
-            raise ValueError(
-                "MapBatch merge: kernels differ (equalize capacities first)"
+            # capacity-only mismatches (e.g. path-dependent nested growth
+            # after elastic regrows) unify to the pointwise max; genuine
+            # structural mismatches raise inside unified()
+            target = self.kernel.unified(other.kernel)
+            a = self if self.kernel == target else MapBatch.from_state(
+                self.kernel.grow_state(self.state, target), target
             )
+            b = other if other.kernel == target else MapBatch.from_state(
+                other.kernel.grow_state(other.state, target), target
+            )
+            return a.merge(b, check)
         state, overflow = _merge(self.state, other.state, self.kernel)
         if check and bool(np.any(np.asarray(overflow))):
             raise CapacityOverflowError(

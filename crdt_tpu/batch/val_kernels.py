@@ -87,6 +87,17 @@ class MVRegKernel:
         """A kernel with every capacity axis scaled by ``factor``."""
         return dataclasses.replace(self, mv_capacity=self.mv_capacity * factor)
 
+    def unified(self, other: "MVRegKernel") -> "MVRegKernel":
+        """The pointwise-max-capacity kernel covering both sides; raises on
+        a structural mismatch (different type/actors/width)."""
+        if (type(other) is not MVRegKernel
+                or other.num_actors != self.num_actors
+                or other.counter_bits != self.counter_bits):
+            raise ValueError(f"incompatible value kernels: {self} vs {other}")
+        return dataclasses.replace(
+            self, mv_capacity=max(self.mv_capacity, other.mv_capacity)
+        )
+
     def grow_state(self, v, target: "MVRegKernel"):
         """Pad value state built under ``self`` to ``target``'s shapes
         (new antichain slots are dead: empty clocks, zero payloads)."""
@@ -199,6 +210,18 @@ class OrswotKernel:
             deferred_capacity=self.deferred_capacity * factor,
         )
 
+    def unified(self, other: "OrswotKernel") -> "OrswotKernel":
+        """See :meth:`MVRegKernel.unified`."""
+        if (type(other) is not OrswotKernel
+                or other.num_actors != self.num_actors
+                or other.counter_bits != self.counter_bits):
+            raise ValueError(f"incompatible value kernels: {self} vs {other}")
+        return dataclasses.replace(
+            self,
+            member_capacity=max(self.member_capacity, other.member_capacity),
+            deferred_capacity=max(self.deferred_capacity, other.deferred_capacity),
+        )
+
     def grow_state(self, v, target: "OrswotKernel"):
         # one padding implementation for standalone AND map-nested sets:
         # OrswotBatch.with_capacity is rank-polymorphic over leading axes
@@ -301,6 +324,19 @@ class MapKernel:
             key_capacity=self.key_capacity * factor,
             deferred_capacity=self.deferred_capacity * factor,
             val_kernel=self.val_kernel.grown(factor),
+        )
+
+    def unified(self, other: "MapKernel") -> "MapKernel":
+        """Pointwise-max capacities, recursing into the value kernel."""
+        if (type(other) is not MapKernel
+                or other.num_actors != self.num_actors
+                or other.counter_bits != self.counter_bits):
+            raise ValueError(f"incompatible value kernels: {self} vs {other}")
+        return dataclasses.replace(
+            self,
+            key_capacity=max(self.key_capacity, other.key_capacity),
+            deferred_capacity=max(self.deferred_capacity, other.deferred_capacity),
+            val_kernel=self.val_kernel.unified(other.val_kernel),
         )
 
     def grow_state(self, v, target: "MapKernel"):

@@ -29,14 +29,19 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU unless the caller explicitly opts into an accelerator with
+# CRDT_EXAMPLE_PLATFORM: dev environments PRESET JAX_PLATFORMS to a
+# remote-accelerator plugin whose backend init can block indefinitely
+# when its tunnel is down, so deferring to the ambient value (setdefault)
+# would hang this walkthrough.  The config.update mirrors
+# tests/conftest.py — the env var alone is not honored once the ambient
+# plugin has registered.
+platform = os.environ.get("CRDT_EXAMPLE_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = platform
 
-# dev environments that preload a remote-accelerator plugin ignore the
-# JAX_PLATFORMS env var once jax is initialized; force it through the
-# live config exactly like tests/conftest.py does
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_platforms", platform)
 
 import numpy as np  # noqa: E402
 
@@ -142,11 +147,93 @@ def step4_collective_join(uni, fleets, expected_sets):
           "matches the batched join on every shard")
 
 
+def step5_typed_collective_joins():
+    """Every register/set type has its own mesh collective: LWW joins by
+    marker-argmax (equal-marker conflicts surface host-side,
+    `lwwreg.rs:56-66`), MVReg by antichain gather-fold (concurrent values
+    all survive, `mvreg.rs:121-153`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch import LWWRegBatch, MVRegBatch
+    from crdt_tpu.parallel import (
+        allgather_join_lww, allgather_join_mvreg, make_mesh,
+    )
+    from crdt_tpu.scalar.lwwreg import LWWReg
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    n_dev = len(jax.devices())
+    if n_dev < 8:
+        print(f"5. typed collective joins skipped ({n_dev} devices < 8)")
+        return
+    mesh = make_mesh({"replicas": 8})
+    uni = Universe(CrdtConfig(num_actors=8, mv_capacity=8))
+
+    # LWW: 8 replicas each last-wrote one register at a distinct time
+    fleet = [[LWWReg(val=f"edit-{r}", marker=100 + r)] for r in range(8)]
+    stack = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[LWWRegBatch.from_scalar(row, uni) for row in fleet],
+    )
+    joined, conflict = allgather_join_lww(stack, mesh)
+    assert not bool(jnp.any(conflict))
+    winner = LWWRegBatch(
+        vals=joined.vals[0], markers=joined.markers[0]
+    ).to_scalar(uni)[0]
+    assert winner.val == "edit-7"  # the largest marker wins everywhere
+
+    # MVReg: 8 concurrent writers — the join keeps all eight values
+    regs = []
+    for r in range(8):
+        reg = MVReg()
+        reg.apply(reg.set(f"draft-{r}", reg.read().derive_add_ctx(r)))
+        regs.append(reg)
+    stack = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[MVRegBatch.from_scalar([reg], uni) for reg in regs],
+    )
+    joined_mv = allgather_join_mvreg(stack, mesh)
+    survivors = MVRegBatch(
+        clocks=joined_mv.clocks[0], vals=joined_mv.vals[0]
+    ).to_scalar(uni)[0]
+    assert len(survivors.read().val) == 8
+    print("5. typed collective joins: LWW marker-argmax winner "
+          f"{winner.val!r}; MVReg keeps all {len(survivors.read().val)} "
+          "concurrent values")
+
+
+def step6_elastic_regrowth():
+    """Static capacities are the TPU build's one concession; the executor
+    makes them elastic — an overflowing join regrows the padded axes and
+    requeues (idempotent merge makes the retry safe)."""
+    from crdt_tpu.parallel import JoinExecutor, JoinStats
+
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=2,
+                              deferred_capacity=2))
+    fleets = []
+    for r in range(4):
+        s = Orswot()
+        for j in range(2):
+            s.apply(s.add(f"m{r}-{j}", s.value().derive_add_ctx(f"node{r}")))
+        fleets.append(OrswotBatch.from_scalar([s], uni))
+
+    stats = JoinStats()
+    joined = JoinExecutor().join_all(fleets, stats=stats)
+    sets = joined.value_sets(uni)
+    assert len(sets[0]) == 8  # union exceeded capacity 2, nothing lost
+    print(f"6. elastic regrowth: capacity 2 → "
+          f"{stats.final_member_capacity} after "
+          f"{stats.overflow_regrows} regrow(s); all {len(sets[0])} members "
+          "survived")
+
+
 def main():
     replicas = step1_op_replication()
     step2_deferred_remove(replicas)
     uni, fleets, sets = step3_batched_join()
     step4_collective_join(uni, fleets, sets)
+    step5_typed_collective_joins()
+    step6_elastic_regrowth()
     print("anti-entropy walkthrough: OK")
 
 

@@ -461,5 +461,40 @@ def test_map_with_capacity_contract():
     assert grown.to_scalar(uni) == b.to_scalar(uni)
     with pytest.raises(ValueError, match="cannot shrink"):
         grown.with_capacity(2, 2)
-    with pytest.raises(ValueError, match="kernels differ"):
-        grown.merge(b)
+    # capacity-mismatched batches unify automatically on merge
+    merged = grown.merge(b)
+    assert merged.kernel == grown.kernel
+    assert merged.to_scalar(uni) == b.to_scalar(uni)
+
+
+def test_map_merge_unifies_path_dependent_kernels():
+    """Stepwise vs one-shot regrowth compound the NESTED capacities
+    differently; merge must unify to the pointwise max, not raise —
+    the shape JoinExecutor(max_capacity=...) produces when a clamp makes
+    one side regrow in more steps than the other."""
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    uni = Universe(CrdtConfig(num_actors=8, key_capacity=2, mv_capacity=2,
+                              deferred_capacity=2))
+    vk = MVRegKernel.from_config(uni.config)
+    a = MapBatch.from_scalar([_map_writer([(0, 1)], actor=0)], uni, vk)
+    b = MapBatch.from_scalar([_map_writer([(1, 2)], actor=1)], uni, vk)
+    a2 = a.with_capacity(4, 4).with_capacity(6, 6)   # nested mv 2->4->8
+    b2 = b.with_capacity(6, 6)                       # nested mv 2->6
+    assert a2.kernel != b2.kernel
+    merged = a2.merge(b2)
+    assert merged.kernel.val_kernel.mv_capacity == 8  # pointwise max
+    want = _map_writer([(0, 1)], actor=0)
+    want.merge(_map_writer([(1, 2)], actor=1))
+    assert merged.to_scalar(uni)[0] == want
+
+    # a genuinely incompatible kernel still raises
+    other_uni = Universe(CrdtConfig(num_actors=4, key_capacity=2,
+                                    mv_capacity=2, deferred_capacity=2))
+    c = MapBatch.from_scalar(
+        [_map_writer([(0, 1)], actor=0)], other_uni,
+        MVRegKernel.from_config(other_uni.config),
+    )
+    with pytest.raises(ValueError, match="incompatible"):
+        a2.merge(c)

@@ -458,3 +458,86 @@ def test_allgather_join_gset_matches_scalar():
     for r in range(8):
         shard = GSetBatch(bits=joined.bits[r])
         assert shard.to_scalar(uni) == expected, f"replica shard {r} diverged"
+
+
+@pytest.mark.parametrize("seed", [29, 31])
+def test_allgather_join_lww_random_histories(seed):
+    """Randomized LWW fleets (distinct markers): collective join == scalar
+    N-way fold on every replica row."""
+    from crdt_tpu.batch import LWWRegBatch
+    from crdt_tpu.parallel import allgather_join_lww
+    from crdt_tpu.scalar.lwwreg import LWWReg
+
+    mesh = make_mesh({"replicas": 8})
+    uni = small_universe()
+    rng = np.random.RandomState(seed)
+    n = 10
+    markers = rng.permutation(8 * n).reshape(8, n) + 1
+    fleet = []
+    for r in range(8):
+        row = []
+        for i in range(n):
+            reg = LWWReg()
+            m = int(markers[r, i])
+            # the write plus an idempotent redelivery (equal marker, same
+            # value — a no-op, not a conflict); markers are a global
+            # permutation so there are no cross-replica ties
+            reg.update(val=m * 13, marker=m)
+            reg.update(val=m * 13, marker=m)
+            row.append(reg)
+        fleet.append(row)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[LWWRegBatch.from_scalar(row, uni) for row in fleet],
+    )
+    joined, conflict = allgather_join_lww(stacked, mesh)
+    assert not bool(jnp.any(conflict))
+    expected = []
+    for i in range(n):
+        acc = fleet[0][i].clone()
+        for r in range(1, 8):
+            acc.merge(fleet[r][i])
+        expected.append(acc)
+    for r in range(8):
+        got = LWWRegBatch(vals=joined.vals[r], markers=joined.markers[r]).to_scalar(uni)
+        assert got == expected, f"replica shard {r} diverged (seed {seed})"
+
+
+@pytest.mark.parametrize("seed", [37, 41])
+def test_allgather_join_mvreg_random_histories(seed):
+    """Randomized MVReg op histories incl. dominating overwrites: the
+    collective join keeps exactly the mutually-undominated values the
+    scalar N-way fold keeps."""
+    from crdt_tpu.batch import MVRegBatch
+    from crdt_tpu.parallel import allgather_join_mvreg
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    mesh = make_mesh({"replicas": 8})
+    uni = small_universe()
+    rng = np.random.RandomState(seed)
+    n = 6
+    fleet = []
+    for r in range(8):
+        row = []
+        for i in range(n):
+            reg = MVReg()
+            for _ in range(rng.randint(0, 4)):
+                actor = int(rng.randint(0, 8))
+                ctx = reg.read().derive_add_ctx(actor)
+                reg.apply(reg.set(int(rng.randint(0, 40)), ctx))
+            row.append(reg)
+        fleet.append(row)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[MVRegBatch.from_scalar(row, uni) for row in fleet],
+    )
+    joined = allgather_join_mvreg(stacked, mesh)
+    expected = []
+    for i in range(n):
+        acc = fleet[0][i].clone()
+        for r in range(1, 8):
+            acc.merge(fleet[r][i])
+        expected.append(acc)
+    for r in range(8):
+        got = MVRegBatch(clocks=joined.clocks[r], vals=joined.vals[r]).to_scalar(uni)
+        assert got == expected, f"replica shard {r} diverged (seed {seed})"

@@ -31,10 +31,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MERGE_MODES = ("merge_scatter", "merge_scatterless", "merge_unrolled", "merge_lanes")
 # mode -> the one-line change that makes it the TPU default
 FLIP = {
-    "merge_scatter": "no change (rank path with scatter is already the default)",
+    "merge_scatter": (
+        "crdt_tpu/ops/orswot_ops.py::_scatterless_default — return False "
+        "(one-hot sum is the default everywhere since the r3 CPU A/B)"
+    ),
     "merge_scatterless": (
-        "no change (scatterless is already the TPU default via "
-        "orswot_ops._scatterless_default backend dispatch)"
+        "no change (one-hot sum is already the default on every backend "
+        "via orswot_ops._scatterless_default)"
     ),
     "merge_unrolled": (
         "crdt_tpu/ops/orswot_ops.py::_merge_impl_default — return 'unrolled' "

@@ -24,8 +24,9 @@ Narrow-table merges dispatch on ``lax.cond(any deferred row exists)``:
 the deferred-free fast path decides each slot's survival with
 OR-reductions over the actor axis, rank-selects the winning ``m_cap``
 member ids with a counting-rank sort (``_stable_order`` — O(S²) bool
-compares + one scatter, far cheaper than a comparison sort at slot counts
-≤ 128), and computes the dot algebra only for the selected slots; the
+compares + a one-hot-sum inversion, far cheaper than a comparison sort at
+slot counts ≤ 128), and computes the dot algebra only for the selected
+slots; the
 2M-wide merged table of the classic pipeline is never materialized.
 Deferred-bearing batches take the full-width pipeline with dedup + replay.
 See `reports/ORSWOT_PROFILE.md` for the measured effect (5.9× on the

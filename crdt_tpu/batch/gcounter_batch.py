@@ -14,6 +14,7 @@ from flax import struct
 from ..ops import clock_ops, counter_ops
 from ..scalar.gcounter import GCounter
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 from ..config import counter_dtype
 from .vclock_batch import VClockBatch
 
@@ -30,10 +31,12 @@ class GCounterBatch:
         ))
 
     @classmethod
+    @gc_paused
     def from_scalar(cls, states: Sequence[GCounter], universe: Universe) -> "GCounterBatch":
         inner = VClockBatch.from_scalar([g.inner for g in states], universe)
         return cls(clocks=inner.clocks)
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[GCounter]:
         return [GCounter(vc) for vc in VClockBatch(clocks=self.clocks).to_scalar(universe)]
 

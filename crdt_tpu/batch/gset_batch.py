@@ -16,6 +16,7 @@ from flax import struct
 
 from ..scalar.gset import GSet
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 
 
 @struct.dataclass
@@ -27,6 +28,7 @@ class GSetBatch:
         return cls(bits=jnp.zeros((n, member_capacity), dtype=bool))
 
     @classmethod
+    @gc_paused
     def from_scalar(
         cls, states: Sequence[GSet], universe: Universe, member_capacity: int
     ) -> "GSetBatch":
@@ -43,6 +45,7 @@ class GSetBatch:
                 buf[i, mid] = True
         return cls(bits=jnp.asarray(buf))
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[GSet]:
         import numpy as np
 

@@ -21,6 +21,7 @@ from ..error import ConflictingMarker
 from ..ops import lww_ops
 from ..scalar.lwwreg import LWWReg
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 
 
 @struct.dataclass
@@ -29,6 +30,7 @@ class LWWRegBatch:
     markers: jax.Array  # u64[N]
 
     @classmethod
+    @gc_paused
     def from_scalar(cls, states: Sequence[LWWReg], universe: Universe) -> "LWWRegBatch":
         import numpy as np
 
@@ -40,6 +42,7 @@ class LWWRegBatch:
         markers = np.asarray([s.marker for s in states], dtype=dt)
         return cls(vals=jnp.asarray(vals), markers=jnp.asarray(markers))
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[LWWReg]:
         import numpy as np
 

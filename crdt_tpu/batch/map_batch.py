@@ -30,6 +30,7 @@ from ..ops.orswot_ops import EMPTY
 from ..scalar.map import Entry, Map
 from ..scalar.vclock import VClock
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 from .val_kernels import MapKernel
 from .vclock_batch import row_to_vclock
 
@@ -79,6 +80,7 @@ class MapBatch:
         return cls.from_state(kernel.zeros((n,)), kernel)
 
     @classmethod
+    @gc_paused
     def from_scalar(
         cls, states: Sequence[Map], universe: Universe, val_kernel
     ) -> "MapBatch":
@@ -129,6 +131,7 @@ class MapBatch:
             kernel=kernel,
         )
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[Map]:
         kernel = self.kernel
         vk = kernel.val_kernel

@@ -20,6 +20,7 @@ from ..error import CapacityOverflowError
 from ..ops import clock_ops, mvreg_ops
 from ..scalar.mvreg import MVReg
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 from .vclock_batch import VClockBatch
 
 
@@ -37,6 +38,7 @@ class MVRegBatch:
         )
 
     @classmethod
+    @gc_paused
     def from_scalar(cls, states: Sequence[MVReg], universe: Universe) -> "MVRegBatch":
         import numpy as np
 
@@ -54,6 +56,7 @@ class MVRegBatch:
                 vals[i, j] = universe.member_id(val)
         return cls(clocks=jnp.asarray(clocks), vals=jnp.asarray(vals))
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[MVReg]:
         import numpy as np
 

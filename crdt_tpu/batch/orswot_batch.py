@@ -22,6 +22,7 @@ from ..ops import orswot_ops
 from ..scalar.orswot import Orswot
 from ..scalar.vclock import VClock
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 from .vclock_batch import VClockBatch
 
 
@@ -55,6 +56,7 @@ class OrswotBatch:
         return cls(*(jnp.asarray(x) for x in _np_planes(n, universe.config)))
 
     @classmethod
+    @gc_paused
     def from_scalar(cls, states: Sequence[Orswot], universe: Universe) -> "OrswotBatch":
         """Bulk ingest: one Python pass per object collects the flat COO
         value columns with C-level ``list.extend(map(...))`` loops — never
@@ -302,6 +304,7 @@ class OrswotBatch:
             (ho, hr, ha, d_clocks[ho, hr, ha]),
         )
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[Orswot]:
         """Bulk egress: ``np.nonzero`` extracts every populated cell in
         four vectorized passes; the Python loop only walks actual dots

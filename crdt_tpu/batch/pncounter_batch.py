@@ -15,6 +15,7 @@ from flax import struct
 from ..ops import clock_ops, counter_ops
 from ..scalar.pncounter import PNCounter
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 from ..config import counter_dtype
 from .vclock_batch import VClockBatch
 
@@ -31,11 +32,13 @@ class PNCounterBatch:
         ))
 
     @classmethod
+    @gc_paused
     def from_scalar(cls, states: Sequence[PNCounter], universe: Universe) -> "PNCounterBatch":
         p = VClockBatch.from_scalar([s.p.inner for s in states], universe)
         n = VClockBatch.from_scalar([s.n.inner for s in states], universe)
         return cls(planes=jnp.stack([p.clocks, n.clocks], axis=1))
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[PNCounter]:
         from ..scalar.gcounter import GCounter
 

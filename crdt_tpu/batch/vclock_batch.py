@@ -16,6 +16,7 @@ from ..config import counter_dtype
 from ..ops import clock_ops
 from ..scalar.vclock import VClock
 from ..utils.interning import Universe
+from ..utils.hostmem import gc_paused
 
 
 def row_to_vclock(row, universe: Universe) -> VClock:
@@ -45,6 +46,7 @@ class VClockBatch:
         ))
 
     @classmethod
+    @gc_paused
     def from_scalar(cls, states: Sequence[VClock], universe: Universe) -> "VClockBatch":
         import numpy as np
 
@@ -55,6 +57,7 @@ class VClockBatch:
                 buf[i, universe.actor_idx(actor)] = counter
         return cls(clocks=jnp.asarray(buf))
 
+    @gc_paused
     def to_scalar(self, universe: Universe) -> list[VClock]:
         import numpy as np
 

@@ -646,6 +646,10 @@ def bench_bulk_ingest():
         f"ingest  from_scalar {n} objects: {t_in:.1f}s ({n/t_in/1e3:.0f}k obj/s)  "
         f"to_scalar: {t_out:.1f}s ({n/t_out/1e3:.0f}k obj/s)"
     )
+    return {
+        "ingest_obj_per_sec": round(n / t_in, 1),
+        "egress_obj_per_sec": round(n / t_out, 1),
+    }
 
 
 def bench_tpu_validation():
@@ -807,8 +811,8 @@ def main():
     log(f"backend: {jax.default_backend()}  devices: {len(jax.devices())}  small={SMALL}")
     parity_anchor()
     bench_clock_merges()
-    bench_orswot_pairwise()
-    bench_bulk_ingest()
+    rate4 = bench_orswot_pairwise()
+    ingest = bench_bulk_ingest()
     # north star BEFORE the Pallas validation attempt: a Mosaic compile
     # crash can take the tunnel's remote-compile helper down with it,
     # which must not be able to cost us the headline metric
@@ -828,6 +832,8 @@ def main():
                 "distinct_objects": resident["distinct_replica_objects"],
                 "e2e_s": resident["e2e_s"],
                 "resident_merges_per_sec": resident["resident_merges_per_sec"],
+                "config4_merges_per_sec": round(rate4, 1),
+                **ingest,
                 **elision,
             }
         )

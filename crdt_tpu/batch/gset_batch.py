@@ -54,9 +54,48 @@ class GSetBatch:
             out.append(GSet({universe.members.lookup(int(i)) for i in np.nonzero(row)[0]}))
         return out
 
-    def merge(self, other: "GSetBatch") -> "GSetBatch":
-        """Union (`gset.rs:30-34`)."""
+    def merge(self, other: "GSetBatch", check: bool = True) -> "GSetBatch":
+        """Union (`gset.rs:30-34`).  Sides of different bitmap widths are
+        first grown to the wider one (union over the missing columns is a
+        no-op, so widening is state-neutral).  ``check`` is accepted for
+        the executor's uniform merge signature; a same-width union cannot
+        overflow, so there is nothing to check."""
+        wa, wb = self.bits.shape[-1], other.bits.shape[-1]
+        if wa != wb:
+            w = max(wa, wb)
+            return GSetBatch(bits=_merge(
+                self.with_capacity(w).bits, other.with_capacity(w).bits
+            ))
         return GSetBatch(bits=_merge(self.bits, other.bits))
+
+    # -- elastic-capacity protocol (crdt_tpu.parallel.JoinExecutor) ----------
+    # The bitmap width is the one growable axis (the member-universe bound
+    # _check_ids enforces); merge itself can never overflow — same-width
+    # OR — so growth happens ahead of inserts of newly-interned members.
+
+    @property
+    def member_capacity(self) -> int:
+        return self.bits.shape[-1]
+
+    @property
+    def deferred_capacity(self) -> int:
+        return 0
+
+    def with_capacity(
+        self, member_capacity: int | None = None,
+        deferred_capacity: int | None = None,
+    ) -> "GSetBatch":
+        """Widen the membership bitmap (new columns start absent)."""
+        if deferred_capacity:
+            raise ValueError("GSetBatch has no deferred axis to grow")
+        w = self.bits.shape[-1]
+        new_w = w if member_capacity is None else member_capacity
+        if new_w < w:
+            raise ValueError("with_capacity cannot shrink (would drop members)")
+        if new_w == w:
+            return self
+        pad = [(0, 0)] * (self.bits.ndim - 1) + [(0, new_w - w)]
+        return GSetBatch(bits=jnp.pad(self.bits, pad))
 
     def _check_ids(self, member_ids):
         """The member registry is unbounded; the bitmap is not.  Reject ids

@@ -50,3 +50,41 @@ def test_prop_merge_associative(xs, ys, zs):
     right = a.clone()
     right.merge(bc)
     assert left == right
+
+
+def test_bitmap_widens_for_new_members():
+    """The bitmap's member-universe bound grows like the other types'
+    capacities: widen, insert a member past the old bound, merge with a
+    narrower batch (auto-widened — union over missing columns is a
+    no-op)."""
+    import numpy as np
+
+    from crdt_tpu.batch import GSetBatch
+    from crdt_tpu.utils.interning import Universe
+
+    uni = Universe()
+    import pytest
+
+    a = GSetBatch.from_scalar([GSet({"x"})], uni, member_capacity=2)
+    assert a.member_capacity == 2 and a.deferred_capacity == 0
+    with pytest.raises(ValueError, match="bitmap capacity"):
+        a.insert(np.array([5]))
+    grown = a.with_capacity(8)
+    yid = uni.members.intern("y")  # a real interned member past the old bound
+    assert yid >= 1
+    grown = grown.insert(np.array([yid]))
+    merged = grown.merge(a)  # narrower side auto-widens
+    assert merged.member_capacity == 8
+    assert bool(merged.contains(np.array([yid]))[0])
+    back = merged.to_scalar(uni)[0]
+    assert back.contains("x") and back.contains("y")
+
+    # the executor's uniform merge path accepts GSet fleets
+    from crdt_tpu.parallel import JoinExecutor
+
+    joined = JoinExecutor(strategy="sequential").join_all(
+        [grown, a], plunger=False
+    )
+    assert joined.to_scalar(uni)[0] == back
+    with pytest.raises(ValueError, match="cannot shrink"):
+        grown.with_capacity(2)

@@ -433,14 +433,16 @@ def bench_north_star():
 
 
 def bench_north_star_resident():
-    """The north star as a REAL resident fleet (VERDICT r2 weak #4): 10M
-    DISTINCT replica-objects — no template recycling — generated as
+    """The north star over a REAL distinct fleet (VERDICT r2 weak #4):
+    10M DISTINCT replica-objects — no template recycling — generated as
     compact columns on the host (~200x smaller than dense state), shipped
     to the device, expanded to dense planes THERE (`build_fleet_planes`
     under jit — the ingest is genuinely paid and timed), folded chunk by
-    chunk, every converged chunk kept device-resident, one digest fetch
-    forcing full completion.  Reports end-to-end seconds including
-    generation + ingest + fold.
+    chunk with every chunk's state device-resident through its whole
+    ingest+build+fold (no host round-trips; converged outputs are
+    consumed into a digest rather than accumulated — see the in-loop
+    note), one digest fetch forcing full completion.  Reports end-to-end
+    seconds including generation + ingest + fold.
 
     Parity is asserted on the warmup chunk before anything is timed."""
     import functools
@@ -494,13 +496,17 @@ def bench_north_star_resident():
         lambda stack: fold_digest(tuple(x for x in stack))[0],
     )
 
-    resident = []
+    # each chunk's state is device-resident through its entire
+    # ingest+build+fold (no host round-trips; the digest consumes the
+    # converged output).  The outputs themselves are NOT accumulated:
+    # retaining 20 converged chunks (~7 GB) on a 16 GB chip alongside the
+    # build/fold transients risks an OOM and adds nothing the digest
+    # doesn't already force.
     t0 = time.perf_counter()
     digest = jnp.uint32(0)
     for c in range(n_chunks):
         planes = build(jax.device_put(chunk_cols(c)))
-        out, dg = fold_digest(planes)
-        resident.append(out)  # converged chunk stays on device
+        _out, dg = fold_digest(planes)
         digest = digest ^ dg
     final = int(np.asarray(digest))  # one fetch forces every chunk
     e2e = time.perf_counter() - t0

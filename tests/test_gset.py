@@ -70,8 +70,11 @@ def test_bitmap_widens_for_new_members():
     with pytest.raises(ValueError, match="bitmap capacity"):
         a.insert(np.array([5]))
     grown = a.with_capacity(8)
-    yid = uni.members.intern("y")  # a real interned member past the old bound
-    assert yid >= 1
+    # intern filler members so the next id truly lands past the old bound
+    while uni.members.intern(f"fill{len(uni.members)}") < 2:
+        pass
+    yid = uni.members.intern("y")
+    assert yid >= 2  # past the original capacity-2 bitmap
     grown = grown.insert(np.array([yid]))
     merged = grown.merge(a)  # narrower side auto-widens
     assert merged.member_capacity == 8

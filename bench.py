@@ -344,7 +344,15 @@ def bench_north_star():
                 log(f"north★ scan attempt {attempt + 1} failed: {str(e)[:200]}")
                 if attempt == 0:
                     time.sleep(20)
-        if t is not None and os.environ.get("CRDT_SKIP_ELISION_CHECK") != "1":
+        run_stepped_path = os.environ.get("CRDT_SKIP_ELISION_CHECK") != "1" or (
+            # the stepped path is also the scan-outage fallback: its
+            # per-step dispatches chain asynchronously through a
+            # device-value salt, so the tunnel's ~65 ms round-trip is
+            # paid once at the final fetch instead of per chunk (the
+            # last-resort host loop below pays it ~every chunk)
+            t is None
+        )
+        if run_stepped_path:
             # Work-elision check (VERDICT r2 weak #4): replay the exact
             # salt chain as per-step host dispatches — a separately
             # compiled program XLA cannot hoist across — and demand
@@ -377,7 +385,7 @@ def bench_north_star():
                 t0r = time.perf_counter()
                 out_r = run_stepped()
                 t_replay = max(time.perf_counter() - t0r - sync_s, 1e-9)
-                same = all(
+                same = scan_out is None or all(
                     bool(jnp.array_equal(x, y)) for x, y in zip(scan_out, out_r)
                 )
             except Exception as e:
@@ -387,27 +395,42 @@ def bench_north_star():
                 assert same, (
                     "north★ elision check FAILED: scan output != per-step replay"
                 )
-                log(
-                    f"north★ elision check: scan == per-step replay (bit-equal); "
-                    f"scan {t:.2f}s vs replay {t_replay:.2f}s"
-                )
-                elision = {"elision_check": "bit_equal",
-                           "scan_s": round(t, 2),
-                           "stepped_s": round(t_replay, 2)}
-                # The replay is not just a check — it is the second timing
-                # path: per-step dispatches chain ASYNCHRONOUSLY (the salt
-                # argument is a device value, so the host never syncs
-                # mid-chain; the tunnel's ~65 ms round-trip is paid once at
-                # the final fetch), and measured 20-30% FASTER than the
-                # lax.scan on CPU — XLA's while-loop materializes the
-                # carried state tuple each iteration, overhead the
-                # straight-line per-step executions don't pay.  The
-                # headline takes whichever path the backend runs faster.
-                if t_replay < t:
-                    elision["timing_path"] = "stepped"
+                if scan_out is None:
+                    # scan never compiled: no hoisting question to answer
+                    # (each sf dispatch is a separately compiled program
+                    # XLA cannot elide across), but the stepped chain is
+                    # still a sync-free timing path
+                    log(
+                        f"north★ stepped timing (scan unavailable): "
+                        f"{t_replay:.2f}s"
+                    )
+                    elision = {"elision_check": "scan_unavailable",
+                               "stepped_s": round(t_replay, 2),
+                               "timing_path": "stepped"}
                     t = t_replay
                 else:
-                    elision["timing_path"] = "scan"
+                    log(
+                        f"north★ elision check: scan == per-step replay "
+                        f"(bit-equal); scan {t:.2f}s vs replay {t_replay:.2f}s"
+                    )
+                    elision = {"elision_check": "bit_equal",
+                               "scan_s": round(t, 2),
+                               "stepped_s": round(t_replay, 2)}
+                    # The replay is not just a check — it is the second
+                    # timing path: per-step dispatches chain ASYNCHRONOUSLY
+                    # (the salt argument is a device value, so the host
+                    # never syncs mid-chain; the tunnel's ~65 ms round-trip
+                    # is paid once at the final fetch), and measured 20-30%
+                    # FASTER than the lax.scan on CPU — XLA's while-loop
+                    # materializes the carried state tuple each iteration,
+                    # overhead the straight-line per-step executions don't
+                    # pay.  The headline takes whichever path the backend
+                    # runs faster.
+                    if t_replay < t:
+                        elision["timing_path"] = "stepped"
+                        t = t_replay
+                    else:
+                        elision["timing_path"] = "scan"
         if t is None:
             # last resort: per-chunk host loop (pays the tunnel sync per
             # chunk — slower but never a crashed bench)

@@ -43,6 +43,171 @@ def _np_planes(n, cfg):
     )
 
 
+def _next_pow2(c: int) -> int:
+    return 1 if c <= 0 else 1 << (c - 1).bit_length()
+
+
+def _on_accelerator(x) -> bool:
+    try:
+        return any(dev.platform != "cpu" for dev in x.devices())
+    except Exception:
+        return False
+
+
+@jax.jit
+def _device_nnz(clock, ids, dots, d_ids, d_clocks):
+    """Populated-cell counts for the five planes, as one tiny fetch."""
+    return jnp.stack(
+        [
+            jnp.count_nonzero(clock),
+            jnp.sum(ids != orswot_ops.EMPTY),
+            jnp.count_nonzero(dots),
+            jnp.sum(d_ids != orswot_ops.EMPTY),
+            jnp.count_nonzero(d_clocks),
+        ]
+    ).astype(jnp.int64)
+
+
+@functools.partial(jax.jit, static_argnames=("sizes", "with_entries"))
+def _device_compact(clock, ids, dots, d_ids, d_clocks, sizes,
+                    with_entries=True):
+    """Size-bounded sparsification ON DEVICE: only compact coordinate
+    columns ever cross the host boundary (the axon tunnel moves dense
+    planes at ~10 MB/s, so dense `np.asarray` egress costs minutes at 1M
+    objects — `reports/INGEST_PROFILE.md`).  ``jnp.nonzero(size=k)``
+    keeps numpy's row-major cell order (objects ascending, slots within),
+    which the scalar reconstruction relies on; padding rows land at the
+    END of each column and the caller trims them with the exact counts
+    from :func:`_device_nnz`.  Indices are narrowed to int32 (N ≤ 2^31)
+    to halve transfer bytes."""
+    kc, ke, kd, kq, kh = sizes
+    i32 = lambda *xs: tuple(x.astype(jnp.int32) for x in xs)  # noqa: E731
+    co, ca = jnp.nonzero(clock, size=kc, fill_value=0)
+    if with_entries:
+        eo, es = jnp.nonzero(ids != orswot_ops.EMPTY, size=ke, fill_value=0)
+        entries = i32(eo, es) + (ids[eo, es],)
+    else:
+        # `to_coo` reconstructs member ids from the dot bundle; skipping
+        # the entry pass saves both the device nonzero and its transfer
+        z = jnp.zeros((0,), jnp.int32)
+        entries = (z, z, jnp.zeros((0,), ids.dtype))
+    do, ds, da = jnp.nonzero(dots, size=kd, fill_value=0)
+    qo, qr = jnp.nonzero(d_ids != orswot_ops.EMPTY, size=kq, fill_value=0)
+    ho, hr, ha = jnp.nonzero(d_clocks, size=kh, fill_value=0)
+    return (
+        i32(co, ca) + (clock[co, ca],),
+        entries,
+        i32(do, ds) + (ids[do, ds], da.astype(jnp.int32), dots[do, ds, da]),
+        i32(qo, qr) + (d_ids[qo, qr],),
+        i32(ho, hr, ha) + (d_clocks[ho, hr, ha],),
+    )
+
+
+def _pad_cols(cols, k, id_fill=False):
+    """Right-pad coordinate columns to length ``k`` with scatter-neutral
+    rows: coordinate 0 everywhere, value 0 (counters) or EMPTY (id
+    planes) — both are identities for the ``max`` scatter the expander
+    uses, so padding never perturbs the planes while keeping the jit
+    cache keyed on power-of-two sizes only."""
+    import numpy as np
+
+    out = []
+    for j, c in enumerate(cols):
+        is_val = j == len(cols) - 1
+        # coordinate columns must be integer indexers on device; callers
+        # may pass Python lists or empty arrays (np.asarray([]) is
+        # float64).  Value columns arrive pre-cast to their plane dtype.
+        c = np.asarray(c) if is_val else np.asarray(c, dtype=np.int32)
+        fill = orswot_ops.EMPTY if (is_val and id_fill) else 0
+        pad = np.full(k - c.shape[0], fill, dtype=c.dtype)
+        out.append(np.concatenate([c, pad]) if k > c.shape[0] else c)
+    return tuple(out)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "a", "m", "d"))
+def _device_expand(cells, n, a, m, d):
+    """Inverse of :func:`_device_compact`: max-scatter compact columns
+    into dense planes ON DEVICE, so ingest ships columns (~200× smaller
+    than dense state at reference-shaped sparsity) instead of dense
+    planes through the tunnel.  ``max`` is the right scatter everywhere:
+    counter cells join by the lattice rule, and id planes start at
+    EMPTY = -1 with real ids ≥ 0 written at most once per slot (host-side
+    validation), so ``max`` equals assignment while padding rows
+    (value EMPTY) are no-ops."""
+    (co, ca, cc), (eo, es, em), (do, ds, da, dc), (qo, qr, qm), (ho, hr, ha, hc) = cells
+    dt = cc.dtype
+    return (
+        jnp.zeros((n, a), dt).at[co, ca].max(cc),
+        jnp.full((n, m), orswot_ops.EMPTY, jnp.int32).at[eo, es].max(em.astype(jnp.int32)),
+        jnp.zeros((n, m, a), dt).at[do, ds, da].max(dc),
+        jnp.full((n, d), orswot_ops.EMPTY, jnp.int32).at[qo, qr].max(qm.astype(jnp.int32)),
+        jnp.zeros((n, d, a), dt).at[ho, hr, ha].max(hc),
+    )
+
+
+def _build_planes(n, cfg, clock_cells, entry_cells, dot_cells, dref_cells,
+                  dclk_cells, via_device=None, join_counters=False):
+    """Shared ingest tail: scatter validated coordinate groups into the
+    five dense planes.  ``via_device=True`` pads the columns to
+    power-of-two lengths and max-scatters ON DEVICE
+    (:func:`_device_expand`) so only compact columns cross the tunnel;
+    the host path is the original vectorized numpy scatter —
+    plain assignment when the caller guarantees unique coordinates
+    (``join_counters=False``; ``np.ufunc.at`` is far slower), lattice
+    ``np.maximum.at`` when duplicates must join by max.  Callers must
+    pass value columns already cast to their plane dtype (counter dtype
+    / int32 ids) — padding derives its dtype from the column."""
+    import numpy as np
+
+    if via_device is None:
+        via_device = jax.default_backend() != "cpu"
+    a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
+
+    if via_device:
+        # device scatter-max joins duplicates either way, matching both
+        # callers (unique coords are a special case of max-join)
+        padded = tuple(
+            _pad_cols(
+                tuple(np.ascontiguousarray(np.asarray(c)) for c in cols),
+                _next_pow2(np.asarray(cols[0]).shape[0]),
+                id_fill=id_fill,
+            )
+            for cols, id_fill in (
+                (clock_cells, False),
+                (entry_cells, True),
+                (dot_cells, False),
+                (dref_cells, True),
+                (dclk_cells, False),
+            )
+        )
+        return _device_expand(padded, n=n, a=a, m=m, d=d)
+
+    clock, ids, dots, d_ids, d_clocks = _np_planes(n, cfg)
+
+    def scatter(plane, idx, vals):
+        if join_counters:
+            np.maximum.at(plane, idx, vals)
+        else:
+            plane[idx] = vals
+
+    co, ca, cc = (np.asarray(x) for x in clock_cells)
+    if co.size:
+        scatter(clock, (co, ca), cc)
+    eo, es, em = (np.asarray(x) for x in entry_cells)
+    if eo.size:
+        ids[eo, es] = em
+    do, ds, da, dc = (np.asarray(x) for x in dot_cells)
+    if do.size:
+        scatter(dots, (do, ds, da), dc)
+    qo, qr, qm = (np.asarray(x) for x in dref_cells)
+    if qo.size:
+        d_ids[qo, qr] = qm
+    ho, hr, ha, hc = (np.asarray(x) for x in dclk_cells)
+    if ho.size:
+        scatter(d_clocks, (ho, hr, ha), hc)
+    return tuple(jnp.asarray(x) for x in (clock, ids, dots, d_ids, d_clocks))
+
+
 @struct.dataclass
 class OrswotBatch:
     clock: jax.Array  # u64[N, A]
@@ -57,16 +222,20 @@ class OrswotBatch:
 
     @classmethod
     @gc_paused
-    def from_scalar(cls, states: Sequence[Orswot], universe: Universe) -> "OrswotBatch":
+    def from_scalar(
+        cls, states: Sequence[Orswot], universe: Universe,
+        via_device: bool | None = None,
+    ) -> "OrswotBatch":
         """Bulk ingest: one Python pass per object collects the flat COO
         value columns with C-level ``list.extend(map(...))`` loops — never
         a per-dot Python append — plus per-object/per-entry *counts*; the
         (object, slot) coordinate columns are then synthesized in bulk
-        with ``np.repeat``/``np.arange`` and four vectorized scatters
-        build the dense tables.  The per-dot Python bytecode of the
-        append-based walk is what bounded ingest at ~30k obj/s at 1M
-        scale (``bench.py`` ``ingest`` line); this path keeps the
-        unavoidable O(total dots) work in C."""
+        with ``np.repeat``/``np.arange`` and the scatters build the dense
+        tables — on device when the backend is an accelerator, so only
+        compact columns cross the tunnel (:func:`_build_planes`).  The
+        per-dot Python bytecode of the append-based walk is what bounded
+        ingest at ~30k obj/s at 1M scale (``bench.py`` ``ingest`` line);
+        this path keeps the unavoidable O(total dots) work in C."""
         import numpy as np
 
         cfg = universe.config
@@ -130,45 +299,55 @@ class OrswotBatch:
             starts = np.repeat(np.cumsum(counts) - counts, counts)
             return obj, np.arange(obj.shape[0]) - starts
 
-        clock, ids, dots, d_ids, d_clocks = _np_planes(n, cfg)
+        ei = np.zeros(0, dtype=np.int64)
+        ev = np.zeros(0, dtype=dt)
+        em32 = np.zeros(0, dtype=np.int32)
+        clock_cells = (ei, ei, ev)
+        entry_cells = (ei, ei, em32)
+        dot_cells = (ei, ei, ei, ev)
+        dref_cells = (ei, ei, em32)
+        dclk_cells = (ei, ei, ei, ev)
         if ca:
             co = np.repeat(np.arange(n), c_counts)
-            clock[co, np.asarray(ca)] = np.asarray(cc, dtype=dt)
+            clock_cells = (co, np.asarray(ca), np.asarray(cc, dtype=dt))
         if em:
             eo, es = _obj_slot(e_counts)
-            ids[eo, es] = np.asarray(em, dtype=np.int32)
+            entry_cells = (eo, es, np.asarray(em, dtype=np.int32))
             if ga:
                 g_counts_arr = np.asarray(g_counts)
                 go = np.repeat(eo, g_counts_arr)
                 gs = np.repeat(es, g_counts_arr)
-                dots[go, gs, np.asarray(ga)] = np.asarray(gc, dtype=dt)
+                dot_cells = (go, gs, np.asarray(ga), np.asarray(gc, dtype=dt))
         if qm:
             qo, qs = _obj_slot(q_counts)
-            d_ids[qo, qs] = np.asarray(qm, dtype=np.int32)
+            dref_cells = (qo, qs, np.asarray(qm, dtype=np.int32))
             if ha:
                 h_counts_arr = np.asarray(h_counts)
                 ho = np.repeat(qo, h_counts_arr)
                 hs = np.repeat(qs, h_counts_arr)
-                d_clocks[ho, hs, np.asarray(ha)] = np.asarray(hc, dtype=dt)
+                dclk_cells = (ho, hs, np.asarray(ha), np.asarray(hc, dtype=dt))
 
         return cls(
-            clock=jnp.asarray(clock),
-            ids=jnp.asarray(ids),
-            dots=jnp.asarray(dots),
-            d_ids=jnp.asarray(d_ids),
-            d_clocks=jnp.asarray(d_clocks),
+            *_build_planes(
+                n, cfg, clock_cells, entry_cells, dot_cells, dref_cells,
+                dclk_cells, via_device=via_device,
+            )
         )
 
     @classmethod
     def from_coo(
         cls, n: int, universe: Universe, *,
         clock_coords, dot_coords, deferred_members=None, deferred_coords=None,
+        via_device: bool | None = None,
     ) -> "OrswotBatch":
         """Columnar bulk ingest — build ``n`` dense states straight from
         COO coordinate arrays, without materializing any scalar objects
         (the per-object Python walk is what bounds :meth:`from_scalar` at
-        ~130k obj/s — ``reports/INGEST_PROFILE.md``; this path is pure
-        numpy scatters).
+        ~130k obj/s — ``reports/INGEST_PROFILE.md``).  Validation and
+        slot assignment stay host-side on the compact columns; the dense
+        scatter runs on device on accelerator backends
+        (:func:`_build_planes`), so dense planes never transit the
+        tunnel.
 
         * ``clock_coords`` — ``(obj, actor_idx, counter)`` arrays for the
           set clocks.
@@ -199,11 +378,16 @@ class OrswotBatch:
         cfg = universe.config
         m, d = cfg.member_capacity, cfg.deferred_capacity
         dt = counter_dtype(cfg)
-        clock, ids, dots, d_ids, d_clocks = _np_planes(n, cfg)
+        ei = np.zeros(0, dtype=np.int64)
+        ev = np.zeros(0, dtype=dt)
+        em32 = np.zeros(0, dtype=np.int32)
+        entry_cells = (ei, ei, em32)
+        dot_cells = (ei, ei, ei, ev)
+        dref_cells = (ei, ei, em32)
+        dclk_cells = (ei, ei, ei, ev)
 
         co, ca, cc = (np.asarray(x) for x in clock_coords)
-        if co.size:
-            np.maximum.at(clock, (co, ca), cc.astype(dt))
+        clock_cells = (co, ca, cc.astype(dt))
 
         do, dm, da, dc = (np.asarray(x) for x in dot_coords)
         if do.size:
@@ -227,8 +411,8 @@ class OrswotBatch:
                 raise ValueError(
                     f"object {bad}: {int(counts[bad])} members > member_capacity {m}"
                 )
-            ids[uo, slot] = um
-            np.maximum.at(dots, (do, slot[inv], da), dc.astype(dt))
+            entry_cells = (uo, slot, um)
+            dot_cells = (do, slot[inv], da, dc.astype(dt))
 
         if (deferred_members is None) != (deferred_coords is None):
             raise ValueError(
@@ -267,59 +451,97 @@ class OrswotBatch:
                         f"(obj={int(sk[i]) // d}, row={int(sk[i]) % d}): "
                         f"member ids {int(sm[i])} and {int(sm[i + 1])}"
                     )
-                d_ids[qo, qr] = qm.astype(np.int32)
+                dref_cells = (qo, qr, qm.astype(np.int32))
             ho, hr, ha, hc = (np.asarray(x) for x in deferred_coords)
             _check_rows(hr, "deferred_coords")
             if ho.size:
-                np.maximum.at(d_clocks, (ho, hr, ha), hc.astype(dt))
+                dclk_cells = (ho, hr, ha, hc.astype(dt))
 
         return cls(
-            clock=jnp.asarray(clock), ids=jnp.asarray(ids),
-            dots=jnp.asarray(dots), d_ids=jnp.asarray(d_ids),
-            d_clocks=jnp.asarray(d_clocks),
+            *_build_planes(
+                n, cfg, clock_cells, entry_cells, dot_cells, dref_cells,
+                dclk_cells, via_device=via_device, join_counters=True,
+            )
         )
 
-    def to_coo(self):
-        """Columnar bulk egress — the inverse of :meth:`from_coo`: four
-        coordinate-array tuples extracted with ``np.nonzero`` (no Python
-        objects; pair with :meth:`from_coo` for checkpoint-scale export
-        of live fleets).  Returns ``(clock_coords, dot_coords,
-        deferred_members, deferred_coords)``."""
+    def _cells(self, via_device: bool | None = None, want_entries: bool = True):
+        """The five populated-cell coordinate bundles — clock, entry ids,
+        entry dots (slot AND member id), deferred ids, deferred clocks —
+        as host numpy columns.  When the planes live on an accelerator
+        (auto-detected), sparsification runs ON DEVICE
+        (:func:`_device_compact`) and only compact columns cross the
+        tunnel; on CPU the same bundles come from ``np.nonzero``
+        directly.  Both paths emit cells in row-major order.
+        ``want_entries=False`` returns an empty entry bundle without
+        computing or transferring it (``to_coo`` derives member ids from
+        the dot bundle instead)."""
         import numpy as np
 
-        clock = np.asarray(self.clock)
-        ids = np.asarray(self.ids)
-        dots = np.asarray(self.dots)
-        d_ids = np.asarray(self.d_ids)
-        d_clocks = np.asarray(self.d_clocks)
-
+        if via_device is None:
+            via_device = _on_accelerator(self.clock)
+        planes = (self.clock, self.ids, self.dots, self.d_ids, self.d_clocks)
+        if via_device:
+            counts = [int(c) for c in np.asarray(_device_nnz(*planes))]
+            if not want_entries:
+                counts[1] = 0
+            sizes = tuple(_next_pow2(c) for c in counts)
+            bundles = jax.device_get(
+                _device_compact(*planes, sizes=sizes, with_entries=want_entries)
+            )
+            return tuple(
+                tuple(col[:c] for col in b) for b, c in zip(bundles, counts)
+            )
+        clock, ids, dots, d_ids, d_clocks = (np.asarray(x) for x in planes)
         co, ca = np.nonzero(clock)
+        if want_entries:
+            eo, es = np.nonzero(ids != orswot_ops.EMPTY)
+            entries = (eo, es, ids[eo, es])
+        else:
+            z = np.zeros(0, dtype=np.int64)
+            entries = (z, z, np.zeros(0, dtype=ids.dtype))
         do, ds, da = np.nonzero(dots)
         qo, qr = np.nonzero(d_ids != orswot_ops.EMPTY)
         ho, hr, ha = np.nonzero(d_clocks)
         return (
             (co, ca, clock[co, ca]),
-            (do, ids[do, ds], da, dots[do, ds, da]),
+            entries,
+            (do, ds, ids[do, ds], da, dots[do, ds, da]),
             (qo, qr, d_ids[qo, qr]),
             (ho, hr, ha, d_clocks[ho, hr, ha]),
         )
 
+    def to_coo(self, via_device: bool | None = None):
+        """Columnar bulk egress — the inverse of :meth:`from_coo`: four
+        coordinate-array tuples of populated cells (no Python objects;
+        pair with :meth:`from_coo` for checkpoint-scale export of live
+        fleets).  Returns ``(clock_coords, dot_coords, deferred_members,
+        deferred_coords)``.  On an accelerator backend the
+        sparsification runs on device and only compact columns transfer
+        (see :meth:`_cells`)."""
+        (co, ca, cv), _e, (do, _ds, dm, da, dv), q, h = self._cells(
+            via_device, want_entries=False
+        )
+        return ((co, ca, cv), (do, dm, da, dv), q, h)
+
     @gc_paused
-    def to_scalar(self, universe: Universe) -> list[Orswot]:
-        """Bulk egress: ``np.nonzero`` extracts every populated cell in
-        four vectorized passes; the Python loop only walks actual dots
-        (sparse), never the dense ``[N, M, A]`` volume."""
+    def to_scalar(
+        self, universe: Universe, via_device: bool | None = None
+    ) -> list[Orswot]:
+        """Bulk egress: :meth:`_cells` extracts every populated cell in
+        five vectorized passes (on device when the planes live on an
+        accelerator — dense planes never cross the tunnel); the Python
+        loop only walks actual dots (sparse), never the dense
+        ``[N, M, A]`` volume."""
         import numpy as np
 
         from ..scalar.vclock import VClock
 
-        clock = np.asarray(self.clock)
-        ids = np.asarray(self.ids)
-        dots = np.asarray(self.dots)
-        d_ids = np.asarray(self.d_ids)
-        d_clocks = np.asarray(self.d_clocks)
+        cells = self._cells(via_device)
+        (co, ca, cv), (eo, es, em), (do, ds, _dm, da, dv), (qo, qr, qm), (
+            ho, hr, ha, hv,
+        ) = cells
 
-        n = clock.shape[0]
+        n = self.clock.shape[0]
         # registry lookups hoisted out of the per-cell loops: the actor
         # universe is dense (one list index per cell instead of a method
         # call; only interned columns can carry data, the rest stay None),
@@ -327,45 +549,38 @@ class OrswotBatch:
         n_interned = len(universe.actors)
         actor_name = [
             universe.actors.lookup(i) if i < n_interned else None
-            for i in range(clock.shape[1])
+            for i in range(self.clock.shape[1])
         ]
         member_of = universe.members.lookup
         out = [Orswot() for _ in range(n)]
 
-        oi, ai = np.nonzero(clock)
-        for i, aix, v in zip(oi.tolist(), ai.tolist(), clock[oi, ai].tolist()):
+        for i, aix, v in zip(co.tolist(), ca.tolist(), cv.tolist()):
             out[i].clock.dots[actor_name[aix]] = v
 
-        # entries in slot order (np.nonzero is row-major), matching the
-        # insertion order the naive path produced
-        oi, si = np.nonzero(ids != orswot_ops.EMPTY)
-        mids = ids[oi, si]
-        uniq, inv = np.unique(mids, return_inverse=True)
+        # entries in slot order (both cell paths emit row-major order),
+        # matching the insertion order the naive path produced
+        uniq, inv = np.unique(em, return_inverse=True)
         uniq_names = [member_of(int(m)) for m in uniq]
         entry_clocks = {}
-        for i, j, u in zip(oi.tolist(), si.tolist(), inv.tolist()):
+        for i, j, u in zip(eo.tolist(), es.tolist(), inv.tolist()):
             vc = VClock()
             out[i].entries[uniq_names[u]] = vc
             entry_clocks[(i, j)] = vc
-        oi, si, ai = np.nonzero(dots)
         for i, j, aix, v in zip(
-            oi.tolist(), si.tolist(), ai.tolist(), dots[oi, si, ai].tolist()
+            do.tolist(), ds.tolist(), da.tolist(), dv.tolist()
         ):
             entry_clocks[(i, j)].dots[actor_name[aix]] = v
 
-        oi, si = np.nonzero(d_ids != orswot_ops.EMPTY)
-        if oi.size:
+        if qo.size:
             deferred_clocks = {}
             deferred_members = {}
-            d_mids = d_ids[oi, si]
-            d_uniq, d_inv = np.unique(d_mids, return_inverse=True)
+            d_uniq, d_inv = np.unique(qm, return_inverse=True)
             d_names = [member_of(int(m)) for m in d_uniq]
-            for i, j, u in zip(oi.tolist(), si.tolist(), d_inv.tolist()):
+            for i, j, u in zip(qo.tolist(), qr.tolist(), d_inv.tolist()):
                 deferred_clocks[(i, j)] = VClock()
                 deferred_members[(i, j)] = d_names[u]
-            oi, si, ai = np.nonzero(d_clocks)
             for i, j, aix, v in zip(
-                oi.tolist(), si.tolist(), ai.tolist(), d_clocks[oi, si, ai].tolist()
+                ho.tolist(), hr.tolist(), ha.tolist(), hv.tolist()
             ):
                 if (i, j) in deferred_clocks:
                     deferred_clocks[(i, j)].dots[actor_name[aix]] = v

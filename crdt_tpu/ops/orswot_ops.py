@@ -257,9 +257,12 @@ def _merge_impl_default():
     the last two live in :mod:`crdt_tpu.ops.orswot_lanes` and are exact
     for uint32 counters only (bit-equal outside the conservative-overflow
     objects; see ``tests/test_orswot_lanes.py``).  The unset default is
-    ``rank`` on every backend until the TPU layout A/B
-    (`scripts/tpu_experiments.py`) picks a winner; flipping the TPU
-    default is then this function's one-line change.
+    backend-dispatched per the round-3 on-chip layout A/B
+    (`reports/LAYOUT_AB_TPU.md`): ``unrolled`` on TPU (54.0 ms vs the
+    rank path's 57.7 ms at config-4 shapes; ``lanes`` lost 2× at
+    120 ms), ``rank`` elsewhere (the unrolled tile math trades extra
+    dot-table reads for regularity — measured 17% slower on the
+    memory-bound CPU backend).
 
     The env var is read at **trace time**: jit caches are keyed on
     shapes/dtypes only, so flipping ``CRDT_MERGE_IMPL`` after a caller's
@@ -269,7 +272,10 @@ def _merge_impl_default():
     use distinctly shaped inputs per impl."""
     import os
 
-    return os.environ.get("CRDT_MERGE_IMPL", "rank")
+    import jax
+
+    default = "unrolled" if jax.default_backend() == "tpu" else "rank"
+    return os.environ.get("CRDT_MERGE_IMPL", default)
 
 
 def merge(

@@ -207,3 +207,88 @@ def test_from_coo_rejects_conflicting_deferred_member_assignment():
     )
     assert int(np.asarray(b.d_ids)[0, 0]) == 3
     assert int(np.asarray(b.d_clocks)[0, 0, 0]) == 9
+
+
+class TestDeviceCellPaths:
+    """The jitted compaction/expansion paths (`via_device=True`) exist so
+    only compact columns cross the host<->device boundary on accelerator
+    backends (the axon tunnel moves dense planes at ~10 MB/s).  Under
+    the CPU test backend they run the same jitted kernels and must be
+    bit-identical to the host numpy paths."""
+
+    def _planes(self, b):
+        return (b.clock, b.ids, b.dots, b.d_ids, b.d_clocks)
+
+    def test_from_scalar_device_expand_matches_host(self):
+        rng = np.random.RandomState(7)
+        uni = _universe()
+        states = _random_states(rng, 40, uni)
+        host = OrswotBatch.from_scalar(states, uni, via_device=False)
+        dev = OrswotBatch.from_scalar(states, uni, via_device=True)
+        for h, d in zip(self._planes(host), self._planes(dev)):
+            assert np.array_equal(np.asarray(h), np.asarray(d))
+
+    def test_from_coo_device_expand_matches_host_with_duplicates(self):
+        uni = _universe()
+        actor = uni.actor_idx("a2")
+        member = uni.member_id("widget")
+        kw = dict(
+            clock_coords=(np.array([0, 0]), np.array([actor, actor]),
+                          np.array([5, 9])),
+            dot_coords=(np.array([0, 0]), np.array([member, member]),
+                        np.array([actor, actor]), np.array([9, 5])),
+        )
+        host = OrswotBatch.from_coo(1, uni, via_device=False, **kw)
+        dev = OrswotBatch.from_coo(1, uni, via_device=True, **kw)
+        for h, d in zip(self._planes(host), self._planes(dev)):
+            assert np.array_equal(np.asarray(h), np.asarray(d))
+
+    def test_to_scalar_device_compact_matches_host(self):
+        rng = np.random.RandomState(11)
+        uni = _universe()
+        states = _random_states(rng, 40, uni)
+        batch = OrswotBatch.from_scalar(states, uni)
+        assert batch.to_scalar(uni, via_device=True) == batch.to_scalar(
+            uni, via_device=False
+        )
+
+    def test_to_coo_device_compact_matches_host(self):
+        rng = np.random.RandomState(13)
+        uni = _universe()
+        states = _random_states(rng, 30, uni)
+        batch = OrswotBatch.from_scalar(states, uni)
+        for host_cols, dev_cols in zip(
+            batch.to_coo(via_device=False), batch.to_coo(via_device=True)
+        ):
+            for h, d in zip(host_cols, dev_cols):
+                assert np.array_equal(np.asarray(h), np.asarray(d))
+
+    def test_empty_batch_device_paths(self):
+        uni = _universe()
+        batch = OrswotBatch.zeros(3, uni)
+        assert batch.to_scalar(uni, via_device=True) == [
+            Orswot(), Orswot(), Orswot()
+        ]
+        for cols in batch.to_coo(via_device=True):
+            for c in cols:
+                assert np.asarray(c).shape[0] == 0
+
+    def test_from_coo_device_accepts_lists_and_empty_columns(self):
+        # np.asarray([]) is float64; the device path must still index
+        # planes with integer coordinates (code-review regression)
+        uni = _universe()
+        b = OrswotBatch.from_coo(
+            2, uni, clock_coords=([], [], []), dot_coords=([], [], [], []),
+            via_device=True,
+        )
+        assert b.to_scalar(uni) == [Orswot(), Orswot()]
+        actor = uni.actor_idx("a1")
+        member = uni.member_id("w")
+        b2 = OrswotBatch.from_coo(
+            2, uni,
+            clock_coords=([0], [actor], [3]),
+            dot_coords=([0], [member], [actor], [3]),
+            via_device=True,
+        )
+        s = b2.to_scalar(uni)[0]
+        assert s.entries == {"w": VClock({"a1": 3})}

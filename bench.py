@@ -211,23 +211,7 @@ def bench_north_star():
             tuple(jnp.stack([rep[k] for rep in reps]) for k in range(5))
         )
 
-    if os.environ.get("CRDT_LANES") == "1":
-        # lanes-last fold (object axis in the TPU vector lanes): templates
-        # are transposed ONCE outside the timed scan and the whole fold
-        # runs in that layout — the steady state a resident fleet would
-        # keep.  Opt-in until the layout A/B (scripts/tpu_experiments.py
-        # merge_lanes mode) shows it faster on the target backend.
-        from crdt_tpu.ops import orswot_lanes
-
-        def fold_join(stack):
-            return orswot_lanes.fold_merge_t(stack, m, d)[0]
-
-        def fold_join_parity(stack):
-            out, _ = orswot_lanes.fold_merge_t(
-                orswot_lanes.stacked_to_lanes(stack), m, d
-            )
-            return orswot_lanes.from_lanes(out)
-    elif os.environ.get("CRDT_TREE_FOLD") == "1":
+    if os.environ.get("CRDT_TREE_FOLD") == "1":
         # pairwise tree reduction: same R-1 merges, log-depth dependency
         # chain, each level one batched call.  Opt-in: measured 2.3x
         # SLOWER than the sequential fold on the CPU backend (the [R/2,
@@ -246,27 +230,12 @@ def bench_north_star():
 
     # parity sample: the SELECTED fold on the first template's first
     # objects must reproduce the scalar engine's N-way merge value()
-    if os.environ.get("CRDT_LANES") == "1":
-        _north_star_parity(templates[0], r, a, m, d, fold_join_parity)
-        # the scan itself runs entirely in the transposed layout
-        templates = [
-            tuple(jax.device_put(x) for x in orswot_lanes.stacked_to_lanes(t))
-            for t in templates
-        ]
-    else:
-        _north_star_parity(templates[0], r, a, m, d, fold_join)
+    _north_star_parity(templates[0], r, a, m, d, fold_join)
 
     n_chunks = max(2, n // chunk)
     elision = {"elision_check": "skipped"}  # per-step-dispatch paths can't hoist
 
-    if os.environ.get("CRDT_LANES") == "1" and os.environ.get("CRDT_PALLAS") == "1":
-        # the lanes templates above are transposed; the Pallas fold wants
-        # the standard [R, N, ...] layout — the flags are mutually
-        # exclusive and lanes wins
-        log("north★ CRDT_LANES=1 overrides CRDT_PALLAS=1 (exclusive folds)")
-    if os.environ.get("CRDT_PALLAS") == "1" and os.environ.get(
-        "CRDT_LANES"
-    ) != "1" and jax.default_backend() == "tpu":
+    if os.environ.get("CRDT_PALLAS") == "1" and jax.default_backend() == "tpu":
         # fused Pallas fold: accumulator stays in VMEM across all R joins.
         # Opt-in only, and only on a real TPU backend — Mosaic cannot lower
         # on CPU, so the flag degrades to the jnp fold after a CPU fallback

@@ -10,6 +10,6 @@ from ..config import enable_x64 as _enable_x64
 
 _enable_x64()
 
-# orswot_pallas / orswot_lanes are imported on demand: they pull
+# orswot_pallas / orswot_unrolled are imported on demand: they pull
 # jax.experimental.pallas, which stays off the default import path
 from . import clock_ops, counter_ops, lww_ops, mvreg_ops, orswot_ops

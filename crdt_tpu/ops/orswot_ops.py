@@ -252,17 +252,16 @@ def _merge_impl_default():
     """Which pairwise-merge implementation ``merge`` dispatches to.
 
     ``CRDT_MERGE_IMPL`` ∈ ``rank`` (the rank-select pipeline below, CPU
-    default), ``unrolled`` (gather/sort-free tile math, standard layout)
-    or ``lanes`` (tile math with the object axis in the vector lanes) —
-    the last two live in :mod:`crdt_tpu.ops.orswot_lanes` and are exact
-    for uint32 counters only (bit-equal outside the conservative-overflow
-    objects; see ``tests/test_orswot_lanes.py``).  The unset default is
+    default) or ``unrolled`` (gather/sort-free tile math,
+    :mod:`crdt_tpu.ops.orswot_unrolled`; exact for uint32 counters only —
+    bit-equal outside the conservative-overflow objects, see
+    ``tests/test_orswot_unrolled.py``).  The unset default is
     backend-dispatched per the round-3 on-chip layout A/B
     (`reports/LAYOUT_AB_TPU.md`): ``unrolled`` on TPU (54.0 ms vs the
-    rank path's 57.7 ms at config-4 shapes; ``lanes`` lost 2× at
-    120 ms), ``rank`` elsewhere (the unrolled tile math trades extra
-    dot-table reads for regularity — measured 17% slower on the
-    memory-bound CPU backend).
+    rank path's 57.7 ms at config-4 shapes), ``rank`` elsewhere (the
+    unrolled tile math trades extra dot-table reads for regularity —
+    measured 17% slower on the memory-bound CPU backend).  A third
+    contender, lanes-last layout, lost the A/B 2× and was deleted.
 
     The env var is read at **trace time**: jit caches are keyed on
     shapes/dtypes only, so flipping ``CRDT_MERGE_IMPL`` after a caller's
@@ -300,35 +299,26 @@ def merge(
     the full-width pipeline.
     """
     impl = _merge_impl_default()
-    if impl not in ("rank", "unrolled", "lanes"):
+    if impl not in ("rank", "unrolled"):
         raise ValueError(
-            f"CRDT_MERGE_IMPL={impl!r} is not one of rank/unrolled/lanes"
+            f"CRDT_MERGE_IMPL={impl!r} is not one of rank/unrolled"
         )
     if (
-        impl != "rank"
+        impl == "unrolled"
         and clock_a.dtype.itemsize <= 4
         and ids_a.shape[-1] <= _ALIGN_MATCH_MAX_M
     ):
         # the tile math unrolls Python loops over the slot axes, so wide
         # member tables (elastic regrowth) stay on the rank path's
-        # sort-aligned _merge_wide below
-        from . import orswot_lanes
+        # sort-aligned _merge_wide below; rank-polymorphic
+        # (ellipsis-based tile math), so any batch shape dispatches
+        from . import orswot_unrolled
 
-        if impl == "unrolled":
-            # rank-polymorphic (ellipsis-based tile math): any batch shape
-            return orswot_lanes.merge_unrolled(
-                clock_a, ids_a, dots_a, dids_a, dclocks_a,
-                clock_b, ids_b, dots_b, dids_b, dclocks_b,
-                m_cap, d_cap,
-            )
-        if clock_a.ndim == 2:
-            # the lanes transpose assumes exactly one batch axis; other
-            # ranks (e.g. the tree fold's [R/2, N, ...]) fall through
-            return orswot_lanes.merge_lanes(
-                clock_a, ids_a, dots_a, dids_a, dclocks_a,
-                clock_b, ids_b, dots_b, dids_b, dclocks_b,
-                m_cap, d_cap,
-            )
+        return orswot_unrolled.merge_unrolled(
+            clock_a, ids_a, dots_a, dids_a, dclocks_a,
+            clock_b, ids_b, dots_b, dids_b, dclocks_b,
+            m_cap, d_cap,
+        )
     if ids_a.shape[-1] > _ALIGN_MATCH_MAX_M:
         return _merge_wide(
             clock_a, ids_a, dots_a, dids_a, dclocks_a,

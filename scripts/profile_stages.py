@@ -130,25 +130,18 @@ def main():
         return (d_ids2, jnp.maximum(d_clocks2, dots2[..., :d, :]))
     chain_time(step_deferred, (dids_a, dclocks_a), "deferred dedup+replay")
 
-    # layout variants (crdt_tpu/ops/orswot_lanes.py): the gather/sort-free
-    # tile math in standard layout, and the same math lanes-last with the
-    # carry staying transposed (steady-state fold shape).  TPU-only: on
-    # CPU these are memory-bound by design (O(M) extra passes) and eat
-    # minutes of a tunnel window's budget for a number we already know.
+    # the unrolled tile math (crdt_tpu/ops/orswot_unrolled.py, the TPU
+    # default since the round-3 A/B).  TPU-only: on CPU it is
+    # memory-bound by design (O(M) extra passes) and eats minutes of a
+    # tunnel window's budget for a number we already know.
     if jax.default_backend() == "tpu":
-        from crdt_tpu.ops import orswot_lanes
+        from crdt_tpu.ops import orswot_unrolled
 
         chain_time(
-            lambda s: orswot_lanes.merge_unrolled(*s, *rhs, m, d)[:5], lhs,
+            lambda s: orswot_unrolled.merge_unrolled(*s, *rhs, m, d)[:5], lhs,
             "merge_unrolled (std layout)", bytes_moved=3 * state_bytes)
-
-        rhs_t = tuple(jax.device_put(x) for x in orswot_lanes.to_lanes(rhs))
-        chain_time(
-            lambda s: orswot_lanes.merge_t(s, rhs_t, m, d)[0],
-            orswot_lanes.to_lanes(lhs),
-            "merge_t (lanes-last)", bytes_moved=3 * state_bytes)
     else:
-        print("layout variants skipped (non-TPU backend)")
+        print("unrolled variant skipped (non-TPU backend)")
 
 
 if __name__ == "__main__":

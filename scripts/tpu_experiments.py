@@ -82,26 +82,18 @@ elif mode in ("merge_scatter", "merge_scatterless"):
     t = chain(lambda acc: orswot_ops.merge(*acc, *rhs, m, d)[:5], lhs, iters=20)
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
 
-elif mode in ("merge_unrolled", "merge_lanes"):
-    # gather/sort-free layout candidates (crdt_tpu/ops/orswot_lanes.py):
-    # the unrolled tile math in standard layout, and the lanes-last
-    # (object-axis-minor) variant timed in its steady state — the carry
-    # stays transposed, as a real fold would keep it
-    from crdt_tpu.ops import orswot_lanes
+elif mode == "merge_unrolled":
+    # gather/sort-free tile math (crdt_tpu/ops/orswot_unrolled.py) — the
+    # round-3 A/B winner, kept in the menu so future windows re-validate
+    # the default against the rank path
+    from crdt_tpu.ops import orswot_unrolled
     n, a, m, d = 100_000, 16, 8, 4
     lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
     rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
-    if mode == "merge_unrolled":
-        t = chain(
-            lambda acc: orswot_lanes.merge_unrolled(*acc, *rhs, m, d)[:5],
-            lhs, iters=20,
-        )
-    else:
-        rhs_t = orswot_lanes.to_lanes(rhs)
-        t = chain(
-            lambda acc: orswot_lanes.merge_t(acc, rhs_t, m, d)[0],
-            orswot_lanes.to_lanes(lhs), iters=20,
-        )
+    t = chain(
+        lambda acc: orswot_unrolled.merge_unrolled(*acc, *rhs, m, d)[:5],
+        lhs, iters=20,
+    )
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
 
 elif mode in ("order_rank", "order_argsort"):
@@ -219,10 +211,9 @@ def main():
     print(f"tpu_experiments on backend env JAX_PLATFORMS="
           f"{os.environ.get('JAX_PLATFORMS')!r}", flush=True)
     menu = [
-        ("merge_scatter", {"CRDT_SCATTERLESS": "0"}, 900),
-        ("merge_scatterless", {"CRDT_SCATTERLESS": "1"}, 900),
+        ("merge_scatter", {"CRDT_SCATTERLESS": "0", "CRDT_MERGE_IMPL": "rank"}, 900),
+        ("merge_scatterless", {"CRDT_SCATTERLESS": "1", "CRDT_MERGE_IMPL": "rank"}, 900),
         ("merge_unrolled", None, 900),
-        ("merge_lanes", None, 900),
         ("order_rank", None, 900),
         ("order_argsort", None, 900),
         ("gather_take", None, 900),

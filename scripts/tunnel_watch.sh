@@ -65,10 +65,8 @@ for i in $(seq 1 600); do
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
         step experiments 5400 /tmp/experiments_tpu.log \
-            env CRDT_EXP_MODES=merge_scatter,merge_scatterless,merge_unrolled,merge_lanes,gather_take,gather_onehot,gather_mxu,gather_mxu8,scatter_put \
+            env CRDT_EXP_MODES=merge_scatter,merge_scatterless,merge_unrolled,fold_seq,fold_tree,dtype_u32,dtype_u64 \
             python scripts/tpu_experiments.py
-        step bench_lanes 2400 /tmp/bench_tpu_lanes.log \
-            env CRDT_LANES=1 CRDT_SKIP_TPU_VALIDATE=1 python bench.py
         # publish only when this iteration actually ran the bench (marker
         # absent before the call) — a marker short-circuit must not
         # re-stamp the artifact's capture time
@@ -84,11 +82,10 @@ for i in $(seq 1 600); do
         # logs whose marker exists for THIS rev are fed in — a stale
         # /tmp bench log from an older build must not color the verdict.
         if [ -e "$MARK/experiments" ]; then
-            BLOG=/dev/null; LLOG=/dev/null
+            BLOG=/dev/null
             [ -e "$MARK/bench" ] && BLOG=/tmp/bench_tpu3.log
-            [ -e "$MARK/bench_lanes" ] && LLOG=/tmp/bench_tpu_lanes.log
             python scripts/layout_decision.py /tmp/experiments_tpu.log \
-                "$BLOG" "$LLOG" >> /tmp/tunnel_watch.log 2>&1 || true
+                "$BLOG" >> /tmp/tunnel_watch.log 2>&1 || true
         fi
         # Compiled-Pallas attempt LAST: a Mosaic crash can wedge the
         # remote compile helper for the rest of the window.  Workaround
@@ -97,7 +94,7 @@ for i in $(seq 1 600); do
             env TPU_ACCELERATOR_TYPE=v5litepod-1 TPU_WORKER_HOSTNAMES=localhost \
             python scripts/tpu_validate.py --pallas
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
-           [ -e "$MARK/bench_lanes" ] && [ -e "$MARK/bench" ] && \
+           [ -e "$MARK/bench" ] && \
            [ -e "$MARK/validate_merge" ] && [ -e "$MARK/pallas" ]; then
             echo "$(date -u +%H:%M:%S) all captures done (rev $REV)" | tee -a /tmp/tunnel_watch.log
             exit 0

@@ -1,0 +1,162 @@
+"""Parity: the unrolled ORSWOT merge vs the production rank path.
+
+``crdt_tpu.ops.orswot_unrolled.merge_unrolled`` (the TPU default since
+the round-3 on-chip layout A/B — `reports/LAYOUT_AB_TPU.md`) must be
+bit-identical to ``orswot_ops.merge``'s rank pipeline, which is itself
+bit-exact against the scalar engine (``tests/test_parity.py``) and
+thereby the reference (`/root/reference/src/orswot.rs:89-156`).
+Deferred-bearing states are included: ``random_orswot_arrays(
+deferred_frac=...)`` plants causally-future remove rows, so the replay
+path is exercised, not just the fast path.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax as _jax
+import jax.numpy as jnp
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from crdt_tpu.ops import orswot_ops, orswot_unrolled
+from crdt_tpu.utils.testdata import random_orswot_arrays
+
+
+def _pair(rng, n, a, m, d, deferred_frac=0.0):
+    lhs = tuple(
+        jnp.asarray(x)
+        for x in random_orswot_arrays(
+            rng, n, a, m, d, np.uint32, deferred_frac=deferred_frac
+        )
+    )
+    rhs = tuple(
+        jnp.asarray(x)
+        for x in random_orswot_arrays(
+            rng, n, a, m, d, np.uint32, deferred_frac=deferred_frac
+        )
+    )
+    return lhs, rhs
+
+
+def _assert_same(ref, got):
+    """Bit-equality on every object the production path doesn't flag as
+    overflowed.  ``orswot_ops`` counts member survivors *pre*-replay (the
+    conservative contract — the host discards flagged objects and
+    regrows), while the unrolled tile math replays before compaction and
+    only overflows when the *post*-replay survivors exceed capacity, so
+    on ref-flagged objects the two legitimately diverge; everywhere else
+    they must agree exactly, and the unrolled flag must never fire where
+    the conservative one didn't."""
+    ref_over = np.asarray(ref[5])
+    got_over = np.asarray(got[5])
+    ok = ~ref_over.any(axis=-1)
+    assert not (got_over & ~ref_over).any(), "unrolled overflow without ref overflow"
+    names = ("clock", "ids", "dots", "d_ids", "d_clocks")
+    for name, r, g in zip(names, ref[:5], got[:5]):
+        np.testing.assert_array_equal(
+            np.asarray(r)[ok], np.asarray(g)[ok], err_msg=name
+        )
+
+
+@pytest.mark.parametrize("deferred_frac", [0.0, 0.4])
+@pytest.mark.parametrize("shape", [(17, 4, 3, 2), (33, 8, 4, 2), (21, 16, 8, 4)])
+def test_unrolled_merge_parity(shape, deferred_frac):
+    n, a, m, d = shape
+    rng = np.random.RandomState(11)
+    lhs, rhs = _pair(rng, n, a, m, d, deferred_frac)
+    _assert_same(
+        orswot_ops.merge(*lhs, *rhs, m, d),
+        orswot_unrolled.merge_unrolled(*lhs, *rhs, m, d),
+    )
+
+
+def test_merge_impl_dispatch(monkeypatch):
+    """CRDT_MERGE_IMPL routes orswot_ops.merge to the unrolled variant;
+    both implementations agree on non-overflow objects, including
+    stacked (rank > 2) batches — the tile math is rank-polymorphic."""
+    rng = np.random.RandomState(23)
+    lhs, rhs = _pair(rng, 19, 4, 3, 2, deferred_frac=0.3)
+    outs = {}
+    for impl in ("rank", "unrolled"):
+        monkeypatch.setenv("CRDT_MERGE_IMPL", impl)
+        outs[impl] = orswot_ops.merge(*lhs, *rhs, 3, 2)
+    _assert_same(outs["rank"], outs["unrolled"])
+
+    # rank > 2 (e.g. the tree fold's [R/2, N, ...] batches)
+    monkeypatch.setenv("CRDT_MERGE_IMPL", "unrolled")
+    stacked_l = tuple(jnp.stack([x, x]) for x in lhs)
+    stacked_r = tuple(jnp.stack([x, x]) for x in rhs)
+    got = orswot_ops.merge(*stacked_l, *stacked_r, 3, 2)
+    monkeypatch.setenv("CRDT_MERGE_IMPL", "rank")
+    want = orswot_ops.merge(*stacked_l, *stacked_r, 3, 2)
+    _assert_same(want, got)
+
+    # unknown impl names error instead of silently picking a variant
+    # (the deleted lanes-last variant must now be rejected too)
+    for bad in ("pallas", "lanes"):
+        monkeypatch.setenv("CRDT_MERGE_IMPL", bad)
+        with pytest.raises(ValueError, match="CRDT_MERGE_IMPL"):
+            orswot_ops.merge(*lhs, *rhs, 3, 2)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(impl, m, d):
+    """One compiled merge per (impl, caps): example iterations then cost
+    dispatch, not tracing (eager tiny-shape merges are ~1s each).  The
+    rank reference pins CRDT_MERGE_IMPL for its trace — with the env
+    unset, a TPU backend would dispatch merge to unrolled and the parity
+    property would compare unrolled against itself."""
+    import os
+
+    if impl == "rank":
+        def fn(*args):
+            prev = os.environ.get("CRDT_MERGE_IMPL")
+            os.environ["CRDT_MERGE_IMPL"] = "rank"
+            try:
+                return orswot_ops.merge(*args)
+            finally:
+                if prev is None:
+                    del os.environ["CRDT_MERGE_IMPL"]
+                else:
+                    os.environ["CRDT_MERGE_IMPL"] = prev
+    else:
+        fn = orswot_unrolled.merge_unrolled
+    return _jax.jit(lambda lhs, rhs: fn(*lhs, *rhs, m, d))
+
+
+@pytest.mark.parametrize(
+    "shape", [(7, 1, 1, 1), (7, 3, 2, 1), (7, 8, 5, 3)]
+)
+@settings(max_examples=25)  # shapes fixed → 3 compiles per impl, data varies
+@given(seed=st.integers(0, 2**31 - 1), deferred_frac=st.sampled_from([0.0, 0.5]))
+def test_impl_agreement_property(shape, seed, deferred_frac):
+    """Both merge implementations agree on random states across the
+    shape grid (incl. single-slot tables and deferred-bearing batches) —
+    the randomized analogue of the fixed-seed parity cases above."""
+    n, a, m, d = shape
+    rng = np.random.RandomState(seed)
+    lhs, rhs = _pair(rng, n, a, m, d, deferred_frac)
+    ref = _jitted("rank", m, d)(lhs, rhs)
+    _assert_same(ref, _jitted("unrolled", m, d)(lhs, rhs))
+
+
+def test_full_uint32_counter_range_parity():
+    """The tile math works in the bias-mapped signed domain
+    (``x ^ 0x8000_0000``); counters at and above ``2**31`` must stay
+    bit-exact through the unrolled variant."""
+    rng = np.random.RandomState(29)
+    n, a, m, d = 16, 4, 4, 2
+    lhs, rhs = _pair(rng, n, a, m, d, deferred_frac=0.4)
+
+    def inflate(state):
+        clock, ids, dots, dids, dclocks = state
+        big = jnp.uint32(1 << 31)
+        up = lambda x: jnp.where(x > 0, x + big, x)  # keep 0 = absent
+        return up(clock), ids, up(dots), dids, up(dclocks)
+
+    lhs, rhs = inflate(lhs), inflate(rhs)
+    ref = orswot_ops.merge(*lhs, *rhs, m, d)
+    _assert_same(ref, orswot_unrolled.merge_unrolled(*lhs, *rhs, m, d))
+    assert int(np.asarray(ref[0]).max()) >= 1 << 31

@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 EMPTY = -1
 # biased-int32 representation of counter 0 (see module docstring): the
@@ -78,6 +79,23 @@ _BIAS = np.uint32(0x8000_0000)
 # ---------------------------------------------------------------------------
 # tile math (plain jnp on VMEM-resident values; shared by both kernels)
 # ---------------------------------------------------------------------------
+
+
+def _emask(b):
+    """Rank-expand a boolean mask by one trailing axis, in the i32 domain.
+
+    Mosaic's vector layout inference rejects shape casts on ``i1``
+    vectors (``tpu.reshape vector<...xi1> -> vector<...x1xi1>``, found by
+    local AOT compile against a v5e topology) — so the reshape runs on an
+    int32 widening and the ``i1`` is re-derived by an elementwise compare
+    in the target shape."""
+    return b.astype(jnp.int32)[..., None] > 0
+
+
+def _bstack(cols, axis=-1):
+    """Stack boolean columns along a new axis via int32 (see :func:`_emask`:
+    ``jnp.stack`` reshapes each ``i1`` column, which Mosaic cannot lower)."""
+    return jnp.stack([c.astype(jnp.int32) for c in cols], axis=axis) > 0
 
 
 def _align_against(ids_a, dots_a, ids_b, dots_b):
@@ -93,9 +111,9 @@ def _align_against(ids_a, dots_a, ids_b, dots_b):
     b_cols = []
     for j in range(m_b):
         mj = valid_a & (ids_a == ids_b[..., j : j + 1])  # [T, M_a]
-        e2 = jnp.maximum(e2, jnp.where(mj[..., None], dots_b[..., j : j + 1, :], ZERO))
+        e2 = jnp.maximum(e2, jnp.where(_emask(mj), dots_b[..., j : j + 1, :], ZERO))
         b_cols.append(_any(mj))
-    return e2, jnp.stack(b_cols, axis=-1)
+    return e2, _bstack(b_cols, axis=-1)
 
 
 def _merge_rule(e1, e2, p1, p2, valid, self_clock, other_clock):
@@ -107,12 +125,12 @@ def _merge_rule(e1, e2, p1, p2, valid, self_clock, other_clock):
     c2 = _sub(_sub(e2, common), sc)
     out_both = jnp.maximum(common, jnp.maximum(c1, c2))
     keep1 = ~_all(e1 <= oc)
-    out_only1 = jnp.where(keep1[..., None], e1, ZERO)
+    out_only1 = jnp.where(_emask(keep1), e1, ZERO)
     out_only2 = _sub(e2, sc)
-    both = (p1 & p2)[..., None]
-    only1 = (p1 & ~p2)[..., None]
+    both = _emask(p1 & p2)
+    only1 = _emask(p1 & ~p2)
     out = jnp.where(both, out_both, jnp.where(only1, out_only1, out_only2))
-    return jnp.where(valid[..., None], out, ZERO)
+    return jnp.where(_emask(valid), out, ZERO)
 
 
 def _sub(a, b):
@@ -155,11 +173,50 @@ def _rank_select(keys, live, payload_ids, payload_clocks, cap):
             jnp.sum(jnp.where(sel, payload_ids + 1, 0), axis=-1, dtype=jnp.int32) - 1
         )
         out_clocks.append(
-            jnp.max(jnp.where(sel[..., None], payload_clocks, ZERO), axis=-2)
+            jnp.max(jnp.where(_emask(sel), payload_clocks, ZERO), axis=-2)
         )
     ids = jnp.stack(out_ids, axis=-1)
     clocks = jnp.stack(out_clocks, axis=-2)
     overflow = jnp.sum(live, axis=-1, dtype=jnp.int32) > cap
+    return ids, clocks, overflow
+
+
+def _rank_select_slots(live, payload_ids, payload_clocks, cap):
+    """Deferred-table pack: keep live slots in slot (first-occurrence)
+    order — the specialization of :func:`_rank_select` for ``keys`` = the
+    slot index, which is what the deferred compaction always uses.
+
+    Everything is python-unrolled into 1-D ``[T]`` / 2-D ``[T, A]`` ops:
+    the deferred concat axis is tiny (``2·d_cap``, typically 4), and
+    Mosaic's vector layout inference CHECK-crashes
+    (``array.h: limits[i] <= dim(i)``) on any ``[T, 1] → [T, s]``
+    broadcast or ``axis=-2`` reduction over a minor axis smaller than the
+    native tile — found by local AOT compile against a v5e topology (the
+    member-table call is fine: its ``2·m_cap`` axis is tile-sized).  With
+    slot-order keys the rank of slot ``j`` is just the running count of
+    live slots before it, so no pairwise compare is needed at all."""
+    s = live.shape[-1]
+    run = jnp.zeros(live.shape[:-1], dtype=jnp.int32)
+    rank = []
+    for j in range(s):
+        rank.append(run)
+        run = run + live[..., j].astype(jnp.int32)
+    out_ids = []
+    out_clocks = []
+    for k in range(cap):
+        oid = jnp.full(live.shape[:-1], -1, dtype=jnp.int32)
+        clk = jnp.full_like(payload_clocks[..., 0, :], ZERO)  # [T, A]
+        for j in range(s):
+            sel_j = live[..., j] & (rank[j] == k)  # [T], at most one hot over j
+            oid = oid + jnp.where(sel_j, payload_ids[..., j] + 1, 0)
+            clk = jnp.maximum(
+                clk, jnp.where(_emask(sel_j), payload_clocks[..., j, :], ZERO)
+            )
+        out_ids.append(oid)
+        out_clocks.append(clk)
+    ids = jnp.stack(out_ids, axis=-1)
+    clocks = jnp.stack(out_clocks, axis=-2)
+    overflow = run > cap
     return ids, clocks, overflow
 
 
@@ -181,7 +238,7 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
     )
     # unmatched b members: the only-in-other rule (`orswot.rs:132-138`)
     b_only = valid_b & ~b_matched
-    out_b = jnp.where(b_only[..., None], _sub(dots_b, ca[..., None, :]), ZERO)
+    out_b = jnp.where(_emask(b_only), _sub(dots_b, ca[..., None, :]), ZERO)
 
     ids_cat = jnp.concatenate(
         [jnp.where(valid_a, ids_a, EMPTY), jnp.where(b_only, ids_b, EMPTY)], axis=-1
@@ -206,10 +263,10 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
             )
             dup_j = dup_j | same
         dup_cols.append(dup_j)
-    is_dup = jnp.stack(dup_cols, axis=-1)
+    is_dup = _bstack(dup_cols, axis=-1)
     d_live = d_valid & ~is_dup
     d_ids = jnp.where(d_live, d_ids, EMPTY)
-    d_clocks = jnp.where(d_live[..., None], d_clocks, ZERO)
+    d_clocks = jnp.where(_emask(d_live), d_clocks, ZERO)
 
     # --- clock join (`orswot.rs:153`) then deferred replay (`:155`) ---
     clock = jnp.maximum(ca, cb)
@@ -217,7 +274,7 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
     for k in range(dn):
         match = (ids_cat == d_ids[..., k : k + 1]) & d_live[..., k : k + 1]
         rm = jnp.maximum(
-            rm, jnp.where(match[..., None], d_clocks[..., k : k + 1, :], ZERO)
+            rm, jnp.where(_emask(match), d_clocks[..., k : k + 1, :], ZERO)
         )
     new_dots = _sub(dots_cat, rm)
     live = _nonempty(new_dots) & (ids_cat != EMPTY)
@@ -227,11 +284,10 @@ def _merge_tile(sa, sb, m_cap: int, d_cap: int):
     big = jnp.iinfo(jnp.int32).max
     m_keys = jnp.where(live, ids_cat, big)
     ids_out, dots_out, m_over = _rank_select(m_keys, live, ids_cat, new_dots, m_cap)
-    slot_keys = jax.lax.broadcasted_iota(jnp.int32, d_ids.shape, d_ids.ndim - 1)
-    dids_out, dclk_out, d_over = _rank_select(
-        slot_keys, still_ahead, d_ids, d_clocks, d_cap
+    dids_out, dclk_out, d_over = _rank_select_slots(
+        still_ahead, d_ids, d_clocks, d_cap
     )
-    return (clock, ids_out, dots_out, dids_out, dclk_out), jnp.stack(
+    return (clock, ids_out, dots_out, dids_out, dclk_out), _bstack(
         [m_over, d_over], axis=-1
     )
 
@@ -269,15 +325,52 @@ def _from_kernel_dtype(x, cdt):
     return (jax.lax.bitcast_convert_type(x, jnp.uint32) ^ _BIAS).astype(cdt)
 
 
-def _tile_size(a, m, d, n_states=2, vmem_budget=8 * 1024 * 1024):
+# Mosaic scoped-VMEM ceiling requested from the compiler.  v5e has 128 MiB
+# of VMEM per core; leave headroom for the compiler's own buffers and the
+# double-buffered HBM⇄VMEM pipeline of the input/output blocks.
+_VMEM_LIMIT_BYTES = 96 * 1024 * 1024
+
+
+def _tile_size(a, m, d, n_states=2, vmem_budget=48 * 1024 * 1024):
     """Largest power-of-two tile whose working set fits the VMEM budget.
 
     ``n_states`` is how many full states are live per tile object: 2 for a
     pairwise merge, R+1 for the fold (all R replica blocks plus the
-    accumulator); the remaining terms bound ``_merge_tile``'s temporaries."""
+    accumulator).  The temporaries term is calibrated against Mosaic's own
+    scoped-stack accounting (local v5e AOT compile of the pairwise merge
+    at a=16/m=8/d=2 reported 22.47 MiB for a 256-object tile ⇒ ~88 KiB
+    per object ⇒ ~11 live ``[2m, a]`` planes per *survivor slot*): the
+    unrolled rank-select keeps roughly one masked ``[2m, a]`` select live
+    per output slot, and Mosaic stack-allocates them without reuse."""
+    import os
+
+    forced = os.environ.get("CRDT_PALLAS_TILE")
+    if forced:
+        # Read at TRACE time (like CRDT_MERGE_IMPL — jit caches are keyed
+        # on shapes/dtypes only, so changing it after a first compile
+        # keeps the old tile for same-shaped inputs; clear jit caches to
+        # re-dispatch).  Bypasses the VMEM-budget model: the knob exists
+        # for on-chip tile experiments where Mosaic's own scoped-vmem
+        # error is the ground truth the model is calibrated against.
+        try:
+            t = int(forced)
+        except ValueError:
+            raise ValueError(
+                f"CRDT_PALLAS_TILE={forced!r} is not an integer"
+            ) from None
+        if t < 8 or t & (t - 1):
+            raise ValueError(
+                f"CRDT_PALLAS_TILE={forced!r} must be a power of two >= 8"
+            )
+        return t
     state_bytes = 4 * (a + m + m * a + d + d * a)
-    tmp_bytes = 4 * (6 * m * a + 4 * d * a)
-    bytes_per_obj = n_states * state_bytes + tmp_bytes
+    tmp_bytes = 4 * 11 * (2 * m) * m * a + 4 * 8 * d * a
+    # the fold kernel unrolls n_states-1 sequential _merge_tile calls;
+    # Mosaic reuses *some* dead stack slots across them, so the
+    # temporaries term scales with the merge count but is capped
+    # (calibration: pairwise merge, n_states=2, factor 1; local AOT
+    # compiles of the fold bound the effective reuse)
+    bytes_per_obj = n_states * state_bytes + min(max(1, n_states - 1), 4) * tmp_bytes
     t = 256
     while t > 8 and t * bytes_per_obj > vmem_budget:
         t //= 2
@@ -375,6 +468,9 @@ def merge(
             in_specs=_state_specs(t, in_shapes),
             out_specs=_state_specs(t, [s.shape for s in out_shape]),
             out_shape=out_shape,
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_VMEM_LIMIT_BYTES
+            ),
             interpret=interpret,
         )(*sa, *sb)
     clock, ids, dots, dids, dclk, over = (x[:n] for x in out)
@@ -451,6 +547,9 @@ def fold_merge(
             in_specs=in_specs,
             out_specs=_state_specs(t, [s.shape for s in out_shape]),
             out_shape=out_shape,
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_VMEM_LIMIT_BYTES
+            ),
             interpret=interpret,
         )(*state)
     c, i, dts, di, dc, over = (x[:n] for x in out)

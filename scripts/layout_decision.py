@@ -89,6 +89,35 @@ def main():
     ranked = sorted(
         (r for r in merge_rows if r[1] is not None), key=lambda r: r[1]
     )
+    out_path = os.path.join(REPO, "reports", "LAYOUT_AB_TPU.md")
+    if not ranked and os.path.exists(out_path):
+        # A capture with no merge contenders (e.g. the fold-only
+        # experiment menu after the A/B concluded) must not clobber the
+        # committed merge-layout decision with "no decision" — but the
+        # fold results themselves still need a committable artifact (a
+        # window can open with no builder session attached; /tmp does not
+        # survive the round).
+        fold_path = os.path.join(REPO, "reports", "FOLD_AB_TPU.md")
+        lines = [
+            "# TPU fold-shape A/B — capture",
+            "",
+            f"Generated {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} "
+            f"by `scripts/layout_decision.py` from "
+            f"`{exp_log}`.  Merge-layout decision unchanged — see "
+            "`LAYOUT_AB_TPU.md`.",
+            "",
+            "| mode | ms |",
+            "|---|---|",
+        ]
+        for mode, ms in sorted(results.items()):
+            lines.append(
+                f"| {mode} | {'FAILED/TIMEOUT' if ms is None else f'{ms:.2f}'} |"
+            )
+        with open(fold_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"no merge contenders in {exp_log}; wrote {fold_path}, "
+              f"keeping existing {out_path}")
+        return
 
     lines = [
         "# TPU layout A/B — decision report",
@@ -168,7 +197,6 @@ def main():
         "  the stage profile (`scripts/profile_stages.py`).",
     ]
 
-    out_path = os.path.join(REPO, "reports", "LAYOUT_AB_TPU.md")
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         f.write("\n".join(lines) + "\n")

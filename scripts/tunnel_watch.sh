@@ -64,8 +64,13 @@ for i in $(seq 1 600); do
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing (rev $REV)" | tee -a /tmp/tunnel_watch.log
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
-        step experiments 5400 /tmp/experiments_tpu.log \
-            env CRDT_EXP_MODES=merge_scatter,merge_scatterless,merge_unrolled,fold_seq,fold_tree,dtype_u32,dtype_u64 \
+        # the 7-mode layout A/B concluded in the 2026-07-31 window
+        # (reports/LAYOUT_AB_TPU.md — unrolled default, lanes deleted);
+        # re-running the full suite would burn ~90 min of a window, so
+        # only the still-undecided fold-shape contenders stay (outer
+        # timeout covers both inner 1500s mode timeouts)
+        step experiments 3600 /tmp/experiments_tpu.log \
+            env CRDT_EXP_MODES=fold_seq,fold_tree \
             python scripts/tpu_experiments.py
         # publish only when this iteration actually ran the bench (marker
         # absent before the call) — a marker short-circuit must not

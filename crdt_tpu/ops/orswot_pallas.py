@@ -40,16 +40,17 @@ Design notes (vs the jnp path):
   sentinel :data:`ZERO` (= INT32_MIN) inside the kernel.  The
   entry/exit bias is one fused XOR outside the kernel.
 
-Deployment note: the kernels lower to Mosaic cleanly (see
-``reports/PALLAS_TPU_ATTEMPT.txt`` for the x64 pitfalls this required:
-32-bit trace mode, signed-domain reductions, int32 index-map constants).
-Remote-TPU tunnels that proxy a single chip (the "axon" plugin in this
-dev environment) currently cannot *execute* them — the terminal's
-compile helper is env-cleared and its runtime libtpu predates the client
-AOT libtpu — so the benchmark harness only engages this path when
-``CRDT_PALLAS=1`` is set on hardware with native Mosaic support; the jnp
-path is the portable default and the two are bit-identical
-(``tests/test_orswot_pallas.py``).
+Deployment note: the kernels **AOT-compile clean for v5e** — verified
+offline against a compile-only PJRT topology running the real Mosaic
+compiler (`reports/PALLAS_LOCAL_AOT.md`; the journey there:
+``reports/PALLAS_TPU_ATTEMPT.txt`` for the x64 pitfalls — 32-bit trace
+mode, signed-domain reductions, int32 index-map constants — plus the i1
+shape-cast, tiny-minor-broadcast, and scoped-VMEM fixes found by the
+local AOT loop).  What remains unproven is *execution* through the
+remote-TPU tunnel of this dev environment (terminal-side compile helper
+fragility, libtpu version skew), so the benchmark harness only engages
+this path when ``CRDT_PALLAS=1`` is set; the jnp path is the portable
+default and the two are bit-identical (``tests/test_orswot_pallas.py``).
 
 Semantics follow `/root/reference/src/orswot.rs:89-156` exactly — the
 asymmetric keep rules (`orswot.rs:94-103` vs `:132-138`), deferred-map

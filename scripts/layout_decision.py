@@ -47,6 +47,10 @@ FLIP = {
 }
 
 
+def _row(mode, ms):
+    return f"| {mode} | {'FAILED/TIMEOUT' if ms is None else f'{ms:.2f}'} |"
+
+
 def parse_results(path):
     """``RESULT <mode>: <float> ms...`` lines -> {mode: ms | None}."""
     out = {}
@@ -90,7 +94,7 @@ def main():
         (r for r in merge_rows if r[1] is not None), key=lambda r: r[1]
     )
     out_path = os.path.join(REPO, "reports", "LAYOUT_AB_TPU.md")
-    if not ranked and os.path.exists(out_path):
+    if not merge_rows and os.path.exists(out_path):
         # A capture with no merge contenders (e.g. the fold-only
         # experiment menu after the A/B concluded) must not clobber the
         # committed merge-layout decision with "no decision" — but the
@@ -109,10 +113,7 @@ def main():
             "| mode | ms |",
             "|---|---|",
         ]
-        for mode, ms in sorted(results.items()):
-            lines.append(
-                f"| {mode} | {'FAILED/TIMEOUT' if ms is None else f'{ms:.2f}'} |"
-            )
+        lines += [_row(mode, ms) for mode, ms in sorted(results.items())]
         with open(fold_path, "w") as f:
             f.write("\n".join(lines) + "\n")
         print(f"no merge contenders in {exp_log}; wrote {fold_path}, "
@@ -132,8 +133,7 @@ def main():
         "| mode | ms/merge |",
         "|---|---|",
     ]
-    for mode, ms in merge_rows:
-        lines.append(f"| {mode} | {'FAILED/TIMEOUT' if ms is None else f'{ms:.2f}'} |")
+    lines += [_row(mode, ms) for mode, ms in merge_rows]
     if ranked:
         winner = ranked[0][0]
         lines += [
@@ -154,10 +154,7 @@ def main():
     diag = {m: v for m, v in results.items() if m not in MERGE_MODES}
     if diag:
         lines += ["", "## Diagnostic modes", "", "| mode | ms |", "|---|---|"]
-        for mode, ms in sorted(diag.items()):
-            lines.append(
-                f"| {mode} | {'FAILED/TIMEOUT' if ms is None else f'{ms:.2f}'} |"
-            )
+        lines += [_row(mode, ms) for mode, ms in sorted(diag.items())]
 
     lines += ["", "## North-star fold (bench captures)", ""]
     if bench is None:

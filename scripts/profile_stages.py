@@ -134,14 +134,61 @@ def main():
     # default since the round-3 A/B).  TPU-only: on CPU it is
     # memory-bound by design (O(M) extra passes) and eats minutes of a
     # tunnel window's budget for a number we already know.
-    if jax.default_backend() == "tpu":
-        from crdt_tpu.ops import orswot_unrolled
+    if jax.default_backend() == "tpu" or "--all-stages" in sys.argv:
+        from crdt_tpu.ops import orswot_pallas, orswot_unrolled
 
         chain_time(
             lambda s: orswot_unrolled.merge_unrolled(*s, *rhs, m, d)[:5], lhs,
             "merge_unrolled (std layout)", bytes_moved=3 * state_bytes)
+
+        # unrolled-path internal stages (the shared tile math of
+        # crdt_tpu/ops/orswot_pallas.py, biased-int32 domain) — the TPU
+        # default dispatches here since the round-3 A/B, so the stage
+        # attribution that matters on-chip is THIS path's
+        op = orswot_pallas
+        u32 = [tuple(x.astype(jnp.uint32) if x.dtype != jnp.int32 else x
+                     for x in side) for side in (lhs, rhs)]
+        ka = op._to_kernel_dtype(u32[0])
+        kb = op._to_kernel_dtype(u32[1])
+
+        def step_align(s):
+            e2, bm = op._align_against(s[1], s[0], kb[1], kb[2])
+            return (jnp.maximum(s[0], jnp.where(op._emask(bm), e2, op.ZERO)),
+                    s[1])
+        chain_time(step_align, (ka[2], ka[1]), "unrolled: align (M^2 select)")
+
+        e2_0, bm_0 = op._align_against(ka[1], ka[2], kb[1], kb[2])
+        valid_a0 = ka[1] != op.EMPTY
+
+        def step_rule(s):
+            dots, e2 = s
+            out = op._merge_rule(
+                dots, e2, valid_a0 & op._nonempty(dots),
+                valid_a0 & op._nonempty(e2), valid_a0, ka[0], kb[0])
+            # both carries data-depend on the output so XLA can neither
+            # hoist the rule nor constant-fold e2 into the loop body
+            return (jnp.maximum(dots, out), jnp.maximum(e2, out))
+        chain_time(step_rule, (ka[2], e2_0), "unrolled: dot-algebra rule")
+
+        ids_cat0 = jnp.concatenate([ka[1], kb[1]], axis=-1)
+        live0 = ids_cat0 != op.EMPTY
+
+        def step_rank(s):
+            big = jnp.iinfo(jnp.int32).max
+            m_keys = jnp.where(live0, ids_cat0, big)
+            out_ids, out_dots, n_surv = op._rank_select(
+                m_keys, live0, ids_cat0, s[0], m)
+            # consume ids and the survivor count too, or XLA DCEs the
+            # id-pack sums and overflow reduce out of the timed stage
+            salt = (out_ids[..., :1] + n_surv[..., None])[..., None]
+            return (jnp.concatenate(
+                [jnp.maximum(out_dots, s[0][..., :m, :] ^ salt),
+                 s[0][..., m:, :]], axis=-2),)
+        chain_time(step_rank, (jnp.concatenate([ka[2], kb[2]], axis=-2),),
+                   "unrolled: member rank-select")
     else:
-        print("unrolled variant skipped (non-TPU backend)")
+        print("unrolled variant + stages skipped (non-TPU backend; "
+              "--all-stages to force)")
 
 
 if __name__ == "__main__":

@@ -674,7 +674,9 @@ def bench_tpu_validation():
     try:
         proc = subprocess.run(
             [sys.executable, script],
-            timeout=float(os.environ.get("CRDT_TPU_VALIDATE_TIMEOUT", "900")),
+            # the run now includes two north-star-scale compiles (see
+            # scripts/tpu_validate.py check_pallas_northstar)
+            timeout=float(os.environ.get("CRDT_TPU_VALIDATE_TIMEOUT", "1800")),
             capture_output=True,
             text=True,
         )
@@ -689,10 +691,18 @@ def bench_tpu_validation():
         err = te.stderr or b""
         if isinstance(err, bytes):
             err = err.decode(errors="replace")
+        out = te.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        # checks that finished before the hang printed flushed JSON lines
+        # — surface them, they are results, not casualties
+        for line in out.strip().splitlines():
+            log(f"tpu-validate: {line}")
         log("tpu-validate: TIMED OUT (Mosaic hang? repro captured)")
         _write_pallas_repro(
             f"timeout after {te.timeout}s — the compiled-Pallas attempt hung "
-            f"through the tunnel\nstderr tail:\n{err[-4000:]}"
+            f"through the tunnel\nstdout (completed checks):\n{out}\n"
+            f"stderr tail:\n{err[-4000:]}"
         )
 
 

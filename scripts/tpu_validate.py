@@ -36,6 +36,18 @@ def _timeit(fn, *args, iters=3):
     return float(np.median(ts)), out
 
 
+def _jnp_chain_fold(stack, r, m, d):
+    """Reference left fold + defer-plunger self-merge — must match
+    fold_merge's semantics exactly (the plunger flushes buffered
+    removes)."""
+    from crdt_tpu.ops import orswot_ops
+
+    acc = tuple(x[0] for x in stack)
+    for i in range(1, r):
+        acc = orswot_ops.merge(*acc, *(x[i] for x in stack), m, d)[:5]
+    return orswot_ops.merge(*acc, *acc, m, d)[:5]
+
+
 def check_pallas():
     """Compiled (interpret=False) Pallas fused fold vs the jnp fold:
     bit-exact outputs and a timing comparison, on the default backend."""
@@ -55,11 +67,7 @@ def check_pallas():
     )
 
     def jnp_fold(stack):
-        acc = tuple(x[0] for x in stack)
-        for i in range(1, r):
-            acc = orswot_ops.merge(*acc, *(x[i] for x in stack), m, d)[:5]
-        # fold_merge finishes with a defer-plunger self-merge; match it
-        return orswot_ops.merge(*acc, *acc, m, d)[:5]
+        return _jnp_chain_fold(stack, r, m, d)
 
     t_jnp, want = _timeit(jax.jit(jnp_fold), stacked)
     t_pal, got = _timeit(
@@ -80,7 +88,86 @@ def check_pallas():
         "pallas_ms": round(t_pal * 1e3, 2),
         "speedup_vs_jnp": round(t_jnp / t_pal, 3) if t_pal else None,
         "shapes": {"n": n, "a": a, "m": m, "d": d, "r": r},
-    }))
+        "tile": os.environ.get("CRDT_PALLAS_TILE", "auto"),
+    }), flush=True)
+    return parity
+
+
+def check_pallas_northstar():
+    """The fused Pallas fold vs the jnp chain fold on ONE north-star
+    chunk (r=8, 62.5k objects, a=64, m=16, deferred present): parity +
+    chained device-side timing (the per-dispatch tunnel sync would dwarf
+    a single fold, so both folds run as a salted lax.scan like the
+    benchmark's own timing path).  The local v5e AOT matrix
+    (`reports/PALLAS_LOCAL_AOT.md`) puts this compile at ~1 min."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from crdt_tpu.ops import orswot_ops, orswot_pallas
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    rng = np.random.RandomState(9)
+    r, n, a, m, d = 8, 62_500, 64, 16, 2
+    iters = 4
+    fleets = anti_entropy_fleets(
+        rng, n, a, m, d, r, base=6, novel=1, deferred_frac=0.25
+    )
+    stacked = tuple(
+        jnp.stack([jnp.asarray(rep[k]).astype(jnp.uint32)
+                   if rep[k].dtype.kind == "u" else jnp.asarray(rep[k])
+                   for rep in fleets])
+        for k in range(5)
+    )
+
+    def jnp_fold(stack):
+        return _jnp_chain_fold(stack, r, m, d)
+
+    def pal_fold(stack):
+        return orswot_pallas.fold_merge(*stack, m, d, interpret=interpret)[:5]
+
+    def chain_time(fold):
+        def step(carry):
+            salt, _ = carry
+            out = fold((stacked[0] ^ salt,) + stacked[1:])
+            return ((jnp.max(out[2]) & jnp.uint32(7)) | jnp.uint32(1), out)
+
+        @jax.jit
+        def run(init):
+            return lax.scan(
+                lambda c, _: (step(c), None), init, None, length=iters
+            )[0]
+
+        init = (jnp.uint32(1), tuple(x[0] for x in stacked))
+        out = run(init)
+        jax.block_until_ready(out)
+        tiny = jax.jit(lambda x: x + 1)
+        np.asarray(tiny(jnp.zeros((8,), jnp.uint32)))
+        t0 = time.perf_counter()
+        np.asarray(tiny(jnp.zeros((8,), jnp.uint32)))
+        sync = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = run(init)
+        np.asarray(out[1][0].ravel()[0])
+        return max(time.perf_counter() - t0 - sync, 1e-9) / iters, out[1]
+
+    t_jnp, want = chain_time(jnp_fold)
+    t_pal, got = chain_time(pal_fold)
+    parity = all(bool(jnp.array_equal(g, w)) for g, w in zip(got, want))
+    print(json.dumps({
+        "check": "pallas_fold_northstar_chunk",
+        "backend": backend,
+        "compiled": not interpret,
+        "parity": parity,
+        "jnp_ms_per_fold": round(t_jnp * 1e3, 2),
+        "pallas_ms_per_fold": round(t_pal * 1e3, 2),
+        "speedup_vs_jnp": round(t_jnp / t_pal, 3) if t_pal else None,
+        "pallas_merges_per_sec": round(n * r / t_pal, 1) if t_pal else None,
+        "shapes": {"n": n, "a": a, "m": m, "d": d, "r": r},
+        "tile": os.environ.get("CRDT_PALLAS_TILE", "auto"),
+    }), flush=True)
     return parity
 
 
@@ -115,7 +202,7 @@ def check_merge_parity():
         "backend": backend,
         "parity": parity,
         "n": n,
-    }))
+    }), flush=True)
     return parity
 
 
@@ -125,7 +212,31 @@ def main():
     if "--merge" in args:
         ok &= check_merge_parity()
     if "--pallas" in args:
+        # small-shape parity first (its t=128 tile is the slow compile —
+        # force a faster one; the env var is read at trace time and the
+        # north-star shapes retrace anyway)
+        import jax
+
+        user_tile = "CRDT_PALLAS_TILE" in os.environ
+        force_tile = not user_tile and jax.default_backend() == "tpu"
+        if force_tile:
+            # interpret mode prefers the big default tile (fewer python
+            # grid steps); compiled mode prefers the fast-compiling one
+            os.environ["CRDT_PALLAS_TILE"] = "32"
         ok &= check_pallas()
+        if force_tile:
+            del os.environ["CRDT_PALLAS_TILE"]
+        # the north-star chunk only on a real TPU backend (interpret mode
+        # at 62.5k x 8 would grind for hours); CRDT_PALLAS_NS=1 forces
+        if jax.default_backend() == "tpu" or os.environ.get("CRDT_PALLAS_NS") == "1":
+            try:
+                ok &= check_pallas_northstar()
+            except Exception as e:  # the small-shape result must survive
+                print(json.dumps({
+                    "check": "pallas_fold_northstar_chunk",
+                    "error": str(e)[:300],
+                }))
+                ok = False
     sys.exit(0 if ok else 1)
 
 

@@ -421,7 +421,7 @@ def bench_north_star():
         f"{t:.2f}s  {rate/1e6:.2f}M merges/s  "
         f"(device working set {state_bytes/1e9:.2f} GB/chunk-fold)"
     )
-    return rate, elision
+    return rate, elision, templates
 
 
 def bench_north_star_resident():
@@ -514,6 +514,113 @@ def bench_north_star_resident():
         "e2e_s": round(e2e, 2),
         "resident_merges_per_sec": round(merges / e2e, 1),
     }
+
+
+def bench_pallas_north_star(templates=None):
+    """Guarded shot at the fused Pallas fold as the headline kernel.
+
+    Runs LAST among the timed benches (after the resident fleet, before
+    the validation subprocess): a Mosaic compile crash through the
+    tunnel's remote-compile helper has been observed to wedge subsequent
+    compiles (reports/PALLAS_TPU_ATTEMPT.txt), so nothing that still
+    needs a compile may come after this.  TPU-only; every failure path
+    degrades to ``None`` and the jnp headline stands.
+
+    Parity gate: the fused fold must reproduce the scalar oracle on the
+    sample (the same `_north_star_parity` the jnp fold passes) before its
+    timing can be believed.  Timing: the same salted-scan chain as the
+    jnp path (one dispatch, tunnel sync paid once)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if jax.default_backend() != "tpu":
+        return None
+    if os.environ.get("CRDT_SKIP_PALLAS_HEADLINE") == "1":
+        log("north★ pallas: skipped (CRDT_SKIP_PALLAS_HEADLINE=1)")
+        return None
+    from crdt_tpu.ops import orswot_pallas
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(2)
+    if SMALL:
+        n, a, m, d, r, chunk = 2_000, 16, 8, 2, 4, 1_000
+        base, novel = 4, 1
+    else:
+        n, a, m, d, r, chunk = 1_250_000, 64, 16, 2, 8, 62_500
+        base, novel = 6, 1
+    deferred_frac = 0.25
+    n_chunks = max(2, n // chunk)
+
+    # mirror the terminal-side compile helper's documented workaround
+    # (reports/PALLAS_TPU_ATTEMPT.txt:12-14); harmless when unneeded
+    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    try:
+        if templates is None:
+            # standalone call: rebuild what bench_north_star would have
+            # handed over (same recipe, same RandomState seed)
+            templates = []
+            for _ in range(2):
+                reps = anti_entropy_fleets(
+                    rng, chunk, a, m, d, r,
+                    base=base, novel=novel, deferred_frac=deferred_frac,
+                )
+                templates.append(
+                    tuple(jnp.stack([rep[k] for rep in reps]) for k in range(5))
+                )
+
+        def fold_join(stack):
+            return orswot_pallas.fold_merge(*stack, m, d, interpret=False)[:5]
+
+        # parity gate BEFORE any timing — same oracle as the jnp fold
+        _north_star_parity(templates[0], r, a, m, d, fold_join)
+
+        # pre-pad the templates to the Pallas tile ONCE, outside the
+        # timed loop: 62500 is not a multiple of any pow2 tile, so
+        # fold_merge would otherwise re-pad (a full working-set copy,
+        # ~2x the fold's own traffic) inside every chunk-fold
+        templates = [
+            orswot_pallas.pad_to_tile(tpl, m, d, n_states=r + 1)
+            for tpl in templates
+        ]
+
+        t0_, t1_ = templates[0], templates[1]
+
+        def salted_fold(tpl, salt):
+            return fold_join((tpl[0] ^ salt,) + tpl[1:])
+
+        def next_salt(acc):
+            return (jnp.max(acc[2]) & jnp.uint32(7)) | jnp.uint32(1)
+
+        @jax.jit
+        def run_chunks(t0_, t1_):
+            def body(carry, _):
+                salt, _prev = carry
+                o0 = salted_fold(t0_, salt)
+                o1 = salted_fold(t1_, next_salt(o0))
+                return (next_salt(o1), o1), None
+
+            init = (jnp.uint32(1), tuple(x[0] for x in t0_))
+            (salt, out), _ = lax.scan(body, init, None, length=n_chunks // 2)
+            return out
+
+        out = run_chunks(t0_, t1_)
+        jax.block_until_ready(out)  # compile + warmup
+        sync_s = _sync_overhead()
+        t0 = time.perf_counter()
+        out = run_chunks(t0_, t1_)
+        np.asarray(out[0].ravel()[0])
+        t = max(time.perf_counter() - t0 - sync_s, 1e-9)
+        rate = n_chunks * chunk * r / t
+        log(
+            f"north★ pallas fused fold: {t:.2f}s  {rate/1e6:.2f}M merges/s "
+            f"(same scale/salt-chain as the jnp fold)"
+        )
+        return round(rate, 1)
+    except Exception as e:
+        log(f"north★ pallas attempt failed (jnp headline stands): {str(e)[:300]}")
+        return None
 
 
 def _north_star_parity(template, r, a, m, d, fold_join):
@@ -824,17 +931,36 @@ def main():
     # north star BEFORE the Pallas validation attempt: a Mosaic compile
     # crash can take the tunnel's remote-compile helper down with it,
     # which must not be able to cost us the headline metric
-    rate, elision = bench_north_star()
+    rate, elision, ns_templates = bench_north_star()
     resident = bench_north_star_resident()
+    # the Pallas attempt runs AFTER every jnp metric is banked (a Mosaic
+    # crash can wedge the tunnel's compile helper) and can only ever
+    # raise the headline, never lose it.  Under CRDT_PALLAS=1 the north
+    # star above already timed the Pallas fold — label it, skip the
+    # redundant second measurement.
+    pallas_primary = (
+        os.environ.get("CRDT_PALLAS") == "1" and jax.default_backend() == "tpu"
+    )
+    pallas_rate = None if pallas_primary else bench_pallas_north_star(ns_templates)
     bench_tpu_validation()
+
+    headline = rate
+    kernel = {"kernel": "pallas_fused_fold" if pallas_primary else "jnp_fold"}
+    if pallas_rate is not None and pallas_rate > rate:
+        headline = pallas_rate
+        kernel = {"kernel": "pallas_fused_fold",
+                  "jnp_merges_per_sec": round(rate, 1)}
+    elif pallas_rate is not None:
+        kernel["pallas_merges_per_sec"] = pallas_rate
 
     print(
         json.dumps(
             {
                 "metric": "orswot_merges_per_sec_to_fixpoint",
-                "value": round(rate, 1),
+                "value": round(headline, 1),
                 "unit": "merges/s",
-                "vs_baseline": round(rate / 1e7, 4),
+                "vs_baseline": round(headline / 1e7, 4),
+                **kernel,
                 "platform": jax.default_backend(),
                 "backend_fallback": fallback,
                 "distinct_objects": resident["distinct_replica_objects"],

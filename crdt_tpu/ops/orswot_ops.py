@@ -252,10 +252,14 @@ def _merge_impl_default():
     """Which pairwise-merge implementation ``merge`` dispatches to.
 
     ``CRDT_MERGE_IMPL`` ∈ ``rank`` (the rank-select pipeline below, CPU
-    default) or ``unrolled`` (gather/sort-free tile math,
+    default), ``unrolled`` (gather/sort-free tile math,
     :mod:`crdt_tpu.ops.orswot_unrolled`; exact for uint32 counters only —
     bit-equal outside the conservative-overflow objects, see
-    ``tests/test_orswot_unrolled.py``).  The unset default is
+    ``tests/test_orswot_unrolled.py``), or ``pallas`` (the fused
+    single-HBM-pass kernel, :mod:`crdt_tpu.ops.orswot_pallas` — same
+    tile math as ``unrolled`` but the whole merge stays in VMEM;
+    compiled on TPU, interpret-emulated elsewhere; 2-D batches and u32
+    only, else falls through).  The unset default is
     backend-dispatched per the round-3 on-chip layout A/B
     (`reports/LAYOUT_AB_TPU.md`): ``unrolled`` on TPU (54.0 ms vs the
     rank path's 57.7 ms at config-4 shapes), ``rank`` elsewhere (the
@@ -299,9 +303,26 @@ def merge(
     the full-width pipeline.
     """
     impl = _merge_impl_default()
-    if impl not in ("rank", "unrolled"):
+    if impl not in ("rank", "unrolled", "pallas"):
         raise ValueError(
-            f"CRDT_MERGE_IMPL={impl!r} is not one of rank/unrolled"
+            f"CRDT_MERGE_IMPL={impl!r} is not one of rank/unrolled/pallas"
+        )
+    if (
+        impl == "pallas"
+        and clock_a.dtype.itemsize <= 4
+        and ids_a.shape[-1] <= _ALIGN_MATCH_MAX_M
+        and clock_a.ndim == 2
+    ):
+        # the fused single-HBM-pass kernel (interpret-mode emulation off
+        # TPU); 2-D [N, ...] batches only — the pallas_call grid blocks
+        # the leading object axis.  Wide tables / u64 / higher-rank
+        # batches fall through to the paths below.
+        from . import orswot_pallas
+
+        return orswot_pallas.merge(
+            clock_a, ids_a, dots_a, dids_a, dclocks_a,
+            clock_b, ids_b, dots_b, dids_b, dclocks_b,
+            m_cap, d_cap,
         )
     if (
         impl == "unrolled"

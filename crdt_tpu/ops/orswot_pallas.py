@@ -481,6 +481,22 @@ def merge(
     )
 
 
+def pad_to_tile(state, m_cap: int, d_cap: int, n_states: int):
+    """Pad ``[R, N, ...]`` stacked planes on the object axis to the fold's
+    tile size, with the module's own fill policy (``EMPTY`` for id planes,
+    0 for counter planes) — so callers can pay the padding copy ONCE
+    outside a timed loop and :func:`fold_merge`'s internal `_pad_to`
+    becomes a no-op.  Returns the padded 5-tuple."""
+    a = state[0].shape[-1]
+    m = state[1].shape[-1]
+    d = state[3].shape[-1]
+    t = _tile_size(a, m, d, n_states=n_states)
+    return tuple(
+        _pad_to(x, t, axis=1, fill=EMPTY if x.dtype == jnp.int32 else 0)
+        for x in state
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret", "plunger"))
 def fold_merge(
     clock, ids, dots, dids, dclocks,

@@ -96,6 +96,18 @@ elif mode == "merge_unrolled":
     )
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
 
+elif mode == "merge_pallas":
+    # fused single-HBM-pass pairwise kernel via the CRDT_MERGE_IMPL=pallas
+    # dispatch (compiled Mosaic on TPU).  Run LAST in any window: a Mosaic
+    # crash can wedge the tunnel's remote-compile helper.
+    n, a, m, d = 100_000, 16, 8, 4
+    lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
+    from crdt_tpu.ops import orswot_pallas
+    t = chain(
+        lambda acc: orswot_pallas.merge(*acc, *rhs, m, d)[:5], lhs, iters=20)
+    print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
+
 elif mode in ("order_rank", "order_argsort"):
     n, s = 200_000, 32
     keys = jnp.asarray(rng.randint(0, 1 << 20, size=(n, s)).astype(np.int32))
@@ -225,6 +237,9 @@ def main():
         ("dtype_u64", {"CRDT_TPU_NO_X64": "0"}, 900),
         ("fold_seq", None, 1500),
         ("fold_tree", None, 1500),
+        # compiled-Mosaic contender: keep LAST — a Mosaic crash can wedge
+        # the tunnel's remote-compile helper for the rest of the window
+        ("merge_pallas", None, 1500),
     ]
     # CRDT_EXP_MODES=comma,separated,subset restricts the menu (tunnel
     # windows are short — spend them on the undecided experiments)

@@ -14,6 +14,12 @@ cd /root/repo
 # windows skip recompiling unchanged programs, so a window spends its
 # minutes measuring instead of compiling
 export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}
+# libtpu-init workaround from the captured Mosaic failure
+# (reports/PALLAS_TPU_ATTEMPT.txt:12-14) — every step that might compile
+# Pallas (bench auto-attempt, experiments_pallas, tpu_validate) needs it,
+# and it is harmless for the rest
+export TPU_ACCELERATOR_TYPE=${TPU_ACCELERATOR_TYPE:-v5litepod-1}
+export TPU_WORKER_HOSTNAMES=${TPU_WORKER_HOSTNAMES:-localhost}
 
 step() {  # step <name> <timeout> <log> <cmd...>
     local name=$1 tmo=$2 log=$3; shift 3
@@ -98,9 +104,14 @@ for i in $(seq 1 600); do
         step pallas 1800 /tmp/pallas_tpu.log \
             env TPU_ACCELERATOR_TYPE=v5litepod-1 TPU_WORKER_HOSTNAMES=localhost \
             python scripts/tpu_validate.py --pallas
+        # pairwise compiled-Mosaic contender, also crash-risky: very last
+        step experiments_pallas 1800 /tmp/experiments_pallas_tpu.log \
+            env CRDT_EXP_MODES=merge_pallas \
+            python scripts/tpu_experiments.py
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
            [ -e "$MARK/bench" ] && \
-           [ -e "$MARK/validate_merge" ] && [ -e "$MARK/pallas" ]; then
+           [ -e "$MARK/validate_merge" ] && [ -e "$MARK/pallas" ] && \
+           [ -e "$MARK/experiments_pallas" ]; then
             echo "$(date -u +%H:%M:%S) all captures done (rev $REV)" | tee -a /tmp/tunnel_watch.log
             exit 0
         fi

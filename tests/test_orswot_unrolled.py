@@ -79,10 +79,13 @@ def test_merge_impl_dispatch(monkeypatch):
     rng = np.random.RandomState(23)
     lhs, rhs = _pair(rng, 19, 4, 3, 2, deferred_frac=0.3)
     outs = {}
-    for impl in ("rank", "unrolled"):
+    for impl in ("rank", "unrolled", "pallas"):
+        # pallas: 2-D batch dispatch to the fused kernel (interpret-mode
+        # emulation on the CPU test backend)
         monkeypatch.setenv("CRDT_MERGE_IMPL", impl)
         outs[impl] = orswot_ops.merge(*lhs, *rhs, 3, 2)
     _assert_same(outs["rank"], outs["unrolled"])
+    _assert_same(outs["rank"], outs["pallas"])
 
     # rank > 2 (e.g. the tree fold's [R/2, N, ...] batches)
     monkeypatch.setenv("CRDT_MERGE_IMPL", "unrolled")
@@ -95,10 +98,16 @@ def test_merge_impl_dispatch(monkeypatch):
 
     # unknown impl names error instead of silently picking a variant
     # (the deleted lanes-last variant must now be rejected too)
-    for bad in ("pallas", "lanes"):
+    for bad in ("lanes", "nway"):
         monkeypatch.setenv("CRDT_MERGE_IMPL", bad)
         with pytest.raises(ValueError, match="CRDT_MERGE_IMPL"):
             orswot_ops.merge(*lhs, *rhs, 3, 2)
+
+    # pallas on a rank>2 batch falls through to a non-pallas path
+    # (the pallas_call grid blocks a 2-D leading axis only)
+    monkeypatch.setenv("CRDT_MERGE_IMPL", "pallas")
+    got = orswot_ops.merge(*stacked_l, *stacked_r, 3, 2)
+    _assert_same(want, got)
 
 
 @functools.lru_cache(maxsize=None)

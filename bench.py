@@ -558,58 +558,77 @@ def bench_pallas_north_star(templates=None):
     os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
     try:
         if templates is None:
-            # standalone call: rebuild what bench_north_star would have
-            # handed over (same recipe, same RandomState seed)
-            templates = []
-            for _ in range(2):
-                reps = anti_entropy_fleets(
-                    rng, chunk, a, m, d, r,
-                    base=base, novel=novel, deferred_frac=deferred_frac,
-                )
-                templates.append(
-                    tuple(jnp.stack([rep[k] for rep in reps]) for k in range(5))
-                )
+            # standalone call: rebuild the first template bench_north_star
+            # would have handed over (same recipe, same RandomState seed);
+            # only templates[0] is used since the single-template rewire
+            reps = anti_entropy_fleets(
+                rng, chunk, a, m, d, r,
+                base=base, novel=novel, deferred_frac=deferred_frac,
+            )
+            templates = [
+                tuple(jnp.stack([rep[k] for rep in reps]) for k in range(5))
+            ]
 
-        def fold_join(stack):
-            return orswot_pallas.fold_merge(*stack, m, d, interpret=False)[:5]
+        def fold_prebiased_roundtrip(stack):
+            # the gate must validate the SAME compiled program the timing
+            # runs: bias in, fold prebiased, unbias out
+            biased = orswot_pallas.to_kernel_domain(stack)
+            out = orswot_pallas.fold_merge(
+                *biased, m, d, interpret=False, prebiased=True
+            )[:5]
+            cdt = stack[0].dtype
+            return (
+                orswot_pallas.from_kernel_domain(out[0], cdt), out[1],
+                orswot_pallas.from_kernel_domain(out[2], cdt), out[3],
+                orswot_pallas.from_kernel_domain(out[4], cdt),
+            )
 
-        # parity gate BEFORE any timing — same oracle as the jnp fold
-        _north_star_parity(templates[0], r, a, m, d, fold_join)
+        # parity gate BEFORE any timing — same oracle as the jnp fold,
+        # through the prebiased compiled path the timing uses
+        _north_star_parity(templates[0], r, a, m, d, fold_prebiased_roundtrip)
 
-        # pre-pad the templates to the Pallas tile ONCE, outside the
-        # timed loop: 62500 is not a multiple of any pow2 tile, so
-        # fold_merge would otherwise re-pad (a full working-set copy,
-        # ~2x the fold's own traffic) inside every chunk-fold
-        templates = [
-            orswot_pallas.pad_to_tile(tpl, m, d, n_states=r + 1)
-            for tpl in templates
-        ]
+        # pre-pad to the Pallas tile AND pre-bias into the kernel's
+        # int32 domain ONCE, outside the timed loop: fold_merge would
+        # otherwise re-pad and re-convert (two full working-set copies,
+        # ~2x the fold's own traffic) inside every chunk-fold.  XOR
+        # salting commutes with the bias, so the salt chain is unchanged.
+        # ONE template only: with both, XLA's layout copies around the
+        # custom call put the program at 17.3 GB on a 16 GB chip (local
+        # AOT memory analysis); one template + the salt chain is 8.8 GB
+        # and the kernels are data-oblivious, so per-chunk distinctness
+        # is cosmetic for the work measured.
+        tpl = orswot_pallas.to_kernel_domain(
+            orswot_pallas.pad_to_tile(templates[0], m, d, n_states=r + 1)
+        )
 
-        t0_, t1_ = templates[0], templates[1]
+        def fold_biased(stack):
+            return orswot_pallas.fold_merge(
+                *stack, m, d, interpret=False, prebiased=True
+            )[:5]
 
-        def salted_fold(tpl, salt):
-            return fold_join((tpl[0] ^ salt,) + tpl[1:])
+        def salted_fold(tpl_, salt):
+            return fold_biased((tpl_[0] ^ salt,) + tpl_[1:])
 
         def next_salt(acc):
-            return (jnp.max(acc[2]) & jnp.uint32(7)) | jnp.uint32(1)
+            # biased domain: max is order-preserving, low bits unchanged
+            return (jnp.max(acc[2]).astype(jnp.int32) & jnp.int32(7)) | jnp.int32(1)
 
         @jax.jit
-        def run_chunks(t0_, t1_):
+        def run_chunks(tpl_):
             def body(carry, _):
                 salt, _prev = carry
-                o0 = salted_fold(t0_, salt)
-                o1 = salted_fold(t1_, next_salt(o0))
-                return (next_salt(o1), o1), None
+                o = salted_fold(tpl_, salt)
+                return (next_salt(o), o), None
 
-            init = (jnp.uint32(1), tuple(x[0] for x in t0_))
-            (salt, out), _ = lax.scan(body, init, None, length=n_chunks // 2)
+            init = (jnp.int32(1), tuple(x[0] for x in tpl_))
+            (salt, out), _ = lax.scan(body, init, None, length=n_chunks)
             return out
 
-        out = run_chunks(t0_, t1_)
+        out = run_chunks(tpl)
         jax.block_until_ready(out)  # compile + warmup
         sync_s = _sync_overhead()
         t0 = time.perf_counter()
-        out = run_chunks(t0_, t1_)
+        out = run_chunks(tpl)
         np.asarray(out[0].ravel()[0])
         t = max(time.perf_counter() - t0 - sync_s, 1e-9)
         rate = n_chunks * chunk * r / t

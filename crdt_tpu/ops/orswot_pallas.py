@@ -497,10 +497,29 @@ def pad_to_tile(state, m_cap: int, d_cap: int, n_states: int):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret", "plunger"))
+def to_kernel_domain(state):
+    """Public: map a canonical 5-tuple of ``[R, N, ...]`` planes into the
+    kernel's biased-int32 domain (see :func:`_to_kernel_dtype`).  Pair
+    with ``fold_merge(..., prebiased=True)`` to hoist the uint32↔int32
+    conversion copies (~a full working set per call) out of a timed loop;
+    XOR salting commutes with the bias, so salt chains work unchanged in
+    this domain.  Rejects >32-bit counters like the in-band path (the
+    bias cast would silently truncate them)."""
+    _check_dtypes(state[0])
+    return _to_kernel_dtype(state)
+
+
+def from_kernel_domain(x, dtype):
+    """Public inverse of :func:`to_kernel_domain` for one counter plane."""
+    return _from_kernel_dtype(x, dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "m_cap", "d_cap", "interpret", "plunger", "prebiased"))
 def fold_merge(
     clock, ids, dots, dids, dclocks,
     m_cap: int, d_cap: int, interpret: bool | None = None, plunger: bool = True,
+    prebiased: bool = False,
 ):
     """Anti-entropy fold: join ``R`` stacked replica fleets (arrays are
     ``[R, N, ...]``) into one ``[N, ...]`` state, entirely in VMEM.
@@ -509,8 +528,12 @@ def fold_merge(
     finishes with a defer-plunger self-merge
     (`/root/reference/test/orswot.rs:61-62`) so buffered removes flush —
     matching ``r`` sequential ``orswot_ops.merge`` calls bit-exactly, but
-    with the accumulator never leaving the chip."""
-    _check_dtypes(clock)
+    with the accumulator never leaving the chip.
+
+    ``prebiased=True``: the counter planes are already in the kernel's
+    biased-int32 domain (:func:`to_kernel_domain`) and the outputs stay
+    there — the entry/exit conversion copies drop out entirely (callers
+    invert with :func:`from_kernel_domain` once, outside their loop)."""
     if interpret is None:
         interpret = _interpret_default()
     r, n, a = clock.shape
@@ -518,12 +541,26 @@ def fold_merge(
     # all R replica blocks plus the accumulator are live in VMEM per tile
     t = _tile_size(a, m, d, n_states=r + 1)
     state = (clock, ids, dots, dids, dclocks)
-    state = tuple(
-        _pad_to(x, t, axis=1, fill=EMPTY if x.dtype == jnp.int32 else 0) for x in state
-    )
-    state = _to_kernel_dtype(state)
+    if prebiased:
+        if clock.dtype != jnp.int32:
+            raise TypeError(
+                f"prebiased fold expects int32 kernel-domain planes, got "
+                f"{clock.dtype}; use to_kernel_domain() first"
+            )
+        cdt = None
+        state = tuple(
+            _pad_to(x, t, axis=1, fill=EMPTY if i in (1, 3) else ZERO)
+            for i, x in enumerate(state)
+        )
+    else:
+        _check_dtypes(clock)
+        cdt = clock.dtype
+        state = tuple(
+            _pad_to(x, t, axis=1, fill=EMPTY if x.dtype == jnp.int32 else 0)
+            for x in state
+        )
+        state = _to_kernel_dtype(state)
     n_pad = state[0].shape[1]
-    cdt = clock.dtype
 
     def kernel(ca, ia, da, dia, dca, oc, oi, od, odi, odc, oover):
         refs = (ca, ia, da, dia, dca)
@@ -570,6 +607,8 @@ def fold_merge(
             interpret=interpret,
         )(*state)
     c, i, dts, di, dc, over = (x[:n] for x in out)
+    if prebiased:
+        return c, i, dts, di, dc, over.astype(bool)
     return (
         _from_kernel_dtype(c, cdt), i, _from_kernel_dtype(dts, cdt), di,
         _from_kernel_dtype(dc, cdt), over.astype(bool),

@@ -71,6 +71,23 @@ def test_fold_merge_matches_sequential_fold():
     got = orswot_pallas.fold_merge(*stacked, m, d, interpret=True)
     _assert_same(acc + (over,), got)
 
+    # the pre-biased entry point (bench hot path): pad+bias once outside,
+    # fold in the kernel domain, unbias once after — bit-equal
+    padded = orswot_pallas.pad_to_tile(stacked, m, d, n_states=r + 1)
+    biased = orswot_pallas.to_kernel_domain(padded)
+    gb = orswot_pallas.fold_merge(
+        *biased, m, d, interpret=True, prebiased=True
+    )
+    unb = (
+        orswot_pallas.from_kernel_domain(gb[0], jnp.uint32)[:n],
+        gb[1][:n],
+        orswot_pallas.from_kernel_domain(gb[2], jnp.uint32)[:n],
+        gb[3][:n],
+        orswot_pallas.from_kernel_domain(gb[4], jnp.uint32)[:n],
+        gb[5][:n],
+    )
+    _assert_same(acc + (over,), unb)
+
 
 def test_overflow_flag_parity():
     # force member-capacity overflow: disjoint member sets, tiny m_cap

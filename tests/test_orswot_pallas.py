@@ -131,3 +131,40 @@ def test_full_uint32_counter_range_parity():
     got = orswot_pallas.merge(*lhs, *rhs, m, d, interpret=True)
     _assert_same(ref, got)
     assert int(np.asarray(got[0]).max()) >= 1 << 31, "fixture must exercise the high half"
+
+
+def test_salt_chain_commutes_with_bias():
+    """The bench's headline attempt salts in the kernel's biased domain
+    (bench.py bench_pallas_north_star): XOR commutes with the x^0x80000000
+    bias, so salting-then-biasing equals biasing-then-salting, and the
+    biased-domain next_salt (max & 7 | 1) picks the same salt values."""
+    rng = np.random.RandomState(7)
+    n, a, m, d, r = 17, 8, 4, 2, 4
+    reps = [
+        tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d, np.uint32))
+        for _ in range(r)
+    ]
+    stacked = tuple(jnp.stack([rep[i] for rep in reps]) for i in range(5))
+    padded = orswot_pallas.pad_to_tile(stacked, m, d, n_states=r + 1)
+    biased = orswot_pallas.to_kernel_domain(padded)
+
+    salt = 5
+    # unbiased domain: salt the clock plane, fold, read next_salt bits
+    u_salted = (padded[0] ^ jnp.uint32(salt),) + padded[1:]
+    u_out = orswot_pallas.fold_merge(*u_salted, m, d, interpret=True)[:5]
+    u_next = int(jnp.max(u_out[2]) & jnp.uint32(7)) | 1
+
+    # biased domain: same salt applied to the biased plane
+    b_salted = (biased[0] ^ jnp.int32(salt),) + biased[1:]
+    b_out = orswot_pallas.fold_merge(
+        *b_salted, m, d, interpret=True, prebiased=True
+    )[:5]
+    b_next = int(jnp.max(b_out[2]).astype(jnp.int32) & jnp.int32(7)) | 1
+
+    assert u_next == b_next, "next_salt must agree across domains"
+    for k, (u, b) in enumerate(zip(u_out, b_out)):
+        if k in (1, 3):  # id planes are unbiased in both
+            assert jnp.array_equal(u, b), f"plane {k}"
+        else:
+            unb = orswot_pallas.from_kernel_domain(b, jnp.uint32)
+            assert jnp.array_equal(u, unb), f"plane {k}"

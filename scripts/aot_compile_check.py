@@ -1,0 +1,172 @@
+"""Local v5e AOT compile checks — no TPU device or tunnel needed.
+
+Builds a compile-only PJRT TPU topology from the local libtpu and runs
+the REAL Mosaic/XLA compile pipeline on the framework's hot programs,
+printing compile time and the executable's memory plan.  This is the
+loop that broke the two-round compiled-Pallas barrier and caught a
+17.3 GB memory plan before it could OOM a 16 GB chip — see
+`reports/PALLAS_LOCAL_AOT.md` for findings and caveats (notably: libtpu
+takes `/tmp/libtpu_lockfile`, so run one instance at a time).
+
+    python scripts/aot_compile_check.py merge      # pairwise Pallas merge
+    python scripts/aot_compile_check.py fold       # small fused fold (r=4)
+    python scripts/aot_compile_check.py fold_ns    # north-star fold (r=8, 62.5k)
+    python scripts/aot_compile_check.py scan_ns    # bench's prebiased salted scan
+    python scripts/aot_compile_check.py jnp_ns     # jnp chunk-fold (HLO stats)
+
+Honors CRDT_PALLAS_TILE for tile experiments.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+
+sys.setrecursionlimit(100000)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import SingleDeviceSharding  # noqa: E402
+
+
+def _topology_sharding():
+    # "v5e:1x1" is rejected (not divisible by the default 2x2x1
+    # chips-per-host bounds); 2x2 compiles the identical single-core
+    # program
+    topo = topologies.get_topology_desc("v5e:2x2", platform="tpu")
+    return SingleDeviceSharding(topo.devices[0])
+
+
+def _report(lowered):
+    t0 = time.time()
+    compiled = lowered.compile()
+    dt = time.time() - t0
+    ma = compiled.memory_analysis()
+    total = ma.argument_size_in_bytes + ma.temp_size_in_bytes + ma.output_size_in_bytes
+    print(f"COMPILE_OK in {dt:.1f}s")
+    print(
+        f"memory plan: args {ma.argument_size_in_bytes/1e9:.2f} GB  "
+        f"temp {ma.temp_size_in_bytes/1e9:.2f} GB  "
+        f"out {ma.output_size_in_bytes/1e9:.2f} GB  "
+        f"TOTAL {total/1e9:.2f} GB  (v5e HBM: 16 GB)"
+    )
+    return compiled
+
+
+def _stack_specs(sh, r, n, a, m, d, dtype):
+    return (
+        jax.ShapeDtypeStruct((r, n, a), dtype, sharding=sh),
+        jax.ShapeDtypeStruct((r, n, m), jnp.int32, sharding=sh),
+        jax.ShapeDtypeStruct((r, n, m, a), dtype, sharding=sh),
+        jax.ShapeDtypeStruct((r, n, d), jnp.int32, sharding=sh),
+        jax.ShapeDtypeStruct((r, n, d, a), dtype, sharding=sh),
+    )
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "fold_ns"
+    sh = _topology_sharding()
+    from crdt_tpu.ops import orswot_pallas
+
+    if which == "merge":
+        n, a, m, d = 2048, 16, 8, 2
+        side = (
+            jax.ShapeDtypeStruct((n, a), jnp.uint32, sharding=sh),
+            jax.ShapeDtypeStruct((n, m), jnp.int32, sharding=sh),
+            jax.ShapeDtypeStruct((n, m, a), jnp.uint32, sharding=sh),
+            jax.ShapeDtypeStruct((n, d), jnp.int32, sharding=sh),
+            jax.ShapeDtypeStruct((n, d, a), jnp.uint32, sharding=sh),
+        )
+        lowered = jax.jit(
+            lambda L, R: orswot_pallas.merge(*L, *R, m, d, interpret=False)
+        ).trace(side, side).lower()
+        _report(lowered)
+        return
+
+    if which == "fold":
+        r, n, a, m, d = 4, 4096, 16, 8, 2
+    else:
+        r, n, a, m, d = 8, 62_500, 64, 16, 2
+
+    if which in ("fold", "fold_ns"):
+        shaped = _stack_specs(sh, r, n, a, m, d, jnp.uint32)
+        lowered = jax.jit(
+            lambda *s: orswot_pallas.fold_merge(*s, m, d, interpret=False)
+        ).trace(*shaped).lower()
+        _report(lowered)
+        return
+
+    if which == "scan_ns":
+        # the bench's actual timed program: salted scan of prebiased
+        # folds.  MIRRORS bench.py bench_pallas_north_star's run_chunks —
+        # if that changes (chunk size, salt formula, scan length), update
+        # this copy or its memory plan stops describing the real bench
+        n_total = 1_250_000  # bench north-star object count
+        n_chunks = n_total // n
+        t = orswot_pallas._tile_size(a, m, d, n_states=r + 1)
+        n_pad = n + ((-n) % t)
+        shaped = _stack_specs(sh, r, n_pad, a, m, d, jnp.int32)
+        i32 = jnp.int32
+
+        def run_chunks(*tpl):
+            def fold_biased(stack):
+                return orswot_pallas.fold_merge(
+                    *stack, m, d, interpret=False, prebiased=True
+                )[:5]
+
+            def next_salt(acc):
+                return (jnp.max(acc[2]).astype(i32) & i32(7)) | i32(1)
+
+            def body(carry, _):
+                salt, _prev = carry
+                o = fold_biased((tpl[0] ^ salt,) + tpl[1:])
+                return (next_salt(o), o), None
+
+            init = (i32(1), tuple(x[0] for x in tpl))
+            (_, out), _ = lax.scan(body, init, None, length=n_chunks)
+            return out
+
+        lowered = jax.jit(run_chunks).trace(*shaped).lower()
+        _report(lowered)
+        return
+
+    if which == "jnp_ns":
+        os.environ.setdefault("CRDT_MERGE_IMPL", "unrolled")
+        from crdt_tpu.ops import orswot_ops
+
+        shaped = _stack_specs(sh, r, n, a, m, d, jnp.uint32)
+
+        def fold(*stack):
+            acc = tuple(x[0] for x in stack)
+            for k in range(1, r):
+                acc = orswot_ops.merge(*acc, *(x[k] for x in stack), m, d)[:5]
+            return orswot_ops.merge(*acc, *acc, m, d)[:5]
+
+        lowered = jax.jit(fold).trace(*shaped).lower()
+        compiled = _report(lowered)
+        txt = compiled.as_text()
+        import re
+        from collections import Counter
+
+        ops = Counter(re.findall(r"= \S+ (\w+)\(", txt))
+        print("top HLO ops:", ops.most_common(8))
+        print("fusions:", txt.count("fusion("), " HLO lines:", txt.count("\n"))
+        return
+
+    raise SystemExit(f"unknown program {which!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -4,10 +4,11 @@ The jnp path (``orswot_ops``) is itself bit-exact against the scalar engine
 (``tests/test_parity.py``), so equality here gives transitive parity with
 the reference semantics (`/root/reference/src/orswot.rs:89-156`).
 
-Kernels run in Pallas interpret mode on the CPU test mesh; compiled-mode
-behavior is exercised by the benchmark harness when real TPU hardware
-supports Mosaic (the axon tunnel in this environment does not — see
-``orswot_pallas`` module docs).
+Kernels run in Pallas interpret mode on the CPU test mesh.  Compiled-mode
+behavior is validated offline by the local v5e AOT loop
+(``scripts/aot_compile_check.py``, `reports/PALLAS_LOCAL_AOT.md`) and
+on-chip by the benchmark harness / ``scripts/tpu_validate.py --pallas``
+when the tunnel is up.
 """
 
 import numpy as np

@@ -116,13 +116,14 @@ def test_allgather_join_orswot_matches_scalar():
         assert got == expected, f"replica shard {r} diverged"
 
 
-@pytest.mark.parametrize("impl", ["unrolled"])
+@pytest.mark.parametrize("impl", ["unrolled", "pallas"])
 def test_allgather_join_orswot_merge_impl_variants(impl, monkeypatch):
-    """The CRDT_MERGE_IMPL unrolled variant (the TPU default) composes
+    """The CRDT_MERGE_IMPL variants (unrolled — the TPU default — and
+    the fused pallas kernel, interpret-emulated on the CPU mesh) compose
     with the collective join: the combiner inside the all-gather fold
     routes through orswot_ops.merge, whose dispatch must behave
     identically under shard_map's per-shard (rank-2) views.  u32
-    counters — the variant's supported width."""
+    counters — the variants' supported width."""
     # CRDT_MERGE_IMPL is read at trace time and jit caches key on shapes
     # only: without clearing, the second param would silently reuse the
     # first param's traced impl (both params use identical shapes)

@@ -48,9 +48,12 @@ mode, signed-domain reductions, int32 index-map constants — plus the i1
 shape-cast, tiny-minor-broadcast, and scoped-VMEM fixes found by the
 local AOT loop).  What remains unproven is *execution* through the
 remote-TPU tunnel of this dev environment (terminal-side compile helper
-fragility, libtpu version skew), so the benchmark harness only engages
-this path when ``CRDT_PALLAS=1`` is set; the jnp path is the portable
-default and the two are bit-identical (``tests/test_orswot_pallas.py``).
+fragility, libtpu version skew).  On TPU backends the benchmark harness
+auto-attempts the fused fold after its jnp metrics are banked —
+parity-gated against the scalar oracle, promoted to the headline only
+if it wins (``CRDT_SKIP_PALLAS_HEADLINE=1`` disables the attempt);
+the jnp path is the portable default and the two are bit-identical
+(``tests/test_orswot_pallas.py``).
 
 Semantics follow `/root/reference/src/orswot.rs:89-156` exactly — the
 asymmetric keep rules (`orswot.rs:94-103` vs `:132-138`), deferred-map

@@ -81,7 +81,10 @@ def main():
     from crdt_tpu.ops import orswot_pallas
 
     if which == "merge":
-        n, a, m, d = 2048, 16, 8, 2
+        # default = the merge_pallas experiment's config-4 shapes
+        # (scripts/tpu_experiments.py); override with CRDT_AOT_SHAPE=n,a,m,d
+        shape = os.environ.get("CRDT_AOT_SHAPE", "100000,16,8,4")
+        n, a, m, d = (int(x) for x in shape.split(","))
         side = (
             jax.ShapeDtypeStruct((n, a), jnp.uint32, sharding=sh),
             jax.ShapeDtypeStruct((n, m), jnp.int32, sharding=sh),

@@ -50,7 +50,11 @@ def chain(step, init, iters):
     np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
     return max(time.perf_counter() - t0 - sync, 1e-9) / iters
 
-if mode in ("fold_seq", "fold_tree"):
+if mode in ("fold_seq", "fold_tree", "fold_seq_rank"):
+    # fold_seq_rank: the same sequential fold with CRDT_MERGE_IMPL=rank
+    # (parent sets the env) — local AOT shows rank compiles to FEWER
+    # kernels (583 vs 785 fusions) but MORE temp (4.8 vs 3.2 GB) at
+    # north-star shapes, so the config-4 A/B verdict may not transfer
     n, a, m, d, r = 62_500, 64, 16, 2, 8
     fleets = anti_entropy_fleets(rng, n, a, m, d, r, base=6, novel=1,
                                  deferred_frac=0.25)
@@ -235,8 +239,11 @@ def main():
         ("scatter_put", None, 900),
         ("dtype_u32", {"CRDT_TPU_NO_X64": "0"}, 900),
         ("dtype_u64", {"CRDT_TPU_NO_X64": "0"}, 900),
-        ("fold_seq", None, 1500),
-        ("fold_tree", None, 1500),
+        # fold impls pinned explicitly: an ambient CRDT_MERGE_IMPL would
+        # otherwise turn the seq-vs-rank A/B into a self-comparison
+        ("fold_seq", {"CRDT_MERGE_IMPL": "unrolled"}, 1500),
+        ("fold_tree", {"CRDT_MERGE_IMPL": "unrolled"}, 1500),
+        ("fold_seq_rank", {"CRDT_MERGE_IMPL": "rank"}, 1500),
         # compiled-Mosaic contender: keep LAST — a Mosaic crash can wedge
         # the tunnel's remote-compile helper for the rest of the window
         ("merge_pallas", None, 1500),

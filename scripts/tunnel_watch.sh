@@ -74,9 +74,9 @@ for i in $(seq 1 600); do
         # (reports/LAYOUT_AB_TPU.md — unrolled default, lanes deleted);
         # re-running the full suite would burn ~90 min of a window, so
         # only the still-undecided fold-shape contenders stay (outer
-        # timeout covers both inner 1500s mode timeouts)
-        step experiments 3600 /tmp/experiments_tpu.log \
-            env CRDT_EXP_MODES=fold_seq,fold_tree \
+        # timeout covers all three inner 1500s mode timeouts)
+        step experiments 5000 /tmp/experiments_tpu.log \
+            env CRDT_EXP_MODES=fold_seq,fold_tree,fold_seq_rank \
             python scripts/tpu_experiments.py
         # publish only when this iteration actually ran the bench (marker
         # absent before the call) — a marker short-circuit must not

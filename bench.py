@@ -56,14 +56,14 @@ def _sync_overhead():
     return float(np.median(samples))
 
 
-def timeit_chained(step, init, iters=None, sync_overhead_s=None):
+def timeit_chained(step, init, iters=None, sync_overhead_s=None, consts=()):
     """Per-iteration wall time of ``step`` chained on-device.
 
     Remote-TPU tunnels charge a large fixed host↔device sync round-trip
     (~65 ms through the axon relay — measured in
     ``reports/TPU_LATENCY.md``) on every dispatch, so per-dispatch timing
     measures the tunnel, not the chip.  This timer runs ``iters``
-    iterations of ``state -> step(state)`` inside ONE jitted
+    iterations of ``state -> step(state, *consts)`` inside ONE jitted
     ``lax.scan`` — the carry makes every iteration data-dependent on the
     previous one, so XLA's while-loop executes each one — and pays the
     sync once.  The measured sync constant is subtracted and the
@@ -77,22 +77,27 @@ def timeit_chained(step, init, iters=None, sync_overhead_s=None):
     if iters is None:
         iters = 10 if SMALL else 100
 
+    # consts: device arrays the step needs besides the carry.  They MUST
+    # come in as jit parameters, not closures — a closed-over concrete
+    # array is inlined into the lowered module as a dense constant, and
+    # the axon tunnel's remote-compile helper rejects large request
+    # bodies (HTTP 413 observed at ~300 MB of closure constants).
     @jax.jit
-    def chained(state):
+    def chained(state, cs):
         def body(carry, _):
-            return step(carry), None
+            return step(carry, *cs), None
         out, _ = lax.scan(body, state, None, length=iters)
         return out
 
     if sync_overhead_s is None:
         sync_overhead_s = _sync_overhead()
 
-    out = chained(init)
+    out = chained(init, consts)
     jax.block_until_ready(out)  # compile + warmup
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
-        out = chained(init)
+        out = chained(init, consts)
         # force completion with a scalar fetch (block_until_ready alone
         # does not round-trip through the tunnel)
         np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
@@ -120,14 +125,16 @@ def bench_clock_merges():
     n, a = (1000, 64) if not SMALL else (100, 16)
     x = jnp.asarray(rand_clocks(rng, (n, a)))
     y = jnp.asarray(rand_clocks(rng, (n, a)))
-    t, _ = timeit_chained(lambda acc: clock_ops.merge(acc, y), x)
+    t, _ = timeit_chained(lambda acc, yy: clock_ops.merge(acc, yy), x,
+                          consts=(y,))
     log(f"config2 vclock_merge   n={n} A={a}: {t*1e6:.1f}us  {n/t/1e6:.2f}M merges/s")
 
     # config 3: PNCounter 1M × 32 (planes [N, 2, A])
     n, a = (1_000_000, 32) if not SMALL else (10_000, 8)
     p = jnp.asarray(rand_clocks(rng, (n, 2, a)))
     q = jnp.asarray(rand_clocks(rng, (n, 2, a)))
-    t, _ = timeit_chained(lambda acc: clock_ops.merge(acc, q), p)
+    t, _ = timeit_chained(lambda acc, qq: clock_ops.merge(acc, qq), p,
+                          consts=(q,))
     log(f"config3 pncounter_merge n={n} A={a}: {t*1e3:.2f}ms  {n/t/1e6:.2f}M merges/s")
 
     # config 5: LWWReg 10M
@@ -139,7 +146,8 @@ def bench_clock_merges():
     vb = jnp.asarray(rng.randint(0, 1 << 30, size=n).astype(np.uint32))
     mb = jnp.asarray(rng.randint(0, 1 << 30, size=n).astype(np.uint32))
     t, _ = timeit_chained(
-        lambda acc: lww_ops.merge(acc[0], acc[1], vb, mb)[:2], (va, ma)
+        lambda acc, v2, m2: lww_ops.merge(acc[0], acc[1], v2, m2)[:2],
+        (va, ma), consts=(vb, mb)
     )
     log(f"config5 lwwreg_merge   n={n}: {t*1e3:.2f}ms  {n/t/1e6:.2f}M merges/s")
 
@@ -158,8 +166,8 @@ def bench_orswot_pairwise():
     rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
 
     t, _ = timeit_chained(
-        lambda acc: orswot_ops.merge(*acc, *rhs, m, d)[:5], lhs,
-        iters=4 if SMALL else 20,
+        lambda acc, *r: orswot_ops.merge(*acc, *r, m, d)[:5], lhs,
+        iters=4 if SMALL else 20, consts=rhs,
     )
     log(f"config4 orswot_merge   n={n} A={a} M={m}: {t*1e3:.2f}ms  {n/t/1e6:.2f}M merges/s")
     return n / t

@@ -22,6 +22,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     import jax
+
+    if "--cpu" in sys.argv:
+        # the ambient axon plugin overrides the JAX_PLATFORMS env var;
+        # only the config knob reliably forces a local-CPU smoke run
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from jax import lax
 
@@ -57,16 +62,24 @@ def main():
     sync = sync_overhead()
     print(f"sync overhead: {sync*1e3:.1f} ms")
 
-    def chain_time(step, init, label, bytes_moved=None):
-        """step: state -> state (same pytree shape), chained iters times."""
+    def chain_time(step, init, label, bytes_moved=None, consts=()):
+        """step: (state, *consts) -> state, chained iters times.
+
+        Every device array the step needs besides the carry MUST come in
+        through ``consts`` — a closed-over concrete array is inlined into
+        the lowered module as a dense constant, and on the axon tunnel
+        the remote-compile request then exceeds the helper's body limit
+        (observed: HTTP 413 at ~300 MB of closure, HTTP 500 beyond).
+        Passing it as a jit parameter keeps the program text shape-only.
+        """
         @jax.jit
-        def run(s0):
-            return lax.scan(lambda c, _: (step(c), None), s0, None,
+        def run(s0, cs):
+            return lax.scan(lambda c, _: (step(c, *cs), None), s0, None,
                             length=iters)[0]
-        out = run(init)
+        out = run(init, consts)
         jax.block_until_ready(out)
         t0 = time.perf_counter()
-        out = run(init)
+        out = run(init, consts)
         np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
         t = max(time.perf_counter() - t0 - sync, 1e-9) / iters
         bw = f"  {bytes_moved/t/1e9:6.1f} GB/s" if bytes_moved else ""
@@ -74,40 +87,41 @@ def main():
         return t
 
     # raw bandwidth floor: elementwise max over the dots footprint
-    chain_time(lambda s: (jnp.maximum(s[0], dots_b),),
+    chain_time(lambda s, db: (jnp.maximum(s[0], db),),
                (dots_a,), "bandwidth: maximum(dots,dots)",
-               bytes_moved=3 * dots_a.nbytes)
+               bytes_moved=3 * dots_a.nbytes, consts=(dots_b,))
 
     # full pairwise merge (the real thing, deferred rows present)
     chain_time(
-        lambda s: orswot_ops.merge(*s, *rhs, m, d)[:5], lhs,
+        lambda s, *r: orswot_ops.merge(*s, *r, m, d)[:5], lhs,
         "full merge (deferred present)",
-        bytes_moved=3 * state_bytes)
+        bytes_moved=3 * state_bytes, consts=rhs)
 
     # deferred-free merge → rank-select fast path via the cond
     lhs_nd = (clock_a, ids_a, dots_a,
               jnp.full_like(dids_a, -1), jnp.zeros_like(dclocks_a))
     chain_time(
-        lambda s: orswot_ops.merge(*s, *lhs_nd[:2], s[2], *lhs_nd[3:], m, d)[:5]
-        if False else orswot_ops.merge(*s, *lhs_nd, m, d)[:5],
+        lambda s, *r: orswot_ops.merge(*s, *r, m, d)[:5],
         lhs_nd, "merge fast path (no deferred)",
-        bytes_moved=3 * state_bytes)
+        bytes_moved=3 * state_bytes, consts=lhs_nd)
 
     # stage: member match (quadratic bool)
-    def step_match(s):
-        va, am, j_idx, bo = orswot_ops._member_match(s[0], ids_b)
+    def step_match(s, idb):
+        va, am, j_idx, bo = orswot_ops._member_match(s[0], idb)
         # consume every output so nothing is DCE'd out of the chain
         return (jnp.where(am & va & ~bo, s[0], j_idx),)
-    chain_time(step_match, (ids_a,), "_member_match [N,M,M] bool")
+    chain_time(step_match, (ids_a,), "_member_match [N,M,M] bool",
+               consts=(ids_b,))
 
     # stage: rank-select core alone (survival reduces + rank + gathers)
-    def step_core(s):
+    def step_core(s, cb, idb, db):
         clock, ids, dots = s
         out_ids, out_dots, n_surv = orswot_ops._rank_select_merge(
-            clock, ids, dots, clock_b, ids_b, dots_b, m)
+            clock, ids, dots, cb, idb, db, m)
         clock2 = clock_ops.merge(clock, jnp.max(out_dots, axis=-2))
         return (clock2, out_ids, out_dots)
-    chain_time(step_core, (clock_a, ids_a, dots_a), "_rank_select_merge core")
+    chain_time(step_core, (clock_a, ids_a, dots_a), "_rank_select_merge core",
+               consts=(clock_b, ids_b, dots_b))
 
     # stage: counting-rank order over 2M keys, vs XLA argsort
     keys = jnp.concatenate([ids_a, ids_b], axis=-1)
@@ -122,13 +136,14 @@ def main():
     chain_time(step_sort, (keys,), "jnp.argsort [N,2M] + gather")
 
     # stage: deferred pipeline (dedup + replay)
-    def step_deferred(s):
+    def step_deferred(s, ca, ia, da):
         d_ids, d_clocks = orswot_ops._dedup_deferred(s[0], s[1])
         ids2, dots2, d_ids2, d_clocks2 = orswot_ops._apply_deferred(
-            clock_a, ids_a, dots_a, d_ids, d_clocks)
+            ca, ia, da, d_ids, d_clocks)
         # keep the member-side replay (dots2) live in the carry
         return (d_ids2, jnp.maximum(d_clocks2, dots2[..., :d, :]))
-    chain_time(step_deferred, (dids_a, dclocks_a), "deferred dedup+replay")
+    chain_time(step_deferred, (dids_a, dclocks_a), "deferred dedup+replay",
+               consts=(clock_a, ids_a, dots_a))
 
     # the unrolled tile math (crdt_tpu/ops/orswot_unrolled.py, the TPU
     # default since the round-3 A/B).  TPU-only: on CPU it is
@@ -138,8 +153,9 @@ def main():
         from crdt_tpu.ops import orswot_pallas, orswot_unrolled
 
         chain_time(
-            lambda s: orswot_unrolled.merge_unrolled(*s, *rhs, m, d)[:5], lhs,
-            "merge_unrolled (std layout)", bytes_moved=3 * state_bytes)
+            lambda s, *r: orswot_unrolled.merge_unrolled(*s, *r, m, d)[:5],
+            lhs, "merge_unrolled (std layout)",
+            bytes_moved=3 * state_bytes, consts=rhs)
 
         # unrolled-path internal stages (the shared tile math of
         # crdt_tpu/ops/orswot_pallas.py, biased-int32 domain) — the TPU
@@ -151,33 +167,35 @@ def main():
         ka = op._to_kernel_dtype(u32[0])
         kb = op._to_kernel_dtype(u32[1])
 
-        def step_align(s):
-            e2, bm = op._align_against(s[1], s[0], kb[1], kb[2])
+        def step_align(s, kb1, kb2):
+            e2, bm = op._align_against(s[1], s[0], kb1, kb2)
             return (jnp.maximum(s[0], jnp.where(op._emask(bm), e2, op.ZERO)),
                     s[1])
-        chain_time(step_align, (ka[2], ka[1]), "unrolled: align (M^2 select)")
+        chain_time(step_align, (ka[2], ka[1]), "unrolled: align (M^2 select)",
+                   consts=(kb[1], kb[2]))
 
         e2_0, bm_0 = op._align_against(ka[1], ka[2], kb[1], kb[2])
-        valid_a0 = ka[1] != op.EMPTY
 
-        def step_rule(s):
+        def step_rule(s, ka1, ka0, kb0):
             dots, e2 = s
+            valid_a = ka1 != op.EMPTY
             out = op._merge_rule(
-                dots, e2, valid_a0 & op._nonempty(dots),
-                valid_a0 & op._nonempty(e2), valid_a0, ka[0], kb[0])
+                dots, e2, valid_a & op._nonempty(dots),
+                valid_a & op._nonempty(e2), valid_a, ka0, kb0)
             # both carries data-depend on the output so XLA can neither
             # hoist the rule nor constant-fold e2 into the loop body
             return (jnp.maximum(dots, out), jnp.maximum(e2, out))
-        chain_time(step_rule, (ka[2], e2_0), "unrolled: dot-algebra rule")
+        chain_time(step_rule, (ka[2], e2_0), "unrolled: dot-algebra rule",
+                   consts=(ka[1], ka[0], kb[0]))
 
         ids_cat0 = jnp.concatenate([ka[1], kb[1]], axis=-1)
-        live0 = ids_cat0 != op.EMPTY
 
-        def step_rank(s):
+        def step_rank(s, idc):
             big = jnp.iinfo(jnp.int32).max
-            m_keys = jnp.where(live0, ids_cat0, big)
+            live = idc != op.EMPTY
+            m_keys = jnp.where(live, idc, big)
             out_ids, out_dots, n_surv = op._rank_select(
-                m_keys, live0, ids_cat0, s[0], m)
+                m_keys, live, idc, s[0], m)
             # consume ids and the survivor count too, or XLA DCEs the
             # id-pack sums and overflow reduce out of the timed stage
             salt = (out_ids[..., :1] + n_surv[..., None])[..., None]
@@ -185,7 +203,7 @@ def main():
                 [jnp.maximum(out_dots, s[0][..., :m, :] ^ salt),
                  s[0][..., m:, :]], axis=-2),)
         chain_time(step_rank, (jnp.concatenate([ka[2], kb[2]], axis=-2),),
-                   "unrolled: member rank-select")
+                   "unrolled: member rank-select", consts=(ids_cat0,))
     else:
         print("unrolled variant + stages skipped (non-TPU backend; "
               "--all-stages to force)")

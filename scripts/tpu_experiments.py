@@ -25,7 +25,12 @@ REPO = os.path.dirname(HERE)
 WORKER = r'''
 import os, sys, time
 sys.path.insert(0, %(repo)r)
-import numpy as np, jax, jax.numpy as jnp
+import numpy as np, jax
+if os.environ.get("EXP_FORCE_CPU") == "1":
+    # the ambient axon plugin overrides the JAX_PLATFORMS env var; only
+    # the config knob reliably forces a local-CPU smoke run
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
 from jax import lax
 from crdt_tpu.ops import orswot_ops
 from crdt_tpu.utils.testdata import anti_entropy_fleets, random_orswot_arrays
@@ -40,13 +45,19 @@ def sync_overhead():
     t0 = time.perf_counter(); np.asarray(tiny(tone))
     return time.perf_counter() - t0
 
-def chain(step, init, iters):
+def chain(step, init, iters, consts=()):
+    # Device arrays the step needs besides the carry must be passed via
+    # ``consts`` (jit parameters), never closed over: a closed-over
+    # concrete array is inlined into the lowered module as a dense
+    # constant, and the tunnel's remote-compile helper rejects large
+    # request bodies (HTTP 413 observed at ~300 MB of closure).
     @jax.jit
-    def run(s0):
-        return lax.scan(lambda c, _: (step(c), None), s0, None, length=iters)[0]
-    out = run(init); jax.block_until_ready(out)
+    def run(s0, cs):
+        return lax.scan(lambda c, _: (step(c, *cs), None), s0, None,
+                        length=iters)[0]
+    out = run(init, consts); jax.block_until_ready(out)
     sync = sync_overhead()
-    t0 = time.perf_counter(); out = run(init)
+    t0 = time.perf_counter(); out = run(init, consts)
     np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
     return max(time.perf_counter() - t0 - sync, 1e-9) / iters
 
@@ -69,12 +80,12 @@ if mode in ("fold_seq", "fold_tree", "fold_seq_rank"):
             for i in range(1, r):
                 acc = orswot_ops.merge(*acc, *(x[i] for x in stack), m, d)[:5]
             return orswot_ops.merge(*acc, *acc, m, d)[:5]
-    def step(carry):
+    def step(carry, *stk):
         salt, _ = carry
-        out = fold((stacked[0] ^ salt,) + stacked[1:])
+        out = fold((stk[0] ^ salt,) + stk[1:])
         return ((jnp.max(out[2]) & jnp.uint32(7)) | jnp.uint32(1), out)
     init = (jnp.uint32(1), tuple(x[0] for x in stacked))
-    t = chain(step, init, iters=4)
+    t = chain(step, init, iters=4, consts=stacked)
     print(f"RESULT {mode}: {t*1e3:.1f} ms/chunk-fold "
           f"({n*r/t/1e6:.2f}M merges/s equiv)")
 
@@ -83,7 +94,8 @@ elif mode in ("merge_scatter", "merge_scatterless"):
     n, a, m, d = 100_000, 16, 8, 4
     lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
     rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
-    t = chain(lambda acc: orswot_ops.merge(*acc, *rhs, m, d)[:5], lhs, iters=20)
+    t = chain(lambda acc, *r: orswot_ops.merge(*acc, *r, m, d)[:5], lhs,
+              iters=20, consts=rhs)
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
 
 elif mode == "merge_unrolled":
@@ -95,8 +107,8 @@ elif mode == "merge_unrolled":
     lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
     rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
     t = chain(
-        lambda acc: orswot_unrolled.merge_unrolled(*acc, *rhs, m, d)[:5],
-        lhs, iters=20,
+        lambda acc, *r: orswot_unrolled.merge_unrolled(*acc, *r, m, d)[:5],
+        lhs, iters=20, consts=rhs,
     )
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
 
@@ -109,7 +121,8 @@ elif mode == "merge_pallas":
     rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d))
     from crdt_tpu.ops import orswot_pallas
     t = chain(
-        lambda acc: orswot_pallas.merge(*acc, *rhs, m, d)[:5], lhs, iters=20)
+        lambda acc, *r: orswot_pallas.merge(*acc, *r, m, d)[:5], lhs,
+        iters=20, consts=rhs)
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge ({n/t/1e6:.2f}M merges/s)")
 
 elif mode in ("order_rank", "order_argsort"):
@@ -142,26 +155,26 @@ elif mode in ("gather_mxu", "gather_mxu8"):
     idx = jnp.asarray(rng.randint(0, s_slots, size=(n, 16)).astype(np.int32))
     onehot = (idx[..., None] == jnp.arange(s_slots)[None, None, :]).astype(jnp.float32)
     if mode == "gather_mxu":
-        def step(c):
+        def step(c, oh):
             lo = (c[0] & jnp.uint32(0xFFFF)).astype(jnp.float32)
             hi = (c[0] >> 16).astype(jnp.float32)
-            glo = jnp.einsum("nks,nsa->nka", onehot, lo,
+            glo = jnp.einsum("nks,nsa->nka", oh, lo,
                              precision=jax.lax.Precision.HIGHEST)
-            ghi = jnp.einsum("nks,nsa->nka", onehot, hi,
+            ghi = jnp.einsum("nks,nsa->nka", oh, hi,
                              precision=jax.lax.Precision.HIGHEST)
             g = (ghi.astype(jnp.uint32) << 16) | glo.astype(jnp.uint32)
             return (jnp.concatenate(
                 [jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
     else:
-        def step(c):
+        def step(c, oh):
             g = jnp.zeros((n, 16, a), jnp.uint32)
             for shift in (0, 8, 16, 24):
                 byte = ((c[0] >> shift) & jnp.uint32(0xFF)).astype(jnp.float32)
-                gb = jnp.einsum("nks,nsa->nka", onehot, byte)
+                gb = jnp.einsum("nks,nsa->nka", oh, byte)
                 g = g | (gb.astype(jnp.uint32) << shift)
             return (jnp.concatenate(
                 [jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
-    t = chain(step, (payload,), iters=20)
+    t = chain(step, (payload,), iters=20, consts=(onehot,))
     print(f"RESULT {mode}: {t*1e3:.2f} ms")
 
 elif mode in ("gather_take", "gather_onehot", "scatter_put"):
@@ -172,25 +185,28 @@ elif mode in ("gather_take", "gather_onehot", "scatter_put"):
     payload = jnp.asarray(rng.randint(0, 1000, size=(n, s_slots, a)).astype(np.uint32))
     idx = jnp.asarray(rng.randint(0, s_slots, size=(n, 16)).astype(np.int32))
     if mode == "gather_take":
-        def step(c):
-            g = jnp.take_along_axis(c[0], idx[..., None], axis=-2)  # [n,16,a]
+        def step(c, ix):
+            g = jnp.take_along_axis(c[0], ix[..., None], axis=-2)  # [n,16,a]
             return (jnp.concatenate(
                 [jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
+        cs = (idx,)
     elif mode == "gather_onehot":
         onehot = (idx[..., None] == jnp.arange(s_slots)[None, None, :])
-        def step(c):
-            g = jnp.einsum("nks,nsa->nka", onehot.astype(jnp.uint32), c[0])
+        def step(c, oh):
+            g = jnp.einsum("nks,nsa->nka", oh.astype(jnp.uint32), c[0])
             return (jnp.concatenate([jnp.maximum(c[0][:, :16], g), c[0][:, 16:]], axis=1),)
+        cs = (onehot,)
     else:  # scatter_put
         ranks = jnp.asarray(
             np.argsort(rng.rand(n, s_slots), axis=-1).astype(np.int32))
-        iota = jnp.arange(s_slots, dtype=jnp.int32)
-        def step(c):
+        def step(c, rk):
+            iota = jnp.arange(s_slots, dtype=jnp.int32)
             perm = jnp.put_along_axis(
-                jnp.zeros(ranks.shape, jnp.int32), ranks,
-                jnp.broadcast_to(iota, ranks.shape), axis=-1, inplace=False)
+                jnp.zeros(rk.shape, jnp.int32), rk,
+                jnp.broadcast_to(iota, rk.shape), axis=-1, inplace=False)
             return (c[0] ^ perm[..., None].astype(c[0].dtype),)
-    t = chain(step, (payload,), iters=20)
+        cs = (ranks,)
+    t = chain(step, (payload,), iters=20, consts=cs)
     print(f"RESULT {mode}: {t*1e3:.2f} ms")
 
 elif mode in ("dtype_u32", "dtype_u64"):
@@ -198,7 +214,8 @@ elif mode in ("dtype_u32", "dtype_u64"):
     n, a, m, d = 100_000, 16, 8, 4
     lhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d, dtype=dt))
     rhs = tuple(jnp.asarray(x) for x in random_orswot_arrays(rng, n, a, m, d, dtype=dt))
-    t = chain(lambda acc: orswot_ops.merge(*acc, *rhs, m, d)[:5], lhs, iters=10)
+    t = chain(lambda acc, *r: orswot_ops.merge(*acc, *r, m, d)[:5], lhs,
+              iters=10, consts=rhs)
     print(f"RESULT {mode}: {t*1e3:.2f} ms/merge")
 ''' % {"repo": REPO}
 

@@ -7,8 +7,11 @@
 # a Mosaic crash has been observed to take the compile helper down with it
 # (reports/TPU_LATENCY.md).
 #
-# Markers are keyed to the git rev so a capture from an older build never
-# satisfies a step after bench/kernel changes (advisor finding r2).
+# Markers are keyed to a content hash of the measured code paths, so a
+# capture from an older build never satisfies a step after bench/kernel
+# changes (advisor finding r2) — while commits that don't change that
+# code (docs, reports, committing the already-captured code verbatim)
+# never discard a capture.
 cd /root/repo
 # persistent XLA compilation cache: repeated captures across tunnel
 # windows skip recompiling unchanged programs, so a window spends its
@@ -37,7 +40,9 @@ publish_bench() {  # publish_bench <log>
     # Persist the captured on-chip bench line as a repo artifact so a
     # mid-round window survives even if the driver's end-of-round probe
     # misses the next window (the driver commits uncommitted files).
-    python - "$1" "$REV" <<'EOF'
+    # captured_rev records BOTH the nearest commit (human-locatable
+    # provenance) and the content hash the markers are keyed on.
+    python - "$1" "$(git rev-parse --short HEAD 2>/dev/null || echo norev).$REV" <<'EOF'
 import json, sys, time
 lines = [l for l in open(sys.argv[1]) if l.startswith('{"metric"')]
 if lines:
@@ -51,19 +56,17 @@ EOF
 }
 
 for i in $(seq 1 600); do
-    # re-key markers every iteration: a commit OR working-tree edit
-    # mid-watch invalidates earlier captures and the steps re-run on the
-    # next window.  The key hashes HEAD + the dirty diff + untracked file
-    # contents (deterministic, unlike `git stash create` whose commit
-    # hash embeds a timestamp), so a capture is never attributed to code
-    # that didn't run.
-    # hash only the paths that determine what a capture measures — the
-    # published artifact / report files must not invalidate the markers
+    # re-key markers every iteration: an edit to the measured code
+    # invalidates earlier captures and the steps re-run on the next
+    # window.  The key is a pure CONTENT hash of the code paths (tracked
+    # + untracked working-tree contents) — deliberately NOT the git HEAD
+    # rev, so committing docs/reports (or committing the very code that
+    # ran, unchanged) never discards a capture; only changing what a
+    # capture measures does.
     CODE="crdt_tpu scripts bench.py __graft_entry__.py"
-    DIRTY=$( { git diff HEAD -- $CODE 2>/dev/null; \
-               git ls-files -o --exclude-standard -z -- $CODE 2>/dev/null \
-                 | xargs -0 cat 2>/dev/null; } | sha1sum | cut -c1-8 )
-    REV="$(git rev-parse --short HEAD 2>/dev/null || echo norev).$DIRTY"
+    REV=$( { git ls-files -z -- $CODE 2>/dev/null; \
+             git ls-files -o --exclude-standard -z -- $CODE 2>/dev/null; } \
+           | LC_ALL=C sort -z | xargs -0 cat 2>/dev/null | sha1sum | cut -c1-12 )
     MARK=/tmp/tw_done.$REV
     mkdir -p "$MARK"
     if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then

@@ -73,6 +73,18 @@ for i in $(seq 1 600); do
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing (rev $REV)" | tee -a /tmp/tunnel_watch.log
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
+        # AOT-bridge probe EARLY and CHEAP: can locally-compiled
+        # executables be deserialized into the axon client at all?
+        # (scripts/aot_exec_bridge.py — bypasses the remote-compile
+        # helper's size limits).  tiny + merge4 only; the big loads run
+        # after the bench so an unknown plugin code path cannot cost the
+        # jnp captures.
+        if [ -e /tmp/aot_exec/tiny.pkl ]; then
+            step aot_probe 600 /tmp/aot_probe_tpu.log bash -c \
+                "python scripts/aot_exec_bridge.py load tiny && \
+                 { [ ! -e /tmp/aot_exec/merge4.pkl ] || \
+                   python scripts/aot_exec_bridge.py load merge4; }"
+        fi
         # the 7-mode layout A/B concluded in the 2026-07-31 window
         # (reports/LAYOUT_AB_TPU.md — unrolled default, lanes deleted);
         # re-running the full suite would burn ~90 min of a window, so
@@ -101,20 +113,45 @@ for i in $(seq 1 600); do
             python scripts/layout_decision.py /tmp/experiments_tpu.log \
                 "$BLOG" >> /tmp/tunnel_watch.log 2>&1 || true
         fi
-        # Compiled-Pallas attempt LAST: a Mosaic crash can wedge the
-        # remote compile helper for the rest of the window.  Workaround
-        # env from the captured failure log (PALLAS_TPU_ATTEMPT.txt:12-14).
+        # the big jnp AOT-bridge load after the jnp captures are banked:
+        # scan_ns is the program the helper 500s on.  No Mosaic inside —
+        # safe before the Pallas block.  Only attempted if the cheap
+        # probe proved the deserialize path works.
+        if [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/scan_ns.pkl ]; then
+            step aot_scan 2400 /tmp/aot_scan_tpu.log \
+                python scripts/aot_exec_bridge.py load scan_ns
+        fi
+        # Compiled-Pallas attempts LAST: a Mosaic crash can wedge the
+        # remote compile helper / device for the rest of the window.
+        # Workaround env from the captured failure log
+        # (PALLAS_TPU_ATTEMPT.txt:12-14).
         step pallas 1800 /tmp/pallas_tpu.log \
             env TPU_ACCELERATOR_TYPE=v5litepod-1 TPU_WORKER_HOSTNAMES=localhost \
             python scripts/tpu_validate.py --pallas
-        # pairwise compiled-Mosaic contender, also crash-risky: very last
+        # pairwise compiled-Mosaic contender, also crash-risky
         step experiments_pallas 1800 /tmp/experiments_pallas_tpu.log \
             env CRDT_EXP_MODES=merge_pallas \
             python scripts/tpu_experiments.py
+        # compiled-Mosaic EXECUTION via the AOT bridge — the headline
+        # candidate but also the least-known plugin code path: very last
+        # so a crash cannot cost any other capture this window.
+        if [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ]; then
+            step aot_pallas_scan 2400 /tmp/aot_pallas_scan_tpu.log \
+                python scripts/aot_exec_bridge.py load pallas_scan_ns
+        fi
+        # done only when every step whose precondition exists has its
+        # marker — including the AOT loads, so a window that closes
+        # mid-load leaves them to retry next window
+        AOT_OK=1
+        [ -e /tmp/aot_exec/tiny.pkl ] && [ ! -e "$MARK/aot_probe" ] && AOT_OK=0
+        [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/scan_ns.pkl ] && \
+            [ ! -e "$MARK/aot_scan" ] && AOT_OK=0
+        [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ] && \
+            [ ! -e "$MARK/aot_pallas_scan" ] && AOT_OK=0
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
            [ -e "$MARK/bench" ] && \
            [ -e "$MARK/validate_merge" ] && [ -e "$MARK/pallas" ] && \
-           [ -e "$MARK/experiments_pallas" ]; then
+           [ -e "$MARK/experiments_pallas" ] && [ "$AOT_OK" = 1 ]; then
             echo "$(date -u +%H:%M:%S) all captures done (rev $REV)" | tee -a /tmp/tunnel_watch.log
             exit 0
         fi

@@ -39,71 +39,25 @@ SMALL = os.environ.get("CRDT_BENCH_SMALL") == "1"
 
 
 def _sync_overhead():
-    """The tunnel's fixed host↔device sync round-trip (~65 ms through the
-    axon relay — reports/TPU_LATENCY.md), measured with a warm tiny op +
-    scalar fetch so chained timers can subtract it."""
-    import jax
-    import jax.numpy as jnp
+    """Same-window tunnel sync constant (crdt_tpu.utils.benchtime)."""
+    from crdt_tpu.utils.benchtime import sync_overhead
 
-    tiny = jax.jit(lambda x: x + 1)
-    tone = jnp.zeros((8,), jnp.uint32)
-    np.asarray(tiny(tone))  # warm
-    samples = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(tiny(tone))
-        samples.append(time.perf_counter() - t0)
-    return float(np.median(samples))
+    return sync_overhead()
 
 
 def timeit_chained(step, init, iters=None, sync_overhead_s=None, consts=()):
     """Per-iteration wall time of ``step`` chained on-device.
 
-    Remote-TPU tunnels charge a large fixed host↔device sync round-trip
-    (~65 ms through the axon relay — measured in
-    ``reports/TPU_LATENCY.md``) on every dispatch, so per-dispatch timing
-    measures the tunnel, not the chip.  This timer runs ``iters``
-    iterations of ``state -> step(state, *consts)`` inside ONE jitted
-    ``lax.scan`` — the carry makes every iteration data-dependent on the
-    previous one, so XLA's while-loop executes each one — and pays the
-    sync once.  The measured sync constant is subtracted and the
-    remainder divided by ``iters``; a final scalar fetch forces real
-    completion.  Returns ``(seconds_per_iter, final_state)``.
+    Thin wrapper over ``crdt_tpu.utils.benchtime.chain_timer`` (see its
+    docstring for the tunnel-driven design: one jitted lax.scan, sync
+    constant subtracted, consts-as-jit-parameters).  Median of 3 runs.
     """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
+    from crdt_tpu.utils.benchtime import chain_timer
 
     if iters is None:
         iters = 10 if SMALL else 100
-
-    # consts: device arrays the step needs besides the carry.  They MUST
-    # come in as jit parameters, not closures — a closed-over concrete
-    # array is inlined into the lowered module as a dense constant, and
-    # the axon tunnel's remote-compile helper rejects large request
-    # bodies (HTTP 413 observed at ~300 MB of closure constants).
-    @jax.jit
-    def chained(state, cs):
-        def body(carry, _):
-            return step(carry, *cs), None
-        out, _ = lax.scan(body, state, None, length=iters)
-        return out
-
-    if sync_overhead_s is None:
-        sync_overhead_s = _sync_overhead()
-
-    out = chained(init, consts)
-    jax.block_until_ready(out)  # compile + warmup
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = chained(init, consts)
-        # force completion with a scalar fetch (block_until_ready alone
-        # does not round-trip through the tunnel)
-        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-        times.append(time.perf_counter() - t0)
-    per_iter = max(float(np.median(times)) - sync_overhead_s, 1e-9) / iters
-    return per_iter, out
+    return chain_timer(step, init, iters, consts=consts,
+                       sync_overhead_s=sync_overhead_s, reps=3)
 
 
 def rand_clocks(rng, shape, hi=1000):

@@ -243,6 +243,16 @@ def build(name: str, small: bool):
 
 
 def load(name: str, small: bool):
+    """Exit codes define the watcher's retry economics:
+
+    * 0 — CONCLUSIVE: the load+execute attempt completed (whatever the
+      parity verdict) OR the deserialize path was refused 3 windows in a
+      row (recorded as given up).  The watcher stamps its marker and
+      stops retrying.  /tmp/aot_exec/probe_ok is touched only on a
+      fully-green tiny/merge4 probe — the gate for the big loads.
+    * 1 — retry-worthy: missing artifact, non-TPU backend, or a
+      (possibly transient) deserialize/execute failure.
+    """
     import numpy as np
 
     import jax
@@ -271,14 +281,36 @@ def load(name: str, small: bool):
         print(json.dumps(result), flush=True)
         return 1
 
+    refusal_marker = os.path.join(ART_DIR, "probe_refusals")
+
+    def _refusal_giveup():
+        # a definitive plugin-side refusal looks identical to a transient
+        # one; give the probe 3 windows before declaring it conclusive so
+        # the watcher can finish instead of retrying forever
+        if name != "tiny":
+            return False
+        count = 1
+        if os.path.exists(refusal_marker):
+            with open(refusal_marker) as f:
+                count = int(f.read().strip() or 0) + 1
+        with open(refusal_marker, "w") as f:
+            f.write(str(count))
+        return count >= 3
+
     try:
         t0 = time.time()
         compiled = deserialize_and_load(
             art["payload"], art["in_tree"], art["out_tree"], backend="tpu"
         )
         result["deserialize_s"] = round(time.time() - t0, 2)
+        if os.path.exists(refusal_marker):
+            os.remove(refusal_marker)  # the path works; reset give-up count
     except Exception as e:  # the capture IS the result if the plugin refuses
         result["error"] = f"deserialize_and_load: {type(e).__name__}: {str(e)[:300]}"
+        if _refusal_giveup():
+            result["gave_up"] = True
+            print(json.dumps(result), flush=True)
+            return 0
         print(json.dumps(result), flush=True)
         return 1
 
@@ -291,6 +323,10 @@ def load(name: str, small: bool):
         result["first_exec_s"] = round(time.time() - t0, 2)
     except Exception as e:
         result["error"] = f"execute: {type(e).__name__}: {str(e)[:300]}"
+        if _refusal_giveup():
+            result["gave_up"] = True
+            print(json.dumps(result), flush=True)
+            return 0
         print(json.dumps(result), flush=True)
         return 1
 
@@ -313,8 +349,15 @@ def load(name: str, small: bool):
         else:
             # replay the salt chain per-step (separately compiled small
             # programs); bit-equality doubles as a work-elision check
-            ok = _stepped_parity(name, small, flat_args, out)
-        result["parity"] = bool(ok)
+            ok = _stepped_parity(name, small, flat_args, out,
+                                 compiled=compiled)
+        if isinstance(ok, str) and ok.startswith("determinism:"):
+            # per-step oracle unavailable (helper rejected it); the
+            # loaded program re-executed bit-equal — determinism floor
+            result["parity"] = None
+            result["determinism"] = ok == "determinism:True"
+        else:
+            result["parity"] = bool(ok)
     except Exception as e:
         result["parity"] = None
         result["parity_error"] = f"{type(e).__name__}: {str(e)[:300]}"
@@ -340,11 +383,19 @@ def load(name: str, small: bool):
         n = 2_000 if small else 100_000
         result["merges_per_sec"] = round(n / t, 1)
     print(json.dumps(result), flush=True)
-    return 0 if result.get("parity", False) else 1
+    # a fully-green tiny probe opens the gate for the big loads
+    if name == "tiny" and result.get("parity") is True:
+        open(os.path.join(ART_DIR, "probe_ok"), "w").close()
+    return 0  # the attempt completed: conclusive either way
 
 
-def _stepped_parity(name, small, args, scan_out):
-    """Replay the scan's salt chain as per-step jit dispatches."""
+def _stepped_parity(name, small, args, scan_out, compiled=None):
+    """Replay the scan's salt chain as per-step jit dispatches.
+
+    Returns a bool verdict, or the string ``"determinism:<bool>"`` when
+    the per-step oracle itself cannot compile through the helper and the
+    fallback (re-execute the LOADED program, demand bit-equality) ran.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -397,7 +448,14 @@ def _stepped_parity(name, small, args, scan_out):
                 out = sf(biased, salt)
                 salt = ns(out)
         except Exception:
-            return None
+            if compiled is None:
+                return None
+            rerun = compiled(*args)
+            jax.block_until_ready(rerun)
+            same = all(
+                bool(jnp.array_equal(g, w)) for g, w in zip(scan_out, rerun)
+            )
+            return f"determinism:{same}"
     else:
         return None
     return all(bool(jnp.array_equal(g, w)) for g, w in zip(scan_out, out))

@@ -51,13 +51,7 @@ def main():
     print(f"backend={jax.default_backend()} n={n} a={a} m={m} d={d} "
           f"state={state_bytes/1e6:.0f} MB/side")
 
-    def sync_overhead():
-        tiny = jax.jit(lambda x: x + 1)
-        tone = jnp.zeros((8,), jnp.uint32)
-        np.asarray(tiny(tone))
-        t0 = time.perf_counter()
-        np.asarray(tiny(tone))
-        return time.perf_counter() - t0
+    from crdt_tpu.utils.benchtime import sync_overhead
 
     sync = sync_overhead()
     print(f"sync overhead: {sync*1e3:.1f} ms")
@@ -65,23 +59,15 @@ def main():
     def chain_time(step, init, label, bytes_moved=None, consts=()):
         """step: (state, *consts) -> state, chained iters times.
 
-        Every device array the step needs besides the carry MUST come in
-        through ``consts`` — a closed-over concrete array is inlined into
-        the lowered module as a dense constant, and on the axon tunnel
-        the remote-compile request then exceeds the helper's body limit
-        (observed: HTTP 413 at ~300 MB of closure, HTTP 500 beyond).
-        Passing it as a jit parameter keeps the program text shape-only.
+        Thin wrapper over crdt_tpu.utils.benchtime.chain_timer (one
+        jitted lax.scan; sync constant subtracted; device arrays flow in
+        as jit parameters via ``consts``, never closures — the tunnel's
+        remote-compile helper rejects oversized request bodies).
         """
-        @jax.jit
-        def run(s0, cs):
-            return lax.scan(lambda c, _: (step(c, *cs), None), s0, None,
-                            length=iters)[0]
-        out = run(init, consts)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        out = run(init, consts)
-        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-        t = max(time.perf_counter() - t0 - sync, 1e-9) / iters
+        from crdt_tpu.utils.benchtime import chain_timer
+
+        t, _ = chain_timer(step, init, iters, consts=consts,
+                           sync_overhead_s=sync)
         bw = f"  {bytes_moved/t/1e9:6.1f} GB/s" if bytes_moved else ""
         print(f"{label:34s} {t*1e3:9.2f} ms{bw}")
         return t

@@ -38,28 +38,15 @@ from crdt_tpu.utils.testdata import anti_entropy_fleets, random_orswot_arrays
 mode = os.environ["EXP_MODE"]
 rng = np.random.RandomState(0)
 
-def sync_overhead():
-    tiny = jax.jit(lambda x: x + 1)
-    tone = jnp.zeros((8,), jnp.uint32)
-    np.asarray(tiny(tone))
-    t0 = time.perf_counter(); np.asarray(tiny(tone))
-    return time.perf_counter() - t0
-
 def chain(step, init, iters, consts=()):
-    # Device arrays the step needs besides the carry must be passed via
-    # ``consts`` (jit parameters), never closed over: a closed-over
-    # concrete array is inlined into the lowered module as a dense
-    # constant, and the tunnel's remote-compile helper rejects large
-    # request bodies (HTTP 413 observed at ~300 MB of closure).
-    @jax.jit
-    def run(s0, cs):
-        return lax.scan(lambda c, _: (step(c, *cs), None), s0, None,
-                        length=iters)[0]
-    out = run(init, consts); jax.block_until_ready(out)
-    sync = sync_overhead()
-    t0 = time.perf_counter(); out = run(init, consts)
-    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-    return max(time.perf_counter() - t0 - sync, 1e-9) / iters
+    # crdt_tpu.utils.benchtime.chain_timer: one jitted lax.scan, the
+    # same-window sync constant subtracted, and every device array the
+    # step needs flowing in as a jit parameter (a closure would inline
+    # it as dense constants and the tunnel's remote-compile helper
+    # rejects oversized request bodies — HTTP 413 at ~300 MB observed).
+    from crdt_tpu.utils.benchtime import chain_timer
+
+    return chain_timer(step, init, iters, consts=consts)[0]
 
 if mode in ("fold_seq", "fold_tree", "fold_seq_rank"):
     # fold_seq_rank: the same sequential fold with CRDT_MERGE_IMPL=rank

@@ -143,34 +143,22 @@ def check_pallas_northstar():
         )
 
     def chain_time(fold, source):
-        # ``source`` (the ~2.5 GB replica stack) must flow in as a jit
-        # PARAMETER: closed-over concrete arrays are inlined into the
-        # lowered module as dense constants and the tunnel's
-        # remote-compile helper rejects the oversized request (HTTP 413)
-        def step(carry, src):
+        # crdt_tpu.utils.benchtime.chain_timer: one jitted lax.scan,
+        # same-window sync subtracted, and ``source`` (the ~2.5 GB
+        # replica stack) flows in as a jit parameter — a closure would
+        # inline it as dense constants and the tunnel's remote-compile
+        # helper rejects the oversized request (HTTP 413)
+        from crdt_tpu.utils.benchtime import chain_timer
+
+        def step(carry, *src):
             salt, _ = carry
             out = fold((src[0] ^ salt,) + src[1:])
             s32 = src[0].dtype.type
             return ((jnp.max(out[2]).astype(src[0].dtype) & s32(7)) | s32(1), out)
 
-        @jax.jit
-        def run(init, src):
-            return lax.scan(
-                lambda c, _: (step(c, src), None), init, None, length=iters
-            )[0]
-
         init = (source[0].dtype.type(1), tuple(x[0] for x in source))
-        out = run(init, source)
-        jax.block_until_ready(out)
-        tiny = jax.jit(lambda x: x + 1)
-        np.asarray(tiny(jnp.zeros((8,), jnp.uint32)))
-        t0 = time.perf_counter()
-        np.asarray(tiny(jnp.zeros((8,), jnp.uint32)))
-        sync = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        out = run(init, source)
-        np.asarray(out[1][0].ravel()[0])
-        return max(time.perf_counter() - t0 - sync, 1e-9) / iters, out[1]
+        t, out = chain_timer(step, init, iters, consts=source)
+        return t, out[1]
 
     t_jnp, want = chain_time(jnp_fold, stacked)
     # bias AFTER the jnp timing: the ~2.5 GB padded+biased copy must not

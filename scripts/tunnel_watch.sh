@@ -78,7 +78,10 @@ for i in $(seq 1 600); do
         # (scripts/aot_exec_bridge.py — bypasses the remote-compile
         # helper's size limits).  tiny + merge4 only; the big loads run
         # after the bench so an unknown plugin code path cannot cost the
-        # jnp captures.
+        # jnp captures.  A completed attempt exits 0 (conclusive, marker
+        # stamps) whatever the verdict; the big loads are gated on the
+        # bridge's probe_ok file, written only on a fully-green tiny
+        # load.
         if [ -e /tmp/aot_exec/tiny.pkl ]; then
             step aot_probe 600 /tmp/aot_probe_tpu.log bash -c \
                 "python scripts/aot_exec_bridge.py load tiny && \
@@ -117,7 +120,7 @@ for i in $(seq 1 600); do
         # scan_ns is the program the helper 500s on.  No Mosaic inside —
         # safe before the Pallas block.  Only attempted if the cheap
         # probe proved the deserialize path works.
-        if [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/scan_ns.pkl ]; then
+        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/scan_ns.pkl ]; then
             step aot_scan 2400 /tmp/aot_scan_tpu.log \
                 python scripts/aot_exec_bridge.py load scan_ns
         fi
@@ -135,7 +138,7 @@ for i in $(seq 1 600); do
         # compiled-Mosaic EXECUTION via the AOT bridge — the headline
         # candidate but also the least-known plugin code path: very last
         # so a crash cannot cost any other capture this window.
-        if [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ]; then
+        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ]; then
             step aot_pallas_scan 2400 /tmp/aot_pallas_scan_tpu.log \
                 python scripts/aot_exec_bridge.py load pallas_scan_ns
         fi
@@ -144,9 +147,9 @@ for i in $(seq 1 600); do
         # mid-load leaves them to retry next window
         AOT_OK=1
         [ -e /tmp/aot_exec/tiny.pkl ] && [ ! -e "$MARK/aot_probe" ] && AOT_OK=0
-        [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/scan_ns.pkl ] && \
+        [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/scan_ns.pkl ] && \
             [ ! -e "$MARK/aot_scan" ] && AOT_OK=0
-        [ -e "$MARK/aot_probe" ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ] && \
+        [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ] && \
             [ ! -e "$MARK/aot_pallas_scan" ] && AOT_OK=0
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
            [ -e "$MARK/bench" ] && \

@@ -543,6 +543,17 @@ def bench_pallas_north_star(templates=None):
             orswot_pallas.pad_to_tile(templates[0], m, d, n_states=r + 1)
         )
 
+        # Bridge path first: a locally-AOT-compiled executable of this
+        # exact scan (scripts/aot_exec_bridge.py) sidesteps the tunnel's
+        # remote-compile helper entirely.  Used only when a previous
+        # window's bridge load recorded parity=true for an artifact whose
+        # kernel-code fingerprint still matches — and the scalar-oracle
+        # sample gate above has already passed this run.
+        if not SMALL:
+            bridged = _pallas_bridge_rate(tpl, n_chunks, chunk, r)
+            if bridged is not None:
+                return bridged
+
         def fold_biased(stack):
             return orswot_pallas.fold_merge(
                 *stack, m, d, interpret=False, prebiased=True
@@ -581,6 +592,76 @@ def bench_pallas_north_star(templates=None):
         return round(rate, 1)
     except Exception as e:
         log(f"north★ pallas attempt failed (jnp headline stands): {str(e)[:300]}")
+        return None
+
+
+def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
+    """Deserialize the staged fused-Pallas scan and time it.
+
+    Returns merges/s, or None to fall through to the helper-path
+    compile.  Trust requirements: the artifact's verdict file (written
+    by a tunnel-window `aot_exec_bridge.py load`) says parity=true, and
+    its kernel-source fingerprint matches the code bench would trace.
+    """
+    import pickle
+
+    import jax
+
+    art_path = "/tmp/aot_exec/pallas_scan_ns.pkl"
+    verdict_path = "/tmp/aot_exec/pallas_scan_ns.verdict.json"
+    if not (os.path.exists(art_path) and os.path.exists(verdict_path)):
+        return None
+    try:
+        from crdt_tpu.utils.fingerprint import ops_fingerprint
+
+        with open(verdict_path) as f:
+            verdict = json.load(f)
+        if verdict.get("parity") is not True:
+            log("north★ pallas bridge: verdict not green; helper path next")
+            return None
+        with open(art_path, "rb") as f:
+            art = pickle.load(f)
+        # the verdict must attest THIS artifact (a rebuild after the
+        # window would inherit an unearned parity=true) and the artifact
+        # must match the kernel sources AND trace-shaping env this bench
+        # process would use
+        if verdict.get("artifact_code") != art["meta"]["code"]:
+            log("north★ pallas bridge: verdict attests a different artifact")
+            return None
+        if art["meta"]["code"] != ops_fingerprint():
+            log("north★ pallas bridge: artifact stale vs kernel sources")
+            return None
+        env_now = {
+            "CRDT_MERGE_IMPL": os.environ.get("CRDT_MERGE_IMPL", "unrolled"),
+            "CRDT_SCATTERLESS": os.environ.get("CRDT_SCATTERLESS", "1"),
+        }
+        if art["meta"].get("env") != env_now or art["meta"].get(
+            "tile", "auto"
+        ) != os.environ.get("CRDT_PALLAS_TILE", "auto"):
+            log("north★ pallas bridge: env pins differ from this run")
+            return None
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        compiled = deserialize_and_load(
+            art["payload"], art["in_tree"], art["out_tree"], backend="tpu"
+        )
+        out = compiled(tpl)
+        jax.block_until_ready(out)  # warmup (already compiled)
+        sync_s = _sync_overhead()
+        t0 = time.perf_counter()
+        out = compiled(tpl)
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        t = max(time.perf_counter() - t0 - sync_s, 1e-9)
+        rate = n_chunks * chunk * r / t
+        log(
+            f"north★ pallas fused fold (AOT bridge, no remote compile): "
+            f"{t:.2f}s  {rate/1e6:.2f}M merges/s"
+        )
+        return round(rate, 1)
+    except Exception as e:
+        log(f"north★ pallas bridge failed; helper path next: {str(e)[:200]}")
         return None
 
 

@@ -1,0 +1,23 @@
+"""Content fingerprint of the kernel sources (`crdt_tpu/ops`).
+
+AOT-serialized executables (scripts/aot_exec_bridge.py) are only valid
+for the kernel code they were traced from; the fingerprint travels with
+the artifact and consumers (the bridge's `load`, bench.py's
+bridge-headline path) refuse stale ones.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def ops_fingerprint() -> str:
+    h = hashlib.sha1()
+    ops_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ops")
+    for name in sorted(os.listdir(ops_dir)):
+        if name.endswith(".py"):
+            with open(os.path.join(ops_dir, name), "rb") as f:
+                h.update(name.encode())
+                h.update(f.read())
+    return h.hexdigest()[:12]

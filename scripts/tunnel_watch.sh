@@ -28,7 +28,10 @@ step() {  # step <name> <timeout> <log> <cmd...>
     local name=$1 tmo=$2 log=$3; shift 3
     [ -e "$MARK/$name" ] && return 0
     echo "$(date -u +%H:%M:%S) step $name starting (rev $REV)" | tee -a /tmp/tunnel_watch.log
-    timeout "$tmo" "$@" > "$log" 2>&1
+    # -k: a python wedged in the tunnel plugin can ignore TERM; without
+    # the KILL fallback `timeout` waits on it forever and the watcher
+    # stalls mid-iteration
+    timeout -k 30 "$tmo" "$@" > "$log" 2>&1
     local rc=$?
     echo "$(date -u +%H:%M:%S) step $name exit $rc (log: $log)" | tee -a /tmp/tunnel_watch.log
     tail -1 "$log" | tee -a /tmp/tunnel_watch.log
@@ -69,7 +72,7 @@ for i in $(seq 1 600); do
            | LC_ALL=C sort -z | xargs -0 cat 2>/dev/null | sha1sum | cut -c1-12 )
     MARK=/tmp/tw_done.$REV
     mkdir -p "$MARK"
-    if timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if timeout -k 15 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing (rev $REV)" | tee -a /tmp/tunnel_watch.log
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py

@@ -68,6 +68,12 @@ def _unzigzag(n: int) -> int:
     return (n >> 1) ^ -(n & 1)
 
 
+# a corrupt or adversarial wire can nest one level per byte; bound the
+# decoder explicitly so depth failures are deterministic (independent of
+# the caller's remaining interpreter stack) and honestly attributed.
+# to_binary recursion makes states this deep unconstructible in practice.
+_MAX_DEPTH = 256
+
 # -- tags -------------------------------------------------------------------
 
 _T_NONE = 0x00
@@ -360,7 +366,9 @@ def _encode_val_type(out: io.BytesIO, val_type) -> None:
 # -- decoder ----------------------------------------------------------------
 
 
-def _decode(buf: io.BytesIO) -> Any:
+def _decode(buf: io.BytesIO, depth: int = 0) -> Any:
+    if depth > _MAX_DEPTH:
+        raise ValueError(f"nesting deeper than {_MAX_DEPTH} levels")
     from ..scalar.ctx import AddCtx, ReadCtx, RmCtx
     from ..scalar.gcounter import GCounter
     from ..scalar.gset import GSet
@@ -375,8 +383,8 @@ def _decode(buf: io.BytesIO) -> Any:
         n = _read_uvarint(buf)
         vc = VClock()
         for _ in range(n):
-            actor = _decode(buf)
-            counter = _decode(buf)
+            actor = _decode(buf, depth + 1)
+            counter = _decode(buf, depth + 1)
             vc.dots[actor] = counter
         return vc
 
@@ -384,9 +392,9 @@ def _decode(buf: io.BytesIO) -> Any:
         n = _read_uvarint(buf)
         deferred = {}
         for _ in range(n):
-            clock_key = _decode(buf)
+            clock_key = _decode(buf, depth + 1)
             m = _read_uvarint(buf)
-            members = set(_decode(buf) for _ in range(m))
+            members = set(_decode(buf, depth + 1) for _ in range(m))
             deferred[clock_key] = members
         return deferred
 
@@ -413,23 +421,23 @@ def _decode(buf: io.BytesIO) -> Any:
         return _read_exact(buf, n)
     if tag == _T_LIST:
         n = _read_uvarint(buf)
-        return [_decode(buf) for _ in range(n)]
+        return [_decode(buf, depth + 1) for _ in range(n)]
     if tag == _T_TUPLE:
         n = _read_uvarint(buf)
-        return tuple(_decode(buf) for _ in range(n))
+        return tuple(_decode(buf, depth + 1) for _ in range(n))
     if tag == _T_SET:
         n = _read_uvarint(buf)
-        return set(_decode(buf) for _ in range(n))
+        return set(_decode(buf, depth + 1) for _ in range(n))
     if tag == _T_FROZENSET:
         n = _read_uvarint(buf)
-        return frozenset(_decode(buf) for _ in range(n))
+        return frozenset(_decode(buf, depth + 1) for _ in range(n))
     if tag == _T_DICT:
         n = _read_uvarint(buf)
-        return {_decode(buf): _decode(buf) for _ in range(n)}
+        return {_decode(buf, depth + 1): _decode(buf, depth + 1) for _ in range(n)}
     if tag == _T_VCLOCK:
         return dec_vclock_body()
     if tag == _T_DOT:
-        actor = _decode(buf)
+        actor = _decode(buf, depth + 1)
         counter = _read_uvarint(buf)
         return Dot(actor, counter)
     if tag == _T_GCOUNTER:
@@ -437,71 +445,73 @@ def _decode(buf: io.BytesIO) -> Any:
     if tag == _T_PNCOUNTER:
         return PNCounter(GCounter(dec_vclock_body()), GCounter(dec_vclock_body()))
     if tag == _T_LWWREG:
-        val = _decode(buf)
-        marker = _decode(buf)
+        val = _decode(buf, depth + 1)
+        marker = _decode(buf, depth + 1)
         return LWWReg(val, marker)
     if tag == _T_MVREG:
         n = _read_uvarint(buf)
         vals = []
         for _ in range(n):
             clock = dec_vclock_body()
-            val = _decode(buf)
+            val = _decode(buf, depth + 1)
             vals.append((clock, val))
         return MVReg(vals)
     if tag == _T_GSET:
         n = _read_uvarint(buf)
-        return GSet(set(_decode(buf) for _ in range(n)))
+        return GSet(set(_decode(buf, depth + 1) for _ in range(n)))
     if tag == _T_ORSWOT:
         s = Orswot()
         s.clock = dec_vclock_body()
         n = _read_uvarint(buf)
         for _ in range(n):
-            member = _decode(buf)
-            clock = _decode(buf)
+            member = _decode(buf, depth + 1)
+            clock = _decode(buf, depth + 1)
             s.entries[member] = clock
         s.deferred = dec_deferred()
         return s
     if tag == _T_MAP:
-        val_type = _decode_val_type(buf)
+        val_type = _decode_val_type(buf, depth + 1)
         m = Map(val_type)
         m.clock = dec_vclock_body()
         n = _read_uvarint(buf)
         for _ in range(n):
-            key = _decode(buf)
+            key = _decode(buf, depth + 1)
             entry_clock = dec_vclock_body()
-            val = _decode(buf)
+            val = _decode(buf, depth + 1)
             m.entries[key] = Entry(clock=entry_clock, val=val)
         m.deferred = dec_deferred()
         return m
     if tag == _T_OP_ADD:
-        return Add(dot=_decode(buf), member=_decode(buf))
+        return Add(dot=_decode(buf, depth + 1), member=_decode(buf, depth + 1))
     if tag == _T_OP_ORM:
-        return ORm(clock=_decode(buf), member=_decode(buf))
+        return ORm(clock=_decode(buf, depth + 1), member=_decode(buf, depth + 1))
     if tag == _T_OP_PUT:
-        return Put(clock=_decode(buf), val=_decode(buf))
+        return Put(clock=_decode(buf, depth + 1), val=_decode(buf, depth + 1))
     if tag == _T_OP_PN:
-        dot = _decode(buf)
+        dot = _decode(buf, depth + 1)
         dir_byte = _read_exact(buf, 1)[0]
         return PNOp(dot=dot, dir=Dir.POS if dir_byte else Dir.NEG)
     if tag == _T_OP_MNOP:
         return MapNop()
     if tag == _T_OP_MRM:
-        return MapRm(clock=_decode(buf), key=_decode(buf))
+        return MapRm(clock=_decode(buf, depth + 1), key=_decode(buf, depth + 1))
     if tag == _T_OP_MUP:
-        return MapUp(dot=_decode(buf), key=_decode(buf), op=_decode(buf))
+        return MapUp(dot=_decode(buf, depth + 1), key=_decode(buf, depth + 1), op=_decode(buf, depth + 1))
     if tag == _T_ADDCTX:
-        return AddCtx(clock=_decode(buf), dot=_decode(buf))
+        return AddCtx(clock=_decode(buf, depth + 1), dot=_decode(buf, depth + 1))
     if tag == _T_RMCTX:
-        return RmCtx(clock=_decode(buf))
+        return RmCtx(clock=_decode(buf, depth + 1))
     if tag == _T_READCTX:
-        return ReadCtx(add_clock=_decode(buf), rm_clock=_decode(buf), val=_decode(buf))
+        return ReadCtx(add_clock=_decode(buf, depth + 1), rm_clock=_decode(buf, depth + 1), val=_decode(buf, depth + 1))
     raise ValueError(f"unknown tag 0x{tag:02x}")
 
 
-def _decode_val_type(buf: io.BytesIO):
+def _decode_val_type(buf: io.BytesIO, depth: int = 0):
+    if depth > _MAX_DEPTH:
+        raise ValueError(f"nesting deeper than {_MAX_DEPTH} levels")
     tag = _read_exact(buf, 1)[0]
     if tag == _T_VALTYPE_MAP:
-        return MapOf(_decode_val_type(buf))
+        return MapOf(_decode_val_type(buf, depth + 1))
     if tag == _T_VALTYPE_NAMED:
         n = _read_uvarint(buf)
         name = _read_exact(buf, n).decode()
@@ -520,9 +530,25 @@ def to_binary(obj: Any) -> bytes:
 
 
 def from_binary(data: bytes) -> Any:
-    """Reconstruct a value written by :func:`to_binary`."""
+    """Reconstruct a value written by :func:`to_binary`.
+
+    Raises ``ValueError`` on any malformed input.  Corrupt bytes from the
+    wire can otherwise escape as arbitrary exceptions — ``TypeError`` from
+    an unhashable set/dict element, ``RecursionError`` from a run of
+    nesting tags (each level costs one byte, so ~1 KB of ``0x07`` outruns
+    the interpreter stack), ``UnicodeDecodeError`` from a clipped UTF-8
+    sequence — so the decode is normalized to the one exception type a
+    transport layer has to handle (property: ``tests/test_serde.py``
+    fuzz suite).
+    """
     buf = io.BytesIO(data)
-    obj = _decode(buf)
+    try:
+        obj = _decode(buf)
+    except ValueError:
+        raise  # includes UnicodeDecodeError; already the contract type
+    except (TypeError, KeyError, IndexError, OverflowError, struct.error,
+            RecursionError) as e:
+        raise ValueError(f"malformed input: {type(e).__name__}: {e}") from e
     rest = buf.read()
     if rest:
         raise ValueError(f"{len(rest)} trailing bytes after decode")

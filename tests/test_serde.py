@@ -163,3 +163,87 @@ def test_mvreg_equal_states_encode_equal_bytes():
     b.merge(r1)
     assert a == b
     assert to_binary(a) == to_binary(b)
+
+
+# -- fuzz: malformed input must fail with ValueError, nothing else ----------
+#
+# The reference delegates this to bincode's typed Result (`lib.rs:79-83`);
+# our contract is the same at the API boundary: from_binary either returns a
+# value or raises ValueError.  Corrupt wires must not leak TypeError /
+# RecursionError / UnicodeDecodeError out of the codec.
+
+
+def _decode_is_total(data: bytes):
+    try:
+        from_binary(data)
+    except ValueError:
+        pass  # the one contract exception (UnicodeDecodeError subclasses it)
+
+
+@given(st.binary(max_size=512))
+def test_prop_random_bytes_decode_totally(data):
+    _decode_is_total(data)
+
+
+def _fuzz_corpus():
+    vc = VClock.from_iter([(1, 3), (2, 5)])
+    o = Orswot()
+    o.apply(o.add("m", o.value().derive_add_ctx(1)))
+    m = Map(MVReg)
+    m.apply(m.update("k", m.len().derive_add_ctx(2), lambda r, c: r.set(9, c)))
+    return [to_binary(x) for x in (vc, o, m, {"a": [1, (2.5, None)]}, "héllo")]
+
+
+_CORPUS = _fuzz_corpus()
+
+
+@given(
+    st.integers(0, len(_CORPUS) - 1),
+    st.integers(0, 4096),
+    st.integers(0, 255),
+    st.sampled_from(["flip", "insert", "delete", "truncate"]),
+)
+def test_prop_mutated_encodings_decode_totally(which, pos, byte, mode):
+    data = bytearray(_CORPUS[which])
+    pos %= max(1, len(data))
+    if mode == "flip":
+        data[pos] = byte
+    elif mode == "insert":
+        data.insert(pos, byte)
+    elif mode == "delete":
+        del data[pos]
+    else:
+        data = data[:pos]
+    _decode_is_total(bytes(data))
+
+
+def test_nesting_bomb_raises_valueerror():
+    """~2 KB of list tags nests one level per byte pair; the explicit
+    _MAX_DEPTH guard must reject it deterministically (long before the
+    interpreter stack is at risk)."""
+    import pytest
+
+    bomb = bytes([0x07, 0x01]) * 2000 + bytes([0x00])
+    with pytest.raises(ValueError, match="nesting deeper"):
+        from_binary(bomb)
+
+
+def test_val_type_nesting_bomb_raises_valueerror():
+    """The Map val_type decoder recurses separately from _decode; a run of
+    MapOf tags must hit the same deterministic depth bound."""
+    import pytest
+
+    bomb = bytes([0x27]) + bytes([0x51]) * 2000
+    with pytest.raises(ValueError, match="nesting deeper"):
+        from_binary(bomb)
+
+
+def test_unhashable_set_element_raises_valueerror():
+    """A set whose element decodes to a list is unhashable — TypeError in
+    the body, ValueError at the boundary."""
+    import pytest
+
+    # T_SET, count=1, element = empty list
+    data = bytes([0x09, 0x01, 0x07, 0x00])
+    with pytest.raises(ValueError):
+        from_binary(data)

@@ -138,7 +138,17 @@ def load(path) -> Tuple[Any, Universe]:
     """Load a checkpoint written by :func:`save`.
 
     Returns ``(batch_state, universe)`` with bit-exact buffers.
+
+    Raises ``ValueError`` on a corrupt or non-checkpoint input (missing
+    files still raise ``FileNotFoundError``).  ``load_bytes`` doubles as
+    the state-replication receive path, so — like
+    :func:`~crdt_tpu.utils.serde.from_binary` — malformed payloads must
+    surface as the one contract exception, not as ``zipfile.BadZipFile``
+    / ``KeyError`` / ``AttributeError`` from the container internals.
     """
+    import zipfile
+    import zlib
+
     import jax.numpy as jnp
 
     if isinstance(path, (str, os.PathLike)):
@@ -148,32 +158,65 @@ def load(path) -> Tuple[Any, Universe]:
             # bare path only when no .npz exists
             if os.path.exists(p + ".npz") or not os.path.exists(p):
                 path = p + ".npz"
-    with np.load(path) as z:
-        meta = serde.from_binary(z["__meta__"].tobytes())
-        if meta.get("version") != FORMAT_VERSION:
-            raise ValueError(f"unsupported checkpoint version: {meta.get('version')!r}")
-        cls = _batch_types().get(meta.get("type"))
-        if cls is None:
-            raise ValueError(f"unknown batch type in checkpoint: {meta.get('type')!r}")
-        universe = _universe_from_blob(z["__universe__"].tobytes())
-        static = meta.get("static", {})
-        fields = {}
-        for f in dataclasses.fields(cls):
-            if _is_static_field(f):
-                from ..batch.val_kernels import kernel_from_spec
+    try:
+        container = np.load(path)
+    except (FileNotFoundError, PermissionError, IsADirectoryError):
+        raise  # real I/O failures are not data corruption
+    except (zipfile.BadZipFile, OSError, ValueError, EOFError) as e:
+        raise ValueError(f"not a checkpoint container: {e}") from e
+    if not isinstance(container, np.lib.npyio.NpzFile):
+        # a bare .npy (or anything else np.load accepts) is not a checkpoint
+        raise ValueError(
+            f"not a checkpoint container: expected npz, got {type(container).__name__}"
+        )
+    with container as z:
+        try:
+            meta = serde.from_binary(z["__meta__"].tobytes())
+            if not isinstance(meta, dict) or meta.get("version") != FORMAT_VERSION:
+                raise ValueError(
+                    "unsupported checkpoint version: "
+                    f"{(meta.get('version') if isinstance(meta, dict) else meta)!r}"
+                )
+            cls = _batch_types().get(meta.get("type"))
+            if cls is None:
+                raise ValueError(
+                    f"unknown batch type in checkpoint: {meta.get('type')!r}"
+                )
+            universe = _universe_from_blob(z["__universe__"].tobytes())
+            static = meta.get("static", {})
+            fields = {}
+            for f in dataclasses.fields(cls):
+                if _is_static_field(f):
+                    from ..batch.val_kernels import kernel_from_spec
 
-                fields[f.name] = kernel_from_spec(static[f.name])
-            elif f.name in z:
-                fields[f.name] = jnp.asarray(z[f.name])
-            else:
-                prefix = f.name + "__"
-                rows = []
-                for key in z.files:
-                    if key.startswith(prefix):
-                        idx_path = tuple(int(s) for s in key[len(prefix):].split("_"))
-                        rows.append((idx_path, jnp.asarray(z[key])))
-                fields[f.name] = _rebuild_tuple(sorted(rows))
-    return cls(**fields), universe
+                    fields[f.name] = kernel_from_spec(static[f.name])
+                elif f.name in z:
+                    fields[f.name] = jnp.asarray(z[f.name])
+                else:
+                    prefix = f.name + "__"
+                    rows = []
+                    for key in z.files:
+                        if key.startswith(prefix):
+                            idx_path = tuple(
+                                int(s) for s in key[len(prefix):].split("_")
+                            )
+                            rows.append((idx_path, jnp.asarray(z[key])))
+                    if not rows:
+                        raise ValueError(
+                            f"checkpoint missing arrays for field {f.name!r}"
+                        )
+                    fields[f.name] = _rebuild_tuple(sorted(rows))
+            out = cls(**fields)
+        except ValueError:
+            raise
+        except (KeyError, AttributeError, TypeError, IndexError,
+                zipfile.BadZipFile, zlib.error, EOFError) as e:
+            # NpzFile member reads are lazy: a corrupted member surfaces
+            # its zip/zlib error at z[key], inside this block
+            raise ValueError(
+                f"malformed checkpoint: {type(e).__name__}: {e}"
+            ) from e
+    return out, universe
 
 
 def save_bytes(batch_state: Any, universe: Universe) -> bytes:

@@ -207,3 +207,118 @@ def test_gset_batch_roundtrip(tmp_path):
     assert type(loaded) is GSetBatch
     _assert_batch_equal(batch, loaded)
     assert loaded.to_scalar(uni2) == sets
+
+
+def test_corrupt_container_raises_valueerror():
+    """load_bytes is the state-replication receive path; corrupt payloads
+    must raise ValueError, not zipfile/KeyError internals (same totality
+    contract as serde.from_binary)."""
+    import pytest
+
+    from crdt_tpu.utils.serde import to_binary
+
+    for bad in [b"", b"garbage-not-a-zip", b"PK\x03\x04truncated"]:
+        with pytest.raises(ValueError):
+            checkpoint.load_bytes(bad)
+
+    # a real npz that is not a checkpoint (missing __meta__/__universe__)
+    buf = io.BytesIO()
+    np.savez(buf, a=np.arange(3))
+    with pytest.raises(ValueError):
+        checkpoint.load_bytes(buf.getvalue())
+
+    # meta decodes to a non-dict
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        __meta__=np.frombuffer(to_binary(42), dtype=np.uint8),
+        __universe__=np.frombuffer(to_binary({}), dtype=np.uint8),
+    )
+    with pytest.raises(ValueError):
+        checkpoint.load_bytes(buf.getvalue())
+
+
+def test_truncated_checkpoint_raises_valueerror():
+    universe = Universe()
+    sets = [Orswot() for _ in range(2)]
+    for i, s in enumerate(sets):
+        s.apply(s.add(f"m{i}", s.value().derive_add_ctx(1)))
+    batch = OrswotBatch.from_scalar(sets, universe)
+    data = checkpoint.save_bytes(batch, universe)
+
+    import pytest
+
+    for cut in (1, len(data) // 2, len(data) - 3):
+        with pytest.raises(ValueError):
+            checkpoint.load_bytes(data[:cut])
+
+
+def test_missing_file_still_filenotfound(tmp_path):
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        checkpoint.load(tmp_path / "nope.npz")
+
+
+def test_bare_npy_payload_raises_valueerror():
+    """np.load on a bare .npy returns an ndarray, not an NpzFile; the
+    receive path must reject it as a non-checkpoint, not crash on the
+    missing context-manager protocol."""
+    import pytest
+
+    buf = io.BytesIO()
+    np.save(buf, np.arange(4))
+    with pytest.raises(ValueError, match="not a checkpoint container"):
+        checkpoint.load_bytes(buf.getvalue())
+
+
+def test_corrupted_member_crc_raises_valueerror():
+    """Npz member reads are lazy: a bit-flip inside a member surfaces as
+    zipfile.BadZipFile at z[key] — must be converted to ValueError."""
+    import pytest
+
+    universe = Universe()
+    sets = [Orswot()]
+    sets[0].apply(sets[0].add("m", sets[0].value().derive_add_ctx(1)))
+    data = bytearray(checkpoint.save_bytes(OrswotBatch.from_scalar(sets, universe), universe))
+
+    # flip one byte inside the first stored member's payload (past the
+    # 30-byte local header + name), leaving the zip directory intact
+    name_len = data[26] | (data[27] << 8)
+    payload_at = 30 + name_len + 64
+    data[payload_at] ^= 0xFF
+    with pytest.raises(ValueError):
+        checkpoint.load_bytes(bytes(data))
+
+
+def test_missing_field_arrays_raise_valueerror():
+    """A structurally valid npz that lacks a field's arrays must fail at
+    load time, not return a silently-corrupt batch."""
+    import zipfile as zf
+
+    import pytest
+
+    universe = Universe()
+    sets = [Orswot()]
+    sets[0].apply(sets[0].add("m", sets[0].value().derive_add_ctx(1)))
+    data = checkpoint.save_bytes(OrswotBatch.from_scalar(sets, universe), universe)
+
+    # rebuild the zip without one data member
+    src = zf.ZipFile(io.BytesIO(data))
+    victim = next(n for n in src.namelist() if not n.startswith("__"))
+    out = io.BytesIO()
+    with zf.ZipFile(out, "w") as dst:
+        for n in src.namelist():
+            if n != victim:
+                dst.writestr(n, src.read(n))
+    with pytest.raises(ValueError):
+        checkpoint.load_bytes(out.getvalue())
+
+
+def test_directory_path_keeps_io_error(tmp_path):
+    """Real I/O failures are not data corruption: loading a directory
+    surfaces the OS error, not a 'corrupt checkpoint' ValueError."""
+    import pytest
+
+    with pytest.raises(IsADirectoryError):
+        checkpoint.load(tmp_path)

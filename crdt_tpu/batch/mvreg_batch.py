@@ -104,6 +104,15 @@ class MVRegBatch:
         """Folded clock per register (`mvreg.rs:216-222`)."""
         return mvreg_ops.read_clock(self.clocks)
 
+    def truncate(self, clock) -> "MVRegBatch":
+        """``Causal::truncate`` (`mvreg.rs:100-113`): subtract ``clock``
+        from every val clock, dropping vals whose clock empties out.
+        ``clock``: ``[N, A]`` counter array, one truncation clock per
+        register.  Cannot overflow (it only removes)."""
+        t = jnp.asarray(clock, dtype=self.clocks.dtype)
+        clocks, vals = _truncate(self.clocks, self.vals, t)
+        return MVRegBatch(clocks=clocks, vals=vals)
+
     # -- elastic-capacity protocol (crdt_tpu.parallel.JoinExecutor) ----------
     # The executor's generic slot-axis names are member/deferred; for a
     # register batch the one growable axis is the antichain (mv_capacity),
@@ -156,3 +165,18 @@ def _merge(ca, va, cb, vb, k_cap):
 def _apply_put(clocks, vals, op_clock, op_val, k_cap):
     clocks2, vals2, keep = mvreg_ops.apply_put(clocks, vals, op_clock, op_val)
     return mvreg_ops.compact(clocks2, vals2, keep, k_cap)
+
+
+@jax.jit
+def _truncate(clocks, vals, t_clock):
+    """Delegates to the nested-protocol kernel (`MVRegKernel.truncate`) —
+    one home for the `mvreg.rs:100-113` semantics."""
+    from .val_kernels import MVRegKernel
+
+    kern = MVRegKernel(
+        mv_capacity=clocks.shape[-2],
+        num_actors=clocks.shape[-1],
+        counter_bits=clocks.dtype.itemsize * 8,
+    )
+    (c, v), _ = kern.truncate((clocks, vals), t_clock)
+    return c, v

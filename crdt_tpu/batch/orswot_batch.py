@@ -682,6 +682,26 @@ class OrswotBatch:
             raise_for_overflow(overflow, "join_fleet")
         return cls(clock=clock, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks)
 
+    def truncate(self, clock, check: bool = True) -> "OrswotBatch":
+        """``Causal::truncate`` (`orswot.rs:159-172`): forget causal history
+        dominated by ``clock`` — the reference's merge-with-an-empty-set
+        trick followed by subtracting ``clock`` from the set clock and
+        every member clock.  ``clock``: ``[N, A]`` counter array, one
+        truncation clock per object.  Same semantics as
+        :meth:`~crdt_tpu.batch.val_kernels.OrswotKernel.truncate`, which
+        serves the nested (Map) protocol."""
+        m_cap = self.ids.shape[-1]
+        d_cap = self.d_ids.shape[-1]
+        (c, ids, dots, d_ids, d_clocks), overflow = _truncate(
+            self.clock, self.ids, self.dots, self.d_ids, self.d_clocks,
+            jnp.asarray(clock, dtype=self.clock.dtype), m_cap, d_cap,
+        )
+        if check:
+            raise_for_overflow(overflow, "truncate")
+        return OrswotBatch(
+            clock=c, ids=ids, dots=dots, d_ids=d_ids, d_clocks=d_clocks
+        )
+
     # -- op path ----------------------------------------------------------
 
     def apply_add(self, actor_idx, counter, member_id, check: bool = True) -> "OrswotBatch":
@@ -752,3 +772,19 @@ def _apply_add(clock, ids, dots, d_ids, d_clocks, actor_idx, counter, member_id)
 @jax.jit
 def _apply_remove(clock, ids, dots, d_ids, d_clocks, rm_clock, member_id):
     return orswot_ops.apply_remove(clock, ids, dots, d_ids, d_clocks, rm_clock, member_id)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7))
+def _truncate(clock, ids, dots, d_ids, d_clocks, t_clock, m_cap, d_cap):
+    """One semantics, one home: delegates to the nested-protocol kernel
+    (`val_kernels.OrswotKernel.truncate_full`), keeping the per-axis
+    overflow pair for raise_for_overflow."""
+    from .val_kernels import OrswotKernel
+
+    kern = OrswotKernel(
+        member_capacity=m_cap,
+        deferred_capacity=d_cap,
+        num_actors=clock.shape[-1],
+        counter_bits=clock.dtype.itemsize * 8,
+    )
+    return kern.truncate_full((clock, ids, dots, d_ids, d_clocks), t_clock)

@@ -178,18 +178,30 @@ class OrswotKernel:
         # per-axis elastic recovery) — collapse the member/deferred pair
         return out[:5], jnp.any(out[5], axis=-1)
 
-    def truncate(self, v, clock):
+    def truncate_full(self, v, clock):
         """`orswot.rs:159-172`: merge with an empty set carrying ``clock``,
-        then subtract ``clock`` from the set clock and every member clock."""
+        then subtract ``clock`` from the set clock and every member clock.
+        Returns the un-collapsed member/deferred overflow pair
+        (``bool[..., 2]``) for callers that report per-axis overflow
+        (``OrswotBatch.truncate``)."""
         empty = self.zeros_like(v)
-        merged, over = self.merge(v, (clock,) + empty[1:])
-        mclock, ids, dots, d_ids, d_clocks = merged
+        out = orswot_ops.merge(
+            *v, clock, *empty[1:],
+            self.member_capacity, self.deferred_capacity,
+        )
+        mclock, ids, dots, d_ids, d_clocks = out[:5]
+        over = out[5]
         mclock = clock_ops.subtract(mclock, clock)
         dots = clock_ops.subtract(dots, clock[..., None, :])
         live = ~clock_ops.is_empty(dots) & (ids != EMPTY)
         ids = jnp.where(live, ids, EMPTY)
         dots = jnp.where(live[..., None], dots, 0)
         return (mclock, ids, dots, d_ids, d_clocks), over
+
+    def truncate(self, v, clock):
+        """Protocol form: overflow collapsed to one flag per object."""
+        out, over = self.truncate_full(v, clock)
+        return out, jnp.any(over, axis=-1)
 
     def apply_add(self, v, actor_idx, counter, member_id):
         """Nested ``Op::Add`` (`orswot.rs:66-79`) for Map ``Op::Up``."""

@@ -446,3 +446,57 @@ def test_lww_markers_stay_64bit_under_counter_bits_32():
     batch = LWWRegBatch.from_scalar(regs, uni)
     assert batch.markers.dtype == jnp.uint64
     assert batch.to_scalar(uni)[0].marker == epoch_micros
+
+
+# -- Causal::truncate --------------------------------------------------------
+
+
+@given(st.lists(st.tuples(orswots(), vclocks), min_size=1, max_size=4))
+@settings(max_examples=60)
+def test_orswot_truncate_parity(pairs):
+    """`orswot.rs:159-172` on the batch engine: bit-identical state vs the
+    scalar truncate, per object."""
+    uni = small_universe()
+    states = [s for s, _ in pairs]
+    clocks = [c for _, c in pairs]
+
+    expected = []
+    for s, c in pairs:
+        e = s.clone()
+        e.truncate(c)
+        expected.append(e)
+
+    batch = OrswotBatch.from_scalar(states, uni)
+    got = batch.truncate(
+        VClockBatch.from_scalar(clocks, uni).clocks
+    ).to_scalar(uni)
+    assert got == expected, f"\nbatch:  {got!r}\nscalar: {expected!r}"
+
+
+@given(st.lists(st.tuples(mvregs(), vclocks), min_size=1, max_size=4))
+@settings(max_examples=60)
+def test_mvreg_truncate_parity(pairs):
+    """`mvreg.rs:100-113` on the batch engine."""
+    uni = small_universe()
+    expected = []
+    for r, c in pairs:
+        e = r.clone()
+        e.truncate(c)
+        expected.append(e)
+
+    batch = MVRegBatch.from_scalar([r for r, _ in pairs], uni)
+    got = batch.truncate(
+        VClockBatch.from_scalar([c for _, c in pairs], uni).clocks
+    ).to_scalar(uni)
+    assert got == expected, f"\nbatch:  {got!r}\nscalar: {expected!r}"
+
+
+def test_truncate_empty_clock_is_identity():
+    """Truncating by the empty clock must be a no-op (`vclock.rs:103-120`
+    GLB with nothing removes nothing)."""
+    uni = small_universe()
+    s = Orswot()
+    s.apply(s.add("m", s.value().derive_add_ctx(1)))
+    batch = OrswotBatch.from_scalar([s], uni)
+    got = batch.truncate(jnp.zeros_like(batch.clock)).to_scalar(uni)[0]
+    assert got == s

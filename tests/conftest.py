@@ -33,3 +33,15 @@ settings.register_profile(
     suppress_health_check=[HealthCheck.too_slow],
 )
 settings.load_profile("crdt")
+
+
+def assert_no_collectives(hlo: str, what: str) -> None:
+    """Assert a compiled HLO moves no cross-device traffic — the
+    zero-collective claim shared by the shard-local merge/truncate and
+    member-sharding tests.  One home for the op-name list so new
+    collective ops get covered everywhere at once."""
+    for collective in (
+        "all-gather", "all-reduce", "collective-permute", "all-to-all",
+        "ragged-all-to-all", "reduce-scatter",
+    ):
+        assert collective not in hlo, f"{what} emitted {collective}"

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import assert_no_collectives
+
 from crdt_tpu import Dot, Orswot, VClock
 from crdt_tpu.batch import OrswotBatch, VClockBatch
 from crdt_tpu.config import CrdtConfig
@@ -310,8 +312,7 @@ def test_sharded_pairwise_merge_no_collectives():
         tuple(jax.tree_util.tree_leaves(b_sharded)),
     ).compile()
     hlo = compiled.as_text()
-    for collective in ("all-gather", "all-reduce", "collective-permute", "all-to-all"):
-        assert collective not in hlo, f"shard-local merge emitted {collective}"
+    assert_no_collectives(hlo, "shard-local merge")
 
 
 # -- LWWReg / MVReg / GSet collective joins ----------------------------------
@@ -542,3 +543,49 @@ def test_allgather_join_mvreg_random_histories(seed):
     for r in range(8):
         got = MVRegBatch(clocks=joined.clocks[r], vals=joined.vals[r]).to_scalar(uni)
         assert got == expected, f"replica shard {r} diverged (seed {seed})"
+
+
+def test_sharded_truncate_matches_unsharded():
+    """Causal::truncate is elementwise over the object axis: on a sharded
+    fleet it must match the unsharded result and, under ``shard_map``,
+    compile with zero cross-device traffic (`orswot.rs:159-172`)."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from crdt_tpu.batch.orswot_batch import _truncate
+
+    mesh = make_mesh({"objects": 8})
+    uni = small_universe()
+    fleet = random_orswots(seed=21, n_replicas=1, n_objects=32)[0]
+    batch = OrswotBatch.from_scalar(fleet, uni)
+
+    # truncate each object by its own clock's GLB with a fixed horizon
+    rng = np.random.RandomState(3)
+    horizon = jnp.asarray(
+        rng.randint(0, 4, size=batch.clock.shape), dtype=batch.clock.dtype
+    )
+    expected = batch.truncate(horizon).to_scalar(uni)
+
+    sharded = shard_batch(batch, mesh, "objects")
+    got = sharded.truncate(horizon).to_scalar(uni)
+    assert got == expected
+
+    m_cap, d_cap = batch.ids.shape[-1], batch.d_ids.shape[-1]
+    spec = P("objects")
+    fn = shard_map(
+        partial(_truncate, m_cap=m_cap, d_cap=d_cap),
+        mesh=mesh,
+        in_specs=(spec,) * 6,
+        out_specs=((spec,) * 5, spec),
+        check_vma=False,
+    )
+    args = (sharded.clock, sharded.ids, sharded.dots,
+            sharded.d_ids, sharded.d_clocks, horizon)
+    (state5, overflow) = fn(*args)
+    got_local = OrswotBatch(*state5).to_scalar(uni)
+    assert got_local == expected
+
+    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    assert_no_collectives(hlo, "shard-local truncate")

@@ -687,3 +687,37 @@ def test_map_map_mvreg_three_engine_agreement():
             m.merge(states[i])
         expected.append(m)
     assert got == expected
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_orswot_fold_parity(engines, dtype):
+    """The bench's native-fold headline path (sequential R-way fold +
+    defer plunger, bench.py native_fold_join) must be bit-identical to
+    the jnp fold on anti-entropy-shaped fleets with deferred rows."""
+    import jax
+
+    engine, *_, orswot_ops, jnp = engines
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(11)
+    n, a, m, d, r = 64, 8, 8, 2, 4
+    reps = anti_entropy_fleets(
+        rng, n, a, m, d, r, base=3, novel=1, deferred_frac=0.3, dtype=dtype
+    )
+    stack = [tuple(np.asarray(x) for x in rep) for rep in reps]
+
+    acc = stack[0]
+    for i in range(1, r):
+        acc = engine.orswot_merge(*acc, *stack[i])[:5]
+    acc = engine.orswot_merge(*acc, *acc)[:5]  # defer plunger
+
+    jacc = tuple(jnp.asarray(x) for x in stack[0])
+    for i in range(1, r):
+        jacc = orswot_ops.merge(*jacc, *(jnp.asarray(x) for x in stack[i]), m, d)[:5]
+    jacc = orswot_ops.merge(*jacc, *jacc, m, d)[:5]
+    jax.block_until_ready(jacc)
+
+    for k, (x, y) in enumerate(zip(acc, jacc)):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=f"plane {k} diverged"
+        )

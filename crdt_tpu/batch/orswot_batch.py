@@ -47,6 +47,13 @@ def _next_pow2(c: int) -> int:
     return 1 if c <= 0 else 1 << (c - 1).bit_length()
 
 
+# host-path egress slice size: per-call conversion cost grows superlinearly
+# past a few hundred thousand objects (measured 2.5x at 1M vs 4x250k with
+# identical final heap — INGEST_PROFILE.md), so to_scalar converts fleets
+# in slices of this many objects
+_EGRESS_SLICE = 250_000
+
+
 def _on_accelerator(x) -> bool:
     try:
         return any(dev.platform != "cpu" for dev in x.devices())
@@ -531,10 +538,41 @@ class OrswotBatch:
         five vectorized passes (on device when the planes live on an
         accelerator — dense planes never cross the tunnel); the Python
         loop only walks actual dots (sparse), never the dense
-        ``[N, M, A]`` volume."""
+        ``[N, M, A]`` volume.
+
+        Host-path fleets convert in bounded slices: one monolithic pass
+        measured 2.5× SLOWER at 1M than the same work in 250k slices
+        (51k vs 128k obj/s, outputs all kept live either way — the cost
+        grows superlinearly with per-call size, not with the resulting
+        heap; `reports/INGEST_PROFILE.md` reproduction section)."""
         import numpy as np
 
         from ..scalar.vclock import VClock
+
+        if via_device is None:
+            via_device = _on_accelerator(self.clock)
+        n_total = self.clock.shape[0]
+        if not via_device and n_total > _EGRESS_SLICE * 3 // 2:
+            # numpy views, not jnp slicing: one zero-copy np.asarray per
+            # plane, then each slice is a view — no XLA slice dispatch or
+            # per-slice plane copies
+            planes = tuple(
+                np.asarray(x)
+                for x in (self.clock, self.ids, self.dots,
+                          self.d_ids, self.d_clocks)
+            )
+            out: list = []
+            s0 = 0
+            while s0 < n_total:
+                # a short final remainder (< slice/2) merges into this
+                # slice instead of becoming a tiny ragged call
+                end = s0 + _EGRESS_SLICE
+                if n_total - end < _EGRESS_SLICE // 2:
+                    end = n_total
+                sub = OrswotBatch(*(p[s0:end] for p in planes))
+                out.extend(sub.to_scalar(universe, via_device=False))
+                s0 = end
+            return out
 
         cells = self._cells(via_device)
         (co, ca, cv), (eo, es, em), (do, ds, _dm, da, dv), (qo, qr, qm), (

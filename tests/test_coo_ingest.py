@@ -292,3 +292,42 @@ class TestDeviceCellPaths:
         )
         s = b2.to_scalar(uni)[0]
         assert s.entries == {"w": VClock({"a1": 3})}
+
+
+def test_to_scalar_sliced_path_matches_monolithic(monkeypatch):
+    """The host-path egress slicing (perf: superlinear per-call cost)
+    must be invisible: sliced output == monolithic output, including a
+    non-multiple tail slice and deferred rows."""
+    import numpy as np
+
+    from crdt_tpu.batch import orswot_batch as ob
+    from crdt_tpu.batch.orswot_batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.scalar.orswot import Orswot
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.utils.interning import Universe
+
+    rng = np.random.RandomState(9)
+    states = []
+    for i in range(23):
+        s = Orswot()
+        actor = int(rng.randint(0, 4))
+        s.clock = VClock({actor: int(rng.randint(1, 9))})
+        s.entries[int(rng.randint(0, 50))] = s.clock.clone()
+        if i % 5 == 0:  # causally-future deferred remove
+            s.deferred[VClock({actor: 99}).key()] = {int(rng.randint(0, 50))}
+        states.append(s)
+
+    uni = Universe(CrdtConfig(num_actors=4, member_capacity=4, deferred_capacity=2))
+    batch = OrswotBatch.from_scalar(states, uni)
+
+    # via_device pinned False so the sliced HOST path runs even when the
+    # ambient backend is an accelerator (auto-detect would skip it)
+    monolithic = batch.to_scalar(uni, via_device=False)
+    monkeypatch.setattr(ob, "_EGRESS_SLICE", 4)  # force slicing + tail merge
+    sliced = batch.to_scalar(uni, via_device=False)
+    assert sliced == monolithic == states
+    # 23 = 5 full slices of 4 + remainder 3 > slice/2=2 → own slice; also
+    # cover the merge-into-previous case
+    monkeypatch.setattr(ob, "_EGRESS_SLICE", 10)  # 23 → 10 + 13 (merged tail)
+    assert batch.to_scalar(uni, via_device=False) == states

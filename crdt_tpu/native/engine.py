@@ -186,6 +186,7 @@ def orswot_merge(
     clock_a, ids_a, dots_a, dids_a, dclocks_a,
     clock_b, ids_b, dots_b, dids_b, dclocks_b,
     m_cap: int | None = None, d_cap: int | None = None,
+    out=None,
 ):
     """Full pairwise ORSWOT merge (`orswot.rs:89-156`), bit-exact with
     :func:`crdt_tpu.ops.orswot_ops.merge` including output slot order
@@ -193,7 +194,14 @@ def orswot_merge(
 
     Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)`` with
     ``overflow`` = ``bool[..., 2]`` (member / deferred axis flags, matching
-    the jnp kernel)."""
+    the jnp kernel).
+
+    ``out``: optional preallocated 5-tuple of output planes to write into
+    (same shapes/dtypes the call would otherwise allocate).  The C kernel
+    fully overwrites every output cell, so reuse is safe; fold loops
+    ping-pong two buffer sets to avoid an mmap page-zeroing pass per
+    merge (~working-set bytes of pure overhead each call at fleet
+    scale).  Outputs MUST NOT alias either input."""
     A = _orswot_state(clock_a, ids_a, dots_a, dids_a, dclocks_a)
     B = _orswot_state(clock_b, ids_b, dots_b, dids_b, dclocks_b)
     dt = _check_counters(A[0], B[0])
@@ -209,11 +217,45 @@ def orswot_merge(
     m_cap = m if m_cap is None else m_cap
     d_cap = d if d_cap is None else d_cap
 
-    clock = np.empty((*lead, a), dtype=dt)
-    ids = np.empty((*lead, m_cap), dtype=np.int32)
-    dots = np.empty((*lead, m_cap, a), dtype=dt)
-    d_ids = np.empty((*lead, d_cap), dtype=np.int32)
-    d_clocks = np.empty((*lead, d_cap, a), dtype=dt)
+    if out is None:
+        clock = np.empty((*lead, a), dtype=dt)
+        ids = np.empty((*lead, m_cap), dtype=np.int32)
+        dots = np.empty((*lead, m_cap, a), dtype=dt)
+        d_ids = np.empty((*lead, d_cap), dtype=np.int32)
+        d_clocks = np.empty((*lead, d_cap, a), dtype=dt)
+    else:
+        clock, ids, dots, d_ids, d_clocks = out
+        expect = (
+            ((*lead, a), dt), ((*lead, m_cap), np.int32),
+            ((*lead, m_cap, a), dt), ((*lead, d_cap), np.int32),
+            ((*lead, d_cap, a), dt),
+        )
+        for name, buf, (shape, dtype) in zip(
+            ("clock", "ids", "dots", "d_ids", "d_clocks"),
+            (clock, ids, dots, d_ids, d_clocks), expect,
+        ):
+            if (not isinstance(buf, np.ndarray) or buf.shape != shape
+                    or buf.dtype != np.dtype(dtype)
+                    or not buf.flags.c_contiguous):
+                raise ValueError(
+                    f"out[{name}]: need C-contiguous {np.dtype(dtype)}"
+                    f"{shape}, got "
+                    f"{getattr(buf, 'dtype', type(buf))}"
+                    f"{getattr(buf, 'shape', '')}"
+                )
+            for src in (*A, *B):
+                if np.shares_memory(buf, src):
+                    raise ValueError(f"out[{name}] aliases an input plane")
+        # outputs must also be distinct from each other (same-shaped int32
+        # planes like ids/d_ids would otherwise pass every check above)
+        outs = (clock, ids, dots, d_ids, d_clocks)
+        for i in range(len(outs)):
+            for j in range(i + 1, len(outs)):
+                if np.shares_memory(outs[i], outs[j]):
+                    raise ValueError(
+                        "out planes must not alias each other "
+                        f"(planes {i} and {j} share memory)"
+                    )
     overflow = np.empty(n * 2, dtype=np.uint8)
     _fn("orswot_merge", dt)(
         _ptr(A[0]), _ptr(A[1]), _ptr(A[2]), _ptr(A[3]), _ptr(A[4]),

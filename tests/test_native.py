@@ -721,3 +721,68 @@ def test_orswot_fold_parity(engines, dtype):
         np.testing.assert_array_equal(
             np.asarray(x), np.asarray(y), err_msg=f"plane {k} diverged"
         )
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_orswot_merge_out_buffers(engines, dtype):
+    """The out= reuse path (bench fold ping-pong) must be bit-identical
+    to fresh allocation, reject shape/dtype mismatches, and reject
+    buffers aliasing an input."""
+    engine, *_ = engines
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(5)
+    n, a, m, d = 32, 8, 8, 2
+    lhs, rhs = [
+        tuple(np.asarray(x) for x in rep)
+        for rep in anti_entropy_fleets(
+            rng, n, a, m, d, 2, base=3, novel=1, deferred_frac=0.3,
+            dtype=dtype,
+        )
+    ]
+    want = engine.orswot_merge(*lhs, *rhs)
+
+    out = (
+        np.empty((n, a), dtype), np.empty((n, m), np.int32),
+        np.empty((n, m, a), dtype), np.empty((n, d), np.int32),
+        np.empty((n, d, a), dtype),
+    )
+    got = engine.orswot_merge(*lhs, *rhs, out=out)
+    for x, y in zip(want, got):
+        np.testing.assert_array_equal(x, y)
+    assert got[0] is out[0]  # actually wrote into the caller's buffer
+
+    # second reuse of the same buffers still exact (full overwrite)
+    got2 = engine.orswot_merge(*rhs, *lhs, out=out)
+    want2 = engine.orswot_merge(*rhs, *lhs)
+    for x, y in zip(want2, got2):
+        np.testing.assert_array_equal(x, y)
+
+    with pytest.raises(ValueError, match="out\\[clock\\]"):
+        engine.orswot_merge(
+            *lhs, *rhs, out=(np.empty((n, a + 1), dtype),) + out[1:]
+        )
+    with pytest.raises(ValueError, match="aliases"):
+        engine.orswot_merge(*lhs, *rhs, out=(lhs[0],) + out[1:])
+
+
+def test_orswot_merge_out_rejects_mutual_aliasing(engines):
+    """Same buffer passed as two outputs (ids/d_ids share shape+dtype
+    when m == d) must be rejected, not silently corrupted."""
+    engine, *_ = engines
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(6)
+    n, a, m, d = 8, 4, 2, 2  # m == d: ids/d_ids shapes coincide
+    lhs, rhs = [
+        tuple(np.asarray(x) for x in rep)
+        for rep in anti_entropy_fleets(rng, n, a, m, d, 2, base=1, novel=0)
+    ]
+    ids_buf = np.empty((n, m), np.int32)
+    out = (
+        np.empty((n, a), np.uint32), ids_buf,
+        np.empty((n, m, a), np.uint32), ids_buf,
+        np.empty((n, d, a), np.uint32),
+    )
+    with pytest.raises(ValueError, match="alias each other"):
+        engine.orswot_merge(*lhs, *rhs, out=out)

@@ -207,7 +207,10 @@ template <typename C>
 void apply_deferred_row(const C* clock, std::vector<int32_t>& ids,
                         std::vector<C>& dots, std::vector<int32_t>& d_ids,
                         std::vector<C>& d_clocks, int64_t a) {
-  std::vector<C> rm(a);
+  // thread-local scratch: this runs once per object row (and per Map
+  // key slot); a fresh heap allocation per call is pure malloc churn
+  static thread_local std::vector<C> rm;
+  rm.resize(a);
   for (size_t e = 0; e < ids.size(); ++e) {
     if (ids[e] == kEmpty) continue;
     std::fill(rm.begin(), rm.end(), 0);
@@ -252,7 +255,11 @@ void orswot_row_merge(
   // align live members of both sides by id, ascending (the JAX kernel's
   // stable sort over the concatenated tables gives the same order)
   struct Slot { int32_t id; int8_t side; int64_t idx; };
-  std::vector<Slot> slots;
+  // thread-local scratch reused across the N-row batch loop: six fresh
+  // vectors per row measured as real malloc churn at fleet scale (every
+  // element below is fully rewritten per row before use)
+  static thread_local std::vector<Slot> slots;
+  slots.clear();
   slots.reserve(m_a + m_b);
   for (int64_t j = 0; j < m_a; ++j)
     if (row_ids_a[j] != kEmpty) slots.push_back({row_ids_a[j], 0, j});
@@ -261,11 +268,14 @@ void orswot_row_merge(
   std::stable_sort(slots.begin(), slots.end(),
                    [](const Slot& x, const Slot& y) { return x.id < y.id; });
 
-  std::vector<int32_t> out_ids;
-  std::vector<C> out_dots;
+  static thread_local std::vector<int32_t> out_ids;
+  static thread_local std::vector<C> out_dots;
+  out_ids.clear();
+  out_dots.clear();
   out_ids.reserve(slots.size());
   out_dots.reserve(slots.size() * a);
-  std::vector<C> merged(a);
+  static thread_local std::vector<C> merged;
+  merged.resize(a);
   for (size_t s = 0; s < slots.size();) {
     int32_t id = slots[s].id;
     const C* e1 = nullptr;
@@ -295,8 +305,10 @@ void orswot_row_merge(
 
   // deferred union, exact-duplicate rows dropped keeping the first
   // (orswot.rs:141-148; the reference map is keyed (clock → members))
-  std::vector<int32_t> dq;
-  std::vector<C> dqc;
+  static thread_local std::vector<int32_t> dq;
+  static thread_local std::vector<C> dqc;
+  dq.clear();
+  dqc.clear();
   auto push_deferred = [&](const int32_t* dids, const C* dclocks, int64_t d) {
     for (int64_t q = 0; q < d; ++q) {
       int32_t id = dids[q];
@@ -404,10 +416,16 @@ void orswot_apply_add_impl(C* clock, int32_t* ids, C* dots, int32_t* dids,
       }
     }
     // replay deferred against the (possibly) advanced clock
-    std::vector<int32_t> ids_v(id_row, id_row + m);
-    std::vector<C> dots_v(dt, dt + m * a);
-    std::vector<int32_t> dq(dids + r * d, dids + (r + 1) * d);
-    std::vector<C> dqc(dclocks + r * d * a, dclocks + (r + 1) * d * a);
+    // (thread-local scratch: same malloc-churn treatment as the row
+    // merge — four fresh vectors per row otherwise)
+    static thread_local std::vector<int32_t> ids_v;
+    static thread_local std::vector<C> dots_v;
+    static thread_local std::vector<int32_t> dq;
+    static thread_local std::vector<C> dqc;
+    ids_v.assign(id_row, id_row + m);
+    dots_v.assign(dt, dt + m * a);
+    dq.assign(dids + r * d, dids + (r + 1) * d);
+    dqc.assign(dclocks + r * d * a, dclocks + (r + 1) * d * a);
     apply_deferred_row(ck, ids_v, dots_v, dq, dqc, a);
     std::copy(ids_v.begin(), ids_v.end(), id_row);
     std::copy(dots_v.begin(), dots_v.end(), dt);
@@ -552,6 +570,12 @@ void mvreg_value_truncate(C* mc, C* mv, const C* del_clock, int64_t v_cap,
 // calls inside the OpenMP row loop — per-call heap churn under OpenMP is
 // allocator contention in the hottest oracle kernel
 template <typename C>
+// Scratch idioms in this file: per-ROW helpers (orswot_row_merge,
+// apply_deferred_row, the apply_* row loops) use function-static
+// thread_local vectors — invisible at call sites, one set per OpenMP
+// worker for the process lifetime.  Per-CALL batch scratch whose size
+// depends on call parameters (the Map value kernels below) uses this
+// explicit struct so its lifetime is scoped to the loop that owns it.
 struct OrswotValScratch {
   std::vector<C> clock, dots, dclocks;
   std::vector<int32_t> ids, dids;

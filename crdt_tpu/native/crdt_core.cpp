@@ -569,13 +569,13 @@ void mvreg_value_truncate(C* mc, C* mv, const C* del_clock, int64_t v_cap,
 // row-level scratch reused across the (up to 2·K per object) truncate
 // calls inside the OpenMP row loop — per-call heap churn under OpenMP is
 // allocator contention in the hottest oracle kernel
-template <typename C>
 // Scratch idioms in this file: per-ROW helpers (orswot_row_merge,
 // apply_deferred_row, the apply_* row loops) use function-static
 // thread_local vectors — invisible at call sites, one set per OpenMP
 // worker for the process lifetime.  Per-CALL batch scratch whose size
 // depends on call parameters (the Map value kernels below) uses this
 // explicit struct so its lifetime is scoped to the loop that owns it.
+template <typename C>
 struct OrswotValScratch {
   std::vector<C> clock, dots, dclocks;
   std::vector<int32_t> ids, dids;

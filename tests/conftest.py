@@ -26,9 +26,17 @@ jax.config.update("jax_platforms", "cpu")
 from hypothesis import HealthCheck, settings
 
 # quickcheck's default is 100 cases per property (SURVEY.md §6); mirror that.
+# CRDT_HYP_EXAMPLES overrides for soak runs (e.g. 500 for a deep pass).
+try:
+    _max_examples = int(os.environ.get("CRDT_HYP_EXAMPLES", "100"))
+except ValueError:
+    import warnings
+
+    warnings.warn("CRDT_HYP_EXAMPLES is not an int; using 100")
+    _max_examples = 100
 settings.register_profile(
     "crdt",
-    max_examples=100,
+    max_examples=_max_examples,
     deadline=None,
     suppress_health_check=[HealthCheck.too_slow],
 )

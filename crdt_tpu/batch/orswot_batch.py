@@ -671,14 +671,25 @@ class OrswotBatch:
 
     # -- state path -------------------------------------------------------
 
-    def merge(self, other: "OrswotBatch", check: bool = True) -> "OrswotBatch":
-        """Pairwise ORSWOT merge (`orswot.rs:89-156`)."""
+    def merge(
+        self, other: "OrswotBatch", check: bool = True,
+        impl: str | None = None,
+    ) -> "OrswotBatch":
+        """Pairwise ORSWOT merge (`orswot.rs:89-156`).
+
+        ``impl`` selects the kernel implementation; pass
+        ``universe.config.merge_impl`` to apply a config's selection
+        (batches are pure pytrees and do not carry the config), or leave
+        ``None`` for the env/backend default — see
+        :func:`crdt_tpu.ops.orswot_ops.resolve_merge_impl`.  The
+        Map/value-kernel path (``OrswotKernel.from_config``) and the
+        collectives thread it automatically."""
         m_cap = self.ids.shape[-1]
         d_cap = self.d_ids.shape[-1]
         clock, ids, dots, d_ids, d_clocks, overflow = _merge(
             self.clock, self.ids, self.dots, self.d_ids, self.d_clocks,
             other.clock, other.ids, other.dots, other.d_ids, other.d_clocks,
-            m_cap, d_cap,
+            m_cap, d_cap, impl,
         )
         if check:
             raise_for_overflow(overflow, "merge")
@@ -687,7 +698,7 @@ class OrswotBatch:
     @classmethod
     def join_fleet(
         cls, fleets: Sequence["OrswotBatch"], check: bool = True,
-        plunger: bool = True,
+        plunger: bool = True, impl: str | None = None,
     ) -> "OrswotBatch":
         """N-way anti-entropy join of replica fleets holding the same
         objects — the device-shaped form of the reference's merge-all
@@ -706,7 +717,7 @@ class OrswotBatch:
             f = fleets[0]
             if not plunger:
                 return f
-            return f.merge(f, check=check)
+            return f.merge(f, check=check, impl=impl)
         m_cap = fleets[0].ids.shape[-1]
         d_cap = fleets[0].d_ids.shape[-1]
         stacked = [
@@ -714,7 +725,7 @@ class OrswotBatch:
             for name in ("clock", "ids", "dots", "d_ids", "d_clocks")
         ]
         clock, ids, dots, d_ids, d_clocks, overflow = _fold_tree(
-            *stacked, m_cap, d_cap, plunger
+            *stacked, m_cap, d_cap, plunger, impl
         )
         if check:
             raise_for_overflow(overflow, "join_fleet")
@@ -790,15 +801,18 @@ class OrswotBatch:
         ]
 
 
-@functools.partial(jax.jit, static_argnums=(10, 11))
-def _merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap):
-    return orswot_ops.merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap)
+@functools.partial(jax.jit, static_argnums=(10, 11, 12))
+def _merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap, impl):
+    return orswot_ops.merge(
+        ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap, impl=impl
+    )
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7))
-def _fold_tree(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger):
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
+def _fold_tree(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger, impl):
     return orswot_ops.fold_merge_tree(
-        clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger=plunger
+        clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger=plunger,
+        impl=impl,
     )
 
 

@@ -139,6 +139,9 @@ class OrswotKernel:
     deferred_capacity: int
     num_actors: int
     counter_bits: int = 64
+    # pairwise-merge implementation (orswot_ops.resolve_merge_impl):
+    # "auto" resolves env override / backend default at trace time
+    merge_impl: str = "auto"
 
     @classmethod
     def from_config(cls, cfg: CrdtConfig) -> "OrswotKernel":
@@ -147,6 +150,7 @@ class OrswotKernel:
             deferred_capacity=cfg.deferred_capacity,
             num_actors=cfg.num_actors,
             counter_bits=cfg.counter_bits,
+            merge_impl=cfg.merge_impl,
         )
 
     def zeros(self, batch_shape):
@@ -172,7 +176,8 @@ class OrswotKernel:
 
     def merge(self, va, vb):
         out = orswot_ops.merge(
-            *va, *vb, self.member_capacity, self.deferred_capacity
+            *va, *vb, self.member_capacity, self.deferred_capacity,
+            impl=self.merge_impl,
         )
         # protocol: one overflow flag per object (the Map layer has no
         # per-axis elastic recovery) — collapse the member/deferred pair
@@ -188,6 +193,7 @@ class OrswotKernel:
         out = orswot_ops.merge(
             *v, clock, *empty[1:],
             self.member_capacity, self.deferred_capacity,
+            impl=self.merge_impl,
         )
         mclock, ids, dots, d_ids, d_clocks = out[:5]
         over = out[5]

@@ -19,6 +19,10 @@ import os
 
 _X64_ENABLED = False
 
+# canonical ORSWOT pairwise-merge implementation names (the dispatch lives
+# in crdt_tpu.ops.orswot_ops.resolve_merge_impl; configs accept "auto" too)
+MERGE_IMPLS = ("rank", "unrolled", "pallas")
+
 
 def enable_x64() -> bool:
     """Enable 64-bit types in JAX (idempotent). Returns True if enabled."""
@@ -74,16 +78,39 @@ class CrdtConfig:
     # counter width: 64 = reference parity (u64, vclock.rs:23), 32 = the
     # TPU-native width (no 64-bit emulation; counters must fit 2^32)
     counter_bits: int = 64
+    # ORSWOT pairwise-merge implementation: "auto" (env override via
+    # CRDT_MERGE_IMPL, else backend default), "rank", "unrolled", or
+    # "pallas" — see crdt_tpu.ops.orswot_ops.resolve_merge_impl
+    merge_impl: str = "auto"
 
     def __post_init__(self):
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
+            if f.name == "merge_impl":
+                if v != "auto" and v not in MERGE_IMPLS:
+                    raise ValueError(
+                        f"CrdtConfig.merge_impl must be 'auto' or one of "
+                        f"{'/'.join(MERGE_IMPLS)}, got {v!r}"
+                    )
+                continue
             if not isinstance(v, int) or v <= 0:
                 raise ValueError(f"CrdtConfig.{f.name} must be a positive int, got {v!r}")
         if self.counter_bits not in (32, 64):
             raise ValueError(
                 f"CrdtConfig.counter_bits must be 32 or 64, got {self.counter_bits!r}"
             )
+
+    @classmethod
+    def tpu_default(cls, **overrides) -> "CrdtConfig":
+        """The recommended production config for TPU workloads.
+
+        ``counter_bits=32``: the measured product default (the unrolled
+        and fused-Pallas fast paths are exact for uint32 only, and u64
+        measured 1.5× the u32 cost even on CPU — `PERF.md` "Counter
+        width").  The u64 default on :class:`CrdtConfig` itself stays for
+        reference parity (`vclock.rs:23`); use this constructor when the
+        per-actor op count fits 2^32."""
+        return cls(**{"counter_bits": 32, **overrides})
 
 
 DEFAULT_CONFIG = CrdtConfig()

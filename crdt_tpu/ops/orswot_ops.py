@@ -38,6 +38,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from . import clock_ops
+from ..config import MERGE_IMPLS
 
 EMPTY = -1
 _SORT_MAX = jnp.iinfo(jnp.int32).max
@@ -248,10 +249,10 @@ def compact_by_id(ids, payload, cap):
     return ids, payload, overflow
 
 
-def _merge_impl_default():
-    """Which pairwise-merge implementation ``merge`` dispatches to.
+def resolve_merge_impl(impl: str | None = None) -> str:
+    """Resolve which pairwise-merge implementation ``merge`` dispatches to.
 
-    ``CRDT_MERGE_IMPL`` ∈ ``rank`` (the rank-select pipeline below, CPU
+    Implementations: ``rank`` (the rank-select pipeline below, CPU
     default), ``unrolled`` (gather/sort-free tile math,
     :mod:`crdt_tpu.ops.orswot_unrolled`; exact for uint32 counters only —
     bit-equal outside the conservative-overflow objects, see
@@ -259,32 +260,45 @@ def _merge_impl_default():
     single-HBM-pass kernel, :mod:`crdt_tpu.ops.orswot_pallas` — same
     tile math as ``unrolled`` but the whole merge stays in VMEM;
     compiled on TPU, interpret-emulated elsewhere; 2-D batches and u32
-    only, else falls through).  The unset default is
-    backend-dispatched per the round-3 on-chip layout A/B
+    only, else falls through).
+
+    Precedence: an explicit non-``"auto"`` choice (the ``impl=`` argument
+    to :func:`merge`, usually fed from ``CrdtConfig.merge_impl``) wins;
+    otherwise the ``CRDT_MERGE_IMPL`` env var (a process-level override —
+    set it before the first compile; jit caches key on shapes only, so
+    flipping it later does not retrace already-compiled shapes); otherwise
+    the backend default from the round-3 on-chip layout A/B
     (`reports/LAYOUT_AB_TPU.md`): ``unrolled`` on TPU (54.0 ms vs the
     rank path's 57.7 ms at config-4 shapes), ``rank`` elsewhere (the
     unrolled tile math trades extra dot-table reads for regularity —
-    measured 17% slower on the memory-bound CPU backend).  A third
-    contender, lanes-last layout, lost the A/B 2× and was deleted.
-
-    The env var is read at **trace time**: jit caches are keyed on
-    shapes/dtypes only, so flipping ``CRDT_MERGE_IMPL`` after a caller's
-    first compile keeps the previously traced impl for same-shaped
-    inputs.  Callers that must re-dispatch (tests parametrized over
-    impls, A/B harnesses) clear jit caches (``jax.clear_caches()``) or
-    use distinctly shaped inputs per impl."""
+    measured 17% slower on the memory-bound CPU backend).  A/B harnesses
+    should pass ``impl=`` explicitly — each choice is a distinct Python
+    call graph, so no cache clearing is needed."""
     import os
 
     import jax
 
-    default = "unrolled" if jax.default_backend() == "tpu" else "rank"
-    return os.environ.get("CRDT_MERGE_IMPL", default)
+    if impl is not None and impl != "auto":
+        if impl not in MERGE_IMPLS:
+            raise ValueError(
+                f"merge impl {impl!r} (CrdtConfig.merge_impl / "
+                f"CRDT_MERGE_IMPL) is not one of rank/unrolled/pallas"
+            )
+        return impl
+    env = os.environ.get("CRDT_MERGE_IMPL")
+    if env is not None:
+        if env not in MERGE_IMPLS:
+            raise ValueError(
+                f"CRDT_MERGE_IMPL={env!r} is not one of rank/unrolled/pallas"
+            )
+        return env
+    return "unrolled" if jax.default_backend() == "tpu" else "rank"
 
 
 def merge(
     clock_a, ids_a, dots_a, dids_a, dclocks_a,
     clock_b, ids_b, dots_b, dids_b, dclocks_b,
-    m_cap: int, d_cap: int,
+    m_cap: int, d_cap: int, impl: str | None = None,
 ):
     """Full pairwise ORSWOT merge (`orswot.rs:89-156`).
 
@@ -301,11 +315,24 @@ def merge(
     with cheap reductions, rank-selects the ``m_cap`` winning slots, and
     computes the dot algebra only for those; deferred-bearing batches take
     the full-width pipeline.
+
+    ``impl`` selects the implementation (see :func:`resolve_merge_impl`
+    for choices and precedence); ``None``/``"auto"`` resolves the
+    env-var/backend default.
     """
-    impl = _merge_impl_default()
-    if impl not in ("rank", "unrolled", "pallas"):
-        raise ValueError(
-            f"CRDT_MERGE_IMPL={impl!r} is not one of rank/unrolled/pallas"
+    impl = resolve_merge_impl(impl)
+    if impl in ("unrolled", "pallas") and clock_a.dtype.itemsize > 4:
+        # the TPU fast paths are exact for <=32-bit counters only; wider
+        # batches silently taking the rank path cost default-config users
+        # the measured speedup (VERDICT r3 weak #6) — say so, once per trace
+        import warnings
+
+        warnings.warn(
+            f"orswot merge impl {impl!r} requires <=32-bit counters; this "
+            f"{clock_a.dtype.name} batch falls back to the 'rank' path. "
+            "Build the universe with CrdtConfig(counter_bits=32) (see "
+            "CrdtConfig.tpu_default()) to stay on the TPU fast paths.",
+            stacklevel=2,
         )
     if (
         impl == "pallas"
@@ -325,14 +352,18 @@ def merge(
             m_cap, d_cap,
         )
     if (
-        impl == "unrolled"
+        impl in ("unrolled", "pallas")
         and clock_a.dtype.itemsize <= 4
         and ids_a.shape[-1] <= _ALIGN_MATCH_MAX_M
     ):
         # the tile math unrolls Python loops over the slot axes, so wide
         # member tables (elastic regrowth) stay on the rank path's
         # sort-aligned _merge_wide below; rank-polymorphic
-        # (ellipsis-based tile math), so any batch shape dispatches
+        # (ellipsis-based tile math), so any batch shape dispatches.
+        # impl == "pallas" lands here for rank>2 batches the pallas_call
+        # grid can't block: unrolled IS the pallas kernel's tile math
+        # (minus the VMEM fusion), so a pallas request degrades to the
+        # nearest fast path, not the rank pipeline
         from . import orswot_unrolled
 
         return orswot_unrolled.merge_unrolled(
@@ -523,7 +554,7 @@ def _merge_wide(
 
 def fold_merge_tree(
     clock, ids, dots, dids, dclocks, m_cap: int, d_cap: int,
-    plunger: bool = True,
+    plunger: bool = True, impl: str | None = None,
 ):
     """Join ``R`` stacked replica fleets (arrays ``[R, N, ...]``) into one
     ``[N, ...]`` state by pairwise tree reduction.
@@ -559,7 +590,7 @@ def fold_merge_tree(
         half = r // 2
         lhs = tuple(x[0 : 2 * half : 2] for x in state)
         rhs = tuple(x[1 : 2 * half : 2] for x in state)
-        out = merge(*lhs, *rhs, m_cap, d_cap)
+        out = merge(*lhs, *rhs, m_cap, d_cap, impl=impl)
         merged, over = out[:5], out[5]
         over_acc = over_acc | jnp.any(over, axis=0)
         if r % 2:
@@ -572,7 +603,7 @@ def fold_merge_tree(
         r = half + r % 2
     state = tuple(x[0] for x in state)
     if plunger:
-        out = merge(*state, *state, m_cap, d_cap)
+        out = merge(*state, *state, m_cap, d_cap, impl=impl)
         state, over = out[:5], out[5]
         over_acc = over_acc | over
     return state + (over_acc,)

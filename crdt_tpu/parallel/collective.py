@@ -130,19 +130,21 @@ def fold_reduce_merge(stack, merge_fn: Callable):
 # -- ORSWOT collective join --------------------------------------------------
 
 
-def _orswot_pair_merge(a, b, m_cap: int, d_cap: int):
+def _orswot_pair_merge(a, b, m_cap: int, d_cap: int, impl: str | None = None):
     """Pairwise merge over state tuples; returns (state5, overflow)."""
     *state, overflow = orswot_ops.merge(
-        a[0], a[1], a[2], a[3], a[4], b[0], b[1], b[2], b[3], b[4], m_cap, d_cap
+        a[0], a[1], a[2], a[3], a[4], b[0], b[1], b[2], b[3], b[4],
+        m_cap, d_cap, impl=impl,
     )
     return tuple(state), overflow
 
 
 @functools.lru_cache(maxsize=64)
-def shard_local_merge_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int):
+def shard_local_merge_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int,
+                         impl: str | None = None):
     """Cached jitted shard-local pairwise merge over state 5-tuples —
-    cache keyed on (mesh, axis, capacities) so loop-heavy callers compile
-    once, not per call."""
+    cache keyed on (mesh, axis, capacities, merge impl) so loop-heavy
+    callers compile once, not per call."""
     spec = P(axis)
 
     @jax.jit
@@ -154,12 +156,13 @@ def shard_local_merge_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int):
         check_vma=False,
     )
     def _local(sa, sb):
-        return _orswot_pair_merge(sa, sb, m_cap, d_cap)
+        return _orswot_pair_merge(sa, sb, m_cap, d_cap, impl)
 
     return _local
 
 
-def shard_local_pairwise_merge(a, b, mesh: Mesh, axis: str = "objects"):
+def shard_local_pairwise_merge(a, b, mesh: Mesh, axis: str = "objects",
+                               impl: str | None = None):
     """Pairwise ORSWOT merge of two object-sharded batches with a
     **zero-collective guarantee**: each device merges only its own object
     shard under ``shard_map``, so the compiled program provably moves no
@@ -173,10 +176,11 @@ def shard_local_pairwise_merge(a, b, mesh: Mesh, axis: str = "objects"):
     m_cap, d_cap = a.ids.shape[-1], a.d_ids.shape[-1]
     state_a = (a.clock, a.ids, a.dots, a.d_ids, a.d_clocks)
     state_b = (b.clock, b.ids, b.dots, b.d_ids, b.d_clocks)
-    return shard_local_merge_fn(mesh, axis, m_cap, d_cap)(state_a, state_b)
+    return shard_local_merge_fn(mesh, axis, m_cap, d_cap, impl)(state_a, state_b)
 
 
-def _fold_orswot_stack(stack5, m_cap: int, d_cap: int):
+def _fold_orswot_stack(stack5, m_cap: int, d_cap: int,
+                       impl: str | None = None):
     """Canonical left fold over a replica-stacked ORSWOT state 5-tuple
     (leading axis R on every array), ORing capacity overflow across every
     pairwise merge.  THE one place the canonical-order + overflow invariant
@@ -187,12 +191,15 @@ def _fold_orswot_stack(stack5, m_cap: int, d_cap: int):
     # [..., 2]: member / deferred overflow flags (orswot_ops.merge)
     overflow = jnp.zeros(stack5[0].shape[1:2] + (2,), dtype=bool)
     for i in range(1, r):
-        acc, over = _orswot_pair_merge(acc, tuple(x[i] for x in stack5), m_cap, d_cap)
+        acc, over = _orswot_pair_merge(
+            acc, tuple(x[i] for x in stack5), m_cap, d_cap, impl
+        )
         overflow |= over
     return acc, overflow
 
 
-def gather_fold_orswot(local, axis: str, m_cap: int, d_cap: int):
+def gather_fold_orswot(local, axis: str, m_cap: int, d_cap: int,
+                       impl: str | None = None):
     """The ORSWOT cross-device join body, for use INSIDE shard_map: all-gather
     each state array over ``axis`` and fold in canonical device order 0..D-1
     (D is the all-gather's leading axis — derived, not caller-supplied, so a
@@ -205,10 +212,11 @@ def gather_fold_orswot(local, axis: str, m_cap: int, d_cap: int):
     a ppermute ring (different fold origin per device) breaks both, because
     the reference merge is order-sensitive (`orswot.rs:94-103` asymmetry)."""
     gathered = tuple(jax.lax.all_gather(x, axis) for x in local)  # [D, ...]
-    return _fold_orswot_stack(gathered, m_cap, d_cap)
+    return _fold_orswot_stack(gathered, m_cap, d_cap, impl)
 
 
-def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
+def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas",
+                          check: bool = True, impl: str | None = None):
     """All-reduce ORSWOT state across a mesh axis with merge as the
     combiner; result is identical on every device and bit-equal to the
     scalar left-fold join in device order 0..D-1 (see
@@ -226,7 +234,7 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
     _check_replica_axis(batch.clock.shape[0], mesh, axis)
     arrays = (batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks)
     join = _orswot_join_fn(
-        mesh, axis, m_cap, d_cap, tuple(a.ndim for a in arrays)
+        mesh, axis, m_cap, d_cap, tuple(a.ndim for a in arrays), impl
     )
     (clock, ids, dots, d_ids, d_clocks), overflow = join(arrays)
     if check:
@@ -235,7 +243,8 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas", check: bool
 
 
 @functools.lru_cache(maxsize=64)
-def _orswot_join_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int, ndims: tuple):
+def _orswot_join_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int,
+                    ndims: tuple, impl: str | None = None):
     """Cached jitted ORSWOT collective join (see :func:`_clock_join_fn`)."""
     specs = tuple(P(axis, *([None] * (nd - 1))) for nd in ndims)
     over_spec = P(axis, None)
@@ -250,7 +259,7 @@ def _orswot_join_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int, ndims: tuple)
     )
     def _join(local):
         acc, overflow = gather_fold_orswot(
-            tuple(x[0] for x in local), axis, m_cap, d_cap
+            tuple(x[0] for x in local), axis, m_cap, d_cap, impl
         )
         return tuple(x[None] for x in acc), jnp.any(overflow, axis=0)[None]
 
@@ -500,20 +509,21 @@ def allgather_join_gset(batch, mesh: Mesh, axis: str = "replicas"):
 
 
 @functools.lru_cache(maxsize=None)
-def _anti_entropy_kernels(m_cap: int, d_cap: int):
-    """Jitted fold/plunge kernels, cached per capacity so repeated
-    anti_entropy calls hit the XLA compile cache instead of retracing
-    (jax.jit caches by function identity; a per-call closure defeats it).
-    Shapes (R, N, A) still key the underlying jit cache as usual."""
+def _anti_entropy_kernels(m_cap: int, d_cap: int, impl: str | None = None):
+    """Jitted fold/plunge kernels, cached per capacity (and merge impl) so
+    repeated anti_entropy calls hit the XLA compile cache instead of
+    retracing (jax.jit caches by function identity; a per-call closure
+    defeats it).  Shapes (R, N, A) still key the underlying jit cache as
+    usual."""
 
     @jax.jit
     def _fold(arrays):
-        acc, overflow = _fold_orswot_stack(arrays, m_cap, d_cap)
+        acc, overflow = _fold_orswot_stack(arrays, m_cap, d_cap, impl)
         return acc, jnp.any(overflow, axis=0)
 
     @jax.jit
     def _plunge(acc):
-        nxt, over = _orswot_pair_merge(acc, acc, m_cap, d_cap)
+        nxt, over = _orswot_pair_merge(acc, acc, m_cap, d_cap, impl)
         same = jnp.array(True)
         for x, y in zip(nxt, acc):
             same &= jnp.array_equal(x, y)
@@ -522,7 +532,8 @@ def _anti_entropy_kernels(m_cap: int, d_cap: int):
     return _fold, _plunge
 
 
-def anti_entropy(stack, max_rounds: int = 3, check: bool = True):
+def anti_entropy(stack, max_rounds: int = 3, check: bool = True,
+                 impl: str | None = None):
     """Converge a replica-stacked :class:`OrswotBatch` (leading axis R) to
     its fixpoint on one device/shard: left-fold-join the replicas in order
     0..R-1 (bit-parity with the scalar N-way join — see
@@ -545,7 +556,7 @@ def anti_entropy(stack, max_rounds: int = 3, check: bool = True):
 
     import numpy as np
 
-    _fold, _plunge = _anti_entropy_kernels(m_cap, d_cap)
+    _fold, _plunge = _anti_entropy_kernels(m_cap, d_cap, impl)
     acc, over_dev = _fold(arrays)
     overflow = np.array(jax.device_get(over_dev), dtype=bool)  # writable copy
     rounds = 1

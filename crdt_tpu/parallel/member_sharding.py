@@ -137,7 +137,7 @@ def unpartition_dense(s_clock, s_ids, s_dots, s_dids, s_dclocks,
 
 
 def member_sharded_merge(state_a, state_b, mesh: Mesh, axis: str = "members",
-                         check: bool = True):
+                         check: bool = True, impl: str | None = None):
     """Pairwise merge of two member-sharded states — fully shard-local
     (zero collectives): each device runs the standard merge kernel on its
     member partition with the replicated set clocks.  Reuses the cached
@@ -151,7 +151,7 @@ def member_sharded_merge(state_a, state_b, mesh: Mesh, axis: str = "members",
     from .collective import shard_local_merge_fn
 
     m_cap, d_cap = state_a[1].shape[-1], state_a[3].shape[-1]
-    state, overflow = shard_local_merge_fn(mesh, axis, m_cap, d_cap)(
+    state, overflow = shard_local_merge_fn(mesh, axis, m_cap, d_cap, impl)(
         tuple(state_a), tuple(state_b)
     )
     if check:

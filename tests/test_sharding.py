@@ -119,18 +119,15 @@ def test_allgather_join_orswot_matches_scalar():
 
 
 @pytest.mark.parametrize("impl", ["unrolled", "pallas"])
-def test_allgather_join_orswot_merge_impl_variants(impl, monkeypatch):
-    """The CRDT_MERGE_IMPL variants (unrolled — the TPU default — and
-    the fused pallas kernel, interpret-emulated on the CPU mesh) compose
+def test_allgather_join_orswot_merge_impl_variants(impl):
+    """The merge-impl variants (unrolled — the TPU default — and the
+    fused pallas kernel, interpret-emulated on the CPU mesh) compose
     with the collective join: the combiner inside the all-gather fold
-    routes through orswot_ops.merge, whose dispatch must behave
-    identically under shard_map's per-shard (rank-2) views.  u32
-    counters — the variants' supported width."""
-    # CRDT_MERGE_IMPL is read at trace time and jit caches key on shapes
-    # only: without clearing, the second param would silently reuse the
-    # first param's traced impl (both params use identical shapes)
-    jax.clear_caches()
-    monkeypatch.setenv("CRDT_MERGE_IMPL", impl)
+    routes through orswot_ops.merge via the explicit ``impl=`` argument
+    (a static jit arg, so each impl compiles its own entry — no env vars
+    or cache clearing), and must behave identically under shard_map's
+    per-shard (rank-2) views.  u32 counters — the variants' supported
+    width."""
     mesh = make_mesh({"replicas": 8})
     uni = Universe(CrdtConfig(num_actors=8, member_capacity=16,
                               deferred_capacity=8, counter_bits=32))
@@ -138,7 +135,7 @@ def test_allgather_join_orswot_merge_impl_variants(impl, monkeypatch):
 
     batches = [OrswotBatch.from_scalar(row, uni) for row in fleet]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *batches)
-    joined = allgather_join_orswot(stacked, mesh, axis="replicas")
+    joined = allgather_join_orswot(stacked, mesh, axis="replicas", impl=impl)
 
     expected = scalar_global_join(fleet)
     shard = OrswotBatch(

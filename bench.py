@@ -37,6 +37,82 @@ def log(*args):
 
 SMALL = os.environ.get("CRDT_BENCH_SMALL") == "1"
 
+# ---------------------------------------------------------------- budget
+#
+# The bench must produce a parseable JSON line and exit 0 under ANY tunnel
+# state (VERDICT r3: the round-3 driver artifact was rc=124/parsed=null
+# because a wedged-tunnel probe plus full-scale CPU fallback blew the
+# driver's timeout).  Three mechanisms:
+#   * a wall-clock budget (CRDT_BENCH_BUDGET_S, default 540s): stages are
+#     skipped once the remaining budget is below their estimated cost
+#   * incremental emission: the headline JSON line is (re)printed after
+#     every completed stage — a kill mid-run still leaves the last banked
+#     line on stdout (consumers take the LAST line starting {"metric")
+#   * CPU-fallback downshift: north-star/resident chunk counts shrink
+#     (rates stay comparable; totals are recorded in the JSON)
+# Orchestrators with a real window raise the budget (the tunnel watcher
+# runs with CRDT_BENCH_BUDGET_S=4200).
+
+_T0 = time.monotonic()
+_BUDGET_S = float(os.environ.get("CRDT_BENCH_BUDGET_S", "540"))
+
+
+def remaining_budget() -> float:
+    return _BUDGET_S - (time.monotonic() - _T0)
+
+
+_JSON_STATE: dict = {
+    "metric": "orswot_merges_per_sec_to_fixpoint",
+    "value": None,
+    "unit": "merges/s",
+    "vs_baseline": None,
+}
+
+
+def emit(**fields):
+    """Merge ``fields`` into the headline record and print it (again).
+
+    Consumers parse the LAST {"metric"...} line, so re-printing after
+    every stage makes the artifact monotonically better instead of
+    all-or-nothing."""
+    _JSON_STATE.update(fields)
+    if _JSON_STATE.get("value") is not None:
+        _JSON_STATE["vs_baseline"] = round(_JSON_STATE["value"] / 1e7, 4)
+        print(json.dumps(_JSON_STATE), flush=True)
+
+
+def run_stage(name: str, est_s: float, fn, *args, **kwargs):
+    """Run one bench stage, absorbing failures and budget exhaustion.
+
+    Returns the stage result or None (skipped/errored) — a crash or a
+    slow tunnel in one stage must never cost the lines already banked."""
+    rem = remaining_budget()
+    if rem < est_s:
+        log(f"stage {name}: SKIPPED (remaining budget {rem:.0f}s < est {est_s:.0f}s)")
+        emit(**{f"{name}_skipped": "budget"})
+        return None
+    try:
+        return fn(*args, **kwargs)
+    except Exception as e:  # noqa: BLE001 — stage isolation is the point
+        import traceback
+
+        log(f"stage {name}: FAILED ({type(e).__name__}: {str(e)[:300]})")
+        log(traceback.format_exc(limit=8))
+        emit(**{f"{name}_error": f"{type(e).__name__}: {str(e)[:120]}"})
+        return None
+
+
+def _downshift() -> bool:
+    """True when full-scale shapes would risk the budget: CPU backends
+    (fallback or explicit) downshift chunk counts unless the caller
+    insists (CRDT_BENCH_FULL=1).  Rates stay comparable — only the number
+    of timed repetitions shrinks."""
+    if os.environ.get("CRDT_BENCH_FULL") == "1":
+        return False
+    import jax
+
+    return jax.default_backend() == "cpu"
+
 
 def _sync_overhead():
     """Same-window tunnel sync constant (crdt_tpu.utils.benchtime)."""
@@ -127,6 +203,73 @@ def bench_orswot_pairwise():
     return n / t
 
 
+def _native_fold_timing(templates, r, a, m, d, n_chunks):
+    """Time the C++ row-kernel chunk fold (CPU backends), or None.
+
+    The framework's best-engine-per-backend dispatch, not a different
+    workload (same templates, same merge count, bit-exact kernels:
+    crdt_tpu/native/crdt_core.cpp vs ops/orswot_ops.py).  Eager C calls
+    cannot be hoisted or elided, so no salt chain is needed; promotion is
+    gated by the same scalar-oracle parity sample as the jnp fold (a
+    parity failure raises — a wrong kernel must not publish timings;
+    only a missing/broken .so degrades to None)."""
+    chunk = templates[0][0].shape[1]
+    try:
+        # import + one tiny warm call: the only failures that may
+        # downgrade to the jnp headline are a missing/broken .so
+        from crdt_tpu.native import engine as native_engine
+
+        native_engine.vclock_merge(
+            np.zeros((1, 2), np.uint32), np.zeros((1, 2), np.uint32)
+        )
+    except (ImportError, OSError, RuntimeError) as e:
+        log(f"north★ native-engine fold unavailable: {str(e)[:200]}")
+        return None
+
+    # two reusable output-buffer sets per input shape: the C kernel fully
+    # overwrites outputs, so ping-ponging avoids an mmap page-zeroing
+    # pass per merge (~working-set bytes of pure overhead each call).
+    # Keyed by shape because the parity sample folds 8-object slices
+    # before the full chunks.
+    _fold_bufs: dict = {}
+
+    def native_fold_join(stack):
+        # NOTE: the returned planes alias the shared buffer cache — a
+        # later same-shape call overwrites them.  Both callers comply:
+        # the parity sample consumes its result before the timing loop
+        # runs, and the timing loop discards results.
+        st = [np.asarray(x) for x in stack]
+        acc = tuple(x[0] for x in st)
+        if acc[0].shape not in _fold_bufs:
+            # guarded (not setdefault): the default would re-build two
+            # full-size buffer sets on every call
+            _fold_bufs[acc[0].shape] = [
+                tuple(np.empty_like(p) for p in acc) for _ in range(2)
+            ]
+        bufs = _fold_bufs[acc[0].shape]
+        k = 0
+        for i in range(1, r):
+            acc = native_engine.orswot_merge(
+                *acc, *(x[i] for x in st), out=bufs[k]
+            )[:5]
+            k ^= 1
+        # defer plunger, as in fold_join (acc sits in bufs[k^1])
+        return native_engine.orswot_merge(*acc, *acc, out=bufs[k])[:5]
+
+    _north_star_parity(templates[0], r, a, m, d, native_fold_join)
+    np_templates = [tuple(np.asarray(x) for x in tpl) for tpl in templates]
+    t0n = time.perf_counter()
+    for c in range(n_chunks):
+        out_native = native_fold_join(np_templates[c % len(np_templates)])
+    native_s = time.perf_counter() - t0n
+    del out_native
+    log(
+        f"north★ native-engine fold: {native_s:.2f}s "
+        f"({n_chunks * chunk * r / native_s / 1e6:.2f}M merges/s)"
+    )
+    return native_s
+
+
 def bench_north_star():
     """BASELINE.md config ★ at its defined scale: 10M replica-objects
     total (R fleets × N objects), 64 actors, N-way anti-entropy to
@@ -195,7 +338,36 @@ def bench_north_star():
     _north_star_parity(templates[0], r, a, m, d, fold_join)
 
     n_chunks = max(2, n // chunk)
+    if _downshift():
+        # CPU fallback: 4 chunks instead of 20 — the merges/s rate is
+        # unchanged (same kernel, same per-chunk work), the wall time
+        # fits the budget; the JSON records the actual total
+        n_chunks = min(n_chunks, 4)
     elision = {"elision_check": "skipped"}  # per-step-dispatch paths can't hoist
+
+    # Native-engine contender FIRST on CPU backends: the C++ row kernel
+    # measured ~3.7x the XLA:CPU fold at north-star shapes on one core,
+    # and it is the cheap path — under a tight budget it banks a headline
+    # before the jnp scan's compile even starts.  Parity-gated by the
+    # same scalar-oracle sample as the jnp fold.
+    native_s = None
+    if (
+        jax.default_backend() == "cpu"
+        and os.environ.get("CRDT_SKIP_NATIVE_HEADLINE") != "1"
+        and remaining_budget() > 45
+    ):
+        native_s = _native_fold_timing(templates, r, a, m, d, n_chunks)
+        if native_s is not None:
+            elision["native_s"] = round(native_s, 2)
+            # bank a provisional headline immediately — a later crash or
+            # budget kill keeps this line (emit_headline keeps a banked
+            # on-chip capture ahead of this CPU number)
+            emit_headline(
+                n_chunks * chunk * r / native_s,
+                {"kernel": "native_fold"},
+                jax.default_backend(),
+                _IS_FALLBACK,
+            )
 
     # stream all chunks in ONE dispatch: a device-side scan over
     # chunk pairs (both templates per step).  A carried salt XORs
@@ -247,22 +419,38 @@ def bench_north_star():
         return max(time.perf_counter() - t0 - sync_s, 1e-9), out
 
     t = scan_out = None
-    for attempt in range(2):
-        try:
-            t, scan_out = run_scan_timed()
-            break
-        except Exception as e:  # transient remote-compile outage
-            log(f"north★ scan attempt {attempt + 1} failed: {str(e)[:200]}")
-            if attempt == 0:
-                time.sleep(20)
-    run_stepped_path = os.environ.get("CRDT_SKIP_ELISION_CHECK") != "1" or (
+    # the scan's compile + two full passes cost real budget (113s/pass at
+    # full CPU scale, ~23s downshifted); when the native contender has
+    # already banked a headline and the budget is tight, skip the scan
+    # rather than risk the artifact
+    est_scan = 90 if _downshift() else 420
+    if remaining_budget() > est_scan or native_s is None:
+        for attempt in range(2):
+            try:
+                t, scan_out = run_scan_timed()
+                break
+            except Exception as e:  # transient remote-compile outage
+                log(f"north★ scan attempt {attempt + 1} failed: {str(e)[:200]}")
+                if attempt == 0:
+                    time.sleep(20)
+    else:
+        log(
+            f"north★ jnp scan: SKIPPED (remaining budget "
+            f"{remaining_budget():.0f}s < est {est_scan}s; native headline "
+            "already banked)"
+        )
+        elision["jnp_scan"] = "skipped_budget"
+    run_stepped_path = os.environ.get("CRDT_RUN_ELISION_CHECK") == "1" or (
         # the stepped path is also the scan-outage fallback: its
         # per-step dispatches chain asynchronously through a
         # device-value salt, so the tunnel's ~65 ms round-trip is
         # paid once at the final fetch instead of per chunk (the
-        # last-resort host loop below pays it ~every chunk)
-        t is None
-    )
+        # last-resort host loop below pays it ~every chunk).  As a pure
+        # work-elision CHECK it is opt-in (VERDICT r3: a 113s correctness
+        # assert living in the timed bench cost the round artifact) —
+        # tests/test_bench_paths.py carries the check at test scale.
+        scan_out is not None and native_s is None and jax.default_backend() != "cpu"
+    ) or (t is None and native_s is None and remaining_budget() > 60)
     if run_stepped_path:
         # Work-elision check (VERDICT r2 weak #4): replay the exact
         # salt chain as per-step host dispatches — a separately
@@ -301,7 +489,7 @@ def bench_north_star():
             )
         except Exception as e:
             log(f"north★ elision check errored (transient?): {str(e)[:200]}")
-            elision = {"elision_check": "error"}
+            elision["elision_check"] = "error"
         else:
             assert same, (
                 "north★ elision check FAILED: scan output != per-step replay"
@@ -315,18 +503,18 @@ def bench_north_star():
                     f"north★ stepped timing (scan unavailable): "
                     f"{t_replay:.2f}s"
                 )
-                elision = {"elision_check": "scan_unavailable",
-                           "stepped_s": round(t_replay, 2),
-                           "timing_path": "stepped"}
+                elision.update(elision_check="scan_unavailable",
+                               stepped_s=round(t_replay, 2),
+                               timing_path="stepped")
                 t = t_replay
             else:
                 log(
                     f"north★ elision check: scan == per-step replay "
                     f"(bit-equal); scan {t:.2f}s vs replay {t_replay:.2f}s"
                 )
-                elision = {"elision_check": "bit_equal",
-                           "scan_s": round(t, 2),
-                           "stepped_s": round(t_replay, 2)}
+                elision.update(elision_check="bit_equal",
+                               scan_s=round(t, 2),
+                               stepped_s=round(t_replay, 2))
                 # The replay is not just a check — it is the second
                 # timing path: per-step dispatches chain ASYNCHRONOUSLY
                 # (the salt argument is a device value, so the host
@@ -342,7 +530,7 @@ def bench_north_star():
                     t = t_replay
                 else:
                     elision["timing_path"] = "scan"
-    if t is None:
+    if t is None and native_s is None and remaining_budget() > 30:
         # last resort: per-chunk host loop (pays the tunnel sync per
         # chunk — slower but never a crashed bench)
         log("north★ falling back to per-chunk host-loop timing")
@@ -354,87 +542,28 @@ def bench_north_star():
         jax.block_until_ready(out)
         t = time.perf_counter() - t0
 
-    # Native-engine contender (CPU backends only): the C++ row kernel
-    # measured ~3.7x the XLA:CPU fold at north-star shapes on one core —
-    # the framework's best-engine-per-backend dispatch, not a different
-    # workload (same templates, same merge count, bit-exact kernels:
-    # crdt_tpu/native/crdt_core.cpp vs ops/orswot_ops.py).  Eager C calls
-    # cannot be hoisted or elided, so no salt chain is needed; promotion
-    # is gated by the same scalar-oracle parity sample as the jnp fold.
+    # headline pick: fastest parity-gated path that actually ran (the
+    # native contender timed itself before the scan on CPU backends)
     kernel_name = "jnp_fold"
-    if (
-        jax.default_backend() == "cpu"
-        and os.environ.get("CRDT_SKIP_NATIVE_HEADLINE") != "1"
-    ):
-        native_engine = None
-        try:
-            # import + one tiny warm call: the only failures that may
-            # downgrade to the jnp headline are a missing/broken .so —
-            # a PARITY failure below stays fatal, exactly like the jnp
-            # fold's own gate above
-            from crdt_tpu.native import engine as native_engine
-
-            native_engine.vclock_merge(
-                np.zeros((1, 2), np.uint32), np.zeros((1, 2), np.uint32)
-            )
-        except (ImportError, OSError, RuntimeError) as e:
-            native_engine = None
-            log(f"north★ native-engine fold unavailable: {str(e)[:200]}")
-        if native_engine is not None:
-
-            # two reusable output-buffer sets per input shape: the C
-            # kernel fully overwrites outputs, so ping-ponging avoids an
-            # mmap page-zeroing pass per merge (~working-set bytes of
-            # pure overhead each call).  Keyed by shape because the
-            # parity sample folds 8-object slices before the full chunks.
-            _fold_bufs: dict = {}
-
-            def native_fold_join(stack):
-                # NOTE: the returned planes alias the shared buffer cache —
-                # a later same-shape call overwrites them.  Both callers
-                # comply: the parity sample consumes its result before the
-                # timing loop runs, and the timing loop discards results.
-                st = [np.asarray(x) for x in stack]
-                acc = tuple(x[0] for x in st)
-                if acc[0].shape not in _fold_bufs:
-                    # guarded (not setdefault): the default would re-build
-                    # two full-size buffer sets on every call
-                    _fold_bufs[acc[0].shape] = [
-                        tuple(np.empty_like(p) for p in acc)
-                        for _ in range(2)
-                    ]
-                bufs = _fold_bufs[acc[0].shape]
-                k = 0
-                for i in range(1, r):
-                    acc = native_engine.orswot_merge(
-                        *acc, *(x[i] for x in st), out=bufs[k]
-                    )[:5]
-                    k ^= 1
-                # defer plunger, as in fold_join (acc sits in bufs[k^1])
-                return native_engine.orswot_merge(*acc, *acc, out=bufs[k])[:5]
-
-            _north_star_parity(templates[0], r, a, m, d, native_fold_join)
-            np_templates = [
-                tuple(np.asarray(x) for x in tpl) for tpl in templates
-            ]
-            t0n = time.perf_counter()
-            for c in range(n_chunks):
-                out_native = native_fold_join(np_templates[c % len(np_templates)])
-            native_s = time.perf_counter() - t0n
-            del out_native
-            log(
-                f"north★ native-engine fold: {native_s:.2f}s "
-                f"({n_chunks * chunk * r / native_s / 1e6:.2f}M merges/s) "
-                f"vs jnp {t:.2f}s"
-            )
-            elision["native_s"] = round(native_s, 2)
-            if native_s < t:
-                elision["jnp_s"] = round(t, 2)
-                elision["timing_path"] = "native"
-                t = native_s
-                kernel_name = "native_fold"
+    if native_s is not None:
+        if t is None:
+            log(f"north★ native-engine fold: {native_s:.2f}s (jnp path unavailable)")
+            elision["timing_path"] = "native"
+            t = native_s
+            kernel_name = "native_fold"
+        elif native_s < t:
+            log(f"north★ native-engine fold: {native_s:.2f}s vs jnp {t:.2f}s")
+            elision["jnp_s"] = round(t, 2)
+            elision["timing_path"] = "native"
+            t = native_s
+            kernel_name = "native_fold"
+        else:
+            log(f"north★ native-engine fold: {native_s:.2f}s vs jnp {t:.2f}s (jnp wins)")
+    if t is None:
+        raise RuntimeError("north★: no timing path produced a measurement")
 
     merges = n_chunks * chunk * r  # (r-1) fold merges + 1 plunger per object
+    elision["northstar_replica_objects"] = merges
     rate = merges / t
     state_bytes = sum(x.nbytes for x in templates[0])
     log(
@@ -471,6 +600,8 @@ def bench_north_star_resident():
         chunk, n_chunks, a, m, d, r, base, novel = 1_000, 4, 16, 8, 2, 4, 4, 1
     else:
         chunk, n_chunks, a, m, d, r, base, novel = 62_500, 20, 64, 16, 2, 8, 6, 1
+        if _downshift():
+            n_chunks = 4  # CPU fallback: same per-chunk work, 5x less wall
     deferred_frac = 0.25
 
     build = jax.jit(
@@ -694,6 +825,14 @@ def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
     try:
         from crdt_tpu.utils.fingerprint import ops_fingerprint
 
+        # unpickling executes arbitrary code: only trust artifacts in a
+        # directory owned by this user and not writable by others
+        # (advisor r3: a fixed world-writable /tmp path invites planted
+        # pickles)
+        st = os.stat(os.path.dirname(art_path))
+        if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+            log("north★ pallas bridge: artifact dir not exclusively ours; refusing")
+            return None
         with open(verdict_path) as f:
             verdict = json.load(f)
         if verdict.get("parity") is not True:
@@ -720,6 +859,26 @@ def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
         ) != os.environ.get("CRDT_PALLAS_TILE", "auto"):
             log("north★ pallas bridge: env pins differ from this run")
             return None
+        # the executable's lax.scan length is baked at build time; the
+        # fingerprint/env gates don't cover it (advisor r3 medium).  The
+        # artifact must carry its own merge counts, they must match what
+        # this bench claims to measure, and the rate is computed from the
+        # ARTIFACT's counts — never from bench constants the executable
+        # does not embody.
+        counts = art["meta"].get("counts")
+        if counts is None:
+            log("north★ pallas bridge: artifact lacks merge counts (rebuild); "
+                "helper path next")
+            return None
+        if (counts.get("n_chunks"), counts.get("chunk"), counts.get("r")) != (
+            n_chunks, chunk, r
+        ):
+            log(
+                f"north★ pallas bridge: artifact counts {counts} != bench "
+                f"shapes (n_chunks={n_chunks}, chunk={chunk}, r={r}); "
+                "helper path next"
+            )
+            return None
         from jax.experimental.serialize_executable import (
             deserialize_and_load,
         )
@@ -734,7 +893,7 @@ def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
         out = compiled(tpl)
         np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
         t = max(time.perf_counter() - t0 - sync_s, 1e-9)
-        rate = n_chunks * chunk * r / t
+        rate = counts["n_chunks"] * counts["chunk"] * counts["r"] / t
         log(
             f"north★ pallas fused fold (AOT bridge, no remote compile): "
             f"{t:.2f}s  {rate/1e6:.2f}M merges/s"
@@ -845,30 +1004,46 @@ def bench_bulk_ingest():
     from crdt_tpu.scalar.vclock import VClock
     from crdt_tpu.utils.interning import Universe
 
-    n = 1_000_000 if not SMALL else 20_000
-    rng = np.random.RandomState(4)
-    actors = rng.randint(0, 16, size=(n, 3))
-    counters = rng.randint(1, 50, size=(n, 3))
-    members = rng.randint(0, 1 << 22, size=(n, 2))
-    states = []
-    for i in range(n):
-        s = Orswot()
-        s.clock = VClock({int(actors[i, 0]): int(counters[i, 0]),
-                          int(actors[i, 1]): int(counters[i, 1])})
-        s.entries[int(members[i, 0])] = VClock({int(actors[i, 0]): int(counters[i, 0])})
-        s.entries[int(members[i, 1])] = VClock({int(actors[i, 1]): int(counters[i, 1])})
-        states.append(s)
+    def run_once(n, rng):
+        actors = rng.randint(0, 16, size=(n, 3))
+        counters = rng.randint(1, 50, size=(n, 3))
+        members = rng.randint(0, 1 << 22, size=(n, 2))
+        states = []
+        for i in range(n):
+            s = Orswot()
+            s.clock = VClock({int(actors[i, 0]): int(counters[i, 0]),
+                              int(actors[i, 1]): int(counters[i, 1])})
+            s.entries[int(members[i, 0])] = VClock({int(actors[i, 0]): int(counters[i, 0])})
+            s.entries[int(members[i, 1])] = VClock({int(actors[i, 1]): int(counters[i, 1])})
+            states.append(s)
 
-    uni = Universe(CrdtConfig(num_actors=16, member_capacity=4, deferred_capacity=2))
-    t0 = time.perf_counter()
-    batch = OrswotBatch.from_scalar(states, uni)
-    t_in = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    back = batch.to_scalar(uni)
-    t_out = time.perf_counter() - t0
-    sample = rng.randint(0, n, size=16)
-    for i in sample:
-        assert back[i].value().val == states[i].value().val, "ingest round-trip parity"
+        uni = Universe(CrdtConfig(num_actors=16, member_capacity=4, deferred_capacity=2))
+        t0 = time.perf_counter()
+        batch = OrswotBatch.from_scalar(states, uni)
+        t_in = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        back = batch.to_scalar(uni)
+        t_out = time.perf_counter() - t0
+        sample = rng.randint(0, n, size=16)
+        for i in sample:
+            assert back[i].value().val == states[i].value().val, \
+                "ingest round-trip parity"
+        return t_in, t_out
+
+    n_full = 1_000_000 if not SMALL else 20_000
+    rng = np.random.RandomState(4)
+    n = n_full
+    if not SMALL:
+        # size the measured volume to the budget from a 20k probe: the
+        # tunneled TPU path has measured as slow as ~21k obj/s in /
+        # ~4.5k obj/s out (BENCH_tpu_window.json), where 1M objects
+        # would eat ~270s; the obj/s rates the JSON reports are
+        # volume-independent at these scales
+        t_in_p, t_out_p = run_once(20_000, np.random.RandomState(7))
+        per_obj = (t_in_p + t_out_p) / 20_000 + 30e-6  # +scalar-build cost
+        slice_budget = max(45.0, min(remaining_budget() * 0.3, 240.0))
+        n = int(min(n_full, max(50_000, slice_budget / per_obj)))
+    t_in, t_out = run_once(n, rng)
     log(
         f"ingest  from_scalar {n} objects: {t_in:.1f}s ({n/t_in/1e3:.0f}k obj/s)  "
         f"to_scalar: {t_out:.1f}s ({n/t_out/1e3:.0f}k obj/s)"
@@ -876,6 +1051,7 @@ def bench_bulk_ingest():
     return {
         "ingest_obj_per_sec": round(n / t_in, 1),
         "egress_obj_per_sec": round(n / t_out, 1),
+        "ingest_objects": n,
     }
 
 
@@ -1024,10 +1200,73 @@ def _probe_backend(total_budget_s: float) -> bool:
     return ok
 
 
+def _load_banked():
+    """The last watcher-published on-chip capture, or None.
+
+    Seeds the artifact so a wedged-tunnel run still carries a real TPU
+    number (clearly labeled as banked, with its capture provenance)
+    instead of nothing — VERDICT r3 item 2."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_tpu_window.json")
+    try:
+        with open(path) as f:
+            rec = json.loads(f.read().strip() or "{}")
+    except (OSError, ValueError):
+        return None
+    if rec.get("platform") == "tpu" and isinstance(rec.get("value"), (int, float)):
+        return rec
+    return None
+
+
+_BANKED_HEADLINE = False
+_IS_FALLBACK = False
+
+
+def emit_headline(rate, kernel_fields: dict, platform: str, fallback: bool):
+    """Publish a live headline — unless a banked on-chip capture is
+    seeding the artifact and the live run is only a CPU fallback, in
+    which case the live numbers land under ``live_*`` keys and the TPU
+    headline stands (a degraded tunnel must not downgrade the artifact's
+    evidence)."""
+    global _BANKED_HEADLINE
+    if _BANKED_HEADLINE and platform != "tpu":
+        emit(
+            live_value=round(rate, 1),
+            live_platform=platform,
+            backend_fallback=fallback,
+            **{f"live_{k}": v for k, v in kernel_fields.items()},
+        )
+    else:
+        _BANKED_HEADLINE = False
+        emit(
+            value=round(rate, 1),
+            platform=platform,
+            backend_fallback=fallback,
+            headline_source="live",
+            **kernel_fields,
+        )
+
+
 def main():
+    global _BANKED_HEADLINE, _IS_FALLBACK
+    banked = _load_banked()
+    if banked is not None:
+        _BANKED_HEADLINE = True
+        emit(
+            value=banked["value"],
+            kernel=banked.get("kernel", "tpu_window_capture"),
+            platform="tpu",
+            backend_fallback=False,
+            headline_source="banked_window",
+            banked_captured_at=banked.get("captured_at"),
+            banked_captured_rev=banked.get("captured_rev"),
+        )
+
     plat = os.environ.get("CRDT_BENCH_PLATFORM")
     fallback = False
-    probe_budget = float(os.environ.get("CRDT_BENCH_PROBE_TIMEOUT", "900"))
+    probe_budget = float(os.environ.get("CRDT_BENCH_PROBE_TIMEOUT", "120"))
+    # the probe must leave enough budget for the CPU-fallback body
+    probe_budget = min(probe_budget, max(30.0, remaining_budget() - 300))
     if not plat and not _probe_backend(probe_budget):
         log(
             f"WARNING: default backend unreachable within the {probe_budget:.0f}s "
@@ -1037,6 +1276,7 @@ def main():
         )
         plat = "cpu"
         fallback = True
+    _IS_FALLBACK = fallback
 
     import jax
 
@@ -1045,50 +1285,59 @@ def main():
     if plat:
         jax.config.update("jax_platforms", plat)
 
-    log(f"backend: {jax.default_backend()}  devices: {len(jax.devices())}  small={SMALL}")
-    parity_anchor()
-    bench_clock_merges()
-    rate4 = bench_orswot_pairwise()
-    ingest = bench_bulk_ingest()
-    # north star BEFORE the Pallas validation attempt: a Mosaic compile
-    # crash can take the tunnel's remote-compile helper down with it,
-    # which must not be able to cost us the headline metric
-    rate, elision, ns_templates, ns_kernel = bench_north_star()
-    resident = bench_north_star_resident()
+    backend = jax.default_backend()
+    log(f"backend: {backend}  devices: {len(jax.devices())}  small={SMALL}  "
+        f"budget={_BUDGET_S:.0f}s (remaining {remaining_budget():.0f}s)")
+
+    run_stage("parity_anchor", 20, parity_anchor)
+    # the headline FIRST: everything else is secondary evidence (stage
+    # order is budget-risk order, not report order)
+    ns = run_stage("north_star", 90, bench_north_star)
+    if ns is not None:
+        rate, elision, ns_templates, ns_kernel = ns
+        emit_headline(rate, {"kernel": ns_kernel}, backend, fallback)
+        emit(**elision)
+    else:
+        rate, elision, ns_templates, ns_kernel = None, {}, None, None
+
+    rate4 = run_stage("config4", 45, bench_orswot_pairwise)
+    if rate4 is not None:
+        emit(config4_merges_per_sec=round(rate4, 1))
+    run_stage("clock_merges", 60, bench_clock_merges)
+    ingest = run_stage("ingest", 60, bench_bulk_ingest)
+    if ingest is not None:
+        emit(**ingest)
+    resident = run_stage("resident", 90, bench_north_star_resident)
+    if resident is not None:
+        emit(
+            distinct_objects=resident["distinct_replica_objects"],
+            e2e_s=resident["e2e_s"],
+            resident_merges_per_sec=resident["resident_merges_per_sec"],
+        )
     # the Pallas attempt runs AFTER every jnp metric is banked (a Mosaic
     # crash can wedge the tunnel's compile helper) and can only ever
     # raise the headline, never lose it
-    pallas_rate = bench_pallas_north_star(ns_templates)
-    bench_tpu_validation()
-
-    headline = rate
-    kernel = {"kernel": ns_kernel}
-    if pallas_rate is not None and pallas_rate > rate:
-        headline = pallas_rate
-        kernel = {"kernel": "pallas_fused_fold",
-                  "jnp_merges_per_sec": round(rate, 1)}
-    elif pallas_rate is not None:
-        kernel["pallas_merges_per_sec"] = pallas_rate
-
-    print(
-        json.dumps(
-            {
-                "metric": "orswot_merges_per_sec_to_fixpoint",
-                "value": round(headline, 1),
-                "unit": "merges/s",
-                "vs_baseline": round(headline / 1e7, 4),
-                **kernel,
-                "platform": jax.default_backend(),
-                "backend_fallback": fallback,
-                "distinct_objects": resident["distinct_replica_objects"],
-                "e2e_s": resident["e2e_s"],
-                "resident_merges_per_sec": resident["resident_merges_per_sec"],
-                "config4_merges_per_sec": round(rate4, 1),
-                **ingest,
-                **elision,
-            }
-        )
+    pallas_rate = run_stage(
+        "pallas_north_star", 120, bench_pallas_north_star, ns_templates
     )
+    if pallas_rate is not None:
+        if rate is None or pallas_rate > rate:
+            kf = {"kernel": "pallas_fused_fold"}
+            if rate is not None:
+                kf["jnp_merges_per_sec"] = round(rate, 1)
+            emit_headline(pallas_rate, kf, backend, fallback)
+        else:
+            emit(pallas_merges_per_sec=pallas_rate)
+    run_stage("tpu_validation", 240, bench_tpu_validation)
+
+    if _JSON_STATE.get("value") is None:
+        # nothing measured and nothing banked: emit an explicit-failure
+        # record rather than no line at all
+        _JSON_STATE["value"] = 0.0
+        emit(platform=backend, backend_fallback=fallback,
+             headline_source="none")
+    else:
+        emit()  # final re-print so the last stdout line is the full record
 
 
 if __name__ == "__main__":

@@ -97,6 +97,14 @@ def _rebuild_tuple(rows):
     return tuple(_rebuild_tuple(groups[i]) for i in range(len(groups)))
 
 
+def _as_pure_tuples(value):
+    """Nested sequences → nested tuples (a zero-leaf pytree field is
+    tuples all the way down; serde round-trips them as lists)."""
+    if isinstance(value, (tuple, list)):
+        return tuple(_as_pure_tuples(v) for v in value)
+    return value
+
+
 def save(path, batch_state: Any, universe: Universe) -> None:
     """Write ``batch_state`` (a :mod:`crdt_tpu.batch` pytree) + its universe.
 
@@ -115,6 +123,7 @@ def save(path, batch_state: Any, universe: Universe) -> None:
         raise TypeError(f"not a checkpointable batch type: {cls_name}")
     arrays: dict = {}
     static: dict = {}
+    empty: dict = {}
     for f in dataclasses.fields(batch_state):
         value = getattr(batch_state, f.name)
         if _is_static_field(f):
@@ -122,9 +131,17 @@ def save(path, batch_state: Any, universe: Universe) -> None:
 
             static[f.name] = kernel_to_spec(value)
         else:
+            before = len(arrays)
             _flatten_field(f.name, value, arrays)
+            if len(arrays) == before:
+                # a field that legitimately flattens to zero leaves (an
+                # empty nested tuple) writes no npz members; record its
+                # structure in the meta so load() can rebuild it instead
+                # of mistaking the absence for corruption
+                empty[f.name] = _as_pure_tuples(value)
     meta = serde.to_binary(
-        {"version": FORMAT_VERSION, "type": cls_name, "static": static}
+        {"version": FORMAT_VERSION, "type": cls_name, "static": static,
+         "empty": empty}
     )
     np.savez(
         path,
@@ -202,10 +219,17 @@ def load(path) -> Tuple[Any, Universe]:
                             )
                             rows.append((idx_path, jnp.asarray(z[key])))
                     if not rows:
-                        raise ValueError(
-                            f"checkpoint missing arrays for field {f.name!r}"
-                        )
-                    fields[f.name] = _rebuild_tuple(sorted(rows))
+                        empties = meta.get("empty", {})
+                        if f.name in empties:
+                            # save() recorded a legitimately leafless
+                            # field (empty nested tuple) — not corruption
+                            fields[f.name] = _as_pure_tuples(empties[f.name])
+                        else:
+                            raise ValueError(
+                                f"checkpoint missing arrays for field {f.name!r}"
+                            )
+                    else:
+                        fields[f.name] = _rebuild_tuple(sorted(rows))
             out = cls(**fields)
         except ValueError:
             raise

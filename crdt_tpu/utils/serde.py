@@ -45,10 +45,20 @@ def _read_exact(buf: io.BytesIO, n: int) -> bytes:
     return raw
 
 
+# Longest varint the decoder accepts.  Generic int payloads are
+# arbitrary-precision (zigzagged through _write_uvarint), so a tight
+# 64-bit cap would reject legitimate states — but an UNBOUNDED decode is
+# an asymmetric CPU-DoS on the replication receive path: a run of 0x80
+# bytes costs quadratic big-int work in its length.  2048 bytes (~14k
+# bits) is far beyond any plausible payload and keeps the worst-case
+# decode cost trivially small.
+_MAX_VARINT_BYTES = 2048
+
+
 def _read_uvarint(buf: io.BytesIO) -> int:
     shift = 0
     result = 0
-    while True:
+    for _ in range(_MAX_VARINT_BYTES):
         raw = buf.read(1)
         if not raw:
             raise ValueError("truncated varint")
@@ -57,6 +67,9 @@ def _read_uvarint(buf: io.BytesIO) -> int:
         if not (b & 0x80):
             return result
         shift += 7
+    raise ValueError(
+        f"varint longer than {_MAX_VARINT_BYTES} bytes (corrupt or adversarial)"
+    )
 
 
 def _zigzag_big(n: int) -> int:

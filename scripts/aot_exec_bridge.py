@@ -71,6 +71,35 @@ def _northstar_shapes(small: bool):
     return dict(n=1_250_000, a=64, m=16, d=2, r=8, chunk=62_500, base=6, novel=1)
 
 
+def _program_counts(name: str, small: bool) -> dict:
+    """The merge counts a program's baked-in structure embodies.
+
+    Stored in the artifact meta at build time and used for every rate
+    computation at load time: the executable's lax.scan length is fixed
+    when it is compiled, so a consumer computing rates from its OWN
+    constants would silently misreport if shapes drifted (advisor r3)."""
+    shp = _northstar_shapes(small)
+    n_chunks = max(2, shp["n"] // shp["chunk"])
+    if name in ("scan_ns", "pallas_scan_ns"):
+        # scan_ns folds two templates per step over n_chunks//2 steps;
+        # pallas_scan_ns folds one template over n_chunks steps — both
+        # execute n_chunks chunk-folds of r merges over `chunk` objects
+        return {"n_chunks": n_chunks, "chunk": shp["chunk"], "r": shp["r"]}
+    if name == "merge4":
+        return {"n_chunks": 1, "chunk": 2_000 if small else 100_000, "r": 1}
+    return {}
+
+
+def _check_art_dir() -> bool:
+    """Refuse pickle traffic through a directory another user could have
+    planted files in (advisor r3: fixed world-writable /tmp path)."""
+    try:
+        st = os.stat(ART_DIR)
+    except FileNotFoundError:
+        return True  # build creates it with default umask below
+    return st.st_uid == os.getuid() and not (st.st_mode & 0o022)
+
+
 def _make_templates(jnp, shp, n_templates=2):
     """Same recipe/seed as bench.bench_north_star (bench.py)."""
     import numpy as np
@@ -219,6 +248,7 @@ def build(name: str, small: bool):
                     "code": _code_fingerprint(),
                     "jax": jax.__version__,
                     "compile_s": round(t_compile, 1),
+                    "counts": _program_counts(name, small),
                 },
             },
             f,
@@ -259,6 +289,11 @@ def load(name: str, small: bool):
     path = os.path.join(ART_DIR, f"{name}{'_small' if small else ''}.pkl")
     if not os.path.exists(path):
         print(json.dumps({"loaded": name, "error": f"no artifact at {path}"}))
+        return 1
+    if not _check_art_dir():
+        print(json.dumps({"loaded": name,
+                          "error": f"{ART_DIR} not exclusively ours; refusing "
+                                   "to unpickle"}))
         return 1
     with open(path, "rb") as f:
         art = pickle.load(f)
@@ -367,16 +402,13 @@ def load(name: str, small: bool):
         times.append(time.perf_counter() - t0)
     t = float(np.median(times))
     result["exec_s"] = round(t, 3)
-    shp = _northstar_shapes(small)
-    if name == "scan_ns":
-        merges = (max(2, shp["n"] // shp["chunk"])) * shp["chunk"] * shp["r"]
+    # rate from the ARTIFACT's own baked-in counts (meta written at build
+    # time), never from this process's constants — see _program_counts
+    counts = art["meta"].get("counts") or _program_counts(name, small)
+    if counts:
+        merges = counts["n_chunks"] * counts["chunk"] * counts["r"]
         result["merges_per_sec"] = round(merges / t, 1)
-    elif name == "pallas_scan_ns":
-        merges = max(2, shp["n"] // shp["chunk"]) * shp["chunk"] * shp["r"]
-        result["merges_per_sec"] = round(merges / t, 1)
-    elif name == "merge4":
-        n = 2_000 if small else 100_000
-        result["merges_per_sec"] = round(n / t, 1)
+        result["counts"] = counts
     print(json.dumps(result), flush=True)
     # persist the verdict beside the artifact: bench.py's bridge-headline
     # path consumes it (only a parity-true verdict BOUND to this exact

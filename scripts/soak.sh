@@ -1,0 +1,45 @@
+#!/bin/bash
+# One-command CI soak (VERDICT r3 item 7): a deep hypothesis pass at
+# 1000 examples/property, then 3 repeated full-suite passes (hypothesis
+# draws fresh cases each pass — profiles are not derandomized, see
+# tests/conftest.py).  Everything tees into one committed log under
+# reports/ so the soak is a reproducible artifact, not a round-notes
+# claim.
+#
+# Usage: bash scripts/soak.sh [logfile]
+#   CRDT_SOAK_DEEP_EXAMPLES  examples/property for the deep pass (1000)
+#   CRDT_SOAK_PASSES         repeated standard passes after it (3)
+set -u
+cd "$(dirname "$0")/.."
+
+LOG=${1:-reports/SOAK_$(date -u +%Y%m%d).log}
+DEEP=${CRDT_SOAK_DEEP_EXAMPLES:-1000}
+PASSES=${CRDT_SOAK_PASSES:-3}
+mkdir -p "$(dirname "$LOG")"
+: > "$LOG"
+
+# NOTE: pass/fail state must live in THIS shell — `{ ...; } | tee` would
+# mutate `fail` inside the pipeline subshell and the final exit would
+# always see 0.  Each step pipes through tee individually and reports
+# its real status via PIPESTATUS.
+note() { echo "$@" 2>&1 | tee -a "$LOG"; }
+runp() { "$@" 2>&1 | tee -a "$LOG"; return "${PIPESTATUS[0]}"; }
+
+fail=0
+note "# soak $(date -u +%Y-%m-%dT%H:%M:%SZ)  rev $(git rev-parse --short HEAD 2>/dev/null || echo norev)"
+note "# deep pass: CRDT_HYP_EXAMPLES=$DEEP; then $PASSES standard passes"
+
+note "== deep hypothesis pass (CRDT_HYP_EXAMPLES=$DEEP) =="
+runp env CRDT_HYP_EXAMPLES="$DEEP" python -m pytest tests/ -q --tb=short || fail=1
+
+for i in $(seq 1 "$PASSES"); do
+    note "== standard pass $i/$PASSES (PYTHONHASHSEED=$i, fresh hypothesis cases) =="
+    runp env PYTHONHASHSEED="$i" python -m pytest tests/ -q --tb=short || fail=1
+done
+
+if [ "$fail" = 0 ]; then
+    note "SOAK GREEN: deep pass + $PASSES repeated passes all passed"
+else
+    note "SOAK FAILED: see above"
+fi
+exit "$fail"

@@ -102,8 +102,15 @@ for i in $(seq 1 600); do
         # publish only when this iteration actually ran the bench (marker
         # absent before the call) — a marker short-circuit must not
         # re-stamp the artifact's capture time
+        # PROBE_TIMEOUT back at the old 900s ladder inside a window: the
+        # watcher's aliveness gate only proved jax.devices(), but the
+        # bench probe also needs a tiny dispatch — on a live-but-slow
+        # window the new 120s default could misclassify the backend as
+        # wedged and burn the whole window on a CPU fallback
         if [ ! -e "$MARK/bench" ] && step bench 4500 /tmp/bench_tpu3.log \
-            env CRDT_SKIP_TPU_VALIDATE=1 python bench.py; then
+            env CRDT_SKIP_TPU_VALIDATE=1 CRDT_BENCH_BUDGET_S=4200 \
+            CRDT_BENCH_PROBE_TIMEOUT=900 \
+            python bench.py; then
             publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \
@@ -145,6 +152,13 @@ for i in $(seq 1 600); do
             step aot_pallas_scan 2400 /tmp/aot_pallas_scan_tpu.log \
                 python scripts/aot_exec_bridge.py load pallas_scan_ns
         fi
+        # fold any green bridge verdicts into BENCH_tpu_window.json NOW —
+        # the bench that would promote them ran earlier in this window,
+        # and the next window may never come (idempotent, headline can
+        # only go up; bench.py's banked-seed path then carries it into
+        # the driver artifact)
+        timeout -k 15 120 python scripts/publish_bridge_capture.py \
+            >> /tmp/tunnel_watch.log 2>&1 || true
         # done only when every step whose precondition exists has its
         # marker — including the AOT loads, so a window that closes
         # mid-load leaves them to retry next window

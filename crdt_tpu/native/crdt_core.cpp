@@ -1338,6 +1338,7 @@ extern "C" {
 DEFINE_ALL(u32, uint32_t)
 DEFINE_ALL(u64, uint64_t)
 
-int crdt_core_abi_version() { return 5; }
+// v6: + orswot_ingest_wire_{u32,u64} (wire_ingest.cpp)
+int crdt_core_abi_version() { return 6; }
 
 }  // extern "C"

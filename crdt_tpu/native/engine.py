@@ -598,3 +598,42 @@ def map_mvreg_merge(
         (clock, keys, eclocks, mv_clocks, mv_vals, d_keys, d_clocks),
         overflow.astype(bool).reshape(lead),
     )
+
+
+# -- bulk wire ingest --------------------------------------------------------
+
+
+def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype):
+    """Parallel wire-format decode of ``n`` concatenated ORSWOT blobs
+    (`crdt_tpu/native/wire_ingest.cpp`) straight into dense planes.
+
+    ``buf``: uint8 array of the concatenated serde blobs; ``offsets``:
+    int64[n+1] blob boundaries.  Identity interning is assumed (the
+    caller — ``OrswotBatch.from_wire`` — guarantees an identity
+    universe): actor index == actor value (< ``a``), member id == member
+    value (int32).
+
+    Returns ``(clock, ids, dots, d_ids, d_clocks, status)`` where
+    ``status`` is uint8[n]: 0 ok, 1 fast-path fallback (blob structure
+    outside the integer-keyed grammar — decode it in Python), 2 member
+    overflow, 3 deferred overflow, 4 actor out of range.  Rows with
+    nonzero status are left empty."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    dt = np.dtype(dtype)
+    clock = np.zeros((n, a), dtype=dt)
+    ids = np.full((n, m), -1, dtype=np.int32)
+    dots = np.zeros((n, m, a), dtype=dt)
+    d_ids = np.full((n, d), -1, dtype=np.int32)
+    d_clocks = np.zeros((n, d, a), dtype=dt)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("orswot_ingest_wire", dt)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n),
+        ctypes.c_int64(a), ctypes.c_int64(m), ctypes.c_int64(d),
+        _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
+        _ptr(status),
+    )
+    return clock, ids, dots, d_ids, d_clocks, status

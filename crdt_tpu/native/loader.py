@@ -48,9 +48,15 @@ def load() -> ctypes.CDLL:
             return _lib
         if _build_error is not None:
             raise RuntimeError(_build_error)
-        if not (os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(
-            os.path.join(_HERE, "crdt_core.cpp")
-        )):
+        sources = [
+            os.path.join(_HERE, name)
+            for name in sorted(os.listdir(_HERE))
+            if name.endswith(".cpp") or name == "Makefile"
+        ]
+        if not (
+            os.path.exists(_SO)
+            and all(os.path.getmtime(_SO) >= os.path.getmtime(s) for s in sources)
+        ):
             err = _build()
             if err is not None:
                 _build_error = err
@@ -74,7 +80,7 @@ def load() -> ctypes.CDLL:
             if err is not None:
                 _build_error = f"{err} (initial load error: {first})"
                 raise RuntimeError(_build_error)
-        if lib.crdt_core_abi_version() != 5:
+        if lib.crdt_core_abi_version() != 6:
             _build_error = "native ABI version mismatch; run make clean"
             raise RuntimeError(_build_error)
         _lib = lib

@@ -1,0 +1,228 @@
+// wire_ingest — bulk ORSWOT wire-format decode straight into dense planes.
+//
+// The framework's wire codec (crdt_tpu/utils/serde.py, a deterministic
+// varint/tag format — deliberately NOT the reference's bincode) is the
+// replication payload: states arrive as byte blobs.  The Python decode
+// path materializes a scalar Orswot per blob and then bulk-converts
+// (~170k obj/s at 1M objects, reports/INGEST_PROFILE.md) — three orders
+// off the north-star <1s end-to-end story.  This translation unit is the
+// bulk path the reference's host serde (lib.rs:62-83) maps to: parse the
+// blobs IN PARALLEL directly into the dense SoA planes, no Python objects
+// anywhere.
+//
+// Fast-path grammar (the subset covering integer actors/members — the
+// dense device types' native domain; any blob outside it is flagged for
+// the Python fallback, never mis-parsed):
+//
+//   ORSWOT    := 0x26 clock_body entries deferred
+//   clock_body:= uv n, n * pair
+//   pair      := 0x03 uv zz(actor) 0x03 uv zz(counter)
+//   entries   := uv n, n * ( 0x03 uv zz(member) 0x20 clock_body )
+//   deferred  := uv n, n * ( clock_key uv m, m * (0x03 uv zz(member)) )
+//   clock_key := 0x08 uv k, k * ( 0x08 uv(2) 0x03 uv zz(actor)
+//                                            0x03 uv zz(counter) )
+//
+// (uv = unsigned LEB128 varint, zz = zigzag; tags from serde.py: 0x03 int,
+// 0x08 tuple, 0x20 vclock, 0x26 orswot.)
+//
+// Identity interning: the caller guarantees a Universe whose actor index
+// IS the actor value (< A) and whose member id IS the member value
+// (int32) — see crdt_tpu.utils.interning.IdentityRegistry.  Counters
+// beyond the counter dtype flag the blob for fallback (the Python path
+// raises OverflowError at the numpy conversion; the fast path must never
+// silently wrap a causal counter).
+//
+// Per-object status codes (status[i]):
+//   0 ok    1 fallback (structure outside the fast-path grammar)
+//   2 member overflow (> M)      3 deferred overflow (> D)
+//   4 actor out of range (>= A or negative)
+//
+// Each object writes only its own rows, so the object loop is
+// embarrassingly parallel (OpenMP).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr uint8_t kTagInt = 0x03;
+constexpr uint8_t kTagTuple = 0x08;
+constexpr uint8_t kTagVClock = 0x20;
+constexpr uint8_t kTagOrswot = 0x26;
+constexpr int32_t kEmpty = -1;
+
+struct Cursor {
+  const uint8_t* p;
+  const uint8_t* end;
+
+  bool byte(uint8_t want) {
+    if (p >= end || *p != want) return false;
+    ++p;
+    return true;
+  }
+
+  // unsigned LEB128, capped at the u64 range — anything longer (or any
+  // byte contributing bits past 2^64) is a legitimate big-int payload
+  // the fast path hands to Python rather than silently truncating
+  bool uv(uint64_t* out) {
+    uint64_t v = 0;
+    int shift = 0;
+    for (int i = 0; i < 10; ++i) {
+      if (p >= end) return false;
+      uint8_t b = *p++;
+      if (shift == 63 && (b & 0x7F) > 1) return false;  // bits >= 2^64
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) {
+        *out = v;
+        return true;
+      }
+      shift += 7;
+    }
+    return false;
+  }
+
+  // a zigzagged NON-NEGATIVE int (actors/members/counters are never
+  // negative in valid states; negative means fallback)
+  bool nonneg(uint64_t* out) {
+    uint64_t z;
+    if (!byte(kTagInt) || !uv(&z)) return false;
+    if (z & 1) return false;  // negative
+    *out = z >> 1;
+    return true;
+  }
+};
+
+template <typename C>
+int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
+              int64_t M, int64_t D, C* clock, int32_t* ids, C* dots,
+              int32_t* d_ids, C* d_clocks) {
+  // counters beyond the counter dtype are NOT wrapped: the Python path
+  // (numpy conversion) raises OverflowError, so the fast path flags the
+  // blob for fallback and lets that exact behavior happen
+  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(kTagOrswot)) return 1;
+
+  uint64_t n;
+  // set clock
+  if (!c.uv(&n)) return 1;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t actor, counter;
+    if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
+    if (actor >= static_cast<uint64_t>(A)) return 4;
+    if (counter > kCounterMax) return 1;
+    clock[actor] = static_cast<C>(counter);
+  }
+
+  // member entries (dense slots in wire order — the same order the
+  // Python fallback's from_binary hands from_scalar)
+  if (!c.uv(&n)) return 1;
+  if (n > static_cast<uint64_t>(M)) return 2;
+  for (uint64_t e = 0; e < n; ++e) {
+    uint64_t member;
+    if (!c.nonneg(&member)) return 1;
+    if (member > 0x7FFFFFFFull) return 1;  // beyond int32 id space
+    ids[e] = static_cast<int32_t>(member);
+    if (!c.byte(kTagVClock)) return 1;
+    uint64_t k;
+    if (!c.uv(&k)) return 1;
+    C* row = dots + e * A;
+    for (uint64_t i = 0; i < k; ++i) {
+      uint64_t actor, counter;
+      if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
+      if (actor >= static_cast<uint64_t>(A)) return 4;
+      if (counter > kCounterMax) return 1;
+      row[actor] = static_cast<C>(counter);
+    }
+  }
+
+  // deferred: one dense row per (clock, member) pair.  The witnessing
+  // clock is decoded once into a thread-local scratch row and copied to
+  // every member row buffered under it (matches from_scalar's layout:
+  // `for member in members: one row sharing the clock columns`).
+  if (!c.uv(&n)) return 1;
+  static thread_local std::vector<C> scratch;
+  int64_t drow = 0;
+  for (uint64_t q = 0; q < n; ++q) {
+    if (!c.byte(kTagTuple)) return 1;
+    uint64_t k;
+    if (!c.uv(&k)) return 1;
+    scratch.assign(static_cast<size_t>(A), C{0});
+    for (uint64_t i = 0; i < k; ++i) {
+      uint64_t two, actor, counter;
+      if (!c.byte(kTagTuple) || !c.uv(&two) || two != 2) return 1;
+      if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
+      if (actor >= static_cast<uint64_t>(A)) return 4;
+      if (counter > kCounterMax) return 1;
+      scratch[actor] = static_cast<C>(counter);
+    }
+    uint64_t m;
+    if (!c.uv(&m)) return 1;
+    for (uint64_t j = 0; j < m; ++j) {
+      uint64_t member;
+      if (!c.nonneg(&member)) return 1;
+      if (member > 0x7FFFFFFFull) return 1;
+      if (drow >= D) return 3;
+      std::memcpy(d_clocks + drow * A, scratch.data(), sizeof(C) * A);
+      d_ids[drow] = static_cast<int32_t>(member);
+      ++drow;
+    }
+  }
+  if (c.p != c.end) return 1;  // trailing bytes: not a lone ORSWOT blob
+  return 0;
+}
+
+template <typename C>
+int64_t ingest_impl(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                    int64_t A, int64_t M, int64_t D, C* clock, int32_t* ids,
+                    C* dots, int32_t* d_ids, C* d_clocks, uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_one<C>(buf, offsets[i], offsets[i + 1], A, M, D,
+                          clock + i * A, ids + i * M, dots + i * M * A,
+                          d_ids + i * D, d_clocks + i * D * A);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      // leave the row pristine for the Python fallback / error report
+      std::memset(clock + i * A, 0, sizeof(C) * A);
+      std::memset(dots + i * M * A, 0, sizeof(C) * M * A);
+      std::memset(d_clocks + i * D * A, 0, sizeof(C) * D * A);
+      for (int64_t j = 0; j < M; ++j) ids[i * M + j] = kEmpty;
+      for (int64_t j = 0; j < D; ++j) d_ids[i * D + j] = kEmpty;
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t orswot_ingest_wire_u32(const uint8_t* buf, const int64_t* offsets,
+                               int64_t n, int64_t A, int64_t M, int64_t D,
+                               uint32_t* clock, int32_t* ids, uint32_t* dots,
+                               int32_t* d_ids, uint32_t* d_clocks,
+                               uint8_t* status) {
+  return ingest_impl<uint32_t>(buf, offsets, n, A, M, D, clock, ids, dots,
+                               d_ids, d_clocks, status);
+}
+
+int64_t orswot_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
+                               int64_t n, int64_t A, int64_t M, int64_t D,
+                               uint64_t* clock, int32_t* ids, uint64_t* dots,
+                               int32_t* d_ids, uint64_t* d_clocks,
+                               uint8_t* status) {
+  return ingest_impl<uint64_t>(buf, offsets, n, A, M, D, clock, ids, dots,
+                               d_ids, d_clocks, status);
+}
+
+}  // extern "C"

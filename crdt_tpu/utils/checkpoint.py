@@ -49,20 +49,43 @@ def _batch_types():
 
 
 def _universe_blob(universe: Universe) -> bytes:
+    from .interning import IdentityRegistry
+
     cfg = universe.config
+    # identity registries carry no value lists; a PER-REGISTRY marker
+    # restores each side as identity (a value list would rebuild a dict
+    # registry whose lookups fail for never-interned dense ids) — mixed
+    # identity/dict universes are constructible and must round-trip too
+    id_actors = isinstance(universe.actors, IdentityRegistry)
+    id_members = isinstance(universe.members, IdentityRegistry)
     payload = {
         "config": {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)},
         "actors": universe.actors.values(),
         "members": universe.members.values(),
+        "identity": [id_actors, id_members],
     }
     return serde.to_binary(payload)
 
 
 def _universe_from_blob(blob: bytes) -> Universe:
+    from .interning import IdentityRegistry, Registry
+
     payload = serde.from_binary(bytes(blob))
-    universe = Universe(CrdtConfig(**payload["config"]))
-    universe.actors.intern_all(payload["actors"])
-    universe.members.intern_all(payload["members"])
+    cfg = CrdtConfig(**payload["config"])
+    ident = payload.get("identity", False)
+    if isinstance(ident, bool):  # blobs from before the per-registry marker
+        ident = [ident, ident]
+    id_actors, id_members = ident
+    actors = (
+        IdentityRegistry(capacity=cfg.num_actors) if id_actors
+        else Registry(capacity=cfg.num_actors)
+    )
+    members = IdentityRegistry() if id_members else Registry()
+    universe = Universe(cfg, actors=actors, members=members)
+    if not id_actors:
+        universe.actors.intern_all(payload["actors"])
+    if not id_members:
+        universe.members.intern_all(payload["members"])
     return universe
 
 

@@ -50,20 +50,100 @@ class Registry:
         return list(self._to_val)
 
 
+class IdentityRegistry:
+    """A registry whose dense index IS the value — non-negative ints only.
+
+    The bulk wire-ingest path (:meth:`OrswotBatch.from_wire` → the native
+    parallel decoder, `crdt_tpu/native/wire_ingest.cpp`) decodes
+    million-object fleets without touching any Python per-value state;
+    that requires interning to be a no-op.  For integer actors (< the
+    actor-axis capacity) and integer members (int32 range) the identity
+    map is lossless: ``lookup`` returns the original int, so
+    ``value_sets``/``to_scalar`` work unchanged."""
+
+    __slots__ = ("capacity",)
+
+    #: duck-typing marker the bulk paths dispatch on
+    identity = True
+
+    def __init__(self, capacity: int | None = None):
+        self.capacity = capacity
+
+    def __len__(self) -> int:
+        # every index in range is permanently "interned"; the int32 id
+        # space [0, 2^31) stands in for the unbounded member registry
+        # (2^31 - 1 itself is a valid id — the native decoder accepts it)
+        return self.capacity if self.capacity is not None else (1 << 31)
+
+    def __contains__(self, value: Hashable) -> bool:
+        return (
+            isinstance(value, int) and not isinstance(value, bool)
+            and 0 <= value < len(self)
+        )
+
+    def intern(self, value: Hashable) -> int:
+        if value not in self:
+            raise ValueError(
+                f"identity registry holds non-negative ints < {len(self)}; "
+                f"got {value!r} (use a standard Universe for arbitrary "
+                "hashable values)"
+            )
+        return value
+
+    def intern_all(self, values: Iterable[Hashable]) -> List[int]:
+        return [self.intern(v) for v in values]
+
+    def lookup(self, idx: int) -> Any:
+        return int(idx)
+
+    def values(self) -> List[Hashable]:
+        # identity registries carry no per-value state; checkpoints record
+        # the identity marker instead of a value list (utils/checkpoint)
+        return []
+
+
 class Universe:
     """The interning context shared by a family of batch CRDTs.
 
     Holds the actor registry (dense columns of the actor axis) and the
     member registry (Orswot member ids / MVReg payload ids), plus the static
     capacities (:class:`crdt_tpu.config.CrdtConfig`).
+
+    :meth:`identity` builds a universe whose registries are identity maps
+    over non-negative ints — zero host-side interning state, required by
+    the native bulk wire-ingest path and recommended whenever actors and
+    members are already dense integers.
     """
 
-    def __init__(self, config=None):
+    def __init__(self, config=None, *, actors=None, members=None):
         from ..config import DEFAULT_CONFIG
 
         self.config = config or DEFAULT_CONFIG
-        self.actors = Registry(capacity=self.config.num_actors)
-        self.members = Registry()
+        self.actors = actors if actors is not None else Registry(
+            capacity=self.config.num_actors
+        )
+        self.members = members if members is not None else Registry()
+
+    @classmethod
+    def identity(cls, config=None) -> "Universe":
+        """A universe with identity interning (int actors < num_actors,
+        int32 members) — the zero-overhead mode the bulk wire-ingest
+        fast path requires."""
+        from ..config import DEFAULT_CONFIG
+
+        cfg = config or DEFAULT_CONFIG
+        return cls(
+            cfg,
+            actors=IdentityRegistry(capacity=cfg.num_actors),
+            members=IdentityRegistry(),
+        )
+
+    @property
+    def is_identity(self) -> bool:
+        return (
+            getattr(self.actors, "identity", False)
+            and getattr(self.members, "identity", False)
+        )
 
     def actor_idx(self, actor) -> int:
         return self.actors.intern(actor)

@@ -1104,12 +1104,20 @@ def bench_bulk_ingest():
                            ("ids", wq.ids, wr.ids), ("dots", wq.dots, wr.dots)):
             assert bool(jnp.array_equal(x, y)), f"wire parity: {name} diverged"
 
+        # egress parity gate too: to_wire must be byte-identical to
+        # to_binary of the scalars
+        assert wq.to_wire(iuni) == pb, "wire egress parity diverged"
+
         n_wire = 200_000 if (_downshift() or SMALL) else 1_000_000
         blobs = synth_wire_blobs(n_wire, rng)  # untimed setup
         t0 = time.perf_counter()
         wb = OrswotBatch.from_wire(blobs, iuni)
         jax.block_until_ready(wb.clock)
         t_wire = max(time.perf_counter() - t0, 1e-9)
+        t0 = time.perf_counter()
+        out_blobs = wb.to_wire(iuni)
+        t_enc = max(time.perf_counter() - t0, 1e-9)
+        del out_blobs
         t0 = time.perf_counter()
         coo = wb.to_coo()
         for part in coo:
@@ -1118,11 +1126,13 @@ def bench_bulk_ingest():
         t_coo = max(time.perf_counter() - t0, 1e-9)
         log(
             f"ingest  from_wire {n_wire} blobs: {t_wire:.2f}s "
-            f"({n_wire/t_wire/1e6:.2f}M obj/s)  to_coo egress: {t_coo:.2f}s "
+            f"({n_wire/t_wire/1e6:.2f}M obj/s)  to_wire egress: {t_enc:.2f}s "
+            f"({n_wire/t_enc/1e6:.2f}M obj/s)  to_coo egress: {t_coo:.2f}s "
             f"({n_wire/t_coo/1e6:.2f}M obj/s)"
         )
         return {
             "ingest_wire_obj_per_sec": round(n_wire / t_wire, 1),
+            "egress_wire_obj_per_sec": round(n_wire / t_enc, 1),
             "egress_coo_obj_per_sec": round(n_wire / t_coo, 1),
         }
 

@@ -439,6 +439,56 @@ class OrswotBatch:
             d_clocks=jnp.asarray(d_clocks),
         )
 
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs — the inverse of :meth:`from_wire`,
+        byte-identical to ``[to_binary(s) for s in self.to_scalar(uni)]``.
+
+        Fast path (identity universe + native engine): the parallel C++
+        encoder (`crdt_tpu/native/wire_ingest.cpp`) serializes the dense
+        planes directly — no scalar objects; the deterministic orderings
+        of the serde codec (encoded-bytes pair sort, repr-sorted clock
+        keys) are reproduced exactly.  Counters at or above 2^63 (u64
+        planes only) and non-identity universes take the Python path."""
+        import numpy as np
+
+        from ..utils.serde import to_binary
+
+        n = self.clock.shape[0]
+        if n == 0:
+            return []
+        engine = None
+        if universe.is_identity:
+            try:
+                from ..native import engine as engine  # noqa: F811
+
+                engine._fn("orswot_encode_wire", counter_dtype(universe.config))
+            except (ImportError, OSError, RuntimeError, AttributeError, TypeError):
+                engine = None
+        planes = None
+        if engine is not None:
+            planes = tuple(
+                np.asarray(x)
+                for x in (self.clock, self.dots, self.d_clocks)
+            )
+            if planes[0].dtype.itemsize == 8 and any(
+                int(p.max(initial=0)) >= 1 << 63 for p in planes
+            ):
+                # zigzag of a >=2^63 counter exceeds u64; to_binary's
+                # big-int varints handle it — take the Python path
+                engine = None
+        if engine is None:
+            return [to_binary(s) for s in self.to_scalar(universe)]
+        buf, offsets = engine.orswot_encode_wire(
+            planes[0], np.asarray(self.ids), planes[1],
+            np.asarray(self.d_ids), planes[2],
+        )
+        mv = memoryview(buf)
+        off = offsets.tolist()
+        # slice the concatenated buffer through a memoryview: one copy
+        # per blob, no whole-buffer intermediate
+        return [bytes(mv[off[i]:off[i + 1]]) for i in range(n)]
+
     @classmethod
     def from_coo(
         cls, n: int, universe: Universe, *,

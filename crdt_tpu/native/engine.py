@@ -637,3 +637,34 @@ def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype):
         _ptr(status),
     )
     return clock, ids, dots, d_ids, d_clocks, status
+
+
+def orswot_encode_wire(clock, ids, dots, d_ids, d_clocks):
+    """Parallel wire-format ENCODE of dense planes into serde blobs —
+    the inverse of :func:`orswot_ingest_wire`, byte-identical to
+    ``to_binary`` of the per-object scalar states (identity universes).
+
+    Returns ``(buf, offsets)``: concatenated blobs + int64[n+1]
+    boundaries (blob i is ``buf[offsets[i]:offsets[i+1]]``)."""
+    clock, ids, dots, d_ids, d_clocks = _contig(
+        clock, ids, dots, d_ids, d_clocks
+    )
+    dt = _check_counters(clock, dots, d_clocks)
+    n, a = clock.shape
+    m = ids.shape[-1]
+    d = d_ids.shape[-1]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("orswot_encode_wire", dt)
+    fn(
+        _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(m),
+        ctypes.c_int64(d), _ptr(offsets), None,
+    )
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(
+        _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
+        ctypes.c_int64(n), ctypes.c_int64(a), ctypes.c_int64(m),
+        ctypes.c_int64(d), _ptr(offsets), _ptr(buf),
+    )
+    return buf, offsets

@@ -40,7 +40,9 @@
 // Each object writes only its own rows, so the object loop is
 // embarrassingly parallel (OpenMP).
 
+#include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <vector>
 
@@ -203,7 +205,287 @@ int64_t ingest_impl(const uint8_t* buf, const int64_t* offsets, int64_t n,
   return bad;
 }
 
+// ---- bulk wire EGRESS: dense planes -> serde blobs -------------------------
+//
+// The inverse direction, byte-identical to
+// `to_binary(batch.to_scalar(uni)[i])` for identity universes.  Three
+// distinct deterministic orderings must be reproduced exactly
+// (serde.py):
+//   * pair/item lists sort by the ENCODED BYTES of the key
+//     (enc_pairs_sorted / enc_items_sorted — python bytes comparison:
+//     lexicographic, shorter-prefix-first),
+//   * ClockKey tuples (deferred keys) sort their (actor, counter) pairs
+//     by repr(actor) — DECIMAL-STRING order for ints (vclock.py key()),
+//   * deferred GROUPS sort by the encoded bytes of the whole clock-key
+//     tuple.
+
+struct Emitter {
+  uint8_t* p;      // nullptr = counting pass
+  int64_t count = 0;
+
+  void byte(uint8_t b) {
+    if (p) *p++ = b;
+    ++count;
+  }
+
+  void uv(uint64_t v) {
+    while (true) {
+      uint8_t b = v & 0x7F;
+      v >>= 7;
+      if (v) {
+        byte(b | 0x80);
+      } else {
+        byte(b);
+        return;
+      }
+    }
+  }
+
+  void tagged_nonneg(uint64_t v) {  // 0x03 + zigzag varint
+    byte(kTagInt);
+    uv(v << 1);
+  }
+};
+
+inline int write_varint(uint64_t v, uint8_t* out) {
+  int n = 0;
+  while (true) {
+    uint8_t b = v & 0x7F;
+    v >>= 7;
+    if (v) {
+      out[n++] = b | 0x80;
+    } else {
+      out[n++] = b;
+      return n;
+    }
+  }
+}
+
+// python-bytes comparison of two encoded varints (zigzagged values):
+// lexicographic, shorter-prefix-first
+inline bool varint_bytes_less(uint64_t za, uint64_t zb) {
+  uint8_t a[10], b[10];
+  int la = write_varint(za, a), lb = write_varint(zb, b);
+  int m = la < lb ? la : lb;
+  int c = std::memcmp(a, b, static_cast<size_t>(m));
+  if (c) return c < 0;
+  return la < lb;
+}
+
+// repr-string (decimal) comparison of two non-negative ints —
+// vclock.py's ClockKey pair order
+inline bool decimal_repr_less(uint64_t a, uint64_t b) {
+  char sa[24], sb[24];
+  int la = std::snprintf(sa, sizeof(sa), "%llu",
+                         static_cast<unsigned long long>(a));
+  int lb = std::snprintf(sb, sizeof(sb), "%llu",
+                         static_cast<unsigned long long>(b));
+  int m = la < lb ? la : lb;
+  int c = std::memcmp(sa, sb, static_cast<size_t>(m));
+  if (c) return c < 0;
+  return la < lb;
+}
+
+// emit one vclock BODY (uv n + sorted pairs) from a dense counter row.
+// ``sorted=false`` skips the order work — the SIZE of the body is
+// order-invariant, so the counting pass never pays for sorts.
+template <typename C>
+void emit_clock_body(Emitter& e, const C* row, int64_t A,
+                     std::vector<int64_t>& idx, bool sorted = true) {
+  idx.clear();
+  for (int64_t a = 0; a < A; ++a)
+    if (row[a]) idx.push_back(a);
+  // keys are 0x03 + varint(2a): shared tag, so encoded-bytes order is
+  // the varint-bytes order of 2a
+  if (sorted)
+    std::sort(idx.begin(), idx.end(), [](int64_t x, int64_t y) {
+      return varint_bytes_less(static_cast<uint64_t>(x) << 1,
+                               static_cast<uint64_t>(y) << 1);
+    });
+  e.uv(static_cast<uint64_t>(idx.size()));
+  for (int64_t a : idx) {
+    e.tagged_nonneg(static_cast<uint64_t>(a));
+    e.tagged_nonneg(static_cast<uint64_t>(row[a]));
+  }
+}
+
+// the encoded clock-KEY tuple for a deferred group (0x08 uv k + pairs
+// as 2-tuples, pair order = decimal repr of the actor)
+template <typename C>
+void emit_clock_key(Emitter& e, const C* row, int64_t A,
+                    std::vector<int64_t>& idx, bool sorted = true) {
+  idx.clear();
+  for (int64_t a = 0; a < A; ++a)
+    if (row[a]) idx.push_back(a);
+  if (sorted)
+    std::sort(idx.begin(), idx.end(), [](int64_t x, int64_t y) {
+      return decimal_repr_less(static_cast<uint64_t>(x),
+                               static_cast<uint64_t>(y));
+    });
+  e.byte(kTagTuple);
+  e.uv(static_cast<uint64_t>(idx.size()));
+  for (int64_t a : idx) {
+    e.byte(kTagTuple);
+    e.uv(2);
+    e.tagged_nonneg(static_cast<uint64_t>(a));
+    e.tagged_nonneg(static_cast<uint64_t>(row[a]));
+  }
+}
+
+template <typename C>
+int64_t encode_one(const C* clock, const int32_t* ids, const C* dots,
+                   const int32_t* d_ids, const C* d_clocks, int64_t A,
+                   int64_t M, int64_t D, uint8_t* out) {
+  // out == nullptr is the counting pass: every blob's SIZE is
+  // order-invariant, so the sorts (and group-key staging buffers) are
+  // skipped there — the write pass alone pays for ordering
+  const bool sizing = (out == nullptr);
+  Emitter e{out};
+  std::vector<int64_t> scratch;
+  e.byte(kTagOrswot);
+  emit_clock_body(e, clock, A, scratch, !sizing);
+
+  // entries: member keys sorted by encoded bytes (0x03 + varint(2m))
+  std::vector<int64_t> slots;
+  for (int64_t s = 0; s < M; ++s)
+    if (ids[s] != kEmpty) slots.push_back(s);
+  if (!sizing)
+    std::sort(slots.begin(), slots.end(), [&](int64_t x, int64_t y) {
+      return varint_bytes_less(
+          static_cast<uint64_t>(static_cast<uint32_t>(ids[x])) << 1,
+          static_cast<uint64_t>(static_cast<uint32_t>(ids[y])) << 1);
+    });
+  e.uv(static_cast<uint64_t>(slots.size()));
+  for (int64_t s : slots) {
+    e.tagged_nonneg(static_cast<uint64_t>(static_cast<uint32_t>(ids[s])));
+    e.byte(kTagVClock);
+    emit_clock_body(e, dots + s * A, A, scratch, !sizing);
+  }
+
+  // deferred: group live rows by identical clock rows; each group is
+  // (encoded clock key, sorted member blobs); groups sort by the
+  // encoded clock-key bytes.  D is small (a handful of rows), so the
+  // quadratic grouping is free.
+  std::vector<int64_t> rows;
+  for (int64_t r = 0; r < D; ++r)
+    if (d_ids[r] != kEmpty) rows.push_back(r);
+  std::vector<char> used(rows.size(), 0);
+  struct Group {
+    const C* crow;                   // the witnessing clock's dense row
+    std::vector<uint8_t> key;        // encoded clock-key tuple (write pass)
+    std::vector<int64_t> members;    // member values, deduped
+  };
+  std::vector<Group> groups;
+  for (size_t i = 0; i < rows.size(); ++i) {
+    if (used[i]) continue;
+    Group g;
+    g.crow = d_clocks + rows[i] * A;
+    g.members.push_back(d_ids[rows[i]]);
+    for (size_t j = i + 1; j < rows.size(); ++j) {
+      if (used[j]) continue;
+      const C* orow = d_clocks + rows[j] * A;
+      bool same = true;
+      for (int64_t a = 0; a < A; ++a)
+        if (g.crow[a] != orow[a]) {
+          same = false;
+          break;
+        }
+      if (same) {
+        used[j] = 1;
+        g.members.push_back(d_ids[rows[j]]);
+      }
+    }
+    // python set() deduplicates members buffered under one clock (dense
+    // rows never legitimately repeat a (clock, member) pair, but match
+    // to_binary on any input); dedup changes the SIZE, so both passes
+    // run it — the sort is its implementation, members lists are tiny
+    std::sort(g.members.begin(), g.members.end(), [](int64_t x, int64_t y) {
+      return varint_bytes_less(static_cast<uint64_t>(x) << 1,
+                               static_cast<uint64_t>(y) << 1);
+    });
+    g.members.erase(std::unique(g.members.begin(), g.members.end()),
+                    g.members.end());
+    if (!sizing) {
+      // stage the encoded clock key for the cross-group sort
+      Emitter cnt{nullptr};
+      emit_clock_key(cnt, g.crow, A, scratch);
+      g.key.resize(static_cast<size_t>(cnt.count));
+      Emitter w{g.key.data()};
+      emit_clock_key(w, g.crow, A, scratch);
+    }
+    groups.push_back(std::move(g));
+  }
+  if (!sizing)
+    std::sort(groups.begin(), groups.end(),
+              [](const Group& x, const Group& y) {
+                size_t m = x.key.size() < y.key.size() ? x.key.size()
+                                                       : y.key.size();
+                int c = std::memcmp(x.key.data(), y.key.data(), m);
+                if (c) return c < 0;
+                return x.key.size() < y.key.size();
+              });
+  e.uv(static_cast<uint64_t>(groups.size()));
+  for (const Group& g : groups) {
+    if (sizing) {
+      emit_clock_key(e, g.crow, A, scratch, false);
+    } else {
+      for (uint8_t b : g.key) e.byte(b);
+    }
+    e.uv(static_cast<uint64_t>(g.members.size()));
+    for (int64_t m : g.members)
+      e.tagged_nonneg(static_cast<uint64_t>(static_cast<uint32_t>(m)));
+  }
+  return e.count;
+}
+
+template <typename C>
+void encode_impl(const C* clock, const int32_t* ids, const C* dots,
+                 const int32_t* d_ids, const C* d_clocks, int64_t n,
+                 int64_t A, int64_t M, int64_t D, int64_t* offsets,
+                 uint8_t* buf) {
+  if (buf == nullptr) {
+    // pass 1: per-object sizes into offsets[1..n] (caller prefix-sums)
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024)
+#endif
+    for (int64_t i = 0; i < n; ++i)
+      offsets[i + 1] = encode_one<C>(clock + i * A, ids + i * M,
+                                     dots + i * M * A, d_ids + i * D,
+                                     d_clocks + i * D * A, A, M, D, nullptr);
+    return;
+  }
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024)
+#endif
+  for (int64_t i = 0; i < n; ++i)
+    encode_one<C>(clock + i * A, ids + i * M, dots + i * M * A,
+                  d_ids + i * D, d_clocks + i * D * A, A, M, D,
+                  buf + offsets[i]);
+}
+
 }  // namespace
+
+extern "C" {
+
+void orswot_encode_wire_u32(const uint32_t* clock, const int32_t* ids,
+                            const uint32_t* dots, const int32_t* d_ids,
+                            const uint32_t* d_clocks, int64_t n, int64_t A,
+                            int64_t M, int64_t D, int64_t* offsets,
+                            uint8_t* buf) {
+  encode_impl<uint32_t>(clock, ids, dots, d_ids, d_clocks, n, A, M, D,
+                        offsets, buf);
+}
+
+void orswot_encode_wire_u64(const uint64_t* clock, const int32_t* ids,
+                            const uint64_t* dots, const int32_t* d_ids,
+                            const uint64_t* d_clocks, int64_t n, int64_t A,
+                            int64_t M, int64_t D, int64_t* offsets,
+                            uint8_t* buf) {
+  encode_impl<uint64_t>(clock, ids, dots, d_ids, d_clocks, n, A, M, D,
+                        offsets, buf);
+}
+
+}  // extern "C"
 
 extern "C" {
 

@@ -173,6 +173,79 @@ def test_from_wire_actor_out_of_range_raises():
         OrswotBatch.from_wire([to_binary(s)], uni)
 
 
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_to_wire_matches_python_encode(counter_bits):
+    """Bulk egress parity: to_wire must be BYTE-identical to to_binary of
+    the per-object scalars — including the codec's deterministic
+    orderings (encoded-bytes pair sort, repr-sorted clock keys)."""
+    rng = np.random.RandomState(53)
+    uni = _identity_uni(counter_bits=counter_bits)
+    states = _random_states(rng, 48)
+    batch = OrswotBatch.from_scalar(states, uni)
+    got = batch.to_wire(uni)
+    want = [to_binary(s) for s in batch.to_scalar(uni)]
+    assert got == want
+
+
+def test_to_wire_ordering_edge_cases():
+    """The three orderings diverge exactly where this state puts them:
+    members {100, 8192} sort 8192-first under encoded-bytes order
+    (varint [0x80,0x80,0x01] < [0xC8,0x01]) though 100 < 8192 numerically;
+    deferred clock keys sort pairs by repr, so actors {2, 10} order
+    10-first ("10" < "2")."""
+    from crdt_tpu.scalar.vclock import VClock
+
+    uni = _identity_uni(num_actors=16, member_capacity=8,
+                        deferred_capacity=4)
+    s = Orswot()
+    for member in (100, 8192, 63, 64):
+        s.apply(s.add(member, s.value().derive_add_ctx(2)))
+    # deferred remove witnessed by a clock over actors {2, 10}
+    ctx = s.contains(100).derive_rm_ctx()
+    ctx.clock.witness(10, 500)
+    ctx.clock.witness(2, 400)
+    s.apply(s.remove(100, ctx))
+    # second member buffered under the SAME clock (grouping leg)
+    ctx2 = s.contains(8192).derive_rm_ctx()
+    ctx2.clock = VClock({2: 400, 10: 500})
+    s.apply(s.remove(8192, ctx2))
+
+    batch = OrswotBatch.from_scalar([s], uni)
+    got = batch.to_wire(uni)
+    want = [to_binary(x) for x in batch.to_scalar(uni)]
+    assert got == want
+    # and the round trip re-ingests to the same state
+    assert OrswotBatch.from_wire(got, uni).to_scalar(uni) == batch.to_scalar(uni)
+
+
+def test_to_wire_u64_high_counter_falls_back():
+    """u64 counters >= 2^63 exceed the native encoder's zigzag range; the
+    Python path must take over with identical bytes."""
+    from crdt_tpu.scalar.vclock import VClock
+
+    uni = _identity_uni(counter_bits=64)
+    s = Orswot()
+    s.clock = VClock({1: 2**63 + 9})
+    s.entries[5] = VClock({1: 2**63 + 9})
+    batch = OrswotBatch.from_scalar([s], uni)
+    got = batch.to_wire(uni)
+    assert got == [to_binary(x) for x in batch.to_scalar(uni)]
+    assert from_binary(got[0]).clock.dots[1] == 2**63 + 9
+
+
+def test_wire_roundtrip_fuzz():
+    """from_wire(to_wire(batch)) is the identity on scalar states across
+    random deferred-bearing fleets, both widths."""
+    rng = np.random.RandomState(59)
+    for bits in (32, 64):
+        uni = _identity_uni(counter_bits=bits)
+        states = _random_states(rng, 40)
+        batch = OrswotBatch.from_scalar(states, uni)
+        blobs = batch.to_wire(uni)
+        back = OrswotBatch.from_wire(blobs, uni)
+        assert back.to_scalar(uni) == batch.to_scalar(uni)
+
+
 def test_identity_universe_checkpoint_roundtrip():
     """Identity universes survive checkpoint save/load as identity (a
     value-list restore would rebuild a dict registry whose lookups fail

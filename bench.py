@@ -1098,7 +1098,9 @@ def bench_bulk_ingest():
             )
             probe_states.append(s)
         pb = [to_binary(s) for s in probe_states]
-        wq = OrswotBatch.from_wire(pb, iuni)
+        # host route for the parity gate: exact-plane comparison needs
+        # the wire slot order (the device route canonicalizes slots)
+        wq = OrswotBatch.from_wire(pb, iuni, via_device=False)
         wr = OrswotBatch.from_scalar([from_binary(x) for x in pb], iuni)
         for name, x, y in (("clock", wq.clock, wr.clock),
                            ("ids", wq.ids, wr.ids), ("dots", wq.dots, wr.dots)):

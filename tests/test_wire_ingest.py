@@ -49,7 +49,11 @@ def test_from_wire_matches_python_decode(counter_bits):
     states = _random_states(rng, 64)
     blobs = [to_binary(s) for s in states]
 
-    got = OrswotBatch.from_wire(blobs, uni)
+    # via_device=False: the host route preserves wire slot order, which
+    # is what makes exact-plane comparison against from_scalar possible
+    # (the device route canonicalizes slots to ascending id — covered by
+    # test_from_wire_via_device_route_matches_host_route)
+    got = OrswotBatch.from_wire(blobs, uni, via_device=False)
     want = OrswotBatch.from_scalar([from_binary(b) for b in blobs], uni)
 
     # set clock / member tables are deterministic (wire order == decode
@@ -231,6 +235,22 @@ def test_to_wire_u64_high_counter_falls_back():
     got = batch.to_wire(uni)
     assert got == [to_binary(x) for x in batch.to_scalar(uni)]
     assert from_binary(got[0]).clock.dots[1] == 2**63 + 9
+
+
+def test_from_wire_via_device_route_matches_host_route():
+    """``via_device=True`` routes the parsed state through COO columns +
+    the device-side expand (dense planes never transit the tunnel on a
+    real accelerator); the result must be semantically identical to the
+    host route — member slots canonicalize to ascending-id order."""
+    rng = np.random.RandomState(61)
+    uni = _identity_uni()
+    states = _random_states(rng, 24)
+    blobs = [to_binary(s) for s in states]
+    host = OrswotBatch.from_wire(blobs, uni, via_device=False)
+    dev = OrswotBatch.from_wire(blobs, uni, via_device=True)
+    assert dev.to_scalar(uni) == host.to_scalar(uni) == states
+    # and the wire bytes agree too (to_binary is canonical)
+    assert dev.to_wire(uni) == host.to_wire(uni)
 
 
 def test_wire_roundtrip_fuzz():

@@ -227,6 +227,42 @@ def step6_elastic_regrowth():
           "survived")
 
 
+def step7_bulk_wire_loop():
+    """State-based replication at fleet scale, zero Python objects in the
+    hot path: wire blobs (`to_binary` payloads) decode straight into
+    dense planes through the native parallel codec, merge on device, and
+    encode back to blobs byte-identical to `to_binary` — ~1M+ objects/s
+    each way vs ~170k/~50k for the per-object walk (`PERF.md`).  Needs an
+    identity universe: int actors/members map to themselves, so there is
+    no host-side interning state at all."""
+    rng = np.random.RandomState(7)
+    uni = Universe.identity(CrdtConfig.tpu_default(
+        num_actors=8, member_capacity=8, deferred_capacity=4,
+    ))
+    n = 2000
+    # replica A's fleet arrives as wire blobs (as if from the network)
+    incoming = []
+    for i in range(n):
+        s = Orswot()
+        for j in range(int(rng.randint(1, 4))):
+            s.apply(s.add(int(rng.randint(0, 100)),
+                          s.value().derive_add_ctx(j % 4)))
+        incoming.append(to_binary(s))
+
+    local = OrswotBatch.from_wire(incoming, uni)     # native parallel decode
+    mine = OrswotBatch.zeros(n, uni)                 # this node starts empty
+    merged = local.merge(mine, impl=uni.config.merge_impl)
+    outgoing = merged.to_wire(uni)                   # native parallel encode
+    # byte-faithful means byte-faithful: what we ship IS what to_binary
+    # would have produced for the merged scalars
+    assert outgoing[:64] == [to_binary(s) for s in merged.to_scalar(uni)[:64]]
+    # and a plain-Python peer decodes it
+    peer = from_binary(outgoing[0])
+    assert peer.value().val == from_binary(incoming[0]).value().val
+    print(f"7. bulk wire loop: {n} blobs in -> device merge -> {n} blobs "
+          "out, byte-identical to the scalar codec")
+
+
 def main():
     replicas = step1_op_replication()
     step2_deferred_remove(replicas)
@@ -234,6 +270,7 @@ def main():
     step4_collective_join(uni, fleets, sets)
     step5_typed_collective_joins()
     step6_elastic_regrowth()
+    step7_bulk_wire_loop()
     print("anti-entropy walkthrough: OK")
 
 

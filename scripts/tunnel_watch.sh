@@ -2,10 +2,14 @@
 # Poll the axon tunnel; whenever it is alive, run every capture step that
 # has not yet succeeded (marker files under /tmp/tw_done.<rev>), until all
 # have.  A window that closes mid-capture just means the remaining steps
-# retry on the next window.  Order matters: everything that needs the
-# tunnel's remote-compile helper runs BEFORE the compiled-Pallas attempt —
-# a Mosaic crash has been observed to take the compile helper down with it
-# (reports/TPU_LATENCY.md).
+# retry on the next window.  ROUND-4 ORDER: headline first — the
+# AOT-bridge loads (incl. the compiled-Pallas execution, which does NOT
+# use the remote-compile helper) run before bench/profile/experiments,
+# because the one capture this round needs is the bridge execution and a
+# ~35-min window must not be eaten by secondary evidence.  The
+# remote-compile Mosaic attempts stay DEAD LAST: helper-path Mosaic
+# crashes have wedged the device for a whole window
+# (reports/TPU_LATENCY.md, PALLAS_TPU_ATTEMPT.txt).
 #
 # Markers are keyed to a content hash of the measured code paths, so a
 # capture from an older build never satisfies a step after bench/kernel
@@ -74,39 +78,47 @@ for i in $(seq 1 600); do
     mkdir -p "$MARK"
     if timeout -k 15 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing (rev $REV)" | tee -a /tmp/tunnel_watch.log
-        step profile 2400 /tmp/profile_tpu.log \
-            python scripts/profile_stages.py
-        # AOT-bridge probe EARLY and CHEAP: can locally-compiled
-        # executables be deserialized into the axon client at all?
-        # (scripts/aot_exec_bridge.py — bypasses the remote-compile
-        # helper's size limits).  tiny + merge4 only; the big loads run
-        # after the bench so an unknown plugin code path cannot cost the
-        # jnp captures.  A completed attempt exits 0 (conclusive, marker
-        # stamps) whatever the verdict; the big loads are gated on the
-        # bridge's probe_ok file, written only on a fully-green tiny
-        # load.
+        # ROUND-4 ORDER: headline first.  Round 3's jnp window numbers
+        # are already banked; the ONE capture this round needs is the
+        # compiled-Pallas bridge execution (VERDICT r3 item 1), so the
+        # bridge steps run before anything that could eat a ~35-min
+        # window (profile/experiments burned 2400+5000s up front in the
+        # old order).  Risk accepted: a Mosaic-execution crash early in
+        # the window can cost the later jnp captures — the banked r03
+        # evidence plus the headline upside dominate.
+        #
+        # 1) deserialize-path probe, cheap (tiny + merge4); probe_ok
+        #    gates the big loads, written only on a fully-green tiny load
         if [ -e /tmp/aot_exec/tiny.pkl ]; then
             step aot_probe 600 /tmp/aot_probe_tpu.log bash -c \
                 "python scripts/aot_exec_bridge.py load tiny && \
                  { [ ! -e /tmp/aot_exec/merge4.pkl ] || \
                    python scripts/aot_exec_bridge.py load merge4; }"
         fi
-        # the 7-mode layout A/B concluded in the 2026-07-31 window
-        # (reports/LAYOUT_AB_TPU.md — unrolled default, lanes deleted);
-        # re-running the full suite would burn ~90 min of a window, so
-        # only the still-undecided fold-shape contenders stay (outer
-        # timeout covers all three inner 1500s mode timeouts)
-        step experiments 5000 /tmp/experiments_tpu.log \
-            env CRDT_EXP_MODES=fold_seq,fold_tree,fold_seq_rank \
-            python scripts/tpu_experiments.py
-        # publish only when this iteration actually ran the bench (marker
-        # absent before the call) — a marker short-circuit must not
-        # re-stamp the artifact's capture time
-        # PROBE_TIMEOUT back at the old 900s ladder inside a window: the
-        # watcher's aliveness gate only proved jax.devices(), but the
-        # bench probe also needs a tiny dispatch — on a live-but-slow
-        # window the new 120s default could misclassify the backend as
-        # wedged and burn the whole window on a CPU fallback
+        # 2) THE HEADLINE: compiled-Mosaic execution via the bridge —
+        #    first-ever compiled-Pallas run; publish its verdict at once
+        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ]; then
+            # TPU_* come from the ${VAR:-default} exports above — an
+            # operator override applies to every step uniformly
+            step aot_pallas_scan 2400 /tmp/aot_pallas_scan_tpu.log \
+                python scripts/aot_exec_bridge.py load pallas_scan_ns
+            timeout -k 15 120 python scripts/publish_bridge_capture.py \
+                >> /tmp/tunnel_watch.log 2>&1 || true
+        fi
+        # 3) the jnp north-star scan via the bridge (the program the
+        #    remote-compile helper 500s on; no Mosaic inside)
+        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/scan_ns.pkl ]; then
+            step aot_scan 2400 /tmp/aot_scan_tpu.log \
+                python scripts/aot_exec_bridge.py load scan_ns
+            timeout -k 15 120 python scripts/publish_bridge_capture.py \
+                >> /tmp/tunnel_watch.log 2>&1 || true
+        fi
+        # 4) the full bench (seeds from whatever the bridge just banked;
+        #    publish only when this iteration actually ran it — a marker
+        #    short-circuit must not re-stamp the artifact's capture time).
+        #    PROBE_TIMEOUT at the old 900s ladder: the aliveness gate only
+        #    proved jax.devices(); a live-but-slow window must not be
+        #    misclassified as wedged by the 120s default.
         if [ ! -e "$MARK/bench" ] && step bench 4500 /tmp/bench_tpu3.log \
             env CRDT_SKIP_TPU_VALIDATE=1 CRDT_BENCH_BUDGET_S=4200 \
             CRDT_BENCH_PROBE_TIMEOUT=900 \
@@ -115,48 +127,33 @@ for i in $(seq 1 600); do
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \
             python scripts/tpu_validate.py --merge
-        # distill the captures into a committable decision report (the
-        # driver commits uncommitted files at round end, so the analysis
-        # survives even if no builder session sees this window).  Only
-        # logs whose marker exists for THIS rev are fed in — a stale
-        # /tmp bench log from an older build must not color the verdict.
+        # 5) secondary evidence, after everything headline-bearing
+        step profile 2400 /tmp/profile_tpu.log \
+            python scripts/profile_stages.py
+        # the 7-mode layout A/B concluded in the 2026-07-31 window
+        # (reports/LAYOUT_AB_TPU.md); only the still-undecided fold-shape
+        # contenders remain
+        step experiments 5000 /tmp/experiments_tpu.log \
+            env CRDT_EXP_MODES=fold_seq,fold_tree,fold_seq_rank \
+            python scripts/tpu_experiments.py
         if [ -e "$MARK/experiments" ]; then
             BLOG=/dev/null
             [ -e "$MARK/bench" ] && BLOG=/tmp/bench_tpu3.log
             python scripts/layout_decision.py /tmp/experiments_tpu.log \
                 "$BLOG" >> /tmp/tunnel_watch.log 2>&1 || true
         fi
-        # the big jnp AOT-bridge load after the jnp captures are banked:
-        # scan_ns is the program the helper 500s on.  No Mosaic inside —
-        # safe before the Pallas block.  Only attempted if the cheap
-        # probe proved the deserialize path works.
-        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/scan_ns.pkl ]; then
-            step aot_scan 2400 /tmp/aot_scan_tpu.log \
-                python scripts/aot_exec_bridge.py load scan_ns
-        fi
-        # Compiled-Pallas attempts LAST: a Mosaic crash can wedge the
-        # remote compile helper / device for the rest of the window.
-        # Workaround env from the captured failure log
-        # (PALLAS_TPU_ATTEMPT.txt:12-14).
+        # 6) remote-compile Mosaic attempts DEAD LAST: these go through
+        #    the compile helper, whose Mosaic crashes have wedged the
+        #    device for a whole window (PALLAS_TPU_ATTEMPT.txt:12-14)
         step pallas 1800 /tmp/pallas_tpu.log \
             env TPU_ACCELERATOR_TYPE=v5litepod-1 TPU_WORKER_HOSTNAMES=localhost \
             python scripts/tpu_validate.py --pallas
-        # pairwise compiled-Mosaic contender, also crash-risky
         step experiments_pallas 1800 /tmp/experiments_pallas_tpu.log \
             env CRDT_EXP_MODES=merge_pallas \
             python scripts/tpu_experiments.py
-        # compiled-Mosaic EXECUTION via the AOT bridge — the headline
-        # candidate but also the least-known plugin code path: very last
-        # so a crash cannot cost any other capture this window.
-        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ]; then
-            step aot_pallas_scan 2400 /tmp/aot_pallas_scan_tpu.log \
-                python scripts/aot_exec_bridge.py load pallas_scan_ns
-        fi
-        # fold any green bridge verdicts into BENCH_tpu_window.json NOW —
-        # the bench that would promote them ran earlier in this window,
-        # and the next window may never come (idempotent, headline can
-        # only go up; bench.py's banked-seed path then carries it into
-        # the driver artifact)
+        # final sweep: fold any green bridge verdicts into
+        # BENCH_tpu_window.json (idempotent, headline can only go up;
+        # bench.py's banked-seed path carries it into the driver artifact)
         timeout -k 15 120 python scripts/publish_bridge_capture.py \
             >> /tmp/tunnel_watch.log 2>&1 || true
         # done only when every step whose precondition exists has its

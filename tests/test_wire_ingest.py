@@ -10,6 +10,8 @@ integer-keyed grammar falls back to the Python decoder per blob.
 
 import numpy as np
 import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
 
 from crdt_tpu import Orswot, from_binary, to_binary
 from crdt_tpu.batch import OrswotBatch
@@ -264,6 +266,64 @@ def test_wire_roundtrip_fuzz():
         blobs = batch.to_wire(uni)
         back = OrswotBatch.from_wire(blobs, uni)
         assert back.to_scalar(uni) == batch.to_scalar(uni)
+
+
+@given(
+    seed=st.integers(0, 999),
+    pos=st.integers(0, 4096),
+    byte=st.integers(0, 255),
+    mode=st.sampled_from(["flip", "insert", "delete", "truncate"]),
+)
+def test_wire_parser_total_on_mutated_blobs(seed, pos, byte, mode):
+    """The C parser consumes UNTRUSTED replication bytes: any mutation of
+    a valid blob must either ingest to exactly what the documented
+    contract produces — ``from_scalar([from_binary(blob)])``, i.e. the
+    Python decode THROUGH the dense engine (which canonicalizes
+    adversarial-only structures like duplicate-actor clock keys the same
+    last-wins way) — or surface as the codec's contract exceptions.
+    Never crash, never silently diverge from the Python pipeline."""
+    rng = np.random.RandomState(seed)
+    uni = _identity_uni()
+    s = _random_states(rng, 1)[0]
+    data = bytearray(to_binary(s))
+    if mode == "insert":
+        # pos == len(data) appends TRAILING garbage — the framing case
+        # (parser must demand consumed == blob length, not stop early)
+        pos %= len(data) + 1
+        data.insert(pos, byte)
+    else:
+        pos %= max(1, len(data))
+        if mode == "flip":
+            data[pos] = byte
+        elif mode == "delete":
+            del data[pos]
+        else:
+            data = data[:pos]
+    blob = bytes(data)
+
+    try:
+        want = OrswotBatch.from_scalar(
+            [from_binary(blob)], uni
+        ).to_scalar(uni)
+    except Exception:
+        want = None  # the python pipeline rejects it; from_wire must too
+    try:
+        got = OrswotBatch.from_wire([blob], uni, via_device=False)
+    except (ValueError, OverflowError):
+        # BOTH directions must agree: from_wire's non-fast-path blobs go
+        # through the python pipeline itself, and its hard errors
+        # (capacity/actor range) are the same checks from_scalar makes —
+        # so a clean rejection here implies the python pipeline rejected
+        # the blob too
+        assert want is None, (
+            "from_wire rejected a blob the python pipeline accepts"
+        )
+        return
+    # ingest succeeded: the python pipeline must agree on the state
+    assert want is not None, (
+        "from_wire accepted a blob the python pipeline rejects"
+    )
+    assert got.to_scalar(uni) == want
 
 
 def test_identity_universe_checkpoint_roundtrip():

@@ -42,6 +42,78 @@ class LWWRegBatch:
         markers = np.asarray([s.marker for s in states], dtype=dt)
         return cls(vals=jnp.asarray(vals), markers=jnp.asarray(markers))
 
+    @classmethod
+    @gc_paused
+    def from_wire(
+        cls, blobs: Sequence[bytes], universe: Universe,
+    ) -> "LWWRegBatch":
+        """Bulk ingest from wire blobs (``to_binary(lwwreg)`` payloads) —
+        the LWW leg of the native bulk path (contract as in
+        :meth:`OrswotBatch.from_wire`: identity universe + native engine,
+        Python fallback per non-conforming blob, always equal to
+        ``from_scalar([from_binary(b) for b in blobs], uni)``)."""
+        import numpy as np
+
+        from ..utils.serde import from_binary
+        from .wirebulk import concat_blobs, probe_engine
+
+        n = len(blobs)
+        if n == 0:
+            return cls(
+                vals=jnp.zeros(0, dtype=counter_dtype()),
+                markers=jnp.zeros(0, dtype=counter_dtype()),
+            )
+        engine = probe_engine(universe, "lww_ingest_wire", np.uint64)
+        if np.dtype(counter_dtype()) != np.uint64:
+            # CRDT_TPU_NO_X64 narrows the marker planes to uint32; the C
+            # codec is u64-only and jnp.asarray would silently truncate
+            # markers the Python path rejects with OverflowError — take
+            # the Python path so the contract (exact from_scalar
+            # equality) holds in that mode too
+            engine = None
+        if engine is None:
+            return cls.from_scalar([from_binary(b) for b in blobs], universe)
+        buf, offsets = concat_blobs(blobs)
+        vals, markers, status = engine.lww_ingest_wire(buf, offsets)
+        if status.any():
+            fb = np.nonzero(status)[0].tolist()
+            sub = cls.from_scalar(
+                [from_binary(blobs[i]) for i in fb], universe
+            )
+            idx = np.asarray(fb, dtype=np.int64)
+            vals[idx] = np.asarray(sub.vals)
+            markers[idx] = np.asarray(sub.markers)
+        return cls(vals=jnp.asarray(vals), markers=jnp.asarray(markers))
+
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs, byte-identical to
+        ``[to_binary(s) for s in self.to_scalar(uni)]``.  Values or
+        markers at or above 2^63 and non-identity universes take the
+        Python path (the codec's zigzag covers them as big ints)."""
+        import numpy as np
+
+        from ..utils.serde import to_binary
+        from .wirebulk import probe_engine, slice_blobs
+
+        if self.vals.shape[0] == 0:
+            return []
+        engine = probe_engine(universe, "lww_encode_wire", np.uint64)
+        planes = None
+        if engine is not None:
+            planes = (np.asarray(self.vals), np.asarray(self.markers))
+            if any(
+                p.dtype != np.uint64 or int(p.max(initial=0)) >= 1 << 63
+                for p in planes
+            ):
+                # non-u64 planes (CRDT_TPU_NO_X64) would be reinterpreted
+                # by the u64-only C encoder; >=2^63 exceeds its zigzag
+                engine = None
+        if engine is None:
+            return [to_binary(s) for s in self.to_scalar(universe)]
+        buf, offsets = engine.lww_encode_wire(*planes)
+        return slice_blobs(buf, offsets)
+
     @gc_paused
     def to_scalar(self, universe: Universe) -> list[LWWReg]:
         import numpy as np

@@ -56,6 +56,85 @@ class MVRegBatch:
                 vals[i, j] = universe.member_id(val)
         return cls(clocks=jnp.asarray(clocks), vals=jnp.asarray(vals))
 
+    @classmethod
+    @gc_paused
+    def from_wire(
+        cls, blobs: Sequence[bytes], universe: Universe,
+    ) -> "MVRegBatch":
+        """Bulk ingest from wire blobs (``to_binary(mvreg)`` payloads) —
+        the MVReg leg of the native bulk path (see
+        :meth:`OrswotBatch.from_wire` for the contract: identity
+        universe + native engine parse in parallel; anything outside the
+        integer-keyed grammar falls back to the Python decoder per blob,
+        so ``from_wire(blobs, uni)`` always equals
+        ``from_scalar([from_binary(b) for b in blobs], uni)``)."""
+        import numpy as np
+
+        from ..utils.serde import from_binary
+        from .wirebulk import concat_blobs, probe_engine
+
+        cfg = universe.config
+        n = len(blobs)
+        if n == 0:
+            return cls.zeros(0, universe)
+        engine = probe_engine(universe, "mvreg_ingest_wire", counter_dtype(cfg))
+        if engine is None:
+            return cls.from_scalar([from_binary(b) for b in blobs], universe)
+        buf, offsets = concat_blobs(blobs)
+        clocks, vals, status = engine.mvreg_ingest_wire(
+            buf, offsets, cfg.mv_capacity, cfg.num_actors, counter_dtype(cfg)
+        )
+        if status.any():
+            hard = np.nonzero(status > 1)[0]
+            if hard.size:
+                first = int(hard[0])
+                if int(status[first]) == 2:
+                    raise ValueError(
+                        f"register {first} has more values than mv_capacity "
+                        f"{cfg.mv_capacity}"
+                    )
+                raise ValueError(
+                    f"register {first}: actor outside the identity registry "
+                    f"range [0, {cfg.num_actors})"
+                )
+            fb = np.nonzero(status == 1)[0].tolist()
+            sub = cls.from_scalar(
+                [from_binary(blobs[i]) for i in fb], universe
+            )
+            idx = np.asarray(fb, dtype=np.int64)
+            clocks[idx] = np.asarray(sub.clocks)
+            vals[idx] = np.asarray(sub.vals)
+        return cls(clocks=jnp.asarray(clocks), vals=jnp.asarray(vals))
+
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs, byte-identical to
+        ``[to_binary(s) for s in self.to_scalar(uni)]`` (the codec's
+        sorted-pair-blob ordering is reproduced in C).  Counters/ids at
+        or above 2^63 (u64 planes) and non-identity universes take the
+        Python path."""
+        import numpy as np
+
+        from ..utils.serde import to_binary
+        from .wirebulk import probe_engine, slice_blobs
+
+        if self.clocks.shape[0] == 0:
+            return []
+        engine = probe_engine(
+            universe, "mvreg_encode_wire", counter_dtype(universe.config)
+        )
+        planes = None
+        if engine is not None:
+            planes = (np.asarray(self.clocks), np.asarray(self.vals))
+            if planes[0].dtype.itemsize == 8 and any(
+                int(p.max(initial=0)) >= 1 << 63 for p in planes
+            ):
+                engine = None
+        if engine is None:
+            return [to_binary(s) for s in self.to_scalar(universe)]
+        buf, offsets = engine.mvreg_encode_wire(*planes)
+        return slice_blobs(buf, offsets)
+
     @gc_paused
     def to_scalar(self, universe: Universe) -> list[MVReg]:
         import numpy as np

@@ -376,34 +376,21 @@ class OrswotBatch:
         import numpy as np
 
         from ..utils.serde import from_binary
+        from .wirebulk import concat_blobs, probe_engine
 
         n = len(blobs)
         cfg = universe.config
         if n == 0:
             return cls.zeros(0, universe)
-        engine = None
-        if universe.is_identity:
-            try:
-                from ..native import engine as engine  # noqa: F811
-
-                # probe the symbol too: an .so built from older sources
-                # loads fine but lacks the ingest entry point (loader
-                # staleness covers the normal case; this covers a .so
-                # shipped or built out-of-band)
-                engine._fn("orswot_ingest_wire", counter_dtype(cfg))
-            except (ImportError, OSError, RuntimeError, AttributeError):
-                engine = None
+        engine = probe_engine(
+            universe, "orswot_ingest_wire", counter_dtype(cfg)
+        )
         if engine is None:
             return cls.from_scalar(
                 [from_binary(b) for b in blobs], universe
             )
 
-        buf = b"".join(blobs)
-        offsets = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(
-            np.fromiter((len(b) for b in blobs), dtype=np.int64, count=n),
-            out=offsets[1:],
-        )
+        buf, offsets = concat_blobs(blobs)
         clock, ids, dots, d_ids, d_clocks, status = engine.orswot_ingest_wire(
             buf, offsets, cfg.num_actors, cfg.member_capacity,
             cfg.deferred_capacity, counter_dtype(cfg),
@@ -482,18 +469,14 @@ class OrswotBatch:
         import numpy as np
 
         from ..utils.serde import to_binary
+        from .wirebulk import probe_engine, slice_blobs
 
         n = self.clock.shape[0]
         if n == 0:
             return []
-        engine = None
-        if universe.is_identity:
-            try:
-                from ..native import engine as engine  # noqa: F811
-
-                engine._fn("orswot_encode_wire", counter_dtype(universe.config))
-            except (ImportError, OSError, RuntimeError, AttributeError, TypeError):
-                engine = None
+        engine = probe_engine(
+            universe, "orswot_encode_wire", counter_dtype(universe.config)
+        )
         planes = None
         if engine is not None:
             planes = tuple(
@@ -512,11 +495,7 @@ class OrswotBatch:
             planes[0], np.asarray(self.ids), planes[1],
             np.asarray(self.d_ids), planes[2],
         )
-        mv = memoryview(buf)
-        off = offsets.tolist()
-        # slice the concatenated buffer through a memoryview: one copy
-        # per blob, no whole-buffer intermediate
-        return [bytes(mv[off[i]:off[i + 1]]) for i in range(n)]
+        return slice_blobs(buf, offsets)
 
     @classmethod
     def from_coo(

@@ -1,0 +1,53 @@
+"""Shared scaffolding for the native bulk wire paths.
+
+Each batch type's ``from_wire``/``to_wire`` follows the same shape
+(`OrswotBatch.from_wire` is the reference implementation): probe the
+native engine + identity universe, concatenate blobs, parse in
+parallel, patch/raise per the status array, fall back to the Python
+codec whenever the fast path cannot apply.  This module holds the two
+pieces that are identical across types so they cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def probe_engine(universe, fn_name: str, dtype):
+    """The native engine module when the fast path applies, else None.
+
+    Applies = identity universe AND the .so loads AND it exports the
+    required symbol (an .so built from older sources loads fine but
+    lacks newer entry points)."""
+    if not universe.is_identity:
+        return None
+    try:
+        from ..native import engine
+
+        engine._fn(fn_name, dtype)
+        return engine
+    except (ImportError, OSError, RuntimeError, AttributeError, TypeError):
+        return None
+
+
+def concat_blobs(blobs: Sequence[bytes]):
+    """``(buf, offsets)`` for the C parsers: one contiguous buffer plus
+    int64[n+1] blob boundaries."""
+    import numpy as np
+
+    n = len(blobs)
+    buf = b"".join(blobs)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(
+        np.fromiter((len(b) for b in blobs), dtype=np.int64, count=n),
+        out=offsets[1:],
+    )
+    return buf, offsets
+
+
+def slice_blobs(buf, offsets) -> list[bytes]:
+    """Concatenated encoder output → per-object bytes (one copy per
+    blob via a memoryview, no whole-buffer intermediate)."""
+    mv = memoryview(buf)
+    off = offsets.tolist()
+    return [bytes(mv[off[i]:off[i + 1]]) for i in range(len(off) - 1)]

@@ -1338,7 +1338,7 @@ extern "C" {
 DEFINE_ALL(u32, uint32_t)
 DEFINE_ALL(u64, uint64_t)
 
-// v6: + orswot_ingest_wire_{u32,u64} (wire_ingest.cpp)
-int crdt_core_abi_version() { return 6; }
+// v7: + orswot wire codec, mvreg/lww wire codecs (wire_ingest.cpp)
+int crdt_core_abi_version() { return 7; }
 
 }  // extern "C"

@@ -668,3 +668,87 @@ def orswot_encode_wire(clock, ids, dots, d_ids, d_clocks):
         ctypes.c_int64(d), _ptr(offsets), _ptr(buf),
     )
     return buf, offsets
+
+
+def mvreg_ingest_wire(buf, offsets, k: int, a: int, dtype):
+    """Parallel MVReg wire decode (see :func:`orswot_ingest_wire` for the
+    buffer/status conventions).  Returns ``(clocks, vals, status)``."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    dt = np.dtype(dtype)
+    clocks = np.zeros((n, k, a), dtype=dt)
+    vals = np.zeros((n, k), dtype=dt)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("mvreg_ingest_wire", dt)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n),
+        ctypes.c_int64(k), ctypes.c_int64(a),
+        _ptr(clocks), _ptr(vals), _ptr(status),
+    )
+    return clocks, vals, status
+
+
+def mvreg_encode_wire(clocks, vals):
+    """Parallel MVReg wire encode — byte-identical to ``to_binary`` of
+    the scalars (identity universes).  Returns ``(buf, offsets)``."""
+    clocks, vals = _contig(clocks, vals)
+    dt = _check_counters(clocks, vals)
+    n, k, a = clocks.shape
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("mvreg_encode_wire", dt)
+    fn(
+        _ptr(clocks), _ptr(vals), ctypes.c_int64(n),
+        ctypes.c_int64(k), ctypes.c_int64(a), _ptr(offsets), None,
+    )
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(
+        _ptr(clocks), _ptr(vals), ctypes.c_int64(n),
+        ctypes.c_int64(k), ctypes.c_int64(a), _ptr(offsets), _ptr(buf),
+    )
+    return buf, offsets
+
+
+def lww_ingest_wire(buf, offsets):
+    """Parallel LWWReg wire decode.  Returns ``(vals, markers, status)``
+    (both u64 — markers are timestamps, `lwwreg.rs:16-24`; callers in a
+    narrower counter mode must use the Python path, see
+    LWWRegBatch.from_wire)."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    vals = np.zeros(n, dtype=np.uint64)
+    markers = np.zeros(n, dtype=np.uint64)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("lww_ingest_wire", np.uint64)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n),
+        _ptr(vals), _ptr(markers), _ptr(status),
+    )
+    return vals, markers, status
+
+
+def lww_encode_wire(vals, markers):
+    """Parallel LWWReg wire encode.  Returns ``(buf, offsets)``.
+
+    u64 planes only — the C symbol has no u32 instantiation (markers are
+    timestamps); narrower planes must take the Python path."""
+    vals, markers = _contig(vals, markers)
+    dt = _check_counters(vals, markers)
+    if dt != np.dtype(np.uint64):
+        raise TypeError(f"lww_encode_wire requires uint64 planes, got {dt}")
+    n = vals.shape[0]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("lww_encode_wire", np.uint64)
+    fn(
+        _ptr(vals), _ptr(markers), ctypes.c_int64(n), _ptr(offsets), None,
+    )
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(
+        _ptr(vals), _ptr(markers), ctypes.c_int64(n), _ptr(offsets), _ptr(buf),
+    )
+    return buf, offsets

@@ -55,6 +55,8 @@ namespace {
 constexpr uint8_t kTagInt = 0x03;
 constexpr uint8_t kTagTuple = 0x08;
 constexpr uint8_t kTagVClock = 0x20;
+constexpr uint8_t kTagLWW = 0x24;
+constexpr uint8_t kTagMVReg = 0x25;
 constexpr uint8_t kTagOrswot = 0x26;
 constexpr int32_t kEmpty = -1;
 
@@ -463,7 +465,231 @@ void encode_impl(const C* clock, const int32_t* ids, const C* dots,
                   buf + offsets[i]);
 }
 
+// ---- MVReg wire codec ------------------------------------------------------
+//
+// MVREG := 0x25 uv n, n * ( clock_body, 0x03 zz(val) )  — pair blobs
+// sorted by their full encoded bytes (serde.py MVReg branch); clock_body
+// pairs sorted by encoded key bytes.  Dense layout: clocks[K, A] +
+// vals[K], slot live iff clock non-empty.
+
+template <typename C>
+int parse_mvreg_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t K,
+                    int64_t A, C* clocks, C* vals) {
+  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(kTagMVReg)) return 1;
+  uint64_t n;
+  if (!c.uv(&n)) return 1;
+  if (n > static_cast<uint64_t>(K)) return 2;
+  for (uint64_t j = 0; j < n; ++j) {
+    uint64_t k;
+    if (!c.uv(&k)) return 1;
+    C* row = clocks + j * A;
+    for (uint64_t i = 0; i < k; ++i) {
+      uint64_t actor, counter;
+      if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
+      if (actor >= static_cast<uint64_t>(A)) return 4;
+      if (counter > kCounterMax) return 1;
+      row[actor] = static_cast<C>(counter);
+    }
+    uint64_t val;
+    if (!c.nonneg(&val)) return 1;
+    // payload ids live in the identity registry's int32 space AND the
+    // vals plane's counter dtype
+    if (val > 0x7FFFFFFFull || val > kCounterMax) return 1;
+    vals[j] = static_cast<C>(val);
+  }
+  if (c.p != c.end) return 1;
+  return 0;
+}
+
+template <typename C>
+int64_t mvreg_encode_one(const C* clocks, const C* vals, int64_t K,
+                         int64_t A, uint8_t* out) {
+  const bool sizing = (out == nullptr);
+  std::vector<int64_t> scratch;
+  // stage each live slot's pair blob (clock body + tagged val); the
+  // cross-slot sort is by full blob bytes, which only the write pass
+  // pays for (sizes are order-invariant)
+  std::vector<std::vector<uint8_t>> blobs;
+  int64_t blob_bytes = 0;
+  int64_t n_live = 0;
+  for (int64_t j = 0; j < K; ++j) {
+    const C* row = clocks + j * A;
+    bool live = false;
+    for (int64_t a = 0; a < A; ++a)
+      if (row[a]) {
+        live = true;
+        break;
+      }
+    if (!live) continue;
+    ++n_live;
+    Emitter cnt{nullptr};
+    emit_clock_body(cnt, row, A, scratch, false);
+    cnt.tagged_nonneg(static_cast<uint64_t>(vals[j]));
+    blob_bytes += cnt.count;
+    if (sizing) continue;
+    std::vector<uint8_t> b(static_cast<size_t>(cnt.count));
+    Emitter w{b.data()};
+    emit_clock_body(w, row, A, scratch);
+    w.tagged_nonneg(static_cast<uint64_t>(vals[j]));
+    blobs.push_back(std::move(b));
+  }
+  Emitter e{out};
+  e.byte(kTagMVReg);
+  e.uv(static_cast<uint64_t>(n_live));
+  if (sizing) return e.count + blob_bytes;
+  std::sort(blobs.begin(), blobs.end(),
+            [](const std::vector<uint8_t>& x, const std::vector<uint8_t>& y) {
+              size_t m = x.size() < y.size() ? x.size() : y.size();
+              int c = std::memcmp(x.data(), y.data(), m);
+              if (c) return c < 0;
+              return x.size() < y.size();
+            });
+  for (const auto& b : blobs)
+    for (uint8_t x : b) e.byte(x);
+  return e.count;
+}
+
+// ---- LWWReg wire codec -----------------------------------------------------
+//
+// LWWREG := 0x24 0x03 zz(val) 0x03 zz(marker).  Dense: vals[N] (payload
+// ids) + markers[N], both u64 (markers are timestamps — lwwreg.rs:16-24).
+
+inline int parse_lww_one(const uint8_t* buf, int64_t lo, int64_t hi,
+                         uint64_t* val, uint64_t* marker) {
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(kTagLWW)) return 1;
+  uint64_t v, m;
+  if (!c.nonneg(&v)) return 1;
+  if (v > 0x7FFFFFFFull) return 1;  // identity payload id space
+  if (!c.nonneg(&m)) return 1;
+  if (c.p != c.end) return 1;
+  *val = v;
+  *marker = m;
+  return 0;
+}
+
+inline int64_t lww_encode_one(uint64_t val, uint64_t marker, uint8_t* out) {
+  Emitter e{out};
+  e.byte(kTagLWW);
+  e.tagged_nonneg(val);
+  e.tagged_nonneg(marker);
+  return e.count;
+}
+
 }  // namespace
+
+extern "C" {
+
+int64_t mvreg_ingest_wire_u32(const uint8_t* buf, const int64_t* offsets,
+                              int64_t n, int64_t K, int64_t A,
+                              uint32_t* clocks, uint32_t* vals,
+                              uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_mvreg_one<uint32_t>(buf, offsets[i], offsets[i + 1], K, A,
+                                       clocks + i * K * A, vals + i * K);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      std::memset(clocks + i * K * A, 0, sizeof(uint32_t) * K * A);
+      std::memset(vals + i * K, 0, sizeof(uint32_t) * K);
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+int64_t mvreg_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
+                              int64_t n, int64_t K, int64_t A,
+                              uint64_t* clocks, uint64_t* vals,
+                              uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_mvreg_one<uint64_t>(buf, offsets[i], offsets[i + 1], K, A,
+                                       clocks + i * K * A, vals + i * K);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      std::memset(clocks + i * K * A, 0, sizeof(uint64_t) * K * A);
+      std::memset(vals + i * K, 0, sizeof(uint64_t) * K);
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+void mvreg_encode_wire_u32(const uint32_t* clocks, const uint32_t* vals,
+                           int64_t n, int64_t K, int64_t A, int64_t* offsets,
+                           uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = mvreg_encode_one<uint32_t>(
+          clocks + i * K * A, vals + i * K, K, A, nullptr);
+    else
+      mvreg_encode_one<uint32_t>(clocks + i * K * A, vals + i * K, K, A,
+                                 buf + offsets[i]);
+  }
+}
+
+void mvreg_encode_wire_u64(const uint64_t* clocks, const uint64_t* vals,
+                           int64_t n, int64_t K, int64_t A, int64_t* offsets,
+                           uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = mvreg_encode_one<uint64_t>(
+          clocks + i * K * A, vals + i * K, K, A, nullptr);
+    else
+      mvreg_encode_one<uint64_t>(clocks + i * K * A, vals + i * K, K, A,
+                                 buf + offsets[i]);
+  }
+}
+
+int64_t lww_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
+                            int64_t n, uint64_t* vals, uint64_t* markers,
+                            uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 4096) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_lww_one(buf, offsets[i], offsets[i + 1], vals + i,
+                           markers + i);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      vals[i] = 0;
+      markers[i] = 0;
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+void lww_encode_wire_u64(const uint64_t* vals, const uint64_t* markers,
+                         int64_t n, int64_t* offsets, uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 4096)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = lww_encode_one(vals[i], markers[i], nullptr);
+    else
+      lww_encode_one(vals[i], markers[i], buf + offsets[i]);
+  }
+}
+
+}  // extern "C"
 
 extern "C" {
 

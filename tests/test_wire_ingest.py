@@ -326,6 +326,123 @@ def test_wire_parser_total_on_mutated_blobs(seed, pos, byte, mode):
     assert got.to_scalar(uni) == want
 
 
+# -- MVReg / LWWReg wire legs -------------------------------------------------
+
+
+def _random_mvregs(rng, n, n_actors=8):
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    regs = []
+    for _ in range(n):
+        reg = MVReg()
+        for actor in rng.choice(n_actors, size=int(rng.randint(1, 4)),
+                                replace=False):
+            ctx = reg.read().derive_add_ctx(int(actor))
+            reg.apply(reg.set(int(rng.randint(0, 1000)), ctx))
+        regs.append(reg)
+    return regs
+
+
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_mvreg_wire_roundtrip_and_parity(counter_bits):
+    """MVReg leg of the bulk wire path: ingest matches the Python
+    pipeline, egress is byte-identical to to_binary, round trip is the
+    identity on scalars."""
+    from crdt_tpu.batch import MVRegBatch
+
+    rng = np.random.RandomState(67)
+    uni = _identity_uni(counter_bits=counter_bits)
+    regs = _random_mvregs(rng, 40)
+    blobs = [to_binary(r) for r in regs]
+
+    got = MVRegBatch.from_wire(blobs, uni)
+    want = MVRegBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.clocks), np.asarray(want.clocks))
+    np.testing.assert_array_equal(np.asarray(got.vals), np.asarray(want.vals))
+
+    out = got.to_wire(uni)
+    assert out == [to_binary(r) for r in got.to_scalar(uni)]
+    back = MVRegBatch.from_wire(out, uni)
+    assert back.to_scalar(uni) == got.to_scalar(uni)
+
+
+def test_mvreg_wire_fallbacks():
+    from crdt_tpu.batch import MVRegBatch
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    uni = _identity_uni(mv_capacity=2)
+    # overflow: 3 concurrent values > mv_capacity 2 → same error as
+    # from_scalar
+    regs = []
+    for actor in range(3):
+        r = MVReg()
+        r.apply(r.set(actor, r.read().derive_add_ctx(actor)))
+        regs.append(r)
+    merged = regs[0]
+    merged.merge(regs[1])
+    merged.merge(regs[2])
+    with pytest.raises(ValueError, match="mv_capacity"):
+        MVRegBatch.from_wire([to_binary(merged)], uni)
+
+    # non-int payload: python fallback raises the identity-registry error
+    s = MVReg()
+    s.apply(s.set("text", s.read().derive_add_ctx(0)))
+    with pytest.raises(ValueError, match="identity registry"):
+        MVRegBatch.from_wire([to_binary(s)], uni)
+
+
+def test_mvreg_wire_mixed_patch_path():
+    """A u64 counter >= 2^63 is outside the native zigzag (status 1) but
+    fine for the Python decoder — drives the row-patch splice alongside
+    natively-parsed rows."""
+    from crdt_tpu.batch import MVRegBatch
+    from crdt_tpu.scalar.mvreg import MVReg
+    from crdt_tpu.scalar.vclock import VClock
+
+    rng = np.random.RandomState(73)
+    uni = _identity_uni(counter_bits=64)
+    regs = _random_mvregs(rng, 10)
+    big = MVReg([(VClock({2: 2**63 + 3}), 42)])
+    regs[4] = big
+    blobs = [to_binary(r) for r in regs]
+    got = MVRegBatch.from_wire(blobs, uni)
+    want = MVRegBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.clocks), np.asarray(want.clocks))
+    np.testing.assert_array_equal(np.asarray(got.vals), np.asarray(want.vals))
+    assert int(np.asarray(got.clocks)[4, 0, 2]) == 2**63 + 3
+
+
+def test_lww_wire_roundtrip_and_parity():
+    """LWW leg: both directions byte/plane-faithful, incl. the mixed
+    patch path (a marker >= 2^63 is outside the native zigzag range and
+    routes through the Python decoder per blob)."""
+    from crdt_tpu.batch import LWWRegBatch
+    from crdt_tpu.scalar.lwwreg import LWWReg
+
+    rng = np.random.RandomState(71)
+    uni = _identity_uni()
+    regs = [
+        LWWReg(int(rng.randint(0, 1000)), int(rng.randint(1, 10**9)))
+        for _ in range(50)
+    ]
+    regs[7] = LWWReg(5, 2**63 + 11)  # native flags it; python patches it
+    blobs = [to_binary(r) for r in regs]
+
+    got = LWWRegBatch.from_wire(blobs, uni)
+    want = LWWRegBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.vals), np.asarray(want.vals))
+    np.testing.assert_array_equal(
+        np.asarray(got.markers), np.asarray(want.markers)
+    )
+    assert int(np.asarray(got.markers)[7]) == 2**63 + 11
+
+    # egress: the big marker forces the whole-batch Python path; bytes
+    # still identical.  Without it, the native path must agree too.
+    assert got.to_wire(uni) == blobs
+    small = LWWRegBatch.from_scalar(regs[:7], uni)
+    assert small.to_wire(uni) == blobs[:7]
+
+
 def test_identity_universe_checkpoint_roundtrip():
     """Identity universes survive checkpoint save/load as identity (a
     value-list restore would rebuild a dict registry whose lookups fail

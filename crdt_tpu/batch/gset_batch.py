@@ -45,6 +45,66 @@ class GSetBatch:
                 buf[i, mid] = True
         return cls(bits=jnp.asarray(buf))
 
+    @classmethod
+    @gc_paused
+    def from_wire(
+        cls, blobs: Sequence[bytes], universe: Universe,
+        member_capacity: int,
+    ) -> "GSetBatch":
+        """Bulk ingest from wire blobs (``to_binary(gset)`` payloads) —
+        the GSet leg of the native bulk path (contract as in
+        :meth:`OrswotBatch.from_wire`: identity universe + native engine,
+        Python fallback per non-conforming blob, always equal to
+        ``from_scalar([from_binary(b) for b in blobs], uni, U)``)."""
+        import numpy as np
+
+        from ..utils.serde import from_binary
+        from .wirebulk import concat_blobs, probe_engine
+
+        n = len(blobs)
+        if n == 0:
+            return cls.zeros(0, member_capacity)
+        engine = probe_engine(universe, "gset_ingest_wire")
+        if engine is None:
+            return cls.from_scalar(
+                [from_binary(b) for b in blobs], universe, member_capacity
+            )
+        buf, offsets = concat_blobs(blobs)
+        bits, status = engine.gset_ingest_wire(buf, offsets, member_capacity)
+        if status.any():
+            hard = np.nonzero(status == 2)[0]
+            if hard.size:
+                raise ValueError(
+                    f"member universe overflow: object {int(hard[0])} has a "
+                    f"member id >= capacity {member_capacity}"
+                )
+            fb = np.nonzero(status)[0].tolist()
+            sub = cls.from_scalar(
+                [from_binary(blobs[i]) for i in fb], universe, member_capacity
+            )
+            idx = np.asarray(fb, dtype=np.int64)
+            bits[idx] = np.asarray(sub.bits)
+        return cls(bits=jnp.asarray(bits))
+
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs, byte-identical to
+        ``[to_binary(s) for s in self.to_scalar(uni)]`` (sorted-items
+        order reproduced in C); non-identity universes take the Python
+        path."""
+        from ..utils.serde import to_binary
+        from .wirebulk import probe_engine, slice_blobs
+
+        if self.bits.shape[0] == 0:
+            return []
+        engine = probe_engine(universe, "gset_encode_wire")
+        if engine is None:
+            return [to_binary(s) for s in self.to_scalar(universe)]
+        import numpy as np
+
+        buf, offsets = engine.gset_encode_wire(np.asarray(self.bits))
+        return slice_blobs(buf, offsets)
+
     @gc_paused
     def to_scalar(self, universe: Universe) -> list[GSet]:
         import numpy as np

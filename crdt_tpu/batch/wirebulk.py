@@ -13,18 +13,23 @@ from __future__ import annotations
 from typing import Sequence
 
 
-def probe_engine(universe, fn_name: str, dtype):
+def probe_engine(universe, fn_name: str, dtype=None):
     """The native engine module when the fast path applies, else None.
 
     Applies = identity universe AND the .so loads AND it exports the
     required symbol (an .so built from older sources loads fine but
-    lacks newer entry points)."""
+    lacks newer entry points).  ``dtype=None`` probes a
+    dtype-independent symbol (no u32/u64 suffix — the GSet bitmap
+    codec)."""
     if not universe.is_identity:
         return None
     try:
         from ..native import engine
 
-        engine._fn(fn_name, dtype)
+        if dtype is None:
+            engine._fn_raw(fn_name)
+        else:
+            engine._fn(fn_name, dtype)
         return engine
     except (ImportError, OSError, RuntimeError, AttributeError, TypeError):
         return None

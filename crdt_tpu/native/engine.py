@@ -752,3 +752,51 @@ def lww_encode_wire(vals, markers):
         _ptr(vals), _ptr(markers), ctypes.c_int64(n), _ptr(offsets), _ptr(buf),
     )
     return buf, offsets
+
+
+def _fn_raw(name: str) -> "ctypes._CFuncPtr":
+    """A dtype-independent C symbol (no u32/u64 suffix — e.g. the GSet
+    bitmap codec, whose planes are bool)."""
+    lib = loader.load()
+    fn = getattr(lib, name, None)
+    if fn is None:
+        raise AttributeError(f"native library lacks symbol {name}")
+    return fn
+
+
+def gset_ingest_wire(buf, offsets, u: int):
+    """Parallel GSet wire decode into the bool membership bitmap.
+    Returns ``(bits, status)``; status 2 = member id >= bitmap width."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    # bool_ shares uint8's layout; the C side writes 0/1 bytes, so no
+    # post-hoc astype copy of the (n, U) plane is needed
+    bits = np.zeros((n, u), dtype=np.bool_)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn_raw("gset_ingest_wire")
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n), ctypes.c_int64(u),
+        _ptr(bits), _ptr(status),
+    )
+    return bits, status
+
+
+def gset_encode_wire(bits):
+    """Parallel GSet wire encode (sorted-items order reproduced).
+    Returns ``(buf, offsets)``."""
+    bits = np.ascontiguousarray(np.asarray(bits, dtype=np.uint8))
+    n, u = bits.shape
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn_raw("gset_encode_wire")
+    fn(
+        _ptr(bits), ctypes.c_int64(n), ctypes.c_int64(u), _ptr(offsets), None,
+    )
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(
+        _ptr(bits), ctypes.c_int64(n), ctypes.c_int64(u), _ptr(offsets),
+        _ptr(buf),
+    )
+    return buf, offsets

@@ -58,6 +58,7 @@ constexpr uint8_t kTagVClock = 0x20;
 constexpr uint8_t kTagLWW = 0x24;
 constexpr uint8_t kTagMVReg = 0x25;
 constexpr uint8_t kTagOrswot = 0x26;
+constexpr uint8_t kTagGSet = 0x28;
 constexpr int32_t kEmpty = -1;
 
 struct Cursor {
@@ -556,6 +557,48 @@ int64_t mvreg_encode_one(const C* clocks, const C* vals, int64_t K,
 // LWWREG := 0x24 0x03 zz(val) 0x03 zz(marker).  Dense: vals[N] (payload
 // ids) + markers[N], both u64 (markers are timestamps — lwwreg.rs:16-24).
 
+// ---- GSet wire codec -------------------------------------------------------
+//
+// GSET := 0x28 uv n, n * (0x03 zz(member)) — items sorted by encoded
+// bytes (serde.py enc_items_sorted).  Dense: bool bitmap[U], member id
+// == bit index (identity universes).
+
+inline int parse_gset_one(const uint8_t* buf, int64_t lo, int64_t hi,
+                          int64_t U, uint8_t* bits) {
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(kTagGSet)) return 1;
+  uint64_t n;
+  if (!c.uv(&n)) return 1;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t member;
+    if (!c.nonneg(&member)) return 1;
+    // beyond the identity registry's int32 id space: fall back so the
+    // Python path raises ITS error, like every other leg
+    if (member > 0x7FFFFFFFull) return 1;
+    if (member >= static_cast<uint64_t>(U)) return 2;  // bitmap overflow
+    bits[member] = 1;
+  }
+  if (c.p != c.end) return 1;
+  return 0;
+}
+
+inline int64_t gset_encode_one(const uint8_t* bits, int64_t U, uint8_t* out) {
+  const bool sizing = (out == nullptr);
+  Emitter e{out};
+  std::vector<int64_t> members;
+  for (int64_t m = 0; m < U; ++m)
+    if (bits[m]) members.push_back(m);
+  if (!sizing)
+    std::sort(members.begin(), members.end(), [](int64_t x, int64_t y) {
+      return varint_bytes_less(static_cast<uint64_t>(x) << 1,
+                               static_cast<uint64_t>(y) << 1);
+    });
+  e.byte(kTagGSet);
+  e.uv(static_cast<uint64_t>(members.size()));
+  for (int64_t m : members) e.tagged_nonneg(static_cast<uint64_t>(m));
+  return e.count;
+}
+
 inline int parse_lww_one(const uint8_t* buf, int64_t lo, int64_t hi,
                          uint64_t* val, uint64_t* marker) {
   Cursor c{buf + lo, buf + hi};
@@ -653,6 +696,37 @@ void mvreg_encode_wire_u64(const uint64_t* clocks, const uint64_t* vals,
     else
       mvreg_encode_one<uint64_t>(clocks + i * K * A, vals + i * K, K, A,
                                  buf + offsets[i]);
+  }
+}
+
+int64_t gset_ingest_wire(const uint8_t* buf, const int64_t* offsets,
+                         int64_t n, int64_t U, uint8_t* bits,
+                         uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 2048) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_gset_one(buf, offsets[i], offsets[i + 1], U, bits + i * U);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      std::memset(bits + i * U, 0, static_cast<size_t>(U));
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+void gset_encode_wire(const uint8_t* bits, int64_t n, int64_t U,
+                      int64_t* offsets, uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 2048)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = gset_encode_one(bits + i * U, U, nullptr);
+    else
+      gset_encode_one(bits + i * U, U, buf + offsets[i]);
   }
 }
 

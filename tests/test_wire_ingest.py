@@ -443,6 +443,39 @@ def test_lww_wire_roundtrip_and_parity():
     assert small.to_wire(uni) == blobs[:7]
 
 
+def test_gset_wire_roundtrip_and_parity():
+    """GSet leg: bitmap ingest/egress, sorted-items byte parity, overflow
+    and non-int fallbacks."""
+    from crdt_tpu.batch import GSetBatch
+    from crdt_tpu.scalar.gset import GSet
+
+    rng = np.random.RandomState(79)
+    uni = _identity_uni()
+    U = 64
+    sets = []
+    for _ in range(30):
+        s = GSet()
+        for _ in range(int(rng.randint(0, 6))):
+            s.insert(int(rng.randint(0, U)))
+        sets.append(s)
+    blobs = [to_binary(s) for s in sets]
+
+    got = GSetBatch.from_wire(blobs, uni, U)
+    want = GSetBatch.from_scalar([from_binary(b) for b in blobs], uni, U)
+    np.testing.assert_array_equal(np.asarray(got.bits), np.asarray(want.bits))
+    out = got.to_wire(uni)
+    assert out == [to_binary(s) for s in got.to_scalar(uni)] == blobs
+
+    # member beyond the bitmap: same error as from_scalar
+    big = GSet({U + 5})
+    with pytest.raises(ValueError, match="universe overflow"):
+        GSetBatch.from_wire([to_binary(big)], uni, U)
+    # non-int member: python fallback raises the identity-registry error
+    s = GSet({"txt"})
+    with pytest.raises(ValueError, match="identity registry"):
+        GSetBatch.from_wire([to_binary(s)], uni, U)
+
+
 def test_identity_universe_checkpoint_roundtrip():
     """Identity universes survive checkpoint save/load as identity (a
     value-list restore would rebuild a dict registry whose lookups fail

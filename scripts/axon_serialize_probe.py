@@ -1,0 +1,110 @@
+"""Probe: can the axon PJRT client serialize ITS OWN executables?
+
+Round-4 finding (first-ever bridge load attempt): the axon runtime
+rejects executables serialized by the local libtpu compile-only
+topology — ``PJRT_Executable_DeserializeAndLoad: cached executable is
+axon format v<garbage>, this build is v9``.  The AOT bridge
+(scripts/aot_exec_bridge.py) therefore cannot ship locally-compiled
+programs into the tunnel; the serialization formats are disjoint.
+
+This probe tests the reverse direction, which the error message implies
+exists: executables the axon client compiled itself (through the
+remote-compile helper) should serialize in "axon format v9" and
+round-trip through deserialize_and_load.  If that holds, the bridge
+strategy flips: compile small-text programs (the fused Pallas scan is
+one Mosaic kernel) through the helper ONCE on a live window, serialize
+axon-side, stash, and every later window loads without any compile.
+
+Also reports whether the JAX persistent compilation cache
+(JAX_COMPILATION_CACHE_DIR) gained entries from the compile — if the
+axon plugin participates, cross-window reuse may already be free.
+
+Usage (live tunnel only):  python scripts/axon_serialize_probe.py
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CACHE_DIR = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache"
+)
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+
+ART = "/tmp/aot_exec/axon_tiny.pkl"
+
+
+def main() -> int:
+    rec: dict = {"probe": "axon_serialize"}
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import serialize_executable as se
+
+    rec["backend"] = jax.default_backend()
+    if rec["backend"] != "tpu":
+        rec["error"] = "no TPU backend; run on a live window"
+        print(json.dumps(rec))
+        return 1
+
+    cache_before = set(glob.glob(os.path.join(CACHE_DIR, "*")))
+
+    @jax.jit
+    def f(x, y):
+        return (x * 2 + y).sum(axis=-1)
+
+    x = jnp.arange(8 * 128, dtype=jnp.int32).reshape(8, 128)
+    y = jnp.ones((8, 128), jnp.int32)
+    t0 = time.perf_counter()
+    compiled = f.trace(x, y).lower().compile()
+    rec["compile_s"] = round(time.perf_counter() - t0, 3)
+    expect = jax.block_until_ready(compiled(x, y))
+
+    cache_after = set(glob.glob(os.path.join(CACHE_DIR, "*")))
+    rec["persistent_cache_new_entries"] = len(cache_after - cache_before)
+
+    # --- serialize from the axon client
+    try:
+        t0 = time.perf_counter()
+        payload, in_tree, out_tree = se.serialize(compiled)
+        rec["serialize_s"] = round(time.perf_counter() - t0, 3)
+        rec["serialized_bytes"] = len(payload)
+    except Exception as e:  # noqa: BLE001 - probe records any failure
+        rec["error"] = f"serialize: {type(e).__name__}: {str(e)[:300]}"
+        print(json.dumps(rec))
+        return 1
+
+    # --- round-trip: deserialize into the same client and run
+    try:
+        t0 = time.perf_counter()
+        loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+        rec["deserialize_s"] = round(time.perf_counter() - t0, 3)
+        got = jax.block_until_ready(loaded(x, y))
+        import numpy as np
+
+        rec["roundtrip_parity"] = bool((np.asarray(got) == np.asarray(expect)).all())
+    except Exception as e:  # noqa: BLE001
+        rec["error"] = f"deserialize_and_load: {type(e).__name__}: {str(e)[:300]}"
+        print(json.dumps(rec))
+        return 1
+
+    os.makedirs(os.path.dirname(ART), exist_ok=True)
+    with open(ART, "wb") as fh:
+        pickle.dump(
+            {"payload": payload, "in_tree": in_tree, "out_tree": out_tree}, fh
+        )
+    rec["artifact"] = ART
+    rec["ok"] = bool(rec.get("roundtrip_parity"))
+    print(json.dumps(rec))
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

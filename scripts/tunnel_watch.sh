@@ -87,33 +87,19 @@ for i in $(seq 1 600); do
         # the window can cost the later jnp captures — the banked r03
         # evidence plus the headline upside dominate.
         #
-        # 1) deserialize-path probe, cheap (tiny + merge4); probe_ok
-        #    gates the big loads, written only on a fully-green tiny load
-        if [ -e /tmp/aot_exec/tiny.pkl ]; then
-            step aot_probe 600 /tmp/aot_probe_tpu.log bash -c \
-                "python scripts/aot_exec_bridge.py load tiny && \
-                 { [ ! -e /tmp/aot_exec/merge4.pkl ] || \
-                   python scripts/aot_exec_bridge.py load merge4; }"
-        fi
-        # 2) THE HEADLINE: compiled-Mosaic execution via the bridge —
-        #    first-ever compiled-Pallas run; publish its verdict at once
-        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ]; then
-            # TPU_* come from the ${VAR:-default} exports above — an
-            # operator override applies to every step uniformly
-            step aot_pallas_scan 2400 /tmp/aot_pallas_scan_tpu.log \
-                python scripts/aot_exec_bridge.py load pallas_scan_ns
-            timeout -k 15 120 python scripts/publish_bridge_capture.py \
-                >> /tmp/tunnel_watch.log 2>&1 || true
-        fi
-        # 3) the jnp north-star scan via the bridge (the program the
-        #    remote-compile helper 500s on; no Mosaic inside)
-        if [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/scan_ns.pkl ]; then
-            step aot_scan 2400 /tmp/aot_scan_tpu.log \
-                python scripts/aot_exec_bridge.py load scan_ns
-            timeout -k 15 120 python scripts/publish_bridge_capture.py \
-                >> /tmp/tunnel_watch.log 2>&1 || true
-        fi
-        # 4) the full bench (seeds from whatever the bridge just banked;
+        # ROUND-4 UPDATE: the local-AOT bridge is DEAD — the axon
+        # runtime only loads executables in its own serialization format
+        # ("axon format v9"); blobs from the local libtpu compile-only
+        # topology are rejected at PJRT_Executable_DeserializeAndLoad
+        # (first-ever load attempt, 2026-08-01 window; see
+        # reports/TPU_LATENCY.md item 7).  The compiled-Pallas headline
+        # now rides bench.py's helper-path attempt (the fused scan is
+        # one Mosaic kernel — small program text, inside the helper's
+        # body limit), and the axon_serialize probe below tests whether
+        # helper-compiled executables can be banked axon-side for
+        # compile-free reuse in later windows.
+        #
+        # 1) the full bench (seeds from whatever is already banked;
         #    publish only when this iteration actually ran it — a marker
         #    short-circuit must not re-stamp the artifact's capture time).
         #    PROBE_TIMEOUT at the old 900s ladder: the aliveness gate only
@@ -127,6 +113,12 @@ for i in $(seq 1 600); do
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \
             python scripts/tpu_validate.py --merge
+        # 2) can the axon client serialize its own executables?  If yes,
+        #    one helper compile of the fused scan can be banked for
+        #    compile-free reuse across windows (the local-AOT direction
+        #    is format-incompatible — see header)
+        step axon_serialize 600 /tmp/axon_serialize_tpu.log \
+            python scripts/axon_serialize_probe.py
         # 5) secondary evidence, after everything headline-bearing
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
@@ -156,19 +148,11 @@ for i in $(seq 1 600); do
         # bench.py's banked-seed path carries it into the driver artifact)
         timeout -k 15 120 python scripts/publish_bridge_capture.py \
             >> /tmp/tunnel_watch.log 2>&1 || true
-        # done only when every step whose precondition exists has its
-        # marker — including the AOT loads, so a window that closes
-        # mid-load leaves them to retry next window
-        AOT_OK=1
-        [ -e /tmp/aot_exec/tiny.pkl ] && [ ! -e "$MARK/aot_probe" ] && AOT_OK=0
-        [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/scan_ns.pkl ] && \
-            [ ! -e "$MARK/aot_scan" ] && AOT_OK=0
-        [ -e /tmp/aot_exec/probe_ok ] && [ -e /tmp/aot_exec/pallas_scan_ns.pkl ] && \
-            [ ! -e "$MARK/aot_pallas_scan" ] && AOT_OK=0
+        # done only when every step has its marker
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
-           [ -e "$MARK/bench" ] && \
+           [ -e "$MARK/bench" ] && [ -e "$MARK/axon_serialize" ] && \
            [ -e "$MARK/validate_merge" ] && [ -e "$MARK/pallas" ] && \
-           [ -e "$MARK/experiments_pallas" ] && [ "$AOT_OK" = 1 ]; then
+           [ -e "$MARK/experiments_pallas" ]; then
             echo "$(date -u +%H:%M:%S) all captures done (rev $REV)" | tee -a /tmp/tunnel_watch.log
             exit 0
         fi

@@ -37,6 +37,19 @@ def log(*args):
 
 SMALL = os.environ.get("CRDT_BENCH_SMALL") == "1"
 
+# Persistent XLA compilation cache, defaulted into the repo so it
+# survives reboots (/tmp is tmpfs).  The axon backend participates in
+# the standard JAX persistent cache (observed 2026-08-01 window:
+# helper-compiled programs land as axon-format entries), so every
+# program one window compiles is a free cache hit for every later run —
+# including the driver's end-of-round bench, which does not set the env
+# itself.  Must be set before the first jax compile; setdefault keeps
+# operator overrides.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
+
 # ---------------------------------------------------------------- budget
 #
 # The bench must produce a parseable JSON line and exit 0 under ANY tunnel
@@ -79,6 +92,44 @@ def emit(**fields):
     if _JSON_STATE.get("value") is not None:
         _JSON_STATE["vs_baseline"] = round(_JSON_STATE["value"] / 1e7, 4)
         print(json.dumps(_JSON_STATE), flush=True)
+
+
+def _install_budget_watchdog(grace_s: float = 60.0):
+    """Guarantee a parseable artifact and rc=0 even when a PJRT call
+    blocks forever (2026-08-01 window: the tunnel wedged MID-RUN and the
+    north-star template transfer never returned — the per-stage budget
+    skips only help BETWEEN stages).  A daemon thread watches the wall
+    budget; once overrun by ``grace_s`` it re-prints the last banked
+    record (or an explicit-failure one) and exits 0 — strictly better
+    for the driver than its own timeout killing us at rc=124."""
+    import threading
+
+    def guard():
+        while True:
+            try:
+                over = -remaining_budget()
+                if over > grace_s:
+                    log(
+                        f"BUDGET WATCHDOG: {_BUDGET_S:.0f}s budget overrun by "
+                        f"{over:.0f}s — a stage is blocked (tunnel wedged "
+                        "mid-run?); emitting the banked record and exiting 0"
+                    )
+                    # snapshot: the main thread may be mid-emit(); dumping
+                    # the live dict could raise mid-iteration and kill the
+                    # very thread that guards against hangs
+                    rec = dict(_JSON_STATE)
+                    if rec.get("value") is None:
+                        rec["value"] = 0.0
+                        rec["vs_baseline"] = 0.0
+                        rec.setdefault("headline_source", "none")
+                    rec["budget_watchdog"] = "fired"
+                    print("\n" + json.dumps(rec), flush=True)
+                    os._exit(0)
+            except Exception:  # noqa: BLE001 — the guard must survive races
+                pass
+            time.sleep(5)
+
+    threading.Thread(target=guard, daemon=True, name="budget-watchdog").start()
 
 
 def run_stage(name: str, est_s: float, fn, *args, **kwargs):
@@ -754,12 +805,11 @@ def bench_pallas_north_star(templates=None):
             orswot_pallas.pad_to_tile(templates[0], m, d, n_states=r + 1)
         )
 
-        # Bridge path first: a locally-AOT-compiled executable of this
-        # exact scan (scripts/aot_exec_bridge.py) sidesteps the tunnel's
-        # remote-compile helper entirely.  Used only when a previous
-        # window's bridge load recorded parity=true for an artifact whose
-        # kernel-code fingerprint still matches — and the scalar-oracle
-        # sample gate above has already passed this run.
+        # Bridge path first: an axon-format executable of this exact
+        # scan, self-banked by a previous bench run right after its
+        # helper compile succeeded, sidesteps the remote-compile helper
+        # entirely.  (The scalar-oracle sample gate above has already
+        # passed this run before any banked timing is trusted.)
         if not SMALL:
             bridged = _pallas_bridge_rate(tpl, n_chunks, chunk, r)
             if bridged is not None:
@@ -788,11 +838,16 @@ def bench_pallas_north_star(templates=None):
             (salt, out), _ = lax.scan(body, init, None, length=n_chunks)
             return out
 
-        out = run_chunks(tpl)
-        jax.block_until_ready(out)  # compile + warmup
+        # explicit compile so the executable object is in hand for
+        # axon-side banking (a plain first call would hide it)
+        compiled = run_chunks.trace(tpl).lower().compile()
+        out = compiled(tpl)
+        jax.block_until_ready(out)  # warmup
+        if not SMALL:
+            _pallas_bank_executable(compiled, n_chunks, chunk, r, out)
         sync_s = _sync_overhead()
         t0 = time.perf_counter()
-        out = run_chunks(tpl)
+        out = compiled(tpl)
         np.asarray(out[0].ravel()[0])
         t = max(time.perf_counter() - t0 - sync_s, 1e-9)
         rate = n_chunks * chunk * r / t
@@ -806,77 +861,91 @@ def bench_pallas_north_star(templates=None):
         return None
 
 
+AXON_ART_PATH = "/tmp/aot_exec/axon_pallas_scan_ns.pkl"
+
+
+def _axon_art_meta(n_chunks, chunk, r):
+    """The identity an axon-banked scan executable must match to be
+    reused: kernel-source fingerprint, trace-shaping env pins, and the
+    merge counts its ``lax.scan`` structure embodies (advisor r3: the
+    rate must come from counts the executable actually bakes in)."""
+    from crdt_tpu.utils.fingerprint import ops_fingerprint
+
+    return {
+        "format": "axon",
+        "code": ops_fingerprint(),
+        "env": {
+            "CRDT_MERGE_IMPL": os.environ.get("CRDT_MERGE_IMPL", "unrolled"),
+            "CRDT_SCATTERLESS": os.environ.get("CRDT_SCATTERLESS", "1"),
+        },
+        "tile": os.environ.get("CRDT_PALLAS_TILE", "auto"),
+        "counts": {"n_chunks": n_chunks, "chunk": chunk, "r": r},
+    }
+
+
+def _out_digest(out):
+    """Order-stable content summary of a fold output pytree: per-plane
+    (wrapping-uint32 sum, max) pairs.  The scan's inputs and salt chain
+    are deterministic (fixed seed, shapes pinned by the artifact meta,
+    kernel code pinned by the fingerprint), so a banked executable must
+    reproduce the digest exactly — this is the parity tie between a
+    deserialized executable and the program the in-run oracle gate
+    validated (a serialize/deserialize corruption must not publish a
+    headline computed from garbage)."""
+    import jax
+    import jax.numpy as jnp
+
+    dig = []
+    for x in jax.tree_util.tree_leaves(out):
+        xu = x.astype(jnp.uint32)
+        dig.append(
+            [int(jnp.sum(xu).astype(jnp.uint32)), int(jnp.max(xu))]
+        )
+    return dig
+
+
+def _artifact_dir_ours(path) -> bool:
+    """Unpickling executes arbitrary code: only trust artifacts in a
+    directory owned by this user and not writable by others (advisor
+    r3: a fixed world-writable /tmp path invites planted pickles)."""
+    try:
+        st = os.stat(os.path.dirname(path))
+    except OSError:
+        return False
+    return st.st_uid == os.getuid() and not (st.st_mode & 0o022)
+
+
 def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
-    """Deserialize the staged fused-Pallas scan and time it.
+    """Load a self-banked axon-format scan executable and time it.
 
     Returns merges/s, or None to fall through to the helper-path
-    compile.  Trust requirements: the artifact's verdict file (written
-    by a tunnel-window `aot_exec_bridge.py load`) says parity=true, and
-    its kernel-source fingerprint matches the code bench would trace.
+    compile.  The artifact is written by a PREVIOUS bench run on this
+    machine, right after its helper compile of the exact same program
+    succeeded and the in-run parity gate had already passed (the gate
+    re-runs before this function every run).  The local-AOT direction
+    (aot_exec_bridge.py) is dead: the axon runtime only loads its own
+    serialization format — "axon format v9", reports/TPU_LATENCY.md
+    item 7 — so only executables the axon client itself compiled can
+    be banked.
     """
     import pickle
 
     import jax
 
-    art_path = "/tmp/aot_exec/pallas_scan_ns.pkl"
-    verdict_path = "/tmp/aot_exec/pallas_scan_ns.verdict.json"
-    if not (os.path.exists(art_path) and os.path.exists(verdict_path)):
+    if not os.path.exists(AXON_ART_PATH):
         return None
     try:
-        from crdt_tpu.utils.fingerprint import ops_fingerprint
-
-        # unpickling executes arbitrary code: only trust artifacts in a
-        # directory owned by this user and not writable by others
-        # (advisor r3: a fixed world-writable /tmp path invites planted
-        # pickles)
-        st = os.stat(os.path.dirname(art_path))
-        if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        if not _artifact_dir_ours(AXON_ART_PATH):
             log("north★ pallas bridge: artifact dir not exclusively ours; refusing")
             return None
-        with open(verdict_path) as f:
-            verdict = json.load(f)
-        if verdict.get("parity") is not True:
-            log("north★ pallas bridge: verdict not green; helper path next")
-            return None
-        with open(art_path, "rb") as f:
+        with open(AXON_ART_PATH, "rb") as f:
             art = pickle.load(f)
-        # the verdict must attest THIS artifact (a rebuild after the
-        # window would inherit an unearned parity=true) and the artifact
-        # must match the kernel sources AND trace-shaping env this bench
-        # process would use
-        if verdict.get("artifact_code") != art["meta"]["code"]:
-            log("north★ pallas bridge: verdict attests a different artifact")
-            return None
-        if art["meta"]["code"] != ops_fingerprint():
-            log("north★ pallas bridge: artifact stale vs kernel sources")
-            return None
-        env_now = {
-            "CRDT_MERGE_IMPL": os.environ.get("CRDT_MERGE_IMPL", "unrolled"),
-            "CRDT_SCATTERLESS": os.environ.get("CRDT_SCATTERLESS", "1"),
-        }
-        if art["meta"].get("env") != env_now or art["meta"].get(
-            "tile", "auto"
-        ) != os.environ.get("CRDT_PALLAS_TILE", "auto"):
-            log("north★ pallas bridge: env pins differ from this run")
-            return None
-        # the executable's lax.scan length is baked at build time; the
-        # fingerprint/env gates don't cover it (advisor r3 medium).  The
-        # artifact must carry its own merge counts, they must match what
-        # this bench claims to measure, and the rate is computed from the
-        # ARTIFACT's counts — never from bench constants the executable
-        # does not embody.
-        counts = art["meta"].get("counts")
-        if counts is None:
-            log("north★ pallas bridge: artifact lacks merge counts (rebuild); "
-                "helper path next")
-            return None
-        if (counts.get("n_chunks"), counts.get("chunk"), counts.get("r")) != (
-            n_chunks, chunk, r
-        ):
+        want = _axon_art_meta(n_chunks, chunk, r)
+        have = art.get("meta", {})
+        if have != want:
             log(
-                f"north★ pallas bridge: artifact counts {counts} != bench "
-                f"shapes (n_chunks={n_chunks}, chunk={chunk}, r={r}); "
-                "helper path next"
+                f"north★ pallas bridge: banked executable identity mismatch "
+                f"(have {have}, want {want}); helper path next"
             )
             return None
         from jax.experimental.serialize_executable import (
@@ -884,24 +953,118 @@ def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
         )
 
         compiled = deserialize_and_load(
-            art["payload"], art["in_tree"], art["out_tree"], backend="tpu"
+            art["payload"], art["in_tree"], art["out_tree"]
         )
         out = compiled(tpl)
         jax.block_until_ready(out)  # warmup (already compiled)
+        want_digest = art.get("out_digest")
+        if want_digest is None or _out_digest(out) != want_digest:
+            log(
+                "north★ pallas bridge: banked executable output digest "
+                "mismatch (serialize round-trip not semantics-preserving?); "
+                "helper path next"
+            )
+            return None
         sync_s = _sync_overhead()
         t0 = time.perf_counter()
         out = compiled(tpl)
         np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
         t = max(time.perf_counter() - t0 - sync_s, 1e-9)
+        counts = have["counts"]
         rate = counts["n_chunks"] * counts["chunk"] * counts["r"] / t
         log(
-            f"north★ pallas fused fold (AOT bridge, no remote compile): "
-            f"{t:.2f}s  {rate/1e6:.2f}M merges/s"
+            f"north★ pallas fused fold (axon-banked executable, no "
+            f"compile): {t:.2f}s  {rate/1e6:.2f}M merges/s"
         )
         return round(rate, 1)
     except Exception as e:
         log(f"north★ pallas bridge failed; helper path next: {str(e)[:200]}")
         return None
+
+
+def _pallas_bank_executable(compiled, n_chunks, chunk, r, out):
+    """Serialize a helper-compiled scan executable axon-side and stash
+    it for compile-free reuse by later bench runs (and the driver's
+    end-of-round run).  ``out`` is the executable's own output on the
+    deterministic template inputs — its digest is baked into the
+    artifact so a load can prove the round-trip preserved semantics.
+    Best-effort: any failure just means the next run pays the helper
+    compile again."""
+    import pickle
+
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        os.makedirs(os.path.dirname(AXON_ART_PATH), mode=0o700, exist_ok=True)
+        if not _artifact_dir_ours(AXON_ART_PATH):
+            log("north★ pallas bank: artifact dir not exclusively ours; skipping")
+            return
+        tmp = AXON_ART_PATH + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                {
+                    "payload": payload,
+                    "in_tree": in_tree,
+                    "out_tree": out_tree,
+                    "meta": _axon_art_meta(n_chunks, chunk, r),
+                    "out_digest": _out_digest(out),
+                },
+                f,
+            )
+        os.replace(tmp, AXON_ART_PATH)
+        log(
+            f"north★ pallas bank: executable serialized axon-side "
+            f"({len(payload)/1e6:.1f} MB) -> {AXON_ART_PATH}"
+        )
+    except Exception as e:
+        log(f"north★ pallas bank: serialize failed (non-fatal): {str(e)[:200]}")
+
+
+# Measured kernel traffic per merge (PERF.md "Roofline extrapolation"):
+# the jnp chunk-fold moves ~7.4 GB per 500k-merge chunk-fold, the fused
+# Pallas fold ~2.8 GB (single HBM pass; AOT memory plan).  Used to quote
+# each on-chip headline as effective GB/s against the same-window floor.
+_BYTES_PER_MERGE = {"jnp_fold": 14800.0, "pallas_fused_fold": 5600.0}
+
+
+def bench_bandwidth_floor():
+    """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
+    elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
+    plane — the cheapest op touching the same footprint the merge
+    kernels stream.  On the tunneled chip this is the platform ceiling
+    (measured 8.5 GB/s vs ~819 GB/s datasheet, reports/TPU_LATENCY.md
+    item 6), so quoting the headline relative to it separates kernel
+    efficiency from tunnel degradation.  TPU-only."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return None
+    from crdt_tpu.utils.benchtime import chain_timer, sync_overhead
+
+    if SMALL:
+        n, a, m = 2_000, 16, 8
+    else:
+        n, a, m = 62_500, 64, 16
+    rng = np.random.RandomState(7)
+    dots = jnp.asarray(
+        rng.randint(0, 100, size=(n, m, a)).astype(np.uint32)
+    )
+    dots_b = jnp.asarray(
+        rng.randint(0, 100, size=(n, m, a)).astype(np.uint32)
+    )
+    t, _ = chain_timer(
+        lambda s, db: (jnp.maximum(s[0], db),),
+        (dots,),
+        8,
+        consts=(dots_b,),
+        sync_overhead_s=sync_overhead(),
+    )
+    # read a + read b + write out per iteration
+    floor = 3 * dots.nbytes / t / 1e9
+    log(f"bandwidth floor: maximum(dots,dots) {floor:.2f} GB/s (this window)")
+    return {"floor_gb_per_s": round(floor, 2)}
 
 
 def _north_star_parity(template, r, a, m, d, fold_join):
@@ -1368,6 +1531,7 @@ def emit_headline(rate, kernel_fields: dict, platform: str, fallback: bool):
 
 def main():
     global _BANKED_HEADLINE, _IS_FALLBACK
+    _install_budget_watchdog()
     banked = _load_banked()
     if banked is not None:
         _BANKED_HEADLINE = True
@@ -1447,6 +1611,28 @@ def main():
             emit_headline(pallas_rate, kf, backend, fallback)
         else:
             emit(pallas_merges_per_sec=pallas_rate)
+    floor = run_stage("bandwidth_floor", 45, bench_bandwidth_floor)
+    if floor is not None:
+        emit(**floor)
+        # quote the live on-chip headline as effective GB/s vs the
+        # same-window floor, so the number survives tunnel degradation
+        # (VERDICT r3 item 1); only meaningful for kernels with audited
+        # traffic accounting and only when the headline is live-TPU
+        hl_kernel = _JSON_STATE.get("kernel")
+        hl_rate = _JSON_STATE.get("value")
+        bpm = _BYTES_PER_MERGE.get(hl_kernel)
+        if (
+            bpm is not None
+            and hl_rate
+            and floor["floor_gb_per_s"] > 0  # rounded; a dead-slow tunnel can floor at 0.0
+            and _JSON_STATE.get("headline_source") == "live"
+            and _JSON_STATE.get("platform") == "tpu"
+        ):
+            eff = hl_rate * bpm / 1e9
+            emit(
+                headline_eff_gb_per_s=round(eff, 2),
+                headline_vs_floor=round(eff / floor["floor_gb_per_s"], 3),
+            )
     run_stage("tpu_validation", 240, bench_tpu_validation)
 
     if _JSON_STATE.get("value") is None:

@@ -27,6 +27,19 @@ Programs (shapes mirror bench.py's north star / BASELINE config 4):
 
 Run one `build` at a time (libtpu takes /tmp/libtpu_lockfile).
 Artifacts land in /tmp/aot_exec/ (tmpfs: rebuild after reboots).
+
+RETIRED (round 4, 2026-08-01): the first-ever `load` attempt through a
+live window failed with ``PJRT_Executable_DeserializeAndLoad: cached
+executable is axon format v268602841, this build is v9`` — the axon
+runtime only loads executables serialized by the axon client itself;
+blobs from the local libtpu compile-only topology are format-
+incompatible (reports/TPU_LATENCY.md item 7).  Kept for the build-side
+technique (offline Mosaic verification, reports/PALLAS_LOCAL_AOT.md),
+which remains the fast iteration loop for kernel debugging.  The
+working replacements are bench.py's axon-side self-banking
+(_pallas_bank_executable) and the repo-persistent JAX compilation
+cache (.jax_cache/), both populated by helper compiles on live
+windows.
 """
 from __future__ import annotations
 

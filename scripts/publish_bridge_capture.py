@@ -10,6 +10,13 @@ loads and it folds any green, fingerprint-fresh verdict into
 `BENCH_tpu_window.json`, which both the round driver (committed artifact)
 and bench.py's banked-seed path (VERDICT r4 item 2) consume.
 
+RETIRED (round 4, 2026-08-01): the bridge-load flow this publishes for
+is dead — the axon runtime rejects locally-serialized executables
+("axon format v9" mismatch, reports/TPU_LATENCY.md item 7), so no
+verdict JSONs are produced anymore.  bench.py now self-banks the
+axon-side executable and publishes through the watcher's publish_bench;
+this script is kept only as provenance for the r03 window artifacts.
+
 Idempotent; keeps the existing record's fields and only raises the
 headline, never lowers it.
 """

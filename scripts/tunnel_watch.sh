@@ -2,14 +2,16 @@
 # Poll the axon tunnel; whenever it is alive, run every capture step that
 # has not yet succeeded (marker files under /tmp/tw_done.<rev>), until all
 # have.  A window that closes mid-capture just means the remaining steps
-# retry on the next window.  ROUND-4 ORDER: headline first — the
-# AOT-bridge loads (incl. the compiled-Pallas execution, which does NOT
-# use the remote-compile helper) run before bench/profile/experiments,
-# because the one capture this round needs is the bridge execution and a
-# ~35-min window must not be eaten by secondary evidence.  The
-# remote-compile Mosaic attempts stay DEAD LAST: helper-path Mosaic
-# crashes have wedged the device for a whole window
-# (reports/TPU_LATENCY.md, PALLAS_TPU_ATTEMPT.txt).
+# retry on the next window.  ROUND-4 ORDER (post-bridge-retirement):
+# bench first — it banks every jnp metric, then attempts the
+# compiled-Pallas fused scan through the remote-compile helper as its
+# LAST stage (small program text: one Mosaic kernel; every known Mosaic
+# crash class was fixed offline in round 3) and self-banks the compiled
+# executable axon-side for compile-free reuse.  Then merge-parity
+# validation, the axon-serialize probe, and secondary evidence
+# (profile/experiments).  The standalone remote-compile Mosaic attempts
+# stay DEAD LAST: a helper-path Mosaic crash has wedged the device for
+# a whole window before (reports/TPU_LATENCY.md, PALLAS_TPU_ATTEMPT.txt).
 #
 # Markers are keyed to a content hash of the measured code paths, so a
 # capture from an older build never satisfies a step after bench/kernel
@@ -20,7 +22,7 @@ cd /root/repo
 # persistent XLA compilation cache: repeated captures across tunnel
 # windows skip recompiling unchanged programs, so a window spends its
 # minutes measuring instead of compiling
-export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_comp_cache}
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/root/repo/.jax_cache}
 # libtpu-init workaround from the captured Mosaic failure
 # (reports/PALLAS_TPU_ATTEMPT.txt:12-14) — every step that might compile
 # Pallas (bench auto-attempt, experiments_pallas, tpu_validate) needs it,
@@ -49,16 +51,23 @@ publish_bench() {  # publish_bench <log>
     # misses the next window (the driver commits uncommitted files).
     # captured_rev records BOTH the nearest commit (human-locatable
     # provenance) and the content hash the markers are keyed on.
+    # Publish ONLY a genuinely live on-chip headline: a banked-seed or
+    # watchdog-rescued record re-stamped with fresh captured_at/rev
+    # would launder stale provenance (code-review r4).
     python - "$1" "$(git rev-parse --short HEAD 2>/dev/null || echo norev).$REV" <<'EOF'
 import json, sys, time
 lines = [l for l in open(sys.argv[1]) if l.startswith('{"metric"')]
 if lines:
     rec = json.loads(lines[-1])
-    rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    rec["captured_rev"] = sys.argv[2]
-    with open("BENCH_tpu_window.json", "w") as f:
-        f.write(json.dumps(rec) + "\n")
-    print("published BENCH_tpu_window.json:", json.dumps(rec))
+    if (rec.get("headline_source") == "live" and rec.get("platform") == "tpu"
+            and not rec.get("budget_watchdog") and rec.get("value")):
+        rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        rec["captured_rev"] = sys.argv[2]
+        with open("BENCH_tpu_window.json", "w") as f:
+            f.write(json.dumps(rec) + "\n")
+        print("published BENCH_tpu_window.json:", json.dumps(rec))
+    else:
+        print("publish_bench: record not a live on-chip headline; not published")
 EOF
 }
 
@@ -78,16 +87,7 @@ for i in $(seq 1 600); do
     mkdir -p "$MARK"
     if timeout -k 15 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing (rev $REV)" | tee -a /tmp/tunnel_watch.log
-        # ROUND-4 ORDER: headline first.  Round 3's jnp window numbers
-        # are already banked; the ONE capture this round needs is the
-        # compiled-Pallas bridge execution (VERDICT r3 item 1), so the
-        # bridge steps run before anything that could eat a ~35-min
-        # window (profile/experiments burned 2400+5000s up front in the
-        # old order).  Risk accepted: a Mosaic-execution crash early in
-        # the window can cost the later jnp captures — the banked r03
-        # evidence plus the headline upside dominate.
-        #
-        # ROUND-4 UPDATE: the local-AOT bridge is DEAD — the axon
+        # ROUND-4 NOTE: the local-AOT bridge is DEAD — the axon
         # runtime only loads executables in its own serialization format
         # ("axon format v9"); blobs from the local libtpu compile-only
         # topology are rejected at PJRT_Executable_DeserializeAndLoad
@@ -109,7 +109,16 @@ for i in $(seq 1 600); do
             env CRDT_SKIP_TPU_VALIDATE=1 CRDT_BENCH_BUDGET_S=4200 \
             CRDT_BENCH_PROBE_TIMEOUT=900 \
             python bench.py; then
-            publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
+            # a watchdog-rescued run exits 0 by design (the DRIVER must
+            # see rc=0); for the WATCHER it is a failed capture — drop
+            # the marker so the bench re-runs on the next window
+            if tail -5 /tmp/bench_tpu3.log | grep -q '"budget_watchdog": "fired"'; then
+                echo "$(date -u +%H:%M:%S) bench watchdog fired - capture incomplete, re-arming" \
+                    | tee -a /tmp/tunnel_watch.log
+                rm -f "$MARK/bench"
+            else
+                publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
+            fi
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \
             python scripts/tpu_validate.py --merge
@@ -119,7 +128,7 @@ for i in $(seq 1 600); do
         #    is format-incompatible — see header)
         step axon_serialize 600 /tmp/axon_serialize_tpu.log \
             python scripts/axon_serialize_probe.py
-        # 5) secondary evidence, after everything headline-bearing
+        # 3) secondary evidence, after everything headline-bearing
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
         # the 7-mode layout A/B concluded in the 2026-07-31 window
@@ -134,7 +143,7 @@ for i in $(seq 1 600); do
             python scripts/layout_decision.py /tmp/experiments_tpu.log \
                 "$BLOG" >> /tmp/tunnel_watch.log 2>&1 || true
         fi
-        # 6) remote-compile Mosaic attempts DEAD LAST: these go through
+        # 4) remote-compile Mosaic attempts DEAD LAST: these go through
         #    the compile helper, whose Mosaic crashes have wedged the
         #    device for a whole window (PALLAS_TPU_ATTEMPT.txt:12-14)
         step pallas 1800 /tmp/pallas_tpu.log \
@@ -143,11 +152,6 @@ for i in $(seq 1 600); do
         step experiments_pallas 1800 /tmp/experiments_pallas_tpu.log \
             env CRDT_EXP_MODES=merge_pallas \
             python scripts/tpu_experiments.py
-        # final sweep: fold any green bridge verdicts into
-        # BENCH_tpu_window.json (idempotent, headline can only go up;
-        # bench.py's banked-seed path carries it into the driver artifact)
-        timeout -k 15 120 python scripts/publish_bridge_capture.py \
-            >> /tmp/tunnel_watch.log 2>&1 || true
         # done only when every step has its marker
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
            [ -e "$MARK/bench" ] && [ -e "$MARK/axon_serialize" ] && \

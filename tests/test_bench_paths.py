@@ -81,3 +81,37 @@ def test_salted_scan_matches_stepped_replay(n_chunks):
             np.asarray(g), np.asarray(w),
             err_msg=f"plane {i}: scan diverged from per-step replay",
         )
+
+
+def test_budget_watchdog_rescues_blocked_run():
+    """A stage blocked past the wall budget (e.g. a PJRT call into a
+    tunnel that wedged mid-run, 2026-08-01 window) must still produce a
+    parseable artifact line and rc=0 — the driver's own timeout killing
+    the bench at rc=124 is exactly what lost the round-3 artifact."""
+    import json
+    import subprocess
+    import sys
+
+    code = """
+import os, sys, time
+os.environ["CRDT_BENCH_BUDGET_S"] = "1"
+sys.path.insert(0, %r)
+import bench
+bench.emit(value=123.4, platform="tpu", kernel="x", headline_source="live")
+bench._install_budget_watchdog(grace_s=2.0)
+time.sleep(120)  # a blocked PJRT call never returns
+"""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", code % repo],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith('{"metric"')]
+    assert lines, proc.stdout
+    rec = json.loads(lines[-1])
+    assert rec["value"] == 123.4
+    assert rec["budget_watchdog"] == "fired"
+    assert "WATCHDOG" in proc.stderr

@@ -1048,12 +1048,8 @@ def bench_bandwidth_floor():
     else:
         n, a, m = 62_500, 64, 16
     rng = np.random.RandomState(7)
-    dots = jnp.asarray(
-        rng.randint(0, 100, size=(n, m, a)).astype(np.uint32)
-    )
-    dots_b = jnp.asarray(
-        rng.randint(0, 100, size=(n, m, a)).astype(np.uint32)
-    )
+    dots = jnp.asarray(rng.randint(0, 100, size=(n, m, a), dtype=np.uint32))
+    dots_b = jnp.asarray(rng.randint(0, 100, size=(n, m, a), dtype=np.uint32))
     t, _ = chain_timer(
         lambda s, db: (jnp.maximum(s[0], db),),
         (dots,),

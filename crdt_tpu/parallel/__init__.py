@@ -14,6 +14,13 @@ from ..config import enable_x64 as _enable_x64
 _enable_x64()
 
 from .mesh import make_mesh, replicate, shard_batch
+from .multihost import (
+    global_batch_from_local,
+    initialize,
+    local_shard,
+    make_multihost_mesh,
+    topology,
+)
 from .executor import JoinError, JoinExecutor, JoinStats, join_all
 from .collective import (
     all_reduce_clock_join,
@@ -46,4 +53,9 @@ __all__ = [
     "replicate",
     "shard_batch",
     "tree_reduce_merge",
+    "initialize",
+    "topology",
+    "make_multihost_mesh",
+    "global_batch_from_local",
+    "local_shard",
 ]

@@ -42,12 +42,26 @@ os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
 ART = "/tmp/aot_exec/axon_tiny.pkl"
 
 
+# error signatures that mean "the axon runtime does not do this", as
+# opposed to a transient tunnel/helper failure worth re-probing
+_STRUCTURAL_MARKERS = (
+    "unimplemented",
+    "not supported",
+    "unsupported",
+    "notimplemented",
+    "invalid_argument",
+    "axon format",
+)
+
+
 def _definitive(rec: dict) -> int:
     """Decide whether a serialize/deserialize failure is the ANSWER
     (axon doesn't support it → rc=0, the watcher marks the step done)
-    or a transient tunnel failure (→ rc=1, re-probe next window).  The
-    discriminator: can the device still run a trivial op right now?  If
-    yes, the failure was about serialization, not the window."""
+    or a transient failure (→ rc=1, re-probe next window).  Two gates:
+    the device must still run a trivial op (else the WINDOW died, not
+    the feature), and the error text must carry a structural signature
+    (unimplemented / unsupported / format mismatch) — a deadline or RPC
+    flap on a live device is still transient."""
     import jax
     import jax.numpy as jnp
 
@@ -56,10 +70,17 @@ def _definitive(rec: dict) -> int:
     except Exception as e:  # noqa: BLE001
         alive = False
         rec["aliveness_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    structural = any(
+        m in rec.get("error", "").lower() for m in _STRUCTURAL_MARKERS
+    )
     rec["device_alive_after_failure"] = alive
-    rec["verdict"] = "definitive_negative" if alive else "inconclusive_transient"
+    rec["error_is_structural"] = structural
+    definitive = alive and structural
+    rec["verdict"] = (
+        "definitive_negative" if definitive else "inconclusive_transient"
+    )
     print(json.dumps(rec))
-    return 0 if alive else 1
+    return 0 if definitive else 1
 
 
 def main() -> int:

@@ -59,8 +59,11 @@ import json, sys, time
 lines = [l for l in open(sys.argv[1]) if l.startswith('{"metric"')]
 if lines:
     rec = json.loads(lines[-1])
+    # budget_watchdog=fired does NOT disqualify: a headline that is
+    # live+tpu was measured in THIS window before the wedge — only
+    # banked/seed headlines (headline_source != live) would launder
     if (rec.get("headline_source") == "live" and rec.get("platform") == "tpu"
-            and not rec.get("budget_watchdog") and rec.get("value")):
+            and rec.get("value")):
         rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         rec["captured_rev"] = sys.argv[2]
         with open("BENCH_tpu_window.json", "w") as f:
@@ -109,15 +112,16 @@ for i in $(seq 1 600); do
             env CRDT_SKIP_TPU_VALIDATE=1 CRDT_BENCH_BUDGET_S=4200 \
             CRDT_BENCH_PROBE_TIMEOUT=900 \
             python bench.py; then
-            # a watchdog-rescued run exits 0 by design (the DRIVER must
-            # see rc=0); for the WATCHER it is a failed capture — drop
-            # the marker so the bench re-runs on the next window
+            # publish whatever live on-chip headline landed (the gate
+            # inside publish_bench refuses banked/seed records); a
+            # watchdog-rescued run exits 0 by design for the DRIVER,
+            # but for the WATCHER the capture is incomplete — drop the
+            # marker so the remaining stages re-run on the next window
+            publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
             if tail -5 /tmp/bench_tpu3.log | grep -q '"budget_watchdog": "fired"'; then
                 echo "$(date -u +%H:%M:%S) bench watchdog fired - capture incomplete, re-arming" \
                     | tee -a /tmp/tunnel_watch.log
                 rm -f "$MARK/bench"
-            else
-                publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
             fi
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \

@@ -1,0 +1,118 @@
+"""The multi-host backend's single-process contracts on the CPU mesh.
+
+``crdt_tpu.parallel.multihost`` scales the collective-join layer across
+hosts (DCN) and slices (ICI).  Real multi-process runs need a cluster;
+what MUST hold everywhere — and is tested here on the virtual 8-device
+mesh — is the degenerate-case contract: ``initialize`` is a no-op
+single-process, ``make_multihost_mesh`` yields a mesh the existing
+collectives run on unchanged (axis names are the single-host
+convention), ``local_shard`` tiles the object space exactly, and
+``global_batch_from_local`` assembles sharded global arrays that feed
+straight into a collective join.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_tpu import Orswot
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.parallel import (
+    allgather_join_orswot,
+    global_batch_from_local,
+    initialize,
+    local_shard,
+    make_multihost_mesh,
+    topology,
+)
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh (see conftest)"
+)
+
+
+def test_initialize_single_process_noop():
+    topo = initialize()  # no coordinator configured anywhere -> no-op
+    assert topo == topology()
+    assert topo["processes"] == 1
+    assert topo["process_id"] == 0
+    assert topo["devices"] == len(jax.devices())
+    # idempotent
+    assert initialize() == topo
+
+
+def test_make_multihost_mesh_single_process_merges_axes():
+    # dcn_axes degrade into plain mesh axes with one process; DCN-first
+    # ordering is preserved so specs written for the hybrid layout hold
+    mesh = make_multihost_mesh({"replicas": 2, "objects": 2}, {"pods": 2})
+    assert mesh.axis_names == ("pods", "replicas", "objects")
+    assert mesh.devices.shape == (2, 2, 2)
+
+    # no dcn axes at all -> identical to make_mesh
+    mesh2 = make_multihost_mesh({"replicas": 8})
+    assert mesh2.axis_names == ("replicas",)
+    assert mesh2.devices.shape == (8,)
+
+
+def test_local_shard_tiles_exactly():
+    for n, k in [(10, 3), (8, 8), (7, 2), (5, 6)]:
+        covered = []
+        for i in range(k):
+            s = local_shard(n, k, i)
+            covered.extend(range(n)[s])
+        assert covered == list(range(n)), (n, k)
+        sizes = [len(range(n)[local_shard(n, k, i)]) for i in range(k)]
+        assert max(sizes) - min(sizes) <= 1, (n, k)
+
+
+def test_global_batch_from_local_feeds_collective_join():
+    """Assemble per-'host' planes into a global sharded batch and run
+    the stock ORSWOT all-gather join over it — the multi-host ingest
+    path composing with the unchanged collective layer."""
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=16, deferred_capacity=8))
+    rng = np.random.RandomState(5)
+
+    n_replicas, n_objects = 4, 6
+    fleet = []
+    for r in range(n_replicas):
+        row = []
+        for i in range(n_objects):
+            o = Orswot()
+            for k in range(rng.randint(1, 4)):
+                actor = int(rng.randint(0, 4))
+                op = o.add(int(rng.randint(0, 10)), o.value().derive_add_ctx(actor))
+                o.apply(op)
+            row.append(o)
+        fleet.append(row)
+
+    batches = [OrswotBatch.from_scalar(row, uni) for row in fleet]
+    stacked_np = jax.tree_util.tree_map(
+        lambda *xs: np.asarray(jnp.stack(xs)), *batches
+    )
+
+    mesh = make_multihost_mesh({"replicas": 4, "objects": 2})
+    stacked = global_batch_from_local(mesh, stacked_np, axis="replicas")
+    for leaf in jax.tree_util.tree_leaves(stacked):
+        assert leaf.sharding.spec[0] == "replicas"
+
+    joined = allgather_join_orswot(stacked, mesh, axis="replicas")
+
+    expected = [Orswot() for _ in range(n_objects)]
+    for row in fleet:
+        for e, o in zip(expected, row):
+            e.merge(o)
+    for e in expected:
+        e.merge(Orswot())  # defer plunger
+
+    shard = OrswotBatch(
+        clock=joined.clock[0], ids=joined.ids[0], dots=joined.dots[0],
+        d_ids=joined.d_ids[0], d_clocks=joined.d_clocks[0],
+    )
+    plunged = shard.merge(OrswotBatch.zeros(n_objects, uni))
+    got = plunged.to_scalar(uni)
+    assert [sorted(g.value().val) for g in got] == [
+        sorted(e.value().val) for e in expected
+    ]

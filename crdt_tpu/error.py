@@ -58,10 +58,22 @@ class CapacityOverflowError(CrdtError, ValueError):
 def raise_for_overflow(overflow, context: str) -> None:
     """Reduce an ORSWOT overflow bitmap (``bool[..., 2]``, member/deferred
     flags in the last axis) and raise :class:`CapacityOverflowError` naming
-    the overflowed axes.  One host sync; no-op when nothing overflowed."""
+    the overflowed axes.  One host sync; no-op when nothing overflowed.
+
+    Multi-process arrays (a ``jax.distributed`` mesh spanning hosts) are
+    checked shard-locally: each process inspects the shards it can
+    address — an overflow raises on the process whose partition
+    overflowed, which is also the process that must regrow."""
     import numpy as np
 
-    flags = np.asarray(overflow).reshape(-1, 2).any(axis=0)
+    shards = getattr(overflow, "addressable_shards", None)
+    if shards is not None and not getattr(overflow, "is_fully_addressable", True):
+        flat = np.concatenate(
+            [np.asarray(s.data).reshape(-1, 2) for s in shards]
+        ) if shards else np.zeros((0, 2), bool)
+        flags = flat.any(axis=0)
+    else:
+        flags = np.asarray(overflow).reshape(-1, 2).any(axis=0)
     m_over, d_over = bool(flags[0]), bool(flags[1])
     if not (m_over or d_over):
         return

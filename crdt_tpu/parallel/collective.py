@@ -216,7 +216,8 @@ def gather_fold_orswot(local, axis: str, m_cap: int, d_cap: int,
 
 
 def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas",
-                          check: bool = True, impl: str | None = None):
+                          check: bool = True, impl: str | None = None,
+                          object_axis: str | None = None):
     """All-reduce ORSWOT state across a mesh axis with merge as the
     combiner; result is identical on every device and bit-equal to the
     scalar left-fold join in device order 0..D-1 (see
@@ -226,7 +227,14 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas",
     ``batch``: an :class:`OrswotBatch` whose leading axis is the replica
     axis, sharded one replica per device over ``axis``.  Raises on
     capacity overflow when ``check`` (pass ``check=False`` to skip the
-    host sync)."""
+    host sync).
+
+    ``object_axis``: optionally shard the OBJECT dimension over a second
+    mesh axis — the multi-host layout (``parallel.multihost``): objects
+    partition over the slow tier (DCN) with zero cross-partition join
+    traffic (each object's merge is independent,
+    `/root/reference/src/orswot.rs:89-156` is per-object), while the
+    replica collective stays on the fast tier."""
     from ..batch.orswot_batch import OrswotBatch
 
     m_cap = batch.ids.shape[-1]
@@ -234,7 +242,8 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas",
     _check_replica_axis(batch.clock.shape[0], mesh, axis)
     arrays = (batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks)
     join = _orswot_join_fn(
-        mesh, axis, m_cap, d_cap, tuple(a.ndim for a in arrays), impl
+        mesh, axis, m_cap, d_cap, tuple(a.ndim for a in arrays), impl,
+        object_axis,
     )
     (clock, ids, dots, d_ids, d_clocks), overflow = join(arrays)
     if check:
@@ -244,10 +253,13 @@ def allgather_join_orswot(batch, mesh: Mesh, axis: str = "replicas",
 
 @functools.lru_cache(maxsize=64)
 def _orswot_join_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int,
-                    ndims: tuple, impl: str | None = None):
+                    ndims: tuple, impl: str | None = None,
+                    object_axis: str | None = None):
     """Cached jitted ORSWOT collective join (see :func:`_clock_join_fn`)."""
-    specs = tuple(P(axis, *([None] * (nd - 1))) for nd in ndims)
-    over_spec = P(axis, None)
+    specs = tuple(
+        P(axis, object_axis, *([None] * (nd - 2))) for nd in ndims
+    )
+    over_spec = P(axis, object_axis)
 
     @jax.jit
     @functools.partial(
@@ -261,7 +273,20 @@ def _orswot_join_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int,
         acc, overflow = gather_fold_orswot(
             tuple(x[0] for x in local), axis, m_cap, d_cap, impl
         )
-        return tuple(x[None] for x in acc), jnp.any(overflow, axis=0)[None]
+        over = jnp.any(overflow, axis=0)[None]
+        if object_axis is not None:
+            # SPMD control-flow consistency: with objects sharded over a
+            # second (possibly multi-process) axis, a shard-local raise
+            # would diverge — the overflowed process raises while its
+            # peers proceed and then hang at the next collective.  OR
+            # the flags across the object axis so EVERY process takes
+            # the same raise/no-raise branch; regrowth is global anyway
+            # (with_capacity recompiles every process's program).
+            flags = jax.lax.pmax(
+                jnp.any(over, axis=(0, 1)).astype(jnp.int32), object_axis
+            )
+            over = jnp.broadcast_to(flags.astype(jnp.bool_), over.shape)
+        return tuple(x[None] for x in acc), over
 
     return _join
 

@@ -34,6 +34,16 @@ CPU mesh, a dev box), :func:`initialize` is a no-op and
 :func:`make_multihost_mesh` degrades to the plain device mesh, so the
 same program text runs everywhere — the multi-host path is a launch
 configuration, not a code path.
+
+**Interning across hosts.** Dense planes built on different hosts mix
+inside a cross-host collective, so the actor/member interning MUST be
+deterministic and shared: use ``Universe.identity`` (dense index ==
+value; what the native bulk wire codec requires anyway) or distribute
+one pre-agreed registry.  Per-host insertion-order registries map
+DIFFERENT actors to the SAME dense id and the join silently conflates
+them — caught the first time the two-process example ran
+(``examples/multihost_cpu.py``; ``tests/test_multihost_mp.py`` pins the
+working setup).
 """
 
 from __future__ import annotations
@@ -142,8 +152,15 @@ def make_multihost_mesh(
 
     from jax.experimental import mesh_utils
 
+    # granule choice: TPU pods group by slice_index; CPU multi-process
+    # (and single-slice multi-host) have no slice structure, so the
+    # process is the DCN granule
+    n_slices = len({getattr(d, "slice_index", None) for d in devices})
     dev_array = mesh_utils.create_hybrid_device_mesh(
-        list(ici_axes.values()), list(dcn_axes.values()), devices=devices
+        list(ici_axes.values()),
+        list(dcn_axes.values()),
+        devices=devices,
+        process_is_granule=(n_slices != int(np.prod(list(dcn_axes.values())))),
     )
     # hybrid layout: DCN dims lead the returned array
     names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())

@@ -116,3 +116,120 @@ def test_global_batch_from_local_feeds_collective_join():
     assert [sorted(g.value().val) for g in got] == [
         sorted(e.value().val) for e in expected
     ]
+
+
+def test_allgather_join_orswot_object_axis_sharded():
+    """The hybrid layout single-host: objects sharded over one mesh axis
+    (the DCN tier in a real deployment), replicas collectively joined
+    over the other — results must match the unsharded-object join and
+    the scalar oracle."""
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=16, deferred_capacity=8))
+    rng = np.random.RandomState(11)
+    n_replicas, n_objects = 4, 8
+
+    fleet = []
+    for r in range(n_replicas):
+        row = []
+        for i in range(n_objects):
+            o = Orswot()
+            for _ in range(rng.randint(1, 4)):
+                op = o.add(int(rng.randint(0, 12)),
+                           o.value().derive_add_ctx(int(rng.randint(0, 4))))
+                o.apply(op)
+            row.append(o)
+        fleet.append(row)
+
+    batches = [OrswotBatch.from_scalar(row, uni) for row in fleet]
+    stacked_np = jax.tree_util.tree_map(
+        lambda *xs: np.asarray(jnp.stack(xs)), *batches
+    )
+
+    mesh = make_multihost_mesh({"replicas": 4}, {"objects": 2})
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P("replicas", "objects",
+                                     *([None] * (x.ndim - 2))))
+        ),
+        stacked_np,
+    )
+    joined = allgather_join_orswot(
+        stacked, mesh, axis="replicas", object_axis="objects"
+    )
+
+    expected = [Orswot() for _ in range(n_objects)]
+    for row in fleet:
+        for e, o in zip(expected, row):
+            e.merge(o)
+    for e in expected:
+        e.merge(Orswot())
+
+    for r in range(n_replicas):
+        shard = OrswotBatch(
+            clock=joined.clock[r], ids=joined.ids[r], dots=joined.dots[r],
+            d_ids=joined.d_ids[r], d_clocks=joined.d_clocks[r],
+        )
+        got = shard.merge(OrswotBatch.zeros(n_objects, uni)).to_scalar(uni)
+        assert [sorted(g.value().val) for g in got] == [
+            sorted(e.value().val) for e in expected
+        ], f"replica {r}"
+
+
+def test_object_axis_overflow_flags_are_global():
+    """With objects sharded over a second axis, the overflow flags must
+    be identical on every object partition (OR-reduced across the axis)
+    — a shard-local flag would diverge SPMD control flow multi-process:
+    the overflowed process raises, its peers proceed and then hang at
+    the next collective."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from crdt_tpu.error import CapacityOverflowError
+
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=2, deferred_capacity=2))
+    n_replicas, n_objects = 4, 4
+
+    # only the LAST object's member union overflows m_cap=2
+    fleet = []
+    for r in range(n_replicas):
+        row = []
+        for i in range(n_objects):
+            o = Orswot()
+            members = [0] if i < n_objects - 1 else [r * 2, r * 2 + 1]
+            for m in members:
+                o.apply(o.add(m, o.value().derive_add_ctx(r)))
+            row.append(o)
+        fleet.append(row)
+
+    batches = [OrswotBatch.from_scalar(row, uni) for row in fleet]
+    stacked_np = jax.tree_util.tree_map(
+        lambda *xs: np.asarray(jnp.stack(xs)), *batches
+    )
+    mesh = make_multihost_mesh({"replicas": 4}, {"objects": 2})
+    stacked = jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P("replicas", "objects",
+                                     *([None] * (x.ndim - 2))))
+        ),
+        stacked_np,
+    )
+
+    # the public API must raise (host-side reduce sees the flag)...
+    with pytest.raises(CapacityOverflowError):
+        allgather_join_orswot(stacked, mesh, axis="replicas",
+                              object_axis="objects")
+
+    # ...and the on-device flags must already be global: every object
+    # partition carries the same OR-reduced [member, deferred] pair
+    from crdt_tpu.parallel.collective import _orswot_join_fn
+
+    arrays = (stacked.clock, stacked.ids, stacked.dots, stacked.d_ids,
+              stacked.d_clocks)
+    join = _orswot_join_fn(mesh, "replicas", 2, 2,
+                           tuple(a.ndim for a in arrays), None, "objects")
+    _, overflow = join(arrays)
+    per_shard = [np.asarray(s.data).reshape(-1, 2).any(axis=0)
+                 for s in overflow.addressable_shards]
+    for flags in per_shard[1:]:
+        np.testing.assert_array_equal(flags, per_shard[0])
+    assert per_shard[0][0]  # member overflow visible on EVERY partition

@@ -1,0 +1,36 @@
+"""True multi-process distributed joins (2 OS processes over Gloo).
+
+Spawns ``examples/multihost_cpu.py``: two processes × 4 virtual CPU
+devices join one ``jax.distributed`` runtime and run the stock
+collective join over the global mesh — XLA's cross-process collectives
+carry the state, the collective layer is unchanged.  Both advertised
+topologies must converge against the scalar oracle:
+
+* ``replicas`` — the all-gather itself crosses the process boundary;
+* ``hybrid``  — objects partition across processes (DCN tier, zero
+  cross-process join traffic), replicas join on each process's own
+  devices via ``object_axis=``.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.parametrize("topology", ["replicas", "hybrid"])
+def test_two_process_join_converges(topology):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "examples", "multihost_cpu.py"),
+            "--objects", "8", "--topology", topology,
+        ],
+        capture_output=True, text=True, timeout=400, env=env,
+    )
+    assert proc.returncode == 0, (proc.stdout[-400:], proc.stderr[-800:])
+    assert "demo: MULTIHOST OK" in proc.stdout
+    assert proc.stdout.count("MULTIHOST OK") == 3  # both workers + demo

@@ -40,6 +40,36 @@ class GCounterBatch:
     def to_scalar(self, universe: Universe) -> list[GCounter]:
         return [GCounter(vc) for vc in VClockBatch(clocks=self.clocks).to_scalar(universe)]
 
+    @classmethod
+    @gc_paused
+    def from_wire(cls, blobs: Sequence[bytes], universe: Universe) -> "GCounterBatch":
+        """Bulk ingest from wire blobs (``to_binary(gcounter)`` payloads,
+        `gcounter.rs:26-28`: a GCounter IS a VClock, so this is the
+        clock-body codec under the GCounter tag).  Contract as
+        :meth:`crdt_tpu.batch.OrswotBatch.from_wire`: identity universe +
+        native parallel parse, per-blob Python fallback, always equal to
+        ``from_scalar([from_binary(b) for b in blobs], uni)``."""
+        import jax.numpy as jnp
+
+        from .wirebulk import WIRE_TAG_GCOUNTER, clockish_from_wire
+
+        return cls(clocks=jnp.asarray(clockish_from_wire(
+            blobs, universe, WIRE_TAG_GCOUNTER,
+            lambda bs: cls.from_scalar(bs, universe).clocks,
+        )))
+
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs, byte-identical to
+        ``[to_binary(s) for s in self.to_scalar(uni)]``."""
+        from ..utils.serde import to_binary
+        from .wirebulk import WIRE_TAG_GCOUNTER, clockish_to_wire
+
+        return clockish_to_wire(
+            self.clocks, universe, WIRE_TAG_GCOUNTER,
+            lambda: [to_binary(s) for s in self.to_scalar(universe)],
+        )
+
     def merge(self, other: "GCounterBatch") -> "GCounterBatch":
         """`gcounter.rs:58-62`."""
         return GCounterBatch(clocks=_merge(self.clocks, other.clocks))

@@ -46,6 +46,37 @@ class PNCounterBatch:
         n = VClockBatch(clocks=self.planes[:, 1]).to_scalar(universe)
         return [PNCounter(GCounter(pi), GCounter(ni)) for pi, ni in zip(p, n)]
 
+    @classmethod
+    @gc_paused
+    def from_wire(cls, blobs: Sequence[bytes], universe: Universe) -> "PNCounterBatch":
+        """Bulk ingest from wire blobs (``to_binary(pncounter)`` payloads
+        — two clock bodies, P then N, `pncounter.rs:33-36`).  Contract as
+        :meth:`crdt_tpu.batch.OrswotBatch.from_wire`: identity universe +
+        native parallel parse, per-blob Python fallback, always equal to
+        ``from_scalar([from_binary(b) for b in blobs], uni)``."""
+        from .wirebulk import planes_from_wire
+
+        return cls(planes=jnp.asarray(planes_from_wire(
+            blobs, universe, "pncounter_ingest_wire",
+            lambda engine, buf, offsets, cfg, dt: engine.pncounter_ingest_wire(
+                buf, offsets, cfg.num_actors, dt
+            ),
+            lambda bs: cls.from_scalar(bs, universe).planes,
+        )))
+
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs, byte-identical to
+        ``[to_binary(s) for s in self.to_scalar(uni)]``."""
+        from ..utils.serde import to_binary
+        from .wirebulk import planes_to_wire
+
+        return planes_to_wire(
+            self.planes, universe, "pncounter_encode_wire",
+            lambda engine, host: engine.pncounter_encode_wire(host),
+            lambda: [to_binary(s) for s in self.to_scalar(universe)],
+        )
+
     def merge(self, other: "PNCounterBatch") -> "PNCounterBatch":
         """`pncounter.rs:90-95`."""
         return PNCounterBatch(planes=_merge(self.planes, other.planes))

@@ -63,6 +63,34 @@ class VClockBatch:
 
         return [row_to_vclock(row, universe) for row in np.asarray(self.clocks)]
 
+    @classmethod
+    @gc_paused
+    def from_wire(cls, blobs: Sequence[bytes], universe: Universe) -> "VClockBatch":
+        """Bulk ingest from wire blobs (``to_binary(vclock)`` payloads) —
+        the causality-kernel leg of the native bulk path (see
+        :meth:`crdt_tpu.batch.OrswotBatch.from_wire` for the contract:
+        identity universe + native parallel parse, per-blob Python
+        fallback outside the integer-keyed grammar, so the result always
+        equals ``from_scalar([from_binary(b) for b in blobs], uni)``)."""
+        from .wirebulk import WIRE_TAG_VCLOCK, clockish_from_wire
+
+        return cls(clocks=jnp.asarray(clockish_from_wire(
+            blobs, universe, WIRE_TAG_VCLOCK,
+            lambda bs: cls.from_scalar(bs, universe).clocks,
+        )))
+
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs, byte-identical to
+        ``[to_binary(s) for s in self.to_scalar(uni)]``."""
+        from ..utils.serde import to_binary
+        from .wirebulk import WIRE_TAG_VCLOCK, clockish_to_wire
+
+        return clockish_to_wire(
+            self.clocks, universe, WIRE_TAG_VCLOCK,
+            lambda: [to_binary(s) for s in self.to_scalar(universe)],
+        )
+
     # -- CRDT contracts ---------------------------------------------------
 
     def merge(self, other: "VClockBatch") -> "VClockBatch":

@@ -4,13 +4,19 @@ Each batch type's ``from_wire``/``to_wire`` follows the same shape
 (`OrswotBatch.from_wire` is the reference implementation): probe the
 native engine + identity universe, concatenate blobs, parse in
 parallel, patch/raise per the status array, fall back to the Python
-codec whenever the fast path cannot apply.  This module holds the two
-pieces that are identical across types so they cannot drift.
+codec whenever the fast path cannot apply.  This module holds the
+pieces that are identical across types so they cannot drift — including
+the whole counter-plane ingest/egress flow (status triage, per-blob
+patch splice, the u64-zigzag egress guard) shared by the clock-shaped
+legs (VClock / GCounter / PNCounter).
 """
 
 from __future__ import annotations
 
 from typing import Sequence
+
+WIRE_TAG_VCLOCK = 0x20    # serde.py _T_VCLOCK
+WIRE_TAG_GCOUNTER = 0x22  # serde.py _T_GCOUNTER
 
 
 def probe_engine(universe, fn_name: str, dtype=None):
@@ -56,3 +62,85 @@ def slice_blobs(buf, offsets) -> list[bytes]:
     mv = memoryview(buf)
     off = offsets.tolist()
     return [bytes(mv[off[i]:off[i + 1]]) for i in range(len(off) - 1)]
+
+
+def planes_from_wire(blobs, universe, probe_name, ingest, planes_of_scalars):
+    """Dense counter planes from wire blobs — the shared ingest flow of
+    the clock-shaped legs.
+
+    ``ingest(engine, buf, offsets, cfg, dtype) -> (planes, status)``
+    runs the type's native parser; ``planes_of_scalars(scalars)`` maps
+    decoded scalar states to dense planes (the calling class's
+    ``from_scalar(...)`` planes) and serves both the no-engine full
+    fallback and the per-blob patch path, so the result always equals
+    the pure-Python decode."""
+    import numpy as np
+
+    from ..config import counter_dtype
+    from ..utils.serde import from_binary
+
+    cfg = universe.config
+    engine = probe_engine(universe, probe_name, counter_dtype(cfg))
+    if engine is None:
+        return planes_of_scalars([from_binary(b) for b in blobs])
+    buf, offsets = concat_blobs(blobs)
+    planes, status = ingest(engine, buf, offsets, cfg, counter_dtype(cfg))
+    if status.any():
+        hard = np.nonzero(status > 1)[0]
+        if hard.size:
+            first = int(hard[0])
+            raise ValueError(
+                f"object {first}: actor outside the identity registry "
+                f"range [0, {cfg.num_actors})"
+            )
+        fb = np.nonzero(status == 1)[0].tolist()
+        sub = np.asarray(planes_of_scalars([from_binary(blobs[i]) for i in fb]))
+        planes[np.asarray(fb, dtype=np.int64)] = sub
+    return planes
+
+
+def planes_to_wire(planes, universe, probe_name, encode, python_path):
+    """Wire blobs from dense counter planes — the shared egress flow,
+    byte-identical to the scalar ``to_binary``.
+
+    ``encode(engine, planes) -> (buf, offsets)`` runs the type's native
+    encoder; ``python_path()`` is the full fallback: non-identity
+    universes, missing engine, or u64 counters at/above 2^63 — whose
+    zigzag encoding overflows the C emitter's uint64."""
+    import numpy as np
+
+    from ..config import counter_dtype
+
+    if planes.shape[0] == 0:
+        return []
+    engine = probe_engine(universe, probe_name, counter_dtype(universe.config))
+    host = None
+    if engine is not None:
+        host = np.asarray(planes)
+        if host.dtype.itemsize == 8 and int(host.max(initial=0)) >= 1 << 63:
+            engine = None
+    if engine is None:
+        return python_path()
+    buf, offsets = encode(engine, host)
+    return slice_blobs(buf, offsets)
+
+
+def clockish_from_wire(blobs, universe, tag, planes_of_scalars):
+    """``[N, A]`` planes from pure-clock-body blobs — the VClock/GCounter
+    legs' tag-parameterized specialization of :func:`planes_from_wire`."""
+    return planes_from_wire(
+        blobs, universe, "clockish_ingest_wire",
+        lambda engine, buf, offsets, cfg, dt: engine.clockish_ingest_wire(
+            buf, offsets, tag, cfg.num_actors, dt
+        ),
+        planes_of_scalars,
+    )
+
+
+def clockish_to_wire(clocks, universe, tag, python_path):
+    """Egress counterpart of :func:`clockish_from_wire`."""
+    return planes_to_wire(
+        clocks, universe, "clockish_encode_wire",
+        lambda engine, host: engine.clockish_encode_wire(host, tag),
+        python_path,
+    )

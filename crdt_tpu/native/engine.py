@@ -800,3 +800,91 @@ def gset_encode_wire(bits):
         _ptr(buf),
     )
     return buf, offsets
+
+
+# -- clock-shaped wire codecs (VClock / GCounter / PNCounter) ----------------
+# (tag constants live in crdt_tpu/batch/wirebulk.py, the single Python
+# source; callers pass them through)
+
+
+def clockish_ingest_wire(buf, offsets, tag: int, a: int, dtype):
+    """Parallel decode of pure-clock-body wire blobs (``0x20`` VClock /
+    ``0x22`` GCounter — `gcounter.rs:26-28`: a GCounter IS a VClock) into
+    dense ``[N, A]`` planes.  Returns ``(clocks, status)``; status codes
+    as the other legs (1 fallback, 4 actor out of range)."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    dt = np.dtype(dtype)
+    clocks = np.zeros((n, a), dtype=dt)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("clockish_ingest_wire", dt)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n), ctypes.c_int64(tag),
+        ctypes.c_int64(a), _ptr(clocks), _ptr(status),
+    )
+    return clocks, status
+
+
+def clockish_encode_wire(clocks, tag: int):
+    """Parallel encode of dense ``[N, A]`` clock planes to wire blobs
+    under the given tag — byte-identical to ``to_binary`` of the scalars
+    (identity universes).  Returns ``(buf, offsets)``."""
+    (clocks,) = _contig(clocks)
+    dt = _check_counters(clocks)
+    n, a = clocks.shape
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("clockish_encode_wire", dt)
+    fn(
+        _ptr(clocks), ctypes.c_int64(n), ctypes.c_int64(tag),
+        ctypes.c_int64(a), _ptr(offsets), None,
+    )
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(
+        _ptr(clocks), ctypes.c_int64(n), ctypes.c_int64(tag),
+        ctypes.c_int64(a), _ptr(offsets), _ptr(buf),
+    )
+    return buf, offsets
+
+
+def pncounter_ingest_wire(buf, offsets, a: int, dtype):
+    """Parallel PNCounter wire decode into stacked ``[N, 2, A]`` planes
+    (P = plane 0, `pncounter.rs:33-36`).  Returns ``(planes, status)``."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    dt = np.dtype(dtype)
+    planes = np.zeros((n, 2, a), dtype=dt)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("pncounter_ingest_wire", dt)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n), ctypes.c_int64(a),
+        _ptr(planes), _ptr(status),
+    )
+    return planes, status
+
+
+def pncounter_encode_wire(planes):
+    """Parallel PNCounter wire encode from ``[N, 2, A]`` planes.
+    Returns ``(buf, offsets)``."""
+    (planes,) = _contig(planes)
+    dt = _check_counters(planes)
+    n, two, a = planes.shape
+    if two != 2:
+        raise ValueError(f"PNCounter planes must be [N, 2, A], got {planes.shape}")
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("pncounter_encode_wire", dt)
+    fn(
+        _ptr(planes), ctypes.c_int64(n), ctypes.c_int64(a), _ptr(offsets),
+        None,
+    )
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(
+        _ptr(planes), ctypes.c_int64(n), ctypes.c_int64(a), _ptr(offsets),
+        _ptr(buf),
+    )
+    return buf, offsets

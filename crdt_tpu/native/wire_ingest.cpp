@@ -55,6 +55,8 @@ namespace {
 constexpr uint8_t kTagInt = 0x03;
 constexpr uint8_t kTagTuple = 0x08;
 constexpr uint8_t kTagVClock = 0x20;
+constexpr uint8_t kTagPNCounter = 0x23;  // 0x22 (gcounter) arrives via the
+                                         // clockish codec's tag parameter
 constexpr uint8_t kTagLWW = 0x24;
 constexpr uint8_t kTagMVReg = 0x25;
 constexpr uint8_t kTagOrswot = 0x26;
@@ -805,6 +807,209 @@ int64_t orswot_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
                                uint8_t* status) {
   return ingest_impl<uint64_t>(buf, offsets, n, A, M, D, clock, ids, dots,
                                d_ids, d_clocks, status);
+}
+
+}  // extern "C"
+
+// ---- clock-shaped wire codecs ---------------------------------------------
+//
+// The remaining wire-friendly batch types are pure clock bodies:
+//
+//   VCLOCK    := 0x20 clock_body          (vclock.rs — the causality kernel)
+//   GCOUNTER  := 0x22 clock_body          (gcounter.rs:26-28 — IS a VClock)
+//   PNCOUNTER := 0x23 clock_body clock_body   (pncounter.rs:33-36 — P then N)
+//
+// clock_body as in the ORSWOT grammar above; pair order on egress is the
+// encoded-key-bytes sort emit_clock_body already reproduces.  Dense
+// layouts: clocks[N, A] (vclock/gcounter), planes[N, 2, A] (pncounter,
+// P = plane 0).  One tag-parameterized implementation serves vclock and
+// gcounter; status codes match the other legs (1 fallback, 4 actor out
+// of range).
+
+namespace {
+
+template <typename C>
+int parse_clock_body(Cursor& c, int64_t A, C* row) {
+  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+  uint64_t n;
+  if (!c.uv(&n)) return 1;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t actor, counter;
+    if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
+    if (actor >= static_cast<uint64_t>(A)) return 4;
+    if (counter > kCounterMax) return 1;
+    // duplicate actor keys canonicalize last-wins, like every other
+    // leg's dense scatter (to_binary never emits them)
+    row[actor] = static_cast<C>(counter);
+  }
+  return 0;
+}
+
+template <typename C>
+int parse_clockish_one(const uint8_t* buf, int64_t lo, int64_t hi,
+                       uint8_t tag, int64_t A, C* row) {
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(tag)) return 1;
+  int st = parse_clock_body(c, A, row);
+  if (st) return st;
+  if (c.p != c.end) return 1;
+  return 0;
+}
+
+template <typename C>
+int parse_pncounter_one(const uint8_t* buf, int64_t lo, int64_t hi,
+                        int64_t A, C* planes) {
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(kTagPNCounter)) return 1;
+  int st = parse_clock_body(c, A, planes);      // P
+  if (st) return st;
+  st = parse_clock_body(c, A, planes + A);      // N
+  if (st) return st;
+  if (c.p != c.end) return 1;
+  return 0;
+}
+
+template <typename C>
+int64_t clockish_ingest_impl(const uint8_t* buf, const int64_t* offsets,
+                             int64_t n, uint8_t tag, int64_t A, C* clocks,
+                             uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 2048) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_clockish_one<C>(buf, offsets[i], offsets[i + 1], tag, A,
+                                   clocks + i * A);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      std::memset(clocks + i * A, 0, sizeof(C) * A);
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+template <typename C>
+int64_t pncounter_ingest_impl(const uint8_t* buf, const int64_t* offsets,
+                              int64_t n, int64_t A, C* planes,
+                              uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 2048) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_pncounter_one<C>(buf, offsets[i], offsets[i + 1], A,
+                                    planes + i * 2 * A);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      std::memset(planes + i * 2 * A, 0, sizeof(C) * 2 * A);
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+template <typename C>
+int64_t clockish_encode_one(uint8_t tag, const C* row, int64_t A,
+                            uint8_t* out) {
+  Emitter e{out};
+  std::vector<int64_t> scratch;
+  e.byte(tag);
+  emit_clock_body(e, row, A, scratch, out != nullptr);
+  return e.count;
+}
+
+template <typename C>
+int64_t pncounter_encode_one(const C* planes, int64_t A, uint8_t* out) {
+  Emitter e{out};
+  std::vector<int64_t> scratch;
+  const bool sorted = (out != nullptr);
+  e.byte(kTagPNCounter);
+  emit_clock_body(e, planes, A, scratch, sorted);
+  emit_clock_body(e, planes + A, A, scratch, sorted);
+  return e.count;
+}
+
+template <typename C>
+void clockish_encode_impl(const C* clocks, int64_t n, uint8_t tag, int64_t A,
+                          int64_t* offsets, uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 2048)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = clockish_encode_one<C>(tag, clocks + i * A, A, nullptr);
+    else
+      clockish_encode_one<C>(tag, clocks + i * A, A, buf + offsets[i]);
+  }
+}
+
+template <typename C>
+void pncounter_encode_impl(const C* planes, int64_t n, int64_t A,
+                           int64_t* offsets, uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 2048)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = pncounter_encode_one<C>(planes + i * 2 * A, A, nullptr);
+    else
+      pncounter_encode_one<C>(planes + i * 2 * A, A, buf + offsets[i]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t clockish_ingest_wire_u32(const uint8_t* buf, const int64_t* offsets,
+                                 int64_t n, int64_t tag, int64_t A,
+                                 uint32_t* clocks, uint8_t* status) {
+  return clockish_ingest_impl<uint32_t>(buf, offsets, n,
+                                        static_cast<uint8_t>(tag), A, clocks,
+                                        status);
+}
+
+int64_t clockish_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
+                                 int64_t n, int64_t tag, int64_t A,
+                                 uint64_t* clocks, uint8_t* status) {
+  return clockish_ingest_impl<uint64_t>(buf, offsets, n,
+                                        static_cast<uint8_t>(tag), A, clocks,
+                                        status);
+}
+
+void clockish_encode_wire_u32(const uint32_t* clocks, int64_t n, int64_t tag,
+                              int64_t A, int64_t* offsets, uint8_t* buf) {
+  clockish_encode_impl<uint32_t>(clocks, n, static_cast<uint8_t>(tag), A,
+                                 offsets, buf);
+}
+
+void clockish_encode_wire_u64(const uint64_t* clocks, int64_t n, int64_t tag,
+                              int64_t A, int64_t* offsets, uint8_t* buf) {
+  clockish_encode_impl<uint64_t>(clocks, n, static_cast<uint8_t>(tag), A,
+                                 offsets, buf);
+}
+
+int64_t pncounter_ingest_wire_u32(const uint8_t* buf, const int64_t* offsets,
+                                  int64_t n, int64_t A, uint32_t* planes,
+                                  uint8_t* status) {
+  return pncounter_ingest_impl<uint32_t>(buf, offsets, n, A, planes, status);
+}
+
+int64_t pncounter_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
+                                  int64_t n, int64_t A, uint64_t* planes,
+                                  uint8_t* status) {
+  return pncounter_ingest_impl<uint64_t>(buf, offsets, n, A, planes, status);
+}
+
+void pncounter_encode_wire_u32(const uint32_t* planes, int64_t n, int64_t A,
+                               int64_t* offsets, uint8_t* buf) {
+  pncounter_encode_impl<uint32_t>(planes, n, A, offsets, buf);
+}
+
+void pncounter_encode_wire_u64(const uint64_t* planes, int64_t n, int64_t A,
+                               int64_t* offsets, uint8_t* buf) {
+  pncounter_encode_impl<uint64_t>(planes, n, A, offsets, buf);
 }
 
 }  // extern "C"

@@ -489,3 +489,203 @@ def test_identity_universe_checkpoint_roundtrip():
     loaded, uni2 = load_bytes(save_bytes(batch, uni))
     assert uni2.is_identity
     assert loaded.to_scalar(uni2) == states
+
+
+# ---------------------------------------------------------------------------
+# clock-shaped legs: VClock / GCounter / PNCounter
+# ---------------------------------------------------------------------------
+
+
+def _random_vclock(rng, n_actors=8, hi=100):
+    from crdt_tpu.scalar.vclock import VClock
+
+    vc = VClock()
+    for a in range(n_actors):
+        if rng.rand() < 0.5:
+            vc.dots[a] = int(rng.randint(1, hi))
+    return vc
+
+
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_vclock_wire_roundtrip_and_parity(counter_bits):
+    """Causality-kernel leg of the bulk wire path (tag 0x20)."""
+    from crdt_tpu.batch.vclock_batch import VClockBatch
+
+    rng = np.random.RandomState(91)
+    uni = _identity_uni(counter_bits=counter_bits)
+    clocks = [_random_vclock(rng) for _ in range(40)]
+    blobs = [to_binary(c) for c in clocks]
+
+    got = VClockBatch.from_wire(blobs, uni)
+    want = VClockBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.clocks), np.asarray(want.clocks))
+
+    assert got.to_wire(uni) == blobs  # byte-identical egress
+    assert VClockBatch.from_wire(got.to_wire(uni), uni).to_scalar(uni) == clocks
+
+
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_gcounter_wire_roundtrip_and_parity(counter_bits):
+    """GCounter leg (tag 0x22 — a GCounter IS a VClock, gcounter.rs:26-28)."""
+    from crdt_tpu.batch.gcounter_batch import GCounterBatch
+    from crdt_tpu.scalar.gcounter import GCounter
+
+    rng = np.random.RandomState(92)
+    uni = _identity_uni(counter_bits=counter_bits)
+    states = [GCounter(_random_vclock(rng)) for _ in range(40)]
+    blobs = [to_binary(s) for s in states]
+
+    got = GCounterBatch.from_wire(blobs, uni)
+    want = GCounterBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.clocks), np.asarray(want.clocks))
+
+    assert got.to_wire(uni) == blobs
+    # values survive the loop (the counter's actual API surface)
+    assert [g.value() for g in GCounterBatch.from_wire(blobs, uni).to_scalar(uni)] == [
+        s.value() for s in states
+    ]
+
+
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_pncounter_wire_roundtrip_and_parity(counter_bits):
+    """PNCounter leg (tag 0x23 — two clock bodies, P then N)."""
+    from crdt_tpu.batch.pncounter_batch import PNCounterBatch
+    from crdt_tpu.scalar.gcounter import GCounter
+    from crdt_tpu.scalar.pncounter import PNCounter
+
+    rng = np.random.RandomState(93)
+    uni = _identity_uni(counter_bits=counter_bits)
+    states = [
+        PNCounter(GCounter(_random_vclock(rng)), GCounter(_random_vclock(rng)))
+        for _ in range(40)
+    ]
+    blobs = [to_binary(s) for s in states]
+
+    got = PNCounterBatch.from_wire(blobs, uni)
+    want = PNCounterBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.planes), np.asarray(want.planes))
+
+    assert got.to_wire(uni) == blobs
+    assert [p.value() for p in PNCounterBatch.from_wire(blobs, uni).to_scalar(uni)] == [
+        s.value() for s in states
+    ]
+
+
+def test_clockish_wire_empty_and_zero_rows():
+    """Empty batches and all-zero clocks round-trip (0-pair bodies)."""
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.batch.vclock_batch import VClockBatch
+
+    uni = _identity_uni()
+    assert VClockBatch.from_wire([], uni).clocks.shape == (0, 8)
+    assert VClockBatch.zeros(0, uni).to_wire(uni) == []
+
+    blobs = [to_binary(VClock()), to_binary(VClock({3: 7}))]
+    got = VClockBatch.from_wire(blobs, uni)
+    assert got.to_wire(uni) == blobs
+
+
+def test_clockish_wire_mixed_patch_path():
+    """u64 counters >= 2^63 are outside the native zigzag (status 1) but
+    fine for Python — drives the row-patch splice next to fast rows, and
+    the egress guard routes the whole batch through the Python encoder."""
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.batch.vclock_batch import VClockBatch
+
+    rng = np.random.RandomState(94)
+    uni = _identity_uni(counter_bits=64)
+    clocks = [_random_vclock(rng) for _ in range(10)]
+    clocks[3] = VClock({1: 2**63 + 11})
+    blobs = [to_binary(c) for c in clocks]
+    got = VClockBatch.from_wire(blobs, uni)
+    want = VClockBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.clocks), np.asarray(want.clocks))
+    assert int(np.asarray(got.clocks)[3, 1]) == 2**63 + 11
+    assert got.to_wire(uni) == blobs  # python-path egress, still byte-equal
+
+
+def test_clockish_wire_actor_out_of_range_raises():
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.batch.vclock_batch import VClockBatch
+    from crdt_tpu.batch.pncounter_batch import PNCounterBatch
+    from crdt_tpu.scalar.gcounter import GCounter
+    from crdt_tpu.scalar.pncounter import PNCounter
+
+    uni = _identity_uni()
+    with pytest.raises(ValueError, match="identity registry"):
+        VClockBatch.from_wire([to_binary(VClock({100: 1}))], uni)
+    bad = PNCounter(GCounter(VClock({0: 1})), GCounter(VClock({100: 1})))
+    with pytest.raises(ValueError, match="identity registry"):
+        PNCounterBatch.from_wire([to_binary(bad)], uni)
+
+
+def test_clockish_wire_duplicate_actor_canonicalizes_last_wins():
+    """Adversarial blob with a repeated actor key (to_binary never emits
+    one): the C scatter and the Python dict decode both keep the LAST
+    pair — the through-pipeline contract, like the ORSWOT leg's fuzz."""
+    import io
+
+    from crdt_tpu.batch.vclock_batch import VClockBatch
+
+    def uv(v):
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+
+    def pair(actor, counter):
+        return b"\x03" + uv(actor << 1) + b"\x03" + uv(counter << 1)
+
+    blob = b"\x20" + uv(2) + pair(1, 5) + pair(1, 9)
+    uni = _identity_uni()
+    got = VClockBatch.from_wire([blob], uni)
+    assert int(np.asarray(got.clocks)[0, 1]) == 9
+    # the Python pipeline agrees (dict insertion: last wins)
+    want = VClockBatch.from_scalar([from_binary(blob)], uni)
+    np.testing.assert_array_equal(np.asarray(got.clocks), np.asarray(want.clocks))
+
+
+def test_clockish_wire_non_identity_universe_falls_back():
+    """Interning universes take the Python path end-to-end; results and
+    bytes match the scalar pipeline exactly."""
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.batch.gcounter_batch import GCounterBatch
+    from crdt_tpu.scalar.gcounter import GCounter
+
+    cfg = CrdtConfig(num_actors=4)
+    uni = Universe(cfg)
+    states = [GCounter(VClock({"a": 3, "b": 1})), GCounter(VClock({"c": 9}))]
+    blobs = [to_binary(s) for s in states]
+    got = GCounterBatch.from_wire(blobs, uni)
+    assert got.to_scalar(uni) == states
+    assert got.to_wire(uni) == blobs
+
+
+def test_pncounter_wire_mixed_patch_path():
+    """PNCounter rides the shared planes_from_wire/planes_to_wire flow;
+    drive its status-1 splice (u64 counter >= 2^63 in the N plane) and
+    the egress guard through the public methods."""
+    from crdt_tpu.batch.pncounter_batch import PNCounterBatch
+    from crdt_tpu.scalar.gcounter import GCounter
+    from crdt_tpu.scalar.pncounter import PNCounter
+    from crdt_tpu.scalar.vclock import VClock
+
+    rng = np.random.RandomState(95)
+    uni = _identity_uni(counter_bits=64)
+    states = [
+        PNCounter(GCounter(_random_vclock(rng)), GCounter(_random_vclock(rng)))
+        for _ in range(8)
+    ]
+    states[5] = PNCounter(GCounter(VClock({0: 4})),
+                          GCounter(VClock({3: 2**63 + 7})))
+    blobs = [to_binary(s) for s in states]
+    got = PNCounterBatch.from_wire(blobs, uni)
+    want = PNCounterBatch.from_scalar([from_binary(b) for b in blobs], uni)
+    np.testing.assert_array_equal(np.asarray(got.planes), np.asarray(want.planes))
+    assert int(np.asarray(got.planes)[5, 1, 3]) == 2**63 + 7
+    assert got.to_wire(uni) == blobs  # python-path egress, byte-equal

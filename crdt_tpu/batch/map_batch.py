@@ -131,6 +131,122 @@ class MapBatch:
             kernel=kernel,
         )
 
+    @classmethod
+    @gc_paused
+    def from_wire(
+        cls, blobs: Sequence[bytes], universe: Universe, val_kernel
+    ) -> "MapBatch":
+        """Bulk ingest from wire blobs (``to_binary(map)`` payloads).
+
+        The native fast path covers the ``Map<int, MVReg<int>>``
+        monomorphization (``val_kernel`` is an ``MVRegKernel``, identity
+        universe); any other composition — and any blob outside the
+        integer-keyed grammar — takes the per-blob Python decoder, so the
+        result always equals
+        ``from_scalar([from_binary(b) for b in blobs], uni, val_kernel)``.
+        Other nestings bulk-transport via ``checkpoint.save_bytes``."""
+        import jax.numpy as jnp
+
+        from ..utils.serde import from_binary
+        from .val_kernels import MVRegKernel
+        from .wirebulk import concat_blobs, probe_engine
+
+        cfg = universe.config
+        engine = None
+        if type(val_kernel) is MVRegKernel:
+            engine = probe_engine(
+                universe, "map_mvreg_ingest_wire", counter_dtype(cfg)
+            )
+        if engine is None:
+            return cls.from_scalar(
+                [from_binary(b) for b in blobs], universe, val_kernel
+            )
+        buf, offsets = concat_blobs(blobs)
+        (clock, keys, eclocks, vclocks, vvals, d_keys, d_clocks,
+         status) = engine.map_mvreg_ingest_wire(
+            buf, offsets, cfg.num_actors, cfg.key_capacity,
+            cfg.deferred_capacity, cfg.mv_capacity, counter_dtype(cfg),
+        )
+        if status.any():
+            hard = np.nonzero(status > 1)[0]
+            if hard.size:
+                first = int(hard[0])
+                code = int(status[first])
+                if code == 2:
+                    raise ValueError(
+                        f"map {first} has more keys than key_capacity "
+                        f"{cfg.key_capacity}"
+                    )
+                if code == 3:
+                    raise ValueError(
+                        f"map {first} has more deferred rows than "
+                        f"deferred_capacity {cfg.deferred_capacity}"
+                    )
+                if code == 5:
+                    raise ValueError(
+                        f"map {first} has a value antichain wider than "
+                        f"mv_capacity {cfg.mv_capacity}"
+                    )
+                raise ValueError(
+                    f"map {first}: actor outside the identity registry "
+                    f"range [0, {cfg.num_actors})"
+                )
+            fb = np.nonzero(status == 1)[0].tolist()
+            sub = cls.from_scalar(
+                [from_binary(blobs[i]) for i in fb], universe, val_kernel
+            )
+            idx = np.asarray(fb, dtype=np.int64)
+            clock[idx] = np.asarray(sub.clock)
+            keys[idx] = np.asarray(sub.keys)
+            eclocks[idx] = np.asarray(sub.entry_clocks)
+            vclocks[idx] = np.asarray(sub.vals[0])
+            vvals[idx] = np.asarray(sub.vals[1])
+            d_keys[idx] = np.asarray(sub.d_keys)
+            d_clocks[idx] = np.asarray(sub.d_clocks)
+        return cls(
+            clock=jnp.asarray(clock),
+            keys=jnp.asarray(keys),
+            entry_clocks=jnp.asarray(eclocks),
+            vals=(jnp.asarray(vclocks), jnp.asarray(vvals)),
+            d_keys=jnp.asarray(d_keys),
+            d_clocks=jnp.asarray(d_clocks),
+            kernel=MapKernel.from_config(cfg, val_kernel),
+        )
+
+    @gc_paused
+    def to_wire(self, universe: Universe) -> list[bytes]:
+        """Bulk egress to wire blobs, byte-identical to
+        ``[to_binary(s) for s in self.to_scalar(uni)]`` (fast path for the
+        ``Map<int, MVReg<int>>`` monomorphization; u64 counters at/above
+        2^63 and other compositions take the Python encoder)."""
+        from ..utils.serde import to_binary
+        from .val_kernels import MVRegKernel
+        from .wirebulk import probe_engine, slice_blobs
+
+        if self.clock.shape[0] == 0:
+            return []
+        engine = None
+        if type(self.kernel.val_kernel) is MVRegKernel:
+            engine = probe_engine(
+                universe, "map_mvreg_encode_wire",
+                counter_dtype(universe.config),
+            )
+        planes = None
+        if engine is not None:
+            planes = tuple(np.asarray(x) for x in (
+                self.clock, self.keys, self.entry_clocks,
+                self.vals[0], self.vals[1], self.d_keys, self.d_clocks,
+            ))
+            counterish = (planes[0], planes[2], planes[3], planes[4], planes[6])
+            if planes[0].dtype.itemsize == 8 and any(
+                int(p.max(initial=0)) >= 1 << 63 for p in counterish
+            ):
+                engine = None
+        if engine is None:
+            return [to_binary(s) for s in self.to_scalar(universe)]
+        buf, offsets = engine.map_mvreg_encode_wire(*planes)
+        return slice_blobs(buf, offsets)
+
     @gc_paused
     def to_scalar(self, universe: Universe) -> list[Map]:
         kernel = self.kernel
@@ -146,7 +262,9 @@ class MapBatch:
 
         out = []
         for i in range(n):
-            m = Map(vk.default_scalar)
+            # a SERIALIZABLE val_type (the registered class / MapOf),
+            # not the bound factory — so to_binary(to_scalar()[i]) works
+            m = Map(vk.scalar_val_type())
             m.clock = row_to_vclock(clock[i], universe)
             for j in range(k):
                 if keys[i, j] == EMPTY:

@@ -119,6 +119,13 @@ class MVRegKernel:
 
         return MVReg()
 
+    def scalar_val_type(self):
+        """The serializable ``Map.val_type`` for this kernel (what
+        ``to_binary`` can round-trip, unlike the bound factory)."""
+        from ..scalar.mvreg import MVReg
+
+        return MVReg
+
     def from_scalar_vals(self, scalars, universe):
         from .mvreg_batch import MVRegBatch
 
@@ -257,6 +264,12 @@ class OrswotKernel:
 
         return Orswot()
 
+    def scalar_val_type(self):
+        """See :meth:`MVRegKernel.scalar_val_type`."""
+        from ..scalar.orswot import Orswot
+
+        return Orswot
+
     def from_scalar_vals(self, scalars, universe):
         from .orswot_batch import OrswotBatch
 
@@ -393,6 +406,12 @@ class MapKernel:
         from ..scalar.map import Map
 
         return Map(self.val_kernel.default_scalar)
+
+    def scalar_val_type(self):
+        """Nested maps serialize their val_type as ``MapOf(inner)``."""
+        from ..utils.serde import MapOf
+
+        return MapOf(self.val_kernel.scalar_val_type())
 
     def from_scalar_vals(self, scalars, universe):
         from .map_batch import MapBatch

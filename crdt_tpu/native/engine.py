@@ -888,3 +888,60 @@ def pncounter_encode_wire(planes):
         _ptr(buf),
     )
     return buf, offsets
+
+
+# -- Map<K, MVReg> wire codec ------------------------------------------------
+
+
+def map_mvreg_ingest_wire(buf, offsets, a: int, k: int, d: int, kv: int, dtype):
+    """Parallel Map<K, MVReg> wire decode into the dense Map planes.
+    Returns ``(clock, keys, eclocks, vclocks, vvals, d_keys, d_clocks,
+    status)``; status 5 = value antichain wider than ``kv``."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    dt = np.dtype(dtype)
+    clock = np.zeros((n, a), dtype=dt)
+    keys = np.full((n, k), -1, dtype=np.int32)
+    eclocks = np.zeros((n, k, a), dtype=dt)
+    vclocks = np.zeros((n, k, kv, a), dtype=dt)
+    vvals = np.zeros((n, k, kv), dtype=dt)
+    d_keys = np.full((n, d), -1, dtype=np.int32)
+    d_clocks = np.zeros((n, d, a), dtype=dt)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("map_mvreg_ingest_wire", dt)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n), ctypes.c_int64(a),
+        ctypes.c_int64(k), ctypes.c_int64(d), ctypes.c_int64(kv),
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(vclocks), _ptr(vvals),
+        _ptr(d_keys), _ptr(d_clocks), _ptr(status),
+    )
+    return clock, keys, eclocks, vclocks, vvals, d_keys, d_clocks, status
+
+
+def map_mvreg_encode_wire(clock, keys, eclocks, vclocks, vvals, d_keys,
+                          d_clocks):
+    """Parallel Map<K, MVReg> wire encode — byte-identical to
+    ``to_binary`` of the scalars (identity universes).
+    Returns ``(buf, offsets)``."""
+    clock, keys, eclocks, vclocks, vvals, d_keys, d_clocks = _contig(
+        clock, keys, eclocks, vclocks, vvals, d_keys, d_clocks
+    )
+    dt = _check_counters(clock, eclocks, vclocks, vvals, d_clocks)
+    n, a = clock.shape
+    k = keys.shape[1]
+    d = d_keys.shape[1]
+    kv = vvals.shape[2]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("map_mvreg_encode_wire", dt)
+    args = (
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(vclocks), _ptr(vvals),
+        _ptr(d_keys), _ptr(d_clocks), ctypes.c_int64(n), ctypes.c_int64(a),
+        ctypes.c_int64(k), ctypes.c_int64(d), ctypes.c_int64(kv),
+    )
+    fn(*args, _ptr(offsets), None)
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(*args, _ptr(offsets), _ptr(buf))
+    return buf, offsets

@@ -104,54 +104,21 @@ struct Cursor {
   }
 };
 
+// defined with the egress helpers below; declared here for the parsers'
+// canonical-order checks
+bool varint_bytes_less(uint64_t za, uint64_t zb);
+
+// deferred section (shared by ORSWOT and Map): uv groups, each a
+// clock-key tuple + member/key list.  One dense row per (clock, id)
+// pair; the witnessing clock is decoded once into a thread-local
+// scratch row and copied to every row buffered under it (matches
+// from_scalar's layout: `for member in members: one row sharing the
+// clock columns`).
 template <typename C>
-int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
-              int64_t M, int64_t D, C* clock, int32_t* ids, C* dots,
-              int32_t* d_ids, C* d_clocks) {
-  // counters beyond the counter dtype are NOT wrapped: the Python path
-  // (numpy conversion) raises OverflowError, so the fast path flags the
-  // blob for fallback and lets that exact behavior happen
+int parse_deferred_section(Cursor& c, int64_t A, int64_t D, int32_t* d_ids,
+                           C* d_clocks) {
   constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
-  Cursor c{buf + lo, buf + hi};
-  if (!c.byte(kTagOrswot)) return 1;
-
   uint64_t n;
-  // set clock
-  if (!c.uv(&n)) return 1;
-  for (uint64_t i = 0; i < n; ++i) {
-    uint64_t actor, counter;
-    if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
-    if (actor >= static_cast<uint64_t>(A)) return 4;
-    if (counter > kCounterMax) return 1;
-    clock[actor] = static_cast<C>(counter);
-  }
-
-  // member entries (dense slots in wire order — the same order the
-  // Python fallback's from_binary hands from_scalar)
-  if (!c.uv(&n)) return 1;
-  if (n > static_cast<uint64_t>(M)) return 2;
-  for (uint64_t e = 0; e < n; ++e) {
-    uint64_t member;
-    if (!c.nonneg(&member)) return 1;
-    if (member > 0x7FFFFFFFull) return 1;  // beyond int32 id space
-    ids[e] = static_cast<int32_t>(member);
-    if (!c.byte(kTagVClock)) return 1;
-    uint64_t k;
-    if (!c.uv(&k)) return 1;
-    C* row = dots + e * A;
-    for (uint64_t i = 0; i < k; ++i) {
-      uint64_t actor, counter;
-      if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
-      if (actor >= static_cast<uint64_t>(A)) return 4;
-      if (counter > kCounterMax) return 1;
-      row[actor] = static_cast<C>(counter);
-    }
-  }
-
-  // deferred: one dense row per (clock, member) pair.  The witnessing
-  // clock is decoded once into a thread-local scratch row and copied to
-  // every member row buffered under it (matches from_scalar's layout:
-  // `for member in members: one row sharing the clock columns`).
   if (!c.uv(&n)) return 1;
   static thread_local std::vector<C> scratch;
   int64_t drow = 0;
@@ -180,6 +147,63 @@ int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
       ++drow;
     }
   }
+  return 0;
+}
+
+template <typename C>
+int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
+              int64_t M, int64_t D, C* clock, int32_t* ids, C* dots,
+              int32_t* d_ids, C* d_clocks) {
+  // counters beyond the counter dtype are NOT wrapped: the Python path
+  // (numpy conversion) raises OverflowError, so the fast path flags the
+  // blob for fallback and lets that exact behavior happen
+  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(kTagOrswot)) return 1;
+
+  uint64_t n;
+  // set clock
+  if (!c.uv(&n)) return 1;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t actor, counter;
+    if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
+    if (actor >= static_cast<uint64_t>(A)) return 4;
+    if (counter > kCounterMax) return 1;
+    clock[actor] = static_cast<C>(counter);
+  }
+
+  // member entries (dense slots in wire order — the same order the
+  // Python fallback's from_binary hands from_scalar).  Members must be
+  // strictly ascending in encoded-key-bytes order — what to_binary
+  // always emits; a duplicate would silently yield two live slots where
+  // the Python dict decode dedupes into one, so anything non-canonical
+  // falls back to the Python path (which dedupes/handles it ITS way)
+  if (!c.uv(&n)) return 1;
+  if (n > static_cast<uint64_t>(M)) return 2;
+  uint64_t prev_member = 0;
+  for (uint64_t e = 0; e < n; ++e) {
+    uint64_t member;
+    if (!c.nonneg(&member)) return 1;
+    if (member > 0x7FFFFFFFull) return 1;  // beyond int32 id space
+    if (e > 0 && !varint_bytes_less(prev_member << 1, member << 1)) return 1;
+    prev_member = member;
+    ids[e] = static_cast<int32_t>(member);
+    if (!c.byte(kTagVClock)) return 1;
+    uint64_t k;
+    if (!c.uv(&k)) return 1;
+    C* row = dots + e * A;
+    for (uint64_t i = 0; i < k; ++i) {
+      uint64_t actor, counter;
+      if (!c.nonneg(&actor) || !c.nonneg(&counter)) return 1;
+      if (actor >= static_cast<uint64_t>(A)) return 4;
+      if (counter > kCounterMax) return 1;
+      row[actor] = static_cast<C>(counter);
+    }
+  }
+
+  // deferred: one dense row per (clock, member) pair
+  int st = parse_deferred_section<C>(c, A, D, d_ids, d_clocks);
+  if (st) return st;
   if (c.p != c.end) return 1;  // trailing bytes: not a lone ORSWOT blob
   return 0;
 }
@@ -337,40 +361,14 @@ void emit_clock_key(Emitter& e, const C* row, int64_t A,
   }
 }
 
+// deferred section on egress (shared by ORSWOT and Map): group live
+// rows by identical clock rows; each group is (encoded clock key,
+// sorted member blobs); groups sort by the encoded clock-key bytes.
+// D is small (a handful of rows), so the quadratic grouping is free.
 template <typename C>
-int64_t encode_one(const C* clock, const int32_t* ids, const C* dots,
-                   const int32_t* d_ids, const C* d_clocks, int64_t A,
-                   int64_t M, int64_t D, uint8_t* out) {
-  // out == nullptr is the counting pass: every blob's SIZE is
-  // order-invariant, so the sorts (and group-key staging buffers) are
-  // skipped there — the write pass alone pays for ordering
-  const bool sizing = (out == nullptr);
-  Emitter e{out};
-  std::vector<int64_t> scratch;
-  e.byte(kTagOrswot);
-  emit_clock_body(e, clock, A, scratch, !sizing);
-
-  // entries: member keys sorted by encoded bytes (0x03 + varint(2m))
-  std::vector<int64_t> slots;
-  for (int64_t s = 0; s < M; ++s)
-    if (ids[s] != kEmpty) slots.push_back(s);
-  if (!sizing)
-    std::sort(slots.begin(), slots.end(), [&](int64_t x, int64_t y) {
-      return varint_bytes_less(
-          static_cast<uint64_t>(static_cast<uint32_t>(ids[x])) << 1,
-          static_cast<uint64_t>(static_cast<uint32_t>(ids[y])) << 1);
-    });
-  e.uv(static_cast<uint64_t>(slots.size()));
-  for (int64_t s : slots) {
-    e.tagged_nonneg(static_cast<uint64_t>(static_cast<uint32_t>(ids[s])));
-    e.byte(kTagVClock);
-    emit_clock_body(e, dots + s * A, A, scratch, !sizing);
-  }
-
-  // deferred: group live rows by identical clock rows; each group is
-  // (encoded clock key, sorted member blobs); groups sort by the
-  // encoded clock-key bytes.  D is small (a handful of rows), so the
-  // quadratic grouping is free.
+void emit_deferred_section(Emitter& e, const int32_t* d_ids,
+                           const C* d_clocks, int64_t A, int64_t D,
+                           std::vector<int64_t>& scratch, bool sizing) {
   std::vector<int64_t> rows;
   for (int64_t r = 0; r < D; ++r)
     if (d_ids[r] != kEmpty) rows.push_back(r);
@@ -440,6 +438,40 @@ int64_t encode_one(const C* clock, const int32_t* ids, const C* dots,
     for (int64_t m : g.members)
       e.tagged_nonneg(static_cast<uint64_t>(static_cast<uint32_t>(m)));
   }
+}
+
+template <typename C>
+int64_t encode_one(const C* clock, const int32_t* ids, const C* dots,
+                   const int32_t* d_ids, const C* d_clocks, int64_t A,
+                   int64_t M, int64_t D, uint8_t* out) {
+  // out == nullptr is the counting pass: every blob's SIZE is
+  // order-invariant, so the sorts (and group-key staging buffers) are
+  // skipped there — the write pass alone pays for ordering
+  const bool sizing = (out == nullptr);
+  Emitter e{out};
+  std::vector<int64_t> scratch;
+  e.byte(kTagOrswot);
+  emit_clock_body(e, clock, A, scratch, !sizing);
+
+  // entries: member keys sorted by encoded bytes (0x03 + varint(2m))
+  std::vector<int64_t> slots;
+  for (int64_t s = 0; s < M; ++s)
+    if (ids[s] != kEmpty) slots.push_back(s);
+  if (!sizing)
+    std::sort(slots.begin(), slots.end(), [&](int64_t x, int64_t y) {
+      return varint_bytes_less(
+          static_cast<uint64_t>(static_cast<uint32_t>(ids[x])) << 1,
+          static_cast<uint64_t>(static_cast<uint32_t>(ids[y])) << 1);
+    });
+  e.uv(static_cast<uint64_t>(slots.size()));
+  for (int64_t s : slots) {
+    e.tagged_nonneg(static_cast<uint64_t>(static_cast<uint32_t>(ids[s])));
+    e.byte(kTagVClock);
+    emit_clock_body(e, dots + s * A, A, scratch, !sizing);
+  }
+
+  // deferred section
+  emit_deferred_section(e, d_ids, d_clocks, A, D, scratch, sizing);
   return e.count;
 }
 
@@ -1010,6 +1042,238 @@ void pncounter_encode_wire_u32(const uint32_t* planes, int64_t n, int64_t A,
 void pncounter_encode_wire_u64(const uint64_t* planes, int64_t n, int64_t A,
                                int64_t* offsets, uint8_t* buf) {
   pncounter_encode_impl<uint64_t>(planes, n, A, offsets, buf);
+}
+
+}  // extern "C"
+
+// ---- Map<K, MVReg> wire codec ---------------------------------------------
+//
+// The most common monomorphic Map composition (the one the multichip
+// dryrun and the reference's nested tests exercise).  Grammar
+// (serde.py Map branch, integer keys, named val_type "MVReg"):
+//
+//   MAP    := 0x27 valtype clock_body entries deferred
+//   valtype:= 0x50 uv(5) "MVReg"          (anything else: fallback)
+//   entries:= uv n, n * ( 0x03 uv zz(key) clock_body MVREG )
+//   MVREG  := 0x25 uv kv, kv * ( clock_body 0x03 uv zz(val) )
+//   deferred as the shared section (clock keys -> key ids).
+//
+// NB: unlike ORSWOT entries, the per-key entry clock body carries NO
+// 0x20 tag (serde writes the raw body), and the nested value arrives
+// fully tagged.  Dense planes: clock[N,A], keys[N,K], eclocks[N,K,A],
+// value antichains vclocks[N,K,KV,A] + vvals[N,K,KV], d_keys[N,D],
+// d_clocks[N,D,A].  Status: 0 ok, 1 fallback, 2 key overflow,
+// 3 deferred overflow, 4 actor out of range, 5 value overflow (> KV).
+
+namespace {
+
+constexpr uint8_t kTagMap = 0x27;
+constexpr uint8_t kTagValTypeNamed = 0x50;
+constexpr uint8_t kMVRegName[5] = {'M', 'V', 'R', 'e', 'g'};
+
+template <typename C>
+int parse_map_mvreg_one(const uint8_t* buf, int64_t lo, int64_t hi,
+                        int64_t A, int64_t K, int64_t D, int64_t KV,
+                        C* clock, int32_t* keys, C* eclocks, C* vclocks,
+                        C* vvals, int32_t* d_keys, C* d_clocks) {
+  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+  Cursor c{buf + lo, buf + hi};
+  if (!c.byte(kTagMap)) return 1;
+  // val_type header: only the named "MVReg" kernel parses fast
+  if (!c.byte(kTagValTypeNamed)) return 1;
+  uint64_t nlen;
+  if (!c.uv(&nlen) || nlen != 5) return 1;
+  if (c.p + 5 > c.end || std::memcmp(c.p, kMVRegName, 5) != 0) return 1;
+  c.p += 5;
+
+  int st = parse_clock_body(c, A, clock);
+  if (st) return st;
+
+  uint64_t n;
+  if (!c.uv(&n)) return 1;
+  if (n > static_cast<uint64_t>(K)) return 2;
+  // strictly ascending keys (canonical to_binary order) — a duplicate
+  // key would yield two live slots where the Python dict dedupes; see
+  // the matching check in parse_one
+  uint64_t prev_key = 0;
+  for (uint64_t e = 0; e < n; ++e) {
+    uint64_t key;
+    if (!c.nonneg(&key)) return 1;
+    if (key > 0x7FFFFFFFull) return 1;  // beyond int32 id space
+    if (e > 0 && !varint_bytes_less(prev_key << 1, key << 1)) return 1;
+    prev_key = key;
+    keys[e] = static_cast<int32_t>(key);
+    st = parse_clock_body(c, A, eclocks + e * A);  // raw body, no 0x20 tag
+    if (st) return st;
+    // nested MVReg value
+    if (!c.byte(kTagMVReg)) return 1;
+    uint64_t kv;
+    if (!c.uv(&kv)) return 1;
+    if (kv > static_cast<uint64_t>(KV)) return 5;
+    for (uint64_t j = 0; j < kv; ++j) {
+      st = parse_clock_body(c, A, vclocks + (e * KV + j) * A);
+      if (st) return st;
+      uint64_t val;
+      if (!c.nonneg(&val)) return 1;
+      if (val > 0x7FFFFFFFull || val > kCounterMax) return 1;
+      vvals[e * KV + j] = static_cast<C>(val);
+    }
+  }
+
+  st = parse_deferred_section<C>(c, A, D, d_keys, d_clocks);
+  if (st) return st;
+  if (c.p != c.end) return 1;
+  return 0;
+}
+
+template <typename C>
+int64_t map_mvreg_encode_one(const C* clock, const int32_t* keys,
+                             const C* eclocks, const C* vclocks,
+                             const C* vvals, int64_t A, int64_t K, int64_t D,
+                             int64_t KV, const int32_t* d_keys,
+                             const C* d_clocks, uint8_t* out) {
+  const bool sizing = (out == nullptr);
+  Emitter e{out};
+  std::vector<int64_t> scratch;
+  e.byte(kTagMap);
+  e.byte(kTagValTypeNamed);
+  e.uv(5);
+  for (uint8_t b : kMVRegName) e.byte(b);
+  emit_clock_body(e, clock, A, scratch, !sizing);
+
+  std::vector<int64_t> slots;
+  for (int64_t s = 0; s < K; ++s)
+    if (keys[s] != kEmpty) slots.push_back(s);
+  if (!sizing)
+    std::sort(slots.begin(), slots.end(), [&](int64_t x, int64_t y) {
+      return varint_bytes_less(
+          static_cast<uint64_t>(static_cast<uint32_t>(keys[x])) << 1,
+          static_cast<uint64_t>(static_cast<uint32_t>(keys[y])) << 1);
+    });
+  e.uv(static_cast<uint64_t>(slots.size()));
+  for (int64_t s : slots) {
+    e.tagged_nonneg(static_cast<uint64_t>(static_cast<uint32_t>(keys[s])));
+    emit_clock_body(e, eclocks + s * A, A, scratch, !sizing);
+    int64_t m = mvreg_encode_one<C>(vclocks + s * KV * A, vvals + s * KV,
+                                    KV, A, e.p);
+    if (e.p) e.p += m;
+    e.count += m;
+  }
+
+  emit_deferred_section(e, d_keys, d_clocks, A, D, scratch, sizing);
+  return e.count;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t map_mvreg_ingest_wire_u32(const uint8_t* buf, const int64_t* offsets,
+                                  int64_t n, int64_t A, int64_t K, int64_t D,
+                                  int64_t KV, uint32_t* clock, int32_t* keys,
+                                  uint32_t* eclocks, uint32_t* vclocks,
+                                  uint32_t* vvals, int32_t* d_keys,
+                                  uint32_t* d_clocks, uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 512) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_map_mvreg_one<uint32_t>(
+        buf, offsets[i], offsets[i + 1], A, K, D, KV, clock + i * A,
+        keys + i * K, eclocks + i * K * A, vclocks + i * K * KV * A,
+        vvals + i * K * KV, d_keys + i * D, d_clocks + i * D * A);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      std::memset(clock + i * A, 0, sizeof(uint32_t) * A);
+      std::memset(eclocks + i * K * A, 0, sizeof(uint32_t) * K * A);
+      std::memset(vclocks + i * K * KV * A, 0, sizeof(uint32_t) * K * KV * A);
+      std::memset(vvals + i * K * KV, 0, sizeof(uint32_t) * K * KV);
+      std::memset(d_clocks + i * D * A, 0, sizeof(uint32_t) * D * A);
+      for (int64_t j = 0; j < K; ++j) keys[i * K + j] = kEmpty;
+      for (int64_t j = 0; j < D; ++j) d_keys[i * D + j] = kEmpty;
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+int64_t map_mvreg_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
+                                  int64_t n, int64_t A, int64_t K, int64_t D,
+                                  int64_t KV, uint64_t* clock, int32_t* keys,
+                                  uint64_t* eclocks, uint64_t* vclocks,
+                                  uint64_t* vvals, int32_t* d_keys,
+                                  uint64_t* d_clocks, uint8_t* status) {
+  int64_t bad = 0;
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 512) reduction(+ : bad)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    int st = parse_map_mvreg_one<uint64_t>(
+        buf, offsets[i], offsets[i + 1], A, K, D, KV, clock + i * A,
+        keys + i * K, eclocks + i * K * A, vclocks + i * K * KV * A,
+        vvals + i * K * KV, d_keys + i * D, d_clocks + i * D * A);
+    status[i] = static_cast<uint8_t>(st);
+    if (st != 0) {
+      std::memset(clock + i * A, 0, sizeof(uint64_t) * A);
+      std::memset(eclocks + i * K * A, 0, sizeof(uint64_t) * K * A);
+      std::memset(vclocks + i * K * KV * A, 0, sizeof(uint64_t) * K * KV * A);
+      std::memset(vvals + i * K * KV, 0, sizeof(uint64_t) * K * KV);
+      std::memset(d_clocks + i * D * A, 0, sizeof(uint64_t) * D * A);
+      for (int64_t j = 0; j < K; ++j) keys[i * K + j] = kEmpty;
+      for (int64_t j = 0; j < D; ++j) d_keys[i * D + j] = kEmpty;
+      ++bad;
+    }
+  }
+  return bad;
+}
+
+void map_mvreg_encode_wire_u32(const uint32_t* clock, const int32_t* keys,
+                               const uint32_t* eclocks,
+                               const uint32_t* vclocks, const uint32_t* vvals,
+                               const int32_t* d_keys,
+                               const uint32_t* d_clocks, int64_t n, int64_t A,
+                               int64_t K, int64_t D, int64_t KV,
+                               int64_t* offsets, uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 512)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = map_mvreg_encode_one<uint32_t>(
+          clock + i * A, keys + i * K, eclocks + i * K * A,
+          vclocks + i * K * KV * A, vvals + i * K * KV, A, K, D, KV,
+          d_keys + i * D, d_clocks + i * D * A, nullptr);
+    else
+      map_mvreg_encode_one<uint32_t>(
+          clock + i * A, keys + i * K, eclocks + i * K * A,
+          vclocks + i * K * KV * A, vvals + i * K * KV, A, K, D, KV,
+          d_keys + i * D, d_clocks + i * D * A, buf + offsets[i]);
+  }
+}
+
+void map_mvreg_encode_wire_u64(const uint64_t* clock, const int32_t* keys,
+                               const uint64_t* eclocks,
+                               const uint64_t* vclocks, const uint64_t* vvals,
+                               const int32_t* d_keys,
+                               const uint64_t* d_clocks, int64_t n, int64_t A,
+                               int64_t K, int64_t D, int64_t KV,
+                               int64_t* offsets, uint8_t* buf) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 512)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    if (buf == nullptr)
+      offsets[i + 1] = map_mvreg_encode_one<uint64_t>(
+          clock + i * A, keys + i * K, eclocks + i * K * A,
+          vclocks + i * K * KV * A, vvals + i * K * KV, A, K, D, KV,
+          d_keys + i * D, d_clocks + i * D * A, nullptr);
+    else
+      map_mvreg_encode_one<uint64_t>(
+          clock + i * A, keys + i * K, eclocks + i * K * A,
+          vclocks + i * K * KV * A, vvals + i * K * KV, A, K, D, KV,
+          d_keys + i * D, d_clocks + i * D * A, buf + offsets[i]);
+  }
 }
 
 }  // extern "C"

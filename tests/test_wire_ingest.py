@@ -689,3 +689,233 @@ def test_pncounter_wire_mixed_patch_path():
     np.testing.assert_array_equal(np.asarray(got.planes), np.asarray(want.planes))
     assert int(np.asarray(got.planes)[5, 1, 3]) == 2**63 + 7
     assert got.to_wire(uni) == blobs  # python-path egress, byte-equal
+
+
+# ---------------------------------------------------------------------------
+# Map<K, MVReg> leg
+# ---------------------------------------------------------------------------
+
+
+def _random_map_mvregs(rng, n, n_actors=8, deferred_frac=0.3):
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    maps = []
+    for i in range(n):
+        m = Map(MVReg)
+        for _ in range(int(rng.randint(0, 4))):
+            key = int(rng.randint(0, 30))
+            actor = int(rng.randint(0, n_actors))
+            ctx = m.get(key).derive_add_ctx(actor)
+            val = int(rng.randint(0, 100))
+            m.apply(m.update(key, ctx, lambda v, c, _v=val: v.set(_v, c)))
+        if rng.rand() < deferred_frac and m.entries:
+            key = next(iter(m.entries))
+            ctx = m.get(key).derive_rm_ctx()
+            ctx.clock.witness(int(rng.randint(0, n_actors)),
+                              int(rng.randint(100, 200)))
+            m.apply(m.rm(key, ctx))
+        maps.append(m)
+    return maps
+
+
+def _map_uni(counter_bits=64):
+    return Universe.identity(CrdtConfig(
+        num_actors=8, key_capacity=4, deferred_capacity=4, mv_capacity=2,
+        counter_bits=counter_bits,
+    ))
+
+
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_map_mvreg_wire_roundtrip_and_parity(counter_bits):
+    """Map<K, MVReg> leg: ingest matches the Python pipeline plane-for-
+    plane (wire order == decode order), egress is byte-identical to
+    to_binary, round trip is the identity on scalars incl. deferred."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+
+    rng = np.random.RandomState(101)
+    uni = _map_uni(counter_bits)
+    vk = MVRegKernel.from_config(uni.config)
+    maps = _random_map_mvregs(rng, 30)
+    blobs = [to_binary(m) for m in maps]
+
+    got = MapBatch.from_wire(blobs, uni, vk)
+    want = MapBatch.from_scalar([from_binary(b) for b in blobs], uni, vk)
+    np.testing.assert_array_equal(np.asarray(got.clock), np.asarray(want.clock))
+    np.testing.assert_array_equal(np.asarray(got.keys), np.asarray(want.keys))
+    np.testing.assert_array_equal(
+        np.asarray(got.entry_clocks), np.asarray(want.entry_clocks))
+    np.testing.assert_array_equal(np.asarray(got.vals[0]), np.asarray(want.vals[0]))
+    np.testing.assert_array_equal(np.asarray(got.vals[1]), np.asarray(want.vals[1]))
+    assert got.to_scalar(uni) == maps  # full state incl. deferred
+
+    out = got.to_wire(uni)
+    assert out == blobs  # byte-identical egress
+    assert MapBatch.from_wire(out, uni, vk).to_scalar(uni) == maps
+
+
+def test_map_wire_non_mvreg_kernel_falls_back():
+    """Map<K, Orswot> has no native codec — the Python path serves both
+    directions with identical results (and bytes)."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import OrswotKernel
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.orswot import Orswot
+
+    uni = _map_uni()
+    vk = OrswotKernel.from_config(uni.config)
+    m = Map(Orswot)
+    ctx = m.get(3).derive_add_ctx(1)
+    m.apply(m.update(3, ctx, lambda v, c: v.add(7, c)))
+    blobs = [to_binary(m)]
+    got = MapBatch.from_wire(blobs, uni, vk)
+    assert got.to_scalar(uni) == [m]
+    assert got.to_wire(uni) == blobs
+
+
+def test_map_wire_overflow_and_actor_errors():
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    uni = _map_uni()
+    vk = MVRegKernel.from_config(uni.config)
+    # key overflow: 5 keys > key_capacity 4 — same error class as
+    # from_scalar
+    m = Map(MVReg)
+    for key in range(5):
+        ctx = m.get(key).derive_add_ctx(0)
+        m.apply(m.update(key, ctx, lambda v, c: v.set(1, c)))
+    with pytest.raises(ValueError, match="key_capacity"):
+        MapBatch.from_wire([to_binary(m)], uni, vk)
+    # actor out of the identity range
+    m2 = Map(MVReg)
+    ctx = m2.get(1).derive_add_ctx(100)
+    m2.apply(m2.update(1, ctx, lambda v, c: v.set(1, c)))
+    with pytest.raises(ValueError, match="identity registry"):
+        MapBatch.from_wire([to_binary(m2)], uni, vk)
+
+
+def test_map_wire_mixed_patch_path():
+    """A u64 counter >= 2^63 is outside the native zigzag (status 1) but
+    fine for the Python big-int decoder — drives the row-patch splice
+    alongside natively-parsed maps, and the egress guard routes the
+    whole batch through the Python encoder."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    rng = np.random.RandomState(103)
+    uni = _map_uni(counter_bits=64)
+    vk = MVRegKernel.from_config(uni.config)
+    maps = _random_map_mvregs(rng, 8)
+    big = Map(MVReg)
+    ctx = big.get(2).derive_add_ctx(1)
+    big.apply(big.update(2, ctx, lambda v, c: v.set(5, c)))
+    big.clock.witness(3, 2**63 + 17)  # only the Python decoder lands this
+    maps[4] = big
+    blobs = [to_binary(m) for m in maps]
+    got = MapBatch.from_wire(blobs, uni, vk)
+    want = MapBatch.from_scalar([from_binary(b) for b in blobs], uni, vk)
+    np.testing.assert_array_equal(np.asarray(got.clock), np.asarray(want.clock))
+    np.testing.assert_array_equal(np.asarray(got.vals[0]), np.asarray(want.vals[0]))
+    assert int(np.asarray(got.clock)[4, 3]) == 2**63 + 17
+    assert got.to_wire(uni) == blobs  # python-path egress, byte-equal
+
+
+def test_map_to_scalar_val_type_is_serializable():
+    """to_scalar must hand back Maps whose val_type survives to_binary —
+    the registered class (or MapOf for nesting), not the kernel's bound
+    factory (which _encode_val_type rejects)."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import MapKernel, MVRegKernel
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    uni = _map_uni()
+    vk = MVRegKernel.from_config(uni.config)
+    m = Map(MVReg)
+    ctx = m.get(1).derive_add_ctx(0)
+    m.apply(m.update(1, ctx, lambda v, c: v.set(9, c)))
+    got = MapBatch.from_scalar([m], uni, vk).to_scalar(uni)
+    assert from_binary(to_binary(got[0])) == m  # round-trips
+    # nested kernel maps to MapOf(MVReg)
+    nested = MapKernel.from_config(uni.config, vk)
+    t = nested.scalar_val_type()
+    from crdt_tpu.utils.serde import MapOf
+    assert isinstance(t, MapOf) and t.inner is MVReg
+
+
+def test_map_wire_deferred_and_value_overflow_errors():
+    """Status 3 (deferred rows > deferred_capacity) and status 5 (value
+    antichain > mv_capacity) raise the same error class as from_scalar."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    uni = _map_uni()  # deferred_capacity=4, mv_capacity=2
+    vk = MVRegKernel.from_config(uni.config)
+
+    # 5 deferred rows > capacity 4
+    m = Map(MVReg)
+    for key in range(5):
+        ctx = m.get(key).derive_rm_ctx()
+        ctx.clock.witness(key % 8, 100 + key)  # future: buffers
+        m.apply(m.rm(key, ctx))
+    with pytest.raises(ValueError, match="deferred_capacity"):
+        MapBatch.from_wire([to_binary(m)], uni, vk)
+
+    # a 3-wide antichain > mv_capacity 2
+    regs = []
+    for actor in range(3):
+        r = Map(MVReg)
+        ctx = r.get(1).derive_add_ctx(actor)
+        r.apply(r.update(1, ctx, lambda v, c, _a=actor: v.set(_a, c)))
+        regs.append(r)
+    merged = regs[0]
+    merged.merge(regs[1])
+    merged.merge(regs[2])
+    with pytest.raises(ValueError, match="mv_capacity"):
+        MapBatch.from_wire([to_binary(merged)], uni, vk)
+
+
+def test_map_wire_duplicate_key_blob_falls_back():
+    """An adversarial blob repeating an entry key (to_binary never emits
+    one) must NOT fast-parse into two live slots — non-canonical key
+    order falls back to the Python decoder, whose dict dedupes; the
+    contract `from_wire == from_scalar(from_binary)` holds."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    uni = _map_uni()
+    vk = MVRegKernel.from_config(uni.config)
+
+    def uv(v):
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                return bytes(out)
+
+    def iv(v):  # 0x03 + zigzag varint (non-negative)
+        return b"\x03" + uv(v << 1)
+
+    clock_body = uv(1) + iv(1) + iv(1)          # {actor 1: 1}
+    mvreg = b"\x25" + uv(1) + clock_body + iv(3)  # one (clock, val=3) pair
+    entry = iv(7) + clock_body + mvreg           # key 7
+    forged = (b"\x27" + b"\x50" + uv(5) + b"MVReg"
+              + clock_body + uv(2) + entry + entry + uv(0))
+    got = MapBatch.from_wire([forged], uni, vk)
+    want = MapBatch.from_scalar([from_binary(forged)], uni, vk)
+    np.testing.assert_array_equal(np.asarray(got.keys), np.asarray(want.keys))
+    assert (np.asarray(got.keys)[0] != -1).sum() == 1  # deduped, one slot

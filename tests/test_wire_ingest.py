@@ -919,3 +919,78 @@ def test_map_wire_duplicate_key_blob_falls_back():
     want = MapBatch.from_scalar([from_binary(forged)], uni, vk)
     np.testing.assert_array_equal(np.asarray(got.keys), np.asarray(want.keys))
     assert (np.asarray(got.keys)[0] != -1).sum() == 1  # deduped, one slot
+
+
+@given(
+    seed=st.integers(0, 999),
+    pos=st.integers(0, 4096),
+    byte=st.integers(0, 255),
+    mode=st.sampled_from(["flip", "insert", "delete", "truncate"]),
+    leg=st.sampled_from(["vclock", "pncounter", "map"]),
+)
+def test_new_leg_parsers_total_on_mutated_blobs(seed, pos, byte, mode, leg):
+    """Mutation-fuzz totality for the round-4 parsers (clockish /
+    PNCounter / Map<K, MVReg>) — same contract as the ORSWOT fuzz: any
+    mutation of a valid blob either ingests to exactly what the Python
+    pipeline produces through the dense engine, or raises the codec's
+    contract exceptions.  Never crash, never silently diverge."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.pncounter_batch import PNCounterBatch
+    from crdt_tpu.batch.vclock_batch import VClockBatch
+    from crdt_tpu.batch.val_kernels import MVRegKernel
+    from crdt_tpu.scalar.gcounter import GCounter
+    from crdt_tpu.scalar.pncounter import PNCounter
+
+    rng = np.random.RandomState(seed)
+    if leg == "map":
+        uni = _map_uni()
+        vk = MVRegKernel.from_config(uni.config)
+        state = _random_map_mvregs(rng, 1)[0]
+        ingest = lambda blob: MapBatch.from_wire([blob], uni, vk)
+        pipeline = lambda blob: MapBatch.from_scalar(
+            [from_binary(blob)], uni, vk)
+    elif leg == "pncounter":
+        uni = _identity_uni()
+        state = PNCounter(GCounter(_random_vclock(rng)),
+                          GCounter(_random_vclock(rng)))
+        ingest = lambda blob: PNCounterBatch.from_wire([blob], uni)
+        pipeline = lambda blob: PNCounterBatch.from_scalar(
+            [from_binary(blob)], uni)
+    else:
+        uni = _identity_uni()
+        state = _random_vclock(rng)
+        ingest = lambda blob: VClockBatch.from_wire([blob], uni)
+        pipeline = lambda blob: VClockBatch.from_scalar(
+            [from_binary(blob)], uni)
+
+    data = bytearray(to_binary(state))
+    if mode == "insert":
+        pos %= len(data) + 1
+        data.insert(pos, byte)
+    else:
+        pos %= max(1, len(data))
+        if mode == "flip":
+            data[pos] = byte
+        elif mode == "delete":
+            del data[pos]
+        else:
+            data = data[:pos]
+    blob = bytes(data)
+
+    try:
+        want = pipeline(blob).to_scalar(uni)
+    except Exception:
+        want = None
+    try:
+        got = ingest(blob)
+    except (ValueError, OverflowError, TypeError, AttributeError):
+        # the python pipeline must reject it too (from_wire's fallback IS
+        # the python pipeline, and its hard errors are the same checks)
+        assert want is None, (
+            f"{leg} from_wire rejected a blob the python pipeline accepts"
+        )
+        return
+    assert want is not None, (
+        f"{leg} from_wire accepted a blob the python pipeline rejects"
+    )
+    assert got.to_scalar(uni) == want

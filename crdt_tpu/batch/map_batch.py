@@ -40,6 +40,18 @@ def _clock_to_row(vc: VClock, row, universe: Universe) -> None:
         row[universe.actor_idx(actor)] = counter
 
 
+def _map_wire_leg(val_kernel) -> str | None:
+    """The native wire-codec leg name for a value kernel, or None when
+    only the Python path serves this composition."""
+    from .val_kernels import MVRegKernel, OrswotKernel
+
+    if type(val_kernel) is MVRegKernel:
+        return "mvreg"
+    if type(val_kernel) is OrswotKernel:
+        return "orswot"
+    return None
+
+
 @struct.dataclass
 class MapBatch:
     clock: jax.Array  # u64[N, A]
@@ -138,35 +150,53 @@ class MapBatch:
     ) -> "MapBatch":
         """Bulk ingest from wire blobs (``to_binary(map)`` payloads).
 
-        The native fast path covers the ``Map<int, MVReg<int>>``
-        monomorphization (``val_kernel`` is an ``MVRegKernel``, identity
-        universe); any other composition — and any blob outside the
-        integer-keyed grammar — takes the per-blob Python decoder, so the
-        result always equals
+        The native fast path covers the ``Map<int, MVReg<int>>`` and
+        ``Map<int, Orswot<int>>`` monomorphizations (identity universe);
+        any other composition — and any blob outside the integer-keyed
+        grammar — takes the per-blob Python decoder, so the result always
+        equals
         ``from_scalar([from_binary(b) for b in blobs], uni, val_kernel)``.
         Other nestings bulk-transport via ``checkpoint.save_bytes``."""
         import jax.numpy as jnp
 
         from ..utils.serde import from_binary
-        from .val_kernels import MVRegKernel
         from .wirebulk import concat_blobs, probe_engine
 
         cfg = universe.config
+        leg = _map_wire_leg(val_kernel)
         engine = None
-        if type(val_kernel) is MVRegKernel:
+        if leg is not None:
             engine = probe_engine(
-                universe, "map_mvreg_ingest_wire", counter_dtype(cfg)
+                universe, f"map_{leg}_ingest_wire", counter_dtype(cfg)
             )
         if engine is None:
             return cls.from_scalar(
                 [from_binary(b) for b in blobs], universe, val_kernel
             )
         buf, offsets = concat_blobs(blobs)
-        (clock, keys, eclocks, vclocks, vvals, d_keys, d_clocks,
-         status) = engine.map_mvreg_ingest_wire(
-            buf, offsets, cfg.num_actors, cfg.key_capacity,
-            cfg.deferred_capacity, cfg.mv_capacity, counter_dtype(cfg),
-        )
+        if leg == "mvreg":
+            (clock, keys, eclocks, *val_planes,
+             d_keys, d_clocks, status) = engine.map_mvreg_ingest_wire(
+                buf, offsets, cfg.num_actors, cfg.key_capacity,
+                cfg.deferred_capacity, val_kernel.mv_capacity,
+                counter_dtype(cfg),
+            )
+            value_overflow_msg = (
+                f"a value antichain wider than mv_capacity "
+                f"{val_kernel.mv_capacity}"
+            )
+        else:
+            (clock, keys, eclocks, *val_planes,
+             d_keys, d_clocks, status) = engine.map_orswot_ingest_wire(
+                buf, offsets, cfg.num_actors, cfg.key_capacity,
+                cfg.deferred_capacity, val_kernel.member_capacity,
+                val_kernel.deferred_capacity, counter_dtype(cfg),
+            )
+            value_overflow_msg = (
+                f"a value set exceeding member_capacity "
+                f"{val_kernel.member_capacity} / deferred_capacity "
+                f"{val_kernel.deferred_capacity}"
+            )
         if status.any():
             hard = np.nonzero(status > 1)[0]
             if hard.size:
@@ -183,10 +213,7 @@ class MapBatch:
                         f"deferred_capacity {cfg.deferred_capacity}"
                     )
                 if code == 5:
-                    raise ValueError(
-                        f"map {first} has a value antichain wider than "
-                        f"mv_capacity {cfg.mv_capacity}"
-                    )
+                    raise ValueError(f"map {first} has {value_overflow_msg}")
                 raise ValueError(
                     f"map {first}: actor outside the identity registry "
                     f"range [0, {cfg.num_actors})"
@@ -199,15 +226,15 @@ class MapBatch:
             clock[idx] = np.asarray(sub.clock)
             keys[idx] = np.asarray(sub.keys)
             eclocks[idx] = np.asarray(sub.entry_clocks)
-            vclocks[idx] = np.asarray(sub.vals[0])
-            vvals[idx] = np.asarray(sub.vals[1])
+            for plane, sub_plane in zip(val_planes, sub.vals):
+                plane[idx] = np.asarray(sub_plane)
             d_keys[idx] = np.asarray(sub.d_keys)
             d_clocks[idx] = np.asarray(sub.d_clocks)
         return cls(
             clock=jnp.asarray(clock),
             keys=jnp.asarray(keys),
             entry_clocks=jnp.asarray(eclocks),
-            vals=(jnp.asarray(vclocks), jnp.asarray(vvals)),
+            vals=tuple(jnp.asarray(p) for p in val_planes),
             d_keys=jnp.asarray(d_keys),
             d_clocks=jnp.asarray(d_clocks),
             kernel=MapKernel.from_config(cfg, val_kernel),
@@ -216,35 +243,37 @@ class MapBatch:
     @gc_paused
     def to_wire(self, universe: Universe) -> list[bytes]:
         """Bulk egress to wire blobs, byte-identical to
-        ``[to_binary(s) for s in self.to_scalar(uni)]`` (fast path for the
-        ``Map<int, MVReg<int>>`` monomorphization; u64 counters at/above
-        2^63 and other compositions take the Python encoder)."""
+        ``[to_binary(s) for s in self.to_scalar(uni)]`` (fast paths for
+        the ``Map<int, MVReg<int>>`` / ``Map<int, Orswot<int>>``
+        monomorphizations; u64 counters at/above 2^63 and other
+        compositions take the Python encoder)."""
         from ..utils.serde import to_binary
-        from .val_kernels import MVRegKernel
         from .wirebulk import probe_engine, slice_blobs
 
         if self.clock.shape[0] == 0:
             return []
+        leg = _map_wire_leg(self.kernel.val_kernel)
         engine = None
-        if type(self.kernel.val_kernel) is MVRegKernel:
+        if leg is not None:
             engine = probe_engine(
-                universe, "map_mvreg_encode_wire",
+                universe, f"map_{leg}_encode_wire",
                 counter_dtype(universe.config),
             )
         planes = None
         if engine is not None:
             planes = tuple(np.asarray(x) for x in (
                 self.clock, self.keys, self.entry_clocks,
-                self.vals[0], self.vals[1], self.d_keys, self.d_clocks,
+                *self.vals, self.d_keys, self.d_clocks,
             ))
-            counterish = (planes[0], planes[2], planes[3], planes[4], planes[6])
-            if planes[0].dtype.itemsize == 8 and any(
+            counterish = [p for p in planes if p.dtype.itemsize == 8]
+            if counterish and any(
                 int(p.max(initial=0)) >= 1 << 63 for p in counterish
             ):
                 engine = None
         if engine is None:
             return [to_binary(s) for s in self.to_scalar(universe)]
-        buf, offsets = engine.map_mvreg_encode_wire(*planes)
+        encode = getattr(engine, f"map_{leg}_encode_wire")
+        buf, offsets = encode(*planes)
         return slice_blobs(buf, offsets)
 
     @gc_paused

@@ -1339,6 +1339,8 @@ DEFINE_ALL(u32, uint32_t)
 DEFINE_ALL(u64, uint64_t)
 
 // v7: + orswot wire codec, mvreg/lww wire codecs (wire_ingest.cpp)
+// v8: + clockish (vclock/gcounter) + pncounter wire codecs,
+//     Map<K, MVReg> and Map<K, Orswot> wire codecs (wire_ingest.cpp)
 int crdt_core_abi_version() { return 8; }
 
 }  // extern "C"

@@ -945,3 +945,67 @@ def map_mvreg_encode_wire(clock, keys, eclocks, vclocks, vvals, d_keys,
     buf = np.empty(int(offsets[-1]), dtype=np.uint8)
     fn(*args, _ptr(offsets), _ptr(buf))
     return buf, offsets
+
+
+def map_orswot_ingest_wire(buf, offsets, a: int, k: int, d: int, mv: int,
+                           dv: int, dtype):
+    """Parallel Map<K, Orswot> wire decode.  Returns ``(clock, keys,
+    eclocks, vclock, vids, vdots, vdids, vdclocks, d_keys, d_clocks,
+    status)``; status 5 = a value's member/deferred table overflow."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    dt = np.dtype(dtype)
+    clock = np.zeros((n, a), dtype=dt)
+    keys = np.full((n, k), -1, dtype=np.int32)
+    eclocks = np.zeros((n, k, a), dtype=dt)
+    vclock = np.zeros((n, k, a), dtype=dt)
+    vids = np.full((n, k, mv), -1, dtype=np.int32)
+    vdots = np.zeros((n, k, mv, a), dtype=dt)
+    vdids = np.full((n, k, dv), -1, dtype=np.int32)
+    vdclocks = np.zeros((n, k, dv, a), dtype=dt)
+    d_keys = np.full((n, d), -1, dtype=np.int32)
+    d_clocks = np.zeros((n, d, a), dtype=dt)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("map_orswot_ingest_wire", dt)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n), ctypes.c_int64(a),
+        ctypes.c_int64(k), ctypes.c_int64(d), ctypes.c_int64(mv),
+        ctypes.c_int64(dv), _ptr(clock), _ptr(keys), _ptr(eclocks),
+        _ptr(vclock), _ptr(vids), _ptr(vdots), _ptr(vdids), _ptr(vdclocks),
+        _ptr(d_keys), _ptr(d_clocks), _ptr(status),
+    )
+    return (clock, keys, eclocks, vclock, vids, vdots, vdids, vdclocks,
+            d_keys, d_clocks, status)
+
+
+def map_orswot_encode_wire(clock, keys, eclocks, vclock, vids, vdots, vdids,
+                           vdclocks, d_keys, d_clocks):
+    """Parallel Map<K, Orswot> wire encode — byte-identical to
+    ``to_binary`` of the scalars (identity universes).
+    Returns ``(buf, offsets)``."""
+    planes = _contig(clock, keys, eclocks, vclock, vids, vdots, vdids,
+                     vdclocks, d_keys, d_clocks)
+    (clock, keys, eclocks, vclock, vids, vdots, vdids, vdclocks, d_keys,
+     d_clocks) = planes
+    dt = _check_counters(clock, eclocks, vclock, vdots, vdclocks, d_clocks)
+    n, a = clock.shape
+    k = keys.shape[1]
+    d = d_keys.shape[1]
+    mv = vids.shape[2]
+    dv = vdids.shape[2]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("map_orswot_encode_wire", dt)
+    args = (
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(vclock), _ptr(vids),
+        _ptr(vdots), _ptr(vdids), _ptr(vdclocks), _ptr(d_keys),
+        _ptr(d_clocks), ctypes.c_int64(n), ctypes.c_int64(a),
+        ctypes.c_int64(k), ctypes.c_int64(d), ctypes.c_int64(mv),
+        ctypes.c_int64(dv),
+    )
+    fn(*args, _ptr(offsets), None)
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(*args, _ptr(offsets), _ptr(buf))
+    return buf, offsets

@@ -150,15 +150,16 @@ int parse_deferred_section(Cursor& c, int64_t A, int64_t D, int32_t* d_ids,
   return 0;
 }
 
+// one full ORSWOT value from the cursor (tag 0x26 through the deferred
+// section, NO end-of-blob check) — shared by the top-level blob parser
+// and the Map<K, Orswot> entry values
 template <typename C>
-int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
-              int64_t M, int64_t D, C* clock, int32_t* ids, C* dots,
-              int32_t* d_ids, C* d_clocks) {
+int parse_orswot_value(Cursor& c, int64_t A, int64_t M, int64_t D, C* clock,
+                       int32_t* ids, C* dots, int32_t* d_ids, C* d_clocks) {
   // counters beyond the counter dtype are NOT wrapped: the Python path
   // (numpy conversion) raises OverflowError, so the fast path flags the
   // blob for fallback and lets that exact behavior happen
   constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
-  Cursor c{buf + lo, buf + hi};
   if (!c.byte(kTagOrswot)) return 1;
 
   uint64_t n;
@@ -202,7 +203,16 @@ int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
   }
 
   // deferred: one dense row per (clock, member) pair
-  int st = parse_deferred_section<C>(c, A, D, d_ids, d_clocks);
+  return parse_deferred_section<C>(c, A, D, d_ids, d_clocks);
+}
+
+template <typename C>
+int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
+              int64_t M, int64_t D, C* clock, int32_t* ids, C* dots,
+              int32_t* d_ids, C* d_clocks) {
+  Cursor c{buf + lo, buf + hi};
+  int st = parse_orswot_value<C>(c, A, M, D, clock, ids, dots, d_ids,
+                                 d_clocks);
   if (st) return st;
   if (c.p != c.end) return 1;  // trailing bytes: not a lone ORSWOT blob
   return 0;
@@ -1071,20 +1081,26 @@ constexpr uint8_t kTagMap = 0x27;
 constexpr uint8_t kTagValTypeNamed = 0x50;
 constexpr uint8_t kMVRegName[5] = {'M', 'V', 'R', 'e', 'g'};
 
-template <typename C>
-int parse_map_mvreg_one(const uint8_t* buf, int64_t lo, int64_t hi,
-                        int64_t A, int64_t K, int64_t D, int64_t KV,
-                        C* clock, int32_t* keys, C* eclocks, C* vclocks,
-                        C* vvals, int32_t* d_keys, C* d_clocks) {
-  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+// the shared Map wire SHELL — tag, named val_type, map clock, the
+// strictly-ascending key loop (key + raw entry clock body + one value
+// via the functor), and the deferred section.  The per-entry value is
+// the only thing that differs between Map compositions:
+// ``parse_val(c, slot) -> status`` / ``emit_val(slot, out) -> bytes``.
+template <typename C, typename ParseVal>
+int parse_map_shell(const uint8_t* buf, int64_t lo, int64_t hi,
+                    const uint8_t* name, uint64_t name_len, int64_t A,
+                    int64_t K, int64_t D, C* clock, int32_t* keys,
+                    C* eclocks, int32_t* d_keys, C* d_clocks,
+                    ParseVal&& parse_val) {
   Cursor c{buf + lo, buf + hi};
   if (!c.byte(kTagMap)) return 1;
-  // val_type header: only the named "MVReg" kernel parses fast
+  // val_type header: only the expected named kernel parses fast
   if (!c.byte(kTagValTypeNamed)) return 1;
   uint64_t nlen;
-  if (!c.uv(&nlen) || nlen != 5) return 1;
-  if (c.p + 5 > c.end || std::memcmp(c.p, kMVRegName, 5) != 0) return 1;
-  c.p += 5;
+  if (!c.uv(&nlen) || nlen != name_len) return 1;
+  if (c.p + name_len > c.end || std::memcmp(c.p, name, name_len) != 0)
+    return 1;
+  c.p += name_len;
 
   int st = parse_clock_body(c, A, clock);
   if (st) return st;
@@ -1105,19 +1121,8 @@ int parse_map_mvreg_one(const uint8_t* buf, int64_t lo, int64_t hi,
     keys[e] = static_cast<int32_t>(key);
     st = parse_clock_body(c, A, eclocks + e * A);  // raw body, no 0x20 tag
     if (st) return st;
-    // nested MVReg value
-    if (!c.byte(kTagMVReg)) return 1;
-    uint64_t kv;
-    if (!c.uv(&kv)) return 1;
-    if (kv > static_cast<uint64_t>(KV)) return 5;
-    for (uint64_t j = 0; j < kv; ++j) {
-      st = parse_clock_body(c, A, vclocks + (e * KV + j) * A);
-      if (st) return st;
-      uint64_t val;
-      if (!c.nonneg(&val)) return 1;
-      if (val > 0x7FFFFFFFull || val > kCounterMax) return 1;
-      vvals[e * KV + j] = static_cast<C>(val);
-    }
+    st = parse_val(c, static_cast<int64_t>(e));
+    if (st) return st;
   }
 
   st = parse_deferred_section<C>(c, A, D, d_keys, d_clocks);
@@ -1126,19 +1131,19 @@ int parse_map_mvreg_one(const uint8_t* buf, int64_t lo, int64_t hi,
   return 0;
 }
 
-template <typename C>
-int64_t map_mvreg_encode_one(const C* clock, const int32_t* keys,
-                             const C* eclocks, const C* vclocks,
-                             const C* vvals, int64_t A, int64_t K, int64_t D,
-                             int64_t KV, const int32_t* d_keys,
-                             const C* d_clocks, uint8_t* out) {
+template <typename C, typename EmitVal>
+int64_t map_shell_encode_one(const C* clock, const int32_t* keys,
+                             const C* eclocks, const int32_t* d_keys,
+                             const C* d_clocks, const uint8_t* name,
+                             uint64_t name_len, int64_t A, int64_t K,
+                             int64_t D, uint8_t* out, EmitVal&& emit_val) {
   const bool sizing = (out == nullptr);
   Emitter e{out};
   std::vector<int64_t> scratch;
   e.byte(kTagMap);
   e.byte(kTagValTypeNamed);
-  e.uv(5);
-  for (uint8_t b : kMVRegName) e.byte(b);
+  e.uv(name_len);
+  for (uint64_t i = 0; i < name_len; ++i) e.byte(name[i]);
   emit_clock_body(e, clock, A, scratch, !sizing);
 
   std::vector<int64_t> slots;
@@ -1154,14 +1159,52 @@ int64_t map_mvreg_encode_one(const C* clock, const int32_t* keys,
   for (int64_t s : slots) {
     e.tagged_nonneg(static_cast<uint64_t>(static_cast<uint32_t>(keys[s])));
     emit_clock_body(e, eclocks + s * A, A, scratch, !sizing);
-    int64_t m = mvreg_encode_one<C>(vclocks + s * KV * A, vvals + s * KV,
-                                    KV, A, e.p);
+    int64_t m = emit_val(s, e.p);
     if (e.p) e.p += m;
     e.count += m;
   }
 
   emit_deferred_section(e, d_keys, d_clocks, A, D, scratch, sizing);
   return e.count;
+}
+
+template <typename C>
+int parse_map_mvreg_one(const uint8_t* buf, int64_t lo, int64_t hi,
+                        int64_t A, int64_t K, int64_t D, int64_t KV,
+                        C* clock, int32_t* keys, C* eclocks, C* vclocks,
+                        C* vvals, int32_t* d_keys, C* d_clocks) {
+  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+  return parse_map_shell<C>(
+      buf, lo, hi, kMVRegName, 5, A, K, D, clock, keys, eclocks, d_keys,
+      d_clocks, [&](Cursor& c, int64_t e) -> int {
+        if (!c.byte(kTagMVReg)) return 1;
+        uint64_t kv;
+        if (!c.uv(&kv)) return 1;
+        if (kv > static_cast<uint64_t>(KV)) return 5;
+        for (uint64_t j = 0; j < kv; ++j) {
+          int st = parse_clock_body(c, A, vclocks + (e * KV + j) * A);
+          if (st) return st;
+          uint64_t val;
+          if (!c.nonneg(&val)) return 1;
+          if (val > 0x7FFFFFFFull || val > kCounterMax) return 1;
+          vvals[e * KV + j] = static_cast<C>(val);
+        }
+        return 0;
+      });
+}
+
+template <typename C>
+int64_t map_mvreg_encode_one(const C* clock, const int32_t* keys,
+                             const C* eclocks, const C* vclocks,
+                             const C* vvals, int64_t A, int64_t K, int64_t D,
+                             int64_t KV, const int32_t* d_keys,
+                             const C* d_clocks, uint8_t* out) {
+  return map_shell_encode_one<C>(
+      clock, keys, eclocks, d_keys, d_clocks, kMVRegName, 5, A, K, D, out,
+      [&](int64_t s, uint8_t* p) -> int64_t {
+        return mvreg_encode_one<C>(vclocks + s * KV * A, vvals + s * KV, KV,
+                                   A, p);
+      });
 }
 
 }  // namespace
@@ -1276,4 +1319,121 @@ void map_mvreg_encode_wire_u64(const uint64_t* clock, const int32_t* keys,
   }
 }
 
+}  // extern "C"
+
+// ---- Map<K, Orswot> wire codec --------------------------------------------
+//
+// The other monomorphic composition the reference tests (reset-remove
+// over sets).  Grammar = the Map grammar with valtype "Orswot" and each
+// entry value a full ORSWOT encoding (tag 0x26 ... deferred).  Value
+// planes per key slot: clock[A], ids[MV], dots[MV,A], d_ids[DV],
+// d_clocks[DV,A].  Status: 0 ok, 1 fallback, 2 key overflow, 3 map
+// deferred overflow, 4 actor out of range, 5 value overflow (the
+// value's member OR deferred table).
+
+namespace {
+
+constexpr uint8_t kOrswotName[6] = {'O', 'r', 's', 'w', 'o', 't'};
+
+template <typename C>
+int parse_map_orswot_one(const uint8_t* buf, int64_t lo, int64_t hi,
+                         int64_t A, int64_t K, int64_t D, int64_t MV,
+                         int64_t DV, C* clock, int32_t* keys, C* eclocks,
+                         C* vclock, int32_t* vids, C* vdots, int32_t* vdids,
+                         C* vdclocks, int32_t* d_keys, C* d_clocks) {
+  return parse_map_shell<C>(
+      buf, lo, hi, kOrswotName, 6, A, K, D, clock, keys, eclocks, d_keys,
+      d_clocks, [&](Cursor& c, int64_t e) -> int {
+        int st = parse_orswot_value<C>(
+            c, A, MV, DV, vclock + e * A, vids + e * MV, vdots + e * MV * A,
+            vdids + e * DV, vdclocks + e * DV * A);
+        // the value's own capacity overflows (2 member / 3 deferred)
+        // must not masquerade as the MAP's key/deferred overflow
+        if (st == 2 || st == 3) return 5;
+        return st;
+      });
+}
+
+template <typename C>
+int64_t map_orswot_encode_one(const C* clock, const int32_t* keys,
+                              const C* eclocks, const C* vclock,
+                              const int32_t* vids, const C* vdots,
+                              const int32_t* vdids, const C* vdclocks,
+                              const int32_t* d_keys, const C* d_clocks,
+                              int64_t A, int64_t K, int64_t D, int64_t MV,
+                              int64_t DV, uint8_t* out) {
+  return map_shell_encode_one<C>(
+      clock, keys, eclocks, d_keys, d_clocks, kOrswotName, 6, A, K, D, out,
+      [&](int64_t s, uint8_t* p) -> int64_t {
+        return encode_one<C>(vclock + s * A, vids + s * MV,
+                             vdots + s * MV * A, vdids + s * DV,
+                             vdclocks + s * DV * A, A, MV, DV, p);
+      });
+}
+
+}  // namespace
+
+#define CRDT_MAP_ORSWOT_INGEST(SUF, TYPE)                                     \
+  int64_t map_orswot_ingest_wire_##SUF(                                       \
+      const uint8_t* buf, const int64_t* offsets, int64_t n, int64_t A,       \
+      int64_t K, int64_t D, int64_t MV, int64_t DV, TYPE* clock,              \
+      int32_t* keys, TYPE* eclocks, TYPE* vclock, int32_t* vids, TYPE* vdots, \
+      int32_t* vdids, TYPE* vdclocks, int32_t* d_keys, TYPE* d_clocks,        \
+      uint8_t* status) {                                                      \
+    int64_t bad = 0;                                                          \
+    _Pragma("omp parallel for schedule(dynamic, 512) reduction(+ : bad)")     \
+    for (int64_t i = 0; i < n; ++i) {                                         \
+      int st = parse_map_orswot_one<TYPE>(                                    \
+          buf, offsets[i], offsets[i + 1], A, K, D, MV, DV, clock + i * A,    \
+          keys + i * K, eclocks + i * K * A, vclock + i * K * A,              \
+          vids + i * K * MV, vdots + i * K * MV * A, vdids + i * K * DV,      \
+          vdclocks + i * K * DV * A, d_keys + i * D, d_clocks + i * D * A);   \
+      status[i] = static_cast<uint8_t>(st);                                   \
+      if (st != 0) {                                                          \
+        std::memset(clock + i * A, 0, sizeof(TYPE) * A);                      \
+        std::memset(eclocks + i * K * A, 0, sizeof(TYPE) * K * A);            \
+        std::memset(vclock + i * K * A, 0, sizeof(TYPE) * K * A);             \
+        std::memset(vdots + i * K * MV * A, 0, sizeof(TYPE) * K * MV * A);    \
+        std::memset(vdclocks + i * K * DV * A, 0,                             \
+                    sizeof(TYPE) * K * DV * A);                               \
+        std::memset(d_clocks + i * D * A, 0, sizeof(TYPE) * D * A);           \
+        for (int64_t j = 0; j < K; ++j) keys[i * K + j] = kEmpty;             \
+        for (int64_t j = 0; j < K * MV; ++j) vids[i * K * MV + j] = kEmpty;   \
+        for (int64_t j = 0; j < K * DV; ++j) vdids[i * K * DV + j] = kEmpty;  \
+        for (int64_t j = 0; j < D; ++j) d_keys[i * D + j] = kEmpty;           \
+        ++bad;                                                                \
+      }                                                                       \
+    }                                                                         \
+    return bad;                                                               \
+  }
+
+#define CRDT_MAP_ORSWOT_ENCODE(SUF, TYPE)                                     \
+  void map_orswot_encode_wire_##SUF(                                          \
+      const TYPE* clock, const int32_t* keys, const TYPE* eclocks,            \
+      const TYPE* vclock, const int32_t* vids, const TYPE* vdots,             \
+      const int32_t* vdids, const TYPE* vdclocks, const int32_t* d_keys,      \
+      const TYPE* d_clocks, int64_t n, int64_t A, int64_t K, int64_t D,       \
+      int64_t MV, int64_t DV, int64_t* offsets, uint8_t* buf) {               \
+    _Pragma("omp parallel for schedule(dynamic, 512)")                        \
+    for (int64_t i = 0; i < n; ++i) {                                         \
+      if (buf == nullptr)                                                     \
+        offsets[i + 1] = map_orswot_encode_one<TYPE>(                         \
+            clock + i * A, keys + i * K, eclocks + i * K * A,                 \
+            vclock + i * K * A, vids + i * K * MV, vdots + i * K * MV * A,    \
+            vdids + i * K * DV, vdclocks + i * K * DV * A, d_keys + i * D,    \
+            d_clocks + i * D * A, A, K, D, MV, DV, nullptr);                  \
+      else                                                                    \
+        map_orswot_encode_one<TYPE>(                                          \
+            clock + i * A, keys + i * K, eclocks + i * K * A,                 \
+            vclock + i * K * A, vids + i * K * MV, vdots + i * K * MV * A,    \
+            vdids + i * K * DV, vdclocks + i * K * DV * A, d_keys + i * D,    \
+            d_clocks + i * D * A, A, K, D, MV, DV, buf + offsets[i]);         \
+    }                                                                         \
+  }
+
+extern "C" {
+CRDT_MAP_ORSWOT_INGEST(u32, uint32_t)
+CRDT_MAP_ORSWOT_INGEST(u64, uint64_t)
+CRDT_MAP_ORSWOT_ENCODE(u32, uint32_t)
+CRDT_MAP_ORSWOT_ENCODE(u64, uint64_t)
 }  // extern "C"

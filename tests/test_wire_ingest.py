@@ -926,7 +926,7 @@ def test_map_wire_duplicate_key_blob_falls_back():
     pos=st.integers(0, 4096),
     byte=st.integers(0, 255),
     mode=st.sampled_from(["flip", "insert", "delete", "truncate"]),
-    leg=st.sampled_from(["vclock", "pncounter", "map"]),
+    leg=st.sampled_from(["vclock", "pncounter", "map", "map_orswot"]),
 )
 def test_new_leg_parsers_total_on_mutated_blobs(seed, pos, byte, mode, leg):
     """Mutation-fuzz totality for the round-4 parsers (clockish /
@@ -946,6 +946,15 @@ def test_new_leg_parsers_total_on_mutated_blobs(seed, pos, byte, mode, leg):
         uni = _map_uni()
         vk = MVRegKernel.from_config(uni.config)
         state = _random_map_mvregs(rng, 1)[0]
+        ingest = lambda blob: MapBatch.from_wire([blob], uni, vk)
+        pipeline = lambda blob: MapBatch.from_scalar(
+            [from_binary(blob)], uni, vk)
+    elif leg == "map_orswot":
+        from crdt_tpu.batch.val_kernels import OrswotKernel
+
+        uni = _map_uni()
+        vk = OrswotKernel.from_config(uni.config)
+        state = _random_map_orswots(rng, 1)[0]
         ingest = lambda blob: MapBatch.from_wire([blob], uni, vk)
         pipeline = lambda blob: MapBatch.from_scalar(
             [from_binary(blob)], uni, vk)
@@ -994,3 +1003,71 @@ def test_new_leg_parsers_total_on_mutated_blobs(seed, pos, byte, mode, leg):
         f"{leg} from_wire accepted a blob the python pipeline rejects"
     )
     assert got.to_scalar(uni) == want
+
+
+def _random_map_orswots(rng, n, n_actors=8):
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.orswot import Orswot
+
+    maps = []
+    for i in range(n):
+        m = Map(Orswot)
+        for _ in range(int(rng.randint(0, 4))):
+            key = int(rng.randint(0, 30))
+            actor = int(rng.randint(0, n_actors))
+            ctx = m.get(key).derive_add_ctx(actor)
+            member = int(rng.randint(0, 40))
+            m.apply(m.update(key, ctx, lambda v, c, _m=member: v.add(_m, c)))
+        if rng.rand() < 0.3 and m.entries:
+            key = next(iter(m.entries))
+            ctx = m.get(key).derive_rm_ctx()
+            ctx.clock.witness(int(rng.randint(0, n_actors)),
+                              int(rng.randint(100, 200)))
+            m.apply(m.rm(key, ctx))
+        maps.append(m)
+    return maps
+
+
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_map_orswot_wire_roundtrip_and_parity(counter_bits):
+    """Map<K, Orswot> leg — the reset-remove-over-sets composition."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import OrswotKernel
+
+    rng = np.random.RandomState(107)
+    uni = _map_uni(counter_bits)
+    vk = OrswotKernel.from_config(uni.config)
+    maps = _random_map_orswots(rng, 30)
+    blobs = [to_binary(m) for m in maps]
+
+    got = MapBatch.from_wire(blobs, uni, vk)
+    want = MapBatch.from_scalar([from_binary(b) for b in blobs], uni, vk)
+    np.testing.assert_array_equal(np.asarray(got.clock), np.asarray(want.clock))
+    np.testing.assert_array_equal(np.asarray(got.keys), np.asarray(want.keys))
+    np.testing.assert_array_equal(
+        np.asarray(got.entry_clocks), np.asarray(want.entry_clocks))
+    # value member tables are wire-order deterministic
+    np.testing.assert_array_equal(np.asarray(got.vals[1]), np.asarray(want.vals[1]))
+    np.testing.assert_array_equal(np.asarray(got.vals[2]), np.asarray(want.vals[2]))
+    assert got.to_scalar(uni) == maps  # full state incl. nested deferred
+
+    out = got.to_wire(uni)
+    assert out == blobs
+    assert MapBatch.from_wire(out, uni, vk).to_scalar(uni) == maps
+
+
+def test_map_orswot_wire_value_overflow_raises():
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.batch.val_kernels import OrswotKernel
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.orswot import Orswot
+
+    uni = Universe.identity(CrdtConfig(
+        num_actors=8, key_capacity=4, deferred_capacity=4, member_capacity=2))
+    vk = OrswotKernel.from_config(uni.config)
+    m = Map(Orswot)
+    for member in (1, 2, 3):  # 3 members > value member_capacity 2
+        ctx = m.get(0).derive_add_ctx(0)
+        m.apply(m.update(0, ctx, lambda v, c, _m=member: v.add(_m, c)))
+    with pytest.raises(ValueError, match="member_capacity"):
+        MapBatch.from_wire([to_binary(m)], uni, vk)

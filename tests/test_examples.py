@@ -1,0 +1,16 @@
+"""Smoke-run the self-verifying examples (their asserts are the test)."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_wire_zoo_example_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "wire_zoo.py")],
+        capture_output=True, text=True, timeout=600, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "all 9 type families converged" in proc.stdout

@@ -14,3 +14,12 @@ def test_wire_zoo_example_runs():
     )
     assert proc.returncode == 0, proc.stderr
     assert "all 9 type families converged" in proc.stdout
+
+
+def test_anti_entropy_example_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", "anti_entropy.py")],
+        capture_output=True, text=True, timeout=600, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "anti-entropy walkthrough: OK" in proc.stdout

@@ -756,6 +756,39 @@ def bench_pallas_north_star(templates=None):
     deferred_frac = 0.25
     n_chunks = max(2, n // chunk)
 
+    # Which fused kernel contends (CRDT_PALLAS_KERNEL): "aligned" — the
+    # union-aligned fold (ops/orswot_fold_aligned: one alignment, pure
+    # elementwise steps; built to fix the fused fold's measured
+    # VPU-compute bind, PERF.md 2026-08-01) — or "fused", the original
+    # per-step tile merge, kept A/B-able until the aligned kernel wins
+    # on-chip.  u_cap = m: the north-star fleets bound the per-object
+    # union at base + r*novel <= m (utils/testdata.py), and the parity
+    # gate below would catch an overflow-truncated fold.
+    kernel_choice = os.environ.get("CRDT_PALLAS_KERNEL", "aligned")
+    if kernel_choice == "aligned":
+        from crdt_tpu.ops import orswot_fold_aligned
+
+        def fold_kernel(*args, **kw):
+            return orswot_fold_aligned.fold_merge(*args, u_cap=m, **kw)
+
+        def pad_tiles(state):
+            return orswot_fold_aligned.pad_to_tile(
+                state, m, d, n_states=r + 1, u_cap=m
+            )
+
+        kernel_label = "pallas_aligned_fold"
+    elif kernel_choice == "fused":
+        fold_kernel = orswot_pallas.fold_merge
+
+        def pad_tiles(state):
+            return orswot_pallas.pad_to_tile(state, m, d, n_states=r + 1)
+
+        kernel_label = "pallas_fused_fold"
+    else:
+        raise ValueError(
+            f"CRDT_PALLAS_KERNEL={kernel_choice!r} is not aligned/fused"
+        )
+
     # mirror the terminal-side compile helper's documented workaround
     # (reports/PALLAS_TPU_ATTEMPT.txt:12-14); harmless when unneeded
     os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
@@ -777,7 +810,7 @@ def bench_pallas_north_star(templates=None):
             # the gate must validate the SAME compiled program the timing
             # runs: bias in, fold prebiased, unbias out
             biased = orswot_pallas.to_kernel_domain(stack)
-            out = orswot_pallas.fold_merge(
+            out = fold_kernel(
                 *biased, m, d, interpret=False, prebiased=True
             )[:5]
             cdt = stack[0].dtype
@@ -801,9 +834,7 @@ def bench_pallas_north_star(templates=None):
         # AOT memory analysis); one template + the salt chain is 8.8 GB
         # and the kernels are data-oblivious, so per-chunk distinctness
         # is cosmetic for the work measured.
-        tpl = orswot_pallas.to_kernel_domain(
-            orswot_pallas.pad_to_tile(templates[0], m, d, n_states=r + 1)
-        )
+        tpl = orswot_pallas.to_kernel_domain(pad_tiles(templates[0]))
 
         # Bridge path first: an axon-format executable of this exact
         # scan, self-banked by a previous bench run right after its
@@ -813,10 +844,10 @@ def bench_pallas_north_star(templates=None):
         if not SMALL:
             bridged = _pallas_bridge_rate(tpl, n_chunks, chunk, r)
             if bridged is not None:
-                return bridged
+                return bridged, kernel_label
 
         def fold_biased(stack):
-            return orswot_pallas.fold_merge(
+            return fold_kernel(
                 *stack, m, d, interpret=False, prebiased=True
             )[:5]
 
@@ -852,10 +883,10 @@ def bench_pallas_north_star(templates=None):
         t = max(time.perf_counter() - t0 - sync_s, 1e-9)
         rate = n_chunks * chunk * r / t
         log(
-            f"north★ pallas fused fold: {t:.2f}s  {rate/1e6:.2f}M merges/s "
+            f"north★ {kernel_label}: {t:.2f}s  {rate/1e6:.2f}M merges/s "
             f"(same scale/salt-chain as the jnp fold)"
         )
-        return round(rate, 1)
+        return round(rate, 1), kernel_label
     except Exception as e:
         log(f"north★ pallas attempt failed (jnp headline stands): {str(e)[:300]}")
         return None
@@ -878,6 +909,9 @@ def _axon_art_meta(n_chunks, chunk, r):
             "CRDT_MERGE_IMPL": os.environ.get("CRDT_MERGE_IMPL", "unrolled"),
             "CRDT_SCATTERLESS": os.environ.get("CRDT_SCATTERLESS", "1"),
         },
+        # which fused kernel the scan wraps — a banked aligned-fold
+        # executable must not serve a fused-fold request or vice versa
+        "kernel": os.environ.get("CRDT_PALLAS_KERNEL", "aligned"),
         "tile": os.environ.get("CRDT_PALLAS_TILE", "auto"),
         "counts": {"n_chunks": n_chunks, "chunk": chunk, "r": r},
     }
@@ -973,8 +1007,9 @@ def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
         counts = have["counts"]
         rate = counts["n_chunks"] * counts["chunk"] * counts["r"] / t
         log(
-            f"north★ pallas fused fold (axon-banked executable, no "
-            f"compile): {t:.2f}s  {rate/1e6:.2f}M merges/s"
+            f"north★ pallas {have.get('kernel', 'fused')} fold "
+            f"(axon-banked executable, no compile): {t:.2f}s  "
+            f"{rate/1e6:.2f}M merges/s"
         )
         return round(rate, 1)
     except Exception as e:
@@ -1025,7 +1060,14 @@ def _pallas_bank_executable(compiled, n_chunks, chunk, r, out):
 # the jnp chunk-fold moves ~7.4 GB per 500k-merge chunk-fold, the fused
 # Pallas fold ~2.8 GB (single HBM pass; AOT memory plan).  Used to quote
 # each on-chip headline as effective GB/s against the same-window floor.
-_BYTES_PER_MERGE = {"jnp_fold": 14800.0, "pallas_fused_fold": 5600.0}
+_BYTES_PER_MERGE = {
+    "jnp_fold": 14800.0,
+    "pallas_fused_fold": 5600.0,
+    # union-aligned fold: each replica state read once + one output write
+    # per object — (r+1)/r states/merge at the north-star shapes
+    # (A=64, M=16, D=2, u32: 4936 B/state, r=8) ≈ 5.55 KB/merge
+    "pallas_aligned_fold": 5550.0,
+}
 
 
 def bench_bandwidth_floor():
@@ -1596,17 +1638,18 @@ def main():
     # the Pallas attempt runs AFTER every jnp metric is banked (a Mosaic
     # crash can wedge the tunnel's compile helper) and can only ever
     # raise the headline, never lose it
-    pallas_rate = run_stage(
+    pallas_res = run_stage(
         "pallas_north_star", 120, bench_pallas_north_star, ns_templates
     )
-    if pallas_rate is not None:
+    if pallas_res is not None:
+        pallas_rate, pallas_kernel = pallas_res
         if rate is None or pallas_rate > rate:
-            kf = {"kernel": "pallas_fused_fold"}
+            kf = {"kernel": pallas_kernel}
             if rate is not None:
                 kf["jnp_merges_per_sec"] = round(rate, 1)
             emit_headline(pallas_rate, kf, backend, fallback)
         else:
-            emit(pallas_merges_per_sec=pallas_rate)
+            emit(pallas_merges_per_sec=pallas_rate, pallas_kernel=pallas_kernel)
     floor = run_stage("bandwidth_floor", 45, bench_bandwidth_floor)
     if floor is not None:
         emit(**floor)

@@ -248,7 +248,7 @@ class MapBatch:
         monomorphizations; u64 counters at/above 2^63 and other
         compositions take the Python encoder)."""
         from ..utils.serde import to_binary
-        from .wirebulk import probe_engine, slice_blobs
+        from .wirebulk import counters_overflow_zigzag, probe_engine, slice_blobs
 
         if self.clock.shape[0] == 0:
             return []
@@ -265,10 +265,7 @@ class MapBatch:
                 self.clock, self.keys, self.entry_clocks,
                 *self.vals, self.d_keys, self.d_clocks,
             ))
-            counterish = [p for p in planes if p.dtype.itemsize == 8]
-            if counterish and any(
-                int(p.max(initial=0)) >= 1 << 63 for p in counterish
-            ):
+            if counters_overflow_zigzag(planes):
                 engine = None
         if engine is None:
             return [to_binary(s) for s in self.to_scalar(universe)]

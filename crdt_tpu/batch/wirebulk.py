@@ -99,14 +99,31 @@ def planes_from_wire(blobs, universe, probe_name, ingest, planes_of_scalars):
     return planes
 
 
+def counters_overflow_zigzag(planes) -> bool:
+    """The shared u64 egress guard: True when any 8-byte counter plane
+    holds a value at/above 2^63, whose zigzag encoding overflows the C
+    emitter's uint64 (such states must take the Python encoder).
+
+    4-byte planes can never overflow — they are skipped without the
+    full-plane ``max`` scan, so u32 configs pay nothing here.  Accepts
+    host or device arrays; the reduction runs where the plane lives and
+    only the scalar crosses to the host."""
+    for p in planes:
+        if p.dtype.itemsize != 8 or p.size == 0:
+            continue
+        if int(p.max()) >= 1 << 63:
+            return True
+    return False
+
+
 def planes_to_wire(planes, universe, probe_name, encode, python_path):
     """Wire blobs from dense counter planes — the shared egress flow,
     byte-identical to the scalar ``to_binary``.
 
     ``encode(engine, planes) -> (buf, offsets)`` runs the type's native
     encoder; ``python_path()`` is the full fallback: non-identity
-    universes, missing engine, or u64 counters at/above 2^63 — whose
-    zigzag encoding overflows the C emitter's uint64."""
+    universes, missing engine, or the :func:`counters_overflow_zigzag`
+    guard."""
     import numpy as np
 
     from ..config import counter_dtype
@@ -117,7 +134,7 @@ def planes_to_wire(planes, universe, probe_name, encode, python_path):
     host = None
     if engine is not None:
         host = np.asarray(planes)
-        if host.dtype.itemsize == 8 and int(host.max(initial=0)) >= 1 << 63:
+        if counters_overflow_zigzag((host,)):
             engine = None
     if engine is None:
         return python_path()

@@ -80,29 +80,38 @@ inline void dot_rule_both(const C* e1, const C* e2, const C* sc, const C* oc,
 
 }  // namespace
 
+// OpenMP pragma helper for the macro-stamped kernels: expands to nothing
+// in a non-OpenMP build (bare #pragma lines carry their own _OPENMP
+// guards; macros need the _Pragma form)
+#if defined(_OPENMP)
+#define CRDT_OMP_FOR(CLAUSES) _Pragma(CLAUSES)
+#else
+#define CRDT_OMP_FOR(CLAUSES)
+#endif
+
 // ==== elementwise VClock batch ops (count = N*A flattened) ==================
 
 #define DEFINE_ELEMENTWISE(SUF, C)                                            \
   void vclock_merge_##SUF(const C* x, const C* y, C* out, int64_t count) {    \
-    _Pragma("omp parallel for")                                               \
+    CRDT_OMP_FOR("omp parallel for")                                               \
     for (int64_t i = 0; i < count; ++i) out[i] = x[i] > y[i] ? x[i] : y[i];   \
   }                                                                           \
   void vclock_intersect_##SUF(const C* x, const C* y, C* out, int64_t count) {\
-    _Pragma("omp parallel for")                                               \
+    CRDT_OMP_FOR("omp parallel for")                                               \
     for (int64_t i = 0; i < count; ++i) out[i] = (x[i] == y[i]) ? x[i] : 0;   \
   }                                                                           \
   void vclock_subtract_##SUF(const C* x, const C* y, C* out, int64_t count) { \
-    _Pragma("omp parallel for")                                               \
+    CRDT_OMP_FOR("omp parallel for")                                               \
     for (int64_t i = 0; i < count; ++i) out[i] = (x[i] > y[i]) ? x[i] : 0;    \
   }                                                                           \
   void vclock_truncate_##SUF(const C* x, const C* y, C* out, int64_t count) { \
-    _Pragma("omp parallel for")                                               \
+    CRDT_OMP_FOR("omp parallel for")                                               \
     for (int64_t i = 0; i < count; ++i) out[i] = x[i] < y[i] ? x[i] : y[i];   \
   }                                                                           \
   /* per-row lattice partial order over [n, a]: leq/geq bitmaps */            \
   void vclock_compare_##SUF(const C* x, const C* y, int64_t n, int64_t a,     \
                             uint8_t* leq, uint8_t* geq) {                     \
-    _Pragma("omp parallel for")                                               \
+    CRDT_OMP_FOR("omp parallel for")                                               \
     for (int64_t r = 0; r < n; ++r) {                                         \
       leq[r] = clock_leq(x + r * a, y + r * a, a);                            \
       geq[r] = clock_leq(y + r * a, x + r * a, a);                            \
@@ -116,7 +125,7 @@ inline void dot_rule_both(const C* e1, const C* e2, const C* sc, const C* oc,
   void lww_merge_##SUF(const int64_t* va, const C* ma, const int64_t* vb,     \
                        const C* mb, int64_t* vo, C* mo, uint8_t* conflict,    \
                        int64_t n) {                                           \
-    _Pragma("omp parallel for")                                               \
+    CRDT_OMP_FOR("omp parallel for")                                               \
     for (int64_t i = 0; i < n; ++i) {                                         \
       bool take_b = mb[i] > ma[i];                                            \
       vo[i] = take_b ? vb[i] : va[i];                                         \
@@ -134,7 +143,9 @@ static void mvreg_merge_impl(const C* ca, const int64_t* va, const C* cb,
                              const int64_t* vb, int64_t n, int64_t k,
                              int64_t a, int64_t k_cap, C* co, int64_t* vo,
                              uint8_t* overflow) {
+#if defined(_OPENMP)
 #pragma omp parallel for
+#endif
   for (int64_t r = 0; r < n; ++r) {
     const C* A_ = ca + r * k * a;
     const C* B_ = cb + r * k * a;
@@ -367,7 +378,9 @@ void orswot_merge_impl(
     const C* dclocks_b, int64_t n, int64_t a, int64_t m, int64_t d,
     int64_t m_cap, int64_t d_cap, C* clock_o, int32_t* ids_o, C* dots_o,
     int32_t* dids_o, C* dclocks_o, uint8_t* overflow) {
+#if defined(_OPENMP)
 #pragma omp parallel for
+#endif
   for (int64_t r = 0; r < n; ++r) {
     // two flags per object — member / deferred axis, matching the jnp
     // kernel's bool[..., 2] so elastic recovery grows only the hit axis
@@ -390,7 +403,9 @@ void orswot_apply_add_impl(C* clock, int32_t* ids, C* dots, int32_t* dids,
                            const C* counter, const int32_t* member_id,
                            int64_t n, int64_t a, int64_t m, int64_t d,
                            uint8_t* overflow) {
+#if defined(_OPENMP)
 #pragma omp parallel for
+#endif
   for (int64_t r = 0; r < n; ++r) {
     C* ck = clock + r * a;
     int32_t* id_row = ids + r * m;
@@ -442,7 +457,9 @@ void orswot_apply_remove_impl(const C* clock, int32_t* ids, C* dots,
                               int32_t* dids, C* dclocks, const C* rm_clock,
                               const int32_t* member_id, int64_t n, int64_t a,
                               int64_t m, int64_t d, uint8_t* overflow) {
+#if defined(_OPENMP)
 #pragma omp parallel for
+#endif
   for (int64_t r = 0; r < n; ++r) {
     const C* ck = clock + r * a;
     const C* rc = rm_clock + r * a;
@@ -1154,7 +1171,9 @@ void map_mvreg_merge_impl(
     int64_t v_cap, int64_t d, int64_t k_cap, int64_t d_cap, C* clock_o,
     int32_t* keys_o, C* ec_o, C* mvc_o, C* mvv_o, int32_t* dk_o, C* dc_o,
     uint8_t* overflow) {
+#if defined(_OPENMP)
 #pragma omp parallel for
+#endif
   for (int64_t r = 0; r < n; ++r) {
     MvregValRow<C> v(mvc_a + r * k * v_cap * a, mvv_a + r * k * v_cap,
                      mvc_b + r * k * v_cap * a, mvv_b + r * k * v_cap,
@@ -1180,7 +1199,9 @@ void map_orswot_merge_impl(
     int64_t m, int64_t d2, int64_t d, int64_t k_cap, int64_t d_cap,
     C* clock_o, int32_t* keys_o, C* ec_o, C* ovc_o, int32_t* oid_o, C* odot_o,
     int32_t* odid_o, C* odclk_o, int32_t* dk_o, C* dc_o, uint8_t* overflow) {
+#if defined(_OPENMP)
 #pragma omp parallel for
+#endif
   for (int64_t r = 0; r < n; ++r) {
     OrswotValRow<C> v(
         ovc_a + r * k * a, oid_a + r * k * m, odot_a + r * k * m * a,
@@ -1212,7 +1233,9 @@ void map_map_mvreg_merge_impl(
     C* ec_o, C* iclk_o, int32_t* ikeys_o, C* iec_o, C* imvc_o, C* imvv_o,
     int32_t* idk_o, C* idc_o, int32_t* dk_o, C* dc_o, uint8_t* overflow) {
   InnerMapDims<C> dm{a, k2, v_cap, d3};
+#if defined(_OPENMP)
 #pragma omp parallel for
+#endif
   for (int64_t r = 0; r < n; ++r) {
     InnerMapValRow<C> v(
         iclk_a + r * k * dm.clock_sz(), ikeys_a + r * k * dm.keys_sz(),

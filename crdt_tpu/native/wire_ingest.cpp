@@ -122,7 +122,15 @@ int parse_deferred_section(Cursor& c, int64_t A, int64_t D, int32_t* d_ids,
   if (!c.uv(&n)) return 1;
   static thread_local std::vector<C> scratch;
   int64_t drow = 0;
+  // canonical-order enforcement (same rationale as the entry/key checks:
+  // to_binary emits groups strictly ascending in encoded clock-key
+  // bytes and members strictly ascending within a group — a duplicate
+  // group or member would buffer extra dense rows where the Python
+  // decode dedupes via dict/set, so non-canonical input falls back)
+  const uint8_t* prev_key = nullptr;
+  size_t prev_key_len = 0;
   for (uint64_t q = 0; q < n; ++q) {
+    const uint8_t* key_start = c.p;
     if (!c.byte(kTagTuple)) return 1;
     uint64_t k;
     if (!c.uv(&k)) return 1;
@@ -135,12 +143,26 @@ int parse_deferred_section(Cursor& c, int64_t A, int64_t D, int32_t* d_ids,
       if (counter > kCounterMax) return 1;
       scratch[actor] = static_cast<C>(counter);
     }
+    const size_t key_len = static_cast<size_t>(c.p - key_start);
+    if (q > 0) {
+      // strictly ascending encoded clock-key bytes (the egress group
+      // comparator: memcmp, shorter-is-less on shared-prefix tie)
+      const size_t m_ = prev_key_len < key_len ? prev_key_len : key_len;
+      const int cmp = std::memcmp(prev_key, key_start, m_);
+      if (!(cmp < 0 || (cmp == 0 && prev_key_len < key_len))) return 1;
+    }
+    prev_key = key_start;
+    prev_key_len = key_len;
     uint64_t m;
     if (!c.uv(&m)) return 1;
+    uint64_t prev_member = 0;
     for (uint64_t j = 0; j < m; ++j) {
       uint64_t member;
       if (!c.nonneg(&member)) return 1;
       if (member > 0x7FFFFFFFull) return 1;
+      if (j > 0 && !varint_bytes_less(prev_member << 1, member << 1))
+        return 1;
+      prev_member = member;
       if (drow >= D) return 3;
       std::memcpy(d_clocks + drow * A, scratch.data(), sizeof(C) * A);
       d_ids[drow] = static_cast<int32_t>(member);
@@ -1373,6 +1395,15 @@ int64_t map_orswot_encode_one(const C* clock, const int32_t* keys,
 
 }  // namespace
 
+// OpenMP pragma helper for the macro-stamped Map kernels: expands to
+// nothing in a non-OpenMP build (every hand-written loop guards its
+// pragma with #if defined(_OPENMP); macros need the _Pragma form)
+#if defined(_OPENMP)
+#define CRDT_OMP_FOR(CLAUSES) _Pragma(CLAUSES)
+#else
+#define CRDT_OMP_FOR(CLAUSES)
+#endif
+
 #define CRDT_MAP_ORSWOT_INGEST(SUF, TYPE)                                     \
   int64_t map_orswot_ingest_wire_##SUF(                                       \
       const uint8_t* buf, const int64_t* offsets, int64_t n, int64_t A,       \
@@ -1381,7 +1412,7 @@ int64_t map_orswot_encode_one(const C* clock, const int32_t* keys,
       int32_t* vdids, TYPE* vdclocks, int32_t* d_keys, TYPE* d_clocks,        \
       uint8_t* status) {                                                      \
     int64_t bad = 0;                                                          \
-    _Pragma("omp parallel for schedule(dynamic, 512) reduction(+ : bad)")     \
+    CRDT_OMP_FOR("omp parallel for schedule(dynamic, 512) reduction(+ : bad)") \
     for (int64_t i = 0; i < n; ++i) {                                         \
       int st = parse_map_orswot_one<TYPE>(                                    \
           buf, offsets[i], offsets[i + 1], A, K, D, MV, DV, clock + i * A,    \
@@ -1414,7 +1445,7 @@ int64_t map_orswot_encode_one(const C* clock, const int32_t* keys,
       const int32_t* vdids, const TYPE* vdclocks, const int32_t* d_keys,      \
       const TYPE* d_clocks, int64_t n, int64_t A, int64_t K, int64_t D,       \
       int64_t MV, int64_t DV, int64_t* offsets, uint8_t* buf) {               \
-    _Pragma("omp parallel for schedule(dynamic, 512)")                        \
+    CRDT_OMP_FOR("omp parallel for schedule(dynamic, 512)")                   \
     for (int64_t i = 0; i < n; ++i) {                                         \
       if (buf == nullptr)                                                     \
         offsets[i + 1] = map_orswot_encode_one<TYPE>(                         \

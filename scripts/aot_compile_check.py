@@ -111,6 +111,55 @@ def main():
         _report(lowered)
         return
 
+    if which in ("fold_aligned", "fold_aligned_ns"):
+        from crdt_tpu.ops import orswot_fold_aligned
+
+        if which == "fold_aligned":
+            r, n, a, m, d = 4, 4096, 16, 8, 2
+        u_cap = int(os.environ.get("CRDT_AOT_UCAP", str(m)))
+        shaped = _stack_specs(sh, r, n, a, m, d, jnp.uint32)
+        lowered = jax.jit(
+            lambda *s: orswot_fold_aligned.fold_merge(
+                *s, m, d, u_cap=u_cap, interpret=False
+            )
+        ).trace(*shaped).lower()
+        _report(lowered)
+        return
+
+    if which == "scan_aligned_ns":
+        # the aligned-fold version of the bench's salted prebiased scan
+        from crdt_tpu.ops import orswot_fold_aligned
+
+        u_cap = int(os.environ.get("CRDT_AOT_UCAP", str(m)))
+        n_total = 1_250_000
+        n_chunks = n_total // n
+        t = orswot_fold_aligned._tile_size(a, m, d, r, u_cap)
+        n_pad = n + ((-n) % t)
+        shaped = _stack_specs(sh, r, n_pad, a, m, d, jnp.int32)
+        i32 = jnp.int32
+
+        def run_chunks(*tpl):
+            def fold_biased(stack):
+                return orswot_fold_aligned.fold_merge(
+                    *stack, m, d, u_cap=u_cap, interpret=False, prebiased=True
+                )[:5]
+
+            def next_salt(acc):
+                return (jnp.max(acc[2]).astype(i32) & i32(7)) | i32(1)
+
+            def body(carry, _):
+                salt, _prev = carry
+                o = fold_biased((tpl[0] ^ salt,) + tpl[1:])
+                return (next_salt(o), o), None
+
+            init = (i32(1), tuple(x[0] for x in tpl))
+            (_, out), _ = lax.scan(body, init, None, length=n_chunks)
+            return out
+
+        lowered = jax.jit(run_chunks).trace(*shaped).lower()
+        _report(lowered)
+        return
+
     if which == "scan_ns":
         # the bench's actual timed program: salted scan of prebiased
         # folds.  MIRRORS bench.py bench_pallas_north_star's run_chunks —

@@ -895,20 +895,7 @@ def test_map_wire_duplicate_key_blob_falls_back():
 
     uni = _map_uni()
     vk = MVRegKernel.from_config(uni.config)
-
-    def uv(v):
-        out = bytearray()
-        while True:
-            b = v & 0x7F
-            v >>= 7
-            if v:
-                out.append(b | 0x80)
-            else:
-                out.append(b)
-                return bytes(out)
-
-    def iv(v):  # 0x03 + zigzag varint (non-negative)
-        return b"\x03" + uv(v << 1)
+    uv, iv = _uv_bytes, _iv_bytes  # module-level blob-forging helpers
 
     clock_body = uv(1) + iv(1) + iv(1)          # {actor 1: 1}
     mvreg = b"\x25" + uv(1) + clock_body + iv(3)  # one (clock, val=3) pair
@@ -1071,3 +1058,88 @@ def test_map_orswot_wire_value_overflow_raises():
         m.apply(m.update(0, ctx, lambda v, c, _m=member: v.add(_m, c)))
     with pytest.raises(ValueError, match="member_capacity"):
         MapBatch.from_wire([to_binary(m)], uni, vk)
+
+
+def _uv_bytes(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _iv_bytes(v):  # 0x03 + zigzag varint (non-negative)
+    return b"\x03" + _uv_bytes(v << 1)
+
+
+def _orswot_blob_with_deferred(groups):
+    """Hand-built ORSWOT blob: set clock {a0: 5}, one member 3 with the
+    same entry clock, then a deferred section given as a list of
+    ``(clock_pairs, members)`` groups IN THE GIVEN ORDER (so tests can
+    craft non-canonical layouts to_binary would never emit)."""
+    clock_body = _uv_bytes(1) + _iv_bytes(0) + _iv_bytes(5)  # {actor 0: 5}
+    entry = _iv_bytes(3) + b"\x20" + clock_body
+    out = b"\x26" + clock_body + _uv_bytes(1) + entry
+    out += _uv_bytes(len(groups))
+    for pairs, members in groups:
+        out += b"\x08" + _uv_bytes(len(pairs))
+        for actor, counter in pairs:
+            out += b"\x08" + _uv_bytes(2) + _iv_bytes(actor) + _iv_bytes(counter)
+        out += _uv_bytes(len(members))
+        for m in members:
+            out += _iv_bytes(m)
+    return out
+
+
+@pytest.mark.parametrize(
+    "groups",
+    [
+        # duplicate clock-key groups (to_binary merges them into one)
+        [([(0, 9)], [3]), ([(0, 9)], [4])],
+        # members out of encoded-bytes order within a group
+        [([(0, 9)], [4, 3])],
+        # duplicate member within a group (set() would dedupe)
+        [([(0, 9)], [3, 3])],
+        # groups out of encoded clock-key-bytes order
+        [([(1, 9)], [3]), ([(0, 9)], [4])],
+    ],
+    ids=["dup-group", "member-order", "dup-member", "group-order"],
+)
+def test_from_wire_non_canonical_deferred_falls_back(groups):
+    """Adversarial deferred sections to_binary never emits (duplicate
+    groups/members, unordered groups/members) must not fast-parse into
+    extra dense rows: the parser's canonical-order checks route them to
+    the Python decoder, which dedupes via dict/set — the documented
+    ``from_wire == from_scalar(from_binary)`` contract."""
+    uni = _identity_uni()
+    blob = _orswot_blob_with_deferred(groups)
+    got = OrswotBatch.from_wire([blob], uni)
+    want = OrswotBatch.from_scalar([from_binary(blob)], uni)
+    for name in ("clock", "ids", "dots", "d_ids", "d_clocks"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(want, name)),
+            err_msg=name,
+        )
+
+
+def test_from_wire_canonical_deferred_still_fast_parses():
+    """The canonical layout (ascending groups, ascending members) must
+    keep fast-parsing — guard the guard against over-rejection."""
+    uni = _identity_uni()
+    blob = _orswot_blob_with_deferred(
+        [([(0, 9)], [3, 4]), ([(1, 9)], [5])]
+    )
+    got = OrswotBatch.from_wire([blob], uni)
+    want = OrswotBatch.from_scalar([from_binary(blob)], uni)
+    for name in ("clock", "ids", "dots", "d_ids", "d_clocks"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(want, name)),
+            err_msg=name,
+        )
+    assert (np.asarray(got.d_ids)[0] != -1).sum() == 3

@@ -1,0 +1,292 @@
+/* Native dense->scalar egress (the `to_scalar` boundary).
+ *
+ * The Python egress loop in OrswotBatch.to_scalar is vectorized down to
+ * "walk the populated cells, build VClock/Orswot objects" — and object
+ * construction through the interpreter is the measured floor (~150k
+ * obj/s at 1M; PERF.md "Ingest/egress is Python-object bound").  This
+ * extension builds the same objects through the CPython C API: tp_new
+ * allocation with direct slot assignment (no __init__ frames), dict
+ * items set with PyDict_SetItem, and single merge-join walks over the
+ * row-major-sorted cell bundles from OrswotBatch._cells.
+ *
+ * Universe-agnostic: the caller resolves actor/member names host-side
+ * (one registry lookup per actor column / unique member id — cheap) and
+ * passes them as Python lists; the C walk only indexes into them, so
+ * interned and identity universes take the same fast path.
+ *
+ * Exactness notes:
+ *  - entries are inserted in (object, slot) order, matching the Python
+ *    path's dict insertion order;
+ *  - deferred keys come from calling the VClock's own .key() method
+ *    (repr-sorted tuple — scalar/vclock.py:92-94), so the key layout
+ *    can never drift from the class definition;
+ *  - counter values are created with PyLong_FromUnsignedLongLong (the
+ *    host passes u32/u64 planes widened to uint64).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <stdlib.h>
+
+typedef struct {
+  Py_buffer view;
+  const int64_t* i;   /* when an index buffer */
+  const uint64_t* u;  /* when a value buffer */
+  Py_ssize_t n;
+  int held;
+} Buf;
+
+static int buf_acquire(PyObject* o, Buf* b, int is_value) {
+  if (PyObject_GetBuffer(o, &b->view, PyBUF_CONTIG_RO) < 0) return -1;
+  b->held = 1;
+  if (b->view.itemsize != 8) {
+    PyErr_SetString(PyExc_TypeError,
+                    "scalarize expects 8-byte (int64/uint64) cell buffers");
+    return -1;
+  }
+  b->i = (const int64_t*)b->view.buf;
+  b->u = (const uint64_t*)b->view.buf;
+  b->n = b->view.len / 8;
+  (void)is_value;
+  return 0;
+}
+
+/* allocate an instance of a slotted Python class without running
+ * __init__ (tp_new only) */
+static PyObject* bare_instance(PyTypeObject* cls, PyObject* empty) {
+  return cls->tp_new(cls, empty, NULL);
+}
+
+/* new VClock with an empty dots dict; returns (vclock, borrowed dots) */
+static PyObject* new_vclock(PyTypeObject* vc_cls, PyObject* empty,
+                            PyObject** dots_out) {
+  PyObject* vc = bare_instance(vc_cls, empty);
+  if (!vc) return NULL;
+  PyObject* dots = PyDict_New();
+  if (!dots || PyObject_SetAttrString(vc, "dots", dots) < 0) {
+    Py_XDECREF(dots);
+    Py_DECREF(vc);
+    return NULL;
+  }
+  *dots_out = dots; /* borrowed: vc holds the ref */
+  Py_DECREF(dots);
+  return vc;
+}
+
+static int dict_set_name_ull(PyObject* d, PyObject* names, int64_t idx,
+                             uint64_t val) {
+  if (idx < 0 || idx >= PyList_GET_SIZE(names)) {
+    PyErr_SetString(PyExc_ValueError, "actor index out of name-list range");
+    return -1;
+  }
+  PyObject* k = PyList_GET_ITEM(names, idx); /* borrowed */
+  PyObject* v = PyLong_FromUnsignedLongLong(val);
+  if (!v) return -1;
+  int rc = PyDict_SetItem(d, k, v);
+  Py_DECREF(v);
+  return rc;
+}
+
+static PyObject* orswot_from_cells(PyObject* self, PyObject* args) {
+  (void)self;
+  PyObject *ors_cls_o, *vc_cls_o, *actor_names, *em_names, *qm_names;
+  Py_ssize_t n;
+  PyObject* raw[17];
+  if (!PyArg_ParseTuple(
+          args, "OOnO!OOOOOO!OOOOOOOO!OOOOO", &ors_cls_o, &vc_cls_o, &n,
+          &PyList_Type, &actor_names,
+          &raw[0], &raw[1], &raw[2],            /* co ca cv   */
+          &raw[3], &raw[4],                      /* eo es      */
+          &PyList_Type, &em_names, &raw[5],      /* em name idx */
+          &raw[6], &raw[7], &raw[8], &raw[9],   /* do ds da dv */
+          &raw[10], &raw[11],                    /* qo qr      */
+          &PyList_Type, &qm_names, &raw[12],     /* qm name idx */
+          &raw[13], &raw[14], &raw[15], &raw[16] /* ho hr ha hv */))
+    return NULL;
+  if (!PyType_Check(ors_cls_o) || !PyType_Check(vc_cls_o)) {
+    PyErr_SetString(PyExc_TypeError, "first two args must be classes");
+    return NULL;
+  }
+  PyTypeObject* ors_cls = (PyTypeObject*)ors_cls_o;
+  PyTypeObject* vc_cls = (PyTypeObject*)vc_cls_o;
+
+  Buf b[17];
+  for (int k = 0; k < 17; ++k) b[k].held = 0;
+  PyObject* out = NULL;
+  PyObject* empty = NULL;
+  PyObject** clock_dots = NULL;   /* borrowed, per object */
+  PyObject** entry_dicts = NULL;  /* borrowed, per object */
+  PyObject** def_dicts = NULL;    /* borrowed, per object */
+  PyObject** entry_dots = NULL;   /* borrowed, per entry cell */
+  int ok = 0;
+
+  for (int k = 0; k < 17; ++k)
+    if (buf_acquire(raw[k], &b[k], k == 2 || k == 9 || k == 16) < 0) goto done;
+  {
+    const Buf *co = &b[0], *ca = &b[1], *cv = &b[2];
+    const Buf *eo = &b[3], *es = &b[4], *em = &b[5];
+    const Buf *dO = &b[6], *ds = &b[7], *da = &b[8], *dv = &b[9];
+    const Buf *qo = &b[10], *qr = &b[11], *qm = &b[12];
+    const Buf *ho = &b[13], *hr = &b[14], *ha = &b[15], *hv = &b[16];
+
+    empty = PyTuple_New(0);
+    if (!empty) goto done;
+    out = PyList_New(n);
+    if (!out) goto done;
+    clock_dots = (PyObject**)calloc((size_t)(n > 0 ? n : 1), sizeof(PyObject*));
+    entry_dicts = (PyObject**)calloc((size_t)(n > 0 ? n : 1), sizeof(PyObject*));
+    def_dicts = (PyObject**)calloc((size_t)(n > 0 ? n : 1), sizeof(PyObject*));
+    entry_dots =
+        (PyObject**)calloc((size_t)(eo->n > 0 ? eo->n : 1), sizeof(PyObject*));
+    if (!clock_dots || !entry_dicts || !def_dicts || !entry_dots) {
+      PyErr_NoMemory();
+      goto done;
+    }
+
+    /* --- construct the N bare objects ---------------------------------- */
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* ors = bare_instance(ors_cls, empty);
+      if (!ors) goto done;
+      PyList_SET_ITEM(out, i, ors); /* list owns ors */
+      PyObject* dots;
+      PyObject* vc = new_vclock(vc_cls, empty, &dots);
+      if (!vc) goto done;
+      int rc = PyObject_SetAttrString(ors, "clock", vc);
+      Py_DECREF(vc);
+      if (rc < 0) goto done;
+      clock_dots[i] = dots;
+      PyObject* entries = PyDict_New();
+      if (!entries) goto done;
+      rc = PyObject_SetAttrString(ors, "entries", entries);
+      entry_dicts[i] = entries; /* borrowed: ors holds the ref */
+      Py_DECREF(entries);
+      if (rc < 0) goto done;
+      PyObject* deferred = PyDict_New();
+      if (!deferred) goto done;
+      rc = PyObject_SetAttrString(ors, "deferred", deferred);
+      def_dicts[i] = deferred;
+      Py_DECREF(deferred);
+      if (rc < 0) goto done;
+    }
+
+    /* --- set clocks ----------------------------------------------------- */
+    for (Py_ssize_t k = 0; k < co->n; ++k) {
+      int64_t i = co->i[k];
+      if (i < 0 || i >= n) {
+        PyErr_SetString(PyExc_ValueError, "clock cell object out of range");
+        goto done;
+      }
+      if (dict_set_name_ull(clock_dots[i], actor_names, ca->i[k], cv->u[k]) < 0)
+        goto done;
+    }
+
+    /* --- entries (object, slot) order; remember each dots dict ---------- */
+    for (Py_ssize_t k = 0; k < eo->n; ++k) {
+      int64_t i = eo->i[k];
+      if (i < 0 || i >= n) {
+        PyErr_SetString(PyExc_ValueError, "entry cell object out of range");
+        goto done;
+      }
+      PyObject* dots;
+      PyObject* vc = new_vclock(vc_cls, empty, &dots);
+      if (!vc) goto done;
+      int64_t mi = em->i[k];
+      int rc = -1;
+      if (mi < 0 || mi >= PyList_GET_SIZE(em_names)) {
+        PyErr_SetString(PyExc_ValueError, "member index out of name-list range");
+      } else {
+        rc = PyDict_SetItem(entry_dicts[i], PyList_GET_ITEM(em_names, mi), vc);
+      }
+      Py_DECREF(vc);
+      if (rc < 0) goto done;
+      entry_dots[k] = dots;
+    }
+
+    /* --- entry dot cells: merge-join against the entry walk ------------- */
+    Py_ssize_t pe = 0;
+    for (Py_ssize_t k = 0; k < dO->n; ++k) {
+      int64_t i = dO->i[k], j = ds->i[k];
+      while (pe < eo->n && (eo->i[pe] < i || (eo->i[pe] == i && es->i[pe] < j)))
+        ++pe;
+      if (pe >= eo->n || eo->i[pe] != i || es->i[pe] != j) {
+        PyErr_SetString(PyExc_ValueError,
+                        "dot cell without a matching entry slot");
+        goto done;
+      }
+      if (dict_set_name_ull(entry_dots[pe], actor_names, da->i[k], dv->u[k]) <
+          0)
+        goto done;
+    }
+
+    /* --- deferred rows: build clock, .key() it, setdefault-add ---------- */
+    Py_ssize_t ph = 0;
+    for (Py_ssize_t k = 0; k < qo->n; ++k) {
+      int64_t i = qo->i[k], j = qr->i[k];
+      if (i < 0 || i >= n) {
+        PyErr_SetString(PyExc_ValueError, "deferred row object out of range");
+        goto done;
+      }
+      PyObject* dots;
+      PyObject* vc = new_vclock(vc_cls, empty, &dots);
+      if (!vc) goto done;
+      while (ph < ho->n && (ho->i[ph] < i || (ho->i[ph] == i && hr->i[ph] < j)))
+        ++ph;
+      while (ph < ho->n && ho->i[ph] == i && hr->i[ph] == j) {
+        if (dict_set_name_ull(dots, actor_names, ha->i[ph], hv->u[ph]) < 0) {
+          Py_DECREF(vc);
+          goto done;
+        }
+        ++ph;
+      }
+      PyObject* key = PyObject_CallMethod(vc, "key", NULL);
+      Py_DECREF(vc);
+      if (!key) goto done;
+      PyObject* fresh = PySet_New(NULL);
+      if (!fresh) {
+        Py_DECREF(key);
+        goto done;
+      }
+      PyObject* set = PyDict_SetDefault(def_dicts[i], key, fresh); /* borrowed */
+      Py_DECREF(key);
+      Py_DECREF(fresh);
+      if (!set) goto done;
+      int64_t mi = qm->i[k];
+      if (mi < 0 || mi >= PyList_GET_SIZE(qm_names)) {
+        PyErr_SetString(PyExc_ValueError, "member index out of name-list range");
+        goto done;
+      }
+      if (PySet_Add(set, PyList_GET_ITEM(qm_names, mi)) < 0) goto done;
+    }
+    ok = 1;
+  }
+
+done:
+  free(clock_dots);
+  free(entry_dicts);
+  free(def_dicts);
+  free(entry_dots);
+  Py_XDECREF(empty);
+  for (int k = 0; k < 17; ++k)
+    if (b[k].held) PyBuffer_Release(&b[k].view);
+  if (!ok) {
+    Py_XDECREF(out);
+    return NULL;
+  }
+  return out;
+}
+
+static PyMethodDef methods[] = {
+    {"orswot_from_cells", orswot_from_cells, METH_VARARGS,
+     "Build a list[Orswot] from OrswotBatch._cells bundles (identity "
+     "universe)."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_crdt_scalarize",
+    "Native dense->scalar object construction.", -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__crdt_scalarize(void) { return PyModule_Create(&module); }

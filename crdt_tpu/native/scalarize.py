@@ -1,0 +1,98 @@
+"""Build + load the native dense->scalar extension (`scalarize.c`).
+
+Unlike the ctypes kernel library (`loader.py`), this is a real CPython
+extension module — it constructs `Orswot`/`VClock` objects directly, so
+it needs the C API, not a flat-array ABI.  Same build-on-first-use
+contract; callers degrade to the Python egress loop when the toolchain
+or headers are unavailable."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_crdt_scalarize.so")
+_lock = threading.Lock()
+_mod = None
+_error: str | None = None
+
+
+def load():
+    """The extension module, building it if needed; raises RuntimeError
+    with the build log when unavailable."""
+    global _mod, _error
+    with _lock:
+        if _mod is not None:
+            return _mod
+        if _error is not None:
+            raise RuntimeError(_error)
+        src = os.path.join(_HERE, "scalarize.c")
+
+        def build():
+            # compile against the RUNNING interpreter's headers —
+            # whatever `python3` is on PATH may be a different ABI
+            import sysconfig
+
+            inc = sysconfig.get_paths()["include"]
+            try:
+                proc = subprocess.run(
+                    ["make", "-C", _HERE, "_crdt_scalarize.so",
+                     f"PYINC={inc}"],
+                    capture_output=True, text=True, timeout=300,
+                )
+            except (OSError, subprocess.TimeoutExpired) as e:
+                return f"scalarize build failed to run: {e}"
+            if proc.returncode != 0:
+                return f"scalarize build failed:\n{proc.stdout}\n{proc.stderr}"
+            return None
+
+        def import_so():
+            spec = importlib.util.spec_from_file_location(
+                "_crdt_scalarize", _SO
+            )
+            if spec is None or spec.loader is None:
+                raise ImportError(f"cannot load extension at {_SO}")
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            return mod
+
+        if not (
+            os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(src)
+        ):
+            err = build()
+            if err is not None:
+                _error = err
+                raise RuntimeError(_error)
+        try:
+            mod = import_so()
+        except Exception as first:  # stale/foreign .so: rebuild once
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            err = build()
+            if err is None:
+                try:
+                    mod = import_so()
+                except Exception as second:
+                    err = f"scalarize unloadable after rebuild: {second}"
+            if err is not None:
+                # cache the failure so later calls degrade to the Python
+                # path instantly instead of re-running make (mirrors
+                # loader.py's second-failure handling)
+                _error = f"{err} (initial load error: {first})"
+                raise RuntimeError(_error)
+        _mod = mod
+        return mod
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except (RuntimeError, OSError):
+        return False

@@ -234,7 +234,13 @@ def _tile_size(a, m, d, r, u_cap, vmem_budget=40 * 1024 * 1024):
     state_bytes = 4 * (a + m + m * a + d + d * a)
     work_bytes = 4 * (6 * u_cap * a + 8 * d * a + 2 * r * m + 4 * u_cap)
     bytes_per_obj = (r + 1) * state_bytes + work_bytes
-    t = 512
+    # capped at 64, not the VMEM ceiling: Mosaic splits every wide op
+    # into ~tile native registers, so compile time scales ~linearly with
+    # the tile (measured: the r=4 kernel at tile 512 took 33 min to
+    # compile — unusable inside a tunnel window; tile 64 keeps the
+    # instruction count ~8x smaller while the grid pipeline still
+    # overlaps HBM perfectly well at 977 tiles/chunk)
+    t = 64
     while t > 8 and t * bytes_per_obj > vmem_budget:
         t //= 2
     if t * bytes_per_obj > vmem_budget:

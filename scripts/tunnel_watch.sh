@@ -108,8 +108,13 @@ for i in $(seq 1 600); do
         #    PROBE_TIMEOUT at the old 900s ladder: the aliveness gate only
         #    proved jax.devices(); a live-but-slow window must not be
         #    misclassified as wedged by the 120s default.
+        # ROUND-5: validation UN-skipped (VERDICT r4 item 2 — one
+        # artifact whose headline, parity gate, elision check and floor
+        # share one rev and one window); the 4200 s budget covers the
+        # ~113 s elision check + ~240 s validation alongside the timed
+        # stages, and the budget watchdog still guarantees rc=0
         if [ ! -e "$MARK/bench" ] && step bench 4500 /tmp/bench_tpu3.log \
-            env CRDT_SKIP_TPU_VALIDATE=1 CRDT_BENCH_BUDGET_S=4200 \
+            env CRDT_RUN_ELISION_CHECK=1 CRDT_BENCH_BUDGET_S=4200 \
             CRDT_BENCH_PROBE_TIMEOUT=900 \
             python bench.py; then
             # publish whatever live on-chip headline landed (the gate

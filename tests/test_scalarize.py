@@ -1,0 +1,121 @@
+"""Native dense->scalar egress (`crdt_tpu/native/scalarize.c`).
+
+Contract: ``OrswotBatch.to_scalar`` through the C extension is
+object-identical to the Python egress loop — same ``to_binary`` bytes,
+same dict insertion order, same deferred keys — for identity AND
+interned universes (names are resolved host-side and passed in, so the
+fast path is universe-agnostic).
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu import Orswot, to_binary
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.utils.interning import Universe
+
+
+def _random_states(rng, n, actor_of, member_of, n_actors=8):
+    states = []
+    for _ in range(n):
+        s = Orswot()
+        for _ in range(int(rng.randint(1, 5))):
+            s.apply(s.add(
+                member_of(int(rng.randint(0, 30))),
+                s.value().derive_add_ctx(actor_of(int(rng.randint(0, n_actors)))),
+            ))
+        if rng.rand() < 0.4 and s.entries:
+            m = next(iter(s.entries))
+            ctx = s.contains(m).derive_rm_ctx()
+            ctx.clock.witness(
+                actor_of(int(rng.randint(0, n_actors))),
+                int(rng.randint(100, 200)),
+            )
+            s.apply(s.remove(m, ctx))
+        states.append(s)
+    return states
+
+
+def _both_paths(states, uni):
+    from crdt_tpu.native import scalarize
+
+    batch = OrswotBatch.from_scalar(states, uni)
+    if not scalarize.available():
+        pytest.skip("scalarize extension unavailable")
+    native = batch.to_scalar(uni)
+    # disable the extension for this comparison only
+    saved_mod, saved_err = scalarize._mod, scalarize._error
+    scalarize._mod, scalarize._error = None, "disabled for test"
+    try:
+        python_path = batch.to_scalar(uni)
+    finally:
+        scalarize._mod, scalarize._error = saved_mod, saved_err
+    return native, python_path
+
+
+def _assert_object_identical(native, python_path):
+    assert len(native) == len(python_path)
+    for a, b in zip(native, python_path):
+        assert to_binary(a) == to_binary(b)
+        assert a.clock.dots == b.clock.dots
+        assert list(a.entries) == list(b.entries)  # insertion order too
+        assert {k: v.dots for k, v in a.entries.items()} == {
+            k: v.dots for k, v in b.entries.items()
+        }
+        assert a.deferred == b.deferred
+
+
+def test_identity_universe_parity():
+    uni = Universe.identity(
+        CrdtConfig(num_actors=8, member_capacity=8, deferred_capacity=4)
+    )
+    rng = np.random.RandomState(0)
+    states = _random_states(rng, 300, actor_of=lambda a: a, member_of=lambda m: m)
+    _assert_object_identical(*_both_paths(states, uni))
+
+
+def test_interned_universe_parity():
+    uni = Universe(
+        CrdtConfig(num_actors=8, member_capacity=8, deferred_capacity=4)
+    )
+    rng = np.random.RandomState(7)
+    states = _random_states(
+        rng, 300,
+        actor_of=lambda a: f"node-{a}", member_of=lambda m: f"fruit-{m}",
+    )
+    _assert_object_identical(*_both_paths(states, uni))
+
+
+def test_empty_and_degenerate_objects():
+    uni = Universe.identity(
+        CrdtConfig(num_actors=4, member_capacity=4, deferred_capacity=2)
+    )
+    states = [Orswot() for _ in range(5)]  # all empty
+    s = Orswot()
+    s.apply(s.add(1, s.value().derive_add_ctx(0)))
+    states.append(s)
+    native, python_path = _both_paths(states, uni)
+    _assert_object_identical(native, python_path)
+    assert native[0].value().val == set()
+    assert native[5].value().val == {1}
+
+
+def test_deferred_key_layout_matches_vclock_key():
+    """The C path calls VClock.key() itself, so the deferred dict keys
+    must be exactly what the scalar class produces (repr-sorted)."""
+    uni = Universe.identity(
+        CrdtConfig(num_actors=16, member_capacity=4, deferred_capacity=4)
+    )
+    s = Orswot()
+    s.apply(s.add(2, s.value().derive_add_ctx(1)))
+    ctx = s.contains(2).derive_rm_ctx()
+    # multi-actor clock where repr order (10 < 2 lexicographically)
+    # differs from numeric order
+    ctx.clock.witness(10, 500)
+    ctx.clock.witness(2, 600)
+    s.apply(s.remove(2, ctx))
+    assert s.deferred
+    native, python_path = _both_paths([s], uni)
+    _assert_object_identical(native, python_path)
+    assert list(native[0].deferred) == list(python_path[0].deferred)

@@ -49,6 +49,13 @@ def _map_wire_leg(val_kernel) -> str | None:
         return "mvreg"
     if type(val_kernel) is OrswotKernel:
         return "orswot"
+    if (
+        type(val_kernel) is MapKernel
+        and type(val_kernel.val_kernel) is MVRegKernel
+    ):
+        # the reference's canonical nesting Map<K, Map<K2, MVReg>>
+        # (`/root/reference/test/map.rs:8`)
+        return "map_mvreg"
     return None
 
 
@@ -150,8 +157,10 @@ class MapBatch:
     ) -> "MapBatch":
         """Bulk ingest from wire blobs (``to_binary(map)`` payloads).
 
-        The native fast path covers the ``Map<int, MVReg<int>>`` and
-        ``Map<int, Orswot<int>>`` monomorphizations (identity universe);
+        The native fast path covers the ``Map<int, MVReg<int>>``,
+        ``Map<int, Orswot<int>>`` and ``Map<int, Map<int, MVReg<int>>>``
+        monomorphizations (identity universe — the last is the
+        reference's canonical nesting, `/root/reference/test/map.rs:8`);
         any other composition — and any blob outside the integer-keyed
         grammar — takes the per-blob Python decoder, so the result always
         equals
@@ -184,6 +193,20 @@ class MapBatch:
             value_overflow_msg = (
                 f"a value antichain wider than mv_capacity "
                 f"{val_kernel.mv_capacity}"
+            )
+        elif leg == "map_mvreg":
+            (clock, keys, eclocks, *val_planes,
+             d_keys, d_clocks, status) = engine.map_map_mvreg_ingest_wire(
+                buf, offsets, cfg.num_actors, cfg.key_capacity,
+                cfg.deferred_capacity, val_kernel.key_capacity,
+                val_kernel.deferred_capacity,
+                val_kernel.val_kernel.mv_capacity, counter_dtype(cfg),
+            )
+            value_overflow_msg = (
+                f"an inner map exceeding key_capacity "
+                f"{val_kernel.key_capacity} / deferred_capacity "
+                f"{val_kernel.deferred_capacity} / mv_capacity "
+                f"{val_kernel.val_kernel.mv_capacity}"
             )
         else:
             (clock, keys, eclocks, *val_planes,
@@ -226,15 +249,23 @@ class MapBatch:
             clock[idx] = np.asarray(sub.clock)
             keys[idx] = np.asarray(sub.keys)
             eclocks[idx] = np.asarray(sub.entry_clocks)
-            for plane, sub_plane in zip(val_planes, sub.vals):
+            for plane, sub_plane in zip(
+                val_planes, jax.tree_util.tree_leaves(sub.vals)
+            ):
                 plane[idx] = np.asarray(sub_plane)
             d_keys[idx] = np.asarray(sub.d_keys)
             d_clocks[idx] = np.asarray(sub.d_clocks)
+        vals = tuple(jnp.asarray(p) for p in val_planes)
+        if leg == "map_mvreg":
+            # re-nest the flat engine planes into the MapKernel vals
+            # pytree: (iclock, ikeys, ieclocks, (vclocks, vvals),
+            # id_keys, id_clocks)
+            vals = vals[:3] + ((vals[3], vals[4]),) + vals[5:]
         return cls(
             clock=jnp.asarray(clock),
             keys=jnp.asarray(keys),
             entry_clocks=jnp.asarray(eclocks),
-            vals=tuple(jnp.asarray(p) for p in val_planes),
+            vals=vals,
             d_keys=jnp.asarray(d_keys),
             d_clocks=jnp.asarray(d_clocks),
             kernel=MapKernel.from_config(cfg, val_kernel),
@@ -263,7 +294,8 @@ class MapBatch:
         if engine is not None:
             planes = tuple(np.asarray(x) for x in (
                 self.clock, self.keys, self.entry_clocks,
-                *self.vals, self.d_keys, self.d_clocks,
+                *jax.tree_util.tree_leaves(self.vals),
+                self.d_keys, self.d_clocks,
             ))
             if counters_overflow_zigzag(planes):
                 engine = None

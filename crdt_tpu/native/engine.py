@@ -1009,3 +1009,77 @@ def map_orswot_encode_wire(clock, keys, eclocks, vclock, vids, vdots, vdids,
     buf = np.empty(int(offsets[-1]), dtype=np.uint8)
     fn(*args, _ptr(offsets), _ptr(buf))
     return buf, offsets
+
+
+# -- Map<K, Map<K2, MVReg>> wire codec (the reference's canonical
+# nesting, `/root/reference/test/map.rs:8`) ---------------------------------
+
+
+def map_map_mvreg_ingest_wire(buf, offsets, a: int, k: int, d: int, k2: int,
+                              d2: int, kv: int, dtype):
+    """Parallel nested-Map wire decode into the dense nested planes.
+    Returns ``(clock, keys, eclocks, iclock, ikeys, ieclocks, vclocks,
+    vvals, id_keys, id_clocks, d_keys, d_clocks, status)``; status 5 =
+    any inner overflow (keys > k2, deferred > d2, antichain > kv)."""
+    buf = np.ascontiguousarray(np.frombuffer(buf, dtype=np.uint8))
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = offsets.shape[0] - 1
+    dt = np.dtype(dtype)
+    clock = np.zeros((n, a), dtype=dt)
+    keys = np.full((n, k), -1, dtype=np.int32)
+    eclocks = np.zeros((n, k, a), dtype=dt)
+    iclock = np.zeros((n, k, a), dtype=dt)
+    ikeys = np.full((n, k, k2), -1, dtype=np.int32)
+    ieclocks = np.zeros((n, k, k2, a), dtype=dt)
+    vclocks = np.zeros((n, k, k2, kv, a), dtype=dt)
+    vvals = np.zeros((n, k, k2, kv), dtype=dt)
+    id_keys = np.full((n, k, d2), -1, dtype=np.int32)
+    id_clocks = np.zeros((n, k, d2, a), dtype=dt)
+    d_keys = np.full((n, d), -1, dtype=np.int32)
+    d_clocks = np.zeros((n, d, a), dtype=dt)
+    status = np.zeros(n, dtype=np.uint8)
+    fn = _fn("map_map_mvreg_ingest_wire", dt)
+    fn.restype = ctypes.c_int64
+    fn(
+        _ptr(buf), _ptr(offsets), ctypes.c_int64(n), ctypes.c_int64(a),
+        ctypes.c_int64(k), ctypes.c_int64(d), ctypes.c_int64(k2),
+        ctypes.c_int64(d2), ctypes.c_int64(kv),
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(iclock), _ptr(ikeys),
+        _ptr(ieclocks), _ptr(vclocks), _ptr(vvals), _ptr(id_keys),
+        _ptr(id_clocks), _ptr(d_keys), _ptr(d_clocks), _ptr(status),
+    )
+    return (clock, keys, eclocks, iclock, ikeys, ieclocks, vclocks, vvals,
+            id_keys, id_clocks, d_keys, d_clocks, status)
+
+
+def map_map_mvreg_encode_wire(clock, keys, eclocks, iclock, ikeys, ieclocks,
+                              vclocks, vvals, id_keys, id_clocks, d_keys,
+                              d_clocks):
+    """Parallel nested-Map wire encode — byte-identical to ``to_binary``
+    of the scalars (identity universes).  Returns ``(buf, offsets)``."""
+    planes = _contig(clock, keys, eclocks, iclock, ikeys, ieclocks, vclocks,
+                     vvals, id_keys, id_clocks, d_keys, d_clocks)
+    (clock, keys, eclocks, iclock, ikeys, ieclocks, vclocks, vvals, id_keys,
+     id_clocks, d_keys, d_clocks) = planes
+    dt = _check_counters(clock, eclocks, iclock, ieclocks, vclocks, vvals,
+                         id_clocks, d_clocks)
+    n, a = clock.shape
+    k = keys.shape[1]
+    d = d_keys.shape[1]
+    k2 = ikeys.shape[2]
+    d2 = id_keys.shape[2]
+    kv = vvals.shape[3]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    fn = _fn("map_map_mvreg_encode_wire", dt)
+    args = (
+        _ptr(clock), _ptr(keys), _ptr(eclocks), _ptr(iclock), _ptr(ikeys),
+        _ptr(ieclocks), _ptr(vclocks), _ptr(vvals), _ptr(id_keys),
+        _ptr(id_clocks), _ptr(d_keys), _ptr(d_clocks), ctypes.c_int64(n),
+        ctypes.c_int64(a), ctypes.c_int64(k), ctypes.c_int64(d),
+        ctypes.c_int64(k2), ctypes.c_int64(d2), ctypes.c_int64(kv),
+    )
+    fn(*args, _ptr(offsets), None)
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(*args, _ptr(offsets), _ptr(buf))
+    return buf, offsets

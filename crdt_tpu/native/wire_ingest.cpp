@@ -1100,29 +1100,29 @@ void pncounter_encode_wire_u64(const uint64_t* planes, int64_t n, int64_t A,
 namespace {
 
 constexpr uint8_t kTagMap = 0x27;
-constexpr uint8_t kTagValTypeNamed = 0x50;
-constexpr uint8_t kMVRegName[5] = {'M', 'V', 'R', 'e', 'g'};
+// val_type headers: the bytes between the 0x27 map tag and the clock
+// body.  0x50 = named kernel (uv(len) + name), 0x51 = nested MapOf
+// (followed by the inner val_type header) — serde.py
+// _T_VALTYPE_NAMED/_T_VALTYPE_MAP.
+constexpr uint8_t kMVRegHdr[] = {0x50, 0x05, 'M', 'V', 'R', 'e', 'g'};
+constexpr uint8_t kOrswotHdr[] = {0x50, 0x06, 'O', 'r', 's', 'w', 'o', 't'};
+constexpr uint8_t kMapMVRegHdr[] = {0x51, 0x50, 0x05, 'M', 'V', 'R', 'e', 'g'};
 
-// the shared Map wire SHELL — tag, named val_type, map clock, the
+// the shared Map wire VALUE — tag, val_type header, map clock, the
 // strictly-ascending key loop (key + raw entry clock body + one value
-// via the functor), and the deferred section.  The per-entry value is
-// the only thing that differs between Map compositions:
-// ``parse_val(c, slot) -> status`` / ``emit_val(slot, out) -> bytes``.
+// via the functor), and the deferred section — parsed mid-stream from
+// an existing cursor, so nested Map values recurse into it.  The
+// per-entry value is the only thing that differs between Map
+// compositions: ``parse_val(c, slot) -> status``.
 template <typename C, typename ParseVal>
-int parse_map_shell(const uint8_t* buf, int64_t lo, int64_t hi,
-                    const uint8_t* name, uint64_t name_len, int64_t A,
-                    int64_t K, int64_t D, C* clock, int32_t* keys,
+int parse_map_value(Cursor& c, const uint8_t* hdr, uint64_t hdr_len,
+                    int64_t A, int64_t K, int64_t D, C* clock, int32_t* keys,
                     C* eclocks, int32_t* d_keys, C* d_clocks,
                     ParseVal&& parse_val) {
-  Cursor c{buf + lo, buf + hi};
   if (!c.byte(kTagMap)) return 1;
-  // val_type header: only the expected named kernel parses fast
-  if (!c.byte(kTagValTypeNamed)) return 1;
-  uint64_t nlen;
-  if (!c.uv(&nlen) || nlen != name_len) return 1;
-  if (c.p + name_len > c.end || std::memcmp(c.p, name, name_len) != 0)
-    return 1;
-  c.p += name_len;
+  // val_type header: only the expected kernel parses fast
+  if (c.p + hdr_len > c.end || std::memcmp(c.p, hdr, hdr_len) != 0) return 1;
+  c.p += hdr_len;
 
   int st = parse_clock_body(c, A, clock);
   if (st) return st;
@@ -1147,7 +1147,19 @@ int parse_map_shell(const uint8_t* buf, int64_t lo, int64_t hi,
     if (st) return st;
   }
 
-  st = parse_deferred_section<C>(c, A, D, d_keys, d_clocks);
+  return parse_deferred_section<C>(c, A, D, d_keys, d_clocks);
+}
+
+// top-level wrapper: one whole blob must be exactly one Map value
+template <typename C, typename ParseVal>
+int parse_map_shell(const uint8_t* buf, int64_t lo, int64_t hi,
+                    const uint8_t* hdr, uint64_t hdr_len, int64_t A,
+                    int64_t K, int64_t D, C* clock, int32_t* keys,
+                    C* eclocks, int32_t* d_keys, C* d_clocks,
+                    ParseVal&& parse_val) {
+  Cursor c{buf + lo, buf + hi};
+  int st = parse_map_value<C>(c, hdr, hdr_len, A, K, D, clock, keys, eclocks,
+                              d_keys, d_clocks, parse_val);
   if (st) return st;
   if (c.p != c.end) return 1;
   return 0;
@@ -1156,16 +1168,14 @@ int parse_map_shell(const uint8_t* buf, int64_t lo, int64_t hi,
 template <typename C, typename EmitVal>
 int64_t map_shell_encode_one(const C* clock, const int32_t* keys,
                              const C* eclocks, const int32_t* d_keys,
-                             const C* d_clocks, const uint8_t* name,
-                             uint64_t name_len, int64_t A, int64_t K,
+                             const C* d_clocks, const uint8_t* hdr,
+                             uint64_t hdr_len, int64_t A, int64_t K,
                              int64_t D, uint8_t* out, EmitVal&& emit_val) {
   const bool sizing = (out == nullptr);
   Emitter e{out};
   std::vector<int64_t> scratch;
   e.byte(kTagMap);
-  e.byte(kTagValTypeNamed);
-  e.uv(name_len);
-  for (uint64_t i = 0; i < name_len; ++i) e.byte(name[i]);
+  for (uint64_t i = 0; i < hdr_len; ++i) e.byte(hdr[i]);
   emit_clock_body(e, clock, A, scratch, !sizing);
 
   std::vector<int64_t> slots;
@@ -1190,28 +1200,38 @@ int64_t map_shell_encode_one(const C* clock, const int32_t* keys,
   return e.count;
 }
 
+// one MVReg value (0x25 uv kv, kv * (clock_body 0x03 uv zz(val))) into
+// per-slot antichain planes — shared by the flat Map<K, MVReg> leg and
+// the nested Map<K, Map<K2, MVReg>> leg.  Status 5 = antichain > KV.
+template <typename C>
+int parse_mvreg_value_into(Cursor& c, int64_t A, int64_t KV, C* vclocks,
+                           C* vvals) {
+  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
+  if (!c.byte(kTagMVReg)) return 1;
+  uint64_t kv;
+  if (!c.uv(&kv)) return 1;
+  if (kv > static_cast<uint64_t>(KV)) return 5;
+  for (uint64_t j = 0; j < kv; ++j) {
+    int st = parse_clock_body(c, A, vclocks + j * A);
+    if (st) return st;
+    uint64_t val;
+    if (!c.nonneg(&val)) return 1;
+    if (val > 0x7FFFFFFFull || val > kCounterMax) return 1;
+    vvals[j] = static_cast<C>(val);
+  }
+  return 0;
+}
+
 template <typename C>
 int parse_map_mvreg_one(const uint8_t* buf, int64_t lo, int64_t hi,
                         int64_t A, int64_t K, int64_t D, int64_t KV,
                         C* clock, int32_t* keys, C* eclocks, C* vclocks,
                         C* vvals, int32_t* d_keys, C* d_clocks) {
-  constexpr uint64_t kCounterMax = static_cast<uint64_t>(~C{0});
   return parse_map_shell<C>(
-      buf, lo, hi, kMVRegName, 5, A, K, D, clock, keys, eclocks, d_keys,
-      d_clocks, [&](Cursor& c, int64_t e) -> int {
-        if (!c.byte(kTagMVReg)) return 1;
-        uint64_t kv;
-        if (!c.uv(&kv)) return 1;
-        if (kv > static_cast<uint64_t>(KV)) return 5;
-        for (uint64_t j = 0; j < kv; ++j) {
-          int st = parse_clock_body(c, A, vclocks + (e * KV + j) * A);
-          if (st) return st;
-          uint64_t val;
-          if (!c.nonneg(&val)) return 1;
-          if (val > 0x7FFFFFFFull || val > kCounterMax) return 1;
-          vvals[e * KV + j] = static_cast<C>(val);
-        }
-        return 0;
+      buf, lo, hi, kMVRegHdr, sizeof(kMVRegHdr), A, K, D, clock, keys,
+      eclocks, d_keys, d_clocks, [&](Cursor& c, int64_t e) -> int {
+        return parse_mvreg_value_into<C>(c, A, KV, vclocks + e * KV * A,
+                                         vvals + e * KV);
       });
 }
 
@@ -1222,10 +1242,67 @@ int64_t map_mvreg_encode_one(const C* clock, const int32_t* keys,
                              int64_t KV, const int32_t* d_keys,
                              const C* d_clocks, uint8_t* out) {
   return map_shell_encode_one<C>(
-      clock, keys, eclocks, d_keys, d_clocks, kMVRegName, 5, A, K, D, out,
-      [&](int64_t s, uint8_t* p) -> int64_t {
+      clock, keys, eclocks, d_keys, d_clocks, kMVRegHdr, sizeof(kMVRegHdr),
+      A, K, D, out, [&](int64_t s, uint8_t* p) -> int64_t {
         return mvreg_encode_one<C>(vclocks + s * KV * A, vvals + s * KV, KV,
                                    A, p);
+      });
+}
+
+// -- nested Map<K, Map<K2, MVReg>> — the reference's canonical nesting
+// (`/root/reference/test/map.rs:8`).  The outer val_type header is
+// 0x51 (MapOf) followed by the inner header; each entry value is a
+// full inner-Map encoding, recursing through parse_map_value.  Value
+// planes per outer key slot: iclock[A], ikeys[K2], ieclocks[K2,A],
+// vclocks[K2,KV,A], vvals[K2,KV], id_keys[D2], id_clocks[D2,A].
+// Status: 0 ok, 1 fallback, 2 outer key overflow, 3 outer deferred
+// overflow, 4 actor out of range, 5 any inner overflow (inner keys >
+// K2, inner deferred > D2, antichain > KV).
+
+template <typename C>
+int parse_map_map_mvreg_one(
+    const uint8_t* buf, int64_t lo, int64_t hi, int64_t A, int64_t K,
+    int64_t D, int64_t K2, int64_t D2, int64_t KV, C* clock, int32_t* keys,
+    C* eclocks, C* iclock, int32_t* ikeys, C* ieclocks, C* vclocks, C* vvals,
+    int32_t* id_keys, C* id_clocks, int32_t* d_keys, C* d_clocks) {
+  return parse_map_shell<C>(
+      buf, lo, hi, kMapMVRegHdr, sizeof(kMapMVRegHdr), A, K, D, clock, keys,
+      eclocks, d_keys, d_clocks, [&](Cursor& c, int64_t e) -> int {
+        int st = parse_map_value<C>(
+            c, kMVRegHdr, sizeof(kMVRegHdr), A, K2, D2, iclock + e * A,
+            ikeys + e * K2, ieclocks + e * K2 * A, id_keys + e * D2,
+            id_clocks + e * D2 * A, [&](Cursor& c2, int64_t e2) -> int {
+              return parse_mvreg_value_into<C>(
+                  c2, A, KV, vclocks + (e * K2 + e2) * KV * A,
+                  vvals + (e * K2 + e2) * KV);
+            });
+        // the inner map's own capacity overflows must not masquerade as
+        // the OUTER map's key/deferred overflow
+        if (st == 2 || st == 3) return 5;
+        return st;
+      });
+}
+
+template <typename C>
+int64_t map_map_mvreg_encode_one(
+    const C* clock, const int32_t* keys, const C* eclocks, const C* iclock,
+    const int32_t* ikeys, const C* ieclocks, const C* vclocks, const C* vvals,
+    const int32_t* id_keys, const C* id_clocks, const int32_t* d_keys,
+    const C* d_clocks, int64_t A, int64_t K, int64_t D, int64_t K2,
+    int64_t D2, int64_t KV, uint8_t* out) {
+  return map_shell_encode_one<C>(
+      clock, keys, eclocks, d_keys, d_clocks, kMapMVRegHdr,
+      sizeof(kMapMVRegHdr), A, K, D, out,
+      [&](int64_t s, uint8_t* p) -> int64_t {
+        return map_shell_encode_one<C>(
+            iclock + s * A, ikeys + s * K2, ieclocks + s * K2 * A,
+            id_keys + s * D2, id_clocks + s * D2 * A, kMVRegHdr,
+            sizeof(kMVRegHdr), A, K2, D2, p,
+            [&](int64_t s2, uint8_t* p2) -> int64_t {
+              return mvreg_encode_one<C>(
+                  vclocks + (s * K2 + s2) * KV * A, vvals + (s * K2 + s2) * KV,
+                  KV, A, p2);
+            });
       });
 }
 
@@ -1355,8 +1432,6 @@ void map_mvreg_encode_wire_u64(const uint64_t* clock, const int32_t* keys,
 
 namespace {
 
-constexpr uint8_t kOrswotName[6] = {'O', 'r', 's', 'w', 'o', 't'};
-
 template <typename C>
 int parse_map_orswot_one(const uint8_t* buf, int64_t lo, int64_t hi,
                          int64_t A, int64_t K, int64_t D, int64_t MV,
@@ -1364,8 +1439,8 @@ int parse_map_orswot_one(const uint8_t* buf, int64_t lo, int64_t hi,
                          C* vclock, int32_t* vids, C* vdots, int32_t* vdids,
                          C* vdclocks, int32_t* d_keys, C* d_clocks) {
   return parse_map_shell<C>(
-      buf, lo, hi, kOrswotName, 6, A, K, D, clock, keys, eclocks, d_keys,
-      d_clocks, [&](Cursor& c, int64_t e) -> int {
+      buf, lo, hi, kOrswotHdr, sizeof(kOrswotHdr), A, K, D, clock, keys,
+      eclocks, d_keys, d_clocks, [&](Cursor& c, int64_t e) -> int {
         int st = parse_orswot_value<C>(
             c, A, MV, DV, vclock + e * A, vids + e * MV, vdots + e * MV * A,
             vdids + e * DV, vdclocks + e * DV * A);
@@ -1385,8 +1460,8 @@ int64_t map_orswot_encode_one(const C* clock, const int32_t* keys,
                               int64_t A, int64_t K, int64_t D, int64_t MV,
                               int64_t DV, uint8_t* out) {
   return map_shell_encode_one<C>(
-      clock, keys, eclocks, d_keys, d_clocks, kOrswotName, 6, A, K, D, out,
-      [&](int64_t s, uint8_t* p) -> int64_t {
+      clock, keys, eclocks, d_keys, d_clocks, kOrswotHdr, sizeof(kOrswotHdr),
+      A, K, D, out, [&](int64_t s, uint8_t* p) -> int64_t {
         return encode_one<C>(vclock + s * A, vids + s * MV,
                              vdots + s * MV * A, vdids + s * DV,
                              vdclocks + s * DV * A, A, MV, DV, p);
@@ -1462,9 +1537,77 @@ int64_t map_orswot_encode_one(const C* clock, const int32_t* keys,
     }                                                                         \
   }
 
+#define CRDT_MAP_MAP_MVREG_INGEST(SUF, TYPE)                                  \
+  int64_t map_map_mvreg_ingest_wire_##SUF(                                    \
+      const uint8_t* buf, const int64_t* offsets, int64_t n, int64_t A,       \
+      int64_t K, int64_t D, int64_t K2, int64_t D2, int64_t KV, TYPE* clock,  \
+      int32_t* keys, TYPE* eclocks, TYPE* iclock, int32_t* ikeys,             \
+      TYPE* ieclocks, TYPE* vclocks, TYPE* vvals, int32_t* id_keys,           \
+      TYPE* id_clocks, int32_t* d_keys, TYPE* d_clocks, uint8_t* status) {    \
+    int64_t bad = 0;                                                          \
+    CRDT_OMP_FOR("omp parallel for schedule(dynamic, 512) reduction(+ : bad)") \
+    for (int64_t i = 0; i < n; ++i) {                                         \
+      int st = parse_map_map_mvreg_one<TYPE>(                                 \
+          buf, offsets[i], offsets[i + 1], A, K, D, K2, D2, KV,               \
+          clock + i * A, keys + i * K, eclocks + i * K * A,                   \
+          iclock + i * K * A, ikeys + i * K * K2,                             \
+          ieclocks + i * K * K2 * A, vclocks + i * K * K2 * KV * A,           \
+          vvals + i * K * K2 * KV, id_keys + i * K * D2,                      \
+          id_clocks + i * K * D2 * A, d_keys + i * D, d_clocks + i * D * A);  \
+      status[i] = static_cast<uint8_t>(st);                                   \
+      if (st != 0) {                                                          \
+        std::memset(clock + i * A, 0, sizeof(TYPE) * A);                      \
+        std::memset(eclocks + i * K * A, 0, sizeof(TYPE) * K * A);            \
+        std::memset(iclock + i * K * A, 0, sizeof(TYPE) * K * A);             \
+        std::memset(ieclocks + i * K * K2 * A, 0,                             \
+                    sizeof(TYPE) * K * K2 * A);                               \
+        std::memset(vclocks + i * K * K2 * KV * A, 0,                         \
+                    sizeof(TYPE) * K * K2 * KV * A);                          \
+        std::memset(vvals + i * K * K2 * KV, 0,                               \
+                    sizeof(TYPE) * K * K2 * KV);                              \
+        std::memset(id_clocks + i * K * D2 * A, 0,                            \
+                    sizeof(TYPE) * K * D2 * A);                               \
+        std::memset(d_clocks + i * D * A, 0, sizeof(TYPE) * D * A);           \
+        for (int64_t j = 0; j < K; ++j) keys[i * K + j] = kEmpty;             \
+        for (int64_t j = 0; j < K * K2; ++j) ikeys[i * K * K2 + j] = kEmpty;  \
+        for (int64_t j = 0; j < K * D2; ++j)                                  \
+          id_keys[i * K * D2 + j] = kEmpty;                                   \
+        for (int64_t j = 0; j < D; ++j) d_keys[i * D + j] = kEmpty;           \
+        ++bad;                                                                \
+      }                                                                       \
+    }                                                                         \
+    return bad;                                                               \
+  }
+
+#define CRDT_MAP_MAP_MVREG_ENCODE(SUF, TYPE)                                  \
+  void map_map_mvreg_encode_wire_##SUF(                                       \
+      const TYPE* clock, const int32_t* keys, const TYPE* eclocks,            \
+      const TYPE* iclock, const int32_t* ikeys, const TYPE* ieclocks,         \
+      const TYPE* vclocks, const TYPE* vvals, const int32_t* id_keys,         \
+      const TYPE* id_clocks, const int32_t* d_keys, const TYPE* d_clocks,     \
+      int64_t n, int64_t A, int64_t K, int64_t D, int64_t K2, int64_t D2,     \
+      int64_t KV, int64_t* offsets, uint8_t* buf) {                           \
+    CRDT_OMP_FOR("omp parallel for schedule(dynamic, 512)")                   \
+    for (int64_t i = 0; i < n; ++i) {                                         \
+      uint8_t* dst = (buf == nullptr) ? nullptr : buf + offsets[i];           \
+      int64_t cnt = map_map_mvreg_encode_one<TYPE>(                           \
+          clock + i * A, keys + i * K, eclocks + i * K * A,                   \
+          iclock + i * K * A, ikeys + i * K * K2,                             \
+          ieclocks + i * K * K2 * A, vclocks + i * K * K2 * KV * A,           \
+          vvals + i * K * K2 * KV, id_keys + i * K * D2,                      \
+          id_clocks + i * K * D2 * A, d_keys + i * D, d_clocks + i * D * A,   \
+          A, K, D, K2, D2, KV, dst);                                          \
+      if (buf == nullptr) offsets[i + 1] = cnt;                               \
+    }                                                                         \
+  }
+
 extern "C" {
 CRDT_MAP_ORSWOT_INGEST(u32, uint32_t)
 CRDT_MAP_ORSWOT_INGEST(u64, uint64_t)
 CRDT_MAP_ORSWOT_ENCODE(u32, uint32_t)
 CRDT_MAP_ORSWOT_ENCODE(u64, uint64_t)
+CRDT_MAP_MAP_MVREG_INGEST(u32, uint32_t)
+CRDT_MAP_MAP_MVREG_INGEST(u64, uint64_t)
+CRDT_MAP_MAP_MVREG_ENCODE(u32, uint32_t)
+CRDT_MAP_MAP_MVREG_ENCODE(u64, uint64_t)
 }  // extern "C"

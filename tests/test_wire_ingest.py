@@ -913,7 +913,7 @@ def test_map_wire_duplicate_key_blob_falls_back():
     pos=st.integers(0, 4096),
     byte=st.integers(0, 255),
     mode=st.sampled_from(["flip", "insert", "delete", "truncate"]),
-    leg=st.sampled_from(["vclock", "pncounter", "map", "map_orswot"]),
+    leg=st.sampled_from(["vclock", "pncounter", "map", "map_orswot", "map_map"]),
 )
 def test_new_leg_parsers_total_on_mutated_blobs(seed, pos, byte, mode, leg):
     """Mutation-fuzz totality for the round-4 parsers (clockish /
@@ -942,6 +942,13 @@ def test_new_leg_parsers_total_on_mutated_blobs(seed, pos, byte, mode, leg):
         uni = _map_uni()
         vk = OrswotKernel.from_config(uni.config)
         state = _random_map_orswots(rng, 1)[0]
+        ingest = lambda blob: MapBatch.from_wire([blob], uni, vk)
+        pipeline = lambda blob: MapBatch.from_scalar(
+            [from_binary(blob)], uni, vk)
+    elif leg == "map_map":
+        uni = _map_uni()
+        vk = _nested_kernel(uni)
+        state = _random_nested_maps(rng, 1)[0]
         ingest = lambda blob: MapBatch.from_wire([blob], uni, vk)
         pipeline = lambda blob: MapBatch.from_scalar(
             [from_binary(blob)], uni, vk)
@@ -1143,3 +1150,128 @@ def test_from_wire_canonical_deferred_still_fast_parses():
             err_msg=name,
         )
     assert (np.asarray(got.d_ids)[0] != -1).sum() == 3
+
+
+def _random_nested_maps(rng, n, n_actors=8, deferred_frac=0.3):
+    """Random ``Map<int, Map<int, MVReg>>`` states — the reference's
+    canonical nesting (`/root/reference/test/map.rs:8`) — with deferred
+    removes planted at BOTH map levels."""
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+    from crdt_tpu.utils.serde import MapOf
+
+    maps = []
+    for i in range(n):
+        m = Map(MapOf(MVReg))
+        for _ in range(int(rng.randint(0, 4))):
+            key = int(rng.randint(0, 30))
+            ikey = int(rng.randint(0, 30))
+            actor = int(rng.randint(0, n_actors))
+            val = int(rng.randint(0, 100))
+            ctx = m.get(key).derive_add_ctx(actor)
+            m.apply(m.update(
+                key, ctx,
+                lambda v, c, _ik=ikey, _v=val: v.update(
+                    _ik, c, lambda reg, c2: reg.set(_v, c2)
+                ),
+            ))
+        if rng.rand() < deferred_frac and m.entries:
+            # outer-level causally-future remove
+            key = next(iter(m.entries))
+            ctx = m.get(key).derive_rm_ctx()
+            ctx.clock.witness(int(rng.randint(0, n_actors)),
+                              int(rng.randint(100, 200)))
+            m.apply(m.rm(key, ctx))
+        if rng.rand() < deferred_frac and m.entries:
+            # inner-level causally-future remove inside one value map
+            key = next(iter(m.entries))
+            inner = m.entries[key].val
+            if inner.entries:
+                ikey = next(iter(inner.entries))
+                ctx = m.get(key).derive_add_ctx(int(rng.randint(0, n_actors)))
+                ictx = inner.get(ikey).derive_rm_ctx()
+                ictx.clock.witness(int(rng.randint(0, n_actors)),
+                                   int(rng.randint(100, 200)))
+                from crdt_tpu.scalar.map import Rm as MapRm, Up as MapUp
+                m.apply(MapUp(dot=ctx.dot, key=key,
+                              op=MapRm(clock=ictx.clock, key=ikey)))
+        maps.append(m)
+    return maps
+
+
+def _nested_kernel(uni):
+    from crdt_tpu.batch.val_kernels import MapKernel, MVRegKernel
+
+    return MapKernel.from_config(uni.config, MVRegKernel.from_config(uni.config))
+
+
+@pytest.mark.parametrize("counter_bits", [32, 64])
+def test_map_map_mvreg_wire_roundtrip_and_parity(counter_bits):
+    """Nested Map<K, Map<K2, MVReg>> leg: ingest matches the Python
+    pipeline plane-for-plane, egress is byte-identical to to_binary,
+    round trip is the identity on scalars incl. deferred at both
+    levels."""
+    from crdt_tpu.batch.map_batch import MapBatch
+
+    rng = np.random.RandomState(211)
+    uni = _map_uni(counter_bits)
+    vk = _nested_kernel(uni)
+    maps = _random_nested_maps(rng, 30)
+    blobs = [to_binary(m) for m in maps]
+
+    got = MapBatch.from_wire(blobs, uni, vk)
+    want = MapBatch.from_scalar([from_binary(b) for b in blobs], uni, vk)
+    import jax
+
+    for g, w in zip(
+        jax.tree_util.tree_leaves(got.state), jax.tree_util.tree_leaves(want.state)
+    ):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    assert got.to_scalar(uni) == maps  # full state incl. deferred
+
+    out = got.to_wire(uni)
+    assert out == blobs  # byte-identical egress
+    assert MapBatch.from_wire(out, uni, vk).to_scalar(uni) == maps
+
+
+def test_map_map_mvreg_wire_inner_overflow_raises():
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+    from crdt_tpu.utils.serde import MapOf
+
+    uni = _map_uni()
+    vk = _nested_kernel(uni)
+    m = Map(MapOf(MVReg))
+    # 5 inner keys under one outer key > key_capacity 4
+    for ikey in range(5):
+        ctx = m.get(1).derive_add_ctx(0)
+        m.apply(m.update(
+            1, ctx,
+            lambda v, c, _ik=ikey: v.update(_ik, c, lambda r, c2: r.set(7, c2)),
+        ))
+    with pytest.raises(ValueError, match="inner map"):
+        MapBatch.from_wire([to_binary(m)], uni, vk)
+
+
+def test_map_map_mvreg_wire_mixed_patch_path():
+    """Blobs outside the native varint range (a u64 counter >= 2^63
+    zigzags past the parser's u64) splice through the per-blob Python
+    fallback while fast rows parse natively."""
+    from crdt_tpu.batch.map_batch import MapBatch
+    from crdt_tpu.scalar.map import Map
+    from crdt_tpu.scalar.mvreg import MVReg
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.utils.serde import MapOf
+
+    rng = np.random.RandomState(212)
+    uni = _map_uni(64)
+    vk = _nested_kernel(uni)
+    maps = _random_nested_maps(rng, 6)
+    big = Map(MapOf(MVReg))
+    big.clock = VClock({3: 2**63 + 5})
+    maps = maps[:3] + [big] + maps[3:]
+    blobs = [to_binary(m) for m in maps]
+    got = MapBatch.from_wire(blobs, uni, vk)
+    assert got.to_scalar(uni) == maps
+    assert int(np.asarray(got.clock)[3, 3]) == 2**63 + 5

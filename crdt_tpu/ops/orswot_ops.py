@@ -256,11 +256,19 @@ def resolve_merge_impl(impl: str | None = None) -> str:
     default), ``unrolled`` (gather/sort-free tile math,
     :mod:`crdt_tpu.ops.orswot_unrolled`; exact for uint32 counters only —
     bit-equal outside the conservative-overflow objects, see
-    ``tests/test_orswot_unrolled.py``), or ``pallas`` (the fused
-    single-HBM-pass kernel, :mod:`crdt_tpu.ops.orswot_pallas` — same
-    tile math as ``unrolled`` but the whole merge stays in VMEM;
-    compiled on TPU, interpret-emulated elsewhere; 2-D batches and u32
-    only, else falls through).
+    ``tests/test_orswot_unrolled.py``), or ``pallas``.
+
+    ``pallas`` — ROUND-5 DECISION (VERDICT r4 item 4): for PAIRWISE
+    merges it is an alias of ``unrolled``.  The fused pairwise kernel
+    (:mod:`crdt_tpu.ops.orswot_pallas`) measured on-chip strictly worse
+    than the jnp path (0.60M vs 3.17M merges/s, 2026-08-01 window —
+    VPU-compute-bound at 8-object tiles), and a fused PAIRWISE merge
+    cannot beat jnp on traffic anyway (both read 2 states and write 1);
+    it stays importable for benches/tests only.  Where ``pallas`` DOES
+    pay is the R-way FOLD — each replica state read once instead of the
+    sequential fold's 3-states-per-merge — which :func:`fold_merge`
+    dispatches to the union-aligned fused kernel
+    (:mod:`crdt_tpu.ops.orswot_fold_aligned`).
 
     Precedence: an explicit non-``"auto"`` choice (the ``impl=`` argument
     to :func:`merge`, usually fed from ``CrdtConfig.merge_impl``) wins;
@@ -335,23 +343,6 @@ def merge(
             stacklevel=2,
         )
     if (
-        impl == "pallas"
-        and clock_a.dtype.itemsize <= 4
-        and ids_a.shape[-1] <= _ALIGN_MATCH_MAX_M
-        and clock_a.ndim == 2
-    ):
-        # the fused single-HBM-pass kernel (interpret-mode emulation off
-        # TPU); 2-D [N, ...] batches only — the pallas_call grid blocks
-        # the leading object axis.  Wide tables / u64 / higher-rank
-        # batches fall through to the paths below.
-        from . import orswot_pallas
-
-        return orswot_pallas.merge(
-            clock_a, ids_a, dots_a, dids_a, dclocks_a,
-            clock_b, ids_b, dots_b, dids_b, dclocks_b,
-            m_cap, d_cap,
-        )
-    if (
         impl in ("unrolled", "pallas")
         and clock_a.dtype.itemsize <= 4
         and ids_a.shape[-1] <= _ALIGN_MATCH_MAX_M
@@ -360,10 +351,10 @@ def merge(
         # member tables (elastic regrowth) stay on the rank path's
         # sort-aligned _merge_wide below; rank-polymorphic
         # (ellipsis-based tile math), so any batch shape dispatches.
-        # impl == "pallas" lands here for rank>2 batches the pallas_call
-        # grid can't block: unrolled IS the pallas kernel's tile math
-        # (minus the VMEM fusion), so a pallas request degrades to the
-        # nearest fast path, not the rank pipeline
+        # impl == "pallas" is an alias of unrolled for PAIRWISE merges
+        # (round-5 keep-or-kill: the fused pairwise kernel lost 5x
+        # on-chip and is bench-only — see resolve_merge_impl); the fused
+        # Pallas product arm is the R-way fold_merge below
         from . import orswot_unrolled
 
         return orswot_unrolled.merge_unrolled(
@@ -550,6 +541,67 @@ def _merge_wide(
     ids, out_dots, m_over = compact_by_id(ids, out_dots, m_cap)
     d_ids, d_clocks, d_over = compact(d_ids, d_clocks, d_cap)
     return clock, ids, out_dots, d_ids, d_clocks, jnp.stack([m_over, d_over], axis=-1)
+
+
+def fold_merge(
+    clock, ids, dots, dids, dclocks, m_cap: int, d_cap: int,
+    plunger: bool = True, impl: str | None = None, u_cap: int | None = None,
+):
+    """Left-fold ``R`` stacked replica fleets (arrays ``[R, N, ...]``)
+    into one ``[N, ...]`` state, with the defer-plunger self-merge
+    (`/root/reference/test/orswot.rs:45-62`) — the anti-entropy join.
+
+    This is the level where the fused Pallas arm lives (round-5
+    keep-or-kill decision, `PERF.md`): with ``impl="pallas"`` and
+    eligible shapes (uint32 counters, ``[R, N, ...]`` rank-3 planes) the
+    whole fold runs in one union-aligned kernel
+    (:mod:`~crdt_tpu.ops.orswot_fold_aligned`) that reads each replica
+    state exactly once — ``(R+1)/R`` states of HBM traffic per merge
+    instead of the sequential fold's 3.  Overflow flagged by the kernel
+    is conservative (see its module docstring); callers discard and
+    regrow exactly as with the pairwise flags.  Other ``impl`` choices
+    (or ineligible shapes) run the sequential pairwise fold.
+
+    Returns ``(clock, ids, dots, d_ids, d_clocks, overflow)``."""
+    resolved = resolve_merge_impl(impl)
+    if (
+        resolved == "pallas"
+        and clock.dtype.itemsize <= 4
+        and clock.ndim == 3
+        and ids.shape[-1] <= _ALIGN_MATCH_MAX_M
+    ):
+        from . import orswot_fold_aligned
+
+        return orswot_fold_aligned.fold_merge(
+            clock, ids, dots, dids, dclocks, m_cap, d_cap,
+            u_cap=u_cap, plunger=plunger,
+        )
+    return fold_merge_sequential(
+        clock, ids, dots, dids, dclocks, m_cap, d_cap,
+        plunger=plunger, impl=impl,
+    )
+
+
+def fold_merge_sequential(
+    clock, ids, dots, dids, dclocks, m_cap: int, d_cap: int,
+    plunger: bool = True, impl: str | None = None,
+):
+    """The canonical sequential left fold over stacked ``[R, N, ...]``
+    planes, ORing capacity overflow across every pairwise merge — THE
+    one place the canonical-order + overflow invariant lives: the fused
+    :func:`fold_merge` dispatch, the collective join
+    (`parallel/collective.py`), and the on-device anti-entropy fold all
+    route through here."""
+    state = (clock, ids, dots, dids, dclocks)
+    acc = tuple(x[0] for x in state)
+    over_acc = jnp.zeros(clock.shape[1:-1] + (2,), bool)
+    for i in range(1, clock.shape[0]):
+        out = merge(*acc, *(x[i] for x in state), m_cap, d_cap, impl=impl)
+        acc, over_acc = out[:5], over_acc | out[5]
+    if plunger:
+        out = merge(*acc, *acc, m_cap, d_cap, impl=impl)
+        acc, over_acc = out[:5], over_acc | out[5]
+    return acc + (over_acc,)
 
 
 def fold_merge_tree(

@@ -183,19 +183,15 @@ def _fold_orswot_stack(stack5, m_cap: int, d_cap: int,
                        impl: str | None = None):
     """Canonical left fold over a replica-stacked ORSWOT state 5-tuple
     (leading axis R on every array), ORing capacity overflow across every
-    pairwise merge.  THE one place the canonical-order + overflow invariant
-    lives; both the collective join and on-device anti-entropy fold through
-    here."""
-    r = stack5[0].shape[0]
-    acc = tuple(x[0] for x in stack5)
-    # [..., 2]: member / deferred overflow flags (orswot_ops.merge)
-    overflow = jnp.zeros(stack5[0].shape[1:2] + (2,), dtype=bool)
-    for i in range(1, r):
-        acc, over = _orswot_pair_merge(
-            acc, tuple(x[i] for x in stack5), m_cap, d_cap, impl
-        )
-        overflow |= over
-    return acc, overflow
+    pairwise merge.  Delegates to ``orswot_ops.fold_merge_sequential``
+    (the one home of the canonical-order + overflow invariant) — always
+    the PAIRWISE loop here: this runs inside ``shard_map``, where the
+    fused-fold dispatch of ``orswot_ops.fold_merge`` would put a
+    ``pallas_call`` under a collective trace."""
+    out = orswot_ops.fold_merge_sequential(
+        *stack5, m_cap, d_cap, plunger=False, impl=impl
+    )
+    return out[:5], out[5]
 
 
 def gather_fold_orswot(local, axis: str, m_cap: int, d_cap: int,

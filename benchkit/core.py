@@ -1,0 +1,184 @@
+"""JSON-line artifact state, wall-clock budget, stage isolation.
+
+The bench must produce a parseable JSON line and exit 0 under ANY
+tunnel state (VERDICT r3: the round-3 driver artifact was
+rc=124/parsed=null).  Three mechanisms: a wall-clock budget
+(CRDT_BENCH_BUDGET_S, default 540s) with per-stage estimates; the
+incremental ``emit`` (consumers take the LAST {"metric"...} line, so
+the artifact gets monotonically better); and the budget WATCHDOG
+daemon thread, which re-prints the banked record and exits 0 once the
+budget is overrun — a PJRT call blocked in a wedged tunnel can no
+longer hang the bench to the driver's rc=124 (2026-08-01 window).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+SMALL = os.environ.get("CRDT_BENCH_SMALL") == "1"
+
+# Persistent XLA compilation cache, defaulted into the repo so it
+# survives reboots (/tmp is tmpfs).  The axon backend participates in
+# the standard JAX persistent cache (observed 2026-08-01 window), so
+# every program one window compiles is a free cache hit for every later
+# run — including the driver's end-of-round bench, which does not set
+# the env itself.  Must be set before the first jax compile; setdefault
+# keeps operator overrides.  Relative to the repo root (this package's
+# parent).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    ),
+)
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------- budget
+#
+# The bench must produce a parseable JSON line and exit 0 under ANY tunnel
+# state (VERDICT r3: the round-3 driver artifact was rc=124/parsed=null
+# because a wedged-tunnel probe plus full-scale CPU fallback blew the
+# driver's timeout).  Three mechanisms:
+#   * a wall-clock budget (CRDT_BENCH_BUDGET_S, default 540s): stages are
+#     skipped once the remaining budget is below their estimated cost
+#   * incremental emission: the headline JSON line is (re)printed after
+#     every completed stage — a kill mid-run still leaves the last banked
+#     line on stdout (consumers take the LAST line starting {"metric")
+#   * CPU-fallback downshift: north-star/resident chunk counts shrink
+#     (rates stay comparable; totals are recorded in the JSON)
+# Orchestrators with a real window raise the budget (the tunnel watcher
+# runs with CRDT_BENCH_BUDGET_S=4200).
+
+_T0 = time.monotonic()
+_BUDGET_S = float(os.environ.get("CRDT_BENCH_BUDGET_S", "540"))
+
+
+def remaining_budget() -> float:
+    return _BUDGET_S - (time.monotonic() - _T0)
+
+
+_JSON_STATE: dict = {
+    "metric": "orswot_merges_per_sec_to_fixpoint",
+    "value": None,
+    "unit": "merges/s",
+    "vs_baseline": None,
+}
+
+
+def emit(**fields):
+    """Merge ``fields`` into the headline record and print it (again).
+
+    Consumers parse the LAST {"metric"...} line, so re-printing after
+    every stage makes the artifact monotonically better instead of
+    all-or-nothing."""
+    _JSON_STATE.update(fields)
+    if _JSON_STATE.get("value") is not None:
+        _JSON_STATE["vs_baseline"] = round(_JSON_STATE["value"] / 1e7, 4)
+        print(json.dumps(_JSON_STATE), flush=True)
+
+
+def install_budget_watchdog(grace_s: float = 60.0):
+    """Guarantee a parseable artifact and rc=0 even when a PJRT call
+    blocks forever (2026-08-01 window: the tunnel wedged MID-RUN and the
+    north-star template transfer never returned — the per-stage budget
+    skips only help BETWEEN stages).  A daemon thread watches the wall
+    budget; once overrun by ``grace_s`` it re-prints the last banked
+    record (or an explicit-failure one) and exits 0 — strictly better
+    for the driver than its own timeout killing us at rc=124."""
+    import threading
+
+    def guard():
+        while True:
+            try:
+                over = -remaining_budget()
+                if over > grace_s:
+                    log(
+                        f"BUDGET WATCHDOG: {_BUDGET_S:.0f}s budget overrun by "
+                        f"{over:.0f}s — a stage is blocked (tunnel wedged "
+                        "mid-run?); emitting the banked record and exiting 0"
+                    )
+                    # snapshot: the main thread may be mid-emit(); dumping
+                    # the live dict could raise mid-iteration and kill the
+                    # very thread that guards against hangs
+                    rec = dict(_JSON_STATE)
+                    if rec.get("value") is None:
+                        rec["value"] = 0.0
+                        rec["vs_baseline"] = 0.0
+                        rec.setdefault("headline_source", "none")
+                    rec["budget_watchdog"] = "fired"
+                    print("\n" + json.dumps(rec), flush=True)
+                    os._exit(0)
+                    return  # unreachable in production; a test-stubbed
+                    # os._exit returns, and the guard must fire ONCE —
+                    # a re-fire after monkeypatch teardown would call
+                    # the real exit and kill the test runner
+            except Exception:  # noqa: BLE001 — the guard must survive races
+                pass
+            time.sleep(5)
+
+    threading.Thread(target=guard, daemon=True, name="budget-watchdog").start()
+
+
+def run_stage(name: str, est_s: float, fn, *args, **kwargs):
+    """Run one bench stage, absorbing failures and budget exhaustion.
+
+    Returns the stage result or None (skipped/errored) — a crash or a
+    slow tunnel in one stage must never cost the lines already banked."""
+    rem = remaining_budget()
+    if rem < est_s:
+        log(f"stage {name}: SKIPPED (remaining budget {rem:.0f}s < est {est_s:.0f}s)")
+        emit(**{f"{name}_skipped": "budget"})
+        return None
+    try:
+        return fn(*args, **kwargs)
+    except Exception as e:  # noqa: BLE001 — stage isolation is the point
+        import traceback
+
+        log(f"stage {name}: FAILED ({type(e).__name__}: {str(e)[:300]})")
+        log(traceback.format_exc(limit=8))
+        emit(**{f"{name}_error": f"{type(e).__name__}: {str(e)[:120]}"})
+        return None
+
+
+def _downshift() -> bool:
+    """True when full-scale shapes would risk the budget: CPU backends
+    (fallback or explicit) downshift chunk counts unless the caller
+    insists (CRDT_BENCH_FULL=1).  Rates stay comparable — only the number
+    of timed repetitions shrinks."""
+    if os.environ.get("CRDT_BENCH_FULL") == "1":
+        return False
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def _sync_overhead():
+    """Same-window tunnel sync constant (crdt_tpu.utils.benchtime)."""
+    from crdt_tpu.utils.benchtime import sync_overhead
+
+    return sync_overhead()
+
+
+def timeit_chained(step, init, iters=None, sync_overhead_s=None, consts=()):
+    """Per-iteration wall time of ``step`` chained on-device.
+
+    Thin wrapper over ``crdt_tpu.utils.benchtime.chain_timer`` (see its
+    docstring for the tunnel-driven design: one jitted lax.scan, sync
+    constant subtracted, consts-as-jit-parameters).  Median of 3 runs.
+    """
+    from crdt_tpu.utils.benchtime import chain_timer
+
+    if iters is None:
+        iters = 10 if SMALL else 100
+    return chain_timer(step, init, iters, consts=consts,
+                       sync_overhead_s=sync_overhead_s, reps=3)
+
+

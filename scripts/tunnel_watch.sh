@@ -82,7 +82,7 @@ for i in $(seq 1 600); do
     # rev, so committing docs/reports (or committing the very code that
     # ran, unchanged) never discards a capture; only changing what a
     # capture measures does.
-    CODE="crdt_tpu scripts bench.py __graft_entry__.py"
+    CODE="crdt_tpu scripts bench.py benchkit __graft_entry__.py"
     REV=$( { git ls-files -z -- $CODE 2>/dev/null; \
              git ls-files -o --exclude-standard -z -- $CODE 2>/dev/null; } \
            | LC_ALL=C sort -z | xargs -0 cat 2>/dev/null | sha1sum | cut -c1-12 )

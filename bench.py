@@ -1087,19 +1087,24 @@ def bench_bulk_ingest():
                 a1 = (a1 + 1) % 16
             c0, c1 = int(counters[i, 0]), int(counters[i, 1])
             m0, m1 = int(members[i, 0]), int(members[i, 1])
+            if m0 == m1:
+                m1 = (m1 + 1) % (1 << 22)  # dict semantics would dedupe
             p0 = b"\x03" + _uv(2 * a0) + b"\x03" + _uv(2 * c0)
             p1 = b"\x03" + _uv(2 * a1) + b"\x03" + _uv(2 * c1)
             if a1 < a0:
                 p0, p1 = p1, p0
-            ap(
-                b"\x26" + _uv(2) + p0 + p1
-                + _uv(2)
-                + b"\x03" + _uv(2 * m0) + b"\x20" + _uv(1)
-                + b"\x03" + _uv(2 * a0) + b"\x03" + _uv(2 * c0)
-                + b"\x03" + _uv(2 * m1) + b"\x20" + _uv(1)
-                + b"\x03" + _uv(2 * a1) + b"\x03" + _uv(2 * c1)
-                + _uv(0)
-            )
+            # members in to_binary's canonical order: sorted by ENCODED
+            # key bytes (serde sorts enc_bytes_of(member), which is NOT
+            # numeric order for LEB128) — the parser's strictly-ascending
+            # check (round 4) rejects anything else to the Python path,
+            # which silently cost this stage ~50% native coverage
+            k0 = b"\x03" + _uv(2 * m0)
+            k1 = b"\x03" + _uv(2 * m1)
+            ent0 = k0 + b"\x20" + _uv(1) + b"\x03" + _uv(2 * a0) + b"\x03" + _uv(2 * c0)
+            ent1 = k1 + b"\x20" + _uv(1) + b"\x03" + _uv(2 * a1) + b"\x03" + _uv(2 * c1)
+            if k1 < k0:
+                ent0, ent1 = ent1, ent0
+            ap(b"\x26" + _uv(2) + p0 + p1 + _uv(2) + ent0 + ent1 + _uv(0))
         return blobs
 
     def bench_wire_path(rng):

@@ -276,6 +276,7 @@ class OrswotBatch:
         h_counts = []  # clock dots per deferred row, aligned with qm
 
         for i, s in enumerate(states):
+          try:
             cd = s.clock.dots
             c_counts[i] = len(cd)
             ca.extend(map(aidx, cd))
@@ -310,6 +311,16 @@ class OrswotBatch:
                     h_counts.append(len(pa))
                     ha.extend(pa)
                     hc.extend(pc)
+          except AttributeError as e:
+            # a decodable-but-wrong-typed object graph (e.g. a corrupted
+            # from_binary payload whose tag flip decoded a GCounter where
+            # a VClock belongs, or a ctx type where an Orswot belongs)
+            # surfaces as the documented contract exception, not a raw
+            # AttributeError (found by the wire mutation fuzz)
+            raise TypeError(
+                f"object {i}: malformed scalar state "
+                f"({type(s).__name__}: {e})"
+            ) from None
 
         def _obj_slot(counts):
             """(object, within-object slot) coordinate columns for rows
@@ -432,9 +443,18 @@ class OrswotBatch:
             # where the scalar path would, e.g. non-int members against
             # an identity registry)
             fb = np.nonzero(status == 1)[0].tolist()
-            sub = cls.from_scalar(
-                [from_binary(blobs[i]) for i in fb], universe
-            )
+            try:
+                sub = cls.from_scalar(
+                    [from_binary(blobs[i]) for i in fb], universe
+                )
+            except (ValueError, TypeError) as e:
+                # from_scalar reports indices relative to the fallback
+                # sublist; translate so the operator can find the blob
+                raise type(e)(
+                    f"{e} [object indices above are relative to the "
+                    f"python-fallback sublist; its blob indices are "
+                    f"{fb[:16]}{'...' if len(fb) > 16 else ''}]"
+                ) from None
             idx = np.asarray(fb, dtype=np.int64)
             clock[idx] = np.asarray(sub.clock)
             ids[idx] = np.asarray(sub.ids)
@@ -733,6 +753,28 @@ class OrswotBatch:
             via_device = _on_accelerator(self.clock)
         n_total = self.clock.shape[0]
 
+        if not via_device and n_total > _EGRESS_SLICE * 3 // 2:
+            # numpy views, not jnp slicing: one zero-copy np.asarray per
+            # plane, then each slice is a view — no XLA slice dispatch or
+            # per-slice plane copies
+            planes = tuple(
+                np.asarray(x)
+                for x in (self.clock, self.ids, self.dots,
+                          self.d_ids, self.d_clocks)
+            )
+            out: list = []
+            s0 = 0
+            while s0 < n_total:
+                # a short final remainder (< slice/2) merges into this
+                # slice instead of becoming a tiny ragged call
+                end = s0 + _EGRESS_SLICE
+                if n_total - end < _EGRESS_SLICE // 2:
+                    end = n_total
+                sub = OrswotBatch(*(p[s0:end] for p in planes))
+                out.extend(sub.to_scalar(universe, via_device=False))
+                s0 = end
+            return out
+
         # native fast path: hand the cell bundles to the C extension,
         # which constructs the Orswot/VClock objects through the C API
         # (no interpreter frames per object).  Names are resolved
@@ -766,28 +808,6 @@ class OrswotBatch:
                     i64(qo), i64(qr), q_names, i64(q_inv),
                     i64(ho), i64(hr), i64(ha), u64(hv),
                 )
-
-        if not via_device and n_total > _EGRESS_SLICE * 3 // 2:
-            # numpy views, not jnp slicing: one zero-copy np.asarray per
-            # plane, then each slice is a view — no XLA slice dispatch or
-            # per-slice plane copies
-            planes = tuple(
-                np.asarray(x)
-                for x in (self.clock, self.ids, self.dots,
-                          self.d_ids, self.d_clocks)
-            )
-            out: list = []
-            s0 = 0
-            while s0 < n_total:
-                # a short final remainder (< slice/2) merges into this
-                # slice instead of becoming a tiny ragged call
-                end = s0 + _EGRESS_SLICE
-                if n_total - end < _EGRESS_SLICE // 2:
-                    end = n_total
-                sub = OrswotBatch(*(p[s0:end] for p in planes))
-                out.extend(sub.to_scalar(universe, via_device=False))
-                s0 = end
-            return out
 
         cells = self._cells(via_device)
         (co, ca, cv), (eo, es, em), (do, ds, _dm, da, dv), (qo, qr, qm), (

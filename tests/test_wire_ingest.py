@@ -309,12 +309,12 @@ def test_wire_parser_total_on_mutated_blobs(seed, pos, byte, mode):
         want = None  # the python pipeline rejects it; from_wire must too
     try:
         got = OrswotBatch.from_wire([blob], uni, via_device=False)
-    except (ValueError, OverflowError):
+    except (ValueError, OverflowError, TypeError):
         # BOTH directions must agree: from_wire's non-fast-path blobs go
         # through the python pipeline itself, and its hard errors
-        # (capacity/actor range) are the same checks from_scalar makes —
-        # so a clean rejection here implies the python pipeline rejected
-        # the blob too
+        # (capacity/actor range, malformed decoded types) are the same
+        # checks from_scalar makes — so a clean rejection here implies
+        # the python pipeline rejected the blob too
         assert want is None, (
             "from_wire rejected a blob the python pipeline accepts"
         )

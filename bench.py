@@ -816,30 +816,26 @@ def bench_e2e_wire():
     # (the loop under test starts AT the blobs)
     rep_blobs = [OrswotBatch(*rep).to_wire(uni) for rep in reps]
 
-    # --- parity gate: byte-identical blobs vs the scalar engine -------
-    sample = list(range(4))
-    for i in sample:
-        acc = from_binary(rep_blobs[0][i])
-        for rr in range(1, r):
-            acc.merge(from_binary(rep_blobs[rr][i]))
-        acc.merge(acc.clone())  # defer plunger (self-merge, as the fold)
-        fleets = [OrswotBatch.from_wire([rep_blobs[rr][i]], uni) for rr in range(r)]
-        st = tuple(
-            jnp.stack([getattr(f, nm) for f in fleets])
-            for nm in ("clock", "ids", "dots", "d_ids", "d_clocks")
-        )
-        out = tuple(x[0] for x in st)
-        for rr in range(1, r):
-            out = orswot_ops.merge(*out, *(x[rr] for x in st), m, d)[:5]
-        out = orswot_ops.merge(*out, *out, m, d)[:5]
-        got_blob = OrswotBatch(*out).to_wire(uni)[0]
-        assert got_blob == to_binary(acc), (
-            f"e2e wire loop parity: object {i} blob != scalar fold blob"
-        )
-    log("e2e wire parity sample: device loop blobs == scalar fold blobs")
+    names = ("clock", "ids", "dots", "d_ids", "d_clocks")
 
-    def ingest_chunk():
-        return [OrswotBatch.from_wire(blobs, uni) for blobs in rep_blobs]
+    # best engine per backend, as the north star: on CPU the C++ row
+    # kernel folds (bit-exact with orswot_ops.merge incl. slot order),
+    # on accelerators the jitted jnp fold; the byte parity gate below
+    # runs through WHICHEVER fold the timing uses
+    native_engine = None
+    if (
+        jax.default_backend() == "cpu"
+        and os.environ.get("CRDT_SKIP_NATIVE_HEADLINE") != "1"
+    ):
+        try:
+            from crdt_tpu.native import engine as native_engine_mod
+
+            native_engine_mod.vclock_merge(
+                np.zeros((1, 2), np.uint32), np.zeros((1, 2), np.uint32)
+            )
+            native_engine = native_engine_mod
+        except (ImportError, OSError, RuntimeError) as e:
+            log(f"e2e wire: native fold unavailable ({str(e)[:120]})")
 
     @jax.jit
     def fold_stacked(stacked):
@@ -848,14 +844,58 @@ def bench_e2e_wire():
             acc = orswot_ops.merge(*acc, *(x[rr] for x in stacked), m, d)[:5]
         return orswot_ops.merge(*acc, *acc, m, d)[:5]
 
+    # two reusable output-buffer sets per shape for the native fold:
+    # the C kernel fully overwrites outputs, so ping-ponging avoids an
+    # mmap page-zeroing pass per merge (engine.py's documented fold-loop
+    # pattern; same as _native_fold_timing).  Safe here because each
+    # chunk's result is encoded to blobs before the next fold starts.
+    _fold_bufs: dict = {}
+
     def fold_chunk(fleets):
+        if native_engine is not None:
+            st = [
+                tuple(np.asarray(getattr(f, nm)) for nm in names)
+                for f in fleets
+            ]
+            acc = st[0]
+            if acc[0].shape not in _fold_bufs:
+                _fold_bufs[acc[0].shape] = [
+                    tuple(np.empty_like(p) for p in acc) for _ in range(2)
+                ]
+            bufs = _fold_bufs[acc[0].shape]
+            k = 0
+            for rr in range(1, r):
+                acc = native_engine.orswot_merge(*acc, *st[rr], out=bufs[k])[:5]
+                k ^= 1
+            acc = native_engine.orswot_merge(*acc, *acc, out=bufs[k])[:5]
+            return OrswotBatch(*acc)
         stacked = tuple(
-            jnp.stack([getattr(f, nm) for f in fleets])
-            for nm in ("clock", "ids", "dots", "d_ids", "d_clocks")
+            jnp.stack([getattr(f, nm) for f in fleets]) for nm in names
         )
         joined = OrswotBatch(*fold_stacked(stacked))
         jax.block_until_ready(joined.clock)
         return joined
+
+    # --- parity gate: byte-identical blobs vs the scalar engine -------
+    # through the SAME fold path the timing uses
+    sample = list(range(4))
+    for i in sample:
+        acc = from_binary(rep_blobs[0][i])
+        for rr in range(1, r):
+            acc.merge(from_binary(rep_blobs[rr][i]))
+        acc.merge(acc.clone())  # defer plunger (self-merge, as the fold)
+        fleets = [OrswotBatch.from_wire([rep_blobs[rr][i]], uni) for rr in range(r)]
+        got_blob = fold_chunk(fleets).to_wire(uni)[0]
+        assert got_blob == to_binary(acc), (
+            f"e2e wire loop parity: object {i} blob != scalar fold blob"
+        )
+    log(
+        "e2e wire parity sample: loop blobs == scalar fold blobs "
+        f"(fold={'native' if native_engine is not None else 'jnp'})"
+    )
+
+    def ingest_chunk():
+        return [OrswotBatch.from_wire(blobs, uni) for blobs in rep_blobs]
 
     # warmup: one full untimed iteration so the chunk-shaped merge
     # kernels compile OUTSIDE the timed region (the sibling benches all
@@ -893,6 +933,7 @@ def bench_e2e_wire():
         "e2e_wire_ingest_s": round(stage_s["ingest"], 2),
         "e2e_wire_fold_s": round(stage_s["fold"], 2),
         "e2e_wire_egress_s": round(stage_s["egress"], 2),
+        "e2e_wire_fold_path": "native" if native_engine is not None else "jnp",
     }
     if n_chunks < full_chunks:
         out["e2e_wire_downshift"] = f"{n_chunks}/{full_chunks}"

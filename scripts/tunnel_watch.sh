@@ -45,6 +45,47 @@ step() {  # step <name> <timeout> <log> <cmd...>
     return $rc
 }
 
+tunnel_alive() {
+    timeout -k 15 150 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+wedge_probe() {  # wedge_probe <context> — fresh-process aliveness probe
+    # after a suspicious step outcome.  A wedged window hangs EVERY
+    # device call — including this probe (round-2 diagnostics,
+    # reports/TPU_TUNNEL_STATUS.md) — so probe-hang means the
+    # iteration's remaining steps are doomed and the watcher should
+    # fall back to the outer probe loop instead of burning hours of
+    # step timeouts (2026-08-02: a wedge right after the bench would
+    # have cost ~3.5h of doomed secondaries before the re-probe).  A
+    # live window answers in seconds, so the probe is cheap when it
+    # matters least.
+    # two attempts: a transiently slow live window must not be
+    # misclassified as wedged off one 150s miss (the second attempt
+    # only runs when the first failed, so the live path stays cheap)
+    for _try in 1 2; do
+        if tunnel_alive; then
+            echo "$(date -u +%H:%M:%S) $1 - tunnel still answers, continuing" \
+                | tee -a /tmp/tunnel_watch.log
+            return 1
+        fi
+    done
+    echo "$(date -u +%H:%M:%S) $1 - tunnel probe hangs: wedged, back to outer probe" \
+        | tee -a /tmp/tunnel_watch.log
+    return 0
+}
+
+wedged() {  # wedged <rc> <name> — true when a failed step left the
+    # window wedged.  ANY nonzero exit is suspicious, not just the
+    # timeout kills (124 TERM / 137 KILL fallback): the documented
+    # wedge-inducer is a fast-crashing Mosaic compile (rc 1/139,
+    # reports/PALLAS_TPU_ATTEMPT.txt) that exits long before its
+    # timeout yet leaves the device hung for the rest of the window.
+    # The probe discriminates — slow-but-live steps (or OOM kills on a
+    # healthy window) keep capturing.
+    [ "$1" -ne 0 ] || return 1
+    wedge_probe "step $2 died (rc $1)"
+}
+
 publish_bench() {  # publish_bench <log>
     # Persist the captured on-chip bench line as a repo artifact so a
     # mid-round window survives even if the driver's end-of-round probe
@@ -88,7 +129,7 @@ for i in $(seq 1 600); do
            | LC_ALL=C sort -z | xargs -0 cat 2>/dev/null | sha1sum | cut -c1-12 )
     MARK=/tmp/tw_done.$REV
     mkdir -p "$MARK"
-    if timeout -k 15 150 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if tunnel_alive; then
         echo "$(date -u +%H:%M:%S) tunnel ALIVE - capturing (rev $REV)" | tee -a /tmp/tunnel_watch.log
         # ROUND-4 NOTE: the local-AOT bridge is DEAD — the axon
         # runtime only loads executables in its own serialization format
@@ -113,39 +154,56 @@ for i in $(seq 1 600); do
         # share one rev and one window); the 4200 s budget covers the
         # ~113 s elision check + ~240 s validation alongside the timed
         # stages, and the budget watchdog still guarantees rc=0
-        if [ ! -e "$MARK/bench" ] && step bench 4500 /tmp/bench_tpu3.log \
-            env CRDT_RUN_ELISION_CHECK=1 CRDT_BENCH_BUDGET_S=4200 \
-            CRDT_BENCH_PROBE_TIMEOUT=900 \
-            python bench.py; then
-            # publish whatever live on-chip headline landed (the gate
-            # inside publish_bench refuses banked/seed records); a
-            # watchdog-rescued run exits 0 by design for the DRIVER,
-            # but for the WATCHER the capture is incomplete — drop the
-            # marker so the remaining stages re-run on the next window
-            publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
-            if tail -5 /tmp/bench_tpu3.log | grep -q '"budget_watchdog": "fired"'; then
-                echo "$(date -u +%H:%M:%S) bench watchdog fired - capture incomplete, re-arming" \
-                    | tee -a /tmp/tunnel_watch.log
-                rm -f "$MARK/bench"
+        if [ ! -e "$MARK/bench" ]; then
+            step bench 4500 /tmp/bench_tpu3.log \
+                env CRDT_RUN_ELISION_CHECK=1 CRDT_BENCH_BUDGET_S=4200 \
+                CRDT_BENCH_PROBE_TIMEOUT=900 \
+                python bench.py
+            brc=$?
+            if [ $brc -eq 0 ]; then
+                # publish whatever live on-chip headline landed (the gate
+                # inside publish_bench refuses banked/seed records); a
+                # watchdog-rescued run exits 0 by design for the DRIVER,
+                # but for the WATCHER the capture is incomplete — drop the
+                # marker so the remaining stages re-run on the next window
+                publish_bench /tmp/bench_tpu3.log 2>&1 | tee -a /tmp/tunnel_watch.log
+                if tail -5 /tmp/bench_tpu3.log | grep -q '"budget_watchdog": "fired"'; then
+                    echo "$(date -u +%H:%M:%S) bench watchdog fired - capture incomplete, re-arming" \
+                        | tee -a /tmp/tunnel_watch.log
+                    rm -f "$MARK/bench"
+                    # the watchdog fires when a stage blocks past the
+                    # budget — usually a wedge, but a live-slow window
+                    # can trip it too; let the probe decide whether the
+                    # secondaries still have a window to capture in
+                    if wedge_probe "bench watchdog fired"; then
+                        sleep 60; continue
+                    fi
+                fi
+            else
+                wedged $brc bench && { sleep 60; continue; }
             fi
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \
             python scripts/tpu_validate.py --merge
+        wedged $? validate_merge && { sleep 60; continue; }
         # 2) can the axon client serialize its own executables?  If yes,
         #    one helper compile of the fused scan can be banked for
         #    compile-free reuse across windows (the local-AOT direction
         #    is format-incompatible — see header)
         step axon_serialize 600 /tmp/axon_serialize_tpu.log \
             python scripts/axon_serialize_probe.py
+        wedged $? axon_serialize && { sleep 60; continue; }
         # 3) secondary evidence, after everything headline-bearing
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
+        wedged $? profile && { sleep 60; continue; }
         # the 7-mode layout A/B concluded in the 2026-07-31 window
         # (reports/LAYOUT_AB_TPU.md); only the still-undecided fold-shape
         # contenders remain
         step experiments 5000 /tmp/experiments_tpu.log \
             env CRDT_EXP_MODES=fold_seq,fold_tree,fold_seq_rank \
             python scripts/tpu_experiments.py
+        wedged $? experiments && { sleep 60; continue; }
         if [ -e "$MARK/experiments" ]; then
             BLOG=/dev/null
             [ -e "$MARK/bench" ] && BLOG=/tmp/bench_tpu3.log
@@ -158,9 +216,11 @@ for i in $(seq 1 600); do
         step pallas 1800 /tmp/pallas_tpu.log \
             env TPU_ACCELERATOR_TYPE=v5litepod-1 TPU_WORKER_HOSTNAMES=localhost \
             python scripts/tpu_validate.py --pallas
+        wedged $? pallas && { sleep 60; continue; }
         step experiments_pallas 1800 /tmp/experiments_pallas_tpu.log \
             env CRDT_EXP_MODES=merge_pallas \
             python scripts/tpu_experiments.py
+        wedged $? experiments_pallas && { sleep 60; continue; }
         # done only when every step has its marker
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
            [ -e "$MARK/bench" ] && [ -e "$MARK/axon_serialize" ] && \

@@ -360,16 +360,23 @@ def bench_north_star():
         )
         elision["jnp_scan"] = "skipped_budget"
     run_stepped_path = os.environ.get("CRDT_RUN_ELISION_CHECK") == "1" or (
-        # the stepped path is also the scan-outage fallback: its
+        # the elision check is VALIDATION: whenever the scan actually
+        # ran, replay it per-step and demand bit-equality — never
+        # budget-skipped (round 5 shipped elision_check: "skipped" on a
+        # run whose scan HAD executed; a headline that might be
+        # invariant-hoisted is not a headline).  The replay doubles as
+        # the second timing path (async per-step dispatches measured
+        # 20-30% faster than lax.scan on CPU), so its cost buys timing
+        # evidence too.
+        scan_out is not None
+    ) or (
+        # ...and the stepped path is also the scan-outage fallback: its
         # per-step dispatches chain asynchronously through a
         # device-value salt, so the tunnel's ~65 ms round-trip is
         # paid once at the final fetch instead of per chunk (the
-        # last-resort host loop below pays it ~every chunk).  As a pure
-        # work-elision CHECK it is opt-in (VERDICT r3: a 113s correctness
-        # assert living in the timed bench cost the round artifact) —
-        # tests/test_bench_paths.py carries the check at test scale.
-        scan_out is not None and native_s is None and jax.default_backend() != "cpu"
-    ) or (t is None and native_s is None and remaining_budget() > 60)
+        # last-resort host loop below pays it ~every chunk)
+        t is None and native_s is None and remaining_budget() > 60
+    )
     if run_stepped_path:
         # Work-elision check (VERDICT r2 weak #4): replay the exact
         # salt chain as per-step host dispatches — a separately
@@ -768,26 +775,43 @@ def bench_pallas_north_star(templates=None):
 
 def bench_e2e_wire():
     """One timed end-to-end replication loop at north-star scale
-    (VERDICT r4 item 3): wire blobs in → ``from_wire(via_device)`` →
-    anti-entropy fold to fixpoint → ``to_wire`` blobs out.  This is the
-    TPU-native form of the reference's full replication story — the
-    reference delegates transport to the user and replication is
-    "serialize, ship, merge" (`/root/reference/src/lib.rs:62-83`).
+    (VERDICT r4 item 3): wire blobs in → parse → anti-entropy fold to
+    fixpoint → ``to_wire`` blobs out.  This is the TPU-native form of
+    the reference's full replication story — the reference delegates
+    transport to the user and replication is "serialize, ship, merge"
+    (`/root/reference/src/lib.rs:62-83`).
+
+    Two loops are timed on the same downshifted workload and both land
+    in the JSON:
+
+    * **serial** — the round-5 shape (``from_wire`` per fleet → fold →
+      ``to_wire``), which allocates a fresh dense plane set per fleet.
+      This is the loop whose ingest collapsed 160× in ``BENCH_r05.json``
+      (root cause: allocation/page-fault churn, NOT a Python fallback —
+      see PERF.md "wire-loop pipeline").
+    * **pipelined** — :class:`crdt_tpu.batch.wireloop.PipelinedWireLoop`:
+      reused staging buffers, background parse overlapped with the fold,
+      ping-pong fold accumulators.  The headline ``e2e_wire_*`` fields
+      come from this loop; ``pipeline: "overlapped"`` marks it.
+
+    Per-stage ``native_fraction`` (and any fallback reasons) are
+    reported from the tracing counters, so a silent-fallback regression
+    is visible from the artifact alone.
 
     Shape mirrors the north star: R replica fleets of the same objects,
     processed in chunk-sized slices (the (R+1)-state working set must
-    fit HBM); ONE chunk template's blob lists are cycled across chunks
-    (kernels and the C parser are content-driven but shape-identical
-    per chunk, and host-side blob synthesis stays a bounded setup
-    cost).  Parity gate: on a sample of objects, the emitted blob must
-    be BYTE-identical to ``to_binary`` of the scalar engine's left fold
-    + self-merge plunger over ``from_binary`` of the input blobs."""
+    fit HBM); ONE chunk template's blob lists are cycled across chunks.
+    Parity gates: on a sample of objects the pipelined loop's emitted
+    blob must be BYTE-identical to ``to_binary`` of the scalar engine's
+    left fold + self-merge plunger over ``from_binary`` of the input
+    blobs; and the serial and pipelined loops must emit byte-identical
+    chunks."""
     import jax
-    import jax.numpy as jnp
 
     from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.batch.wireloop import PipelinedWireLoop
     from crdt_tpu.config import CrdtConfig
-    from crdt_tpu.ops import orswot_ops
+    from crdt_tpu.utils import tracing
     from crdt_tpu.utils.interning import Universe
     from crdt_tpu.utils.serde import from_binary, to_binary
     from crdt_tpu.utils.testdata import anti_entropy_fleets
@@ -803,6 +827,10 @@ def bench_e2e_wire():
     n_chunks = full_chunks
     if _downshift():
         n_chunks = min(n_chunks, 2)
+    # the serial comparator re-pays its allocation churn every chunk, so
+    # 2 chunks measure it faithfully; the pipelined loop runs the full
+    # (downshifted) chunk count for the headline
+    serial_chunks = min(n_chunks, 2)
     cfg = CrdtConfig(
         num_actors=a, member_capacity=m, deferred_capacity=d,
         counter_bits=32,
@@ -816,127 +844,166 @@ def bench_e2e_wire():
     # (the loop under test starts AT the blobs)
     rep_blobs = [OrswotBatch(*rep).to_wire(uni) for rep in reps]
 
-    names = ("clock", "ids", "dots", "d_ids", "d_clocks")
-
     # best engine per backend, as the north star: on CPU the C++ row
-    # kernel folds (bit-exact with orswot_ops.merge incl. slot order),
-    # on accelerators the jitted jnp fold; the byte parity gate below
-    # runs through WHICHEVER fold the timing uses
-    native_engine = None
+    # kernels parse AND fold (bit-exact with orswot_ops.merge incl. slot
+    # order), on accelerators the jitted jnp fold with async dispatch
+    fold_path = None
     if (
-        jax.default_backend() == "cpu"
-        and os.environ.get("CRDT_SKIP_NATIVE_HEADLINE") != "1"
+        jax.default_backend() != "cpu"
+        or os.environ.get("CRDT_SKIP_NATIVE_HEADLINE") == "1"
     ):
-        try:
-            from crdt_tpu.native import engine as native_engine_mod
-
-            native_engine_mod.vclock_merge(
-                np.zeros((1, 2), np.uint32), np.zeros((1, 2), np.uint32)
-            )
-            native_engine = native_engine_mod
-        except (ImportError, OSError, RuntimeError) as e:
-            log(f"e2e wire: native fold unavailable ({str(e)[:120]})")
-
-    @jax.jit
-    def fold_stacked(stacked):
-        acc = tuple(x[0] for x in stacked)
-        for rr in range(1, r):
-            acc = orswot_ops.merge(*acc, *(x[rr] for x in stacked), m, d)[:5]
-        return orswot_ops.merge(*acc, *acc, m, d)[:5]
-
-    # two reusable output-buffer sets per shape for the native fold:
-    # the C kernel fully overwrites outputs, so ping-ponging avoids an
-    # mmap page-zeroing pass per merge (engine.py's documented fold-loop
-    # pattern; same as _native_fold_timing).  Safe here because each
-    # chunk's result is encoded to blobs before the next fold starts.
-    _fold_bufs: dict = {}
-
-    def fold_chunk(fleets):
-        if native_engine is not None:
-            st = [
-                tuple(np.asarray(getattr(f, nm)) for nm in names)
-                for f in fleets
-            ]
-            acc = st[0]
-            if acc[0].shape not in _fold_bufs:
-                _fold_bufs[acc[0].shape] = [
-                    tuple(np.empty_like(p) for p in acc) for _ in range(2)
-                ]
-            bufs = _fold_bufs[acc[0].shape]
-            k = 0
-            for rr in range(1, r):
-                acc = native_engine.orswot_merge(*acc, *st[rr], out=bufs[k])[:5]
-                k ^= 1
-            acc = native_engine.orswot_merge(*acc, *acc, out=bufs[k])[:5]
-            return OrswotBatch(*acc)
-        stacked = tuple(
-            jnp.stack([getattr(f, nm) for f in fleets]) for nm in names
-        )
-        joined = OrswotBatch(*fold_stacked(stacked))
-        jax.block_until_ready(joined.clock)
-        return joined
+        fold_path = "jnp"
+    loop = PipelinedWireLoop(uni, fold_path=fold_path)
 
     # --- parity gate: byte-identical blobs vs the scalar engine -------
-    # through the SAME fold path the timing uses
+    # through the SAME staged fold path the timing uses
     sample = list(range(4))
-    for i in sample:
+    sample_blobs = [[rep_blobs[rr][i] for i in sample] for rr in range(r)]
+    got = loop.run([sample_blobs], overlap=False)["out_blobs"]
+    for pos, i in enumerate(sample):
         acc = from_binary(rep_blobs[0][i])
         for rr in range(1, r):
             acc.merge(from_binary(rep_blobs[rr][i]))
         acc.merge(acc.clone())  # defer plunger (self-merge, as the fold)
-        fleets = [OrswotBatch.from_wire([rep_blobs[rr][i]], uni) for rr in range(r)]
-        got_blob = fold_chunk(fleets).to_wire(uni)[0]
-        assert got_blob == to_binary(acc), (
+        assert got[pos] == to_binary(acc), (
             f"e2e wire loop parity: object {i} blob != scalar fold blob"
         )
     log(
         "e2e wire parity sample: loop blobs == scalar fold blobs "
-        f"(fold={'native' if native_engine is not None else 'jnp'})"
+        f"(fold={loop.fold_path})"
     )
 
-    def ingest_chunk():
-        return [OrswotBatch.from_wire(blobs, uni) for blobs in rep_blobs]
+    # --- serial comparator (the round-5 loop, timed for the A/B) ------
+    def serial_loop(chunks):
+        stage = {"ingest": 0.0, "fold": 0.0, "egress": 0.0}
+        blobs_out = None
+        t_all0 = time.perf_counter()
+        for _ in range(chunks):
+            t0 = time.perf_counter()
+            fleets = [OrswotBatch.from_wire(blobs, uni) for blobs in rep_blobs]
+            stage["ingest"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            names = ("clock", "ids", "dots", "d_ids", "d_clocks")
+            if loop.fold_path == "native":
+                staged = [
+                    tuple(np.asarray(getattr(f, nm)) for nm in names)
+                    for f in fleets
+                ]
+                acc = staged[0]
+                for rr in range(1, r):
+                    acc = loop._merge_native(
+                        acc, staged[rr], loop._pingpong[(rr - 1) & 1]
+                    )
+                acc = loop._merge_native(acc, acc, loop._pingpong[(r - 1) & 1])
+            else:
+                # keep the planes device-resident, as the round-5 serial
+                # loop did — a np.asarray round-trip here would charge
+                # the comparator D2H transfers the old loop never paid
+                staged = [
+                    tuple(getattr(f, nm) for nm in names) for f in fleets
+                ]
+                acc = staged[0]
+                for rr in range(1, r):
+                    acc = loop._merge_jnp(acc, staged[rr])
+                acc = loop._merge_jnp(acc, acc)
+                if loop._overflow is not None:
+                    # the comparator's own overflow must raise HERE, not
+                    # leak into the pipelined run's first round
+                    from crdt_tpu.error import raise_for_overflow
 
-    # warmup: one full untimed iteration so the chunk-shaped merge
-    # kernels compile OUTSIDE the timed region (the sibling benches all
-    # warm before timing; a compile inside would make the e2e rate
-    # meaningless on the downshifted path)
-    fold_chunk(ingest_chunk()).to_wire(uni)
+                    ov, loop._overflow = loop._overflow, None
+                    raise_for_overflow(ov, "e2e serial fold")
+            stage["fold"] += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            blobs_out = loop._egress(acc)
+            stage["egress"] += time.perf_counter() - t0
+        return time.perf_counter() - t_all0, stage, blobs_out
 
-    # --- the timed loop ----------------------------------------------
-    stage_s = {"ingest": 0.0, "fold": 0.0, "egress": 0.0}
-    t_all0 = time.perf_counter()
-    for _ in range(n_chunks):
-        t0 = time.perf_counter()
-        fleets = ingest_chunk()
-        stage_s["ingest"] += time.perf_counter() - t0
-        t0 = time.perf_counter()
-        joined = fold_chunk(fleets)
-        stage_s["fold"] += time.perf_counter() - t0
-        t0 = time.perf_counter()
-        blobs_out = joined.to_wire(uni)
-        stage_s["egress"] += time.perf_counter() - t0
-    e2e_s = time.perf_counter() - t_all0
-    assert len(blobs_out) == chunk
+    # warmup: one full untimed iteration of each loop so kernel compiles
+    # and buffer pools exist OUTSIDE the timed regions (the serial
+    # comparator borrows the loop's fold/egress primitives — one
+    # implementation under test — so its buffers must exist first)
+    loop._ensure_buffers(chunk)
+    serial_loop(1)
+    warm = loop.run([rep_blobs], overlap=True)
 
-    merges = n_chunks * chunk * r
+    serial_s, serial_stage, serial_blobs = serial_loop(serial_chunks)
+
+    # --- the timed pipelined loop -------------------------------------
+    counters0 = tracing.counters()
+    res = loop.run([rep_blobs] * n_chunks, overlap=True)
+    e2e_s = res["e2e_s"]
+    assert len(res["out_blobs"]) == chunk
+    # serial and pipelined must emit byte-identical chunks (same blobs
+    # in, same fold, same encoder)
+    assert res["out_blobs"] == serial_blobs, (
+        "e2e wire: pipelined chunk != serial chunk"
+    )
+
+    merges = res["merges"]
+    speedup = (serial_s / serial_chunks) / (e2e_s / n_chunks)
     log(
-        f"e2e wire loop: {merges} replica-objects blobs-in→blobs-out in "
-        f"{e2e_s:.2f}s (ingest {stage_s['ingest']:.2f} fold "
-        f"{stage_s['fold']:.2f} egress {stage_s['egress']:.2f}) = "
-        f"{merges/e2e_s/1e6:.2f}M merges/s end-to-end"
+        f"e2e wire pipelined: {merges} replica-objects blobs-in→blobs-out "
+        f"in {e2e_s:.2f}s (parse {res['stage_s']['parse']:.2f} fold "
+        f"{res['stage_s']['fold']:.2f} egress {res['stage_s']['egress']:.2f})"
+        f" = {merges/e2e_s/1e6:.2f}M merges/s end-to-end; serial comparator "
+        f"{serial_s:.2f}s/{serial_chunks} chunks (ingest "
+        f"{serial_stage['ingest']:.2f} fold {serial_stage['fold']:.2f} "
+        f"egress {serial_stage['egress']:.2f}) -> pipelined is "
+        f"{speedup:.2f}x per chunk"
     )
+    deltas = tracing.counters_since(counters0)
     out = {
         "e2e_wire_s": round(e2e_s, 2),
         "e2e_wire_replica_objects": merges,
         "e2e_wire_merges_per_sec": round(merges / e2e_s, 1),
-        "e2e_wire_ingest_s": round(stage_s["ingest"], 2),
-        "e2e_wire_fold_s": round(stage_s["fold"], 2),
-        "e2e_wire_egress_s": round(stage_s["egress"], 2),
-        "e2e_wire_fold_path": "native" if native_engine is not None else "jnp",
+        "e2e_wire_ingest_s": round(res["stage_s"]["parse"], 2),
+        "e2e_wire_fold_s": round(res["stage_s"]["fold"], 2),
+        "e2e_wire_egress_s": round(res["stage_s"]["egress"], 2),
+        "e2e_wire_fold_path": loop.fold_path,
+        "pipeline": res["pipeline"],
+        "e2e_wire_serial_s": round(serial_s, 2),
+        "e2e_wire_serial_chunks": serial_chunks,
+        "e2e_wire_serial_ingest_s": round(serial_stage["ingest"], 2),
+        "e2e_wire_serial_fold_s": round(serial_stage["fold"], 2),
+        "e2e_wire_serial_egress_s": round(serial_stage["egress"], 2),
+        "e2e_wire_pipeline_speedup": round(speedup, 2),
     }
+    # same-shape parse microbench: ONE fleet through the same warm
+    # staging buffers, isolated from the loop — the in-artifact
+    # reference the e2e ingest rate is judged against (done-bar: e2e
+    # ingest within ~2x of the microbench on IDENTICAL shapes; the old
+    # 160x gap was vs a 2-member/A=16 synthetic microbench)
+    from crdt_tpu.batch.wirebulk import orswot_planes_from_wire
+
+    t0 = time.perf_counter()
+    probe_planes = orswot_planes_from_wire(
+        rep_blobs[0], uni, out=loop._staging[0] if loop._staging else None
+    )
+    t_probe = max(time.perf_counter() - t0, 1e-9)
+    if probe_planes is not None:
+        # None = no native fast path at all — a microsecond no-op whose
+        # "rate" would be garbage in the artifact
+        out["e2e_shape_ingest_obj_per_sec"] = round(chunk / t_probe, 1)
+    if res["stage_s"]["parse"] > 0:
+        out["e2e_wire_parse_obj_per_sec"] = round(
+            n_chunks * r * chunk / res["stage_s"]["parse"], 1
+        )
+
+    nf_in = res["ingest_native_fraction"]
+    nf_out = res["egress_native_fraction"]
+    if nf_in is not None:
+        out["e2e_wire_ingest_native_fraction"] = round(nf_in, 4)
+    if nf_out is not None:
+        out["e2e_wire_egress_native_fraction"] = round(nf_out, 4)
+    reasons = {
+        k: v for k, v in deltas.items() if ".fallback_reason." in k
+    }
+    if reasons:
+        out["e2e_wire_fallback_reasons"] = reasons
     if n_chunks < full_chunks:
         out["e2e_wire_downshift"] = f"{n_chunks}/{full_chunks}"
+    del warm
     return out
 
 
@@ -1189,6 +1256,9 @@ def bench_bulk_ingest():
         n_wire_full = 1_000_000
         n_wire = 200_000 if (_downshift() or SMALL) else n_wire_full
         blobs = synth_wire_blobs(n_wire, rng)  # untimed setup
+        from crdt_tpu.utils import tracing
+
+        counters0 = tracing.counters()
         t0 = time.perf_counter()
         wb = OrswotBatch.from_wire(blobs, iuni)
         jax.block_until_ready(wb.clock)
@@ -1197,6 +1267,7 @@ def bench_bulk_ingest():
         out_blobs = wb.to_wire(iuni)
         t_enc = max(time.perf_counter() - t0, 1e-9)
         del out_blobs
+        wire_deltas = tracing.counters_since(counters0)
         t0 = time.perf_counter()
         coo = wb.to_coo()
         for part in coo:
@@ -1214,6 +1285,19 @@ def bench_bulk_ingest():
             "egress_wire_obj_per_sec": round(n_wire / t_enc, 1),
             "egress_coo_obj_per_sec": round(n_wire / t_coo, 1),
         }
+        # path-taken accounting (VERDICT r5 weak #2): the silent-fallback
+        # class of regression must be visible from the artifact alone
+        nf_in = tracing.native_fraction(wire_deltas, "wire.orswot.from_wire")
+        nf_out = tracing.native_fraction(wire_deltas, "wire.orswot.to_wire")
+        if nf_in is not None:
+            wire_out["ingest_wire_native_fraction"] = round(nf_in, 4)
+        if nf_out is not None:
+            wire_out["egress_wire_native_fraction"] = round(nf_out, 4)
+        reasons = {
+            k: v for k, v in wire_deltas.items() if ".fallback_reason." in k
+        }
+        if reasons:
+            wire_out["wire_fallback_reasons"] = reasons
         if n_wire < n_wire_full and not SMALL:
             wire_out["wire_downshift"] = f"{n_wire}/{n_wire_full}"
         return wire_out
@@ -1395,6 +1479,31 @@ def _probe_backend(total_budget_s: float) -> bool:
     return ok
 
 
+def _emit_regression_warnings(quiet=False):
+    """Diff the current record against the latest prior BENCH_r*.json
+    and emit `regression_warnings` (VERDICT r5 weak #6).  Called twice:
+    once before the required validation stage (so a watchdog kill
+    mid-validation still leaves the field in the banked record) and
+    once after the last stage (final values win — emit() reprints the
+    whole record)."""
+    try:
+        from benchkit import artifacts
+
+        prior_name, prior = artifacts.latest_prior_artifact(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        if prior is None:
+            emit(regression_warnings=[], regression_baseline=None)
+            return
+        warns = artifacts.regression_warnings(prior, _JSON_STATE)
+        if not quiet:
+            for w in warns[:8]:
+                log(f"regression warning vs {prior_name}: {w}")
+        emit(regression_warnings=warns, regression_baseline=prior_name)
+    except Exception as e:  # noqa: BLE001 — diffing must never cost the bench
+        log(f"artifact diffing failed: {type(e).__name__}: {str(e)[:200]}")
+
+
 def main():
     _install_budget_watchdog()
     banked = banked_mod.load_banked()
@@ -1437,7 +1546,11 @@ def main():
     log(f"backend: {backend}  devices: {len(jax.devices())}  small={SMALL}  "
         f"budget={_BUDGET_S:.0f}s (remaining {remaining_budget():.0f}s)")
 
-    run_stage("parity_anchor", 20, parity_anchor)
+    # validation gates are REQUIRED: never budget-skipped (VERDICT r5
+    # weak #3 — budget starvation was eating validation while contender
+    # stages ran; a bench whose parity anchor never ran has no business
+    # publishing numbers)
+    run_stage("parity_anchor", 20, parity_anchor, required=True)
     # the headline FIRST: everything else is secondary evidence (stage
     # order is budget-risk order, not report order)
     ns = run_stage("north_star", 90, bench_north_star)
@@ -1458,6 +1571,15 @@ def main():
     e2e_wire = run_stage("e2e_wire", 120, bench_e2e_wire)
     if e2e_wire is not None:
         emit(**e2e_wire)
+    # provisional regression tail first: a watchdog kill inside the
+    # required validation stage below must not cost the field entirely
+    _emit_regression_warnings(quiet=True)
+    # TPU validation runs BEFORE the optional contenders (resident /
+    # pallas / floor) and is never budget-skipped: it is a killable
+    # subprocess, so its compiles cannot wedge this process's tunnel
+    # helper, and an artifact must not trade validation for contender
+    # stages (VERDICT r5 weak #3).  On non-TPU backends it is a no-op.
+    run_stage("tpu_validation", 240, bench_tpu_validation, required=True)
     resident = run_stage("resident", 90, bench_north_star_resident)
     if resident is not None:
         emit(
@@ -1512,7 +1634,11 @@ def main():
                 headline_eff_gb_per_s=round(eff, 2),
                 headline_vs_floor=round(eff / floor["floor_gb_per_s"], 3),
             )
-    run_stage("tpu_validation", 240, bench_tpu_validation)
+
+    # final regression tail: recompute over the complete record (the
+    # provisional pass before tpu_validation only covered the stages
+    # that had run by then)
+    _emit_regression_warnings()
 
     if _JSON_STATE.get("value") is None:
         # nothing measured and nothing banked: emit an explicit-failure

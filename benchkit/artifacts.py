@@ -1,0 +1,97 @@
+"""Round-over-round bench artifact diffing.
+
+The r4→r5 ``ingest_obj_per_sec`` dip (157k→126k) and
+``egress_wire_obj_per_sec`` dip (1.27M→1.07M) went unremarked for a full
+round because nobody compared the artifacts (VERDICT r5 weak #6).  This
+module makes the bench do it itself: load the latest prior
+``BENCH_r*.json``, compare every shared numeric metric, and emit a
+``regression_warnings`` list (possibly empty) into the tail of the new
+artifact — so a regression is visible to anyone reading only the JSON.
+
+Driver artifacts wrap the parsed record as ``{"n": .., "parsed": {..}}``;
+raw bench output is the record itself.  Both load.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Optional, Tuple
+
+# fields that are not round-comparable metrics: identity/provenance
+# strings are skipped by the numeric filter anyway; these are numeric
+# but meaningless to ratio across rounds
+_IGNORE = {
+    "n", "rc", "vs_baseline",  # vs_baseline is value/1e7 — value covers it
+}
+# workload-size suffixes: chunk counts and object totals are CONFIG
+# (they move with downshift decisions), not measurements — and raw
+# wall-clock totals (`*_s`) are sums OVER those counts, so a changed
+# downshift decision moves every one of them ~Nx without any real
+# regression.  The scale-free rates/fractions computed from them are
+# the comparable metrics (the satellite's motivating misses —
+# ingest_obj_per_sec, egress_wire_obj_per_sec — are rates).
+_IGNORE_SUFFIXES = ("_objects", "_chunks", "_s")
+
+
+def latest_prior_artifact(root: str) -> Tuple[Optional[str], Optional[dict]]:
+    """``(filename, parsed_record)`` of the highest-numbered
+    ``BENCH_r*.json`` under ``root``, or ``(None, None)`` when there is
+    no readable prior artifact (first round, clean checkout)."""
+    best = None
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r0*(\d+)\.json$", os.path.basename(path))
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), path)
+    if best is None:
+        return None, None
+    try:
+        with open(best[1]) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None, None
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict):
+        parsed = doc if isinstance(doc, dict) and "metric" in doc else None
+    if parsed is None:
+        return None, None
+    return os.path.basename(best[1]), parsed
+
+
+def regression_warnings(prior: dict, current: dict,
+                        threshold: float = 0.30) -> list:
+    """Warnings for every numeric metric present in both records that
+    moved more than ``threshold`` (relative), either direction — a 30%
+    *improvement* in a secondary metric is just as often a sign the
+    stage silently measured something else.
+
+    Returns JSON-ready dicts ``{"field", "prior", "current", "ratio"}``
+    sorted by |log ratio| (biggest movers first)."""
+    out = []
+    for field in sorted(set(prior) & set(current) - _IGNORE):
+        if field.endswith(_IGNORE_SUFFIXES):
+            continue
+        p, c = prior[field], current[field]
+        if isinstance(p, bool) or isinstance(c, bool):
+            continue
+        if not isinstance(p, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        if p == 0 or c == 0:
+            # a metric collapsing to exactly 0 (or appearing from 0) is
+            # its own kind of signal, but ratios are undefined; flag
+            # only the collapse direction
+            if p != c:
+                out.append({"field": field, "prior": p, "current": c,
+                            "ratio": None})
+            continue
+        ratio = c / p
+        if ratio > 1 + threshold or ratio < 1 / (1 + threshold):
+            out.append({"field": field, "prior": p, "current": c,
+                        "ratio": round(ratio, 4)})
+    import math
+
+    out.sort(key=lambda w: -abs(math.log(w["ratio"])) if w["ratio"]
+             else -float("inf"))
+    return out

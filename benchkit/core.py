@@ -127,16 +127,29 @@ def install_budget_watchdog(grace_s: float = 60.0):
     threading.Thread(target=guard, daemon=True, name="budget-watchdog").start()
 
 
-def run_stage(name: str, est_s: float, fn, *args, **kwargs):
+def run_stage(name: str, est_s: float, fn, *args, required: bool = False,
+              **kwargs):
     """Run one bench stage, absorbing failures and budget exhaustion.
 
     Returns the stage result or None (skipped/errored) — a crash or a
-    slow tunnel in one stage must never cost the lines already banked."""
+    slow tunnel in one stage must never cost the lines already banked.
+
+    ``required=True`` marks a VALIDATION stage (parity gates, TPU
+    validation): it is never budget-skipped — an artifact whose numbers
+    were never validated is worse than a late artifact (VERDICT r5 weak
+    #3: budget starvation ate four validation stages while contender
+    stages ran).  The watchdog still bounds a stage that *hangs*."""
     rem = remaining_budget()
     if rem < est_s:
-        log(f"stage {name}: SKIPPED (remaining budget {rem:.0f}s < est {est_s:.0f}s)")
-        emit(**{f"{name}_skipped": "budget"})
-        return None
+        if required:
+            log(
+                f"stage {name}: budget low (remaining {rem:.0f}s < est "
+                f"{est_s:.0f}s) but stage is REQUIRED validation — running"
+            )
+        else:
+            log(f"stage {name}: SKIPPED (remaining budget {rem:.0f}s < est {est_s:.0f}s)")
+            emit(**{f"{name}_skipped": "budget"})
+            return None
     try:
         return fn(*args, **kwargs)
     except Exception as e:  # noqa: BLE001 — stage isolation is the point

@@ -20,6 +20,7 @@ from .pncounter_batch import PNCounterBatch
 from .lwwreg_batch import LWWRegBatch
 from .mvreg_batch import MVRegBatch
 from .orswot_batch import OrswotBatch
+from .wireloop import PipelinedWireLoop
 from .gset_batch import GSetBatch
 from .map_batch import MapBatch
 from .val_kernels import MapKernel, MVRegKernel, OrswotKernel
@@ -34,6 +35,7 @@ __all__ = [
     "MVRegKernel",
     "OrswotBatch",
     "OrswotKernel",
+    "PipelinedWireLoop",
     "PNCounterBatch",
     "VClockBatch",
 ]

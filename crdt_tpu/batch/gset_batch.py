@@ -59,18 +59,23 @@ class GSetBatch:
         import numpy as np
 
         from ..utils.serde import from_binary
-        from .wirebulk import concat_blobs, probe_engine
+        from .wirebulk import (
+            concat_blobs, fallback_reason, probe_engine, record_wire,
+        )
 
         n = len(blobs)
         if n == 0:
             return cls.zeros(0, member_capacity)
         engine = probe_engine(universe, "gset_ingest_wire")
         if engine is None:
+            record_wire("gset", "from_wire", fallback=n,
+                        reason=fallback_reason(universe))
             return cls.from_scalar(
                 [from_binary(b) for b in blobs], universe, member_capacity
             )
         buf, offsets = concat_blobs(blobs)
         bits, status = engine.gset_ingest_wire(buf, offsets, member_capacity)
+        n_fb = 0
         if status.any():
             hard = np.nonzero(status == 2)[0]
             if hard.size:
@@ -79,11 +84,14 @@ class GSetBatch:
                     f"member id >= capacity {member_capacity}"
                 )
             fb = np.nonzero(status)[0].tolist()
+            n_fb = len(fb)
             sub = cls.from_scalar(
                 [from_binary(blobs[i]) for i in fb], universe, member_capacity
             )
             idx = np.asarray(fb, dtype=np.int64)
             bits[idx] = np.asarray(sub.bits)
+        record_wire("gset", "from_wire", native=n - n_fb, fallback=n_fb,
+                    reason="grammar")
         return cls(bits=jnp.asarray(bits))
 
     @gc_paused
@@ -93,16 +101,22 @@ class GSetBatch:
         order reproduced in C); non-identity universes take the Python
         path."""
         from ..utils.serde import to_binary
-        from .wirebulk import probe_engine, slice_blobs
+        from .wirebulk import (
+            fallback_reason, probe_engine, record_wire, slice_blobs,
+        )
 
-        if self.bits.shape[0] == 0:
+        n = self.bits.shape[0]
+        if n == 0:
             return []
         engine = probe_engine(universe, "gset_encode_wire")
         if engine is None:
+            record_wire("gset", "to_wire", fallback=n,
+                        reason=fallback_reason(universe))
             return [to_binary(s) for s in self.to_scalar(universe)]
         import numpy as np
 
         buf, offsets = engine.gset_encode_wire(np.asarray(self.bits))
+        record_wire("gset", "to_wire", native=n)
         return slice_blobs(buf, offsets)
 
     @gc_paused

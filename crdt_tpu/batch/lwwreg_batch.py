@@ -55,7 +55,9 @@ class LWWRegBatch:
         import numpy as np
 
         from ..utils.serde import from_binary
-        from .wirebulk import concat_blobs, probe_engine
+        from .wirebulk import (
+            concat_blobs, fallback_reason, probe_engine, record_wire,
+        )
 
         n = len(blobs)
         if n == 0:
@@ -64,6 +66,7 @@ class LWWRegBatch:
                 markers=jnp.zeros(0, dtype=counter_dtype()),
             )
         engine = probe_engine(universe, "lww_ingest_wire", np.uint64)
+        reason = fallback_reason(universe)
         if np.dtype(counter_dtype()) != np.uint64:
             # CRDT_TPU_NO_X64 narrows the marker planes to uint32; the C
             # codec is u64-only and jnp.asarray would silently truncate
@@ -71,18 +74,24 @@ class LWWRegBatch:
             # the Python path so the contract (exact from_scalar
             # equality) holds in that mode too
             engine = None
+            reason = "narrow_counters"
         if engine is None:
+            record_wire("lwwreg", "from_wire", fallback=n, reason=reason)
             return cls.from_scalar([from_binary(b) for b in blobs], universe)
         buf, offsets = concat_blobs(blobs)
         vals, markers, status = engine.lww_ingest_wire(buf, offsets)
+        n_fb = 0
         if status.any():
             fb = np.nonzero(status)[0].tolist()
+            n_fb = len(fb)
             sub = cls.from_scalar(
                 [from_binary(blobs[i]) for i in fb], universe
             )
             idx = np.asarray(fb, dtype=np.int64)
             vals[idx] = np.asarray(sub.vals)
             markers[idx] = np.asarray(sub.markers)
+        record_wire("lwwreg", "from_wire", native=n - n_fb, fallback=n_fb,
+                    reason="grammar")
         return cls(vals=jnp.asarray(vals), markers=jnp.asarray(markers))
 
     @gc_paused
@@ -94,11 +103,15 @@ class LWWRegBatch:
         import numpy as np
 
         from ..utils.serde import to_binary
-        from .wirebulk import probe_engine, slice_blobs
+        from .wirebulk import (
+            fallback_reason, probe_engine, record_wire, slice_blobs,
+        )
 
-        if self.vals.shape[0] == 0:
+        n = self.vals.shape[0]
+        if n == 0:
             return []
         engine = probe_engine(universe, "lww_encode_wire", np.uint64)
+        reason = fallback_reason(universe)
         planes = None
         if engine is not None:
             planes = (np.asarray(self.vals), np.asarray(self.markers))
@@ -109,9 +122,12 @@ class LWWRegBatch:
                 # non-u64 planes (CRDT_TPU_NO_X64) would be reinterpreted
                 # by the u64-only C encoder; >=2^63 exceeds its zigzag
                 engine = None
+                reason = "overflow_zigzag"
         if engine is None:
+            record_wire("lwwreg", "to_wire", fallback=n, reason=reason)
             return [to_binary(s) for s in self.to_scalar(universe)]
         buf, offsets = engine.lww_encode_wire(*planes)
+        record_wire("lwwreg", "to_wire", native=n)
         return slice_blobs(buf, offsets)
 
     @gc_paused

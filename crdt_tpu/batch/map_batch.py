@@ -169,7 +169,9 @@ class MapBatch:
         import jax.numpy as jnp
 
         from ..utils.serde import from_binary
-        from .wirebulk import concat_blobs, probe_engine
+        from .wirebulk import (
+            concat_blobs, fallback_reason, probe_engine, record_wire,
+        )
 
         cfg = universe.config
         leg = _map_wire_leg(val_kernel)
@@ -179,6 +181,9 @@ class MapBatch:
                 universe, f"map_{leg}_ingest_wire", counter_dtype(cfg)
             )
         if engine is None:
+            record_wire("map", "from_wire", fallback=len(blobs),
+                        reason="no_native_leg" if leg is None
+                        else fallback_reason(universe))
             return cls.from_scalar(
                 [from_binary(b) for b in blobs], universe, val_kernel
             )
@@ -220,6 +225,7 @@ class MapBatch:
                 f"{val_kernel.member_capacity} / deferred_capacity "
                 f"{val_kernel.deferred_capacity}"
             )
+        n_fb = 0
         if status.any():
             hard = np.nonzero(status > 1)[0]
             if hard.size:
@@ -242,6 +248,7 @@ class MapBatch:
                     f"range [0, {cfg.num_actors})"
                 )
             fb = np.nonzero(status == 1)[0].tolist()
+            n_fb = len(fb)
             sub = cls.from_scalar(
                 [from_binary(blobs[i]) for i in fb], universe, val_kernel
             )
@@ -255,6 +262,8 @@ class MapBatch:
                 plane[idx] = np.asarray(sub_plane)
             d_keys[idx] = np.asarray(sub.d_keys)
             d_clocks[idx] = np.asarray(sub.d_clocks)
+        record_wire("map", "from_wire", native=len(blobs) - n_fb,
+                    fallback=n_fb, reason="grammar")
         vals = tuple(jnp.asarray(p) for p in val_planes)
         if leg == "map_mvreg":
             # re-nest the flat engine planes into the MapKernel vals
@@ -279,9 +288,13 @@ class MapBatch:
         monomorphizations; u64 counters at/above 2^63 and other
         compositions take the Python encoder)."""
         from ..utils.serde import to_binary
-        from .wirebulk import counters_overflow_zigzag, probe_engine, slice_blobs
+        from .wirebulk import (
+            counters_overflow_zigzag, fallback_reason, probe_engine,
+            record_wire, slice_blobs,
+        )
 
-        if self.clock.shape[0] == 0:
+        n = self.clock.shape[0]
+        if n == 0:
             return []
         leg = _map_wire_leg(self.kernel.val_kernel)
         engine = None
@@ -290,6 +303,7 @@ class MapBatch:
                 universe, f"map_{leg}_encode_wire",
                 counter_dtype(universe.config),
             )
+        reason = "no_native_leg" if leg is None else fallback_reason(universe)
         planes = None
         if engine is not None:
             planes = tuple(np.asarray(x) for x in (
@@ -299,10 +313,13 @@ class MapBatch:
             ))
             if counters_overflow_zigzag(planes):
                 engine = None
+                reason = "overflow_zigzag"
         if engine is None:
+            record_wire("map", "to_wire", fallback=n, reason=reason)
             return [to_binary(s) for s in self.to_scalar(universe)]
         encode = getattr(engine, f"map_{leg}_encode_wire")
         buf, offsets = encode(*planes)
+        record_wire("map", "to_wire", native=n)
         return slice_blobs(buf, offsets)
 
     @gc_paused

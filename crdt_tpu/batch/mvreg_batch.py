@@ -71,7 +71,9 @@ class MVRegBatch:
         import numpy as np
 
         from ..utils.serde import from_binary
-        from .wirebulk import concat_blobs, probe_engine
+        from .wirebulk import (
+            concat_blobs, fallback_reason, probe_engine, record_wire,
+        )
 
         cfg = universe.config
         n = len(blobs)
@@ -79,11 +81,14 @@ class MVRegBatch:
             return cls.zeros(0, universe)
         engine = probe_engine(universe, "mvreg_ingest_wire", counter_dtype(cfg))
         if engine is None:
+            record_wire("mvreg", "from_wire", fallback=n,
+                        reason=fallback_reason(universe))
             return cls.from_scalar([from_binary(b) for b in blobs], universe)
         buf, offsets = concat_blobs(blobs)
         clocks, vals, status = engine.mvreg_ingest_wire(
             buf, offsets, cfg.mv_capacity, cfg.num_actors, counter_dtype(cfg)
         )
+        n_fb = 0
         if status.any():
             hard = np.nonzero(status > 1)[0]
             if hard.size:
@@ -98,12 +103,15 @@ class MVRegBatch:
                     f"range [0, {cfg.num_actors})"
                 )
             fb = np.nonzero(status == 1)[0].tolist()
+            n_fb = len(fb)
             sub = cls.from_scalar(
                 [from_binary(blobs[i]) for i in fb], universe
             )
             idx = np.asarray(fb, dtype=np.int64)
             clocks[idx] = np.asarray(sub.clocks)
             vals[idx] = np.asarray(sub.vals)
+        record_wire("mvreg", "from_wire", native=n - n_fb, fallback=n_fb,
+                    reason="grammar")
         return cls(clocks=jnp.asarray(clocks), vals=jnp.asarray(vals))
 
     @gc_paused
@@ -116,13 +124,17 @@ class MVRegBatch:
         import numpy as np
 
         from ..utils.serde import to_binary
-        from .wirebulk import probe_engine, slice_blobs
+        from .wirebulk import (
+            fallback_reason, probe_engine, record_wire, slice_blobs,
+        )
 
-        if self.clocks.shape[0] == 0:
+        n = self.clocks.shape[0]
+        if n == 0:
             return []
         engine = probe_engine(
             universe, "mvreg_encode_wire", counter_dtype(universe.config)
         )
+        reason = fallback_reason(universe)
         planes = None
         if engine is not None:
             planes = (np.asarray(self.clocks), np.asarray(self.vals))
@@ -130,9 +142,12 @@ class MVRegBatch:
                 int(p.max(initial=0)) >= 1 << 63 for p in planes
             ):
                 engine = None
+                reason = "overflow_zigzag"
         if engine is None:
+            record_wire("mvreg", "to_wire", fallback=n, reason=reason)
             return [to_binary(s) for s in self.to_scalar(universe)]
         buf, offsets = engine.mvreg_encode_wire(*planes)
+        record_wire("mvreg", "to_wire", native=n)
         return slice_blobs(buf, offsets)
 
     @gc_paused

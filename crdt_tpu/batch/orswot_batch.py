@@ -396,71 +396,20 @@ class OrswotBatch:
         ~325 MB of planes would cost ~30 s while its ~16 MB of columns
         cost ~2 s.  The device route canonicalizes member-slot order
         (ascending id), which is semantically identical."""
-        import numpy as np
-
         from ..utils.serde import from_binary
-        from .wirebulk import concat_blobs, probe_engine
+        from .wirebulk import orswot_planes_from_wire
 
         n = len(blobs)
-        cfg = universe.config
         if n == 0:
             return cls.zeros(0, universe)
-        engine = probe_engine(
-            universe, "orswot_ingest_wire", counter_dtype(cfg)
-        )
-        if engine is None:
+        planes = orswot_planes_from_wire(blobs, universe)
+        if planes is None:
+            # no native fast path (engine missing / non-identity
+            # universe): the whole batch decodes in Python
             return cls.from_scalar(
                 [from_binary(b) for b in blobs], universe
             )
-
-        buf, offsets = concat_blobs(blobs)
-        clock, ids, dots, d_ids, d_clocks, status = engine.orswot_ingest_wire(
-            buf, offsets, cfg.num_actors, cfg.member_capacity,
-            cfg.deferred_capacity, counter_dtype(cfg),
-        )
-        if status.any():
-            # hard errors first, reported with the CALLER's blob index
-            hard = np.nonzero(status > 1)[0]
-            if hard.size:
-                first = int(hard[0])
-                code = int(status[first])
-                if code == 2:
-                    raise ValueError(
-                        f"object {first}: members > member_capacity "
-                        f"{cfg.member_capacity}"
-                    )
-                if code == 3:
-                    raise ValueError(
-                        f"object {first}: deferred rows > deferred_capacity "
-                        f"{cfg.deferred_capacity}"
-                    )
-                raise ValueError(
-                    f"object {first}: actor outside the identity registry "
-                    f"range [0, {cfg.num_actors})"
-                )
-            # code 1: structure outside the fast-path grammar — decode
-            # those blobs in Python and patch their rows (raises exactly
-            # where the scalar path would, e.g. non-int members against
-            # an identity registry)
-            fb = np.nonzero(status == 1)[0].tolist()
-            try:
-                sub = cls.from_scalar(
-                    [from_binary(blobs[i]) for i in fb], universe
-                )
-            except (ValueError, TypeError) as e:
-                # from_scalar reports indices relative to the fallback
-                # sublist; translate so the operator can find the blob
-                raise type(e)(
-                    f"{e} [object indices above are relative to the "
-                    f"python-fallback sublist; its blob indices are "
-                    f"{fb[:16]}{'...' if len(fb) > 16 else ''}]"
-                ) from None
-            idx = np.asarray(fb, dtype=np.int64)
-            clock[idx] = np.asarray(sub.clock)
-            ids[idx] = np.asarray(sub.ids)
-            dots[idx] = np.asarray(sub.dots)
-            d_ids[idx] = np.asarray(sub.d_ids)
-            d_clocks[idx] = np.asarray(sub.d_clocks)
+        clock, ids, dots, d_ids, d_clocks = planes
         if via_device is None:
             via_device = jax.default_backend() != "cpu"
         if via_device:
@@ -501,33 +450,19 @@ class OrswotBatch:
         import numpy as np
 
         from ..utils.serde import to_binary
-        from .wirebulk import probe_engine, slice_blobs
+        from .wirebulk import orswot_planes_to_wire
 
         n = self.clock.shape[0]
         if n == 0:
             return []
-        engine = probe_engine(
-            universe, "orswot_encode_wire", counter_dtype(universe.config)
+        blobs = orswot_planes_to_wire(
+            np.asarray(self.clock), np.asarray(self.ids),
+            np.asarray(self.dots), np.asarray(self.d_ids),
+            np.asarray(self.d_clocks), universe,
         )
-        planes = None
-        if engine is not None:
-            planes = tuple(
-                np.asarray(x)
-                for x in (self.clock, self.dots, self.d_clocks)
-            )
-            if planes[0].dtype.itemsize == 8 and any(
-                int(p.max(initial=0)) >= 1 << 63 for p in planes
-            ):
-                # zigzag of a >=2^63 counter exceeds u64; to_binary's
-                # big-int varints handle it — take the Python path
-                engine = None
-        if engine is None:
+        if blobs is None:
             return [to_binary(s) for s in self.to_scalar(universe)]
-        buf, offsets = engine.orswot_encode_wire(
-            planes[0], np.asarray(self.ids), planes[1],
-            np.asarray(self.d_ids), planes[2],
-        )
-        return slice_blobs(buf, offsets)
+        return blobs
 
     @classmethod
     def from_coo(

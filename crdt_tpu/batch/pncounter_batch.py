@@ -62,6 +62,7 @@ class PNCounterBatch:
                 buf, offsets, cfg.num_actors, dt
             ),
             lambda bs: cls.from_scalar(bs, universe).planes,
+            leg="pncounter",
         )))
 
     @gc_paused
@@ -75,6 +76,7 @@ class PNCounterBatch:
             self.planes, universe, "pncounter_encode_wire",
             lambda engine, host: engine.pncounter_encode_wire(host),
             lambda: [to_binary(s) for s in self.to_scalar(universe)],
+            leg="pncounter",
         )
 
     def merge(self, other: "PNCounterBatch") -> "PNCounterBatch":

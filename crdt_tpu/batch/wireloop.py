@@ -1,0 +1,406 @@
+"""Pipelined wire replication loop — overlap host parse with folds.
+
+The replication story is "serialize, ship, merge" (the reference
+delegates transport, `/root/reference/src/lib.rs:62-83`); at fleet scale
+the user-facing loop is *wire blobs in → anti-entropy fold → wire blobs
+out*, processed in device-sized chunks.  The serial form of that loop —
+``from_wire`` per replica fleet, then fold, then ``to_wire`` — measured
+**13,908 merges/s** against a 3.17M merges/s fold kernel in the same
+artifact (``BENCH_r05.json``): ingest was 87% of wall clock, ~160× off
+the wire microbench.  Profiling found the collapse was NOT a silent
+Python fallback (the native parser accepts 100% of e2e-shaped blobs —
+the ``native_fraction`` counters now prove that from the artifact
+alone); it was **allocation churn**: every ``from_wire`` call allocated
+a fresh ~300 MB dense plane set per fleet, page-faulting ~2.5 GB of
+zeroed memory per chunk and freeing it again, which measured 27× slower
+than the identical parse into warm buffers (see PERF.md "wire-loop
+pipeline").
+
+:class:`PipelinedWireLoop` rebuilds the loop around that finding:
+
+* **Staging-buffer reuse** — a small pool of preallocated plane sets
+  (default 3: one being parsed into, up to two held as fold inputs);
+  the native parser clears each object's rows itself
+  (``engine.orswot_ingest_wire(..., out=...)``), so no allocation ever
+  happens in steady state.
+* **Parse/fold overlap** — a background thread parses fleet ``k+1``
+  into a free staging set while the main thread folds fleet ``k``
+  (the ctypes call into the OpenMP parser releases the GIL, so the
+  overlap is real on multicore hosts; device folds dispatch
+  asynchronously on accelerator backends).
+* **Ping-pong fold accumulators** — the C merge kernel fully overwrites
+  its outputs, so two reusable buffer sets absorb the whole fold with
+  zero allocations (`engine.orswot_merge(out=...)`).
+* **Instrumentation** — per-stage wall times and native-vs-fallback
+  blob counts (via :mod:`crdt_tpu.utils.tracing` counters) are returned
+  with the result, so the bench JSON can self-report ``native_fraction``
+  per stage.
+
+``bench_e2e_wire`` (bench.py) and ``examples/anti_entropy.py`` drive
+this one implementation.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..config import counter_dtype
+from ..utils import tracing
+from ..utils.interning import Universe
+
+_SENTINEL = object()
+
+
+def _native_fold_engine():
+    """The native engine module when its merge kernel is usable, else
+    None (same probe discipline as wirebulk.probe_engine: an old .so may
+    load yet lack newer entry points)."""
+    try:
+        from ..native import engine
+
+        engine._fn("orswot_merge", np.uint32)
+        return engine
+    except (ImportError, OSError, RuntimeError, AttributeError, TypeError):
+        return None
+
+
+class PipelinedWireLoop:
+    """Double-buffered ORSWOT wire replication: blobs in → fold → blobs
+    out, with host parse overlapped against the fold.
+
+    One instance owns the staging/accumulator buffer pools for a fixed
+    ``universe`` (identity universes take the native parse/encode fast
+    path; any other universe still works through the Python codec, just
+    without the zero-allocation steady state).  ``run`` processes any
+    number of rounds; buffers are sized on first use and reused across
+    rounds and across ``run`` calls.
+
+    ``fold_path``: ``"native"`` (C++ row kernels, the CPU best engine),
+    ``"jnp"`` (jitted device merge, async dispatch), or None to pick
+    native when available on a CPU backend, jnp otherwise.
+    """
+
+    def __init__(self, universe: Universe, *, fold_path: Optional[str] = None,
+                 staging_sets: int = 3):
+        if staging_sets < 2:
+            raise ValueError("pipelining needs at least 2 staging sets")
+        self.universe = universe
+        self.cfg = universe.config
+        self._staging_sets = staging_sets
+        self._staging: list[tuple] = []
+        self._pingpong: list[tuple] = []
+        self._n: Optional[int] = None
+        if fold_path is None:
+            import jax
+
+            engine = _native_fold_engine() if jax.default_backend() == "cpu" \
+                else None
+            fold_path = "native" if engine is not None else "jnp"
+        if fold_path not in ("native", "jnp"):
+            raise ValueError(f"fold_path {fold_path!r} is not native/jnp")
+        self.fold_path = fold_path
+        self._engine = _native_fold_engine() if fold_path == "native" else None
+        if fold_path == "native" and self._engine is None:
+            raise RuntimeError("fold_path='native' but the native engine "
+                               "is unavailable")
+        self._jit_merge = None
+        self._overflow = None  # jnp path: lazily ORed bool[2] flags
+
+    # -- buffers -------------------------------------------------------------
+
+    def _plane_set(self, n: int) -> tuple:
+        cfg = self.cfg
+        dt = counter_dtype(cfg)
+        a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
+        return (
+            np.zeros((n, a), dtype=dt),
+            np.full((n, m), -1, dtype=np.int32),
+            np.zeros((n, m, a), dtype=dt),
+            np.full((n, d), -1, dtype=np.int32),
+            np.zeros((n, d, a), dtype=dt),
+        )
+
+    def _ensure_buffers(self, n: int) -> None:
+        if self._n == n:
+            return
+        self._n = n
+        self._staging = [self._plane_set(n) for _ in range(self._staging_sets)]
+        self._pingpong = (
+            [self._plane_set(n) for _ in range(2)]
+            if self.fold_path == "native" else []
+        )
+
+    # -- stages --------------------------------------------------------------
+
+    def _parse_into(self, blobs: Sequence[bytes], staging: tuple) -> None:
+        """Decode ``blobs`` into the ``staging`` plane set (native fast
+        path with per-blob triage; full Python route when the fast path
+        does not apply)."""
+        from .wirebulk import orswot_planes_from_wire
+
+        planes = orswot_planes_from_wire(blobs, self.universe, out=staging)
+        if planes is None:
+            # no native fast path: decode in Python and copy into the
+            # staging set so the fold sees one buffer discipline
+            from ..utils.serde import from_binary
+            from .orswot_batch import OrswotBatch
+
+            sub = OrswotBatch.from_scalar(
+                [from_binary(b) for b in blobs], self.universe
+            )
+            for dst, src in zip(staging, (sub.clock, sub.ids, sub.dots,
+                                          sub.d_ids, sub.d_clocks)):
+                np.copyto(dst, np.asarray(src))
+
+    def _merge_native(self, acc: tuple, rhs: tuple, out: tuple) -> tuple:
+        res = self._engine.orswot_merge(*acc, *rhs, out=out)
+        if res[5].any():
+            from ..error import raise_for_overflow
+
+            raise_for_overflow(res[5], "wire-loop fold")
+        return res[:5]
+
+    def _merge_jnp(self, acc: tuple, rhs: tuple) -> tuple:
+        """One async-dispatched device merge; overflow flags accumulate
+        in ``self._overflow`` (checked once per round, at the egress
+        sync, so no host round-trip lands mid-fold)."""
+        import functools
+
+        import jax
+
+        if self._jit_merge is None:
+            from ..ops import orswot_ops
+
+            cfg = self.cfg
+            self._jit_merge = jax.jit(functools.partial(
+                orswot_ops.merge,
+                m_cap=cfg.member_capacity, d_cap=cfg.deferred_capacity,
+            ))
+        out = self._jit_merge(*acc, *rhs)
+        ov = out[5].reshape(-1, 2).any(axis=0)
+        self._overflow = ov if self._overflow is None else \
+            (self._overflow | ov)
+        return out[:5]
+
+    def _egress(self, acc: tuple) -> list[bytes]:
+        from .wirebulk import orswot_planes_to_wire
+
+        planes = tuple(np.asarray(x) for x in acc)
+        blobs = orswot_planes_to_wire(*planes, self.universe)
+        if blobs is not None:
+            return blobs
+        # Python route (non-identity universe / u64 zigzag overflow) —
+        # already counted by orswot_planes_to_wire
+        from ..utils.serde import to_binary
+        from .orswot_batch import OrswotBatch
+
+        batch = OrswotBatch(*(np.ascontiguousarray(p) for p in planes))
+        return [to_binary(s) for s in batch.to_scalar(self.universe)]
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self, rounds: Iterable[Sequence[Sequence[bytes]]], *,
+            overlap: bool = True, collect: str = "last",
+            on_round: Optional[Callable[[int, list], None]] = None) -> dict:
+        """Process ``rounds`` of replica-fleet blobs through parse →
+        fold-to-fixpoint (left fold + defer-plunger self-merge) → egress.
+
+        Each round is a sequence of ``r`` blob lists (one per replica
+        fleet, equal lengths).  With ``overlap=True`` a background
+        thread stays one fleet ahead of the fold; ``overlap=False`` runs
+        the identical staged code serially (the A/B the bench reports).
+
+        ``collect``: ``"last"`` keeps only the final round's egressed
+        blobs (bounded memory at bench scale), ``"all"`` keeps every
+        round's, ``"none"`` keeps none.  ``on_round(i, blobs)`` sees
+        each round's output either way.
+
+        Returns ``{"out_blobs", "rounds", "merges", "objects",
+        "pipeline", "fold_path", "stage_s": {parse, fold, egress},
+        "e2e_s", "wire_counters", "ingest_native_fraction",
+        "egress_native_fraction"}`` — ``stage_s`` are per-stage wall
+        sums (with overlap they can exceed ``e2e_s``; that surplus IS
+        the overlap won), counters/fractions are the tracing deltas for
+        this call."""
+        if collect not in ("last", "all", "none"):
+            raise ValueError(f"collect {collect!r} is not last/all/none")
+        rounds = list(rounds)
+        stage_s = {"parse": 0.0, "fold": 0.0, "egress": 0.0}
+        counters_before = tracing.counters()
+        out_blobs: list = []
+        all_blobs: list = []
+        merges = objects = 0
+        t_all0 = time.perf_counter()
+
+        free_q: "queue.Queue" = queue.Queue()
+        parsed_q: "queue.Queue" = queue.Queue()
+
+        def parse_one(blobs, staging):
+            t0 = time.perf_counter()
+            self._parse_into(blobs, staging)
+            stage_s["parse"] += time.perf_counter() - t0
+
+        def worker():
+            try:
+                for blobs in fleet_stream:
+                    staging = free_q.get()
+                    if staging is _SENTINEL:
+                        return
+                    parse_one(blobs, staging)
+                    parsed_q.put(staging)
+                parsed_q.put(_SENTINEL)
+            except BaseException as e:  # surfaced in the main thread
+                parsed_q.put(e)
+
+        n_rounds = len(rounds)
+        fleet_stream = [blobs for rnd in rounds for blobs in rnd]
+        if not fleet_stream:
+            return {
+                "out_blobs": [], "rounds": 0, "merges": 0, "objects": 0,
+                "pipeline": "overlapped" if overlap else "serial",
+                "fold_path": self.fold_path,
+                "stage_s": {k: 0.0 for k in stage_s}, "e2e_s": 0.0,
+                "wire_counters": {}, "ingest_native_fraction": None,
+                "egress_native_fraction": None,
+            }
+        n = len(fleet_stream[0])
+        if any(len(b) != n for b in fleet_stream):
+            raise ValueError("all fleets must hold the same object count")
+        self._ensure_buffers(n)
+        for st in self._staging:
+            free_q.put(st)
+
+        thread = None
+        stream_iter = iter(fleet_stream)
+        if overlap:
+            thread = threading.Thread(target=worker, daemon=True,
+                                      name="wireloop-parse")
+            thread.start()
+
+        def next_staged():
+            if overlap:
+                item = parsed_q.get()
+                if isinstance(item, BaseException):
+                    raise item
+                return item
+            blobs = next(stream_iter, _SENTINEL)
+            if blobs is _SENTINEL:
+                return _SENTINEL
+            staging = free_q.get()
+            parse_one(blobs, staging)
+            return staging
+
+        try:
+            for ri, rnd in enumerate(rounds):
+                r = len(rnd)
+                acc = None
+                acc_staging = None  # staging set acc still aliases
+                pp = 0
+                t0 = time.perf_counter()
+                for fi in range(r):
+                    staged = next_staged()
+                    assert staged is not _SENTINEL
+                    if acc is None:
+                        acc, acc_staging = staged, staged
+                        continue
+                    if self.fold_path == "native":
+                        acc = self._merge_native(
+                            acc, staged, self._pingpong[pp]
+                        )
+                        pp ^= 1
+                    else:
+                        acc = self._merge_jnp(
+                            self._put_device(acc), self._put_device(staged)
+                        )
+                    # both consumed buffer sets go back to the parser
+                    if acc_staging is not None:
+                        free_q.put(acc_staging)
+                        acc_staging = None
+                    free_q.put(staged)
+                # defer plunger: one self-merge flushes deferred removes
+                if self.fold_path == "native":
+                    acc = self._merge_native(acc, acc, self._pingpong[pp])
+                    pp ^= 1
+                else:
+                    acc = self._merge_jnp(
+                        self._put_device(acc), self._put_device(acc)
+                    )
+                if acc_staging is not None:
+                    # r == 1: the plunger read straight from staging
+                    free_q.put(acc_staging)
+                    acc_staging = None
+                stage_s["fold"] += time.perf_counter() - t0
+                merges += n * r
+                objects += n
+
+                t0 = time.perf_counter()
+                if self._overflow is not None:
+                    # jnp path: one deferred overflow check per round —
+                    # the egress fetch syncs the device anyway
+                    from ..error import raise_for_overflow
+
+                    ov, self._overflow = self._overflow, None
+                    raise_for_overflow(ov, "wire-loop fold")
+                blobs_out = self._egress(acc)
+                stage_s["egress"] += time.perf_counter() - t0
+                if on_round is not None:
+                    on_round(ri, blobs_out)
+                if collect == "all":
+                    all_blobs.append(blobs_out)
+                elif collect == "last":
+                    out_blobs = blobs_out
+        finally:
+            if thread is not None:
+                free_q.put(_SENTINEL)  # unblock a parser waiting for buffers
+                thread.join(timeout=30)
+                if thread.is_alive():
+                    # a worker still parsing (main thread raised mid-fold
+                    # on a slow parse) may write into the staging planes
+                    # for a while yet — orphan the whole pool so the next
+                    # run() allocates fresh buffers instead of handing
+                    # the zombie's targets to a new worker
+                    self._staging = []
+                    self._pingpong = []
+                    self._n = None
+
+        e2e_s = time.perf_counter() - t_all0
+        deltas = tracing.counters_since(counters_before)
+        return {
+            "out_blobs": all_blobs if collect == "all" else out_blobs,
+            "rounds": n_rounds,
+            "merges": merges,
+            "objects": objects,
+            "pipeline": "overlapped" if overlap else "serial",
+            "fold_path": self.fold_path,
+            "stage_s": {k: round(v, 4) for k, v in stage_s.items()},
+            "e2e_s": round(e2e_s, 4),
+            "wire_counters": deltas,
+            "ingest_native_fraction": tracing.native_fraction(
+                deltas, "wire.orswot.from_wire"
+            ),
+            "egress_native_fraction": tracing.native_fraction(
+                deltas, "wire.orswot.to_wire"
+            ),
+        }
+
+    def _put_device(self, planes: tuple):
+        """Host staging planes → device arrays for the jnp fold.
+
+        ``device_put`` copies host numpy buffers into the backend's own
+        (aligned) allocations, so once the transfer completes the
+        staging set is safe to hand back to the parser; blocking here
+        costs only the H2D — the merges themselves still chain
+        asynchronously.  Device-resident accumulators pass through
+        untouched."""
+        import jax
+
+        if not isinstance(planes[0], np.ndarray):
+            return planes
+        moved = jax.device_put(planes)
+        jax.block_until_ready(moved)
+        return moved

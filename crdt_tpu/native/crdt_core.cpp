@@ -1364,6 +1364,8 @@ DEFINE_ALL(u64, uint64_t)
 // v7: + orswot wire codec, mvreg/lww wire codecs (wire_ingest.cpp)
 // v8: + clockish (vclock/gcounter) + pncounter wire codecs,
 //     Map<K, MVReg> and Map<K, Orswot> wire codecs (wire_ingest.cpp)
-int crdt_core_abi_version() { return 8; }
+// v9: orswot_ingest_wire grows a trailing `clear` flag (self-clearing
+//     rows for reused staging buffers — the pipelined wire loop)
+int crdt_core_abi_version() { return 9; }
 
 }  // extern "C"

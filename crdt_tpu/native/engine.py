@@ -603,7 +603,7 @@ def map_mvreg_merge(
 # -- bulk wire ingest --------------------------------------------------------
 
 
-def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype):
+def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype, out=None):
     """Parallel wire-format decode of ``n`` concatenated ORSWOT blobs
     (`crdt_tpu/native/wire_ingest.cpp`) straight into dense planes.
 
@@ -612,6 +612,15 @@ def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype):
     caller — ``OrswotBatch.from_wire`` — guarantees an identity
     universe): actor index == actor value (< ``a``), member id == member
     value (int32).
+
+    ``out``: optional preallocated ``(clock, ids, dots, d_ids,
+    d_clocks)`` 5-tuple to decode into (same shapes/dtypes the call
+    would otherwise allocate).  The C parser then clears each object's
+    rows itself before writing, so buffers may be REUSED across calls —
+    which is the point: a fresh ~plane-set allocation per call
+    page-faults GBs of zeroed memory and measured a 27x ingest collapse
+    at north-star chunk scale (the pipelined wire loop's staging buffers
+    exist to amortize exactly this; see PERF.md).
 
     Returns ``(clock, ids, dots, d_ids, d_clocks, status)`` where
     ``status`` is uint8[n]: 0 ok, 1 fast-path fallback (blob structure
@@ -622,11 +631,33 @@ def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype):
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     n = offsets.shape[0] - 1
     dt = np.dtype(dtype)
-    clock = np.zeros((n, a), dtype=dt)
-    ids = np.full((n, m), -1, dtype=np.int32)
-    dots = np.zeros((n, m, a), dtype=dt)
-    d_ids = np.full((n, d), -1, dtype=np.int32)
-    d_clocks = np.zeros((n, d, a), dtype=dt)
+    if out is None:
+        clear = 0
+        clock = np.zeros((n, a), dtype=dt)
+        ids = np.full((n, m), -1, dtype=np.int32)
+        dots = np.zeros((n, m, a), dtype=dt)
+        d_ids = np.full((n, d), -1, dtype=np.int32)
+        d_clocks = np.zeros((n, d, a), dtype=dt)
+    else:
+        clear = 1
+        clock, ids, dots, d_ids, d_clocks = out
+        expect = (
+            ((n, a), dt), ((n, m), np.dtype(np.int32)),
+            ((n, m, a), dt), ((n, d), np.dtype(np.int32)),
+            ((n, d, a), dt),
+        )
+        for name, buf_, (shape, dtype_) in zip(
+            ("clock", "ids", "dots", "d_ids", "d_clocks"),
+            (clock, ids, dots, d_ids, d_clocks), expect,
+        ):
+            if (not isinstance(buf_, np.ndarray) or buf_.shape != shape
+                    or buf_.dtype != dtype_
+                    or not buf_.flags.c_contiguous):
+                raise ValueError(
+                    f"out[{name}]: need C-contiguous {dtype_}{shape}, got "
+                    f"{getattr(buf_, 'dtype', type(buf_))}"
+                    f"{getattr(buf_, 'shape', '')}"
+                )
     status = np.zeros(n, dtype=np.uint8)
     fn = _fn("orswot_ingest_wire", dt)
     fn.restype = ctypes.c_int64
@@ -634,7 +665,7 @@ def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype):
         _ptr(buf), _ptr(offsets), ctypes.c_int64(n),
         ctypes.c_int64(a), ctypes.c_int64(m), ctypes.c_int64(d),
         _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
-        _ptr(status),
+        _ptr(status), ctypes.c_int64(clear),
     )
     return clock, ids, dots, d_ids, d_clocks, status
 

@@ -241,25 +241,41 @@ int parse_one(const uint8_t* buf, int64_t lo, int64_t hi, int64_t A,
 }
 
 template <typename C>
+void clear_orswot_row(int64_t A, int64_t M, int64_t D, C* clock, int32_t* ids,
+                      C* dots, int32_t* d_ids, C* d_clocks) {
+  std::memset(clock, 0, sizeof(C) * A);
+  std::memset(dots, 0, sizeof(C) * M * A);
+  std::memset(d_clocks, 0, sizeof(C) * D * A);
+  for (int64_t j = 0; j < M; ++j) ids[j] = kEmpty;
+  for (int64_t j = 0; j < D; ++j) d_ids[j] = kEmpty;
+}
+
+// ``clear`` != 0: zero each object's output rows before parsing, so the
+// caller may hand REUSED buffers (the pipelined loop's staging planes —
+// a fresh np.zeros alloc per chunk page-faults ~GBs and was the measured
+// e2e ingest collapse, PERF.md).  0 keeps the historical contract
+// (caller pre-zeroed the planes) and skips the memset pass.
+template <typename C>
 int64_t ingest_impl(const uint8_t* buf, const int64_t* offsets, int64_t n,
                     int64_t A, int64_t M, int64_t D, C* clock, int32_t* ids,
-                    C* dots, int32_t* d_ids, C* d_clocks, uint8_t* status) {
+                    C* dots, int32_t* d_ids, C* d_clocks, uint8_t* status,
+                    int64_t clear) {
   int64_t bad = 0;
 #if defined(_OPENMP)
 #pragma omp parallel for schedule(dynamic, 1024) reduction(+ : bad)
 #endif
   for (int64_t i = 0; i < n; ++i) {
+    if (clear)
+      clear_orswot_row<C>(A, M, D, clock + i * A, ids + i * M,
+                          dots + i * M * A, d_ids + i * D, d_clocks + i * D * A);
     int st = parse_one<C>(buf, offsets[i], offsets[i + 1], A, M, D,
                           clock + i * A, ids + i * M, dots + i * M * A,
                           d_ids + i * D, d_clocks + i * D * A);
     status[i] = static_cast<uint8_t>(st);
     if (st != 0) {
       // leave the row pristine for the Python fallback / error report
-      std::memset(clock + i * A, 0, sizeof(C) * A);
-      std::memset(dots + i * M * A, 0, sizeof(C) * M * A);
-      std::memset(d_clocks + i * D * A, 0, sizeof(C) * D * A);
-      for (int64_t j = 0; j < M; ++j) ids[i * M + j] = kEmpty;
-      for (int64_t j = 0; j < D; ++j) d_ids[i * D + j] = kEmpty;
+      clear_orswot_row<C>(A, M, D, clock + i * A, ids + i * M,
+                          dots + i * M * A, d_ids + i * D, d_clocks + i * D * A);
       ++bad;
     }
   }
@@ -859,18 +875,18 @@ int64_t orswot_ingest_wire_u32(const uint8_t* buf, const int64_t* offsets,
                                int64_t n, int64_t A, int64_t M, int64_t D,
                                uint32_t* clock, int32_t* ids, uint32_t* dots,
                                int32_t* d_ids, uint32_t* d_clocks,
-                               uint8_t* status) {
+                               uint8_t* status, int64_t clear) {
   return ingest_impl<uint32_t>(buf, offsets, n, A, M, D, clock, ids, dots,
-                               d_ids, d_clocks, status);
+                               d_ids, d_clocks, status, clear);
 }
 
 int64_t orswot_ingest_wire_u64(const uint8_t* buf, const int64_t* offsets,
                                int64_t n, int64_t A, int64_t M, int64_t D,
                                uint64_t* clock, int32_t* ids, uint64_t* dots,
                                int32_t* d_ids, uint64_t* d_clocks,
-                               uint8_t* status) {
+                               uint8_t* status, int64_t clear) {
   return ingest_impl<uint64_t>(buf, offsets, n, A, M, D, clock, ids, dots,
-                               d_ids, d_clocks, status);
+                               d_ids, d_clocks, status, clear);
 }
 
 }  // extern "C"

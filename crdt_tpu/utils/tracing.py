@@ -77,16 +77,40 @@ def pytree_bytes(*trees: Any) -> int:
 
 @dataclass
 class Tracer:
-    """Aggregates named wall-time spans; thread-safe."""
+    """Aggregates named wall-time spans and event counters; thread-safe.
+
+    Counters are the *path-taken* half of observability (SURVEY §5): the
+    wire codecs count native-vs-fallback blobs per call so a silent
+    fallback regression is visible in the bench artifact, not just in
+    wall time.  Unlike spans they are always on — one dict increment per
+    *bulk call* (not per blob) is free — so ``enabled`` gates spans only.
+    """
 
     enabled: bool = True
     stats: Dict[str, SpanStats] = field(default_factory=dict)
+    counts: Dict[str, int] = field(default_factory=dict)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     def add(self, name: str, dt: float, nbytes: int = 0) -> None:
         """Record one observation for ``name`` (thread-safe)."""
         with self._lock:
             self.stats.setdefault(name, SpanStats()).add(dt, nbytes)
+
+    def count(self, name: str, n: int = 1) -> None:
+        """Add ``n`` to the event counter ``name`` (thread-safe).
+
+        Zero increments are dropped so snapshots only carry counters
+        that actually fired — a fallback counter that never appears is
+        distinguishable from one that counted 0 this interval."""
+        if n == 0:
+            return
+        with self._lock:
+            self.counts[name] = self.counts.get(name, 0) + int(n)
+
+    def counters(self) -> Dict[str, int]:
+        """A snapshot copy of all event counters."""
+        with self._lock:
+            return dict(self.counts)
 
     @contextlib.contextmanager
     def span(self, name: str, nbytes: int = 0) -> Iterator[None]:
@@ -104,6 +128,7 @@ class Tracer:
     def reset(self) -> None:
         with self._lock:
             self.stats.clear()
+            self.counts.clear()
 
     def report(self) -> str:
         """Human-readable table, longest total first."""
@@ -114,8 +139,11 @@ class Tracer:
                 key=lambda kv: kv[1].total_s,
                 reverse=True,
             )
-        if not rows:
+            counter_rows = sorted(self.counts.items())
+        if not rows and not counter_rows:
             return "(no spans recorded)"
+        if not rows:
+            return "\n".join(f"{name:<48} {n:>12}" for name, n in counter_rows)
         lines = [
             f"{'span':<32} {'count':>7} {'total':>10} {'mean':>10} "
             f"{'min':>10} {'max':>10} {'GB/s':>8}"
@@ -127,6 +155,7 @@ class Tracer:
                 f"{s.mean_s*1e3:>9.3f}ms {s.min_s*1e3:>9.3f}ms "
                 f"{s.max_s*1e3:>9.3f}ms {gbps}"
             )
+        lines.extend(f"{name:<48} {n:>12}" for name, n in counter_rows)
         return "\n".join(lines)
 
 
@@ -162,6 +191,42 @@ def enable(on: bool = True) -> None:
 def span(name: str):
     """``with tracing.span("orswot.merge"): ...`` on the global tracer."""
     return _GLOBAL.span(name)
+
+
+def count(name: str, n: int = 1) -> None:
+    """Increment an always-on event counter on the global tracer (the
+    wire codecs' native-vs-fallback accounting; one increment per bulk
+    call, so no ``enabled`` gate)."""
+    _GLOBAL.count(name, n)
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of the global tracer's event counters."""
+    return _GLOBAL.counters()
+
+
+def counters_since(before: Dict[str, int]) -> Dict[str, int]:
+    """Counter deltas vs an earlier :func:`counters` snapshot — the
+    per-stage view the bench uses: snapshot, run a stage, diff."""
+    now = _GLOBAL.counters()
+    out = {k: v - before.get(k, 0) for k, v in now.items()}
+    return {k: v for k, v in out.items() if v}
+
+
+def native_fraction(deltas: Dict[str, int], prefix: str) -> Optional[float]:
+    """The fraction of blobs that took the native path for one wire
+    stage, from a :func:`counters_since` delta dict.
+
+    ``prefix`` is the counter family (e.g. ``"wire.orswot.from_wire"``);
+    the convention is ``<prefix>.native`` / ``<prefix>.fallback`` blob
+    counts plus ``<prefix>.fallback_reason.<why>`` detail counters.
+    Returns None when the stage moved no blobs."""
+    native = deltas.get(f"{prefix}.native", 0)
+    fallback = deltas.get(f"{prefix}.fallback", 0)
+    total = native + fallback
+    if total == 0:
+        return None
+    return native / total
 
 
 def report() -> str:

@@ -261,6 +261,34 @@ def step7_bulk_wire_loop():
     assert peer.value().val == from_binary(incoming[0]).value().val
     print(f"7. bulk wire loop: {n} blobs in -> device merge -> {n} blobs "
           "out, byte-identical to the scalar codec")
+    return uni, n, incoming
+
+
+def step8_pipelined_wire_loop(uni, n, incoming):
+    """The sustained form of step 7 — the SAME loop the bench times
+    (`crdt_tpu.batch.wireloop.PipelinedWireLoop`, one implementation for
+    bench and examples): reused staging buffers instead of a fresh plane
+    set per fleet (the round-5 e2e ingest collapse was exactly that
+    allocation churn, PERF.md), with a background thread parsing the
+    next fleet while the current one folds.  The result dict carries the
+    per-stage times and the native-vs-fallback blob accounting the bench
+    JSON publishes as ``native_fraction``."""
+    from crdt_tpu.batch.wireloop import PipelinedWireLoop
+
+    # two replica fleets of the same objects: fleet 0 is the step-7
+    # traffic, fleet 1 a second replica's copy arriving in the same
+    # anti-entropy round
+    loop = PipelinedWireLoop(uni)
+    res = loop.run([[incoming, incoming]])
+    # fold of two identical replicas + plunger == scalar self-merge
+    acc = from_binary(incoming[0])
+    acc.merge(from_binary(incoming[0]))
+    acc.merge(acc.clone())
+    assert res["out_blobs"][0] == to_binary(acc)
+    nf = res["ingest_native_fraction"]
+    print(f"8. pipelined wire loop ({res['fold_path']} fold, "
+          f"{res['pipeline']}): {res['merges']} replica-objects in "
+          f"{res['e2e_s']:.3f}s, ingest native_fraction={nf}")
 
 
 def main():
@@ -270,7 +298,8 @@ def main():
     step4_collective_join(uni, fleets, sets)
     step5_typed_collective_joins()
     step6_elastic_regrowth()
-    step7_bulk_wire_loop()
+    uni, n, incoming = step7_bulk_wire_loop()
+    step8_pipelined_wire_loop(uni, n, incoming)
     print("anti-entropy walkthrough: OK")
 
 

@@ -46,6 +46,11 @@ step() {  # step <name> <timeout> <log> <cmd...>
 }
 
 tunnel_alive() {
+    # returns the probe's own rc so callers can discriminate: 0 = alive;
+    # 124/137 = the timeout wrapper killed a HUNG probe (wedged window);
+    # anything else = jax.devices() failed FAST (window simply closed /
+    # plugin error).  Fallback behavior is the same either way, but the
+    # log line must not claim "wedged" for a fast failure (advisor r5).
     timeout -k 15 150 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
@@ -62,15 +67,26 @@ wedge_probe() {  # wedge_probe <context> — fresh-process aliveness probe
     # two attempts: a transiently slow live window must not be
     # misclassified as wedged off one 150s miss (the second attempt
     # only runs when the first failed, so the live path stays cheap)
+    local prc=0
     for _try in 1 2; do
-        if tunnel_alive; then
+        tunnel_alive
+        prc=$?
+        if [ "$prc" -eq 0 ]; then
             echo "$(date -u +%H:%M:%S) $1 - tunnel still answers, continuing" \
                 | tee -a /tmp/tunnel_watch.log
             return 1
         fi
     done
-    echo "$(date -u +%H:%M:%S) $1 - tunnel probe hangs: wedged, back to outer probe" \
-        | tee -a /tmp/tunnel_watch.log
+    # wedged (probe HUNG until the timeout killed it) vs window closed
+    # (probe failed fast) — distinct diagnoses for later debugging even
+    # though both fall back to the outer probe loop
+    if [ "$prc" -eq 124 ] || [ "$prc" -eq 137 ]; then
+        echo "$(date -u +%H:%M:%S) $1 - tunnel probe hangs (rc $prc): wedged, back to outer probe" \
+            | tee -a /tmp/tunnel_watch.log
+    else
+        echo "$(date -u +%H:%M:%S) $1 - tunnel probe fails fast (rc $prc): window closed, back to outer probe" \
+            | tee -a /tmp/tunnel_watch.log
+    fi
     return 0
 }
 
@@ -185,25 +201,29 @@ for i in $(seq 1 600); do
         fi
         step validate_merge 900 /tmp/validate_merge_tpu.log \
             python scripts/tpu_validate.py --merge
-        wedged $? validate_merge && { sleep 60; continue; }
+        rc=$?  # captured immediately: an inserted line would silently break $?
+        wedged "$rc" validate_merge && { sleep 60; continue; }
         # 2) can the axon client serialize its own executables?  If yes,
         #    one helper compile of the fused scan can be banked for
         #    compile-free reuse across windows (the local-AOT direction
         #    is format-incompatible — see header)
         step axon_serialize 600 /tmp/axon_serialize_tpu.log \
             python scripts/axon_serialize_probe.py
-        wedged $? axon_serialize && { sleep 60; continue; }
+        rc=$?
+        wedged "$rc" axon_serialize && { sleep 60; continue; }
         # 3) secondary evidence, after everything headline-bearing
         step profile 2400 /tmp/profile_tpu.log \
             python scripts/profile_stages.py
-        wedged $? profile && { sleep 60; continue; }
+        rc=$?
+        wedged "$rc" profile && { sleep 60; continue; }
         # the 7-mode layout A/B concluded in the 2026-07-31 window
         # (reports/LAYOUT_AB_TPU.md); only the still-undecided fold-shape
         # contenders remain
         step experiments 5000 /tmp/experiments_tpu.log \
             env CRDT_EXP_MODES=fold_seq,fold_tree,fold_seq_rank \
             python scripts/tpu_experiments.py
-        wedged $? experiments && { sleep 60; continue; }
+        rc=$?
+        wedged "$rc" experiments && { sleep 60; continue; }
         if [ -e "$MARK/experiments" ]; then
             BLOG=/dev/null
             [ -e "$MARK/bench" ] && BLOG=/tmp/bench_tpu3.log
@@ -216,11 +236,13 @@ for i in $(seq 1 600); do
         step pallas 1800 /tmp/pallas_tpu.log \
             env TPU_ACCELERATOR_TYPE=v5litepod-1 TPU_WORKER_HOSTNAMES=localhost \
             python scripts/tpu_validate.py --pallas
-        wedged $? pallas && { sleep 60; continue; }
+        rc=$?
+        wedged "$rc" pallas && { sleep 60; continue; }
         step experiments_pallas 1800 /tmp/experiments_pallas_tpu.log \
             env CRDT_EXP_MODES=merge_pallas \
             python scripts/tpu_experiments.py
-        wedged $? experiments_pallas && { sleep 60; continue; }
+        rc=$?
+        wedged "$rc" experiments_pallas && { sleep 60; continue; }
         # done only when every step has its marker
         if [ -e "$MARK/profile" ] && [ -e "$MARK/experiments" ] && \
            [ -e "$MARK/bench" ] && [ -e "$MARK/axon_serialize" ] && \

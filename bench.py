@@ -1007,6 +1007,124 @@ def bench_e2e_wire():
     return out
 
 
+def bench_sync():
+    """Digest-driven delta anti-entropy at bench-fleet shape (the
+    `crdt_tpu.sync` subsystem): two replicas of the same fleet diverge
+    on 1% of objects per round, then reconcile through a
+    :class:`~crdt_tpu.sync.SyncSession` — digest vectors first, then
+    only the diverged rows' wire blobs.
+
+    The headline number is ``sync_delta_ratio``: payload bytes the delta
+    session shipped over what a full-state exchange ships for the same
+    fleet (the pre-sync replication cost).  At 1% divergence the done-bar
+    is ≤ 0.10; a ratio drifting toward 1.0 means the delta path
+    degenerated (digest churn, fallback storms) and
+    ``benchkit/artifacts.py`` flags the movement round-over-round like
+    any other metric.  Parity gate: the reconciled fleets must be
+    byte-identical to the plain full-state merge of the same inputs."""
+    import jax
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.sync.session import SyncSession, sync_pair
+    from crdt_tpu.utils import tracing
+    from crdt_tpu.utils.interning import Universe
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(13)
+    if SMALL:
+        n, a, m, d = 2_000, 16, 8, 2
+    else:
+        n, a, m, d = 62_500, 64, 16, 2
+    divergence = 0.01
+    cfg = CrdtConfig(
+        num_actors=a, member_capacity=m, deferred_capacity=d,
+        counter_bits=32,
+    )
+    uni = Universe.identity(cfg)
+
+    import jax.numpy as jnp
+
+    reps = anti_entropy_fleets(
+        rng, n, a, m, d, 1, base=min(4, m - 2), novel=0, deferred_frac=0.25,
+    )
+    fleet_a = OrswotBatch(*(jnp.asarray(x) for x in reps[0]))
+    # canonicalize: testdata plants some already-applicable deferred
+    # removes straight into the planes; one plunger self-merge flushes
+    # them so merge is idempotent on the fleet and the byte-parity gate
+    # below compares like with like
+    fleet_a = fleet_a.merge(fleet_a)
+    # replica B: same state, plus local ops on a 1% row sample — the
+    # per-round divergence the digest exchange must localize
+    k = max(1, int(n * divergence))
+    rows = np.sort(rng.choice(n, size=k, replace=False)).astype(np.int64)
+    sub = jax.tree_util.tree_map(lambda p: p[rows], fleet_a)
+    counters = jnp.max(sub.clock, axis=-1) + 1
+    sub = sub.apply_add(
+        np.zeros(k, np.int32), counters,
+        np.full(k, 1 << 20, np.int32),
+    )
+    fleet_b = jax.tree_util.tree_map(
+        lambda p, s: p.at[rows].set(s), fleet_a, sub
+    )
+
+    # full-state reference: what the pre-sync protocol ships each round
+    full_bytes = sum(len(b) for b in fleet_a.to_wire(uni))
+
+    counters0 = tracing.counters()
+    sa = SyncSession(fleet_a, uni)
+    sb = SyncSession(fleet_b, uni)
+    t0 = time.perf_counter()
+    ra, rb = sync_pair(sa, sb)
+    wall = time.perf_counter() - t0
+    deltas = tracing.counters_since(counters0)
+
+    assert ra.converged and rb.converged, "sync session did not converge"
+    # parity gate: the reconciled fleets must equal the full-state merge
+    # byte-for-byte (sampled to keep the gate cheap at full scale)
+    ref = fleet_a.merge(fleet_b)
+    sample = np.concatenate([rows[:8], np.arange(min(8, n))])
+    from crdt_tpu.sync.delta import gather_blobs
+
+    want = gather_blobs(ref, sample, uni)
+    assert gather_blobs(sa.batch, sample, uni) == want, (
+        "sync parity: session fleet != full-state merge (peer A)"
+    )
+    assert gather_blobs(sb.batch, sample, uni) == want, (
+        "sync parity: session fleet != full-state merge (peer B)"
+    )
+
+    payload_bytes = ra.delta_bytes_sent + ra.full_bytes_sent
+    ratio = tracing.delta_ratio(payload_bytes, full_bytes)
+    log(
+        f"sync: {n} objects, {ra.diverged} diverged ({divergence:.0%}) -> "
+        f"digest {ra.digest_bytes_sent}B + delta {ra.delta_bytes_sent}B vs "
+        f"full-state {full_bytes}B per round; delta_ratio={ratio:.4f} "
+        f"(wall {wall:.2f}s, fallback={ra.full_state_fallback})"
+    )
+    if ratio is not None and ratio > 0.10:
+        log(
+            f"sync WARNING: delta_ratio {ratio:.3f} > 0.10 at 1% divergence "
+            "— the delta path is degenerating (see PERF.md sync section)"
+        )
+    out = {
+        "sync_objects": n,
+        "sync_diverged_objects": ra.diverged,
+        "sync_delta_ratio": round(ratio, 4) if ratio is not None else None,
+        "sync_digest_bytes": ra.digest_bytes_sent,
+        "sync_delta_bytes": payload_bytes,
+        "sync_full_state_bytes": full_bytes,
+        "sync_wall_s": round(wall, 3),
+        "sync_full_state_fallback": bool(
+            ra.full_state_fallback or rb.full_state_fallback
+        ),
+    }
+    reasons = {k: v for k, v in deltas.items() if ".fallback_reason." in k}
+    if reasons:
+        out["sync_fallback_reasons"] = reasons
+    return out
+
+
 def bench_bandwidth_floor():
     """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
     elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
@@ -1571,6 +1689,12 @@ def main():
     e2e_wire = run_stage("e2e_wire", 120, bench_e2e_wire)
     if e2e_wire is not None:
         emit(**e2e_wire)
+    # budget-skippable by design (required=False): the sync stage is a
+    # contender metric, and must never crowd out the parity anchor or
+    # the TPU validation below
+    sync_res = run_stage("sync", 60, bench_sync)
+    if sync_res is not None:
+        emit(**sync_res)
     # provisional regression tail first: a watchdog kill inside the
     # required validation stage below must not cost the field entirely
     _emit_regression_warnings(quiet=True)

@@ -32,8 +32,11 @@ _IGNORE = {
 # downshift decision moves every one of them ~Nx without any real
 # regression.  The scale-free rates/fractions computed from them are
 # the comparable metrics (the satellite's motivating misses —
-# ingest_obj_per_sec, egress_wire_obj_per_sec — are rates).
-_IGNORE_SUFFIXES = ("_objects", "_chunks", "_s")
+# ingest_obj_per_sec, egress_wire_obj_per_sec — are rates).  `_bytes`
+# totals (the sync stage's per-phase wire accounting) scale with the
+# fleet size the same way; their scale-free form is sync_delta_ratio,
+# which IS compared.
+_IGNORE_SUFFIXES = ("_objects", "_chunks", "_s", "_bytes")
 
 
 def latest_prior_artifact(root: str) -> Tuple[Optional[str], Optional[dict]]:

@@ -37,6 +37,22 @@ def enable_x64() -> bool:
     return _X64_ENABLED
 
 
+def x64_disabled():
+    """Context manager forcing 32-bit trace mode for a kernel trace
+    (Mosaic has no 64-bit support — Python-int literals must not become
+    i64[] operands).  ``jax.enable_x64(False)`` on new jax,
+    ``jax.experimental.disable_x64()`` on 0.4.x, where ``jax.enable_x64``
+    does not exist."""
+    import jax
+
+    try:
+        return jax.enable_x64(False)
+    except AttributeError:
+        from jax.experimental import disable_x64
+
+        return disable_x64()
+
+
 def counter_dtype(config=None):
     """The dtype used for dense counters.
 

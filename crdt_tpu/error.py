@@ -94,3 +94,16 @@ class NestedOpFailed(CrdtError):
 
     def __str__(self) -> str:
         return "We failed to apply a nested op to a nested CRDT"
+
+
+class SyncProtocolError(CrdtError):
+    """An anti-entropy sync frame or session violated the protocol.
+
+    No reference counterpart — the reference ships no transport
+    (`lib.rs:62-83`); this covers the sync layer built above the wire
+    codec (:mod:`crdt_tpu.sync`): version mismatches, truncated or
+    CRC-failing frames, fleet-size disagreements, and sessions that
+    fail to converge after the full-state retry.  Deliberately NOT a
+    ``ValueError``: a malformed peer frame is an I/O-boundary fault to
+    catch and drop, not a local programming error.
+    """

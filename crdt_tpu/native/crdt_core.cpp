@@ -1366,6 +1366,8 @@ DEFINE_ALL(u64, uint64_t)
 //     Map<K, MVReg> and Map<K, Orswot> wire codecs (wire_ingest.cpp)
 // v9: orswot_ingest_wire grows a trailing `clear` flag (self-clearing
 //     rows for reused staging buffers — the pipelined wire loop)
-int crdt_core_abi_version() { return 9; }
+// v10: + orswot_encode_wire_rows (indexed encode of selected fleet rows
+//     — the delta anti-entropy gather path, wire_ingest.cpp)
+int crdt_core_abi_version() { return 10; }
 
 }  // extern "C"

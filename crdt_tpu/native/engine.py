@@ -701,6 +701,44 @@ def orswot_encode_wire(clock, ids, dots, d_ids, d_clocks):
     return buf, offsets
 
 
+def orswot_encode_wire_rows(clock, ids, dots, d_ids, d_clocks, rows):
+    """Indexed wire ENCODE (native ABI v10): serialize only the fleet
+    rows named by ``rows`` (int64 indices), straight from the full dense
+    planes — the delta anti-entropy gather path
+    (:mod:`crdt_tpu.sync.delta`).  Byte-identical to gathering the rows
+    into compact planes and calling :func:`orswot_encode_wire`, without
+    the gather copy.
+
+    Returns ``(buf, offsets)``: concatenated blobs + int64[k+1]
+    boundaries, in ``rows`` order."""
+    clock, ids, dots, d_ids, d_clocks = _contig(
+        clock, ids, dots, d_ids, d_clocks
+    )
+    dt = _check_counters(clock, dots, d_clocks)
+    n, a = clock.shape
+    m = ids.shape[-1]
+    d = d_ids.shape[-1]
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    if rows.size and (rows.min() < 0 or rows.max() >= n):
+        raise ValueError(
+            f"orswot_encode_wire_rows: row indices must lie in [0, {n}); "
+            f"got [{int(rows.min())}, {int(rows.max())}]"
+        )
+    k = rows.shape[0]
+    offsets = np.zeros(k + 1, dtype=np.int64)
+    fn = _fn("orswot_encode_wire_rows", dt)
+    args = (
+        _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
+        _ptr(rows), ctypes.c_int64(k), ctypes.c_int64(a),
+        ctypes.c_int64(m), ctypes.c_int64(d),
+    )
+    fn(*args, _ptr(offsets), None)
+    np.cumsum(offsets, out=offsets)
+    buf = np.empty(int(offsets[-1]), dtype=np.uint8)
+    fn(*args, _ptr(offsets), _ptr(buf))
+    return buf, offsets
+
+
 def mvreg_ingest_wire(buf, offsets, k: int, a: int, dtype):
     """Parallel MVReg wire decode (see :func:`orswot_ingest_wire` for the
     buffer/status conventions).  Returns ``(clocks, vals, status)``."""

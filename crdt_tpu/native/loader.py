@@ -80,7 +80,7 @@ def load() -> ctypes.CDLL:
             if err is not None:
                 _build_error = f"{err} (initial load error: {first})"
                 raise RuntimeError(_build_error)
-        if lib.crdt_core_abi_version() != 9:
+        if lib.crdt_core_abi_version() != 10:
             _build_error = "native ABI version mismatch; run make clean"
             raise RuntimeError(_build_error)
         _lib = lib

@@ -869,6 +869,67 @@ void orswot_encode_wire_u64(const uint64_t* clock, const int32_t* ids,
 
 }  // extern "C"
 
+// ---- v10: indexed (gathered) ORSWOT encode --------------------------------
+//
+// Delta anti-entropy ships only diverged rows (crdt_tpu/sync/delta.py).
+// Encoding k selected rows of an n-row fleet straight from the fleet
+// planes skips the gather copy a compact sub-plane set would cost per
+// delta frame.  Same two-pass contract as encode_impl: nullptr buf is
+// the sizing pass (offsets[1..k] get per-row sizes, caller prefix-sums),
+// the write pass fills buf at offsets[i].
+
+template <typename C>
+void encode_rows_impl(const C* clock, const int32_t* ids, const C* dots,
+                      const int32_t* d_ids, const C* d_clocks,
+                      const int64_t* rows, int64_t k, int64_t A, int64_t M,
+                      int64_t D, int64_t* offsets, uint8_t* buf) {
+  if (buf == nullptr) {
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024)
+#endif
+    for (int64_t i = 0; i < k; ++i) {
+      const int64_t r = rows[i];
+      offsets[i + 1] = encode_one<C>(clock + r * A, ids + r * M,
+                                     dots + r * M * A, d_ids + r * D,
+                                     d_clocks + r * D * A, A, M, D, nullptr);
+    }
+    return;
+  }
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(dynamic, 1024)
+#endif
+  for (int64_t i = 0; i < k; ++i) {
+    const int64_t r = rows[i];
+    encode_one<C>(clock + r * A, ids + r * M, dots + r * M * A,
+                  d_ids + r * D, d_clocks + r * D * A, A, M, D,
+                  buf + offsets[i]);
+  }
+}
+
+extern "C" {
+
+void orswot_encode_wire_rows_u32(const uint32_t* clock, const int32_t* ids,
+                                 const uint32_t* dots, const int32_t* d_ids,
+                                 const uint32_t* d_clocks,
+                                 const int64_t* rows, int64_t k, int64_t A,
+                                 int64_t M, int64_t D, int64_t* offsets,
+                                 uint8_t* buf) {
+  encode_rows_impl<uint32_t>(clock, ids, dots, d_ids, d_clocks, rows, k, A,
+                             M, D, offsets, buf);
+}
+
+void orswot_encode_wire_rows_u64(const uint64_t* clock, const int32_t* ids,
+                                 const uint64_t* dots, const int32_t* d_ids,
+                                 const uint64_t* d_clocks,
+                                 const int64_t* rows, int64_t k, int64_t A,
+                                 int64_t M, int64_t D, int64_t* offsets,
+                                 uint8_t* buf) {
+  encode_rows_impl<uint64_t>(clock, ids, dots, d_ids, d_clocks, rows, k, A,
+                             M, D, offsets, buf);
+}
+
+}  // extern "C"
+
 extern "C" {
 
 int64_t orswot_ingest_wire_u32(const uint8_t* buf, const int64_t* offsets,

@@ -62,6 +62,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..config import x64_disabled
+
+# jax 0.4.x spells pltpu.CompilerParams `TPUCompilerParams`
+_compiler_params = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 from .orswot_pallas import (
     EMPTY,
     ZERO,
@@ -377,14 +383,14 @@ def fold_merge(
         jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
     # 32-bit trace mode — see orswot_pallas.merge
-    with jax.enable_x64(False):
+    with x64_disabled():
         out = pl.pallas_call(
             kernel,
             grid=(n_pad // t,),
             in_specs=in_specs,
             out_specs=_state_specs(t, [s.shape for s in out_shape]),
             out_shape=out_shape,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 vmem_limit_bytes=_VMEM_LIMIT_BYTES
             ),
             interpret=interpret,

@@ -73,6 +73,12 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..config import x64_disabled
+
+# jax 0.4.x spells pltpu.CompilerParams `TPUCompilerParams`
+_compiler_params = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 EMPTY = -1
 # biased-int32 representation of counter 0 (see module docstring): the
 # kernel-internal "absent / empty clock lane" sentinel
@@ -465,14 +471,14 @@ def merge(
     # Python-int literal (the `0`s in jnp.where etc.) becomes an i64[]
     # scalar operand, and Mosaic has no 64-bit support — its convert
     # helper recurses forever on the i64→i32 truncation
-    with jax.enable_x64(False):
+    with x64_disabled():
         out = pl.pallas_call(
             kernel,
             grid=(n_pad // t,),
             in_specs=_state_specs(t, in_shapes),
             out_specs=_state_specs(t, [s.shape for s in out_shape]),
             out_shape=out_shape,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 vmem_limit_bytes=_VMEM_LIMIT_BYTES
             ),
             interpret=interpret,
@@ -597,14 +603,14 @@ def fold_merge(
         jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
     # 32-bit trace mode — see the matching comment in merge()
-    with jax.enable_x64(False):
+    with x64_disabled():
         out = pl.pallas_call(
             kernel,
             grid=(n_pad // t,),
             in_specs=in_specs,
             out_specs=_state_specs(t, [s.shape for s in out_shape]),
             out_shape=out_shape,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 vmem_limit_bytes=_VMEM_LIMIT_BYTES
             ),
             interpret=interpret,

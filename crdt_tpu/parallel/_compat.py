@@ -1,0 +1,28 @@
+"""JAX API-skew shims for the parallel layer.
+
+``shard_map`` moved twice across the jax versions this repo must run
+on: new releases export it at the top level with a ``check_vma``
+keyword; 0.4.x ships it under ``jax.experimental.shard_map`` with the
+same knob spelled ``check_rep``.  Callers here write the modern
+spelling and this shim translates downward, so the collectives code
+stays single-source.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, `check_vma` spelling
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental home, `check_rep` spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` under either API generation; accepts the
+    modern ``check_vma`` keyword everywhere."""
+    if "check_vma" in kwargs and _CHECK_KW != "check_vma":
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

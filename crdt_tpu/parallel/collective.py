@@ -27,7 +27,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ._compat import shard_map
 
 from ..error import CapacityOverflowError, raise_for_overflow
 from ..ops import orswot_ops

@@ -1,0 +1,63 @@
+"""Digest-driven delta anti-entropy — the protocol layer above the wire codec.
+
+Every replication leg before this package shipped the FULL wire blob of
+every object every round (``examples/replicate_tcp.py``, the pipelined
+wire loop), so bandwidth was O(total state) even when two replicas
+differed in a handful of dots.  The reference deliberately ships no
+transport ("serialize, transport however you like",
+`/root/reference/src/lib.rs:62-83`); delta-state CRDTs (Almeida, Shoker
+& Baquero) and Merkle-style anti-entropy as deployed in Riak — the
+lineage of this reference — give the standard answer: summarize,
+compare, then ship only the diff.  Three pieces:
+
+* :mod:`crdt_tpu.sync.digest` — batched, jit-able per-object
+  fingerprints computed straight from the dense planes (one u64 lane
+  per object), plus a per-fleet version-vector summary: "what differs"
+  for a 1M-object fleet is one kernel launch and a ~8 MB exchange.
+* :mod:`crdt_tpu.sync.delta` — the versioned frame codec (digest /
+  delta / full-state frames, CRC-guarded) and the delta gather/apply
+  paths; delta ingest reuses the native ``out=`` warm-buffer parse.
+* :mod:`crdt_tpu.sync.session` — :class:`SyncSession`, the two-phase
+  digest-exchange → delta-exchange → converged-check protocol with a
+  full-state fallback and per-phase wire counters.
+"""
+
+from .digest import (  # noqa: F401
+    counter_digest,
+    digest_of,
+    fleet_summary,
+    lww_digest,
+    orswot_digest,
+    version_vector,
+)
+from .delta import (  # noqa: F401
+    PROTOCOL_VERSION,
+    OrswotDeltaApplier,
+    decode_frame,
+    diverged_indices,
+    encode_delta_frame,
+    encode_digest_frame,
+    encode_full_frame,
+    gather_blobs,
+)
+from .session import SyncReport, SyncSession, queue_transport  # noqa: F401
+
+__all__ = [
+    "PROTOCOL_VERSION",
+    "OrswotDeltaApplier",
+    "SyncReport",
+    "SyncSession",
+    "counter_digest",
+    "decode_frame",
+    "digest_of",
+    "diverged_indices",
+    "encode_delta_frame",
+    "encode_digest_frame",
+    "encode_full_frame",
+    "fleet_summary",
+    "gather_blobs",
+    "lww_digest",
+    "orswot_digest",
+    "queue_transport",
+    "version_vector",
+]

@@ -1,0 +1,399 @@
+"""Delta frames: versioned wire envelopes + diverged-row gather/apply.
+
+The sync protocol moves three frame kinds between peers — digest
+vectors, delta payloads (object ids + their wire blobs), and full-state
+payloads.  Every frame leads with a 1-byte protocol version so
+mixed-version peers fail loudly (:class:`crdt_tpu.error.
+SyncProtocolError`) instead of misparsing, and carries a CRC32 of its
+payload so truncation/tampering is a clean rejection, not a crash in
+the blob parser.
+
+Frame layout (all little-endian)::
+
+    version(1) | type(1) | crc32(4) | payload_len(8) | payload
+
+The gather side encodes only diverged rows — through the native
+indexed encoder (``orswot_encode_wire_rows``, ABI v10) when it applies,
+so the fleet planes are never copied just to serialize 1% of them.  The
+apply side parses delta blobs into REUSED staging planes
+(``engine.orswot_ingest_wire(..., out=)`` — the same warm-buffer path
+that fixed the e2e ingest collapse, PERF.md) and scatter-merges the
+rows into the local fleet.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+from ..error import SyncProtocolError
+
+#: bumped whenever the frame grammar changes; peers with different
+#: versions must fail loudly at the first frame, never misparse
+PROTOCOL_VERSION = 1
+
+FRAME_DIGEST = 0x01
+FRAME_DELTA = 0x02
+FRAME_FULL = 0x03
+
+_FRAME_NAMES = {FRAME_DIGEST: "digest", FRAME_DELTA: "delta",
+                FRAME_FULL: "full"}
+_HEADER = struct.Struct("<BBIQ")
+
+
+def _frame(ftype: int, payload: bytes) -> bytes:
+    return _HEADER.pack(
+        PROTOCOL_VERSION, ftype, zlib.crc32(payload), len(payload)
+    ) + payload
+
+
+def decode_frame(frame: bytes) -> tuple[int, bytes]:
+    """``(frame_type, payload)`` of a validated frame.  Raises
+    :class:`SyncProtocolError` on a version mismatch, unknown frame
+    type, truncated/overlong frame, or CRC mismatch — the caller never
+    sees a payload that could misparse downstream."""
+    if len(frame) < _HEADER.size:
+        raise SyncProtocolError(
+            f"truncated sync frame: {len(frame)} bytes < "
+            f"{_HEADER.size}-byte header"
+        )
+    version, ftype, crc, plen = _HEADER.unpack_from(frame)
+    if version != PROTOCOL_VERSION:
+        raise SyncProtocolError(
+            f"sync protocol version mismatch: peer sent v{version}, "
+            f"this build speaks v{PROTOCOL_VERSION}"
+        )
+    if ftype not in _FRAME_NAMES:
+        raise SyncProtocolError(f"unknown sync frame type {ftype:#04x}")
+    payload = frame[_HEADER.size:]
+    if len(payload) != plen:
+        raise SyncProtocolError(
+            f"sync frame length mismatch: header says {plen} payload "
+            f"bytes, frame carries {len(payload)}"
+        )
+    if zlib.crc32(payload) != crc:
+        raise SyncProtocolError(
+            f"sync {_FRAME_NAMES[ftype]} frame CRC mismatch "
+            "(tampered or corrupted in transit)"
+        )
+    return ftype, payload
+
+
+# ---- digest frames ---------------------------------------------------------
+
+
+def encode_digest_frame(digests: np.ndarray,
+                        version_vec: np.ndarray | None = None) -> bytes:
+    """A DIGEST frame: the per-object u64 digest vector plus the
+    (possibly empty) per-fleet version-vector summary."""
+    d = np.ascontiguousarray(digests, dtype="<u8")
+    vv = np.ascontiguousarray(
+        version_vec if version_vec is not None else np.zeros(0), dtype="<u8"
+    ).reshape(-1)
+    payload = (
+        struct.pack("<Q", d.shape[0]) + d.tobytes()
+        + struct.pack("<I", vv.shape[0]) + vv.tobytes()
+    )
+    return _frame(FRAME_DIGEST, payload)
+
+
+def decode_digest_payload(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
+    """``(digests u64[n], version_vector u64[v])`` from a DIGEST
+    payload."""
+    try:
+        (n,) = struct.unpack_from("<Q", payload, 0)
+        off = 8
+        d = np.frombuffer(payload, dtype="<u8", count=n, offset=off)
+        off += 8 * n
+        (v,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        vv = np.frombuffer(payload, dtype="<u8", count=v, offset=off)
+        if off + 8 * v != len(payload):
+            raise ValueError("trailing bytes")
+    except (struct.error, ValueError) as e:
+        raise SyncProtocolError(f"malformed digest payload: {e}") from None
+    return d.astype(np.uint64), vv.astype(np.uint64)
+
+
+# ---- delta / full-state frames ---------------------------------------------
+
+
+def _pack_blobs(blobs) -> bytes:
+    parts = []
+    for b in blobs:
+        parts.append(struct.pack("<I", len(b)))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _unpack_blobs(payload: bytes, off: int, count: int) -> list[bytes]:
+    out = []
+    view = memoryview(payload)
+    for _ in range(count):
+        if off + 4 > len(payload):
+            raise SyncProtocolError(
+                "malformed sync payload: blob length field truncated"
+            )
+        (ln,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        if off + ln > len(payload):
+            raise SyncProtocolError(
+                f"malformed sync payload: blob of {ln} bytes overruns frame"
+            )
+        out.append(bytes(view[off:off + ln]))
+        off += ln
+    if off != len(payload):
+        raise SyncProtocolError(
+            f"malformed sync payload: {len(payload) - off} trailing bytes"
+        )
+    return out
+
+
+def encode_delta_frame(fleet_n: int, ids: np.ndarray, blobs) -> bytes:
+    """A DELTA frame: the diverged object ids and their wire blobs, in
+    id order.  ``fleet_n`` rides along so a peer with a different fleet
+    size rejects cleanly."""
+    ids = np.ascontiguousarray(ids, dtype="<u8")
+    if ids.shape[0] != len(blobs):
+        raise ValueError(
+            f"delta frame: {ids.shape[0]} ids vs {len(blobs)} blobs"
+        )
+    payload = (
+        struct.pack("<QQ", fleet_n, ids.shape[0]) + ids.tobytes()
+        + _pack_blobs(blobs)
+    )
+    return _frame(FRAME_DELTA, payload)
+
+
+def decode_delta_payload(payload: bytes) -> tuple[int, np.ndarray, list[bytes]]:
+    """``(fleet_n, ids int64[k], blobs)`` from a DELTA payload."""
+    try:
+        fleet_n, k = struct.unpack_from("<QQ", payload, 0)
+        ids = np.frombuffer(payload, dtype="<u8", count=k, offset=16)
+    except (struct.error, ValueError) as e:
+        raise SyncProtocolError(f"malformed delta payload: {e}") from None
+    blobs = _unpack_blobs(payload, 16 + 8 * k, k)
+    return int(fleet_n), ids.astype(np.int64), blobs
+
+
+def encode_full_frame(blobs) -> bytes:
+    """A FULL frame: every object's wire blob, in object order — the
+    fallback when divergence is wide or digests disagree after a delta
+    pass."""
+    payload = struct.pack("<Q", len(blobs)) + _pack_blobs(blobs)
+    return _frame(FRAME_FULL, payload)
+
+
+def decode_full_payload(payload: bytes) -> list[bytes]:
+    try:
+        (n,) = struct.unpack_from("<Q", payload, 0)
+    except struct.error as e:
+        raise SyncProtocolError(f"malformed full-state payload: {e}") from None
+    return _unpack_blobs(payload, 8, n)
+
+
+# ---- diverged-row gather ---------------------------------------------------
+
+
+def diverged_indices(mine: np.ndarray, theirs: np.ndarray) -> np.ndarray:
+    """Ascending object indices where the two digest vectors disagree.
+    Both peers compute the SAME set from the exchanged vectors, which is
+    what keeps the lock-step protocol deadlock-free."""
+    mine = np.asarray(mine, dtype=np.uint64)
+    theirs = np.asarray(theirs, dtype=np.uint64)
+    if mine.shape != theirs.shape:
+        raise SyncProtocolError(
+            f"digest vector shape mismatch: {mine.shape} vs {theirs.shape} "
+            "(peers must sync equal-sized fleets)"
+        )
+    return np.nonzero(mine != theirs)[0].astype(np.int64)
+
+
+def _tree_gather(batch, ids: np.ndarray):
+    """``batch[ids]`` across every plane — batches are flax pytrees, so
+    one tree_map covers all types."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda p: p[ids], batch)
+
+
+def gather_blobs(batch, ids: np.ndarray, universe) -> list[bytes]:
+    """Wire blobs of the fleet rows named by ``ids``, byte-identical to
+    ``batch.to_wire(universe)`` restricted to those rows.
+
+    OrswotBatch with an identity universe takes the native indexed
+    encoder (ABI v10) — no gather copy of the planes; everything else
+    (other types, non-identity universes, pre-v10 engines, the u64
+    zigzag guard) gathers the rows and uses the type's own ``to_wire``.
+    """
+    from ..batch.orswot_batch import OrswotBatch
+    from ..batch.wirebulk import (
+        counters_overflow_zigzag, probe_engine, record_wire, slice_blobs,
+    )
+    from ..config import counter_dtype
+
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    if ids.size == 0:
+        return []
+    if isinstance(batch, OrswotBatch):
+        engine = probe_engine(
+            universe, "orswot_encode_wire_rows", counter_dtype(universe.config)
+        )
+        if engine is not None:
+            planes = tuple(
+                np.asarray(x)
+                for x in (batch.clock, batch.ids, batch.dots,
+                          batch.d_ids, batch.d_clocks)
+            )
+            if not counters_overflow_zigzag(
+                (planes[0], planes[2], planes[4])
+            ):
+                buf, offsets = engine.orswot_encode_wire_rows(*planes, ids)
+                record_wire("orswot", "to_wire", native=ids.size)
+                return slice_blobs(buf, offsets)
+    return _tree_gather(batch, ids).to_wire(universe)
+
+
+# ---- delta apply -----------------------------------------------------------
+
+
+def _next_pow2(c: int) -> int:
+    return 1 if c <= 0 else 1 << (c - 1).bit_length()
+
+
+class OrswotDeltaApplier:
+    """Scatter-merge delta rows into an ORSWOT fleet through warm
+    buffers.
+
+    One instance owns two reusable plane sets sized to the largest delta
+    seen (power-of-two rows): a parse staging set handed to
+    ``engine.orswot_ingest_wire(..., out=)`` — the allocation-churn fix
+    the pipelined wire loop is built on — and a merge output set for the
+    native row merge.  A session applies one delta per sync, but a
+    long-lived endpoint syncing every round reuses the same buffers
+    forever.
+
+    Falls back to the jnp path (``from_wire`` + batch merge +
+    ``.at[ids].set``) when the native engine or identity universe is
+    unavailable; results are identical either way (the parity tests pin
+    this)."""
+
+    def __init__(self, universe):
+        self.universe = universe
+        self._cap = 0
+        self._staging = None
+        self._merge_out = None
+
+    def _plane_set(self, n: int) -> tuple:
+        from ..config import counter_dtype
+
+        cfg = self.universe.config
+        dt = counter_dtype(cfg)
+        a, m, d = cfg.num_actors, cfg.member_capacity, cfg.deferred_capacity
+        return (
+            np.zeros((n, a), dtype=dt),
+            np.full((n, m), -1, dtype=np.int32),
+            np.zeros((n, m, a), dtype=dt),
+            np.full((n, d), -1, dtype=np.int32),
+            np.zeros((n, d, a), dtype=dt),
+        )
+
+    def _buffers(self, k: int) -> tuple[tuple, tuple]:
+        cap = _next_pow2(k)
+        if cap > self._cap:
+            self._cap = cap
+            self._staging = self._plane_set(cap)
+            self._merge_out = self._plane_set(cap)
+        # leading-axis slices of C-contiguous planes stay C-contiguous,
+        # so the exact-(k, ...) shape contract of out= holds
+        return (
+            tuple(p[:k] for p in self._staging),
+            tuple(p[:k] for p in self._merge_out),
+        )
+
+    def apply(self, batch, ids: np.ndarray, blobs) -> "object":
+        """``batch`` with ``merge(local_row, peer_row)`` applied at every
+        ``ids`` row; peer rows decoded from ``blobs``.  Raises
+        :class:`crdt_tpu.error.CapacityOverflowError` when a row union
+        outgrows the padded capacities (the caller regrows and retries,
+        as any merge path)."""
+        import jax.numpy as jnp
+
+        from ..batch.orswot_batch import OrswotBatch
+        from ..batch.wirebulk import orswot_planes_from_wire, probe_engine
+        from ..config import counter_dtype
+        from ..error import raise_for_overflow
+
+        ids = np.ascontiguousarray(ids, dtype=np.int64)
+        k = len(blobs)
+        if k != ids.shape[0]:
+            raise SyncProtocolError(
+                f"delta apply: {ids.shape[0]} ids vs {k} blobs"
+            )
+        if k == 0:
+            return batch
+        n = batch.clock.shape[0]
+        if ids.min() < 0 or ids.max() >= n:
+            raise SyncProtocolError(
+                f"delta apply: object id outside fleet [0, {n})"
+            )
+        engine = probe_engine(
+            self.universe, "orswot_merge", counter_dtype(self.universe.config)
+        )
+        if engine is not None:
+            staging, merge_out = self._buffers(k)
+            peer = orswot_planes_from_wire(blobs, self.universe, out=staging)
+            if peer is not None:
+                local = tuple(
+                    np.ascontiguousarray(np.asarray(p)[ids])
+                    for p in (batch.clock, batch.ids, batch.dots,
+                              batch.d_ids, batch.d_clocks)
+                )
+                res = engine.orswot_merge(*local, *peer, out=merge_out)
+                raise_for_overflow(res[5], "delta apply")
+                host = [
+                    np.array(np.asarray(p))
+                    for p in (batch.clock, batch.ids, batch.dots,
+                              batch.d_ids, batch.d_clocks)
+                ]
+                for dst, src in zip(host, res[:5]):
+                    dst[ids] = src
+                return OrswotBatch(*(jnp.asarray(h) for h in host))
+        # jnp route: parse (Python codec if need be), merge the gathered
+        # rows on device, scatter back
+        sub_peer = OrswotBatch.from_wire(blobs, self.universe)
+        sub_local = _tree_gather(batch, ids)
+        merged = sub_local.merge(sub_peer)
+        return OrswotBatch(
+            clock=batch.clock.at[ids].set(merged.clock),
+            ids=batch.ids.at[ids].set(merged.ids),
+            dots=batch.dots.at[ids].set(merged.dots),
+            d_ids=batch.d_ids.at[ids].set(merged.d_ids),
+            d_clocks=batch.d_clocks.at[ids].set(merged.d_clocks),
+        )
+
+
+def apply_delta_rows(batch, ids: np.ndarray, blobs, universe,
+                     applier: OrswotDeltaApplier | None = None):
+    """Generic scatter-merge for any fleet batch type: decode the peer's
+    delta rows, merge them with the gathered local rows, scatter the
+    result back.  ORSWOT fleets route through ``applier`` (or a
+    transient one) for the warm-buffer native path."""
+    import jax
+
+    from ..batch.orswot_batch import OrswotBatch
+
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    if ids.size == 0:
+        return batch
+    if isinstance(batch, OrswotBatch):
+        if applier is None:
+            applier = OrswotDeltaApplier(universe)
+        return applier.apply(batch, ids, blobs)
+    sub_peer = type(batch).from_wire(blobs, universe)
+    merged = _tree_gather(batch, ids).merge(sub_peer)
+    return jax.tree_util.tree_map(
+        lambda p, s: p.at[ids].set(s), batch, merged
+    )

@@ -1,0 +1,272 @@
+"""Batched per-object fingerprints computed from the dense planes.
+
+The point of a digest is "what differs" without shipping state: one u64
+lane per object, computed as one jitted kernel launch over the SoA
+planes — no per-object host loop, no scalar objects.  Two replicas
+exchange digest vectors (~8 MB per 1M objects) and only diverged rows
+ride the wire (:mod:`crdt_tpu.sync.delta`).
+
+Canonicality: a digest must depend only on the CRDT *state*, never on
+its dense representation.  The planes are canonical only up to slot
+order (the host wire route preserves wire order; the device COO route
+re-packs ascending by member id) and up to capacity padding
+(``with_capacity`` grows the slot axes).  Every cell therefore hashes
+to a lane keyed by its *semantic* coordinates (actor index, member id,
+counter, plane tag) — never its slot — and lanes combine by XOR, which
+is order- and padding-invariant (empty cells contribute the XOR
+identity 0).
+
+Collisions exist by construction (64-bit fingerprints of larger
+states); the session protocol treats digest equality as a fast path
+only and falls back to full-state exchange when a post-delta verify
+pass disagrees (:class:`crdt_tpu.sync.session.SyncSession`).
+
+Shared-universe requirement: lanes key on the INTERNED actor index and
+member id, so two peers' digests are comparable only when they assign
+the same indices to the same actors/members.  Identity universes — the
+bulk-path mode every replication example uses — satisfy this by
+construction (index == value).  Interned (non-identity) universes only
+compare across processes when the peers' interning order matches;
+in-process sessions sharing one ``Universe`` are always safe.
+(ROADMAP: name-keyed digest salts would lift this.)
+
+Counter width note: mixing runs in u64 when x64 is enabled (the batch
+package enables it at import) and degrades to 32-bit mixing under
+``CRDT_TPU_NO_X64`` — both peers of a session must run the same mode,
+which the frame codec's version byte does not police (it polices the
+protocol, not the build); a width mismatch surfaces as a permanent
+digest mismatch and the session's full-state fallback still converges.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# plane tags keep the per-plane lane families disjoint: a clock dot
+# (a, c) and a member dot (m, a, c) with colliding coordinates must not
+# cancel under XOR
+_T_CLOCK = 0x9E3779B97F4A7C15
+_T_ENTRY = 0xC2B2AE3D27D4EB4F
+_T_DOT = 0x165667B19E3779F9
+_T_DREF = 0x27D4EB2F165667C5
+_T_DCLK = 0x85EBCA77C2B2AE63
+_T_COUNTER = 0x2545F4914F6CDD1D
+_T_LWW = 0x9E3779B185EBCA87
+
+_K1 = 0xFF51AFD7ED558CCD  # actor-lane multiplier
+_K2 = 0xC4CEB9FE1A85EC53  # member-lane multiplier
+
+
+def _digest_dtype():
+    """u64 lanes when 64-bit types are live, u32 otherwise (see module
+    docstring — both peers must agree, and they do when they share the
+    build mode)."""
+    import jax.numpy as jnp
+
+    from ..config import enable_x64
+
+    return jnp.uint64 if enable_x64() else jnp.uint32
+
+
+def _mix(x, dt):
+    """SplitMix64 finalizer (u64) / Murmur3 fmix32 (u32) — the avalanche
+    step that turns structured coordinate keys into uniform lanes."""
+    import jax.numpy as jnp
+
+    if dt == jnp.uint64:
+        x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        return x ^ (x >> 31)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def _const(v, dt):
+    import jax.numpy as jnp
+
+    return dt(v & 0xFFFFFFFFFFFFFFFF) if dt == jnp.uint64 else dt(v & 0xFFFFFFFF)
+
+
+def _lane(value, key, tag, dt):
+    """One cell's lane: mix the coordinate key, fold the counter value
+    in, mix again.  ``value`` 0 is handled by the caller's mask."""
+    return _mix(value.astype(dt) ^ _mix(key + _const(tag, dt), dt), dt)
+
+
+def _jit(fn):
+    import jax
+
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _orswot_kernel():
+    import jax.numpy as jnp
+
+    from ..ops import orswot_ops
+
+    dt = _digest_dtype()
+
+    def kernel(clock, ids, dots, d_ids, d_clocks):
+        a = clock.shape[-1]
+        aix = jnp.arange(a).astype(dt) * _const(_K1, dt)
+        # set clock: lanes keyed by actor, masked to witnessed dots
+        h = _lane(clock, aix, _T_CLOCK, dt)
+        out = jnp.bitwise_xor.reduce(
+            jnp.where(clock != 0, h, dt(0)), axis=-1
+        )
+        # member entries + their dot clocks: keyed by MEMBER ID (slot
+        # order is representation, not state)
+        live = ids != orswot_ops.EMPTY
+        mkey = ids.astype(dt) * _const(_K2, dt)
+        he = _mix(mkey + _const(_T_ENTRY, dt), dt)
+        out = out ^ jnp.bitwise_xor.reduce(
+            jnp.where(live, he, dt(0)), axis=-1
+        )
+        hd = _lane(dots, mkey[..., None] + aix, _T_DOT, dt)
+        out = out ^ jnp.bitwise_xor.reduce(
+            jnp.where(dots != 0, hd, dt(0)), axis=(-2, -1)
+        )
+        # deferred rows: a SET of (member, clock) removes — row index is
+        # representation too
+        dlive = d_ids != orswot_ops.EMPTY
+        dkey = d_ids.astype(dt) * _const(_K2, dt)
+        hq = _mix(dkey + _const(_T_DREF, dt), dt)
+        out = out ^ jnp.bitwise_xor.reduce(
+            jnp.where(dlive, hq, dt(0)), axis=-1
+        )
+        hh = _lane(d_clocks, dkey[..., None] + aix, _T_DCLK, dt)
+        out = out ^ jnp.bitwise_xor.reduce(
+            jnp.where(d_clocks != 0, hh, dt(0)), axis=(-2, -1)
+        )
+        return out
+
+    return _jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _counter_kernel():
+    import jax.numpy as jnp
+
+    dt = _digest_dtype()
+
+    def kernel(planes):
+        n = planes.shape[0]
+        flat = planes.reshape(n, -1)
+        lin = jnp.arange(flat.shape[1]).astype(dt) * _const(_K1, dt)
+        h = _lane(flat, lin, _T_COUNTER, dt)
+        return jnp.bitwise_xor.reduce(
+            jnp.where(flat != 0, h, dt(0)), axis=-1
+        )
+
+    return _jit(kernel)
+
+
+@functools.lru_cache(maxsize=None)
+def _lww_kernel():
+    dt = _digest_dtype()
+
+    def kernel(vals, markers):
+        return _mix(
+            markers.astype(dt)
+            ^ _mix(vals.astype(dt) * _const(_K2, dt) + _const(_T_LWW, dt), dt),
+            dt,
+        )
+
+    return _jit(kernel)
+
+
+def _host_u64(x) -> np.ndarray:
+    """Digest lanes as host ``np.uint64`` (u32 lanes zero-extend, so the
+    frame codec always ships 8-byte lanes)."""
+    return np.asarray(x).astype(np.uint64)
+
+
+def orswot_digest(clock, ids, dots, d_ids, d_clocks) -> np.ndarray:
+    """``uint64[N]`` fingerprints of N ORSWOT states, from the dense
+    planes in one kernel launch.  Slot-order- and capacity-invariant
+    (see module docstring)."""
+    return _host_u64(_orswot_kernel()(clock, ids, dots, d_ids, d_clocks))
+
+
+def counter_digest(planes) -> np.ndarray:
+    """``uint64[N]`` fingerprints of counter-shaped planes — ``[N, A]``
+    (VClock / GCounter) or ``[N, 2, A]`` (PNCounter).  Cell position is
+    semantic here (actor index / P-N plane), so lanes key on the linear
+    cell index; zero cells (absent actors) contribute nothing, keeping
+    the digest invariant to ``num_actors`` padding growth."""
+    return _host_u64(_counter_kernel()(planes))
+
+
+def lww_digest(vals, markers) -> np.ndarray:
+    """``uint64[N]`` fingerprints of N LWW registers (value id +
+    marker)."""
+    return _host_u64(_lww_kernel()(vals, markers))
+
+
+def digest_of(batch) -> np.ndarray:
+    """Per-object digest vector for any supported fleet batch —
+    dispatches on the batch type's planes (OrswotBatch, PNCounterBatch,
+    GCounterBatch, VClockBatch, LWWRegBatch)."""
+    from ..batch.gcounter_batch import GCounterBatch
+    from ..batch.lwwreg_batch import LWWRegBatch
+    from ..batch.orswot_batch import OrswotBatch
+    from ..batch.pncounter_batch import PNCounterBatch
+    from ..batch.vclock_batch import VClockBatch
+
+    if isinstance(batch, OrswotBatch):
+        return orswot_digest(
+            batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks
+        )
+    if isinstance(batch, PNCounterBatch):
+        return counter_digest(batch.planes)
+    if isinstance(batch, (GCounterBatch, VClockBatch)):
+        return counter_digest(batch.clocks)
+    if isinstance(batch, LWWRegBatch):
+        return lww_digest(batch.vals, batch.markers)
+    raise TypeError(
+        f"no digest kernel for {type(batch).__name__} "
+        "(supported: Orswot/PNCounter/GCounter/VClock/LWWReg batches)"
+    )
+
+
+def version_vector(batch) -> np.ndarray | None:
+    """Per-fleet version-vector summary: the pointwise max of every
+    object's clock — ``uint64[A]`` (``[2, A]`` for PNCounter), or None
+    for clockless types (LWW).  A strictly-dominating peer summary means
+    "the peer has seen everything I have"; the session ships it in the
+    digest frame as cheap divergence telemetry."""
+    import jax.numpy as jnp
+
+    from ..batch.gcounter_batch import GCounterBatch
+    from ..batch.lwwreg_batch import LWWRegBatch
+    from ..batch.orswot_batch import OrswotBatch
+    from ..batch.pncounter_batch import PNCounterBatch
+    from ..batch.vclock_batch import VClockBatch
+
+    if isinstance(batch, OrswotBatch):
+        clocks = batch.clock
+    elif isinstance(batch, PNCounterBatch):
+        clocks = batch.planes
+    elif isinstance(batch, (GCounterBatch, VClockBatch)):
+        clocks = batch.clocks
+    elif isinstance(batch, LWWRegBatch):
+        return None
+    else:
+        raise TypeError(f"no version vector for {type(batch).__name__}")
+    if clocks.shape[0] == 0:
+        return np.zeros(clocks.shape[1:], dtype=np.uint64).reshape(-1)
+    return np.asarray(jnp.max(clocks, axis=0)).astype(np.uint64).reshape(-1)
+
+
+def fleet_summary(digests: np.ndarray) -> tuple[int, int]:
+    """``(xor_fold, count)`` of a digest vector — the 16-byte fleet
+    summary two peers can compare before deciding whether the vectors
+    themselves are worth diffing (equal folds + counts almost certainly
+    mean an idempotent re-sync)."""
+    d = np.asarray(digests, dtype=np.uint64)
+    fold = int(np.bitwise_xor.reduce(d)) if d.size else 0
+    return fold, int(d.size)

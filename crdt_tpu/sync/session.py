@@ -1,0 +1,323 @@
+"""SyncSession — the two-phase digest/delta anti-entropy protocol.
+
+One session reconciles one local fleet batch with one peer over an
+abstract byte transport (``send(bytes)`` / ``recv() -> bytes``
+callables — TCP frames, in-process queues, anything ordered and
+reliable).  The protocol is symmetric and lock-step: both peers run the
+same code and every decision (diverged set, delta-vs-full, retry) is a
+pure function of data both sides have already exchanged, so neither
+peer can block waiting for a frame the other will never send.
+
+Phases::
+
+    1. digest exchange   — one jitted kernel + ~8 bytes/object on the
+                           wire; both peers now know the diverged set
+    2. delta exchange    — only diverged rows ship (FULL frame instead
+                           when divergence exceeds ``full_state_
+                           threshold``); scatter-merge through the warm
+                           ``out=`` ingest path
+    3. converged check   — digests recomputed and re-exchanged; on a
+                           mismatch (64-bit collision, digest-mode skew)
+                           the session retries with full state, which
+                           must converge or the sync raises
+
+Wire cost is O(divergence): an idempotent re-sync costs one digest
+exchange and zero delta bytes.  Every phase feeds the always-on
+``wire.sync.*`` counters (:mod:`crdt_tpu.utils.tracing`) so the bench
+artifact reports ``delta_ratio`` next to ``native_fraction``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..error import SyncProtocolError
+from ..utils import tracing
+from . import delta as delta_mod
+from . import digest as digest_mod
+from .delta import (
+    FRAME_DELTA,
+    FRAME_DIGEST,
+    FRAME_FULL,
+    OrswotDeltaApplier,
+    decode_delta_payload,
+    decode_digest_payload,
+    decode_frame,
+    decode_full_payload,
+    diverged_indices,
+    encode_delta_frame,
+    encode_digest_frame,
+    encode_full_frame,
+    gather_blobs,
+)
+
+
+@dataclasses.dataclass
+class SyncReport:
+    """What one peer's side of a sync cost and concluded."""
+
+    objects: int = 0
+    diverged: int = 0              # rows the digest exchange flagged
+    converged: bool = False
+    digest_rounds: int = 0         # digest exchanges (1 clean, 2-3 with verify/retry)
+    full_state_fallback: bool = False  # threshold or verify-retry path
+    delta_objects_sent: int = 0
+    digest_bytes_sent: int = 0
+    delta_bytes_sent: int = 0      # DELTA frames only
+    full_bytes_sent: int = 0       # FULL frames only
+    bytes_received: int = 0
+
+    @property
+    def bytes_sent(self) -> int:
+        return (self.digest_bytes_sent + self.delta_bytes_sent
+                + self.full_bytes_sent)
+
+    def delta_ratio(self, full_state_bytes: int) -> Optional[float]:
+        """Payload bytes this side shipped (delta + any full-state
+        fallback) over what a full-state exchange would have shipped —
+        the O(divergence) claim as one number.  None when the reference
+        size is unknown/zero."""
+        if not full_state_bytes:
+            return None
+        return (self.delta_bytes_sent + self.full_bytes_sent) / full_state_bytes
+
+
+class SyncSession:
+    """Reconcile ``batch`` with one peer; the converged fleet is
+    ``session.batch`` after :meth:`sync` returns.
+
+    ``full_state_threshold``: diverged fraction above which the delta
+    phase ships full state instead (wide divergence makes per-row
+    framing pure overhead; both peers compute the same decision).
+    ``full_state=True`` skips the digest phase entirely and ships full
+    state up front — the legacy replication behavior, kept for the
+    ``--full-state`` example flag and as the mixed-mode escape hatch.
+    ``digest_fn`` overrides the phase-1 digest (testing/experimentation
+    hook — e.g. forcing collisions); the converged CHECK always uses the
+    canonical :func:`crdt_tpu.sync.digest.digest_of`, which is what
+    lets a collided delta pass fall back to full state and still
+    converge.
+    """
+
+    def __init__(self, batch, universe, *,
+                 full_state_threshold: float = 0.5,
+                 full_state: bool = False,
+                 digest_fn: Optional[Callable] = None):
+        if not 0.0 <= full_state_threshold <= 1.0:
+            raise ValueError(
+                f"full_state_threshold {full_state_threshold} not in [0, 1]"
+            )
+        self.batch = batch
+        self.universe = universe
+        self.full_state_threshold = full_state_threshold
+        self.full_state = full_state
+        self._digest_fn = digest_fn or digest_mod.digest_of
+        self._applier = OrswotDeltaApplier(universe)
+
+    # -- frame plumbing ------------------------------------------------------
+
+    def _send(self, send, frame: bytes, report: SyncReport, leg: str,
+              objects: int) -> None:
+        send(frame)
+        tracing.record_sync(leg, nbytes=len(frame), objects=objects)
+        if leg == "digest":
+            report.digest_bytes_sent += len(frame)
+        elif leg == "delta":
+            report.delta_bytes_sent += len(frame)
+        else:
+            report.full_bytes_sent += len(frame)
+
+    def _recv(self, recv, report: SyncReport) -> tuple[int, bytes]:
+        frame = recv()
+        if not isinstance(frame, (bytes, bytearray, memoryview)):
+            raise SyncProtocolError(
+                f"transport returned {type(frame).__name__}, not bytes"
+            )
+        frame = bytes(frame)
+        report.bytes_received += len(frame)
+        return decode_frame(frame)
+
+    # -- phase helpers -------------------------------------------------------
+
+    def _n(self) -> int:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(self.batch)
+        return int(leaves[0].shape[0])
+
+    def _exchange_digests(self, send, recv, report: SyncReport,
+                          digest_fn) -> tuple[np.ndarray, np.ndarray]:
+        mine = np.asarray(digest_fn(self.batch), dtype=np.uint64)
+        vv = digest_mod.version_vector(self.batch)
+        self._send(send, encode_digest_frame(mine, vv), report, "digest",
+                   mine.shape[0])
+        ftype, payload = self._recv(recv, report)
+        if ftype != FRAME_DIGEST:
+            raise SyncProtocolError(
+                f"expected a digest frame, peer sent type {ftype:#04x}"
+            )
+        theirs, _peer_vv = decode_digest_payload(payload)
+        report.digest_rounds += 1
+        return mine, theirs
+
+    def _send_full(self, send, report: SyncReport) -> None:
+        blobs = self.batch.to_wire(self.universe)
+        self._send(send, encode_full_frame(blobs), report, "full", len(blobs))
+
+    def _apply_frame(self, ftype: int, payload: bytes) -> None:
+        n = self._n()
+        if ftype == FRAME_FULL:
+            blobs = decode_full_payload(payload)
+            if len(blobs) != n:
+                raise SyncProtocolError(
+                    f"peer full state carries {len(blobs)} objects, "
+                    f"local fleet holds {n}"
+                )
+            peer = type(self.batch).from_wire(blobs, self.universe)
+            self.batch = self.batch.merge(peer)
+        elif ftype == FRAME_DELTA:
+            fleet_n, ids, blobs = decode_delta_payload(payload)
+            if fleet_n != n:
+                raise SyncProtocolError(
+                    f"peer fleet size {fleet_n} != local {n}"
+                )
+            self.batch = delta_mod.apply_delta_rows(
+                self.batch, ids, blobs, self.universe, applier=self._applier
+            )
+        else:
+            raise SyncProtocolError(
+                f"expected a delta/full frame, peer sent type {ftype:#04x}"
+            )
+
+    # -- the protocol --------------------------------------------------------
+
+    def sync(self, send: Callable[[bytes], None],
+             recv: Callable[[], bytes]) -> SyncReport:
+        """Run the session to convergence (or raise).  Returns the
+        per-phase :class:`SyncReport`; the reconciled fleet is
+        ``self.batch``."""
+        report = SyncReport(objects=self._n())
+
+        if self.full_state:
+            # legacy mode: full state both ways, digest-verified
+            report.full_state_fallback = True
+            self._send_full(send, report)
+            self._apply_frame(*self._recv(recv, report))
+            mine, theirs = self._exchange_digests(
+                send, recv, report, digest_mod.digest_of
+            )
+            report.converged = bool(np.array_equal(mine, theirs))
+            if not report.converged:
+                raise SyncProtocolError(
+                    "full-state exchange did not converge (digest "
+                    "vectors still differ — mixed digest modes?)"
+                )
+            return report
+
+        # phase 1: digest exchange
+        mine, theirs = self._exchange_digests(
+            send, recv, report, self._digest_fn
+        )
+        diverged = diverged_indices(mine, theirs)
+        report.diverged = int(diverged.size)
+        canonical = self._digest_fn is digest_mod.digest_of
+        if diverged.size == 0 and canonical:
+            # idempotent re-sync: one digest exchange, zero delta bytes.
+            # (Phase 1 IS the canonical verify here — re-running it
+            # would compare the same function on the same data.)
+            report.converged = True
+            return report
+
+        if diverged.size:
+            # phase 2: delta (or threshold full-state) exchange — the
+            # decision is a pure function of the shared diverged set,
+            # so both peers take the same branch
+            n = report.objects
+            if n and diverged.size / n > self.full_state_threshold:
+                report.full_state_fallback = True
+                self._send_full(send, report)
+            else:
+                blobs = gather_blobs(self.batch, diverged, self.universe)
+                report.delta_objects_sent = len(blobs)
+                self._send(send, encode_delta_frame(n, diverged, blobs),
+                           report, "delta", len(blobs))
+            self._apply_frame(*self._recv(recv, report))
+        # else: a non-canonical phase-1 digest saw nothing to ship —
+        # both peers skip straight to the canonical verify, whose
+        # mismatch path (below) is what catches collisions
+
+        # phase 3: converged check with the CANONICAL digest (a phase-1
+        # digest_fn override must not be able to fake convergence)
+        mine, theirs = self._exchange_digests(
+            send, recv, report, digest_mod.digest_of
+        )
+        if np.array_equal(mine, theirs):
+            report.converged = True
+            return report
+
+        # digest mismatch after delta apply: 64-bit collision in phase 1
+        # or digest-mode skew — retry with full state, which must land
+        report.full_state_fallback = True
+        self._send_full(send, report)
+        self._apply_frame(*self._recv(recv, report))
+        mine, theirs = self._exchange_digests(
+            send, recv, report, digest_mod.digest_of
+        )
+        report.converged = bool(np.array_equal(mine, theirs))
+        if not report.converged:
+            raise SyncProtocolError(
+                "sync did not converge after full-state retry (digest "
+                "vectors still differ — peers disagree on state or "
+                "digest mode)"
+            )
+        return report
+
+
+# ---- in-process transports -------------------------------------------------
+
+
+def queue_transport():
+    """Two paired in-process endpoints: ``((send_a, recv_a), (send_b,
+    recv_b))`` over unbounded queues — the bench/test transport.  Run
+    the two sessions in separate threads (the lock-step protocol blocks
+    each peer on the other's frames)."""
+    import queue
+
+    a_to_b: "queue.Queue[bytes]" = queue.Queue()
+    b_to_a: "queue.Queue[bytes]" = queue.Queue()
+    return (
+        (a_to_b.put, lambda: b_to_a.get(timeout=120)),
+        (b_to_a.put, lambda: a_to_b.get(timeout=120)),
+    )
+
+
+def sync_pair(session_a: SyncSession, session_b: SyncSession
+              ) -> tuple[SyncReport, SyncReport]:
+    """Drive two sessions against each other in-process (one thread per
+    peer) and return both reports; exceptions from either side
+    propagate."""
+    import threading
+
+    (send_a, recv_a), (send_b, recv_b) = queue_transport()
+    results: dict = {}
+
+    def run_b():
+        try:
+            results["b"] = session_b.sync(send_b, recv_b)
+        except BaseException as e:  # surfaced in the caller's thread
+            results["b_err"] = e
+
+    t = threading.Thread(target=run_b, name="sync-peer-b", daemon=True)
+    t.start()
+    try:
+        results["a"] = session_a.sync(send_a, recv_a)
+    finally:
+        t.join(timeout=120)
+    if "b_err" in results:
+        raise results["b_err"]
+    if t.is_alive():
+        raise SyncProtocolError("peer session deadlocked (thread alive)")
+    return results["a"], results["b"]

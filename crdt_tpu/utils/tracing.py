@@ -229,6 +229,28 @@ def native_fraction(deltas: Dict[str, int], prefix: str) -> Optional[float]:
     return native / total
 
 
+def record_sync(leg: str, *, nbytes: int = 0, objects: int = 0) -> None:
+    """Count one sync-protocol frame under the always-on
+    ``wire.sync.<leg>.{bytes,objects}`` counters (legs: ``digest`` /
+    ``delta`` / ``full``) — the per-phase bytes-on-wire accounting the
+    bench publishes as ``delta_ratio`` next to ``native_fraction``.
+    One increment pair per FRAME, not per object, so it is free at any
+    fleet scale (same discipline as :func:`record_wire
+    <crdt_tpu.batch.wirebulk.record_wire>`)."""
+    count(f"wire.sync.{leg}.bytes", nbytes)
+    count(f"wire.sync.{leg}.objects", objects)
+
+
+def delta_ratio(delta_bytes: int, full_state_bytes: int) -> Optional[float]:
+    """Delta payload bytes over the full-state bytes the same exchange
+    would have cost — the O(divergence) claim as one number (≤ ~0.01 +
+    framing at 1% divergence; 1.0+ means the delta path degenerated).
+    None when the full-state reference size is unknown or zero."""
+    if not full_state_bytes:
+        return None
+    return delta_bytes / full_state_bytes
+
+
 def report() -> str:
     return _GLOBAL.report()
 

@@ -281,14 +281,34 @@ def step8_pipelined_wire_loop(uni, n, incoming):
     loop = PipelinedWireLoop(uni)
     res = loop.run([[incoming, incoming]])
     # fold of two identical replicas + plunger == scalar self-merge
+    # (byte-level spot check on object 0 — the digest pass below is the
+    # fleet-wide oracle)
     acc = from_binary(incoming[0])
     acc.merge(from_binary(incoming[0]))
     acc.merge(acc.clone())
     assert res["out_blobs"][0] == to_binary(acc)
+
+    # convergence oracle: one digest pass per replica instead of a full
+    # value() comparison — after the round, every replica that merges
+    # the fold output must land on an identical digest vector (one
+    # jitted kernel + an N×8-byte compare; a 1M-object fleet checks in
+    # one launch where per-object value() comparison walks the heap)
+    from crdt_tpu.sync import digest as sync_digest
+
+    folded = OrswotBatch.from_wire(res["out_blobs"], uni)
+    want = sync_digest.digest_of(folded)
+    for r, blobs in enumerate((incoming, incoming)):
+        replica = OrswotBatch.from_wire(blobs, uni).merge(folded)
+        replica = replica.merge(replica)  # defer plunger
+        got = sync_digest.digest_of(replica)
+        assert np.array_equal(got, want), (
+            f"replica {r} digest vector diverged after anti-entropy"
+        )
     nf = res["ingest_native_fraction"]
     print(f"8. pipelined wire loop ({res['fold_path']} fold, "
           f"{res['pipeline']}): {res['merges']} replica-objects in "
-          f"{res['e2e_s']:.3f}s, ingest native_fraction={nf}")
+          f"{res['e2e_s']:.3f}s, ingest native_fraction={nf}; all replica "
+          "digest vectors converged")
 
 
 def main():

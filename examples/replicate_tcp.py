@@ -1,30 +1,38 @@
-"""Two-process anti-entropy over real TCP — the full replication loop.
+"""Two-process anti-entropy over real TCP — digest-driven delta sync.
 
 The reference deliberately ships no transport: "serialize state or op,
 transport however you like, merge/apply on the other side"
 (`/root/reference/src/lib.rs:62-83`; the ctx protocol docs even sketch
 the ship-to-client pattern, `/root/reference/src/ctx.rs:5-9`).  This
-example IS that missing piece, built on the framework's bulk wire
-codec: two OS processes, each owning a replica of the same object
-partition, exchange state over a localhost TCP socket and converge.
+example IS that missing piece: two OS processes, each owning a replica
+of the same object partition, reconcile over a localhost TCP socket
+through :class:`crdt_tpu.sync.SyncSession` — digest vectors first, then
+only the diverged rows' wire blobs, so bytes-on-wire is O(divergence)
+instead of O(total state).
 
 Per peer:
 
-1. build N ``Orswot`` objects and apply local ops under its own actor
-   (op path: ``value().derive_add_ctx(actor)`` → ``add`` → ``apply``,
-   `/root/reference/src/orswot.rs:64-84` semantics);
-2. pack the fleet into dense planes (``OrswotBatch.from_scalar``) and
-   egress wire blobs with the native bulk codec (``to_wire`` — each
-   blob is byte-identical to ``to_binary`` of the scalar object);
-3. swap blobs over TCP (length-prefixed frames);
-4. ``from_wire`` the peer's state and ``merge`` on the batch engine;
-   one extra self-merge acts as the defer plunger;
-5. print a digest of every object's ``value()``; both sides must match.
+1. build N ``Orswot`` objects from a SHARED op history (same seed), then
+   apply divergent local ops under its own actor to a small fraction of
+   objects — the realistic anti-entropy shape: replicas agree on almost
+   everything;
+2. pack the fleet into dense planes (``OrswotBatch.from_scalar``);
+3. run a ``SyncSession`` over the socket: every frame is length-prefixed
+   and carries a 1-byte protocol version, so a mixed-version peer fails
+   loudly (`SyncProtocolError`) instead of misparsing;
+4. print the per-phase wire accounting (digest vs delta bytes) and the
+   convergence verdict from the session's digest verify.
+
+``--full-state`` keeps the legacy behavior — full wire blobs both ways
+(still version-tagged frames, still digest-verified) — as the A/B
+comparator: at the default 5% divergence the delta session ships a
+fraction of the full-state bytes.
 
 Run it:
 
-    python examples/replicate_tcp.py            # spawns both peers
-    python examples/replicate_tcp.py --objects 1000
+    python examples/replicate_tcp.py                    # delta sync demo
+    python examples/replicate_tcp.py --full-state       # legacy full state
+    python examples/replicate_tcp.py --objects 1000 --divergence 0.01
 
 (`--platform cpu` forces the CPU backend, e.g. when no TPU is
 reachable; the kernels are platform-agnostic.)
@@ -33,7 +41,6 @@ reachable; the kernels are platform-agnostic.)
 from __future__ import annotations
 
 import argparse
-import hashlib
 import os
 import socket
 import struct
@@ -42,11 +49,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _frame_send(sock: socket.socket, blobs: list[bytes]) -> None:
-    sock.sendall(struct.pack("<I", len(blobs)))
-    for b in blobs:
-        sock.sendall(struct.pack("<I", len(b)))
-        sock.sendall(b)
+def _send_frame(sock: socket.socket, frame: bytes) -> None:
+    sock.sendall(struct.pack("<I", len(frame)))
+    sock.sendall(frame)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -59,17 +64,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _frame_recv(sock: socket.socket) -> list[bytes]:
-    (count,) = struct.unpack("<I", _recv_exact(sock, 4))
-    out = []
-    for _ in range(count):
-        (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
-        out.append(_recv_exact(sock, ln))
-    return out
+def _recv_frame(sock: socket.socket) -> bytes:
+    (ln,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, ln)
 
 
-def _build_fleet(n_objects: int, actor: int, seed: int):
-    """N scalar Orswots with local op histories under ``actor``."""
+def _build_fleet(n_objects: int, actor: int, divergence: float, seed: int):
+    """N scalar Orswots: a shared base history (seed-deterministic,
+    actor 0) + this peer's own ops on a ``divergence`` fraction of
+    objects.  Both peers call this with the SAME ``seed`` and different
+    ``actor``, so they agree everywhere except the divergent rows."""
     import numpy as np
 
     from crdt_tpu import Orswot
@@ -80,25 +84,28 @@ def _build_fleet(n_objects: int, actor: int, seed: int):
         o = Orswot()
         for _ in range(int(rng.randint(1, 5))):
             member = int(rng.randint(0, 64))
-            o.apply(o.add(member, o.value().derive_add_ctx(actor)))
+            o.apply(o.add(member, o.value().derive_add_ctx(0)))
         if i % 7 == 0:  # a causal remove on some objects
             read = o.value()
             if read.val:
                 m = sorted(read.val)[0]
                 o.apply(o.remove(m, o.contains(m).derive_rm_ctx()))
         fleet.append(o)
+    # divergent tail: per-peer ops the OTHER replica has not seen (the
+    # rng is past the shared prefix here, so draws differ per peer only
+    # through the actor-dependent op content below)
+    n_div = int(n_objects * divergence)
+    div_rng = np.random.RandomState(seed + 1)
+    targets = div_rng.choice(n_objects, size=n_div, replace=False)
+    for i in targets:
+        o = fleet[int(i)]
+        member = int(100 + actor * 10 + int(i) % 7)
+        o.apply(o.add(member, o.value().derive_add_ctx(actor)))
     return fleet
 
 
-def _digest(batch, universe) -> str:
-    """Canonical content digest of every object's value() set."""
-    h = hashlib.sha256()
-    for o in batch.to_scalar(universe):
-        h.update(repr(sorted(o.value().val)).encode())
-    return h.hexdigest()[:16]
-
-
-def peer(role: str, port: int, n_objects: int, platform: str | None) -> str:
+def peer(role: str, port: int, n_objects: int, platform: str | None,
+         full_state: bool = False, divergence: float = 0.05) -> str:
     import jax
 
     if platform:
@@ -106,6 +113,7 @@ def peer(role: str, port: int, n_objects: int, platform: str | None) -> str:
 
     from crdt_tpu.batch import OrswotBatch
     from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.sync import SyncSession
     from crdt_tpu.utils.interning import Universe
 
     # identity universe: int actors/members -> the native C++ bulk codec
@@ -114,7 +122,7 @@ def peer(role: str, port: int, n_objects: int, platform: str | None) -> str:
                                        deferred_capacity=8, counter_bits=32))
     actor = 1 if role == "server" else 2
     mine = OrswotBatch.from_scalar(
-        _build_fleet(n_objects, actor, seed=actor), uni
+        _build_fleet(n_objects, actor, divergence, seed=42), uni
     )
 
     if role == "server":
@@ -139,22 +147,21 @@ def peer(role: str, port: int, n_objects: int, platform: str | None) -> str:
                     raise
                 time.sleep(0.5)
 
+    session = SyncSession(mine, uni, full_state=full_state)
     with sock:
-        # state-based anti-entropy: swap full state, merge, done — merge
-        # idempotence/commutativity makes ordering and redelivery safe
-        # (`/root/reference/src/traits.rs:9-12,36`)
-        _frame_send(sock, mine.to_wire(uni))
-        theirs = OrswotBatch.from_wire(_frame_recv(sock), uni)
-        merged = mine.merge(theirs)
-        merged = merged.merge(merged)  # defer plunger
+        report = session.sync(
+            lambda frame: _send_frame(sock, frame),
+            lambda: _recv_frame(sock),
+        )
 
-        dig = _digest(merged, uni)
-        # confirm convergence: exchange digests
-        _frame_send(sock, [dig.encode()])
-        peer_dig = _frame_recv(sock)[0].decode()
-
-    status = "CONVERGED" if dig == peer_dig else "DIVERGED"
-    print(f"{role}: {n_objects} objects  digest={dig}  peer={peer_dig}  {status}")
+    status = "CONVERGED" if report.converged else "DIVERGED"
+    mode = "full-state" if full_state else "delta"
+    print(
+        f"{role}: {n_objects} objects  mode={mode}  "
+        f"diverged={report.diverged}  delta_objects={report.delta_objects_sent}  "
+        f"sent: digest={report.digest_bytes_sent}B delta="
+        f"{report.delta_bytes_sent}B full={report.full_bytes_sent}B  {status}"
+    )
     return status
 
 
@@ -164,6 +171,11 @@ def main() -> int:
                     choices=["demo", "server", "client"])
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--objects", type=int, default=64)
+    ap.add_argument("--divergence", type=float, default=0.05,
+                    help="fraction of objects with peer-local ops")
+    ap.add_argument("--full-state", action="store_true",
+                    help="legacy behavior: ship full state instead of "
+                         "digest-driven deltas")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform (e.g. cpu)")
     args = ap.parse_args()
@@ -171,7 +183,9 @@ def main() -> int:
     if args.role != "demo":
         if not args.port:
             ap.error("server/client roles need --port")
-        return 0 if peer(args.role, args.port, args.objects, args.platform) == "CONVERGED" else 1
+        status = peer(args.role, args.port, args.objects, args.platform,
+                      full_state=args.full_state, divergence=args.divergence)
+        return 0 if status == "CONVERGED" else 1
 
     # demo: spawn both peers as real OS processes
     import subprocess
@@ -181,7 +195,10 @@ def main() -> int:
         port = probe.getsockname()[1]
 
     base = [sys.executable, os.path.abspath(__file__)]
-    extra = ["--port", str(port), "--objects", str(args.objects)]
+    extra = ["--port", str(port), "--objects", str(args.objects),
+             "--divergence", str(args.divergence)]
+    if args.full_state:
+        extra += ["--full-state"]
     if args.platform:
         extra += ["--platform", args.platform]
     srv = subprocess.Popen(base + ["server"] + extra)

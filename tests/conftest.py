@@ -23,6 +23,21 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+
+def pytest_configure(config):
+    # registered here (not pyproject) so the marker set lives next to the
+    # harness that polices it.  `sync` tags the delta anti-entropy suite —
+    # deliberately NOT `slow`, so the tier-1 command (`-m 'not slow'`)
+    # picks the sync tests up without marker collisions; `slow` stays the
+    # opt-out for long property soaks.
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run"
+    )
+    config.addinivalue_line(
+        "markers",
+        "sync: digest/delta anti-entropy subsystem tests (crdt_tpu.sync)",
+    )
+
 # hypothesis is an optional dependency of the property suites only: on
 # boxes without it the non-property tests must still collect and run, so
 # the import is gated and the @given modules are ignored rather than

@@ -30,3 +30,33 @@ def test_tcp_demo_converges(n_objects):
     assert proc.returncode == 0, proc.stderr[-800:]
     assert "demo: CONVERGED" in proc.stdout
     assert "DIVERGED" not in proc.stdout
+
+
+@pytest.mark.sync
+@pytest.mark.parametrize("mode", ["delta", "full-state"])
+def test_tcp_sync_modes_converge_identically(mode):
+    """Two-process round trip in both protocol modes: the delta session
+    and the legacy full-state exchange must both converge, and the
+    delta mode must actually ship deltas (not fall back to full
+    frames)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    args = [
+        sys.executable,
+        os.path.join(repo, "examples", "replicate_tcp.py"),
+        "--platform", "cpu",
+        "--objects", "200",
+        "--divergence", "0.05",
+    ]
+    if mode == "full-state":
+        args.append("--full-state")
+    proc = subprocess.run(args, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "demo: CONVERGED" in proc.stdout
+    if mode == "delta":
+        # both peers shipped a delta frame and no full-state frame
+        for line in proc.stdout.splitlines():
+            if "mode=delta" in line:
+                assert "full=0B" in line, line
+                assert "delta_objects=10" in line, line
+    else:
+        assert "mode=full-state" in proc.stdout

@@ -1,0 +1,460 @@
+"""The digest/delta anti-entropy subsystem (`crdt_tpu.sync`).
+
+Covers the acceptance bar of the sync PR: delta sync converges to
+byte-identical state vs the full-state merge path on the same op
+history (seeded property sweep across orswot/counter/lww fleets),
+idempotent re-sync ships zero deltas, malformed frames are clean
+`SyncProtocolError`s (never parser crashes), and a forced digest
+collision falls back to full state and still converges byte-identical.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.batch import (
+    GCounterBatch, LWWRegBatch, OrswotBatch, PNCounterBatch,
+)
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.error import SyncProtocolError
+from crdt_tpu.scalar.gcounter import GCounter
+from crdt_tpu.scalar.lwwreg import LWWReg
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.scalar.pncounter import PNCounter
+from crdt_tpu.sync import digest as sync_digest
+from crdt_tpu.sync import delta as sync_delta
+from crdt_tpu.sync.delta import (
+    OrswotDeltaApplier,
+    decode_frame,
+    diverged_indices,
+    encode_delta_frame,
+    encode_digest_frame,
+    encode_full_frame,
+    gather_blobs,
+)
+from crdt_tpu.sync.session import SyncSession, sync_pair
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = pytest.mark.sync
+
+
+def _uni(**kw):
+    cfg = dict(num_actors=8, member_capacity=16, deferred_capacity=4,
+               counter_bits=32)
+    cfg.update(kw)
+    return Universe.identity(CrdtConfig(**cfg))
+
+
+def _orswot_fleet(n, seed, actor=1, extra_on=(), rng_members=50):
+    """n scalar Orswots from a seed-shared history, plus local ops under
+    ``actor`` on the ``extra_on`` rows (the divergence)."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        s = Orswot()
+        for _ in range(rng.randint(1, 5)):
+            s.apply(s.add(int(rng.randint(0, rng_members)),
+                          s.value().derive_add_ctx(0)))
+        if i % 5 == 0:
+            read = s.value()
+            if read.val:
+                m = sorted(read.val)[0]
+                s.apply(s.remove(m, s.contains(m).derive_rm_ctx()))
+        out.append(s)
+    for i in extra_on:
+        s = out[i]
+        s.apply(s.add(900 + actor, s.value().derive_add_ctx(actor)))
+    return out
+
+
+# ---- digest canonicality ---------------------------------------------------
+
+
+def test_digest_slot_order_and_capacity_invariant():
+    uni = _uni()
+    fleet = _orswot_fleet(64, seed=3)
+    b = OrswotBatch.from_scalar(fleet, uni)
+    d = sync_digest.digest_of(b)
+    # the wire host route preserves wire slot order; the from_scalar
+    # route uses insertion order — both must digest identically
+    via_wire = OrswotBatch.from_wire(b.to_wire(uni), uni, via_device=False)
+    assert np.array_equal(d, sync_digest.digest_of(via_wire))
+    # growing the padded capacities is a representation change only
+    grown = b.with_capacity(member_capacity=32, deferred_capacity=8)
+    assert np.array_equal(d, sync_digest.digest_of(grown))
+
+
+def test_digest_distinguishes_states():
+    uni = _uni()
+    base = _orswot_fleet(64, seed=4)
+    b = OrswotBatch.from_scalar(base, uni)
+    d = sync_digest.digest_of(b)
+    # one extra dot on one object must flip exactly that object's lane
+    mutated = [s.clone() for s in base]
+    mutated[17].apply(
+        mutated[17].add(999, mutated[17].value().derive_add_ctx(2))
+    )
+    d2 = sync_digest.digest_of(OrswotBatch.from_scalar(mutated, uni))
+    assert d[17] != d2[17]
+    mask = np.ones(64, bool)
+    mask[17] = False
+    assert np.array_equal(d[mask], d2[mask])
+
+
+def test_digest_deferred_state_is_visible():
+    """A buffered (causally-future) remove is real state and must be
+    digested — two replicas differing only in a deferred row diverge."""
+    uni = _uni()
+    s1, s2 = Orswot(), Orswot()
+    for s in (s1, s2):
+        s.apply(s.add(1, s.value().derive_add_ctx(0)))
+    ctx = s2.contains(1).derive_rm_ctx()
+    ctx.clock.witness(5, 10)  # a write s2 has not seen -> remove buffers
+    s2.apply(s2.remove(1, ctx))
+    assert len(s2.deferred) == 1
+    d = sync_digest.digest_of(OrswotBatch.from_scalar([s1, s2], uni))
+    assert d[0] != d[1]
+
+
+def test_counter_and_lww_digests():
+    uni = _uni()
+    pns = []
+    for i in range(8):
+        c = PNCounter()
+        for _ in range(i + 1):
+            c.apply(c.inc(i % 4))
+        pns.append(c)
+    d = sync_digest.digest_of(PNCounterBatch.from_scalar(pns, uni))
+    assert len(set(d.tolist())) == len(pns)
+    regs = [LWWReg(val=i, marker=10 + i) for i in range(8)]
+    dl = sync_digest.digest_of(LWWRegBatch.from_scalar(regs, uni))
+    assert len(set(dl.tolist())) == len(regs)
+    # marker-only difference must be visible (same value id)
+    regs2 = [LWWReg(val=i, marker=11 + i) for i in range(8)]
+    dl2 = sync_digest.digest_of(LWWRegBatch.from_scalar(regs2, uni))
+    assert not np.array_equal(dl, dl2)
+
+
+def test_version_vector_summary():
+    uni = _uni()
+    fleet = _orswot_fleet(16, seed=9)
+    b = OrswotBatch.from_scalar(fleet, uni)
+    vv = sync_digest.version_vector(b)
+    assert vv.shape == (8,)
+    assert vv.dtype == np.uint64
+    assert int(np.asarray(b.clock).max()) == int(vv.max())
+    fold, count = sync_digest.fleet_summary(sync_digest.digest_of(b))
+    assert count == 16
+
+
+# ---- frame codec -----------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    d = np.arange(10, dtype=np.uint64)
+    ftype, payload = decode_frame(encode_digest_frame(d, np.arange(4)))
+    got, vv = sync_delta.decode_digest_payload(payload)
+    assert np.array_equal(got, d) and np.array_equal(vv, np.arange(4))
+    ids = np.array([3, 7], dtype=np.int64)
+    ftype, payload = decode_frame(encode_delta_frame(100, ids, [b"ab", b"c"]))
+    n, got_ids, blobs = sync_delta.decode_delta_payload(payload)
+    assert (n, blobs) == (100, [b"ab", b"c"])
+    assert np.array_equal(got_ids, ids)
+    ftype, payload = decode_frame(encode_full_frame([b"x", b"", b"yz"]))
+    assert sync_delta.decode_full_payload(payload) == [b"x", b"", b"yz"]
+
+
+@pytest.mark.parametrize("mutate", ["truncate", "tamper", "version", "type"])
+def test_malformed_frames_rejected_cleanly(mutate):
+    frame = encode_delta_frame(
+        8, np.array([1, 2], dtype=np.int64), [b"hello", b"world"]
+    )
+    if mutate == "truncate":
+        bad = frame[:-3]
+    elif mutate == "tamper":
+        i = len(frame) - 4  # flip a payload byte -> CRC must catch it
+        bad = frame[:i] + bytes([frame[i] ^ 0x40]) + frame[i + 1:]
+    elif mutate == "version":
+        bad = bytes([frame[0] + 1]) + frame[1:]
+    else:
+        bad = frame[:1] + bytes([0x7F]) + frame[2:]
+    with pytest.raises(SyncProtocolError):
+        decode_frame(bad)
+
+
+def test_truncated_delta_inside_session_is_clean():
+    """A tampered frame arriving mid-session surfaces as
+    SyncProtocolError from sync(), never a parser crash."""
+    uni = _uni()
+    b = OrswotBatch.from_scalar(_orswot_fleet(8, seed=5), uni)
+    session = SyncSession(b, uni)
+    peer_digest = encode_digest_frame(np.zeros(8, np.uint64))
+    good_delta = encode_delta_frame(8, np.array([0]), [b"\x26\x00\x00\x00"])
+    frames = iter([peer_digest, good_delta[:-2]])
+    with pytest.raises(SyncProtocolError):
+        session.sync(lambda f: None, lambda: next(frames))
+
+
+def test_fleet_size_mismatch_fails_loudly():
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(8, seed=6), uni)
+    b = OrswotBatch.from_scalar(_orswot_fleet(12, seed=6), uni)
+    with pytest.raises(SyncProtocolError):
+        sync_pair(SyncSession(a, uni), SyncSession(b, uni))
+
+
+# ---- indexed gather / warm apply -------------------------------------------
+
+
+def test_gather_blobs_matches_to_wire_subset():
+    uni = _uni()
+    b = OrswotBatch.from_scalar(_orswot_fleet(64, seed=7), uni)
+    full = b.to_wire(uni)
+    ids = np.array([0, 5, 31, 63], dtype=np.int64)
+    assert gather_blobs(b, ids, uni) == [full[i] for i in ids]
+    assert gather_blobs(b, np.zeros(0, np.int64), uni) == []
+
+
+def test_delta_applier_reuses_buffers():
+    uni = _uni()
+    base = _orswot_fleet(32, seed=8)
+    a = OrswotBatch.from_scalar(base, uni)
+    peer_fleet = [s.clone() for s in base]
+    for i in (2, 9):
+        peer_fleet[i].apply(
+            peer_fleet[i].add(901, peer_fleet[i].value().derive_add_ctx(3))
+        )
+    peer = OrswotBatch.from_scalar(peer_fleet, uni)
+    applier = OrswotDeltaApplier(uni)
+    ids = np.array([2, 9], dtype=np.int64)
+    blobs = gather_blobs(peer, ids, uni)
+    out1 = applier.apply(a, ids, blobs)
+    staging_before = applier._staging
+    # second apply with the same delta size must reuse the same buffers
+    out2 = applier.apply(out1, ids, blobs)
+    assert applier._staging is staging_before
+    ref = a.merge(peer)
+    want = gather_blobs(ref, ids, uni)
+    assert gather_blobs(out1, ids, uni) == want
+    # idempotence: re-applying the same delta changes nothing
+    assert out2.to_wire(uni) == out1.to_wire(uni)
+
+
+def test_delta_applier_rejects_out_of_range_ids():
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(8, seed=10), uni)
+    applier = OrswotDeltaApplier(uni)
+    with pytest.raises(SyncProtocolError):
+        applier.apply(a, np.array([99], dtype=np.int64), [b"\x26\x00\x00\x00"])
+
+
+# ---- session convergence (the property sweep) ------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_orswot_delta_sync_matches_full_state_merge(seed):
+    """The acceptance bar: on the same op history, the delta session's
+    converged fleets are byte-identical to the full-state merge."""
+    rng = np.random.RandomState(100 + seed)
+    n = int(rng.randint(20, 120))
+    k = int(rng.randint(1, max(2, n // 8)))
+    rows_a = rng.choice(n, size=k, replace=False)
+    rows_b = rng.choice(n, size=k, replace=False)
+    uni = _uni()
+    a = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=seed, actor=1, extra_on=rows_a), uni
+    )
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=seed, actor=2, extra_on=rows_b), uni
+    )
+    ref = a.merge(b)
+    sa, sb = SyncSession(a, uni), SyncSession(b, uni)
+    ra, rb = sync_pair(sa, sb)
+    assert ra.converged and rb.converged
+    assert sa.batch.to_wire(uni) == ref.to_wire(uni) == sb.batch.to_wire(uni)
+    # digest vectors agree with the reference fleet's too
+    assert np.array_equal(
+        sync_digest.digest_of(sa.batch), sync_digest.digest_of(ref)
+    )
+    want_div = len(set(rows_a.tolist()) | set(rows_b.tolist()))
+    assert ra.diverged == want_div
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_counter_fleets_delta_sync(seed):
+    rng = np.random.RandomState(200 + seed)
+    n = 60
+    uni = _uni()
+
+    def pn_fleet(bump_rows):
+        rng2 = np.random.RandomState(300 + seed)
+        out = []
+        for i in range(n):
+            c = PNCounter()
+            for _ in range(rng2.randint(1, 6)):
+                c.apply(c.inc(int(rng2.randint(0, 8))))
+            out.append(c)
+        for i in bump_rows:
+            out[i].apply(out[i].dec(int(rng.randint(0, 8))))
+        return out
+
+    rows = rng.choice(n, size=4, replace=False)
+    a = PNCounterBatch.from_scalar(pn_fleet([]), uni)
+    b = PNCounterBatch.from_scalar(pn_fleet(rows), uni)
+    ref = a.merge(b)
+    sa, sb = SyncSession(a, uni), SyncSession(b, uni)
+    ra, rb = sync_pair(sa, sb)
+    assert ra.converged and ra.diverged == len(set(rows.tolist()))
+    assert sa.batch.to_wire(uni) == ref.to_wire(uni) == sb.batch.to_wire(uni)
+
+    def gc_fleet(bump_rows):
+        rng2 = np.random.RandomState(400 + seed)
+        out = []
+        for i in range(n):
+            c = GCounter()
+            for _ in range(rng2.randint(1, 4)):
+                c.apply(c.inc(int(rng2.randint(0, 8))))
+            out.append(c)
+        for i in bump_rows:
+            out[i].apply(out[i].inc(1))
+        return out
+
+    a = GCounterBatch.from_scalar(gc_fleet([]), uni)
+    b = GCounterBatch.from_scalar(gc_fleet(rows), uni)
+    ref = a.merge(b)
+    sa, sb = SyncSession(a, uni), SyncSession(b, uni)
+    ra, _rb = sync_pair(sa, sb)
+    assert ra.converged
+    assert sa.batch.to_wire(uni) == ref.to_wire(uni)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_lww_fleets_delta_sync(seed):
+    rng = np.random.RandomState(500 + seed)
+    n = 60
+    uni = _uni()
+
+    def fleet(bump_rows):
+        rng2 = np.random.RandomState(600 + seed)
+        out = [
+            LWWReg(val=int(rng2.randint(0, 1000)),
+                   marker=int(rng2.randint(1, 100)))
+            for _ in range(n)
+        ]
+        for i in bump_rows:
+            out[i] = LWWReg(val=int(rng.randint(0, 1000)), marker=500 + i)
+        return out
+
+    rows = rng.choice(n, size=3, replace=False)
+    a = LWWRegBatch.from_scalar(fleet([]), uni)
+    b = LWWRegBatch.from_scalar(fleet(rows), uni)
+    ref = a.merge(b)
+    sa, sb = SyncSession(a, uni), SyncSession(b, uni)
+    ra, _rb = sync_pair(sa, sb)
+    assert ra.converged and ra.diverged == len(set(rows.tolist()))
+    assert sa.batch.to_wire(uni) == ref.to_wire(uni) == sb.batch.to_wire(uni)
+
+
+def test_idempotent_resync_ships_zero_deltas():
+    uni = _uni()
+    a = OrswotBatch.from_scalar(
+        _orswot_fleet(50, seed=11, actor=1, extra_on=[1, 2]), uni
+    )
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(50, seed=11, actor=2, extra_on=[3]), uni
+    )
+    sa, sb = SyncSession(a, uni), SyncSession(b, uni)
+    sync_pair(sa, sb)
+    # second session over the converged fleets: one digest exchange,
+    # zero delta/full bytes, zero objects shipped
+    sa2, sb2 = SyncSession(sa.batch, uni), SyncSession(sb.batch, uni)
+    ra2, rb2 = sync_pair(sa2, sb2)
+    for r in (ra2, rb2):
+        assert r.converged
+        assert r.diverged == 0
+        assert r.delta_objects_sent == 0
+        assert r.delta_bytes_sent == 0 and r.full_bytes_sent == 0
+        assert r.digest_rounds == 1
+
+
+def test_forced_digest_collision_falls_back_to_full_state():
+    """Phase-1 digests that collide on diverged rows ship nothing for
+    them; the canonical verify catches it and the full-state retry must
+    still converge byte-identical."""
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(40, seed=12, actor=1), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(40, seed=12, actor=2, extra_on=[4, 14, 24]), uni
+    )
+    ref = a.merge(b)
+
+    # total collision: every lane equal, nothing flagged in phase 1
+    zero = lambda batch: np.zeros(40, np.uint64)  # noqa: E731
+    sa, sb = (SyncSession(x, uni, digest_fn=zero) for x in (a, b))
+    ra, rb = sync_pair(sa, sb)
+    assert ra.converged and ra.full_state_fallback
+    assert ra.delta_objects_sent == 0
+    assert sa.batch.to_wire(uni) == ref.to_wire(uni) == sb.batch.to_wire(uni)
+
+    # partial collision: two diverged rows hidden, one flagged — the
+    # delta pass fixes the flagged row, the verify catches the hidden
+    # ones, the retry converges
+    def partial(batch):
+        d = sync_digest.digest_of(batch).copy()
+        d[[4, 24]] = 0
+        return d
+
+    sa, sb = (SyncSession(x, uni, digest_fn=partial) for x in (a, b))
+    ra, rb = sync_pair(sa, sb)
+    assert ra.converged and ra.full_state_fallback
+    assert ra.diverged == 1 and ra.delta_objects_sent == 1
+    assert ra.digest_rounds == 3
+    assert sa.batch.to_wire(uni) == ref.to_wire(uni) == sb.batch.to_wire(uni)
+
+
+def test_wide_divergence_uses_full_state_threshold():
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(30, seed=13, actor=1), uni)
+    # a completely different history: every row diverges
+    b = OrswotBatch.from_scalar(_orswot_fleet(30, seed=14, actor=2), uni)
+    ref = a.merge(b)
+    sa, sb = SyncSession(a, uni), SyncSession(b, uni)
+    ra, _rb = sync_pair(sa, sb)
+    assert ra.converged and ra.full_state_fallback
+    assert ra.delta_bytes_sent == 0  # threshold sent FULL, not a delta
+    assert sa.batch.to_wire(uni) == ref.to_wire(uni)
+
+
+def test_full_state_mode_still_version_tagged():
+    """--full-state keeps the legacy exchange but every frame still
+    carries the protocol version byte."""
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(16, seed=15, actor=1), uni)
+    b = OrswotBatch.from_scalar(_orswot_fleet(16, seed=15, actor=2,
+                                              extra_on=[0]), uni)
+    frames_a: list = []
+    sa = SyncSession(a, uni, full_state=True)
+    sb = SyncSession(b, uni, full_state=True)
+    import threading
+
+    from crdt_tpu.sync.session import queue_transport
+
+    (send_a, recv_a), (send_b, recv_b) = queue_transport()
+
+    def wrapped_send(f):
+        frames_a.append(f)
+        send_a(f)
+
+    t = threading.Thread(target=lambda: sb.sync(send_b, recv_b), daemon=True)
+    t.start()
+    ra = sa.sync(wrapped_send, recv_a)
+    t.join(timeout=60)
+    assert ra.converged
+    assert frames_a and all(
+        f[0] == sync_delta.PROTOCOL_VERSION for f in frames_a
+    )
+    assert sa.batch.to_wire(uni) == sb.batch.to_wire(uni)
+
+
+def test_diverged_indices_shape_guard():
+    with pytest.raises(SyncProtocolError):
+        diverged_indices(np.zeros(3, np.uint64), np.zeros(4, np.uint64))

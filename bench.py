@@ -1125,6 +1125,94 @@ def bench_sync():
     return out
 
 
+def bench_obs_overhead():
+    """Always-on observability cost gate (the obs subsystem's bench
+    satellite): the counters/gauges/events added across the wire and
+    sync paths are deliberately per-BULK-call, so their total cost must
+    be noise.  This stage measures the per-operation cost of each
+    always-on instrument (registry-forwarded counter increment,
+    ``record_sync`` with its frame-size histogram, gauge set, flight-
+    recorder append), scales it by a deliberately generous per-fleet
+    operation count for the e2e wire workload, and asserts the result
+    is <1% of the measured ``bench_e2e_wire`` wall time.  If counting
+    ever regresses to per-blob (the failure mode this gate exists for),
+    the scaled estimate blows through 1% immediately."""
+    from crdt_tpu.obs import events as obs_events
+    from crdt_tpu.obs import metrics as obs_metrics
+    from crdt_tpu.utils import tracing
+
+    iters = 20_000 if SMALL else 100_000
+
+    def per_op(fn):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            fn(i)
+        return (time.perf_counter() - t0) / iters
+
+    count_s = per_op(lambda i: tracing.count("obs.overhead.count_probe"))
+    sync_s = per_op(
+        lambda i: tracing.record_sync("probe", nbytes=1024, objects=1)
+    )
+    g = obs_metrics.registry().gauge("obs.overhead.gauge_probe")
+    gauge_s = per_op(g.set)
+    rec = obs_events.FlightRecorder(capacity=256)  # private ring: the
+    # probe must not wash real sessions out of the global recorder
+    event_s = per_op(lambda i: rec.record("obs.overhead.event_probe", n=i))
+    out = {
+        "obs_count_ns": round(count_s * 1e9, 1),
+        "obs_record_sync_ns": round(sync_s * 1e9, 1),
+        "obs_gauge_set_ns": round(gauge_s * 1e9, 1),
+        "obs_event_ns": round(event_s * 1e9, 1),
+    }
+    log(
+        f"obs overhead: count {out['obs_count_ns']}ns  record_sync "
+        f"{out['obs_record_sync_ns']}ns  gauge {out['obs_gauge_set_ns']}ns  "
+        f"event {out['obs_event_ns']}ns per op"
+    )
+
+    e2e_s = _JSON_STATE.get("e2e_wire_s")
+    if e2e_s:
+        # the e2e workload shape, re-derived as bench_e2e_wire derives it
+        if SMALL:
+            n, chunk, r = 2_000, 1_000, 4
+        else:
+            n, chunk, r = 1_250_000, 62_500, 8
+        n_chunks = max(2, n // chunk)
+        if _downshift():
+            n_chunks = min(n_chunks, 2)
+        # ~10 always-on ops actually fire per fleet in the e2e loop
+        # (record_wire counts, native engine call counters, wireloop
+        # gauges — all per BULK call); 32 is the headroom that keeps the
+        # gate meaningful without flaking.  record_sync is per sync
+        # frame, not part of this loop — reported above, gated out.
+        ops = n_chunks * r * 32
+        worst = max(count_s, gauge_s, event_s)
+        frac = ops * worst / e2e_s
+        out["obs_overhead_frac"] = round(frac, 6)
+        log(
+            f"obs overhead: {ops} ops x {worst*1e9:.0f}ns = "
+            f"{ops*worst*1e3:.2f}ms vs e2e_wire {e2e_s:.2f}s "
+            f"-> {frac:.4%} (bar: <1%)"
+        )
+        # only gate against a reference big enough to be a denominator:
+        # a SMALL/smoke e2e finishes in ~10ms, where fixed microsecond
+        # costs are a huge fraction of nothing
+        if e2e_s >= 0.5:
+            assert frac < 0.01, (
+                f"always-on observability costs {frac:.2%} of "
+                "bench_e2e_wire wall time (bar: <1%) — did counting "
+                "regress to per-blob?"
+            )
+        else:
+            log(
+                f"obs overhead: e2e_wire {e2e_s}s too small to gate "
+                "against (smoke shape); per-op costs recorded"
+            )
+    else:
+        log("obs overhead: e2e_wire did not run; per-op costs only")
+    return out
+
+
 def bench_bandwidth_floor():
     """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
     elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
@@ -1617,9 +1705,33 @@ def _emit_regression_warnings(quiet=False):
         if not quiet:
             for w in warns[:8]:
                 log(f"regression warning vs {prior_name}: {w}")
-        emit(regression_warnings=warns, regression_baseline=prior_name)
+        # counter-family diff: a family that vanished round-over-round
+        # (especially a *.native leaf) is the silent-fallback smell the
+        # always-on counters exist to catch
+        fam_warns = artifacts.counter_family_warnings(
+            prior.get("obs_counters"), _JSON_STATE.get("obs_counters")
+        )
+        if not quiet:
+            for w in fam_warns[:8]:
+                log(f"counter family warning vs {prior_name}: {w}")
+        emit(regression_warnings=warns, regression_baseline=prior_name,
+             counter_family_warnings=fam_warns)
     except Exception as e:  # noqa: BLE001 — diffing must never cost the bench
         log(f"artifact diffing failed: {type(e).__name__}: {str(e)[:200]}")
+
+
+def _emit_obs_snapshot():
+    """Publish the always-on counter registry into the artifact tail so
+    :mod:`benchkit.artifacts` can diff counter FAMILIES round over
+    round (the obs tentpole): every counter the run incremented, by
+    name.  Values are workload-sized so the ratio differ skips them
+    (nested dict); what matters is which families exist at all."""
+    try:
+        from crdt_tpu.utils import tracing
+
+        emit(obs_counters=tracing.counters())
+    except Exception as e:  # noqa: BLE001 — telemetry must never cost the bench
+        log(f"obs snapshot failed: {type(e).__name__}: {str(e)[:200]}")
 
 
 def main():
@@ -1695,8 +1807,14 @@ def main():
     sync_res = run_stage("sync", 60, bench_sync)
     if sync_res is not None:
         emit(**sync_res)
+    # budget-skippable: the <1% always-on metrics gate (needs e2e_wire's
+    # wall time above to have something to be a fraction OF)
+    obs_res = run_stage("obs_overhead", 15, bench_obs_overhead)
+    if obs_res is not None:
+        emit(**obs_res)
     # provisional regression tail first: a watchdog kill inside the
     # required validation stage below must not cost the field entirely
+    _emit_obs_snapshot()
     _emit_regression_warnings(quiet=True)
     # TPU validation runs BEFORE the optional contenders (resident /
     # pallas / floor) and is never budget-skipped: it is a killable
@@ -1762,6 +1880,7 @@ def main():
     # final regression tail: recompute over the complete record (the
     # provisional pass before tpu_validation only covered the stages
     # that had run by then)
+    _emit_obs_snapshot()
     _emit_regression_warnings()
 
     if _JSON_STATE.get("value") is None:

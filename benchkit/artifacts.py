@@ -98,3 +98,56 @@ def regression_warnings(prior: dict, current: dict,
     out.sort(key=lambda w: -abs(math.log(w["ratio"])) if w["ratio"]
              else -float("inf"))
     return out
+
+
+# leaf segments that mark a counter as one member of a FAMILY: the
+# family is the dotted prefix (e.g. `wire.orswot.from_wire` owns
+# `.native`, `.fallback`, `.fallback_reason.*`); detail counters under
+# `fallback_reason` collapse into one member so a reason that stops
+# firing (an improvement) never warns on its own
+_FAMILY_LEAVES = frozenset({
+    "native", "fallback", "bytes", "objects", "calls", "errors",
+    "decoded", "stalls", "sessions",
+})
+
+
+def counter_family(name: str) -> str:
+    """The family a counter belongs to: its name minus a recognized
+    leaf segment (``wire.orswot.from_wire.native`` →
+    ``wire.orswot.from_wire``); names without a recognized leaf are
+    their own family."""
+    parts = name.split(".")
+    if "fallback_reason" in parts:
+        return ".".join(parts[:parts.index("fallback_reason")])
+    if len(parts) > 1 and parts[-1] in _FAMILY_LEAVES:
+        return ".".join(parts[:-1])
+    return name
+
+
+def counter_family_warnings(prior_counters, current_counters) -> list:
+    """Warnings for always-on counter families that vanished round over
+    round (the ``obs_counters`` tail the bench publishes).
+
+    Two kinds: a whole FAMILY disappearing means a code path stopped
+    being exercised at all; a ``*.native`` counter disappearing while
+    its family survives is the silent-fallback smell — the path still
+    runs, but nothing takes the native route anymore.  Counter VALUES
+    are workload-sized and deliberately not ratio-compared here (that
+    is :func:`regression_warnings`' job for the scale-free metrics)."""
+    if not isinstance(prior_counters, dict) or \
+            not isinstance(current_counters, dict):
+        return []
+    prior_fams = {counter_family(k) for k in prior_counters}
+    cur_fams = {counter_family(k) for k in current_counters}
+    out = [
+        {"kind": "family_vanished", "family": fam}
+        for fam in sorted(prior_fams - cur_fams)
+    ]
+    out.extend(
+        {"kind": "native_vanished", "family": counter_family(name),
+         "counter": name, "prior": prior_counters[name]}
+        for name in sorted(prior_counters)
+        if name.endswith(".native") and name not in current_counters
+        and counter_family(name) in cur_fams
+    )
+    return out

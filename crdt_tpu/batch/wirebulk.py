@@ -35,7 +35,13 @@ def record_wire(leg: str, direction: str, *, native: int = 0,
     fallback).  Reasons in use: ``no_engine`` (native library absent or
     symbol missing), ``non_identity`` (universe is not identity-interned),
     ``grammar`` (per-blob status==1 splice), ``overflow_zigzag`` (u64
-    counters past the native encoder's range)."""
+    counters past the native encoder's range).
+
+    A reasoned fallback also lands in the flight recorder (kind
+    ``wire.fallback``) — one event per bulk call, so the recorder shows
+    WHEN the native path was lost, which the monotonic counters alone
+    cannot."""
+    from ..obs import events as obs_events
     from ..utils import tracing
 
     prefix = f"wire.{leg}.{direction}"
@@ -43,6 +49,8 @@ def record_wire(leg: str, direction: str, *, native: int = 0,
     tracing.count(f"{prefix}.fallback", fallback)
     if reason is not None and fallback:
         tracing.count(f"{prefix}.fallback_reason.{reason}", fallback)
+        obs_events.record("wire.fallback", leg=leg, direction=direction,
+                          reason=reason, blobs=fallback)
 
 
 def probe_engine(universe, fn_name: str, dtype=None):

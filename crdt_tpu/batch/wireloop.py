@@ -34,7 +34,14 @@ pipeline").
 * **Instrumentation** — per-stage wall times and native-vs-fallback
   blob counts (via :mod:`crdt_tpu.utils.tracing` counters) are returned
   with the result, so the bench JSON can self-report ``native_fraction``
-  per stage.
+  per stage.  The loop also publishes live gauges
+  (``wireloop.staging_free`` — free staging sets, ``wireloop.
+  parsed_depth`` — parsed fleets waiting for the fold) to the obs
+  registry, and a fold blocked on the parser for longer than
+  ``stall_threshold_s`` leaves a ``wireloop.stall`` flight-recorder
+  event: an operator watching ``/metrics`` sees a parse-bound loop as
+  ``staging_free == 0`` plus a stall count, without attaching a
+  profiler.
 
 ``bench_e2e_wire`` (bench.py) and ``examples/anti_entropy.py`` drive
 this one implementation.
@@ -86,12 +93,15 @@ class PipelinedWireLoop:
     """
 
     def __init__(self, universe: Universe, *, fold_path: Optional[str] = None,
-                 staging_sets: int = 3):
+                 staging_sets: int = 3, stall_threshold_s: float = 0.1):
         if staging_sets < 2:
             raise ValueError("pipelining needs at least 2 staging sets")
         self.universe = universe
         self.cfg = universe.config
         self._staging_sets = staging_sets
+        # a fold wait on the parser above this leaves a wireloop.stall
+        # event in the flight recorder (0 disables the event, not the wait)
+        self.stall_threshold_s = stall_threshold_s
         self._staging: list[tuple] = []
         self._pingpong: list[tuple] = []
         self._n: Optional[int] = None
@@ -282,9 +292,34 @@ class PipelinedWireLoop:
                                       name="wireloop-parse")
             thread.start()
 
+        from ..obs import events as obs_events
+        from ..obs import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
+        g_free = reg.gauge("wireloop.staging_free")
+        g_depth = reg.gauge("wireloop.parsed_depth")
+
+        def update_gauges():
+            # qsize is advisory under concurrency, which is exactly what
+            # a gauge is — last write wins, scrapes see the latest level
+            g_free.set(free_q.qsize())
+            g_depth.set(parsed_q.qsize())
+
         def next_staged():
             if overlap:
+                t_wait0 = time.perf_counter()
                 item = parsed_q.get()
+                waited = time.perf_counter() - t_wait0
+                if self.stall_threshold_s and waited > self.stall_threshold_s:
+                    # the fold outran the parser: record the stall so a
+                    # parse-bound loop is visible from /events, not just
+                    # from a post-hoc stage_s diff
+                    tracing.count("wireloop.stalls")
+                    obs_events.record(
+                        "wireloop.stall", waited_s=round(waited, 4),
+                        staging_free=free_q.qsize(),
+                    )
+                update_gauges()
                 if isinstance(item, BaseException):
                     raise item
                 return item
@@ -293,6 +328,7 @@ class PipelinedWireLoop:
                 return _SENTINEL
             staging = free_q.get()
             parse_one(blobs, staging)
+            update_gauges()
             return staging
 
         try:

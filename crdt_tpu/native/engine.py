@@ -44,6 +44,19 @@ def _check_counters(*arrays):
     return dt
 
 
+def _count_native(name: str, objects: int) -> None:
+    """Always-on call/object counters for the hot native entry points
+    (``native.engine.<name>.{calls,objects}``) — one dict increment per
+    BULK call, same discipline as the wire codec counters.  A counter
+    family that vanishes round-over-round in the bench artifact is the
+    silent-fallback smell ``benchkit/artifacts.py`` warns on: the native
+    path stopped being exercised without anything failing loudly."""
+    from ..utils import tracing
+
+    tracing.count(f"native.engine.{name}.calls")
+    tracing.count(f"native.engine.{name}.objects", objects)
+
+
 # -- VClock ------------------------------------------------------------------
 
 
@@ -257,6 +270,7 @@ def orswot_merge(
                         f"(planes {i} and {j} share memory)"
                     )
     overflow = np.empty(n * 2, dtype=np.uint8)
+    _count_native("orswot_merge", n)
     _fn("orswot_merge", dt)(
         _ptr(A[0]), _ptr(A[1]), _ptr(A[2]), _ptr(A[3]), _ptr(A[4]),
         _ptr(B[0]), _ptr(B[1]), _ptr(B[2]), _ptr(B[3]), _ptr(B[4]),
@@ -659,6 +673,7 @@ def orswot_ingest_wire(buf, offsets, a: int, m: int, d: int, dtype, out=None):
                     f"{getattr(buf_, 'shape', '')}"
                 )
     status = np.zeros(n, dtype=np.uint8)
+    _count_native("orswot_ingest_wire", n)
     fn = _fn("orswot_ingest_wire", dt)
     fn.restype = ctypes.c_int64
     fn(
@@ -685,6 +700,7 @@ def orswot_encode_wire(clock, ids, dots, d_ids, d_clocks):
     m = ids.shape[-1]
     d = d_ids.shape[-1]
     offsets = np.zeros(n + 1, dtype=np.int64)
+    _count_native("orswot_encode_wire", n)
     fn = _fn("orswot_encode_wire", dt)
     fn(
         _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),
@@ -726,6 +742,7 @@ def orswot_encode_wire_rows(clock, ids, dots, d_ids, d_clocks, rows):
         )
     k = rows.shape[0]
     offsets = np.zeros(k + 1, dtype=np.int64)
+    _count_native("orswot_encode_wire_rows", k)
     fn = _fn("orswot_encode_wire_rows", dt)
     args = (
         _ptr(clock), _ptr(ids), _ptr(dots), _ptr(d_ids), _ptr(d_clocks),

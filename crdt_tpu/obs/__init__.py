@@ -1,0 +1,60 @@
+"""Observability — metrics registry, flight recorder, live export.
+
+The reference crate has zero observability (SURVEY §5: no logging
+crates, only ``Display`` impls); this package is the TPU port's
+first-class answer, in four parts:
+
+* :mod:`crdt_tpu.obs.metrics` — a typed registry (counters, gauges,
+  log2-bucketed histograms) that every always-on instrument feeds; the
+  legacy :mod:`crdt_tpu.utils.tracing` span/counter API re-routes into
+  it, so existing call sites needed no churn.
+* :mod:`crdt_tpu.obs.events` — a bounded ring-buffer flight recorder of
+  structured events (sync phase transitions, digest collisions,
+  full-state fallbacks, protocol errors, native-parse fallback reasons,
+  wire-loop stalls), stamped with monotonic time and per-session IDs.
+* :mod:`crdt_tpu.obs.export` — Prometheus text exposition + JSON
+  snapshots, plus an opt-in stdlib-only HTTP thread serving
+  ``/metrics``, ``/events``, ``/healthz``
+  (``examples/replicate_tcp.py --metrics-port``).
+* :mod:`crdt_tpu.obs.convergence` — per-peer digest-divergence gauges,
+  rounds-to-converge, staleness age, and delta-ratio history, computed
+  from the digest vectors the sync protocol already exchanges.
+
+Import-light by design: nothing here imports JAX or numpy, so the
+scalar engine (and any process that only wants a counter) pays nothing
+for it.  PERF.md "Observability" documents naming conventions and how
+to read the flight recorder after a failed sync.
+"""
+
+from . import convergence, events, metrics  # noqa: F401
+from .convergence import ConvergenceTracker, tracker  # noqa: F401
+from .events import FlightRecorder, new_session_id, record, recorder  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+
+__all__ = [
+    "ConvergenceTracker",
+    "Counter",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "new_session_id",
+    "record",
+    "recorder",
+    "registry",
+    "tracker",
+]
+
+
+def start_metrics_server(port: int = 0, host: str = "127.0.0.1"):
+    """Start the background ``/metrics`` HTTP exporter (lazy import so
+    merely importing :mod:`crdt_tpu.obs` never touches http.server)."""
+    from .export import start_metrics_server as _start
+
+    return _start(port=port, host=host)

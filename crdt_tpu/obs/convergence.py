@@ -1,0 +1,162 @@
+"""Convergence telemetry — how far apart replicas are, and for how long.
+
+The sync protocol already *computes* everything an operator needs to
+answer "are my replicas converging?" — the digest exchange yields the
+exact diverged set, the session report carries rounds and byte costs —
+but PR 2 threw that away after printing.  This module keeps it, per
+peer:
+
+* ``sync.peer.<peer>.divergence`` / ``.divergence_frac`` — gauges from
+  the most recent digest exchange: how many objects (and what fraction
+  of the fleet) differed from that peer.
+* ``sync.peer.<peer>.rounds_to_converge`` — digest exchanges the last
+  session needed (1 = clean delta sync, 3 = a full-state retry).
+* ``sync.peer.<peer>.staleness_s`` — seconds since the last *converged*
+  sync with that peer; the anti-entropy freshness alarm.  Recomputed at
+  read time (:meth:`ConvergenceTracker.refresh`), so a scrape always
+  sees the live age, not the age at last sync.
+* ``sync.peer.<peer>.delta_ratio`` — the last session's payload bytes
+  over the full-state reference, with a bounded history kept for the
+  JSON snapshot (the O(divergence) claim, live instead of bench-only).
+
+:class:`~crdt_tpu.sync.session.SyncSession` feeds this automatically
+through the default tracker; nothing here imports the sync package, so
+the dependency points protocol → telemetry only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from . import metrics
+
+_HISTORY = 64  # delta_ratio observations retained per peer
+
+
+class _PeerState:
+    __slots__ = (
+        "divergence", "objects", "rounds_to_converge", "sessions",
+        "converged_sessions", "last_converged_ts", "delta_ratios",
+    )
+
+    def __init__(self):
+        self.divergence = 0
+        self.objects = 0
+        self.rounds_to_converge = 0
+        self.sessions = 0
+        self.converged_sessions = 0
+        self.last_converged_ts: Optional[float] = None
+        self.delta_ratios: deque = deque(maxlen=_HISTORY)
+
+
+class ConvergenceTracker:
+    """Per-peer convergence state, mirrored into registry gauges."""
+
+    def __init__(self, registry: Optional[metrics.MetricsRegistry] = None):
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._peers: Dict[str, _PeerState] = {}
+
+    def _reg(self) -> metrics.MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else metrics.registry()
+
+    def _state(self, peer: str) -> _PeerState:
+        st = self._peers.get(peer)
+        if st is None:
+            st = self._peers[peer] = _PeerState()
+        return st
+
+    def observe_divergence(self, peer: str, diverged: int,
+                           objects: int) -> None:
+        """Record one digest exchange's outcome vs ``peer``: ``diverged``
+        of ``objects`` fleet rows differ."""
+        with self._lock:
+            st = self._state(peer)
+            st.divergence = int(diverged)
+            st.objects = int(objects)
+        reg = self._reg()
+        reg.gauge_set(f"sync.peer.{peer}.divergence", diverged)
+        reg.gauge_set(
+            f"sync.peer.{peer}.divergence_frac",
+            diverged / objects if objects else 0.0,
+        )
+
+    def observe_session(self, peer: str, *, converged: bool, rounds: int,
+                        payload_bytes: int = 0,
+                        full_state_bytes: Optional[int] = None) -> None:
+        """Record one finished session vs ``peer``.  ``rounds`` is the
+        session's digest-exchange count; ``payload_bytes`` over
+        ``full_state_bytes`` (when known) is the live delta_ratio."""
+        ratio = None
+        if full_state_bytes:
+            ratio = payload_bytes / full_state_bytes
+        with self._lock:
+            st = self._state(peer)
+            st.sessions += 1
+            st.rounds_to_converge = int(rounds)
+            if converged:
+                st.converged_sessions += 1
+                st.last_converged_ts = time.monotonic()
+            if ratio is not None:
+                st.delta_ratios.append(ratio)
+        reg = self._reg()
+        reg.gauge_set(f"sync.peer.{peer}.rounds_to_converge", rounds)
+        if converged:
+            reg.gauge_set(f"sync.peer.{peer}.staleness_s", 0.0)
+        if ratio is not None:
+            reg.gauge_set(f"sync.peer.{peer}.delta_ratio", ratio)
+
+    def refresh(self) -> None:
+        """Recompute the read-time gauges (staleness ages).  The export
+        surface calls this before every scrape so ``staleness_s`` is the
+        live age of the last converged sync, not a stale write."""
+        now = time.monotonic()
+        with self._lock:
+            ages = {
+                peer: now - st.last_converged_ts
+                for peer, st in self._peers.items()
+                if st.last_converged_ts is not None
+            }
+        reg = self._reg()
+        for peer, age in ages.items():
+            reg.gauge_set(f"sync.peer.{peer}.staleness_s", age)
+
+    def snapshot(self) -> dict:
+        """JSON-ready per-peer state, staleness computed at call time."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                peer: {
+                    "divergence": st.divergence,
+                    "objects": st.objects,
+                    "divergence_frac": (
+                        st.divergence / st.objects if st.objects else 0.0
+                    ),
+                    "rounds_to_converge": st.rounds_to_converge,
+                    "sessions": st.sessions,
+                    "converged_sessions": st.converged_sessions,
+                    "staleness_s": (
+                        None if st.last_converged_ts is None
+                        else now - st.last_converged_ts
+                    ),
+                    "delta_ratio_history": list(st.delta_ratios),
+                }
+                for peer, st in self._peers.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._peers.clear()
+
+
+# -- the default (process-global) tracker ------------------------------------
+
+_DEFAULT = ConvergenceTracker()
+
+
+def tracker() -> ConvergenceTracker:
+    return _DEFAULT

@@ -1,0 +1,119 @@
+"""Flight recorder — a bounded ring buffer of structured events.
+
+Metrics answer "how much, how fast"; they cannot answer "what happened
+just before this sync failed".  The flight recorder keeps the last N
+structured events — sync phase transitions, digest collisions,
+full-state fallbacks, ``SyncProtocolError``\\s, native-parse fallback
+reasons, wire-loop stalls — stamped with monotonic time and, where one
+exists, the :class:`~crdt_tpu.sync.session.SyncSession` session ID, so
+a failed session's whole trajectory can be read back from ``/events``
+(or :func:`snapshot` in a debugger) after the fact.
+
+Bounded by design: the buffer is a ``deque(maxlen=...)`` so a chatty
+instrument can never grow memory — old events fall off the front and
+the ``dropped`` count says how many did.  Appends are a deque push
+under a lock (deque appends are O(1) and never resize), cheap enough
+to leave always-on next to the counters.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class FlightRecorder:
+    """The bounded event ring.  ``capacity`` is the number of retained
+    events; the default keeps a few complete sync sessions' worth."""
+
+    def __init__(self, capacity: int = 2048):
+        if capacity < 1:
+            raise ValueError(f"capacity {capacity} < 1")
+        self.capacity = capacity
+        self._buf: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._recorded = 0
+
+    def record(self, kind: str, session: Optional[str] = None,
+               **fields) -> None:
+        """Append one event.  ``kind`` is a dotted event family
+        (``sync.phase``, ``wireloop.stall``); ``session`` threads a sync
+        session ID through; ``fields`` is free-form JSON-ready detail."""
+        ev = {
+            "seq": 0,  # patched under the lock
+            "ts": time.monotonic(),
+            "wall": time.time(),
+            "kind": kind,
+        }
+        if session is not None:
+            ev["session"] = session
+        if fields:
+            ev["fields"] = fields
+        with self._lock:
+            self._seq += 1
+            self._recorded += 1
+            ev["seq"] = self._seq
+            self._buf.append(ev)
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted by the ring bound since the last :meth:`clear`."""
+        with self._lock:
+            return self._recorded - len(self._buf)
+
+    def snapshot(self, kind: Optional[str] = None,
+                 session: Optional[str] = None) -> List[Dict]:
+        """Retained events oldest-first, optionally filtered by ``kind``
+        prefix (``kind="sync"`` matches ``sync.phase``) and/or exact
+        ``session`` ID.  Returns copies — callers may mutate freely."""
+        with self._lock:
+            evs = list(self._buf)
+        out = []
+        for ev in evs:
+            if kind is not None and not (
+                ev["kind"] == kind or ev["kind"].startswith(kind + ".")
+            ):
+                continue
+            if session is not None and ev.get("session") != session:
+                continue
+            out.append(dict(ev))
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._recorded = 0
+
+
+# -- the default (process-global) recorder -----------------------------------
+
+_DEFAULT = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _DEFAULT
+
+
+def record(kind: str, session: Optional[str] = None, **fields) -> None:
+    """Append one event to the process-global flight recorder."""
+    _DEFAULT.record(kind, session=session, **fields)
+
+
+# -- session IDs -------------------------------------------------------------
+
+_SESSION_SEQ = itertools.count(1)
+# a per-process random component so two peer processes syncing the same
+# fleet never mint the same ID (the whole point of threading session IDs
+# through the recorder is telling their event streams apart)
+_PROC_TAG = os.urandom(3).hex()
+
+
+def new_session_id() -> str:
+    """A short, process-unique session ID (``sync-<proc>-<n>``) for
+    stamping one :class:`~crdt_tpu.sync.session.SyncSession`'s events."""
+    return f"sync-{_PROC_TAG}-{next(_SESSION_SEQ):04x}"

@@ -1,0 +1,200 @@
+"""Export surfaces: Prometheus text, JSON snapshots, a live HTTP thread.
+
+Three consumers, three shapes:
+
+* :func:`prometheus_text` — text exposition (format 0.0.4) of the
+  metric registry under the ``crdt_tpu_`` namespace: counters as
+  ``*_total``, gauges bare, histograms as ``_bucket``/``_sum``/
+  ``_count`` with power-of-two ``le`` bounds.  Dotted metric names
+  sanitize to underscores at scrape time so hot paths never pay for it.
+* :func:`json_snapshot` — one dict with the registry snapshot, the
+  flight-recorder events, and the per-peer convergence state; what
+  ``bench.py`` embeds in the artifact tail and ``/events`` serves.
+* :class:`MetricsServer` / :func:`start_metrics_server` — an opt-in,
+  stdlib-only background HTTP thread serving ``GET /metrics`` (Prom
+  text), ``GET /events`` (JSON; ``?session=`` / ``?kind=`` filters) and
+  ``GET /healthz``.  Daemon threads throughout: an exporter must never
+  keep a replica process alive or take it down — handler errors are
+  swallowed into 500s and ``stop()`` is idempotent.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from . import convergence, events, metrics
+
+PROM_PREFIX = "crdt_tpu"
+
+_SAN = {ord(c): "_" for c in ".-/ "}
+
+
+def _sanitize(name: str) -> str:
+    """Dotted metric name → Prometheus-legal metric name body."""
+    out = name.translate(_SAN)
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in out)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare, floats via repr."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_text(registry: Optional[metrics.MetricsRegistry] = None,
+                    prefix: str = PROM_PREFIX) -> str:
+    """The registry as Prometheus text exposition.  Also refreshes the
+    read-time convergence gauges (staleness ages) first, so a scrape
+    sees live ages."""
+    convergence.tracker().refresh()
+    reg = registry if registry is not None else metrics.registry()
+    snap = reg.snapshot()
+    lines = []
+    for name in sorted(snap["counters"]):
+        mname = f"{prefix}_{_sanitize(name)}_total"
+        lines.append(f"# TYPE {mname} counter")
+        lines.append(f"{mname} {_fmt(snap['counters'][name])}")
+    for name in sorted(snap["gauges"]):
+        mname = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname} {_fmt(snap['gauges'][name])}")
+    for name in sorted(snap["histograms"]):
+        h = snap["histograms"][name]
+        mname = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# TYPE {mname} histogram")
+        running = 0
+        import math
+
+        for e in sorted(h["buckets"]):
+            running += h["buckets"][e]
+            bound = 0.0 if e == metrics.Histogram.ZERO_BUCKET \
+                else math.ldexp(1.0, e)
+            lines.append(
+                f'{mname}_bucket{{le="{_fmt(bound)}"}} {running}'
+            )
+        lines.append(f'{mname}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{mname}_sum {_fmt(h['sum'])}")
+        lines.append(f"{mname}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def json_snapshot(registry: Optional[metrics.MetricsRegistry] = None) -> dict:
+    """One JSON-ready dict: metrics + flight-recorder events + per-peer
+    convergence state (what ``/events`` and the bench artifact embed)."""
+    reg = registry if registry is not None else metrics.registry()
+    rec = events.recorder()
+    return {
+        "metrics": reg.snapshot(),
+        "events": rec.snapshot(),
+        "events_dropped": rec.dropped,
+        "convergence": convergence.tracker().snapshot(),
+    }
+
+
+# ---- the background HTTP exporter ------------------------------------------
+
+
+class MetricsServer:
+    """A daemon HTTP thread serving ``/metrics``, ``/events``,
+    ``/healthz`` on localhost.  Construct via
+    :func:`start_metrics_server`; ``port`` is the bound port (useful
+    with ``port=0``), ``scrapes`` counts GETs per path (a peer that
+    wants to linger "until someone scraped me" — the TCP example's
+    ``--linger`` — polls it)."""
+
+    def __init__(self, host: str, port: int,
+                 registry: Optional[metrics.MetricsRegistry] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self._registry = registry
+        self._t0 = time.monotonic()
+        self.scrapes: dict = {}
+        self._scrape_lock = threading.Lock()
+        server_self = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # the exporter must be silent
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype, status = server_self._render(self.path)
+                except Exception as e:  # noqa: BLE001 — a scrape bug
+                    # must 500, never kill the serving thread
+                    body = f"exporter error: {type(e).__name__}: {e}\n".encode()
+                    ctype, status = "text/plain; charset=utf-8", 500
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _render(self, path: str) -> tuple:
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(path)
+        route = parsed.path.rstrip("/") or "/"
+        with self._scrape_lock:
+            self.scrapes[route] = self.scrapes.get(route, 0) + 1
+        if route == "/metrics":
+            text = prometheus_text(self._registry)
+            return text.encode(), "text/plain; version=0.0.4; charset=utf-8", 200
+        if route == "/events":
+            q = parse_qs(parsed.query)
+            rec = events.recorder()
+            evs = rec.snapshot(
+                kind=q.get("kind", [None])[0],
+                session=q.get("session", [None])[0],
+            )
+            body = json.dumps({
+                "events": evs,
+                "dropped": rec.dropped,
+                "convergence": convergence.tracker().snapshot(),
+            }).encode()
+            return body, "application/json", 200
+        if route == "/healthz":
+            body = json.dumps({
+                "status": "ok",
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+            }).encode()
+            return body, "application/json", 200
+        return b"not found (try /metrics, /events, /healthz)\n", \
+            "text/plain; charset=utf-8", 404
+
+    def scraped(self, *routes: str) -> bool:
+        """True once every named route has been GET'd at least once."""
+        with self._scrape_lock:
+            return all(self.scrapes.get(r, 0) > 0 for r in routes)
+
+    def stop(self) -> None:
+        """Shut the exporter down; idempotent."""
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:  # noqa: BLE001 — double-stop must be a no-op
+            pass
+        self._thread.join(timeout=5)
+
+
+def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
+                         registry: Optional[metrics.MetricsRegistry] = None
+                         ) -> MetricsServer:
+    """Start the opt-in background exporter; ``port=0`` picks a free
+    port (read it back from ``server.port``)."""
+    return MetricsServer(host, port, registry)

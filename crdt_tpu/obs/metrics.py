@@ -1,0 +1,237 @@
+"""Typed metric registry — counters, gauges, log2-bucketed histograms.
+
+The flat span/counter dicts in :mod:`crdt_tpu.utils.tracing` only become
+legible when ``bench.py`` diffs snapshots after the fact; a live export
+surface (:mod:`crdt_tpu.obs.export`) needs metrics with *types*, because
+a Prometheus scrape renders a counter, a gauge, and a histogram
+differently and a consumer alerts on them differently:
+
+* :class:`Counter` — monotonically increasing event counts (the
+  always-on ``wire.*`` native-vs-fallback accounting, sync frame
+  bytes).  Resets only with the registry.
+* :class:`Gauge` — a point-in-time level (wire-loop staging-pool
+  occupancy, parse-queue depth, per-peer digest divergence).  Last
+  write wins.
+* :class:`Histogram` — log2-bucketed distributions (span latencies,
+  sync frame sizes).  Power-of-two buckets make ``observe`` one
+  ``frexp`` + dict increment — cheap enough to stay always-on — while
+  still answering "how many syncs took >128 ms" from the export.
+
+Everything here is dependency-free, thread-safe (one registry lock —
+observations are single dict updates, so contention is negligible next
+to the work being measured) and import-light: no JAX, no numpy.  The
+existing :mod:`crdt_tpu.utils.tracing` API re-routes into the default
+registry, so every current ``span``/``count``/``record_sync``/
+``record_wire`` call site feeds this module with no churn at the call
+sites (see ``Tracer.forward_metrics``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+
+class Counter:
+    """A monotonically increasing event count."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += int(n)
+
+
+class Gauge:
+    """A point-in-time level; last write wins."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += float(n)
+
+
+class Histogram:
+    """Log2-bucketed distribution: bucket ``e`` counts observations in
+    ``(2**(e-1), 2**e]``.  Non-positive observations land in a floor
+    bucket (exponent :data:`ZERO_BUCKET`) so a zero-length span is
+    counted, not lost.  Sum/count/min/max ride along so the export can
+    emit Prometheus ``_sum``/``_count`` and the mean survives bucketing.
+    """
+
+    ZERO_BUCKET = -1075  # below the smallest subnormal double's exponent
+
+    __slots__ = ("name", "count", "sum", "min", "max", "buckets")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.buckets: Dict[int, int] = {}
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if v > 0.0:
+            # frexp: v = m * 2**e with 0.5 <= m < 1, so 2**(e-1) < v <= 2**e
+            e = math.frexp(v)[1]
+        else:
+            e = self.ZERO_BUCKET
+        self.buckets[e] = self.buckets.get(e, 0) + 1
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+
+    def cumulative(self) -> Iterator[Tuple[float, int]]:
+        """``(upper_bound, cumulative_count)`` pairs in ascending bound
+        order — the Prometheus ``le`` series (without the +Inf bucket,
+        which equals :attr:`count`)."""
+        running = 0
+        for e in sorted(self.buckets):
+            running += self.buckets[e]
+            bound = 0.0 if e == self.ZERO_BUCKET else math.ldexp(1.0, e)
+            yield bound, running
+
+
+class MetricsRegistry:
+    """One process's named metrics, behind one lock.
+
+    Names are free-form dotted strings (``wire.sync.digest.bytes``);
+    the Prometheus exporter sanitizes them at scrape time, so hot paths
+    never pay for name mangling.  A name is permanently one type —
+    re-registering ``x`` as a gauge after counting it raises, because a
+    silent type flip would corrupt the export.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def _claim(self, name: str, kind: str) -> None:
+        for other_kind, table in (("counter", self._counters),
+                                  ("gauge", self._gauges),
+                                  ("histogram", self._histograms)):
+            if other_kind != kind and name in table:
+                raise ValueError(
+                    f"metric {name!r} is already a {other_kind}, "
+                    f"cannot re-register as a {kind}"
+                )
+
+    # -- typed handles (hot paths hold these to skip the dict lookup) --------
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                self._claim(name, "counter")
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                self._claim(name, "gauge")
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                self._claim(name, "histogram")
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    # -- one-shot observations ------------------------------------------------
+
+    def counter_inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                self._claim(name, "counter")
+                c = self._counters[name] = Counter(name)
+            c.inc(n)
+
+    def gauge_set(self, name: str, v: float) -> None:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                self._claim(name, "gauge")
+                g = self._gauges[name] = Gauge(name)
+            g.set(v)
+
+    def observe(self, name: str, v: float) -> None:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                self._claim(name, "histogram")
+                h = self._histograms[name] = Histogram(name)
+            h.observe(v)
+
+    # -- snapshots ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """A JSON-ready consistent copy: ``{"counters": {name: int},
+        "gauges": {name: float}, "histograms": {name: {count, sum, min,
+        max, buckets: {exponent: count}}}}`` — taken under the lock, so
+        a scrape concurrent with writers never sees a torn histogram."""
+        with self._lock:
+            return {
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {
+                    k: {
+                        "count": h.count,
+                        "sum": h.sum,
+                        "min": (None if h.count == 0 else h.min),
+                        "max": (None if h.count == 0 else h.max),
+                        "buckets": dict(h.buckets),
+                    }
+                    for k, h in self._histograms.items()
+                },
+            }
+
+    def counters_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: c.value for k, c in self._counters.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+# -- the default (process-global) registry -----------------------------------
+
+_DEFAULT: Optional[MetricsRegistry] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry every always-on instrument feeds and
+    the ``/metrics`` exporter scrapes."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = MetricsRegistry()
+    return _DEFAULT

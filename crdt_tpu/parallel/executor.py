@@ -33,7 +33,17 @@ import time
 from typing import Any, Optional, Sequence
 
 from ..error import CapacityOverflowError
+from ..obs import events as obs_events
 from ..utils import tracing
+
+
+def _record_recovery(kind: str, **fields) -> None:
+    """Executor recoveries (regrows, transient requeues) are rare and
+    diagnostic-grade: count them always-on AND leave a flight-recorder
+    event, so a fleet that silently regrew mid-join shows up on
+    ``/events`` with the capacities it regrew to."""
+    tracing.count(f"executor.{kind}")
+    obs_events.record(f"executor.{kind}", **fields)
 
 
 @dataclasses.dataclass
@@ -194,6 +204,9 @@ class JoinExecutor:
                             f"deferred_capacity={d})"
                         ) from overflow
                     stats.overflow_regrows += 1
+                    _record_recovery("regrow", schedule="tree",
+                                     member_capacity=new_m,
+                                     deferred_capacity=new_d)
                     with tracing.span("executor.regrow"):
                         batches = [b.with_capacity(new_m, new_d) for b in batches]
                 except RuntimeError as transient:
@@ -207,6 +220,9 @@ class JoinExecutor:
                             f"tree join failed after {self.max_retries} retries"
                         ) from transient
                     stats.transient_retries += 1
+                    _record_recovery("transient_retry", schedule="tree",
+                                     attempt=retries,
+                                     error=str(transient)[:200])
                     if self.retry_backoff_s > 0:
                         time.sleep(self.retry_backoff_s * (2 ** (retries - 1)))
 
@@ -253,6 +269,9 @@ class JoinExecutor:
                         f"(member_capacity={m}, deferred_capacity={d})"
                     ) from overflow
                 stats.overflow_regrows += 1
+                _record_recovery("regrow", schedule="sequential",
+                                 member_capacity=new_m,
+                                 deferred_capacity=new_d)
                 with tracing.span("executor.regrow"):
                     acc = acc.with_capacity(new_m, new_d)
                     nxt = nxt.with_capacity(new_m, new_d)
@@ -269,6 +288,9 @@ class JoinExecutor:
                         f"join failed after {self.max_retries} retries"
                     ) from transient
                 stats.transient_retries += 1
+                _record_recovery("transient_retry", schedule="sequential",
+                                 attempt=retries,
+                                 error=str(transient)[:200])
                 if self.retry_backoff_s > 0:
                     time.sleep(self.retry_backoff_s * (2 ** (retries - 1)))
 

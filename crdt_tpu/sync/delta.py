@@ -49,35 +49,58 @@ def _frame(ftype: int, payload: bytes) -> bytes:
     ) + payload
 
 
+def _reject(reason: str, message: str) -> "SyncProtocolError":
+    """A :class:`SyncProtocolError` carrying flight-recorder evidence:
+    every rejected frame leaves a ``sync.protocol_error`` event and a
+    ``sync.frame.rejected.<reason>`` counter before the raise, so a
+    misbehaving peer is visible on ``/events`` even when the caller
+    catches and drops the error (the I/O-boundary discipline
+    :class:`SyncProtocolError` documents)."""
+    from ..obs import events as obs_events
+    from ..utils import tracing
+
+    tracing.count(f"sync.frame.rejected.{reason}")
+    obs_events.record("sync.protocol_error", reason=reason,
+                      error=message[:200])
+    return SyncProtocolError(message)
+
+
 def decode_frame(frame: bytes) -> tuple[int, bytes]:
     """``(frame_type, payload)`` of a validated frame.  Raises
     :class:`SyncProtocolError` on a version mismatch, unknown frame
     type, truncated/overlong frame, or CRC mismatch — the caller never
     sees a payload that could misparse downstream."""
+    from ..utils import tracing
+
     if len(frame) < _HEADER.size:
-        raise SyncProtocolError(
+        raise _reject(
+            "truncated",
             f"truncated sync frame: {len(frame)} bytes < "
             f"{_HEADER.size}-byte header"
         )
     version, ftype, crc, plen = _HEADER.unpack_from(frame)
     if version != PROTOCOL_VERSION:
-        raise SyncProtocolError(
+        raise _reject(
+            "version_mismatch",
             f"sync protocol version mismatch: peer sent v{version}, "
             f"this build speaks v{PROTOCOL_VERSION}"
         )
     if ftype not in _FRAME_NAMES:
-        raise SyncProtocolError(f"unknown sync frame type {ftype:#04x}")
+        raise _reject("unknown_type", f"unknown sync frame type {ftype:#04x}")
     payload = frame[_HEADER.size:]
     if len(payload) != plen:
-        raise SyncProtocolError(
+        raise _reject(
+            "length_mismatch",
             f"sync frame length mismatch: header says {plen} payload "
             f"bytes, frame carries {len(payload)}"
         )
     if zlib.crc32(payload) != crc:
-        raise SyncProtocolError(
+        raise _reject(
+            "crc_mismatch",
             f"sync {_FRAME_NAMES[ftype]} frame CRC mismatch "
             "(tampered or corrupted in transit)"
         )
+    tracing.count(f"sync.frame.{_FRAME_NAMES[ftype]}.decoded")
     return ftype, payload
 
 

@@ -25,6 +25,16 @@ Wire cost is O(divergence): an idempotent re-sync costs one digest
 exchange and zero delta bytes.  Every phase feeds the always-on
 ``wire.sync.*`` counters (:mod:`crdt_tpu.utils.tracing`) so the bench
 artifact reports ``delta_ratio`` next to ``native_fraction``.
+
+Observability: each session mints a session ID
+(:func:`crdt_tpu.obs.events.new_session_id`) and writes its phase
+transitions, digest collisions, full-state fallbacks and protocol
+errors into the flight recorder (:mod:`crdt_tpu.obs.events`), stamped
+with that ID — read them back from ``GET /events?session=...`` or
+:func:`crdt_tpu.obs.recorder`.  Phase wall times land in the span
+histograms when tracing is enabled, and per-peer divergence /
+rounds-to-converge / staleness gauges feed
+:mod:`crdt_tpu.obs.convergence` always.
 """
 
 from __future__ import annotations
@@ -35,6 +45,8 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..error import SyncProtocolError
+from ..obs import convergence as obs_convergence
+from ..obs import events as obs_events
 from ..utils import tracing
 from . import delta as delta_mod
 from . import digest as digest_mod
@@ -100,12 +112,16 @@ class SyncSession:
     canonical :func:`crdt_tpu.sync.digest.digest_of`, which is what
     lets a collided delta pass fall back to full state and still
     converge.
+    ``peer`` labels this session's convergence gauges
+    (``sync.peer.<peer>.*``); unnamed sessions share the ``"peer"``
+    label.  ``session_id`` stamps every flight-recorder event.
     """
 
     def __init__(self, batch, universe, *,
                  full_state_threshold: float = 0.5,
                  full_state: bool = False,
-                 digest_fn: Optional[Callable] = None):
+                 digest_fn: Optional[Callable] = None,
+                 peer: Optional[str] = None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -114,8 +130,14 @@ class SyncSession:
         self.universe = universe
         self.full_state_threshold = full_state_threshold
         self.full_state = full_state
+        self.peer = peer or "peer"
+        self.session_id = obs_events.new_session_id()
         self._digest_fn = digest_fn or digest_mod.digest_of
         self._applier = OrswotDeltaApplier(universe)
+
+    def _event(self, kind: str, **fields) -> None:
+        obs_events.record(kind, session=self.session_id, peer=self.peer,
+                          **fields)
 
     # -- frame plumbing ------------------------------------------------------
 
@@ -150,16 +172,17 @@ class SyncSession:
 
     def _exchange_digests(self, send, recv, report: SyncReport,
                           digest_fn) -> tuple[np.ndarray, np.ndarray]:
-        mine = np.asarray(digest_fn(self.batch), dtype=np.uint64)
-        vv = digest_mod.version_vector(self.batch)
-        self._send(send, encode_digest_frame(mine, vv), report, "digest",
-                   mine.shape[0])
-        ftype, payload = self._recv(recv, report)
-        if ftype != FRAME_DIGEST:
-            raise SyncProtocolError(
-                f"expected a digest frame, peer sent type {ftype:#04x}"
-            )
-        theirs, _peer_vv = decode_digest_payload(payload)
+        with tracing.span("sync.digest_exchange"):
+            mine = np.asarray(digest_fn(self.batch), dtype=np.uint64)
+            vv = digest_mod.version_vector(self.batch)
+            self._send(send, encode_digest_frame(mine, vv), report, "digest",
+                       mine.shape[0])
+            ftype, payload = self._recv(recv, report)
+            if ftype != FRAME_DIGEST:
+                raise SyncProtocolError(
+                    f"expected a digest frame, peer sent type {ftype:#04x}"
+                )
+            theirs, _peer_vv = decode_digest_payload(payload)
         report.digest_rounds += 1
         return mine, theirs
 
@@ -198,14 +221,49 @@ class SyncSession:
              recv: Callable[[], bytes]) -> SyncReport:
         """Run the session to convergence (or raise).  Returns the
         per-phase :class:`SyncReport`; the reconciled fleet is
-        ``self.batch``."""
+        ``self.batch``.
+
+        Protocol errors are written to the flight recorder (kind
+        ``sync.error``, stamped with this session's ID) before they
+        propagate, so a failed session's last event explains the raise.
+        """
+        try:
+            report = self._sync(send, recv)
+        except SyncProtocolError as e:
+            tracing.count("sync.errors")
+            self._event("sync.error", error=str(e)[:200])
+            raise
+        obs_convergence.tracker().observe_session(
+            self.peer, converged=report.converged,
+            rounds=report.digest_rounds,
+            payload_bytes=report.delta_bytes_sent + report.full_bytes_sent,
+        )
+        self._event(
+            "sync.phase", phase="converged", rounds=report.digest_rounds,
+            diverged=report.diverged,
+            full_state_fallback=report.full_state_fallback,
+        )
+        return report
+
+    def _fallback(self, report: SyncReport, reason: str) -> None:
+        report.full_state_fallback = True
+        tracing.count("sync.full_state_fallback")
+        tracing.count(f"sync.full_state_fallback.{reason}")
+        self._event("sync.full_state_fallback", reason=reason)
+
+    def _sync(self, send, recv) -> SyncReport:
         report = SyncReport(objects=self._n())
+        tracing.count("sync.sessions")
+        self._event("sync.phase", phase="start", objects=report.objects,
+                    mode="full_state" if self.full_state else "delta")
 
         if self.full_state:
             # legacy mode: full state both ways, digest-verified
-            report.full_state_fallback = True
-            self._send_full(send, report)
-            self._apply_frame(*self._recv(recv, report))
+            self._fallback(report, "requested")
+            with tracing.span("sync.full_state_exchange"):
+                self._send_full(send, report)
+                self._apply_frame(*self._recv(recv, report))
+            self._event("sync.phase", phase="converged_check")
             mine, theirs = self._exchange_digests(
                 send, recv, report, digest_mod.digest_of
             )
@@ -218,11 +276,15 @@ class SyncSession:
             return report
 
         # phase 1: digest exchange
+        self._event("sync.phase", phase="digest_exchange")
         mine, theirs = self._exchange_digests(
             send, recv, report, self._digest_fn
         )
         diverged = diverged_indices(mine, theirs)
         report.diverged = int(diverged.size)
+        obs_convergence.tracker().observe_divergence(
+            self.peer, report.diverged, report.objects
+        )
         canonical = self._digest_fn is digest_mod.digest_of
         if diverged.size == 0 and canonical:
             # idempotent re-sync: one digest exchange, zero delta bytes.
@@ -237,20 +299,28 @@ class SyncSession:
             # so both peers take the same branch
             n = report.objects
             if n and diverged.size / n > self.full_state_threshold:
-                report.full_state_fallback = True
-                self._send_full(send, report)
+                self._fallback(report, "threshold")
+                self._event("sync.phase", phase="full_state_exchange",
+                            diverged=report.diverged)
+                with tracing.span("sync.full_state_exchange"):
+                    self._send_full(send, report)
+                    self._apply_frame(*self._recv(recv, report))
             else:
-                blobs = gather_blobs(self.batch, diverged, self.universe)
-                report.delta_objects_sent = len(blobs)
-                self._send(send, encode_delta_frame(n, diverged, blobs),
-                           report, "delta", len(blobs))
-            self._apply_frame(*self._recv(recv, report))
+                self._event("sync.phase", phase="delta_exchange",
+                            diverged=report.diverged)
+                with tracing.span("sync.delta_exchange"):
+                    blobs = gather_blobs(self.batch, diverged, self.universe)
+                    report.delta_objects_sent = len(blobs)
+                    self._send(send, encode_delta_frame(n, diverged, blobs),
+                               report, "delta", len(blobs))
+                    self._apply_frame(*self._recv(recv, report))
         # else: a non-canonical phase-1 digest saw nothing to ship —
         # both peers skip straight to the canonical verify, whose
         # mismatch path (below) is what catches collisions
 
         # phase 3: converged check with the CANONICAL digest (a phase-1
         # digest_fn override must not be able to fake convergence)
+        self._event("sync.phase", phase="converged_check")
         mine, theirs = self._exchange_digests(
             send, recv, report, digest_mod.digest_of
         )
@@ -260,9 +330,14 @@ class SyncSession:
 
         # digest mismatch after delta apply: 64-bit collision in phase 1
         # or digest-mode skew — retry with full state, which must land
-        report.full_state_fallback = True
-        self._send_full(send, report)
-        self._apply_frame(*self._recv(recv, report))
+        tracing.count("sync.digest_collision")
+        self._event("sync.digest_collision",
+                    mismatched=int(np.count_nonzero(mine != theirs)))
+        self._fallback(report, "digest_collision")
+        self._event("sync.phase", phase="full_state_retry")
+        with tracing.span("sync.full_state_exchange"):
+            self._send_full(send, report)
+            self._apply_frame(*self._recv(recv, report))
         mine, theirs = self._exchange_digests(
             send, recv, report, digest_mod.digest_of
         )

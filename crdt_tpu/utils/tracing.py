@@ -22,6 +22,14 @@ provides both, dependency-free:
 Everything is opt-in and zero-cost when unused; the global tracer is
 disabled by default and enabled with :func:`enable` (or the
 ``CRDT_TRACE=1`` environment variable, read at import).
+
+The global tracer also re-routes every observation into the typed
+metric registry (:mod:`crdt_tpu.obs.metrics`): spans feed latency
+histograms, counters feed registry counters — so each existing
+``span``/``count``/``record_sync``/``record_wire`` call site shows up
+on the live ``/metrics`` surface with no churn here.  Bare ``Tracer``
+instances (tests, scoped measurements) do NOT forward unless
+constructed with ``forward_metrics=True``.
 """
 
 from __future__ import annotations
@@ -89,12 +97,28 @@ class Tracer:
     enabled: bool = True
     stats: Dict[str, SpanStats] = field(default_factory=dict)
     counts: Dict[str, int] = field(default_factory=dict)
+    # re-route observations into the typed obs registry (the global
+    # tracer sets this, so every legacy call site feeds /metrics)
+    forward_metrics: bool = False
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _reg: Any = field(default=None, repr=False)
+
+    def _registry(self):
+        # cached: count() is always-on, so the import-machinery lookup
+        # must be paid once, not per increment
+        if self._reg is None:
+            from ..obs import metrics as obs_metrics
+
+            self._reg = obs_metrics.registry()
+        return self._reg
 
     def add(self, name: str, dt: float, nbytes: int = 0) -> None:
         """Record one observation for ``name`` (thread-safe)."""
         with self._lock:
             self.stats.setdefault(name, SpanStats()).add(dt, nbytes)
+        if self.forward_metrics:
+            # span latency histogram (log2 buckets), seconds
+            self._registry().observe(name, dt)
 
     def count(self, name: str, n: int = 1) -> None:
         """Add ``n`` to the event counter ``name`` (thread-safe).
@@ -106,6 +130,8 @@ class Tracer:
             return
         with self._lock:
             self.counts[name] = self.counts.get(name, 0) + int(n)
+        if self.forward_metrics:
+            self._registry().counter_inc(name, int(n))
 
     def counters(self) -> Dict[str, int]:
         """A snapshot copy of all event counters."""
@@ -142,20 +168,27 @@ class Tracer:
             counter_rows = sorted(self.counts.items())
         if not rows and not counter_rows:
             return "(no spans recorded)"
+        # the name column widens to the longest name so long span names
+        # (wire.sync.*) never tear the table out of alignment
+        cw = max(
+            [48] + [len(name) for name, _ in counter_rows]
+        ) if counter_rows else 48
         if not rows:
-            return "\n".join(f"{name:<48} {n:>12}" for name, n in counter_rows)
+            return "\n".join(f"{name:<{cw}} {n:>12}" for name, n in counter_rows)
+        w = max([32] + [len(name) for name, _ in rows])
         lines = [
-            f"{'span':<32} {'count':>7} {'total':>10} {'mean':>10} "
+            f"{'span':<{w}} {'count':>7} {'total':>10} {'mean':>10} "
             f"{'min':>10} {'max':>10} {'GB/s':>8}"
         ]
         for name, s in rows:
             gbps = f"{s.gbps:>7.2f}" if s.bytes_total else f"{'—':>7}"
             lines.append(
-                f"{name:<32} {s.count:>7} {s.total_s*1e3:>9.2f}ms "
+                f"{name:<{w}} {s.count:>7} {s.total_s*1e3:>9.2f}ms "
                 f"{s.mean_s*1e3:>9.3f}ms {s.min_s*1e3:>9.3f}ms "
                 f"{s.max_s*1e3:>9.3f}ms {gbps}"
             )
-        lines.extend(f"{name:<48} {n:>12}" for name, n in counter_rows)
+        cw = max(cw, w)
+        lines.extend(f"{name:<{cw}} {n:>12}" for name, n in counter_rows)
         return "\n".join(lines)
 
 
@@ -177,7 +210,8 @@ def _trace_annotation(name: str):
 
 # -- global tracer -----------------------------------------------------------
 
-_GLOBAL = Tracer(enabled=os.environ.get("CRDT_TRACE") == "1")
+_GLOBAL = Tracer(enabled=os.environ.get("CRDT_TRACE") == "1",
+                 forward_metrics=True)
 
 
 def get_tracer() -> Tracer:
@@ -236,9 +270,13 @@ def record_sync(leg: str, *, nbytes: int = 0, objects: int = 0) -> None:
     bench publishes as ``delta_ratio`` next to ``native_fraction``.
     One increment pair per FRAME, not per object, so it is free at any
     fleet scale (same discipline as :func:`record_wire
-    <crdt_tpu.batch.wirebulk.record_wire>`)."""
+    <crdt_tpu.batch.wirebulk.record_wire>`).  Each frame's size also
+    lands in a log2-bucketed histogram so the export answers "how big
+    are my delta frames" without a bench diff."""
     count(f"wire.sync.{leg}.bytes", nbytes)
     count(f"wire.sync.{leg}.objects", objects)
+    if _GLOBAL.forward_metrics:
+        _GLOBAL._registry().observe(f"wire.sync.{leg}.frame_bytes", nbytes)
 
 
 def delta_ratio(delta_bytes: int, full_state_bytes: int) -> Optional[float]:
@@ -278,18 +316,25 @@ def timed_kernel(name: Optional[str] = None, count_bytes: bool = False) -> Calla
                 return fn(*args, **kwargs)
             import jax
 
-            out = None
             t0 = time.perf_counter()
             try:
                 with _trace_annotation(label):
                     out = fn(*args, **kwargs)
                     jax.block_until_ready(out)
-                return out
-            finally:
+            except BaseException:
                 # record failing calls too — a raising kernel (overflow,
-                # device error) must not vanish from the report
-                nbytes = pytree_bytes(args, kwargs, out) if count_bytes else 0
+                # device error) must not vanish from the report.  Bytes
+                # cover INPUTS ONLY (outputs were never materialized,
+                # whether fn raised with out unbound or block_until_ready
+                # raised on a poisoned result), and the per-label errors
+                # counter makes a flaky kernel visible from the artifact.
+                nbytes = pytree_bytes(args, kwargs) if count_bytes else 0
                 _GLOBAL.add(label, time.perf_counter() - t0, nbytes)
+                _GLOBAL.count(f"kernel.{label}.errors")
+                raise
+            nbytes = pytree_bytes(args, kwargs, out) if count_bytes else 0
+            _GLOBAL.add(label, time.perf_counter() - t0, nbytes)
+            return out
 
         wrapped.__name__ = getattr(fn, "__name__", "kernel")
         wrapped.__doc__ = fn.__doc__

@@ -34,6 +34,17 @@ Run it:
     python examples/replicate_tcp.py --full-state       # legacy full state
     python examples/replicate_tcp.py --objects 1000 --divergence 0.01
 
+``--metrics-port N`` starts the live observability exporter
+(:mod:`crdt_tpu.obs`) in the peer process: ``GET /metrics`` is the
+Prometheus view of the ``wire.sync.*`` counters and phase latency
+histograms, ``GET /events`` is the flight recorder (filter to this
+session with ``?session=<id>`` — the peer prints its session ID), and
+``GET /healthz`` is the liveness probe.  ``--linger S`` keeps the
+exporter up for up to S seconds after the sync finishes (returning as
+soon as both ``/metrics`` and ``/events`` have been scraped), so a
+scraper — PERF.md's ``curl`` walkthrough, or the automated test — can
+read the final state before the process exits.
+
 (`--platform cpu` forces the CPU backend, e.g. when no TPU is
 reachable; the kernels are platform-agnostic.)
 """
@@ -105,7 +116,8 @@ def _build_fleet(n_objects: int, actor: int, divergence: float, seed: int):
 
 
 def peer(role: str, port: int, n_objects: int, platform: str | None,
-         full_state: bool = False, divergence: float = 0.05) -> str:
+         full_state: bool = False, divergence: float = 0.05,
+         metrics_port: int | None = None, linger_s: float = 0.0) -> str:
     import jax
 
     if platform:
@@ -115,6 +127,21 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
     from crdt_tpu.config import CrdtConfig
     from crdt_tpu.sync import SyncSession
     from crdt_tpu.utils.interning import Universe
+
+    metrics_server = None
+    if metrics_port is not None:
+        from crdt_tpu.obs import export as obs_export
+        from crdt_tpu.utils import tracing
+
+        # enable spans so sync phase latencies land in the histograms
+        # the exporter serves (counters/events are always-on anyway)
+        tracing.enable(True)
+        metrics_server = obs_export.start_metrics_server(port=metrics_port)
+        print(
+            f"{role}: metrics exporter on "
+            f"http://127.0.0.1:{metrics_server.port}/metrics",
+            flush=True,
+        )
 
     # identity universe: int actors/members -> the native C++ bulk codec
     # parses/serializes the blobs with zero host-side interning state
@@ -147,7 +174,8 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
                     raise
                 time.sleep(0.5)
 
-    session = SyncSession(mine, uni, full_state=full_state)
+    other = "client" if role == "server" else "server"
+    session = SyncSession(mine, uni, full_state=full_state, peer=other)
     with sock:
         report = session.sync(
             lambda frame: _send_frame(sock, frame),
@@ -158,10 +186,25 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
     mode = "full-state" if full_state else "delta"
     print(
         f"{role}: {n_objects} objects  mode={mode}  "
+        f"session={session.session_id}  "
         f"diverged={report.diverged}  delta_objects={report.delta_objects_sent}  "
         f"sent: digest={report.digest_bytes_sent}B delta="
-        f"{report.delta_bytes_sent}B full={report.full_bytes_sent}B  {status}"
+        f"{report.delta_bytes_sent}B full={report.full_bytes_sent}B  {status}",
+        flush=True,
     )
+    if metrics_server is not None and linger_s > 0:
+        # hold the exporter up until someone has read the final state
+        # (or the linger budget runs out) — a sync finishing in
+        # milliseconds must not close the scrape window with it
+        import time
+
+        deadline = time.monotonic() + linger_s
+        while time.monotonic() < deadline:
+            if metrics_server.scraped("/metrics", "/events"):
+                break
+            time.sleep(0.05)
+    if metrics_server is not None:
+        metrics_server.stop()
     return status
 
 
@@ -178,13 +221,21 @@ def main() -> int:
                          "digest-driven deltas")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform (e.g. cpu)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics, /events, /healthz on this port "
+                         "(crdt_tpu.obs exporter; server/client roles only)")
+    ap.add_argument("--linger", type=float, default=0.0,
+                    help="with --metrics-port: keep the exporter alive up "
+                         "to this many seconds after the sync (returns as "
+                         "soon as /metrics and /events were both scraped)")
     args = ap.parse_args()
 
     if args.role != "demo":
         if not args.port:
             ap.error("server/client roles need --port")
         status = peer(args.role, args.port, args.objects, args.platform,
-                      full_state=args.full_state, divergence=args.divergence)
+                      full_state=args.full_state, divergence=args.divergence,
+                      metrics_port=args.metrics_port, linger_s=args.linger)
         return 0 if status == "CONVERGED" else 1
 
     # demo: spawn both peers as real OS processes
@@ -201,7 +252,12 @@ def main() -> int:
         extra += ["--full-state"]
     if args.platform:
         extra += ["--platform", args.platform]
-    srv = subprocess.Popen(base + ["server"] + extra)
+    srv_extra = list(extra)
+    if args.metrics_port is not None:
+        # one exporter per process; in demo mode the server peer gets it
+        srv_extra += ["--metrics-port", str(args.metrics_port),
+                      "--linger", str(args.linger)]
+    srv = subprocess.Popen(base + ["server"] + srv_extra)
     cli = subprocess.Popen(base + ["client"] + extra)
     rc = srv.wait() | cli.wait()
     print("demo:", "CONVERGED" if rc == 0 else "DIVERGED/FAILED")

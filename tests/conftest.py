@@ -37,6 +37,11 @@ def pytest_configure(config):
         "markers",
         "sync: digest/delta anti-entropy subsystem tests (crdt_tpu.sync)",
     )
+    config.addinivalue_line(
+        "markers",
+        "obs: observability subsystem tests (crdt_tpu.obs — metrics "
+        "registry, flight recorder, exporter); tier-1 like `sync`",
+    )
 
 # hypothesis is an optional dependency of the property suites only: on
 # boxes without it the non-property tests must still collect and run, so

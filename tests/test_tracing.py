@@ -86,3 +86,98 @@ def test_profile_propagates_caller_exceptions(tmp_path):
 
 def test_empty_report():
     assert "no spans" in tracing.Tracer().report()
+
+
+def test_report_widens_to_longest_span_name():
+    """Span names longer than the old fixed 32-char column must not tear
+    the table: the name column widens to the longest name, so the count
+    field sits at the same offset on every row."""
+    tr = tracing.Tracer()
+    long = "wire.sync.full_state_exchange.with.an.absurdly.long.suffix"
+    assert len(long) > 32
+    tr.add(long, 0.001)
+    tr.add("short", 0.002)
+    lines = tr.report().splitlines()
+    header, row_a, row_b = lines[0], lines[1], lines[2]
+    w = len(long)  # the longest name defines the column width
+    assert header[:w].rstrip() == "span"
+    assert header[w:w + 8] == f" {'count':>7}"
+    row_long, row_short = (row_a, row_b) if row_a.startswith(long) \
+        else (row_b, row_a)
+    assert row_long[:w].rstrip() == long
+    assert row_short[:w].rstrip() == "short"
+    # both spans ran once: identical, aligned count fields
+    assert row_long[w:w + 8] == row_short[w:w + 8] == f" {1:>7}"
+
+
+def test_timed_kernel_failure_counts_inputs_only_and_errors():
+    """A raising kernel must record a span with INPUT bytes only plus a
+    per-label `kernel.<label>.errors` counter (satellite: failing calls
+    previously risked counting phantom output bytes)."""
+    tracing.reset()
+    tracing.enable(True)
+    try:
+        x = jnp.zeros((128,), jnp.uint32)
+
+        @tracing.timed_kernel("boomk", count_bytes=True)
+        def boomk(v):
+            raise RuntimeError("kernel exploded")
+
+        try:
+            boomk(x)
+        except RuntimeError:
+            pass
+        st = tracing.get_tracer().stats["boomk"]
+        assert st.count == 1
+        assert st.bytes_total == x.nbytes  # inputs only, no output bytes
+        assert tracing.counters()["kernel.boomk.errors"] == 1
+
+        # a successful call still counts inputs + outputs and no error
+        @tracing.timed_kernel("okk", count_bytes=True)
+        def okk(v):
+            return v + 1
+
+        okk(x)
+        st = tracing.get_tracer().stats["okk"]
+        assert st.bytes_total == 2 * x.nbytes
+        assert "kernel.okk.errors" not in tracing.counters()
+    finally:
+        tracing.enable(False)
+        tracing.reset()
+
+
+def test_global_tracer_forwards_into_obs_registry():
+    """The legacy span/count API re-routes into the typed obs registry
+    (the tentpole's no-churn contract): counters land as registry
+    counters, spans as log2 latency histograms."""
+    from crdt_tpu.obs import metrics as obs_metrics
+
+    tracing.reset()
+    reg = obs_metrics.registry()
+    tracing.count("wire.trace_forward_probe.native", 7)
+    snap = reg.snapshot()
+    assert snap["counters"]["wire.trace_forward_probe.native"] >= 7
+
+    tracing.enable(True)
+    try:
+        with tracing.span("trace_forward_probe.span"):
+            pass
+    finally:
+        tracing.enable(False)
+        tracing.reset()
+    h = reg.snapshot()["histograms"]["trace_forward_probe.span"]
+    assert h["count"] >= 1 and h["sum"] >= 0.0
+
+
+def test_bare_tracer_does_not_forward():
+    """Non-global Tracer instances stay self-contained — tests and
+    scoped measurements must not pollute the process registry."""
+    from crdt_tpu.obs import metrics as obs_metrics
+
+    tr = tracing.Tracer()
+    tr.count("bare_tracer_probe.counter", 3)
+    with tr.span("bare_tracer_probe.span"):
+        pass
+    snap = obs_metrics.registry().snapshot()
+    assert "bare_tracer_probe.counter" not in snap["counters"]
+    assert "bare_tracer_probe.span" not in snap["histograms"]

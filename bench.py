@@ -1072,8 +1072,8 @@ def bench_sync():
     full_bytes = sum(len(b) for b in fleet_a.to_wire(uni))
 
     counters0 = tracing.counters()
-    sa = SyncSession(fleet_a, uni)
-    sb = SyncSession(fleet_b, uni)
+    sa = SyncSession(fleet_a, uni, full_state_bytes=full_bytes)
+    sb = SyncSession(fleet_b, uni, full_state_bytes=full_bytes)
     t0 = time.perf_counter()
     ra, rb = sync_pair(sa, sb)
     wall = time.perf_counter() - t0

@@ -18,6 +18,10 @@ peer:
 * ``sync.peer.<peer>.delta_ratio`` — the last session's payload bytes
   over the full-state reference, with a bounded history kept for the
   JSON snapshot (the O(divergence) claim, live instead of bench-only).
+  Populated when the session knows a reference size: either the
+  ``SyncSession(full_state_bytes=...)`` hint, or the exact full frame a
+  fallback path shipped.  A pure delta session without the hint leaves
+  the gauge untouched rather than serializing full state to measure it.
 
 :class:`~crdt_tpu.sync.session.SyncSession` feeds this automatically
 through the default tracker; nothing here imports the sync package, so

@@ -48,11 +48,19 @@ def _fmt(v: float) -> str:
 
 
 def prometheus_text(registry: Optional[metrics.MetricsRegistry] = None,
-                    prefix: str = PROM_PREFIX) -> str:
-    """The registry as Prometheus text exposition.  Also refreshes the
-    read-time convergence gauges (staleness ages) first, so a scrape
-    sees live ages."""
-    convergence.tracker().refresh()
+                    prefix: str = PROM_PREFIX,
+                    tracker: Optional[convergence.ConvergenceTracker] = None
+                    ) -> str:
+    """The registry as Prometheus text exposition.  Refreshes the
+    read-time convergence gauges (staleness ages) first so a scrape
+    sees live ages — the default tracker when rendering the default
+    registry, else only a caller-supplied ``tracker`` (the one whose
+    gauges land in ``registry``): scraping a private registry must not
+    write the global tracker's gauges into the process-global one."""
+    if tracker is None and registry is None:
+        tracker = convergence.tracker()
+    if tracker is not None:
+        tracker.refresh()
     reg = registry if registry is not None else metrics.registry()
     snap = reg.snapshot()
     lines = []
@@ -109,10 +117,12 @@ class MetricsServer:
     ``--linger`` — polls it)."""
 
     def __init__(self, host: str, port: int,
-                 registry: Optional[metrics.MetricsRegistry] = None):
+                 registry: Optional[metrics.MetricsRegistry] = None,
+                 tracker: Optional[convergence.ConvergenceTracker] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self._registry = registry
+        self._tracker = tracker
         self._t0 = time.monotonic()
         self.scrapes: dict = {}
         self._scrape_lock = threading.Lock()
@@ -153,7 +163,7 @@ class MetricsServer:
         with self._scrape_lock:
             self.scrapes[route] = self.scrapes.get(route, 0) + 1
         if route == "/metrics":
-            text = prometheus_text(self._registry)
+            text = prometheus_text(self._registry, tracker=self._tracker)
             return text.encode(), "text/plain; version=0.0.4; charset=utf-8", 200
         if route == "/events":
             q = parse_qs(parsed.query)
@@ -177,10 +187,22 @@ class MetricsServer:
         return b"not found (try /metrics, /events, /healthz)\n", \
             "text/plain; charset=utf-8", 404
 
-    def scraped(self, *routes: str) -> bool:
-        """True once every named route has been GET'd at least once."""
+    def scrape_counts(self) -> dict:
+        """Per-route GET counts so far (a consistent copy) — take one as
+        the ``since`` baseline for :meth:`scraped`."""
         with self._scrape_lock:
-            return all(self.scrapes.get(r, 0) > 0 for r in routes)
+            return dict(self.scrapes)
+
+    def scraped(self, *routes: str, since: Optional[dict] = None) -> bool:
+        """True once every named route has been GET'd at least once —
+        strictly more times than in ``since`` (a prior
+        :meth:`scrape_counts` baseline) when given, so a linger can wait
+        for scrapes of the *final* state rather than counting ones that
+        raced the work itself."""
+        base = since or {}
+        with self._scrape_lock:
+            return all(self.scrapes.get(r, 0) > base.get(r, 0)
+                       for r in routes)
 
     def stop(self) -> None:
         """Shut the exporter down; idempotent."""
@@ -193,8 +215,11 @@ class MetricsServer:
 
 
 def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
-                         registry: Optional[metrics.MetricsRegistry] = None
-                         ) -> MetricsServer:
+                         registry: Optional[metrics.MetricsRegistry] = None,
+                         tracker: Optional[convergence.ConvergenceTracker]
+                         = None) -> MetricsServer:
     """Start the opt-in background exporter; ``port=0`` picks a free
-    port (read it back from ``server.port``)."""
-    return MetricsServer(host, port, registry)
+    port (read it back from ``server.port``).  ``tracker`` pairs a
+    custom ``registry`` with the convergence tracker writing into it
+    (see :func:`prometheus_text`)."""
+    return MetricsServer(host, port, registry, tracker)

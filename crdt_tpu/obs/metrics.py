@@ -17,9 +17,14 @@ differently and a consumer alerts on them differently:
   ``frexp`` + dict increment — cheap enough to stay always-on — while
   still answering "how many syncs took >128 ms" from the export.
 
-Everything here is dependency-free, thread-safe (one registry lock —
-observations are single dict updates, so contention is negligible next
-to the work being measured) and import-light: no JAX, no numpy.  The
+Everything here is dependency-free and import-light: no JAX, no numpy.
+Thread-safety: the one-shot registry methods (``counter_inc`` /
+``gauge_set`` / ``observe``) and ``snapshot`` run under the registry
+lock; a :class:`Counter` handle locks itself so cached-handle ``inc``
+never drops increments; :class:`Gauge` handle writes are last-write-
+wins by contract; :class:`Histogram` handles should be fed through
+``registry.observe`` (multi-field updates need the registry lock to
+keep snapshots untorn).  The
 existing :mod:`crdt_tpu.utils.tracing` API re-routes into the default
 registry, so every current ``span``/``count``/``record_sync``/
 ``record_wire`` call site feeds this module with no churn at the call
@@ -34,20 +39,34 @@ from typing import Dict, Iterator, Optional, Tuple
 
 
 class Counter:
-    """A monotonically increasing event count."""
+    """A monotonically increasing event count.
 
-    __slots__ = ("name", "value")
+    ``inc`` takes the counter's own lock: handles are cached by hot
+    paths and mutated outside the registry lock, and a read-modify-write
+    without one can drop increments under concurrent writers — which the
+    monotonic-counter contract forbids.
+    """
+
+    __slots__ = ("name", "value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self.value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        self.value += int(n)
+        with self._lock:
+            self.value += int(n)
 
 
 class Gauge:
-    """A point-in-time level; last write wins."""
+    """A point-in-time level; last write wins.
+
+    Handle mutation is deliberately unsynchronized: a gauge tolerates a
+    lost write by contract (the racing ``set`` that wins *is* the
+    current level).  ``inc`` is read-modify-write — only use it on
+    gauges with a single writer.
+    """
 
     __slots__ = ("name", "value")
 
@@ -68,6 +87,10 @@ class Histogram:
     bucket (exponent :data:`ZERO_BUCKET`) so a zero-length span is
     counted, not lost.  Sum/count/min/max ride along so the export can
     emit Prometheus ``_sum``/``_count`` and the mean survives bucketing.
+
+    Observe through ``registry.observe`` under concurrency: ``observe``
+    updates several fields, and only the registry lock keeps a
+    concurrent ``snapshot`` from seeing them torn.
     """
 
     ZERO_BUCKET = -1075  # below the smallest subnormal double's exponent
@@ -85,8 +108,14 @@ class Histogram:
     def observe(self, v: float) -> None:
         v = float(v)
         if v > 0.0:
-            # frexp: v = m * 2**e with 0.5 <= m < 1, so 2**(e-1) < v <= 2**e
-            e = math.frexp(v)[1]
+            # frexp: v = m * 2**e with 0.5 <= m < 1 puts v in
+            # [2**(e-1), 2**e); pulling exact powers of two (m == 0.5)
+            # down one exponent makes bucket e hold (2**(e-1), 2**e],
+            # so 4.0 exports under le="4", not le="8" (Prometheus le
+            # bounds are inclusive)
+            m, e = math.frexp(v)
+            if m == 0.5:
+                e -= 1
         else:
             e = self.ZERO_BUCKET
         self.buckets[e] = self.buckets.get(e, 0) + 1

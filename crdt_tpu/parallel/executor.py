@@ -41,8 +41,14 @@ def _record_recovery(kind: str, **fields) -> None:
     """Executor recoveries (regrows, transient requeues) are rare and
     diagnostic-grade: count them always-on AND leave a flight-recorder
     event, so a fleet that silently regrew mid-join shows up on
-    ``/events`` with the capacities it regrew to."""
-    tracing.count(f"executor.{kind}")
+    ``/events`` with the capacities it regrew to.
+
+    The counter lives under ``executor.recovery.*`` — a namespace
+    disjoint from the ``executor.regrow`` SPAN below, because the obs
+    registry claims one metric type per name and the span forwards into
+    a histogram of the same name.
+    """
+    tracing.count(f"executor.recovery.{kind}")
     obs_events.record(f"executor.{kind}", **fields)
 
 

@@ -115,13 +115,20 @@ class SyncSession:
     ``peer`` labels this session's convergence gauges
     (``sync.peer.<peer>.*``); unnamed sessions share the ``"peer"``
     label.  ``session_id`` stamps every flight-recorder event.
+    ``full_state_bytes`` is an optional telemetry hint: the byte size a
+    full-state frame of this fleet would have (callers that serialize
+    the fleet anyway — the bench, the TCP example — know it).  It is the
+    reference the per-peer ``delta_ratio`` gauge divides by; without it
+    the ratio is only computed on sessions that actually shipped a full
+    frame (where the frame itself is the reference).
     """
 
     def __init__(self, batch, universe, *,
                  full_state_threshold: float = 0.5,
                  full_state: bool = False,
                  digest_fn: Optional[Callable] = None,
-                 peer: Optional[str] = None):
+                 peer: Optional[str] = None,
+                 full_state_bytes: Optional[int] = None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -131,6 +138,7 @@ class SyncSession:
         self.full_state_threshold = full_state_threshold
         self.full_state = full_state
         self.peer = peer or "peer"
+        self.full_state_bytes = full_state_bytes
         self.session_id = obs_events.new_session_id()
         self._digest_fn = digest_fn or digest_mod.digest_of
         self._applier = OrswotDeltaApplier(universe)
@@ -233,10 +241,16 @@ class SyncSession:
             tracing.count("sync.errors")
             self._event("sync.error", error=str(e)[:200])
             raise
+        # delta_ratio reference: the caller's hint when given, else the
+        # exact full frame this session shipped on a fallback path (a
+        # pure delta session without a hint leaves the ratio unknown —
+        # serializing full state just for telemetry would cost the very
+        # O(total state) work the delta path exists to avoid)
         obs_convergence.tracker().observe_session(
             self.peer, converged=report.converged,
             rounds=report.digest_rounds,
             payload_bytes=report.delta_bytes_sent + report.full_bytes_sent,
+            full_state_bytes=self.full_state_bytes or report.full_bytes_sent,
         )
         self._event(
             "sync.phase", phase="converged", rounds=report.digest_rounds,

@@ -39,6 +39,7 @@ import dataclasses
 import os
 import threading
 import time
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, Optional
 
@@ -83,6 +84,30 @@ def pytree_bytes(*trees: Any) -> int:
     return total
 
 
+# metric names whose registry forwarding already warned about a
+# name/type conflict — warn once per name, then drop silently
+_CONFLICT_WARNED: set = set()
+
+
+def _forward(observe: Callable[[str, float], None], name: str,
+             value: float) -> None:
+    """Forward one observation into the obs registry, never raising.
+
+    The registry claims one metric type per name (a span and a counter
+    sharing a name would conflict); instrumentation must degrade to a
+    warning in that case, not raise ValueError through the code path it
+    is instrumenting."""
+    try:
+        observe(name, value)
+    except ValueError as e:
+        if name not in _CONFLICT_WARNED:
+            _CONFLICT_WARNED.add(name)
+            warnings.warn(
+                f"dropping metric forwarding for {name!r}: {e}",
+                RuntimeWarning, stacklevel=3,
+            )
+
+
 @dataclass
 class Tracer:
     """Aggregates named wall-time spans and event counters; thread-safe.
@@ -118,7 +143,7 @@ class Tracer:
             self.stats.setdefault(name, SpanStats()).add(dt, nbytes)
         if self.forward_metrics:
             # span latency histogram (log2 buckets), seconds
-            self._registry().observe(name, dt)
+            _forward(self._registry().observe, name, dt)
 
     def count(self, name: str, n: int = 1) -> None:
         """Add ``n`` to the event counter ``name`` (thread-safe).
@@ -131,7 +156,7 @@ class Tracer:
         with self._lock:
             self.counts[name] = self.counts.get(name, 0) + int(n)
         if self.forward_metrics:
-            self._registry().counter_inc(name, int(n))
+            _forward(self._registry().counter_inc, name, int(n))
 
     def counters(self) -> Dict[str, int]:
         """A snapshot copy of all event counters."""
@@ -276,7 +301,8 @@ def record_sync(leg: str, *, nbytes: int = 0, objects: int = 0) -> None:
     count(f"wire.sync.{leg}.bytes", nbytes)
     count(f"wire.sync.{leg}.objects", objects)
     if _GLOBAL.forward_metrics:
-        _GLOBAL._registry().observe(f"wire.sync.{leg}.frame_bytes", nbytes)
+        _forward(_GLOBAL._registry().observe,
+                 f"wire.sync.{leg}.frame_bytes", nbytes)
 
 
 def delta_ratio(delta_bytes: int, full_state_bytes: int) -> Optional[float]:

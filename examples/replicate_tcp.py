@@ -41,7 +41,8 @@ histograms, ``GET /events`` is the flight recorder (filter to this
 session with ``?session=<id>`` — the peer prints its session ID), and
 ``GET /healthz`` is the liveness probe.  ``--linger S`` keeps the
 exporter up for up to S seconds after the sync finishes (returning as
-soon as both ``/metrics`` and ``/events`` have been scraped), so a
+soon as both ``/metrics`` and ``/events`` have been scraped after the
+sync finished — scrapes that raced the sync don't count), so a
 scraper — PERF.md's ``curl`` walkthrough, or the automated test — can
 read the final state before the process exits.
 
@@ -175,7 +176,12 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
                 time.sleep(0.5)
 
     other = "client" if role == "server" else "server"
-    session = SyncSession(mine, uni, full_state=full_state, peer=other)
+    # full-state reference size (one serialization pass, outside the
+    # timed sync): feeds the per-peer delta_ratio gauge the exporter
+    # serves — what this sync cost vs shipping everything
+    full_ref = sum(len(b) for b in mine.to_wire(uni))
+    session = SyncSession(mine, uni, full_state=full_state, peer=other,
+                          full_state_bytes=full_ref)
     with sock:
         report = session.sync(
             lambda frame: _send_frame(sock, frame),
@@ -193,14 +199,18 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
         flush=True,
     )
     if metrics_server is not None and linger_s > 0:
-        # hold the exporter up until someone has read the final state
+        # hold the exporter up until someone has read the FINAL state
         # (or the linger budget runs out) — a sync finishing in
-        # milliseconds must not close the scrape window with it
+        # milliseconds must not close the scrape window with it, and a
+        # scrape that raced the sync itself read a half-told story, so
+        # only scrapes arriving from here on count
         import time
 
+        baseline = metrics_server.scrape_counts()
         deadline = time.monotonic() + linger_s
         while time.monotonic() < deadline:
-            if metrics_server.scraped("/metrics", "/events"):
+            if metrics_server.scraped("/metrics", "/events",
+                                      since=baseline):
                 break
             time.sleep(0.05)
     if metrics_server is not None:

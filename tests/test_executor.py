@@ -89,6 +89,36 @@ def test_only_overflowed_axis_regrows():
     assert len([i for i in joined.to_scalar(uni)[0].deferred]) == 2
 
 
+def test_regrow_with_tracing_enabled_does_not_collide_in_registry():
+    """Regression: with spans enabled (CRDT_TRACE=1 / --metrics-port),
+    the ``executor.regrow`` span forwards a histogram into the obs
+    registry while the recovery counter lives under
+    ``executor.recovery.regrow`` — the names must stay disjoint, or the
+    registry's one-type-per-name claim raises ValueError out of
+    ``join_all`` instead of recovering."""
+    from crdt_tpu.obs import metrics as obs_metrics
+    from crdt_tpu.utils import tracing
+
+    uni = _universe(m=2)
+    rows = [
+        [[("a", 0), ("b", 0)]],
+        [[("c", 1), ("d", 1)]],
+        [[("e", 2), ("f", 2)]],
+    ]
+    batches = [OrswotBatch.from_scalar(_fleet(uni, r), uni) for r in rows]
+    stats = JoinStats()
+    tracing.enable(True)
+    try:
+        joined = JoinExecutor().join_all(batches, stats=stats)
+    finally:
+        tracing.enable(False)
+    assert stats.overflow_regrows >= 1
+    assert joined.value_sets(uni)[0] == {"a", "b", "c", "d", "e", "f"}
+    snap = obs_metrics.registry().snapshot()
+    assert snap["counters"]["executor.recovery.regrow"] >= 1
+    assert snap["histograms"]["executor.regrow"]["count"] >= 1
+
+
 def test_overflow_beyond_max_capacity_raises():
     uni = _universe(m=2)
     rows = [

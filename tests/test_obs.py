@@ -87,20 +87,41 @@ def test_registry_rejects_type_flips():
 
 def test_histogram_log2_buckets():
     h = obs_metrics.Histogram("h")
-    # 3.0 = 0.75 * 2**2 -> bucket exponent 2 (bound 4.0); 4.0 lands in
-    # the SAME bucket (frexp(4.0) = (0.5, 3)? no: 4.0 = 0.5*2**3 -> e=3)
+    # bucket e holds (2**(e-1), 2**e]: 3.0 -> bound 4.0, and exactly
+    # 4.0 ALSO -> bound 4.0 (Prometheus le is inclusive); 5.0 -> 8.0
     h.observe(3.0)
     h.observe(4.0)
+    h.observe(5.0)
     h.observe(0.0)       # zero/negative -> floor bucket, bound 0.0
     h.observe(1e-9)
     bounds = dict(h.cumulative())
-    assert h.count == 4
+    assert h.count == 5
     assert 4.0 in bounds and 8.0 in bounds
     assert 0.0 in bounds and bounds[0.0] == 1  # only the zero landed there
+    assert bounds[4.0] == 4                    # 0.0, 1e-9, 3.0, 4.0 are <= 4
+    assert bounds[8.0] - bounds[4.0] == 1      # only 5.0 sits in (4, 8]
     # cumulative counts are monotone and end at count
     cum = [c for _, c in h.cumulative()]
     assert cum == sorted(cum) and cum[-1] == h.count
-    assert h.min == 0.0 and h.max == 4.0
+    assert h.min == 0.0 and h.max == 5.0
+
+
+def test_counter_handle_is_thread_safe():
+    """Cached counter handles mutate outside the registry lock; the
+    counter's own lock must keep concurrent increments exact (the
+    monotonic contract — a gauge may lose races, a counter may not)."""
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("c")
+    n_threads, per_thread = 8, 5_000
+    threads = [
+        threading.Thread(target=lambda: [c.inc() for _ in range(per_thread)])
+        for _ in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert c.value == n_threads * per_thread
 
 
 def test_prometheus_text_rendering():
@@ -277,6 +298,72 @@ def test_forced_digest_collision_leaves_fallback_event():
     assert colls
 
 
+def test_delta_ratio_gauge_populates_with_reference():
+    """The per-peer delta_ratio gauge and history populate when the
+    session knows a full-state reference — the constructor hint on the
+    delta path, the shipped full frame itself on fallback paths."""
+    uni = _uni()
+    fa = OrswotBatch.from_scalar(_orswot_fleet(32, 21, actor=1,
+                                               extra_on=[3]), uni)
+    fb = OrswotBatch.from_scalar(_orswot_fleet(32, 21, actor=2,
+                                               extra_on=[9]), uni)
+    full_ref = sum(len(b) for b in fa.to_wire(uni))
+    a = SyncSession(fa, uni, peer="ratio-b", full_state_bytes=full_ref)
+    b = SyncSession(fb, uni, peer="ratio-a", full_state_bytes=full_ref)
+    ra, _ = sync_pair(a, b)
+    assert ra.converged and not ra.full_state_fallback
+    g = obs_metrics.registry().snapshot()["gauges"]
+    ratio = g["sync.peer.ratio-b.delta_ratio"]
+    assert 0.0 < ratio < 1.0  # 2/32 rows diverged: far below full state
+    hist = obs_convergence.tracker().snapshot()["ratio-b"][
+        "delta_ratio_history"]
+    assert hist and hist[-1] == pytest.approx(ratio)
+
+    # fallback path, NO hint: the full frame itself is the reference,
+    # so the ratio lands at >= 1.0 (full state shipped plus framing)
+    collide = lambda batch: np.zeros(  # noqa: E731 — constant digest
+        batch.clock.shape[0], dtype=np.uint64
+    )
+    fc = OrswotBatch.from_scalar(_orswot_fleet(16, 23, actor=1,
+                                               extra_on=[2]), uni)
+    fd = OrswotBatch.from_scalar(_orswot_fleet(16, 23, actor=2,
+                                               extra_on=[5]), uni)
+    c = SyncSession(fc, uni, digest_fn=collide, peer="ratio-d")
+    d = SyncSession(fd, uni, digest_fn=collide, peer="ratio-c")
+    rc, _ = sync_pair(c, d)
+    assert rc.converged and rc.full_state_fallback
+    g = obs_metrics.registry().snapshot()["gauges"]
+    assert g["sync.peer.ratio-d.delta_ratio"] >= 1.0
+
+
+def test_private_registry_scrape_keeps_global_state_untouched():
+    """Rendering a caller-owned registry must refresh the caller's
+    tracker (so its staleness gauges are live) and must NOT write the
+    process-global tracker's gauges into the global registry."""
+    import time
+
+    # seed the global tracker so a buggy refresh would visibly rewrite
+    # the global staleness gauge
+    obs_convergence.tracker().observe_session("leak-probe", converged=True,
+                                              rounds=1)
+    time.sleep(0.01)
+    before = obs_metrics.registry().snapshot()["gauges"]
+
+    reg = obs_metrics.MetricsRegistry()
+    trk = obs_convergence.ConvergenceTracker(reg)
+    trk.observe_session("px", converged=True, rounds=2)
+    time.sleep(0.01)
+    text = obs_export.prometheus_text(reg, tracker=trk)
+    assert "crdt_tpu_sync_peer_px_rounds_to_converge 2" in text
+    staleness = [ln for ln in text.splitlines()
+                 if ln.startswith("crdt_tpu_sync_peer_px_staleness_s ")]
+    assert staleness and float(staleness[0].split()[1]) > 0.0  # refreshed
+
+    obs_export.prometheus_text(reg)  # private registry, no tracker
+    after = obs_metrics.registry().snapshot()["gauges"]
+    assert after == before
+
+
 def test_protocol_error_recorded():
     from crdt_tpu.error import SyncProtocolError
     from crdt_tpu.sync.delta import decode_frame
@@ -411,11 +498,16 @@ def test_replicate_tcp_metrics_endpoint_live():
     murl = f"http://127.0.0.1:{metrics_port}"
     text = events_doc = None
     try:
+        # poll until the scrape shows the finished session: wire.sync
+        # counters AND the span histograms (a scrape can race the sync
+        # mid-phase, so wait for everything rather than asserting on a
+        # half-told story)
         deadline = time.monotonic() + 180
         while time.monotonic() < deadline:
             try:
                 _, text = _http_get(f"{murl}/metrics", timeout=5)
-                if "crdt_tpu_wire_sync_digest_bytes_total" in text:
+                if ("crdt_tpu_wire_sync_digest_bytes_total" in text
+                        and "crdt_tpu_sync_digest_exchange_bucket" in text):
                     break
             except OSError:
                 pass
@@ -431,8 +523,30 @@ def test_replicate_tcp_metrics_endpoint_live():
         # latency histograms (spans are enabled by --metrics-port)
         assert "crdt_tpu_sync_digest_exchange_bucket" in text
         assert "crdt_tpu_sync_digest_exchange_count" in text
-        _, body = _http_get(f"{murl}/events?kind=sync.phase", timeout=5)
-        events_doc = json.loads(body)
+        # poll /events until the converged phase lands (mid-sync scrapes
+        # see a prefix of the phase transitions); the server lingers
+        # until both routes are scraped AFTER its sync finished, so the
+        # polling itself is what eventually releases it
+        while time.monotonic() < deadline:
+            try:
+                _, body = _http_get(f"{murl}/events?kind=sync.phase",
+                                    timeout=5)
+                events_doc = json.loads(body)
+                if any(e["fields"]["phase"] == "converged"
+                       for e in events_doc["events"]):
+                    break
+            except OSError:
+                pass
+            if srv.poll() is not None:
+                break
+            time.sleep(0.2)
+        # release the linger: scrape both routes once more, tolerating
+        # the server winning the race and exiting first
+        for route in ("/metrics", "/events"):
+            try:
+                _http_get(f"{murl}{route}", timeout=5)
+            except OSError:
+                pass
     finally:
         try:
             srv.wait(timeout=120)

@@ -169,6 +169,37 @@ def test_global_tracer_forwards_into_obs_registry():
     assert h["count"] >= 1 and h["sum"] >= 0.0
 
 
+def test_forwarding_name_conflict_warns_instead_of_raising():
+    """A name already claimed as another metric type in the obs registry
+    must not make instrumentation raise through the instrumented code
+    path (the executor.regrow counter-vs-span collision): forwarding
+    drops the observation with one RuntimeWarning per name, and the
+    tracer's own span stats still record."""
+    import warnings
+
+    from crdt_tpu.obs import metrics as obs_metrics
+
+    tracing.reset()
+    name = "trace_conflict_probe.span"
+    obs_metrics.registry().counter_inc(name)  # claim the name as a counter
+    tracing.enable(True)
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for _ in range(2):  # second conflict must stay silent
+                with tracing.span(name):
+                    pass
+    finally:
+        tracing.enable(False)
+    conflicts = [w for w in caught
+                 if issubclass(w.category, RuntimeWarning)
+                 and name in str(w.message)]
+    assert len(conflicts) == 1
+    assert tracing.get_tracer().stats[name].count == 2
+    assert name not in obs_metrics.registry().snapshot()["histograms"]
+    tracing.reset()
+
+
 def test_bare_tracer_does_not_forward():
     """Non-global Tracer instances stay self-contained — tests and
     scoped measurements must not pollute the process registry."""

@@ -1,0 +1,51 @@
+"""crdtlint — AST-based static analysis for this repo's contracts.
+
+PR 3's HIGH-severity review finding — a counter and a histogram sharing
+the ``executor.regrow`` name, crashing executor recovery at runtime —
+is fully decidable from the source text.  This package moves that bug
+class (and three more like it) from "runtime surprise" to "CI failure":
+
+* :mod:`~crdt_tpu.analysis.telemetry` — every metric name declared
+  anywhere in the tree, cross-checked for type collisions and against
+  the documented namespace manifest
+  (:mod:`crdt_tpu.obs.namespace`).
+* :mod:`~crdt_tpu.analysis.locks` — Eraser-style lockset discipline for
+  the threaded modules: attributes written both inside and outside
+  ``with self._lock``, and unlocked read-modify-writes.
+* :mod:`~crdt_tpu.analysis.tracer` — jax tracer hygiene: host coercion
+  of traced values inside jit-decorated functions, int64 flowing into
+  the Pallas modules (the jax-0.4.x Mosaic-skew class), dict-iteration
+  order feeding jit inputs.
+* :mod:`~crdt_tpu.analysis.wire` — the wire/sync error contract: decode
+  paths raise :class:`~crdt_tpu.error.CrdtError` subclasses, never bare
+  ``ValueError``; no swallowing ``except Exception``; every
+  ``from_wire``/``to_wire`` leg feeds ``record_wire``.
+
+Run it: ``python -m crdt_tpu.analysis`` (or ``scripts/crdtlint.py``);
+``--json`` for machine output.  Suppress one finding with a
+``# crdtlint: disable=RULE`` pragma on the flagged line; park a known
+finding in ``crdt_tpu/analysis/baseline.json`` with a justification.
+Stdlib-only by hard contract: the lint never imports jax, numpy, or any
+module that does (``tests/test_analysis.py`` pins this), so it runs in
+<5 s on a box with no accelerator stack at all.
+"""
+
+from .core import (  # noqa: F401
+    Baseline,
+    Finding,
+    LintResult,
+    ParsedFile,
+    default_targets,
+    load_files,
+    run_lint,
+)
+
+__all__ = [
+    "Baseline",
+    "Finding",
+    "LintResult",
+    "ParsedFile",
+    "default_targets",
+    "load_files",
+    "run_lint",
+]

@@ -1,0 +1,121 @@
+"""``python -m crdt_tpu.analysis`` — run crdtlint over the tree.
+
+Exit codes: 0 clean (live findings all pragma'd or baselined), 1 live
+findings or parse errors, 2 usage error.  ``--json`` emits the full
+machine-readable result on stdout (what ``tests/test_analysis.py`` and
+CI consume); the default human output is one ``path:line:col: rule:
+message`` line per finding, grep- and editor-jumpable.
+
+The lint never imports jax/numpy — it must run (fast) on boxes with no
+accelerator stack, and tier-1 budgets the whole run under 5 seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .core import (
+    Baseline, default_targets, load_files, repo_root, rule_names, run_lint,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="crdtlint",
+        description="AST-based static analysis for crdt_tpu contracts "
+                    "(telemetry namespaces, lock discipline, tracer "
+                    "hygiene, wire error contracts)",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint (default: the "
+                             "whole repo except tests/)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON (default: the shipped "
+                             "crdt_tpu/analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (audit mode: every "
+                             "finding is live)")
+    parser.add_argument("--rule", action="append", dest="rules",
+                        metavar="RULE",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print registered rule names and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in rule_names():
+            print(name)
+        return 0
+    if args.rules:
+        unknown = set(args.rules) - set(rule_names())
+        if unknown:
+            print(f"crdtlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    if args.paths:
+        targets = []
+        for p in args.paths:
+            if os.path.isdir(p):
+                targets.extend(default_targets(root=p))
+            elif os.path.isfile(p):
+                targets.append(p)
+            else:
+                print(f"crdtlint: no such path: {p}", file=sys.stderr)
+                return 2
+    else:
+        targets = default_targets()
+
+    baseline = None
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            baseline = Baseline.load(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"crdtlint: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    files, parse_errors = load_files(targets, root=repo_root())
+    result = run_lint(files, baseline=baseline, only_rules=args.rules)
+    result.parse_errors = parse_errors
+    dt = time.perf_counter() - t0
+
+    if args.as_json:
+        out = result.to_json()
+        out["elapsed_s"] = round(dt, 3)
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.render())
+    for err in parse_errors:
+        print(f"{err} [parse-error]")
+    tallies = (
+        f"{result.files} files, {len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} pragma-suppressed, "
+        f"{len(result.baselined)} baselined, {dt:.2f}s"
+    )
+    if result.stale_baseline:
+        print(f"crdtlint: {len(result.stale_baseline)} stale baseline "
+              "entr(ies) matched nothing — delete them:", file=sys.stderr)
+        for e in result.stale_baseline:
+            print(f"  - {e['rule']} @ {e['path']}: {e['message'][:80]}",
+                  file=sys.stderr)
+    print(("OK: " if result.ok else "FAIL: ") + tallies,
+          file=sys.stderr)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,360 @@
+"""The crdtlint rule engine: findings, pragmas, baseline, file loading.
+
+Design constraints, in order:
+
+1. **Stdlib-only.**  Pure ``ast`` + ``json``; importing this package
+   must never pull jax/numpy (the lint gates CI on boxes without the
+   accelerator stack, and tier-1 budgets it <5 s).
+2. **Whole-program rules.**  Every rule sees the full parsed file set —
+   the telemetry rule is inherently cross-file (a collision is two call
+   sites in different modules), and per-file rules simply ignore the
+   rest.
+3. **Escape hatches that leave a trail.**  A ``# crdtlint:
+   disable=RULE`` pragma suppresses one line; ``baseline.json`` parks a
+   known finding with a one-line justification.  Both are counted and
+   reported, never silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable, List, Optional, Sequence
+
+#: ``# crdtlint: disable=rule-a,rule-b`` — suppresses the named rules on
+#: that physical line.  ``disable-file=...`` anywhere in a file's first
+#: 20 lines suppresses them for the whole file (fixture twins use this).
+_PRAGMA = re.compile(r"#\s*crdtlint:\s*(disable(?:-file)?)\s*=\s*([\w\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def render(self) -> str:
+        return f"{self.location()}: {self.rule}: {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ParsedFile:
+    """One source file: path, text, AST, and its pragma map."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        self._line_pragmas: dict[int, set[str]] = {}
+        self._file_pragmas: set[str] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+            if m.group(1) == "disable-file" and i <= 20:
+                self._file_pragmas |= rules
+            else:
+                self._line_pragmas.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_pragmas or "all" in self._file_pragmas:
+            return True
+        at = self._line_pragmas.get(line, ())
+        return rule in at or "all" in at
+
+
+class Baseline:
+    """Known findings parked in ``baseline.json``.
+
+    Each entry is ``{"rule", "path", "message", "justification"}``;
+    ``message`` may end with ``*`` to prefix-match (messages embed
+    details like capacities that legitimately drift).  Lines are NOT
+    part of the match — baselines must survive unrelated edits above
+    the finding.
+    """
+
+    def __init__(self, entries: Sequence[dict]):
+        for e in entries:
+            for key in ("rule", "path", "message", "justification"):
+                if not isinstance(e.get(key), str) or not e[key]:
+                    raise ValueError(
+                        f"baseline entry {e!r} needs a non-empty {key!r}"
+                    )
+        self.entries = list(entries)
+        self._hits = [0] * len(self.entries)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        if not isinstance(data, list):
+            raise ValueError(f"{path}: baseline must be a JSON list")
+        return cls(data)
+
+    def covers(self, finding: Finding) -> bool:
+        for i, e in enumerate(self.entries):
+            if e["rule"] != finding.rule or e["path"] != finding.path:
+                continue
+            pat = e["message"]
+            ok = (finding.message.startswith(pat[:-1]) if pat.endswith("*")
+                  else finding.message == pat)
+            if ok:
+                self._hits[i] += 1
+                return True
+        return False
+
+    def stale_entries(self) -> List[dict]:
+        """Entries that matched nothing this run — candidates for
+        deletion (the finding they parked is gone)."""
+        return [e for e, n in zip(self.entries, self._hits) if n == 0]
+
+
+@dataclasses.dataclass
+class LintResult:
+    """What one lint run produced, in severity order."""
+
+    findings: List[Finding]          # live: fail the build
+    suppressed: List[Finding]        # pragma-disabled at the site
+    baselined: List[Finding]         # parked in baseline.json
+    stale_baseline: List[dict]       # baseline entries matching nothing
+    files: int = 0
+    parse_errors: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [f.to_json() for f in self.suppressed],
+            "baselined": [f.to_json() for f in self.baselined],
+            "stale_baseline": self.stale_baseline,
+            "parse_errors": self.parse_errors,
+        }
+
+
+# -- rule registry ------------------------------------------------------------
+
+#: rule name -> callable(files: list[ParsedFile]) -> iterable[Finding]
+_RULES: dict[str, Callable[[List[ParsedFile]], Iterable[Finding]]] = {}
+
+
+def rule(name: str):
+    """Register a whole-program rule under ``name`` (the pragma /
+    baseline / CLI identifier)."""
+
+    def deco(fn):
+        if name in _RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        _RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def rule_names() -> List[str]:
+    _ensure_rules_loaded()
+    return sorted(_RULES)
+
+
+def _ensure_rules_loaded() -> None:
+    # rule modules self-register on import; imported lazily so `import
+    # crdt_tpu.analysis` stays cheap and cycle-free
+    from . import locks, telemetry, tracer, wire  # noqa: F401
+
+
+# -- file loading -------------------------------------------------------------
+
+#: directories never scanned (tests carry deliberate violations in
+#: fixtures; vendored/build trees are not ours to lint)
+_SKIP_DIRS = {
+    ".git", "__pycache__", "tests", "build", "dist", ".eggs", "node_modules",
+}
+
+
+def repo_root() -> str:
+    """The repository root: the directory holding the ``crdt_tpu``
+    package this module was imported from."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_targets(root: Optional[str] = None) -> List[str]:
+    """The default scan set: every ``*.py`` under the repo root except
+    ``tests/`` (fixtures deliberately violate rules) and non-source
+    dirs.  Sorted for deterministic output."""
+    root = root or repo_root()
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in _SKIP_DIRS
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def load_files(paths: Sequence[str], root: Optional[str] = None
+               ) -> tuple[List[ParsedFile], List[str]]:
+    """Parse ``paths`` into :class:`ParsedFile`\\s; returns ``(files,
+    parse_errors)``.  A file that fails to parse is reported, not
+    fatal — the rest of the tree still gets linted."""
+    root = root or repo_root()
+    files: List[ParsedFile] = []
+    errors: List[str] = []
+    for path in paths:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            files.append(ParsedFile(path, rel, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: {type(e).__name__}: {e}")
+    return files, errors
+
+
+# -- the runner ---------------------------------------------------------------
+
+
+def run_lint(files: List[ParsedFile],
+             baseline: Optional[Baseline] = None,
+             only_rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Run every registered rule over ``files`` and triage the findings
+    through pragmas, then the baseline."""
+    _ensure_rules_loaded()
+    by_rel = {f.rel: f for f in files}
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    for name in sorted(_RULES):
+        if only_rules is not None and name not in only_rules:
+            continue
+        for finding in _RULES[name](files):
+            pf = by_rel.get(finding.path)
+            if pf is not None and pf.suppressed(finding.rule, finding.line):
+                suppressed.append(finding)
+            elif baseline is not None and baseline.covers(finding):
+                baselined.append(finding)
+            else:
+                live.append(finding)
+    live.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return LintResult(
+        findings=live,
+        suppressed=suppressed,
+        baselined=baselined,
+        stale_baseline=baseline.stale_entries() if baseline else [],
+        files=len(files),
+    )
+
+
+# -- shared AST helpers (used by several rule modules) ------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """The trailing identifier of a call target: ``tracing.count`` →
+    ``count``, ``count`` → ``count``, anything else → ``""``."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for a Name/Attribute chain, else ``""``."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    """The value of a plain string literal, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+#: sentinel segment for "any one dynamic segment" in a metric pattern
+WILD = "*"
+
+
+def name_pattern(node: ast.AST) -> Optional[str]:
+    """A dotted metric-name pattern from a string literal or a simple
+    f-string: formatted values become ``*`` segments (``f"executor.
+    recovery.{kind}"`` → ``executor.recovery.*``).  Returns None when
+    the name is not statically derivable (leading dynamic segment,
+    non-string expression, concatenation)."""
+    s = literal_str(node)
+    if s is not None:
+        return s
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    raw = ""
+    for part in node.values:
+        if isinstance(part, ast.Constant) and isinstance(part.value, str):
+            raw += part.value
+        elif isinstance(part, ast.FormattedValue):
+            raw += "\0"
+        else:
+            return None
+    segs = raw.split(".")
+    out = []
+    for seg in segs:
+        if "\0" in seg:
+            out.append(WILD)
+        else:
+            out.append(seg)
+    if not out or out[0] == WILD:
+        return None  # leading dynamic segment: not statically nameable
+    return ".".join(out)
+
+
+def patterns_overlap(a: str, b: str) -> bool:
+    """Whether two ``*``-segment patterns can name the same metric
+    (equal length, each position equal or wild on either side)."""
+    pa, pb = a.split("."), b.split(".")
+    if len(pa) != len(pb):
+        return False
+    return all(x == WILD or y == WILD or x == y for x, y in zip(pa, pb))
+
+
+def parents_of(tree: ast.AST) -> dict:
+    """child node -> parent node for a whole tree (rules use it for
+    enclosing-``try``/``with`` questions)."""
+    parents: dict = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+def ancestors(node: ast.AST, parents: dict) -> Iterable[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)

@@ -1,0 +1,252 @@
+"""Lock-discipline lint — Eraser-style lockset checking, statically.
+
+Savage et al.'s Eraser checks at runtime that every shared variable is
+consistently protected by some lock; the threaded modules here
+(``obs/``, ``batch/wireloop.py``, ``parallel/executor.py``,
+``utils/tracing.py``) follow a simpler, fully lexical discipline that
+an AST pass can police:
+
+* A class that owns a ``threading.Lock``/``RLock`` attribute guards its
+  mutable state with ``with self.<lock>:`` blocks.
+* ``lock-discipline`` — an instance attribute is written both inside
+  and outside such a block (outside ``__init__``): one of the two
+  sites is a race.  (A deliberately unsynchronized attribute — a gauge
+  contract, an idempotent cache — gets a pragma with its reason.)
+* ``unlocked-rmw`` — a read-modify-write (``self.x += n``) outside any
+  lock block in a lock-owning class: increments are lost under
+  concurrent writers no matter how "atomic" they look.
+
+Classes that own no lock are skipped entirely — single-threaded state
+machines (the wire loop's fold accumulators) and by-contract
+unsynchronized types (``Gauge``) stay out of scope, which keeps the
+rule's false-positive rate near zero.  Helper methods called with the
+lock already held (``with self._lock: self._state(...)``) are lexically
+"outside" a with-block; such writes take a pragma naming the caller's
+lock, making the calling convention part of the source text.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .core import Finding, ParsedFile, dotted_name, rule
+
+#: modules always under lock discipline (prefix match on the
+#: repo-relative path) — the known threaded set.  Any OTHER module that
+#: imports ``threading`` is scoped in too (:func:`in_scope`), so a new
+#: threaded module is covered the day it appears.
+THREADED_MODULES = (
+    "crdt_tpu/obs/",
+    "crdt_tpu/batch/wireloop.py",
+    "crdt_tpu/parallel/executor.py",
+    "crdt_tpu/utils/tracing.py",
+    "crdt_tpu/sync/session.py",
+)
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def in_scope(pf: ParsedFile) -> bool:
+    """Under lock discipline: the known threaded modules, plus anything
+    that imports ``threading`` (it mints threads or locks, so its
+    classes are fair game — lockless classes are skipped either way)."""
+    if pf.rel.startswith(THREADED_MODULES):
+        return True
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "threading" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "threading":
+                return True
+    return False
+
+
+def _lock_factory_call(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``Lock()`` / ``field(default_factory=
+    threading.Lock)`` — anything that mints a lock."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _LOCK_FACTORIES:
+        return True
+    if tail == "field":
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                inner = dotted_name(kw.value)
+                if inner.rsplit(".", 1)[-1] in _LOCK_FACTORIES:
+                    return True
+    return False
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    """``self.x`` (or ``self.x[...]``) as a write target → ``"x"``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """Instance attributes of ``cls`` holding locks: ``self.X =
+    threading.Lock()`` in any method, or a dataclass field whose
+    default_factory is a lock."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _lock_factory_call(node.value):
+            for tgt in node.targets:
+                attr = _self_attr_target(tgt)
+                if attr is not None:
+                    out.add(attr)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and _lock_factory_call(node.value):
+            if isinstance(node.target, ast.Name):
+                out.add(node.target.id)  # dataclass field
+            else:
+                attr = _self_attr_target(node.target)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Writes to ``self.*`` within one method, tagged with whether a
+    ``with self.<lock>`` block encloses them lexically."""
+
+    def __init__(self, lock_attrs: set[str]):
+        self.lock_attrs = lock_attrs
+        self.depth = 0
+        # attr -> list of (node, locked, is_rmw)
+        self.writes: List[tuple[ast.AST, str, bool, bool]] = []
+
+    def _is_lock_ctx(self, item: ast.withitem) -> bool:
+        expr = item.context_expr
+        # both `with self._lock:` and `with self._lock.acquire_timeout()`
+        attr = None
+        if isinstance(expr, ast.Attribute):
+            attr = _self_attr_target(expr)
+            if attr is None and isinstance(expr.value, ast.Attribute):
+                attr = _self_attr_target(expr.value)
+        elif isinstance(expr, ast.Call):
+            attr = _self_attr_target(expr.func)
+            if attr is None and isinstance(expr.func, ast.Attribute):
+                attr = _self_attr_target(expr.func.value)
+        return attr in self.lock_attrs
+
+    def visit_With(self, node: ast.With) -> None:
+        holds = any(self._is_lock_ctx(item) for item in node.items)
+        if holds:
+            self.depth += 1
+        self.generic_visit(node)
+        if holds:
+            self.depth -= 1
+
+    def _record(self, target: ast.AST, node: ast.AST, rmw: bool) -> None:
+        attr = _self_attr_target(target)
+        if attr is not None and attr not in self.lock_attrs:
+            self.writes.append((node, attr, self.depth > 0, rmw))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            self._record(tgt, node, rmw=False)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record(node.target, node, rmw=False)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(node.target, node, rmw=True)
+        self.generic_visit(node)
+
+    # nested defs get their own scan via the class walk; don't descend
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _scan_class(pf: ParsedFile, cls: ast.ClassDef):
+    lock_attrs = _lock_attrs(cls)
+    if not lock_attrs:
+        return None
+    # attr -> {"locked": [(node, method)], "unlocked": [...], "rmw": [...]}
+    state: dict[str, dict] = {}
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scan = _MethodScan(lock_attrs)
+        for stmt in item.body:
+            scan.visit(stmt)
+        for node, attr, locked, rmw in scan.writes:
+            slot = state.setdefault(
+                attr, {"locked": [], "unlocked": [], "rmw": []})
+            is_init = item.name == "__init__"
+            if locked:
+                slot["locked"].append((node, item.name))
+            elif not is_init:
+                slot["unlocked"].append((node, item.name))
+                if rmw:
+                    slot["rmw"].append((node, item.name))
+    return lock_attrs, state
+
+
+@rule("lock-discipline")
+def check_lock_discipline(files: List[ParsedFile]) -> Iterable[Finding]:
+    """Attributes written both under and outside ``with self.<lock>`` in
+    a lock-owning class — one of the two sites races."""
+    for pf in files:
+        if not in_scope(pf):
+            continue
+        for cls in ast.walk(pf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            scanned = _scan_class(pf, cls)
+            if scanned is None:
+                continue
+            lock_attrs, state = scanned
+            locks = "/".join(sorted(lock_attrs))
+            for attr, slot in sorted(state.items()):
+                if not slot["locked"] or not slot["unlocked"]:
+                    continue
+                node, method = slot["unlocked"][0]
+                lk_node, lk_method = slot["locked"][0]
+                yield Finding(
+                    "lock-discipline", pf.rel, node.lineno, node.col_offset,
+                    f"{cls.name}.{attr} is written without holding "
+                    f"self.{locks} in {method}() but under the lock in "
+                    f"{lk_method}() (line {lk_node.lineno}) — one of the "
+                    "two sites races; hold the lock or pragma the "
+                    "deliberate one with its reason",
+                )
+
+
+@rule("unlocked-rmw")
+def check_unlocked_rmw(files: List[ParsedFile]) -> Iterable[Finding]:
+    """Read-modify-writes of instance state outside any lock block, in
+    classes that own a lock — lost updates under concurrent writers."""
+    for pf in files:
+        if not in_scope(pf):
+            continue
+        for cls in ast.walk(pf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            scanned = _scan_class(pf, cls)
+            if scanned is None:
+                continue
+            lock_attrs, state = scanned
+            locks = "/".join(sorted(lock_attrs))
+            for attr, slot in sorted(state.items()):
+                for node, method in slot["rmw"]:
+                    yield Finding(
+                        "unlocked-rmw", pf.rel, node.lineno, node.col_offset,
+                        f"{cls.name}.{attr} is read-modify-written in "
+                        f"{method}() without holding self.{locks} — "
+                        "concurrent writers lose increments (the Counter "
+                        "contract this registry documents)",
+                    )

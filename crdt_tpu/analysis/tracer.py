@@ -1,0 +1,270 @@
+"""Tracer-hygiene lint — jax tracing contracts the runtime only reports
+as deep, late errors (or worse, silently miscompiles).
+
+* ``jit-host-coercion`` — ``bool()``/``int()``/``float()`` of a traced
+  argument, or branching (``if``/``while``) on a bare traced argument,
+  inside a ``@jit``-decorated function.  At trace time these raise
+  ``TracerBoolConversionError`` — but only on the first call with a
+  shape that reaches the branch, which is how they slip past smoke
+  tests.  Parameters named in ``static_argnames``/``static_argnums``
+  are concrete Python values and exempt.
+* ``pallas-int64`` — ``int64`` dtypes inside the Pallas kernel modules.
+  Mosaic has no 64-bit support; under jax 0.4.x an i64 scalar lowering
+  into an interpret-mode kernel recurses forever in the int64→int32
+  truncation (the ROADMAP "jax 0.4.x Pallas skew" class — 33 known
+  test failures).  Index/scalar plumbing in these modules must stay
+  i32.
+* ``jit-dict-order`` — dict/set iteration order flowing into jit
+  boundaries: iterating ``.items()``/``.keys()``/``.values()`` or a
+  ``set(...)`` inside a jit-decorated function, or splatting
+  ``d.values()`` into a call of a known-jitted callable.  Python dicts
+  preserve insertion order, so two replicas that interned in different
+  orders trace different programs from "the same" state — wrap the
+  iteration in ``sorted(...)`` or iterate a canonical list.
+
+All three are lexical approximations (no interprocedural reachability);
+they are tuned so the current tree is clean and the fixture suite
+(`tests/analysis_fixtures/`) defines the exact contract.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from .core import Finding, ParsedFile, dotted_name, rule
+
+_COERCIONS = {"bool", "int", "float"}
+_DICT_ITERS = {"items", "keys", "values"}
+
+#: modules where int64 must not appear (the Mosaic kernels); any other
+#: module that imports ``jax.experimental.pallas`` is scoped in too
+PALLAS_MODULES = (
+    "crdt_tpu/ops/orswot_pallas.py",
+    "crdt_tpu/ops/orswot_fold_aligned.py",
+)
+
+
+def _imports_pallas(tree: ast.AST) -> bool:
+    """Imports the Pallas kernel DSL itself (``jax.experimental.pallas``
+    or deeper) — not merely a module that happens to mention pallas in
+    its name (bench/host code calling a kernel wrapper is host code)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.startswith("jax.experimental.pallas")
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith("jax.experimental.pallas"):
+                return True
+            if mod == "jax.experimental" and any(
+                    a.name == "pallas" for a in node.names):
+                return True
+    return False
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """The decorator node when it marks a function as jitted:
+    ``@jit`` / ``@jax.jit`` / ``@[functools.]partial(jax.jit, ...)``.
+    Returns the partial() Call (for static-arg extraction) or a dummy
+    when the decorator carries no static args."""
+    name = dotted_name(dec)
+    if name.rsplit(".", 1)[-1] == "jit":
+        return ast.Call(func=dec, args=[], keywords=[])
+    if isinstance(dec, ast.Call):
+        fn_name = dotted_name(dec.func).rsplit(".", 1)[-1]
+        if fn_name == "jit":
+            return dec
+        if fn_name == "partial" and dec.args:
+            inner = dotted_name(dec.args[0]).rsplit(".", 1)[-1]
+            if inner == "jit":
+                return dec
+    return None
+
+
+def _static_params(fn: ast.FunctionDef, deco: ast.Call) -> set[str]:
+    """Parameter names the jit decorator marks static."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    for kw in deco.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                static.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        static.add(el.value)
+        elif kw.arg == "static_argnums":
+            v = kw.value
+            nums = []
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [el.value for el in v.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)]
+            for i in nums:
+                if 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+def _jitted_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            deco = _jit_decorator(dec)
+            if deco is not None:
+                yield node, deco
+                break
+
+
+@rule("jit-host-coercion")
+def check_host_coercion(files: List[ParsedFile]) -> Iterable[Finding]:
+    """Host coercion of traced values inside jit-decorated functions."""
+    for pf in files:
+        for fn, deco in _jitted_functions(pf.tree):
+            static = _static_params(fn, deco)
+            traced = {
+                a.arg for a in fn.args.posonlyargs + fn.args.args
+                + fn.args.kwonlyargs
+            } - static - {"self", "cls"}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id in _COERCIONS and \
+                        len(node.args) == 1 and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in traced:
+                    yield Finding(
+                        "jit-host-coercion", pf.rel, node.lineno,
+                        node.col_offset,
+                        f"{node.func.id}({node.args[0].id}) inside "
+                        f"@jit function {fn.name}() coerces a traced "
+                        "value on the host — raises at trace time; mark "
+                        "the argument static or keep the computation "
+                        "on-device",
+                    )
+                elif isinstance(node, (ast.If, ast.While)) and \
+                        isinstance(node.test, ast.Name) and \
+                        node.test.id in traced:
+                    yield Finding(
+                        "jit-host-coercion", pf.rel, node.lineno,
+                        node.col_offset,
+                        f"branching on traced argument "
+                        f"{node.test.id!r} inside @jit function "
+                        f"{fn.name}() — Python control flow cannot "
+                        "depend on a tracer; use jnp.where/lax.cond or "
+                        "mark it static",
+                    )
+
+
+@rule("pallas-int64")
+def check_pallas_int64(files: List[ParsedFile]) -> Iterable[Finding]:
+    """int64 dtypes in the Mosaic kernel modules (jax 0.4.x lowers them
+    into an infinite truncation recursion; Mosaic is 32-bit)."""
+    for pf in files:
+        if pf.rel not in PALLAS_MODULES and not _imports_pallas(pf.tree):
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "int64":
+                base = dotted_name(node.value)
+                yield Finding(
+                    "pallas-int64", pf.rel, node.lineno, node.col_offset,
+                    f"{base}.int64 in a Pallas kernel module — Mosaic "
+                    "has no 64-bit lowering (jax 0.4.x recurses in the "
+                    "int64→int32 truncation); keep kernel index/scalar "
+                    "plumbing i32",
+                )
+            elif isinstance(node, ast.keyword) and node.arg == "dtype" and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value == "int64":
+                yield Finding(
+                    "pallas-int64", pf.rel, node.lineno,
+                    getattr(node.value, "col_offset", 0),
+                    'dtype="int64" in a Pallas kernel module — Mosaic '
+                    "has no 64-bit lowering; use int32",
+                )
+
+
+def _known_jitted_names(tree: ast.AST) -> set[str]:
+    """Names (or ``self.attr`` spelled ``attr``) bound to the result of
+    a ``jax.jit(...)`` call anywhere in the module."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        if dotted_name(node.value.func).rsplit(".", 1)[-1] != "jit":
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                out.add(tgt.attr)
+    return out
+
+
+def _dict_iter_call(node: ast.AST) -> Optional[str]:
+    """``d.items()``/``d.keys()``/``d.values()``/``set(...)`` → a label,
+    else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _DICT_ITERS and not node.args:
+        return f".{node.func.attr}()"
+    if isinstance(node.func, ast.Name) and node.func.id == "set":
+        return "set(...)"
+    return None
+
+
+@rule("jit-dict-order")
+def check_dict_order(files: List[ParsedFile]) -> Iterable[Finding]:
+    """Dict/set iteration order feeding jit-traced computation."""
+    for pf in files:
+        # (a) iteration inside jit-decorated functions
+        for fn, _deco in _jitted_functions(pf.tree):
+            iters = []
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append((node, node.iter))
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    iters.extend((node, gen.iter) for gen in node.generators)
+            for node, it in iters:
+                label = _dict_iter_call(it)
+                if label is not None:
+                    yield Finding(
+                        "jit-dict-order", pf.rel, node.lineno,
+                        node.col_offset,
+                        f"iterating {label} inside @jit function "
+                        f"{fn.name}() — dict/set order is insertion/"
+                        "hash order, so replicas that interned "
+                        "differently trace different programs; iterate "
+                        "sorted(...) or a canonical list",
+                    )
+        # (b) dict views splatted into known-jitted callables
+        jitted = _known_jitted_names(pf.tree)
+        if not jitted:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee not in jitted:
+                continue
+            for arg in node.args:
+                inner = arg.value if isinstance(arg, ast.Starred) else arg
+                label = _dict_iter_call(inner)
+                if label is not None:
+                    yield Finding(
+                        "jit-dict-order", pf.rel, arg.lineno,
+                        arg.col_offset,
+                        f"passing {label} into jitted callable "
+                        f"{callee!r} — argument order follows dict/set "
+                        "order; pass sorted(...) or a canonical tuple",
+                    )

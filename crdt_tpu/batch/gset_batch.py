@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from ..error import WireFormatError
 from ..scalar.gset import GSet
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
@@ -79,7 +80,7 @@ class GSetBatch:
         if status.any():
             hard = np.nonzero(status == 2)[0]
             if hard.size:
-                raise ValueError(
+                raise WireFormatError(
                     f"member universe overflow: object {int(hard[0])} has a "
                     f"member id >= capacity {member_capacity}"
                 )

@@ -23,7 +23,7 @@ import jax
 import numpy as np
 from flax import struct
 
-from ..error import CapacityOverflowError
+from ..error import CapacityOverflowError, WireFormatError
 from ..config import counter_dtype
 from ..ops import map_ops
 from ..ops.orswot_ops import EMPTY
@@ -232,18 +232,18 @@ class MapBatch:
                 first = int(hard[0])
                 code = int(status[first])
                 if code == 2:
-                    raise ValueError(
+                    raise WireFormatError(
                         f"map {first} has more keys than key_capacity "
                         f"{cfg.key_capacity}"
                     )
                 if code == 3:
-                    raise ValueError(
+                    raise WireFormatError(
                         f"map {first} has more deferred rows than "
                         f"deferred_capacity {cfg.deferred_capacity}"
                     )
                 if code == 5:
-                    raise ValueError(f"map {first} has {value_overflow_msg}")
-                raise ValueError(
+                    raise WireFormatError(f"map {first} has {value_overflow_msg}")
+                raise WireFormatError(
                     f"map {first}: actor outside the identity registry "
                     f"range [0, {cfg.num_actors})"
                 )

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..config import counter_dtype
-from ..error import CapacityOverflowError
+from ..error import CapacityOverflowError, WireFormatError
 from ..ops import clock_ops, mvreg_ops
 from ..scalar.mvreg import MVReg
 from ..utils.interning import Universe
@@ -94,11 +94,11 @@ class MVRegBatch:
             if hard.size:
                 first = int(hard[0])
                 if int(status[first]) == 2:
-                    raise ValueError(
+                    raise WireFormatError(
                         f"register {first} has more values than mv_capacity "
                         f"{cfg.mv_capacity}"
                     )
-                raise ValueError(
+                raise WireFormatError(
                     f"register {first}: actor outside the identity registry "
                     f"range [0, {cfg.num_actors})"
                 )

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Sequence
 
+from ..error import WireFormatError
+
 WIRE_TAG_VCLOCK = 0x20    # serde.py _T_VCLOCK
 WIRE_TAG_GCOUNTER = 0x22  # serde.py _T_GCOUNTER
 
@@ -135,7 +137,7 @@ def planes_from_wire(blobs, universe, probe_name, ingest, planes_of_scalars,
         hard = np.nonzero(status > 1)[0]
         if hard.size:
             first = int(hard[0])
-            raise ValueError(
+            raise WireFormatError(
                 f"object {first}: actor outside the identity registry "
                 f"range [0, {cfg.num_actors})"
             )
@@ -244,16 +246,16 @@ def orswot_planes_from_wire(blobs, universe, out=None):
             first = int(hard[0])
             code = int(status[first])
             if code == 2:
-                raise ValueError(
+                raise WireFormatError(
                     f"object {first}: members > member_capacity "
                     f"{cfg.member_capacity}"
                 )
             if code == 3:
-                raise ValueError(
+                raise WireFormatError(
                     f"object {first}: deferred rows > deferred_capacity "
                     f"{cfg.deferred_capacity}"
                 )
-            raise ValueError(
+            raise WireFormatError(
                 f"object {first}: actor outside the identity registry "
                 f"range [0, {cfg.num_actors})"
             )

@@ -89,6 +89,21 @@ def raise_for_overflow(overflow, context: str) -> None:
     )
 
 
+class WireFormatError(CrdtError, ValueError):
+    """A wire blob violated the binary grammar or the static capacities
+    of the receiving fleet (actor outside the identity registry, more
+    members than ``member_capacity``, ...).
+
+    No reference counterpart — the reference's serde is infallible by
+    construction (serde derive); the TPU build's native bulk parsers
+    triage per-blob status codes instead, and hard statuses surface as
+    this.  Subclasses ``ValueError`` so existing callers (and tests)
+    that catch the old error type keep working; the wire error-contract
+    lint (``crdt_tpu.analysis.wire``) requires every decode path to
+    raise a :class:`CrdtError` subclass, which this satisfies.
+    """
+
+
 class NestedOpFailed(CrdtError):
     """We failed to apply a nested op to a nested CRDT (`error.rs:16-17`)."""
 

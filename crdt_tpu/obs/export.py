@@ -26,16 +26,7 @@ import time
 from typing import Optional
 
 from . import convergence, events, metrics
-
-PROM_PREFIX = "crdt_tpu"
-
-_SAN = {ord(c): "_" for c in ".-/ "}
-
-
-def _sanitize(name: str) -> str:
-    """Dotted metric name → Prometheus-legal metric name body."""
-    out = name.translate(_SAN)
-    return "".join(c if c.isalnum() or c == "_" else "_" for c in out)
+from .namespace import PROM_PREFIX, sanitize as _sanitize
 
 
 def _fmt(v: float) -> str:

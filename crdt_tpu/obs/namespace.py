@@ -1,0 +1,165 @@
+"""The metric-namespace manifest — one source of truth for `crdt_tpu_*`.
+
+PERF.md's "Metric naming" table used to be prose only; a counter and a
+histogram silently sharing a name (`executor.regrow`, PR 3) showed that
+the namespace needs to be machine-checkable.  This module IS the table:
+every metric the process may emit matches exactly one :class:`NameSpec`
+pattern here, with its registry type.  Two consumers:
+
+* :mod:`crdt_tpu.obs.export` — the Prometheus prefix and name
+  sanitization live here, so the exported name for any internal name is
+  derivable without running the exporter.
+* :mod:`crdt_tpu.analysis.telemetry` — the static namespace lint
+  extracts every metric name declared in the source tree and fails on
+  names outside this table (and on cross-type collisions).
+
+Patterns are dotted, with ``*`` matching exactly one segment (segments
+never contain dots by convention; dynamic segments — peer labels,
+kernel names, fallback reasons — are single identifiers).  Adding a
+metric family means adding a row here FIRST; the lint turns a missing
+row into a CI failure, which is the point.
+
+Stdlib-only: no jax, no numpy — the lint must be runnable without the
+device runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, NamedTuple, Optional
+
+#: the Prometheus metric-name prefix every exported name carries
+PROM_PREFIX = "crdt_tpu"
+
+#: registry types a name can claim (one per name, forever)
+KINDS = ("counter", "gauge", "histogram")
+
+_SAN = {ord(c): "_" for c in ".-/ "}
+
+
+def sanitize(name: str) -> str:
+    """Dotted internal metric name → Prometheus-legal metric name body
+    (dots/dashes/slashes/spaces to underscores, anything else
+    non-alphanumeric likewise)."""
+    out = name.translate(_SAN)
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in out)
+
+
+def prometheus_name(name: str, kind: str) -> str:
+    """The exported Prometheus name for an internal dotted name:
+    ``crdt_tpu_<sanitized>`` plus the ``_total`` suffix for counters
+    (histograms grow ``_bucket``/``_sum``/``_count`` series at render
+    time; the base name is returned here)."""
+    base = f"{PROM_PREFIX}_{sanitize(name)}"
+    return f"{base}_total" if kind == "counter" else base
+
+
+class NameSpec(NamedTuple):
+    """One documented metric family: a dotted pattern (``*`` = exactly
+    one segment), its registry type, and what it measures."""
+
+    pattern: str
+    kind: str
+    doc: str
+
+    def matches(self, name: str) -> bool:
+        pat = self.pattern.split(".")
+        got = name.split(".")
+        if len(pat) != len(got):
+            return False
+        return all(p == "*" or p == g for p, g in zip(pat, got))
+
+
+#: Every metric family the process may emit.  The namespace lint
+#: (`python -m crdt_tpu.analysis`) fails the build on any call site
+#: whose name matches no row, or whose type disagrees with the row.
+NAMESPACE: tuple[NameSpec, ...] = (
+    # -- wire codec accounting (batch/wirebulk.record_wire) ------------------
+    NameSpec("wire.*.*.native", "counter",
+             "blobs that took the native path, per <type>.<direction>"),
+    NameSpec("wire.*.*.fallback", "counter",
+             "blobs that fell back to the Python codec"),
+    NameSpec("wire.*.*.fallback_reason.*", "counter",
+             "fallback blobs by reason (no_engine/non_identity/grammar/"
+             "overflow_zigzag)"),
+    # -- sync protocol frames (utils/tracing.record_sync + sync/delta) ------
+    NameSpec("wire.sync.*.bytes", "counter",
+             "bytes on the wire per sync leg (digest/delta/full)"),
+    NameSpec("wire.sync.*.objects", "counter",
+             "objects shipped per sync leg"),
+    NameSpec("wire.sync.*.frame_bytes", "histogram",
+             "per-frame size distribution per sync leg"),
+    NameSpec("sync.frame.*.decoded", "counter",
+             "accepted frames by type (digest/delta/full)"),
+    NameSpec("sync.frame.rejected.*", "counter",
+             "rejected frames by reason (truncated/version_mismatch/...)"),
+    # -- sync sessions (sync/session.py) -------------------------------------
+    NameSpec("sync.sessions", "counter", "sessions started"),
+    NameSpec("sync.errors", "counter", "sessions that raised"),
+    NameSpec("sync.digest_collision", "counter",
+             "post-delta digest mismatches (64-bit collision / mode skew)"),
+    NameSpec("sync.full_state_fallback", "counter",
+             "sessions that shipped full state"),
+    NameSpec("sync.full_state_fallback.*", "counter",
+             "full-state fallbacks by reason (requested/threshold/"
+             "digest_collision)"),
+    NameSpec("sync.digest_exchange", "histogram",
+             "digest-exchange phase wall time (span)"),
+    NameSpec("sync.delta_exchange", "histogram",
+             "delta-exchange phase wall time (span)"),
+    NameSpec("sync.full_state_exchange", "histogram",
+             "full-state exchange wall time (span)"),
+    # -- per-peer convergence gauges (obs/convergence.py) --------------------
+    NameSpec("sync.peer.*.divergence", "gauge",
+             "objects diverged at the last digest exchange"),
+    NameSpec("sync.peer.*.divergence_frac", "gauge",
+             "diverged fraction of the fleet"),
+    NameSpec("sync.peer.*.rounds_to_converge", "gauge",
+             "digest exchanges the last session needed"),
+    NameSpec("sync.peer.*.staleness_s", "gauge",
+             "seconds since the last converged sync (refreshed at scrape)"),
+    NameSpec("sync.peer.*.delta_ratio", "gauge",
+             "last session's payload bytes over the full-state reference"),
+    # -- native engine (native/engine.py) ------------------------------------
+    NameSpec("native.engine.*.calls", "counter",
+             "native kernel invocations per entry point"),
+    NameSpec("native.engine.*.objects", "counter",
+             "objects processed per native entry point"),
+    # -- pipelined wire loop (batch/wireloop.py) -----------------------------
+    NameSpec("wireloop.stalls", "counter",
+             "folds that waited on the parse thread past the threshold"),
+    NameSpec("wireloop.staging_free", "gauge",
+             "free staging plane sets (0 = parse-bound)"),
+    NameSpec("wireloop.parsed_depth", "gauge",
+             "parsed fleets queued ahead of the fold"),
+    # -- executor (parallel/executor.py) -------------------------------------
+    NameSpec("executor.recovery.*", "counter",
+             "recoveries by kind (regrow/transient_retry) — disjoint from "
+             "the executor.* spans by construction (the PR 3 collision)"),
+    NameSpec("executor.join_all", "histogram", "sequential fold span"),
+    NameSpec("executor.join_all_tree", "histogram", "tree join span"),
+    NameSpec("executor.merge", "histogram", "one recoverable pair merge"),
+    NameSpec("executor.regrow", "histogram", "capacity regrow span"),
+    # -- kernels (utils/tracing.timed_kernel) --------------------------------
+    NameSpec("kernel.*.errors", "counter",
+             "raising calls per timed kernel label"),
+    # -- bench probes (bench.py bench_obs_overhead) --------------------------
+    NameSpec("obs.overhead.count_probe", "counter",
+             "bench_obs_overhead per-op counter cost probe"),
+    NameSpec("obs.overhead.gauge_probe", "gauge",
+             "bench_obs_overhead per-op gauge cost probe"),
+)
+
+
+def match(name: str, kind: Optional[str] = None) -> Optional[NameSpec]:
+    """The manifest row ``name`` falls under, or None.  With ``kind``,
+    the row must also agree on the registry type (a name matching a row
+    of a different type is a namespace violation, not a match)."""
+    for spec in NAMESPACE:
+        if spec.matches(name):
+            return spec if kind is None or spec.kind == kind else None
+    return None
+
+
+def patterns(kind: Optional[str] = None) -> Iterable[NameSpec]:
+    """All manifest rows, optionally filtered by registry type."""
+    return tuple(s for s in NAMESPACE if kind is None or s.kind == kind)

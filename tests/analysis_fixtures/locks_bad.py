@@ -1,0 +1,26 @@
+"""Fixture: lock-discipline violations (MUST trigger).
+
+A lock-owning class that writes the same attribute under the lock in
+one method and bare in another, plus an unlocked read-modify-write —
+the lost-increment shape the Counter contract forbids.
+"""
+
+import threading
+
+
+class RacyAccumulator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.last = None
+
+    def add(self, n):
+        with self._lock:
+            self.total = self.total + n      # locked write ...
+            self.last = n
+
+    def sneak(self, n):
+        self.last = n                        # line 23: ... unlocked write
+
+    def bump(self):
+        self.total += 1                      # line 26: unlocked RMW

@@ -1,0 +1,27 @@
+"""Fixture: the lock-discipline twin (MUST NOT trigger).
+
+The same shapes, either properly locked or pragma'd with the reason the
+discipline is deliberately waived (the Gauge last-write-wins contract).
+"""
+
+import threading
+
+
+class DisciplinedAccumulator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.last = None
+
+    def add(self, n):
+        with self._lock:
+            self.total = self.total + n
+            self.last = n
+
+    def sneak(self, n):
+        # gauge contract: the racing write that wins IS the level
+        self.last = n  # crdtlint: disable=lock-discipline
+
+    def bump(self):
+        with self._lock:
+            self.total += 1

@@ -1,0 +1,22 @@
+"""Fixture: telemetry-namespace violations (MUST trigger).
+
+Reintroduces the exact PR 3 bug — a counter and a span histogram
+sharing ``executor.regrow`` — plus a metric outside the documented
+namespace manifest.  Never imported; the lint only parses it.
+"""
+
+from crdt_tpu.utils import tracing
+
+
+def recover(batch):
+    # the PR 3 collision: count() claims executor.regrow as a counter...
+    tracing.count("executor.regrow")                    # line 13
+    # ...while the span forwards it into a histogram of the same name
+    with tracing.span("executor.regrow"):               # line 15
+        batch = batch.with_capacity(8, 8)
+    return batch
+
+
+def rogue_metric():
+    # not a documented family: no NameSpec row covers it
+    tracing.count("totally.undocumented.metric")        # line 22

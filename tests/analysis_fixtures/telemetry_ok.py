@@ -1,0 +1,20 @@
+"""Fixture: the telemetry twin (MUST NOT trigger — pragma-suppressed).
+
+Same violation shapes as ``telemetry_bad.py`` on a distinct name (so
+the cross-file dedup can't fold the two fixtures together), with
+per-line pragmas; the findings land in the ``suppressed`` bucket, not
+the live set.
+"""
+
+from crdt_tpu.utils import tracing
+
+
+def recover(batch):
+    tracing.count("executor.twin_probe")  # crdtlint: disable=metric-type-collision,metric-namespace
+    with tracing.span("executor.twin_probe"):  # crdtlint: disable=metric-type-collision,metric-namespace
+        batch = batch.with_capacity(8, 8)
+    return batch
+
+
+def rogue_metric():
+    tracing.count("totally.undocumented.metric")  # crdtlint: disable=metric-namespace

@@ -1,0 +1,47 @@
+"""Fixture: tracer-hygiene violations (MUST trigger).
+
+Host coercion and branching on traced args inside @jit, int64 in a
+pallas-importing module, dict iteration feeding jit.  Parsed, never
+imported — jax need not exist on the box.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl  # scopes pallas-int64 in
+
+
+@jax.jit
+def bad_merge(clock, flags):
+    if flags:                                 # line 17: branch on traced arg
+        clock = clock + 1
+    return bool(flags), float(clock)          # line 19: two host coercions
+
+
+@functools.partial(jax.jit, static_argnames=("m_cap",))
+def ok_static_branch(clock, m_cap):
+    if m_cap:  # static arg: NOT a finding
+        clock = clock + 1
+    return clock
+
+
+@jax.jit
+def bad_dict_fold(state):
+    acc = 0
+    for k, v in state.items():                # line 32: dict order traces
+        acc = acc + v
+    return acc
+
+
+def kernel_index(block):
+    # int64 plumbing in a pallas module: Mosaic has no 64-bit lowering
+    idx = jnp.zeros((8,), dtype=jnp.int64)    # line 40
+    return pl.load(block, idx)
+
+
+_jit_apply = jax.jit(lambda *planes: planes)
+
+
+def bad_splat(plane_map):
+    return _jit_apply(*plane_map.values())    # line 47: dict order as args

@@ -1,0 +1,48 @@
+"""Fixture: the tracer-hygiene twin (MUST NOT trigger).
+
+Same shapes made hygienic (static args, sorted iteration, i32) or
+pragma'd where the coercion is deliberate.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl  # noqa: F401
+
+
+@functools.partial(jax.jit, static_argnames=("flags",))
+def ok_merge(clock, flags):
+    if flags:  # static: concrete Python value at trace time
+        clock = clock + 1
+    return clock
+
+
+@jax.jit
+def ok_where(clock, flags):
+    return jnp.where(flags, clock + 1, clock)
+
+
+@jax.jit
+def deliberate_coercion(clock, flags):
+    return bool(flags)  # crdtlint: disable=jit-host-coercion
+
+
+@jax.jit
+def ok_sorted_fold(state):
+    acc = 0
+    for k in sorted(state):  # canonical order: not a finding
+        acc = acc + state[k]
+    return acc
+
+
+def kernel_index(block):
+    idx = jnp.zeros((8,), dtype=jnp.int32)  # i32: the Mosaic-safe dtype
+    return pl.load(block, idx)
+
+
+_jit_apply = jax.jit(lambda *planes: planes)
+
+
+def ok_splat(plane_map):
+    return _jit_apply(*sorted(plane_map.values()))  # canonicalized

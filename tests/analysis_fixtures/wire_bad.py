@@ -1,0 +1,29 @@
+"""Fixture: wire error-contract violations (MUST trigger).
+
+A frame-decode path raising bare ValueError, a swallowing ``except
+Exception``, and a bulk wire leg that never feeds record_wire.
+"""
+
+import struct
+
+
+def decode_frame(frame):
+    if len(frame) < 8:
+        raise ValueError("short frame")       # line 12: bare ValueError
+    kind, length = struct.unpack_from("<II", frame)
+    try:
+        payload = frame[8:8 + length]
+    except Exception:                          # line 16: swallowed
+        payload = b""
+    return kind, payload
+
+
+class SilentBatch:
+    def from_wire(self, blobs, universe):      # line 22: no record_wire
+        return [b.decode() for b in blobs]
+
+    def to_wire(self, universe):
+        from crdt_tpu.batch.wirebulk import record_wire
+
+        record_wire("silent", "to_wire", native=1)
+        return [b"ok"]

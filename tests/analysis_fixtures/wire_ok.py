@@ -1,0 +1,46 @@
+"""Fixture: the wire error-contract twin (MUST NOT trigger).
+
+The same decode shapes done right: CrdtError subclasses (including the
+sanctioned convert-in-try idiom), specific excepts, record_wire on
+every leg.
+"""
+
+import struct
+
+from crdt_tpu.error import SyncProtocolError, WireFormatError
+
+
+def decode_frame(frame):
+    if len(frame) < 8:
+        raise SyncProtocolError("short frame")
+    try:
+        kind, length = struct.unpack_from("<II", frame)
+        if length > len(frame):
+            raise ValueError("overrun")  # converted below: not a finding
+    except (struct.error, ValueError) as e:
+        raise SyncProtocolError(f"malformed frame: {e}") from None
+    return kind, frame[8:8 + length]
+
+
+def decode_blob(blob):
+    if not blob:
+        raise WireFormatError("empty blob")
+    return blob[1:]
+
+
+class CountedBatch:
+    def from_wire(self, blobs, universe):
+        from crdt_tpu.batch.wirebulk import record_wire
+
+        record_wire("counted", "from_wire", native=len(blobs))
+        return [b.decode() for b in blobs]
+
+    def to_wire(self, universe):
+        # delegation to a recording helper counts too
+        return self._planes_to_wire()
+
+    def _planes_to_wire(self):
+        from crdt_tpu.batch.wirebulk import record_wire
+
+        record_wire("counted", "to_wire", native=1)
+        return [b"ok"]

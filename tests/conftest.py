@@ -42,6 +42,63 @@ def pytest_configure(config):
         "obs: observability subsystem tests (crdt_tpu.obs — metrics "
         "registry, flight recorder, exporter); tier-1 like `sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "analysis: crdtlint static-analysis tests (crdt_tpu.analysis — "
+        "rule engine, fixtures, and the repo-wide lint gate); tier-1, "
+        "jax-free",
+    )
+
+
+# -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------
+#
+# The Mosaic kernel suites fail wholesale under jax 0.4.x: i64 scalars
+# lowering into the interpret-mode Pallas kernels recurse forever in
+# Mosaic's int64→int32 truncation (ROADMAP "jax 0.4.x Pallas skew"; the
+# PR 2 compat shims recovered the collectives/executor suites but not
+# the kernels themselves).  Gate them as xfail — NOT skip — so the
+# tier-1 output distinguishes "known skew" (x) from a new regression,
+# and a jax>=0.5 box runs the full suite ungated.  The exempt tests
+# never enter a Mosaic kernel (u64 rejection / dispatch selection) and
+# pass on 0.4.x; they stay live so the gate can't mask regressions in
+# the dispatch/rejection logic.
+
+_MOSAIC_SKEW_FILES = ("test_orswot_pallas.py", "test_orswot_fold_aligned.py")
+_MOSAIC_SKEW_EXEMPT_PREFIXES = (
+    "test_u64_counters_rejected",
+    "test_ops_fold_merge_dispatch_parity[rank]",
+    "test_ops_fold_merge_pallas_u64_degrades_to_sequential",
+)
+_MOSAIC_SKEW_REASON = (
+    "known jax 0.4.x Pallas/Mosaic skew: i64 lowering into the "
+    "interpret-mode kernels recurses in Mosaic's int64->int32 "
+    "truncation (ROADMAP 'jax 0.4.x Pallas skew'); not a new "
+    "regression — kernels need a 0.4.x-safe trace mode or jax>=0.5"
+)
+
+
+def _jax_04x() -> bool:
+    import jax
+
+    try:
+        major, minor = (int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:
+        return False
+    return (major, minor) < (0, 5)
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    if not _jax_04x():
+        return
+    marker = pytest.mark.xfail(reason=_MOSAIC_SKEW_REASON, strict=False)
+    for item in items:
+        if item.fspath.basename not in _MOSAIC_SKEW_FILES:
+            continue
+        if item.name.startswith(_MOSAIC_SKEW_EXEMPT_PREFIXES):
+            continue
+        item.add_marker(marker)
 
 # hypothesis is an optional dependency of the property suites only: on
 # boxes without it the non-property tests must still collect and run, so

@@ -1,0 +1,271 @@
+"""crdtlint self-tests: the repo-wide gate, fixture contracts, and the
+regression pins the acceptance criteria name.
+
+Everything here is jax-free by construction (the lint's hard contract);
+the repo-gate test additionally proves it in a subprocess, because this
+pytest session itself imports jax via conftest.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from crdt_tpu.analysis import Baseline, ParsedFile, load_files, run_lint
+from crdt_tpu.analysis.core import default_targets, repo_root
+from crdt_tpu.obs import namespace
+
+pytestmark = pytest.mark.analysis
+
+REPO = repo_root()
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+
+
+def _lint_paths(paths):
+    files, errors = load_files(paths, root=REPO)
+    assert not errors, errors
+    return run_lint(files)
+
+
+# ---- the tier-1 gate: the shipped tree is clean, fast, and jax-free --------
+
+
+def test_repo_lint_clean_fast_and_jax_free():
+    """`python -m crdt_tpu.analysis` exits 0 on the shipped tree in
+    <5 s without importing jax (the acceptance criterion, verbatim)."""
+    probe = (
+        # some environments preload jax via a site hook (see
+        # test_import_hygiene) — only assert absence when the
+        # interpreter started without it
+        "import sys, json\n"
+        "pre_jax = 'jax' in sys.modules\n"
+        "pre_np = 'numpy' in sys.modules\n"
+        "from crdt_tpu.analysis.__main__ import main\n"
+        "rc = main(['--json'])\n"
+        "assert pre_jax or 'jax' not in sys.modules, 'lint imported jax'\n"
+        "assert pre_np or 'numpy' not in sys.modules, "
+        "'lint imported numpy'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe], cwd=REPO, capture_output=True,
+        text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["ok"] is True
+    assert out["files"] > 50  # the walk really covered the tree
+    assert out["elapsed_s"] < 5.0, f"lint took {out['elapsed_s']}s (budget 5s)"
+
+
+def test_shipped_baseline_is_empty_for_telemetry():
+    """The shipped baseline parks nothing for the telemetry rules (and,
+    as it happens, nothing at all — every finding was fixed)."""
+    path = os.path.join(REPO, "crdt_tpu", "analysis", "baseline.json")
+    with open(path) as fh:
+        entries = json.load(fh)
+    assert [e for e in entries
+            if e["rule"].startswith("metric-")] == []
+
+
+# ---- fixture suite: each rule family fires where pinned, twins stay clean --
+
+
+def _findings_by_file(result):
+    out = {}
+    for f in result.findings:
+        out.setdefault(os.path.basename(f.path), []).append(f)
+    return out
+
+
+@pytest.fixture(scope="module")
+def fixture_result():
+    paths = sorted(
+        os.path.join(FIXTURES, p)
+        for p in os.listdir(FIXTURES) if p.endswith(".py")
+    )
+    return _lint_paths(paths)
+
+
+def test_fixture_bad_files_trigger(fixture_result):
+    by_file = _findings_by_file(fixture_result)
+    rules = {name: sorted({f.rule for f in fs})
+             for name, fs in by_file.items()}
+    assert rules["telemetry_bad.py"] == [
+        "metric-namespace", "metric-type-collision"]
+    assert rules["locks_bad.py"] == ["lock-discipline", "unlocked-rmw"]
+    assert rules["tracer_bad.py"] == [
+        "jit-dict-order", "jit-host-coercion", "pallas-int64"]
+    assert rules["wire_bad.py"] == [
+        "wire-bare-valueerror", "wire-missing-record",
+        "wire-swallowed-except"]
+    # the coercion rule saw all three sites (if + bool + float)
+    coercions = [f for f in by_file["tracer_bad.py"]
+                 if f.rule == "jit-host-coercion"]
+    assert len(coercions) == 3
+
+
+def test_fixture_ok_twins_are_suppressed_not_clean(fixture_result):
+    by_file = _findings_by_file(fixture_result)
+    for ok in ("telemetry_ok.py", "locks_ok.py", "tracer_ok.py",
+               "wire_ok.py"):
+        assert ok not in by_file, (
+            f"{ok} produced live findings: {by_file.get(ok)}")
+    # the pragmas suppressed real findings — the twins aren't just inert
+    suppressed_files = {os.path.basename(f.path)
+                        for f in fixture_result.suppressed}
+    assert {"telemetry_ok.py", "locks_ok.py",
+            "tracer_ok.py"} <= suppressed_files
+
+
+def test_findings_carry_location_and_render(fixture_result):
+    f = fixture_result.findings[0]
+    assert f.line > 0 and f.path.startswith("tests/analysis_fixtures/")
+    assert f.location() in f.render() and f.rule in f.render()
+
+
+# ---- acceptance regressions: reintroduce each bug class, lint must fail ----
+
+
+def test_regrow_cross_type_collision_fails_cli(tmp_path):
+    """Reintroducing an executor.regrow-style cross-type metric name
+    makes the CLI exit non-zero, naming the rule and file:line."""
+    bad = tmp_path / "regressed.py"
+    bad.write_text(
+        "from crdt_tpu.utils import tracing\n"
+        "def recover():\n"
+        "    tracing.count('executor.regrow')\n"
+        "    with tracing.span('executor.regrow'):\n"
+        "        pass\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "crdt_tpu.analysis", str(bad)],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "metric-type-collision" in proc.stdout
+    assert "regressed.py:4" in proc.stdout  # rule anchors the later site
+
+
+def test_unlocked_write_to_guarded_attr_fails():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.n = 1\n"
+        "    def racy(self):\n"
+        "        self.n = 2\n"
+    )
+    pf = ParsedFile("x", "crdt_tpu/obs/regressed.py", src)
+    result = run_lint([pf])
+    assert [f.rule for f in result.findings] == ["lock-discipline"]
+    assert result.findings[0].line == 10
+
+
+def test_bare_valueerror_in_decode_path_fails():
+    src = (
+        "def decode_frame(frame):\n"
+        "    if not frame:\n"
+        "        raise ValueError('empty')\n"
+        "    return frame\n"
+    )
+    pf = ParsedFile("x", "crdt_tpu/sync/regressed.py", src)
+    result = run_lint([pf])
+    assert [f.rule for f in result.findings] == ["wire-bare-valueerror"]
+    assert result.findings[0].line == 3
+
+
+def test_converted_valueerror_is_sanctioned():
+    src = (
+        "from crdt_tpu.error import SyncProtocolError\n"
+        "def decode_frame(frame):\n"
+        "    try:\n"
+        "        if not frame:\n"
+        "            raise ValueError('empty')\n"
+        "    except (TypeError, ValueError) as e:\n"
+        "        raise SyncProtocolError(str(e)) from None\n"
+        "    return frame\n"
+    )
+    pf = ParsedFile("x", "crdt_tpu/sync/regressed.py", src)
+    assert run_lint([pf]).findings == []
+
+
+# ---- baseline mechanics -----------------------------------------------------
+
+
+def test_baseline_parks_finding_and_reports_stale():
+    src = (
+        "def decode_frame(frame):\n"
+        "    raise ValueError('nope')\n"
+    )
+    pf = ParsedFile("x", "crdt_tpu/sync/regressed.py", src)
+    live = run_lint([pf]).findings
+    assert len(live) == 1
+    baseline = Baseline([
+        {"rule": live[0].rule, "path": live[0].path,
+         "message": live[0].message, "justification": "test park"},
+        {"rule": "metric-namespace", "path": "crdt_tpu/gone.py",
+         "message": "whatever", "justification": "stale entry"},
+    ])
+    result = run_lint([pf], baseline=baseline)
+    assert result.findings == [] and len(result.baselined) == 1
+    assert [e["path"] for e in result.stale_baseline] == ["crdt_tpu/gone.py"]
+    # prefix matching: a trailing * survives message drift
+    baseline2 = Baseline([
+        {"rule": live[0].rule, "path": live[0].path,
+         "message": live[0].message[:20] + "*",
+         "justification": "prefix park"},
+    ])
+    assert run_lint([pf], baseline=baseline2).findings == []
+
+
+def test_baseline_rejects_malformed_entries():
+    with pytest.raises(ValueError, match="justification"):
+        Baseline([{"rule": "r", "path": "p", "message": "m"}])
+
+
+# ---- the namespace manifest -------------------------------------------------
+
+
+def test_manifest_is_well_formed():
+    seen = set()
+    for spec in namespace.NAMESPACE:
+        assert spec.kind in namespace.KINDS
+        assert spec.pattern not in seen, f"duplicate row {spec.pattern}"
+        seen.add(spec.pattern)
+        assert spec.doc
+
+
+def test_manifest_match_and_prometheus_names():
+    assert namespace.match("wire.sync.delta.bytes", "counter") is not None
+    assert namespace.match("wire.sync.delta.bytes", "gauge") is None
+    assert namespace.match("no.such.metric") is None
+    assert namespace.prometheus_name("wire.sync.delta.bytes", "counter") \
+        == "crdt_tpu_wire_sync_delta_bytes_total"
+    assert namespace.prometheus_name("sync.peer.a-1.staleness_s", "gauge") \
+        == "crdt_tpu_sync_peer_a_1_staleness_s"
+
+
+def test_every_declared_metric_is_documented():
+    """Direct form of the namespace gate: every name the tree declares
+    matches a manifest row of the same type (the lint enforces this;
+    this test keeps the property visible even if rule scoping drifts)."""
+    from crdt_tpu.analysis.telemetry import extract_decls
+
+    files, _ = load_files(default_targets(), root=REPO)
+    for d in extract_decls(files):
+        specs = [s for s in namespace.NAMESPACE
+                 if namespace_overlap(d.pattern, s.pattern, s.kind, d.kind)]
+        assert specs, f"undocumented metric {d.pattern!r} at {d.path}:{d.line}"
+
+
+def namespace_overlap(decl, pattern, spec_kind, decl_kind):
+    from crdt_tpu.analysis.core import patterns_overlap
+
+    return spec_kind == decl_kind and patterns_overlap(decl, pattern)

@@ -25,7 +25,8 @@ bench artifact (the round-5 ingest-collapse lesson).
 
 Decode paths are functions named ``from_wire`` / ``decode*`` /
 ``_unpack*`` / ``*_from_wire`` in the wire modules (``sync/``,
-``batch/wirebulk.py``, the batch codecs).
+``cluster/`` — its ARQ envelope decode and transport error paths
+carry the same contract — ``batch/wirebulk.py``, the batch codecs).
 """
 
 from __future__ import annotations
@@ -38,6 +39,7 @@ from .core import Finding, ParsedFile, ancestors, dotted_name, parents_of, rule
 #: modules under the wire error contract (repo-relative prefixes)
 WIRE_MODULES = (
     "crdt_tpu/sync/",
+    "crdt_tpu/cluster/",
     "crdt_tpu/batch/wirebulk.py",
     "crdt_tpu/batch/orswot_batch.py",
     "crdt_tpu/batch/vclock_batch.py",
@@ -63,7 +65,8 @@ _BARE_ERRORS = {"ValueError", "TypeError", "KeyError", "struct.error"}
 _CRDT_ERRORS = {
     "CrdtError", "SyncProtocolError", "WireFormatError",
     "CapacityOverflowError", "ConflictingMarker", "MergeConflict",
-    "NestedOpFailed",
+    "NestedOpFailed", "TransportError", "SyncTimeoutError",
+    "PeerUnavailableError", "TransportClosedError", "TransportFrameError",
 }
 
 

@@ -1,0 +1,172 @@
+"""Deterministic fault injection — the adversary the cluster runtime
+is tested against.
+
+Wraps any :class:`~crdt_tpu.cluster.transport.Transport`'s SEND side
+with a seeded fault roll per frame: drop, delay (reorder behind the
+next frame), truncate, duplicate, and disconnect-mid-frame (a prefix
+ships, then the link goes down for ``reconnect_after`` frames — the
+flap).  Receive passes through untouched: injecting on one side's send
+is injecting on the other side's recv, and keeping one injection point
+makes the RNG consumption order — and therefore the whole fault
+schedule — a pure function of the seed.
+
+The injector lives UNDER the resilient wrapper::
+
+    session → ResilientTransport → FaultyTransport → queue/tcp
+
+so every injected fault exercises the ARQ machinery: drops and delays
+become retransmits, truncation dies at the envelope CRC and becomes a
+retransmit, duplicates are suppressed by sequence number, disconnects
+surface as transient errors that back off and retry.  Injected faults
+count under ``cluster.faults.<kind>`` — nonzero outside a test run
+means this module leaked into production wiring.
+
+:class:`FlappingDialer` injects at the DIAL level instead: a scheduled
+subset of connection attempts fail with
+:class:`~crdt_tpu.error.PeerUnavailableError`, which is what drives a
+peer through the alive → suspect → dead → probed → alive membership
+cycle in the acceptance test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional, Sequence
+
+from ..error import PeerUnavailableError, TransportClosedError
+from ..utils import tracing
+from .transport import Transport
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Per-frame fault probabilities (evaluated in this order: drop,
+    duplicate, truncate, delay, disconnect — at most one fault per
+    frame) plus the flap width.  All zeros = a transparent wrapper."""
+
+    seed: int = 0
+    drop: float = 0.0
+    duplicate: float = 0.0
+    truncate: float = 0.0
+    delay: float = 0.0
+    disconnect: float = 0.0
+    #: frames the link stays down after an injected disconnect (every
+    #: send in the window fails with TransportClosedError, then the
+    #: link self-heals — the flapping-peer shape)
+    reconnect_after: int = 6
+
+    def total(self) -> float:
+        return (self.drop + self.duplicate + self.truncate + self.delay
+                + self.disconnect)
+
+
+class FaultyTransport(Transport):
+    """``inner`` with ``plan``'s faults injected on the send side.
+
+    Deterministic: the k-th ``send`` consumes the same RNG draws for
+    the same plan regardless of timing, so a failing fleet test replays
+    exactly from its seed.  Per-instance ``injected`` tallies mirror
+    the ``cluster.faults.*`` counters for per-link assertions.
+    """
+
+    def __init__(self, inner: Transport, plan: FaultPlan, *,
+                 name: str = "faulty"):
+        if not 0.0 <= plan.total() <= 1.0:
+            raise ValueError(
+                f"fault probabilities sum to {plan.total():.3f}, "
+                "need a value in [0, 1]"
+            )
+        self._inner = inner
+        self.plan = plan
+        self.name = name
+        self._rng = random.Random(plan.seed)
+        self._down_for = 0          # injected-disconnect frames remaining
+        self._delayed: Optional[bytes] = None
+        self.injected = {k: 0 for k in
+                         ("drop", "duplicate", "truncate", "delay",
+                          "disconnect")}
+
+    def _fault(self, kind: str) -> None:
+        self.injected[kind] += 1
+        tracing.count(f"cluster.faults.{kind}")
+
+    def send(self, frame: bytes) -> None:
+        frame = bytes(frame)
+        # one roll per send attempt, BEFORE the down-window check, so
+        # the fault schedule stays a function of the attempt count only
+        roll = self._rng.random()
+        cut = self._rng.random()
+        if self._down_for > 0:
+            self._down_for -= 1
+            raise TransportClosedError(
+                f"{self.name}: injected link-down window "
+                f"({self._down_for + 1} frames remaining)"
+            )
+        p = self.plan
+        edge = p.drop
+        if roll < edge:
+            self._fault("drop")
+            return
+        edge += p.duplicate
+        if roll < edge:
+            self._fault("duplicate")
+            self._inner.send(frame)
+            self._inner.send(frame)
+        elif roll < (edge := edge + p.truncate):
+            self._fault("truncate")
+            self._inner.send(frame[: int(cut * len(frame))])
+        elif roll < (edge := edge + p.delay):
+            # hold the frame; it ships AFTER the next one (reorder). A
+            # frame still held at close is a drop — the ARQ's problem.
+            self._fault("delay")
+            if self._delayed is not None:
+                self._inner.send(self._delayed)
+            self._delayed = frame
+            return
+        elif roll < edge + p.disconnect:
+            self._fault("disconnect")
+            self._down_for = max(0, p.reconnect_after - 1)
+            self._inner.send(frame[: int(cut * len(frame))])
+            raise TransportClosedError(
+                f"{self.name}: injected disconnect mid-frame"
+            )
+        else:
+            self._inner.send(frame)
+        if self._delayed is not None:
+            delayed, self._delayed = self._delayed, None
+            self._inner.send(delayed)
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        return self._inner.recv(timeout)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class FlappingDialer:
+    """A dialer whose k-th attempt succeeds iff ``schedule[k % len]``
+    is true — deterministic dial-level flapping.
+
+    Wraps any :data:`~crdt_tpu.cluster.gossip.Dialer`; refused attempts
+    count under ``cluster.faults.dial_refused`` and raise
+    :class:`~crdt_tpu.error.PeerUnavailableError`, which is what the
+    membership thresholds escalate on.
+    """
+
+    def __init__(self, dial, schedule: Sequence[bool]):
+        if not schedule:
+            raise ValueError("schedule must be non-empty")
+        self._dial = dial
+        self._schedule = tuple(bool(x) for x in schedule)
+        self._calls = 0
+
+    def __call__(self, peer) -> Transport:
+        up = self._schedule[self._calls % len(self._schedule)]
+        self._calls += 1
+        if not up:
+            tracing.count("cluster.faults.dial_refused")
+            raise PeerUnavailableError(
+                f"injected dial refusal (attempt {self._calls})"
+            )
+        return self._dial(peer)

@@ -1,0 +1,317 @@
+"""Gossip scheduler — who syncs with whom, when.
+
+The sync protocol (PR 2) answers *how* two replicas reconcile; the
+telemetry layer (PR 3) answers *how far apart* every peer pair is.
+This module closes the loop: a scheduler that each round ranks the
+roster by the per-peer staleness/divergence the convergence tracker
+already keeps (``sync.peer.<peer>.staleness_s`` — the gauges ROADMAP
+said a gossip scheduler should pick peers off), dials the most-needy
+``fanout`` peers, and runs their sessions concurrently over hardened
+transports.
+
+Scheduling policy (:meth:`GossipScheduler.rank_peers`):
+
+1. never-synced peers first (infinite staleness),
+2. then by seconds since the last converged sync with that peer,
+3. ties broken toward the peer that diverged most last time
+   (:meth:`~crdt_tpu.obs.convergence.ConvergenceTracker.urgency`);
+4. dead peers join the candidate set only every ``probe_dead_every``
+   rounds — the probe that re-admits a flapping peer without letting a
+   truly dead one eat a dial every round.
+
+Per-endpoint session locks: the scheduler holds one lock per peer id
+and skips (never queues behind) a peer whose previous session is still
+running, so two rounds can never interleave frames on one endpoint —
+the lock-step protocol cannot multiplex.  The node itself serializes
+initiated-vs-accepted sessions the same way (:class:`ClusterNode`).
+
+Every round lands in the flight recorder (kind ``cluster.round`` with
+the per-peer outcomes) and the ``cluster.{rounds,sessions.*}``
+counters; round wall time is the ``cluster.round`` span histogram.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..error import PeerUnavailableError, SyncProtocolError, TransportError
+from ..obs import convergence as obs_convergence
+from ..obs import events as obs_events
+from ..sync.session import SyncReport, SyncSession
+from ..utils import tracing
+from . import membership as membership_mod
+from .transport import Transport
+
+#: a dialer: PeerInfo -> connected Transport (raises
+#: PeerUnavailableError when the peer cannot be reached)
+Dialer = Callable[[membership_mod.PeerInfo], Transport]
+
+
+def hello_dial(transport: Transport, node_id: str) -> None:
+    """Initiator half of the one-frame identity handshake: ship our
+    node id so the acceptor can label its gauges/session events with
+    WHO dialed (the sync protocol itself is peer-anonymous)."""
+    transport.send(node_id.encode("utf-8"))
+
+
+def hello_accept(transport: Transport,
+                 timeout: Optional[float] = None) -> str:
+    """Acceptor half: the dialer's node id, decoded defensively (a
+    garbage hello still yields a usable label — the session's own frame
+    validation is what rejects a broken peer)."""
+    raw = transport.recv(timeout)
+    return raw.decode("utf-8", errors="replace")[:64] or "peer"
+
+
+class ClusterNode:
+    """One replica's identity + fleet batch, with session serialization.
+
+    The node owns the batch; every session (initiated via
+    :meth:`sync_with` or accepted via :meth:`accept`) runs under the
+    node's busy lock so two sessions never read-modify-write the batch
+    concurrently, and the converged batch replaces the old one under a
+    separate state lock.  A session that cannot start within
+    ``busy_timeout_s`` fails with :class:`~crdt_tpu.error.
+    PeerUnavailableError` — bounded, so two nodes dialing each other
+    simultaneously degrade to one retried session, not a deadlock.
+    """
+
+    def __init__(self, node_id: str, batch, universe, *,
+                 full_state_threshold: float = 0.5,
+                 busy_timeout_s: float = 10.0):
+        self.node_id = node_id
+        self.universe = universe
+        self.full_state_threshold = full_state_threshold
+        self.busy_timeout_s = busy_timeout_s
+        self._lock = threading.Lock()   # guards the batch reference
+        self._busy = threading.Lock()   # serializes whole sessions
+        self._batch = batch
+
+    @property
+    def batch(self):
+        with self._lock:
+            return self._batch
+
+    def digest(self):
+        """The canonical digest vector of the current fleet (numpy
+        u64[N]) — the convergence oracle the tests and the example
+        compare across nodes."""
+        import numpy as np
+
+        from ..sync import digest as digest_mod
+
+        return np.asarray(digest_mod.digest_of(self.batch), dtype="u8")
+
+    def _run_session(self, peer_label: str, transport: Transport
+                     ) -> SyncReport:
+        if not self._busy.acquire(timeout=self.busy_timeout_s):
+            raise PeerUnavailableError(
+                f"node {self.node_id}: busy with another session for "
+                f">{self.busy_timeout_s:.1f}s, refusing session with "
+                f"{peer_label}"
+            )
+        try:
+            session = SyncSession(
+                self.batch, self.universe, peer=peer_label,
+                full_state_threshold=self.full_state_threshold,
+            )
+            report = session.sync(transport)
+            with self._lock:
+                self._batch = session.batch
+            return report
+        finally:
+            self._busy.release()
+
+    def sync_with(self, peer_id: str, transport: Transport) -> SyncReport:
+        """Run the initiator leg of one session against ``peer_id``."""
+        return self._run_session(peer_id, transport)
+
+    def accept(self, transport: Transport, peer_id: str = "peer"
+               ) -> SyncReport:
+        """Run the acceptor leg of a session a peer dialed into us.
+        The protocol is symmetric, so this is the same state machine —
+        the split exists for listeners' readability and telemetry."""
+        return self._run_session(peer_id, transport)
+
+
+@dataclasses.dataclass
+class RoundReport:
+    """One gossip round's outcome, per peer id."""
+
+    round_no: int
+    ranked: List[str] = dataclasses.field(default_factory=list)
+    ok: List[str] = dataclasses.field(default_factory=list)
+    failed: Dict[str, str] = dataclasses.field(default_factory=dict)
+    skipped_busy: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def attempted(self) -> int:
+        return len(self.ok) + len(self.failed)
+
+
+class GossipScheduler:
+    """Staleness-driven peer selection + concurrent session fan-out.
+
+    ``dialer`` turns a :class:`~crdt_tpu.cluster.membership.PeerInfo`
+    into a connected :class:`~crdt_tpu.cluster.transport.Transport`
+    (typically ``ResilientTransport(TcpTransport(...))`` — the dialer
+    owns transport policy, the scheduler owns peer policy).  ``fanout``
+    bounds concurrent sessions per round; ``seed`` drives the interval
+    jitter so a fleet of schedulers doesn't phase-lock.
+
+    Drive it deterministically with :meth:`run_round` (what the tests
+    and the example's sweep loop do) or as a background thread via
+    :meth:`start`/:meth:`stop`.
+    """
+
+    def __init__(self, node: ClusterNode,
+                 membership: membership_mod.Membership,
+                 dialer: Dialer, *,
+                 fanout: int = 2,
+                 interval_s: float = 1.0,
+                 probe_dead_every: int = 4,
+                 session_timeout_s: float = 120.0,
+                 seed: int = 0,
+                 tracker: Optional[obs_convergence.ConvergenceTracker]
+                 = None):
+        if fanout < 1:
+            raise ValueError(f"fanout {fanout} < 1")
+        self.node = node
+        self.membership = membership
+        self.dialer = dialer
+        self.fanout = fanout
+        self.interval_s = interval_s
+        self.probe_dead_every = max(1, probe_dead_every)
+        self.session_timeout_s = session_timeout_s
+        self._tracker = tracker or obs_convergence.tracker()
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._peer_locks: Dict[str, threading.Lock] = {}
+        self._round_no = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- peer selection ------------------------------------------------------
+
+    def _endpoint_lock(self, peer_id: str) -> threading.Lock:
+        with self._lock:
+            lk = self._peer_locks.get(peer_id)
+            if lk is None:
+                lk = self._peer_locks[peer_id] = threading.Lock()
+            return lk
+
+    def rank_peers(self, round_no: int = 0
+                   ) -> List[membership_mod.PeerInfo]:
+        """The candidate roster for one round, most-in-need first.
+        Alive and suspect peers always qualify; dead peers only on
+        probe rounds (every ``probe_dead_every``-th)."""
+        states = [membership_mod.ALIVE, membership_mod.SUSPECT]
+        if round_no % self.probe_dead_every == 0:
+            states.append(membership_mod.DEAD)
+        candidates = self.membership.peers(*states)
+        return sorted(
+            candidates,
+            key=lambda p: self._tracker.urgency(p.peer_id),
+            reverse=True,
+        )
+
+    # -- one round -----------------------------------------------------------
+
+    def _session_leg(self, peer: membership_mod.PeerInfo,
+                     lock: threading.Lock, report: RoundReport,
+                     results_lock: threading.Lock) -> None:
+        try:
+            try:
+                transport = self.dialer(peer)
+                try:
+                    self.node.sync_with(peer.peer_id, transport)
+                finally:
+                    transport.close()
+            except (SyncProtocolError, TransportError) as e:
+                tracing.count("cluster.sessions.failed")
+                self.membership.record_failure(peer.peer_id)
+                obs_events.record("cluster.session", peer=peer.peer_id,
+                                  outcome="failed",
+                                  error=f"{type(e).__name__}: {e}"[:200])
+                with results_lock:
+                    report.failed[peer.peer_id] = type(e).__name__
+            else:
+                tracing.count("cluster.sessions.ok")
+                self.membership.record_success(peer.peer_id)
+                obs_events.record("cluster.session", peer=peer.peer_id,
+                                  outcome="ok")
+                with results_lock:
+                    report.ok.append(peer.peer_id)
+        finally:
+            lock.release()
+
+    def run_round(self) -> RoundReport:
+        """Rank, pick ``fanout`` peers, run their sessions concurrently,
+        record the outcomes.  Synchronous: returns when every session
+        leg finished (or the round's join deadline passed)."""
+        with self._lock:
+            self._round_no += 1
+            round_no = self._round_no
+        tracing.count("cluster.rounds")
+        report = RoundReport(round_no=round_no)
+        results_lock = threading.Lock()
+        with tracing.span("cluster.round"):
+            ranked = self.rank_peers(round_no)
+            report.ranked = [p.peer_id for p in ranked]
+            legs: List[threading.Thread] = []
+            for peer in ranked:
+                if len(legs) >= self.fanout:
+                    break
+                lk = self._endpoint_lock(peer.peer_id)
+                if not lk.acquire(blocking=False):
+                    tracing.count("cluster.sessions.skipped_busy")
+                    report.skipped_busy.append(peer.peer_id)
+                    continue
+                t = threading.Thread(
+                    target=self._session_leg,
+                    args=(peer, lk, report, results_lock),
+                    name=f"gossip-{self.node.node_id}-{peer.peer_id}",
+                    daemon=True,
+                )
+                legs.append(t)
+                t.start()
+            deadline = time.monotonic() + self.session_timeout_s
+            for t in legs:
+                t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        obs_events.record(
+            "cluster.round", node=self.node.node_id, round=round_no,
+            ok=list(report.ok), failed=dict(report.failed),
+            skipped_busy=list(report.skipped_busy),
+        )
+        return report
+
+    # -- the background loop -------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.run_round()
+            # jittered inter-round sleep so a fleet of schedulers
+            # doesn't phase-lock into synchronized dial storms
+            pause = self.interval_s * (0.5 + self._rng.random())
+            self._stop.wait(timeout=pause)
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"gossip-{self.node.node_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        self._thread = None

@@ -1,0 +1,210 @@
+"""Peer registry — who is in the fleet and how healthy they look.
+
+Riak's anti-entropy runtime kept exactly this around the CRDT library:
+a roster of peers with a health state driven by observed behavior, so
+the gossip scheduler stops hammering a dead peer but keeps probing it
+for recovery.  The state machine is the classic three-level one:
+
+* **alive** — last session succeeded (or the peer is new).
+* **suspect** — ``suspect_after`` consecutive failures; still gossiped
+  to at normal priority (one blip must not eject a peer).
+* **dead** — ``dead_after`` consecutive failures; only probed every
+  few rounds (:class:`~crdt_tpu.cluster.gossip.GossipScheduler`'s
+  ``probe_dead_every``) so a flapping peer is re-admitted the first
+  time a probe lands.
+
+One success from ANY state resets the peer to alive — health is an
+observation, not a sentence.  Every transition lands in the flight
+recorder (kind ``cluster.peer_state``) and bumps the
+``cluster.peer_transition.<state>`` counter; the current shape of the
+fleet is mirrored into ``cluster.peers.{alive,suspect,dead}`` gauges
+and per-peer ``cluster.peer.<id>.{state,consecutive_failures}`` gauges
+(Prometheus: ``crdt_tpu_cluster_*``, see ``obs/namespace.py``).
+
+Thread-safety: registry state mutates under one lock; gauge mirroring
+happens after release (the registry has its own lock — same discipline
+as :mod:`crdt_tpu.obs.convergence`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..obs import events as obs_events
+from ..obs import metrics
+from ..utils import tracing
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+#: health states in escalation order; index doubles as the gauge level
+STATES = (ALIVE, SUSPECT, DEAD)
+_LEVEL = {s: i for i, s in enumerate(STATES)}
+
+
+@dataclasses.dataclass
+class PeerInfo:
+    """One fleet member as the registry sees it.  ``address`` is opaque
+    to the cluster layer — the dialer interprets it (host/port tuple, a
+    transport factory, a queue pair)."""
+
+    peer_id: str
+    address: object = None
+    state: str = ALIVE
+    consecutive_failures: int = 0
+    sessions_ok: int = 0
+    sessions_failed: int = 0
+
+
+class Membership:
+    """The mutable peer roster + health thresholds, feeding gauges.
+
+    ``suspect_after``/``dead_after`` are consecutive-failure thresholds
+    (``suspect_after <= dead_after``); ``registry`` overrides the
+    process-global metrics registry for isolated tests.
+    """
+
+    def __init__(self, *, suspect_after: int = 2, dead_after: int = 5,
+                 registry: Optional[metrics.MetricsRegistry] = None):
+        if not 1 <= suspect_after <= dead_after:
+            raise ValueError(
+                f"need 1 <= suspect_after ({suspect_after}) <= "
+                f"dead_after ({dead_after})"
+            )
+        self.suspect_after = suspect_after
+        self.dead_after = dead_after
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._peers: Dict[str, PeerInfo] = {}
+
+    def _reg(self) -> metrics.MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else metrics.registry()
+
+    # -- roster --------------------------------------------------------------
+
+    def add(self, peer_id: str, address: object = None) -> PeerInfo:
+        """Register ``peer_id`` (idempotent — re-adding refreshes the
+        address but keeps observed health)."""
+        with self._lock:
+            info = self._peers.get(peer_id)
+            if info is None:
+                info = self._peers[peer_id] = PeerInfo(peer_id, address)
+            elif address is not None:
+                info.address = address
+            snapshot = dataclasses.replace(info)
+        self._mirror()
+        return snapshot
+
+    def remove(self, peer_id: str) -> None:
+        with self._lock:
+            self._peers.pop(peer_id, None)
+        self._mirror()
+
+    def get(self, peer_id: str) -> Optional[PeerInfo]:
+        with self._lock:
+            info = self._peers.get(peer_id)
+            return None if info is None else dataclasses.replace(info)
+
+    def peers(self, *states: str) -> List[PeerInfo]:
+        """Copies of the roster (insertion order), optionally filtered
+        to the given health states."""
+        with self._lock:
+            return [
+                dataclasses.replace(p) for p in self._peers.values()
+                if not states or p.state in states
+            ]
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out = {s: 0 for s in STATES}
+            for p in self._peers.values():
+                out[p.state] += 1
+            return out
+
+    # -- health observations -------------------------------------------------
+
+    def _transition(self, info: PeerInfo, new_state: str) -> Optional[Tuple]:
+        """State change under the lock; returns the event payload to
+        emit after release (None when the state did not change)."""
+        old = info.state
+        if old == new_state:
+            return None
+        info.state = new_state
+        return (info.peer_id, old, new_state, info.consecutive_failures)
+
+    def record_success(self, peer_id: str) -> None:
+        """One converged session with ``peer_id``: failures reset, any
+        state returns to alive (the flapping-peer re-admission path)."""
+        with self._lock:
+            info = self._peers.get(peer_id)
+            if info is None:
+                info = self._peers[peer_id] = PeerInfo(peer_id)
+            info.sessions_ok += 1
+            info.consecutive_failures = 0
+            changed = self._transition(info, ALIVE)
+        self._emit(changed)
+        self._mirror()
+
+    def record_failure(self, peer_id: str) -> None:
+        """One failed session with ``peer_id``: escalate through the
+        consecutive-failure thresholds."""
+        with self._lock:
+            info = self._peers.get(peer_id)
+            if info is None:
+                info = self._peers[peer_id] = PeerInfo(peer_id)
+            info.sessions_failed += 1
+            info.consecutive_failures += 1
+            n = info.consecutive_failures
+            if n >= self.dead_after:
+                changed = self._transition(info, DEAD)
+            elif n >= self.suspect_after:
+                changed = self._transition(info, SUSPECT)
+            else:
+                changed = None
+        self._emit(changed)
+        self._mirror()
+
+    # -- telemetry mirroring -------------------------------------------------
+
+    def _emit(self, changed: Optional[Tuple]) -> None:
+        if changed is None:
+            return
+        peer_id, old, new, failures = changed
+        tracing.count(f"cluster.peer_transition.{new}")
+        obs_events.record("cluster.peer_state", peer=peer_id, old=old,
+                          new=new, consecutive_failures=failures)
+
+    def _mirror(self) -> None:
+        with self._lock:
+            per_state = {s: 0 for s in STATES}
+            rows = []
+            for p in self._peers.values():
+                per_state[p.state] += 1
+                rows.append((p.peer_id, _LEVEL[p.state],
+                             p.consecutive_failures))
+        reg = self._reg()
+        for state, n in per_state.items():
+            reg.gauge_set(f"cluster.peers.{state}", n)
+        for peer_id, level, failures in rows:
+            reg.gauge_set(f"cluster.peer.{peer_id}.state", level)
+            reg.gauge_set(
+                f"cluster.peer.{peer_id}.consecutive_failures", failures
+            )
+
+    def snapshot(self) -> dict:
+        """JSON-ready roster state (for ``/events`` debugging and the
+        example's summary line)."""
+        with self._lock:
+            return {
+                p.peer_id: {
+                    "state": p.state,
+                    "consecutive_failures": p.consecutive_failures,
+                    "sessions_ok": p.sessions_ok,
+                    "sessions_failed": p.sessions_failed,
+                }
+                for p in self._peers.values()
+            }

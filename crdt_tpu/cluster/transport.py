@@ -1,0 +1,503 @@
+"""Transports — the byte channels a :class:`SyncSession` runs over.
+
+PR 2's session API takes raw ``send(bytes)`` / ``recv() -> bytes``
+callables and assumes an ordered, reliable stream (TCP, in-process
+queues).  That assumption is exactly what a real fleet cannot make:
+peers hang mid-frame, links flap, and a lock-step protocol over a
+silent socket blocks forever.  This module makes the channel a first-
+class object:
+
+* :class:`Transport` — the abstraction: ``send(frame)`` /
+  ``recv(timeout) -> frame`` / ``close()``.  :class:`SyncSession.sync`
+  accepts one directly (the callable API remains as a shim).
+* :class:`CallableTransport` — wraps the legacy callable pair.
+* :class:`QueuePairTransport` / :func:`queue_pair` — paired in-process
+  endpoints over queues (the test/bench transport, fault-injectable).
+* :class:`TcpTransport` — length-prefixed frames over a socket (the
+  framing ``examples/replicate_tcp.py`` always used, as a class).
+* :class:`ResilientTransport` — the hardening layer: wraps any frame
+  transport in a stop-and-wait ARQ (sequence numbers, acks, CRC-guarded
+  envelopes) with per-leg deadlines, bounded exponential backoff with
+  jitter, and a finite retry budget.  Loss, duplication, truncation,
+  reordering-by-delay and transient disconnects below it are absorbed;
+  what escapes is always a :class:`~crdt_tpu.error.TransportError`
+  subclass — :class:`~crdt_tpu.error.SyncTimeoutError` when a leg
+  deadline elapses, :class:`~crdt_tpu.error.PeerUnavailableError` when
+  the retry budget runs dry — never an unbounded spin.
+
+The ARQ is stop-and-wait (one outstanding frame per direction), which
+is all a lock-step session can use: the protocol never has two frames
+in flight the peer hasn't answered.  Each direction of a link keeps an
+independent sequence space; the receive path acks duplicates without
+re-delivering, so retransmits are idempotent end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import random
+import socket
+import struct
+import time
+import zlib
+from collections import deque
+from typing import Callable, Optional, Tuple
+
+from ..error import (
+    PeerUnavailableError,
+    SyncTimeoutError,
+    TransportClosedError,
+    TransportError,
+    TransportFrameError,
+)
+from ..obs import events as obs_events
+from ..utils import tracing
+
+
+class Transport:
+    """A connected, frame-oriented byte channel between two peers.
+
+    ``send`` ships one opaque frame; ``recv`` blocks up to ``timeout``
+    seconds (None = the transport's own default) for the next frame.
+    Failures speak the :class:`~crdt_tpu.error.TransportError` taxonomy:
+    ``recv`` raises :class:`~crdt_tpu.error.SyncTimeoutError` on
+    timeout and :class:`~crdt_tpu.error.TransportClosedError` when the
+    peer hung up.
+    """
+
+    def send(self, frame: bytes) -> None:
+        raise NotImplementedError
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:  # idempotent by contract
+        pass
+
+    def __enter__(self) -> "Transport":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class CallableTransport(Transport):
+    """The legacy ``(send, recv)`` callable pair as a :class:`Transport`.
+
+    The callables predate timeouts, so ``recv``'s ``timeout`` is advisory
+    only (the underlying callable blocks however it always did); use a
+    real transport class when deadlines matter.
+    """
+
+    def __init__(self, send: Callable[[bytes], None],
+                 recv: Callable[[], bytes]):
+        self._send = send
+        self._recv = recv
+
+    def send(self, frame: bytes) -> None:
+        self._send(frame)
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        return self._recv()
+
+
+class QueuePairTransport(Transport):
+    """One endpoint of an in-process frame channel over two queues.
+
+    ``close`` pushes a sentinel so the peer's ``recv`` raises
+    :class:`~crdt_tpu.error.TransportClosedError` instead of waiting out
+    its timeout — the in-process analogue of a TCP FIN.
+    """
+
+    _CLOSED = object()
+
+    def __init__(self, out_q: "queue.Queue", in_q: "queue.Queue",
+                 default_timeout: float = 120.0):
+        self._out = out_q
+        self._in = in_q
+        self._default_timeout = default_timeout
+        self._closed = False
+
+    def send(self, frame: bytes) -> None:
+        if self._closed:
+            raise TransportClosedError("queue transport is closed")
+        self._out.put(bytes(frame))
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        if self._closed:
+            raise TransportClosedError("queue transport is closed")
+        t = self._default_timeout if timeout is None else timeout
+        try:
+            item = self._in.get(timeout=t)
+        except queue.Empty:
+            raise SyncTimeoutError(
+                f"no frame from peer within {t:.3f}s"
+            ) from None
+        if item is self._CLOSED:
+            self._in.put(item)  # every later recv sees closed too
+            raise TransportClosedError("peer closed the queue transport")
+        return item
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._out.put(self._CLOSED)
+
+
+def queue_pair(default_timeout: float = 120.0
+               ) -> Tuple[QueuePairTransport, QueuePairTransport]:
+    """Two connected in-process endpoints (A's sends are B's recvs and
+    vice versa) — the bench/test link, and the substrate the fault
+    injector (:mod:`crdt_tpu.cluster.faults`) wraps."""
+    a_to_b: "queue.Queue" = queue.Queue()
+    b_to_a: "queue.Queue" = queue.Queue()
+    return (
+        QueuePairTransport(a_to_b, b_to_a, default_timeout),
+        QueuePairTransport(b_to_a, a_to_b, default_timeout),
+    )
+
+
+class TcpTransport(Transport):
+    """Length-prefixed frames (``<I`` prefix) over a connected socket —
+    the framing the TCP example always used, packaged so the cluster
+    runtime and the example share one implementation."""
+
+    _LEN = struct.Struct("<I")
+
+    def __init__(self, sock: socket.socket, default_timeout: float = 120.0):
+        self._sock = sock
+        self._default_timeout = default_timeout
+
+    def send(self, frame: bytes) -> None:
+        try:
+            self._sock.sendall(self._LEN.pack(len(frame)) + frame)
+        except (ConnectionError, BrokenPipeError, OSError) as e:
+            raise TransportClosedError(f"socket send failed: {e}") from e
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except socket.timeout:
+                raise SyncTimeoutError(
+                    f"socket recv timed out mid-frame ({len(buf)}/{n} bytes)"
+                ) from None
+            except (ConnectionError, OSError) as e:
+                raise TransportClosedError(f"socket recv failed: {e}") from e
+            if not chunk:
+                raise TransportClosedError("peer closed the socket mid-frame")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        t = self._default_timeout if timeout is None else timeout
+        self._sock.settimeout(t)
+        (ln,) = self._LEN.unpack(self._recv_exact(self._LEN.size))
+        return self._recv_exact(ln)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---- the resilient (ARQ) wrapper -------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Deadlines, backoff shape, and the retry budget of one
+    :class:`ResilientTransport`.
+
+    ``ack_timeout_s`` is the initial retransmit timer; each retransmit
+    multiplies it by ``backoff_factor`` up to ``max_backoff_s``, with
+    ``jitter`` (a fraction of the delay) drawn from the transport's
+    seeded RNG so a fleet of retrying peers doesn't beat in lockstep.
+    ``retry_budget`` bounds the TOTAL retransmits + transient-error
+    retries over the transport's lifetime — the no-unbounded-spin
+    guarantee: a dead peer costs at most
+    ``retry_budget × max_backoff_s`` seconds before
+    :class:`~crdt_tpu.error.PeerUnavailableError`.
+    """
+
+    send_deadline_s: float = 30.0
+    recv_deadline_s: float = 30.0
+    ack_timeout_s: float = 0.1
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 2.0
+    jitter: float = 0.25
+    retry_budget: int = 64
+
+
+_DATA = 0x01
+_ACK = 0x02
+
+#: ARQ envelope: kind(1) | seq(8) | crc32(4) | payload_len(4) | payload
+_ENV = struct.Struct("<BQII")
+
+
+def encode_envelope(kind: int, seq: int, payload: bytes = b"") -> bytes:
+    return _ENV.pack(kind, seq, zlib.crc32(payload), len(payload)) + payload
+
+
+def decode_envelope(env: bytes) -> Tuple[int, int, bytes]:
+    """``(kind, seq, payload)`` of a validated ARQ envelope.  Raises
+    :class:`~crdt_tpu.error.TransportFrameError` on truncation, length
+    or CRC mismatch, or an unknown kind — the receiver treats all of
+    those exactly like loss (drop; the sender retransmits)."""
+    if len(env) < _ENV.size:
+        raise TransportFrameError(
+            f"truncated ARQ envelope: {len(env)} bytes < "
+            f"{_ENV.size}-byte header"
+        )
+    kind, seq, crc, plen = _ENV.unpack_from(env)
+    if kind not in (_DATA, _ACK):
+        raise TransportFrameError(f"unknown ARQ envelope kind {kind:#04x}")
+    payload = env[_ENV.size:]
+    if len(payload) != plen:
+        raise TransportFrameError(
+            f"ARQ envelope length mismatch: header says {plen}, "
+            f"envelope carries {len(payload)}"
+        )
+    if zlib.crc32(payload) != crc:
+        raise TransportFrameError("ARQ envelope CRC mismatch")
+    return kind, seq, payload
+
+
+class ResilientTransport(Transport):
+    """Reliable delivery over an unreliable frame transport.
+
+    Wraps ``inner`` in a stop-and-wait ARQ: every ``send`` ships a
+    sequence-numbered, CRC-guarded DATA envelope and blocks until the
+    matching ACK, retransmitting on timeout with jittered exponential
+    backoff; every ``recv`` delivers in-order payloads exactly once
+    (duplicates are re-acked and suppressed, corrupt envelopes dropped
+    as loss).  Designed for one session thread per transport — the
+    lock-step sync protocol drives exactly one leg at a time, so the
+    state machine is deliberately single-threaded and lock-free.
+
+    Failure surface: a leg that exceeds its deadline raises
+    :class:`~crdt_tpu.error.SyncTimeoutError`; a transport whose retry
+    budget is exhausted (retransmits + transient inner errors) raises
+    :class:`~crdt_tpu.error.PeerUnavailableError`.  Both are
+    :class:`~crdt_tpu.error.TransportError`\\s, so the gossip layer
+    catches one type.  A closed link is asymmetric by design: closure
+    on the SEND side is retried with backoff (an injected flap window
+    heals; a TCP write may race a peer's clean shutdown), but closure
+    on the RECEIVE side is terminal (``PeerUnavailableError``
+    immediately) — a peer that hung up sends no more frames, and
+    waiting out the deadline would only hold session locks hostage.
+
+    Per-instance tallies (``retransmits``, ``duplicates``, ``corrupt``,
+    ``transient_errors``) mirror the ``cluster.transport.*`` counters
+    for tests that need this link's numbers rather than the process's.
+    """
+
+    def __init__(self, inner: Transport,
+                 policy: Optional[RetryPolicy] = None, *,
+                 name: str = "link", seed: int = 0):
+        self._inner = inner
+        self.policy = policy or RetryPolicy()
+        self.name = name
+        self._rng = random.Random(seed)
+        self._send_seq = 0     # next DATA sequence number to ship
+        self._recv_next = 0    # next in-order sequence number to deliver
+        self._inbox: deque = deque()
+        self._budget = self.policy.retry_budget
+        self.retransmits = 0
+        self.duplicates = 0
+        self.corrupt = 0
+        self.transient_errors = 0
+
+    # -- budget / backoff ----------------------------------------------------
+
+    def _spend(self, reason: str) -> None:
+        self._budget -= 1
+        if self._budget < 0:
+            raise PeerUnavailableError(
+                f"transport {self.name}: retry budget "
+                f"({self.policy.retry_budget}) exhausted ({reason})"
+            )
+
+    def _delay(self, attempt: int) -> float:
+        p = self.policy
+        d = min(p.max_backoff_s, p.ack_timeout_s * (p.backoff_factor ** attempt))
+        return d * (1.0 + p.jitter * (2.0 * self._rng.random() - 1.0))
+
+    def _transient(self, leg: str, err: TransportError) -> None:
+        """One recoverable inner-transport failure: count it, spend
+        budget, and let the caller back off and retry."""
+        self.transient_errors += 1
+        tracing.count("cluster.transport.transient_errors")
+        self._spend(f"{leg}: {err}")
+
+    # -- receive-path demux --------------------------------------------------
+
+    def _send_ack(self, seq: int) -> None:
+        try:
+            self._inner.send(encode_envelope(_ACK, seq))
+        except TransportError as e:
+            # a lost ack is identical to a dropped one: the peer
+            # retransmits and we re-ack; spend budget so a dead link
+            # still terminates
+            self._transient("ack", e)
+
+    def _on_data(self, seq: int, payload: bytes) -> None:
+        if seq < self._recv_next:
+            self.duplicates += 1
+            tracing.count("cluster.transport.duplicates")
+            self._send_ack(self._recv_next - 1)
+            return
+        if seq == self._recv_next:
+            self._recv_next += 1
+            self._inbox.append(payload)
+            self._send_ack(seq)
+        # seq > expected is unreachable under stop-and-wait (the sender
+        # never advances past an unacked frame); if a broken inner
+        # transport produces one anyway, dropping it is safe — the
+        # sender retransmits
+
+    def _dispatch(self, env: bytes) -> Optional[int]:
+        """Decode one envelope; deliver DATA into the inbox, return the
+        seq of an ACK (None otherwise).  Corrupt envelopes count and
+        vanish — loss semantics."""
+        try:
+            kind, seq, payload = decode_envelope(env)
+        except TransportFrameError:
+            self.corrupt += 1
+            tracing.count("cluster.transport.corrupt")
+            return None
+        if kind == _DATA:
+            self._on_data(seq, payload)
+            return None
+        return seq
+
+    # -- the public legs -----------------------------------------------------
+
+    def send(self, frame: bytes) -> None:
+        p = self.policy
+        seq = self._send_seq
+        self._send_seq += 1
+        env = encode_envelope(_DATA, seq, frame)
+        deadline = time.monotonic() + p.send_deadline_s
+        attempt = 0
+        while True:
+            delay = self._delay(attempt)
+            try:
+                self._inner.send(env)
+            except TransportError as e:
+                self._transient("send", e)
+                time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
+            else:
+                if self._await_ack(seq, delay, deadline):
+                    return
+                self.retransmits += 1
+                tracing.count("cluster.transport.retransmits")
+                self._spend(f"retransmit seq={seq}")
+                obs_events.record(
+                    "cluster.transport.retry", link=self.name, seq=seq,
+                    attempt=attempt, backoff_s=round(delay, 4),
+                )
+            if time.monotonic() >= deadline:
+                tracing.count("cluster.transport.timeouts")
+                raise SyncTimeoutError(
+                    f"transport {self.name}: no ack for seq={seq} within "
+                    f"{p.send_deadline_s:.3f}s ({attempt + 1} attempts)"
+                )
+            attempt += 1
+
+    def _await_ack(self, seq: int, timeout: float, deadline: float) -> bool:
+        """Pump the inner transport until ``seq`` is acked or ``timeout``
+        elapses.  Incoming DATA is delivered (and acked) along the way —
+        both peers of a lock-step session sit in this loop at once."""
+        end = min(time.monotonic() + timeout, deadline)
+        while True:
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                env = self._inner.recv(timeout=remaining)
+            except SyncTimeoutError:
+                return False
+            except TransportClosedError as e:
+                # closed on the RECEIVE path is terminal: a flap window
+                # only ever closes the injected send side, and a peer
+                # that hung up will never ack — fail now, not at the
+                # deadline (the lingering-acceptor cascade)
+                raise PeerUnavailableError(
+                    f"transport {self.name}: peer closed the link "
+                    f"mid-send: {e}"
+                ) from e
+            except TransportError as e:
+                self._transient("send-pump", e)
+                time.sleep(min(self.policy.ack_timeout_s, max(remaining, 0)))
+                continue
+            acked = self._dispatch(env)
+            if acked is not None and acked >= seq:
+                return True
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        p = self.policy
+        budget_s = p.recv_deadline_s if timeout is None else timeout
+        deadline = time.monotonic() + budget_s
+        while not self._inbox:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                tracing.count("cluster.transport.timeouts")
+                raise SyncTimeoutError(
+                    f"transport {self.name}: no frame from peer within "
+                    f"{budget_s:.3f}s"
+                )
+            try:
+                env = self._inner.recv(timeout=remaining)
+            except SyncTimeoutError:
+                continue  # the while guard raises once the deadline passes
+            except TransportClosedError as e:
+                # terminal, as in the send pump: a hung-up peer sends
+                # no more frames, so waiting out the deadline only
+                # holds locks and budget hostage
+                raise PeerUnavailableError(
+                    f"transport {self.name}: peer closed the link "
+                    f"mid-recv: {e}"
+                ) from e
+            except TransportError as e:
+                # a transient inner fault mid-recv: the peer's
+                # retransmit covers the data; wait out the blip
+                self._transient("recv", e)
+                time.sleep(min(p.ack_timeout_s, max(remaining, 0)))
+                continue
+            self._dispatch(env)  # stray ACKs are stale here; ignored
+        return self._inbox.popleft()
+
+    def close(self) -> None:
+        # the ARQ last-ack problem (TCP's TIME_WAIT, in miniature): our
+        # final ACK may have been lost, in which case the peer is about
+        # to retransmit its last frame against a dead link and fail a
+        # session that actually converged.  Drain briefly before
+        # closing: keep answering envelopes (duplicates get re-acked by
+        # _on_data) until the link goes quiet for ~2 retransmit timers,
+        # the peer closes, or the cap elapses.  Over a lossless inner
+        # transport (TCP) the peer closes almost immediately and the
+        # drain costs one quiet window at most.
+        p = self.policy
+        quiet_s = min(2.0 * p.ack_timeout_s, 1.0)
+        cap = time.monotonic() + 3.0 * quiet_s
+        last_activity = time.monotonic()
+        while (time.monotonic() < cap
+               and time.monotonic() - last_activity < quiet_s):
+            try:
+                env = self._inner.recv(timeout=min(
+                    p.ack_timeout_s, max(cap - time.monotonic(), 0.001)))
+            except SyncTimeoutError:
+                continue
+            except TransportError:
+                break  # peer hung up or the link died: nothing to answer
+            try:
+                self._dispatch(env)
+            except TransportError:
+                break  # budget exhausted mid-drain: stop being polite
+            last_activity = time.monotonic()
+        self._inner.close()

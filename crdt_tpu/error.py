@@ -122,3 +122,47 @@ class SyncProtocolError(CrdtError):
     ``ValueError``: a malformed peer frame is an I/O-boundary fault to
     catch and drop, not a local programming error.
     """
+
+
+class TransportError(CrdtError):
+    """A transport leg (send/recv/connect) failed below the sync
+    protocol: the frames were fine, moving them was not.
+
+    The split from :class:`SyncProtocolError` is deliberate — a
+    protocol error means the PEER misbehaved (drop the peer), a
+    transport error means the NETWORK misbehaved (retry with backoff).
+    The gossip scheduler (:mod:`crdt_tpu.cluster.gossip`) treats both
+    as a failed session but only transport errors feed the
+    alive→suspect→dead health thresholds.
+    """
+
+
+class SyncTimeoutError(TransportError):
+    """A transport leg blew its deadline: the peer (or the path to it)
+    went quiet mid-session.  Raised by :class:`crdt_tpu.cluster.
+    transport.ResilientTransport` when a receive deadline elapses or a
+    send exhausts its per-frame retransmit window — always bounded, the
+    lock-step session never spins forever on a dead peer."""
+
+
+class PeerUnavailableError(TransportError):
+    """The peer cannot be reached at all: dial refused, link closed, or
+    the transport's retry budget ran dry.  Distinct from
+    :class:`SyncTimeoutError` (mid-session silence) so membership can
+    treat "never answered" and "stopped answering" with different
+    thresholds if it wants to; both count as failures today."""
+
+
+class TransportClosedError(TransportError):
+    """The underlying byte channel closed (peer hung up, injected
+    disconnect).  Raised by the raw transports; the resilient wrapper
+    converts persistent closure into :class:`PeerUnavailableError`
+    after its retry budget."""
+
+
+class TransportFrameError(TransportError):
+    """A transport-level envelope (the resilient wrapper's ARQ framing,
+    not a sync-protocol frame) was malformed — truncated header, CRC
+    mismatch, unknown kind.  The receiver treats it exactly like frame
+    loss (drop it; the sender's retransmit covers it), so this rarely
+    escapes the transport."""

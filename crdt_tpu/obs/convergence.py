@@ -152,6 +152,22 @@ class ConvergenceTracker:
                 for peer, st in self._peers.items()
             }
 
+    def urgency(self, peer: str) -> tuple:
+        """How badly ``peer`` needs a sync, as a sort key: ``(staleness
+        seconds, last diverged fraction)``, both +inf for a peer never
+        converged with (never-synced peers rank first).  The gossip
+        scheduler (:mod:`crdt_tpu.cluster.gossip`) sorts candidates by
+        this key, descending — the policy "sync whoever you've ignored
+        longest, break ties toward whoever differed most" lives here,
+        next to the gauges it reads."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._peers.get(peer)
+            if st is None or st.last_converged_ts is None:
+                return (float("inf"), float("inf"))
+            frac = st.divergence / st.objects if st.objects else 0.0
+            return (now - st.last_converged_ts, frac)
+
     def reset(self) -> None:
         with self._lock:
             self._peers.clear()

@@ -119,6 +119,34 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "seconds since the last converged sync (refreshed at scrape)"),
     NameSpec("sync.peer.*.delta_ratio", "gauge",
              "last session's payload bytes over the full-state reference"),
+    # -- cluster runtime (cluster/membership.py, cluster/gossip.py,
+    # cluster/transport.py, cluster/faults.py) -------------------------------
+    NameSpec("cluster.peers.*", "gauge",
+             "peer count per health state (alive/suspect/dead)"),
+    NameSpec("cluster.peer.*.state", "gauge",
+             "per-peer health as a level (0 alive, 1 suspect, 2 dead)"),
+    NameSpec("cluster.peer.*.consecutive_failures", "gauge",
+             "per-peer consecutive failed sessions (resets on success)"),
+    NameSpec("cluster.peer_transition.*", "counter",
+             "peer health transitions by destination state"),
+    NameSpec("cluster.rounds", "counter", "gossip rounds started"),
+    NameSpec("cluster.round", "histogram", "gossip round wall time (span)"),
+    NameSpec("cluster.sessions.*", "counter",
+             "gossip-driven sessions by outcome (ok/failed/skipped_busy)"),
+    NameSpec("cluster.transport.retransmits", "counter",
+             "ARQ data frames re-sent after an ack timeout"),
+    NameSpec("cluster.transport.timeouts", "counter",
+             "transport legs that blew their deadline (SyncTimeoutError)"),
+    NameSpec("cluster.transport.corrupt", "counter",
+             "ARQ envelopes dropped as malformed (treated as loss)"),
+    NameSpec("cluster.transport.duplicates", "counter",
+             "duplicate ARQ data frames suppressed at the receiver"),
+    NameSpec("cluster.transport.transient_errors", "counter",
+             "transport legs that failed and were retried with backoff"),
+    NameSpec("cluster.faults.*", "counter",
+             "injected faults by kind (drop/delay/truncate/duplicate/"
+             "disconnect) — nonzero outside tests means faults.py leaked "
+             "into production wiring"),
     # -- native engine (native/engine.py) ------------------------------------
     NameSpec("native.engine.*.calls", "counter",
              "native kernel invocations per entry point"),

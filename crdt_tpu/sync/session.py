@@ -1,9 +1,11 @@
 """SyncSession — the two-phase digest/delta anti-entropy protocol.
 
 One session reconciles one local fleet batch with one peer over an
-abstract byte transport (``send(bytes)`` / ``recv() -> bytes``
-callables — TCP frames, in-process queues, anything ordered and
-reliable).  The protocol is symmetric and lock-step: both peers run the
+abstract byte transport — either ``send(bytes)`` / ``recv() -> bytes``
+callables (TCP frames, in-process queues, anything ordered and
+reliable) or a :class:`crdt_tpu.cluster.transport.Transport` passed
+directly to :meth:`SyncSession.sync` (the hardened/ARQ path the
+cluster runtime uses).  The protocol is symmetric and lock-step: both peers run the
 same code and every decision (diverged set, delta-vs-full, retry) is a
 pure function of data both sides have already exchanged, so neither
 peer can block waiting for a frame the other will never send.
@@ -44,7 +46,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..error import SyncProtocolError
+from ..error import SyncProtocolError, TransportError
 from ..obs import convergence as obs_convergence
 from ..obs import events as obs_events
 from ..utils import tracing
@@ -161,7 +163,16 @@ class SyncSession:
             report.full_bytes_sent += len(frame)
 
     def _recv(self, recv, report: SyncReport) -> tuple[int, bytes]:
-        frame = recv()
+        try:
+            frame = recv()
+        except (ConnectionError, EOFError) as e:
+            # a peer hanging up mid-frame is a protocol-level fact of
+            # this session, not a local I/O bug — surface it in the
+            # sync taxonomy (and through sync()'s flight-recorder
+            # event), never as a bare ConnectionError/EOFError
+            raise SyncProtocolError(
+                f"peer closed the stream mid-session: {e}"
+            ) from e
         if not isinstance(frame, (bytes, bytearray, memoryview)):
             raise SyncProtocolError(
                 f"transport returned {type(frame).__name__}, not bytes"
@@ -225,19 +236,30 @@ class SyncSession:
 
     # -- the protocol --------------------------------------------------------
 
-    def sync(self, send: Callable[[bytes], None],
-             recv: Callable[[], bytes]) -> SyncReport:
+    def sync(self, send, recv: Optional[Callable[[], bytes]] = None
+             ) -> SyncReport:
         """Run the session to convergence (or raise).  Returns the
         per-phase :class:`SyncReport`; the reconciled fleet is
         ``self.batch``.
 
-        Protocol errors are written to the flight recorder (kind
+        Accepts either the legacy ``(send, recv)`` callable pair or a
+        single :class:`~crdt_tpu.cluster.transport.Transport` — pass
+        the transport as the only argument and both legs route through
+        it (``session.sync(transport)``), so hardened transports slot
+        in without touching the protocol.
+
+        Protocol errors — and transport failures
+        (:class:`~crdt_tpu.error.TransportError`: deadlines, exhausted
+        retry budgets) — are written to the flight recorder (kind
         ``sync.error``, stamped with this session's ID) before they
         propagate, so a failed session's last event explains the raise.
         """
+        if recv is None:
+            transport = send
+            send, recv = transport.send, transport.recv
         try:
             report = self._sync(send, recv)
-        except SyncProtocolError as e:
+        except (SyncProtocolError, TransportError) as e:
             tracing.count("sync.errors")
             self._event("sync.error", error=str(e)[:200])
             raise

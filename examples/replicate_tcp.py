@@ -33,6 +33,14 @@ Run it:
     python examples/replicate_tcp.py                    # delta sync demo
     python examples/replicate_tcp.py --full-state       # legacy full state
     python examples/replicate_tcp.py --objects 1000 --divergence 0.01
+    python examples/replicate_tcp.py --gossip 5         # N-peer fleet mode
+
+``--gossip N`` runs the cluster runtime instead of a single session: N
+replicas (in-process nodes over real loopback TCP sockets), each with a
+listener, a peer roster (``crdt_tpu.cluster.Membership``) and a
+staleness-driven ``GossipScheduler``, reconcile through hardened
+``ResilientTransport`` links until every node's digest vector is
+byte-identical (PERF.md "Cluster runtime").
 
 ``--metrics-port N`` starts the live observability exporter
 (:mod:`crdt_tpu.obs`) in the peer process: ``GET /metrics`` is the
@@ -218,6 +226,141 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
     return status
 
 
+def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
+                divergence: float, max_sweeps: int = 20) -> int:
+    """N in-process replicas over real loopback TCP, reconciled by the
+    cluster runtime (``crdt_tpu/cluster``): each node owns a listener
+    (accepted sessions run through the same hardened transport stack),
+    a peer roster, and a staleness-driven ``GossipScheduler``.  The
+    demo drives deterministic scheduler sweeps (round-robin
+    ``run_round`` across nodes) until every node's digest vector is
+    byte-identical — the same convergence oracle the sessions
+    themselves use."""
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    import threading
+
+    import numpy as np
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.cluster import (
+        ClusterNode, GossipScheduler, Membership, ResilientTransport,
+        RetryPolicy, TcpTransport, hello_accept, hello_dial,
+    )
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.utils.interning import Universe
+
+    uni = Universe.identity(CrdtConfig(num_actors=max(8, n_peers + 2),
+                                       member_capacity=32,
+                                       deferred_capacity=8,
+                                       counter_bits=32))
+    policy = RetryPolicy(send_deadline_s=20.0, recv_deadline_s=20.0,
+                         ack_timeout_s=0.25, max_backoff_s=2.0,
+                         retry_budget=64)
+
+    nodes = []
+    for i in range(n_peers):
+        fleet = _build_fleet(n_objects, actor=i + 1,
+                             divergence=divergence, seed=42)
+        nodes.append(ClusterNode(
+            f"n{i}", OrswotBatch.from_scalar(fleet, uni), uni,
+            busy_timeout_s=30.0,
+        ))
+
+    # one listener per node; accepted connections run the acceptor leg
+    # through the same ResilientTransport stack the dialers use
+    stop = threading.Event()
+    servers = []
+    ports = {}
+    for i, node in enumerate(nodes):
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(n_peers)
+        srv.settimeout(0.2)  # poll the stop flag between accepts
+        ports[f"n{i}"] = srv.getsockname()[1]
+        servers.append(srv)
+
+        def listener(node=node, srv=srv):
+            while not stop.is_set():
+                try:
+                    sock, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+
+                def serve(sock=sock, node=node):
+                    t = ResilientTransport(
+                        TcpTransport(sock, default_timeout=20.0), policy,
+                        name=f"{node.node_id}-accept",
+                    )
+                    try:
+                        peer = hello_accept(t, timeout=20.0)
+                        node.accept(t, peer_id=peer)
+                    except Exception as e:  # a failed inbound session
+                        print(f"{node.node_id}: inbound session failed: "
+                              f"{type(e).__name__}: {e}", flush=True)
+                    finally:
+                        t.close()
+
+                threading.Thread(target=serve, daemon=True).start()
+
+        threading.Thread(target=listener, daemon=True,
+                         name=f"listen-n{i}").start()
+
+    def make_dialer(node):
+        def dial(peer):
+            sock = socket.create_connection(
+                ("127.0.0.1", ports[peer.peer_id]), timeout=20.0)
+            t = ResilientTransport(
+                TcpTransport(sock, default_timeout=20.0), policy,
+                name=f"{node.node_id}->{peer.peer_id}",
+            )
+            hello_dial(t, node.node_id)
+            return t
+        return dial
+
+    scheds = []
+    for i, node in enumerate(nodes):
+        membership = Membership(suspect_after=2, dead_after=5)
+        for j in range(n_peers):
+            if j != i:
+                membership.add(f"n{j}", address=ports[f"n{j}"])
+        scheds.append(GossipScheduler(
+            node, membership, make_dialer(node), fanout=2,
+            session_timeout_s=60.0, seed=i,
+        ))
+
+    sweeps = 0
+    converged = False
+    try:
+        for sweeps in range(1, max_sweeps + 1):
+            for sched in scheds:
+                sched.run_round()
+            digests = [n.digest() for n in nodes]
+            converged = all(
+                np.array_equal(digests[0], d) for d in digests[1:]
+            )
+            print(f"sweep {sweeps}: "
+                  + ("digest vectors identical" if converged
+                     else "still diverged"), flush=True)
+            if converged:
+                break
+    finally:
+        stop.set()
+        for srv in servers:
+            srv.close()
+
+    verdict = "CONVERGED" if converged else "DIVERGED"
+    print(f"gossip: {n_peers} peers x {n_objects} objects  "
+          f"sweeps={sweeps}  {verdict}", flush=True)
+    return 0 if converged else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("role", nargs="?", default="demo",
@@ -238,7 +381,18 @@ def main() -> int:
                     help="with --metrics-port: keep the exporter alive up "
                          "to this many seconds after the sync (returns as "
                          "soon as /metrics and /events were both scraped)")
+    ap.add_argument("--gossip", type=int, default=0, metavar="N",
+                    help="N-peer gossip mode: N in-process replicas over "
+                         "loopback TCP reconciled by the cluster runtime "
+                         "(crdt_tpu.cluster) until their digest vectors "
+                         "are byte-identical")
     args = ap.parse_args()
+
+    if args.gossip:
+        if args.gossip < 2:
+            ap.error("--gossip needs N >= 2 peers")
+        return gossip_demo(args.gossip, args.objects, args.platform,
+                           divergence=args.divergence)
 
     if args.role != "demo":
         if not args.port:

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# The one CI gate: crdtlint (exit-code gated), then the tier-1 pytest
+# line from ROADMAP.md — builder and CI invoke the SAME entrypoint, so
+# "it passed locally" and "it passed in CI" mean the same command.
+#
+#   scripts/ci.sh            # lint + tier-1
+#   scripts/ci.sh --lint     # lint only (seconds, jax-free)
+#
+# The tier-1 line mirrors ROADMAP.md "Tier-1 verify" verbatim: CPU
+# backend, `not slow`, collection errors don't abort, and the trailing
+# DOTS_PASSED count makes pass-count regressions diffable from the log.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== crdtlint =="
+python -m crdt_tpu.analysis
+
+if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 pytest =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit "$rc"

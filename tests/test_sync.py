@@ -458,3 +458,33 @@ def test_full_state_mode_still_version_tagged():
 def test_diverged_indices_shape_guard():
     with pytest.raises(SyncProtocolError):
         diverged_indices(np.zeros(3, np.uint64), np.zeros(4, np.uint64))
+
+
+def test_peer_disconnect_mid_frame_is_sync_protocol_error():
+    """A peer hanging up mid-frame must surface as SyncProtocolError —
+    the sync taxonomy's I/O-boundary fault — never as the transport's
+    bare ConnectionError/EOFError (or struct.error from a half-parsed
+    header), and the failed session must leave a ``sync.error`` event
+    in the flight recorder before the raise propagates."""
+    from crdt_tpu.obs import events as obs_events
+
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(8, seed=31, actor=1), uni)
+
+    for hangup in (ConnectionResetError("peer closed mid-frame"),
+                   EOFError("stream ended inside a frame")):
+        session = SyncSession(a, uni, peer="hangup")
+        sent: list = []
+
+        def recv_then_die():
+            raise hangup
+
+        with pytest.raises(SyncProtocolError) as exc_info:
+            session.sync(sent.append, recv_then_die)
+        # the cause chain keeps the transport detail, the type is ours
+        assert exc_info.value.__cause__ is hangup
+        assert not isinstance(exc_info.value, (ConnectionError, EOFError))
+        evs = obs_events.recorder().snapshot(kind="sync.error",
+                                             session=session.session_id)
+        assert evs, "disconnect left no sync.error event"
+        assert "mid-session" in evs[-1]["fields"]["error"]

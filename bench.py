@@ -1213,6 +1213,123 @@ def bench_obs_overhead():
     return out
 
 
+def bench_fleet_obs():
+    """Fleet-observatory cost gate (the obs/fleet satellite): snapshot
+    encode + CRDT merge cost as a function of node count, and the
+    piggyback's share of a real sync session's wall time.  The
+    piggyback rides EVERY gossip session, so its budget is noise:
+    the bar is <5% of session wall.  Costs are measured on synthetic
+    per-node slices shaped like a live registry (manifest-conformant
+    names, histograms, convergence state, an event tail) so the JSON
+    numbers track the real payload round over round."""
+    from crdt_tpu.obs import convergence as obs_conv
+    from crdt_tpu.obs import events as obs_events
+    from crdt_tpu.obs import fleet as obs_fleet
+    from crdt_tpu.obs import metrics as obs_metrics
+
+    n_metrics = 40 if SMALL else 150
+
+    def synth_observatory(node: str) -> obs_fleet.FleetObservatory:
+        reg = obs_metrics.MetricsRegistry()
+        for i in range(n_metrics):
+            reg.counter_inc(f"wire.sync.leg{i}.bytes", i * 7 + 1)
+        for i in range(max(4, n_metrics // 4)):
+            reg.gauge_set(f"sync.peer.p{i}.divergence", float(i))
+        for i in range(64):
+            reg.observe("sync.digest_exchange", 0.0005 * (i + 1))
+        trk = obs_conv.ConvergenceTracker(registry=reg)
+        trk.observe_session(node, converged=True, rounds=1,
+                            payload_bytes=1024, full_state_bytes=65536)
+        rec = obs_events.FlightRecorder(capacity=256)
+        for i in range(128):
+            rec.record("sync.phase", session=f"s{i:04d}", phase="digest",
+                       trace=f"t{i:04d}")
+        return obs_fleet.FleetObservatory(node, registry=reg, tracker=trk,
+                                          recorder=rec)
+
+    out = {}
+    for n_nodes in (2, 8, 32):
+        observatories = [synth_observatory(f"b{i}") for i in range(n_nodes)]
+        t0 = time.perf_counter()
+        frames = [o.encode() for o in observatories]
+        encode_s = time.perf_counter() - t0
+        sink = observatories[0]
+        t0 = time.perf_counter()
+        for f in frames:
+            sink.merge_frame(f)
+        merge_s = time.perf_counter() - t0
+        assert len(sink.merged(refresh=False).slices) == n_nodes
+        if n_nodes == 32:
+            out["fleet_obs_encode_ms_per_node"] = round(
+                encode_s / n_nodes * 1e3, 3)
+            out["fleet_obs_merge_ms_per_node"] = round(
+                merge_s / n_nodes * 1e3, 3)
+            out["fleet_obs_frame_bytes"] = len(sink.encode(refresh=False))
+        log(f"fleet obs: {n_nodes} nodes  encode {encode_s*1e3:.1f}ms  "
+            f"merge {merge_s*1e3:.1f}ms  frame "
+            f"{len(frames[0])/1024:.1f}KB")
+
+    # piggyback share of a real session: one delta sync at bench shape,
+    # then the exact per-session piggyback work (encode both sides,
+    # merge both frames) measured against that session's wall
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.sync.session import SyncSession, sync_pair
+    from crdt_tpu.utils.interning import Universe
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(17)
+    n, a, m, d = (2_000, 16, 8, 2) if SMALL else (20_000, 32, 16, 2)
+    cfg = CrdtConfig(num_actors=a, member_capacity=m, deferred_capacity=d,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+    reps = anti_entropy_fleets(rng, n, a, m, d, 1, base=min(4, m - 2),
+                               novel=0, deferred_frac=0.25)
+    fleet_a = OrswotBatch(*(jnp.asarray(x) for x in reps[0]))
+    fleet_a = fleet_a.merge(fleet_a)
+    k = max(1, n // 100)
+    rows = np.sort(rng.choice(n, size=k, replace=False)).astype(np.int64)
+    import jax
+
+    sub = jax.tree_util.tree_map(lambda p: p[rows], fleet_a)
+    sub = sub.apply_add(np.zeros(k, np.int32),
+                        jnp.max(sub.clock, axis=-1) + 1,
+                        np.full(k, 1 << 20, np.int32))
+    fleet_b = jax.tree_util.tree_map(lambda p, s: p.at[rows].set(s),
+                                     fleet_a, sub)
+    sa = SyncSession(fleet_a, uni)
+    sb = SyncSession(fleet_b, uni)
+    t0 = time.perf_counter()
+    ra, rb = sync_pair(sa, sb)
+    session_wall = time.perf_counter() - t0
+    assert ra.converged and rb.converged
+
+    oa, ob = synth_observatory("pa"), synth_observatory("pb")
+    t0 = time.perf_counter()
+    fa = oa.encode()
+    fb = ob.encode()
+    ob.merge_frame(fa)
+    oa.merge_frame(fb)
+    piggy_s = time.perf_counter() - t0
+    frac = piggy_s / session_wall if session_wall else 0.0
+    out["fleet_obs_piggyback_frac"] = round(frac, 5)
+    log(f"fleet obs: piggyback {piggy_s*1e3:.2f}ms vs session "
+        f"{session_wall*1e3:.1f}ms -> {frac:.3%} (bar: <5%)")
+    # only gate against a session long enough to be a denominator (a
+    # smoke-shape sync finishes in ms, where any fixed cost dominates)
+    if session_wall >= 0.2:
+        assert frac < 0.05, (
+            f"fleet-snapshot piggyback costs {frac:.1%} of session wall "
+            "(bar: <5%) — did the snapshot stop being bounded?"
+        )
+    else:
+        log("fleet obs: session too fast to gate against (smoke shape); "
+            "per-op costs recorded")
+    return out
+
+
 def bench_bandwidth_floor():
     """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
     elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
@@ -1812,6 +1929,12 @@ def main():
     obs_res = run_stage("obs_overhead", 15, bench_obs_overhead)
     if obs_res is not None:
         emit(**obs_res)
+    # budget-skippable: fleet-observatory encode/merge costs + the <5%
+    # piggyback-per-session gate (benchkit/artifacts.py ratio-compares
+    # the scale-free ms/frac fields round over round)
+    fleet_res = run_stage("fleet_obs", 20, bench_fleet_obs)
+    if fleet_res is not None:
+        emit(**fleet_res)
     # provisional regression tail first: a watchdog kill inside the
     # required validation stage below must not cost the field entirely
     _emit_obs_snapshot()

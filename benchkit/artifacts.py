@@ -119,6 +119,12 @@ def counter_family(name: str) -> str:
     parts = name.split(".")
     if "fallback_reason" in parts:
         return ".".join(parts[:parts.index("fallback_reason")])
+    if "rejected" in parts[:-1]:
+        # reason-detail counters (sync.frame.rejected.<why>,
+        # obs.fleet.frames.rejected.<why>) collapse like
+        # fallback_reason: a reason that stops firing is an
+        # improvement, not a vanished code path
+        return ".".join(parts[:parts.index("rejected") + 1])
     if len(parts) > 1 and parts[-1] in _FAMILY_LEAVES:
         return ".".join(parts[:-1])
     return name

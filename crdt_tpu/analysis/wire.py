@@ -40,6 +40,10 @@ from .core import Finding, ParsedFile, ancestors, dotted_name, parents_of, rule
 WIRE_MODULES = (
     "crdt_tpu/sync/",
     "crdt_tpu/cluster/",
+    # the fleet-observatory snapshot codec rides the same envelope
+    # discipline as the sync frames, so its decode paths are held to
+    # the same error contract
+    "crdt_tpu/obs/fleet.py",
     "crdt_tpu/batch/wirebulk.py",
     "crdt_tpu/batch/orswot_batch.py",
     "crdt_tpu/batch/vclock_batch.py",

@@ -41,6 +41,7 @@ from typing import Callable, Dict, List, Optional
 from ..error import PeerUnavailableError, SyncProtocolError, TransportError
 from ..obs import convergence as obs_convergence
 from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
 from ..sync.session import SyncReport, SyncSession
 from ..utils import tracing
 from . import membership as membership_mod
@@ -82,19 +83,34 @@ class ClusterNode:
 
     def __init__(self, node_id: str, batch, universe, *,
                  full_state_threshold: float = 0.5,
-                 busy_timeout_s: float = 10.0):
+                 busy_timeout_s: float = 10.0,
+                 observatory=None):
         self.node_id = node_id
         self.universe = universe
         self.full_state_threshold = full_state_threshold
         self.busy_timeout_s = busy_timeout_s
-        self._lock = threading.Lock()   # guards the batch reference
+        #: a :class:`crdt_tpu.obs.fleet.FleetObservatory`; every session
+        #: this node runs advertises it in the hello and piggybacks a
+        #: merged-snapshot exchange once the session converged, so
+        #: telemetry slices spread through the fleet on the gossip the
+        #: fleet already does
+        self.observatory = observatory
+        self._lock = threading.Lock()   # guards batch + last_report
         self._busy = threading.Lock()   # serializes whole sessions
         self._batch = batch
+        self._last_report: Optional[SyncReport] = None
 
     @property
     def batch(self):
         with self._lock:
             return self._batch
+
+    @property
+    def last_report(self) -> Optional[SyncReport]:
+        """The most recent converged session's report — carries the
+        hello-negotiated ``trace_id`` the demo/walkthrough prints."""
+        with self._lock:
+            return self._last_report
 
     def digest(self):
         """The canonical digest vector of the current fleet (numpy
@@ -118,10 +134,12 @@ class ClusterNode:
             session = SyncSession(
                 self.batch, self.universe, peer=peer_label,
                 full_state_threshold=self.full_state_threshold,
+                observatory=self.observatory,
             )
             report = session.sync(transport)
             with self._lock:
                 self._batch = session.batch
+                self._last_report = report
             return report
         finally:
             self._busy.release()
@@ -287,7 +305,37 @@ class GossipScheduler:
             ok=list(report.ok), failed=dict(report.failed),
             skipped_busy=list(report.skipped_busy),
         )
+        self._publish_round_health(report)
         return report
+
+    def _publish_round_health(self, report: RoundReport) -> None:
+        """Mirror the round's outcome + the tracker's divergence view
+        into the ``cluster.gossip.*`` gauges, so one scrape of any node
+        answers "is the fleet converging": peers attempted / failed /
+        skipped-busy this round, the max per-peer divergence the digest
+        exchanges last saw, and a rounds-to-converge ETA (peers still
+        diverged over the per-round fanout — 0 once every known peer's
+        last digest exchange was clean)."""
+        conv = self._tracker.snapshot()
+        # outstanding divergence only: a converged session resolved
+        # what its digest exchange found (the per-peer gauge keeps the
+        # found value — this view answers "what is still diverged NOW")
+        divergences = [
+            0 if st.get("divergence_resolved", True)
+            else st.get("divergence", 0)
+            for st in conv.values()
+        ]
+        diverged_peers = sum(1 for d in divergences if d > 0)
+        eta = -(-diverged_peers // self.fanout) if diverged_peers else 0
+        reg = obs_metrics.registry()
+        reg.gauge_set("cluster.gossip.attempted", report.attempted)
+        reg.gauge_set("cluster.gossip.ok", len(report.ok))
+        reg.gauge_set("cluster.gossip.failed", len(report.failed))
+        reg.gauge_set("cluster.gossip.skipped_busy",
+                      len(report.skipped_busy))
+        reg.gauge_set("cluster.gossip.fleet_divergence_max",
+                      max(divergences, default=0))
+        reg.gauge_set("cluster.gossip.eta_rounds", eta)
 
     # -- the background loop -------------------------------------------------
 

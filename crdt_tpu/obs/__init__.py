@@ -2,7 +2,7 @@
 
 The reference crate has zero observability (SURVEY §5: no logging
 crates, only ``Display`` impls); this package is the TPU port's
-first-class answer, in four parts:
+first-class answer, in five parts:
 
 * :mod:`crdt_tpu.obs.metrics` — a typed registry (counters, gauges,
   log2-bucketed histograms) that every always-on instrument feeds; the
@@ -19,6 +19,11 @@ first-class answer, in four parts:
 * :mod:`crdt_tpu.obs.convergence` — per-peer digest-divergence gauges,
   rounds-to-converge, staleness age, and delta-ratio history, computed
   from the digest vectors the sync protocol already exchanges.
+* :mod:`crdt_tpu.obs.fleet` — the cross-process plane: registry
+  snapshots as a join-semilattice (counters G-Counter-merged per node,
+  gauges LWW, histograms bucket-wise), CRC-guarded snapshot frames
+  piggybacked on gossip sessions or all-gathered over a mesh, the
+  ``/fleet`` aggregate, and the trace-ID timeline stitcher.
 
 Import-light by design: nothing here imports JAX or numpy, so the
 scalar engine (and any process that only wants a counter) pays nothing
@@ -26,9 +31,15 @@ for it.  PERF.md "Observability" documents naming conventions and how
 to read the flight recorder after a failed sync.
 """
 
-from . import convergence, events, metrics  # noqa: F401
+from . import convergence, events, fleet, metrics  # noqa: F401
 from .convergence import ConvergenceTracker, tracker  # noqa: F401
 from .events import FlightRecorder, new_session_id, record, recorder  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetObservatory,
+    FleetSnapshot,
+    observatory,
+    stitch_trace,
+)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -40,14 +51,18 @@ from .metrics import (  # noqa: F401
 __all__ = [
     "ConvergenceTracker",
     "Counter",
+    "FleetObservatory",
+    "FleetSnapshot",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "new_session_id",
+    "observatory",
     "record",
     "recorder",
     "registry",
+    "stitch_trace",
     "tracker",
 ]
 

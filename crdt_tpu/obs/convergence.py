@@ -44,6 +44,7 @@ class _PeerState:
     __slots__ = (
         "divergence", "objects", "rounds_to_converge", "sessions",
         "converged_sessions", "last_converged_ts", "delta_ratios",
+        "divergence_resolved",
     )
 
     def __init__(self):
@@ -54,6 +55,11 @@ class _PeerState:
         self.converged_sessions = 0
         self.last_converged_ts: Optional[float] = None
         self.delta_ratios: deque = deque(maxlen=_HISTORY)
+        # `divergence` documents what the last digest exchange FOUND; a
+        # session that then converged has resolved it, which the fleet
+        # health view (gossip's fleet_divergence_max / eta_rounds)
+        # needs to tell apart from divergence still outstanding
+        self.divergence_resolved = True
 
 
 class ConvergenceTracker:
@@ -82,6 +88,7 @@ class ConvergenceTracker:
             st = self._state(peer)
             st.divergence = int(diverged)
             st.objects = int(objects)
+            st.divergence_resolved = diverged == 0
         reg = self._reg()
         reg.gauge_set(f"sync.peer.{peer}.divergence", diverged)
         reg.gauge_set(
@@ -105,6 +112,7 @@ class ConvergenceTracker:
             if converged:
                 st.converged_sessions += 1
                 st.last_converged_ts = time.monotonic()
+                st.divergence_resolved = True
             if ratio is not None:
                 st.delta_ratios.append(ratio)
         reg = self._reg()
@@ -141,6 +149,7 @@ class ConvergenceTracker:
                         st.divergence / st.objects if st.objects else 0.0
                     ),
                     "rounds_to_converge": st.rounds_to_converge,
+                    "divergence_resolved": st.divergence_resolved,
                     "sessions": st.sessions,
                     "converged_sessions": st.converged_sessions,
                     "staleness_s": (

@@ -62,7 +62,12 @@ class FlightRecorder:
 
     @property
     def dropped(self) -> int:
-        """Events evicted by the ring bound since the last :meth:`clear`."""
+        """Events evicted by the ring bound since the last :meth:`clear`.
+
+        The exporter refreshes this into the ``obs.events.dropped``
+        gauge (``crdt_tpu_obs_events_dropped``) at scrape time, so "the
+        ring overflows faster than anyone reads it" is alertable, not
+        just a Python property."""
         with self._lock:
             return self._recorded - len(self._buf)
 

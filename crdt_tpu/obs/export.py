@@ -12,8 +12,12 @@ Three consumers, three shapes:
   ``bench.py`` embeds in the artifact tail and ``/events`` serves.
 * :class:`MetricsServer` / :func:`start_metrics_server` — an opt-in,
   stdlib-only background HTTP thread serving ``GET /metrics`` (Prom
-  text), ``GET /events`` (JSON; ``?session=`` / ``?kind=`` filters) and
-  ``GET /healthz``.  Daemon threads throughout: an exporter must never
+  text), ``GET /events`` (JSON; ``?session=`` / ``?kind=`` filters),
+  ``GET /fleet`` (the CRDT-merged cross-process snapshot from
+  :mod:`crdt_tpu.obs.fleet` — Prom text by default, ``?format=json``
+  for per-node slices, ``?trace=<id>`` for a stitched cross-peer
+  session timeline) and ``GET /healthz``.  Daemon threads throughout:
+  an exporter must never
   keep a replica process alive or take it down — handler errors are
   swallowed into 500s and ``stop()`` is idempotent.
 """
@@ -52,6 +56,15 @@ def prometheus_text(registry: Optional[metrics.MetricsRegistry] = None,
         tracker = convergence.tracker()
     if tracker is not None:
         tracker.refresh()
+    if registry is None:
+        # scrape-time refresh of the flight recorder's eviction count:
+        # `dropped` is a Python property, and an alert on "the ring is
+        # overflowing faster than anyone reads it" needs it as a gauge.
+        # Default registry only — a private-registry scrape must not
+        # write global recorder state into the global registry's twin.
+        metrics.registry().gauge_set(
+            "obs.events.dropped", events.recorder().dropped
+        )
     reg = registry if registry is not None else metrics.registry()
     snap = reg.snapshot()
     lines = []
@@ -109,11 +122,13 @@ class MetricsServer:
 
     def __init__(self, host: str, port: int,
                  registry: Optional[metrics.MetricsRegistry] = None,
-                 tracker: Optional[convergence.ConvergenceTracker] = None):
+                 tracker: Optional[convergence.ConvergenceTracker] = None,
+                 observatory=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self._registry = registry
         self._tracker = tracker
+        self._observatory = observatory
         self._t0 = time.monotonic()
         self.scrapes: dict = {}
         self._scrape_lock = threading.Lock()
@@ -169,13 +184,33 @@ class MetricsServer:
                 "convergence": convergence.tracker().snapshot(),
             }).encode()
             return body, "application/json", 200
+        if route == "/fleet":
+            from . import fleet as fleet_mod
+
+            obs = self._observatory if self._observatory is not None \
+                else fleet_mod.observatory()
+            snap = obs.merged()  # refreshes the local slice per scrape
+            q = parse_qs(parsed.query)
+            trace = q.get("trace", [None])[0]
+            if trace is not None:
+                body = json.dumps({
+                    "trace": trace,
+                    "timeline": fleet_mod.stitch_trace(snap, trace),
+                }).encode()
+                return body, "application/json", 200
+            if q.get("format", [None])[0] == "json":
+                return (json.dumps(snap.to_json()).encode(),
+                        "application/json", 200)
+            text = fleet_mod.fleet_prometheus_text(snap)
+            return (text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8", 200)
         if route == "/healthz":
             body = json.dumps({
                 "status": "ok",
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }).encode()
             return body, "application/json", 200
-        return b"not found (try /metrics, /events, /healthz)\n", \
+        return b"not found (try /metrics, /events, /fleet, /healthz)\n", \
             "text/plain; charset=utf-8", 404
 
     def scrape_counts(self) -> dict:
@@ -208,9 +243,11 @@ class MetricsServer:
 def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
                          registry: Optional[metrics.MetricsRegistry] = None,
                          tracker: Optional[convergence.ConvergenceTracker]
-                         = None) -> MetricsServer:
+                         = None, observatory=None) -> MetricsServer:
     """Start the opt-in background exporter; ``port=0`` picks a free
     port (read it back from ``server.port``).  ``tracker`` pairs a
     custom ``registry`` with the convergence tracker writing into it
-    (see :func:`prometheus_text`)."""
-    return MetricsServer(host, port, registry, tracker)
+    (see :func:`prometheus_text`); ``observatory`` is the
+    :class:`~crdt_tpu.obs.fleet.FleetObservatory` behind ``/fleet``
+    (default: the process-global one)."""
+    return MetricsServer(host, port, registry, tracker, observatory)

@@ -1,0 +1,563 @@
+"""Fleet observatory — CRDT-merged cross-process telemetry.
+
+One process's registry snapshot answers "what did *this* replica do";
+a fleet needs the union.  The insight this module dogfoods is that a
+telemetry snapshot is itself a join-semilattice, so fleet aggregation
+is one more commutative/associative/idempotent merge — the same
+anti-entropy shape the CRDTs under observation use (Shapiro et al.;
+riak_dt shipped its stats the same way).  Per-kind join semantics:
+
+* **counters** — per-node-keyed, merged by per-node ``max`` (counter
+  values are monotone per process, so the latest capture dominates):
+  a G-Counter with the node id as the actor.  The *fleet* counter is
+  the sum over nodes, and re-delivered snapshots are idempotent — the
+  acceptance property a gossiping, duplicating transport demands.
+* **gauges** — LWW by capture stamp ``(wall_ts, seq)``, per
+  ``(name, node)``; the fleet gauge is the newest capture fleet-wide.
+* **histograms** — per-node LWW by capture stamp (bucket counts are
+  monotone per process, so newest-capture-wins is the per-node join);
+  the fleet histogram is the bucket-wise sum across nodes.
+
+Snapshots travel as versioned, CRC-guarded frames (the same envelope
+discipline as :mod:`crdt_tpu.sync.delta`: mixed versions fail loudly
+as :class:`~crdt_tpu.error.SyncProtocolError`, never misparse) over
+two paths: piggybacked on gossip sync sessions
+(:class:`~crdt_tpu.sync.session.SyncSession` ``observatory=``), and an
+all-gather over :func:`crdt_tpu.parallel.collective.
+allgather_fleet_snapshots` for pjit meshes with no network peers.
+Because an observatory ships its *merged* snapshot, slices spread
+transitively: a node learns about peers it never dialed.
+
+The flight-recorder tail each slice carries feeds
+:func:`stitch_trace`: given the fleet-unique trace ID a sync hello
+negotiated, it reconstructs the cross-peer session timeline from the
+merged slices — both halves of one session, one ordered story.
+
+Stdlib-only (no jax, no numpy): an observatory must be importable from
+any process that owns a metrics registry, scraper boxes included.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import struct
+import threading
+import time
+import zlib
+from typing import Dict, Iterable, List, Optional
+
+from ..error import SyncProtocolError
+from . import convergence as convergence_mod
+from . import events as events_mod
+from . import metrics as metrics_mod
+from .namespace import sanitize as _sanitize
+
+#: bumped whenever the snapshot grammar changes; a peer speaking a
+#: different version must fail loudly at the first frame
+FLEET_PROTOCOL_VERSION = 1
+
+#: frame type byte — disjoint from the sync codec's 0x01-0x0f range so
+#: a misrouted frame is an immediate unknown-type rejection either way
+FRAME_FLEET_SNAPSHOT = 0x21
+
+_HEADER = struct.Struct("<BBIQ")  # version | type | crc32 | payload_len
+
+#: flight-recorder events retained per node slice (the stitcher's
+#: working set; bounded so a snapshot frame stays a few KB)
+EVENTS_TAIL = 128
+
+_CAPTURE_SEQ = itertools.count(1)
+
+
+def _canon(obj) -> str:
+    """Canonical JSON — the deterministic tie-breaker and equality key."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def _stamp_key(entry) -> tuple:
+    """Total order over stamped entries ``[ts, seq, value]``: capture
+    stamp first, canonical value as the final tie-break so the LWW pick
+    stays commutative even for (theoretically) equal stamps."""
+    return (entry[0], entry[1], _canon(entry[2]))
+
+
+def _merge_stamped(a: Dict[str, list], b: Dict[str, list]) -> Dict[str, list]:
+    """Pointwise LWW join of two ``{name: [ts, seq, value]}`` maps."""
+    out = dict(a)
+    for name, entry in b.items():
+        cur = out.get(name)
+        if cur is None or _stamp_key(entry) > _stamp_key(cur):
+            out[name] = entry
+    return out
+
+
+def _merge_events(a: List[dict], b: List[dict]) -> List[dict]:
+    """Union of two event tails from ONE node, keyed by the recorder's
+    per-process ``seq`` (idempotent under re-delivery), trimmed to the
+    newest :data:`EVENTS_TAIL`."""
+    by_seq = {ev.get("seq", 0): ev for ev in a}
+    for ev in b:
+        by_seq.setdefault(ev.get("seq", 0), ev)
+    tail = [by_seq[s] for s in sorted(by_seq)]
+    return tail[-EVENTS_TAIL:]
+
+
+class FleetSnapshot:
+    """A mergeable fleet telemetry state: one slice per node id.
+
+    ``slices`` maps node id → JSON-ready slice dict (see
+    :func:`capture_slice` for the shape).  Instances are treated as
+    immutable: :meth:`merge` returns a new snapshot, so a scrape can
+    render one while gossip merges another.
+    """
+
+    __slots__ = ("slices",)
+
+    def __init__(self, slices: Optional[Dict[str, dict]] = None):
+        self.slices = slices or {}
+
+    # -- the lattice ---------------------------------------------------------
+
+    def merge(self, other: "FleetSnapshot") -> "FleetSnapshot":
+        """The join: per-kind semantics within a node (counters max,
+        gauges/histograms/convergence LWW by capture stamp, event tails
+        seq-unioned), slice union across nodes.  Commutative,
+        associative, idempotent — property-tested in
+        ``tests/test_fleet_obs.py``."""
+        merged = dict(self.slices)
+        for node, theirs in other.slices.items():
+            mine = merged.get(node)
+            merged[node] = theirs if mine is None \
+                else _merge_slice(mine, theirs)
+        return FleetSnapshot(merged)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, FleetSnapshot) and \
+            _canon(self.slices) == _canon(other.slices)
+
+    def __hash__(self):  # canonical-JSON equality needs a matching hash
+        return hash(_canon(self.slices))
+
+    # -- fleet views ---------------------------------------------------------
+
+    def nodes(self) -> List[str]:
+        return sorted(self.slices)
+
+    def fleet_counters(self) -> Dict[str, int]:
+        """Every counter name → the SUM of the per-node values (the
+        G-Counter read: each node contributes its own latest value
+        exactly once, however many times its snapshot was delivered)."""
+        out: Dict[str, int] = {}
+        for sl in self.slices.values():
+            for name, v in sl.get("counters", {}).items():
+                out[name] = out.get(name, 0) + int(v)
+        return out
+
+    def counters_by_node(self, name: str) -> Dict[str, int]:
+        return {
+            node: int(sl["counters"][name])
+            for node, sl in self.slices.items()
+            if name in sl.get("counters", {})
+        }
+
+    def fleet_gauges(self) -> Dict[str, float]:
+        """Every gauge name → the newest capture's value fleet-wide
+        (LWW across nodes, same order as within a node)."""
+        best: Dict[str, list] = {}
+        for sl in self.slices.values():
+            best = _merge_stamped(best, sl.get("gauges", {}))
+        return {name: entry[2] for name, entry in best.items()}
+
+    def fleet_histograms(self) -> Dict[str, dict]:
+        """Every histogram name → the bucket-wise sum across nodes
+        (count/sum add, min/max combine) — each node's latest capture
+        contributes once."""
+        out: Dict[str, dict] = {}
+        for sl in self.slices.values():
+            for name, entry in sl.get("histograms", {}).items():
+                h = entry[2]
+                acc = out.get(name)
+                if acc is None:
+                    acc = out[name] = {
+                        "count": 0, "sum": 0.0, "min": None, "max": None,
+                        "buckets": {},
+                    }
+                acc["count"] += int(h.get("count", 0))
+                acc["sum"] += float(h.get("sum", 0.0))
+                for bound in ("min", "max"):
+                    v = h.get(bound)
+                    if v is None:
+                        continue
+                    cur = acc[bound]
+                    pick = min if bound == "min" else max
+                    acc[bound] = v if cur is None else pick(cur, v)
+                for e, n in h.get("buckets", {}).items():
+                    acc["buckets"][e] = acc["buckets"].get(e, 0) + int(n)
+        return out
+
+    def events(self, node: Optional[str] = None) -> List[dict]:
+        """Retained flight-recorder events, each annotated with its
+        ``node``, ordered by wall-clock then per-process seq."""
+        out = []
+        for nid, sl in self.slices.items():
+            if node is not None and nid != node:
+                continue
+            for ev in sl.get("events", []):
+                ev = dict(ev)
+                ev["node"] = nid
+                out.append(ev)
+        out.sort(key=lambda e: (e.get("wall", 0.0), e.get("seq", 0)))
+        return out
+
+    def to_json(self) -> dict:
+        """JSON-ready view: the raw slices plus the fleet aggregates
+        (what ``/fleet?format=json`` serves)."""
+        return {
+            "version": FLEET_PROTOCOL_VERSION,
+            "nodes": self.nodes(),
+            "slices": self.slices,
+            "fleet": {
+                "counters": self.fleet_counters(),
+                "gauges": self.fleet_gauges(),
+                "histograms": self.fleet_histograms(),
+            },
+        }
+
+
+def _merge_slice(a: dict, b: dict) -> dict:
+    """Join two slices OF THE SAME NODE (see module docstring for the
+    per-kind semantics)."""
+    counters = dict(a.get("counters", {}))
+    for name, v in b.get("counters", {}).items():
+        cur = counters.get(name)
+        counters[name] = int(v) if cur is None else max(int(cur), int(v))
+    return {
+        "ts": max(a.get("ts", 0.0), b.get("ts", 0.0)),
+        "seq": max(a.get("seq", 0), b.get("seq", 0)),
+        "counters": counters,
+        "gauges": _merge_stamped(a.get("gauges", {}), b.get("gauges", {})),
+        "histograms": _merge_stamped(
+            a.get("histograms", {}), b.get("histograms", {})
+        ),
+        "convergence": max(
+            a.get("convergence", [0.0, 0, {}]),
+            b.get("convergence", [0.0, 0, {}]),
+            key=_stamp_key,
+        ),
+        "events_dropped": max(
+            int(a.get("events_dropped", 0)), int(b.get("events_dropped", 0))
+        ),
+        "events": _merge_events(a.get("events", []), b.get("events", [])),
+    }
+
+
+def capture_slice(node_id: str, *,
+                  registry: Optional[metrics_mod.MetricsRegistry] = None,
+                  tracker: Optional[convergence_mod.ConvergenceTracker] = None,
+                  recorder: Optional[events_mod.FlightRecorder] = None,
+                  events_tail: int = EVENTS_TAIL) -> FleetSnapshot:
+    """One node's live telemetry as a single-slice snapshot: the
+    registry snapshot re-shaped into the lattice (stamped with this
+    capture's ``(wall_ts, seq)``), the convergence tracker state, the
+    events-dropped count and a bounded flight-recorder tail."""
+    reg = registry if registry is not None else metrics_mod.registry()
+    trk = tracker if tracker is not None else convergence_mod.tracker()
+    rec = recorder if recorder is not None else events_mod.recorder()
+    snap = reg.snapshot()
+    ts, seq = time.time(), next(_CAPTURE_SEQ)
+    hists = {}
+    for name, h in snap["histograms"].items():
+        hists[name] = [ts, seq, {
+            "count": h["count"],
+            "sum": h["sum"],
+            "min": h["min"],
+            "max": h["max"],
+            # JSON object keys are strings; exponents stay str end-to-end
+            "buckets": {str(e): n for e, n in h["buckets"].items()},
+        }]
+    tail = rec.snapshot()[-max(0, events_tail):]
+    slice_ = {
+        "ts": ts,
+        "seq": seq,
+        "counters": {k: int(v) for k, v in snap["counters"].items()},
+        "gauges": {k: [ts, seq, float(v)]
+                   for k, v in snap["gauges"].items()},
+        "histograms": hists,
+        "convergence": [ts, seq, trk.snapshot()],
+        "events_dropped": rec.dropped,
+        "events": tail,
+    }
+    return FleetSnapshot({node_id: slice_})
+
+
+# ---- the wire codec ---------------------------------------------------------
+
+
+def encode_snapshot(snap: FleetSnapshot) -> bytes:
+    """A fleet-snapshot frame: the versioned+CRC envelope around the
+    canonical-JSON payload (same discipline as the sync codec —
+    truncation/tampering is a clean rejection, mixed versions fail
+    loudly)."""
+    payload = _canon(snap.slices).encode("utf-8")
+    return _HEADER.pack(
+        FLEET_PROTOCOL_VERSION, FRAME_FLEET_SNAPSHOT,
+        zlib.crc32(payload), len(payload),
+    ) + payload
+
+
+def _reject(reason: str, message: str) -> SyncProtocolError:
+    from ..utils import tracing
+
+    tracing.count(f"obs.fleet.frames.rejected.{reason}")
+    events_mod.record("obs.fleet.frame_rejected", reason=reason,
+                      error=message[:200])
+    return SyncProtocolError(message)
+
+
+def decode_snapshot(frame: bytes) -> FleetSnapshot:
+    """Validate and decode one fleet-snapshot frame.  Raises
+    :class:`~crdt_tpu.error.SyncProtocolError` on a version mismatch,
+    unknown type, truncated/overlong frame, CRC mismatch, or a payload
+    that is not a slices object — the caller never merges garbage."""
+    from ..utils import tracing
+
+    if len(frame) < _HEADER.size:
+        raise _reject(
+            "truncated",
+            f"truncated fleet frame: {len(frame)} bytes < "
+            f"{_HEADER.size}-byte header"
+        )
+    version, ftype, crc, plen = _HEADER.unpack_from(frame)
+    if version != FLEET_PROTOCOL_VERSION:
+        raise _reject(
+            "version_mismatch",
+            f"fleet snapshot version mismatch: peer sent v{version}, "
+            f"this build speaks v{FLEET_PROTOCOL_VERSION}"
+        )
+    if ftype != FRAME_FLEET_SNAPSHOT:
+        raise _reject(
+            "unknown_type", f"unknown fleet frame type {ftype:#04x}"
+        )
+    payload = frame[_HEADER.size:]
+    if len(payload) != plen:
+        raise _reject(
+            "length_mismatch",
+            f"fleet frame length mismatch: header says {plen} payload "
+            f"bytes, frame carries {len(payload)}"
+        )
+    if zlib.crc32(payload) != crc:
+        raise _reject(
+            "crc_mismatch",
+            "fleet snapshot frame CRC mismatch (tampered or corrupted "
+            "in transit)"
+        )
+    try:
+        slices = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise _reject("malformed_payload",
+                      f"malformed fleet snapshot payload: {e}") from None
+    if not isinstance(slices, dict) or not all(
+        isinstance(k, str) and isinstance(v, dict)
+        for k, v in slices.items()
+    ):
+        raise _reject(
+            "malformed_payload",
+            "fleet snapshot payload is not a {node: slice} object"
+        )
+    tracing.count("obs.fleet.frames.decoded")
+    return FleetSnapshot(slices)
+
+
+def merge_snapshots(frames: Iterable[bytes]) -> FleetSnapshot:
+    """Decode and fold a batch of snapshot frames — the shared body of
+    the transport-piggyback and collective all-gather paths."""
+    acc = FleetSnapshot()
+    for frame in frames:
+        acc = acc.merge(decode_snapshot(frame))
+    return acc
+
+
+# ---- the trace stitcher -----------------------------------------------------
+
+
+def stitch_trace(snapshot_or_events, trace_id: str) -> List[dict]:
+    """The cross-peer timeline of one sync session: every flight-
+    recorder event (from every node slice) stamped with ``trace_id``,
+    ordered by wall clock then per-process seq, each annotated with the
+    node that recorded it.  Both halves of a session carry the SAME
+    hello-negotiated trace ID, so this is the whole story — dial,
+    digest exchange, delta, converged — interleaved across peers.
+
+    Accepts a :class:`FleetSnapshot` or a pre-annotated event list (the
+    shape :meth:`FleetSnapshot.events` returns)."""
+    evs = snapshot_or_events.events() \
+        if isinstance(snapshot_or_events, FleetSnapshot) \
+        else list(snapshot_or_events)
+    return [
+        ev for ev in evs
+        if ev.get("fields", {}).get("trace") == trace_id
+        or ev.get("session") == trace_id
+    ]
+
+
+# ---- Prometheus rendering ---------------------------------------------------
+
+#: the merged-fleet metric prefix — deliberately distinct from the
+#: per-process ``crdt_tpu_`` namespace so one Prometheus can scrape
+#: both ``/metrics`` and ``/fleet`` of the same node without the fleet
+#: aggregate shadowing the local series
+FLEET_PROM_PREFIX = "crdt_tpu_fleet"
+
+
+def fleet_prometheus_text(snap: FleetSnapshot,
+                          prefix: str = FLEET_PROM_PREFIX) -> str:
+    """The merged fleet snapshot as Prometheus text exposition:
+    counters summed over nodes (``*_total``), gauges LWW fleet-wide,
+    histograms bucket-wise summed, plus ``<prefix>_nodes`` (distinct
+    nodes merged so far) — one scrape of ANY node answers for the
+    fleet."""
+    lines = [
+        f"# TYPE {prefix}_nodes gauge",
+        f"{prefix}_nodes {len(snap.slices)}",
+    ]
+    counters = snap.fleet_counters()
+    for name in sorted(counters):
+        mname = f"{prefix}_{_sanitize(name)}_total"
+        lines.append(f"# TYPE {mname} counter")
+        lines.append(f"{mname} {int(counters[name])}")
+    gauges = snap.fleet_gauges()
+    for name in sorted(gauges):
+        mname = f"{prefix}_{_sanitize(name)}"
+        v = gauges[name]
+        rendered = str(int(v)) if float(v).is_integer() else repr(float(v))
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname} {rendered}")
+    hists = snap.fleet_histograms()
+    import math
+
+    for name in sorted(hists):
+        h = hists[name]
+        mname = f"{prefix}_{_sanitize(name)}"
+        lines.append(f"# TYPE {mname} histogram")
+        running = 0
+        for e in sorted(h["buckets"], key=int):
+            running += h["buckets"][e]
+            exp = int(e)
+            bound = 0.0 if exp == metrics_mod.Histogram.ZERO_BUCKET \
+                else math.ldexp(1.0, exp)
+            b = str(int(bound)) if bound.is_integer() else repr(bound)
+            lines.append(f'{mname}_bucket{{le="{b}"}} {running}')
+        lines.append(f'{mname}_bucket{{le="+Inf"}} {h["count"]}')
+        s = h["sum"]
+        lines.append(
+            f"{mname}_sum {str(int(s)) if float(s).is_integer() else repr(s)}"
+        )
+        lines.append(f"{mname}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# ---- the observatory --------------------------------------------------------
+
+
+class FleetObservatory:
+    """One node's accumulation point for fleet telemetry.
+
+    Owns the merged :class:`FleetSnapshot` under a lock; gossip
+    sessions feed peer frames in (:meth:`merge_frame`) and ship the
+    merged state out (:meth:`encode` — merged, not just local, so
+    slices spread transitively through the fleet), while ``/fleet``
+    scrapes read a refreshed copy (:meth:`merged`).
+
+    ``node_id`` labels this process's slice; in-process multi-node
+    harnesses (tests, the ``--gossip`` demo) share one metrics
+    registry, so their slices differ by capture time and node label —
+    the lattice does not care.
+    """
+
+    def __init__(self, node_id: Optional[str] = None, *,
+                 registry: Optional[metrics_mod.MetricsRegistry] = None,
+                 tracker: Optional[convergence_mod.ConvergenceTracker]
+                 = None,
+                 recorder: Optional[events_mod.FlightRecorder] = None,
+                 events_tail: int = EVENTS_TAIL):
+        self.node_id = node_id or f"proc-{events_mod._PROC_TAG}"
+        self._registry = registry
+        self._tracker = tracker
+        self._recorder = recorder
+        self._events_tail = events_tail
+        self._lock = threading.Lock()
+        self._merged = FleetSnapshot()
+
+    def capture(self) -> FleetSnapshot:
+        """Capture this node's live slice, fold it into the merged
+        state, and return the single-slice snapshot."""
+        local = capture_slice(
+            self.node_id, registry=self._registry, tracker=self._tracker,
+            recorder=self._recorder, events_tail=self._events_tail,
+        )
+        with self._lock:
+            self._merged = self._merged.merge(local)
+        return local
+
+    def merge(self, snap: FleetSnapshot) -> FleetSnapshot:
+        """Fold a peer snapshot in; returns the new merged state.
+        Idempotent — re-delivered snapshots (an ARQ retransmit, a
+        gossip echo of our own slice) change nothing."""
+        with self._lock:
+            self._merged = merged = self._merged.merge(snap)
+        from ..utils import tracing
+
+        tracing.count("obs.fleet.merges")
+        reg = self._registry if self._registry is not None \
+            else metrics_mod.registry()
+        reg.gauge_set("obs.fleet.nodes", len(merged.slices))
+        return merged
+
+    def merge_frame(self, frame: bytes) -> FleetSnapshot:
+        """Decode one wire frame and fold it in (raises
+        :class:`~crdt_tpu.error.SyncProtocolError` on a bad frame
+        WITHOUT touching the merged state)."""
+        return self.merge(decode_snapshot(frame))
+
+    def merged(self, refresh: bool = True) -> FleetSnapshot:
+        """The merged fleet snapshot; ``refresh`` folds a fresh local
+        capture in first so the local slice is never stale."""
+        if refresh:
+            self.capture()
+        with self._lock:
+            return self._merged
+
+    def encode(self, refresh: bool = True) -> bytes:
+        """The merged snapshot as one wire frame — what a gossip
+        session piggybacks.  Shipping the MERGED state (not just the
+        local slice) is what makes snapshot dissemination itself an
+        anti-entropy protocol."""
+        snap = self.merged(refresh=refresh)
+        frame = encode_snapshot(snap)
+        reg = self._registry if self._registry is not None \
+            else metrics_mod.registry()
+        reg.observe("obs.fleet.snapshot_bytes", len(frame))
+        return frame
+
+    def reset(self) -> None:
+        with self._lock:
+            self._merged = FleetSnapshot()
+
+
+# -- the default (process-global) observatory --------------------------------
+
+_DEFAULT: Optional[FleetObservatory] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def observatory() -> FleetObservatory:
+    """The process-global observatory — what ``/fleet`` serves when the
+    server was not handed a private one, and the default aggregation
+    point for single-node-per-process deployments."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = FleetObservatory()
+    return _DEFAULT

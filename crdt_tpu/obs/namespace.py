@@ -147,6 +147,28 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "injected faults by kind (drop/delay/truncate/duplicate/"
              "disconnect) — nonzero outside tests means faults.py leaked "
              "into production wiring"),
+    # -- gossip-round fleet health (cluster/gossip.py) -----------------------
+    NameSpec("cluster.gossip.*", "gauge",
+             "last gossip round's health (attempted/ok/failed/"
+             "skipped_busy) + fleet convergence view (fleet_divergence_"
+             "max, eta_rounds — peers still diverged over the fanout)"),
+    # -- fleet observatory (obs/fleet.py, obs/export.py) ---------------------
+    NameSpec("obs.events.dropped", "gauge",
+             "flight-recorder events evicted by the ring bound "
+             "(refreshed at scrape time)"),
+    NameSpec("obs.fleet.merges", "counter",
+             "peer fleet snapshots merged into this observatory"),
+    NameSpec("obs.fleet.nodes", "gauge",
+             "distinct nodes in the merged fleet snapshot"),
+    NameSpec("obs.fleet.frames.decoded", "counter",
+             "accepted fleet-snapshot frames"),
+    NameSpec("obs.fleet.frames.rejected.*", "counter",
+             "rejected fleet frames by reason (truncated/"
+             "version_mismatch/crc_mismatch/...)"),
+    NameSpec("obs.fleet.exchange", "histogram",
+             "piggybacked snapshot-exchange wall time (span)"),
+    NameSpec("obs.fleet.snapshot_bytes", "histogram",
+             "encoded merged-snapshot frame size"),
     # -- native engine (native/engine.py) ------------------------------------
     NameSpec("native.engine.*.calls", "counter",
              "native kernel invocations per entry point"),

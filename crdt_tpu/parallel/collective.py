@@ -527,6 +527,50 @@ def allgather_join_gset(batch, mesh: Mesh, axis: str = "replicas"):
     return GSetBatch(bits=joined.astype(bool))
 
 
+# -- fleet-observability all-gather -------------------------------------------
+
+
+def allgather_fleet_snapshots(observatory):
+    """Aggregate fleet telemetry across the processes of a jax mesh —
+    the scraper-free path for pjit deployments with NO network peers to
+    gossip with: every process encodes its observatory's merged
+    snapshot frame (:meth:`crdt_tpu.obs.fleet.FleetObservatory.encode`
+    — versioned + CRC-guarded, so a skewed process fails loudly at
+    decode), the frames ride one ``process_allgather`` over DCN (byte
+    payloads padded to the fleet max, lengths gathered first), and
+    every process folds every frame into its observatory.  Because the
+    snapshot merge is commutative/associative/idempotent, all processes
+    converge to the SAME fleet view — including each process's own
+    echoed frame, which the G-Counter semantics absorb as a no-op.
+
+    Returns the merged :class:`~crdt_tpu.obs.fleet.FleetSnapshot`.
+    Single-process meshes degrade to a local capture+merge, so the
+    call is safe unconditionally."""
+    import numpy as np
+
+    frame = observatory.encode()
+    if jax.process_count() == 1:
+        # nothing to gather; the encode above already refreshed the
+        # local slice into the merged state
+        return observatory.merged(refresh=False)
+
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(frame, dtype=np.uint8)
+    sizes = np.atleast_1d(np.asarray(
+        multihost_utils.process_allgather(np.int64(data.size))
+    )).reshape(-1)
+    pad = int(sizes.max())
+    buf = np.zeros(pad, dtype=np.uint8)
+    buf[:data.size] = data
+    gathered = np.atleast_2d(np.asarray(
+        multihost_utils.process_allgather(buf)
+    ))
+    for row, size in zip(gathered, sizes):
+        observatory.merge_frame(bytes(row[:int(size)]))
+    return observatory.merged(refresh=False)
+
+
 # -- anti-entropy to fixpoint ------------------------------------------------
 
 

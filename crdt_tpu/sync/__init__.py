@@ -34,10 +34,12 @@ from .delta import (  # noqa: F401
     PROTOCOL_VERSION,
     OrswotDeltaApplier,
     decode_frame,
+    decode_hello_payload,
     diverged_indices,
     encode_delta_frame,
     encode_digest_frame,
     encode_full_frame,
+    encode_hello_frame,
     gather_blobs,
 )
 from .session import SyncReport, SyncSession, queue_transport  # noqa: F401
@@ -49,11 +51,13 @@ __all__ = [
     "SyncSession",
     "counter_digest",
     "decode_frame",
+    "decode_hello_payload",
     "digest_of",
     "diverged_indices",
     "encode_delta_frame",
     "encode_digest_frame",
     "encode_full_frame",
+    "encode_hello_frame",
     "fleet_summary",
     "gather_blobs",
     "lww_digest",

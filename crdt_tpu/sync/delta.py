@@ -31,15 +31,20 @@ import numpy as np
 from ..error import SyncProtocolError
 
 #: bumped whenever the frame grammar changes; peers with different
-#: versions must fail loudly at the first frame, never misparse
-PROTOCOL_VERSION = 1
+#: versions must fail loudly at the first frame, never misparse.
+#: v2: sessions open with a HELLO frame (trace-ID negotiation + fleet
+#: observability capability flag) and may close with a FLEET frame.
+PROTOCOL_VERSION = 2
 
 FRAME_DIGEST = 0x01
 FRAME_DELTA = 0x02
 FRAME_FULL = 0x03
+FRAME_HELLO = 0x04
+FRAME_FLEET = 0x05
 
 _FRAME_NAMES = {FRAME_DIGEST: "digest", FRAME_DELTA: "delta",
-                FRAME_FULL: "full"}
+                FRAME_FULL: "full", FRAME_HELLO: "hello",
+                FRAME_FLEET: "fleet"}
 _HEADER = struct.Struct("<BBIQ")
 
 
@@ -102,6 +107,57 @@ def decode_frame(frame: bytes) -> tuple[int, bytes]:
         )
     tracing.count(f"sync.frame.{_FRAME_NAMES[ftype]}.decoded")
     return ftype, payload
+
+
+# ---- hello frames ----------------------------------------------------------
+
+
+def encode_hello_frame(trace: str, node: str, fleet_obs: bool) -> bytes:
+    """A HELLO frame — the session-opening handshake: this side's
+    trace-ID proposal (both peers adopt the lexicographic min, so the
+    two halves of one session share ONE fleet-unique ID), its node
+    label, and whether it can exchange piggybacked fleet-observability
+    snapshots (the exchange only happens when BOTH advertise it, which
+    keeps the lock-step protocol symmetric)."""
+    import json
+
+    payload = json.dumps(
+        {"trace": str(trace), "node": str(node), "fleet_obs": bool(fleet_obs)},
+        sort_keys=True, separators=(",", ":"),
+    ).encode("utf-8")
+    return _frame(FRAME_HELLO, payload)
+
+
+def decode_hello_payload(payload: bytes) -> tuple[str, str, bool]:
+    """``(trace_proposal, node_label, fleet_obs)`` from a HELLO
+    payload.  Labels are bounded defensively — a garbage hello must
+    yield a rejection, not an unbounded event field."""
+    import json
+
+    try:
+        doc = json.loads(payload.decode("utf-8"))
+        trace = str(doc["trace"])[:128]
+        node = str(doc.get("node", "peer"))[:64]
+        fleet_obs = bool(doc.get("fleet_obs", False))
+    except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
+        raise SyncProtocolError(f"malformed hello payload: {e}") from None
+    if not trace:
+        raise SyncProtocolError("hello payload carries an empty trace ID")
+    return trace, node, fleet_obs
+
+
+def encode_fleet_frame(snapshot_frame: bytes) -> bytes:
+    """A FLEET frame: one fleet-observatory snapshot frame
+    (:func:`crdt_tpu.obs.fleet.encode_snapshot` — itself versioned and
+    CRC-guarded) nested in the sync envelope, so the piggyback ride
+    gets the same loud-rejection treatment as every other sync leg."""
+    return _frame(FRAME_FLEET, bytes(snapshot_frame))
+
+
+def decode_fleet_payload(payload: bytes) -> bytes:
+    """The nested fleet-snapshot frame from a FLEET payload (validated
+    by the fleet codec's own decode, not here)."""
+    return bytes(payload)
 
 
 # ---- digest frames ---------------------------------------------------------

@@ -12,6 +12,11 @@ peer can block waiting for a frame the other will never send.
 
 Phases::
 
+    0. hello             — one frame each way: trace-ID proposals (both
+                           peers adopt the lexicographic min, so the
+                           session's two halves share ONE fleet-unique
+                           trace ID) + the fleet-observability
+                           capability flag
     1. digest exchange   — one jitted kernel + ~8 bytes/object on the
                            wire; both peers now know the diverged set
     2. delta exchange    — only diverged rows ship (FULL frame instead
@@ -22,6 +27,10 @@ Phases::
                            mismatch (64-bit collision, digest-mode skew)
                            the session retries with full state, which
                            must converge or the sync raises
+    4. fleet piggyback   — only when BOTH hellos advertised an
+                           observatory: each side ships its merged
+                           fleet-telemetry snapshot and folds the
+                           peer's in (:mod:`crdt_tpu.obs.fleet`)
 
 Wire cost is O(divergence): an idempotent re-sync costs one digest
 exchange and zero delta bytes.  Every phase feeds the always-on
@@ -55,16 +64,22 @@ from . import digest as digest_mod
 from .delta import (
     FRAME_DELTA,
     FRAME_DIGEST,
+    FRAME_FLEET,
     FRAME_FULL,
+    FRAME_HELLO,
     OrswotDeltaApplier,
     decode_delta_payload,
     decode_digest_payload,
+    decode_fleet_payload,
     decode_frame,
     decode_full_payload,
+    decode_hello_payload,
     diverged_indices,
     encode_delta_frame,
     encode_digest_frame,
+    encode_fleet_frame,
     encode_full_frame,
+    encode_hello_frame,
     gather_blobs,
 )
 
@@ -82,12 +97,17 @@ class SyncReport:
     digest_bytes_sent: int = 0
     delta_bytes_sent: int = 0      # DELTA frames only
     full_bytes_sent: int = 0       # FULL frames only
+    hello_bytes_sent: int = 0      # the session-opening handshake
+    fleet_bytes_sent: int = 0      # piggybacked observability snapshot
     bytes_received: int = 0
+    trace_id: Optional[str] = None  # hello-negotiated, same on BOTH peers
+    fleet_nodes: int = 0           # nodes known after a snapshot exchange
 
     @property
     def bytes_sent(self) -> int:
         return (self.digest_bytes_sent + self.delta_bytes_sent
-                + self.full_bytes_sent)
+                + self.full_bytes_sent + self.hello_bytes_sent
+                + self.fleet_bytes_sent)
 
     def delta_ratio(self, full_state_bytes: int) -> Optional[float]:
         """Payload bytes this side shipped (delta + any full-state
@@ -130,7 +150,8 @@ class SyncSession:
                  full_state: bool = False,
                  digest_fn: Optional[Callable] = None,
                  peer: Optional[str] = None,
-                 full_state_bytes: Optional[int] = None):
+                 full_state_bytes: Optional[int] = None,
+                 observatory=None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -142,10 +163,22 @@ class SyncSession:
         self.peer = peer or "peer"
         self.full_state_bytes = full_state_bytes
         self.session_id = obs_events.new_session_id()
+        #: hello-negotiated, fleet-unique: the lexicographic min of the
+        #: two peers' session IDs, so BOTH halves of one session stamp
+        #: their events/errors with the same ID (None until the hello
+        #: exchange lands)
+        self.trace_id: Optional[str] = None
+        #: a :class:`crdt_tpu.obs.fleet.FleetObservatory`; when set AND
+        #: the peer's hello advertises one too, the session closes with
+        #: a piggybacked fleet-snapshot exchange
+        self.observatory = observatory
+        self._peer_fleet_obs = False
         self._digest_fn = digest_fn or digest_mod.digest_of
         self._applier = OrswotDeltaApplier(universe)
 
     def _event(self, kind: str, **fields) -> None:
+        if self.trace_id is not None and "trace" not in fields:
+            fields["trace"] = self.trace_id
         obs_events.record(kind, session=self.session_id, peer=self.peer,
                           **fields)
 
@@ -159,6 +192,10 @@ class SyncSession:
             report.digest_bytes_sent += len(frame)
         elif leg == "delta":
             report.delta_bytes_sent += len(frame)
+        elif leg == "hello":
+            report.hello_bytes_sent += len(frame)
+        elif leg == "fleet":
+            report.fleet_bytes_sent += len(frame)
         else:
             report.full_bytes_sent += len(frame)
 
@@ -182,6 +219,58 @@ class SyncSession:
         return decode_frame(frame)
 
     # -- phase helpers -------------------------------------------------------
+
+    def _hello(self, send, recv, report: SyncReport) -> None:
+        """The session-opening handshake: both peers ship their trace
+        proposal (their own session ID — process-unique by
+        construction) and their fleet-observability capability, then
+        adopt the lexicographic MIN of the two proposals as the shared
+        trace ID.  Pure function of exchanged data, so both sides agree
+        without a leader — and from here on every event either peer
+        records carries the same fleet-unique trace."""
+        node = self.observatory.node_id if self.observatory is not None \
+            else f"proc-{obs_events._PROC_TAG}"
+        proposal = self.session_id
+        self._send(
+            send,
+            encode_hello_frame(proposal, node, self.observatory is not None),
+            report, "hello", 0,
+        )
+        ftype, payload = self._recv(recv, report)
+        if ftype != FRAME_HELLO:
+            raise SyncProtocolError(
+                f"expected a hello frame, peer sent type {ftype:#04x} "
+                "(pre-v2 peer?)"
+            )
+        theirs, peer_node, self._peer_fleet_obs = \
+            decode_hello_payload(payload)
+        self.trace_id = report.trace_id = min(proposal, theirs)
+        self._event("sync.hello", proposed=proposal, peer_node=peer_node,
+                    peer_fleet_obs=self._peer_fleet_obs)
+
+    def _fleet_exchange(self, send, recv, report: SyncReport) -> None:
+        """Piggybacked fleet-observability snapshot swap after the
+        session converged — only when BOTH hellos advertised an
+        observatory (the decision is shared data, so the lock-step
+        protocol stays symmetric).  Each side ships its MERGED snapshot
+        and folds the peer's in; the merge is idempotent, so ARQ
+        re-delivery and gossip echoes cannot double-count."""
+        if self.observatory is None or not self._peer_fleet_obs:
+            return
+        with tracing.span("obs.fleet.exchange"):
+            mine = self.observatory.encode()
+            self._send(send, encode_fleet_frame(mine), report, "fleet", 0)
+            ftype, payload = self._recv(recv, report)
+            if ftype != FRAME_FLEET:
+                raise SyncProtocolError(
+                    f"expected a fleet frame, peer sent type {ftype:#04x}"
+                )
+            merged = self.observatory.merge_frame(
+                decode_fleet_payload(payload)
+            )
+        report.fleet_nodes = len(merged.slices)
+        self._event("sync.fleet_snapshot", nodes=report.fleet_nodes,
+                    bytes=len(mine))
 
     def _n(self) -> int:
         import jax
@@ -259,6 +348,10 @@ class SyncSession:
             send, recv = transport.send, transport.recv
         try:
             report = self._sync(send, recv)
+            # piggyback AFTER convergence: a failed session must not
+            # spend frames on telemetry, and a converged one has both
+            # hellos' capability flags to decide with
+            self._fleet_exchange(send, recv, report)
         except (SyncProtocolError, TransportError) as e:
             tracing.count("sync.errors")
             self._event("sync.error", error=str(e)[:200])
@@ -290,6 +383,9 @@ class SyncSession:
     def _sync(self, send, recv) -> SyncReport:
         report = SyncReport(objects=self._n())
         tracing.count("sync.sessions")
+        # the hello exchange runs first so every subsequent event —
+        # including the start marker below — carries the shared trace
+        self._hello(send, recv, report)
         self._event("sync.phase", phase="start", objects=report.objects,
                     mode="full_state" if self.full_state else "delta")
 

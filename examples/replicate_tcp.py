@@ -200,7 +200,7 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
     mode = "full-state" if full_state else "delta"
     print(
         f"{role}: {n_objects} objects  mode={mode}  "
-        f"session={session.session_id}  "
+        f"session={session.session_id}  trace={report.trace_id}  "
         f"diverged={report.diverged}  delta_objects={report.delta_objects_sent}  "
         f"sent: digest={report.digest_bytes_sent}B delta="
         f"{report.delta_bytes_sent}B full={report.full_bytes_sent}B  {status}",
@@ -227,7 +227,8 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
 
 
 def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
-                divergence: float, max_sweeps: int = 20) -> int:
+                divergence: float, max_sweeps: int = 20,
+                fleet_port: int | None = None) -> int:
     """N in-process replicas over real loopback TCP, reconciled by the
     cluster runtime (``crdt_tpu/cluster``): each node owns a listener
     (accepted sessions run through the same hardened transport stack),
@@ -235,7 +236,15 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     demo drives deterministic scheduler sweeps (round-robin
     ``run_round`` across nodes) until every node's digest vector is
     byte-identical — the same convergence oracle the sessions
-    themselves use."""
+    themselves use.
+
+    Every node carries a ``FleetObservatory``, so telemetry snapshots
+    piggyback on the gossip sessions; at convergence the demo prints
+    ONE merged fleet snapshot (fleet counters = per-node sums) instead
+    of N disjoint per-node ``/metrics`` views, plus the shared trace ID
+    of the final session (both halves carry it — PERF.md "Fleet
+    observability" walks the curl side).  ``--fleet-port`` additionally
+    serves the live merged view on ``GET /fleet``."""
     import jax
 
     if platform:
@@ -251,6 +260,7 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         RetryPolicy, TcpTransport, hello_accept, hello_dial,
     )
     from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.obs.fleet import FleetObservatory
     from crdt_tpu.utils.interning import Universe
 
     uni = Universe.identity(CrdtConfig(num_actors=max(8, n_peers + 2),
@@ -268,7 +278,22 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         nodes.append(ClusterNode(
             f"n{i}", OrswotBatch.from_scalar(fleet, uni), uni,
             busy_timeout_s=30.0,
+            observatory=FleetObservatory(f"n{i}"),
         ))
+
+    fleet_server = None
+    if fleet_port is not None:
+        from crdt_tpu.obs import export as obs_export
+
+        fleet_server = obs_export.start_metrics_server(
+            port=fleet_port, observatory=nodes[0].observatory
+        )
+        print(
+            f"fleet: merged observatory on "
+            f"http://127.0.0.1:{fleet_server.port}/fleet "
+            f"(?format=json for per-node slices, ?trace=<id> for a "
+            f"stitched session timeline)", flush=True,
+        )
 
     # one listener per node; accepted connections run the acceptor leg
     # through the same ResilientTransport stack the dialers use
@@ -355,6 +380,27 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         for srv in servers:
             srv.close()
 
+    # ONE merged fleet snapshot (every node's slice reached node 0 on
+    # the gossip itself — no scraper, no federation) instead of N
+    # disjoint per-node /metrics views
+    merged = nodes[0].observatory.merged()
+    fc = merged.fleet_counters()
+    sessions_by_node = merged.counters_by_node("sync.sessions")
+    print(f"fleet: merged snapshot spans nodes={merged.nodes()}", flush=True)
+    print(
+        f"fleet: sync.sessions={fc.get('sync.sessions', 0)} "
+        f"(per-node {sessions_by_node}; fleet counter == sum of "
+        f"per-node values by G-Counter merge)", flush=True,
+    )
+    trace = next(
+        (n.last_report.trace_id for n in reversed(nodes)
+         if n.last_report is not None), None,
+    )
+    print(f"fleet: final session trace={trace} "
+          f"(both peers' /events carry it)", flush=True)
+    if fleet_server is not None:
+        fleet_server.stop()
+
     verdict = "CONVERGED" if converged else "DIVERGED"
     print(f"gossip: {n_peers} peers x {n_objects} objects  "
           f"sweeps={sweeps}  {verdict}", flush=True)
@@ -386,13 +432,19 @@ def main() -> int:
                          "loopback TCP reconciled by the cluster runtime "
                          "(crdt_tpu.cluster) until their digest vectors "
                          "are byte-identical")
+    ap.add_argument("--fleet-port", type=int, default=None,
+                    help="with --gossip: serve the live CRDT-merged fleet "
+                         "snapshot on GET /fleet at this port (0 picks a "
+                         "free one); the demo prints the merged snapshot "
+                         "at convergence either way")
     args = ap.parse_args()
 
     if args.gossip:
         if args.gossip < 2:
             ap.error("--gossip needs N >= 2 peers")
         return gossip_demo(args.gossip, args.objects, args.platform,
-                           divergence=args.divergence)
+                           divergence=args.divergence,
+                           fleet_port=args.fleet_port)
 
     if args.role != "demo":
         if not args.port:

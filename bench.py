@@ -1125,6 +1125,143 @@ def bench_sync():
     return out
 
 
+def bench_oplog():
+    """Op-based write front-end (the `crdt_tpu.oplog` subsystem): user
+    writes as columnar op batches folded into the dense planes by the
+    scatter-fold kernel, instead of arriving as state blobs.
+
+    Reports ops/s through ``OpApplier.apply_ops`` at 1k/16k/64k-op
+    batches (each fold is ONE jitted scatter — ``oplog_apply_steps``
+    pins that), plus the wire economics: bytes/op through the op-frame
+    codec against what delta sync pays to move the same writes (the
+    one-side session cost — two digest frames over the whole fleet plus
+    the diverged-row delta frame — per touched object).  The done-bar
+    is ``oplog_vs_delta_ratio <= 0.10``: an op frame must cost at most
+    10% of the per-object delta-sync cost, or the op path has no reason
+    to exist.  Parity gate: a sampled op batch folded by the kernel
+    must digest-match the scalar engine applying the same ops one at a
+    time (`orswot.rs:60-83`)."""
+    import jax
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.oplog import OpApplier, derive_add_ctx, encode_ops_frame
+    from crdt_tpu.scalar.orswot import Orswot
+    from crdt_tpu.sync import digest as digest_mod
+    from crdt_tpu.sync.delta import (
+        encode_delta_frame, encode_digest_frame, gather_blobs,
+    )
+    from crdt_tpu.utils.interning import Universe
+
+    rng = np.random.RandomState(17)
+    if SMALL:
+        n, a, m, batches, reps = 4_096, 16, 16, (256, 1_024, 4_096), 3
+    else:
+        n, a, m, batches, reps = 65_536, 64, 16, (1_000, 16_384, 65_536), 5
+    cfg = CrdtConfig(num_actors=a, member_capacity=m, deferred_capacity=2,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+
+    # a realistic fleet: objects carry history (multi-member, multi-
+    # actor clocks), because that is exactly when re-shipping state per
+    # write is expensive and ops win
+    import jax.numpy as jnp
+
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    reps_planes = anti_entropy_fleets(
+        rng, n, a, m, 2, 1, base=min(10, m - 4), novel=0,
+    )
+    fleet = OrswotBatch(*(jnp.asarray(x) for x in reps_planes[0]))
+    fleet = fleet.merge(fleet)  # canonicalize (plunger), as bench_sync
+
+    # -- parity gate vs the scalar engine (always runs with the stage) --
+    k = 48
+    pobj = rng.randint(0, 64, k)
+    pactor = rng.randint(0, a, k).astype(np.int32)
+    pmember = rng.randint(1 << 16, (1 << 16) + 6, k).astype(np.int32)
+    head = jax.tree_util.tree_map(lambda p: p[:64], fleet)
+    pops, _ = derive_add_ctx(np.asarray(head.clock), pobj, pactor,
+                             member=pmember)
+    folded_head, prep = OpApplier(uni).apply_ops(head, pops)
+    scal = head.to_scalar(uni)
+    for i in range(k):
+        o = scal[int(pobj[i])]
+        o.apply(o.add(int(pmember[i]),
+                      o.value().derive_add_ctx(int(pactor[i]))))
+    ref_head = OrswotBatch.from_scalar(scal, uni)
+    assert np.array_equal(
+        np.asarray(digest_mod.digest_of(folded_head)),
+        np.asarray(digest_mod.digest_of(ref_head)),
+    ), "oplog parity: scatter-fold != scalar apply loop"
+    assert prep.merge_steps == 1 and prep.still_parked == 0, prep
+
+    # -- throughput: ops/s per batch size -------------------------------
+    out = {"oplog_objects": n}
+    clock_host = np.asarray(fleet.clock)
+    rates = {}
+    steps_16k = None
+    ops_by_b = {}
+    for b in batches:
+        ops, _ = derive_add_ctx(
+            clock_host, rng.randint(0, n, b),
+            rng.randint(0, a, b).astype(np.int32),
+            member=rng.randint(1 << 16, (1 << 16) + 4, b).astype(np.int32),
+        )
+        ops_by_b[b] = ops
+        applier = OpApplier(uni)
+        folded, rep = applier.apply_ops(fleet, ops)  # warm/compile
+        jax.block_until_ready(folded.clock)
+        assert rep.still_parked == 0, rep
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            folded, rep = applier.apply_ops(fleet, ops)
+        jax.block_until_ready(folded.clock)
+        wall = time.perf_counter() - t0
+        rates[b] = b * reps / wall
+        if b == batches[1]:
+            steps_16k = rep.merge_steps
+        log(f"oplog: {b} ops -> {rates[b]:,.0f} ops/s "
+            f"({rep.merge_steps} scatter step, rm_rounds={rep.rm_rounds})")
+    out["oplog_apply_ops_per_sec"] = round(max(rates.values()))
+    out["oplog_apply_ops_per_sec_small"] = round(rates[batches[0]])
+    out["oplog_apply_steps"] = steps_16k
+
+    # -- wire economics: op frame vs the delta-sync equivalent ----------
+    b_mid = batches[1]
+    ops = ops_by_b[b_mid]
+    frame = encode_ops_frame(ops)
+    bytes_per_op = len(frame) / b_mid
+    folded, _ = OpApplier(uni).apply_ops(fleet, ops)
+    touched = np.unique(ops.obj)
+    # what ONE side of a delta session pays to move the same writes:
+    # two digest frames over the whole fleet (phase 1 + converged
+    # check) and the diverged rows' delta frame
+    digest_frame = encode_digest_frame(
+        np.asarray(digest_mod.digest_of(folded), dtype=np.uint64))
+    delta_frame = encode_delta_frame(
+        n, touched, gather_blobs(folded, touched, uni))
+    delta_total = 2 * len(digest_frame) + len(delta_frame)
+    delta_per_obj = delta_total / touched.size
+    ratio = bytes_per_op / delta_per_obj
+    out["oplog_bytes_per_op"] = round(bytes_per_op, 2)
+    out["oplog_delta_bytes_per_object"] = round(delta_per_obj, 2)
+    out["oplog_vs_delta_ratio"] = round(ratio, 4)
+    log(
+        f"oplog: {bytes_per_op:.1f} B/op on the wire vs "
+        f"{delta_per_obj:.1f} B/object delta-sync equivalent "
+        f"({touched.size} touched objects) -> ratio {ratio:.3f}"
+    )
+    if ratio > 0.10:
+        log(
+            f"oplog WARNING: wire bytes/op is {ratio:.1%} of the "
+            "per-object delta-sync cost (bar: 10%) — the op frame "
+            "degenerated or the fleet shape got too lean (see PERF.md "
+            "op-based replication section)"
+        )
+    return out
+
+
 def bench_obs_overhead():
     """Always-on observability cost gate (the obs subsystem's bench
     satellite): the counters/gauges/events added across the wire and
@@ -1924,6 +2061,12 @@ def main():
     sync_res = run_stage("sync", 60, bench_sync)
     if sync_res is not None:
         emit(**sync_res)
+    # budget-skippable: the op-based write front-end (ops/s through the
+    # scatter-fold + wire bytes/op vs the delta-sync equivalent;
+    # parity-gated against the scalar apply loop inside the stage)
+    oplog_res = run_stage("oplog", 45, bench_oplog)
+    if oplog_res is not None:
+        emit(**oplog_res)
     # budget-skippable: the <1% always-on metrics gate (needs e2e_wire's
     # wall time above to have something to be a fraction OF)
     obs_res = run_stage("obs_overhead", 15, bench_obs_overhead)

@@ -40,6 +40,10 @@ from .core import Finding, ParsedFile, ancestors, dotted_name, parents_of, rule
 WIRE_MODULES = (
     "crdt_tpu/sync/",
     "crdt_tpu/cluster/",
+    # the op-frame codec (and the whole op front-end) rides the same
+    # envelope discipline as the sync frames: decode paths must speak
+    # SyncProtocolError/WireFormatError, never bare stdlib errors
+    "crdt_tpu/oplog/",
     # the fleet-observatory snapshot codec rides the same envelope
     # discipline as the sync frames, so its decode paths are held to
     # the same error contract
@@ -71,6 +75,7 @@ _CRDT_ERRORS = {
     "CapacityOverflowError", "ConflictingMarker", "MergeConflict",
     "NestedOpFailed", "TransportError", "SyncTimeoutError",
     "PeerUnavailableError", "TransportClosedError", "TransportFrameError",
+    "OpLogOverflowError", "UnsupportedBackendError",
 }
 
 

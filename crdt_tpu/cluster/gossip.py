@@ -79,12 +79,27 @@ class ClusterNode:
     ``busy_timeout_s`` fails with :class:`~crdt_tpu.error.
     PeerUnavailableError` — bounded, so two nodes dialing each other
     simultaneously degrade to one retried session, not a deadlock.
+
+    **Live writes** enter through :meth:`submit_ops` — the op-based
+    write front-end (:mod:`crdt_tpu.oplog`): any thread may submit an
+    op batch (or a decoded op frame) at any time.  An idle node folds
+    the ops immediately (one jitted scatter); a node mid-session queues
+    them in its op log and folds them the moment the session releases
+    the busy lock — a write can never be lost to a concurrent
+    anti-entropy round, because the fold always happens on the batch
+    the session produced.  Sessions advertise the oplog capability in
+    their hello and piggyback pending op batches to the peer at session
+    close (exactly the fleet-snapshot discipline), so a mid-session
+    write reaches the peer in the SAME session instead of waiting a
+    round; re-delivery through later state sync is idempotent — the
+    CmRDT contract.
     """
 
     def __init__(self, node_id: str, batch, universe, *,
                  full_state_threshold: float = 0.5,
                  busy_timeout_s: float = 10.0,
-                 observatory=None):
+                 observatory=None,
+                 oplog=None):
         self.node_id = node_id
         self.universe = universe
         self.full_state_threshold = full_state_threshold
@@ -95,8 +110,14 @@ class ClusterNode:
         #: telemetry slices spread through the fleet on the gossip the
         #: fleet already does
         self.observatory = observatory
+        #: the write front-end's staging log (:class:`crdt_tpu.oplog.
+        #: OpLog`); pass one to bound/observe it, or leave None — the
+        #: first :meth:`submit_ops` creates a default
+        self._oplog = oplog
+        self._applier = None
         self._lock = threading.Lock()   # guards batch + last_report
         self._busy = threading.Lock()   # serializes whole sessions
+        self._mint = threading.Lock()   # serializes dot minting
         self._batch = batch
         self._last_report: Optional[SyncReport] = None
 
@@ -122,6 +143,144 @@ class ClusterNode:
 
         return np.asarray(digest_mod.digest_of(self.batch), dtype="u8")
 
+    # -- the op-based write front-end ---------------------------------------
+
+    def _ensure_oplog(self):
+        from ..oplog import OpApplier, OpLog
+
+        # benign create race: submit_ops callers may race here, but the
+        # assignment is idempotent (a second OpLog replacing an empty
+        # first drops nothing because append happens after this returns
+        # the FINAL instance read below)
+        if self._oplog is None:
+            self._oplog = OpLog(self.universe)
+        if self._applier is None:
+            self._applier = OpApplier(self.universe)
+        return self._oplog
+
+    def submit_ops(self, ops) -> int:
+        """Ingest live user writes: ``ops`` is an
+        :class:`~crdt_tpu.oplog.OpBatch` or an encoded op frame
+        (:func:`crdt_tpu.oplog.wire.encode_ops_frame` bytes).  Returns
+        how many ops are still pending (0 = folded immediately).
+
+        Never blocks on a running session: ops queue in the op log and
+        fold when the session ends.  Raises
+        :class:`~crdt_tpu.error.OpLogOverflowError` when the log fills
+        faster than sessions drain it (backpressure, not silent drop).
+        """
+        from ..oplog.records import OpBatch
+        from ..oplog.wire import decode_ops_frame
+
+        if isinstance(ops, (bytes, bytearray, memoryview)):
+            ops = decode_ops_frame(
+                bytes(ops), num_actors=self.universe.config.num_actors)
+        if not isinstance(ops, OpBatch):
+            raise TypeError(
+                f"submit_ops wants an OpBatch or an encoded op frame, "
+                f"got {type(ops).__name__}"
+            )
+        log = self._ensure_oplog()
+        log.append(ops)
+        if self._busy.acquire(blocking=False):
+            try:
+                self._drain_ops_locked()
+            finally:
+                self._busy.release()
+        pending = len(log)
+        obs_metrics.registry().gauge_set("oplog.pending", pending)
+        return pending
+
+    def write_clock(self):
+        """The node's WRITE view of the fleet clock (numpy ``[N, A]``):
+        the current batch clock joined with the dot of every op still
+        queued in the log or parked in the applier.  THE safe base for
+        ``derive_add_ctx`` against a live node — deriving from the raw
+        batch clock while earlier writes are still queued (the node was
+        mid-session) would re-mint their counters, and a reused dot
+        violates the one-shot dot contract (`error.rs:9-13`)."""
+        import numpy as np
+
+        from ..oplog.records import OP_ADD, OP_DEC, OP_INC
+
+        with self._lock:
+            batch = self._batch
+        clock = np.array(np.asarray(batch.clock), dtype=np.uint64)
+        pending = []
+        if self._oplog is not None:
+            pending.append(self._oplog.pending())
+        if self._applier is not None and len(self._applier.parked):
+            pending.append(self._applier.parked)
+        for ops in pending:
+            dotted = np.isin(ops.kind, np.asarray(
+                [OP_ADD, OP_INC, OP_DEC], np.uint8))
+            if dotted.any():
+                np.maximum.at(
+                    clock, (ops.obj[dotted], ops.actor[dotted]),
+                    ops.counter[dotted])
+        return clock
+
+    def submit_writes(self, obj, member, *, actor) -> int:
+        """Mint-and-submit in one step: derive fresh dots for these
+        adds against :meth:`write_clock` and :meth:`submit_ops` them —
+        atomically against other minters, so two writer threads can
+        never derive the same dot.  ``actor`` is the writer's dense
+        actor index (scalar or per-write array).  Returns the pending
+        count like :meth:`submit_ops`."""
+        import numpy as np
+
+        from ..oplog.records import derive_add_ctx
+
+        obj = np.asarray(obj, np.int64)
+        actor = np.broadcast_to(np.asarray(actor, np.int32), obj.shape)
+        self._ensure_oplog()
+        with self._mint:
+            ops, _ = derive_add_ctx(self.write_clock(), obj, actor,
+                                    member=member)
+            return self.submit_ops(ops)
+
+    def _drain_ops_locked(self) -> None:
+        """Fold every queued op batch into the fleet — caller holds
+        ``_busy`` (either a fresh acquire in :meth:`submit_ops` or the
+        tail of :meth:`_run_session`, so the fold always sees the batch
+        a concurrent session produced, never a snapshot it replaced)."""
+        log = self._oplog
+        if log is None:
+            return
+        parked = self._applier is not None and len(self._applier.parked)
+        if len(log) == 0 and not parked:
+            return
+        # an empty drain still re-checks the applier's parked ops: the
+        # session that just ended may have synced in exactly the
+        # predecessor dots a parked add was waiting for
+        ops = log.drain()
+        with self._lock:
+            batch = self._batch
+        batch, report = self._applier.apply_ops(batch, ops)
+        with self._lock:
+            self._batch = batch
+        obs_events.record(
+            "oplog.drain", node=self.node_id, ops=report.ops,
+            applied=report.applied, duplicates=report.duplicates,
+            parked=report.still_parked,
+        )
+
+    def _op_outbox(self) -> bytes:
+        """Session piggyback source: everything queued while the
+        session ran (shipped as a COPY — the local drain still folds
+        it; the peer's re-receipt through state sync is idempotent)."""
+        from ..oplog.wire import encode_ops_frame
+
+        return encode_ops_frame(self._oplog.pending())
+
+    def _op_sink(self, frame: bytes) -> None:
+        """Session piggyback sink: peer ops queue like any other write
+        and fold at the session-tail drain."""
+        from ..oplog.wire import decode_ops_frame
+
+        self._ensure_oplog().append(decode_ops_frame(
+            bytes(frame), num_actors=self.universe.config.num_actors))
+
     def _run_session(self, peer_label: str, transport: Transport
                      ) -> SyncReport:
         if not self._busy.acquire(timeout=self.busy_timeout_s):
@@ -131,10 +290,16 @@ class ClusterNode:
                 f"{peer_label}"
             )
         try:
+            op_hooks = {}
+            if self._oplog is not None:
+                self._ensure_oplog()
+                op_hooks = {"op_outbox": self._op_outbox,
+                            "op_sink": self._op_sink}
             session = SyncSession(
                 self.batch, self.universe, peer=peer_label,
                 full_state_threshold=self.full_state_threshold,
                 observatory=self.observatory,
+                **op_hooks,
             )
             report = session.sync(transport)
             with self._lock:
@@ -142,7 +307,12 @@ class ClusterNode:
                 self._last_report = report
             return report
         finally:
-            self._busy.release()
+            try:
+                # fold writes queued while the session ran — BEFORE the
+                # busy release, so the next session's snapshot sees them
+                self._drain_ops_locked()
+            finally:
+                self._busy.release()
 
     def sync_with(self, peer_id: str, transport: Transport) -> SyncReport:
         """Run the initiator leg of one session against ``peer_id``."""

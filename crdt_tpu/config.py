@@ -53,6 +53,39 @@ def x64_disabled():
         return disable_x64()
 
 
+def pallas_mosaic_skew():
+    """Reason string when the installed jax cannot run the interpret-mode
+    Pallas ORSWOT kernels, else ``None`` — the ONE home for the
+    "jax 0.4.x Pallas skew" version gate (ROADMAP carried item).
+
+    Under jax 0.4.x (observed on 0.4.37), i64 scalars lowering into the
+    interpret-mode kernels recurse forever in Mosaic's int64→int32
+    truncation helper; the kernel entry points
+    (:func:`crdt_tpu.ops.orswot_pallas.merge` / ``fold_merge`` and
+    :func:`crdt_tpu.ops.orswot_fold_aligned.fold_merge`) call this and
+    raise a typed :class:`crdt_tpu.error.UnsupportedBackendError` at
+    the API boundary instead of failing deep in the compiler.  The test
+    harness xfail gate (``tests/conftest.py``) keys off the SAME
+    predicate, so the two can never drift.
+    """
+    import jax
+
+    try:
+        major, minor = (int(p) for p in jax.__version__.split(".")[:2])
+    except ValueError:
+        return None
+    if (major, minor) >= (0, 5):
+        return None
+    return (
+        f"jax {jax.__version__} cannot run the interpret-mode Pallas "
+        "ORSWOT kernels: i64 scalars lowering into interpret mode "
+        "recurse in Mosaic's int64->int32 truncation (the 0.4.37 skew; "
+        "ROADMAP 'jax 0.4.x Pallas skew').  Remediation: upgrade to "
+        "jax>=0.5, run on a real TPU backend (interpret=False), or use "
+        "the portable jnp path (crdt_tpu.ops.orswot_ops)"
+    )
+
+
 def counter_dtype(config=None):
     """The dtype used for dense counters.
 

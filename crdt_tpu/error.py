@@ -104,6 +104,35 @@ class WireFormatError(CrdtError, ValueError):
     """
 
 
+class OpLogOverflowError(CrdtError):
+    """A bounded op-log structure ran out of room: the append-only
+    columnar log (:class:`crdt_tpu.oplog.OpLog`) hit its capacity, or
+    the causal-gap parking buffer (:class:`crdt_tpu.oplog.OpApplier`)
+    filled with ops whose causal predecessors never arrived.
+
+    No reference counterpart — the reference applies one op at a time
+    and delegates delivery (`traits.rs:15-41`); bounding the batched
+    front-end is this build's backpressure story.  Deliberately NOT a
+    ``ValueError``: a full log means the caller must drain (apply) or
+    shed load, not that the op itself was malformed.
+    """
+
+
+class UnsupportedBackendError(CrdtError, RuntimeError):
+    """A kernel cannot run on this backend/toolchain combination.
+
+    Raised by the version gates in front of the Mosaic kernels
+    (:mod:`crdt_tpu.ops.orswot_pallas`,
+    :mod:`crdt_tpu.ops.orswot_fold_aligned`) when the installed jax
+    would fail deep inside the compiler instead of at the API boundary
+    — e.g. the jax 0.4.x interpret-mode i64 lowering skew (ROADMAP
+    "jax 0.4.x Pallas skew").  The message always names the remediation
+    (upgrade jax, or use the portable jnp path).  Subclasses
+    ``RuntimeError`` so generic "kernel unavailable" handlers keep
+    working.
+    """
+
+
 class NestedOpFailed(CrdtError):
     """We failed to apply a nested op to a nested CRDT (`error.rs:16-17`)."""
 

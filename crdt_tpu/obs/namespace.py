@@ -152,6 +152,31 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "last gossip round's health (attempted/ok/failed/"
              "skipped_busy) + fleet convergence view (fleet_divergence_"
              "max, eta_rounds — peers still diverged over the fanout)"),
+    # -- op-based write front-end (oplog/, cluster/gossip.py,
+    # sync/session.py, batch/wireloop.py) ------------------------------------
+    NameSpec("oplog.submitted", "counter",
+             "ops appended to an op log (writers, wire frames, session "
+             "piggybacks)"),
+    NameSpec("oplog.pending", "gauge",
+             "ops queued in the node's op log awaiting the fold"),
+    NameSpec("oplog.parked", "gauge",
+             "adds parked on a causal gap (missing predecessor dots)"),
+    NameSpec("oplog.apply.*", "counter",
+             "apply_ops outcomes (ops/applied/duplicates/parked/"
+             "released/rm_rounds)"),
+    NameSpec("oplog.apply_ops", "histogram",
+             "one scatter-fold apply call (span)"),
+    NameSpec("oplog.exchange", "histogram",
+             "session op-piggyback wall time (span)"),
+    NameSpec("oplog.frames.decoded", "counter", "accepted op frames"),
+    NameSpec("oplog.frames.rejected.*", "counter",
+             "rejected op frames by reason (truncated/version_mismatch/"
+             "crc_mismatch/bad_kind/...)"),
+    NameSpec("wire.oplog.*.ops", "counter",
+             "ops moved through the op-frame codec per direction "
+             "(encode/decode)"),
+    NameSpec("wire.oplog.*.bytes", "counter",
+             "op-frame bytes per direction (encode/decode)"),
     # -- fleet observatory (obs/fleet.py, obs/export.py) ---------------------
     NameSpec("obs.events.dropped", "gauge",
              "flight-recorder events evicted by the ring bound "

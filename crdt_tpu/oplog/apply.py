@@ -1,0 +1,504 @@
+"""Batched ``CmRDT::apply`` — fold op batches into the dense planes.
+
+The scalar op path applies ONE op to ONE object
+(`/root/reference/src/orswot.rs:60-83`; ported as
+``OrswotBatch.apply_add/apply_remove``, one op per object across the
+batch).  The write front-end needs the transpose: **thousands of
+concurrent user ops, many per object, folded into the fleet in one
+jitted step**.  This module does that with scatter-fold kernels:
+
+* **Adds** become a COO delta — every ready ``(obj, member, actor,
+  counter)`` dot scattered into a delta fleet
+  (:meth:`~crdt_tpu.batch.orswot_batch.OrswotBatch.from_coo`, which
+  max-joins duplicate dots: in-batch re-delivery is already idempotent
+  at the scatter) — and ONE batched lattice merge folds the delta in.
+  Merging an already-witnessed dot is a no-op and a dot the local
+  clock dominates cannot resurrect a removed member (the add-wins
+  algebra, `orswot.rs:89-156`), which is exactly the scalar ``apply``
+  dedup rule (`orswot.rs:71-73`): re-delivery is a no-op — the CmRDT
+  contract.
+* **Removes** replay through the existing ``apply_remove`` kernel
+  (deferral + dedup + dot subtraction, `orswot.rs:195-211`), segment-
+  sorted by object row and round-scheduled so each jitted call carries
+  at most one remove per object; idle rows ride a no-op sentinel.
+* **Causal gaps** park: an add whose dot counter jumps ahead of the
+  local clock (`AddCtx.clock`'s novel part dominating the local view —
+  the causal-delivery precondition of `ctx.rs:12-21`) is buffered, and
+  released the moment the missing dots land.  The buffer is bounded
+  (:class:`~crdt_tpu.error.OpLogOverflowError` — a peer that never
+  closes its gaps must not grow memory forever).
+
+Counter and LWW planes get their own scatter kernels
+(:func:`apply_gcounter_ops` / :func:`apply_pncounter_ops` /
+:func:`apply_lww_ops`): pure scatter-max folds, no causal buffering —
+counter dots are cumulative per-actor totals (`gcounter.rs:26-28`: a
+GCounter IS a VClock) and LWW is marker-ordered, so both are
+gap-tolerant by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..error import ConflictingMarker, OpLogOverflowError
+from ..utils import tracing
+from .records import NO_MEMBER, OP_ADD, OP_DEC, OP_INC, OP_RM, OP_SET, OpBatch
+
+#: member-id sentinel for the remove kernel's idle rows: matches no
+#: member slot (live ids are >= 0, empty slots are EMPTY = -1) and no
+#: deferred row, and rides a zero clock (never "ahead"), so an idle row
+#: is a provable no-op through apply_remove
+_RM_IDLE = -2
+
+
+def _next_pow2(c: int) -> int:
+    return 1 if c <= 0 else 1 << (c - 1).bit_length()
+
+
+def _pad(x, k, fill=0):
+    x = np.asarray(x)
+    if x.shape[0] >= k:
+        return x
+    return np.concatenate([x, np.full(k - x.shape[0], fill, x.dtype)])
+
+
+_scatter_adds = None
+
+
+def _scatter_adds_kernel():
+    """The jitted add scatter-fold, built once: counter max-scatters
+    into the set clock and the planned member-dot slots (scatter-``max``
+    is the dot-witness rule AND the in-batch duplicate dedup in one op),
+    new member ids land via ``max`` over the ``EMPTY`` fill, and one
+    deferred replay finishes the op exactly like the scalar ``apply``
+    (`orswot.rs:78` → ``apply_deferred``; a freshly witnessed dot can
+    close the gap a buffered remove was waiting on).  Padded rows are
+    scatter-neutral (counter 0 / member ``EMPTY``), so the jit cache
+    keys on power-of-two batch sizes only."""
+    global _scatter_adds
+    if _scatter_adds is None:
+        import jax
+
+        from ..ops.orswot_ops import _apply_deferred
+
+        def kernel(clock, ids, dots, d_ids, d_clocks,
+                   oo, oa, oc, oslot, po, pslot, pm, replay):
+            new_clock = clock.at[oo, oa].max(oc)
+            new_ids = ids.at[po, pslot].max(pm)
+            new_dots = dots.at[oo, oslot, oa].max(oc)
+            if not replay:
+                # deferred-free fleet: the replay is a provable no-op —
+                # skip its member×deferred cross product (the same
+                # dispatch economy the merge kernel's lax.cond buys)
+                return new_clock, new_ids, new_dots, d_ids, d_clocks
+            i2, d2, di2, dc2 = _apply_deferred(
+                new_clock, new_ids, new_dots, d_ids, d_clocks)
+            return new_clock, i2, d2, di2, dc2
+
+        _scatter_adds = jax.jit(
+            kernel, static_argnames=("replay",))
+    return _scatter_adds
+
+
+@dataclasses.dataclass
+class ApplyReport:
+    """What one ``apply_ops`` call did with its batch."""
+
+    ops: int = 0               # ops handed in (incoming + released parks)
+    applied_adds: int = 0
+    applied_rms: int = 0
+    duplicates: int = 0        # adds the local clock already witnessed
+    parked: int = 0            # adds newly parked on a causal gap
+    released: int = 0          # previously parked adds applied this call
+    still_parked: int = 0      # park-buffer depth after this call
+    rm_rounds: int = 0         # jitted remove rounds (max removes/object)
+    merge_steps: int = 0       # jitted scatter-fold merges (1 per call
+    #                            when nothing parks)
+
+    @property
+    def applied(self) -> int:
+        return self.applied_adds + self.applied_rms
+
+
+class OpApplier:
+    """Fold :class:`OpBatch`\\ es into one ORSWOT fleet, with causal-gap
+    parking.
+
+    One instance owns the park buffer for one fleet; reuse it across
+    calls so gapped ops survive until their predecessors arrive.
+    ``park_capacity`` bounds the buffer —
+    :class:`~crdt_tpu.error.OpLogOverflowError` on overflow.
+    """
+
+    def __init__(self, universe, park_capacity: int = 1 << 16):
+        if park_capacity < 1:
+            raise ValueError(f"park_capacity {park_capacity} < 1")
+        self.universe = universe
+        self.park_capacity = park_capacity
+        self._parked: OpBatch = OpBatch.empty()
+
+    @property
+    def parked(self) -> OpBatch:
+        """The currently parked (causally gapped) adds."""
+        return self._parked
+
+    # -- the readiness partition --------------------------------------------
+
+    @staticmethod
+    def _partition_adds(clock_host: np.ndarray, ops: OpBatch
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """``(ready, dup, gap)`` boolean masks over an all-adds batch.
+
+        An add is a **duplicate** when the local clock already witnessed
+        its dot (`orswot.rs:71-73`); **ready** when its counter extends
+        the actor's dot run contiguously — counting the batch's own
+        earlier dots on the same ``(obj, actor)``, so a whole burst of
+        writes applies in one pass; **gapped** otherwise (a causal
+        predecessor is missing — park it).
+
+        The contiguity argument: within one ``(obj, actor)`` group the
+        distinct pending counters sorted ascending are ``u_0 < u_1 <
+        ...``; ``u_i`` is ready iff ``u_i == base + i + 1`` where ``i``
+        counts distinct pending dots below it — and because the ``u``
+        are strictly increasing integers, that equality forces every
+        lower ``u_j`` onto ``base + j + 1`` too, so readiness needs no
+        sequential chain walk.
+        """
+        b = len(ops)
+        base = clock_host[ops.obj, ops.actor].astype(np.uint64)
+        key = ops.obj * np.int64(clock_host.shape[1] + 1) \
+            + ops.actor.astype(np.int64)
+        order = np.lexsort((ops.counter, key))
+        sk = key[order]
+        sc = ops.counter[order]
+        sb = base[order]
+        new_group = np.ones(b, bool)
+        new_group[1:] = sk[1:] != sk[:-1]
+        group_start = np.nonzero(new_group)[0]
+        start_of = group_start[np.cumsum(new_group) - 1]
+        # duplicates: dot already witnessed locally, or an identical dot
+        # earlier in this very batch (equal counters sort adjacent)
+        dup_sorted = sc <= sb
+        same_as_prev = np.zeros(b, bool)
+        same_as_prev[1:] = (~new_group[1:]) & (sc[1:] == sc[:-1])
+        dup_sorted |= same_as_prev
+        # rank among distinct not-yet-witnessed dots within the group
+        nd = (~dup_sorted).astype(np.int64)
+        cnd = np.cumsum(nd)
+        prior = cnd - nd - (cnd[start_of] - nd[start_of])
+        ready_sorted = ~dup_sorted & (
+            sc == sb + (prior + 1).astype(np.uint64))
+        ready = np.zeros(b, bool)
+        dup = np.zeros(b, bool)
+        ready[order] = ready_sorted
+        dup[order] = dup_sorted
+        return ready, dup, ~ready & ~dup
+
+    # -- the fold kernels ----------------------------------------------------
+
+    def _plan_slots(self, batch, ops: OpBatch):
+        """Host-side member-slot planning for a ready-add batch: resolve
+        every unique ``(obj, member)`` pair to its existing slot, or
+        assign distinct free slots (in ascending member-id order per
+        object — the canonical order the merge paths produce) to pairs
+        the table has not seen.  Vectorized numpy — no per-op Python.
+
+        Returns ``(op_slot int[B], pair_obj, pair_slot, pair_member)``;
+        raises :class:`~crdt_tpu.error.CapacityOverflowError` when an
+        object's new members outgrow its free slots.
+        """
+        from ..error import CapacityOverflowError
+        from ..ops.orswot_ops import EMPTY
+
+        ids_host = np.asarray(batch.ids)
+        m = ids_host.shape[1]
+        pair_key = ops.obj * np.int64(1 << 32) + ops.member.astype(np.int64)
+        uniq, inv = np.unique(pair_key, return_inverse=True)
+        uo = (uniq >> 32).astype(np.int64)
+        um = (uniq & ((1 << 32) - 1)).astype(np.int32)
+        rows = ids_host[uo]                       # [P, M]
+        hit = rows == um[:, None]
+        have = hit.any(axis=1)
+        slot = np.where(have, hit.argmax(axis=1), -1).astype(np.int64)
+        miss = ~have
+        if miss.any():
+            mo = uo[miss]
+            # distinct objects among the misses; k-th NEW member of an
+            # object (pairs sort ascending by member id inside np.unique)
+            # takes the object's k-th free slot
+            oq, o_inv = np.unique(mo, return_inverse=True)
+            rank = np.arange(mo.shape[0]) - np.searchsorted(mo, mo)
+            free = ids_host[oq] == EMPTY          # [Q, M]
+            n_free = free.sum(axis=1)
+            if bool((rank >= n_free[o_inv]).any()):
+                raise CapacityOverflowError(
+                    "Orswot capacity overflow in apply_ops: new members "
+                    "exceed free slots — raise member_capacity",
+                    member=True, deferred=False,
+                )
+            # stable argsort of ~free lists free slot indices first
+            free_order = np.argsort(~free, axis=1, kind="stable")
+            slot[np.nonzero(miss)[0]] = free_order[o_inv, rank]
+        return slot[inv], uo, slot, um
+
+    def _fold_adds(self, batch, ops: OpBatch, check: bool):
+        """ONE jitted scatter-fold: every ready dot max-scatters into
+        the clock and member-dot planes (new members take planned free
+        slots), then one deferred replay matches the scalar ``apply``
+        tail (`orswot.rs:78`, ``apply_deferred``).  Scatter-max makes
+        in-batch duplicate dots idempotent at the kernel itself."""
+        import jax.numpy as jnp
+
+        from ..ops.orswot_ops import EMPTY
+
+        op_slot, po, pslot, pm = self._plan_slots(batch, ops)
+        dt = np.asarray(batch.clock).dtype
+        kb = _next_pow2(len(ops))
+        kp = _next_pow2(po.shape[0])
+        # a fleet with no buffered removes makes the deferred replay a
+        # no-op; the check is one cheap pass over the [N, D] id plane
+        replay = bool((np.asarray(batch.d_ids) != EMPTY).any())
+        planes = _scatter_adds_kernel()(
+            batch.clock, batch.ids, batch.dots, batch.d_ids,
+            batch.d_clocks,
+            jnp.asarray(_pad(ops.obj, kb)),
+            jnp.asarray(_pad(ops.actor, kb)),
+            jnp.asarray(_pad(ops.counter.astype(dt), kb)),
+            jnp.asarray(_pad(op_slot, kb)),
+            jnp.asarray(_pad(po, kp)),
+            jnp.asarray(_pad(pslot, kp)),
+            jnp.asarray(_pad(pm.astype(np.int32), kp, fill=EMPTY)),
+            replay=replay,
+        )
+        return type(batch)(*planes)
+
+    def _fold_removes(self, batch, ops: OpBatch, check: bool,
+                      report: ApplyReport):
+        """Round-scheduled ``apply_remove``: segment-sort by object so
+        round ``k`` carries each object's k-th remove; idle objects
+        ride the :data:`_RM_IDLE` no-op sentinel."""
+        import jax.numpy as jnp
+
+        n = batch.clock.shape[0]
+        a = batch.clock.shape[1]
+        order = np.lexsort((np.arange(len(ops)), ops.obj))
+        so = ops.obj[order]
+        rounds = np.zeros(len(ops), np.int64)
+        new_obj = np.ones(len(ops), bool)
+        new_obj[1:] = so[1:] != so[:-1]
+        start = np.nonzero(new_obj)[0]
+        rounds = np.arange(len(ops)) - start[np.cumsum(new_obj) - 1]
+        clocks = (ops.rm_clocks if ops.rm_clocks is not None
+                  else np.zeros((len(ops), a), np.uint64))
+        dt = np.asarray(batch.clock).dtype
+        for k in range(int(rounds.max(initial=-1)) + 1):
+            sel = order[rounds == k]
+            member = np.full(n, _RM_IDLE, np.int32)
+            rm_clock = np.zeros((n, a), dt)
+            member[ops.obj[sel]] = ops.member[sel]
+            rm_clock[ops.obj[sel]] = clocks[sel].astype(dt)
+            batch = batch.apply_remove(
+                jnp.asarray(rm_clock), jnp.asarray(member), check=check)
+            report.rm_rounds += 1
+        return batch
+
+    # -- the entry point -----------------------------------------------------
+
+    def apply_ops(self, batch, ops: OpBatch, check: bool = True):
+        """``(folded_batch, report)``: fold ``ops`` (plus any previously
+        parked adds whose gaps have closed) into ``batch``.
+
+        Raises :class:`~crdt_tpu.error.CapacityOverflowError` when a
+        fold outgrows the padded capacities (regrow and retry, as any
+        merge path) and :class:`~crdt_tpu.error.OpLogOverflowError`
+        when the park buffer fills.  Re-delivering any prefix, suffix
+        or permutation of an already-applied batch is a no-op — the
+        CmRDT idempotence/commutativity contract, pinned by
+        ``tests/test_oplog.py``.
+        """
+        report = ApplyReport()
+        with tracing.span("oplog.apply_ops"):
+            parked, self._parked = self._parked, OpBatch.empty()
+            ops = OpBatch.concat([parked, ops])
+            report.ops = len(ops)
+            if len(ops) == 0:
+                return batch, report
+            is_add = ops.kind == OP_ADD
+            is_rm = ops.kind == OP_RM
+            if not bool((is_add | is_rm).all()):
+                raise ValueError(
+                    "OpApplier folds ORSWOT add/rm ops; counter/lww ops "
+                    "have their own planes (apply_gcounter_ops / "
+                    "apply_pncounter_ops / apply_lww_ops)"
+                )
+            ops.validate(batch.clock.shape[0],
+                         self.universe.config.num_actors)
+
+            adds = ops.select(is_add)
+            clock_host = np.asarray(batch.clock)
+            ready, dup, gap = self._partition_adds(clock_host, adds)
+            report.duplicates = int(dup.sum())
+            # the parked batch was concatenated FIRST and holds adds
+            # only, so the first len(parked) rows of `adds` are exactly
+            # the previously parked ops: released = those that left the
+            # gap set, parked = fresh arrivals that entered it
+            n_parked_in = len(parked)
+            report.released = n_parked_in - int(gap[:n_parked_in].sum())
+            report.parked = int(gap[n_parked_in:].sum())
+            if bool(gap.any()):
+                gapped = adds.select(gap)
+                if len(gapped) > self.park_capacity:
+                    raise OpLogOverflowError(
+                        f"causal-gap buffer full: {len(gapped)} gapped "
+                        f"adds > park_capacity {self.park_capacity} — "
+                        "the missing predecessor dots never arrived"
+                    )
+                self._parked = gapped
+            report.still_parked = len(self._parked)
+
+            if bool(ready.any()):
+                ready_ops = adds.select(ready)
+                batch = self._fold_adds(batch, ready_ops, check)
+                report.merge_steps += 1
+                report.applied_adds = len(ready_ops)
+
+            if bool(is_rm.any()):
+                rms = ops.select(is_rm)
+                batch = self._fold_removes(batch, rms, check, report)
+                report.applied_rms = len(rms)
+
+        tracing.count("oplog.apply.ops", report.ops)
+        tracing.count("oplog.apply.applied", report.applied)
+        tracing.count("oplog.apply.duplicates", report.duplicates)
+        tracing.count("oplog.apply.parked", report.parked)
+        tracing.count("oplog.apply.released", report.released)
+        tracing.count("oplog.apply.rm_rounds", report.rm_rounds)
+        from ..obs import metrics as obs_metrics
+
+        obs_metrics.registry().gauge_set("oplog.parked",
+                                         report.still_parked)
+        return batch, report
+
+
+# ---------------------------------------------------------------------------
+# counter / LWW scatter folds
+# ---------------------------------------------------------------------------
+
+
+_counter_scatter_jit = None
+_pn_scatter_jit = None
+
+
+def _counter_scatter(clocks, obj, actor, counter):
+    return clocks.at[obj, actor].max(counter.astype(clocks.dtype))
+
+
+def _pn_scatter(planes, obj, plane, actor, counter):
+    return planes.at[obj, plane, actor].max(counter.astype(planes.dtype))
+
+
+def apply_gcounter_ops(batch, ops: OpBatch):
+    """Fold ``inc`` dots into a :class:`~crdt_tpu.batch.gcounter_batch.
+    GCounterBatch` — one jitted scatter-max (`gcounter.rs:71-73`: the
+    op IS a dot, the apply IS a witness; a dot carries the actor's
+    cumulative total, so out-of-order and duplicated delivery are both
+    absorbed by ``max``)."""
+    import jax
+    import jax.numpy as jnp
+
+    global _counter_scatter_jit
+    if bool((ops.kind != OP_INC).any()):
+        raise ValueError("apply_gcounter_ops folds inc ops only "
+                         "(a GCounter cannot decrement, gcounter.rs:14)")
+    if len(ops) == 0:
+        return batch
+    if _counter_scatter_jit is None:
+        _counter_scatter_jit = jax.jit(_counter_scatter)
+    clocks = _counter_scatter_jit(
+        batch.clocks, jnp.asarray(ops.obj), jnp.asarray(ops.actor),
+        jnp.asarray(ops.counter))
+    return type(batch)(clocks=clocks)
+
+
+def apply_pncounter_ops(batch, ops: OpBatch):
+    """Fold ``inc``/``dec`` dots into a :class:`~crdt_tpu.batch.
+    pncounter_batch.PNCounterBatch` — the kind column picks the P or N
+    plane (`pncounter.rs:65-78`), one jitted scatter-max."""
+    import jax
+    import jax.numpy as jnp
+
+    global _pn_scatter_jit
+    ok = np.isin(ops.kind, np.asarray([OP_INC, OP_DEC], np.uint8))
+    if not bool(ok.all()):
+        raise ValueError("apply_pncounter_ops folds inc/dec ops only")
+    if len(ops) == 0:
+        return batch
+    if _pn_scatter_jit is None:
+        _pn_scatter_jit = jax.jit(_pn_scatter)
+    plane = (ops.kind == OP_DEC).astype(np.int32)
+    planes = _pn_scatter_jit(
+        batch.planes, jnp.asarray(ops.obj), jnp.asarray(plane),
+        jnp.asarray(ops.actor), jnp.asarray(ops.counter))
+    return type(batch)(planes=planes)
+
+
+def apply_lww_ops(batch, ops: OpBatch, check: bool = True):
+    """Fold LWW writes — ``(marker, payload-id)`` pairs in the
+    ``(counter, member)`` columns — into a :class:`~crdt_tpu.batch.
+    lwwreg_batch.LWWRegBatch`.
+
+    Per register the highest marker wins (`lwwreg.rs:56-66`); an exact
+    re-delivery is a no-op.  Equal markers with DIFFERENT values — in
+    the batch or against the register — surface as
+    :class:`~crdt_tpu.error.ConflictingMarker` when ``check`` (the
+    reference's ``update`` contract, `lwwreg.rs:104-118`); with
+    ``check=False`` returns ``(batch, conflict_bitmap)`` instead.
+    """
+    import jax.numpy as jnp
+
+    if bool((ops.kind != OP_SET).any()):
+        raise ValueError("apply_lww_ops folds set ops only")
+    n = batch.vals.shape[0]
+    if len(ops) == 0:
+        return batch if check else (batch, np.zeros(n, bool))
+    # per-object winner: lexicographic (marker, val) max — a total
+    # order, so the pick is delivery-order independent; the val
+    # tiebreak only matters for detecting the equal-marker conflict
+    order = np.lexsort((ops.member, ops.counter, ops.obj))
+    so, sm, sv = ops.obj[order], ops.counter[order], ops.member[order]
+    last = np.ones(len(ops), bool)
+    last[:-1] = so[:-1] != so[1:]
+    # in-batch conflict: same object, same marker, different value
+    clash = np.zeros(len(ops), bool)
+    clash[:-1] = (so[:-1] == so[1:]) & (sm[:-1] == sm[1:]) \
+        & (sv[:-1] != sv[1:])
+    in_batch_conflict = np.zeros(n, bool)
+    in_batch_conflict[so[clash]] = True
+    w_obj, w_marker, w_val = so[last], sm[last], sv[last]
+
+    vals = np.asarray(batch.vals)
+    markers = np.asarray(batch.markers)
+    cur_m = markers[w_obj]
+    cur_v = vals[w_obj]
+    newer = w_marker > cur_m
+    conflict_rows = (w_marker == cur_m) & (
+        w_val.astype(vals.dtype) != cur_v)
+    conflict = in_batch_conflict.copy()
+    conflict[w_obj[conflict_rows]] = True
+    if check and bool(conflict.any()):
+        idx = np.nonzero(conflict)[0]
+        raise ConflictingMarker(
+            f"{idx.shape[0]} conflicting marker(s) in op fold, "
+            f"first at {int(idx[0])}"
+        )
+    take = w_obj[newer]
+    out = type(batch)(
+        vals=batch.vals.at[take].set(
+            jnp.asarray(w_val[newer].astype(vals.dtype))),
+        markers=batch.markers.at[take].set(
+            jnp.asarray(w_marker[newer].astype(markers.dtype))),
+    )
+    return out if check else (out, conflict)

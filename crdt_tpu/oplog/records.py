@@ -1,0 +1,445 @@
+"""Columnar op records — the op-based (CmRDT) write front-end's store.
+
+Everything shipped before this package moves **state**: wire blobs,
+digest-driven deltas, gossip rounds.  The reference crate's second
+replication model — ``CmRDT::apply(&mut self, &Op)`` with causal
+contexts (`/root/reference/src/traits.rs:15-41`, `ctx.rs:26-53`) —
+ships **operations**: a user write is a few dozen bytes (a dot, an
+object, a member), not a 2 GB fleet.  This module is the columnar form
+of that model:
+
+* :class:`OpBatch` — a struct-of-arrays batch of operations:
+  ``(kind, obj, actor, counter, member)`` planes plus a dense
+  ``rm_clocks`` plane carried only when the batch holds removes
+  (``Op::Rm`` ships a full witnessing clock, `orswot.rs:80-83`;
+  ``Op::Add`` ships only its dot, `orswot.rs:66-79` — the AddCtx clock
+  never travels).
+* :class:`OpLog` — a bounded append-only log of batches with a
+  per-actor dot high-watermark, the staging area between ``submit``
+  (any thread) and ``apply`` (the fold step).
+* :func:`derive_add_ctx` — the batched, jit-able form of the scalar
+  clone-and-increment (`ctx.rs:45-53`, ported in
+  :func:`crdt_tpu.scalar.ctx.ReadCtx.derive_add_ctx`): given ``A``
+  actors and ``B`` pending writes it assigns every write its dot
+  counter and AddCtx clock in ONE kernel, matching the scalar loop
+  dot-for-dot (pinned by ``tests/test_oplog.py``).
+* :func:`derive_rm_ctx` — the batched ``derive_rm_ctx``
+  (`ctx.rs:56-60`): gather each object's current clock as the remove's
+  witnessing clock.
+* :func:`intern_ops` — batch interning of arbitrary actor/member (and
+  optionally object) names through the existing registries
+  (:mod:`crdt_tpu.utils.interning`), so string-keyed writers feed the
+  dense pipeline without per-op Python in the hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..error import OpLogOverflowError
+
+#: operation kinds (the ``Op`` enum across the plane families):
+#: ORSWOT add/remove (`orswot.rs:60-83`), G/PN-counter increment and
+#: decrement (`gcounter.rs:71-73`, `pncounter.rs:65-78`), LWW write
+#: (`lwwreg.rs:104-118`).
+OP_ADD = 0
+OP_RM = 1
+OP_INC = 2
+OP_DEC = 3
+OP_SET = 4
+
+OP_KINDS = (OP_ADD, OP_RM, OP_INC, OP_DEC, OP_SET)
+OP_NAMES = {OP_ADD: "add", OP_RM: "rm", OP_INC: "inc", OP_DEC: "dec",
+            OP_SET: "set"}
+
+#: ``member`` value for ops that carry none (counter increments)
+NO_MEMBER = -1
+
+
+def _col(x, dtype):
+    return np.ascontiguousarray(np.asarray(x), dtype=dtype)
+
+
+@dataclasses.dataclass
+class OpBatch:
+    """A struct-of-arrays batch of ``B`` operations.
+
+    Columns (all length ``B``): ``kind`` (uint8, one of
+    :data:`OP_KINDS`), ``obj`` (int64 fleet row), ``actor`` (int32
+    dense actor index), ``counter`` (uint64 dot counter for
+    add/inc/dec, LWW marker for set), ``member`` (int32 member id for
+    add/rm, payload id for set, :data:`NO_MEMBER` otherwise).
+
+    ``rm_clocks`` is an optional dense ``uint64[B, A]`` plane: row
+    ``b`` is the witnessing clock of a remove (zeros on non-remove
+    rows).  ``None`` means "no remove in this batch carries a clock" —
+    the common all-adds case costs no ``[B, A]`` memory.
+    """
+
+    kind: np.ndarray
+    obj: np.ndarray
+    actor: np.ndarray
+    counter: np.ndarray
+    member: np.ndarray
+    rm_clocks: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        self.kind = _col(self.kind, np.uint8)
+        self.obj = _col(self.obj, np.int64)
+        self.actor = _col(self.actor, np.int32)
+        self.counter = _col(self.counter, np.uint64)
+        self.member = _col(self.member, np.int32)
+        b = self.kind.shape[0]
+        for name in ("obj", "actor", "counter", "member"):
+            if getattr(self, name).shape != (b,):
+                raise ValueError(
+                    f"OpBatch column {name!r} has shape "
+                    f"{getattr(self, name).shape}, expected ({b},)"
+                )
+        if self.rm_clocks is not None:
+            self.rm_clocks = _col(self.rm_clocks, np.uint64)
+            if self.rm_clocks.ndim != 2 or self.rm_clocks.shape[0] != b:
+                raise ValueError(
+                    f"OpBatch.rm_clocks has shape {self.rm_clocks.shape}, "
+                    f"expected ({b}, A)"
+                )
+        if b and not np.isin(self.kind, np.asarray(OP_KINDS, np.uint8)).all():
+            bad = int(self.kind[~np.isin(
+                self.kind, np.asarray(OP_KINDS, np.uint8))][0])
+            raise ValueError(f"OpBatch holds unknown op kind {bad}")
+
+    def __len__(self) -> int:
+        return int(self.kind.shape[0])
+
+    @classmethod
+    def empty(cls, num_actors: int = 0) -> "OpBatch":
+        return cls(
+            kind=np.zeros(0, np.uint8), obj=np.zeros(0, np.int64),
+            actor=np.zeros(0, np.int32), counter=np.zeros(0, np.uint64),
+            member=np.zeros(0, np.int32),
+            rm_clocks=None,
+        )
+
+    def select(self, mask) -> "OpBatch":
+        """The sub-batch at ``mask`` (bool[B] or index array), clocks
+        sliced along."""
+        mask = np.asarray(mask)
+        return OpBatch(
+            kind=self.kind[mask], obj=self.obj[mask],
+            actor=self.actor[mask], counter=self.counter[mask],
+            member=self.member[mask],
+            rm_clocks=None if self.rm_clocks is None
+            else self.rm_clocks[mask],
+        )
+
+    @classmethod
+    def concat(cls, batches: Sequence["OpBatch"]) -> "OpBatch":
+        batches = [b for b in batches if len(b)]
+        if not batches:
+            return cls.empty()
+        if len(batches) == 1:
+            return batches[0]
+        widths = {b.rm_clocks.shape[1] for b in batches
+                  if b.rm_clocks is not None}
+        if len(widths) > 1:
+            raise ValueError(
+                f"cannot concat OpBatches with mixed actor widths {widths}"
+            )
+        clocks = None
+        if widths:
+            (a,) = widths
+            clocks = np.concatenate([
+                b.rm_clocks if b.rm_clocks is not None
+                else np.zeros((len(b), a), np.uint64)
+                for b in batches
+            ])
+        return cls(
+            kind=np.concatenate([b.kind for b in batches]),
+            obj=np.concatenate([b.obj for b in batches]),
+            actor=np.concatenate([b.actor for b in batches]),
+            counter=np.concatenate([b.counter for b in batches]),
+            member=np.concatenate([b.member for b in batches]),
+            rm_clocks=clocks,
+        )
+
+    def validate(self, n_objects: int, num_actors: int) -> None:
+        """Raise ``ValueError`` when any column violates the fleet's
+        bounds — the local-construction twin of the wire codec's
+        grammar checks (decoded frames arrive pre-validated)."""
+        if not len(self):
+            return
+        if self.obj.min() < 0 or self.obj.max() >= n_objects:
+            raise ValueError(
+                f"op object row outside fleet [0, {n_objects}): "
+                f"[{int(self.obj.min())}, {int(self.obj.max())}]"
+            )
+        if self.actor.min() < 0 or self.actor.max() >= num_actors:
+            raise ValueError(
+                f"op actor index outside universe [0, {num_actors}): "
+                f"[{int(self.actor.min())}, {int(self.actor.max())}]"
+            )
+        needs_member = np.isin(self.kind, np.asarray(
+            [OP_ADD, OP_RM, OP_SET], np.uint8))
+        if bool((self.member[needs_member] < 0).any()):
+            raise ValueError(
+                "add/rm/set op carries a negative member id "
+                "(the EMPTY sentinel leaking from an export?)"
+            )
+        dotted = np.isin(self.kind, np.asarray(
+            [OP_ADD, OP_INC, OP_DEC], np.uint8))
+        if bool((self.counter[dotted] == 0).any()):
+            raise ValueError(
+                "dot counter 0 in an add/inc/dec op (dots start at 1 — "
+                "vclock.rs:206-210: an absent actor has an implied 0)"
+            )
+
+
+class OpLog:
+    """Bounded append-only staging log of :class:`OpBatch` segments.
+
+    The write front-end's mailbox: any thread may :meth:`append`
+    (writers, decoded wire frames, session piggybacks); the fold step
+    :meth:`drain`\\ s everything accumulated so far as ONE concatenated
+    batch.  ``capacity`` bounds total buffered ops — a full log raises
+    :class:`~crdt_tpu.error.OpLogOverflowError` (backpressure: drain or
+    shed, never silently drop a write).
+
+    ``watermark`` is the per-actor dot high-watermark (uint64[A]): the
+    highest add/inc/dec counter this log has ever seen per actor — the
+    cheap staleness/progress signal an operator reads next to the
+    ``oplog.pending`` gauge.
+    """
+
+    def __init__(self, universe, capacity: int = 1 << 16):
+        if capacity < 1:
+            raise ValueError(f"OpLog capacity {capacity} < 1")
+        self.universe = universe
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._segments: list = []
+        self._count = 0
+        self._watermark = np.zeros(universe.config.num_actors, np.uint64)
+        self._appended_total = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def watermark(self) -> np.ndarray:
+        """Copy of the per-actor dot high-watermark (uint64[A])."""
+        with self._lock:
+            return self._watermark.copy()
+
+    def append(self, batch: OpBatch) -> None:
+        from ..utils import tracing
+
+        if not isinstance(batch, OpBatch):
+            raise TypeError(
+                f"OpLog.append wants an OpBatch, got {type(batch).__name__}"
+            )
+        b = len(batch)
+        if b == 0:
+            return
+        with self._lock:
+            if self._count + b > self.capacity:
+                raise OpLogOverflowError(
+                    f"op log full: {self._count} buffered + {b} appended "
+                    f"> capacity {self.capacity} — drain (apply) before "
+                    "submitting more writes"
+                )
+            self._segments.append(batch)
+            self._count += b
+            self._appended_total += b
+            dotted = np.isin(batch.kind, np.asarray(
+                [OP_ADD, OP_INC, OP_DEC], np.uint8))
+            if dotted.any():
+                np.maximum.at(
+                    self._watermark, batch.actor[dotted],
+                    batch.counter[dotted],
+                )
+        tracing.count("oplog.submitted", b)
+
+    def pending(self) -> OpBatch:
+        """Everything buffered, as one batch — WITHOUT clearing (the
+        session piggyback ships a copy; the local drain still applies
+        the ops, and re-delivery is idempotent by the CmRDT contract)."""
+        with self._lock:
+            segments = list(self._segments)
+        return OpBatch.concat(segments)
+
+    def drain(self) -> OpBatch:
+        """Everything buffered, as one batch; the log is empty after."""
+        with self._lock:
+            segments, self._segments = self._segments, []
+            self._count = 0
+        return OpBatch.concat(segments)
+
+
+# ---------------------------------------------------------------------------
+# batched causal-context derivation
+# ---------------------------------------------------------------------------
+
+
+_derive_jit = None
+
+
+def _derive_kernel():
+    """The jitted core of :func:`derive_add_ctx`, built once (jax loads
+    lazily so the columnar records stay importable on jax-free tooling
+    paths)."""
+    global _derive_jit
+    if _derive_jit is None:
+        import jax
+
+        _derive_jit = jax.jit(_derive_kernel_host)
+    return _derive_jit
+
+
+def _derive_kernel_host(base_clock, obj, actor):
+    """jit-able core of :func:`derive_add_ctx` (see there for the
+    semantics).  Separated so the jit cache keys on array shapes only."""
+    import jax.numpy as jnp
+
+    b = obj.shape[0]
+    a = base_clock.shape[1]
+    dt = base_clock.dtype
+    # stable sort by object: ops on one object become one contiguous
+    # segment, batch order preserved within it (jnp.argsort is stable)
+    order = jnp.argsort(obj)
+    so = obj[order]
+    sa = actor[order]
+    # per-actor one-hot cumulative counts down the sorted batch
+    onehot = (sa[:, None] == jnp.arange(a)[None, :]).astype(dt)
+    csum = jnp.cumsum(onehot, axis=0)                      # inclusive
+    is_start = jnp.concatenate(
+        [jnp.ones(1, bool), so[1:] != so[:-1]])
+    seg_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    first = jnp.nonzero(is_start, size=b, fill_value=0)[0]
+    start_row = first[seg_id]
+    # within-segment INCLUSIVE per-actor op counts: global cumsum minus
+    # everything accumulated before this object's segment
+    incl = csum - csum[start_row] + onehot[start_row]
+    # scalar parity (`ctx.rs:45-53` looped): the k-th write by actor a'
+    # on object o sees base[o] advanced by every prior same-object
+    # write's dot, and its own dot is base[o, a'] + k
+    ctx = base_clock[so] + incl
+    counters = jnp.take_along_axis(
+        ctx, sa[:, None].astype(jnp.int32), axis=1)[:, 0]
+    inv = jnp.zeros(b, order.dtype).at[order].set(jnp.arange(b))
+    return counters[inv], ctx[inv]
+
+
+def derive_add_ctx(base_clock, obj, actor, *, member=None, kind=OP_ADD):
+    """Vectorized ``ReadCtx.derive_add_ctx`` over a whole write batch.
+
+    ``base_clock`` is the fleet's current clock plane (``[N, A]`` — for
+    ORSWOT the set clock, for counters the count plane itself, a
+    GCounter IS a VClock, `gcounter.rs:26-28`); ``obj``/``actor`` name
+    each pending write.  Returns ``(ops, ctx_clocks)``:
+
+    * ``ops`` — an :class:`OpBatch` with the assigned dot ``counter``
+      per write: exactly the sequence the scalar loop — read, clone,
+      ``inc``, witness, apply (`ctx.rs:45-53`; the apply witnesses only
+      the dot, `orswot.rs:75-77`) — would mint, including interleaved
+      actors on one object and fresh-actor bootstrap from an implied 0
+      (pinned against :func:`crdt_tpu.scalar.ctx.sequential_add_ctxs`).
+    * ``ctx_clocks`` — ``uint64[B, A]``: each write's full AddCtx clock
+      (base clock + every same-object dot minted at or before it).
+      Local bookkeeping only — ``Op::Add`` ships just the dot
+      (`orswot.rs:66-79`), so the wire codec never carries these.
+
+    One jitted kernel regardless of batch size: a stable segment sort
+    by object, one ``[B, A]`` cumulative one-hot, two gathers.
+    """
+    import jax.numpy as jnp
+
+    obj = np.asarray(obj, np.int64)
+    actor = np.asarray(actor, np.int32)
+    b = obj.shape[0]
+    if obj.shape != actor.shape:
+        raise ValueError(
+            f"obj/actor shape mismatch: {obj.shape} vs {actor.shape}"
+        )
+    if kind not in (OP_ADD, OP_INC, OP_DEC):
+        raise ValueError(
+            f"derive_add_ctx mints dots for add/inc/dec ops, not "
+            f"{OP_NAMES.get(kind, kind)!r} (removes derive a clock — "
+            "derive_rm_ctx)"
+        )
+    if b == 0:
+        a = np.asarray(base_clock).shape[1]
+        return OpBatch.empty(), np.zeros((0, a), np.uint64)
+    if actor.min() < 0 or actor.max() >= np.asarray(base_clock).shape[1]:
+        raise ValueError(
+            f"actor index outside the universe "
+            f"[0, {np.asarray(base_clock).shape[1]})"
+        )
+    counters, ctx = _derive_kernel()(
+        jnp.asarray(base_clock), jnp.asarray(obj), jnp.asarray(actor)
+    )
+    member_col = (np.full(b, NO_MEMBER, np.int32) if member is None
+                  else _col(member, np.int32))
+    ops = OpBatch(
+        kind=np.full(b, kind, np.uint8), obj=obj, actor=actor,
+        counter=np.asarray(counters, np.uint64), member=member_col,
+    )
+    return ops, np.asarray(ctx, np.uint64)
+
+
+def derive_rm_ctx(base_clock, obj, member) -> OpBatch:
+    """Vectorized ``derive_rm_ctx`` (`ctx.rs:56-60`): each remove's
+    witnessing clock is a clone of the object's current clock — one
+    gather for the whole batch.  Removes mint no dot
+    (`orswot.rs:80-83`), so ``counter`` is 0 and ``actor`` is 0."""
+    obj = np.asarray(obj, np.int64)
+    member = _col(member, np.int32)
+    if obj.shape != member.shape:
+        raise ValueError(
+            f"obj/member shape mismatch: {obj.shape} vs {member.shape}"
+        )
+    base = np.asarray(base_clock, np.uint64)
+    b = obj.shape[0]
+    return OpBatch(
+        kind=np.full(b, OP_RM, np.uint8), obj=obj,
+        actor=np.zeros(b, np.int32), counter=np.zeros(b, np.uint64),
+        member=member,
+        rm_clocks=base[obj] if b else None,
+    )
+
+
+def intern_ops(universe, actors: Iterable, members: Iterable = None,
+               objects: Iterable = None, object_registry=None):
+    """Batch-intern arbitrary writer names through the existing tables.
+
+    ``actors`` intern through ``universe.actors`` (dense columns),
+    ``members`` through ``universe.members`` (int32 ids) — the same
+    registries every state-path ingest uses, so op-path and state-path
+    writers can never disagree on an index.  ``objects`` optionally
+    intern through a caller-owned ``object_registry``
+    (:class:`crdt_tpu.utils.interning.Registry`) for deployments whose
+    object keys are names rather than dense fleet rows.
+
+    Returns ``(actor_idx int32[B], member_id int32[B] | None,
+    obj int64[B] | None)``.
+    """
+    actor_idx = np.asarray(universe.actors.intern_all(list(actors)),
+                           np.int32)
+    member_id = None
+    if members is not None:
+        member_id = np.asarray(universe.members.intern_all(list(members)),
+                               np.int32)
+    obj = None
+    if objects is not None:
+        if object_registry is None:
+            raise ValueError(
+                "interning object names needs an object_registry "
+                "(fleet rows are dense; pass rows directly otherwise)"
+            )
+        obj = np.asarray(object_registry.intern_all(list(objects)),
+                         np.int64)
+    return actor_idx, member_id, obj

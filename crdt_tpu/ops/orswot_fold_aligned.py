@@ -78,6 +78,7 @@ from .orswot_pallas import (
     _check_dtypes,
     _emask,
     _from_kernel_dtype,
+    _gate_interpret,
     _interpret_default,
     _nonempty,
     _pad_to,
@@ -383,6 +384,7 @@ def fold_merge(
         jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
     # 32-bit trace mode — see orswot_pallas.merge
+    _gate_interpret(interpret)
     with x64_disabled():
         out = pl.pallas_call(
             kernel,

@@ -424,6 +424,29 @@ def _interpret_default():
     return jax.default_backend() != "tpu"
 
 
+def _gate_interpret(interpret: bool) -> None:
+    """The "jax 0.4.x Pallas skew" version gate: interpret-mode kernel
+    launches on a 0.4.x jax would recurse forever in Mosaic's
+    int64→int32 truncation — raise the typed
+    :class:`~crdt_tpu.error.UnsupportedBackendError` (with the
+    remediation in its message) at the API boundary instead of failing
+    deep in the compiler.  One predicate —
+    :func:`crdt_tpu.config.pallas_mosaic_skew` — shared with the test
+    harness's xfail gate (``tests/conftest.py``), so the gate and the
+    expected-failure set can never drift.  Sits AFTER the dtype checks
+    in every entry point: u64 rejection (a caller bug on any jax)
+    outranks the version gate (an environment limit)."""
+    if not interpret:
+        return
+    from ..config import pallas_mosaic_skew
+
+    skew = pallas_mosaic_skew()
+    if skew is not None:
+        from ..error import UnsupportedBackendError
+
+        raise UnsupportedBackendError(skew)
+
+
 @functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret"))
 def merge(
     clock_a, ids_a, dots_a, dids_a, dclocks_a,
@@ -471,6 +494,7 @@ def merge(
     # Python-int literal (the `0`s in jnp.where etc.) becomes an i64[]
     # scalar operand, and Mosaic has no 64-bit support — its convert
     # helper recurses forever on the i64→i32 truncation
+    _gate_interpret(interpret)
     with x64_disabled():
         out = pl.pallas_call(
             kernel,
@@ -603,6 +627,7 @@ def fold_merge(
         jax.ShapeDtypeStruct((n_pad, 2), jnp.int32),
     )
     # 32-bit trace mode — see the matching comment in merge()
+    _gate_interpret(interpret)
     with x64_disabled():
         out = pl.pallas_call(
             kernel,

@@ -57,3 +57,26 @@ class ReadCtx(Generic[V]):
     def derive_rm_ctx(self) -> RmCtx:
         """Derive a RmCtx (`ctx.rs:56-60`): clone the rm clock."""
         return RmCtx(clock=self.rm_clock.clone())
+
+
+def sequential_add_ctxs(base_clock: VClock, actors) -> list:
+    """The scalar clone-and-increment LOOP over one object's writes —
+    the oracle the batched derive (:func:`crdt_tpu.oplog.records.
+    derive_add_ctx`) is parity-pinned against.
+
+    Each write re-reads the clock the previous apply produced: derive
+    an AddCtx (`ctx.rs:45-53`), then witness ONLY its dot — which is
+    all ``CmRDT::apply`` witnesses (`orswot.rs:75-77`) — before the
+    next write's read.  Interleaved actors therefore see each other's
+    dots, and an actor absent from the base clock boots from the
+    implied 0 (`vclock.rs:206-210`).  Returns one :class:`AddCtx` per
+    entry of ``actors``, in order.
+    """
+    clock = base_clock.clone()
+    out = []
+    for actor in actors:
+        ctx = ReadCtx(add_clock=clock, rm_clock=clock, val=None) \
+            .derive_add_ctx(actor)
+        out.append(ctx)
+        clock.apply(ctx.dot)
+    return out

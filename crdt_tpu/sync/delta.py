@@ -41,10 +41,11 @@ FRAME_DELTA = 0x02
 FRAME_FULL = 0x03
 FRAME_HELLO = 0x04
 FRAME_FLEET = 0x05
+FRAME_OPS = 0x06
 
 _FRAME_NAMES = {FRAME_DIGEST: "digest", FRAME_DELTA: "delta",
                 FRAME_FULL: "full", FRAME_HELLO: "hello",
-                FRAME_FLEET: "fleet"}
+                FRAME_FLEET: "fleet", FRAME_OPS: "ops"}
 _HEADER = struct.Struct("<BBIQ")
 
 
@@ -112,26 +113,31 @@ def decode_frame(frame: bytes) -> tuple[int, bytes]:
 # ---- hello frames ----------------------------------------------------------
 
 
-def encode_hello_frame(trace: str, node: str, fleet_obs: bool) -> bytes:
+def encode_hello_frame(trace: str, node: str, fleet_obs: bool,
+                       oplog: bool = False) -> bytes:
     """A HELLO frame — the session-opening handshake: this side's
     trace-ID proposal (both peers adopt the lexicographic min, so the
     two halves of one session share ONE fleet-unique ID), its node
-    label, and whether it can exchange piggybacked fleet-observability
-    snapshots (the exchange only happens when BOTH advertise it, which
-    keeps the lock-step protocol symmetric)."""
+    label, and two capability flags — piggybacked fleet-observability
+    snapshots and piggybacked op batches (each exchange only happens
+    when BOTH peers advertise it, which keeps the lock-step protocol
+    symmetric; a pre-oplog peer simply never sees the key)."""
     import json
 
     payload = json.dumps(
-        {"trace": str(trace), "node": str(node), "fleet_obs": bool(fleet_obs)},
+        {"trace": str(trace), "node": str(node),
+         "fleet_obs": bool(fleet_obs), "oplog": bool(oplog)},
         sort_keys=True, separators=(",", ":"),
     ).encode("utf-8")
     return _frame(FRAME_HELLO, payload)
 
 
-def decode_hello_payload(payload: bytes) -> tuple[str, str, bool]:
-    """``(trace_proposal, node_label, fleet_obs)`` from a HELLO
+def decode_hello_payload(payload: bytes) -> tuple[str, str, bool, bool]:
+    """``(trace_proposal, node_label, fleet_obs, oplog)`` from a HELLO
     payload.  Labels are bounded defensively — a garbage hello must
-    yield a rejection, not an unbounded event field."""
+    yield a rejection, not an unbounded event field.  A hello without
+    the ``oplog`` key (an older peer) reads as "no op piggyback", so
+    mixed fleets degrade to state-only sessions instead of rejecting."""
     import json
 
     try:
@@ -139,11 +145,12 @@ def decode_hello_payload(payload: bytes) -> tuple[str, str, bool]:
         trace = str(doc["trace"])[:128]
         node = str(doc.get("node", "peer"))[:64]
         fleet_obs = bool(doc.get("fleet_obs", False))
+        oplog = bool(doc.get("oplog", False))
     except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
         raise SyncProtocolError(f"malformed hello payload: {e}") from None
     if not trace:
         raise SyncProtocolError("hello payload carries an empty trace ID")
-    return trace, node, fleet_obs
+    return trace, node, fleet_obs, oplog
 
 
 def encode_fleet_frame(snapshot_frame: bytes) -> bytes:
@@ -157,6 +164,23 @@ def encode_fleet_frame(snapshot_frame: bytes) -> bytes:
 def decode_fleet_payload(payload: bytes) -> bytes:
     """The nested fleet-snapshot frame from a FLEET payload (validated
     by the fleet codec's own decode, not here)."""
+    return bytes(payload)
+
+
+def encode_ops_sync_frame(ops_frame: bytes) -> bytes:
+    """An OPS frame: one op-batch frame
+    (:func:`crdt_tpu.oplog.wire.encode_ops_frame` — itself versioned
+    and CRC-guarded) nested in the sync envelope, exactly the FLEET
+    piggyback discipline: converged sessions may close with an op
+    exchange when both hellos advertised the capability, so live
+    writes submitted mid-session reach the peer in the same session
+    instead of waiting a gossip round."""
+    return _frame(FRAME_OPS, bytes(ops_frame))
+
+
+def decode_ops_sync_payload(payload: bytes) -> bytes:
+    """The nested op-batch frame from an OPS payload (validated by the
+    oplog codec's own decode, not here)."""
     return bytes(payload)
 
 

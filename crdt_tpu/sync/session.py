@@ -67,6 +67,7 @@ from .delta import (
     FRAME_FLEET,
     FRAME_FULL,
     FRAME_HELLO,
+    FRAME_OPS,
     OrswotDeltaApplier,
     decode_delta_payload,
     decode_digest_payload,
@@ -74,12 +75,14 @@ from .delta import (
     decode_frame,
     decode_full_payload,
     decode_hello_payload,
+    decode_ops_sync_payload,
     diverged_indices,
     encode_delta_frame,
     encode_digest_frame,
     encode_fleet_frame,
     encode_full_frame,
     encode_hello_frame,
+    encode_ops_sync_frame,
     gather_blobs,
 )
 
@@ -99,6 +102,9 @@ class SyncReport:
     full_bytes_sent: int = 0       # FULL frames only
     hello_bytes_sent: int = 0      # the session-opening handshake
     fleet_bytes_sent: int = 0      # piggybacked observability snapshot
+    ops_bytes_sent: int = 0        # piggybacked op-batch frames
+    ops_sent: int = 0              # ops shipped in the piggyback
+    ops_received: int = 0          # peer ops handed to the op sink
     bytes_received: int = 0
     trace_id: Optional[str] = None  # hello-negotiated, same on BOTH peers
     fleet_nodes: int = 0           # nodes known after a snapshot exchange
@@ -107,7 +113,7 @@ class SyncReport:
     def bytes_sent(self) -> int:
         return (self.digest_bytes_sent + self.delta_bytes_sent
                 + self.full_bytes_sent + self.hello_bytes_sent
-                + self.fleet_bytes_sent)
+                + self.fleet_bytes_sent + self.ops_bytes_sent)
 
     def delta_ratio(self, full_state_bytes: int) -> Optional[float]:
         """Payload bytes this side shipped (delta + any full-state
@@ -151,7 +157,9 @@ class SyncSession:
                  digest_fn: Optional[Callable] = None,
                  peer: Optional[str] = None,
                  full_state_bytes: Optional[int] = None,
-                 observatory=None):
+                 observatory=None,
+                 op_outbox: Optional[Callable[[], bytes]] = None,
+                 op_sink: Optional[Callable[[bytes], None]] = None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -173,6 +181,17 @@ class SyncSession:
         #: a piggybacked fleet-snapshot exchange
         self.observatory = observatory
         self._peer_fleet_obs = False
+        #: op-batch piggyback hooks (:mod:`crdt_tpu.oplog`): when BOTH
+        #: hellos advertise the capability, a converged session closes
+        #: with one OPS frame each way — ``op_outbox()`` supplies this
+        #: side's encoded op frame (live writes submitted mid-session),
+        #: ``op_sink(frame)`` ingests the peer's.  Both hooks are
+        #: required to advertise (a sink-less peer would drop ops on
+        #: the floor, which the CmRDT contract tolerates but the
+        #: capability flag exists to avoid).
+        self._op_outbox = op_outbox
+        self._op_sink = op_sink
+        self._peer_oplog = False
         self._digest_fn = digest_fn or digest_mod.digest_of
         self._applier = OrswotDeltaApplier(universe)
 
@@ -231,9 +250,11 @@ class SyncSession:
         node = self.observatory.node_id if self.observatory is not None \
             else f"proc-{obs_events._PROC_TAG}"
         proposal = self.session_id
+        can_ops = self._op_outbox is not None and self._op_sink is not None
         self._send(
             send,
-            encode_hello_frame(proposal, node, self.observatory is not None),
+            encode_hello_frame(proposal, node, self.observatory is not None,
+                               oplog=can_ops),
             report, "hello", 0,
         )
         ftype, payload = self._recv(recv, report)
@@ -242,11 +263,12 @@ class SyncSession:
                 f"expected a hello frame, peer sent type {ftype:#04x} "
                 "(pre-v2 peer?)"
             )
-        theirs, peer_node, self._peer_fleet_obs = \
+        theirs, peer_node, self._peer_fleet_obs, self._peer_oplog = \
             decode_hello_payload(payload)
         self.trace_id = report.trace_id = min(proposal, theirs)
         self._event("sync.hello", proposed=proposal, peer_node=peer_node,
-                    peer_fleet_obs=self._peer_fleet_obs)
+                    peer_fleet_obs=self._peer_fleet_obs,
+                    peer_oplog=self._peer_oplog)
 
     def _fleet_exchange(self, send, recv, report: SyncReport) -> None:
         """Piggybacked fleet-observability snapshot swap after the
@@ -271,6 +293,46 @@ class SyncSession:
         report.fleet_nodes = len(merged.slices)
         self._event("sync.fleet_snapshot", nodes=report.fleet_nodes,
                     bytes=len(mine))
+
+    def _ops_exchange(self, send, recv, report: SyncReport) -> None:
+        """Piggybacked op-batch swap after the session converged — only
+        when BOTH hellos advertised the oplog capability (shared data,
+        so the lock-step protocol stays symmetric).  Each side ships
+        whatever its outbox holds — possibly an EMPTY op frame, which
+        keeps the exchange symmetric when only one side has pending
+        writes — and hands the peer's batch to its sink.  Re-delivery
+        (the ops will also arrive folded into state next round) is
+        harmless: batched ``apply`` is idempotent, the CmRDT contract.
+        """
+        if self._op_outbox is None or self._op_sink is None \
+                or not self._peer_oplog:
+            return
+        from ..oplog.wire import decode_ops_frame, frame_op_count
+
+        with tracing.span("oplog.exchange"):
+            mine = self._op_outbox()
+            if not mine:
+                # the exchange is lock-step: an empty outbox still owes
+                # the peer a frame
+                from ..oplog.records import OpBatch
+                from ..oplog.wire import encode_ops_frame
+
+                mine = encode_ops_frame(OpBatch.empty())
+            n_ops = frame_op_count(mine)
+            report.ops_sent = n_ops
+            self._send(send, encode_ops_sync_frame(mine), report, "ops",
+                       n_ops)
+            ftype, payload = self._recv(recv, report)
+            if ftype != FRAME_OPS:
+                raise SyncProtocolError(
+                    f"expected an ops frame, peer sent type {ftype:#04x}"
+                )
+            theirs = decode_ops_sync_payload(payload)
+            report.ops_received = len(decode_ops_frame(theirs))
+            self._op_sink(theirs)
+        if report.ops_sent or report.ops_received:
+            self._event("sync.ops_piggyback", sent=report.ops_sent,
+                        received=report.ops_received)
 
     def _n(self) -> int:
         import jax
@@ -348,10 +410,13 @@ class SyncSession:
             send, recv = transport.send, transport.recv
         try:
             report = self._sync(send, recv)
-            # piggyback AFTER convergence: a failed session must not
-            # spend frames on telemetry, and a converged one has both
-            # hellos' capability flags to decide with
+            # piggybacks AFTER convergence: a failed session must not
+            # spend frames on telemetry or writes, and a converged one
+            # has both hellos' capability flags to decide with; ops ride
+            # after the fleet snapshot so telemetry cost stays bounded
+            # even when the op exchange carries a large burst
             self._fleet_exchange(send, recv, report)
+            self._ops_exchange(send, recv, report)
         except (SyncProtocolError, TransportError) as e:
             tracing.count("sync.errors")
             self._event("sync.error", error=str(e)[:200])

@@ -228,7 +228,8 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
 
 def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 divergence: float, max_sweeps: int = 20,
-                fleet_port: int | None = None) -> int:
+                fleet_port: int | None = None, ops_rate: int = 0,
+                ops_sweeps: int = 3) -> int:
     """N in-process replicas over real loopback TCP, reconciled by the
     cluster runtime (``crdt_tpu/cluster``): each node owns a listener
     (accepted sessions run through the same hardened transport stack),
@@ -244,7 +245,16 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     of N disjoint per-node ``/metrics`` views, plus the shared trace ID
     of the final session (both halves carry it — PERF.md "Fleet
     observability" walks the curl side).  ``--fleet-port`` additionally
-    serves the live merged view on ``GET /fleet``."""
+    serves the live merged view on ``GET /fleet``.
+
+    ``--ops R`` turns the demo into a LIVE-WRITE run: for the first few
+    sweeps, R random user writes per sweep land on random nodes through
+    the op-based front-end (``ClusterNode.submit_ops`` — batched
+    ``derive_add_ctx`` dots, :mod:`crdt_tpu.oplog`) WHILE gossip is
+    reconciling, so anti-entropy and ingest genuinely overlap; once the
+    writes stop, the fleet must still converge to byte-identical digest
+    vectors — the mixed op+state acceptance shape (PERF.md "Op-based
+    replication")."""
     import jax
 
     if platform:
@@ -271,6 +281,8 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                          ack_timeout_s=0.25, max_backoff_s=2.0,
                          retry_budget=64)
 
+    from crdt_tpu.oplog import OpLog
+
     nodes = []
     for i in range(n_peers):
         fleet = _build_fleet(n_objects, actor=i + 1,
@@ -279,6 +291,9 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             f"n{i}", OrswotBatch.from_scalar(fleet, uni), uni,
             busy_timeout_s=30.0,
             observatory=FleetObservatory(f"n{i}"),
+            # op front-end armed up front so sessions advertise the
+            # piggyback capability from the first hello
+            oplog=OpLog(uni) if ops_rate else None,
         ))
 
     fleet_server = None
@@ -360,25 +375,69 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             session_timeout_s=60.0, seed=i,
         ))
 
+    ops_rng = np.random.RandomState(4242)
+    total_ops = 0
+
+    def inject_writes(r):
+        """R random user writes into random nodes, mid-round: each
+        write mints its dot through the node's own write front-end
+        (``submit_writes`` — batched clone-and-increment against the
+        log-inclusive write clock, so a node mid-session can never
+        reuse a dot), using a per-node writer actor; folded immediately
+        when the node is idle, queued (and piggybacked to the next
+        session peer) when it is busy."""
+        nonlocal total_ops
+        per_node = np.bincount(
+            ops_rng.randint(0, n_peers, r), minlength=n_peers)
+        for i, cnt in enumerate(per_node):
+            if not cnt:
+                continue
+            nodes[i].submit_writes(
+                ops_rng.randint(0, n_objects, cnt),
+                ops_rng.randint(200, 216, cnt).astype(np.int32),
+                actor=i + 1,
+            )
+            total_ops += cnt
+
     sweeps = 0
     converged = False
     try:
         for sweeps in range(1, max_sweeps + 1):
+            writing = ops_rate and sweeps <= ops_sweeps
+            if writing:
+                inject_writes(ops_rate)
             for sched in scheds:
+                if writing:
+                    # writes land between (and during) rounds, not just
+                    # at sweep boundaries — the live-traffic shape
+                    inject_writes(max(1, ops_rate // n_peers))
                 sched.run_round()
             digests = [n.digest() for n in nodes]
             converged = all(
                 np.array_equal(digests[0], d) for d in digests[1:]
             )
-            print(f"sweep {sweeps}: "
-                  + ("digest vectors identical" if converged
-                     else "still diverged"), flush=True)
-            if converged:
+            state = ("digest vectors identical" if converged
+                     else "still diverged")
+            if ops_rate:
+                state += f" (ops submitted so far: {total_ops})"
+            print(f"sweep {sweeps}: {state}", flush=True)
+            # while writes flow, convergence is a moving target — only
+            # the post-write sweeps decide the verdict
+            if converged and not writing:
                 break
     finally:
         stop.set()
         for srv in servers:
             srv.close()
+
+    if ops_rate:
+        print(f"ops: {total_ops} live writes ingested through "
+              f"submit_ops while gossip ran; fleet "
+              f"{'CONVERGED' if converged else 'DIVERGED'} after writes "
+              "stopped", flush=True)
+        assert not converged or all(
+            len(n._oplog) == 0 for n in nodes if n._oplog is not None
+        ), "converged with undrained op logs"
 
     # ONE merged fleet snapshot (every node's slice reached node 0 on
     # the gossip itself — no scraper, no federation) instead of N
@@ -437,14 +496,23 @@ def main() -> int:
                          "snapshot on GET /fleet at this port (0 picks a "
                          "free one); the demo prints the merged snapshot "
                          "at convergence either way")
+    ap.add_argument("--ops", type=int, default=0, metavar="R",
+                    help="with --gossip: drive R random user writes per "
+                         "sweep into random nodes through the op-based "
+                         "front-end (crdt_tpu.oplog / submit_ops) WHILE "
+                         "gossip runs, then assert the fleet still "
+                         "converges after writes stop")
     args = ap.parse_args()
 
     if args.gossip:
         if args.gossip < 2:
             ap.error("--gossip needs N >= 2 peers")
+        if args.ops < 0:
+            ap.error("--ops needs R >= 0")
         return gossip_demo(args.gossip, args.objects, args.platform,
                            divergence=args.divergence,
-                           fleet_port=args.fleet_port)
+                           fleet_port=args.fleet_port,
+                           ops_rate=args.ops)
 
     if args.role != "demo":
         if not args.port:

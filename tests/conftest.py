@@ -54,6 +54,12 @@ def pytest_configure(config):
         "membership, gossip scheduler, fault injection); tier-1 like "
         "`sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "oplog: op-based write front-end tests (crdt_tpu.oplog — "
+        "columnar op log, batched causal contexts, scatter-fold apply, "
+        "op-frame codec); tier-1 like `sync`",
+    )
 
 
 # -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------
@@ -62,35 +68,36 @@ def pytest_configure(config):
 # lowering into the interpret-mode Pallas kernels recurse forever in
 # Mosaic's int64→int32 truncation (ROADMAP "jax 0.4.x Pallas skew"; the
 # PR 2 compat shims recovered the collectives/executor suites but not
-# the kernels themselves).  Gate them as xfail — NOT skip — so the
-# tier-1 output distinguishes "known skew" (x) from a new regression,
-# and a jax>=0.5 box runs the full suite ungated.  The exempt tests
-# never enter a Mosaic kernel (u64 rejection / dispatch selection) and
-# pass on 0.4.x; they stay live so the gate can't mask regressions in
-# the dispatch/rejection logic.
+# the kernels themselves).  The kernels now gate this THEMSELVES: the
+# entry points call `crdt_tpu.config.pallas_mosaic_skew()` and raise a
+# typed `UnsupportedBackendError` with a remediation message instead of
+# failing deep in Mosaic — and this harness keys its xfail marking off
+# the SAME predicate, so the test gate and the runtime gate can never
+# drift.  xfail — NOT skip — so the tier-1 output distinguishes "known
+# skew" (x) from a new regression, and a jax>=0.5 box runs the full
+# suite ungated.  The exempt tests never enter a Mosaic kernel (u64
+# rejection / dispatch selection) and pass on 0.4.x; they stay live so
+# the gate can't mask regressions in the dispatch/rejection logic.
 
 _MOSAIC_SKEW_FILES = ("test_orswot_pallas.py", "test_orswot_fold_aligned.py")
 _MOSAIC_SKEW_EXEMPT_PREFIXES = (
     "test_u64_counters_rejected",
     "test_ops_fold_merge_dispatch_parity[rank]",
     "test_ops_fold_merge_pallas_u64_degrades_to_sequential",
-)
-_MOSAIC_SKEW_REASON = (
-    "known jax 0.4.x Pallas/Mosaic skew: i64 lowering into the "
-    "interpret-mode kernels recurses in Mosaic's int64->int32 "
-    "truncation (ROADMAP 'jax 0.4.x Pallas skew'); not a new "
-    "regression — kernels need a 0.4.x-safe trace mode or jax>=0.5"
+    # the gate's own pin: asserts UnsupportedBackendError surfaces (with
+    # its remediation text) instead of a deep Mosaic failure, so it must
+    # PASS exactly where the rest of the suite xfails
+    "test_mosaic_skew_gate_raises_typed_error",
 )
 
 
-def _jax_04x() -> bool:
-    import jax
+def _mosaic_skew():
+    """The kernel-side gate's reason string (None when the jax version
+    is fine) — conftest marks xfails with the SAME text the runtime
+    error carries."""
+    from crdt_tpu.config import pallas_mosaic_skew
 
-    try:
-        major, minor = (int(p) for p in jax.__version__.split(".")[:2])
-    except ValueError:
-        return False
-    return (major, minor) < (0, 5)
+    return pallas_mosaic_skew()
 
 
 # -- CPU-backend multiprocess gate -------------------------------------------
@@ -115,8 +122,13 @@ _MULTIHOST_MP_REASON = (
 def pytest_collection_modifyitems(config, items):
     import pytest
 
-    if _jax_04x():
-        marker = pytest.mark.xfail(reason=_MOSAIC_SKEW_REASON, strict=False)
+    skew = _mosaic_skew()
+    if skew is not None:
+        marker = pytest.mark.xfail(
+            reason=f"known jax 0.4.x Pallas/Mosaic skew (gated as "
+                   f"UnsupportedBackendError by the kernels): {skew}",
+            strict=False,
+        )
         for item in items:
             if item.fspath.basename not in _MOSAIC_SKEW_FILES:
                 continue

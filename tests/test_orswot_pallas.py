@@ -110,6 +110,31 @@ def test_u64_counters_rejected():
         orswot_pallas.merge(*lhs, *lhs, 3, 2, interpret=True)
 
 
+def test_mosaic_skew_gate_raises_typed_error():
+    """The jax 0.4.x version gate: on a skewed jax, an interpret-mode
+    kernel launch must surface the typed UnsupportedBackendError — with
+    its remediation text — at the API boundary, never a deep Mosaic
+    failure.  (On jax>=0.5 there is nothing to gate; conftest keeps
+    this test OUT of the xfail set so the gate itself stays pinned.)"""
+    from crdt_tpu.config import pallas_mosaic_skew
+    from crdt_tpu.error import UnsupportedBackendError
+
+    if pallas_mosaic_skew() is None:
+        pytest.skip("jax >= 0.5: the Mosaic i64 skew does not apply")
+    rng = np.random.RandomState(5)
+    lhs = tuple(
+        jnp.asarray(x) for x in random_orswot_arrays(rng, 4, 4, 3, 2, np.uint32)
+    )
+    with pytest.raises(UnsupportedBackendError, match="jax"):
+        orswot_pallas.merge(*lhs, *lhs, 3, 2, interpret=True)
+    # u64 rejection still outranks the version gate (caller bug first)
+    as_u64 = tuple(
+        x.astype(jnp.uint64) if x.dtype != jnp.int32 else x for x in lhs
+    )
+    with pytest.raises(TypeError, match="32-bit"):
+        orswot_pallas.merge(*as_u64, *as_u64, 3, 2, interpret=True)
+
+
 def test_full_uint32_counter_range_parity():
     """Counters at and above 2**31 must merge bit-identically — the kernel
     works in a bias-mapped signed domain (x ^ 0x8000_0000) precisely so

@@ -1794,6 +1794,43 @@ def bench_bulk_ingest():
     return out
 
 
+def bench_kernelcheck():
+    """Kernelcheck coverage gauge (the static-analysis bench satellite):
+    runs the jaxpr tier exactly as ``scripts/ci.sh`` does — a CPU-pinned
+    subprocess of ``python -m crdt_tpu.analysis --kernels --json`` — and
+    reports analyzer wall time plus kernels-covered counts into the
+    artifact tail.  The point is the COVERAGE trend, not the seconds: a
+    new kernel module escaping the manifest shows up here as a
+    kernels/cases count that stopped growing while the tree did (and as
+    a hard tier-1 failure via the kernel-manifest AST rule); a wall-time
+    blowup means a ladder got expensive enough to threaten the <60 s CI
+    budget."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "crdt_tpu.analysis", "--kernels", "--json"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    out = json.loads(proc.stdout)
+    kc = out["kernelcheck"]
+    log(
+        f"kernelcheck: rc={proc.returncode}  {kc['kernels']} kernels "
+        f"({kc['traced']} traced, {kc['cases']} cases), "
+        f"{len(out['findings'])} finding(s), {kc['elapsed_s']}s"
+    )
+    return {
+        "kernelcheck_rc": proc.returncode,
+        "kernelcheck_kernels": kc["kernels"],
+        "kernelcheck_traced": kc["traced"],
+        "kernelcheck_cases": kc["cases"],
+        "kernelcheck_findings": len(out["findings"]),
+        "kernelcheck_trace_errors": len(kc["trace_errors"]),
+        "kernelcheck_wall_s": kc["elapsed_s"],
+    }
+
+
 def bench_tpu_validation():
     """On a real TPU backend: compiled-Pallas parity + timing and
     accel-vs-CPU merge parity, in a killable subprocess (a Mosaic hang
@@ -2078,6 +2115,12 @@ def main():
     fleet_res = run_stage("fleet_obs", 20, bench_fleet_obs)
     if fleet_res is not None:
         emit(**fleet_res)
+    # budget-skippable: kernelcheck coverage gauge (analyzer wall time +
+    # kernels-covered counts, so a kernel module escaping the manifest
+    # shows in the artifact tail as a coverage count that stopped moving)
+    kc_res = run_stage("kernelcheck", 40, bench_kernelcheck)
+    if kc_res is not None:
+        emit(**kc_res)
     # provisional regression tail first: a watchdog kill inside the
     # required validation stage below must not cost the field entirely
     _emit_obs_snapshot()

@@ -6,8 +6,13 @@ machine-readable result on stdout (what ``tests/test_analysis.py`` and
 CI consume); the default human output is one ``path:line:col: rule:
 message`` line per finding, grep- and editor-jumpable.
 
-The lint never imports jax/numpy — it must run (fast) on boxes with no
-accelerator stack, and tier-1 budgets the whole run under 5 seconds.
+The default (AST) tier never imports jax/numpy — it must run (fast) on
+boxes with no accelerator stack, and tier-1 budgets the whole run under
+5 seconds.  ``--kernels`` runs the SECOND tier instead: kernelcheck
+(:mod:`crdt_tpu.analysis.jaxpr_rules`) imports jax under
+``JAX_PLATFORMS=cpu``, traces every manifested kernel abstractly and
+lints the jaxprs (KC01-KC05); same exit codes, same ``--json`` shape
+plus a ``kernelcheck`` stats block, same baseline file.
 """
 
 from __future__ import annotations
@@ -49,7 +54,18 @@ def main(argv=None) -> int:
                         help="run only this rule (repeatable)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print registered rule names and exit")
+    parser.add_argument("--kernels", action="store_true",
+                        help="run the jaxpr tier (kernelcheck, KC01-KC05) "
+                             "instead of the AST lint; imports jax under "
+                             "JAX_PLATFORMS=cpu")
     args = parser.parse_args(argv)
+
+    if args.kernels:
+        if args.paths or args.rules:
+            print("crdtlint: --kernels takes no paths/--rule (the kernel "
+                  "manifest defines the scan set)", file=sys.stderr)
+            return 2
+        return _main_kernels(args)
 
     if args.list_rules:
         for name in rule_names():
@@ -114,6 +130,54 @@ def main(argv=None) -> int:
                   file=sys.stderr)
     print(("OK: " if result.ok else "FAIL: ") + tallies,
           file=sys.stderr)
+    return 0 if result.ok else 1
+
+
+def _main_kernels(args) -> int:
+    """The --kernels tier: trace the manifest, lint the jaxprs."""
+    # jax must see the platform pin before first import — kernelcheck
+    # is a static analyzer, it never needs (or wants) an accelerator
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    baseline = None
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            baseline = Baseline.load(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"crdtlint: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    from .jaxpr_rules import run_kernelcheck
+
+    result, report = run_kernelcheck(baseline=baseline)
+
+    if args.as_json:
+        out = result.to_json()
+        out["kernelcheck"] = report.to_json()
+        out["elapsed_s"] = report.elapsed_s
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.render())
+    for err in result.parse_errors:
+        print(f"{err} [trace-error]")
+    for sk in report.skipped:
+        print(f"kernelcheck: not traced: {sk['kernel']} ({sk['reason']})",
+              file=sys.stderr)
+    if result.stale_baseline:
+        print(f"kernelcheck: {len(result.stale_baseline)} stale baseline "
+              "entr(ies) matched nothing — delete them", file=sys.stderr)
+    tallies = (
+        f"{report.kernels} kernels ({report.traced} traced, "
+        f"{report.cases} trace cases, {len(report.skipped)} declared "
+        f"no-trace), {len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} pragma-suppressed, "
+        f"{len(result.baselined)} baselined, {report.elapsed_s:.2f}s"
+    )
+    print(("OK: " if result.ok else "FAIL: ") + tallies, file=sys.stderr)
     return 0 if result.ok else 1
 
 

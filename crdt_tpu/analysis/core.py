@@ -177,8 +177,10 @@ def rule_names() -> List[str]:
 
 def _ensure_rules_loaded() -> None:
     # rule modules self-register on import; imported lazily so `import
-    # crdt_tpu.analysis` stays cheap and cycle-free
-    from . import locks, telemetry, tracer, wire  # noqa: F401
+    # crdt_tpu.analysis` stays cheap and cycle-free (kernels registers
+    # the stdlib-side kernel-manifest rule; its jax-flavoured sibling
+    # jaxpr_rules is NOT loaded here — that is the --kernels tier)
+    from . import kernels, locks, telemetry, tracer, wire  # noqa: F401
 
 
 # -- file loading -------------------------------------------------------------

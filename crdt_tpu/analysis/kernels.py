@@ -1,0 +1,938 @@
+"""The kernel-contract manifest: every jitted entry point, declared.
+
+PR 4's crdtlint sees Python source only.  The contracts that keep
+lattice joins byte-identical live one layer lower, in the *compiled*
+program: an i64 primitive Mosaic cannot lower (the "jax 0.4.x Pallas
+skew" class), a float scatter-add whose accumulation order varies run
+to run, a closure-captured array baked into every lowering of the
+capacity-regrow ladder, a kernel that silently recompiles per batch
+size.  This module is the single source of truth those checks hang off:
+
+* :class:`KernelSpec` — one row per jitted kernel: where it lives
+  (``path`` + ``jit_name``, the AST coordinates of the ``jax.jit``
+  site), its determinism class, whether it is Mosaic-destined, its
+  compile budget across the canonical capacity ladder, and a ``build``
+  hook producing the abstract trace cases
+  (:mod:`crdt_tpu.analysis.jaxpr_rules` walks the resulting jaxprs).
+* :data:`MANIFEST` — the rows.  100% coverage of ``@jax.jit`` entry
+  points under ``crdt_tpu/`` is enforced by the ``kernel-manifest``
+  AST rule below (tier 1, stdlib-only, no jax import), the same
+  single-source discipline :mod:`crdt_tpu.obs.namespace` applies to
+  metric names.
+* :func:`iter_jit_sites` — the stdlib AST extractor both layers share:
+  a jit site is a ``jax.jit``/``functools.partial(jax.jit, ...)``
+  decorator or a direct ``jax.jit(fn)`` call, named by the enclosing
+  def/class chain (``_scatter_adds_kernel.kernel``,
+  ``PipelinedWireLoop._merge_jnp.<jit>``).
+
+Import contract: importing this module must stay stdlib-only (the AST
+rule gates tier-1 CI on jax-free boxes).  Everything jax-flavoured
+lives inside the ``build`` closures, which only run under
+``python -m crdt_tpu.analysis --kernels``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, List, Optional
+
+from .core import Finding, ParsedFile, dotted_name, rule
+
+# ---------------------------------------------------------------------------
+# the canonical capacity ladder
+# ---------------------------------------------------------------------------
+
+#: (num_actors, member_capacity, deferred_capacity) rungs of the regrow
+#: ladder kernelcheck traces every ORSWOT-shaped kernel across — the
+#: same doubling walk ``with_capacity`` takes when a merge overflows
+#: (parallel/executor.py regrow path).  One fresh lowering per rung is
+#: the expected cost; KC04 fails a kernel whose ladder produces MORE
+#: distinct lowerings than its declared budget.
+LADDER = ((8, 8, 4), (8, 16, 8), (8, 32, 8))
+
+#: actor-axis rungs for clock/counter-plane kernels (num_actors regrow)
+ACTOR_LADDER = (8, 16, 32)
+
+LADDER_N = 8   # objects per fleet in trace cases
+LADDER_R = 3   # stacked replicas for fold kernels
+LADDER_B = 16  # op-batch rows (power of two: the padded scatter shape)
+
+
+# ---------------------------------------------------------------------------
+# jit-site extraction (stdlib, shared by the AST rule and kernelcheck)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class JitSite:
+    """One ``jax.jit`` application in one source file."""
+
+    name: str  # enclosing def/class chain + target, "." joined
+    line: int
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    return dotted_name(node) == "jax.jit"
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):  # @jax.jit(...) factory form
+            return True
+        if (dotted_name(dec.func) in ("functools.partial", "partial")
+                and dec.args and _is_jit_expr(dec.args[0])):
+            return True
+    return False
+
+
+def iter_jit_sites(tree: ast.AST) -> List[JitSite]:
+    """Every jit application in ``tree``, deterministically named:
+
+    * a jit-decorated ``def`` → the def/class chain
+      (``PipelinedWireLoop._merge_jnp`` style, dots, no ``<locals>``);
+    * a direct ``jax.jit(target, ...)`` call → the enclosing chain plus
+      the target's trailing identifier (``_jit.fn``), ``<lambda>`` for
+      lambdas, ``<jit>`` for computed targets such as
+      ``jax.jit(functools.partial(...))``.
+    """
+    sites: List[JitSite] = []
+    deco_calls: set = set()
+
+    def visit(node: ast.AST, scope: tuple) -> None:
+        child_scope = scope
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _decorator_is_jit(dec):
+                    deco_calls.add(id(dec))
+            if any(_decorator_is_jit(d) for d in node.decorator_list):
+                sites.append(
+                    JitSite(".".join(scope + (node.name,)), node.lineno))
+            child_scope = scope + (node.name,)
+        elif isinstance(node, ast.ClassDef):
+            child_scope = scope + (node.name,)
+        elif (isinstance(node, ast.Call) and id(node) not in deco_calls
+              and _is_jit_expr(node.func)):
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Name):
+                leaf = arg.id
+            elif isinstance(arg, ast.Attribute):
+                leaf = arg.attr
+            elif isinstance(arg, ast.Lambda):
+                leaf = "<lambda>"
+            else:
+                leaf = "<jit>"
+            sites.append(JitSite(".".join(scope + (leaf,)), node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, child_scope)
+
+    visit(tree, ())
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# the manifest rows
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TraceCase:
+    """One abstract call of one kernel: statics pre-bound, array args as
+    ``jax.ShapeDtypeStruct``\\s.  ``key`` fingerprints the static
+    arguments; the harness appends the arg avals to form the jit cache
+    key KC04 counts."""
+
+    rung: str
+    fn: Callable
+    args: tuple
+    key: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelSpec:
+    """Declared contract for one jitted kernel.
+
+    ``determinism`` classes: ``"bitwise"`` (output is a pure lattice
+    fold — byte-identical across devices and merge orders, the digest
+    oracle's requirement), ``"integer-lattice"`` (integer scatter/fold —
+    order-free by associativity, the sanctioned scatter-max witness
+    idiom), ``"float-accum"`` (floating-point accumulation — order
+    sensitivity must be justified; none shipped today).  KC02 sanctions
+    integer lattice folds and flags unordered float scatter-adds
+    everywhere.
+
+    ``compile_budget`` bounds the DISTINCT lowerings the trace cases may
+    produce (jit cache keys: static fingerprint + arg avals).  The
+    regrow ladder legitimately recompiles once per rung; a kernel that
+    retraces on anything else blows the budget — KC04.
+
+    ``build`` returns the :class:`TraceCase` list, importing jax/numpy
+    lazily.  ``build=None`` rows are manifest-covered but not traced
+    (``notrace_reason`` says why; the CLI reports them, never silently).
+    """
+
+    name: str                     # stable kernel id, e.g. "batch.orswot.merge"
+    path: str                     # repo-relative source file
+    jit_name: str                 # AST site name (see iter_jit_sites)
+    determinism: str = "bitwise"
+    mosaic: bool = False          # Mosaic/TPU-destined (KC01 strict)
+    compile_budget: int = 3
+    const_budget: int = 1 << 16   # KC03: max baked-constant bytes per trace
+    hot_path: bool = True         # KC05: host callbacks forbidden
+    build: Optional[Callable[[], List[TraceCase]]] = None
+    notrace_reason: str = ""
+
+
+# -- builder helpers (jax/numpy imported lazily, never at module scope) ------
+
+
+def _cfg(a: int, m: int, d: int, mv: int = 4, k: int = 4):
+    from ..config import CrdtConfig
+
+    return CrdtConfig(num_actors=a, member_capacity=m, deferred_capacity=d,
+                      mv_capacity=mv, key_capacity=k)
+
+
+def _sds(tree):
+    """Every array leaf of ``tree`` replaced by its ShapeDtypeStruct."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
+def _orswot_planes(a: int, m: int, d: int, n: int = LADDER_N):
+    from ..batch.orswot_batch import OrswotBatch
+    from ..utils.interning import Universe
+
+    b = OrswotBatch.zeros(n, Universe.identity(_cfg(a, m, d)))
+    return _sds((b.clock, b.ids, b.dots, b.d_ids, b.d_clocks))
+
+
+def _stacked(planes, r: int = LADDER_R):
+    import jax
+
+    return tuple(
+        jax.ShapeDtypeStruct((r,) + p.shape, p.dtype) for p in planes)
+
+
+def _vec(n, dtype_name):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct((n,), getattr(jnp, dtype_name))
+
+
+def _mat(shape, dtype_name):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(shape), getattr(jnp, dtype_name))
+
+
+def _clock_dt():
+    import jax.numpy as jnp
+
+    from ..config import enable_x64
+
+    return "uint64" if enable_x64() else "uint32"
+
+
+def _cpu_mesh(axis: str = "replicas"):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices("cpu")[:1]), (axis,))
+
+
+def _unjit(fn):
+    """The traceable callable behind a jitted one (tracing through the
+    pjit wrapper would work too — the walkers recurse into sub-jaxprs —
+    but the bare function keeps static arguments plain Python)."""
+    return getattr(fn, "__wrapped__", fn)
+
+
+# -- builders ----------------------------------------------------------------
+
+
+def _b_orswot_batch(kernel_attr: str, statics: Callable = None,
+                    extra: Callable = None, stacked: bool = False):
+    """Shared builder for the orswot_batch jitted kernels: planes across
+    the ladder, plus ``extra(a, m, d) -> tuple`` trailing args and
+    ``statics(a, m, d) -> dict`` pre-bound keywords."""
+
+    def build():
+        import functools
+
+        from ..batch import orswot_batch as ob
+
+        fn = _unjit(getattr(ob, kernel_attr))
+        cases = []
+        for (a, m, d) in LADDER:
+            planes = _orswot_planes(a, m, d)
+            if stacked:
+                planes = _stacked(planes)
+            kw = statics(a, m, d) if statics else {}
+            args = planes + (extra(a, m, d) if extra else ())
+            cases.append(TraceCase(
+                rung=f"A{a}.M{m}.D{d}",
+                fn=functools.partial(fn, **kw) if kw else fn,
+                args=args,
+                key=tuple(sorted(kw.items())),
+            ))
+        return cases
+
+    return build
+
+
+def _b_orswot_merge():
+    def build():
+        import functools
+
+        from ..batch import orswot_batch as ob
+
+        fn = _unjit(ob._merge)
+        cases = []
+        for (a, m, d) in LADDER:
+            planes = _orswot_planes(a, m, d)
+            cases.append(TraceCase(
+                rung=f"A{a}.M{m}.D{d}",
+                fn=functools.partial(fn, m_cap=m, d_cap=d, impl="rank"),
+                args=planes + planes,
+                key=(m, d, "rank"),
+            ))
+        return cases
+
+    return build
+
+
+def _b_counter_merge(module: str, shape):
+    """Clock/counter-plane pairwise merges across the actor ladder;
+    ``shape(a) -> plane shape``."""
+
+    def build():
+        import importlib
+
+        mod = importlib.import_module(f"crdt_tpu.batch.{module}")
+        fn = _unjit(mod._merge)
+        dt = _clock_dt()
+        cases = []
+        for a in ACTOR_LADDER:
+            p = _mat(shape(a), dt)
+            cases.append(TraceCase(rung=f"A{a}", fn=fn, args=(p, p)))
+        return cases
+
+    return build
+
+
+def _b_gset_merge():
+    def build():
+        from ..batch import gset_batch as gb
+
+        fn = _unjit(gb._merge)
+        cases = []
+        for cap in (64, 128, 256):  # member-bitmap capacity ladder
+            p = _mat((LADDER_N, cap), "bool_")
+            cases.append(TraceCase(rung=f"K{cap}", fn=fn, args=(p, p)))
+        return cases
+
+    return build
+
+
+def _b_lww_merge():
+    def build():
+        from ..batch import lwwreg_batch as lb
+
+        fn = _unjit(lb._merge)
+        dt = _clock_dt()
+        cases = []
+        for n in (8, 64, 512):  # register-count ladder (no capacity axis)
+            v, m = _vec(n, dt), _vec(n, dt)
+            cases.append(TraceCase(rung=f"N{n}", fn=fn, args=(v, m, v, m)))
+        return cases
+
+    return build
+
+
+def _b_mvreg(kernel_attr: str, with_op: bool = False, k_static: bool = True):
+    def build():
+        import functools
+
+        from ..batch import mvreg_batch as mb
+        from ..batch.mvreg_batch import MVRegBatch
+        from ..utils.interning import Universe
+
+        fn = _unjit(getattr(mb, kernel_attr))
+        cases = []
+        for (a, mv) in ((8, 4), (8, 8), (16, 8)):  # antichain regrow
+            b = MVRegBatch.zeros(LADDER_N, Universe.identity(
+                _cfg(a, 8, 4, mv=mv)))
+            c, v = _sds((b.clocks, b.vals))
+            if kernel_attr == "_merge":
+                args = (c, v, c, v)
+            elif kernel_attr == "_apply_put":
+                args = (c, v, _mat((LADDER_N, a), _clock_dt()),
+                        _vec(LADDER_N, _clock_dt()))
+            else:  # _truncate
+                args = (c, v, _mat((LADDER_N, a), _clock_dt()))
+            kw = {"k_cap": mv} if k_static else {}
+            cases.append(TraceCase(
+                rung=f"A{a}.K{mv}",
+                fn=functools.partial(fn, **kw) if kw else fn,
+                args=args, key=tuple(sorted(kw.items())),
+            ))
+        return cases
+
+    return build
+
+
+def _map_fixture(a: int, k: int, d: int):
+    from ..batch.map_batch import MapBatch
+    from ..batch.val_kernels import MVRegKernel
+    from ..utils.interning import Universe
+
+    cfg = _cfg(a, 8, d, mv=2, k=k)
+    uni = Universe.identity(cfg)
+    batch = MapBatch.zeros(LADDER_N, uni, MVRegKernel.from_config(cfg))
+    return batch
+
+
+_MAP_LADDER = ((8, 4, 4), (8, 8, 4), (8, 16, 8))  # (A, key_cap, deferred)
+
+
+def _b_map(kernel_attr: str):
+    def build():
+        import functools
+
+        from ..batch import map_batch as mb
+
+        fn = _unjit(getattr(mb, kernel_attr))
+        dt = _clock_dt()
+        cases = []
+        for (a, k, d) in _MAP_LADDER:
+            batch = _map_fixture(a, k, d)
+            state = _sds(batch.state)
+            kern = batch.kernel
+            if kernel_attr == "_merge":
+                args, kw = (state, state), {"kernel": kern}
+            elif kernel_attr == "_truncate":
+                args, kw = (state, _mat((LADDER_N, a), dt)), {"kernel": kern}
+            elif kernel_attr == "_apply_rm":
+                args = (state, _mat((LADDER_N, a), dt), _vec(LADDER_N, "int32"))
+                kw = {"kernel": kern}
+            else:  # _apply_up: nested MVReg put
+                args = (
+                    state, _vec(LADDER_N, "int32"), _vec(LADDER_N, dt),
+                    _vec(LADDER_N, "int32"),
+                    (_mat((LADDER_N, a), dt), _vec(LADDER_N, dt)),
+                )
+                kw = {"nested_op": "apply_put", "kernel": kern}
+            cases.append(TraceCase(
+                rung=f"A{a}.K{k}.D{d}",
+                fn=functools.partial(fn, **kw), args=args,
+                key=(kernel_attr, a, k, d),
+            ))
+        return cases
+
+    return build
+
+
+def _b_wireloop_merge():
+    def build():
+        import functools
+
+        from ..ops import orswot_ops
+
+        cases = []
+        for (a, m, d) in LADDER:
+            planes = _orswot_planes(a, m, d)
+            cases.append(TraceCase(
+                rung=f"A{a}.M{m}.D{d}",
+                fn=functools.partial(orswot_ops.merge, m_cap=m, d_cap=d),
+                args=planes + planes, key=(m, d),
+            ))
+        return cases
+
+    return build
+
+
+def _b_derive_ctx():
+    def build():
+        from ..oplog import records
+
+        fn = records._derive_kernel_host
+        cases = []
+        for a in ACTOR_LADDER:
+            cases.append(TraceCase(
+                rung=f"A{a}.B{LADDER_B}", fn=fn,
+                args=(_mat((LADDER_N, a), _clock_dt()),
+                      _vec(LADDER_B, "int64"), _vec(LADDER_B, "int32")),
+            ))
+        return cases
+
+    return build
+
+
+def _b_scatter_adds():
+    def build():
+        import functools
+
+        from ..oplog import apply as ap
+
+        fn = _unjit(ap._scatter_adds_kernel())
+        cases = []
+        for i, (a, m, d) in enumerate(LADDER):
+            planes = _orswot_planes(a, m, d)
+            kb = kp = LADDER_B
+            ops = (_vec(kb, "int64"), _vec(kb, "int32"), _vec(kb, _clock_dt()),
+                   _vec(kb, "int64"), _vec(kp, "int64"), _vec(kp, "int64"),
+                   _vec(kp, "int32"))
+            # both sides of the deferred-replay dispatch on the first
+            # rung, replay-only afterwards: budget = len(LADDER) + 1
+            for replay in ((False, True) if i == 0 else (True,)):
+                cases.append(TraceCase(
+                    rung=f"A{a}.M{m}.D{d}.replay={replay}",
+                    fn=functools.partial(fn, replay=replay),
+                    args=planes + ops, key=(replay,),
+                ))
+        return cases
+
+    return build
+
+
+def _b_oplog_counter(kernel_attr: str, pn: bool):
+    def build():
+        from ..oplog import apply as ap
+
+        fn = getattr(ap, kernel_attr)
+        dt = _clock_dt()
+        cases = []
+        for a in ACTOR_LADDER:
+            plane = _mat((LADDER_N, 2, a) if pn else (LADDER_N, a), dt)
+            ops = (_vec(LADDER_B, "int64"),) + (
+                (_vec(LADDER_B, "int32"),) if pn else ()) + (
+                _vec(LADDER_B, "int32"), _vec(LADDER_B, dt))
+            cases.append(TraceCase(rung=f"A{a}", fn=fn, args=(plane,) + ops))
+        return cases
+
+    return build
+
+
+def _b_digest(which: str):
+    def build():
+        from ..sync import digest
+
+        cases = []
+        if which == "orswot":
+            fn = _unjit(digest._orswot_kernel())
+            for (a, m, d) in LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}.M{m}.D{d}", fn=fn,
+                    args=_orswot_planes(a, m, d)))
+        elif which == "counter":
+            fn = _unjit(digest._counter_kernel())
+            for a in ACTOR_LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}", fn=fn,
+                    args=(_mat((LADDER_N, a), _clock_dt()),)))
+            # the PNCounter plane shape is a distinct (legitimate)
+            # lowering: [N, 2, A] reshapes to [N, 2A]
+            cases.append(TraceCase(
+                rung="A8.pn", fn=fn,
+                args=(_mat((LADDER_N, 2, 8), _clock_dt()),)))
+        else:  # lww
+            fn = _unjit(digest._lww_kernel())
+            for n in (8, 64, 512):
+                cases.append(TraceCase(
+                    rung=f"N{n}", fn=fn,
+                    args=(_vec(n, _clock_dt()), _vec(n, _clock_dt()))))
+        return cases
+
+    return build
+
+
+def _b_collective(which: str):
+    def build():
+        import functools
+
+        from ..parallel import collective as co
+
+        mesh = _cpu_mesh("replicas")
+        dt = _clock_dt()
+        cases = []
+        if which == "clock":
+            for a in ACTOR_LADDER:
+                fn = _unjit(co._clock_join_fn(mesh, "replicas", 2))
+                cases.append(TraceCase(
+                    rung=f"A{a}", fn=fn, args=(_mat((1, a), dt),), key=(2,)))
+        elif which == "lww":
+            for n in (8, 64, 512):
+                fn = _unjit(co._lww_join_fn(mesh, "replicas", 1))
+                cases.append(TraceCase(
+                    rung=f"N{n}", fn=fn,
+                    args=(_vec(n, dt), _vec(n, dt)), key=(1,)))
+        elif which == "mvreg":
+            for (a, mv) in ((8, 4), (8, 8), (16, 8)):
+                fn = _unjit(co._mvreg_join_fn(mesh, "replicas", mv, 3, 2))
+                cases.append(TraceCase(
+                    rung=f"A{a}.K{mv}", fn=fn,
+                    args=(_mat((1, mv, a), dt), _mat((1, mv), dt)),
+                    key=(mv,)))
+        elif which == "orswot":
+            for (a, m, d) in LADDER:
+                planes = tuple(
+                    _mat((1,) + p.shape, p.dtype.name)
+                    for p in _orswot_planes(a, m, d, n=LADDER_N))
+                fn = _unjit(co._orswot_join_fn(
+                    mesh, "replicas", m, d,
+                    tuple(p.ndim for p in planes), "rank", None))
+                cases.append(TraceCase(
+                    rung=f"A{a}.M{m}.D{d}", fn=fn, args=(planes,),
+                    key=(m, d, "rank")))
+        elif which == "map":
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            for (a, k, d) in _MAP_LADDER:
+                batch = _map_fixture(a, k, d)
+                state = _sds(batch.state)
+                state1 = jax.tree_util.tree_map(
+                    lambda x: _mat((1,) + x.shape, x.dtype.name), state)
+                specs = jax.tree_util.tree_map(
+                    lambda x: P("replicas", *([None] * (x.ndim - 1))),
+                    state1)
+                flat_specs, spec_tree = jax.tree_util.tree_flatten(specs)
+                fn = _unjit(co._map_join_fn(
+                    mesh, "replicas", batch.kernel, tuple(flat_specs),
+                    spec_tree))
+                cases.append(TraceCase(
+                    rung=f"A{a}.K{k}.D{d}", fn=fn, args=(state1,),
+                    key=(a, k, d)))
+        elif which in ("ae_fold", "ae_plunge"):
+            for (a, m, d) in LADDER:
+                fold, plunge = co._anti_entropy_kernels(m, d, "rank")
+                fn = _unjit(fold if which == "ae_fold" else plunge)
+                planes = _orswot_planes(a, m, d)
+                args = (_stacked(planes),) if which == "ae_fold" \
+                    else (planes,)
+                cases.append(TraceCase(
+                    rung=f"A{a}.M{m}.D{d}", fn=fn, args=args,
+                    key=(m, d, "rank")))
+        return cases
+
+    return build
+
+
+def _b_member_sharding(which: str):
+    def build():
+        from ..parallel import member_sharding as ms
+
+        mesh = _cpu_mesh("members")
+        dt = _clock_dt()
+        cases = []
+        for (a, m, d) in LADDER:
+            planes = tuple(
+                _mat((1,) + p.shape, p.dtype.name)
+                for p in _orswot_planes(a, m, d))
+            if which == "clock":
+                fn = _unjit(ms._clock_join_fn(mesh, "members"))
+                cases.append(TraceCase(
+                    rung=f"A{a}", fn=fn, args=(planes[0],)))
+            else:
+                fn = _unjit(ms._apply_add_fn(mesh, "members", 1))
+                ops = (_vec(1, "int32"), _vec(LADDER_N, "int32"),
+                       _vec(LADDER_N, dt), _vec(LADDER_N, "int32"))
+                cases.append(TraceCase(
+                    rung=f"A{a}.M{m}.D{d}", fn=fn,
+                    args=(planes,) + ops, key=(1,)))
+        return cases
+
+    return build
+
+
+def _b_pallas(module: str, kernel_attr: str, fold: bool):
+    """Mosaic kernels trace with ``interpret=False`` (abstract tracing
+    never enters Mosaic; lowering does, which is exactly what KC01
+    guards) and uint32 planes (their hard API precondition)."""
+
+    def build():
+        import functools
+        import importlib
+
+        mod = importlib.import_module(f"crdt_tpu.ops.{module}")
+        fn = _unjit(getattr(mod, kernel_attr))
+        cases = []
+        for (a, m, d) in LADDER:
+            planes = (
+                _mat((LADDER_N, a), "uint32"),
+                _mat((LADDER_N, m), "int32"),
+                _mat((LADDER_N, m, a), "uint32"),
+                _mat((LADDER_N, d), "int32"),
+                _mat((LADDER_N, d, a), "uint32"),
+            )
+            if fold:
+                args = _stacked(planes)
+            else:
+                args = planes + planes
+            cases.append(TraceCase(
+                rung=f"A{a}.M{m}.D{d}",
+                fn=functools.partial(fn, m_cap=m, d_cap=d, interpret=False),
+                args=args, key=(m, d)))
+        return cases
+
+    return build
+
+
+# -- the rows ----------------------------------------------------------------
+
+_OB = "crdt_tpu/batch/orswot_batch.py"
+_CO = "crdt_tpu/parallel/collective.py"
+_AP = "crdt_tpu/oplog/apply.py"
+
+MANIFEST: tuple = (
+    # batch/orswot_batch.py ---------------------------------------------------
+    KernelSpec("batch.orswot.device_nnz", _OB, "_device_nnz",
+               build=_b_orswot_batch("_device_nnz")),
+    KernelSpec("batch.orswot.device_compact", _OB, "_device_compact",
+               build=_b_orswot_batch(
+                   "_device_compact",
+                   statics=lambda a, m, d: {
+                       "sizes": (LADDER_N * a, LADDER_N * m,
+                                 LADDER_N * m, LADDER_N * d, LADDER_N * d),
+                       "with_entries": True})),
+    KernelSpec("batch.orswot.device_expand", _OB, "_device_expand",
+               determinism="integer-lattice",
+               build=lambda: _build_device_expand()),
+    KernelSpec("batch.orswot.merge", _OB, "_merge",
+               build=_b_orswot_merge()),
+    KernelSpec("batch.orswot.fold_tree", _OB, "_fold_tree",
+               build=_b_orswot_batch(
+                   "_fold_tree", stacked=True,
+                   statics=lambda a, m, d: {
+                       "m_cap": m, "d_cap": d, "plunger": True,
+                       "impl": "rank"})),
+    KernelSpec("batch.orswot.apply_add", _OB, "_apply_add",
+               build=_b_orswot_batch(
+                   "_apply_add",
+                   extra=lambda a, m, d: (
+                       _vec(LADDER_N, "int32"), _vec(LADDER_N, _clock_dt()),
+                       _vec(LADDER_N, "int32")))),
+    KernelSpec("batch.orswot.apply_remove", _OB, "_apply_remove",
+               build=_b_orswot_batch(
+                   "_apply_remove",
+                   extra=lambda a, m, d: (
+                       _mat((LADDER_N, a), _clock_dt()),
+                       _vec(LADDER_N, "int32")))),
+    KernelSpec("batch.orswot.truncate", _OB, "_truncate",
+               build=_b_orswot_batch(
+                   "_truncate",
+                   statics=lambda a, m, d: {"m_cap": m, "d_cap": d},
+                   extra=lambda a, m, d: (_mat((LADDER_N, a), _clock_dt()),))),
+    # the scalar-plane batch merges ------------------------------------------
+    KernelSpec("batch.vclock.merge", "crdt_tpu/batch/vclock_batch.py",
+               "_merge", build=_b_counter_merge(
+                   "vclock_batch", lambda a: (LADDER_N, a))),
+    KernelSpec("batch.gcounter.merge", "crdt_tpu/batch/gcounter_batch.py",
+               "_merge", build=_b_counter_merge(
+                   "gcounter_batch", lambda a: (LADDER_N, a))),
+    KernelSpec("batch.pncounter.merge", "crdt_tpu/batch/pncounter_batch.py",
+               "_merge", build=_b_counter_merge(
+                   "pncounter_batch", lambda a: (LADDER_N, 2, a))),
+    KernelSpec("batch.gset.merge", "crdt_tpu/batch/gset_batch.py",
+               "_merge", build=_b_gset_merge()),
+    KernelSpec("batch.lwwreg.merge", "crdt_tpu/batch/lwwreg_batch.py",
+               "_merge", build=_b_lww_merge()),
+    KernelSpec("batch.mvreg.merge", "crdt_tpu/batch/mvreg_batch.py",
+               "_merge", build=_b_mvreg("_merge")),
+    KernelSpec("batch.mvreg.apply_put", "crdt_tpu/batch/mvreg_batch.py",
+               "_apply_put", build=_b_mvreg("_apply_put")),
+    KernelSpec("batch.mvreg.truncate", "crdt_tpu/batch/mvreg_batch.py",
+               "_truncate", build=_b_mvreg("_truncate", k_static=False)),
+    # batch/map_batch.py -----------------------------------------------------
+    KernelSpec("batch.map.merge", "crdt_tpu/batch/map_batch.py", "_merge",
+               build=_b_map("_merge")),
+    KernelSpec("batch.map.truncate", "crdt_tpu/batch/map_batch.py",
+               "_truncate", build=_b_map("_truncate")),
+    KernelSpec("batch.map.apply_rm", "crdt_tpu/batch/map_batch.py",
+               "_apply_rm", build=_b_map("_apply_rm")),
+    KernelSpec("batch.map.apply_up", "crdt_tpu/batch/map_batch.py",
+               "_apply_up", build=_b_map("_apply_up")),
+    # batch/wireloop.py ------------------------------------------------------
+    KernelSpec("batch.wireloop.fold_merge", "crdt_tpu/batch/wireloop.py",
+               "PipelinedWireLoop._merge_jnp.<jit>",
+               build=_b_wireloop_merge()),
+    # oplog ------------------------------------------------------------------
+    KernelSpec("oplog.derive_add_ctx", "crdt_tpu/oplog/records.py",
+               "_derive_kernel._derive_kernel_host",
+               build=_b_derive_ctx()),
+    KernelSpec("oplog.scatter_adds", _AP, "_scatter_adds_kernel.kernel",
+               determinism="integer-lattice",
+               compile_budget=len(LADDER) + 1,
+               build=_b_scatter_adds()),
+    KernelSpec("oplog.gcounter_scatter", _AP,
+               "apply_gcounter_ops._counter_scatter",
+               determinism="integer-lattice",
+               build=_b_oplog_counter("_counter_scatter", pn=False)),
+    KernelSpec("oplog.pncounter_scatter", _AP,
+               "apply_pncounter_ops._pn_scatter",
+               determinism="integer-lattice",
+               build=_b_oplog_counter("_pn_scatter", pn=True)),
+    # sync/digest.py ---------------------------------------------------------
+    KernelSpec("sync.digest.orswot", "crdt_tpu/sync/digest.py", "_jit.fn",
+               build=_b_digest("orswot")),
+    KernelSpec("sync.digest.counter", "crdt_tpu/sync/digest.py", "_jit.fn",
+               compile_budget=len(ACTOR_LADDER) + 1,
+               build=_b_digest("counter")),
+    KernelSpec("sync.digest.lww", "crdt_tpu/sync/digest.py", "_jit.fn",
+               build=_b_digest("lww")),
+    # parallel/collective.py -------------------------------------------------
+    KernelSpec("parallel.clock_join", _CO, "_clock_join_fn._join",
+               build=_b_collective("clock")),
+    KernelSpec("parallel.lww_join", _CO, "_lww_join_fn._join",
+               build=_b_collective("lww")),
+    KernelSpec("parallel.mvreg_join", _CO, "_mvreg_join_fn._join",
+               build=_b_collective("mvreg")),
+    KernelSpec("parallel.orswot_join", _CO, "_orswot_join_fn._join",
+               build=_b_collective("orswot")),
+    KernelSpec("parallel.shard_local_merge", _CO,
+               "shard_local_merge_fn._local",
+               build=lambda: _build_shard_local_merge()),
+    KernelSpec("parallel.map_join", _CO, "_map_join_fn._join",
+               build=_b_collective("map")),
+    KernelSpec("parallel.anti_entropy_fold", _CO,
+               "_anti_entropy_kernels._fold",
+               build=_b_collective("ae_fold")),
+    KernelSpec("parallel.anti_entropy_plunge", _CO,
+               "_anti_entropy_kernels._plunge",
+               build=_b_collective("ae_plunge")),
+    # parallel/member_sharding.py --------------------------------------------
+    KernelSpec("parallel.member_clock_join",
+               "crdt_tpu/parallel/member_sharding.py",
+               "_clock_join_fn._join",
+               build=_b_member_sharding("clock")),
+    KernelSpec("parallel.member_apply_add",
+               "crdt_tpu/parallel/member_sharding.py",
+               "_apply_add_fn._local",
+               build=_b_member_sharding("apply_add")),
+    # ops: the Mosaic-destined Pallas kernels --------------------------------
+    KernelSpec("ops.pallas.merge", "crdt_tpu/ops/orswot_pallas.py",
+               "merge", mosaic=True,
+               build=_b_pallas("orswot_pallas", "merge", fold=False)),
+    KernelSpec("ops.pallas.fold_merge", "crdt_tpu/ops/orswot_pallas.py",
+               "fold_merge", mosaic=True,
+               build=_b_pallas("orswot_pallas", "fold_merge", fold=True)),
+    KernelSpec("ops.fold_aligned.fold_merge",
+               "crdt_tpu/ops/orswot_fold_aligned.py",
+               "fold_merge", mosaic=True,
+               build=_b_pallas("orswot_fold_aligned", "fold_merge",
+                               fold=True)),
+    # utils/benchtime.py: bench-harness scaffolding, manifest-covered but
+    # not traced — the jitted bodies are caller-shaped (a warmup +1 lambda
+    # and a closure over the caller's step fn), so there is no canonical
+    # abstract call to declare.  hot_path=False: they ARE the timing
+    # harness, host sync is their job.
+    KernelSpec("utils.benchtime.sync_probe", "crdt_tpu/utils/benchtime.py",
+               "sync_overhead.<lambda>", hot_path=False,
+               notrace_reason="warmup lambda; shapes fixed at call site, "
+                              "no CRDT contract"),
+    KernelSpec("utils.benchtime.chain_timer", "crdt_tpu/utils/benchtime.py",
+               "chain_timer.run", hot_path=False,
+               notrace_reason="closure over the caller-supplied step fn; "
+                              "shapes are caller-defined"),
+)
+
+
+def _build_device_expand():
+    import functools
+
+    from ..batch import orswot_batch as ob
+
+    fn = _unjit(ob._device_expand)
+    cases = []
+    for (a, m, d) in LADDER:
+        dt = _clock_dt()
+        k = LADDER_B
+        cells = (  # (clock, entry, dot, dref, dclk) compact columns
+            (_vec(k, "int32"), _vec(k, "int32"), _vec(k, dt)),
+            (_vec(k, "int32"), _vec(k, "int32"), _vec(k, "int32")),
+            (_vec(k, "int32"), _vec(k, "int32"), _vec(k, "int32"),
+             _vec(k, dt)),
+            (_vec(k, "int32"), _vec(k, "int32"), _vec(k, "int32")),
+            (_vec(k, "int32"), _vec(k, "int32"), _vec(k, "int32"),
+             _vec(k, dt)),
+        )
+        cases.append(TraceCase(
+            rung=f"A{a}.M{m}.D{d}",
+            fn=functools.partial(fn, n=LADDER_N, a=a, m=m, d=d),
+            args=(cells,), key=(LADDER_N, a, m, d)))
+    return cases
+
+
+def _build_shard_local_merge():
+    from ..parallel import collective as co
+
+    mesh = _cpu_mesh("objects")
+    cases = []
+    for (a, m, d) in LADDER:
+        planes = tuple(
+            _mat((1,) + p.shape[1:], p.dtype.name)
+            for p in _orswot_planes(a, m, d))
+        fn = _unjit(co.shard_local_merge_fn(mesh, "objects", m, d, "rank"))
+        cases.append(TraceCase(
+            rung=f"A{a}.M{m}.D{d}", fn=fn, args=(planes, planes),
+            key=(m, d, "rank")))
+    return cases
+
+
+def manifest_keys() -> set:
+    """The ``(path, jit_name)`` pairs the manifest covers."""
+    return {(s.path, s.jit_name) for s in MANIFEST}
+
+
+def specs_by_name() -> dict:
+    return {s.name: s for s in MANIFEST}
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 AST rule: every jit site under crdt_tpu/ has a manifest row
+# ---------------------------------------------------------------------------
+
+
+@rule("kernel-manifest")
+def _kernel_manifest_rule(files: List[ParsedFile]):
+    """Single-source discipline for jitted kernels, enforced at the
+    source tier (stdlib-only — runs before kernelcheck ever imports
+    jax): every ``jax.jit`` application under ``crdt_tpu/`` must have a
+    :class:`KernelSpec` row, and every row must still point at a live
+    jit site (stale rows rot the jaxpr tier's coverage silently)."""
+    covered = manifest_keys()
+    sites_by_rel: dict = {}
+    for pf in files:
+        if not pf.rel.startswith("crdt_tpu/"):
+            continue
+        if pf.rel.startswith("crdt_tpu/analysis/"):
+            continue  # the analyzer itself hosts no kernels
+        sites = iter_jit_sites(pf.tree)
+        sites_by_rel[pf.rel] = {s.name for s in sites}
+        for site in sites:
+            if (pf.rel, site.name) not in covered:
+                yield Finding(
+                    "kernel-manifest", pf.rel, site.line, 0,
+                    f"jit entry point {site.name!r} has no KernelSpec row "
+                    "in crdt_tpu/analysis/kernels.py — declare its shapes, "
+                    "determinism class and compile budget (kernelcheck "
+                    "cannot trace unmanifested kernels)",
+                )
+    # stale rows: only decidable for files actually in the scanned set
+    for spec in MANIFEST:
+        names = sites_by_rel.get(spec.path)
+        if names is not None and spec.jit_name not in names:
+            yield Finding(
+                "kernel-manifest", "crdt_tpu/analysis/kernels.py", 1, 0,
+                f"stale manifest row {spec.name!r}: no jit site named "
+                f"{spec.jit_name!r} in {spec.path} — the kernel moved or "
+                "was deleted; update the row",
+            )

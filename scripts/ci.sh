@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# The one CI gate: crdtlint (exit-code gated), then the tier-1 pytest
-# line from ROADMAP.md — builder and CI invoke the SAME entrypoint, so
-# "it passed locally" and "it passed in CI" mean the same command.
+# The one CI gate: crdtlint (exit-code gated), kernelcheck (the jaxpr
+# tier, exit-code gated), then the tier-1 pytest line from ROADMAP.md —
+# builder and CI invoke the SAME entrypoint, so "it passed locally" and
+# "it passed in CI" mean the same command.
 #
-#   scripts/ci.sh            # lint + tier-1
-#   scripts/ci.sh --lint     # lint only (seconds, jax-free)
+#   scripts/ci.sh            # lint + kernelcheck + tier-1
+#   scripts/ci.sh --lint     # AST lint only (seconds, jax-free)
 #
 # The tier-1 line mirrors ROADMAP.md "Tier-1 verify" verbatim: CPU
 # backend, `not slow`, collection errors don't abort, and the trailing
@@ -19,6 +20,24 @@ python -m crdt_tpu.analysis
 if [[ "${1:-}" == "--lint" ]]; then
     exit 0
 fi
+
+echo "== kernelcheck =="
+# the jaxpr tier: traces every manifested kernel abstractly on CPU and
+# lints the jaxprs (KC01-KC05).  The JSON artifact keeps the coverage
+# numbers (kernels/traced/cases/mosaic) diffable from the CI log.
+JAX_PLATFORMS=cpu python -m crdt_tpu.analysis --kernels --json \
+    > /tmp/kernelcheck.json || {
+    cat /tmp/kernelcheck.json
+    echo "kernelcheck FAILED (see findings above)" >&2
+    exit 1
+}
+python - <<'EOF'
+import json
+kc = json.load(open("/tmp/kernelcheck.json"))["kernelcheck"]
+print(f"kernelcheck OK: {kc['kernels']} kernels, {kc['traced']} traced, "
+      f"{kc['cases']} cases, {len(kc['skipped'])} declared no-trace, "
+      f"{kc['elapsed_s']}s (artifact: /tmp/kernelcheck.json)")
+EOF
 
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log

@@ -1,0 +1,107 @@
+"""Fixture: kernel-contract violations (MUST trigger KC01-KC05).
+
+Unlike the AST fixture twins (parsed, never imported), this module is
+imported AND traced by ``tests/test_kernelcheck.py`` — the jaxpr tier
+needs real traceable kernels.  Each ``fixture.*`` spec commits exactly
+one sin:
+
+* ``fixture.i64_lowering``   — an int64 op inside a pallas_call (KC01)
+* ``fixture.float_scatter``  — float scatter-add, no unique_indices (KC02)
+* ``fixture.baked_const``    — a 1 MB closure-captured array (KC03)
+* ``fixture.shape_special``  — statics keyed on raw batch size (KC04)
+* ``fixture.hidden_callback``— pure_callback in a hot-path kernel (KC05)
+
+jax imports live inside the builders so merely importing this module
+stays cheap; tests/ is outside the default scan set, so the repo-wide
+gates never see these.
+"""
+
+import numpy as np
+
+from crdt_tpu.analysis.kernels import KernelSpec, TraceCase
+
+HERE = "tests/analysis_fixtures/kernels_bad.py"
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, getattr(jnp, dtype))
+
+
+def _b_i64_pallas():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = (x_ref[...].astype(jnp.int64) + 1).astype(jnp.int32)
+
+    def widen(x):
+        return pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+            interpret=False,
+        )(x)
+
+    return [TraceCase("r0", widen, (_sds((8, 128), "int32"),))]
+
+
+def _b_float_scatter():
+    def fold(x, idx, upd):
+        return x.at[idx].add(upd)  # order-unspecified float accumulation
+
+    return [TraceCase(
+        "r0", fold,
+        (_sds((64,), "float32"), _sds((16,), "int32"),
+         _sds((16,), "float32")))]
+
+
+def _b_baked_const():
+    import jax.numpy as jnp
+
+    big = np.ones((512, 512), np.float32)  # 1 MB baked into every lowering
+
+    def shift(x):
+        return x + jnp.asarray(big)
+
+    return [TraceCase("r0", shift, (_sds((512, 512), "float32"),))]
+
+
+def _b_shape_special():
+    import functools
+
+    def head(x, k):
+        return x[:k]  # k is the RAW batch size: one lowering per call
+
+    return [
+        TraceCase(f"B{k}", functools.partial(head, k=k),
+                  (_sds((16,), "uint32"),), key=(k,))
+        for k in (3, 5, 7, 11)
+    ]
+
+
+def _b_hidden_callback():
+    import jax
+    import jax.numpy as jnp
+
+    def probe(x):
+        host = jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((8,), jnp.float32), x)
+        return host + 1
+
+    return [TraceCase("r0", probe, (_sds((8,), "float32"),))]
+
+
+SPECS = (
+    KernelSpec("fixture.i64_lowering", HERE, "widen", mosaic=True,
+               build=_b_i64_pallas),
+    KernelSpec("fixture.float_scatter", HERE, "fold",
+               determinism="float-accum", build=_b_float_scatter),
+    KernelSpec("fixture.baked_const", HERE, "shift", build=_b_baked_const),
+    KernelSpec("fixture.shape_special", HERE, "head", compile_budget=2,
+               build=_b_shape_special),
+    KernelSpec("fixture.hidden_callback", HERE, "probe",
+               build=_b_hidden_callback),
+)

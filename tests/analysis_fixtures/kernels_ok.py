@@ -1,0 +1,139 @@
+"""Fixture: kernelcheck ok-twins — sanctioned idioms and pragma'd sins.
+
+Two flavours, per the fixture-suite contract (ok twins must be
+SUPPRESSED where they sin, not merely inert):
+
+* genuinely clean idioms that must produce NO finding at all: the
+  integer scatter-``max`` witness fold (the dot-witness rule every
+  apply kernel uses), a float scatter-add with ``unique_indices=True``,
+  a large array passed as an argument instead of captured, statics
+  keyed on the padded capacity so the ladder shares one lowering, and
+  a host callback in a spec declared ``hot_path=False``;
+* the same sins as ``kernels_bad.py`` carrying a ``# crdtlint:
+  disable=KCxx`` pragma with a justification — they must land in the
+  ``suppressed`` bucket, proving the pragma machinery reaches
+  jaxpr-tier findings through the equations' source locations.
+"""
+
+import numpy as np
+
+from crdt_tpu.analysis.kernels import KernelSpec, TraceCase
+
+HERE = "tests/analysis_fixtures/kernels_ok.py"
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, getattr(jnp, dtype))
+
+
+# -- genuinely clean idioms ---------------------------------------------------
+
+
+def _b_witness_fold():
+    def fold(clock, obj, actor, counter):
+        # the sanctioned idiom: integer scatter-max IS the dot-witness
+        # rule, associative+commutative, delivery-order free
+        return clock.at[obj, actor].max(counter)
+
+    return [TraceCase(
+        "r0", fold,
+        (_sds((8, 8), "uint64"), _sds((16,), "int32"),
+         _sds((16,), "int32"), _sds((16,), "uint64")))]
+
+
+def _b_unique_float_scatter():
+    def fold(x, idx, upd):
+        # unique indices: no accumulation, order cannot matter
+        return x.at[idx].add(upd, unique_indices=True)
+
+    return [TraceCase(
+        "r0", fold,
+        (_sds((64,), "float32"), _sds((16,), "int32"),
+         _sds((16,), "float32")))]
+
+
+def _b_const_as_arg():
+    def shift(x, table):
+        return x + table  # the 1 MB table rides as an ARGUMENT
+
+    return [TraceCase(
+        "r0", shift,
+        (_sds((512, 512), "float32"), _sds((512, 512), "float32")))]
+
+
+def _b_padded_shapes():
+    import functools
+
+    def head(x, k):
+        return x[:k]
+
+    # raw batch sizes 3/5/7 all pad to capacity 8: ONE cache key
+    return [
+        TraceCase(f"B{b}", functools.partial(head, k=8),
+                  (_sds((16,), "uint32"),), key=(8,))
+        for b in (3, 5, 7)
+    ]
+
+
+def _b_cold_callback():
+    import jax
+    import jax.numpy as jnp
+
+    def probe(x):
+        host = jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((8,), jnp.float32), x)
+        return host + 1
+
+    return [TraceCase("r0", probe, (_sds((8,), "float32"),))]
+
+
+# -- pragma'd sins (must be suppressed, not clean) ----------------------------
+
+
+def _b_sanctioned_float_scatter():
+    def fold(x, idx, upd):
+        # sanctioned: bench-only diagnostic fold, never feeds a digest
+        return x.at[idx].add(upd)  # crdtlint: disable=KC02
+
+    return [TraceCase(
+        "r0", fold,
+        (_sds((64,), "float32"), _sds((16,), "int32"),
+         _sds((16,), "float32")))]
+
+
+def _b_sanctioned_const():
+    import jax.numpy as jnp
+
+    big = np.ones((512, 512), np.float32)
+
+    def shift(x):
+        return x + jnp.asarray(big)
+
+    return [TraceCase("r0", shift, (_sds((512, 512), "float32"),))]
+
+
+SPECS = (
+    KernelSpec("fixture_ok.witness_fold", HERE, "fold",
+               determinism="integer-lattice", build=_b_witness_fold),
+    KernelSpec("fixture_ok.unique_float_scatter", HERE, "fold",
+               build=_b_unique_float_scatter),
+    KernelSpec("fixture_ok.const_as_arg", HERE, "shift",
+               build=_b_const_as_arg),
+    KernelSpec("fixture_ok.padded_shapes", HERE, "head", compile_budget=1,
+               build=_b_padded_shapes),
+    # a declared cold path: callbacks allowed (KC05 scopes to hot_path)
+    KernelSpec("fixture_ok.cold_callback", HERE, "probe", hot_path=False,
+               build=_b_cold_callback),
+    KernelSpec("fixture_ok.sanctioned_float_scatter", HERE, "fold",
+               determinism="float-accum",
+               build=_b_sanctioned_float_scatter),
+    # consts carry no per-equation source frame, so KC03 sanctions go
+    # through baseline.json (justification mandatory) rather than a
+    # line pragma — the test parks this one via a baseline entry
+    KernelSpec("fixture_ok.baselined_const", HERE, "shift",
+               build=_b_sanctioned_const),
+)

@@ -73,9 +73,16 @@ def pytest_configure(config):
 # typed `UnsupportedBackendError` with a remediation message instead of
 # failing deep in Mosaic — and this harness keys its xfail marking off
 # the SAME predicate, so the test gate and the runtime gate can never
-# drift.  xfail — NOT skip — so the tier-1 output distinguishes "known
-# skew" (x) from a new regression, and a jax>=0.5 box runs the full
-# suite ungated.  The exempt tests never enter a Mosaic kernel (u64
+# drift.  A THIRD gate hangs off the same predicate: kernelcheck's KC01
+# (jaxpr tier, `python -m crdt_tpu.analysis --kernels`) proves the
+# Mosaic kernels are 64-bit-clean at the trace level, records
+# `pallas_mosaic_skew()` as its `skew_reason`, and re-flags any KC01
+# pragma as a stale sanction the moment the skew lifts — so this xfail
+# can only ever cover the version skew, never real 64-bit content
+# (cross-check pinned in tests/test_kernelcheck.py::
+# test_kc01_agrees_with_conftest_skew_gate).  xfail — NOT skip — so the
+# tier-1 output distinguishes "known skew" (x) from a new regression,
+# and a jax>=0.5 box runs the full suite ungated.  The exempt tests never enter a Mosaic kernel (u64
 # rejection / dispatch selection) and pass on 0.4.x; they stay live so
 # the gate can't mask regressions in the dispatch/rejection logic.
 

@@ -1467,6 +1467,83 @@ def bench_fleet_obs():
     return out
 
 
+def bench_capacity_obs():
+    """Capacity-observatory cost gate (the obs/capacity satellite): one
+    occupancy sample is one jitted reduction + a six-int host fetch,
+    and the gossip scheduler takes one per ROUND — so its cost must be
+    noise next to a round's real work.  Measures per-sample wall at
+    1k/64k/1M objects (plus the op-log/gap-buffer samples), pins the
+    reported plane bytes against the actual buffer nbytes at every
+    size, and asserts the largest per-sample cost is <1% of the
+    measured ``bench_e2e_wire`` wall."""
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.obs import metrics as obs_metrics
+    from crdt_tpu.obs.capacity import CapacityTracker
+    from crdt_tpu.oplog import OpBatch, OpLog
+    from crdt_tpu.utils.interning import Universe
+
+    cfg = CrdtConfig(num_actors=8, member_capacity=8, deferred_capacity=4,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+    sizes = (1_000, 16_000, 64_000) if SMALL else (1_000, 64_000, 1_000_000)
+    # private registry: bench probe gauges must not shadow live ones
+    trk = CapacityTracker(registry=obs_metrics.MetricsRegistry())
+    out = {}
+    worst_s = 0.0
+    for n in sizes:
+        batch = OrswotBatch.zeros(n, uni)
+        trk.sample(batch)  # compile + warm
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            occ = trk.sample(batch)
+        per = (time.perf_counter() - t0) / iters
+        nbytes = sum(x.nbytes for x in (batch.clock, batch.ids, batch.dots,
+                                        batch.d_ids, batch.d_clocks))
+        assert occ.bytes == nbytes, (
+            f"reported plane bytes {occ.bytes} != buffer nbytes {nbytes} "
+            f"at N={n}"
+        )
+        out[f"capacity_sample_ms_{n}"] = round(per * 1e3, 4)
+        worst_s = max(worst_s, per)
+        log(f"capacity obs: N={n}  sample {per*1e3:.3f}ms  "
+            f"plane bytes {nbytes/1e6:.1f}MB (exact)")
+        del batch
+    olog = OpLog(uni, capacity=1 << 16)
+    olog.append(OpBatch(kind=np.full(1024, 0, np.uint8),
+                        obj=np.arange(1024) % 64,
+                        actor=np.zeros(1024, np.int32),
+                        counter=np.arange(1, 1025, dtype=np.uint64),
+                        member=np.arange(1024, dtype=np.int32)))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        trk.sample_oplog(olog)
+    out["capacity_oplog_sample_ms"] = round(
+        (time.perf_counter() - t0) / 20 * 1e3, 4)
+
+    e2e_s = _JSON_STATE.get("e2e_wire_s")
+    if e2e_s:
+        frac = worst_s / e2e_s
+        out["capacity_sample_frac"] = round(frac, 6)
+        log(f"capacity obs: worst sample {worst_s*1e3:.2f}ms vs e2e_wire "
+            f"{e2e_s:.2f}s -> {frac:.4%} (bar: <1%)")
+        # same denominators discipline as bench_obs_overhead: only gate
+        # when the e2e reference is big enough to be a denominator
+        if e2e_s >= 0.5:
+            assert frac < 0.01, (
+                f"one capacity sample costs {frac:.2%} of bench_e2e_wire "
+                "wall (bar: <1%) — did the occupancy fetch stop being one "
+                "small reduction?"
+            )
+        else:
+            log("capacity obs: e2e_wire too small to gate against "
+                "(smoke shape); per-sample costs recorded")
+    else:
+        log("capacity obs: e2e_wire did not run; per-sample costs only")
+    return out
+
+
 def bench_bandwidth_floor():
     """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
     elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
@@ -2115,6 +2192,12 @@ def main():
     fleet_res = run_stage("fleet_obs", 20, bench_fleet_obs)
     if fleet_res is not None:
         emit(**fleet_res)
+    # budget-skippable: plane-occupancy sampling cost (per-sample ms at
+    # 1k/64k/1M objects + the <1%-of-e2e gate; exact-bytes parity is
+    # asserted inside the stage)
+    cap_res = run_stage("capacity_obs", 20, bench_capacity_obs)
+    if cap_res is not None:
+        emit(**cap_res)
     # budget-skippable: kernelcheck coverage gauge (analyzer wall time +
     # kernels-covered counts, so a kernel module escaping the manifest
     # shows in the artifact tail as a coverage count that stopped moving)

@@ -108,6 +108,11 @@ def regression_warnings(prior: dict, current: dict,
 _FAMILY_LEAVES = frozenset({
     "native", "fallback", "bytes", "objects", "calls", "errors",
     "decoded", "stalls", "sessions",
+    # capacity observatory: `capacity.samples` collapses into the
+    # `capacity` family, so occupancy sampling vanishing round over
+    # round (a scheduler that stopped sampling) warns like any other
+    # dead code path
+    "samples",
 })
 
 

@@ -440,6 +440,49 @@ def _b_map(kernel_attr: str):
     return build
 
 
+def _b_occupancy(which: str):
+    """The plane-occupancy reductions (batch/occupancy.py): pure
+    integer counting folds, traced across the same regrow rungs as the
+    kernels whose planes they measure."""
+
+    def build():
+        from ..batch import occupancy as oc
+
+        dt = _clock_dt()
+        cases = []
+        if which == "orswot":
+            fn = _unjit(oc._orswot_occupancy)
+            for (a, m, d) in LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}.M{m}.D{d}", fn=fn,
+                    args=_orswot_planes(a, m, d)))
+        elif which == "clock":
+            fn = _unjit(oc._clock_occupancy)
+            for a in ACTOR_LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}", fn=fn,
+                    args=(_mat((LADDER_N, a), dt),)))
+        elif which == "pn":
+            fn = _unjit(oc._pn_occupancy)
+            for a in ACTOR_LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}", fn=fn,
+                    args=(_mat((LADDER_N, 2, a), dt),)))
+        else:  # map
+            fn = _unjit(oc._map_occupancy)
+            for (a, k, d) in _MAP_LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}.K{k}.D{d}", fn=fn,
+                    args=(_mat((LADDER_N, a), dt),
+                          _mat((LADDER_N, k), "int32"),
+                          _mat((LADDER_N, k, a), dt),
+                          _mat((LADDER_N, d), "int32"),
+                          _mat((LADDER_N, d, a), dt))))
+        return cases
+
+    return build
+
+
 def _b_wireloop_merge():
     def build():
         import functools
@@ -760,6 +803,15 @@ MANIFEST: tuple = (
                "_apply_rm", build=_b_map("_apply_rm")),
     KernelSpec("batch.map.apply_up", "crdt_tpu/batch/map_batch.py",
                "_apply_up", build=_b_map("_apply_up")),
+    # batch/occupancy.py (the capacity observatory's reductions) -------------
+    KernelSpec("batch.occupancy.orswot", "crdt_tpu/batch/occupancy.py",
+               "_orswot_occupancy", build=_b_occupancy("orswot")),
+    KernelSpec("batch.occupancy.clock", "crdt_tpu/batch/occupancy.py",
+               "_clock_occupancy", build=_b_occupancy("clock")),
+    KernelSpec("batch.occupancy.pncounter", "crdt_tpu/batch/occupancy.py",
+               "_pn_occupancy", build=_b_occupancy("pn")),
+    KernelSpec("batch.occupancy.map", "crdt_tpu/batch/occupancy.py",
+               "_map_occupancy", build=_b_occupancy("map")),
     # batch/wireloop.py ------------------------------------------------------
     KernelSpec("batch.wireloop.fold_merge", "crdt_tpu/batch/wireloop.py",
                "PipelinedWireLoop._merge_jnp.<jit>",

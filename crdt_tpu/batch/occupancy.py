@@ -1,0 +1,190 @@
+"""Plane-occupancy kernels — how full is every dense plane, exactly.
+
+ROADMAP's causal-GC item admits the memory story for long-lived fleets
+is "restart", and until this module nothing even *measured* the planes:
+how many member slots are live vs padding, how many deferred-remove
+tombstones a fleet is dragging, how many bytes the planes actually pin
+on device, how close the busiest object is to the next capacity regrow.
+These kernels are the oracle that item needs — and the signal
+:mod:`crdt_tpu.obs.capacity` turns into ``crdt_tpu_capacity_*`` gauges,
+growth rates and time-to-overflow ETAs.
+
+One jitted reduction per plane family (a handful of ``count_nonzero``/
+``sum``/``max`` folds over planes already resident on device), one tiny
+int64 vector fetched to host per sample — cheap enough to sample every
+gossip round.  Exact plane bytes are computed host-side from the live
+arrays (``x.nbytes`` per plane leaf), so the reported number equals the
+actual device-buffer footprint by construction; the long-soak test
+(``tests/test_capacity_soak.py``) pins that equality under churn.
+
+Every kernel here is rowed into the kernelcheck ``KernelSpec`` manifest
+(``crdt_tpu/analysis/kernels.py``) with trace ladders across the
+canonical capacity-regrow rungs, so the PR 8 jaxpr gate covers them
+like any other kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.capacity import Occupancy
+from ..ops.orswot_ops import EMPTY
+
+#: key/deferred-slot sentinel in MapBatch planes (-1 = empty)
+_MAP_EMPTY = -1
+
+
+def _tree_nbytes(*planes) -> int:
+    """Exact byte footprint of a pytree of arrays — what the buffers
+    actually pin (jax and numpy arrays agree on ``nbytes``)."""
+    return int(sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(planes)))
+
+
+# ---------------------------------------------------------------------------
+# the jitted reductions (one per plane family, one host fetch each)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _orswot_occupancy(clock, ids, dots, d_ids, d_clocks):
+    """ORSWOT plane occupancy as one int64[6] fetch: live member slots
+    (total, busiest object), live deferred tombstone rows (total,
+    busiest object), populated clock cells, actor columns in use."""
+    live = ids != EMPTY
+    tombs = d_ids != EMPTY
+    return jnp.stack(
+        [
+            jnp.sum(live),
+            jnp.max(jnp.sum(live, axis=1)),
+            jnp.sum(tombs),
+            jnp.max(jnp.sum(tombs, axis=1)),
+            jnp.count_nonzero(clock),
+            jnp.sum(jnp.any(clock != 0, axis=0)),
+        ]
+    ).astype(jnp.int64)
+
+
+@jax.jit
+def _clock_occupancy(plane):
+    """``[N, A]`` clock/counter plane occupancy as one int64[4] fetch:
+    populated cells (total, busiest object), objects with any dot,
+    actor columns in use."""
+    nz = plane != 0
+    return jnp.stack(
+        [
+            jnp.sum(nz),
+            jnp.max(jnp.sum(nz, axis=1)),
+            jnp.sum(jnp.any(nz, axis=1)),
+            jnp.sum(jnp.any(nz, axis=0)),
+        ]
+    ).astype(jnp.int64)
+
+
+@jax.jit
+def _pn_occupancy(planes):
+    """``[N, 2, A]`` PN-counter plane occupancy as one int64[4] fetch:
+    populated cells across both planes, the busiest object's distinct
+    live actors (P and N folded — the actor is live if either plane
+    holds a dot), objects in use, actor columns in use."""
+    merged = jnp.max(planes, axis=1)  # [N, A]: actor live in P or N
+    nz = merged != 0
+    return jnp.stack(
+        [
+            jnp.count_nonzero(planes),
+            jnp.max(jnp.sum(nz, axis=1)),
+            jnp.sum(jnp.any(nz, axis=1)),
+            jnp.sum(jnp.any(nz, axis=0)),
+        ]
+    ).astype(jnp.int64)
+
+
+@jax.jit
+def _map_occupancy(clock, keys, entry_clocks, d_keys, d_clocks):
+    """Map plane occupancy as one int64[6] fetch: live key slots
+    (total, busiest object), live deferred tombstone rows (total,
+    busiest object), populated clock cells, actor columns in use."""
+    live = keys != _MAP_EMPTY
+    tombs = d_keys != _MAP_EMPTY
+    return jnp.stack(
+        [
+            jnp.sum(live),
+            jnp.max(jnp.sum(live, axis=1)),
+            jnp.sum(tombs),
+            jnp.max(jnp.sum(tombs, axis=1)),
+            jnp.count_nonzero(clock),
+            jnp.sum(jnp.any(clock != 0, axis=0)),
+        ]
+    ).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# the dispatch
+# ---------------------------------------------------------------------------
+
+
+def occupancy_of(batch) -> Occupancy:
+    """The :class:`Occupancy` of any dense-plane batch type.
+
+    Dispatches on the batch's plane attributes (the same duck typing
+    the executor's regrow path uses): ORSWOT member/deferred slot
+    tables, Map key/deferred tables, PN-counter ``[N, 2, A]`` planes,
+    and the ``[N, A]`` clock planes (VClock and GCounter share the
+    shape; ``kind`` keeps them apart).  Raises ``TypeError`` for batch
+    types without dense planes to measure.
+    """
+    if hasattr(batch, "d_ids") and hasattr(batch, "ids"):
+        stats = np.asarray(_orswot_occupancy(
+            batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks
+        ))
+        n, m = batch.ids.shape
+        return Occupancy(
+            kind="orswot", objects=n,
+            bytes=_tree_nbytes(batch.clock, batch.ids, batch.dots,
+                               batch.d_ids, batch.d_clocks),
+            slot_capacity=m, slots=n * m,
+            live=int(stats[0]), live_max=int(stats[1]),
+            tombstone_capacity=int(batch.d_ids.shape[1]),
+            tombstones=int(stats[2]),
+            actors=int(batch.clock.shape[1]), actors_live=int(stats[5]),
+        )
+    if hasattr(batch, "d_keys") and hasattr(batch, "keys"):
+        stats = np.asarray(_map_occupancy(
+            batch.clock, batch.keys, batch.entry_clocks,
+            batch.d_keys, batch.d_clocks
+        ))
+        n, k = batch.keys.shape
+        return Occupancy(
+            kind="map", objects=n,
+            bytes=_tree_nbytes(batch.state),
+            slot_capacity=k, slots=n * k,
+            live=int(stats[0]), live_max=int(stats[1]),
+            tombstone_capacity=int(batch.d_keys.shape[1]),
+            tombstones=int(stats[2]),
+            actors=int(batch.clock.shape[1]), actors_live=int(stats[5]),
+        )
+    if hasattr(batch, "planes"):
+        stats = np.asarray(_pn_occupancy(batch.planes))
+        n, _, a = batch.planes.shape
+        return Occupancy(
+            kind="pncounter", objects=n, bytes=_tree_nbytes(batch.planes),
+            slot_capacity=a, slots=n * 2 * a,
+            live=int(stats[0]), live_max=int(stats[1]),
+            actors=a, actors_live=int(stats[3]),
+        )
+    if hasattr(batch, "clocks"):
+        stats = np.asarray(_clock_occupancy(batch.clocks))
+        n, a = batch.clocks.shape
+        kind = type(batch).__name__.removesuffix("Batch").lower()
+        return Occupancy(
+            kind=kind or "clock", objects=n,
+            bytes=_tree_nbytes(batch.clocks),
+            slot_capacity=a, slots=n * a,
+            live=int(stats[0]), live_max=int(stats[1]),
+            actors=a, actors_live=int(stats[3]),
+        )
+    raise TypeError(
+        f"no occupancy kernel for {type(batch).__name__} (dense-plane "
+        "batch types only: Orswot/VClock/GCounter/PNCounter/Map)"
+    )

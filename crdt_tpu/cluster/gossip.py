@@ -99,11 +99,16 @@ class ClusterNode:
                  full_state_threshold: float = 0.5,
                  busy_timeout_s: float = 10.0,
                  observatory=None,
-                 oplog=None):
+                 oplog=None,
+                 capacity_tracker=None):
         self.node_id = node_id
         self.universe = universe
         self.full_state_threshold = full_state_threshold
         self.busy_timeout_s = busy_timeout_s
+        #: a :class:`crdt_tpu.obs.capacity.CapacityTracker` this node's
+        #: occupancy samples feed (None = the process-global one); the
+        #: gossip scheduler samples once per round
+        self.capacity_tracker = capacity_tracker
         #: a :class:`crdt_tpu.obs.fleet.FleetObservatory`; every session
         #: this node runs advertises it in the hello and piggybacks a
         #: merged-snapshot exchange once the session converged, so
@@ -314,6 +319,29 @@ class ClusterNode:
             finally:
                 self._busy.release()
 
+    def sample_capacity(self) -> list:
+        """Sample this node's dense planes + op buffers into the
+        ``crdt_tpu_capacity_*`` gauges (one jitted reduction + a small
+        host fetch per plane family — cheap enough for every round).
+        The gossip scheduler calls this once per round; call it
+        directly for scheduler-less deployments.  Returns the
+        occupancies sampled (batch types without dense planes are
+        skipped, never an error)."""
+        from ..obs import capacity as obs_capacity
+
+        trk = self.capacity_tracker if self.capacity_tracker is not None \
+            else obs_capacity.capacity_tracker()
+        occs = []
+        try:
+            occs.append(trk.sample(self.batch))
+        except TypeError:
+            pass  # no occupancy kernel for this batch type
+        if self._oplog is not None:
+            occs.append(trk.sample_oplog(self._oplog))
+        if self._applier is not None:
+            occs.append(trk.sample_gap_buffer(self._applier))
+        return occs
+
     def sync_with(self, peer_id: str, transport: Transport) -> SyncReport:
         """Run the initiator leg of one session against ``peer_id``."""
         return self._run_session(peer_id, transport)
@@ -476,6 +504,10 @@ class GossipScheduler:
             skipped_busy=list(report.skipped_busy),
         )
         self._publish_round_health(report)
+        # capacity sample per round: the sessions above may have merged
+        # in peer members (plane growth) or drained queued ops, so the
+        # occupancy gauges / growth ETAs refresh on the post-round state
+        self.node.sample_capacity()
         return report
 
     def _publish_round_health(self, report: RoundReport) -> None:

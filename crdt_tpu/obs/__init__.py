@@ -24,6 +24,12 @@ first-class answer, in five parts:
   gauges LWW, histograms bucket-wise), CRC-guarded snapshot frames
   piggybacked on gossip sessions or all-gathered over a mesh, the
   ``/fleet`` aggregate, and the trace-ID timeline stitcher.
+* :mod:`crdt_tpu.obs.capacity` — the memory plane: dense-plane
+  occupancy samples (jitted kernels in
+  :mod:`crdt_tpu.batch.occupancy`) turned into ``crdt_tpu_capacity_*``
+  gauges, EWMA growth rates, time-to-overflow ETAs against the
+  executor's regrow ceiling, and the ok/warn/critical watermark
+  ``/healthz`` reports.
 
 Import-light by design: nothing here imports JAX or numpy, so the
 scalar engine (and any process that only wants a counter) pays nothing
@@ -31,7 +37,8 @@ for it.  PERF.md "Observability" documents naming conventions and how
 to read the flight recorder after a failed sync.
 """
 
-from . import convergence, events, fleet, metrics  # noqa: F401
+from . import capacity, convergence, events, fleet, metrics  # noqa: F401
+from .capacity import CapacityTracker, Occupancy, capacity_tracker  # noqa: F401
 from .convergence import ConvergenceTracker, tracker  # noqa: F401
 from .events import FlightRecorder, new_session_id, record, recorder  # noqa: F401
 from .fleet import (  # noqa: F401
@@ -49,6 +56,7 @@ from .metrics import (  # noqa: F401
 )
 
 __all__ = [
+    "CapacityTracker",
     "ConvergenceTracker",
     "Counter",
     "FleetObservatory",
@@ -57,6 +65,8 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "Occupancy",
+    "capacity_tracker",
     "new_session_id",
     "observatory",
     "record",

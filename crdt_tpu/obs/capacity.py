@@ -1,0 +1,329 @@
+"""Capacity observatory — plane occupancy, growth rates, overflow ETAs.
+
+The causal-GC roadmap item has no oracle and mesh-shard capacity
+planning has no data until someone *measures* the dense planes.  The
+kernels live in :mod:`crdt_tpu.batch.occupancy` (jitted reductions, one
+small host fetch per sample); this module turns their
+:class:`~crdt_tpu.batch.occupancy.Occupancy` samples into operator
+signal:
+
+* ``crdt_tpu_capacity_<plane>_*`` gauges — exact plane bytes, padded
+  vs live slots, busiest-object live count, tombstone rows, EWMA
+  growth rate (rows/s) and a time-to-overflow ETA against the
+  executor's ``max_capacity`` regrow ceiling
+  (:class:`crdt_tpu.parallel.executor.JoinExecutor`).
+* a **watermark state** (``ok``/``warn``/``critical``) per plane and
+  overall, surfaced as the ``/healthz`` JSON body
+  (:mod:`crdt_tpu.obs.export`) and the ``crdt_tpu_capacity_watermark``
+  gauge, so "this fleet is 90% of the way to its regrow ceiling" is an
+  alert, not an autopsy.
+* :meth:`CapacityTracker.regrow_timeline` — the executor's regrow
+  events (now stamped with before/after capacities) read back from the
+  flight recorder as one ordered story, so a regrowing fleet's
+  capacity history correlates with the occupancy curve that forced it.
+
+The oplog buffers get the same treatment (:meth:`CapacityTracker.
+sample_oplog` / :meth:`sample_gap_buffer`): the PR 7 "bounded, loud
+overflow" op log and causal-gap park buffer report their occupancy
+before they throw, not after.
+
+Capacity gauges are plain registry gauges, so they ride the PR 6 fleet
+lattice for free (per-node LWW slices); :meth:`crdt_tpu.obs.fleet.
+FleetSnapshot.fleet_capacity` adds the fleet max/sum reduction
+``/fleet`` serves.
+
+Stdlib-only at module scope (the obs import-lightness contract): the
+kernel module imports lazily inside :meth:`CapacityTracker.sample`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import events as events_mod
+from . import metrics as metrics_mod
+
+#: the executor's default regrow ceiling
+#: (:class:`crdt_tpu.parallel.executor.JoinExecutor` ``max_capacity``)
+#: — the default overflow horizon ETAs count down toward
+DEFAULT_CEILING = 1 << 16
+
+
+@dataclasses.dataclass(frozen=True)
+class Occupancy:
+    """One plane family's occupancy at one instant.
+
+    ``slot_capacity`` is the *binding* per-object axis — the one a
+    capacity regrow widens (member slots for ORSWOT, key slots for Map,
+    actor columns for the counter planes, the buffer bound for op
+    logs); ``live_max`` is the busiest object's live count along it,
+    i.e. the distance-to-overflow statistic.  ``bytes`` is the exact
+    byte footprint of the live arrays (sum of plane ``nbytes``), pinned
+    equal to the device buffers by the long-soak test.
+
+    Defined here (stdlib-only) so op-buffer samples need no jax; the
+    kernels that fill it for dense batches live in
+    :mod:`crdt_tpu.batch.occupancy`.
+    """
+
+    kind: str               # orswot / vclock / gcounter / pncounter / map /
+    #                         oplog / oplog_gap
+    objects: int            # N (fleet rows; log segments for op logs)
+    bytes: int              # exact plane bytes == sum of buffer nbytes
+    slot_capacity: int      # binding axis width per object
+    slots: int              # total padded cells along the binding axis
+    live: int               # live cells along the binding axis, fleet-wide
+    live_max: int           # busiest object's live count (overflow distance)
+    actors: int = 0         # actor columns carried (0 = not applicable)
+    actors_live: int = 0    # actor columns with any nonzero dot
+    tombstone_capacity: int = 0  # deferred slots per object (0 = none)
+    tombstones: int = 0     # live deferred/tombstone rows, fleet-wide
+
+    @property
+    def utilization(self) -> float:
+        """Live fraction of the binding axis, fleet-wide."""
+        return self.live / self.slots if self.slots else 0.0
+
+#: watermark states, in severity order (the overall state is the max)
+WATERMARK_STATES = ("ok", "warn", "critical")
+
+#: ``eta_s`` gauge sentinel: the plane is not growing (rate <= 0), so
+#: there is no finite overflow horizon — exported as -1, never +Inf,
+#: so JSON consumers and Prometheus alerts stay arithmetic-safe
+ETA_NOT_GROWING = -1.0
+
+
+@dataclasses.dataclass
+class PlaneCapacity:
+    """One tracked plane's latest sample + derived series."""
+
+    occupancy: Occupancy
+    ceiling: int                 # regrow ceiling ETAs count toward
+    rate: Optional[float]        # EWMA live_max growth, rows/s
+    eta_s: float                 # seconds to ceiling (ETA_NOT_GROWING
+    #                              when rate <= 0; 0.0 when already there)
+    state: str                   # ok / warn / critical
+    sampled_at: float            # tracker-clock timestamp
+
+
+class CapacityTracker:
+    """Samples plane occupancy into gauges, growth rates and ETAs.
+
+    One tracker per registry (the process-global pair is the default);
+    every :meth:`sample` publishes the plane's gauges, folds the
+    busiest-object live count into an EWMA growth rate, derives the
+    overflow ETA against the plane's ceiling, and re-computes the
+    watermark.  ``warn_frac``/``critical_frac`` are utilization-of-
+    ceiling thresholds on the busiest object; ``alpha`` is the EWMA
+    smoothing weight on instantaneous rates; ``clock`` is injectable
+    for tests (monotonic seconds).
+    """
+
+    def __init__(self, registry: Optional[metrics_mod.MetricsRegistry]
+                 = None, *,
+                 max_capacity: int = DEFAULT_CEILING,
+                 warn_frac: float = 0.7,
+                 critical_frac: float = 0.9,
+                 alpha: float = 0.3,
+                 clock: Callable[[], float] = time.monotonic):
+        if not 0.0 < warn_frac <= critical_frac <= 1.0:
+            raise ValueError(
+                f"need 0 < warn_frac <= critical_frac <= 1, got "
+                f"{warn_frac}/{critical_frac}"
+            )
+        self._registry = registry
+        self.max_capacity = max_capacity
+        self.warn_frac = warn_frac
+        self.critical_frac = critical_frac
+        self.alpha = alpha
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._planes: Dict[str, PlaneCapacity] = {}
+
+    def _reg(self) -> metrics_mod.MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else metrics_mod.registry()
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self, batch, label: Optional[str] = None, *,
+               ceiling: Optional[int] = None):
+        """Measure ``batch``'s planes (one jitted reduction + one host
+        fetch) and publish.  Returns the
+        :class:`~crdt_tpu.batch.occupancy.Occupancy`.  Raises
+        ``TypeError`` for batch types without dense planes."""
+        from ..batch import occupancy as batch_occupancy
+
+        occ = batch_occupancy.occupancy_of(batch)
+        return self.observe(occ, label=label, ceiling=ceiling)
+
+    def sample_oplog(self, log, label: str = "oplog"):
+        """The op log's occupancy (buffered ops vs its bound, exact
+        column bytes) — the backpressure signal the bounded buffer
+        never exposed before it threw."""
+        o = log.occupancy()
+        return self.observe(_buffer_occupancy("oplog", o), label=label,
+                            ceiling=o["capacity"])
+
+    def sample_gap_buffer(self, applier, label: str = "oplog_gap"):
+        """The causal-gap park buffer's occupancy (parked adds vs
+        ``park_capacity``) — a climbing gauge here means predecessor
+        dots are not arriving."""
+        o = applier.occupancy()
+        return self.observe(_buffer_occupancy("oplog_gap", o), label=label,
+                            ceiling=o["capacity"])
+
+    def observe(self, occ, label: Optional[str] = None, *,
+                ceiling: Optional[int] = None):
+        """Fold one pre-computed occupancy sample in and publish its
+        gauges.  ``label`` names the gauge family (defaults to the
+        occupancy's ``kind``; one dotted segment)."""
+        label = label if label is not None else occ.kind
+        if not label or "." in label or "/" in label:
+            raise ValueError(
+                f"capacity label must be a single metric segment, "
+                f"got {label!r}"
+            )
+        if ceiling is None:
+            # actor planes cannot regrow through the executor: their
+            # horizon is the interning table's width itself
+            ceiling = occ.slot_capacity \
+                if occ.kind in ("vclock", "gcounter", "pncounter") \
+                else self.max_capacity
+        now = self._clock()
+        with self._lock:
+            prev = self._planes.get(label)
+            rate = prev.rate if prev is not None else None
+            if prev is not None and now > prev.sampled_at:
+                inst = (occ.live_max - prev.occupancy.live_max) \
+                    / (now - prev.sampled_at)
+                rate = inst if rate is None \
+                    else self.alpha * inst + (1.0 - self.alpha) * rate
+            headroom = ceiling - occ.live_max
+            if headroom <= 0:
+                eta = 0.0
+            elif rate is not None and rate > 0:
+                eta = headroom / rate
+            else:
+                eta = ETA_NOT_GROWING
+            util = occ.live_max / ceiling if ceiling > 0 else 0.0
+            if util >= self.critical_frac:
+                state = "critical"
+            elif util >= self.warn_frac:
+                state = "warn"
+            else:
+                state = "ok"
+            self._planes[label] = PlaneCapacity(
+                occupancy=occ, ceiling=ceiling, rate=rate, eta_s=eta,
+                state=state, sampled_at=now,
+            )
+            overall = max(
+                (WATERMARK_STATES.index(p.state)
+                 for p in self._planes.values()),
+                default=0,
+            )
+        reg = self._reg()
+        reg.counter_inc("capacity.samples")
+        reg.gauge_set(f"capacity.{label}.bytes", occ.bytes)
+        reg.gauge_set(f"capacity.{label}.objects", occ.objects)
+        reg.gauge_set(f"capacity.{label}.slots", occ.slots)
+        reg.gauge_set(f"capacity.{label}.live", occ.live)
+        reg.gauge_set(f"capacity.{label}.live_max", occ.live_max)
+        reg.gauge_set(f"capacity.{label}.tombstones", occ.tombstones)
+        reg.gauge_set(f"capacity.{label}.utilization", util)
+        if rate is not None:
+            reg.gauge_set(f"capacity.{label}.growth_rows_per_s", rate)
+        reg.gauge_set(f"capacity.{label}.eta_s", eta)
+        reg.gauge_set(f"capacity.{label}.watermark",
+                      WATERMARK_STATES.index(state))
+        reg.gauge_set("capacity.watermark", overall)
+        return occ
+
+    # -- the watermark view (what /healthz serves) ---------------------------
+
+    def watermark(self) -> dict:
+        """The current watermark: overall ``state`` (the max severity
+        across tracked planes; ``ok`` with none tracked) plus a
+        per-plane breakdown — the ``/healthz`` JSON body."""
+        with self._lock:
+            planes = dict(self._planes)
+        state_idx = 0
+        detail = {}
+        for label, p in sorted(planes.items()):
+            state_idx = max(state_idx, WATERMARK_STATES.index(p.state))
+            detail[label] = {
+                "state": p.state,
+                "live_max": p.occupancy.live_max,
+                "ceiling": p.ceiling,
+                "utilization": round(
+                    p.occupancy.live_max / p.ceiling, 6
+                ) if p.ceiling else 0.0,
+                "bytes": p.occupancy.bytes,
+                "growth_rows_per_s": p.rate,
+                "eta_s": p.eta_s,
+            }
+        return {"state": WATERMARK_STATES[state_idx], "planes": detail}
+
+    def planes(self) -> Dict[str, PlaneCapacity]:
+        """A consistent copy of the per-plane tracking state."""
+        with self._lock:
+            return dict(self._planes)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._planes.clear()
+
+    # -- regrow correlation --------------------------------------------------
+
+    def regrow_timeline(self, recorder: Optional[events_mod.FlightRecorder]
+                        = None) -> List[dict]:
+        """The executor's capacity regrows as an ordered timeline:
+        every ``executor.regrow`` flight-recorder event with its
+        before/after capacity stamps
+        (:func:`crdt_tpu.parallel.executor._record_recovery` writes
+        them), so an occupancy curve can be correlated with the regrow
+        that answered it."""
+        rec = recorder if recorder is not None else events_mod.recorder()
+        out = []
+        for ev in rec.snapshot(kind="executor.regrow"):
+            f = ev.get("fields", {})
+            out.append({
+                "ts": ev["ts"],
+                "wall": ev["wall"],
+                "schedule": f.get("schedule"),
+                "member_capacity": (f.get("member_capacity_before"),
+                                    f.get("member_capacity")),
+                "deferred_capacity": (f.get("deferred_capacity_before"),
+                                      f.get("deferred_capacity")),
+            })
+        return out
+
+
+def _buffer_occupancy(kind: str, o: dict) -> Occupancy:
+    """An op-buffer occupancy dict (``OpLog.occupancy()`` /
+    ``OpApplier.occupancy()`` shape) as an :class:`Occupancy`."""
+    return Occupancy(
+        kind=kind, objects=int(o.get("segments", 0)), bytes=int(o["bytes"]),
+        slot_capacity=int(o["capacity"]), slots=int(o["capacity"]),
+        live=int(o["ops"]), live_max=int(o["ops"]),
+    )
+
+
+# -- the default (process-global) tracker -------------------------------------
+
+_DEFAULT: Optional[CapacityTracker] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def capacity_tracker() -> CapacityTracker:
+    """The process-global tracker — what ``/healthz`` consults and the
+    gossip runtime samples into by default."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = CapacityTracker()
+    return _DEFAULT

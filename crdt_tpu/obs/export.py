@@ -123,12 +123,13 @@ class MetricsServer:
     def __init__(self, host: str, port: int,
                  registry: Optional[metrics.MetricsRegistry] = None,
                  tracker: Optional[convergence.ConvergenceTracker] = None,
-                 observatory=None):
+                 observatory=None, capacity=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self._registry = registry
         self._tracker = tracker
         self._observatory = observatory
+        self._capacity = capacity
         self._t0 = time.monotonic()
         self.scrapes: dict = {}
         self._scrape_lock = threading.Lock()
@@ -205,9 +206,23 @@ class MetricsServer:
             return (text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8", 200)
         if route == "/healthz":
+            # liveness + the capacity watermark: `status` mirrors the
+            # tracker's overall watermark state (ok/warn/critical; "ok"
+            # when nothing is tracked yet), with the per-plane
+            # breakdown under `capacity` so an operator's first curl
+            # answers "how close is this node to its regrow ceiling".
+            # Always HTTP 200 — a critical watermark is an alert, not
+            # a liveness failure (restarting the process would make
+            # the memory story WORSE).
+            from . import capacity as capacity_mod
+
+            cap = self._capacity if self._capacity is not None \
+                else capacity_mod.capacity_tracker()
+            wm = cap.watermark()
             body = json.dumps({
-                "status": "ok",
+                "status": wm["state"],
                 "uptime_s": round(time.monotonic() - self._t0, 3),
+                "capacity": wm,
             }).encode()
             return body, "application/json", 200
         return b"not found (try /metrics, /events, /fleet, /healthz)\n", \
@@ -243,11 +258,15 @@ class MetricsServer:
 def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
                          registry: Optional[metrics.MetricsRegistry] = None,
                          tracker: Optional[convergence.ConvergenceTracker]
-                         = None, observatory=None) -> MetricsServer:
+                         = None, observatory=None,
+                         capacity=None) -> MetricsServer:
     """Start the opt-in background exporter; ``port=0`` picks a free
     port (read it back from ``server.port``).  ``tracker`` pairs a
     custom ``registry`` with the convergence tracker writing into it
     (see :func:`prometheus_text`); ``observatory`` is the
     :class:`~crdt_tpu.obs.fleet.FleetObservatory` behind ``/fleet``
-    (default: the process-global one)."""
-    return MetricsServer(host, port, registry, tracker, observatory)
+    (default: the process-global one); ``capacity`` is the
+    :class:`~crdt_tpu.obs.capacity.CapacityTracker` whose watermark
+    ``/healthz`` reports (default: the process-global one)."""
+    return MetricsServer(host, port, registry, tracker, observatory,
+                         capacity)

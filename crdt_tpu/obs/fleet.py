@@ -51,6 +51,7 @@ from ..error import SyncProtocolError
 from . import convergence as convergence_mod
 from . import events as events_mod
 from . import metrics as metrics_mod
+from .capacity import ETA_NOT_GROWING
 from .namespace import sanitize as _sanitize
 
 #: bumped whenever the snapshot grammar changes; a peer speaking a
@@ -196,6 +197,38 @@ class FleetSnapshot:
                     acc["buckets"][e] = acc["buckets"].get(e, 0) + int(n)
         return out
 
+    def fleet_capacity(self) -> Dict[str, dict]:
+        """Every ``capacity.*`` gauge → ``{"sum", "max", "nodes"}``
+        across each node's OWN latest value.
+
+        The LWW fleet-gauge read is wrong for capacity: "newest capture
+        wins" answers *somebody's* plane bytes, while capacity planning
+        needs the fleet footprint (sum of per-node bytes/live rows) and
+        the worst node (max utilization/watermark; for ``eta_s`` the
+        max is over growing planes only — a ``-1`` "not growing"
+        sentinel must not shadow a finite horizon).  Per-node values
+        stay LWW within the slice, so re-delivery cannot double-count.
+        """
+        out: Dict[str, dict] = {}
+        for sl in self.slices.values():
+            for name, entry in sl.get("gauges", {}).items():
+                if not name.startswith("capacity."):
+                    continue
+                v = float(entry[2])
+                acc = out.get(name)
+                if acc is None:
+                    acc = out[name] = {"sum": 0.0, "max": None, "nodes": 0}
+                acc["sum"] += v
+                if name.endswith(".eta_s") and v < 0:
+                    pass  # not-growing sentinel: excluded from the max
+                elif acc["max"] is None or v > acc["max"]:
+                    acc["max"] = v
+                acc["nodes"] += 1
+        for acc in out.values():
+            if acc["max"] is None:
+                acc["max"] = ETA_NOT_GROWING
+        return out
+
     def events(self, node: Optional[str] = None) -> List[dict]:
         """Retained flight-recorder events, each annotated with its
         ``node``, ordered by wall-clock then per-process seq."""
@@ -221,6 +254,7 @@ class FleetSnapshot:
                 "counters": self.fleet_counters(),
                 "gauges": self.fleet_gauges(),
                 "histograms": self.fleet_histograms(),
+                "capacity": self.fleet_capacity(),
             },
         }
 
@@ -433,6 +467,17 @@ def fleet_prometheus_text(snap: FleetSnapshot,
         rendered = str(int(v)) if float(v).is_integer() else repr(float(v))
         lines.append(f"# TYPE {mname} gauge")
         lines.append(f"{mname} {rendered}")
+    # capacity gauges additionally get the sum/max fleet reduction (the
+    # LWW series above answers "some node's value"; capacity planning
+    # needs the fleet footprint and the worst node — see fleet_capacity)
+    cap = snap.fleet_capacity()
+    for name in sorted(cap):
+        base = f"{prefix}_{_sanitize(name)}"
+        for reduction in ("sum", "max"):
+            v = float(cap[name][reduction])
+            rendered = str(int(v)) if v.is_integer() else repr(v)
+            lines.append(f"# TYPE {base}_{reduction} gauge")
+            lines.append(f"{base}_{reduction} {rendered}")
     hists = snap.fleet_histograms()
     import math
 

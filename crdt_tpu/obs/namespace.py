@@ -161,6 +161,13 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "ops queued in the node's op log awaiting the fold"),
     NameSpec("oplog.parked", "gauge",
              "adds parked on a causal gap (missing predecessor dots)"),
+    NameSpec("oplog.log_depth", "gauge",
+             "ops buffered in the op log right now (refreshed by the "
+             "log itself on every append/drain — nonzero while a "
+             "session holds the fold lock)"),
+    NameSpec("oplog.watermark", "gauge",
+             "highest per-actor dot counter the op log has seen (max "
+             "over actors) — the cheap write-progress signal"),
     NameSpec("oplog.apply.*", "counter",
              "apply_ops outcomes (ops/applied/duplicates/parked/"
              "released/rm_rounds)"),
@@ -194,6 +201,36 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "piggybacked snapshot-exchange wall time (span)"),
     NameSpec("obs.fleet.snapshot_bytes", "histogram",
              "encoded merged-snapshot frame size"),
+    # -- capacity observatory (obs/capacity.py, batch/occupancy.py) ----------
+    NameSpec("capacity.samples", "counter",
+             "occupancy sampling passes (any plane family)"),
+    NameSpec("capacity.watermark", "gauge",
+             "overall capacity watermark (0 ok / 1 warn / 2 critical — "
+             "the max across tracked planes; /healthz's status)"),
+    NameSpec("capacity.*.bytes", "gauge",
+             "exact plane bytes per tracked plane label (== device "
+             "buffer nbytes by construction)"),
+    NameSpec("capacity.*.objects", "gauge",
+             "fleet rows per tracked plane (log segments for op logs)"),
+    NameSpec("capacity.*.slots", "gauge",
+             "padded cells along the binding slot axis"),
+    NameSpec("capacity.*.live", "gauge",
+             "live cells along the binding slot axis, fleet-wide"),
+    NameSpec("capacity.*.live_max", "gauge",
+             "busiest object's live slot count — the distance-to-"
+             "overflow statistic growth rates and ETAs track"),
+    NameSpec("capacity.*.tombstones", "gauge",
+             "live deferred-remove/tombstone rows, fleet-wide"),
+    NameSpec("capacity.*.utilization", "gauge",
+             "live_max over the plane's regrow ceiling"),
+    NameSpec("capacity.*.growth_rows_per_s", "gauge",
+             "EWMA growth of live_max, rows/s (absent until two "
+             "samples)"),
+    NameSpec("capacity.*.eta_s", "gauge",
+             "seconds until live_max hits the regrow ceiling at the "
+             "EWMA rate (-1 = not growing, 0 = already there)"),
+    NameSpec("capacity.*.watermark", "gauge",
+             "per-plane watermark (0 ok / 1 warn / 2 critical)"),
     # -- native engine (native/engine.py) ------------------------------------
     NameSpec("native.engine.*.calls", "counter",
              "native kernel invocations per entry point"),

@@ -145,6 +145,20 @@ class OpApplier:
         """The currently parked (causally gapped) adds."""
         return self._parked
 
+    def occupancy(self) -> dict:
+        """The gap buffer's occupancy for the capacity observatory
+        (:meth:`crdt_tpu.obs.capacity.CapacityTracker.sample_gap_buffer`):
+        parked adds vs ``park_capacity`` plus their exact column bytes —
+        a climbing number here means predecessor dots never arrive."""
+        from .records import opbatch_nbytes
+
+        parked = self._parked
+        return {
+            "ops": len(parked),
+            "capacity": self.park_capacity,
+            "bytes": opbatch_nbytes(parked),
+        }
+
     # -- the readiness partition --------------------------------------------
 
     @staticmethod

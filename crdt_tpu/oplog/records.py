@@ -64,6 +64,16 @@ def _col(x, dtype):
     return np.ascontiguousarray(np.asarray(x), dtype=dtype)
 
 
+def opbatch_nbytes(batch: "OpBatch") -> int:
+    """Exact byte footprint of one batch's columns (clocks included) —
+    what the capacity observatory reports for buffered ops."""
+    n = (batch.kind.nbytes + batch.obj.nbytes + batch.actor.nbytes
+         + batch.counter.nbytes + batch.member.nbytes)
+    if batch.rm_clocks is not None:
+        n += batch.rm_clocks.nbytes
+    return int(n)
+
+
 @dataclasses.dataclass
 class OpBatch:
     """A struct-of-arrays batch of ``B`` operations.
@@ -212,6 +222,15 @@ class OpLog:
     highest add/inc/dec counter this log has ever seen per actor — the
     cheap staleness/progress signal an operator reads next to the
     ``oplog.pending`` gauge.
+
+    The log publishes its own occupancy on every mutation: the
+    ``oplog.log_depth`` gauge (ops buffered right now — nonzero while
+    a session holds the fold lock, unlike ``oplog.pending`` which the
+    cluster node refreshes post-drain) and ``oplog.watermark`` (max
+    per-actor dot), so the bounded buffer is loud BEFORE it overflows,
+    not only when it throws.  :meth:`occupancy` feeds the same numbers
+    plus exact column bytes to the capacity observatory
+    (:meth:`crdt_tpu.obs.capacity.CapacityTracker.sample_oplog`).
     """
 
     def __init__(self, universe, capacity: int = 1 << 16):
@@ -262,7 +281,18 @@ class OpLog:
                     self._watermark, batch.actor[dotted],
                     batch.counter[dotted],
                 )
+            depth = self._count
+            high = int(self._watermark.max(initial=0))
         tracing.count("oplog.submitted", b)
+        self._publish(depth, high)
+
+    @staticmethod
+    def _publish(depth: int, high: int) -> None:
+        from ..obs import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
+        reg.gauge_set("oplog.log_depth", depth)
+        reg.gauge_set("oplog.watermark", high)
 
     def pending(self) -> OpBatch:
         """Everything buffered, as one batch — WITHOUT clearing (the
@@ -277,7 +307,25 @@ class OpLog:
         with self._lock:
             segments, self._segments = self._segments, []
             self._count = 0
+            high = int(self._watermark.max(initial=0))
+        self._publish(0, high)
         return OpBatch.concat(segments)
+
+    def occupancy(self) -> dict:
+        """The log's occupancy for the capacity observatory: buffered
+        ops vs the bound, segment count, exact column bytes, and the
+        max per-actor dot high-watermark — one consistent read."""
+        with self._lock:
+            segments = list(self._segments)
+            count = self._count
+            high = int(self._watermark.max(initial=0))
+        return {
+            "ops": count,
+            "capacity": self.capacity,
+            "segments": len(segments),
+            "bytes": sum(opbatch_nbytes(b) for b in segments),
+            "watermark_max": high,
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -210,7 +210,12 @@ class JoinExecutor:
                             f"deferred_capacity={d})"
                         ) from overflow
                     stats.overflow_regrows += 1
+                    # before/after capacity stamps: the capacity
+                    # observatory's regrow_timeline correlates these
+                    # events with the occupancy curve that forced them
                     _record_recovery("regrow", schedule="tree",
+                                     member_capacity_before=m,
+                                     deferred_capacity_before=d,
                                      member_capacity=new_m,
                                      deferred_capacity=new_d)
                     with tracing.span("executor.regrow"):
@@ -276,6 +281,8 @@ class JoinExecutor:
                     ) from overflow
                 stats.overflow_regrows += 1
                 _record_recovery("regrow", schedule="sequential",
+                                 member_capacity_before=m,
+                                 deferred_capacity_before=d,
                                  member_capacity=new_m,
                                  deferred_capacity=new_d)
                 with tracing.span("executor.regrow"):

@@ -159,7 +159,8 @@ class SyncSession:
                  full_state_bytes: Optional[int] = None,
                  observatory=None,
                  op_outbox: Optional[Callable[[], bytes]] = None,
-                 op_sink: Optional[Callable[[bytes], None]] = None):
+                 op_sink: Optional[Callable[[bytes], None]] = None,
+                 capacity_tracker=None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -192,6 +193,14 @@ class SyncSession:
         self._op_outbox = op_outbox
         self._op_sink = op_sink
         self._peer_oplog = False
+        #: a :class:`crdt_tpu.obs.capacity.CapacityTracker`; when set, a
+        #: converged session samples the reconciled fleet's plane
+        #: occupancy — a merge is exactly when planes grow (new members,
+        #: new tombstones, an equalize regrow), so the capacity gauges
+        #: refresh on the state the session produced.  Opt-in: the
+        #: cluster runtime samples per gossip ROUND instead, and a
+        #: session-rate sample would be redundant there.
+        self.capacity_tracker = capacity_tracker
         self._digest_fn = digest_fn or digest_mod.digest_of
         self._applier = OrswotDeltaApplier(universe)
 
@@ -437,6 +446,11 @@ class SyncSession:
             diverged=report.diverged,
             full_state_fallback=report.full_state_fallback,
         )
+        if self.capacity_tracker is not None:
+            try:
+                self.capacity_tracker.sample(self.batch)
+            except TypeError:
+                pass  # no occupancy kernel for this batch type
         return report
 
     def _fallback(self, report: SyncReport, reason: str) -> None:

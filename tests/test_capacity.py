@@ -1,0 +1,386 @@
+"""Capacity observatory tests — plane-occupancy kernels, growth/ETA
+gauges, watermark states, /healthz body, oplog occupancy, regrow
+timeline, fleet aggregates (crdt_tpu.obs.capacity +
+crdt_tpu.batch.occupancy).
+
+The long-soak acceptance run (3-node gossip fleet under churn, exact
+plane-bytes parity, monotone growth, shrinking ETA) lives in
+``tests/test_capacity_soak.py`` behind the ``slow`` marker; this module
+pins the pieces at tier-1 speed.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.batch.gcounter_batch import GCounterBatch
+from crdt_tpu.batch.map_batch import MapBatch
+from crdt_tpu.batch.occupancy import occupancy_of
+from crdt_tpu.batch.pncounter_batch import PNCounterBatch
+from crdt_tpu.batch.val_kernels import MVRegKernel
+from crdt_tpu.batch.vclock_batch import VClockBatch
+from crdt_tpu.cluster import ClusterNode
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.obs import capacity as obs_capacity
+from crdt_tpu.obs import events as obs_events
+from crdt_tpu.obs import export as obs_export
+from crdt_tpu.obs import fleet as obs_fleet
+from crdt_tpu.obs import metrics as obs_metrics
+from crdt_tpu.obs import namespace
+from crdt_tpu.obs.capacity import CapacityTracker, ETA_NOT_GROWING
+from crdt_tpu.oplog import OpApplier, OpBatch, OpLog
+from crdt_tpu.parallel import JoinExecutor, JoinStats
+from crdt_tpu.scalar.ctx import RmCtx
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.scalar.vclock import VClock
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = pytest.mark.obs
+
+
+def _uni(**kw):
+    cfg = dict(num_actors=8, member_capacity=16, deferred_capacity=4,
+               counter_bits=32)
+    cfg.update(kw)
+    return Universe.identity(CrdtConfig(**cfg))
+
+
+def _orswot(uni, member_counts, deferred_on=()):
+    """One Orswot per entry of ``member_counts``, the i-th holding that
+    many members; objects in ``deferred_on`` also buffer one deferred
+    remove (a rm witnessed by a clock the set has not seen)."""
+    states = []
+    for i, k in enumerate(member_counts):
+        s = Orswot()
+        for m in range(k):
+            s.apply(s.add(m, s.value().derive_add_ctx(0)))
+        if i in deferred_on:
+            future = VClock()
+            future.witness(5, 99)
+            s.apply(s.remove(0, RmCtx(clock=future)))
+            assert s.deferred
+        states.append(s)
+    return OrswotBatch.from_scalar(states, uni)
+
+
+def _plane_nbytes(batch):
+    return sum(x.nbytes for x in (batch.clock, batch.ids, batch.dots,
+                                  batch.d_ids, batch.d_clocks))
+
+
+# ---- the occupancy kernels -------------------------------------------------
+
+
+def test_orswot_occupancy_counts_and_exact_bytes():
+    uni = _uni()
+    batch = _orswot(uni, [1, 3, 5], deferred_on=(1,))
+    occ = occupancy_of(batch)
+    assert occ.kind == "orswot"
+    assert occ.objects == 3
+    assert occ.slot_capacity == 16 and occ.slots == 3 * 16
+    assert occ.live == 1 + 3 + 5
+    assert occ.live_max == 5
+    assert occ.tombstones == 1 and occ.tombstone_capacity == 4
+    assert occ.actors == 8 and occ.actors_live == 1
+    # the headline contract: reported bytes == actual buffer nbytes
+    assert occ.bytes == _plane_nbytes(batch)
+    assert 0.0 < occ.utilization < 1.0
+
+
+def test_clock_and_counter_plane_occupancy():
+    uni = _uni()
+    vc_a, vc_b = VClock(), VClock()
+    vc_a.witness(0, 3)
+    vc_a.witness(2, 1)
+    vc_b.witness(2, 7)
+    vcb = VClockBatch.from_scalar([vc_a, vc_b], uni)
+    occ = occupancy_of(vcb)
+    assert occ.kind == "vclock"
+    assert (occ.objects, occ.slot_capacity, occ.slots) == (2, 8, 16)
+    assert occ.live == 3          # three populated dots
+    assert occ.live_max == 2      # object 0 has two actors
+    assert occ.actors_live == 2   # actor columns 0 and 2
+    assert occ.bytes == vcb.clocks.nbytes
+
+    gcb = GCounterBatch(clocks=vcb.clocks)
+    assert occupancy_of(gcb).kind == "gcounter"
+
+    planes = jnp.stack([vcb.clocks, jnp.zeros_like(vcb.clocks)], axis=1)
+    pnb = PNCounterBatch(planes=planes)
+    occ = occupancy_of(pnb)
+    assert occ.kind == "pncounter"
+    assert occ.live == 3 and occ.live_max == 2 and occ.actors_live == 2
+    assert occ.slots == 2 * 2 * 8
+    assert occ.bytes == planes.nbytes
+
+
+def test_map_occupancy():
+    uni = _uni(key_capacity=4, mv_capacity=2)
+    batch = MapBatch.zeros(3, uni, MVRegKernel.from_config(uni.config))
+    occ = occupancy_of(batch)
+    assert occ.kind == "map"
+    assert (occ.objects, occ.slot_capacity) == (3, 4)
+    assert occ.live == 0 and occ.tombstones == 0
+    assert occ.bytes == sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(batch.state))
+    # populate two key slots on one object and re-measure
+    batch = batch.replace(keys=batch.keys.at[1, 0].set(7).at[1, 1].set(9))
+    occ = occupancy_of(batch)
+    assert occ.live == 2 and occ.live_max == 2
+
+
+def test_occupancy_rejects_unknown_batch_types():
+    with pytest.raises(TypeError, match="no occupancy kernel"):
+        occupancy_of(object())
+
+
+# ---- the tracker: growth rates, ETA, watermark ------------------------------
+
+
+def test_tracker_growth_rate_eta_and_watermark_transitions():
+    uni = _uni(member_capacity=32)
+    reg = obs_metrics.MetricsRegistry()
+    t = [0.0]
+    trk = CapacityTracker(reg, max_capacity=32, alpha=1.0,
+                          clock=lambda: t[0])
+
+    occ = trk.sample(_orswot(uni, [4]))
+    g = reg.snapshot()["gauges"]
+    assert g["capacity.orswot.live_max"] == 4
+    assert g["capacity.orswot.eta_s"] == ETA_NOT_GROWING  # one sample: no rate
+    assert "capacity.orswot.growth_rows_per_s" not in g
+    assert g["capacity.orswot.watermark"] == 0
+    assert reg.snapshot()["counters"]["capacity.samples"] == 1
+
+    # steady growth: +4 rows per 10 s → rate 0.4 rows/s, shrinking ETA
+    etas = []
+    for live in (8, 12, 16):
+        t[0] += 10.0
+        trk.sample(_orswot(uni, [live]))
+        g = reg.snapshot()["gauges"]
+        assert g["capacity.orswot.growth_rows_per_s"] == pytest.approx(0.4)
+        etas.append(g["capacity.orswot.eta_s"])
+        assert etas[-1] == pytest.approx((32 - live) / 0.4)
+    assert etas == sorted(etas, reverse=True)  # ETA shrinks as planes fill
+
+    # warn at 0.7 * 32 = 22.4 rows, critical at 0.9 * 32 = 28.8
+    t[0] += 10.0
+    trk.sample(_orswot(uni, [24]))
+    assert reg.snapshot()["gauges"]["capacity.orswot.watermark"] == 1
+    assert trk.watermark()["state"] == "warn"
+    t[0] += 10.0
+    trk.sample(_orswot(uni, [30]))
+    g = reg.snapshot()["gauges"]
+    assert g["capacity.orswot.watermark"] == 2
+    assert g["capacity.watermark"] == 2
+    wm = trk.watermark()
+    assert wm["state"] == "critical"
+    assert wm["planes"]["orswot"]["ceiling"] == 32
+    assert wm["planes"]["orswot"]["eta_s"] > 0
+
+    # a flat plane stops growing: EWMA with alpha=1 → rate 0, eta sentinel
+    t[0] += 10.0
+    trk.sample(_orswot(uni, [30]))
+    assert reg.snapshot()["gauges"]["capacity.orswot.eta_s"] \
+        == ETA_NOT_GROWING
+
+
+def test_tracker_label_and_ceiling_rules():
+    uni = _uni()
+    reg = obs_metrics.MetricsRegistry()
+    trk = CapacityTracker(reg, max_capacity=1 << 10)
+    with pytest.raises(ValueError, match="single metric segment"):
+        trk.sample(_orswot(uni, [1]), label="a.b")
+    # actor planes cap at their own width, not the executor ceiling
+    vc = VClock()
+    vc.witness(0, 1)
+    trk.sample(VClockBatch.from_scalar([vc], uni))
+    assert trk.planes()["vclock"].ceiling == 8
+
+
+def test_every_published_name_has_a_manifest_row():
+    uni = _uni()
+    reg = obs_metrics.MetricsRegistry()
+    trk = CapacityTracker(reg)
+    trk.sample(_orswot(uni, [2, 3]))
+    trk.sample(_orswot(uni, [2, 4]))  # second sample adds the rate gauge
+    log = OpLog(uni, capacity=64)
+    trk.sample_oplog(log)
+    trk.sample_gap_buffer(OpApplier(uni))
+    snap = reg.snapshot()
+    for name in snap["gauges"]:
+        assert namespace.match(name, "gauge") is not None, name
+    for name in snap["counters"]:
+        assert namespace.match(name, "counter") is not None, name
+
+
+# ---- /healthz --------------------------------------------------------------
+
+
+def test_healthz_serves_capacity_watermark_json():
+    uni = _uni()
+    reg = obs_metrics.MetricsRegistry()
+    trk = CapacityTracker(reg, max_capacity=4)
+    trk.sample(_orswot(uni, [3]))  # 3/4 = 0.75 → warn
+    srv = obs_export.start_metrics_server(port=0, registry=reg,
+                                          capacity=trk)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=10
+        ) as resp:
+            assert resp.status == 200  # a warn watermark is an alert,
+            #                            not a liveness failure
+            doc = json.loads(resp.read())
+        assert doc["status"] == "warn"
+        plane = doc["capacity"]["planes"]["orswot"]
+        assert plane["state"] == "warn"
+        assert plane["live_max"] == 3 and plane["ceiling"] == 4
+        assert plane["eta_s"] == ETA_NOT_GROWING
+        assert "uptime_s" in doc
+    finally:
+        srv.stop()
+
+
+# ---- oplog occupancy (the PR 7 buffers, now loud before they throw) --------
+
+
+def test_oplog_publishes_depth_and_watermark_gauges():
+    uni = _uni()
+    log = OpLog(uni, capacity=128)
+    ops = OpBatch(
+        kind=np.zeros(4, np.uint8), obj=np.arange(4) % 2,
+        actor=np.zeros(4, np.int32),
+        counter=np.arange(1, 5, dtype=np.uint64),
+        member=np.arange(4, dtype=np.int32),
+    )
+    log.append(ops)
+    g = obs_metrics.registry().snapshot()["gauges"]
+    assert g["oplog.log_depth"] == 4
+    assert g["oplog.watermark"] == 4
+    o = log.occupancy()
+    assert o["ops"] == 4 and o["capacity"] == 128 and o["segments"] == 1
+    assert o["bytes"] == (ops.kind.nbytes + ops.obj.nbytes
+                          + ops.actor.nbytes + ops.counter.nbytes
+                          + ops.member.nbytes)
+    assert o["watermark_max"] == 4
+    log.drain()
+    g = obs_metrics.registry().snapshot()["gauges"]
+    assert g["oplog.log_depth"] == 0
+    assert g["oplog.watermark"] == 4  # the high-watermark survives drains
+
+    reg = obs_metrics.MetricsRegistry()
+    trk = CapacityTracker(reg)
+    trk.sample_oplog(log)
+    g = reg.snapshot()["gauges"]
+    assert g["capacity.oplog.slots"] == 128
+    assert g["capacity.oplog.live"] == 0
+
+
+def test_gap_buffer_occupancy_counts_parked_adds():
+    uni = _uni()
+    applier = OpApplier(uni, park_capacity=32)
+    batch = _orswot(uni, [1, 1])
+    gapped = OpBatch(
+        kind=np.zeros(1, np.uint8), obj=np.zeros(1, np.int64),
+        actor=np.zeros(1, np.int32),
+        counter=np.asarray([9], np.uint64),  # clock is at 1: dots 2..8 missing
+        member=np.asarray([7], np.int32),
+    )
+    _, report = applier.apply_ops(batch, gapped)
+    assert report.parked == 1
+    o = applier.occupancy()
+    assert o["ops"] == 1 and o["capacity"] == 32 and o["bytes"] > 0
+    reg = obs_metrics.MetricsRegistry()
+    trk = CapacityTracker(reg)
+    trk.sample_gap_buffer(applier)
+    assert reg.snapshot()["gauges"]["capacity.oplog_gap.live"] == 1
+
+
+# ---- regrow correlation ----------------------------------------------------
+
+
+def test_executor_regrow_events_carry_before_after_stamps():
+    uni = Universe(CrdtConfig(num_actors=8, member_capacity=2,
+                              deferred_capacity=2, counter_bits=32))
+    rows = [[("a", 0), ("b", 0)], [("c", 1), ("d", 1)], [("e", 2), ("f", 2)]]
+    batches = []
+    for row in rows:
+        s = Orswot()
+        for member, actor in row:
+            s.apply(s.add(member, s.value().derive_add_ctx(actor)))
+        batches.append(OrswotBatch.from_scalar([s], uni))
+    obs_events.recorder().clear()
+    stats = JoinStats()
+    JoinExecutor(strategy="sequential").join_all(batches, stats=stats)
+    assert stats.overflow_regrows >= 1
+    timeline = obs_capacity.capacity_tracker().regrow_timeline()
+    assert len(timeline) == stats.overflow_regrows
+    for entry in timeline:
+        before_m, after_m = entry["member_capacity"]
+        assert after_m > before_m >= 2
+        assert entry["schedule"] == "sequential"
+        before_d, after_d = entry["deferred_capacity"]
+        assert after_d == before_d  # only the overflowed axis regrew
+    # the timeline is ordered and capacities walk the doubling ladder
+    walks = [e["member_capacity"] for e in timeline]
+    assert all(a == 2 * b for b, a in walks)
+
+
+# ---- fleet aggregation -----------------------------------------------------
+
+
+def _node_slice(node_id, bytes_, eta):
+    reg = obs_metrics.MetricsRegistry()
+    reg.gauge_set("capacity.orswot.bytes", bytes_)
+    reg.gauge_set("capacity.orswot.eta_s", eta)
+    reg.gauge_set("capacity.watermark", 1 if eta >= 0 else 0)
+    return obs_fleet.capture_slice(node_id, registry=reg)
+
+
+def test_fleet_capacity_sum_and_max_aggregates():
+    snap = _node_slice("a", 100.0, ETA_NOT_GROWING) \
+        .merge(_node_slice("b", 250.0, 50.0))
+    cap = snap.fleet_capacity()
+    assert cap["capacity.orswot.bytes"] == {
+        "sum": 350.0, "max": 250.0, "nodes": 2}
+    # the -1 "not growing" sentinel must not shadow the finite horizon
+    assert cap["capacity.orswot.eta_s"]["max"] == 50.0
+    assert cap["capacity.watermark"]["max"] == 1.0
+    # every node flat → the sentinel IS the fleet max
+    flat = _node_slice("a", 1.0, ETA_NOT_GROWING) \
+        .merge(_node_slice("b", 2.0, ETA_NOT_GROWING))
+    assert flat.fleet_capacity()["capacity.orswot.eta_s"]["max"] \
+        == ETA_NOT_GROWING
+
+    text = obs_fleet.fleet_prometheus_text(snap)
+    assert "crdt_tpu_fleet_capacity_orswot_bytes_sum 350" in text
+    assert "crdt_tpu_fleet_capacity_orswot_bytes_max 250" in text
+    assert "crdt_tpu_fleet_capacity_orswot_eta_s_max 50" in text
+    assert snap.to_json()["fleet"]["capacity"][
+        "capacity.orswot.bytes"]["sum"] == 350.0
+
+
+# ---- the cluster wiring ----------------------------------------------------
+
+
+def test_cluster_node_samples_planes_and_op_buffers():
+    uni = _uni()
+    reg = obs_metrics.MetricsRegistry()
+    trk = CapacityTracker(reg)
+    node = ClusterNode("n0", _orswot(uni, [1, 2]), uni,
+                       oplog=OpLog(uni, capacity=256),
+                       capacity_tracker=trk)
+    node.submit_writes([0, 1], [11, 12], actor=3)
+    occs = node.sample_capacity()
+    assert [o.kind for o in occs] == ["orswot", "oplog", "oplog_gap"]
+    g = reg.snapshot()["gauges"]
+    assert g["capacity.orswot.bytes"] == _plane_nbytes(node.batch)
+    assert g["capacity.oplog.slots"] == 256
+    assert "capacity.oplog_gap.live" in g
+    assert trk.watermark()["state"] == "ok"

@@ -1,0 +1,171 @@
+"""Long-soak capacity acceptance — the future causal-GC oracle.
+
+ISSUE 9's acceptance bar, and the measurement half of ROADMAP's causal-
+GC item: a 3-node gossip fleet under sustained write churn, where at
+every epoch
+
+* the reported plane bytes EXACTLY equal the live device buffers'
+  nbytes on every node (the gauge is the footprint, not an estimate),
+* the growth gauges are monotone (live slots never "un-fill" under
+  add-dominated churn — until a causal-GC truncate exists, planes only
+  grow, which is precisely what this observatory exists to prove), and
+* the writer node's time-to-overflow ETA is finite and shrinking
+  (steady growth against a fixed regrow ceiling must read as a
+  countdown, not noise).
+
+When batched ``Causal::truncate`` lands, this test is its acceptance
+oracle flipped: the same fleet with GC on must show bounded live slots
+and a growing ETA.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.cluster import ClusterNode, GossipScheduler, Membership, queue_pair
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.obs import metrics as obs_metrics
+from crdt_tpu.obs.capacity import CapacityTracker
+from crdt_tpu.oplog import OpLog
+from crdt_tpu.oplog.records import derive_rm_ctx
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.sync import digest as digest_mod
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = [pytest.mark.obs, pytest.mark.slow]
+
+N_OBJECTS = 8
+MEMBER_CAP = 64
+EPOCHS = 8
+NEW_MEMBERS_PER_EPOCH = 4
+EPOCH_DT = 10.0  # fake-clock seconds per epoch (deterministic rates)
+
+
+def _plane_nbytes(batch):
+    return sum(x.nbytes for x in (batch.clock, batch.ids, batch.dots,
+                                  batch.d_ids, batch.d_clocks))
+
+
+def _fleet(clock):
+    uni = Universe.identity(CrdtConfig(
+        num_actors=8, member_capacity=MEMBER_CAP, deferred_capacity=4,
+        counter_bits=32))
+    states = []
+    for _ in range(N_OBJECTS):
+        s = Orswot()
+        for m in range(4):
+            s.apply(s.add(m, s.value().derive_add_ctx(0)))
+        states.append(s)
+    base = OrswotBatch.from_scalar(states, uni)
+
+    regs = [obs_metrics.MetricsRegistry() for _ in range(3)]
+    trackers = [
+        CapacityTracker(regs[i], max_capacity=MEMBER_CAP, alpha=1.0,
+                        clock=clock)
+        for i in range(3)
+    ]
+    nodes = [
+        ClusterNode(f"n{i}", base, uni, busy_timeout_s=5.0,
+                    oplog=OpLog(uni, capacity=1 << 16),
+                    capacity_tracker=trackers[i])
+        for i in range(3)
+    ]
+
+    def make_dialer(i):
+        def dial(peer):
+            j = int(peer.peer_id[1:])
+            ta, tb = queue_pair(default_timeout=10.0)
+
+            def serve():
+                try:
+                    nodes[j].accept(tb, peer_id=f"n{i}")
+                except Exception:
+                    pass
+                finally:
+                    tb.close()
+
+            threading.Thread(target=serve, daemon=True).start()
+            return ta
+        return dial
+
+    scheds = []
+    for i in range(3):
+        m = Membership(suspect_after=3, dead_after=6)
+        for j in range(3):
+            if j != i:
+                m.add(f"n{j}")
+        scheds.append(GossipScheduler(
+            nodes[i], m, make_dialer(i), fanout=2,
+            session_timeout_s=30.0, seed=i,
+        ))
+    return uni, nodes, scheds, regs
+
+
+def test_soak_plane_bytes_exact_growth_monotone_eta_shrinking():
+    t = [0.0]
+    uni, nodes, scheds, regs = _fleet(clock=lambda: t[0])
+
+    def gauges(i):
+        return regs[i].snapshot()["gauges"]
+
+    live_hist = {i: [] for i in range(3)}
+    live_max_hist = []
+    eta_hist = []
+    next_member = 100
+    for epoch in range(EPOCHS):
+        t[0] += EPOCH_DT
+        # churn: node 0 mints NEW members onto object 0 (plane growth),
+        # plus a no-op remove of a never-added member riding the same
+        # rounds (rm traffic through the op path without shrinking
+        # planes — nothing un-fills a slot until causal GC exists)
+        members = list(range(next_member, next_member
+                             + NEW_MEMBERS_PER_EPOCH))
+        next_member += NEW_MEMBERS_PER_EPOCH
+        nodes[0].submit_writes([0] * len(members), members, actor=0)
+        nodes[0].submit_ops(derive_rm_ctx(
+            np.asarray(nodes[0].batch.clock, dtype=np.uint64),
+            [1], [999_999]))
+        for sched in scheds:
+            sched.run_round()  # each round ends in a capacity sample
+
+        for i in range(3):
+            g = gauges(i)
+            # THE acceptance identity: the gauge is the real footprint
+            assert g["capacity.orswot.bytes"] \
+                == _plane_nbytes(nodes[i].batch), (epoch, i)
+            live_hist[i].append(g["capacity.orswot.live"])
+        live_max_hist.append(gauges(0)["capacity.orswot.live_max"])
+        if epoch >= 1:
+            eta_hist.append(gauges(0)["capacity.orswot.eta_s"])
+
+    # growth gauges monotone: planes only fill under add churn
+    for i in range(3):
+        assert live_hist[i] == sorted(live_hist[i]), live_hist[i]
+    assert live_max_hist == sorted(live_max_hist)
+    # the writer's busiest object grew every epoch
+    assert live_max_hist[-1] >= live_max_hist[0] \
+        + (EPOCHS - 1) * NEW_MEMBERS_PER_EPOCH
+
+    # ETA finite and shrinking: steady growth against a fixed ceiling
+    # reads as a countdown (rates are deterministic: fake clock, EWMA
+    # alpha 1, constant members/epoch)
+    assert all(e > 0 for e in eta_hist), eta_hist
+    assert eta_hist == sorted(eta_hist, reverse=True), eta_hist
+    assert gauges(0)["capacity.orswot.growth_rows_per_s"] \
+        == pytest.approx(NEW_MEMBERS_PER_EPOCH / EPOCH_DT)
+
+    # soak sanity: with writes stopped the fleet still converges, and
+    # every node's capacity view agrees on the busiest object
+    for _ in range(3):
+        for sched in scheds:
+            sched.run_round()
+    digests = [np.asarray(digest_mod.digest_of(n.batch), dtype=np.uint64)
+               for n in nodes]
+    assert all((d == digests[0]).all() for d in digests[1:])
+    t[0] += EPOCH_DT
+    for node in nodes:
+        node.sample_capacity()
+    finals = [gauges(i)["capacity.orswot.live_max"] for i in range(3)]
+    assert len(set(finals)) == 1, finals

@@ -1544,6 +1544,118 @@ def bench_capacity_obs():
     return out
 
 
+def bench_gc():
+    """Causal-GC cost + reclamation gauge (the `crdt_tpu.gc` stage):
+    tombstone settling and plane re-packing wall at 1k/64k/1M objects
+    over a burst-over-provisioned fleet (4x the config rung — the shape
+    the executor's regrow ladder leaves behind), plus bytes reclaimed.
+
+    Parity-gated: a fleet with real op history (including deferred
+    rows) compacted by the full GcEngine pass must digest-match its
+    untouched twin — compaction is representation-only, and a stage
+    that reclaimed bytes by touching state must fail here, not in a
+    fleet."""
+    import jax
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.gc import GcEngine, GcPolicy
+    from crdt_tpu.gc.compact import settle_orswot
+    from crdt_tpu.gc.repack import repack_orswot
+    from crdt_tpu.obs import convergence as obs_convergence
+    from crdt_tpu.obs import metrics as obs_metrics
+    from crdt_tpu.scalar.ctx import RmCtx
+    from crdt_tpu.scalar.orswot import Orswot
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.sync import digest as digest_mod
+    from crdt_tpu.utils.interning import Universe
+
+    cfg = CrdtConfig(num_actors=8, member_capacity=8, deferred_capacity=4,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+
+    # -- parity gate (always runs with the stage) ---------------------------
+    rng = np.random.RandomState(29)
+    states = []
+    for i in range(256):
+        s = Orswot()
+        for j in range(int(rng.randint(1, 5))):
+            s.apply(s.add(int(rng.randint(0, 500)),
+                          s.value().derive_add_ctx(int(rng.randint(0, 4)))))
+        if i % 9 == 0:  # a causally-future remove → a deferred row
+            future = VClock()
+            future.witness(7, int(rng.randint(50, 90)))
+            s.apply(s.remove(0, RmCtx(clock=future)))
+        states.append(s)
+    twin = OrswotBatch.from_scalar(states, uni)
+    big = twin.with_capacity(32, 16)
+    eng = GcEngine(
+        GcPolicy(interval_rounds=1),
+        tracker=obs_convergence.ConvergenceTracker(
+            obs_metrics.MetricsRegistry()),
+    )
+    compacted, report = eng.collect(big, universe=uni)
+    want = np.asarray(digest_mod.digest_of(twin), np.uint64)
+    got = np.asarray(digest_mod.digest_of(compacted), np.uint64)
+    assert np.array_equal(got, want), (
+        "GC parity gate: compacted fleet's digest vector diverged from "
+        "its untruncated twin"
+    )
+    assert report.shrunk and report.reclaimed_bytes > 0
+    log(f"gc parity: 256-object history fleet compacted "
+        f"({report.reclaimed_bytes}B reclaimed, member capacity "
+        f"{report.member_capacity[0]}->{report.member_capacity[1]}), "
+        "digest vectors byte-identical")
+
+    # -- the cost/reclamation curve -----------------------------------------
+    sizes = (1_000, 16_000, 64_000) if SMALL else (1_000, 64_000, 1_000_000)
+    out = {"gc_reclaimed_frac": None}
+    for n in sizes:
+        fleet = OrswotBatch.zeros(n, uni)
+        col = np.zeros(n, np.int32)
+        for j in range(3):  # 3 live members per object
+            fleet = fleet.apply_add(
+                col, np.full(n, j + 1, np.uint32),
+                np.full(n, j, np.int32))
+        grown = fleet.with_capacity(cfg.member_capacity * 4,
+                                    cfg.deferred_capacity * 4)
+        bytes_before = sum(
+            x.nbytes for x in (grown.clock, grown.ids, grown.dots,
+                               grown.d_ids, grown.d_clocks))
+        settled, _ = settle_orswot(grown)  # compile + warm
+        jax.block_until_ready(settled.ids)
+        iters = 3 if n < 1_000_000 else 1
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            settled, _ = settle_orswot(grown)
+            jax.block_until_ready(settled.ids)
+        settle_ms = (time.perf_counter() - t0) / iters * 1e3
+
+        reg = obs_metrics.MetricsRegistry()
+        shrunk, reclaimed = repack_orswot(
+            settled, cfg.member_capacity, cfg.deferred_capacity,
+            registry=reg)  # compile + warm
+        jax.block_until_ready(shrunk.ids)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            shrunk, reclaimed = repack_orswot(
+                settled, cfg.member_capacity, cfg.deferred_capacity,
+                registry=reg)
+            jax.block_until_ready(shrunk.ids)
+        repack_ms = (time.perf_counter() - t0) / iters * 1e3
+
+        out[f"gc_settle_ms_{n}"] = round(settle_ms, 3)
+        out[f"gc_repack_ms_{n}"] = round(repack_ms, 3)
+        out[f"gc_reclaimed_bytes_{n}"] = int(reclaimed)
+        out["gc_reclaimed_frac"] = round(reclaimed / bytes_before, 4)
+        log(f"gc: N={n}  settle {settle_ms:.2f}ms  repack "
+            f"{repack_ms:.2f}ms  reclaimed {reclaimed/1e6:.1f}MB of "
+            f"{bytes_before/1e6:.1f}MB "
+            f"({reclaimed / bytes_before:.0%})")
+        del fleet, grown, settled, shrunk
+    return out
+
+
 def bench_bandwidth_floor():
     """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
     elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
@@ -2198,6 +2310,13 @@ def main():
     cap_res = run_stage("capacity_obs", 20, bench_capacity_obs)
     if cap_res is not None:
         emit(**cap_res)
+    # budget-skippable: causal-GC settle/re-pack wall + bytes reclaimed
+    # over a burst-over-provisioned fleet, parity-gated (digest vectors
+    # byte-identical vs the untruncated twin); the `gc` counter family
+    # in the obs tail warns if collection stops running round over round
+    gc_res = run_stage("gc", 30, bench_gc)
+    if gc_res is not None:
+        emit(**gc_res)
     # budget-skippable: kernelcheck coverage gauge (analyzer wall time +
     # kernels-covered counts, so a kernel module escaping the manifest
     # shows in the artifact tail as a coverage count that stopped moving)

@@ -122,6 +122,12 @@ def counter_family(name: str) -> str:
     ``wire.orswot.from_wire``); names without a recognized leaf are
     their own family."""
     parts = name.split(".")
+    if parts[0] == "gc":
+        # the causal-GC counters (runs/shrinks/reclaimed_bytes/...)
+        # collapse into ONE family: an idle-fleet round legitimately
+        # reclaims nothing, so individual leaves vanishing must not
+        # warn — only GC disappearing wholesale is the signal
+        return "gc"
     if "fallback_reason" in parts:
         return ".".join(parts[:parts.index("fallback_reason")])
     if "rejected" in parts[:-1]:

@@ -483,6 +483,47 @@ def _b_occupancy(which: str):
     return build
 
 
+def _b_gc_settle():
+    """The standalone defer plunger (gc/compact.py): the same replay
+    stage merge's deferred pipeline runs, traced across the regrow
+    ladder like the merge kernels whose planes it settles."""
+
+    def build():
+        from ..gc import compact as gc_compact
+
+        fn = _unjit(gc_compact._settle)
+        return [
+            TraceCase(rung=f"A{a}.M{m}.D{d}", fn=fn,
+                      args=_orswot_planes(a, m, d))
+            for (a, m, d) in LADDER
+        ]
+
+    return build
+
+
+def _b_gc_repack():
+    """The shrink re-pack (gc/repack.py): every ladder rung re-packed
+    one rung down — the shrink direction the executor's regrow ladder
+    never exercises."""
+
+    def build():
+        import functools
+
+        from ..gc import repack as gc_repack
+
+        fn = _unjit(gc_repack._repack)
+        cases = []
+        for (a, m, d) in LADDER:
+            m_new, d_new = max(1, m // 2), max(1, d // 2)
+            cases.append(TraceCase(
+                rung=f"A{a}.M{m}.D{d}->M{m_new}.D{d_new}",
+                fn=functools.partial(fn, m_cap=m_new, d_cap=d_new),
+                args=_orswot_planes(a, m, d), key=(m_new, d_new)))
+        return cases
+
+    return build
+
+
 def _b_wireloop_merge():
     def build():
         import functools
@@ -812,6 +853,11 @@ MANIFEST: tuple = (
                "_pn_occupancy", build=_b_occupancy("pn")),
     KernelSpec("batch.occupancy.map", "crdt_tpu/batch/occupancy.py",
                "_map_occupancy", build=_b_occupancy("map")),
+    # gc/ (causal garbage collection) ----------------------------------------
+    KernelSpec("gc.settle", "crdt_tpu/gc/compact.py", "_settle",
+               build=_b_gc_settle()),
+    KernelSpec("gc.repack", "crdt_tpu/gc/repack.py", "_repack",
+               build=_b_gc_repack()),
     # batch/wireloop.py ------------------------------------------------------
     KernelSpec("batch.wireloop.fold_merge", "crdt_tpu/batch/wireloop.py",
                "PipelinedWireLoop._merge_jnp.<jit>",

@@ -42,6 +42,9 @@ THREADED_MODULES = (
     "crdt_tpu/parallel/executor.py",
     "crdt_tpu/utils/tracing.py",
     "crdt_tpu/sync/session.py",
+    # the causal-GC layer runs from the gossip thread AND operator
+    # calls; its watermark bookkeeping is lock-guarded
+    "crdt_tpu/gc/",
 )
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}

@@ -44,6 +44,10 @@ WIRE_MODULES = (
     # envelope discipline as the sync frames: decode paths must speak
     # SyncProtocolError/WireFormatError, never bare stdlib errors
     "crdt_tpu/oplog/",
+    # the causal-GC layer mutates the same planes the wire codecs feed
+    # and consumes the digest frames' version vectors; its (rare)
+    # decode-adjacent paths are held to the same error contract
+    "crdt_tpu/gc/",
     # the fleet-observatory snapshot codec rides the same envelope
     # discipline as the sync frames, so its decode paths are held to
     # the same error contract

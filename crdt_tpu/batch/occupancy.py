@@ -146,7 +146,7 @@ def occupancy_of(batch) -> Occupancy:
             slot_capacity=m, slots=n * m,
             live=int(stats[0]), live_max=int(stats[1]),
             tombstone_capacity=int(batch.d_ids.shape[1]),
-            tombstones=int(stats[2]),
+            tombstones=int(stats[2]), tombstones_max=int(stats[3]),
             actors=int(batch.clock.shape[1]), actors_live=int(stats[5]),
         )
     if hasattr(batch, "d_keys") and hasattr(batch, "keys"):
@@ -161,7 +161,7 @@ def occupancy_of(batch) -> Occupancy:
             slot_capacity=k, slots=n * k,
             live=int(stats[0]), live_max=int(stats[1]),
             tombstone_capacity=int(batch.d_keys.shape[1]),
-            tombstones=int(stats[2]),
+            tombstones=int(stats[2]), tombstones_max=int(stats[3]),
             actors=int(batch.clock.shape[1]), actors_live=int(stats[5]),
         )
     if hasattr(batch, "planes"):

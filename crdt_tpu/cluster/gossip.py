@@ -100,7 +100,8 @@ class ClusterNode:
                  busy_timeout_s: float = 10.0,
                  observatory=None,
                  oplog=None,
-                 capacity_tracker=None):
+                 capacity_tracker=None,
+                 gc=None):
         self.node_id = node_id
         self.universe = universe
         self.full_state_threshold = full_state_threshold
@@ -109,6 +110,11 @@ class ClusterNode:
         #: occupancy samples feed (None = the process-global one); the
         #: gossip scheduler samples once per round
         self.capacity_tracker = capacity_tracker
+        #: a :class:`crdt_tpu.gc.GcEngine`; when set, the gossip
+        #: scheduler runs :meth:`collect_garbage` at round end on the
+        #: engine's cadence — compaction between sessions, never
+        #: concurrently with one (the busy lock serializes them)
+        self.gc = gc
         #: a :class:`crdt_tpu.obs.fleet.FleetObservatory`; every session
         #: this node runs advertises it in the hello and piggybacks a
         #: merged-snapshot exchange once the session converged, so
@@ -125,6 +131,7 @@ class ClusterNode:
         self._mint = threading.Lock()   # serializes dot minting
         self._batch = batch
         self._last_report: Optional[SyncReport] = None
+        self._last_gc_report = None
 
     @property
     def batch(self):
@@ -319,6 +326,38 @@ class ClusterNode:
             finally:
                 self._busy.release()
 
+    @property
+    def last_gc_report(self):
+        """The most recent collection pass's
+        :class:`~crdt_tpu.gc.GcReport` (None until GC has run)."""
+        with self._lock:
+            return self._last_gc_report
+
+    def collect_garbage(self, peers=None):
+        """Run one causal-GC pass on this node's batch + op buffers
+        (:meth:`crdt_tpu.gc.GcEngine.collect`).  Returns the
+        :class:`~crdt_tpu.gc.GcReport`, or None when no engine is
+        configured or a sync session currently holds the busy lock —
+        compaction never runs concurrently with a session on the same
+        node (it retries next round instead of queueing).  ``peers``
+        is the roster the fleet watermark must account for."""
+        if self.gc is None:
+            return None
+        if not self._busy.acquire(blocking=False):
+            return None
+        try:
+            with self._lock:
+                batch = self._batch
+            batch, report = self.gc.collect(
+                batch, universe=self.universe, oplog=self._oplog,
+                applier=self._applier, peers=peers)
+            with self._lock:
+                self._batch = batch
+                self._last_gc_report = report
+            return report
+        finally:
+            self._busy.release()
+
     def sample_capacity(self) -> list:
         """Sample this node's dense planes + op buffers into the
         ``crdt_tpu_capacity_*`` gauges (one jitted reduction + a small
@@ -508,6 +547,22 @@ class GossipScheduler:
         # in peer members (plane growth) or drained queued ops, so the
         # occupancy gauges / growth ETAs refresh on the post-round state
         self.node.sample_capacity()
+        # causal GC between sessions: the engine decides cadence (every
+        # Nth round, or early on a capacity-watermark trigger); the
+        # roster includes DEAD peers — the watermark's quarantine, not
+        # the membership state, decides when a silent peer stops
+        # freezing the fleet's memory
+        if self.node.gc is not None and self.node.gc.due(round_no):
+            roster = [
+                p.peer_id for p in self.membership.peers(
+                    membership_mod.ALIVE, membership_mod.SUSPECT,
+                    membership_mod.DEAD)
+            ]
+            if self.node.collect_garbage(peers=roster) is not None:
+                # a shrink/settle changed the planes: refresh the
+                # occupancy gauges on the post-GC state (and re-seed
+                # the EWMA on a capacity change)
+                self.node.sample_capacity()
         return report
 
     def _publish_round_health(self, report: RoundReport) -> None:

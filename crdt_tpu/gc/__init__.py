@@ -1,0 +1,31 @@
+"""crdt_tpu.gc — causal garbage collection for long-lived fleets.
+
+The memory-reclamation layer ROADMAP's causal-GC item asked for, built
+against the PR 9 capacity observatory's numbers:
+
+* :mod:`crdt_tpu.gc.watermark` — the fleet **low-watermark clock**: the
+  element-wise minimum over the per-peer version vectors the digest
+  exchange already ships, with staleness freezing and dead-peer
+  quarantine (`gc.watermark.*` gauges).
+* :mod:`crdt_tpu.gc.compact` — jitted masked-compaction kernels:
+  tombstone settling (the defer plunger as a standalone kernel, without
+  a merge), the batched ``Causal::truncate`` reset, and op-log /
+  gap-buffer column compaction below the watermark.
+* :mod:`crdt_tpu.gc.repack` — plane re-packing: the executor's regrow
+  path in reverse, shrinking over-provisioned slot axes back down the
+  capacity ladder (``executor.shrink`` flight-recorder events).
+* :mod:`crdt_tpu.gc.policy` — :class:`GcPolicy` + :class:`GcEngine`:
+  when to run, what to reclaim, and the ``gc.*`` accounting; driven
+  from the gossip scheduler between sync sessions.
+"""
+
+from .policy import GcEngine, GcPolicy, GcReport  # noqa: F401
+from .watermark import FleetWatermark, WatermarkReport  # noqa: F401
+
+__all__ = [
+    "FleetWatermark",
+    "GcEngine",
+    "GcPolicy",
+    "GcReport",
+    "WatermarkReport",
+]

@@ -1,0 +1,158 @@
+"""Masked-compaction kernels — what causal GC may actually reclaim.
+
+Three compactions, in decreasing order of how freely they may run:
+
+* **Tombstone settling** (:func:`settle_orswot`) — replay every
+  deferred-remove row the object's own clock already dominates and
+  clear it, then re-pack the member/deferred tables into canonical
+  order.  This is exactly the defer plunger (``merge`` with an empty
+  set, `test/orswot.rs:61-62`) as ONE standalone kernel instead of a
+  full merge: any later merge would perform the same replay
+  (:func:`crdt_tpu.ops.orswot_ops._apply_deferred` is the shared
+  stage), so a settled replica and its unsettled twin converge to
+  byte-identical digest vectors after any plunged merge — the property
+  ``tests/test_gc.py`` pins.  Safe to run unilaterally, any time.
+* **Op-buffer compaction** (:func:`compact_oplog` /
+  :func:`compact_gap_buffer`) — drop buffered add/inc/dec ops whose
+  dot the local planes already witness (``counter <= clock[obj,
+  actor]`` — the exact dedup the apply kernel would perform), gated
+  below the fleet watermark so a dropped op is one every heard-from
+  peer's frontier already covers (the state path re-ships it anyway;
+  the gate just avoids shedding ops a piggyback could still deliver
+  first).  Removes and LWW writes are never dropped — they are not
+  dots and carry intent.
+* **Reset truncation** (:func:`truncate_orswot`) — the reference's
+  full ``Causal::truncate`` (`orswot.rs:159-172`): merge with an empty
+  set carrying the clock, then subtract it everywhere.  This is
+  *reset-remove* semantics (what ``Map::rm`` uses on nested values,
+  `map.rs:131-158`) — it deletes members the clock dominates, so it is
+  NOT digest-preserving under unilateral GC and the default
+  :class:`~crdt_tpu.gc.policy.GcPolicy` never runs it; it is exposed
+  for coordinated fleets where every replica truncates at the same
+  watermark, and parity-pinned against the scalar implementation
+  (`crdt_tpu/scalar/orswot.py::truncate`).
+
+Capacity reclamation (the bytes) lives in :mod:`crdt_tpu.gc.repack`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import orswot_ops
+from ..ops.orswot_ops import EMPTY
+
+
+@jax.jit
+def _settle(clock, ids, dots, d_ids, d_clocks):
+    """Standalone defer plunger: dedup + replay dominated deferred rows
+    (the same :func:`~crdt_tpu.ops.orswot_ops._apply_deferred` stage
+    ``merge`` runs), then re-pack both slot tables into canonical order
+    (ascending member id / live-rows-first) at unchanged capacities.
+    Returns the four mutated planes plus an ``int64[2]`` stats vector:
+    deferred rows cleared, member slots freed."""
+    tombs_before = jnp.sum(d_ids != EMPTY)
+    members_before = jnp.sum(ids != EMPTY)
+    d_ids, d_clocks = orswot_ops._dedup_deferred(d_ids, d_clocks)
+    ids, dots, d_ids, d_clocks = orswot_ops._apply_deferred(
+        clock, ids, dots, d_ids, d_clocks)
+    # canonical re-pack at the SAME capacities: slot order is
+    # representation (the digest is slot-order invariant), and the
+    # ascending-id layout is what every other kernel emits
+    ids, dots, _ = orswot_ops.compact_by_id(ids, dots, ids.shape[-1])
+    d_ids, d_clocks, _ = orswot_ops.compact(
+        d_ids, d_clocks, d_ids.shape[-1])
+    stats = jnp.stack([
+        tombs_before - jnp.sum(d_ids != EMPTY),
+        members_before - jnp.sum(ids != EMPTY),
+    ]).astype(jnp.int64)
+    return ids, dots, d_ids, d_clocks, stats
+
+
+def settle_orswot(batch):
+    """``(settled_batch, stats)`` — tombstone settling for an
+    :class:`~crdt_tpu.batch.orswot_batch.OrswotBatch` (see module
+    docstring).  ``stats``: ``{"tombstones_cleared", "members_freed"}``
+    (members freed = entries a replayed remove emptied, exactly what
+    the next plunged merge would have dropped)."""
+    ids, dots, d_ids, d_clocks, stats = _settle(
+        batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks)
+    stats = np.asarray(stats)
+    settled = type(batch)(clock=batch.clock, ids=ids, dots=dots,
+                          d_ids=d_ids, d_clocks=d_clocks)
+    return settled, {
+        "tombstones_cleared": int(stats[0]),
+        "members_freed": int(stats[1]),
+    }
+
+
+def truncate_orswot(batch, clock, check: bool = True):
+    """The batched reference ``Causal::truncate`` at one fleet-wide
+    clock: ``clock`` is ``uint64[A]`` (e.g. a watermark) broadcast to
+    every object, or a full ``[N, A]`` plane.  Reset-remove semantics —
+    see the module docstring for why the default policy never runs
+    this unilaterally."""
+    t = jnp.asarray(clock, dtype=batch.clock.dtype)
+    if t.ndim == 1:
+        t = jnp.broadcast_to(t, batch.clock.shape)
+    return batch.truncate(t, check=check)
+
+
+# ---------------------------------------------------------------------------
+# op-buffer compaction (host-side: the buffers are numpy columns)
+# ---------------------------------------------------------------------------
+
+
+def witnessed_ops_mask(ops, clock_plane,
+                       watermark: Optional[np.ndarray] = None
+                       ) -> np.ndarray:
+    """``bool[B]``: buffered ops the local planes already witness —
+    add/inc/dec rows with ``counter <= clock_plane[obj, actor]`` (the
+    apply kernel's dedup criterion, so dropping them cannot change any
+    state), optionally also required to sit at or below the fleet
+    ``watermark`` entry for their actor.  Removes/LWW writes are never
+    flagged."""
+    from ..oplog.records import OP_ADD, OP_DEC, OP_INC
+
+    if not len(ops):
+        return np.zeros(0, dtype=bool)
+    clock_plane = np.asarray(clock_plane)
+    dotted = np.isin(ops.kind, np.asarray(
+        [OP_ADD, OP_INC, OP_DEC], np.uint8))
+    counters = ops.counter.astype(np.uint64)
+    witnessed = dotted & (
+        counters <= clock_plane[ops.obj, ops.actor].astype(np.uint64))
+    if watermark is not None:
+        wm = np.asarray(watermark, dtype=np.uint64).reshape(-1)
+        in_range = ops.actor < wm.size
+        wm_of = np.zeros(len(ops), np.uint64)
+        wm_of[in_range] = wm[ops.actor[in_range]]
+        witnessed &= in_range & (counters <= wm_of)
+    return witnessed
+
+
+def compact_oplog(log, clock_plane,
+                  watermark: Optional[np.ndarray] = None) -> dict:
+    """Compact an :class:`~crdt_tpu.oplog.OpLog`'s per-actor columns
+    below the watermark: buffered dots the local planes already
+    witness (and, when a ``watermark`` is given, that every heard-from
+    peer's frontier covers) are dropped in place.  Returns the log's
+    ``{"ops_dropped", "bytes_reclaimed"}``."""
+    dropped, freed = log.compact(
+        lambda ops: witnessed_ops_mask(ops, clock_plane, watermark))
+    return {"ops_dropped": dropped, "bytes_reclaimed": freed}
+
+
+def compact_gap_buffer(applier, clock_plane,
+                       watermark: Optional[np.ndarray] = None) -> dict:
+    """Same compaction for the causal-gap park buffer
+    (:class:`~crdt_tpu.oplog.OpApplier`): a parked add whose dot the
+    planes now witness arrived twice — state sync closed the gap — and
+    replaying it would be a no-op anyway."""
+    dropped, freed = applier.prune(
+        lambda ops: witnessed_ops_mask(ops, clock_plane, watermark))
+    return {"ops_dropped": dropped, "bytes_reclaimed": freed}

@@ -1,0 +1,268 @@
+"""GcPolicy + GcEngine — when causal GC runs and what it reclaims.
+
+One :class:`GcEngine` per node.  The gossip scheduler drives it at
+round end (:meth:`crdt_tpu.cluster.gossip.GossipScheduler.run_round` —
+compaction runs BETWEEN sync sessions, never concurrently with one on
+the same node: the node's busy lock serializes them), or call
+:meth:`GcEngine.collect` directly for scheduler-less deployments.
+
+One collection pass:
+
+1. compute the fleet low-watermark from the cached per-peer version
+   vectors (:class:`~crdt_tpu.gc.watermark.FleetWatermark`; publishes
+   the ``gc.watermark.*`` gauges),
+2. settle tombstones — the standalone defer plunger
+   (:func:`~crdt_tpu.gc.compact.settle_orswot`),
+3. re-pack the slot axes down the capacity ladder when the live
+   occupancy clears the shrink hysteresis
+   (:func:`~crdt_tpu.gc.repack.shrink_plan` /
+   :func:`~crdt_tpu.gc.repack.repack_orswot`),
+4. compact the op-log columns and the causal-gap park buffer below
+   each actor's watermark entry
+   (:func:`~crdt_tpu.gc.compact.compact_oplog` /
+   :func:`~crdt_tpu.gc.compact.compact_gap_buffer`).
+
+Every pass counts into ``gc.runs`` / ``gc.tombstones_cleared`` /
+``gc.oplog_ops_dropped`` / ``gc.reclaimed_bytes`` (+ ``gc.shrinks``
+from the repack layer), times itself under the ``gc.collect`` span,
+and leaves a ``gc.collect`` flight-recorder event — so a fleet's
+steady-state memory story is auditable, not inferred.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
+from ..utils import tracing
+from .watermark import FleetWatermark, WatermarkReport
+
+
+@dataclasses.dataclass
+class GcPolicy:
+    """Operator knobs for one node's causal GC.
+
+    ``interval_rounds`` — run every Nth gossip round (1 = every round).
+    ``utilization_trigger`` — additionally run off-cadence the moment
+    the capacity tracker's overall watermark state reaches this level
+    (``"warn"``/``"critical"``; ``None`` disables the trigger).
+    ``shrink_hysteresis`` — re-pack only when the fitted capacity rung
+    is at most this fraction of the current one (anti-flap headroom).
+    ``member_floor``/``deferred_floor`` — smallest rungs a shrink may
+    reach; ``None`` = the universe config's capacities (the smallest
+    wire-ingest-compatible shapes — see :mod:`crdt_tpu.gc.repack`).
+    ``stale_after_s``/``quarantine_s`` — the watermark liveness rules
+    (:class:`~crdt_tpu.gc.watermark.FleetWatermark`).
+    ``compact_op_buffers`` — drop witnessed dots from the op log and
+    gap buffer below the watermark.
+    """
+
+    interval_rounds: int = 4
+    utilization_trigger: Optional[str] = "warn"
+    shrink_hysteresis: float = 0.5
+    member_floor: Optional[int] = None
+    deferred_floor: Optional[int] = None
+    stale_after_s: float = 30.0
+    quarantine_s: float = 300.0
+    compact_op_buffers: bool = True
+
+    def __post_init__(self):
+        if self.interval_rounds < 1:
+            raise ValueError(
+                f"interval_rounds {self.interval_rounds} < 1")
+        if self.utilization_trigger not in (None, "warn", "critical"):
+            raise ValueError(
+                f"utilization_trigger must be None/'warn'/'critical', "
+                f"got {self.utilization_trigger!r}")
+
+
+@dataclasses.dataclass
+class GcReport:
+    """What one collection pass reclaimed."""
+
+    watermark: Optional[WatermarkReport] = None
+    tombstones_cleared: int = 0
+    members_freed: int = 0
+    shrunk: bool = False
+    member_capacity: Optional[tuple] = None    # (before, after)
+    deferred_capacity: Optional[tuple] = None  # (before, after)
+    reclaimed_bytes: int = 0
+    oplog_ops_dropped: int = 0
+    skipped: Optional[str] = None  # why the pass did nothing (if it did)
+
+
+class GcEngine:
+    """Runs :class:`GcPolicy` against one node's batch + op buffers.
+
+    ``tracker`` is the convergence tracker whose version-vector cache
+    feeds the watermark (the process-global one by default);
+    ``capacity_tracker`` supplies the utilization trigger.  The engine
+    accumulates ``total_reclaimed_bytes`` across passes — what the
+    examples print per node at convergence.
+    """
+
+    def __init__(self, policy: Optional[GcPolicy] = None, *,
+                 tracker=None, capacity_tracker=None,
+                 registry: Optional[obs_metrics.MetricsRegistry] = None,
+                 clock=None):
+        self.policy = policy if policy is not None else GcPolicy()
+        self._capacity_tracker = capacity_tracker
+        self._registry = registry
+        kwargs = {} if clock is None else {"clock": clock}
+        self.watermark = FleetWatermark(
+            tracker, stale_after_s=self.policy.stale_after_s,
+            quarantine_s=self.policy.quarantine_s, registry=registry,
+            **kwargs)
+        self.runs = 0
+        self.total_reclaimed_bytes = 0
+        self.last_report: Optional[GcReport] = None
+
+    def _reg(self) -> obs_metrics.MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else obs_metrics.registry()
+
+    # -- scheduling ----------------------------------------------------------
+
+    def due(self, round_no: int) -> bool:
+        """Whether the round-end hook should collect this round: the
+        cadence, or the capacity watermark trigger firing early."""
+        if round_no % self.policy.interval_rounds == 0:
+            return True
+        trigger = self.policy.utilization_trigger
+        if trigger is not None and self._capacity_tracker is not None:
+            from ..obs.capacity import WATERMARK_STATES
+
+            state = self._capacity_tracker.watermark()["state"]
+            return WATERMARK_STATES.index(state) \
+                >= WATERMARK_STATES.index(trigger)
+        return False
+
+    # -- one pass ------------------------------------------------------------
+
+    def collect(self, batch, *, universe=None, oplog=None, applier=None,
+                peers: Optional[Iterable[str]] = None):
+        """``(batch, GcReport)`` — one collection pass over ``batch``
+        (and optionally its op buffers).  Only dense ORSWOT-shaped
+        batches compact today; other types get the watermark gauges and
+        op-buffer compaction but no plane work (``report.skipped``
+        says so).  ``peers`` is the membership roster the watermark
+        must account for (unheard peers pin it at zero)."""
+        import numpy as np
+
+        from ..sync import digest as digest_mod
+
+        policy = self.policy
+        report = GcReport()
+        with tracing.span("gc.collect"):
+            try:
+                local_vv = digest_mod.version_vector(batch)
+            except TypeError:
+                local_vv = None
+            if local_vv is not None:
+                report.watermark = self.watermark.compute(
+                    np.asarray(local_vv).reshape(-1), peers=peers)
+
+            if hasattr(batch, "d_ids") and hasattr(batch, "ids"):
+                batch, report = self._collect_orswot(
+                    batch, universe, report)
+            else:
+                report.skipped = "no compaction kernels for " \
+                    f"{type(batch).__name__}"
+
+            if policy.compact_op_buffers and report.watermark is not None:
+                report.oplog_ops_dropped += self._compact_buffers(
+                    batch, oplog, applier, report)
+
+        self.runs += 1
+        self.total_reclaimed_bytes += report.reclaimed_bytes
+        self.last_report = report
+        reg = self._reg()
+        reg.counter_inc("gc.runs")
+        if report.tombstones_cleared:
+            reg.counter_inc("gc.tombstones_cleared",
+                            report.tombstones_cleared)
+        if report.oplog_ops_dropped:
+            reg.counter_inc("gc.oplog_ops_dropped",
+                            report.oplog_ops_dropped)
+        obs_events.record(
+            "gc.collect",
+            tombstones_cleared=report.tombstones_cleared,
+            members_freed=report.members_freed,
+            shrunk=report.shrunk,
+            reclaimed_bytes=report.reclaimed_bytes,
+            oplog_ops_dropped=report.oplog_ops_dropped,
+            watermark_peers=(report.watermark.peers
+                             if report.watermark else 0),
+            watermark_frozen=(report.watermark.frozen
+                              if report.watermark else True),
+        )
+        return batch, report
+
+    def _collect_orswot(self, batch, universe, report: GcReport):
+        from ..batch.occupancy import occupancy_of
+        from . import compact as gc_compact
+        from . import repack as gc_repack
+
+        batch, stats = gc_compact.settle_orswot(batch)
+        report.tombstones_cleared = stats["tombstones_cleared"]
+        report.members_freed = stats["members_freed"]
+
+        policy = self.policy
+        m_floor = policy.member_floor
+        d_floor = policy.deferred_floor
+        if universe is not None:
+            cfg = universe.config
+            # never below the config rung: wire/delta ingest builds
+            # peer batches at exactly these shapes
+            m_floor = max(m_floor or 0, cfg.member_capacity)
+            d_floor = max(d_floor or 0, cfg.deferred_capacity)
+        if m_floor is None or d_floor is None:
+            raise ValueError(
+                "GcEngine.collect needs a universe (config floors) or "
+                "explicit member_floor/deferred_floor in the policy"
+            )
+        plan = gc_repack.shrink_plan(
+            occupancy_of(batch), member_floor=m_floor,
+            deferred_floor=d_floor,
+            hysteresis=policy.shrink_hysteresis)
+        if plan is not None:
+            m_before, d_before = (batch.member_capacity,
+                                  batch.deferred_capacity)
+            batch, reclaimed = gc_repack.repack_orswot(
+                batch, *plan, registry=self._registry)
+            report.shrunk = True
+            report.member_capacity = (m_before, batch.member_capacity)
+            report.deferred_capacity = (d_before,
+                                        batch.deferred_capacity)
+            report.reclaimed_bytes += reclaimed
+        return batch, report
+
+    def _compact_buffers(self, batch, oplog, applier,
+                         report: GcReport) -> int:
+        import numpy as np
+
+        from . import compact as gc_compact
+
+        clock_plane = getattr(batch, "clock", None)
+        if clock_plane is None or oplog is None and applier is None:
+            return 0
+        clock_host = np.asarray(clock_plane)
+        if clock_host.ndim != 2:
+            return 0
+        wm = report.watermark.clock
+        dropped = 0
+        freed = 0
+        if oplog is not None:
+            res = gc_compact.compact_oplog(oplog, clock_host, wm)
+            dropped += res["ops_dropped"]
+            freed += res["bytes_reclaimed"]
+        if applier is not None:
+            res = gc_compact.compact_gap_buffer(applier, clock_host, wm)
+            dropped += res["ops_dropped"]
+            freed += res["bytes_reclaimed"]
+        if freed:
+            report.reclaimed_bytes += freed
+            self._reg().counter_inc("gc.reclaimed_bytes", freed)
+        return dropped

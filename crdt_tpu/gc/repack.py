@@ -1,0 +1,140 @@
+"""Plane re-packing — the executor's regrow path in reverse.
+
+Capacity regrows (:class:`crdt_tpu.parallel.executor.JoinExecutor`)
+double the padded member/deferred slot axes on overflow and never come
+back down: after a burst, a fleet drags 2-8x the planes its live
+occupancy needs, forever.  This module shrinks them again:
+
+* :func:`shrink_plan` — the hysteresis decision: given a fresh
+  :class:`~crdt_tpu.obs.capacity.Occupancy` sample, pick the smallest
+  power-of-two capacity rung that still fits the busiest object, and
+  only propose it when it clears ``hysteresis`` headroom below the
+  current rung (so a fleet oscillating around a rung boundary never
+  shrink/regrow-flaps).
+* :func:`repack_orswot` — one jitted kernel
+  (:func:`~crdt_tpu.ops.orswot_ops.compact_by_id` /
+  :func:`~crdt_tpu.ops.orswot_ops.compact` — the same packing stages
+  the merge pipeline uses) packs live slots first and slices the slot
+  axes down to the new rung, then the host releases the old buffers.
+  Slot order is representation, so the digest vector is untouched —
+  re-packing reclaims bytes, never state.
+
+Every shrink lands in the flight recorder as an ``executor.shrink``
+event with before/after capacity stamps — symmetric to the
+``executor.regrow`` events the capacity observatory's
+``regrow_timeline`` correlates — and in the ``gc.shrinks`` /
+``gc.reclaimed_bytes`` counters.
+
+Floors: node-level GC never shrinks below the universe config's
+capacities — the wire/delta ingest paths build peer batches at exactly
+those shapes (``sync/delta.py`` warm buffers), so the config rung is
+the smallest session-compatible capacity.  Pass explicit floors to go
+lower on fleets that never ingest wire state.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
+from ..ops import orswot_ops
+from ..utils import tracing
+
+
+def _next_pow2(c: int) -> int:
+    return 1 if c <= 0 else 1 << (c - 1).bit_length()
+
+
+@functools.partial(jax.jit, static_argnames=("m_cap", "d_cap"))
+def _repack(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap):
+    """Pack live member slots (ascending id — the canonical order) and
+    live deferred rows first, slice both slot axes to the new rungs.
+    Returns the planes plus the two would-truncate-live-rows overflow
+    flags (the host refuses the shrink rather than dropping state)."""
+    ids2, dots2, m_over = orswot_ops.compact_by_id(ids, dots, m_cap)
+    d_ids2, d_clocks2, d_over = orswot_ops.compact(d_ids, d_clocks, d_cap)
+    return (clock, ids2, dots2, d_ids2, d_clocks2,
+            jnp.any(m_over), jnp.any(d_over))
+
+
+def shrink_plan(occ, *, member_floor: int, deferred_floor: int,
+                hysteresis: float = 0.5) -> Optional[Tuple[int, int]]:
+    """``(member_capacity, deferred_capacity)`` to re-pack to, or None
+    when the planes are already tight.
+
+    ``occ`` is an ORSWOT/Map-shaped :class:`~crdt_tpu.obs.capacity.
+    Occupancy` (needs ``live_max`` / ``tombstones_max``).  A shrink is
+    proposed only when the fitted rung is at most ``hysteresis`` of the
+    current one on the axis that shrinks — the headroom that keeps a
+    fleet hovering at a rung boundary from regrow/shrink flapping."""
+    if not 0.0 < hysteresis <= 1.0:
+        raise ValueError(f"hysteresis {hysteresis} not in (0, 1]")
+    m_cur = occ.slot_capacity
+    d_cur = occ.tombstone_capacity
+    m_new = max(int(member_floor), _next_pow2(occ.live_max))
+    d_new = max(int(deferred_floor), _next_pow2(occ.tombstones_max))
+    m_new = min(m_new, m_cur)
+    d_new = min(d_new, d_cur)
+    shrinks = False
+    if m_new < m_cur and m_new <= m_cur * hysteresis:
+        shrinks = True
+    else:
+        m_new = m_cur
+    if d_new < d_cur and d_new <= d_cur * hysteresis:
+        shrinks = True
+    else:
+        d_new = d_cur
+    return (m_new, d_new) if shrinks else None
+
+
+def repack_orswot(batch, member_capacity: Optional[int] = None,
+                  deferred_capacity: Optional[int] = None,
+                  registry: Optional[obs_metrics.MetricsRegistry] = None):
+    """``(repacked_batch, reclaimed_bytes)`` — shrink ``batch``'s slot
+    axes to the given capacities (None = keep).  Raises ``ValueError``
+    when a live row would not fit (use :func:`shrink_plan` to pick
+    capacities that do).  Emits the ``executor.shrink`` event with
+    before/after stamps and counts the freed bytes."""
+    m_before = batch.member_capacity
+    d_before = batch.deferred_capacity
+    m_new = m_before if member_capacity is None else int(member_capacity)
+    d_new = d_before if deferred_capacity is None else int(deferred_capacity)
+    if m_new > m_before or d_new > d_before:
+        raise ValueError(
+            f"repack cannot grow (member {m_before}->{m_new}, deferred "
+            f"{d_before}->{d_new}); use with_capacity to regrow"
+        )
+    if (m_new, d_new) == (m_before, d_before):
+        return batch, 0
+    bytes_before = sum(
+        x.nbytes for x in (batch.clock, batch.ids, batch.dots,
+                           batch.d_ids, batch.d_clocks))
+    with tracing.span("executor.shrink"):
+        clock, ids, dots, d_ids, d_clocks, m_over, d_over = _repack(
+            batch.clock, batch.ids, batch.dots, batch.d_ids,
+            batch.d_clocks, m_cap=m_new, d_cap=d_new)
+        if bool(m_over) or bool(d_over):
+            raise ValueError(
+                f"repack to (member={m_new}, deferred={d_new}) would drop "
+                "live rows — re-run shrink_plan on a fresh occupancy sample"
+            )
+        out = type(batch)(clock=clock, ids=ids, dots=dots, d_ids=d_ids,
+                          d_clocks=d_clocks)
+    reclaimed = bytes_before - sum(
+        x.nbytes for x in (out.clock, out.ids, out.dots, out.d_ids,
+                           out.d_clocks))
+    obs_events.record("executor.shrink", schedule="gc",
+                      member_capacity_before=m_before,
+                      deferred_capacity_before=d_before,
+                      member_capacity=m_new,
+                      deferred_capacity=d_new,
+                      reclaimed_bytes=reclaimed)
+    reg = registry if registry is not None else obs_metrics.registry()
+    reg.counter_inc("gc.shrinks")
+    reg.counter_inc("gc.reclaimed_bytes", max(0, reclaimed))
+    return out, reclaimed

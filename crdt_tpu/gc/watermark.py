@@ -1,0 +1,203 @@
+"""Fleet low-watermark clocks — the causal-GC stability frontier.
+
+Every digest exchange already ships a per-fleet version-vector summary
+(:func:`crdt_tpu.sync.digest.version_vector` — the pointwise max of
+every object's clock), and :class:`crdt_tpu.obs.convergence.
+ConvergenceTracker` now caches the most recent one per peer.  The fleet
+**low-watermark** is the element-wise minimum over those vectors plus
+the local one: counters at or below it have been witnessed by every
+peer this node has heard from, which is what makes compaction decisions
+(op-log column drops, tombstone settling cadence) safe to take
+unilaterally.
+
+Actor alignment is salt-free: the vectors index by the DENSE actor
+column of the shared intern tables (:class:`crdt_tpu.utils.interning.
+Universe`), the same alignment contract the digest lanes already rely
+on — identity universes satisfy it by construction, interned universes
+whenever the peers' interning order matches (see
+``crdt_tpu/sync/digest.py`` module docstring).  Vectors of different
+widths (a peer running a wider actor axis) align by zero-padding: an
+absent actor has an implied counter of 0 (`vclock.rs:206-210`), and a
+zero entry pins the minimum — conservative, never unsafe.
+
+Liveness rules (the part a naive min gets wrong):
+
+* **staleness freeze** — a peer not heard from within ``stale_after_s``
+  keeps contributing its LAST vector, so the watermark freezes at that
+  peer's old frontier instead of advancing past state the peer may not
+  have;
+* **unheard peers** — a roster peer with no cached vector pins the
+  watermark at zero (we know nothing about what it has seen);
+* **dead-peer quarantine** — a peer silent (or unheard) longer than
+  ``quarantine_s`` is excluded from the minimum so one dead replica
+  cannot freeze the fleet's memory forever; the exclusion is
+  operator-tunable and counted in the ``gc.watermark.*`` gauges, never
+  silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import convergence as obs_convergence
+from ..obs import metrics as obs_metrics
+
+
+@dataclasses.dataclass
+class WatermarkReport:
+    """One watermark computation's outcome.
+
+    ``clock`` is the fleet low-watermark (``uint64[A]``) — all-zero
+    when any included peer is unheard; ``frozen`` is True when a stale
+    or unheard peer is holding the watermark back.
+    """
+
+    clock: np.ndarray
+    peers: int = 0          # peers contributing a cached vector
+    stale: int = 0          # contributing but past stale_after_s
+    unheard: int = 0        # roster peers with no vector yet (pin zero)
+    excluded: int = 0       # quarantined out of the minimum
+    age_s: float = 0.0      # oldest contributing observation's age
+
+    @property
+    def frozen(self) -> bool:
+        return self.stale > 0 or self.unheard > 0
+
+    def lag(self, local_vv) -> int:
+        """Max per-actor distance between the local frontier and the
+        watermark — how much causal history the fleet is holding back
+        from collection."""
+        local = np.asarray(local_vv, dtype=np.uint64).reshape(-1)
+        wm, local = _aligned([self.clock, local])
+        if local.size == 0:
+            return 0
+        return int((local - np.minimum(local, wm)).max(initial=0))
+
+
+def _aligned(vvs: Sequence[np.ndarray]) -> list:
+    """Zero-pad vectors to a common width (implied-0 counters)."""
+    width = max((v.size for v in vvs), default=0)
+    out = []
+    for v in vvs:
+        if v.size < width:
+            v = np.concatenate(
+                [v, np.zeros(width - v.size, dtype=np.uint64)])
+        out.append(v.astype(np.uint64))
+    return out
+
+
+class FleetWatermark:
+    """Computes (and publishes) the fleet low-watermark clock.
+
+    ``tracker`` is the :class:`~crdt_tpu.obs.convergence.
+    ConvergenceTracker` whose per-peer version-vector cache feeds the
+    minimum (the process-global one by default — the same tracker every
+    :class:`~crdt_tpu.sync.session.SyncSession` feeds).
+    ``stale_after_s`` / ``quarantine_s`` are the liveness knobs (module
+    docstring); ``clock`` is injectable for tests (monotonic seconds).
+    """
+
+    def __init__(self, tracker: Optional[
+            obs_convergence.ConvergenceTracker] = None, *,
+                 stale_after_s: float = 30.0,
+                 quarantine_s: float = 300.0,
+                 registry: Optional[obs_metrics.MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not 0.0 < stale_after_s <= quarantine_s:
+            raise ValueError(
+                f"need 0 < stale_after_s <= quarantine_s, got "
+                f"{stale_after_s}/{quarantine_s}"
+            )
+        self._tracker = tracker
+        self.stale_after_s = stale_after_s
+        self.quarantine_s = quarantine_s
+        self._registry = registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        # roster peers never heard from quarantine off their FIRST
+        # sighting here (there is no observation to age them by)
+        self._first_seen: Dict[str, float] = {}
+
+    def _reg(self) -> obs_metrics.MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else obs_metrics.registry()
+
+    def _vectors(self) -> Dict[str, Tuple[Tuple[int, ...], float]]:
+        tracker = self._tracker if self._tracker is not None \
+            else obs_convergence.tracker()
+        return tracker.version_vectors()
+
+    def compute(self, local_vv, peers: Optional[Iterable[str]] = None
+                ) -> WatermarkReport:
+        """The fleet low-watermark given the local version vector and
+        an optional peer roster.
+
+        Without a roster, every peer with a cached vector contributes
+        (subject to quarantine).  With one, roster peers WITHOUT a
+        cached vector pin the watermark at zero until their quarantine
+        expires — the membership rule that makes "I have never heard
+        from n3" explicit instead of silently optimistic.  Publishes
+        the ``gc.watermark.*`` gauges either way."""
+        local = np.asarray(local_vv, dtype=np.uint64).reshape(-1)
+        now = self._clock()
+        vectors = self._vectors()
+        report = WatermarkReport(clock=local.copy())
+
+        contributing = [local]
+        roster = set(peers) if peers is not None else set(vectors)
+        with self._lock:
+            for peer in sorted(roster | set(vectors)):
+                cached = vectors.get(peer)
+                if cached is None:
+                    if peer not in roster:
+                        continue
+                    first = self._first_seen.setdefault(peer, now)
+                    if now - first > self.quarantine_s:
+                        report.excluded += 1
+                    else:
+                        report.unheard += 1
+                    continue
+                self._first_seen.pop(peer, None)
+                vv, seen_ts = cached
+                age = max(0.0, now - seen_ts)
+                if age > self.quarantine_s:
+                    report.excluded += 1
+                    continue
+                report.peers += 1
+                report.age_s = max(report.age_s, age)
+                if age > self.stale_after_s:
+                    report.stale += 1
+                contributing.append(
+                    np.asarray(vv, dtype=np.uint64).reshape(-1))
+
+        if report.unheard:
+            # an unheard (but not yet quarantined) roster peer: nothing
+            # below its frontier is known-stable, and its frontier is
+            # unknown — the only safe minimum is zero
+            report.clock = np.zeros_like(local)
+        else:
+            aligned = _aligned(contributing)
+            report.clock = aligned[0]
+            for v in aligned[1:]:
+                report.clock = np.minimum(report.clock, v)
+
+        reg = self._reg()
+        reg.gauge_set("gc.watermark.peers", report.peers)
+        reg.gauge_set("gc.watermark.stale", report.stale)
+        reg.gauge_set("gc.watermark.unheard", report.unheard)
+        reg.gauge_set("gc.watermark.excluded", report.excluded)
+        reg.gauge_set("gc.watermark.age_s", round(report.age_s, 3))
+        reg.gauge_set("gc.watermark.max_counter",
+                      int(report.clock.max(initial=0)))
+        reg.gauge_set("gc.watermark.lag", report.lag(local))
+        return report
+
+    def forget(self, peer: str) -> None:
+        """Drop a peer's quarantine bookkeeping (it left the roster)."""
+        with self._lock:
+            self._first_seen.pop(peer, None)

@@ -81,6 +81,8 @@ class Occupancy:
     actors_live: int = 0    # actor columns with any nonzero dot
     tombstone_capacity: int = 0  # deferred slots per object (0 = none)
     tombstones: int = 0     # live deferred/tombstone rows, fleet-wide
+    tombstones_max: int = 0  # busiest object's tombstone rows (the
+    #                          deferred axis's shrink-fit statistic)
 
     @property
     def utilization(self) -> float:
@@ -194,9 +196,20 @@ class CapacityTracker:
                 if occ.kind in ("vclock", "gcounter", "pncounter") \
                 else self.max_capacity
         now = self._clock()
+        capacity_changed = False
         with self._lock:
             prev = self._planes.get(label)
             rate = prev.rate if prev is not None else None
+            if prev is not None and \
+                    prev.occupancy.slot_capacity != occ.slot_capacity:
+                # the plane was re-packed (GC shrink) or regrown between
+                # samples: the live_max delta measures the capacity
+                # event, not write demand — a stale positive EWMA would
+                # count down a bogus ETA against the new rung, so the
+                # rate re-seeds from scratch
+                capacity_changed = True
+                rate = None
+                prev = None
             if prev is not None and now > prev.sampled_at:
                 inst = (occ.live_max - prev.occupancy.live_max) \
                     / (now - prev.sampled_at)
@@ -236,6 +249,11 @@ class CapacityTracker:
         reg.gauge_set(f"capacity.{label}.utilization", util)
         if rate is not None:
             reg.gauge_set(f"capacity.{label}.growth_rows_per_s", rate)
+        elif capacity_changed:
+            # overwrite the pre-shrink/regrow rate: the exported gauge
+            # must not keep reporting a stale positive growth against
+            # the new capacity while the EWMA re-seeds
+            reg.gauge_set(f"capacity.{label}.growth_rows_per_s", 0.0)
         reg.gauge_set(f"capacity.{label}.eta_s", eta)
         reg.gauge_set(f"capacity.{label}.watermark",
                       WATERMARK_STATES.index(state))

@@ -44,7 +44,7 @@ class _PeerState:
     __slots__ = (
         "divergence", "objects", "rounds_to_converge", "sessions",
         "converged_sessions", "last_converged_ts", "delta_ratios",
-        "divergence_resolved",
+        "divergence_resolved", "version_vector", "version_vector_ts",
     )
 
     def __init__(self):
@@ -60,6 +60,12 @@ class _PeerState:
         # health view (gossip's fleet_divergence_max / eta_rounds)
         # needs to tell apart from divergence still outstanding
         self.divergence_resolved = True
+        # the peer's most recent version-vector summary (the digest
+        # frame already ships it) — the fleet low-watermark's input
+        # (crdt_tpu/gc/watermark.py); a tuple of ints so this module
+        # stays numpy-free
+        self.version_vector: Optional[tuple] = None
+        self.version_vector_ts: Optional[float] = None
 
 
 class ConvergenceTracker:
@@ -121,6 +127,32 @@ class ConvergenceTracker:
             reg.gauge_set(f"sync.peer.{peer}.staleness_s", 0.0)
         if ratio is not None:
             reg.gauge_set(f"sync.peer.{peer}.delta_ratio", ratio)
+
+    def observe_version_vector(self, peer: str, vv,
+                               at: Optional[float] = None) -> None:
+        """Cache ``peer``'s version-vector summary from a digest
+        exchange (any iterable of counters; stored as a tuple of ints).
+        The fleet low-watermark (:class:`crdt_tpu.gc.watermark.
+        FleetWatermark`) takes the element-wise minimum over these.
+        ``at`` overrides the observation timestamp (monotonic seconds;
+        tests inject fake clocks through it)."""
+        frozen = tuple(int(c) for c in vv)
+        now = time.monotonic() if at is None else at
+        with self._lock:
+            st = self._state(peer)
+            st.version_vector = frozen
+            st.version_vector_ts = now
+
+    def version_vectors(self) -> Dict[str, tuple]:
+        """``{peer: (version_vector, observed_ts)}`` for every peer a
+        digest exchange has shipped one for (monotonic timestamps — age
+        against ``time.monotonic()``)."""
+        with self._lock:
+            return {
+                peer: (st.version_vector, st.version_vector_ts)
+                for peer, st in self._peers.items()
+                if st.version_vector is not None
+            }
 
     def refresh(self) -> None:
         """Recompute the read-time gauges (staleness ages).  The export

@@ -231,6 +231,24 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "EWMA rate (-1 = not growing, 0 = already there)"),
     NameSpec("capacity.*.watermark", "gauge",
              "per-plane watermark (0 ok / 1 warn / 2 critical)"),
+    # -- causal GC (gc/watermark.py, gc/policy.py, gc/repack.py) -------------
+    NameSpec("gc.runs", "counter", "causal-GC collection passes"),
+    NameSpec("gc.shrinks", "counter",
+             "plane re-packs that shrank a capacity rung"),
+    NameSpec("gc.reclaimed_bytes", "counter",
+             "bytes released by re-packing and op-buffer compaction"),
+    NameSpec("gc.tombstones_cleared", "counter",
+             "deferred-remove tombstone rows settled by GC"),
+    NameSpec("gc.oplog_ops_dropped", "counter",
+             "buffered ops dropped as already-witnessed below the "
+             "fleet watermark"),
+    NameSpec("gc.collect", "histogram",
+             "one causal-GC collection pass (span)"),
+    NameSpec("gc.watermark.*", "gauge",
+             "fleet low-watermark state (peers/stale/unheard/excluded "
+             "contributing counts, age_s of the oldest contribution, "
+             "max_counter of the watermark clock, lag behind the local "
+             "frontier)"),
     # -- native engine (native/engine.py) ------------------------------------
     NameSpec("native.engine.*.calls", "counter",
              "native kernel invocations per entry point"),
@@ -251,6 +269,9 @@ NAMESPACE: tuple[NameSpec, ...] = (
     NameSpec("executor.join_all_tree", "histogram", "tree join span"),
     NameSpec("executor.merge", "histogram", "one recoverable pair merge"),
     NameSpec("executor.regrow", "histogram", "capacity regrow span"),
+    NameSpec("executor.shrink", "histogram",
+             "capacity shrink (GC re-pack) span — the regrow path in "
+             "reverse (crdt_tpu/gc/repack.py)"),
     # -- kernels (utils/tracing.timed_kernel) --------------------------------
     NameSpec("kernel.*.errors", "counter",
              "raising calls per timed kernel label"),

@@ -159,6 +159,33 @@ class OpApplier:
             "bytes": opbatch_nbytes(parked),
         }
 
+    def prune(self, droppable) -> Tuple[int, int]:
+        """Shed parked adds flagged by ``droppable(batch) -> bool[B]``
+        (the GC layer passes the witnessed-dot mask — a parked add the
+        planes now witness arrived again through state sync, and the
+        next apply would discard it as a duplicate anyway).  Returns
+        ``(ops_dropped, bytes_reclaimed)``.  Callers serialize against
+        :meth:`apply_ops` the same way they already must (the node's
+        busy lock): the park buffer has no lock of its own."""
+        from .records import opbatch_nbytes
+
+        parked = self._parked
+        if not len(parked):
+            return 0, 0
+        mask = np.asarray(droppable(parked), dtype=bool)
+        if mask.shape != (len(parked),):
+            raise ValueError(
+                f"droppable mask has shape {mask.shape}, expected "
+                f"({len(parked)},)"
+            )
+        kept = parked.select(~mask)
+        freed = opbatch_nbytes(parked) - opbatch_nbytes(kept)
+        self._parked = kept
+        from ..obs import metrics as obs_metrics
+
+        obs_metrics.registry().gauge_set("oplog.parked", len(kept))
+        return int(mask.sum()), int(freed)
+
     # -- the readiness partition --------------------------------------------
 
     @staticmethod

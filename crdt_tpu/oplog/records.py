@@ -311,6 +311,39 @@ class OpLog:
         self._publish(0, high)
         return OpBatch.concat(segments)
 
+    def compact(self, droppable) -> tuple:
+        """Drop buffered ops in place: ``droppable(batch) -> bool[B]``
+        flags rows to shed (the GC layer passes the witnessed-dot mask,
+        :func:`crdt_tpu.gc.compact.witnessed_ops_mask`).  Returns
+        ``(ops_dropped, bytes_reclaimed)``.  The per-actor
+        high-watermark is untouched — it records dots *seen*, which
+        compaction does not un-see — and ``oplog.submitted`` does not
+        re-count the survivors."""
+        with self._lock:
+            segments, self._segments = self._segments, []
+            self._count = 0
+        batch = OpBatch.concat(segments)
+        if not len(batch):
+            return 0, 0
+        mask = np.asarray(droppable(batch), dtype=bool)
+        if mask.shape != (len(batch),):
+            raise ValueError(
+                f"droppable mask has shape {mask.shape}, expected "
+                f"({len(batch)},)"
+            )
+        kept = batch.select(~mask)
+        freed = opbatch_nbytes(batch) - opbatch_nbytes(kept)
+        with self._lock:
+            # survivors re-enter at the FRONT so appends that raced the
+            # compaction keep their relative order behind them
+            if len(kept):
+                self._segments.insert(0, kept)
+            self._count += len(kept)
+            depth = self._count
+            high = int(self._watermark.max(initial=0))
+        self._publish(depth, high)
+        return int(mask.sum()), int(freed)
+
     def occupancy(self) -> dict:
         """The log's occupancy for the capacity observatory: buffered
         ops vs the bound, segment count, exact column bytes, and the

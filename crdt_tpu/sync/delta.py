@@ -445,6 +445,17 @@ class OrswotDeltaApplier:
         engine = probe_engine(
             self.universe, "orswot_merge", counter_dtype(self.universe.config)
         )
+        cfg = self.universe.config
+        if engine is not None and (
+            batch.member_capacity != cfg.member_capacity
+            or batch.deferred_capacity != cfg.deferred_capacity
+        ):
+            # the warm staging/merge-out buffers (and the native row
+            # codec) are shaped by the CONFIG capacities; a batch that
+            # regrew above — or was GC-repacked to a different rung —
+            # must take the shape-polymorphic jnp route (the merge
+            # kernel handles asymmetric slot widths, out= does not)
+            engine = None
         if engine is not None:
             staging, merge_out = self._buffers(k)
             peer = orswot_planes_from_wire(blobs, self.universe, out=staging)

@@ -361,7 +361,13 @@ class SyncSession:
                 raise SyncProtocolError(
                     f"expected a digest frame, peer sent type {ftype:#04x}"
                 )
-            theirs, _peer_vv = decode_digest_payload(payload)
+            theirs, peer_vv = decode_digest_payload(payload)
+        if peer_vv.size:
+            # cache the peer's version-vector summary: the fleet
+            # low-watermark (crdt_tpu/gc) takes the element-wise min
+            # over these, so every digest exchange advances GC's view
+            obs_convergence.tracker().observe_version_vector(
+                self.peer, peer_vv)
         report.digest_rounds += 1
         return mine, theirs
 

@@ -311,6 +311,42 @@ def step8_pipelined_wire_loop(uni, n, incoming):
           "digest vectors converged")
 
 
+def step9_causal_gc(uni, n, incoming):
+    """Causal GC closes the loop: a fleet that regrew through step 6's
+    elastic ladder carries padding (and settled-but-unswept tombstone
+    rows) forever — until the GC layer (`crdt_tpu.gc`) settles the
+    deferred tables and re-packs the slot axes back down the ladder.
+    Compaction reclaims REPRESENTATION, never state: the digest vector
+    — the same convergence oracle step 8 used — is byte-identical
+    before and after."""
+    from crdt_tpu.gc import GcEngine, GcPolicy
+    from crdt_tpu.sync import digest as sync_digest
+
+    fleet = OrswotBatch.from_wire(incoming, uni)
+    fleet = fleet.merge(fleet)  # canonical (plunged) form
+    # as a burst would leave it: slot axes regrown 4x above the config
+    cfg = uni.config
+    fleet = fleet.with_capacity(cfg.member_capacity * 4,
+                                cfg.deferred_capacity * 4)
+    before = sync_digest.digest_of(fleet)
+    bytes_before = sum(
+        x.nbytes for x in (fleet.clock, fleet.ids, fleet.dots,
+                           fleet.d_ids, fleet.d_clocks))
+
+    engine = GcEngine(GcPolicy(interval_rounds=1))
+    compacted, report = engine.collect(fleet, universe=uni)
+    after = sync_digest.digest_of(compacted)
+    assert np.array_equal(np.asarray(before), np.asarray(after)), (
+        "causal GC changed the digest vector — compaction must be "
+        "representation-only"
+    )
+    assert report.reclaimed_bytes > 0 and report.shrunk
+    print(f"9. causal GC: member capacity "
+          f"{report.member_capacity[0]} -> {report.member_capacity[1]}, "
+          f"{report.reclaimed_bytes} of {bytes_before} plane bytes "
+          f"reclaimed; digest vector byte-identical before/after")
+
+
 def main():
     replicas = step1_op_replication()
     step2_deferred_remove(replicas)
@@ -320,6 +356,7 @@ def main():
     step6_elastic_regrowth()
     uni, n, incoming = step7_bulk_wire_loop()
     step8_pipelined_wire_loop(uni, n, incoming)
+    step9_causal_gc(uni, n, incoming)
     print("anti-entropy walkthrough: OK")
 
 

@@ -229,7 +229,8 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
 def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 divergence: float, max_sweeps: int = 20,
                 fleet_port: int | None = None, ops_rate: int = 0,
-                ops_sweeps: int = 3) -> int:
+                ops_sweeps: int = 3, gc_enabled: bool = False,
+                gc_interval: int = 1, gc_hysteresis: float = 0.5) -> int:
     """N in-process replicas over real loopback TCP, reconciled by the
     cluster runtime (``crdt_tpu/cluster``): each node owns a listener
     (accepted sessions run through the same hardened transport stack),
@@ -287,13 +288,27 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     for i in range(n_peers):
         fleet = _build_fleet(n_objects, actor=i + 1,
                              divergence=divergence, seed=42)
+        batch = OrswotBatch.from_scalar(fleet, uni)
+        gc_engine = None
+        if gc_enabled:
+            from crdt_tpu.gc import GcEngine, GcPolicy
+
+            # over-provision the planes as an earlier burst's regrow
+            # would have, so the demo has real padding to reclaim
+            batch = batch.with_capacity(uni.config.member_capacity * 4,
+                                        uni.config.deferred_capacity * 4)
+            gc_engine = GcEngine(GcPolicy(
+                interval_rounds=gc_interval,
+                shrink_hysteresis=gc_hysteresis,
+            ))
         nodes.append(ClusterNode(
-            f"n{i}", OrswotBatch.from_scalar(fleet, uni), uni,
+            f"n{i}", batch, uni,
             busy_timeout_s=30.0,
             observatory=FleetObservatory(f"n{i}"),
             # op front-end armed up front so sessions advertise the
             # piggyback capability from the first hello
             oplog=OpLog(uni) if ops_rate else None,
+            gc=gc_engine,
         ))
 
     fleet_server = None
@@ -457,6 +472,22 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     )
     print(f"fleet: final session trace={trace} "
           f"(both peers' /events carry it)", flush=True)
+
+    if gc_enabled:
+        # per-node reclamation story + the watermark clock GC last
+        # collected under (the element-wise min over every peer's
+        # version vector — counters at or below it are fleet-stable)
+        for node in nodes:
+            rep = node.last_gc_report
+            wm = "never-ran" if rep is None or rep.watermark is None \
+                else rep.watermark.clock.tolist()
+            print(
+                f"gc: {node.node_id} reclaimed="
+                f"{node.gc.total_reclaimed_bytes}B over {node.gc.runs} "
+                f"pass(es)  member_capacity="
+                f"{node.batch.member_capacity}  watermark={wm}",
+                flush=True,
+            )
     if fleet_server is not None:
         fleet_server.stop()
 
@@ -502,6 +533,21 @@ def main() -> int:
                          "front-end (crdt_tpu.oplog / submit_ops) WHILE "
                          "gossip runs, then assert the fleet still "
                          "converges after writes stop")
+    ap.add_argument("--gc", action="store_true",
+                    help="with --gossip: enable causal GC (crdt_tpu.gc) — "
+                         "each node starts with burst-over-provisioned "
+                         "planes, the scheduler settles tombstones and "
+                         "re-packs capacity between sessions, and the "
+                         "demo prints per-node reclaimed bytes + the "
+                         "fleet low-watermark clock at convergence")
+    ap.add_argument("--gc-interval", type=int, default=1, metavar="N",
+                    help="with --gc: collect every Nth gossip round "
+                         "(GcPolicy.interval_rounds; default 1)")
+    ap.add_argument("--gc-hysteresis", type=float, default=0.5,
+                    help="with --gc: shrink only when the fitted "
+                         "capacity rung is at most this fraction of the "
+                         "current one (GcPolicy.shrink_hysteresis; "
+                         "default 0.5)")
     args = ap.parse_args()
 
     if args.gossip:
@@ -512,7 +558,9 @@ def main() -> int:
         return gossip_demo(args.gossip, args.objects, args.platform,
                            divergence=args.divergence,
                            fleet_port=args.fleet_port,
-                           ops_rate=args.ops)
+                           ops_rate=args.ops, gc_enabled=args.gc,
+                           gc_interval=args.gc_interval,
+                           gc_hysteresis=args.gc_hysteresis)
 
     if args.role != "demo":
         if not args.port:

@@ -60,6 +60,12 @@ def pytest_configure(config):
         "columnar op log, batched causal contexts, scatter-fold apply, "
         "op-frame codec); tier-1 like `sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "gc: causal garbage-collection tests (crdt_tpu.gc — fleet "
+        "low-watermark clocks, compaction kernels, plane re-packing, "
+        "GC policy); tier-1 like `sync`",
+    )
 
 
 # -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------

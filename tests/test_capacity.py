@@ -220,6 +220,46 @@ def test_every_published_name_has_a_manifest_row():
         assert namespace.match(name, "counter") is not None, name
 
 
+def test_tracker_ewma_reseeds_on_capacity_change():
+    """The capacity-ETA edge causal GC exposes: after a shrink (or a
+    regrow), the live_max delta measures the re-pack, not write demand
+    — a stale positive EWMA must not keep counting down an overflow
+    ETA against the new rung."""
+    from crdt_tpu.gc.repack import repack_orswot
+
+    uni = _uni(member_capacity=32)
+    reg = obs_metrics.MetricsRegistry()
+    t = [0.0]
+    trk = CapacityTracker(reg, max_capacity=64, alpha=1.0,
+                          clock=lambda: t[0])
+
+    trk.sample(_orswot(uni, [4]))
+    for live in (12, 20):
+        t[0] += 10.0
+        trk.sample(_orswot(uni, [live]))
+    g = reg.snapshot()["gauges"]
+    assert g["capacity.orswot.growth_rows_per_s"] == pytest.approx(0.8)
+    assert g["capacity.orswot.eta_s"] > 0
+
+    # GC re-packs the plane (32 -> 8 slots, live window back to 4):
+    # the rate gauge must re-seed, not report the old +0.8 — and the
+    # huge negative live_max delta must not poison the EWMA either
+    shrunk, _ = repack_orswot(_orswot(uni, [4]), 8, 4, registry=reg)
+    t[0] += 10.0
+    trk.sample(shrunk)
+    g = reg.snapshot()["gauges"]
+    assert g["capacity.orswot.growth_rows_per_s"] == 0.0
+    assert g["capacity.orswot.eta_s"] == ETA_NOT_GROWING
+
+    # growth measured AFTER the shrink re-seeds from scratch (alpha-1:
+    # the first post-shrink delta IS the rate; no pre-shrink memory)
+    t[0] += 10.0
+    trk.sample(repack_orswot(_orswot(uni, [6]), 8, 4,
+                             registry=obs_metrics.MetricsRegistry())[0])
+    g = reg.snapshot()["gauges"]
+    assert g["capacity.orswot.growth_rows_per_s"] == pytest.approx(0.2)
+
+
 # ---- /healthz --------------------------------------------------------------
 
 

@@ -1,0 +1,622 @@
+"""Causal-GC tests — fleet low-watermark clocks, compaction kernels,
+plane re-packing, policy wiring (crdt_tpu.gc).
+
+THE acceptance property lives here at tier-1 speed: for seeded random
+op/merge histories, GC-compacting any replica at the fleet
+low-watermark and then merging it with any peer (compacted or not)
+yields digest vectors byte-identical to the never-compacted fleet —
+compaction reclaims representation (tombstones the next plunge would
+settle anyway, slot padding, witnessed op-buffer rows), never state.
+The long-soak flip of the PR 9 capacity oracle (bounded live slots
+under churn with GC on) is ``tests/test_gc_soak.py`` behind ``slow``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.batch.occupancy import occupancy_of
+from crdt_tpu.cluster import ClusterNode, GossipScheduler, Membership, queue_pair
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.gc import FleetWatermark, GcEngine, GcPolicy
+from crdt_tpu.gc.compact import (
+    compact_gap_buffer,
+    compact_oplog,
+    settle_orswot,
+    truncate_orswot,
+    witnessed_ops_mask,
+)
+from crdt_tpu.gc.repack import repack_orswot, shrink_plan
+from crdt_tpu.obs import convergence as obs_convergence
+from crdt_tpu.obs import events as obs_events
+from crdt_tpu.obs import metrics as obs_metrics
+from crdt_tpu.obs import namespace
+from crdt_tpu.oplog import OpApplier, OpBatch, OpLog
+from crdt_tpu.scalar.ctx import RmCtx
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.scalar.vclock import VClock
+from crdt_tpu.sync import digest as digest_mod
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = pytest.mark.gc
+
+
+def _uni(**kw):
+    cfg = dict(num_actors=8, member_capacity=8, deferred_capacity=4,
+               counter_bits=32)
+    cfg.update(kw)
+    return Universe.identity(CrdtConfig(**cfg))
+
+
+def _digest(batch) -> np.ndarray:
+    return np.asarray(digest_mod.digest_of(batch), dtype=np.uint64)
+
+
+def _plunged(batch):
+    """Canonical form: the defer-plunger self-merge every join ends
+    with (`test/orswot.rs:61-62`)."""
+    return batch.merge(batch)
+
+
+def _join(a, b):
+    """The production pair join: equalize capacities, merge with
+    elastic regrowth on overflow, end with the plunger — exactly what
+    ``JoinExecutor`` does when anti-entropy folds two replicas (a
+    shrink-to-fit batch legitimately regrows when a union outgrows its
+    rung; GC and the executor's ladder are inverses, not rivals)."""
+    from crdt_tpu.parallel import JoinExecutor
+
+    return JoinExecutor(strategy="sequential").join_all([a, b])
+
+
+def _plane_nbytes(batch):
+    return sum(x.nbytes for x in (batch.clock, batch.ids, batch.dots,
+                                  batch.d_ids, batch.d_clocks))
+
+
+def _random_replicas(seed: int, n_objects: int = 12, n_replicas: int = 3):
+    """Seeded random op/merge histories: a shared base history, then
+    per-replica adds/removes (some removes witnessed by ANOTHER
+    replica's clock, so deferred rows appear), then a partial gossip
+    pass — the divergence shape real anti-entropy sees."""
+    rng = np.random.RandomState(seed)
+    uni = _uni()
+    fleets = []
+    for r in range(n_replicas):
+        row = []
+        for i in range(n_objects):
+            s = Orswot()
+            # shared prefix: same (seeded per-object) ops on actor 0
+            for j in range((i % 3) + 1):
+                s.apply(s.add((i * 7 + j) % 11, s.value().derive_add_ctx(0)))
+            row.append(s)
+        fleets.append(row)
+    # divergent per-replica ops
+    for r in range(n_replicas):
+        for _ in range(n_objects * 2):
+            i = int(rng.randint(n_objects))
+            s = fleets[r][i]
+            if rng.rand() < 0.7:
+                s.apply(s.add(int(rng.randint(20, 40)),
+                              s.value().derive_add_ctx(r + 1)))
+            else:
+                read = s.value()
+                if read.val:
+                    m = sorted(read.val)[int(rng.randint(len(read.val)))]
+                    s.apply(s.remove(m, s.contains(m).derive_rm_ctx()))
+    # cross-replica removes: witness clocks from a PEER's copy, so the
+    # local apply defers (tombstone rows) until anti-entropy catches up
+    for r in range(n_replicas):
+        for _ in range(n_objects // 2):
+            i = int(rng.randint(n_objects))
+            peer = fleets[(r + 1) % n_replicas][i]
+            target = sorted(peer.value().val)
+            if not target:
+                continue
+            m = target[int(rng.randint(len(target)))]
+            ctx = RmCtx(clock=peer.value().add_clock.clone())
+            fleets[r][i].apply(fleets[r][i].remove(m, ctx))
+    batches = [OrswotBatch.from_scalar(row, uni) for row in fleets]
+    return uni, batches
+
+
+def _fleet_watermark_of(batches) -> np.ndarray:
+    vvs = [np.asarray(digest_mod.version_vector(b), np.uint64)
+           for b in batches]
+    wm = vvs[0]
+    for v in vvs[1:]:
+        wm = np.minimum(wm, v)
+    return wm
+
+
+def _gc(batch, uni, *, tracker=None, peers=None, reg=None):
+    eng = GcEngine(
+        GcPolicy(interval_rounds=1, member_floor=None, deferred_floor=None),
+        tracker=tracker or obs_convergence.ConvergenceTracker(
+            reg or obs_metrics.MetricsRegistry()),
+        registry=reg or obs_metrics.MetricsRegistry(),
+    )
+    out, report = eng.collect(batch, universe=uni, peers=peers)
+    return out, report
+
+
+# ---- THE acceptance property ------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_gc_then_merge_matches_never_gcd_fleet(seed):
+    """Compact any replica at the fleet low-watermark, merge with any
+    peer (compacted or not): digest vectors byte-identical to the
+    never-compacted fleet's same merge (both sides plunged — the
+    canonical form every join ends in)."""
+    uni, batches = _random_replicas(seed)
+    # over-provision one replica as if a burst had regrown it: the GC
+    # must also walk the capacity back down without touching state
+    batches[0] = batches[0].with_capacity(32, 16)
+
+    for victim in range(len(batches)):
+        gcd, report = _gc(batches[victim], uni)
+        assert report.watermark is not None
+        for peer_idx in range(len(batches)):
+            if peer_idx == victim:
+                continue
+            for peer in (batches[peer_idx], _gc(batches[peer_idx], uni)[0]):
+                want = _digest(_join(batches[victim], peer))
+                got = _digest(_join(gcd, peer))
+                assert np.array_equal(got, want), (seed, victim, peer_idx)
+        # and the compacted replica alone, plunged, is the replica
+        got_alone = _digest(_plunged(gcd))
+        want_alone = _digest(_plunged(batches[victim]))
+        assert np.array_equal(got_alone, want_alone), (seed, victim)
+
+
+def test_gc_fleet_join_matches_never_gcd_join():
+    """A whole-fleet join where one replica was GC-compacted first
+    converges to the never-compacted join's digest vector."""
+    uni, batches = _random_replicas(seed=7)
+    want = _digest(OrswotBatch.join_fleet(batches))
+    gcd, _ = _gc(batches[1], uni)
+    mixed = [batches[0], gcd.with_capacity(batches[0].member_capacity,
+                                           batches[0].deferred_capacity),
+             batches[2]]
+    assert np.array_equal(_digest(OrswotBatch.join_fleet(mixed)), want)
+
+
+# ---- the watermark ----------------------------------------------------------
+
+
+def _tracker_with(reg, vvs, ts=None):
+    trk = obs_convergence.ConvergenceTracker(reg)
+    for peer, vv in vvs.items():
+        trk.observe_version_vector(peer, vv)
+    return trk
+
+
+def test_watermark_is_elementwise_min_including_local():
+    reg = obs_metrics.MetricsRegistry()
+    trk = _tracker_with(reg, {"p1": [5, 2, 0], "p2": [3, 9, 1]})
+    wm = FleetWatermark(trk, registry=reg)
+    report = wm.compute([4, 4, 4])
+    assert report.clock.tolist() == [3, 2, 0]
+    assert report.peers == 2 and not report.frozen
+    g = reg.snapshot()["gauges"]
+    assert g["gc.watermark.peers"] == 2
+    assert g["gc.watermark.max_counter"] == 3
+    assert g["gc.watermark.lag"] == 4  # actor 2: local 4 vs wm 0
+
+
+def test_watermark_aligns_mixed_widths_by_implied_zero():
+    reg = obs_metrics.MetricsRegistry()
+    trk = _tracker_with(reg, {"narrow": [7]})
+    report = FleetWatermark(trk, registry=reg).compute([5, 6, 7])
+    # the narrow peer has implied-0 counters for actors it never saw
+    assert report.clock.tolist() == [5, 0, 0]
+
+
+def test_watermark_staleness_freezes_and_quarantine_excludes():
+    t = [0.0]
+    reg = obs_metrics.MetricsRegistry()
+    trk = obs_convergence.ConvergenceTracker(reg)
+    trk.observe_version_vector("p1", [2, 2], at=0.0)
+    wm = FleetWatermark(trk, stale_after_s=10.0, quarantine_s=100.0,
+                        registry=reg, clock=lambda: t[0])
+    # within stale_after: fresh contribution
+    t[0] = 5.0
+    r = wm.compute([9, 9])
+    assert r.clock.tolist() == [2, 2] and r.stale == 0
+
+    # past stale_after: still contributes (the freeze), counted stale
+    t[0] = 50.0
+    r = wm.compute([9, 9])
+    assert r.clock.tolist() == [2, 2]
+    assert r.stale == 1 and r.frozen
+
+    # past quarantine: excluded — the watermark advances to local
+    t[0] = 200.0
+    r = wm.compute([9, 9])
+    assert r.clock.tolist() == [9, 9]
+    assert r.excluded == 1 and r.peers == 0
+
+
+def test_watermark_unheard_roster_peer_pins_zero_until_quarantined():
+    t = [0.0]
+    reg = obs_metrics.MetricsRegistry()
+    trk = obs_convergence.ConvergenceTracker(reg)
+    trk.observe_version_vector("p1", [4, 4], at=0.0)
+    wm = FleetWatermark(trk, stale_after_s=10.0, quarantine_s=60.0,
+                        registry=reg, clock=lambda: t[0])
+    r = wm.compute([9, 9], peers=["p1", "ghost"])
+    assert r.clock.tolist() == [0, 0]  # ghost: nothing is known-stable
+    assert r.unheard == 1 and r.frozen
+    # the ghost quarantines off its first sighting
+    t[0] = 120.0
+    r = wm.compute([9, 9], peers=["p1", "ghost"])
+    assert r.unheard == 0 and r.excluded >= 1
+    # p1 is ALSO past quarantine by now (observed at t=0)
+    assert r.clock.tolist() == [9, 9]
+
+
+def test_session_digest_exchange_feeds_version_vector_cache():
+    from crdt_tpu.sync.session import SyncSession, sync_pair
+
+    uni = _uni()
+    s = Orswot()
+    s.apply(s.add(1, s.value().derive_add_ctx(0)))
+    batch = OrswotBatch.from_scalar([s], uni)
+    obs_convergence.tracker().reset()
+    a = SyncSession(batch, uni, peer="gc-vv-b")
+    b = SyncSession(batch, uni, peer="gc-vv-a")
+    sync_pair(a, b)
+    vvs = obs_convergence.tracker().version_vectors()
+    assert "gc-vv-b" in vvs and "gc-vv-a" in vvs
+    vv, ts = vvs["gc-vv-b"]
+    assert vv[0] == 1 and ts is not None
+
+
+# ---- compaction kernels -----------------------------------------------------
+
+
+def _batch_with_dominated_tombstones(uni):
+    """Dense planes carrying deferred rows the object clock ALREADY
+    dominates — the shape a replica holds right after ingesting state
+    that settled elsewhere (scalar states can't express it: their
+    apply_deferred runs eagerly)."""
+    s = Orswot()
+    for m in (1, 2, 3):
+        s.apply(s.add(m, s.value().derive_add_ctx(0)))
+    base = OrswotBatch.from_scalar([s], uni)
+    # deferred row: remove member 2 witnessed by (actor 0, counter 2)
+    # — dominated by the set clock (actor 0 at 3)
+    (co, ca, cv), (do, dm, da, dv), _q, _h = base.to_coo()
+    return OrswotBatch.from_coo(
+        1, uni, clock_coords=(co, ca, cv), dot_coords=(do, dm, da, dv),
+        deferred_members=([0], [0], [2]),
+        deferred_coords=([0], [0], [0], [2]),
+    ), s
+
+
+def test_settle_clears_dominated_tombstones_like_the_plunger():
+    uni = _uni()
+    batch, scalar = _batch_with_dominated_tombstones(uni)
+    assert occupancy_of(batch).tombstones == 1
+    settled, stats = settle_orswot(batch)
+    assert stats["tombstones_cleared"] == 1
+    assert occupancy_of(settled).tombstones == 0
+    # the replayed remove dropped member 2, exactly as the scalar
+    # plunger (merge with an empty set) would
+    ref = scalar.clone()
+    ref.apply_remove(2, VClock({0: 2}))
+    ref.merge(Orswot())
+    want = _digest(OrswotBatch.from_scalar([ref], uni))
+    assert np.array_equal(_digest(settled), want)
+    # settle == plunger: the unsettled twin's self-merge agrees too
+    assert np.array_equal(_digest(_plunged(batch)), want)
+
+
+def test_settle_keeps_future_tombstones_parked():
+    uni = _uni()
+    s = Orswot()
+    s.apply(s.add(1, s.value().derive_add_ctx(0)))
+    future = VClock()
+    future.witness(5, 99)
+    s.apply(s.remove(1, RmCtx(clock=future)))
+    batch = OrswotBatch.from_scalar([s], uni)
+    settled, stats = settle_orswot(batch)
+    assert stats["tombstones_cleared"] == 0
+    assert occupancy_of(settled).tombstones == 1  # still causally ahead
+
+
+def test_truncate_matches_scalar_reference():
+    """The batched reset truncate == scalar `Causal::truncate` per
+    object (`orswot.rs:159-172`), including deferred replay."""
+    uni, batches = _random_replicas(seed=11, n_replicas=2)
+    scal = batches[0].to_scalar(uni)
+    wm = np.asarray([2, 1, 0, 0, 0, 0, 0, 0], np.uint64)
+    clock = VClock({0: 2, 1: 1})
+    got = truncate_orswot(batches[0], wm)
+    for s in scal:
+        s.truncate(clock)
+    want = OrswotBatch.from_scalar(scal, uni)
+    assert np.array_equal(_digest(got), _digest(want))
+
+
+# ---- re-packing -------------------------------------------------------------
+
+
+def test_shrink_plan_hysteresis_and_floors():
+    uni = _uni()
+    s = Orswot()
+    for m in range(3):
+        s.apply(s.add(m, s.value().derive_add_ctx(0)))
+    occ = occupancy_of(OrswotBatch.from_scalar([s], uni)
+                       .with_capacity(64, 16))
+    # live_max 3 → fitted rung 4, but floors win
+    assert shrink_plan(occ, member_floor=8, deferred_floor=4) == (8, 4)
+    # hysteresis: at 0.25, one rung down (4/8 = 0.5) is not enough
+    # headroom — only a >=4x over-provisioned axis shrinks
+    occ_tight = occupancy_of(OrswotBatch.from_scalar(
+        [s], uni).with_capacity(8, 4))
+    assert shrink_plan(occ_tight, member_floor=4, deferred_floor=4,
+                       hysteresis=0.25) is None
+    # at the default 0.5 the same fit IS allowed
+    assert shrink_plan(occ_tight, member_floor=4, deferred_floor=4,
+                       hysteresis=0.5) == (4, 4)
+    with pytest.raises(ValueError, match="hysteresis"):
+        shrink_plan(occ, member_floor=8, deferred_floor=4, hysteresis=0.0)
+
+
+def test_repack_reclaims_bytes_and_stamps_shrink_event():
+    uni = _uni()
+    s = Orswot()
+    for m in range(3):
+        s.apply(s.add(m, s.value().derive_add_ctx(0)))
+    big = OrswotBatch.from_scalar([s], uni).with_capacity(64, 16)
+    obs_events.recorder().clear()
+    reg = obs_metrics.MetricsRegistry()
+    shrunk, reclaimed = repack_orswot(big, 8, 4, registry=reg)
+    assert (shrunk.member_capacity, shrunk.deferred_capacity) == (8, 4)
+    assert reclaimed == _plane_nbytes(big) - _plane_nbytes(shrunk) > 0
+    assert np.array_equal(_digest(shrunk), _digest(big))
+    snap = reg.snapshot()["counters"]
+    assert snap["gc.shrinks"] == 1
+    assert snap["gc.reclaimed_bytes"] == reclaimed
+    events = obs_events.recorder().snapshot(kind="executor.shrink")
+    assert len(events) == 1
+    f = events[0]["fields"]
+    assert (f["member_capacity_before"], f["member_capacity"]) == (64, 8)
+    assert (f["deferred_capacity_before"], f["deferred_capacity"]) == (16, 4)
+    assert f["reclaimed_bytes"] == reclaimed
+
+
+def test_repack_refuses_to_drop_live_rows_or_grow():
+    uni = _uni()
+    s = Orswot()
+    for m in range(6):
+        s.apply(s.add(m, s.value().derive_add_ctx(0)))
+    batch = OrswotBatch.from_scalar([s], uni)
+    with pytest.raises(ValueError, match="live rows"):
+        repack_orswot(batch, 4, 4, registry=obs_metrics.MetricsRegistry())
+    with pytest.raises(ValueError, match="cannot grow"):
+        repack_orswot(batch, 16, 4, registry=obs_metrics.MetricsRegistry())
+
+
+def test_delta_applier_takes_jnp_route_for_nonconfig_capacities():
+    """The warm native delta buffers are config-shaped; a repacked or
+    regrown batch must fall through to the shape-polymorphic route
+    instead of handing mismatched planes to out= (the latent bug the
+    GC shrink exposes)."""
+    from crdt_tpu.sync.delta import OrswotDeltaApplier
+
+    uni = _uni()
+    s = Orswot()
+    s.apply(s.add(1, s.value().derive_add_ctx(0)))
+    peer = Orswot()
+    peer.apply(peer.add(2, peer.value().derive_add_ctx(1)))
+    batch = OrswotBatch.from_scalar([s], uni).with_capacity(16, 8)
+    from crdt_tpu import to_binary
+
+    merged = OrswotDeltaApplier(uni).apply(
+        batch, np.asarray([0]), [to_binary(peer)])
+    assert merged.member_capacity == 16  # capacity preserved
+    want = s.clone()
+    want.merge(peer)
+    assert np.array_equal(
+        _digest(merged),
+        _digest(OrswotBatch.from_scalar([want], uni).with_capacity(16, 8)))
+
+
+# ---- op-buffer compaction ---------------------------------------------------
+
+
+def _ops(kind, obj, actor, counter, member):
+    return OpBatch(kind=np.asarray(kind, np.uint8),
+                   obj=np.asarray(obj, np.int64),
+                   actor=np.asarray(actor, np.int32),
+                   counter=np.asarray(counter, np.uint64),
+                   member=np.asarray(member, np.int32))
+
+
+def test_witnessed_mask_drops_only_dominated_dotted_ops():
+    clock = np.zeros((2, 4), np.uint64)
+    clock[0, 0] = 3
+    ops = _ops([0, 0, 1, 0], [0, 0, 0, 1], [0, 0, 0, 0], [2, 5, 0, 1],
+               [7, 8, 7, 9])
+    # no watermark: local witness criterion only
+    mask = witnessed_ops_mask(ops, clock)
+    assert mask.tolist() == [True, False, False, False]  # rm never drops
+    # watermark gate: actor 0 only stable to counter 1 → nothing drops
+    mask = witnessed_ops_mask(ops, clock, np.asarray([1, 0, 0, 0],
+                                                     np.uint64))
+    assert mask.tolist() == [False, False, False, False]
+
+
+def test_compact_oplog_and_gap_buffer_reclaim_witnessed_dots():
+    uni = _uni()
+    log = OpLog(uni, capacity=64)
+    clock = np.zeros((2, 8), np.uint64)
+    clock[0, 0] = 4
+    log.append(_ops([0, 0], [0, 0], [0, 0], [2, 9], [5, 6]))
+    res = compact_oplog(log, clock, np.asarray([8] * 8, np.uint64))
+    assert res["ops_dropped"] == 1 and res["bytes_reclaimed"] > 0
+    assert len(log) == 1
+    survivor = log.pending()
+    assert survivor.counter.tolist() == [9]
+    # high-watermark survives compaction (it records dots SEEN)
+    assert int(log.watermark.max()) == 9
+
+    applier = OpApplier(uni)
+    batch = OrswotBatch.zeros(2, uni)
+    gapped = _ops([0], [0], [0], [9], [7])
+    applier.apply_ops(batch, gapped)
+    assert len(applier.parked) == 1
+    # the gap closed through state sync: the dot is witnessed now
+    closed = np.zeros((2, 8), np.uint64)
+    closed[0, 0] = 9
+    res = compact_gap_buffer(applier, closed,
+                             np.asarray([9] * 8, np.uint64))
+    assert res["ops_dropped"] == 1
+    assert len(applier.parked) == 0
+
+
+# ---- the engine + cluster wiring -------------------------------------------
+
+
+def test_engine_due_cadence_and_capacity_trigger():
+    from crdt_tpu.obs.capacity import CapacityTracker
+
+    reg = obs_metrics.MetricsRegistry()
+    trk = CapacityTracker(reg, max_capacity=4)
+    eng = GcEngine(GcPolicy(interval_rounds=3, utilization_trigger="warn"),
+                   tracker=obs_convergence.ConvergenceTracker(reg),
+                   capacity_tracker=trk, registry=reg)
+    assert eng.due(3) and eng.due(6)
+    assert not eng.due(1)
+    # 3/4 of the ceiling → warn → the trigger fires off-cadence
+    uni = _uni()
+    s = Orswot()
+    for m in range(3):
+        s.apply(s.add(m, s.value().derive_add_ctx(0)))
+    trk.sample(OrswotBatch.from_scalar([s], uni))
+    assert eng.due(1)
+
+
+def test_engine_publishes_gc_counters_and_every_name_is_manifested():
+    uni = _uni()
+    batch, _ = _batch_with_dominated_tombstones(uni)
+    batch = batch.with_capacity(64, 16)
+    reg = obs_metrics.MetricsRegistry()
+    trk = _tracker_with(reg, {"p1": [9] * 8})
+    log = OpLog(uni, capacity=64)
+    log.append(_ops([0], [0], [0], [3], [1]))  # witnessed: clock[0,0]=3
+    eng = GcEngine(GcPolicy(interval_rounds=1), tracker=trk, registry=reg)
+    out, report = eng.collect(batch, universe=uni, oplog=log,
+                              applier=OpApplier(uni), peers=["p1"])
+    assert report.tombstones_cleared == 1
+    assert report.shrunk and report.member_capacity == (64, 8)
+    assert report.oplog_ops_dropped == 1
+    assert report.reclaimed_bytes > 0
+    assert eng.total_reclaimed_bytes == report.reclaimed_bytes
+    snap = reg.snapshot()
+    c = snap["counters"]
+    assert c["gc.runs"] == 1 and c["gc.shrinks"] == 1
+    assert c["gc.tombstones_cleared"] == 1
+    assert c["gc.oplog_ops_dropped"] == 1
+    for name in list(c) + list(snap["gauges"]):
+        kind = "counter" if name in c else "gauge"
+        assert namespace.match(name, kind) is not None, name
+
+
+def test_cluster_round_runs_gc_between_sessions():
+    """A 3-node fleet with over-provisioned planes: the scheduler's
+    round-end hook settles + shrinks on the engine's cadence, the
+    fleet still converges byte-identically, and GC never runs while a
+    session holds the node (the busy lock is the pin)."""
+    uni = _uni(num_actors=8, member_capacity=8, deferred_capacity=4)
+    s = Orswot()
+    for m in range(3):
+        s.apply(s.add(m, s.value().derive_add_ctx(0)))
+    base = OrswotBatch.from_scalar([s] * 4, uni).with_capacity(32, 16)
+
+    regs = [obs_metrics.MetricsRegistry() for _ in range(3)]
+    nodes = []
+    for i in range(3):
+        trk = obs_convergence.ConvergenceTracker(regs[i])
+        eng = GcEngine(GcPolicy(interval_rounds=1), tracker=trk,
+                       registry=regs[i])
+        # sessions feed the process-global tracker; give the engine
+        # the global one so watermarks see real peer vectors
+        eng.watermark._tracker = obs_convergence.tracker()
+        nodes.append(ClusterNode(f"g{i}", base, uni, busy_timeout_s=5.0,
+                                 gc=eng))
+
+    def make_dialer(i):
+        def dial(peer):
+            j = int(peer.peer_id[1:])
+            ta, tb = queue_pair(default_timeout=10.0)
+
+            def serve():
+                try:
+                    nodes[j].accept(tb, peer_id=f"g{i}")
+                except Exception:
+                    pass
+                finally:
+                    tb.close()
+
+            threading.Thread(target=serve, daemon=True).start()
+            return ta
+        return dial
+
+    scheds = []
+    for i in range(3):
+        m = Membership(suspect_after=3, dead_after=6)
+        for j in range(3):
+            if j != i:
+                m.add(f"g{j}")
+        scheds.append(GossipScheduler(nodes[i], m, make_dialer(i),
+                                      fanout=2, session_timeout_s=30.0,
+                                      seed=i))
+    obs_convergence.tracker().reset()
+    for _ in range(3):
+        for sched in scheds:
+            sched.run_round()
+    digests = [n.digest() for n in nodes]
+    assert all(np.array_equal(digests[0], d) for d in digests[1:])
+    for n in nodes:
+        report = n.last_gc_report
+        assert report is not None
+        assert n.batch.member_capacity == 8  # shrank back to the config rung
+        assert n.gc.runs >= 1
+
+
+def test_collect_garbage_skips_while_session_holds_busy_lock():
+    uni = _uni()
+    batch = OrswotBatch.zeros(1, uni)
+    eng = GcEngine(GcPolicy(interval_rounds=1),
+                   tracker=obs_convergence.ConvergenceTracker(
+                       obs_metrics.MetricsRegistry()),
+                   registry=obs_metrics.MetricsRegistry())
+    node = ClusterNode("busy", batch, uni, gc=eng)
+    assert node._busy.acquire(blocking=False)
+    try:
+        assert node.collect_garbage() is None  # skipped, not queued
+    finally:
+        node._busy.release()
+    assert node.collect_garbage() is not None
+
+
+def test_gc_skips_batch_types_without_compaction_kernels():
+    from crdt_tpu.batch.gcounter_batch import GCounterBatch
+
+    uni = _uni()
+    import jax.numpy as jnp
+
+    eng = GcEngine(GcPolicy(interval_rounds=1),
+                   tracker=obs_convergence.ConvergenceTracker(
+                       obs_metrics.MetricsRegistry()),
+                   registry=obs_metrics.MetricsRegistry())
+    batch = GCounterBatch(clocks=jnp.zeros((2, 8), jnp.uint32))
+    out, report = eng.collect(batch, universe=uni)
+    assert out is batch
+    assert report.skipped and "GCounterBatch" in report.skipped

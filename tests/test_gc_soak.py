@@ -1,0 +1,217 @@
+"""Long-soak causal-GC acceptance — the PR 9 capacity oracle, flipped.
+
+``tests/test_capacity_soak.py`` (kept unchanged as the GC-off control)
+pins that without GC an add-churning fleet's planes grow monotonically
+with a finite, shrinking time-to-overflow ETA.  This soak runs the
+same 3-node gossip harness with sliding-window churn (adds + removes +
+cross-node deferred tombstones) and GC ENABLED, and asserts the
+opposite steady state:
+
+* live slots stay bounded (the window, not the history),
+* planes that a burst over-provisioned shrink back down the capacity
+  ladder (``executor.shrink`` stamped, bytes reclaimed, EWMA re-seeded),
+* deferred tombstones return to ~0 after quiescence,
+* the overflow ETA ends growing or not-growing (-1) instead of
+  counting down,
+* and the fleet's digest vectors stay byte-identical at every epoch's
+  converged point — GC reclaims representation, never state.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.cluster import ClusterNode, GossipScheduler, Membership, queue_pair
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.gc import GcEngine, GcPolicy
+from crdt_tpu.obs import convergence as obs_convergence
+from crdt_tpu.obs import metrics as obs_metrics
+from crdt_tpu.obs.capacity import CapacityTracker, ETA_NOT_GROWING
+from crdt_tpu.oplog import OpLog
+from crdt_tpu.oplog.records import derive_rm_ctx
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.sync import digest as digest_mod
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = [pytest.mark.gc, pytest.mark.slow]
+
+N_OBJECTS = 8
+CFG_MEMBER_CAP = 16     # the config rung — GC's shrink floor
+BURST_MEMBER_CAP = 64   # where an earlier burst left the planes
+EPOCHS = 8
+NEW_MEMBERS_PER_EPOCH = 2
+WINDOW_EPOCHS = 1       # members live this many epochs before removal
+EPOCH_DT = 10.0
+
+
+def _plane_nbytes(batch):
+    return sum(x.nbytes for x in (batch.clock, batch.ids, batch.dots,
+                                  batch.d_ids, batch.d_clocks))
+
+
+def _fleet(clock):
+    uni = Universe.identity(CrdtConfig(
+        num_actors=8, member_capacity=CFG_MEMBER_CAP, deferred_capacity=4,
+        counter_bits=32))
+    states = []
+    for _ in range(N_OBJECTS):
+        s = Orswot()
+        for m in range(4):
+            s.apply(s.add(m, s.value().derive_add_ctx(0)))
+        states.append(s)
+    # the fleet as a burst left it: planes regrown 4x above the config
+    # rung (the executor's ladder), live occupancy nowhere near it
+    base = OrswotBatch.from_scalar(states, uni).with_capacity(
+        BURST_MEMBER_CAP, 16)
+
+    regs = [obs_metrics.MetricsRegistry() for _ in range(3)]
+    trackers = [
+        CapacityTracker(regs[i], max_capacity=BURST_MEMBER_CAP, alpha=1.0,
+                        clock=clock)
+        for i in range(3)
+    ]
+    engines = [
+        GcEngine(GcPolicy(interval_rounds=1),
+                 capacity_tracker=trackers[i], registry=regs[i])
+        for i in range(3)
+    ]
+    nodes = [
+        ClusterNode(f"n{i}", base, uni, busy_timeout_s=5.0,
+                    oplog=OpLog(uni, capacity=1 << 16),
+                    capacity_tracker=trackers[i], gc=engines[i])
+        for i in range(3)
+    ]
+
+    def make_dialer(i):
+        def dial(peer):
+            j = int(peer.peer_id[1:])
+            ta, tb = queue_pair(default_timeout=10.0)
+
+            def serve():
+                try:
+                    nodes[j].accept(tb, peer_id=f"n{i}")
+                except Exception:
+                    pass
+                finally:
+                    tb.close()
+
+            threading.Thread(target=serve, daemon=True).start()
+            return ta
+        return dial
+
+    scheds = []
+    for i in range(3):
+        m = Membership(suspect_after=3, dead_after=6)
+        for j in range(3):
+            if j != i:
+                m.add(f"n{j}")
+        scheds.append(GossipScheduler(
+            nodes[i], m, make_dialer(i), fanout=2,
+            session_timeout_s=30.0, seed=i,
+        ))
+    return uni, nodes, scheds, regs
+
+
+def _converge(nodes, scheds, max_sweeps=6):
+    for _ in range(max_sweeps):
+        for sched in scheds:
+            sched.run_round()
+        digests = [np.asarray(digest_mod.digest_of(n.batch), np.uint64)
+                   for n in nodes]
+        if all((d == digests[0]).all() for d in digests[1:]):
+            return digests
+    raise AssertionError("fleet failed to converge within the sweep budget")
+
+
+def test_gc_soak_bounded_slots_reclaimed_tombstones_growing_eta():
+    t = [0.0]
+    obs_convergence.tracker().reset()
+    uni, nodes, scheds, regs = _fleet(clock=lambda: t[0])
+
+    def gauges(i):
+        return regs[i].snapshot()["gauges"]
+
+    bytes_start = _plane_nbytes(nodes[0].batch)
+    live_max_hist = []
+    eta_hist = []
+    tomb_seen = 0
+    window = []  # (epoch, members) still live
+    next_member = 100
+    for epoch in range(EPOCHS):
+        t[0] += EPOCH_DT
+        # sliding-window churn on object 0: node 0 mints new members...
+        members = list(range(next_member,
+                             next_member + NEW_MEMBERS_PER_EPOCH))
+        next_member += NEW_MEMBERS_PER_EPOCH
+        nodes[0].submit_writes([0] * len(members), members, actor=0)
+        window.append((epoch, members))
+        # ...and removes the window's expired members (clock derived
+        # from its own write view — applies immediately, frees slots)
+        expired = [w for w in window if w[0] <= epoch - WINDOW_EPOCHS]
+        window = [w for w in window if w[0] > epoch - WINDOW_EPOCHS]
+        for _, olds in expired:
+            nodes[0].submit_ops(derive_rm_ctx(
+                nodes[0].write_clock(), [0] * len(olds), olds))
+        # cross-node deferred tombstone: node 0 also writes object 1,
+        # then a remove WITNESSED BY ITS ADVANCED CLOCK lands on node 1
+        # before node 1 has synced the epoch's adds — the remove parks
+        # in node 1's deferred table until anti-entropy catches up,
+        # then settles (merge plunger or GC, whichever runs first)
+        obj1_member = 500 + epoch
+        nodes[0].submit_writes([1], [obj1_member], actor=0)
+        nodes[1].submit_ops(derive_rm_ctx(
+            nodes[0].write_clock(), [1], [obj1_member]))
+        nodes[1].sample_capacity()
+        tomb_seen = max(tomb_seen,
+                        gauges(1)["capacity.orswot.tombstones"])
+
+        digests = _converge(nodes, scheds)
+        assert digests is not None
+        for i in range(3):
+            g = gauges(i)
+            # the PR 9 identity still holds under GC: reported bytes ==
+            # the live buffers, through every settle/shrink
+            assert g["capacity.orswot.bytes"] \
+                == _plane_nbytes(nodes[i].batch), (epoch, i)
+        live_max_hist.append(gauges(0)["capacity.orswot.live_max"])
+        if epoch >= 1:
+            eta_hist.append(gauges(0)["capacity.orswot.eta_s"])
+
+    # BOUNDED live slots: the window, not the history.  The GC-off
+    # control (test_capacity_soak) grows monotonically by
+    # NEW_MEMBERS_PER_EPOCH every epoch; here the busiest object must
+    # stay under the config rung with room to spare.
+    bound = 4 + NEW_MEMBERS_PER_EPOCH * (WINDOW_EPOCHS + 1) + 2
+    assert max(live_max_hist) <= bound, live_max_hist
+    assert live_max_hist[-1] <= bound
+    assert live_max_hist != sorted(set(live_max_hist)) or \
+        live_max_hist[-1] - live_max_hist[0] < (EPOCHS - 1) \
+        * NEW_MEMBERS_PER_EPOCH  # NOT the control's monotone climb
+
+    # capacity walked back down the ladder: every node re-packed to the
+    # config rung and the planes shed real bytes
+    for i in range(3):
+        assert nodes[i].batch.member_capacity == CFG_MEMBER_CAP, i
+        assert _plane_nbytes(nodes[i].batch) < bytes_start
+        assert nodes[i].gc.total_reclaimed_bytes > 0
+        assert regs[i].snapshot()["counters"]["gc.shrinks"] >= 1
+
+    # quiescence: writes stopped — tombstones drain to zero everywhere
+    # (the soak DID see tombstone rows in flight)
+    assert tomb_seen >= 1
+    t[0] += EPOCH_DT
+    digests = _converge(nodes, scheds)
+    for i in range(3):
+        assert gauges(i)["capacity.orswot.tombstones"] == 0, i
+
+    # the ETA story flipped: where the control's countdown shrank every
+    # epoch, the GC'd fleet ends not-growing (or at worst further from
+    # overflow than it started)
+    final_eta = gauges(0)["capacity.orswot.eta_s"]
+    assert final_eta == ETA_NOT_GROWING or final_eta >= eta_hist[0], (
+        final_eta, eta_hist)
+
+    # and the converged digests are byte-identical across the fleet
+    assert all((d == digests[0]).all() for d in digests[1:])

@@ -1125,6 +1125,163 @@ def bench_sync():
     return out
 
 
+def bench_digest_tree():
+    """Hierarchical digest trees vs the flat digest exchange (the
+    `crdt_tpu.sync.tree` subsystem): digest bytes per round at 0 /
+    0.1% / 1% / 10% / 100% divergence, uniform AND hot-key (Zipf)
+    shaped, on a live fleet plus a planner-level 1M-object rung.
+
+    Headline ratios (``tree_ratio_*``: tree-mode digest bytes per round
+    over ONE flat digest frame, per side):
+
+    * converged: the O(log N) claim at its best — one root frame
+      instead of u64[N]; done-bar ≤ 0.05.
+    * 1% uniform: descent's worst realistic shape (every top subtree
+      dirty); done-bar ≤ 0.15.  Hot-key divergence (Zipf 1.2 — same
+      diverged-row count clustered into few subtrees) is reported next
+      to it and must come in cheaper.
+    * dense (100%): the cutover guarantee — total tree bytes never
+      regress past flat + one root frame.
+
+    Parity gates: every tree session must converge, and the 1%-uniform
+    tree-mode fleets must end digest-identical to flat-mode sessions
+    reconciling the same inputs."""
+    import jax
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.sync import digest as digest_mod
+    from crdt_tpu.sync import tree as tree_mod
+    from crdt_tpu.sync.delta import encode_digest_frame
+    from crdt_tpu.sync.session import SyncSession, sync_pair
+    from crdt_tpu.utils.interning import Universe
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+    from crdt_tpu.utils.workload import WorkloadGen
+
+    rng = np.random.RandomState(17)
+    if SMALL:
+        n, n_sim = 8_192, 65_536
+    else:
+        n, n_sim = 65_536, 1_048_576
+    a, m, d = 16, 8, 2
+    cfg = CrdtConfig(num_actors=a, member_capacity=m, deferred_capacity=d,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+
+    import jax.numpy as jnp
+
+    reps = anti_entropy_fleets(
+        rng, n, a, m, d, 1, base=min(4, m - 2), novel=0, deferred_frac=0.25,
+    )
+    fleet_a = OrswotBatch(*(jnp.asarray(x) for x in reps[0]))
+    fleet_a = fleet_a.merge(fleet_a)  # canonicalize (plunger), as bench_sync
+
+    def diverge(rows):
+        k = rows.shape[0]
+        sub = jax.tree_util.tree_map(lambda p: p[rows], fleet_a)
+        counters = jnp.max(sub.clock, axis=-1) + 1
+        sub = sub.apply_add(
+            np.zeros(k, np.int32), counters, np.full(k, 1 << 20, np.int32))
+        return jax.tree_util.tree_map(
+            lambda p, s: p.at[rows].set(s), fleet_a, sub)
+
+    # the flat reference: ONE digest frame (lanes + version vector),
+    # the fixed per-round cost the tree replaces
+    t0 = time.perf_counter()
+    tree_a = tree_mod.build_tree(digest_mod.digest_of(fleet_a, uni))
+    build_ms = (time.perf_counter() - t0) * 1e3
+    flat_bytes = len(encode_digest_frame(
+        digest_mod.digest_of(fleet_a, uni),
+        digest_mod.version_vector(fleet_a)))
+
+    shapes = [("converged", 0.0, None), ("0p1", 0.001, None),
+              ("1", 0.01, None), ("1_hot", 0.01, 1.2),
+              ("10", 0.1, None), ("dense", 1.0, None)]
+    out = {"tree_objects": n, "tree_flat_digest_bytes": flat_bytes,
+           "tree_build_ms": round(build_ms, 2)}
+    flat_1pct_digest = None
+    for label, frac, zipf in shapes:
+        k = int(n * frac)
+        if k:
+            if zipf:
+                rows = WorkloadGen(n, seed=23, zipf_s=zipf).sample_rows(k)
+            else:
+                rows = np.sort(rng.choice(n, size=k, replace=False)
+                               ).astype(np.int64)
+            fleet_b = diverge(rows)
+        else:
+            fleet_b = fleet_a
+        sa = SyncSession(fleet_a, uni, digest_tree=True)
+        sb = SyncSession(fleet_b, uni, digest_tree=True)
+        t0 = time.perf_counter()
+        ra, rb = sync_pair(sa, sb)
+        wall = time.perf_counter() - t0
+        assert ra.converged and rb.converged, f"tree sync ({label})"
+        assert ra.tree_mode, f"session did not negotiate tree mode ({label})"
+        ratio = ra.tree_bytes_sent / flat_bytes
+        out[f"tree_ratio_{label}"] = round(ratio, 4)
+        log(
+            f"digest_tree[{label}]: {k} diverged -> tree {ra.tree_bytes_sent}B"
+            f" vs flat-frame {flat_bytes}B (ratio {ratio:.4f}, "
+            f"levels {ra.tree_levels}, subtrees {ra.subtrees_diverged}, "
+            f"wall {wall:.2f}s)"
+        )
+        if label == "1":
+            # parity: flat-mode sessions on the same inputs end
+            # digest-identical to the descent-mode fleets
+            fa, fb = SyncSession(fleet_a, uni), SyncSession(fleet_b, uni)
+            rfa, _ = sync_pair(fa, fb)
+            assert rfa.converged
+            flat_1pct_digest = rfa.digest_bytes_sent
+            assert np.array_equal(
+                digest_mod.digest_of(sa.batch, uni),
+                digest_mod.digest_of(fa.batch, uni),
+            ), "tree-mode fleet != flat-mode fleet at 1% divergence"
+    if flat_1pct_digest:
+        out["tree_flat_session_digest_bytes_1"] = flat_1pct_digest
+
+    # acceptance bars
+    if out["tree_ratio_converged"] > 0.05:
+        log(f"digest_tree WARNING: converged ratio "
+            f"{out['tree_ratio_converged']:.4f} > 0.05")
+    if out["tree_ratio_1"] > 0.15:
+        log(f"digest_tree WARNING: 1%-uniform ratio "
+            f"{out['tree_ratio_1']:.4f} > 0.15")
+    root_frame = 8 + 4 * (tree_mod.root_frame_lanes(tree_a) - 1) + 14 + a * 8
+    assert out["tree_ratio_dense"] * flat_bytes <= flat_bytes + root_frame, (
+        "dense divergence regressed past flat + one root frame"
+    )
+
+    # planner rung: 1M-object descent byte-accounting on synthetic
+    # digest vectors (the fleet itself would not fit a bench box)
+    base = rng.randint(0, 1 << 31, size=n_sim).astype(np.uint64)
+    sim_tree = tree_mod.build_tree(base)
+    sim_flat = 8 * n_sim
+    for label, frac, zipf in [("converged", 0.0, None), ("0p1", 0.001, None),
+                              ("1", 0.01, None), ("1_hot", 0.01, 1.2)]:
+        k = int(n_sim * frac)
+        peer = base.copy()
+        if k:
+            if zipf:
+                rows = WorkloadGen(n_sim, seed=29, zipf_s=zipf).sample_rows(k)
+            else:
+                rows = rng.choice(n_sim, size=k, replace=False)
+            # DISTINCT nonzero deltas per row: a shared constant would
+            # XOR-cancel in any parent with two diverged children and
+            # fake descent into missing real divergence
+            peer[rows] ^= (rng.randint(1, 1 << 31, size=k).astype(np.uint64)
+                           << np.uint64(16)) | np.uint64(1)
+        leaves, stats = tree_mod.simulate_descent(
+            sim_tree, tree_mod.build_tree(peer), flat_bytes=sim_flat)
+        out[f"tree_sim_ratio_{label}_1m"] = round(
+            stats.payload_bytes / sim_flat, 4)
+        log(f"digest_tree[sim {n_sim} {label}]: {k} diverged -> "
+            f"{stats.payload_bytes}B vs flat {sim_flat}B "
+            f"(ratio {stats.payload_bytes / sim_flat:.4f}, "
+            f"levels {stats.levels})")
+    return out
+
+
 def bench_oplog():
     """Op-based write front-end (the `crdt_tpu.oplog` subsystem): user
     writes as columnar op batches folded into the dense planes by the
@@ -2287,6 +2444,13 @@ def main():
     sync_res = run_stage("sync", 60, bench_sync)
     if sync_res is not None:
         emit(**sync_res)
+    # budget-skippable: digest-tree descent vs the flat exchange —
+    # digest bytes per round at 0/0.1%/1%/10%/dense divergence (uniform
+    # + Zipf hot-key), live sessions at bench-fleet shape plus the
+    # 1M-object planner rung; parity- and cutover-gated inside
+    tree_res = run_stage("digest_tree", 90, bench_digest_tree)
+    if tree_res is not None:
+        emit(**tree_res)
     # budget-skippable: the op-based write front-end (ops/s through the
     # scatter-fold + wire bytes/op vs the delta-sync equivalent;
     # parity-gated against the scalar apply loop inside the stage)

@@ -122,6 +122,16 @@ def counter_family(name: str) -> str:
     ``wire.orswot.from_wire``); names without a recognized leaf are
     their own family."""
     parts = name.split(".")
+    if parts[:2] == ["sync", "tree"]:
+        # the digest-tree counters (descents/cutover/collision/
+        # fallback.*) collapse into ONE family: a healthy all-sparse
+        # round legitimately records only descents — only the descent
+        # path vanishing wholesale is the signal
+        return "sync.tree"
+    if parts[:3] == ["sync", "digest", "cache"]:
+        # hit and miss are one family: an all-hit round (every fleet
+        # idle) is an improvement, not a vanished code path
+        return "sync.digest.cache"
     if parts[0] == "gc":
         # the causal-GC counters (runs/shrinks/reclaimed_bytes/...)
         # collapse into ONE family: an idle-fleet round legitimately

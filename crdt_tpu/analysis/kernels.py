@@ -609,31 +609,68 @@ def _b_digest(which: str):
     def build():
         from ..sync import digest
 
+        dt = digest._digest_dtype().__name__ \
+            if hasattr(digest._digest_dtype(), "__name__") else "uint64"
         cases = []
         if which == "orswot":
-            fn = _unjit(digest._orswot_kernel())
+            # identity universes: salts device-inline; one extra case
+            # traces the interned-universe member-salt-table gather
+            fn = _unjit(digest._orswot_kernel(False))
             for (a, m, d) in LADDER:
                 cases.append(TraceCase(
                     rung=f"A{a}.M{m}.D{d}", fn=fn,
-                    args=_orswot_planes(a, m, d)))
+                    args=_orswot_planes(a, m, d) + (_vec(a, dt),)))
+            a, m, d = LADDER[0]
+            cases.append(TraceCase(
+                rung=f"A{a}.M{m}.D{d}.table",
+                fn=_unjit(digest._orswot_kernel(True)),
+                args=_orswot_planes(a, m, d) + (_vec(a, dt), _vec(64, dt)),
+                key=("table",)))
         elif which == "counter":
             fn = _unjit(digest._counter_kernel())
             for a in ACTOR_LADDER:
                 cases.append(TraceCase(
                     rung=f"A{a}", fn=fn,
-                    args=(_mat((LADDER_N, a), _clock_dt()),)))
+                    args=(_mat((LADDER_N, a), _clock_dt()), _vec(a, dt))))
             # the PNCounter plane shape is a distinct (legitimate)
             # lowering: [N, 2, A] reshapes to [N, 2A]
             cases.append(TraceCase(
                 rung="A8.pn", fn=fn,
-                args=(_mat((LADDER_N, 2, 8), _clock_dt()),)))
+                args=(_mat((LADDER_N, 2, 8), _clock_dt()),
+                      _vec(16, dt))))
         else:  # lww
-            fn = _unjit(digest._lww_kernel())
+            fn = _unjit(digest._lww_kernel(False))
             for n in (8, 64, 512):
                 cases.append(TraceCase(
                     rung=f"N{n}", fn=fn,
                     args=(_vec(n, _clock_dt()), _vec(n, _clock_dt()))))
+            cases.append(TraceCase(
+                rung="N8.table", fn=_unjit(digest._lww_kernel(True)),
+                args=(_vec(8, _clock_dt()), _vec(8, _clock_dt()),
+                      _vec(64, dt)),
+                key=("table",)))
         return cases
+
+    return build
+
+
+def _b_tree_fold(which: str):
+    def build():
+        import jax.numpy as jnp
+
+        from ..sync import digest, tree
+
+        dt = "uint64" if digest._digest_dtype() == jnp.uint64 else "uint32"
+        if which == "fold":
+            fn = _unjit(tree._fold_kernel())
+            sizes = (16, 256, 4096)
+        else:  # the elementwise leaf position-mix
+            fn = _unjit(tree._leaf_kernel())
+            sizes = (8, 256, 4096)
+        # one legitimate lowering per level/vector length — the k-ary
+        # walk a 64k..1M-leaf tree folds through
+        return [TraceCase(rung=f"M{m}", fn=fn, args=(_vec(m, dt),))
+                for m in sizes]
 
     return build
 
@@ -880,12 +917,23 @@ MANIFEST: tuple = (
                build=_b_oplog_counter("_pn_scatter", pn=True)),
     # sync/digest.py ---------------------------------------------------------
     KernelSpec("sync.digest.orswot", "crdt_tpu/sync/digest.py", "_jit.fn",
+               compile_budget=len(LADDER) + 1,  # +1: salt-table variant
                build=_b_digest("orswot")),
     KernelSpec("sync.digest.counter", "crdt_tpu/sync/digest.py", "_jit.fn",
                compile_budget=len(ACTOR_LADDER) + 1,
                build=_b_digest("counter")),
     KernelSpec("sync.digest.lww", "crdt_tpu/sync/digest.py", "_jit.fn",
+               compile_budget=4,  # 3 sizes + the salt-table variant
                build=_b_digest("lww")),
+    # sync/tree.py -----------------------------------------------------------
+    KernelSpec("sync.tree.fold", "crdt_tpu/sync/tree.py",
+               "_fold_kernel.kernel",
+               compile_budget=3,  # one lowering per traced level length
+               build=_b_tree_fold("fold")),
+    KernelSpec("sync.tree.leaf_mix", "crdt_tpu/sync/tree.py",
+               "_leaf_kernel.kernel",
+               compile_budget=3,
+               build=_b_tree_fold("leaf")),
     # parallel/collective.py -------------------------------------------------
     KernelSpec("parallel.clock_join", _CO, "_clock_join_fn._join",
                build=_b_collective("clock")),

@@ -101,11 +101,18 @@ class ClusterNode:
                  observatory=None,
                  oplog=None,
                  capacity_tracker=None,
-                 gc=None):
+                 gc=None,
+                 digest_tree: bool = False):
         self.node_id = node_id
         self.universe = universe
         self.full_state_threshold = full_state_threshold
         self.busy_timeout_s = busy_timeout_s
+        #: advertise the digest-tree capability (sync protocol v3) in
+        #: every session this node runs: peers that also advertise it
+        #: replace the flat O(N) digest exchange with the subtree
+        #: descent; mixed fleets fall back per session, loudly
+        #: (``sync.tree.fallback.*``)
+        self.digest_tree = bool(digest_tree)
         #: a :class:`crdt_tpu.obs.capacity.CapacityTracker` this node's
         #: occupancy samples feed (None = the process-global one); the
         #: gossip scheduler samples once per round
@@ -146,14 +153,15 @@ class ClusterNode:
             return self._last_report
 
     def digest(self):
-        """The canonical digest vector of the current fleet (numpy
-        u64[N]) — the convergence oracle the tests and the example
-        compare across nodes."""
+        """The canonical (name-salted) digest vector of the current
+        fleet (numpy u64[N]) — the convergence oracle the tests and the
+        example compare across nodes."""
         import numpy as np
 
         from ..sync import digest as digest_mod
 
-        return np.asarray(digest_mod.digest_of(self.batch), dtype="u8")
+        return np.asarray(
+            digest_mod.digest_of(self.batch, self.universe), dtype="u8")
 
     # -- the op-based write front-end ---------------------------------------
 
@@ -311,6 +319,7 @@ class ClusterNode:
                 self.batch, self.universe, peer=peer_label,
                 full_state_threshold=self.full_state_threshold,
                 observatory=self.observatory,
+                digest_tree=self.digest_tree,
                 **op_hooks,
             )
             report = session.sync(transport)

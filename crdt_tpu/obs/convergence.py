@@ -45,11 +45,16 @@ class _PeerState:
         "divergence", "objects", "rounds_to_converge", "sessions",
         "converged_sessions", "last_converged_ts", "delta_ratios",
         "divergence_resolved", "version_vector", "version_vector_ts",
+        "diverged_subtrees",
     )
 
     def __init__(self):
         self.divergence = 0
         self.objects = 0
+        # widest diverged internal frontier the last tree descent saw
+        # (0 = converged or flat-mode peer) — a cheap "how clustered is
+        # the divergence" signal the gossip urgency tiebreaks on
+        self.diverged_subtrees = 0
         self.rounds_to_converge = 0
         self.sessions = 0
         self.converged_sessions = 0
@@ -128,6 +133,19 @@ class ConvergenceTracker:
         if ratio is not None:
             reg.gauge_set(f"sync.peer.{peer}.delta_ratio", ratio)
 
+    def observe_tree(self, peer: str, subtrees: int) -> None:
+        """Record one tree descent's widest diverged internal frontier
+        vs ``peer`` (:class:`~crdt_tpu.sync.session.SyncSession` tree
+        mode).  Feeds the ``sync.peer.<peer>.diverged_subtrees`` gauge
+        and the third :meth:`urgency` component: between two peers with
+        equal staleness and diverged fraction, the one whose divergence
+        spans MORE subtrees costs more descent frames to reconcile and
+        ranks more urgent — syncing it first amortizes better."""
+        with self._lock:
+            self._state(peer).diverged_subtrees = int(subtrees)
+        self._reg().gauge_set(
+            f"sync.peer.{peer}.diverged_subtrees", int(subtrees))
+
     def observe_version_vector(self, peer: str, vv,
                                at: Optional[float] = None) -> None:
         """Cache ``peer``'s version-vector summary from a digest
@@ -182,6 +200,7 @@ class ConvergenceTracker:
                     ),
                     "rounds_to_converge": st.rounds_to_converge,
                     "divergence_resolved": st.divergence_resolved,
+                    "diverged_subtrees": st.diverged_subtrees,
                     "sessions": st.sessions,
                     "converged_sessions": st.converged_sessions,
                     "staleness_s": (
@@ -195,19 +214,21 @@ class ConvergenceTracker:
 
     def urgency(self, peer: str) -> tuple:
         """How badly ``peer`` needs a sync, as a sort key: ``(staleness
-        seconds, last diverged fraction)``, both +inf for a peer never
-        converged with (never-synced peers rank first).  The gossip
-        scheduler (:mod:`crdt_tpu.cluster.gossip`) sorts candidates by
-        this key, descending — the policy "sync whoever you've ignored
-        longest, break ties toward whoever differed most" lives here,
-        next to the gauges it reads."""
+        seconds, last diverged fraction, diverged subtree count)`` —
+        all +inf for a peer never converged with (never-synced peers
+        rank first).  The gossip scheduler
+        (:mod:`crdt_tpu.cluster.gossip`) sorts candidates by this key,
+        descending — the policy "sync whoever you've ignored longest,
+        break ties toward whoever differed most, then toward whoever's
+        divergence is spread over the most subtrees (the costliest
+        descent)" lives here, next to the gauges it reads."""
         now = time.monotonic()
         with self._lock:
             st = self._peers.get(peer)
             if st is None or st.last_converged_ts is None:
-                return (float("inf"), float("inf"))
+                return (float("inf"), float("inf"), float("inf"))
             frac = st.divergence / st.objects if st.objects else 0.0
-            return (now - st.last_converged_ts, frac)
+            return (now - st.last_converged_ts, frac, st.diverged_subtrees)
 
     def reset(self) -> None:
         with self._lock:

@@ -104,6 +104,24 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "digest_collision)"),
     NameSpec("sync.digest_exchange", "histogram",
              "digest-exchange phase wall time (span)"),
+    # -- digest-tree descent (sync/session.py, sync/digest.py) ---------------
+    NameSpec("sync.tree.descents", "counter",
+             "sessions that ran the v3 subtree descent (root exchange)"),
+    NameSpec("sync.tree.cutover", "counter",
+             "descents that fell back to the flat exchange at the "
+             "dense-divergence byte threshold"),
+    NameSpec("sync.tree.collision", "counter",
+             "descents where a differing parent had no differing child "
+             "(truncated-lane collision / XOR cancellation) — fell back "
+             "to the flat exchange"),
+    NameSpec("sync.tree.fallback.*", "counter",
+             "tree-capable sessions that ran flat, by reason "
+             "(capability/version)"),
+    NameSpec("sync.tree.exchange", "histogram",
+             "tree root-compare + descent phase wall time (span)"),
+    NameSpec("sync.digest.cache.*", "counter",
+             "digest memo consults by outcome (hit/miss) — a converged "
+             "re-sync must be all hits (zero digest-kernel launches)"),
     NameSpec("sync.delta_exchange", "histogram",
              "delta-exchange phase wall time (span)"),
     NameSpec("sync.full_state_exchange", "histogram",
@@ -119,6 +137,9 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "seconds since the last converged sync (refreshed at scrape)"),
     NameSpec("sync.peer.*.delta_ratio", "gauge",
              "last session's payload bytes over the full-state reference"),
+    NameSpec("sync.peer.*.diverged_subtrees", "gauge",
+             "widest diverged internal frontier the last tree descent "
+             "saw (0 = converged or flat-mode peer); urgency tiebreak"),
     # -- cluster runtime (cluster/membership.py, cluster/gossip.py,
     # cluster/transport.py, cluster/faults.py) -------------------------------
     NameSpec("cluster.peers.*", "gauge",

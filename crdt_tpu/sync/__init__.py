@@ -23,15 +23,24 @@ compare, then ship only the diff.  Three pieces:
 """
 
 from .digest import (  # noqa: F401
+    DigestCache,
+    actor_salt_table,
     counter_digest,
+    digest_cache,
     digest_of,
+    digest_tree_of,
     fleet_summary,
     lww_digest,
+    member_salt_table,
     orswot_digest,
+    stable_name_salt,
     version_vector,
 )
 from .delta import (  # noqa: F401
+    BASELINE_VERSION,
+    COMPAT_VERSIONS,
     PROTOCOL_VERSION,
+    HelloInfo,
     OrswotDeltaApplier,
     decode_frame,
     decode_hello_payload,
@@ -40,28 +49,51 @@ from .delta import (  # noqa: F401
     encode_digest_frame,
     encode_full_frame,
     encode_hello_frame,
+    encode_tree_level_frame,
+    encode_tree_root_frame,
     gather_blobs,
 )
 from .session import SyncReport, SyncSession, queue_transport  # noqa: F401
+from .tree import (  # noqa: F401
+    TREE_K,
+    DigestTree,
+    build_tree,
+    simulate_descent,
+)
 
 __all__ = [
+    "BASELINE_VERSION",
+    "COMPAT_VERSIONS",
     "PROTOCOL_VERSION",
+    "TREE_K",
+    "DigestCache",
+    "DigestTree",
+    "HelloInfo",
     "OrswotDeltaApplier",
     "SyncReport",
     "SyncSession",
+    "actor_salt_table",
+    "build_tree",
     "counter_digest",
     "decode_frame",
     "decode_hello_payload",
+    "digest_cache",
     "digest_of",
+    "digest_tree_of",
     "diverged_indices",
     "encode_delta_frame",
     "encode_digest_frame",
     "encode_full_frame",
     "encode_hello_frame",
+    "encode_tree_level_frame",
+    "encode_tree_root_frame",
     "fleet_summary",
     "gather_blobs",
     "lww_digest",
+    "member_salt_table",
     "orswot_digest",
     "queue_transport",
+    "simulate_descent",
+    "stable_name_salt",
     "version_vector",
 ]

@@ -25,16 +25,33 @@ from __future__ import annotations
 
 import struct
 import zlib
+from typing import NamedTuple
 
 import numpy as np
 
 from ..error import SyncProtocolError
 
-#: bumped whenever the frame grammar changes; peers with different
-#: versions must fail loudly at the first frame, never misparse.
+#: bumped whenever the protocol grows; peers negotiate DOWN to the
+#: lower of the two in the hello exchange, and versions outside
+#: ``COMPAT_VERSIONS`` fail loudly at the first frame, never misparse.
 #: v2: sessions open with a HELLO frame (trace-ID negotiation + fleet
 #: observability capability flag) and may close with a FLEET frame.
-PROTOCOL_VERSION = 2
+#: v3: hello carries ``ver`` + a ``digest_tree`` capability; tree-mode
+#: sessions replace the flat digest exchange with a root comparison +
+#: subtree descent (FRAME_TREE).  The envelope grammar is unchanged
+#: since v2, so v2 and v3 interoperate: hello frames always ship at
+#: ``BASELINE_VERSION`` (they precede negotiation), every later frame
+#: at the negotiated version, and a v2 peer never sees a TREE frame
+#: because the capability defaults off for hellos without the key.
+PROTOCOL_VERSION = 3
+
+#: the version hello frames ship at, and the version assumed for a
+#: peer whose hello predates the ``ver`` key
+BASELINE_VERSION = 2
+
+#: envelope versions this build parses (the grammar is shared; frame
+#: TYPES gate on the hello-negotiated version instead)
+COMPAT_VERSIONS = frozenset({2, 3})
 
 FRAME_DIGEST = 0x01
 FRAME_DELTA = 0x02
@@ -42,16 +59,19 @@ FRAME_FULL = 0x03
 FRAME_HELLO = 0x04
 FRAME_FLEET = 0x05
 FRAME_OPS = 0x06
+FRAME_TREE = 0x07
 
 _FRAME_NAMES = {FRAME_DIGEST: "digest", FRAME_DELTA: "delta",
                 FRAME_FULL: "full", FRAME_HELLO: "hello",
-                FRAME_FLEET: "fleet", FRAME_OPS: "ops"}
+                FRAME_FLEET: "fleet", FRAME_OPS: "ops",
+                FRAME_TREE: "tree"}
 _HEADER = struct.Struct("<BBIQ")
 
 
-def _frame(ftype: int, payload: bytes) -> bytes:
+def _frame(ftype: int, payload: bytes, version: int | None = None) -> bytes:
     return _HEADER.pack(
-        PROTOCOL_VERSION, ftype, zlib.crc32(payload), len(payload)
+        PROTOCOL_VERSION if version is None else version,
+        ftype, zlib.crc32(payload), len(payload)
     ) + payload
 
 
@@ -85,11 +105,12 @@ def decode_frame(frame: bytes) -> tuple[int, bytes]:
             f"{_HEADER.size}-byte header"
         )
     version, ftype, crc, plen = _HEADER.unpack_from(frame)
-    if version != PROTOCOL_VERSION:
+    if version not in COMPAT_VERSIONS:
         raise _reject(
             "version_mismatch",
             f"sync protocol version mismatch: peer sent v{version}, "
-            f"this build speaks v{PROTOCOL_VERSION}"
+            f"this build speaks v{PROTOCOL_VERSION} "
+            f"(compatible: {sorted(COMPAT_VERSIONS)})"
         )
     if ftype not in _FRAME_NAMES:
         raise _reject("unknown_type", f"unknown sync frame type {ftype:#04x}")
@@ -113,31 +134,50 @@ def decode_frame(frame: bytes) -> tuple[int, bytes]:
 # ---- hello frames ----------------------------------------------------------
 
 
+class HelloInfo(NamedTuple):
+    """One peer's decoded hello: trace proposal, node label, the
+    capability flags, and the protocol version it speaks (``ver``
+    absent = a v2 peer — both sides then run the v2 flat protocol)."""
+
+    trace: str
+    node: str
+    fleet_obs: bool
+    oplog: bool
+    ver: int
+    digest_tree: bool
+
+
 def encode_hello_frame(trace: str, node: str, fleet_obs: bool,
-                       oplog: bool = False) -> bytes:
+                       oplog: bool = False, digest_tree: bool = False,
+                       ver: int = PROTOCOL_VERSION) -> bytes:
     """A HELLO frame — the session-opening handshake: this side's
     trace-ID proposal (both peers adopt the lexicographic min, so the
     two halves of one session share ONE fleet-unique ID), its node
-    label, and two capability flags — piggybacked fleet-observability
-    snapshots and piggybacked op batches (each exchange only happens
-    when BOTH peers advertise it, which keeps the lock-step protocol
-    symmetric; a pre-oplog peer simply never sees the key)."""
+    label, the protocol version it speaks, and three capability flags —
+    piggybacked fleet-observability snapshots, piggybacked op batches,
+    and digest-tree descent (each only happens when BOTH peers
+    advertise it, which keeps the lock-step protocol symmetric; an
+    older peer simply never sees the key).  The hello itself ships at
+    ``BASELINE_VERSION`` — it precedes the negotiation every later
+    frame's version byte follows."""
     import json
 
     payload = json.dumps(
         {"trace": str(trace), "node": str(node),
-         "fleet_obs": bool(fleet_obs), "oplog": bool(oplog)},
+         "fleet_obs": bool(fleet_obs), "oplog": bool(oplog),
+         "ver": int(ver), "digest_tree": bool(digest_tree)},
         sort_keys=True, separators=(",", ":"),
     ).encode("utf-8")
-    return _frame(FRAME_HELLO, payload)
+    return _frame(FRAME_HELLO, payload, version=BASELINE_VERSION)
 
 
-def decode_hello_payload(payload: bytes) -> tuple[str, str, bool, bool]:
-    """``(trace_proposal, node_label, fleet_obs, oplog)`` from a HELLO
-    payload.  Labels are bounded defensively — a garbage hello must
-    yield a rejection, not an unbounded event field.  A hello without
-    the ``oplog`` key (an older peer) reads as "no op piggyback", so
-    mixed fleets degrade to state-only sessions instead of rejecting."""
+def decode_hello_payload(payload: bytes) -> HelloInfo:
+    """The :class:`HelloInfo` of a HELLO payload.  Labels are bounded
+    defensively — a garbage hello must yield a rejection, not an
+    unbounded event field.  A hello without the ``oplog`` /
+    ``digest_tree`` / ``ver`` keys (an older peer) reads as "no
+    capability, v2", so mixed fleets degrade to flat state-only
+    sessions instead of rejecting."""
     import json
 
     try:
@@ -146,19 +186,22 @@ def decode_hello_payload(payload: bytes) -> tuple[str, str, bool, bool]:
         node = str(doc.get("node", "peer"))[:64]
         fleet_obs = bool(doc.get("fleet_obs", False))
         oplog = bool(doc.get("oplog", False))
+        ver = int(doc.get("ver", BASELINE_VERSION))
+        digest_tree = bool(doc.get("digest_tree", False))
     except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
         raise SyncProtocolError(f"malformed hello payload: {e}") from None
     if not trace:
         raise SyncProtocolError("hello payload carries an empty trace ID")
-    return trace, node, fleet_obs, oplog
+    return HelloInfo(trace, node, fleet_obs, oplog, ver, digest_tree)
 
 
-def encode_fleet_frame(snapshot_frame: bytes) -> bytes:
+def encode_fleet_frame(snapshot_frame: bytes,
+                       version: int | None = None) -> bytes:
     """A FLEET frame: one fleet-observatory snapshot frame
     (:func:`crdt_tpu.obs.fleet.encode_snapshot` — itself versioned and
     CRC-guarded) nested in the sync envelope, so the piggyback ride
     gets the same loud-rejection treatment as every other sync leg."""
-    return _frame(FRAME_FLEET, bytes(snapshot_frame))
+    return _frame(FRAME_FLEET, bytes(snapshot_frame), version=version)
 
 
 def decode_fleet_payload(payload: bytes) -> bytes:
@@ -167,7 +210,8 @@ def decode_fleet_payload(payload: bytes) -> bytes:
     return bytes(payload)
 
 
-def encode_ops_sync_frame(ops_frame: bytes) -> bytes:
+def encode_ops_sync_frame(ops_frame: bytes,
+                          version: int | None = None) -> bytes:
     """An OPS frame: one op-batch frame
     (:func:`crdt_tpu.oplog.wire.encode_ops_frame` — itself versioned
     and CRC-guarded) nested in the sync envelope, exactly the FLEET
@@ -175,7 +219,7 @@ def encode_ops_sync_frame(ops_frame: bytes) -> bytes:
     exchange when both hellos advertised the capability, so live
     writes submitted mid-session reach the peer in the same session
     instead of waiting a gossip round."""
-    return _frame(FRAME_OPS, bytes(ops_frame))
+    return _frame(FRAME_OPS, bytes(ops_frame), version=version)
 
 
 def decode_ops_sync_payload(payload: bytes) -> bytes:
@@ -188,7 +232,8 @@ def decode_ops_sync_payload(payload: bytes) -> bytes:
 
 
 def encode_digest_frame(digests: np.ndarray,
-                        version_vec: np.ndarray | None = None) -> bytes:
+                        version_vec: np.ndarray | None = None,
+                        version: int | None = None) -> bytes:
     """A DIGEST frame: the per-object u64 digest vector plus the
     (possibly empty) per-fleet version-vector summary."""
     d = np.ascontiguousarray(digests, dtype="<u8")
@@ -199,7 +244,7 @@ def encode_digest_frame(digests: np.ndarray,
         struct.pack("<Q", d.shape[0]) + d.tobytes()
         + struct.pack("<I", vv.shape[0]) + vv.tobytes()
     )
-    return _frame(FRAME_DIGEST, payload)
+    return _frame(FRAME_DIGEST, payload, version=version)
 
 
 def decode_digest_payload(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
@@ -218,6 +263,108 @@ def decode_digest_payload(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
     except (struct.error, ValueError) as e:
         raise SyncProtocolError(f"malformed digest payload: {e}") from None
     return d.astype(np.uint64), vv.astype(np.uint64)
+
+
+# ---- digest-tree frames (protocol v3, capability-gated) --------------------
+
+TREE_SUB_ROOT = 0x01
+TREE_SUB_LEVEL = 0x02
+
+
+def encode_tree_root_frame(tree, version_vec: np.ndarray | None = None,
+                           version: int | None = None) -> bytes:
+    """A TREE/root frame: fan-out k, fleet size, the u64 root, the top
+    children level (u32 wire lanes — the first descent comparison rides
+    along, so a dense-divergence cutover costs exactly one root frame),
+    and the per-fleet version vector the flat digest frame would have
+    carried (the GC watermark feeds off every exchange, tree or flat).
+    """
+    from .tree import wire_lanes
+
+    children = (tree.levels[-2] if tree.num_levels >= 2
+                else np.zeros(0, dtype=np.uint64))
+    cw = wire_lanes(children)
+    vv = np.ascontiguousarray(
+        version_vec if version_vec is not None else np.zeros(0), dtype="<u8"
+    ).reshape(-1)
+    payload = (
+        struct.pack("<BBQQQI", TREE_SUB_ROOT, tree.k, tree.n,
+                    tree.num_levels, tree.root & 0xFFFFFFFFFFFFFFFF,
+                    cw.shape[0])
+        + cw.tobytes()
+        + struct.pack("<I", vv.shape[0]) + vv.tobytes()
+    )
+    return _frame(FRAME_TREE, payload, version=version)
+
+
+def decode_tree_root_payload(payload: bytes
+                             ) -> tuple[int, int, int, int, np.ndarray,
+                                        np.ndarray]:
+    """``(k, n, levels, root, children u32[c], version_vector u64[v])``
+    from a TREE/root payload."""
+    try:
+        sub, k, n, levels, root, c = struct.unpack_from("<BBQQQI", payload, 0)
+        if sub != TREE_SUB_ROOT:
+            raise ValueError(f"expected a tree ROOT subframe, got {sub}")
+        off = struct.calcsize("<BBQQQI")
+        children = np.frombuffer(payload, dtype="<u4", count=c, offset=off)
+        off += 4 * c
+        (v,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        vv = np.frombuffer(payload, dtype="<u8", count=v, offset=off)
+        if off + 8 * v != len(payload):
+            raise ValueError("trailing bytes")
+    except (struct.error, ValueError) as e:
+        raise SyncProtocolError(
+            f"malformed tree root payload: {e}") from None
+    return (int(k), int(n), int(levels), int(root),
+            children.astype(np.uint32), vv.astype(np.uint64))
+
+
+def encode_tree_level_frame(level: int, parents: np.ndarray,
+                            lanes: np.ndarray,
+                            version: int | None = None) -> bytes:
+    """A TREE/level frame: one descent step — the diverged parent node
+    ids (level ``level + 1``; both peers computed the same set, they
+    travel for lock-step validation) and the u32 wire lanes of their k
+    children each, parent-major."""
+    from .tree import TREE_K, wire_lanes
+
+    parents = np.ascontiguousarray(parents, dtype="<u8")
+    lw = wire_lanes(lanes)
+    if lw.shape[0] != parents.shape[0] * TREE_K:
+        raise ValueError(
+            f"tree level frame: {parents.shape[0]} parents need "
+            f"{parents.shape[0] * TREE_K} child lanes, got {lw.shape[0]}"
+        )
+    payload = (
+        struct.pack("<BBI", TREE_SUB_LEVEL, level, parents.shape[0])
+        + parents.tobytes() + lw.tobytes()
+    )
+    return _frame(FRAME_TREE, payload, version=version)
+
+
+def decode_tree_level_payload(payload: bytes
+                              ) -> tuple[int, np.ndarray, np.ndarray]:
+    """``(level, parents int64[p], lanes u32[p*k])`` from a TREE/level
+    payload."""
+    from .tree import TREE_K
+
+    try:
+        sub, level, p = struct.unpack_from("<BBI", payload, 0)
+        if sub != TREE_SUB_LEVEL:
+            raise ValueError(f"expected a tree LEVEL subframe, got {sub}")
+        off = struct.calcsize("<BBI")
+        parents = np.frombuffer(payload, dtype="<u8", count=p, offset=off)
+        off += 8 * p
+        lanes = np.frombuffer(payload, dtype="<u4", count=p * TREE_K,
+                              offset=off)
+        if off + 4 * p * TREE_K != len(payload):
+            raise ValueError("trailing bytes")
+    except (struct.error, ValueError) as e:
+        raise SyncProtocolError(
+            f"malformed tree level payload: {e}") from None
+    return int(level), parents.astype(np.int64), lanes.astype(np.uint32)
 
 
 # ---- delta / full-state frames ---------------------------------------------
@@ -254,7 +401,8 @@ def _unpack_blobs(payload: bytes, off: int, count: int) -> list[bytes]:
     return out
 
 
-def encode_delta_frame(fleet_n: int, ids: np.ndarray, blobs) -> bytes:
+def encode_delta_frame(fleet_n: int, ids: np.ndarray, blobs,
+                       version: int | None = None) -> bytes:
     """A DELTA frame: the diverged object ids and their wire blobs, in
     id order.  ``fleet_n`` rides along so a peer with a different fleet
     size rejects cleanly."""
@@ -267,7 +415,7 @@ def encode_delta_frame(fleet_n: int, ids: np.ndarray, blobs) -> bytes:
         struct.pack("<QQ", fleet_n, ids.shape[0]) + ids.tobytes()
         + _pack_blobs(blobs)
     )
-    return _frame(FRAME_DELTA, payload)
+    return _frame(FRAME_DELTA, payload, version=version)
 
 
 def decode_delta_payload(payload: bytes) -> tuple[int, np.ndarray, list[bytes]]:
@@ -281,12 +429,12 @@ def decode_delta_payload(payload: bytes) -> tuple[int, np.ndarray, list[bytes]]:
     return int(fleet_n), ids.astype(np.int64), blobs
 
 
-def encode_full_frame(blobs) -> bytes:
+def encode_full_frame(blobs, version: int | None = None) -> bytes:
     """A FULL frame: every object's wire blob, in object order — the
     fallback when divergence is wide or digests disagree after a delta
     pass."""
     payload = struct.pack("<Q", len(blobs)) + _pack_blobs(blobs)
-    return _frame(FRAME_FULL, payload)
+    return _frame(FRAME_FULL, payload, version=version)
 
 
 def decode_full_payload(payload: bytes) -> list[bytes]:

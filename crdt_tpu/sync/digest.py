@@ -21,14 +21,17 @@ states); the session protocol treats digest equality as a fast path
 only and falls back to full-state exchange when a post-delta verify
 pass disagrees (:class:`crdt_tpu.sync.session.SyncSession`).
 
-Shared-universe requirement: lanes key on the INTERNED actor index and
-member id, so two peers' digests are comparable only when they assign
-the same indices to the same actors/members.  Identity universes — the
-bulk-path mode every replication example uses — satisfy this by
-construction (index == value).  Interned (non-identity) universes only
-compare across processes when the peers' interning order matches;
-in-process sessions sharing one ``Universe`` are always safe.
-(ROADMAP: name-keyed digest salts would lift this.)
+Name-keyed salts: lanes key on *salts derived from the registered
+NAMES*, never on raw intern indices — ``actor_salt_table`` hashes each
+actor column's registered name into a ``uint64[A]`` table and member
+ids hash through ``member_salt_table`` (interned universes) or a
+device-inline SplitMix of the value itself (identity universes, where
+the id IS the name).  Two processes that interned the same names in
+different orders therefore produce byte-identical digest vectors — the
+prerequisite for gossip between independently-started hosts.  The only
+remaining comparability requirement is universe MODE (identity vs
+interned) and name-domain stability: non-int/str/bytes names hash via
+``repr``, which must be stable across processes to compare.
 
 Counter width note: mixing runs in u64 when x64 is enabled (the batch
 package enables it at import) and degrades to 32-bit mixing under
@@ -41,6 +44,9 @@ digest mismatch and the session's full-state fallback still converges.
 from __future__ import annotations
 
 import functools
+import hashlib
+import threading
+import weakref
 
 import numpy as np
 
@@ -57,6 +63,130 @@ _T_LWW = 0x9E3779B185EBCA87
 
 _K1 = 0xFF51AFD7ED558CCD  # actor-lane multiplier
 _K2 = 0xC4CEB9FE1A85EC53  # member-lane multiplier
+
+# salt-domain tags: actor-name and member-name salts must live in
+# disjoint lane families even when an actor and a member share a name
+_T_ASALT = 0x6C62272E07BB0142
+_T_MSALT = 0x27220A95FE7D4D7C
+
+_U64 = (1 << 64) - 1
+
+
+def _splitmix64_host(x: np.ndarray) -> np.ndarray:
+    """The SplitMix64 finalizer on host u64 arrays — the same avalanche
+    the device ``_mix`` applies, so identity universes (device-inline
+    member salts) and host-built salt tables agree on integer names."""
+    x = np.asarray(x, dtype=np.uint64).copy()
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def stable_name_salt(value, domain: int) -> int:
+    """A process-independent u64 salt for one registered name.
+
+    Integers (incl. the identity registries' own ids) take the SplitMix
+    path — the same formula the device-inline identity route computes,
+    so an interned universe over ints digests identically to an
+    identity universe over the same ints.  ``str``/``bytes`` hash
+    through blake2b (stable across processes and Python hash seeds,
+    unlike ``hash()``).  Anything else hashes its ``repr`` — stable
+    only if the type's repr is; document your names."""
+    if isinstance(value, bool) or not isinstance(value, int):
+        if isinstance(value, str):
+            data = b"s:" + value.encode("utf-8")
+        elif isinstance(value, (bytes, bytearray, memoryview)):
+            data = b"b:" + bytes(value)
+        else:
+            data = b"r:" + repr(value).encode("utf-8")
+        h = int.from_bytes(
+            hashlib.blake2b(data, digest_size=8).digest(), "little"
+        )
+        return int(_splitmix64_host(np.uint64((h ^ domain) & _U64))[()])
+    return int(_splitmix64_host(np.uint64((value + domain) & _U64))[()])
+
+
+@functools.lru_cache(maxsize=64)
+def _default_actor_salts(num_actors: int) -> np.ndarray:
+    """Salts for a salt-less call (``universe=None``) or an identity
+    actor registry: the column index IS the name."""
+    return _splitmix64_host(
+        (np.arange(num_actors, dtype=np.uint64)
+         + np.uint64(_T_ASALT & _U64))
+    )
+
+
+#: salt tables per (universe id, registry sizes) — interning new names
+#: invalidates by construction (the length key changes); weakref'd so a
+#: dropped universe frees its tables
+_SALT_LOCK = threading.Lock()
+_SALT_TABLES: dict = {}
+
+
+def _salt_cache_entry(universe) -> dict:
+    key = id(universe)
+    with _SALT_LOCK:
+        ent = _SALT_TABLES.get(key)
+        if ent is None or ent["ref"]() is not universe:
+            ent = {"ref": weakref.ref(universe)}
+            _SALT_TABLES[key] = ent
+            if len(_SALT_TABLES) > 64:  # drop dead refs, oldest first
+                for k in [k for k, e in _SALT_TABLES.items()
+                          if e["ref"]() is None]:
+                    del _SALT_TABLES[k]
+        return ent
+
+
+def actor_salt_table(universe=None, num_actors: int | None = None
+                     ) -> np.ndarray:
+    """``uint64[A]`` name-keyed actor salts for ``universe`` (or the
+    index-keyed default when None — identical to what an identity
+    universe derives).  Columns beyond the interned count salt on their
+    index; they only ever hash masked (zero) cells."""
+    if universe is None:
+        return _default_actor_salts(int(num_actors))
+    a = universe.config.num_actors
+    if getattr(universe.actors, "identity", False):
+        return _default_actor_salts(a)
+    ent = _salt_cache_entry(universe)
+    n = len(universe.actors)
+    cached = ent.get("actors")
+    if cached is not None and cached[0] == n:
+        return cached[1]
+    salts = np.array(
+        [stable_name_salt(universe.actors.lookup(i), _T_ASALT)
+         for i in range(min(n, a))],
+        dtype=np.uint64,
+    )
+    if n < a:
+        salts = np.concatenate([salts, _default_actor_salts(a)[n:]])
+    ent["actors"] = (n, salts)
+    return salts
+
+
+def member_salt_table(universe=None):
+    """``uint64[R]`` name-keyed member salts for an interned universe
+    (R = registered member count, padded to a power of two so the
+    digest kernels only retrace on registry doublings), or None for
+    identity universes — there the device computes the identical
+    SplitMix salt inline from the member id itself."""
+    if universe is None or getattr(universe.members, "identity", False):
+        return None
+    ent = _salt_cache_entry(universe)
+    n = len(universe.members)
+    cached = ent.get("members")
+    if cached is not None and cached[0] == n:
+        return cached[1]
+    r = max(8, 1 << max(0, (max(1, n) - 1).bit_length()))
+    salts = np.zeros(r, dtype=np.uint64)
+    for i in range(n):
+        salts[i] = stable_name_salt(universe.members.lookup(i), _T_MSALT)
+    ent["members"] = (n, salts)
+    return salts
 
 
 def _digest_dtype():
@@ -102,43 +232,58 @@ def _jit(fn):
     return jax.jit(fn)
 
 
+def _member_salts(ids, mtable, dt):
+    """Per-slot member salts: gathered from the name-keyed table when
+    one is supplied (interned universes), else SplitMix of the id
+    itself (identity universes — the id IS the name; matches
+    :func:`stable_name_salt` on ints).  Empty slots (-1) gather a
+    garbage salt that the caller's live mask discards."""
+    import jax.numpy as jnp
+
+    if mtable is None:
+        return _mix(ids.astype(dt) + _const(_T_MSALT, dt), dt)
+    safe = jnp.clip(ids, 0, mtable.shape[0] - 1)
+    return mtable[safe]
+
+
 @functools.lru_cache(maxsize=None)
-def _orswot_kernel():
+def _orswot_kernel(use_table: bool = False):
     import jax.numpy as jnp
 
     from ..ops import orswot_ops
 
     dt = _digest_dtype()
 
-    def kernel(clock, ids, dots, d_ids, d_clocks):
-        a = clock.shape[-1]
-        aix = jnp.arange(a).astype(dt) * _const(_K1, dt)
-        # set clock: lanes keyed by actor, masked to witnessed dots
-        h = _lane(clock, aix, _T_CLOCK, dt)
+    def kernel(clock, ids, dots, d_ids, d_clocks, asalts, *mtab):
+        mtable = mtab[0] if use_table else None
+        akey = asalts * _const(_K1, dt)
+        # set clock: lanes keyed by actor-name salt, masked to
+        # witnessed dots
+        h = _lane(clock, akey, _T_CLOCK, dt)
         out = jnp.bitwise_xor.reduce(
             jnp.where(clock != 0, h, dt(0)), axis=-1
         )
-        # member entries + their dot clocks: keyed by MEMBER ID (slot
-        # order is representation, not state)
+        # member entries + their dot clocks: keyed by MEMBER-name salt
+        # (slot order is representation, not state)
         live = ids != orswot_ops.EMPTY
-        mkey = ids.astype(dt) * _const(_K2, dt)
+        mkey = _member_salts(ids, mtable, dt) * _const(_K2, dt)
         he = _mix(mkey + _const(_T_ENTRY, dt), dt)
         out = out ^ jnp.bitwise_xor.reduce(
             jnp.where(live, he, dt(0)), axis=-1
         )
-        hd = _lane(dots, mkey[..., None] + aix, _T_DOT, dt)
+        hd = _lane(dots, mkey[..., None] + akey, _T_DOT, dt)
         out = out ^ jnp.bitwise_xor.reduce(
             jnp.where(dots != 0, hd, dt(0)), axis=(-2, -1)
         )
         # deferred rows: a SET of (member, clock) removes — row index is
         # representation too
         dlive = d_ids != orswot_ops.EMPTY
-        dkey = d_ids.astype(dt) * _const(_K2, dt)
+        dkey = _member_salts(d_ids, mtable, dt) * _const(_K2, dt)
         hq = _mix(dkey + _const(_T_DREF, dt), dt)
         out = out ^ jnp.bitwise_xor.reduce(
             jnp.where(dlive, hq, dt(0)), axis=-1
         )
-        hh = _lane(d_clocks, dkey[..., None] + aix, _T_DCLK, dt)
+        hh = _lane(d_clocks, dkey[..., None] + akey, _T_DCLK, dt)
         out = out ^ jnp.bitwise_xor.reduce(
             jnp.where(d_clocks != 0, hh, dt(0)), axis=(-2, -1)
         )
@@ -153,11 +298,10 @@ def _counter_kernel():
 
     dt = _digest_dtype()
 
-    def kernel(planes):
+    def kernel(planes, cell_salts):
         n = planes.shape[0]
         flat = planes.reshape(n, -1)
-        lin = jnp.arange(flat.shape[1]).astype(dt) * _const(_K1, dt)
-        h = _lane(flat, lin, _T_COUNTER, dt)
+        h = _lane(flat, cell_salts * _const(_K1, dt), _T_COUNTER, dt)
         return jnp.bitwise_xor.reduce(
             jnp.where(flat != 0, h, dt(0)), axis=-1
         )
@@ -166,14 +310,14 @@ def _counter_kernel():
 
 
 @functools.lru_cache(maxsize=None)
-def _lww_kernel():
+def _lww_kernel(use_table: bool = False):
     dt = _digest_dtype()
 
-    def kernel(vals, markers):
+    def kernel(vals, markers, *mtab):
+        mtable = mtab[0] if use_table else None
+        vkey = _member_salts(vals, mtable, dt) * _const(_K2, dt)
         return _mix(
-            markers.astype(dt)
-            ^ _mix(vals.astype(dt) * _const(_K2, dt) + _const(_T_LWW, dt), dt),
-            dt,
+            markers.astype(dt) ^ _mix(vkey + _const(_T_LWW, dt), dt), dt
         )
 
     return _jit(kernel)
@@ -185,32 +329,151 @@ def _host_u64(x) -> np.ndarray:
     return np.asarray(x).astype(np.uint64)
 
 
-def orswot_digest(clock, ids, dots, d_ids, d_clocks) -> np.ndarray:
+def _salts_device(salts: np.ndarray):
+    """A host u64 salt table as a device array of the digest dtype
+    (explicit truncation under ``CRDT_TPU_NO_X64`` — never an implicit
+    x64 downcast warning)."""
+    import jax.numpy as jnp
+
+    dt = _digest_dtype()
+    host = np.asarray(salts, dtype=np.uint64)
+    if dt != jnp.uint64:
+        host = host.astype(np.uint32)
+    return jnp.asarray(host)
+
+
+def _counter_cell_salts(universe, tail_shape, num_actors: int) -> np.ndarray:
+    """Per-cell salts for counter-shaped planes: the actor-name salt
+    per column, domain-shifted per leading plane (the PNCounter P/N
+    split) so a P increment and an N increment never share a lane."""
+    asalts = actor_salt_table(universe, num_actors=num_actors)
+    width = 1
+    for s in tail_shape[:-1]:
+        width *= int(s)
+    if width == 1:
+        return asalts
+    shift = (np.arange(width, dtype=np.uint64)[:, None]
+             * np.uint64(0x9E3779B97F4A7C15 & _U64))
+    with np.errstate(over="ignore"):
+        cells = _splitmix64_host(asalts[None, :] + shift)
+    return cells.reshape(-1)
+
+
+def orswot_digest(clock, ids, dots, d_ids, d_clocks,
+                  universe=None) -> np.ndarray:
     """``uint64[N]`` fingerprints of N ORSWOT states, from the dense
-    planes in one kernel launch.  Slot-order- and capacity-invariant
-    (see module docstring)."""
-    return _host_u64(_orswot_kernel()(clock, ids, dots, d_ids, d_clocks))
+    planes in one kernel launch.  Slot-order-, capacity- and (with
+    ``universe``) interning-order-invariant (see module docstring)."""
+    asalts = _salts_device(
+        actor_salt_table(universe, num_actors=int(clock.shape[-1]))
+    )
+    mtable = member_salt_table(universe)
+    if mtable is None:
+        return _host_u64(_orswot_kernel(False)(
+            clock, ids, dots, d_ids, d_clocks, asalts))
+    return _host_u64(_orswot_kernel(True)(
+        clock, ids, dots, d_ids, d_clocks, asalts, _salts_device(mtable)))
 
 
-def counter_digest(planes) -> np.ndarray:
+def counter_digest(planes, universe=None) -> np.ndarray:
     """``uint64[N]`` fingerprints of counter-shaped planes — ``[N, A]``
-    (VClock / GCounter) or ``[N, 2, A]`` (PNCounter).  Cell position is
-    semantic here (actor index / P-N plane), so lanes key on the linear
-    cell index; zero cells (absent actors) contribute nothing, keeping
-    the digest invariant to ``num_actors`` padding growth."""
-    return _host_u64(_counter_kernel()(planes))
+    (VClock / GCounter) or ``[N, 2, A]`` (PNCounter).  Lanes key on the
+    actor-name salt of each column (P/N planes domain-shifted); zero
+    cells (absent actors) contribute nothing, keeping the digest
+    invariant to ``num_actors`` padding growth."""
+    cells = _counter_cell_salts(
+        universe, tuple(planes.shape[1:]), int(planes.shape[-1])
+    )
+    return _host_u64(_counter_kernel()(planes, _salts_device(cells)))
 
 
-def lww_digest(vals, markers) -> np.ndarray:
+def lww_digest(vals, markers, universe=None) -> np.ndarray:
     """``uint64[N]`` fingerprints of N LWW registers (value id +
-    marker)."""
-    return _host_u64(_lww_kernel()(vals, markers))
+    marker); value ids salt through the member-name table."""
+    mtable = member_salt_table(universe)
+    if mtable is None:
+        return _host_u64(_lww_kernel(False)(vals, markers))
+    return _host_u64(_lww_kernel(True)(vals, markers,
+                                       _salts_device(mtable)))
 
 
-def digest_of(batch) -> np.ndarray:
-    """Per-object digest vector for any supported fleet batch —
-    dispatches on the batch type's planes (OrswotBatch, PNCounterBatch,
-    GCounterBatch, VClockBatch, LWWRegBatch)."""
+# ---------------------------------------------------------------------------
+# digest memoization
+# ---------------------------------------------------------------------------
+
+
+class DigestCache:
+    """Memo for per-fleet digest state keyed on *plane version*.
+
+    Batches are immutable pytrees, so the batch OBJECT is the version
+    stamp: every mutation path (wire ingest, op apply, delta merge, GC
+    settle/re-pack) produces a new batch object, and the long-lived
+    owners (``ClusterNode``, ``SyncSession``) only swap their reference
+    when state actually changed.  Entries hold the digest vector, the
+    version-vector summary and the digest tree, keyed on
+    ``(id(batch), universe identity, registry sizes)`` — interning a
+    new name changes the size key, so salt-table growth invalidates by
+    construction — and guard against id reuse with a weakref identity
+    check.  Back-to-back converged sessions therefore recompute
+    nothing: the second session's digest exchange is a pure cache hit
+    (``sync.digest.cache.hit``), zero kernel launches.
+    """
+
+    def __init__(self, maxsize: int = 8):
+        self.maxsize = maxsize
+        self._lock = threading.Lock()
+        self._entries: dict = {}  # key -> {"ref": weakref, fields...}
+
+    def _key(self, batch, universe) -> tuple:
+        if universe is None or universe.is_identity:
+            salt_key = ("identity",)
+        else:
+            salt_key = (id(universe), len(universe.actors),
+                        len(universe.members))
+        return (id(batch), type(batch).__name__) + salt_key
+
+    def _entry(self, batch, universe, create: bool):
+        key = self._key(batch, universe)
+        ent = self._entries.get(key)
+        if ent is not None and ent["ref"]() is batch:
+            return ent
+        if not create:
+            return None
+        try:
+            ref = weakref.ref(batch)
+        except TypeError:  # un-weakref-able batch type: no caching
+            return None
+        ent = {"ref": ref}
+        self._entries[key] = ent
+        while len(self._entries) > self.maxsize:
+            self._entries.pop(next(iter(self._entries)))
+        return ent
+
+    def get(self, batch, universe, field: str):
+        with self._lock:
+            ent = self._entry(batch, universe, create=False)
+            return None if ent is None else ent.get(field)
+
+    def put(self, batch, universe, field: str, value) -> None:
+        with self._lock:
+            ent = self._entry(batch, universe, create=True)
+            if ent is not None:
+                ent[field] = value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+#: the process-global memo `digest_of` / `digest_tree_of` consult
+_CACHE = DigestCache()
+
+
+def digest_cache() -> DigestCache:
+    return _CACHE
+
+
+def _compute_digest(batch, universe) -> np.ndarray:
     from ..batch.gcounter_batch import GCounterBatch
     from ..batch.lwwreg_batch import LWWRegBatch
     from ..batch.orswot_batch import OrswotBatch
@@ -219,18 +482,59 @@ def digest_of(batch) -> np.ndarray:
 
     if isinstance(batch, OrswotBatch):
         return orswot_digest(
-            batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks
+            batch.clock, batch.ids, batch.dots, batch.d_ids,
+            batch.d_clocks, universe,
         )
     if isinstance(batch, PNCounterBatch):
-        return counter_digest(batch.planes)
+        return counter_digest(batch.planes, universe)
     if isinstance(batch, (GCounterBatch, VClockBatch)):
-        return counter_digest(batch.clocks)
+        return counter_digest(batch.clocks, universe)
     if isinstance(batch, LWWRegBatch):
-        return lww_digest(batch.vals, batch.markers)
+        return lww_digest(batch.vals, batch.markers, universe)
     raise TypeError(
         f"no digest kernel for {type(batch).__name__} "
         "(supported: Orswot/PNCounter/GCounter/VClock/LWWReg batches)"
     )
+
+
+def digest_of(batch, universe=None) -> np.ndarray:
+    """Per-object digest vector for any supported fleet batch —
+    dispatches on the batch type's planes (OrswotBatch, PNCounterBatch,
+    GCounterBatch, VClockBatch, LWWRegBatch).  ``universe`` selects the
+    name-keyed salt tables; None uses index/value-keyed salts, which is
+    exactly what an identity universe derives.  Memoized per batch
+    object (see :class:`DigestCache`) — mutating paths always produce a
+    new batch, so a hit can never serve stale lanes."""
+    from ..utils import tracing
+
+    cached = _CACHE.get(batch, universe, "digests")
+    if cached is not None:
+        tracing.count("sync.digest.cache.hit")
+        return cached
+    tracing.count("sync.digest.cache.miss")
+    digests = _compute_digest(batch, universe)
+    _CACHE.put(batch, universe, "digests", digests)
+    return digests
+
+
+def digest_tree_of(batch, universe=None, k: int | None = None):
+    """The k-ary XOR-fold digest tree over ``digest_of(batch)`` —
+    memoized alongside the digest vector, so converged gossip rounds
+    rebuild neither (:mod:`crdt_tpu.sync.tree`)."""
+    from . import tree as tree_mod
+
+    from ..utils import tracing
+
+    if k is None:
+        k = tree_mod.TREE_K
+    field = f"tree{k}"
+    cached = _CACHE.get(batch, universe, field)
+    if cached is not None:
+        tracing.count("sync.digest.cache.hit")
+        return cached
+    t = tree_mod.build_tree(digest_of(batch, universe), k=k)
+    _CACHE.put(batch, universe, field, t)
+    return t
 
 
 def version_vector(batch) -> np.ndarray | None:
@@ -238,8 +542,14 @@ def version_vector(batch) -> np.ndarray | None:
     object's clock — ``uint64[A]`` (``[2, A]`` for PNCounter), or None
     for clockless types (LWW).  A strictly-dominating peer summary means
     "the peer has seen everything I have"; the session ships it in the
-    digest frame as cheap divergence telemetry."""
+    digest frame as cheap divergence telemetry.  Memoized beside the
+    digest vector (same batch-object version stamp; salts play no part
+    here, so the identity salt key is used)."""
     import jax.numpy as jnp
+
+    cached = _CACHE.get(batch, None, "vv")
+    if cached is not None:
+        return cached
 
     from ..batch.gcounter_batch import GCounterBatch
     from ..batch.lwwreg_batch import LWWRegBatch
@@ -258,8 +568,12 @@ def version_vector(batch) -> np.ndarray | None:
     else:
         raise TypeError(f"no version vector for {type(batch).__name__}")
     if clocks.shape[0] == 0:
-        return np.zeros(clocks.shape[1:], dtype=np.uint64).reshape(-1)
-    return np.asarray(jnp.max(clocks, axis=0)).astype(np.uint64).reshape(-1)
+        vv = np.zeros(clocks.shape[1:], dtype=np.uint64).reshape(-1)
+    else:
+        vv = np.asarray(
+            jnp.max(clocks, axis=0)).astype(np.uint64).reshape(-1)
+    _CACHE.put(batch, None, "vv", vv)
+    return vv
 
 
 def fleet_summary(digests: np.ndarray) -> tuple[int, int]:

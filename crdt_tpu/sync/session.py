@@ -15,10 +15,19 @@ Phases::
     0. hello             — one frame each way: trace-ID proposals (both
                            peers adopt the lexicographic min, so the
                            session's two halves share ONE fleet-unique
-                           trace ID) + the fleet-observability
-                           capability flag
+                           trace ID), the spoken protocol version
+                           (sessions run at the min), and the
+                           capability flags (fleet observability, op
+                           piggyback, digest tree)
     1. digest exchange   — one jitted kernel + ~8 bytes/object on the
-                           wire; both peers now know the diverged set
+                           wire; both peers now know the diverged set.
+                           With the v3 ``digest_tree`` capability on
+                           both hellos, a k-ary root comparison +
+                           subtree descent replaces this phase —
+                           O(log N) frames at sparse divergence, one
+                           tiny root frame when converged, flat resumed
+                           on the shared dense-divergence cutover
+                           (:mod:`crdt_tpu.sync.tree`)
     2. delta exchange    — only diverged rows ship (FULL frame instead
                            when divergence exceeds ``full_state_
                            threshold``); scatter-merge through the warm
@@ -61,13 +70,18 @@ from ..obs import events as obs_events
 from ..utils import tracing
 from . import delta as delta_mod
 from . import digest as digest_mod
+from . import tree as tree_mod
 from .delta import (
+    BASELINE_VERSION,
+    COMPAT_VERSIONS,
     FRAME_DELTA,
     FRAME_DIGEST,
     FRAME_FLEET,
     FRAME_FULL,
     FRAME_HELLO,
     FRAME_OPS,
+    FRAME_TREE,
+    PROTOCOL_VERSION,
     OrswotDeltaApplier,
     decode_delta_payload,
     decode_digest_payload,
@@ -76,6 +90,8 @@ from .delta import (
     decode_full_payload,
     decode_hello_payload,
     decode_ops_sync_payload,
+    decode_tree_level_payload,
+    decode_tree_root_payload,
     diverged_indices,
     encode_delta_frame,
     encode_digest_frame,
@@ -83,6 +99,8 @@ from .delta import (
     encode_full_frame,
     encode_hello_frame,
     encode_ops_sync_frame,
+    encode_tree_level_frame,
+    encode_tree_root_frame,
     gather_blobs,
 )
 
@@ -108,12 +126,19 @@ class SyncReport:
     bytes_received: int = 0
     trace_id: Optional[str] = None  # hello-negotiated, same on BOTH peers
     fleet_nodes: int = 0           # nodes known after a snapshot exchange
+    protocol_version: int = 0      # hello-negotiated (min of the peers')
+    tree_mode: bool = False        # this session ran the subtree descent
+    tree_bytes_sent: int = 0       # TREE frames (root + level ships)
+    tree_frames_sent: int = 0
+    tree_levels: int = 0           # descent level exchanges after the root
+    subtrees_diverged: int = 0     # widest diverged internal frontier
 
     @property
     def bytes_sent(self) -> int:
         return (self.digest_bytes_sent + self.delta_bytes_sent
                 + self.full_bytes_sent + self.hello_bytes_sent
-                + self.fleet_bytes_sent + self.ops_bytes_sent)
+                + self.fleet_bytes_sent + self.ops_bytes_sent
+                + self.tree_bytes_sent)
 
     def delta_ratio(self, full_state_bytes: int) -> Optional[float]:
         """Payload bytes this side shipped (delta + any full-state
@@ -160,10 +185,18 @@ class SyncSession:
                  observatory=None,
                  op_outbox: Optional[Callable[[], bytes]] = None,
                  op_sink: Optional[Callable[[bytes], None]] = None,
-                 capacity_tracker=None):
+                 capacity_tracker=None,
+                 digest_tree: bool = False,
+                 protocol_version: Optional[int] = None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
+            )
+        if protocol_version is not None \
+                and protocol_version not in COMPAT_VERSIONS:
+            raise ValueError(
+                f"protocol_version {protocol_version} not in "
+                f"{sorted(COMPAT_VERSIONS)}"
             )
         self.batch = batch
         self.universe = universe
@@ -201,8 +234,39 @@ class SyncSession:
         #: cluster runtime samples per gossip ROUND instead, and a
         #: session-rate sample would be redundant there.
         self.capacity_tracker = capacity_tracker
-        self._digest_fn = digest_fn or digest_mod.digest_of
+        #: request the digest-tree descent (protocol v3): the session
+        #: advertises the ``digest_tree`` capability in its hello and
+        #: runs the O(log N) subtree descent instead of the flat O(N)
+        #: digest exchange when the peer advertised it too — otherwise
+        #: it falls back to flat, loudly (``sync.tree.fallback.*``).
+        #: A phase-1 ``digest_fn`` override disables the descent (the
+        #: tree folds the canonical vector; a synthetic one would make
+        #: the collision tests lie).
+        self.digest_tree = bool(digest_tree) and digest_fn is None
+        #: the protocol version this session SPEAKS (test hook: pin 2
+        #: to faithfully simulate a pre-tree peer); the session RUNS at
+        #: the min of both hellos' versions
+        self.speaks_version = (PROTOCOL_VERSION if protocol_version is None
+                               else int(protocol_version))
+        if self.speaks_version < 3:
+            self.digest_tree = False
+        #: hello-negotiated: min(self.speaks_version, peer's) — every
+        #: post-hello frame's version byte (None until the hello lands)
+        self.negotiated_version: Optional[int] = None
+        self._peer_digest_tree = False
+        self._user_digest_fn = digest_fn
+        self._digest_fn = digest_fn or self._canonical_digest
         self._applier = OrswotDeltaApplier(universe)
+
+    def _canonical_digest(self, batch) -> np.ndarray:
+        """The salted canonical digest vector (memoized per batch
+        object — see :class:`crdt_tpu.sync.digest.DigestCache`)."""
+        return digest_mod.digest_of(batch, self.universe)
+
+    @property
+    def _wire_version(self) -> int:
+        return (self.negotiated_version if self.negotiated_version is not None
+                else BASELINE_VERSION)
 
     def _event(self, kind: str, **fields) -> None:
         if self.trace_id is not None and "trace" not in fields:
@@ -224,6 +288,11 @@ class SyncSession:
             report.hello_bytes_sent += len(frame)
         elif leg == "fleet":
             report.fleet_bytes_sent += len(frame)
+        elif leg == "tree":
+            report.tree_bytes_sent += len(frame)
+            report.tree_frames_sent += 1
+        elif leg == "ops":
+            report.ops_bytes_sent += len(frame)
         else:
             report.full_bytes_sent += len(frame)
 
@@ -263,7 +332,8 @@ class SyncSession:
         self._send(
             send,
             encode_hello_frame(proposal, node, self.observatory is not None,
-                               oplog=can_ops),
+                               oplog=can_ops, digest_tree=self.digest_tree,
+                               ver=self.speaks_version),
             report, "hello", 0,
         )
         ftype, payload = self._recv(recv, report)
@@ -272,12 +342,41 @@ class SyncSession:
                 f"expected a hello frame, peer sent type {ftype:#04x} "
                 "(pre-v2 peer?)"
             )
-        theirs, peer_node, self._peer_fleet_obs, self._peer_oplog = \
-            decode_hello_payload(payload)
-        self.trace_id = report.trace_id = min(proposal, theirs)
-        self._event("sync.hello", proposed=proposal, peer_node=peer_node,
+        hello = decode_hello_payload(payload)
+        self._peer_fleet_obs = hello.fleet_obs
+        self._peer_oplog = hello.oplog
+        self._peer_digest_tree = hello.digest_tree
+        # post-hello, every frame's version byte is the NEGOTIATED
+        # version — the highest both peers speak — so a v2 peer's
+        # decoder never sees a byte it would reject
+        self.negotiated_version = report.protocol_version = \
+            min(self.speaks_version, hello.ver)
+        self.trace_id = report.trace_id = min(proposal, hello.trace)
+        self._event("sync.hello", proposed=proposal, peer_node=hello.node,
                     peer_fleet_obs=self._peer_fleet_obs,
-                    peer_oplog=self._peer_oplog)
+                    peer_oplog=self._peer_oplog,
+                    peer_digest_tree=self._peer_digest_tree,
+                    negotiated_version=self.negotiated_version)
+
+    def _tree_session(self) -> bool:
+        """Whether this session runs the subtree descent — a pure
+        function of both hellos (capability AND negotiated version), so
+        the lock-step protocol stays symmetric.  A tree-capable session
+        that can't descend records WHY (``sync.tree.fallback.*``) and
+        runs the flat exchange — mixed fleets degrade, never reject."""
+        if not self.digest_tree:
+            return False
+        if self.negotiated_version is not None \
+                and self.negotiated_version < 3:
+            tracing.count("sync.tree.fallback.version")
+            self._event("sync.tree_fallback", reason="version",
+                        negotiated=self.negotiated_version)
+            return False
+        if not self._peer_digest_tree:
+            tracing.count("sync.tree.fallback.capability")
+            self._event("sync.tree_fallback", reason="capability")
+            return False
+        return True
 
     def _fleet_exchange(self, send, recv, report: SyncReport) -> None:
         """Piggybacked fleet-observability snapshot swap after the
@@ -290,7 +389,9 @@ class SyncSession:
             return
         with tracing.span("obs.fleet.exchange"):
             mine = self.observatory.encode()
-            self._send(send, encode_fleet_frame(mine), report, "fleet", 0)
+            self._send(send,
+                       encode_fleet_frame(mine, version=self._wire_version),
+                       report, "fleet", 0)
             ftype, payload = self._recv(recv, report)
             if ftype != FRAME_FLEET:
                 raise SyncProtocolError(
@@ -329,8 +430,10 @@ class SyncSession:
                 mine = encode_ops_frame(OpBatch.empty())
             n_ops = frame_op_count(mine)
             report.ops_sent = n_ops
-            self._send(send, encode_ops_sync_frame(mine), report, "ops",
-                       n_ops)
+            self._send(send,
+                       encode_ops_sync_frame(mine,
+                                             version=self._wire_version),
+                       report, "ops", n_ops)
             ftype, payload = self._recv(recv, report)
             if ftype != FRAME_OPS:
                 raise SyncProtocolError(
@@ -354,8 +457,10 @@ class SyncSession:
         with tracing.span("sync.digest_exchange"):
             mine = np.asarray(digest_fn(self.batch), dtype=np.uint64)
             vv = digest_mod.version_vector(self.batch)
-            self._send(send, encode_digest_frame(mine, vv), report, "digest",
-                       mine.shape[0])
+            self._send(send,
+                       encode_digest_frame(mine, vv,
+                                           version=self._wire_version),
+                       report, "digest", mine.shape[0])
             ftype, payload = self._recv(recv, report)
             if ftype != FRAME_DIGEST:
                 raise SyncProtocolError(
@@ -371,9 +476,156 @@ class SyncSession:
         report.digest_rounds += 1
         return mine, theirs
 
+    # -- the digest-tree descent (protocol v3) -------------------------------
+
+    def _tree_root_exchange(self, send, recv, report: SyncReport):
+        """Ship this side's TREE root frame and decode the peer's —
+        returns ``(tree, peer_root, peer_children)``.  The root frame
+        carries fleet size, fan-out and level count, so a structural
+        mismatch rejects before any descent frame flows; it also
+        carries the version vector the flat digest frame would have
+        (the GC watermark feeds off every exchange, tree or flat)."""
+        tree = digest_mod.digest_tree_of(self.batch, self.universe)
+        vv = digest_mod.version_vector(self.batch)
+        self._send(
+            send,
+            encode_tree_root_frame(tree, vv, version=self._wire_version),
+            report, "tree", 0,
+        )
+        ftype, payload = self._recv(recv, report)
+        if ftype != FRAME_TREE:
+            raise SyncProtocolError(
+                f"expected a tree root frame, peer sent type {ftype:#04x}"
+            )
+        k, n, levels, root, children, peer_vv = \
+            decode_tree_root_payload(payload)
+        if k != tree.k:
+            raise SyncProtocolError(
+                f"digest-tree fan-out mismatch: peer k={k}, local "
+                f"k={tree.k}"
+            )
+        if n != tree.n:
+            raise SyncProtocolError(
+                f"digest vector shape mismatch: peer fleet {n}, local "
+                f"{tree.n} (peers must sync equal-sized fleets)"
+            )
+        if levels != tree.num_levels:
+            raise SyncProtocolError(
+                f"digest-tree level mismatch: peer {levels}, local "
+                f"{tree.num_levels}"
+            )
+        expected = (tree.level_size(tree.num_levels - 2)
+                    if tree.num_levels >= 2 else 0)
+        if children.shape[0] != expected:
+            raise SyncProtocolError(
+                f"tree root frame carries {children.shape[0]} children, "
+                f"expected {expected}"
+            )
+        if peer_vv.size:
+            obs_convergence.tracker().observe_version_vector(
+                self.peer, peer_vv)
+        report.digest_rounds += 1
+        return tree, root, children
+
+    def _tree_locate_diverged(self, send, recv, report: SyncReport
+                              ) -> Optional[np.ndarray]:
+        """Phase 1 in tree mode: root comparison + lock-step subtree
+        descent.  Returns the diverged leaf ids (EMPTY = the roots
+        matched, converged), or None when the session falls back to
+        the flat exchange — dense divergence about to out-cost the flat
+        frame (``sync.tree.cutover``) or a truncated-lane collision
+        hiding every diverged child (``sync.tree.collision``).  Every
+        decision — descend/cutover/collide — is a pure function of
+        exchanged data, so both peers take the same branch and the
+        lock-step protocol cannot deadlock."""
+        tracing.count("sync.tree.descents")
+        with tracing.span("sync.tree.exchange"):
+            tree, peer_root, peer_children = \
+                self._tree_root_exchange(send, recv, report)
+            report.tree_mode = True
+            if peer_root == tree.root:
+                return np.zeros(0, dtype=np.int64)
+            if tree.num_levels < 2:
+                return np.arange(tree.n, dtype=np.int64)
+            top = tree.num_levels - 2
+            # the root frame ships the top level unpadded; compare
+            # against the k-padded child block (zeros == zeros)
+            theirs_top = np.zeros(tree.k, dtype=np.uint32)
+            theirs_top[:peer_children.shape[0]] = peer_children
+            d = tree_mod.diverged_children(
+                np.zeros(1, dtype=np.int64),
+                tree.child_lanes(top, np.zeros(1, dtype=np.int64)),
+                theirs_top, tree.level_size(top),
+            )
+            # byte-exact mirror of tree.simulate_descent: the cutover
+            # threshold compares the planner's cost formula against one
+            # flat digest frame's lanes, on data both peers share
+            flat_bytes = 8 * tree.n
+            shipped = 8 + tree_mod.LANE_WIRE_BYTES * (
+                tree_mod.root_frame_lanes(tree) - 1)
+            level = top
+            while level > 0:
+                if d.size == 0:
+                    tracing.count("sync.tree.collision")
+                    self._event("sync.tree_fallback", reason="collision",
+                                level=level)
+                    return None
+                report.subtrees_diverged = max(
+                    report.subtrees_diverged, int(d.size))
+                ship = (d.size * tree.k * tree_mod.LANE_WIRE_BYTES
+                        + d.size * 8)
+                if shipped + ship > flat_bytes:
+                    tracing.count("sync.tree.cutover")
+                    self._event("sync.tree_fallback", reason="cutover",
+                                level=level, subtrees=int(d.size))
+                    return None
+                shipped += ship
+                report.tree_levels += 1
+                mine = tree.child_lanes(level - 1, d)
+                self._send(
+                    send,
+                    encode_tree_level_frame(level - 1, d, mine,
+                                            version=self._wire_version),
+                    report, "tree", int(d.size),
+                )
+                ftype, payload = self._recv(recv, report)
+                if ftype != FRAME_TREE:
+                    raise SyncProtocolError(
+                        "expected a tree level frame, peer sent type "
+                        f"{ftype:#04x}"
+                    )
+                plevel, pparents, planes = decode_tree_level_payload(payload)
+                if plevel != level - 1 or not np.array_equal(pparents, d):
+                    raise SyncProtocolError(
+                        "digest-tree descent out of lock-step: peer "
+                        f"shipped level {plevel} ({pparents.shape[0]} "
+                        f"parents), expected level {level - 1} "
+                        f"({d.shape[0]} parents)"
+                    )
+                d = tree_mod.diverged_children(
+                    d, mine, planes, tree.level_size(level - 1))
+                level -= 1
+            if d.size == 0:
+                tracing.count("sync.tree.collision")
+                self._event("sync.tree_fallback", reason="collision", level=0)
+                return None
+            report.subtrees_diverged = max(
+                report.subtrees_diverged, int(d.size))
+            return np.sort(d).astype(np.int64)
+
+    def _tree_converged_check(self, send, recv, report: SyncReport) -> bool:
+        """Tree-mode converged check: one root-frame exchange, u64 root
+        comparison — O(1) bytes where the flat check re-ships O(N).
+        The root XORs every full-width leaf lane, so a truncated-lane
+        collision that hid a diverged subtree during descent surfaces
+        here and routes to the full-state retry."""
+        tree, peer_root, _ = self._tree_root_exchange(send, recv, report)
+        return peer_root == tree.root
+
     def _send_full(self, send, report: SyncReport) -> None:
         blobs = self.batch.to_wire(self.universe)
-        self._send(send, encode_full_frame(blobs), report, "full", len(blobs))
+        self._send(send, encode_full_frame(blobs, version=self._wire_version),
+                   report, "full", len(blobs))
 
     def _apply_frame(self, ftype: int, payload: bytes) -> None:
         n = self._n()
@@ -482,7 +734,7 @@ class SyncSession:
                 self._apply_frame(*self._recv(recv, report))
             self._event("sync.phase", phase="converged_check")
             mine, theirs = self._exchange_digests(
-                send, recv, report, digest_mod.digest_of
+                send, recv, report, self._canonical_digest
             )
             report.converged = bool(np.array_equal(mine, theirs))
             if not report.converged:
@@ -492,21 +744,38 @@ class SyncSession:
                 )
             return report
 
-        # phase 1: digest exchange
-        self._event("sync.phase", phase="digest_exchange")
-        mine, theirs = self._exchange_digests(
-            send, recv, report, self._digest_fn
-        )
-        diverged = diverged_indices(mine, theirs)
+        # phase 1: locate divergence — the v3 subtree descent when both
+        # hellos negotiated it, else the flat digest exchange.  Both
+        # sides compute `tree_phase` from shared hello data, and a
+        # mid-descent fallback (cutover/collision) is itself a pure
+        # function of exchanged lanes, so the peers always agree on
+        # which exchange runs next.
+        tree_phase = self._tree_session()
+        diverged: Optional[np.ndarray] = None
+        if tree_phase:
+            self._event("sync.phase", phase="tree_descent")
+            diverged = self._tree_locate_diverged(send, recv, report)
+            if diverged is None:
+                tree_phase = False  # shared cutover/collision decision
+        if diverged is None:
+            self._event("sync.phase", phase="digest_exchange")
+            mine, theirs = self._exchange_digests(
+                send, recv, report, self._digest_fn
+            )
+            diverged = diverged_indices(mine, theirs)
         report.diverged = int(diverged.size)
         obs_convergence.tracker().observe_divergence(
             self.peer, report.diverged, report.objects
         )
-        canonical = self._digest_fn is digest_mod.digest_of
+        if report.tree_mode:
+            obs_convergence.tracker().observe_tree(
+                self.peer, report.subtrees_diverged)
+        canonical = self._user_digest_fn is None
         if diverged.size == 0 and canonical:
-            # idempotent re-sync: one digest exchange, zero delta bytes.
-            # (Phase 1 IS the canonical verify here — re-running it
-            # would compare the same function on the same data.)
+            # idempotent re-sync: one digest (or root) exchange, zero
+            # delta bytes.  (Phase 1 IS the canonical verify here — in
+            # tree mode the u64 root equality is the same XOR-collision
+            # class as a flat 64-bit lane match.)
             report.converged = True
             return report
 
@@ -528,7 +797,10 @@ class SyncSession:
                 with tracing.span("sync.delta_exchange"):
                     blobs = gather_blobs(self.batch, diverged, self.universe)
                     report.delta_objects_sent = len(blobs)
-                    self._send(send, encode_delta_frame(n, diverged, blobs),
+                    self._send(send,
+                               encode_delta_frame(
+                                   n, diverged, blobs,
+                                   version=self._wire_version),
                                report, "delta", len(blobs))
                     self._apply_frame(*self._recv(recv, report))
         # else: a non-canonical phase-1 digest saw nothing to ship —
@@ -536,27 +808,35 @@ class SyncSession:
         # mismatch path (below) is what catches collisions
 
         # phase 3: converged check with the CANONICAL digest (a phase-1
-        # digest_fn override must not be able to fake convergence)
+        # digest_fn override must not be able to fake convergence).  In
+        # tree mode one root-frame exchange replaces the O(N) re-ship;
+        # a root mismatch (incl. any truncated-lane collision the
+        # descent missed) routes to the same full-state retry.
         self._event("sync.phase", phase="converged_check")
-        mine, theirs = self._exchange_digests(
-            send, recv, report, digest_mod.digest_of
-        )
-        if np.array_equal(mine, theirs):
+        mismatched = -1
+        if tree_phase:
+            converged = self._tree_converged_check(send, recv, report)
+        else:
+            mine, theirs = self._exchange_digests(
+                send, recv, report, self._canonical_digest
+            )
+            converged = bool(np.array_equal(mine, theirs))
+            mismatched = int(np.count_nonzero(mine != theirs))
+        if converged:
             report.converged = True
             return report
 
         # digest mismatch after delta apply: 64-bit collision in phase 1
         # or digest-mode skew — retry with full state, which must land
         tracing.count("sync.digest_collision")
-        self._event("sync.digest_collision",
-                    mismatched=int(np.count_nonzero(mine != theirs)))
+        self._event("sync.digest_collision", mismatched=mismatched)
         self._fallback(report, "digest_collision")
         self._event("sync.phase", phase="full_state_retry")
         with tracing.span("sync.full_state_exchange"):
             self._send_full(send, report)
             self._apply_frame(*self._recv(recv, report))
         mine, theirs = self._exchange_digests(
-            send, recv, report, digest_mod.digest_of
+            send, recv, report, self._canonical_digest
         )
         report.converged = bool(np.array_equal(mine, theirs))
         if not report.converged:

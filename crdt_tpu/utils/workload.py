@@ -1,0 +1,110 @@
+"""Seeded workload shapes for the soak/bench write drivers.
+
+The soak tests and bench stages drove uniform random writes; production
+traffic is Zipfian keys and bursty sessions (ROADMAP "Realistic traffic
+shapes").  This module is the minimal cut the digest-tree work needs:
+one deterministic generator with **key-skew** and **burst** knobs, so a
+write driver (or a divergence planter) can shape *clustered* divergence
+— hot keys concentrated in few digest subtrees, the best case for the
+subtree descent — next to uniform divergence, its worst case, from the
+same seed-replayable source.
+
+* ``zipf_s`` — the Zipf exponent over object ranks (0 = uniform).
+  Rank r draws with probability ∝ 1/(r+1)^s; rank 0 is object 0 unless
+  ``permute_ranks`` scatters the ranking over the object axis (hot keys
+  contiguous vs spread — contiguous is what clusters divergence into
+  few k-ary subtrees).
+* ``burst_len`` — each drawn key repeats for a fixed burst before the
+  next draw (sessions hammer an object, they don't sprinkle).
+
+Everything is host-side numpy off one ``RandomState``; no jax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WorkloadGen:
+    """Deterministic key-skew/burst workload over ``n_objects`` keys.
+
+    >>> gen = WorkloadGen(1000, seed=7, zipf_s=1.2, burst_len=4)
+    >>> keys = gen.draw(16)          # doctest: +SKIP
+    """
+
+    def __init__(self, n_objects: int, *, seed: int = 0,
+                 zipf_s: float = 0.0, burst_len: int = 1,
+                 permute_ranks: bool = False):
+        if n_objects < 1:
+            raise ValueError(f"n_objects {n_objects} < 1")
+        if zipf_s < 0.0:
+            raise ValueError(f"zipf_s {zipf_s} < 0")
+        if burst_len < 1:
+            raise ValueError(f"burst_len {burst_len} < 1")
+        self.n_objects = int(n_objects)
+        self.zipf_s = float(zipf_s)
+        self.burst_len = int(burst_len)
+        self._rng = np.random.RandomState(seed)
+        if zipf_s == 0.0:
+            self._cdf = None
+        else:
+            w = 1.0 / np.power(
+                np.arange(1, n_objects + 1, dtype=np.float64), zipf_s)
+            self._cdf = np.cumsum(w / w.sum())
+        if permute_ranks:
+            # a seed-stable rank→object scatter (its own stream, so
+            # toggling it never shifts the draw sequence)
+            self._rank_to_obj = np.random.RandomState(
+                seed ^ 0x5EED).permutation(n_objects).astype(np.int64)
+        else:
+            self._rank_to_obj = None
+        self._burst_left = 0
+        self._burst_key = 0
+
+    # -- draws ---------------------------------------------------------------
+
+    def _ranks(self, count: int) -> np.ndarray:
+        if self._cdf is None:
+            return self._rng.randint(
+                0, self.n_objects, size=count).astype(np.int64)
+        u = self._rng.random_sample(count)
+        return np.searchsorted(self._cdf, u, side="right").astype(np.int64)
+
+    def _to_objects(self, ranks: np.ndarray) -> np.ndarray:
+        if self._rank_to_obj is None:
+            return ranks
+        return self._rank_to_obj[ranks]
+
+    def draw(self, count: int) -> np.ndarray:
+        """``int64[count]`` object keys: Zipf-skewed draws, each held
+        for ``burst_len`` consecutive writes (bursts carry across
+        calls, so chunked drivers see the same stream as one big
+        draw)."""
+        out = np.empty(count, dtype=np.int64)
+        i = 0
+        while i < count:
+            if self._burst_left == 0:
+                self._burst_key = int(self._to_objects(self._ranks(1))[0])
+                self._burst_left = self.burst_len
+            take = min(self._burst_left, count - i)
+            out[i:i + take] = self._burst_key
+            self._burst_left -= take
+            i += take
+        return out
+
+    def sample_rows(self, k: int) -> np.ndarray:
+        """``k`` DISTINCT object rows, sorted ascending, sampled by the
+        same skew (Gumbel top-k over the Zipf weights — exact weighted
+        sampling without replacement) — the divergence planter for
+        bench/soak: hot-key skew concentrates the rows in few digest
+        subtrees, uniform spreads them."""
+        k = min(int(k), self.n_objects)
+        if k <= 0:
+            return np.zeros(0, dtype=np.int64)
+        if self._cdf is None:
+            rows = self._rng.choice(self.n_objects, size=k, replace=False)
+            return np.sort(rows.astype(np.int64))
+        w = np.diff(self._cdf, prepend=0.0)
+        g = np.log(w) + self._rng.gumbel(size=self.n_objects)
+        ranks = np.argpartition(-g, k - 1)[:k].astype(np.int64)
+        return np.sort(self._to_objects(ranks))

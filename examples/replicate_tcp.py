@@ -230,7 +230,9 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 divergence: float, max_sweeps: int = 20,
                 fleet_port: int | None = None, ops_rate: int = 0,
                 ops_sweeps: int = 3, gc_enabled: bool = False,
-                gc_interval: int = 1, gc_hysteresis: float = 0.5) -> int:
+                gc_interval: int = 1, gc_hysteresis: float = 0.5,
+                digest_tree: bool = False, zipf_s: float = 0.0,
+                burst_len: int = 1) -> int:
     """N in-process replicas over real loopback TCP, reconciled by the
     cluster runtime (``crdt_tpu/cluster``): each node owns a listener
     (accepted sessions run through the same hardened transport stack),
@@ -309,6 +311,10 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             # piggyback capability from the first hello
             oplog=OpLog(uni) if ops_rate else None,
             gc=gc_engine,
+            # sync protocol v3: sessions compare digest-tree roots and
+            # descend into diverged subtrees instead of shipping the
+            # flat O(N) digest vector
+            digest_tree=digest_tree,
         ))
 
     fleet_server = None
@@ -392,6 +398,13 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
 
     ops_rng = np.random.RandomState(4242)
     total_ops = 0
+    # key-skew / burst knobs (crdt_tpu.utils.workload): Zipfian hot
+    # keys cluster divergence into few digest subtrees — the descent's
+    # best case — while the default stays uniform (its worst case)
+    from crdt_tpu.utils.workload import WorkloadGen
+
+    key_gen = WorkloadGen(n_objects, seed=4242, zipf_s=zipf_s,
+                          burst_len=burst_len)
 
     def inject_writes(r):
         """R random user writes into random nodes, mid-round: each
@@ -408,7 +421,7 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             if not cnt:
                 continue
             nodes[i].submit_writes(
-                ops_rng.randint(0, n_objects, cnt),
+                key_gen.draw(int(cnt)),
                 ops_rng.randint(200, 216, cnt).astype(np.int32),
                 actor=i + 1,
             )
@@ -491,6 +504,21 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     if fleet_server is not None:
         fleet_server.stop()
 
+    if digest_tree:
+        from crdt_tpu.utils import tracing as _tracing
+
+        c = _tracing.counters()
+        print(
+            f"tree: descents={c.get('sync.tree.descents', 0)} "
+            f"cutover={c.get('sync.tree.cutover', 0)} "
+            f"fallbacks="
+            f"{sum(v for k, v in c.items() if k.startswith('sync.tree.fallback.'))} "
+            f"digest_cache_hits={c.get('sync.digest.cache.hit', 0)} "
+            f"(wire.sync.tree.bytes={c.get('wire.sync.tree.bytes', 0)} vs "
+            f"flat wire.sync.digest.bytes="
+            f"{c.get('wire.sync.digest.bytes', 0)})", flush=True,
+        )
+
     verdict = "CONVERGED" if converged else "DIVERGED"
     print(f"gossip: {n_peers} peers x {n_objects} objects  "
           f"sweeps={sweeps}  {verdict}", flush=True)
@@ -543,6 +571,19 @@ def main() -> int:
     ap.add_argument("--gc-interval", type=int, default=1, metavar="N",
                     help="with --gc: collect every Nth gossip round "
                          "(GcPolicy.interval_rounds; default 1)")
+    ap.add_argument("--digest-tree", action="store_true",
+                    help="with --gossip: sync protocol v3 — sessions "
+                         "compare k-ary digest-tree roots and descend "
+                         "into diverged subtrees (O(log N) digest "
+                         "frames) instead of shipping the flat O(N) "
+                         "digest vector")
+    ap.add_argument("--zipf", type=float, default=0.0, metavar="S",
+                    help="with --ops: Zipf key-skew exponent for the "
+                         "write driver (0 = uniform; ~1.2 = hot keys "
+                         "clustered into few digest subtrees)")
+    ap.add_argument("--burst", type=int, default=1, metavar="B",
+                    help="with --ops: each drawn key repeats for B "
+                         "consecutive writes (bursty sessions)")
     ap.add_argument("--gc-hysteresis", type=float, default=0.5,
                     help="with --gc: shrink only when the fitted "
                          "capacity rung is at most this fraction of the "
@@ -560,7 +601,9 @@ def main() -> int:
                            fleet_port=args.fleet_port,
                            ops_rate=args.ops, gc_enabled=args.gc,
                            gc_interval=args.gc_interval,
-                           gc_hysteresis=args.gc_hysteresis)
+                           gc_hysteresis=args.gc_hysteresis,
+                           digest_tree=args.digest_tree,
+                           zipf_s=args.zipf, burst_len=args.burst)
 
     if args.role != "demo":
         if not args.port:

@@ -449,9 +449,13 @@ def test_full_state_mode_still_version_tagged():
     ra = sa.sync(wrapped_send, recv_a)
     t.join(timeout=60)
     assert ra.converged
+    # the hello ships at the baseline version (it precedes negotiation),
+    # every later frame at the negotiated one — all within the compat set
     assert frames_a and all(
-        f[0] == sync_delta.PROTOCOL_VERSION for f in frames_a
+        f[0] in sync_delta.COMPAT_VERSIONS for f in frames_a
     )
+    assert frames_a[0][0] == sync_delta.BASELINE_VERSION
+    assert any(f[0] == sync_delta.PROTOCOL_VERSION for f in frames_a[1:])
     assert sa.batch.to_wire(uni) == sb.batch.to_wire(uni)
 
 

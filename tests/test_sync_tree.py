@@ -1,0 +1,599 @@
+"""Hierarchical digest trees + name-keyed salts (`crdt_tpu.sync.tree`).
+
+Covers the ISSUE 11 acceptance bar: tree-root equality ⟺ flat
+digest-vector equality on seeded random histories (incl. post-GC /
+repacked replicas), interning-order salt invariance across universes
+that never shared an intern table, the v3 subtree descent converging
+byte-identical to flat mode — including under 20% frame loss — the
+mixed-version fleet falling back to flat loudly (counter, never a
+``SyncProtocolError``), the dense-divergence cutover, digest
+memoization (a second idle sync performs ZERO digest-kernel calls),
+and the seeded workload generator's skew/burst knobs.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from crdt_tpu.batch import GCounterBatch, OrswotBatch
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.error import SyncProtocolError
+from crdt_tpu.scalar.gcounter import GCounter
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.sync import digest as sync_digest
+from crdt_tpu.sync import delta as sync_delta
+from crdt_tpu.sync import tree as sync_tree
+from crdt_tpu.sync.delta import (
+    BASELINE_VERSION,
+    COMPAT_VERSIONS,
+    decode_frame,
+    decode_tree_level_payload,
+    decode_tree_root_payload,
+    encode_tree_level_frame,
+    encode_tree_root_frame,
+)
+from crdt_tpu.sync.session import SyncSession, sync_pair
+from crdt_tpu.utils.interning import Registry, Universe
+from crdt_tpu.utils.workload import WorkloadGen
+
+pytestmark = pytest.mark.sync
+
+
+def _uni(**kw):
+    cfg = dict(num_actors=8, member_capacity=16, deferred_capacity=4,
+               counter_bits=32)
+    cfg.update(kw)
+    return Universe.identity(CrdtConfig(**cfg))
+
+
+def _orswot_fleet(n, seed, actor=1, extra_on=(), rng_members=50):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        s = Orswot()
+        for _ in range(rng.randint(1, 5)):
+            s.apply(s.add(int(rng.randint(0, rng_members)),
+                          s.value().derive_add_ctx(0)))
+        if i % 5 == 0:
+            read = s.value()
+            if read.val:
+                m = sorted(read.val)[0]
+                s.apply(s.remove(m, s.contains(m).derive_rm_ctx()))
+        out.append(s)
+    for i in extra_on:
+        s = out[i]
+        s.apply(s.add(900 + actor, s.value().derive_add_ctx(actor)))
+    return out
+
+
+# ---- the tree itself -------------------------------------------------------
+
+
+def test_tree_structure_and_root_is_xor_fold():
+    d = np.arange(1, 41, dtype=np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    t = sync_tree.build_tree(d)
+    assert [lv.shape[0] for lv in t.levels] == [40, 3, 1]
+    # the root is the XOR fold of the position-mixed leaf lanes
+    assert t.root == int(np.bitwise_xor.reduce(t.levels[0]))
+    # every parent is the XOR of its (zero-padded) children
+    for lvl in range(1, t.num_levels):
+        for p in range(t.level_size(lvl)):
+            kids = t.child_lanes(lvl - 1, np.array([p]))
+            assert int(np.bitwise_xor.reduce(kids)) == int(t.levels[lvl][p])
+    # the leaf mix is a per-position bijection: diverged positions
+    # match the raw vector's exactly
+    d2 = d.copy()
+    d2[[3, 17]] ^= np.uint64(0xABCD)
+    t2 = sync_tree.build_tree(d2)
+    assert np.nonzero(t.levels[0] != t2.levels[0])[0].tolist() == [3, 17]
+    # ...and an IDENTICAL delta at two positions must not XOR-cancel
+    # out of the root (the bulk-write cancellation class the mix kills)
+    assert t.root != t2.root
+
+
+def test_tree_edge_sizes():
+    assert sync_tree.build_tree(np.zeros(0, np.uint64)).root == 0
+    one = sync_tree.build_tree(np.array([7], np.uint64))
+    assert one.num_levels == 1 and one.root == int(one.levels[0][0])
+    assert one.root != sync_tree.build_tree(np.array([8], np.uint64)).root
+    exact = sync_tree.build_tree(np.arange(256, dtype=np.uint64))
+    assert [lv.shape[0] for lv in exact.levels] == [256, 16, 1]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_root_equality_iff_flat_vector_equality(seed):
+    """The property sweep: on seeded random histories, tree roots agree
+    exactly when the flat digest vectors do, and a descent recovers the
+    exact flat diverged set."""
+    rng = np.random.RandomState(700 + seed)
+    n = int(rng.randint(20, 200))
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(n, seed=seed), uni)
+    da = sync_digest.digest_of(a, uni)
+    ta = sync_digest.digest_tree_of(a, uni)
+    # identical history -> identical vector -> identical root
+    b_same = OrswotBatch.from_scalar(_orswot_fleet(n, seed=seed), uni)
+    assert np.array_equal(da, sync_digest.digest_of(b_same, uni))
+    assert sync_digest.digest_tree_of(b_same, uni).root == ta.root
+
+    k = int(rng.randint(1, max(2, n // 6)))
+    rows = np.sort(rng.choice(n, size=k, replace=False))
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=seed, actor=2, extra_on=rows), uni)
+    db = sync_digest.digest_of(b, uni)
+    tb = sync_digest.digest_tree_of(b, uni)
+    assert not np.array_equal(da, db) and ta.root != tb.root
+    leaves, stats = sync_tree.simulate_descent(ta, tb)
+    assert np.array_equal(leaves, np.nonzero(da != db)[0])
+    assert not stats.cutover and not stats.collision
+
+
+def test_tree_matches_flat_after_gc_settle_and_repack():
+    """Post-GC/repacked replicas digest (and therefore tree) identical
+    to their never-compacted twin — representation changed, state did
+    not."""
+    from crdt_tpu.gc.compact import settle_orswot
+    from crdt_tpu.gc.repack import repack_orswot
+
+    uni = _uni(member_capacity=8)
+    base = OrswotBatch.from_scalar(_orswot_fleet(48, seed=9), uni)
+    grown = base.with_capacity(member_capacity=32, deferred_capacity=8)
+    settled, _ = settle_orswot(grown)
+    packed, _reclaimed = repack_orswot(settled, member_capacity=8,
+                                       deferred_capacity=4)
+    want = sync_digest.digest_of(base.merge(base), uni)
+    assert np.array_equal(want, sync_digest.digest_of(packed, uni))
+    assert sync_digest.digest_tree_of(packed, uni).root \
+        == sync_tree.build_tree(want).root
+    _leaves, stats = sync_tree.simulate_descent(
+        sync_digest.digest_tree_of(packed, uni), sync_tree.build_tree(want))
+    assert _leaves.size == 0 and not stats.collision
+
+
+# ---- name-keyed salts ------------------------------------------------------
+
+
+def _interleaved_universes():
+    """Two universes interning the SAME names in DIFFERENT orders."""
+    cfg = CrdtConfig(num_actors=8, member_capacity=16, deferred_capacity=4,
+                     counter_bits=32)
+    actors = ["alice", "bob", "carol"]
+    members = [f"m{i}" for i in range(20)]
+    u1 = Universe(cfg, actors=Registry(capacity=8), members=Registry())
+    u2 = Universe(cfg, actors=Registry(capacity=8), members=Registry())
+    u1.actors.intern_all(actors)
+    u1.members.intern_all(members)
+    u2.actors.intern_all(list(reversed(actors)))
+    u2.members.intern_all(list(reversed(members)))
+    return u1, u2, actors, members
+
+
+def _named_fleet(n, actors, members, seed=5):
+    """Scalar states over the NAME values themselves — ``from_scalar``
+    interns them through whichever universe ingests the fleet."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        s = Orswot()
+        for _ in range(rng.randint(1, 6)):
+            actor = actors[rng.randint(0, len(actors))]
+            member = members[rng.randint(0, len(members))]
+            s.apply(s.add(member, s.value().derive_add_ctx(actor)))
+        out.append(s)
+    return out
+
+
+def test_salt_invariance_across_interning_orders():
+    """Two nodes that interned the same names in different orders still
+    compare digests — lane keys come from the NAMES, not the dense
+    indices (the prerequisite for gossip between independently-started
+    hosts)."""
+    u1, u2, actors, members = _interleaved_universes()
+    fleet = _named_fleet(40, actors, members)
+    b1 = OrswotBatch.from_scalar(fleet, u1)
+    b2 = OrswotBatch.from_scalar(fleet, u2)
+    d1 = sync_digest.digest_of(b1, u1)
+    d2 = sync_digest.digest_of(b2, u2)
+    assert np.array_equal(d1, d2)
+    assert sync_digest.digest_tree_of(b1, u1).root \
+        == sync_digest.digest_tree_of(b2, u2).root
+    # and the planes really ARE laid out differently (the invariance is
+    # doing work, not comparing identical buffers)
+    assert not np.array_equal(np.asarray(b1.ids), np.asarray(b2.ids))
+
+    # counter planes too: actor columns permuted between universes
+    counters = []
+    for i in range(12):
+        g = GCounter()
+        for _ in range(i + 1):
+            g.apply(g.inc(actors[i % len(actors)]))
+        counters.append(g)
+    c1 = sync_digest.digest_of(GCounterBatch.from_scalar(counters, u1), u1)
+    c2 = sync_digest.digest_of(GCounterBatch.from_scalar(counters, u2), u2)
+    assert np.array_equal(c1, c2)
+
+
+def test_interned_int_names_match_identity_universe():
+    """An interned universe over int names (in scrambled order) digests
+    identically to an identity universe — int salts are the same
+    SplitMix the identity path computes on device."""
+    cfg = CrdtConfig(num_actors=8, member_capacity=16, deferred_capacity=4,
+                     counter_bits=32)
+    uid = Universe.identity(cfg)
+    uin = Universe(cfg, actors=Registry(capacity=8), members=Registry())
+    uin.actors.intern_all([3, 0, 1, 2])     # scrambled int actor names
+    uin.members.intern_all([17, 4, 99, 23])  # scrambled int member names
+    rngs = np.random.RandomState(11)
+    fleet = []
+    for _ in range(24):
+        s = Orswot()
+        for _ in range(rngs.randint(1, 5)):
+            actor = int(rngs.randint(0, 4))
+            member = [17, 4, 99, 23][rngs.randint(0, 4)]
+            s.apply(s.add(member, s.value().derive_add_ctx(actor)))
+        fleet.append(s)
+    di = sync_digest.digest_of(OrswotBatch.from_scalar(fleet, uid), uid)
+    dn = sync_digest.digest_of(OrswotBatch.from_scalar(fleet, uin), uin)
+    assert np.array_equal(di, dn)
+
+
+def test_stable_name_salt_is_deterministic_and_domain_separated():
+    s = sync_digest.stable_name_salt
+    from crdt_tpu.sync.digest import _T_ASALT, _T_MSALT
+
+    assert s("alice", _T_ASALT) == s("alice", _T_ASALT)
+    assert s("alice", _T_ASALT) != s("alice", _T_MSALT)
+    assert s("alice", _T_ASALT) != s("bob", _T_ASALT)
+    assert s(5, _T_MSALT) != s("5", _T_MSALT)
+    assert s(b"x", _T_MSALT) != s("x", _T_MSALT)
+
+
+# ---- tree frames -----------------------------------------------------------
+
+
+def test_tree_frame_roundtrip():
+    t = sync_tree.build_tree(np.arange(100, dtype=np.uint64))
+    vv = np.arange(4, dtype=np.uint64)
+    ftype, payload = decode_frame(encode_tree_root_frame(t, vv))
+    assert ftype == sync_delta.FRAME_TREE
+    k, n, levels, root, children, got_vv = decode_tree_root_payload(payload)
+    assert (k, n, levels, root) == (16, 100, t.num_levels, t.root)
+    assert np.array_equal(children,
+                          sync_tree.wire_lanes(t.levels[-2]))
+    assert np.array_equal(got_vv, vv)
+
+    parents = np.array([0, 3], dtype=np.int64)
+    lanes = t.child_lanes(0, parents)
+    ftype, payload = decode_frame(encode_tree_level_frame(0, parents, lanes))
+    level, got_p, got_l = decode_tree_level_payload(payload)
+    assert level == 0 and np.array_equal(got_p, parents)
+    assert np.array_equal(got_l, sync_tree.wire_lanes(lanes))
+
+
+def test_malformed_tree_frames_rejected_cleanly():
+    t = sync_tree.build_tree(np.arange(64, dtype=np.uint64))
+    frame = encode_tree_root_frame(t)
+    with pytest.raises(SyncProtocolError):
+        decode_frame(frame[:-3])  # truncation dies at the CRC
+    _, payload = decode_frame(frame)
+    with pytest.raises(SyncProtocolError):
+        decode_tree_root_payload(payload[:-2])
+    with pytest.raises(SyncProtocolError):
+        decode_tree_level_payload(payload)  # wrong subframe tag
+    with pytest.raises(SyncProtocolError):
+        decode_tree_root_payload(b"")
+
+
+def test_envelope_accepts_both_compat_versions():
+    d = np.arange(4, dtype=np.uint64)
+    for ver in sorted(COMPAT_VERSIONS):
+        frame = sync_delta.encode_digest_frame(d, version=ver)
+        assert frame[0] == ver
+        decode_frame(frame)
+    for bad in (1, 4):
+        frame = sync_delta.encode_digest_frame(d, version=bad)
+        with pytest.raises(SyncProtocolError):
+            decode_frame(frame)
+    # hellos always ship at the baseline (they precede negotiation)
+    hello = sync_delta.encode_hello_frame("t", "n", False)
+    assert hello[0] == BASELINE_VERSION
+
+
+# ---- descent sessions ------------------------------------------------------
+
+
+def test_converged_tree_session_is_one_root_frame():
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(120, seed=21), uni)
+    b = OrswotBatch.from_scalar(_orswot_fleet(120, seed=21), uni)
+    sa = SyncSession(a, uni, digest_tree=True)
+    sb = SyncSession(b, uni, digest_tree=True)
+    ra, rb = sync_pair(sa, sb)
+    for r in (ra, rb):
+        assert r.converged and r.tree_mode
+        assert r.diverged == 0 and r.digest_rounds == 1
+        assert r.delta_bytes_sent == 0 and r.full_bytes_sent == 0
+        assert r.digest_bytes_sent == 0  # no flat vector ever shipped
+        assert r.tree_frames_sent == 1   # the root frame IS the session
+        assert r.tree_bytes_sent < 8 * 120  # and it beats the flat frame
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_tree_session_matches_flat_session_byte_identical(seed):
+    rng = np.random.RandomState(800 + seed)
+    n = int(rng.randint(40, 160))
+    k = int(rng.randint(1, max(2, n // 10)))
+    rows_a = rng.choice(n, size=k, replace=False)
+    rows_b = rng.choice(n, size=k, replace=False)
+    uni = _uni()
+    a = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=seed, actor=1, extra_on=rows_a), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=seed, actor=2, extra_on=rows_b), uni)
+    sa = SyncSession(a, uni, digest_tree=True)
+    sb = SyncSession(b, uni, digest_tree=True)
+    ra, rb = sync_pair(sa, sb)
+    assert ra.converged and rb.converged and ra.tree_mode
+    fa, fb = SyncSession(a, uni), SyncSession(b, uni)
+    rfa, _rfb = sync_pair(fa, fb)
+    assert rfa.converged and not rfa.tree_mode
+    # byte-identical to the flat-mode session AND the plain merge
+    ref = a.merge(b).to_wire(uni)
+    assert sa.batch.to_wire(uni) == ref == sb.batch.to_wire(uni)
+    assert fa.batch.to_wire(uni) == ref
+    # the descent located the exact flat diverged set
+    assert ra.diverged == rfa.diverged
+    assert ra.subtrees_diverged >= 1
+
+
+def test_dense_divergence_cutover_falls_back_to_flat():
+    """A fleet small enough that one descent level out-costs the flat
+    frame: both peers take the shared cutover decision, fall back to
+    the flat exchange, and still converge — total tree spend is the
+    root frame only."""
+    from crdt_tpu.utils import tracing
+
+    uni = _uni()
+    n = 17  # levels [17, 2, 1]: one level ship (2 parents) > 8n bytes
+    a = OrswotBatch.from_scalar(_orswot_fleet(n, seed=31, actor=1), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=31, actor=2, extra_on=[0, 16]), uni)
+    before = tracing.counters().get("sync.tree.cutover", 0)
+    sa = SyncSession(a, uni, digest_tree=True)
+    sb = SyncSession(b, uni, digest_tree=True)
+    ra, rb = sync_pair(sa, sb)
+    assert ra.converged and rb.converged
+    assert ra.tree_mode                      # the descent started...
+    assert ra.tree_frames_sent == 1          # ...but spent only the root
+    assert ra.digest_bytes_sent > 0          # flat exchange took over
+    assert tracing.counters()["sync.tree.cutover"] >= before + 2
+    assert sa.batch.to_wire(uni) == a.merge(b).to_wire(uni)
+
+
+def test_mixed_version_fleet_falls_back_flat_loudly():
+    """A v3 tree-capable node gossiping with a v2 node: capability off,
+    counter recorded, flat exchange, NO SyncProtocolError — the PR 6/7
+    capability discipline."""
+    from crdt_tpu.utils import tracing
+
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(30, seed=41, actor=1), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(30, seed=41, actor=2, extra_on=[3]), uni)
+
+    before = dict(tracing.counters())
+    sa = SyncSession(a, uni, digest_tree=True)
+    sb = SyncSession(b, uni, protocol_version=2)  # a faithful v2 peer
+    frames_a: list = []
+    from crdt_tpu.sync.session import queue_transport
+
+    (send_a, recv_a), (send_b, recv_b) = queue_transport()
+
+    def wrapped(f):
+        frames_a.append(f)
+        send_a(f)
+
+    t = threading.Thread(target=lambda: sb.sync(send_b, recv_b), daemon=True)
+    t.start()
+    ra = sa.sync(wrapped, recv_a)
+    t.join(timeout=60)
+    assert ra.converged and not ra.tree_mode
+    assert ra.protocol_version == 2  # negotiated down
+    # every post-hello frame the v3 side sent speaks v2 on the wire —
+    # a REAL v2 build would parse this session end to end
+    assert frames_a and all(f[0] == 2 for f in frames_a)
+    deltas = {k: v - before.get(k, 0)
+              for k, v in tracing.counters().items()}
+    assert deltas.get("sync.tree.fallback.version", 0) == 1
+    assert sa.batch.to_wire(uni) == sb.batch.to_wire(uni)
+
+    # capability-off peer (same version, no tree): same discipline
+    before = dict(tracing.counters())
+    sa2 = SyncSession(sa.batch, uni, digest_tree=True)
+    sb2 = SyncSession(sb.batch, uni)  # v3 but no digest_tree
+    ra2, _ = sync_pair(sa2, sb2)
+    assert ra2.converged and not ra2.tree_mode
+    deltas = {k: v - before.get(k, 0)
+              for k, v in tracing.counters().items()}
+    assert deltas.get("sync.tree.fallback.capability", 0) == 1
+
+
+def test_descent_under_20pct_loss_converges_byte_identical():
+    """Three digest-tree nodes gossiping over links dropping 20% of
+    frames (ARQ-hardened) converge to digest vectors byte-identical to
+    a flat-mode control fleet on the same histories."""
+    from crdt_tpu.cluster import (
+        ClusterNode, GossipScheduler, Membership, queue_pair,
+    )
+    from crdt_tpu.cluster.faults import FaultPlan, FaultyTransport
+    from crdt_tpu.cluster.transport import ResilientTransport, RetryPolicy
+
+    uni = _uni()
+    fast = RetryPolicy(send_deadline_s=3.0, recv_deadline_s=3.0,
+                       ack_timeout_s=0.05, max_backoff_s=0.3,
+                       retry_budget=400)
+
+    def build(digest_tree):
+        nodes = []
+        for i in range(3):
+            batch = OrswotBatch.from_scalar(
+                _orswot_fleet(60, seed=51, actor=i + 1,
+                              extra_on=[(7 * i + j) % 60 for j in range(4)]),
+                uni)
+            nodes.append(ClusterNode(f"n{i}", batch, uni,
+                                     busy_timeout_s=5.0,
+                                     digest_tree=digest_tree))
+        seeds = iter(range(1000, 4000))
+
+        def make_dialer(i):
+            def dial(peer):
+                j = int(peer.peer_id[1:])
+                s = next(seeds)
+                ta, tb = queue_pair(default_timeout=10.0)
+                fa = FaultyTransport(ta, FaultPlan(seed=s, drop=0.2))
+                fb = FaultyTransport(tb, FaultPlan(seed=s + 1, drop=0.2))
+                ra = ResilientTransport(fa, fast, seed=s + 2)
+                rb = ResilientTransport(fb, fast, seed=s + 3)
+
+                def serve():
+                    try:
+                        nodes[j].accept(rb, peer_id=f"n{i}")
+                    except Exception:
+                        pass
+                    finally:
+                        rb.close()
+
+                threading.Thread(target=serve, daemon=True).start()
+                return ra
+            return dial
+
+        scheds = []
+        for i in range(3):
+            m = Membership(suspect_after=3, dead_after=6)
+            for j in range(3):
+                if j != i:
+                    m.add(f"n{j}")
+            scheds.append(GossipScheduler(nodes[i], m, make_dialer(i),
+                                          fanout=2, session_timeout_s=30.0,
+                                          seed=i))
+        return nodes, scheds
+
+    results = {}
+    for mode in (True, False):
+        nodes, scheds = build(mode)
+        for _ in range(4):
+            for sched in scheds:
+                sched.run_round()
+            digests = [n.digest() for n in nodes]
+            if all(np.array_equal(d, digests[0]) for d in digests[1:]):
+                break
+        digests = [n.digest() for n in nodes]
+        assert all(np.array_equal(d, digests[0]) for d in digests[1:]), \
+            f"fleet (tree={mode}) did not converge under loss"
+        results[mode] = digests[0]
+    # descent-mode fleet == flat-mode fleet, byte for byte
+    assert np.array_equal(results[True], results[False])
+
+
+# ---- digest memoization ----------------------------------------------------
+
+
+def test_second_idle_sync_runs_zero_digest_kernels(monkeypatch):
+    from crdt_tpu.utils import tracing
+
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(40, seed=61, actor=1,
+                                              extra_on=[2]), uni)
+    b = OrswotBatch.from_scalar(_orswot_fleet(40, seed=61, actor=2), uni)
+    calls = {"n": 0}
+    real = sync_digest._compute_digest
+
+    def counting(batch, universe):
+        calls["n"] += 1
+        return real(batch, universe)
+
+    monkeypatch.setattr(sync_digest, "_compute_digest", counting)
+    sa, sb = (SyncSession(x, uni, digest_tree=True) for x in (a, b))
+    ra, _ = sync_pair(sa, sb)
+    assert ra.converged
+    assert calls["n"] > 0
+    # second, idle sync over the SAME (converged) batch objects: the
+    # memo keyed on the plane version stamp serves everything
+    calls["n"] = 0
+    before = dict(tracing.counters())
+    sa2 = SyncSession(sa.batch, uni, digest_tree=True)
+    sb2 = SyncSession(sb.batch, uni, digest_tree=True)
+    ra2, _ = sync_pair(sa2, sb2)
+    assert ra2.converged and ra2.diverged == 0
+    assert calls["n"] == 0, "idle re-sync re-ran a digest kernel"
+    deltas = {k: v - before.get(k, 0) for k, v in tracing.counters().items()}
+    assert deltas.get("sync.digest.cache.hit", 0) >= 2
+    assert deltas.get("sync.digest.cache.miss", 0) == 0
+    # flat idle re-sync hits the same memo
+    calls["n"] = 0
+    fa2, fb2 = SyncSession(sa.batch, uni), SyncSession(sb.batch, uni)
+    rf, _ = sync_pair(fa2, fb2)
+    assert rf.converged and calls["n"] == 0
+
+
+def test_digest_cache_invalidates_on_new_batch_and_interning():
+    uni = _uni()
+    a = OrswotBatch.from_scalar(_orswot_fleet(20, seed=71), uni)
+    d1 = sync_digest.digest_of(a, uni)
+    assert sync_digest.digest_of(a, uni) is d1  # pure hit
+    grown = a.with_capacity(member_capacity=32, deferred_capacity=8)
+    d2 = sync_digest.digest_of(grown, uni)     # new object -> recompute
+    assert d2 is not d1 and np.array_equal(d1, d2)
+
+    # interned universes: interning a NEW name changes the salt key, so
+    # a stale salt table can never be served
+    u1, u2, actors, members = _interleaved_universes()
+    b1 = OrswotBatch.from_scalar(_named_fleet(10, actors, members), u1)
+    before = sync_digest.digest_of(b1, u1)
+    u1.members.intern("brand-new-name")  # table grows; key changes
+    again = sync_digest.digest_of(b1, u1)
+    assert again is not before
+    assert np.array_equal(before, again)  # the name is unused: same lanes
+
+
+# ---- workload generator ----------------------------------------------------
+
+
+def test_workloadgen_deterministic_and_bursty():
+    g1 = WorkloadGen(500, seed=3, zipf_s=1.1, burst_len=5)
+    g2 = WorkloadGen(500, seed=3, zipf_s=1.1, burst_len=5)
+    a = g1.draw(37)
+    # chunked draws see the same stream (bursts carry across calls)
+    b = np.concatenate([g2.draw(10), g2.draw(20), g2.draw(7)])
+    assert np.array_equal(a, b)
+    # fixed-length bursts
+    full = WorkloadGen(500, seed=4, burst_len=5).draw(50).reshape(10, 5)
+    assert all(len(set(row.tolist())) == 1 for row in full)
+
+
+def test_workloadgen_zipf_skew_and_clustering():
+    uniform = WorkloadGen(10_000, seed=9).draw(5000)
+    skewed = WorkloadGen(10_000, seed=9, zipf_s=1.3).draw(5000)
+    # skew concentrates mass on the low ranks
+    assert np.median(skewed) < np.median(uniform) / 4
+    # and clusters divergence into fewer k-ary subtrees — the tree
+    # bench's "hot keys are descent's best case" claim
+    k = 64
+    u_rows = WorkloadGen(10_000, seed=11).sample_rows(k)
+    z_rows = WorkloadGen(10_000, seed=11, zipf_s=1.3).sample_rows(k)
+    assert u_rows.shape == z_rows.shape == (k,)
+    assert len(set(u_rows.tolist())) == k  # distinct
+    assert len(set(z_rows.tolist())) == k
+    subtrees = lambda rows: len(set((rows // 16).tolist()))  # noqa: E731
+    assert subtrees(z_rows) < subtrees(u_rows)
+
+
+def test_workloadgen_validation():
+    with pytest.raises(ValueError):
+        WorkloadGen(0)
+    with pytest.raises(ValueError):
+        WorkloadGen(10, zipf_s=-1.0)
+    with pytest.raises(ValueError):
+        WorkloadGen(10, burst_len=0)
+    assert WorkloadGen(5, seed=1).sample_rows(99).shape == (5,)

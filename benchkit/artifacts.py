@@ -138,6 +138,11 @@ def counter_family(name: str) -> str:
         # reclaims nothing, so individual leaves vanishing must not
         # warn — only GC disappearing wholesale is the signal
         return "gc"
+    if parts[0] == "durable":
+        # same shape as gc: a run without a crash legitimately never
+        # tears a WAL or falls back a generation — only the durability
+        # layer disappearing wholesale is the signal
+        return "durable"
     if "fallback_reason" in parts:
         return ".".join(parts[:parts.index("fallback_reason")])
     if "rejected" in parts[:-1]:

@@ -48,6 +48,14 @@ WIRE_MODULES = (
     # and consumes the digest frames' version vectors; its (rare)
     # decode-adjacent paths are held to the same error contract
     "crdt_tpu/gc/",
+    # the durable layer's snapshot/WAL decode paths parse disk bytes
+    # that kill -9 may have torn mid-write — exactly the hostile-input
+    # shape the wire contract exists for: CheckpointFormatError (a
+    # CrdtError), never a bare zipfile/struct/ValueError leak
+    "crdt_tpu/durable/",
+    # the seed-level checkpoint loader doubles as the state-replication
+    # receive path AND the snapshot store's payload decoder
+    "crdt_tpu/utils/checkpoint.py",
     # the fleet-observatory snapshot codec rides the same envelope
     # discipline as the sync frames, so its decode paths are held to
     # the same error contract
@@ -80,6 +88,7 @@ _CRDT_ERRORS = {
     "NestedOpFailed", "TransportError", "SyncTimeoutError",
     "PeerUnavailableError", "TransportClosedError", "TransportFrameError",
     "OpLogOverflowError", "UnsupportedBackendError",
+    "DurabilityError", "CheckpointFormatError",
 }
 
 

@@ -17,7 +17,18 @@ flight recorder; everything that fails speaks the
 runtime" documents the defaults and the knobs.
 """
 
-from .faults import FaultPlan, FaultyTransport, FlappingDialer  # noqa: F401
+from .faults import (  # noqa: F401
+    CrashPlan,
+    CrashState,
+    FaultPlan,
+    FaultyTransport,
+    FlappingDialer,
+    InjectedCrash,
+    TornWriter,
+    arm_crashes,
+    crash_point,
+    disarm_crashes,
+)
 from .gossip import (  # noqa: F401
     ClusterNode,
     GossipScheduler,
@@ -48,9 +59,16 @@ __all__ = [
     "SUSPECT",
     "CallableTransport",
     "ClusterNode",
+    "CrashPlan",
+    "CrashState",
     "FaultPlan",
     "FaultyTransport",
     "FlappingDialer",
+    "InjectedCrash",
+    "TornWriter",
+    "arm_crashes",
+    "crash_point",
+    "disarm_crashes",
     "GossipScheduler",
     "Membership",
     "PeerInfo",

@@ -26,13 +26,28 @@ subset of connection attempts fail with
 :class:`~crdt_tpu.error.PeerUnavailableError`, which is what drives a
 peer through the alive → suspect → dead → probed → alive membership
 cycle in the acceptance test.
+
+**Crash + disk faults** (the durability layer's adversary): the
+runtime calls :func:`crash_point` at its kill -9-shaped moments —
+session start (``cluster.session``), the op fold after the in-memory
+log drained (``oplog.fold``), the checkpoint pass
+(``durable.checkpoint``), the WAL append (``durable.wal.append``),
+and the instant before a snapshot renames into place
+(``durable.snapshot.pre_rename``).  Unarmed, a point is one dict-is-
+None check; armed via :func:`arm_crashes`, the scheduled invocation
+raises :class:`InjectedCrash` — a ``BaseException``, so the cleanup
+``except Exception`` blocks that would NOT run under a real SIGKILL
+cannot swallow it either.  :class:`TornWriter` is the disk half: it
+wraps the snapshot store's byte writer and truncates a scheduled
+write, modeling the short write a dying kernel leaves behind.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import random
-from typing import Optional, Sequence
+import threading
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 from ..error import PeerUnavailableError, TransportClosedError
 from ..utils import tracing
@@ -170,3 +185,142 @@ class FlappingDialer:
                 f"injected dial refusal (attempt {self._calls})"
             )
         return self._dial(peer)
+
+
+# ---- crash injection (the durability layer's kill -9) ----------------------
+
+
+class InjectedCrash(BaseException):
+    """An in-process stand-in for kill -9.
+
+    Deliberately a ``BaseException``: a real SIGKILL runs no cleanup,
+    so the ``except Exception`` recovery paths that would mask a crash
+    (session error handlers, listener loops) must not be able to
+    swallow the injected one either — it unwinds to the test harness,
+    which abandons the node object exactly as the OS would and
+    restarts it from disk."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashPlan:
+    """Which :func:`crash_point` invocation dies: ``{point_name: k}``
+    crashes the k-th (1-based) hit of each named point.  Points not
+    named never fire; an armed plan is process-global (the soak owns
+    the process) and one-shot per point."""
+
+    at: Mapping[str, int]
+
+    def __post_init__(self):
+        for name, k in self.at.items():
+            if k < 1:
+                raise ValueError(
+                    f"CrashPlan point {name!r} schedules hit {k} < 1")
+
+
+class CrashState:
+    """Bookkeeping for one armed :class:`CrashPlan`: per-point hit
+    counts and which points already fired (each fires once — a crashed
+    "process" is replaced, not resumed)."""
+
+    def __init__(self, plan: CrashPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._fired: List[str] = []
+
+    @property
+    def fired(self) -> List[str]:
+        with self._lock:
+            return list(self._fired)
+
+    def hit(self, name: str) -> bool:
+        """Count one hit of ``name``; True when this hit is scheduled
+        to crash (and has not fired before)."""
+        scheduled = self.plan.at.get(name)
+        with self._lock:
+            self._hits[name] = self._hits.get(name, 0) + 1
+            if scheduled is None or name in self._fired:
+                return False
+            if self._hits[name] != scheduled:
+                return False
+            self._fired.append(name)
+            return True
+
+
+_crash_state: Optional[CrashState] = None
+
+
+def arm_crashes(plan: CrashPlan) -> CrashState:
+    """Arm ``plan`` process-wide; returns the state for assertions.
+    Always pair with :func:`disarm_crashes` (a try/finally in the
+    test) — a leaked plan crashes unrelated tests."""
+    global _crash_state
+    state = CrashState(plan)
+    _crash_state = state
+    return state
+
+
+def disarm_crashes() -> None:
+    global _crash_state
+    _crash_state = None
+
+
+def crash_point(name: str) -> None:
+    """A kill -9-shaped moment in the runtime: no-op unless a
+    :class:`CrashPlan` schedules this invocation, in which case it
+    raises :class:`InjectedCrash` (counted under
+    ``cluster.faults.crash`` — nonzero outside tests means a plan
+    leaked into production wiring)."""
+    state = _crash_state
+    if state is None:
+        return
+    if state.hit(name):
+        tracing.count("cluster.faults.crash")
+        raise InjectedCrash(f"injected kill -9 at crash point {name!r}")
+
+
+# ---- disk faults (torn / short writes) -------------------------------------
+
+
+class TornWriter:
+    """A snapshot byte-writer whose k-th write is torn.
+
+    Wraps any ``writer(path, data)`` (the :class:`crdt_tpu.durable.
+    snapshot.SnapshotStore` hook): write number ``at_write`` (1-based)
+    persists only the first ``keep_frac`` of its bytes — the short
+    write a dying kernel leaves behind.  The truncated file still
+    renames into place, so the store's CRC/length checks (not the
+    filesystem) are what must catch it; injections count under
+    ``cluster.faults.torn_write``."""
+
+    def __init__(self, inner: Callable[[str, bytes], None], *,
+                 at_write: int = 1, keep_frac: float = 0.5):
+        if not 0.0 <= keep_frac < 1.0:
+            raise ValueError(f"keep_frac {keep_frac} not in [0, 1)")
+        if at_write < 1:
+            raise ValueError(f"at_write {at_write} < 1")
+        self._inner = inner
+        self.at_write = int(at_write)
+        self.keep_frac = float(keep_frac)
+        self._lock = threading.Lock()
+        self._calls = 0
+        self.injected = 0
+
+    @property
+    def calls(self) -> int:
+        """Writes seen so far — ``writer.at_write = writer.calls + 1``
+        schedules the NEXT write to tear (``at_write`` is mutable for
+        exactly this)."""
+        with self._lock:
+            return self._calls
+
+    def __call__(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._calls += 1
+            torn = self._calls == self.at_write
+            if torn:
+                self.injected += 1
+        if torn:
+            tracing.count("cluster.faults.torn_write")
+            data = data[: int(len(data) * self.keep_frac)]
+        self._inner(path, data)

@@ -44,6 +44,7 @@ from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
 from ..sync.session import SyncReport, SyncSession
 from ..utils import tracing
+from . import faults as faults_mod
 from . import membership as membership_mod
 from .transport import Transport
 
@@ -102,11 +103,20 @@ class ClusterNode:
                  oplog=None,
                  capacity_tracker=None,
                  gc=None,
-                 digest_tree: bool = False):
+                 digest_tree: bool = False,
+                 durability=None,
+                 applier=None):
         self.node_id = node_id
         self.universe = universe
         self.full_state_threshold = full_state_threshold
         self.busy_timeout_s = busy_timeout_s
+        #: a :class:`crdt_tpu.durable.Durability`; when set, every
+        #: ingested op batch is WAL-appended BEFORE the in-memory fold
+        #: (a write acknowledged to the caller survives kill -9), and
+        #: the gossip scheduler runs :meth:`checkpoint` at round end on
+        #: the manager's cadence — same busy-lock discipline as GC:
+        #: never concurrent with a session, skipped when one runs
+        self.durability = durability
         #: advertise the digest-tree capability (sync protocol v3) in
         #: every session this node runs: peers that also advertise it
         #: replace the flat O(N) digest exchange with the subtree
@@ -132,10 +142,20 @@ class ClusterNode:
         #: OpLog`); pass one to bound/observe it, or leave None — the
         #: first :meth:`submit_ops` creates a default
         self._oplog = oplog
-        self._applier = None
+        #: the op fold's causal-gap applier; pass the one
+        #: :func:`crdt_tpu.durable.recover` returns when rebuilding a
+        #: crashed node — it carries the ops still parked at snapshot
+        #: time, which exist nowhere else until their gaps close
+        self._applier = applier
         self._lock = threading.Lock()   # guards batch + last_report
         self._busy = threading.Lock()   # serializes whole sessions
         self._mint = threading.Lock()   # serializes dot minting
+        # serializes (WAL append, log append) pairs against the
+        # checkpoint's wal_seq capture: with the pair atomic w.r.t. the
+        # capture, every frame below the captured sequence is in the
+        # in-memory log by drain time — the replay-bound invariant
+        # (crdt_tpu/durable/manager.py module docstring)
+        self._ingest = threading.Lock()
         self._batch = batch
         self._last_report: Optional[SyncReport] = None
         self._last_gc_report = None
@@ -201,7 +221,18 @@ class ClusterNode:
                 f"got {type(ops).__name__}"
             )
         log = self._ensure_oplog()
-        log.append(ops)
+        if self.durability is not None and len(ops):
+            # write-AHEAD: the ops hit fsync'd disk before the
+            # in-memory log, inside the ingest critical section the
+            # checkpoint's wal_seq capture synchronizes with.  Ingest
+            # is at-least-once — a crash (or a log-overflow raise)
+            # after the WAL append may replay ops the caller saw
+            # rejected, which batched apply dedups (CmRDT idempotence)
+            with self._ingest:
+                self.durability.wal_append(ops)
+                log.append(ops)
+        else:
+            log.append(ops)
         if self._busy.acquire(blocking=False):
             try:
                 self._drain_ops_locked()
@@ -274,6 +305,12 @@ class ClusterNode:
         # session that just ended may have synced in exactly the
         # predecessor dots a parked add was waiting for
         ops = log.drain()
+        # mid-fold kill -9 shape: the drained ops exist only in this
+        # frame's locals (and, on a durable node, in the WAL — which is
+        # why recovery replays them).  The node-scoped name lets a
+        # multi-node in-process soak kill ONE replica deterministically
+        faults_mod.crash_point("oplog.fold")
+        faults_mod.crash_point(f"oplog.fold.{self.node_id}")
         with self._lock:
             batch = self._batch
         batch, report = self._applier.apply_ops(batch, ops)
@@ -295,11 +332,23 @@ class ClusterNode:
 
     def _op_sink(self, frame: bytes) -> None:
         """Session piggyback sink: peer ops queue like any other write
-        and fold at the session-tail drain."""
+        and fold at the session-tail drain — WAL'd first (the frame
+        bytes verbatim: the wire codec IS the WAL codec) when the node
+        is durable, so a peer write this node acknowledged by folding
+        survives its own kill -9 without waiting for the peer's next
+        round."""
         from ..oplog.wire import decode_ops_frame
 
-        self._ensure_oplog().append(decode_ops_frame(
-            bytes(frame), num_actors=self.universe.config.num_actors))
+        frame = bytes(frame)
+        ops = decode_ops_frame(
+            frame, num_actors=self.universe.config.num_actors)
+        log = self._ensure_oplog()
+        if self.durability is not None and len(ops):
+            with self._ingest:
+                self.durability.wal_append(frame)
+                log.append(ops)
+        else:
+            log.append(ops)
 
     def _run_session(self, peer_label: str, transport: Transport
                      ) -> SyncReport:
@@ -309,6 +358,8 @@ class ClusterNode:
                 f">{self.busy_timeout_s:.1f}s, refusing session with "
                 f"{peer_label}"
             )
+        faults_mod.crash_point("cluster.session")
+        faults_mod.crash_point(f"cluster.session.{self.node_id}")
         try:
             op_hooks = {}
             if self._oplog is not None:
@@ -364,6 +415,55 @@ class ClusterNode:
                 self._batch = batch
                 self._last_gc_report = report
             return report
+        finally:
+            self._busy.release()
+
+    @property
+    def last_snapshot(self):
+        """The most recent checkpoint's
+        :class:`~crdt_tpu.durable.Snapshot` (None until one ran)."""
+        return self.durability.last_snapshot \
+            if self.durability is not None else None
+
+    def checkpoint(self):
+        """Run one durability checkpoint on this node: capture the WAL
+        replay bound under the ingest lock, fold pending ops, then
+        snapshot the planes + parked ops + version vector + GC
+        watermark (:meth:`crdt_tpu.durable.Durability.checkpoint`).
+
+        Returns the :class:`~crdt_tpu.durable.Snapshot`, or None when
+        no durability manager is configured or a sync session holds
+        the busy lock — a checkpoint never runs concurrently with a
+        session on the same node (it retries next round instead of
+        queueing), the same non-blocking discipline as
+        :meth:`collect_garbage`."""
+        if self.durability is None:
+            return None
+        if not self._busy.acquire(blocking=False):
+            return None
+        try:
+            # capture BEFORE the drain: every WAL frame below this
+            # sequence has completed its log append (the ingest lock
+            # makes the pair atomic), so the drain folds it into the
+            # snapshot; frames at or above it replay on recovery —
+            # possibly redundantly, which batched apply dedups
+            with self._ingest:
+                wal_seq = self.durability.wal.head_seq
+            self._drain_ops_locked()
+            with self._lock:
+                batch = self._batch
+                gc_report = self._last_gc_report
+            parked = None
+            if self._applier is not None and len(self._applier.parked):
+                parked = self._applier.parked
+            watermark = None
+            if gc_report is not None and gc_report.watermark is not None:
+                watermark = gc_report.watermark.clock
+            faults_mod.crash_point(f"durable.checkpoint.{self.node_id}")
+            return self.durability.checkpoint(
+                batch, self.universe, wal_seq=wal_seq,
+                watermark=watermark, parked=parked,
+                node_id=self.node_id)
         finally:
             self._busy.release()
 
@@ -572,6 +672,14 @@ class GossipScheduler:
                 # occupancy gauges on the post-GC state (and re-seed
                 # the EWMA on a capacity change)
                 self.node.sample_capacity()
+        # durability checkpoint at round end, AFTER GC: the snapshot
+        # then captures the settled/re-packed planes and the freshest
+        # watermark clock.  Non-blocking like GC — a session racing in
+        # just defers the checkpoint one round (the WAL already holds
+        # every write, so deferral risks nothing)
+        if self.node.durability is not None \
+                and self.node.durability.due(round_no):
+            self.node.checkpoint()
         return report
 
     def _publish_round_health(self, report: RoundReport) -> None:

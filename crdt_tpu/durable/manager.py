@@ -1,0 +1,143 @@
+"""Durability — the per-node policy object ``ClusterNode(durability=)``.
+
+One :class:`Durability` owns one node's durable directory::
+
+    <dir>/snapshots/snap-<generation>.crdtsnap   retained generations
+    <dir>/wal/wal-<first_seq>.log                op-frame segments
+
+and wires the two stores into the node's lifecycle:
+
+* **ingest** — :meth:`wal_append` runs inside the node's ingest
+  critical section BEFORE the op enters the in-memory log, so a write
+  acknowledged to the caller is on disk first (write-AHEAD);
+* **checkpoint** — :meth:`checkpoint` runs at gossip-round end on the
+  engine's cadence (:meth:`due`), under the node's busy lock — the
+  same non-blocking discipline as GC: never concurrent with a session,
+  skipped (not queued) when one is running.  One pass captures the WAL
+  head, drains pending ops (the caller does, pre-call), snapshots the
+  planes + parked ops, rolls the WAL and truncates segments the
+  snapshot covers — so WAL growth is bounded by one checkpoint
+  interval of writes;
+* **recovery** — :func:`crdt_tpu.durable.recover` (module level; it
+  runs before any node exists).
+
+The replay-bound invariant the ingest lock buys: the checkpoint
+captures ``wal_seq`` while no writer is between its WAL append and its
+log append, so every frame below the captured sequence is in the
+in-memory log by then and folds into the snapshot's batch; every frame
+at or above it replays on recovery.  Replaying a frame the snapshot
+already folded is a no-op (CmRDT idempotence), so the bound only has
+to be conservative — ingest is at-least-once, never at-most-once.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..utils import tracing
+from .snapshot import Snapshot, SnapshotStore
+from .wal import WalWriter
+from .recover import SNAPSHOT_SUBDIR, WAL_SUBDIR
+
+
+class Durability:
+    """Snapshot + WAL policy for one cluster node.
+
+    ``interval_rounds`` — checkpoint every Nth gossip round (1 = every
+    round).  ``retain`` — snapshot generations kept (>= 2 keeps a
+    fallback behind a torn newest).  ``fsync`` — gate the disk syncs
+    (leave on outside benchmarks).  ``segment_bytes`` — WAL segment
+    roll size.  ``writer`` — snapshot byte-writer hook
+    (:class:`crdt_tpu.cluster.faults.TornWriter` wraps it in tests).
+    """
+
+    def __init__(self, dirpath, *, interval_rounds: int = 1,
+                 retain: int = 2, fsync: bool = True,
+                 segment_bytes: int = 4 << 20, writer=None):
+        if interval_rounds < 1:
+            raise ValueError(f"interval_rounds {interval_rounds} < 1")
+        self.dirpath = os.fspath(dirpath)
+        self.interval_rounds = int(interval_rounds)
+        self.store = SnapshotStore(
+            os.path.join(self.dirpath, SNAPSHOT_SUBDIR),
+            retain=retain, fsync=fsync, writer=writer)
+        self.wal = WalWriter(
+            os.path.join(self.dirpath, WAL_SUBDIR),
+            segment_bytes=segment_bytes, fsync=fsync)
+        self.snapshots_written = 0
+        self.last_snapshot: Optional[Snapshot] = None
+        self._last_snapshot_monotonic: Optional[float] = None
+
+    # -- ingest --------------------------------------------------------------
+
+    def wal_append(self, ops_or_frame) -> int:
+        """Append one op batch (or an already-encoded op frame — the
+        session piggyback sink passes its bytes through verbatim) to
+        the WAL.  Returns the frame's sequence; once this returns, the
+        ops survive kill -9."""
+        return self.wal.append(ops_or_frame)
+
+    # -- cadence -------------------------------------------------------------
+
+    def due(self, round_no: int) -> bool:
+        """Whether the round-end hook should checkpoint this round.
+        Also refreshes the age/depth gauges, so a fleet with a long
+        cadence still reports how stale its newest snapshot is."""
+        self.publish_gauges()
+        return round_no % self.interval_rounds == 0
+
+    @property
+    def snapshot_age_s(self) -> Optional[float]:
+        if self._last_snapshot_monotonic is None:
+            return None
+        return time.monotonic() - self._last_snapshot_monotonic
+
+    def publish_gauges(self) -> None:
+        from ..obs import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
+        age = self.snapshot_age_s
+        if age is not None:
+            reg.gauge_set("durable.snapshot.age_s", round(age, 3))
+        frames, nbytes = self.wal.pending()
+        reg.gauge_set("durable.wal.depth", frames)
+        reg.gauge_set("durable.wal.pending_bytes", nbytes)
+
+    # -- checkpoint ----------------------------------------------------------
+
+    def checkpoint(self, batch, universe, *, wal_seq: Optional[int] = None,
+                   watermark=None, parked=None,
+                   node_id: str = "") -> Snapshot:
+        """One checkpoint pass: write the next snapshot generation
+        atomically, roll the WAL, truncate segments the snapshot
+        covers.  ``wal_seq`` is the replay bound the caller captured
+        under its ingest lock (defaults to the WAL head NOW — only
+        safe when no writer is concurrent, e.g. single-threaded
+        drivers).  The caller holds the node's busy lock; see the
+        module docstring for the invariant."""
+        from ..cluster import faults as cluster_faults
+
+        with tracing.span("durable.checkpoint"):
+            cluster_faults.crash_point("durable.checkpoint")
+            if wal_seq is None:
+                wal_seq = self.wal.head_seq
+            snap = self.store.write(
+                batch, universe, wal_seq=wal_seq, watermark=watermark,
+                parked=parked, node_id=node_id)
+            # roll so truncation operates on closed files only, then
+            # truncate below the OLDEST retained generation's sequence
+            # — not this snapshot's: if this one turns out torn on
+            # disk, recovery falls back a generation and must still
+            # find that generation's replay window in the WAL
+            self.wal.roll()
+            self.wal.truncate_below(self.store.wal_floor())
+        self.snapshots_written += 1
+        self.last_snapshot = snap
+        self._last_snapshot_monotonic = time.monotonic()
+        self.publish_gauges()
+        return snap
+
+    def close(self) -> None:
+        self.wal.close()

@@ -1,0 +1,420 @@
+"""Snapshot store — versioned+CRC durable generations of one replica.
+
+The seed-level checkpoint (:mod:`crdt_tpu.utils.checkpoint`) answers
+"serialize these planes"; this module answers "survive kill -9": every
+generation is one self-verifying file under the sync/delta envelope
+discipline — a magic, a 1-byte format version so a mixed-version
+restore fails loudly, a CRC32 of the payload so torn/truncated/
+bit-flipped files are a clean :class:`~crdt_tpu.error.
+CheckpointFormatError` (never a crash in the npz parser), and an
+atomic write-temp-fsync-rename into place so a crash mid-checkpoint
+can only ever leave the PREVIOUS generation visible, never a half
+file under the live name.
+
+File layout (all little-endian)::
+
+    magic(8 = b"CRDTSNAP") | version(1) | type(1) | crc32(4)
+    | payload_len(8) | payload
+
+The payload is one serde blob carrying the batch checkpoint
+(:func:`crdt_tpu.utils.checkpoint.save_bytes` — dense planes + intern
+tables), the fleet version vector, the GC watermark clock last
+computed, any causally-parked ops (the gap buffer is state too — a
+parked add may exist nowhere else), the WAL sequence the snapshot is
+current through, and the digest-tree ROOT of the planes at save time.
+A restore recomputes the root from the restored planes
+(:func:`crdt_tpu.sync.digest.digest_tree_of` — name-keyed salts make
+it process-independent) and rejects on mismatch: a snapshot that
+passes :meth:`SnapshotStore.load` is byte-exactly the state that was
+saved, proven by the same oracle the sync sessions converge on.
+
+Generations are retained newest-N (``retain``); :meth:`SnapshotStore.
+load_latest` walks them newest-first and falls back PAST a rejected
+generation — loudly (``durable.snapshot.rejected.*`` counters +
+flight-recorder events), raising :class:`~crdt_tpu.error.
+DurabilityError` only when every retained generation is bad.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import zlib
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..error import CheckpointFormatError, DurabilityError
+from ..utils import checkpoint as checkpoint_mod
+from ..utils import serde, tracing
+
+#: leads every snapshot file; a file without it is not a snapshot
+SNAPSHOT_MAGIC = b"CRDTSNAP"
+
+#: bumped whenever the snapshot grammar changes; a restore across a
+#: version skew must fail loudly at the header, never misparse
+SNAPSHOT_VERSION = 1
+
+#: frame type byte — disjoint from the sync (0x01-0x07), fleet (0x21)
+#: and ops (0x31) codecs, so a snapshot routed into the wrong decoder
+#: rejects on type, not CRC luck
+FRAME_SNAPSHOT = 0x41
+
+_HEADER = struct.Struct("<BBIQ")  # version | type | crc32 | payload_len
+
+_SNAP_PREFIX = "snap-"
+_SNAP_SUFFIX = ".crdtsnap"
+
+
+def _reject(reason: str, message: str) -> CheckpointFormatError:
+    """A :class:`CheckpointFormatError` carrying flight-recorder
+    evidence (the :func:`crdt_tpu.sync.delta._reject` discipline):
+    counter + event before the raise, so a bad generation is visible on
+    ``/events`` even when recovery catches it and falls back."""
+    from ..obs import events as obs_events
+
+    tracing.count(f"durable.snapshot.rejected.{reason}")
+    obs_events.record("durable.snapshot_rejected", reason=reason,
+                      error=message[:200])
+    return CheckpointFormatError(message)
+
+
+def default_writer(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` and fsync it — the durable half of
+    the write-temp-then-rename dance.  Injectable (the ``writer``
+    knob) so :class:`crdt_tpu.cluster.faults.TornWriter` can model
+    short writes without touching this module."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """fsync the directory so the rename itself is durable (a crash
+    right after ``os.replace`` must not resurrect the old file)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return  # platforms without directory fds: best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """One decoded (and root-verified) snapshot generation."""
+
+    batch: object
+    universe: object
+    generation: int
+    wal_seq: int                       # WAL frames before this are folded in
+    root: int                          # digest-tree root at save time
+    vv: np.ndarray                     # fleet version vector (uint64, flat)
+    watermark: Optional[np.ndarray]    # GC watermark clock, if one existed
+    parked: Optional[object]           # causally-parked OpBatch, if any
+    node_id: str = ""
+    nbytes: int = 0                    # file size on disk
+
+
+class SnapshotStore:
+    """Retained-generation snapshot files under one directory.
+
+    ``retain`` keeps the newest N generations (>= 2, so a torn newest
+    always has a fallback); ``fsync`` gates the data/dir syncs (leave
+    on outside benchmarks — an unsynced snapshot is a wish, not a
+    checkpoint); ``writer`` is the byte-writing hook fault injection
+    wraps.  Thread-safety: callers serialize writes (the cluster node
+    checkpoints under its busy lock); reads are safe any time because
+    visible files are only ever complete, renamed-in generations.
+    """
+
+    def __init__(self, dirpath, *, retain: int = 2, fsync: bool = True,
+                 writer: Optional[Callable[[str, bytes], None]] = None):
+        if retain < 1:
+            raise ValueError(f"retain {retain} < 1")
+        self.dirpath = os.fspath(dirpath)
+        self.retain = int(retain)
+        self.fsync = bool(fsync)
+        self._writer = writer if writer is not None else (
+            default_writer if fsync else _plain_writer)
+        os.makedirs(self.dirpath, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+    #
+    # filenames carry both the generation AND the WAL sequence the
+    # snapshot is current through (snap-<gen>-w<seq>.crdtsnap): WAL
+    # truncation must keep frames back to the OLDEST retained
+    # generation — the newest may be the one that turns out torn — and
+    # reading that floor must not cost a full payload decode per file.
+
+    def _path(self, generation: int, wal_seq: int) -> str:
+        return os.path.join(
+            self.dirpath,
+            f"{_SNAP_PREFIX}{generation:010d}-w{wal_seq:010d}{_SNAP_SUFFIX}")
+
+    def _entries(self) -> List[Tuple[int, int, str]]:
+        """``(generation, wal_seq, path)`` for every retained file,
+        generation-ascending.  Temp files from a crashed mid-write
+        checkpoint are invisible here (and harmless: the next
+        successful write replaces them)."""
+        out = []
+        for name in os.listdir(self.dirpath):
+            if not (name.startswith(_SNAP_PREFIX)
+                    and name.endswith(_SNAP_SUFFIX)):
+                continue
+            body = name[len(_SNAP_PREFIX):-len(_SNAP_SUFFIX)]
+            gen_part, sep, seq_part = body.partition("-w")
+            if sep and gen_part.isdigit() and seq_part.isdigit():
+                out.append((int(gen_part), int(seq_part),
+                            os.path.join(self.dirpath, name)))
+        return sorted(out)
+
+    def generations(self) -> List[int]:
+        """Retained generation numbers, ascending."""
+        return [gen for gen, _, _ in self._entries()]
+
+    def path_of(self, generation: int) -> str:
+        """The on-disk path of one retained generation."""
+        for gen, _, path in self._entries():
+            if gen == generation:
+                return path
+        raise FileNotFoundError(
+            f"no retained snapshot generation {generation} under "
+            f"{self.dirpath}")
+
+    def wal_floor(self) -> int:
+        """The smallest ``wal_seq`` across retained generations — the
+        sequence WAL truncation must keep frames from, so a fallback
+        past a torn newest generation still finds its replay window
+        (0 when the store is empty)."""
+        entries = self._entries()
+        return min((seq for _, seq, _ in entries), default=0)
+
+    # -- write ---------------------------------------------------------------
+
+    def write(self, batch, universe, *, wal_seq: int = 0,
+              watermark=None, parked=None, node_id: str = "") -> Snapshot:
+        """Write the next generation atomically and prune old ones.
+
+        ``wal_seq`` is the WAL frame sequence this state is current
+        through (every frame below it is folded into ``batch`` or
+        carried in ``parked``); ``watermark`` is the GC fleet
+        low-watermark clock to persist (restores GC's stability
+        frontier across the restart); ``parked`` is the op applier's
+        causally-parked batch — state that lives nowhere else until
+        its causal gap closes.
+        """
+        from ..sync import digest as digest_mod
+
+        gens = self.generations()
+        generation = (gens[-1] + 1) if gens else 1
+        vv = digest_mod.version_vector(batch)
+        vv = (np.zeros(0, np.uint64) if vv is None
+              else np.asarray(vv, np.uint64).reshape(-1))
+        root = int(digest_mod.digest_tree_of(batch, universe).root)
+        parked_frame = None
+        if parked is not None and len(parked):
+            from ..oplog.wire import encode_ops_frame
+
+            parked_frame = encode_ops_frame(parked)
+        payload = serde.to_binary({
+            "generation": generation,
+            "wal_seq": int(wal_seq),
+            "root": root,
+            "vv": [int(x) for x in vv],
+            "watermark": (None if watermark is None
+                          else [int(x) for x in np.asarray(
+                              watermark, np.uint64).reshape(-1)]),
+            "parked": parked_frame,
+            "node": str(node_id),
+            "checkpoint": checkpoint_mod.save_bytes(batch, universe),
+        })
+        frame = SNAPSHOT_MAGIC + _HEADER.pack(
+            SNAPSHOT_VERSION, FRAME_SNAPSHOT, zlib.crc32(payload),
+            len(payload)) + payload
+
+        final = self._path(generation, int(wal_seq))
+        tmp = final + ".tmp"
+        self._writer(tmp, frame)
+        # the crash window the soak aims at: a kill here leaves only a
+        # .tmp file — the previous generation stays the visible truth
+        from ..cluster import faults as cluster_faults
+
+        cluster_faults.crash_point("durable.snapshot.pre_rename")
+        os.replace(tmp, final)
+        if self.fsync:
+            _fsync_dir(self.dirpath)
+        self._prune()
+        tracing.count("durable.snapshots")
+        from ..obs import events as obs_events
+        from ..obs import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
+        reg.gauge_set("durable.snapshot.generation", generation)
+        reg.gauge_set("durable.snapshot.bytes", len(frame))
+        obs_events.record("durable.checkpoint", node=node_id,
+                          generation=generation, bytes=len(frame),
+                          wal_seq=int(wal_seq))
+        return Snapshot(
+            batch=batch, universe=universe, generation=generation,
+            wal_seq=int(wal_seq), root=root, vv=vv,
+            watermark=(None if watermark is None
+                       else np.asarray(watermark, np.uint64).reshape(-1)),
+            parked=parked, node_id=node_id, nbytes=len(frame),
+        )
+
+    def _prune(self) -> None:
+        entries = self._entries()
+        for _, _, path in entries[:-self.retain]:
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+    # -- read ----------------------------------------------------------------
+
+    def load(self, generation: int) -> Snapshot:
+        """Decode AND verify one generation.  Raises
+        :class:`~crdt_tpu.error.CheckpointFormatError` on any fault —
+        torn file, CRC mismatch, version skew, npz corruption, or a
+        restored batch whose recomputed digest-tree root disagrees
+        with the recorded one."""
+        path = self.path_of(generation)
+        with open(path, "rb") as f:
+            data = f.read()
+        snap = decode_snapshot(data)
+        snap.generation = generation
+        snap.nbytes = len(data)
+        return snap
+
+    def load_latest(self) -> Optional[Snapshot]:
+        """The newest generation that decodes and verifies, falling
+        back PAST rejected ones — loudly (``durable.snapshot.
+        fallbacks``).  None when the store holds no generation at all
+        (a fresh replica); :class:`~crdt_tpu.error.DurabilityError`
+        when generations exist but every one is bad."""
+        from ..obs import events as obs_events
+
+        gens = self.generations()
+        last_err: Optional[Exception] = None
+        for generation in reversed(gens):
+            try:
+                return self.load(generation)
+            except CheckpointFormatError as e:
+                last_err = e
+                tracing.count("durable.snapshot.fallbacks")
+                obs_events.record(
+                    "durable.snapshot_fallback", generation=generation,
+                    error=str(e)[:200])
+        if gens:
+            raise DurabilityError(
+                f"all {len(gens)} retained snapshot generations rejected "
+                f"(newest error: {last_err})"
+            ) from last_err
+        return None
+
+
+def _plain_writer(path: str, data: bytes) -> None:
+    """The fsync-free writer (bench/test knob)."""
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def decode_snapshot(data: bytes) -> Snapshot:
+    """Decode one snapshot file's bytes into a verified
+    :class:`Snapshot`.  The decode path of the store, held to the wire
+    error contract: every fault speaks
+    :class:`~crdt_tpu.error.CheckpointFormatError`, with a
+    ``durable.snapshot.rejected.<reason>`` counter and a
+    flight-recorder event before the raise."""
+    from ..sync import digest as digest_mod
+
+    head_len = len(SNAPSHOT_MAGIC) + _HEADER.size
+    if len(data) < head_len:
+        raise _reject(
+            "truncated",
+            f"truncated snapshot: {len(data)} bytes < {head_len}-byte "
+            "header")
+    if data[:len(SNAPSHOT_MAGIC)] != SNAPSHOT_MAGIC:
+        raise _reject("bad_magic", "not a snapshot file (magic mismatch)")
+    version, ftype, crc, plen = _HEADER.unpack_from(
+        data, len(SNAPSHOT_MAGIC))
+    if version != SNAPSHOT_VERSION:
+        raise _reject(
+            "version_mismatch",
+            f"snapshot format version skew: file is v{version}, this "
+            f"build speaks v{SNAPSHOT_VERSION}")
+    if ftype != FRAME_SNAPSHOT:
+        raise _reject("unknown_type",
+                      f"unknown snapshot frame type {ftype:#04x}")
+    payload = data[head_len:]
+    if len(payload) != plen:
+        raise _reject(
+            "length_mismatch",
+            f"snapshot length mismatch: header says {plen} payload "
+            f"bytes, file carries {len(payload)} (torn write?)")
+    if zlib.crc32(payload) != crc:
+        raise _reject(
+            "crc_mismatch",
+            "snapshot CRC mismatch (torn or bit-flipped on disk)")
+
+    try:
+        meta = serde.from_binary(payload)
+    except ValueError as e:
+        raise _reject("bad_payload",
+                      f"snapshot payload undecodable: {e}") from None
+    if not isinstance(meta, dict) or "checkpoint" not in meta:
+        raise _reject("bad_payload",
+                      "snapshot payload is not a snapshot dict")
+    try:
+        batch, universe = checkpoint_mod.load_bytes(meta["checkpoint"])
+    except CheckpointFormatError as e:
+        raise _reject("bad_checkpoint",
+                      f"snapshot checkpoint blob rejected: {e}") from None
+
+    # the rejoin self-check: the restored planes must be digest-
+    # identical to the saved ones — the same tree-root oracle a sync
+    # session's converged check uses (sync/tree.py), so "this snapshot
+    # loaded" and "a peer would find this replica byte-exact" are the
+    # same statement
+    root = int(digest_mod.digest_tree_of(batch, universe).root)
+    want = meta.get("root")
+    if not isinstance(want, int) or root != want:
+        raise _reject(
+            "root_mismatch",
+            f"restored planes are not digest-identical to the snapshot "
+            f"(recomputed tree root {root:#018x}, recorded {want!r})")
+
+    parked = None
+    if meta.get("parked"):
+        from ..oplog.wire import decode_ops_frame
+
+        from ..error import CrdtError
+
+        try:
+            parked = decode_ops_frame(
+                bytes(meta["parked"]),
+                num_actors=universe.config.num_actors)
+        except (CrdtError, ValueError) as e:
+            # the op-frame codec speaks SyncProtocolError (envelope) /
+            # WireFormatError (grammar); inside a snapshot both mean
+            # "this generation is bad"
+            raise _reject(
+                "bad_parked",
+                f"snapshot parked-ops frame rejected: {e}") from None
+    vv = np.asarray(meta.get("vv", []), dtype=np.uint64).reshape(-1)
+    wm = meta.get("watermark")
+    tracing.count("durable.snapshot.decoded")
+    return Snapshot(
+        batch=batch, universe=universe,
+        generation=int(meta.get("generation", 0)),
+        wal_seq=int(meta.get("wal_seq", 0)), root=root, vv=vv,
+        watermark=(None if wm is None
+                   else np.asarray(wm, dtype=np.uint64).reshape(-1)),
+        parked=parked, node_id=str(meta.get("node", "")),
+        nbytes=len(data),
+    )

@@ -1,0 +1,293 @@
+"""Op-log write-ahead segments — the durability layer ABOVE the snapshot.
+
+Snapshots are cheap but not per-write; the WAL is: every op batch a
+node ingests (a local ``submit_ops``/``submit_writes``, a peer's
+session piggyback) is appended to the open segment as one encoded op
+frame — the same versioned+CRC 23 B/op columnar codec the sync
+piggyback ships (:mod:`crdt_tpu.oplog.wire`) — and fsync'd BEFORE the
+in-memory fold, so a kill -9 at any point loses nothing that was
+acknowledged.  Recovery replays the frames above the snapshot's
+recorded sequence through the normal causal-gap apply path
+(:class:`crdt_tpu.oplog.OpApplier`); replaying a frame the snapshot
+already folded is a no-op — batched ``apply`` is idempotent, the CmRDT
+contract — so the replay bound (the snapshot's ``wal_seq``) only has
+to be conservative, never exact.
+
+Segment files (``wal-<first_seq 10 digits>.log``) are a plain
+concatenation of op frames; every frame self-delimits through its
+header's payload length, so no index file exists to corrupt.  A torn
+tail — the expected shape after kill -9 mid-append — parses as "stop
+here": the complete prefix replays, the torn bytes are counted
+(``durable.wal.torn``) and event-logged, and whatever ops the torn
+frame carried come back through normal delta sync (they were never
+acknowledged as durable).  A CRC-corrupt frame BEFORE the tail stops
+replay the same loud way — everything after an undecodable frame is
+unreachable garbage, and the delta-sync catch-up covers it.
+
+Segments wholly below a snapshot's sequence (or the GC watermark's
+witnessed frontier) are deleted by :meth:`WalWriter.truncate_below` —
+the checkpoint cadence calls it with the snapshot's ``wal_seq``, so WAL
+growth is bounded by one checkpoint interval of writes.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from ..error import DurabilityError
+from ..utils import tracing
+
+#: mirrors the op-frame envelope (:mod:`crdt_tpu.oplog.wire`): the WAL
+#: stores frames verbatim, so its split logic must stay in lock-step
+#: with the codec's header
+_FRAME_HEADER = struct.Struct("<BBIQ")
+
+_SEG_PREFIX = "wal-"
+_SEG_SUFFIX = ".log"
+
+
+def split_frames(data: bytes) -> Tuple[List[bytes], int]:
+    """``(frames, torn_bytes)``: the complete op frames at the head of
+    ``data`` and how many trailing bytes belong to an incomplete frame
+    (0 = the segment ends exactly on a frame boundary).  Pure framing —
+    CRC/grammar validation happens at decode time, where rejection is
+    loud."""
+    frames: List[bytes] = []
+    off = 0
+    n = len(data)
+    while n - off >= _FRAME_HEADER.size:
+        _, _, _, plen = _FRAME_HEADER.unpack_from(data, off)
+        end = off + _FRAME_HEADER.size + plen
+        if end > n:
+            break
+        frames.append(data[off:end])
+        off = end
+    return frames, n - off
+
+
+def _segment_first_seq(name: str) -> Optional[int]:
+    if name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX):
+        body = name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)]
+        if body.isdigit():
+            return int(body)
+    return None
+
+
+class WalWriter:
+    """Appends op frames to fsync'd segment files under one directory.
+
+    ``segment_bytes`` rolls to a new segment once the open one exceeds
+    the bound (a roll also happens at every checkpoint, so truncation
+    operates on whole files); ``fsync=False`` is the bench knob — an
+    unsynced WAL survives process death only by luck.  Thread-safe:
+    any writer thread may :meth:`append` (the cluster node calls it
+    from ``submit_ops``, which is any-thread by contract).
+
+    ``head_seq`` is the sequence the NEXT appended frame gets; frame
+    sequences are global across segments and monotone for the life of
+    the directory (recovery re-seeds from the files, so a restarted
+    writer continues where the dead one stopped).
+    """
+
+    def __init__(self, dirpath, *, segment_bytes: int = 4 << 20,
+                 fsync: bool = True):
+        if segment_bytes < 1:
+            raise ValueError(f"segment_bytes {segment_bytes} < 1")
+        self.dirpath = os.fspath(dirpath)
+        self.segment_bytes = int(segment_bytes)
+        self.fsync = bool(fsync)
+        os.makedirs(self.dirpath, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._open_first_seq: Optional[int] = None
+        self._open_bytes = 0
+        # resume where the previous process died: the last segment's
+        # frame count fixes the next sequence.  A torn tail (kill -9
+        # mid-append) is truncated to the last frame boundary — those
+        # bytes were never acknowledged as durable, and leaving them
+        # would wedge every future replay at the tear — loudly, then
+        # the segment reopens for append so sequences stay contiguous.
+        head = 0
+        segs = self._segments()
+        if segs:
+            first, path = segs[-1]
+            with open(path, "rb") as f:
+                data = f.read()
+            frames, torn = split_frames(data)
+            if torn:
+                from ..obs import events as obs_events
+
+                with open(path, "r+b") as f:
+                    f.truncate(len(data) - torn)
+                tracing.count("durable.wal.torn")
+                obs_events.record(
+                    "durable.wal_torn", segment=os.path.basename(path),
+                    torn_bytes=torn, frames_kept=len(frames))
+            head = first + len(frames)
+            self._fh = open(path, "ab")
+            self._open_first_seq = first
+            self._open_bytes = len(data) - torn
+        self._head_seq = head
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _segments(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.dirpath):
+            seq = _segment_first_seq(name)
+            if seq is not None:
+                out.append((seq, os.path.join(self.dirpath, name)))
+        return sorted(out)
+
+    @property
+    def head_seq(self) -> int:
+        with self._lock:
+            return self._head_seq
+
+    def pending(self) -> Tuple[int, int]:
+        """``(frames, bytes)`` across retained segments — the replay
+        depth a recovery right now would face (the ``durable.wal.
+        depth`` gauge)."""
+        frames = 0
+        nbytes = 0
+        for _, path in self._segments():
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except FileNotFoundError:
+                continue  # truncation raced us
+            fs, _ = split_frames(data)
+            frames += len(fs)
+            nbytes += len(data)
+        return frames, nbytes
+
+    # -- append --------------------------------------------------------------
+
+    def append(self, frame) -> int:
+        """Append one encoded op frame (or an :class:`~crdt_tpu.oplog.
+        records.OpBatch`, encoded here) and fsync it.  Returns the
+        frame's sequence number — once this returns, the ops are
+        durable."""
+        if not isinstance(frame, (bytes, bytearray, memoryview)):
+            from ..oplog.wire import encode_ops_frame
+
+            frame = encode_ops_frame(frame)
+        frame = bytes(frame)
+        from ..cluster import faults as cluster_faults
+
+        with self._lock:
+            cluster_faults.crash_point("durable.wal.append")
+            if self._fh is None or self._open_bytes >= self.segment_bytes:
+                if self._fh is not None:
+                    self._fh.close()
+                self._fh = self._open_segment(self._head_seq)
+                self._open_first_seq = self._head_seq
+                self._open_bytes = 0
+            self._fh.write(frame)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self._open_bytes += len(frame)
+            seq = self._head_seq
+            self._head_seq += 1
+        tracing.count("durable.wal.frames")
+        tracing.count("durable.wal.bytes", len(frame))
+        return seq
+
+    def _open_segment(self, first: int):
+        """A fresh segment file whose name pins its first sequence —
+        no instance state touched (the caller assigns under its lock)."""
+        path = os.path.join(
+            self.dirpath, f"{_SEG_PREFIX}{first:010d}{_SEG_SUFFIX}")
+        if os.path.exists(path):
+            # a previous process died with a torn tail in this very
+            # segment: appending behind torn bytes would wedge replay —
+            # recovery (which truncates the torn tail's segment) must
+            # run before new writes land
+            raise DurabilityError(
+                f"WAL segment {path} already exists at head seq {first} "
+                "(torn tail not truncated?) — run recovery first"
+            )
+        return open(path, "ab")
+
+    def roll(self) -> None:
+        """Close the open segment so the NEXT append starts a new file
+        — the checkpoint calls this so truncation operates on whole
+        segments."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- truncation ----------------------------------------------------------
+
+    def truncate_below(self, seq: int) -> int:
+        """Delete whole segments every frame of which has sequence
+        ``< seq`` (the snapshot's ``wal_seq``, or the GC watermark's
+        witnessed frontier mapped to a sequence).  Returns segments
+        deleted.  Never touches the open segment."""
+        dropped = 0
+        with self._lock:
+            open_first = self._open_first_seq if self._fh is not None \
+                else None
+            segs = self._segments()
+            for i, (first, path) in enumerate(segs):
+                if first == open_first:
+                    continue
+                # the segment's frames end where the next begins (or at
+                # the head for the last file)
+                next_first = segs[i + 1][0] if i + 1 < len(segs) \
+                    else self._head_seq
+                if next_first <= seq:
+                    try:
+                        os.unlink(path)
+                        dropped += 1
+                    except FileNotFoundError:
+                        pass
+        if dropped:
+            tracing.count("durable.wal.segments_dropped", dropped)
+        return dropped
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def replay_frames(dirpath, from_seq: int = 0
+                  ) -> Iterator[Tuple[int, bytes]]:
+    """Yield ``(seq, frame_bytes)`` for every complete frame with
+    ``seq >= from_seq``, oldest first.  A torn tail (or a mid-segment
+    framing fault) stops the iteration LOUDLY — ``durable.wal.torn``
+    counter + flight-recorder event — never silently: the bytes past
+    it were not durable, and delta sync covers whatever they carried.
+    Frame payloads are NOT validated here; the replayer decodes them
+    through :func:`crdt_tpu.oplog.wire.decode_ops_frame`, whose
+    rejection is the loud path for in-frame corruption."""
+    from ..obs import events as obs_events
+
+    dirpath = os.fspath(dirpath)
+    segs = []
+    if os.path.isdir(dirpath):
+        for name in os.listdir(dirpath):
+            seq = _segment_first_seq(name)
+            if seq is not None:
+                segs.append((seq, os.path.join(dirpath, name)))
+    for first, path in sorted(segs):
+        with open(path, "rb") as f:
+            data = f.read()
+        frames, torn = split_frames(data)
+        for i, frame in enumerate(frames):
+            seq = first + i
+            if seq >= from_seq:
+                yield seq, frame
+        if torn:
+            tracing.count("durable.wal.torn")
+            obs_events.record(
+                "durable.wal_torn", segment=os.path.basename(path),
+                torn_bytes=torn, frames_kept=len(frames))
+            return  # nothing after a torn segment is trustworthy

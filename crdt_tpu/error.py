@@ -133,6 +133,38 @@ class UnsupportedBackendError(CrdtError, RuntimeError):
     """
 
 
+class DurabilityError(CrdtError):
+    """The durable-replica layer (:mod:`crdt_tpu.durable`) could not
+    produce or restore persistent state: every retained snapshot
+    generation rejected, a WAL directory in an impossible shape, a
+    restored batch failing its digest-root self-check.
+
+    No reference counterpart — the reference's checkpoint story ends at
+    ``to_binary``/``from_binary`` (`lib.rs:62-83`); surviving kill -9
+    is this build's addition.  Deliberately NOT a ``ValueError``: an
+    unrecoverable store means the operator must intervene (restore a
+    backup, rejoin as a fresh replica), not that one payload was
+    malformed — that is :class:`CheckpointFormatError`.
+    """
+
+
+class CheckpointFormatError(DurabilityError, ValueError):
+    """One checkpoint/snapshot payload violated its binary format:
+    torn/truncated container, CRC mismatch, version skew, or a restored
+    batch whose digest-tree root disagrees with the one recorded at
+    save time.
+
+    Raised by the checkpoint loader (:mod:`crdt_tpu.utils.checkpoint`)
+    and the snapshot store (:mod:`crdt_tpu.durable.snapshot`); recovery
+    treats it as "this generation is bad, fall back to the previous
+    one" — loudly (``durable.snapshot.rejected.*``), never silently.
+    Subclasses ``ValueError`` because ``load_bytes`` doubles as the
+    state-replication receive path, whose historical contract was
+    ValueError-on-corruption; existing callers keep working while the
+    wire error-contract lint sees a :class:`CrdtError`.
+    """
+
+
 class NestedOpFailed(CrdtError):
     """We failed to apply a nested op to a nested CRDT (`error.rs:16-17`)."""
 

@@ -119,6 +119,13 @@ class GcEngine:
         self.total_reclaimed_bytes = 0
         self.last_report: Optional[GcReport] = None
 
+    def restore_watermark(self, clock) -> None:
+        """Seed the fleet watermark from a persisted snapshot clock
+        (:meth:`crdt_tpu.gc.watermark.FleetWatermark.restore`) — the
+        recovery path calls this so a restarted node's compaction
+        resumes at its pre-crash stability frontier."""
+        self.watermark.restore(clock)
+
     def _reg(self) -> obs_metrics.MetricsRegistry:
         return self._registry if self._registry is not None \
             else obs_metrics.registry()

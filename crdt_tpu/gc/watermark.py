@@ -122,6 +122,9 @@ class FleetWatermark:
         # roster peers never heard from quarantine off their FIRST
         # sighting here (there is no observation to age them by)
         self._first_seen: Dict[str, float] = {}
+        # a persisted watermark restored across a restart (see
+        # :meth:`restore`): a safe FLOOR under the computed minimum
+        self._floor: Optional[np.ndarray] = None
 
     def _reg(self) -> obs_metrics.MetricsRegistry:
         return self._registry if self._registry is not None \
@@ -147,6 +150,8 @@ class FleetWatermark:
         now = self._clock()
         vectors = self._vectors()
         report = WatermarkReport(clock=local.copy())
+        with self._lock:
+            floor = self._floor
 
         contributing = [local]
         roster = set(peers) if peers is not None else set(vectors)
@@ -185,6 +190,13 @@ class FleetWatermark:
             report.clock = aligned[0]
             for v in aligned[1:]:
                 report.clock = np.minimum(report.clock, v)
+        if floor is not None:
+            # stability is monotone: counters at or below a previously
+            # fleet-stable watermark were witnessed by every peer THEN,
+            # and counters only grow — so a restored floor may only
+            # ever raise the minimum, never unsafely advance it
+            wm, fl = _aligned([report.clock, floor])
+            report.clock = np.maximum(wm, fl)
 
         reg = self._reg()
         reg.gauge_set("gc.watermark.peers", report.peers)
@@ -196,6 +208,18 @@ class FleetWatermark:
                       int(report.clock.max(initial=0)))
         reg.gauge_set("gc.watermark.lag", report.lag(local))
         return report
+
+    def restore(self, clock) -> None:
+        """Seed the watermark with a clock persisted by a snapshot
+        (:mod:`crdt_tpu.durable`): counters at or below it were
+        fleet-stable when the snapshot was taken, and stability is
+        monotone, so the restored value is a safe floor under every
+        future minimum — a restarted node's GC resumes from where it
+        left off instead of freezing at zero until its peers' vectors
+        arrive (or their quarantine expires)."""
+        with self._lock:
+            self._floor = np.asarray(
+                clock, dtype=np.uint64).reshape(-1).copy()
 
     def forget(self, peer: str) -> None:
         """Drop a peer's quarantine bookkeeping (it left the roster)."""

@@ -270,6 +270,52 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "contributing counts, age_s of the oldest contribution, "
              "max_counter of the watermark clock, lag behind the local "
              "frontier)"),
+    # -- durable replicas (durable/, cluster/gossip.py) ----------------------
+    NameSpec("durable.snapshots", "counter",
+             "snapshot generations written (atomic rename-into-place)"),
+    NameSpec("durable.snapshot.decoded", "counter",
+             "snapshot generations that decoded AND passed the "
+             "digest-root self-check"),
+    NameSpec("durable.snapshot.rejected.*", "counter",
+             "snapshot loads rejected by reason (truncated/bad_magic/"
+             "version_mismatch/crc_mismatch/root_mismatch/...)"),
+    NameSpec("durable.snapshot.fallbacks", "counter",
+             "recoveries that fell back past a rejected generation"),
+    NameSpec("durable.wal.frames", "counter",
+             "op frames appended to WAL segments (fsync'd before the "
+             "in-memory fold)"),
+    NameSpec("durable.wal.bytes", "counter",
+             "bytes appended to WAL segments"),
+    NameSpec("durable.wal.torn", "counter",
+             "WAL segments whose torn tail was truncated (the expected "
+             "kill -9 mid-append shape; the bytes were never "
+             "acknowledged durable)"),
+    NameSpec("durable.wal.segments_dropped", "counter",
+             "WAL segments deleted by checkpoint/watermark truncation"),
+    NameSpec("durable.snapshot.generation", "gauge",
+             "latest snapshot generation number"),
+    NameSpec("durable.snapshot.bytes", "gauge",
+             "latest snapshot file size"),
+    NameSpec("durable.snapshot.age_s", "gauge",
+             "seconds since the last checkpoint (refreshed at "
+             "round-end cadence checks)"),
+    NameSpec("durable.wal.depth", "gauge",
+             "op frames in retained WAL segments — the replay a "
+             "recovery right now would face"),
+    NameSpec("durable.wal.pending_bytes", "gauge",
+             "bytes across retained WAL segments"),
+    NameSpec("durable.replay.frames", "gauge",
+             "WAL frames the last recovery replayed"),
+    NameSpec("durable.replay.ops", "gauge",
+             "ops the last recovery replayed through the causal-gap "
+             "apply path"),
+    NameSpec("durable.recovery.wall_s", "gauge",
+             "last recovery's wall time (restore + verify + replay)"),
+    NameSpec("durable.checkpoint", "histogram",
+             "one checkpoint pass: snapshot write + WAL roll/truncate "
+             "(span)"),
+    NameSpec("durable.recover", "histogram",
+             "one recovery: restore + root verify + WAL replay (span)"),
     # -- native engine (native/engine.py) ------------------------------------
     NameSpec("native.engine.*.calls", "counter",
              "native kernel invocations per entry point"),

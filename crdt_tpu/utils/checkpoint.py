@@ -174,22 +174,96 @@ def save(path, batch_state: Any, universe: Universe) -> None:
     )
 
 
-def load(path) -> Tuple[Any, Universe]:
-    """Load a checkpoint written by :func:`save`.
+def decode_checkpoint(z) -> Tuple[Any, Universe]:
+    """Decode an open npz checkpoint container into ``(batch_state,
+    universe)`` with bit-exact buffers.
 
-    Returns ``(batch_state, universe)`` with bit-exact buffers.
-
-    Raises ``ValueError`` on a corrupt or non-checkpoint input (missing
-    files still raise ``FileNotFoundError``).  ``load_bytes`` doubles as
-    the state-replication receive path, so — like
-    :func:`~crdt_tpu.utils.serde.from_binary` — malformed payloads must
-    surface as the one contract exception, not as ``zipfile.BadZipFile``
-    / ``KeyError`` / ``AttributeError`` from the container internals.
+    The decode half of :func:`load`, split out so the wire
+    error-contract lint (:mod:`crdt_tpu.analysis.wire`) polices it: a
+    malformed payload must surface as
+    :class:`~crdt_tpu.error.CheckpointFormatError` (a
+    :class:`~crdt_tpu.error.CrdtError` that is also a ``ValueError``,
+    the loader's historical contract), never as ``zipfile.BadZipFile``
+    / ``KeyError`` / ``AttributeError`` from the container internals —
+    ``load_bytes`` doubles as the state-replication receive path.
     """
     import zipfile
     import zlib
 
     import jax.numpy as jnp
+
+    from ..error import CheckpointFormatError
+
+    try:
+        meta = serde.from_binary(z["__meta__"].tobytes())
+        if not isinstance(meta, dict) or meta.get("version") != FORMAT_VERSION:
+            raise CheckpointFormatError(
+                "unsupported checkpoint version: "
+                f"{(meta.get('version') if isinstance(meta, dict) else meta)!r}"
+            )
+        cls = _batch_types().get(meta.get("type"))
+        if cls is None:
+            raise CheckpointFormatError(
+                f"unknown batch type in checkpoint: {meta.get('type')!r}"
+            )
+        universe = _universe_from_blob(z["__universe__"].tobytes())
+        static = meta.get("static", {})
+        fields = {}
+        for f in dataclasses.fields(cls):
+            if _is_static_field(f):
+                from ..batch.val_kernels import kernel_from_spec
+
+                fields[f.name] = kernel_from_spec(static[f.name])
+            elif f.name in z:
+                fields[f.name] = jnp.asarray(z[f.name])
+            else:
+                prefix = f.name + "__"
+                rows = []
+                for key in z.files:
+                    if key.startswith(prefix):
+                        idx_path = tuple(
+                            int(s) for s in key[len(prefix):].split("_")
+                        )
+                        rows.append((idx_path, jnp.asarray(z[key])))
+                if not rows:
+                    empties = meta.get("empty", {})
+                    if f.name in empties:
+                        # save() recorded a legitimately leafless
+                        # field (empty nested tuple) — not corruption
+                        fields[f.name] = _as_pure_tuples(empties[f.name])
+                    else:
+                        raise CheckpointFormatError(
+                            f"checkpoint missing arrays for field {f.name!r}"
+                        )
+                else:
+                    fields[f.name] = _rebuild_tuple(sorted(rows))
+        out = cls(**fields)
+    except CheckpointFormatError:
+        raise
+    except (KeyError, AttributeError, TypeError, IndexError, ValueError,
+            zipfile.BadZipFile, zlib.error, EOFError) as e:
+        # NpzFile member reads are lazy: a corrupted member surfaces
+        # its zip/zlib error at z[key], inside this block
+        raise CheckpointFormatError(
+            f"malformed checkpoint: {type(e).__name__}: {e}"
+        ) from e
+    return out, universe
+
+
+def load(path) -> Tuple[Any, Universe]:
+    """Load a checkpoint written by :func:`save`.
+
+    Returns ``(batch_state, universe)`` with bit-exact buffers.
+
+    Raises :class:`~crdt_tpu.error.CheckpointFormatError` — a
+    :class:`~crdt_tpu.error.CrdtError` that is also a ``ValueError``,
+    so pre-taxonomy callers keep working — on a corrupt or
+    non-checkpoint input (missing files still raise
+    ``FileNotFoundError``); see :func:`decode_checkpoint`.
+    """
+    import zipfile
+
+    from ..error import CheckpointFormatError
 
     if isinstance(path, (str, os.PathLike)):
         p = os.fspath(path)
@@ -203,67 +277,16 @@ def load(path) -> Tuple[Any, Universe]:
     except (FileNotFoundError, PermissionError, IsADirectoryError):
         raise  # real I/O failures are not data corruption
     except (zipfile.BadZipFile, OSError, ValueError, EOFError) as e:
-        raise ValueError(f"not a checkpoint container: {e}") from e
+        raise CheckpointFormatError(
+            f"not a checkpoint container: {e}") from e
     if not isinstance(container, np.lib.npyio.NpzFile):
         # a bare .npy (or anything else np.load accepts) is not a checkpoint
-        raise ValueError(
-            f"not a checkpoint container: expected npz, got {type(container).__name__}"
+        raise CheckpointFormatError(
+            f"not a checkpoint container: expected npz, got "
+            f"{type(container).__name__}"
         )
     with container as z:
-        try:
-            meta = serde.from_binary(z["__meta__"].tobytes())
-            if not isinstance(meta, dict) or meta.get("version") != FORMAT_VERSION:
-                raise ValueError(
-                    "unsupported checkpoint version: "
-                    f"{(meta.get('version') if isinstance(meta, dict) else meta)!r}"
-                )
-            cls = _batch_types().get(meta.get("type"))
-            if cls is None:
-                raise ValueError(
-                    f"unknown batch type in checkpoint: {meta.get('type')!r}"
-                )
-            universe = _universe_from_blob(z["__universe__"].tobytes())
-            static = meta.get("static", {})
-            fields = {}
-            for f in dataclasses.fields(cls):
-                if _is_static_field(f):
-                    from ..batch.val_kernels import kernel_from_spec
-
-                    fields[f.name] = kernel_from_spec(static[f.name])
-                elif f.name in z:
-                    fields[f.name] = jnp.asarray(z[f.name])
-                else:
-                    prefix = f.name + "__"
-                    rows = []
-                    for key in z.files:
-                        if key.startswith(prefix):
-                            idx_path = tuple(
-                                int(s) for s in key[len(prefix):].split("_")
-                            )
-                            rows.append((idx_path, jnp.asarray(z[key])))
-                    if not rows:
-                        empties = meta.get("empty", {})
-                        if f.name in empties:
-                            # save() recorded a legitimately leafless
-                            # field (empty nested tuple) — not corruption
-                            fields[f.name] = _as_pure_tuples(empties[f.name])
-                        else:
-                            raise ValueError(
-                                f"checkpoint missing arrays for field {f.name!r}"
-                            )
-                    else:
-                        fields[f.name] = _rebuild_tuple(sorted(rows))
-            out = cls(**fields)
-        except ValueError:
-            raise
-        except (KeyError, AttributeError, TypeError, IndexError,
-                zipfile.BadZipFile, zlib.error, EOFError) as e:
-            # NpzFile member reads are lazy: a corrupted member surfaces
-            # its zip/zlib error at z[key], inside this block
-            raise ValueError(
-                f"malformed checkpoint: {type(e).__name__}: {e}"
-            ) from e
-    return out, universe
+        return decode_checkpoint(z)
 
 
 def save_bytes(batch_state: Any, universe: Universe) -> bytes:

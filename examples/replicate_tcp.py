@@ -232,7 +232,8 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 ops_sweeps: int = 3, gc_enabled: bool = False,
                 gc_interval: int = 1, gc_hysteresis: float = 0.5,
                 digest_tree: bool = False, zipf_s: float = 0.0,
-                burst_len: int = 1) -> int:
+                burst_len: int = 1, durable_dir: str | None = None,
+                kill_sweep: int = 2) -> int:
     """N in-process replicas over real loopback TCP, reconciled by the
     cluster runtime (``crdt_tpu/cluster``): each node owns a listener
     (accepted sessions run through the same hardened transport stack),
@@ -257,7 +258,18 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     reconciling, so anti-entropy and ingest genuinely overlap; once the
     writes stop, the fleet must still converge to byte-identical digest
     vectors — the mixed op+state acceptance shape (PERF.md "Op-based
-    replication")."""
+    replication").
+
+    ``--durable DIR`` arms every node with a :class:`crdt_tpu.durable.
+    Durability` manager (WAL-ahead ingest + a checkpoint at every
+    gossip round end) and turns the run into the crash-recovery demo:
+    at sweep ``kill_sweep`` node n1 is killed — listener closed, object
+    dropped, nothing flushed, exactly what kill -9 leaves — and one
+    sweep later it restores from its snapshot + WAL
+    (:func:`crdt_tpu.durable.recover`), rejoins through NORMAL delta
+    sync, and the demo prints the recovery wall, bytes replayed from
+    the WAL vs bytes delta-synced during the rejoin, and asserts the
+    rejoin shipped zero full-state frames (PERF.md "Durability")."""
     import jax
 
     if platform:
@@ -286,35 +298,49 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
 
     from crdt_tpu.oplog import OpLog
 
+    def make_gc_engine():
+        if not gc_enabled:
+            return None
+        from crdt_tpu.gc import GcEngine, GcPolicy
+
+        return GcEngine(GcPolicy(
+            interval_rounds=gc_interval,
+            shrink_hysteresis=gc_hysteresis,
+        ))
+
+    def make_durability(node_name):
+        if durable_dir is None:
+            return None
+        from crdt_tpu.durable import Durability
+
+        return Durability(os.path.join(durable_dir, node_name),
+                          interval_rounds=1, retain=2)
+
     nodes = []
     for i in range(n_peers):
         fleet = _build_fleet(n_objects, actor=i + 1,
                              divergence=divergence, seed=42)
         batch = OrswotBatch.from_scalar(fleet, uni)
-        gc_engine = None
+        gc_engine = make_gc_engine()
         if gc_enabled:
-            from crdt_tpu.gc import GcEngine, GcPolicy
-
             # over-provision the planes as an earlier burst's regrow
             # would have, so the demo has real padding to reclaim
             batch = batch.with_capacity(uni.config.member_capacity * 4,
                                         uni.config.deferred_capacity * 4)
-            gc_engine = GcEngine(GcPolicy(
-                interval_rounds=gc_interval,
-                shrink_hysteresis=gc_hysteresis,
-            ))
         nodes.append(ClusterNode(
             f"n{i}", batch, uni,
             busy_timeout_s=30.0,
             observatory=FleetObservatory(f"n{i}"),
             # op front-end armed up front so sessions advertise the
-            # piggyback capability from the first hello
-            oplog=OpLog(uni) if ops_rate else None,
+            # piggyback capability from the first hello (always armed
+            # in durable mode — the WAL rides the op ingest path)
+            oplog=OpLog(uni) if (ops_rate or durable_dir) else None,
             gc=gc_engine,
             # sync protocol v3: sessions compare digest-tree roots and
             # descend into diverged subtrees instead of shipping the
             # flat O(N) digest vector
             digest_tree=digest_tree,
+            durability=make_durability(f"n{i}"),
         ))
 
     fleet_server = None
@@ -332,20 +358,23 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         )
 
     # one listener per node; accepted connections run the acceptor leg
-    # through the same ResilientTransport stack the dialers use
+    # through the same ResilientTransport stack the dialers use.  The
+    # served node is looked up LATE (nodes[i] at accept time), so a
+    # killed slot refuses and a restarted one serves its new object.
     stop = threading.Event()
-    servers = []
+    servers: list = [None] * n_peers
     ports = {}
-    for i, node in enumerate(nodes):
+
+    def start_listener(i):
         srv = socket.socket()
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("127.0.0.1", 0))
         srv.listen(n_peers)
         srv.settimeout(0.2)  # poll the stop flag between accepts
         ports[f"n{i}"] = srv.getsockname()[1]
-        servers.append(srv)
+        servers[i] = srv
 
-        def listener(node=node, srv=srv):
+        def listener():
             while not stop.is_set():
                 try:
                     sock, _ = srv.accept()
@@ -354,7 +383,11 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 except OSError:
                     return
 
-                def serve(sock=sock, node=node):
+                def serve(sock=sock):
+                    node = nodes[i]
+                    if node is None:  # killed between accept and serve
+                        sock.close()
+                        return
                     t = ResilientTransport(
                         TcpTransport(sock, default_timeout=20.0), policy,
                         name=f"{node.node_id}-accept",
@@ -373,10 +406,21 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         threading.Thread(target=listener, daemon=True,
                          name=f"listen-n{i}").start()
 
+    for i in range(n_peers):
+        start_listener(i)
+
     def make_dialer(node):
         def dial(peer):
-            sock = socket.create_connection(
-                ("127.0.0.1", ports[peer.peer_id]), timeout=20.0)
+            from crdt_tpu.error import PeerUnavailableError
+
+            try:
+                sock = socket.create_connection(
+                    ("127.0.0.1", ports[peer.peer_id]), timeout=20.0)
+            except OSError as e:
+                # a killed peer's port refuses: that is a membership
+                # fact (alive -> suspect -> dead), not a crash
+                raise PeerUnavailableError(
+                    f"dial {peer.peer_id} refused: {e}") from e
             t = ResilientTransport(
                 TcpTransport(sock, default_timeout=20.0), policy,
                 name=f"{node.node_id}->{peer.peer_id}",
@@ -385,16 +429,17 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             return t
         return dial
 
-    scheds = []
-    for i, node in enumerate(nodes):
+    def make_sched(i):
         membership = Membership(suspect_after=2, dead_after=5)
         for j in range(n_peers):
             if j != i:
                 membership.add(f"n{j}", address=ports[f"n{j}"])
-        scheds.append(GossipScheduler(
-            node, membership, make_dialer(node), fanout=2,
+        return GossipScheduler(
+            nodes[i], membership, make_dialer(nodes[i]), fanout=2,
             session_timeout_s=60.0, seed=i,
-        ))
+        )
+
+    scheds = [make_sched(i) for i in range(n_peers)]
 
     ops_rng = np.random.RandomState(4242)
     total_ops = 0
@@ -418,7 +463,7 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         per_node = np.bincount(
             ops_rng.randint(0, n_peers, r), minlength=n_peers)
         for i, cnt in enumerate(per_node):
-            if not cnt:
+            if not cnt or nodes[i] is None:  # a killed node takes none
                 continue
             nodes[i].submit_writes(
                 key_gen.draw(int(cnt)),
@@ -427,25 +472,89 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             )
             total_ops += cnt
 
+    victim = 1 if (durable_dir is not None and n_peers >= 2) else None
+    killed_at = None
+    rejoin_baseline = None
+    recovery = None
+
+    def kill_victim(sweep):
+        """kill -9 in-process: close the listener, drop the object —
+        no drain, no flush, no goodbye.  Everything the node will have
+        after this moment is what its Durability manager already put
+        on disk."""
+        servers[victim].close()
+        nodes[victim] = None
+        scheds[victim] = None
+        print(f"kill: n{victim} killed -9 at sweep {sweep} "
+              "(listener closed, in-memory state dropped)", flush=True)
+
+    def restart_victim():
+        nonlocal rejoin_baseline, recovery
+        from crdt_tpu.durable import recover
+        from crdt_tpu.utils import tracing as _tracing
+
+        c = _tracing.counters()
+        rejoin_baseline = {
+            "full_frames": c.get("sync.full_state_fallback", 0),
+            "full_bytes": c.get("wire.sync.full.bytes", 0),
+            "delta_bytes": c.get("wire.sync.delta.bytes", 0),
+        }
+        recovery = recover(os.path.join(durable_dir, f"n{victim}"))
+        gc_engine = make_gc_engine()
+        if gc_engine is not None and recovery.watermark is not None:
+            # resume GC's stability frontier from the persisted clock
+            gc_engine.restore_watermark(recovery.watermark)
+        nodes[victim] = ClusterNode(
+            f"n{victim}", recovery.batch, recovery.universe,
+            busy_timeout_s=30.0,
+            observatory=FleetObservatory(f"n{victim}"),
+            oplog=OpLog(recovery.universe),
+            applier=recovery.applier,
+            gc=gc_engine,
+            digest_tree=digest_tree,
+            durability=make_durability(f"n{victim}"),
+        )
+        start_listener(victim)
+        scheds[victim] = make_sched(victim)
+        rep = recovery.report
+        print(f"recovery: n{victim} restored generation "
+              f"{rep.generation} in {rep.wall_s * 1e3:.1f}ms — "
+              f"replayed {rep.replayed_frames} WAL frames / "
+              f"{rep.replayed_ops} ops ({rep.replayed_bytes}B), "
+              f"{rep.parked_ops} re-parked; rejoining via delta sync",
+              flush=True)
+
     sweeps = 0
     converged = False
     try:
         for sweeps in range(1, max_sweeps + 1):
+            if victim is not None and killed_at is None \
+                    and sweeps == kill_sweep:
+                kill_victim(sweeps)
+                killed_at = sweeps
+            elif killed_at is not None and nodes[victim] is None \
+                    and sweeps == killed_at + 1:
+                restart_victim()
             writing = ops_rate and sweeps <= ops_sweeps
             if writing:
                 inject_writes(ops_rate)
             for sched in scheds:
+                if sched is None:
+                    continue  # the victim is down this sweep
                 if writing:
                     # writes land between (and during) rounds, not just
                     # at sweep boundaries — the live-traffic shape
                     inject_writes(max(1, ops_rate // n_peers))
                 sched.run_round()
-            digests = [n.digest() for n in nodes]
-            converged = all(
+            live = [n for n in nodes if n is not None]
+            digests = [n.digest() for n in live]
+            converged = len(live) == n_peers and all(
                 np.array_equal(digests[0], d) for d in digests[1:]
             )
             state = ("digest vectors identical" if converged
-                     else "still diverged")
+                     else "still diverged"
+                     if len(live) == n_peers else
+                     f"{n_peers - len(live)} node(s) down")
             if ops_rate:
                 state += f" (ops submitted so far: {total_ops})"
             print(f"sweep {sweeps}: {state}", flush=True)
@@ -456,7 +565,25 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     finally:
         stop.set()
         for srv in servers:
-            srv.close()
+            if srv is not None:
+                srv.close()
+
+    if recovery is not None:
+        from crdt_tpu.utils import tracing as _tracing
+
+        c = _tracing.counters()
+        full_frames = c.get("sync.full_state_fallback", 0) \
+            - rejoin_baseline["full_frames"]
+        delta_bytes = c.get("wire.sync.delta.bytes", 0) \
+            - rejoin_baseline["delta_bytes"]
+        print(
+            f"rejoin: {recovery.report.replayed_bytes}B replayed from "
+            f"the WAL vs {delta_bytes}B delta-synced fleet-wide during "
+            f"the rejoin; full-state fallbacks={full_frames}",
+            flush=True,
+        )
+        assert full_frames == 0, \
+            "rejoin shipped a full-state frame (must be delta-only)"
 
     if ops_rate:
         print(f"ops: {total_ops} live writes ingested through "
@@ -584,6 +711,16 @@ def main() -> int:
     ap.add_argument("--burst", type=int, default=1, metavar="B",
                     help="with --ops: each drawn key repeats for B "
                          "consecutive writes (bursty sessions)")
+    ap.add_argument("--durable", default=None, metavar="DIR",
+                    help="with --gossip: arm every node with a durable "
+                         "snapshot store + op-log WAL under DIR/n<i> "
+                         "(crdt_tpu.durable), kill node n1 -9 mid-run, "
+                         "restore it from disk, and print recovery "
+                         "wall + bytes replayed vs bytes delta-synced "
+                         "during the rejoin")
+    ap.add_argument("--kill-sweep", type=int, default=2, metavar="K",
+                    help="with --durable: kill n1 at sweep K and "
+                         "restart it one sweep later (default 2)")
     ap.add_argument("--gc-hysteresis", type=float, default=0.5,
                     help="with --gc: shrink only when the fitted "
                          "capacity rung is at most this fraction of the "
@@ -596,6 +733,8 @@ def main() -> int:
             ap.error("--gossip needs N >= 2 peers")
         if args.ops < 0:
             ap.error("--ops needs R >= 0")
+        if args.kill_sweep < 1:
+            ap.error("--kill-sweep needs K >= 1")
         return gossip_demo(args.gossip, args.objects, args.platform,
                            divergence=args.divergence,
                            fleet_port=args.fleet_port,
@@ -603,7 +742,9 @@ def main() -> int:
                            gc_interval=args.gc_interval,
                            gc_hysteresis=args.gc_hysteresis,
                            digest_tree=args.digest_tree,
-                           zipf_s=args.zipf, burst_len=args.burst)
+                           zipf_s=args.zipf, burst_len=args.burst,
+                           durable_dir=args.durable,
+                           kill_sweep=args.kill_sweep)
 
     if args.role != "demo":
         if not args.port:

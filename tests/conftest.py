@@ -66,6 +66,12 @@ def pytest_configure(config):
         "low-watermark clocks, compaction kernels, plane re-packing, "
         "GC policy); tier-1 like `sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "durable: durability tests (crdt_tpu.durable — snapshot store, "
+        "op-log WAL, crash-recovery rejoin, fault injection); tier-1 "
+        "like `sync`",
+    )
 
 
 # -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------

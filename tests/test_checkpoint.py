@@ -9,6 +9,7 @@ a resumed-then-merged state must equal merging the originals.
 import io
 
 import numpy as np
+import pytest
 
 from crdt_tpu import Orswot
 from crdt_tpu.batch import LWWRegBatch, OrswotBatch
@@ -322,3 +323,281 @@ def test_directory_path_keeps_io_error(tmp_path):
 
     with pytest.raises(IsADirectoryError):
         checkpoint.load(tmp_path)
+
+
+def test_gcounter_batch_roundtrip(tmp_path):
+    from crdt_tpu.batch import GCounterBatch
+    from crdt_tpu.scalar.gcounter import GCounter
+
+    universe = Universe()
+    counters = []
+    for i in range(5):
+        c = GCounter()
+        for j in range(i + 1):
+            c.apply(c.inc(f"a{j % 3}"))
+        counters.append(c)
+    batch = GCounterBatch.from_scalar(counters, universe)
+    path = tmp_path / "gc.npz"
+    checkpoint.save(path, batch, universe)
+    loaded, uni2 = checkpoint.load(path)
+    assert type(loaded) is GCounterBatch
+    _assert_batch_equal(batch, loaded)
+    assert [c.value() for c in loaded.to_scalar(uni2)] == [
+        c.value() for c in counters
+    ]
+
+
+def test_pncounter_batch_roundtrip(tmp_path):
+    from crdt_tpu.batch import PNCounterBatch
+    from crdt_tpu.scalar.pncounter import PNCounter
+
+    universe = Universe()
+    counters = []
+    for i in range(5):
+        c = PNCounter()
+        for j in range(i + 2):
+            c.apply(c.inc(f"a{j % 3}"))
+        if i % 2:
+            c.apply(c.dec("a0"))
+        counters.append(c)
+    batch = PNCounterBatch.from_scalar(counters, universe)
+    path = tmp_path / "pn.npz"
+    checkpoint.save(path, batch, universe)
+    loaded, uni2 = checkpoint.load(path)
+    assert type(loaded) is PNCounterBatch
+    _assert_batch_equal(batch, loaded)
+    assert [c.value() for c in loaded.to_scalar(uni2)] == [
+        c.value() for c in counters
+    ]
+
+
+# ---- the all-families property sweep (ISSUE 12 satellite) ------------------
+#
+# For EVERY plane family: a seeded random diverged pair (A, B) must
+# satisfy  load(save(A)) == A  (bit-exact buffers + scalar parity) and
+# the resume-by-merge identity  B.merge(load(save(A))) == B.merge(A) —
+# the reference's whole durability contract (`lib.rs:62-83`,
+# `traits.rs:36`) across the batch engine.
+
+
+def _orswot_pair(rng, universe):
+    from crdt_tpu.batch import OrswotBatch
+
+    def states(extra_actor):
+        out = []
+        for i in range(6):
+            s = Orswot()
+            for _ in range(int(rng.randint(1, 4))):
+                s.apply(s.add(int(rng.randint(0, 20)),
+                              s.value().derive_add_ctx(
+                                  f"a{int(rng.randint(0, 3))}")))
+            if i % 2:
+                s.apply(s.add(100 + i, s.value().derive_add_ctx(extra_actor)))
+            out.append(s)
+        return out
+
+    return (OrswotBatch.from_scalar(states("x"), universe),
+            OrswotBatch.from_scalar(states("y"), universe))
+
+
+def _gcounter_pair(rng, universe):
+    from crdt_tpu.batch import GCounterBatch
+    from crdt_tpu.scalar.gcounter import GCounter
+
+    def states():
+        out = []
+        for _ in range(6):
+            c = GCounter()
+            for _ in range(int(rng.randint(1, 6))):
+                c.apply(c.inc(f"a{int(rng.randint(0, 3))}"))
+            out.append(c)
+        return out
+
+    return (GCounterBatch.from_scalar(states(), universe),
+            GCounterBatch.from_scalar(states(), universe))
+
+
+def _pncounter_pair(rng, universe):
+    from crdt_tpu.batch import PNCounterBatch
+    from crdt_tpu.scalar.pncounter import PNCounter
+
+    def states():
+        out = []
+        for _ in range(6):
+            c = PNCounter()
+            for _ in range(int(rng.randint(1, 6))):
+                c.apply(c.inc(f"a{int(rng.randint(0, 3))}"))
+            if rng.randint(0, 2):
+                c.apply(c.dec(f"a{int(rng.randint(0, 3))}"))
+            out.append(c)
+        return out
+
+    return (PNCounterBatch.from_scalar(states(), universe),
+            PNCounterBatch.from_scalar(states(), universe))
+
+
+def _gset_pair(rng, universe):
+    from crdt_tpu.batch import GSetBatch
+    from crdt_tpu.scalar.gset import GSet
+
+    def states():
+        return [
+            GSet({int(m) for m in rng.randint(0, 30, rng.randint(1, 6))})
+            for _ in range(6)
+        ]
+
+    # interned member ids are registry-dense: capacity must cover every
+    # distinct member both sides ever intern
+    return (GSetBatch.from_scalar(states(), universe, member_capacity=32),
+            GSetBatch.from_scalar(states(), universe, member_capacity=32))
+
+
+def _mvreg_pair(rng, universe):
+    from crdt_tpu.batch import MVRegBatch
+    from crdt_tpu.scalar.mvreg import MVReg
+
+    def states():
+        out = []
+        for i in range(6):
+            r = MVReg()
+            r.apply(r.set(int(rng.randint(0, 50)),
+                          r.read().derive_add_ctx(int(rng.randint(0, 3)))))
+            if i % 2:
+                r2 = MVReg()
+                r2.apply(r2.set(int(rng.randint(50, 99)),
+                                r2.read().derive_add_ctx(5)))
+                r.merge(r2)
+            out.append(r)
+        return out
+
+    return (MVRegBatch.from_scalar(states(), universe),
+            MVRegBatch.from_scalar(states(), universe))
+
+
+def _lwwreg_pair(rng, universe):
+    from crdt_tpu import LWWReg
+    from crdt_tpu.batch import LWWRegBatch
+
+    def states():
+        return [LWWReg(val=int(rng.randint(0, 99)),
+                       marker=int(rng.randint(1, 50)))
+                for _ in range(6)]
+
+    return (LWWRegBatch.from_scalar(states(), universe),
+            LWWRegBatch.from_scalar(states(), universe))
+
+
+def _map_pair(rng, universe):
+    from crdt_tpu import Dot, Map, MVReg, VClock
+    from crdt_tpu.batch import MapBatch, MVRegKernel
+    from crdt_tpu.scalar.map import Up
+    from crdt_tpu.scalar.mvreg import Put
+
+    kernel = MVRegKernel.from_config(universe.config)
+
+    def states():
+        out = []
+        for i in range(3):
+            m = Map(MVReg)
+            for j in range(int(rng.randint(1, 3))):
+                clock = VClock.from_iter([(f"a{j}", int(rng.randint(1, 5)))])
+                m.apply(Up(dot=Dot(f"a{j}", int(rng.randint(1, 5))), key=j,
+                           op=Put(clock=clock, val=int(rng.randint(0, 99)))))
+            out.append(m)
+        return out
+
+    return (MapBatch.from_scalar(states(), universe, kernel),
+            MapBatch.from_scalar(states(), universe, kernel))
+
+
+_FAMILY_PAIRS = {
+    "orswot": (_orswot_pair, True),
+    "gcounter": (_gcounter_pair, True),
+    "pncounter": (_pncounter_pair, True),
+    "gset": (_gset_pair, True),
+    "mvreg": (_mvreg_pair, True),
+    "lwwreg": (_lwwreg_pair, True),
+    "map": (_map_pair, False),   # static kernel field: compare via to_scalar
+}
+
+
+def _uni_for(family):
+    cfg = CrdtConfig(num_actors=8, member_capacity=8, deferred_capacity=4,
+                     mv_capacity=4, key_capacity=4)
+    return Universe(cfg)
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILY_PAIRS))
+@pytest.mark.parametrize("seed", [0, 1])
+def test_family_roundtrip_and_resume_merge_identity(family, seed):
+    make_pair, arrays_comparable = _FAMILY_PAIRS[family]
+    rng = np.random.RandomState(seed * 101 + 7)
+    universe = _uni_for(family)
+    a, b = make_pair(rng, universe)
+
+    blob = checkpoint.save_bytes(a, universe)
+    loaded, uni2 = checkpoint.load_bytes(blob)
+    assert type(loaded) is type(a)
+    if arrays_comparable:
+        _assert_batch_equal(a, loaded)
+        merged_orig = b.merge(a)
+        merged_restored = b.merge(loaded)
+        _assert_batch_equal(merged_orig, merged_restored)
+    else:
+        assert loaded.to_scalar(uni2) == a.to_scalar(universe)
+        assert b.merge(loaded).to_scalar(universe) \
+            == b.merge(a).to_scalar(universe)
+    # restored universe is equivalent
+    assert uni2.actors.values() == universe.actors.values()
+    assert uni2.members.values() == universe.members.values()
+
+
+def test_post_gc_settled_repacked_state_roundtrips():
+    """ISSUE 12 satellite: a fleet that GC settled AND re-packed down
+    the capacity ladder must checkpoint/restore digest-identical —
+    durability composes with compaction, not just with fresh planes."""
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.gc import GcEngine, GcPolicy
+    from crdt_tpu.obs import convergence as obs_convergence
+    from crdt_tpu.obs import metrics as obs_metrics
+    from crdt_tpu.scalar.ctx import RmCtx
+    from crdt_tpu.scalar.vclock import VClock
+    from crdt_tpu.sync import digest as digest_mod
+
+    cfg = CrdtConfig(num_actors=8, member_capacity=8, deferred_capacity=4,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+    rng = np.random.RandomState(31)
+    states = []
+    for i in range(64):
+        s = Orswot()
+        for _ in range(int(rng.randint(1, 5))):
+            s.apply(s.add(int(rng.randint(0, 200)),
+                          s.value().derive_add_ctx(int(rng.randint(0, 4)))))
+        if i % 9 == 0:  # a causally-future remove -> a deferred row
+            future = VClock()
+            future.witness(7, int(rng.randint(50, 90)))
+            s.apply(s.remove(0, RmCtx(clock=future)))
+        states.append(s)
+    twin = OrswotBatch.from_scalar(states, uni)
+    big = twin.with_capacity(32, 16)
+    eng = GcEngine(
+        GcPolicy(interval_rounds=1),
+        tracker=obs_convergence.ConvergenceTracker(
+            obs_metrics.MetricsRegistry()))
+    compacted, report = eng.collect(big, universe=uni)
+    assert report.shrunk  # the fixture really exercised the repack
+
+    blob = checkpoint.save_bytes(compacted, uni)
+    loaded, uni2 = checkpoint.load_bytes(blob)
+    _assert_batch_equal(compacted, loaded)
+    want = np.asarray(digest_mod.digest_of(twin), np.uint64)
+    got = np.asarray(digest_mod.digest_of(loaded), np.uint64)
+    np.testing.assert_array_equal(got, want)
+
+    # resume-by-merge across the GC boundary: merging the restored
+    # compacted fleet equals merging the never-compacted twin
+    other = OrswotBatch.from_scalar(states[::-1], uni).with_capacity(32, 16)
+    a = np.asarray(digest_mod.digest_of(other.merge(loaded)), np.uint64)
+    b = np.asarray(digest_mod.digest_of(other.merge(twin)), np.uint64)
+    np.testing.assert_array_equal(a, b)

@@ -1,0 +1,283 @@
+"""Kill -9 acceptance soak — durable replicas under user-shaped churn.
+
+The ISSUE 12 acceptance bar: a 3-node gossip fleet with durability ON
+(WAL-ahead ingest, checkpoint cadence at round end, causal GC running
+between sessions) takes Zipf/burst write traffic
+(:class:`crdt_tpu.utils.workload.WorkloadGen` — the ROADMAP carried
+item: soak numbers run against user-shaped keys, not uniform sprays);
+a node is killed -9 mid-gossip through the :mod:`crdt_tpu.cluster.
+faults` crash points; the survivors keep writing; the dead node
+restores from snapshot + WAL, rejoins through normal delta sync, and
+the fleet converges to byte-identical digest vectors with ZERO
+full-state frames shipped during the rejoin.  A torn newest snapshot
+(short-write disk fault) must reject loudly and fall back to the
+previous generation — and still converge.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.cluster import (
+    ClusterNode, CrashPlan, GossipScheduler, InjectedCrash, Membership,
+    TornWriter, arm_crashes, disarm_crashes, queue_pair,
+)
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.durable import Durability, recover
+from crdt_tpu.durable.snapshot import default_writer
+from crdt_tpu.error import PeerUnavailableError
+from crdt_tpu.gc import GcEngine, GcPolicy
+from crdt_tpu.obs import convergence as obs_convergence
+from crdt_tpu.oplog import OpLog
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.utils import tracing
+from crdt_tpu.utils.interning import Universe
+from crdt_tpu.utils.workload import WorkloadGen
+
+pytestmark = [pytest.mark.durable, pytest.mark.slow]
+
+N_OBJECTS = 32
+N_NODES = 3
+EPOCHS = 4
+WRITES_PER_EPOCH = 6
+
+
+def _fleet(tmp_path, torn_writer_for=None):
+    uni = Universe.identity(CrdtConfig(
+        num_actors=8, member_capacity=64, deferred_capacity=8,
+        counter_bits=32))
+    states = []
+    for _ in range(N_OBJECTS):
+        s = Orswot()
+        for m in range(4):
+            s.apply(s.add(m, s.value().derive_add_ctx(0)))
+        states.append(s)
+    base = OrswotBatch.from_scalar(states, uni)
+
+    nodes = []
+    for i in range(N_NODES):
+        writer = None
+        if torn_writer_for is not None and i == torn_writer_for[0]:
+            writer = torn_writer_for[1]
+        nodes.append(ClusterNode(
+            f"n{i}", base, uni, busy_timeout_s=5.0,
+            oplog=OpLog(uni, capacity=1 << 16),
+            gc=GcEngine(GcPolicy(interval_rounds=1)),
+            durability=Durability(tmp_path / f"n{i}", interval_rounds=1,
+                                  retain=2, writer=writer),
+        ))
+    return uni, nodes
+
+
+def _scheds(nodes, seed_base=0):
+    def make_dialer(i):
+        def dial(peer):
+            j = int(peer.peer_id[1:])
+            if nodes[j] is None:
+                raise PeerUnavailableError(f"n{j} is down (killed)")
+            ta, tb = queue_pair(default_timeout=10.0)
+
+            def serve(target=nodes[j], label=f"n{i}"):
+                try:
+                    target.accept(tb, peer_id=label)
+                except InjectedCrash:
+                    raise
+                except Exception:
+                    pass
+                finally:
+                    tb.close()
+
+            threading.Thread(target=serve, daemon=True).start()
+            return ta
+        return dial
+
+    scheds = []
+    for i in range(N_NODES):
+        m = Membership(suspect_after=3, dead_after=8)
+        for j in range(N_NODES):
+            if j != i:
+                m.add(f"n{j}")
+        scheds.append(GossipScheduler(
+            nodes[i], m, make_dialer(i), fanout=2,
+            session_timeout_s=30.0, seed=seed_base + i,
+        ))
+    return scheds
+
+
+def _converge(nodes, scheds, max_sweeps=8):
+    for _ in range(max_sweeps):
+        for i, sched in enumerate(scheds):
+            if nodes[i] is not None:
+                sched.run_round()
+        digests = [n.digest() for n in nodes if n is not None]
+        if all(np.array_equal(digests[0], d) for d in digests[1:]):
+            return digests
+    raise AssertionError("fleet failed to converge within the sweep budget")
+
+
+def _inject(gen, nodes, epoch, next_member):
+    """One epoch of user-shaped writes: Zipf/burst object keys onto
+    live nodes round-robin, fresh member ids per write."""
+    keys = gen.draw(WRITES_PER_EPOCH)
+    live = [n for n in nodes if n is not None]
+    for k, obj in enumerate(keys):
+        node = live[k % len(live)]
+        node.submit_writes([int(obj)], [next_member + k],
+                           actor=int(node.node_id[1:]) + 1)
+    return next_member + len(keys)
+
+
+def _kill_mid_checkpoint(nodes, scheds):
+    """The kill lands at n1's round-end checkpoint — after its
+    sessions ran, between the WAL capture and the snapshot write."""
+    arm_crashes(CrashPlan(at={"durable.checkpoint.n1": 1}))
+    try:
+        with pytest.raises(InjectedCrash):
+            for _ in range(4):
+                scheds[1].run_round()
+    finally:
+        disarm_crashes()
+
+
+def _kill_mid_session(nodes, scheds):
+    """The kill lands right after n1 takes its busy lock for an
+    anti-entropy session — mid-gossip in the narrowest sense."""
+    ta, tb = queue_pair(default_timeout=10.0)
+
+    def serve():
+        try:
+            nodes[0].accept(tb, peer_id="n1")
+        except Exception:
+            pass  # the peer vanished mid-hello — expected
+        finally:
+            tb.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    arm_crashes(CrashPlan(at={"cluster.session.n1": 1}))
+    try:
+        with pytest.raises(InjectedCrash):
+            nodes[1].sync_with("n0", ta)
+    finally:
+        disarm_crashes()
+        ta.close()
+        t.join(timeout=10)
+
+
+def _kill_mid_fold(nodes, scheds):
+    """The kill lands after n1 drained its in-memory op log but before
+    the fold — the drained ops exist only in the WAL."""
+    arm_crashes(CrashPlan(at={"oplog.fold.n1": 1}))
+    try:
+        with pytest.raises(InjectedCrash):
+            nodes[1].submit_writes([0, 1], [90, 91], actor=2)
+    finally:
+        disarm_crashes()
+
+
+def _run_soak(tmp_path, kill, torn_writer_for=None):
+    try:
+        return _run_soak_inner(tmp_path, kill, torn_writer_for)
+    finally:
+        # the tracker is process-global; a later gossip test's round-
+        # health gauges must not fold this fleet's peer entries in
+        obs_convergence.tracker().reset()
+
+
+def _run_soak_inner(tmp_path, kill, torn_writer_for=None):
+    obs_convergence.tracker().reset()
+    uni, nodes = _fleet(tmp_path, torn_writer_for=torn_writer_for)
+    scheds = _scheds(nodes)
+    gen = WorkloadGen(N_OBJECTS, seed=99, zipf_s=1.1, burst_len=2)
+    next_member = 1000
+
+    # warm epochs: traffic + gossip + GC + checkpoints on every node
+    for epoch in range(EPOCHS):
+        next_member = _inject(gen, nodes, epoch, next_member)
+        _converge(nodes, scheds)
+    for node in nodes:
+        assert node.durability.snapshots_written >= 1, node.node_id
+
+    # kill -9 node 1 mid-gossip through its node-scoped crash point
+    next_member = _inject(gen, nodes, EPOCHS, next_member)
+    kill(nodes, scheds)
+    dead_dir = tmp_path / "n1"
+    nodes[1] = None  # nothing cleans up — that is the point
+
+    # the fleet keeps taking writes while n1 is down
+    for epoch in range(2):
+        next_member = _inject(gen, nodes, EPOCHS + 1 + epoch, next_member)
+        _converge(nodes, scheds)
+
+    # restore + rejoin: snapshot -> root verify -> WAL replay -> delta
+    fallbacks_before = tracing.counters().get("sync.full_state_fallback", 0)
+    full_bytes_before = tracing.counters().get("wire.sync.full.bytes", 0)
+    rec = recover(dead_dir)
+    assert rec is not None
+    engine = GcEngine(GcPolicy(interval_rounds=1))
+    if rec.watermark is not None:
+        # resume GC's stability frontier from the persisted clock
+        engine.restore_watermark(rec.watermark)
+    nodes[1] = ClusterNode(
+        "n1", rec.batch, rec.universe, busy_timeout_s=5.0,
+        oplog=OpLog(rec.universe, capacity=1 << 16),
+        applier=rec.applier, gc=engine,
+        durability=Durability(dead_dir, interval_rounds=1, retain=2))
+    scheds[1] = _scheds(nodes, seed_base=10)[1]
+
+    digests = _converge(nodes, scheds)
+    assert all(np.array_equal(digests[0], d) for d in digests[1:])
+    # zero full-state frames shipped during the rejoin
+    assert tracing.counters().get(
+        "sync.full_state_fallback", 0) == fallbacks_before
+    assert tracing.counters().get(
+        "wire.sync.full.bytes", 0) == full_bytes_before
+    return rec
+
+
+def test_durable_soak_kill9_mid_checkpoint_rejoin_delta_only(tmp_path):
+    rec = _run_soak(tmp_path, _kill_mid_checkpoint)
+    # the recovery audit trail is populated
+    assert rec.report.generation >= 1
+    assert rec.report.wall_s > 0
+
+
+def test_durable_soak_kill9_mid_session_rejoin(tmp_path):
+    """The mid-session kill shape: the crash fires right after the
+    busy lock is taken for an anti-entropy session."""
+    rec = _run_soak(tmp_path, _kill_mid_session)
+    assert rec.report.generation >= 1
+
+
+def test_durable_soak_torn_snapshot_falls_back_and_converges(tmp_path):
+    """Short-write disk fault on n1's LAST checkpoint before a
+    mid-fold kill: recovery must reject the torn generation loudly,
+    fall back to the previous one, and the fleet must still converge
+    delta-only (the WAL + delta sync cover the difference — the WAL
+    retains frames back to the OLDEST retained generation precisely
+    for this fallback)."""
+    writer = TornWriter(default_writer, at_write=1 << 30, keep_frac=0.5)
+
+    def kill(nodes, scheds):
+        # tear n1's NEXT checkpoint — its newest generation is then a
+        # short write on disk — and kill it mid-fold right after
+        writer.at_write = writer.calls + 1
+        assert nodes[1].checkpoint() is not None
+        assert writer.injected == 1
+        _kill_mid_fold(nodes, scheds)
+
+    before = tracing.counters()
+    rejected_before = sum(
+        v for k, v in before.items()
+        if k.startswith("durable.snapshot.rejected."))
+    fallback_before = before.get("durable.snapshot.fallbacks", 0)
+    rec = _run_soak(tmp_path, kill, torn_writer_for=(1, writer))
+    assert writer.injected == 1
+    after = tracing.counters()
+    assert sum(
+        v for k, v in after.items()
+        if k.startswith("durable.snapshot.rejected.")) > rejected_before
+    assert after.get("durable.snapshot.fallbacks", 0) > fallback_before
+    assert rec.report.generation >= 1

@@ -34,6 +34,7 @@ from crdt_tpu.oplog.records import derive_rm_ctx
 from crdt_tpu.scalar.orswot import Orswot
 from crdt_tpu.sync import digest as digest_mod
 from crdt_tpu.utils.interning import Universe
+from crdt_tpu.utils.workload import WorkloadGen
 
 pytestmark = [pytest.mark.gc, pytest.mark.slow]
 
@@ -139,8 +140,17 @@ def test_gc_soak_bounded_slots_reclaimed_tombstones_growing_eta():
     tomb_seen = 0
     window = []  # (epoch, members) still live
     next_member = 100
+    # user-shaped background traffic (ROADMAP carried item): Zipf/burst
+    # re-adds of BASE members on skew-drawn objects ride every epoch —
+    # clocks advance on hot keys through the op path (so GC's watermark
+    # and compaction see realistic key skew) without adding/removing
+    # slots, which keeps the bounded-live-slot arithmetic exact
+    workload = WorkloadGen(N_OBJECTS, seed=55, zipf_s=1.2, burst_len=2)
     for epoch in range(EPOCHS):
         t[0] += EPOCH_DT
+        bg = workload.draw(6)
+        nodes[(epoch + 1) % 3].submit_writes(
+            bg, (bg % 4).astype(np.int32), actor=1 + epoch % 3)
         # sliding-window churn on object 0: node 0 mints new members...
         members = list(range(next_member,
                              next_member + NEW_MEMBERS_PER_EPOCH))

@@ -1507,6 +1507,206 @@ def bench_obs_overhead():
     return out
 
 
+def bench_latency():
+    """Latency-observatory stage (budget-skippable): fault-injected
+    50/100/200 ms-RTT delay links driving real sync sessions, reporting
+    session wall vs the transport's measured SRTT, the profiler's
+    network_wait_frac, and write-to-visible lag percentiles; plus the
+    adaptive-vs-static retransmit story (adaptive RTO tighter than the
+    static timer on loopback, retransmit count not regressing at
+    200 ms RTT) and the always-on profiler/stamp overhead gate (<1% of
+    ``bench_e2e_wire`` wall, the bench_obs_overhead discipline)."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.cluster import ResilientTransport, RetryPolicy, queue_pair
+    from crdt_tpu.cluster.faults import (
+        FaultPlan, FaultyTransport, LatencyTransport, latency_pair,
+    )
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.obs.latency import LagTracker, SessionProfile
+    from crdt_tpu.sync.session import SyncSession
+    from crdt_tpu.utils.interning import Universe
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    rng = np.random.RandomState(23)
+    n, a, m, d = (512, 8, 8, 2) if SMALL else (4096, 16, 8, 2)
+    cfg = CrdtConfig(num_actors=a, member_capacity=m, deferred_capacity=d,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+
+    def diverged_pair():
+        import jax
+
+        reps = anti_entropy_fleets(rng, n, a, m, d, 1, base=min(4, m - 2),
+                                   novel=0, deferred_frac=0.25)
+        fa = OrswotBatch(*(jnp.asarray(x) for x in reps[0]))
+        fa = fa.merge(fa)
+        k = max(1, n // 100)
+        rows = np.sort(rng.choice(n, size=k, replace=False)).astype(np.int64)
+        sub = jax.tree_util.tree_map(lambda p: p[rows], fa)
+        sub = sub.apply_add(np.zeros(k, np.int32),
+                            jnp.max(sub.clock, axis=-1) + 1,
+                            np.full(k, 1 << 20, np.int32))
+        fb = jax.tree_util.tree_map(lambda p, s: p.at[rows].set(s), fa, sub)
+        return fa, fb
+
+    def run_session(fa, fb, ta, tb, *, lag_a=None, lag_b=None):
+        sa = SyncSession(fa, uni, peer="lat-b", lag_tracker=lag_a)
+        sb = SyncSession(fb, uni, peer="lat-a", lag_tracker=lag_b)
+        res = {}
+
+        def side_b():
+            res["b"] = sb.sync(tb)
+
+        t = threading.Thread(target=side_b, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        res["a"] = sa.sync(ta)
+        wall = time.perf_counter() - t0
+        t.join(timeout=60.0)
+        assert res["a"].converged and res["b"].converged
+        return res["a"], res["b"], wall
+
+    out = {}
+    policy = RetryPolicy(send_deadline_s=30.0, recv_deadline_s=30.0,
+                         ack_timeout_s=0.1, max_backoff_s=2.0,
+                         retry_budget=256)
+    rtts_ms = (50,) if SMALL else (50, 100, 200)
+    for rtt_ms in rtts_ms:
+        one_way = rtt_ms / 2e3
+        fa, fb = diverged_pair()
+        if rtt_ms == 100:
+            # the delay-REORDER shape (ROADMAP WAN schedules): 20% of
+            # one side's frames ship behind their successor, under the
+            # propagation delay, absorbed by the ARQ below the session
+            qa, qb = queue_pair(default_timeout=30.0)
+            faulty = FaultyTransport(qa, FaultPlan(seed=11, delay=0.2),
+                                     name=f"lat{rtt_ms}-reorder")
+            ta = LatencyTransport(faulty, one_way, name=f"lat{rtt_ms}-a")
+            tb = LatencyTransport(qb, one_way, name=f"lat{rtt_ms}-b")
+        else:
+            ta, tb = latency_pair(one_way, default_timeout=30.0)
+        ra = ResilientTransport(ta, policy, name=f"lat{rtt_ms}-a", seed=1)
+        rb = ResilientTransport(tb, policy, name=f"lat{rtt_ms}-b", seed=2)
+        lag_a, lag_b = LagTracker(), LagTracker()
+        # stamp a write the session will make visible at the peer: the
+        # write-to-visible measurement rides the real sidecar
+        clock_a = np.asarray(fa.clock)
+        lag_a.record_ingest(0, int(clock_a[:, 0].max()))
+        rep_a, _rep_b, wall = run_session(fa, fb, ra, rb,
+                                          lag_a=lag_a, lag_b=lag_b)
+        prof = rep_a.profile
+        srtt = ra.rtt.snapshot()["srtt_s"] or 0.0
+        lag = lag_b.snapshot()["peers"].get("lat-a", {})
+        rtt_s = rtt_ms / 1e3
+        out[f"latency_{rtt_ms}ms_wall_over_rtt"] = round(wall / rtt_s, 3)
+        out[f"latency_{rtt_ms}ms_srtt_over_rtt"] = round(
+            srtt / rtt_s, 3)
+        out[f"latency_{rtt_ms}ms_network_wait_frac"] = round(
+            prof.network_wait_frac, 4)
+        out[f"latency_{rtt_ms}ms_unaccounted_frac"] = round(
+            prof.unaccounted_ns / prof.wall_ns if prof.wall_ns else 0.0, 5)
+        out[f"latency_{rtt_ms}ms_lag_p99_over_rtt"] = round(
+            lag.get("p99_s", 0.0) / rtt_s, 3)
+        log(f"latency: {rtt_ms}ms RTT  session wall {wall*1e3:.0f}ms "
+            f"({wall / rtt_s:.1f}x RTT)  srtt {srtt*1e3:.0f}ms  "
+            f"network_wait {prof.network_wait_frac:.0%}  "
+            f"unaccounted {out[f'latency_{rtt_ms}ms_unaccounted_frac']:.2%}  "
+            f"lag p99 {lag.get('p99_s', 0.0)*1e3:.0f}ms  "
+            f"retransmits {ra.retransmits + rb.retransmits}")
+        # a shaped-RTT session must be wire-dominated and fully
+        # accounted — the acceptance pins (|unaccounted| <= 10% wall)
+        assert abs(prof.unaccounted_ns) <= 0.10 * prof.wall_ns, (
+            f"profiler lost {prof.unaccounted_ns / prof.wall_ns:.1%} "
+            f"of a {rtt_ms}ms-RTT session wall (bar: 10%)"
+        )
+        if rtt_ms == 200:
+            # the adaptive timer (srtt+4var ≈ 0.2s+) must keep spurious
+            # retransmits at the static-0.1s timer's 200ms-RTT level or
+            # better; only the pre-sample opening frames may fire the
+            # static timer, so the count stays O(1) instead of
+            # once-per-frame — the no-regression acceptance pin
+            retr = ra.retransmits + rb.retransmits
+            out["latency_200ms_retransmits"] = retr
+            assert retr <= 6, (
+                f"{retr} retransmits at 200ms RTT — the adaptive timer "
+                "is not suppressing spurious retransmission"
+            )
+
+    # adaptive-vs-static on loopback: after a handful of acked frames
+    # the adaptive RTO must sit well under the static 100ms timer
+    ta, tb = latency_pair(0.0005, default_timeout=10.0)
+    ra = ResilientTransport(ta, policy, name="loop-a", seed=3)
+    rb = ResilientTransport(tb, policy, name="loop-b", seed=4)
+    got = []
+
+    def consume():
+        for _ in range(16):
+            got.append(rb.recv(timeout=10.0))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    for i in range(16):
+        ra.send(b"probe-%02d" % i)
+    t.join(timeout=30.0)
+    out["latency_loopback_rto_s"] = round(ra.current_rto(), 5)
+    out["latency_loopback_rto_over_static"] = round(
+        ra.current_rto() / policy.ack_timeout_s, 4)
+    log(f"latency: loopback adaptive RTO {ra.current_rto()*1e3:.1f}ms vs "
+        f"static {policy.ack_timeout_s*1e3:.0f}ms "
+        f"({out['latency_loopback_rto_over_static']:.2f}x)")
+    assert ra.current_rto() < policy.ack_timeout_s, (
+        "adaptive RTO did not tighten below the static timer on loopback"
+    )
+
+    # always-on overhead: per-op cost of a profile stamp + an ingest
+    # stamp, scaled by a generous per-session stamp count against the
+    # e2e reference — the bench_obs_overhead discipline
+    iters = 20_000 if SMALL else 100_000
+    prof = SessionProfile()
+
+    def per_op(fn):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            fn(i)
+        return (time.perf_counter() - t0) / iters
+
+    def stamp(i):
+        with prof.clock("kernel"):
+            pass
+
+    stamp_s = per_op(stamp)
+    lt = LagTracker()
+    ingest_s = per_op(lambda i: lt.record_ingest(i & 63, i))
+    out["latency_profile_stamp_ns"] = round(stamp_s * 1e9, 1)
+    out["latency_ingest_stamp_ns"] = round(ingest_s * 1e9, 1)
+    e2e_s = _JSON_STATE.get("e2e_wire_s")
+    if e2e_s and e2e_s >= 0.5:
+        if SMALL:
+            n_e2e, chunk, r = 2_000, 1_000, 4
+        else:
+            n_e2e, chunk, r = 1_250_000, 62_500, 8
+        n_chunks = max(2, n_e2e // chunk)
+        if _downshift():
+            n_chunks = min(n_chunks, 2)
+        # ~64 stamps per session and an ingest stamp per bulk submit is
+        # the generous ceiling; both are per BULK call, never per op
+        ops = n_chunks * r * 64
+        frac = ops * max(stamp_s, ingest_s) / e2e_s
+        out["latency_overhead_frac"] = round(frac, 6)
+        log(f"latency: observatory overhead {ops} stamps x "
+            f"{max(stamp_s, ingest_s)*1e9:.0f}ns vs e2e_wire {e2e_s:.2f}s "
+            f"-> {frac:.4%} (bar: <1%)")
+        assert frac < 0.01, (
+            f"latency observatory costs {frac:.2%} of bench_e2e_wire "
+            "wall (bar: <1%) — did stamping regress to per-op?"
+        )
+    return out
+
+
 def bench_fleet_obs():
     """Fleet-observatory cost gate (the obs/fleet satellite): snapshot
     encode + CRDT merge cost as a function of node count, and the
@@ -2573,6 +2773,13 @@ def main():
     obs_res = run_stage("obs_overhead", 15, bench_obs_overhead)
     if obs_res is not None:
         emit(**obs_res)
+    # budget-skippable: the latency observatory — shaped 50/100/200ms
+    # RTT sessions (wall vs SRTT, network_wait_frac, lag percentiles),
+    # adaptive-vs-static retransmit timers, and the <1% stamp-overhead
+    # gate (families collapsed in benchkit/artifacts.py)
+    lat_res = run_stage("latency", 30, bench_latency)
+    if lat_res is not None:
+        emit(**lat_res)
     # budget-skippable: fleet-observatory encode/merge costs + the <5%
     # piggyback-per-session gate (benchkit/artifacts.py ratio-compares
     # the scale-free ms/frac fields round over round)

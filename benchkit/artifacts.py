@@ -132,6 +132,13 @@ def counter_family(name: str) -> str:
         # hit and miss are one family: an all-hit round (every fleet
         # idle) is an improvement, not a vanished code path
         return "sync.digest.cache"
+    if parts[:2] == ["sync", "lag"]:
+        # the lag-sidecar counters (samples + fallback.<reason>)
+        # collapse into ONE family: a same-version in-process run
+        # legitimately never records a capability or clock-domain
+        # fallback — only lag measurement vanishing wholesale is the
+        # signal
+        return "sync.lag"
     if parts[0] == "gc":
         # the causal-GC counters (runs/shrinks/reclaimed_bytes/...)
         # collapse into ONE family: an idle-fleet round legitimately

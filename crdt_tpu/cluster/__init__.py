@@ -24,10 +24,12 @@ from .faults import (  # noqa: F401
     FaultyTransport,
     FlappingDialer,
     InjectedCrash,
+    LatencyTransport,
     TornWriter,
     arm_crashes,
     crash_point,
     disarm_crashes,
+    latency_pair,
 )
 from .gossip import (  # noqa: F401
     ClusterNode,
@@ -70,6 +72,7 @@ __all__ = [
     "crash_point",
     "disarm_crashes",
     "GossipScheduler",
+    "LatencyTransport",
     "Membership",
     "PeerInfo",
     "QueuePairTransport",
@@ -80,5 +83,6 @@ __all__ = [
     "Transport",
     "hello_accept",
     "hello_dial",
+    "latency_pair",
     "queue_pair",
 ]

@@ -159,6 +159,94 @@ class FaultyTransport(Transport):
         self._inner.close()
 
 
+class LatencyTransport(Transport):
+    """``inner`` with a shaped one-way propagation delay — the WAN link.
+
+    The delay-reorder schedules the ROADMAP's windowed-transport item
+    calls for, as a transport wrapper: wrap BOTH endpoints of a pair
+    (:func:`latency_pair`) with the same ``one_way_s`` and every frame
+    arrives one-way late in each direction, so a stop-and-wait exchange
+    pays a full RTT per round trip — exactly what the latency
+    observatory must measure and the windowed ARQ must amortize.
+
+    Mechanics: ``send`` stamps the frame with a monotonic due time
+    (``now + one_way_s + jitter``) and forwards immediately — the
+    sender never blocks on its own link's propagation; ``recv`` strips
+    the stamp and sleeps out the remaining transit before delivering.
+    Stamps are monotonic nanoseconds, so the wrapper is in-process only
+    (the queue-pair substrate, like the fault injector).  Jitter draws
+    from a seeded RNG per endpoint — the schedule is replayable — and
+    can reorder deliveries relative to an unjittered link when combined
+    with :class:`FaultyTransport` delays below it.  Injections count
+    under ``cluster.faults.latency`` per frame, same leak-detection
+    contract as every other injected fault.
+    """
+
+    _STAMP = 8  # u64 big-endian monotonic-ns due time
+
+    def __init__(self, inner: Transport, one_way_s: float, *,
+                 jitter_s: float = 0.0, seed: int = 0,
+                 name: str = "latency"):
+        if one_way_s < 0.0:
+            raise ValueError(f"one_way_s {one_way_s} < 0")
+        if jitter_s < 0.0:
+            raise ValueError(f"jitter_s {jitter_s} < 0")
+        self._inner = inner
+        self.one_way_s = float(one_way_s)
+        self.jitter_s = float(jitter_s)
+        self.name = name
+        self._rng = random.Random(seed)
+        self.injected = 0
+
+    def send(self, frame: bytes) -> None:
+        import struct
+        import time
+
+        delay = self.one_way_s
+        if self.jitter_s:
+            delay += self.jitter_s * self._rng.random()
+        due = time.monotonic_ns() + int(delay * 1e9)
+        self.injected += 1
+        tracing.count("cluster.faults.latency")
+        self._inner.send(struct.pack(">Q", due) + bytes(frame))
+
+    def recv(self, timeout: Optional[float] = None) -> bytes:
+        import struct
+        import time
+
+        env = self._inner.recv(timeout)
+        if len(env) < self._STAMP:
+            return bytes(env)  # a truncation fault ate the stamp:
+            #                    deliver what's left, the ARQ's problem
+        (due,) = struct.unpack_from(">Q", env)
+        wait = (due - time.monotonic_ns()) / 1e9
+        if wait > 0:
+            time.sleep(wait)
+        return bytes(env[self._STAMP:])
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+def latency_pair(one_way_s: float, *, jitter_s: float = 0.0,
+                 seed: int = 0, default_timeout: float = 120.0):
+    """Two connected in-process endpoints over a shaped link: a
+    :func:`~crdt_tpu.cluster.transport.queue_pair` with both ends
+    wrapped in :class:`LatencyTransport`, so the pair behaves like a
+    ``2·one_way_s``-RTT WAN path.  The bench's 50/100/200 ms schedules
+    and the 3-node lag fleet in ``tests/test_latency.py`` build on
+    this."""
+    from .transport import queue_pair
+
+    a, b = queue_pair(default_timeout=default_timeout)
+    return (
+        LatencyTransport(a, one_way_s, jitter_s=jitter_s, seed=seed,
+                         name="latency-a"),
+        LatencyTransport(b, one_way_s, jitter_s=jitter_s, seed=seed + 1,
+                         name="latency-b"),
+    )
+
+
 class FlappingDialer:
     """A dialer whose k-th attempt succeeds iff ``schedule[k % len]``
     is true — deterministic dial-level flapping.

@@ -105,11 +105,23 @@ class ClusterNode:
                  gc=None,
                  digest_tree: bool = False,
                  durability=None,
-                 applier=None):
+                 applier=None,
+                 lag_tracker=None):
+        from ..obs import latency as obs_latency
+
         self.node_id = node_id
         self.universe = universe
         self.full_state_threshold = full_state_threshold
         self.busy_timeout_s = busy_timeout_s
+        #: the node's :class:`crdt_tpu.obs.latency.LagTracker` — always
+        #: on (host-side deques, bounded): every ingested write is
+        #: stamped at :meth:`submit_ops`, every session ships/receives
+        #: the lag sidecar, and every op-log fold re-checks visibility.
+        #: Private per node by default so in-process fleets keep their
+        #: (origin, observer) pairs apart; pass one to share or bound
+        #: differently.
+        self.lag_tracker = lag_tracker if lag_tracker is not None \
+            else obs_latency.LagTracker()
         #: a :class:`crdt_tpu.durable.Durability`; when set, every
         #: ingested op batch is WAL-appended BEFORE the in-memory fold
         #: (a write acknowledged to the caller survives kill -9), and
@@ -233,6 +245,10 @@ class ClusterNode:
                 log.append(ops)
         else:
             log.append(ops)
+        # write-to-visible lag starts HERE: stamp the batch's dot
+        # frontier with this node's monotonic clock (bounded per-actor
+        # table; the stamps ride the next session's lag sidecar)
+        self.lag_tracker.record_ingest_batch(ops)
         if self._busy.acquire(blocking=False):
             try:
                 self._drain_ops_locked()
@@ -321,6 +337,16 @@ class ClusterNode:
             applied=report.applied, duplicates=report.duplicates,
             parked=report.still_parked,
         )
+        if report.applied:
+            # the fold advanced visibility: peer writes parked in the
+            # lag tracker (sidecar entries whose dots arrived via the
+            # op piggyback rather than state sync) are measurable now
+            import numpy as np
+
+            clock = getattr(batch, "clock", None)
+            if clock is not None:
+                self.lag_tracker.observe_visibility(
+                    np.asarray(clock).max(axis=0))
 
     def _op_outbox(self) -> bytes:
         """Session piggyback source: everything queued while the
@@ -371,6 +397,7 @@ class ClusterNode:
                 full_state_threshold=self.full_state_threshold,
                 observatory=self.observatory,
                 digest_tree=self.digest_tree,
+                lag_tracker=self.lag_tracker,
                 **op_hooks,
             )
             report = session.sync(transport)
@@ -623,6 +650,7 @@ class GossipScheduler:
         tracing.count("cluster.rounds")
         report = RoundReport(round_no=round_no)
         results_lock = threading.Lock()
+        round_t0 = time.monotonic()
         with tracing.span("cluster.round"):
             ranked = self.rank_peers(round_no)
             report.ranked = [p.peer_id for p in ranked]
@@ -652,6 +680,13 @@ class GossipScheduler:
             skipped_busy=list(report.skipped_busy),
         )
         self._publish_round_health(report)
+        # the convergence SLO: a round "meets" it when every attempted
+        # session succeeded AND the round finished within the lag
+        # tracker's budget — published as sync.slo.converged_frac over
+        # a bounded window of recent rounds
+        self.node.lag_tracker.observe_round(
+            converged=not report.failed,
+            wall_s=time.monotonic() - round_t0)
         # capacity sample per round: the sessions above may have merged
         # in peer members (plane growth) or drained queued ops, so the
         # occupancy gauges / growth ETAs refresh on the post-round state

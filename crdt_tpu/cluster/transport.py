@@ -37,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import random
+import re
 import socket
 import struct
 import time
@@ -52,6 +53,8 @@ from ..error import (
     TransportFrameError,
 )
 from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
+from ..obs.latency import RttEstimator
 from ..utils import tracing
 
 
@@ -221,6 +224,18 @@ class RetryPolicy:
     guarantee: a dead peer costs at most
     ``retry_budget × max_backoff_s`` seconds before
     :class:`~crdt_tpu.error.PeerUnavailableError`.
+
+    With ``adaptive`` (the default), the retransmit timer tracks the
+    link's measured round trip instead of the static ``ack_timeout_s``:
+    the transport's Jacobson/Karels estimator yields ``srtt +
+    4·rttvar``, clamped into ``[min_rto_s, max_backoff_s]`` — so a
+    loopback link retransmits in milliseconds instead of waiting a
+    WAN-sized static timer, and a 200 ms-RTT link stops spuriously
+    retransmitting frames whose acks are merely in flight.  Until the
+    first sample the static ``ack_timeout_s`` applies (clamped to the
+    same bounds), and the bounds are HARD either way — an estimator
+    poisoned by a clock step can never push the timer outside the
+    policy (pinned in ``tests/test_latency.py``).
     """
 
     send_deadline_s: float = 30.0
@@ -230,6 +245,8 @@ class RetryPolicy:
     max_backoff_s: float = 2.0
     jitter: float = 0.25
     retry_budget: int = 64
+    adaptive: bool = True
+    min_rto_s: float = 0.01
 
 
 _DATA = 0x01
@@ -294,6 +311,14 @@ class ResilientTransport(Transport):
     Per-instance tallies (``retransmits``, ``duplicates``, ``corrupt``,
     ``transient_errors``) mirror the ``cluster.transport.*`` counters
     for tests that need this link's numbers rather than the process's.
+
+    Every clean first-transmission ack also feeds a Jacobson/Karels
+    :class:`~crdt_tpu.obs.latency.RttEstimator` (``rtt`` — Karn's rule:
+    retransmitted frames never sample, their ack could answer either
+    copy), published per link as ``cluster.transport.<link>.rtt_*``
+    gauges and, under ``policy.adaptive``, driving the retransmit timer
+    (:meth:`current_rto`) and the close-drain quiet window in place of
+    the static ``ack_timeout_s``.
     """
 
     def __init__(self, inner: Transport,
@@ -311,6 +336,13 @@ class ResilientTransport(Transport):
         self.duplicates = 0
         self.corrupt = 0
         self.transient_errors = 0
+        #: the link's RTT estimator — sampled by the ack loop, read by
+        #: the adaptive retransmit timer and the rtt_* gauges
+        self.rtt = RttEstimator()
+        # metric-label form of the link name: one dotted segment
+        # (cluster.transport.<label>.rtt_srtt_s must stay one family
+        # per link for the namespace manifest)
+        self._label = re.sub(r"[^A-Za-z0-9_]", "_", name) or "link"
 
     # -- budget / backoff ----------------------------------------------------
 
@@ -322,9 +354,36 @@ class ResilientTransport(Transport):
                 f"({self.policy.retry_budget}) exhausted ({reason})"
             )
 
+    def current_rto(self) -> float:
+        """The retransmit timer in force: ``srtt + 4·rttvar`` clamped
+        to ``[min_rto_s, max_backoff_s]`` once the estimator has a
+        sample (and ``policy.adaptive``), else the static
+        ``ack_timeout_s`` clamped to the same bounds — the timer can
+        never leave the policy's envelope."""
+        p = self.policy
+        if not p.adaptive:
+            return p.ack_timeout_s
+        rto = self.rtt.rto(p.min_rto_s, p.max_backoff_s,
+                           default_s=p.ack_timeout_s)
+        return p.ack_timeout_s if rto is None else rto
+
+    def _sample_rtt(self, sample_s: float) -> None:
+        self.rtt.observe(sample_s)
+        snap = self.rtt.snapshot()
+        reg = obs_metrics.registry()
+        reg.gauge_set(f"cluster.transport.{self._label}.rtt_srtt_s",
+                      snap["srtt_s"] or 0.0)
+        reg.gauge_set(f"cluster.transport.{self._label}.rtt_rttvar_s",
+                      snap["rttvar_s"] or 0.0)
+        reg.gauge_set(f"cluster.transport.{self._label}.rtt_rto_s",
+                      self.current_rto())
+        reg.gauge_set(f"cluster.transport.{self._label}.rtt_samples",
+                      snap["samples"])
+
     def _delay(self, attempt: int) -> float:
         p = self.policy
-        d = min(p.max_backoff_s, p.ack_timeout_s * (p.backoff_factor ** attempt))
+        d = min(p.max_backoff_s,
+                self.current_rto() * (p.backoff_factor ** attempt))
         return d * (1.0 + p.jitter * (2.0 * self._rng.random() - 1.0))
 
     def _transient(self, leg: str, err: TransportError) -> None:
@@ -386,6 +445,7 @@ class ResilientTransport(Transport):
         attempt = 0
         while True:
             delay = self._delay(attempt)
+            t_sent = time.monotonic()
             try:
                 self._inner.send(env)
             except TransportError as e:
@@ -393,6 +453,10 @@ class ResilientTransport(Transport):
                 time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
             else:
                 if self._await_ack(seq, delay, deadline):
+                    if attempt == 0:
+                        # Karn's rule: only a frame transmitted exactly
+                        # once yields an unambiguous round-trip sample
+                        self._sample_rtt(time.monotonic() - t_sent)
                     return
                 self.retransmits += 1
                 tracing.count("cluster.transport.retransmits")
@@ -481,16 +545,21 @@ class ResilientTransport(Transport):
         # _on_data) until the link goes quiet for ~2 retransmit timers,
         # the peer closes, or the cap elapses.  Over a lossless inner
         # transport (TCP) the peer closes almost immediately and the
-        # drain costs one quiet window at most.
-        p = self.policy
-        quiet_s = min(2.0 * p.ack_timeout_s, 1.0)
+        # drain costs one quiet window at most.  The quiet window
+        # follows the ADAPTIVE timer (the peer's retransmit would
+        # arrive within its RTO, which tracks ours): a loopback link
+        # drains in milliseconds; the policy bounds still cap the
+        # window at the static drain's 1 s worst case, so the PR 5
+        # TIME_WAIT fix keeps its wall-time envelope.
+        rto = self.current_rto()
+        quiet_s = min(2.0 * rto, 1.0)
         cap = time.monotonic() + 3.0 * quiet_s
         last_activity = time.monotonic()
         while (time.monotonic() < cap
                and time.monotonic() - last_activity < quiet_s):
             try:
                 env = self._inner.recv(timeout=min(
-                    p.ack_timeout_s, max(cap - time.monotonic(), 0.001)))
+                    rto, max(cap - time.monotonic(), 0.001)))
             except SyncTimeoutError:
                 continue
             except TransportError:

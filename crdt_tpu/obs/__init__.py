@@ -24,6 +24,12 @@ first-class answer, in five parts:
   gauges LWW, histograms bucket-wise), CRC-guarded snapshot frames
   piggybacked on gossip sessions or all-gathered over a mesh, the
   ``/fleet`` aggregate, and the trace-ID timeline stitcher.
+* :mod:`crdt_tpu.obs.latency` — the time plane: per-session
+  critical-path profiles (serialize / network-wait / kernel, with the
+  unaccounted residual as its own alertable series), Jacobson/Karels
+  transport RTT estimation feeding adaptive retransmit timers, and
+  write-to-visible replication lag per (origin, observer) pair with a
+  convergence-SLO window.
 * :mod:`crdt_tpu.obs.capacity` — the memory plane: dense-plane
   occupancy samples (jitted kernels in
   :mod:`crdt_tpu.batch.occupancy`) turned into ``crdt_tpu_capacity_*``
@@ -37,7 +43,7 @@ for it.  PERF.md "Observability" documents naming conventions and how
 to read the flight recorder after a failed sync.
 """
 
-from . import capacity, convergence, events, fleet, metrics  # noqa: F401
+from . import capacity, convergence, events, fleet, latency, metrics  # noqa: F401
 from .capacity import CapacityTracker, Occupancy, capacity_tracker  # noqa: F401
 from .convergence import ConvergenceTracker, tracker  # noqa: F401
 from .events import FlightRecorder, new_session_id, record, recorder  # noqa: F401
@@ -46,6 +52,12 @@ from .fleet import (  # noqa: F401
     FleetSnapshot,
     observatory,
     stitch_trace,
+)
+from .latency import (  # noqa: F401
+    LagTracker,
+    RttEstimator,
+    SessionProfile,
+    lag_tracker,
 )
 from .metrics import (  # noqa: F401
     Counter,
@@ -64,9 +76,13 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "LagTracker",
     "MetricsRegistry",
     "Occupancy",
+    "RttEstimator",
+    "SessionProfile",
     "capacity_tracker",
+    "lag_tracker",
     "new_session_id",
     "observatory",
     "record",

@@ -309,8 +309,10 @@ class CapacityTracker:
         for ev in rec.snapshot(kind="executor.regrow"):
             f = ev.get("fields", {})
             out.append({
-                "ts": ev["ts"],
-                "wall": ev["wall"],
+                # mono_ts is the duration-math stamp (wall-skew immune);
+                # wall_ts rides along for human display
+                "mono_ts": ev["mono_ts"],
+                "wall_ts": ev["wall_ts"],
                 "schedule": f.get("schedule"),
                 "member_capacity": (f.get("member_capacity_before"),
                                     f.get("member_capacity")),

@@ -4,7 +4,9 @@ Metrics answer "how much, how fast"; they cannot answer "what happened
 just before this sync failed".  The flight recorder keeps the last N
 structured events — sync phase transitions, digest collisions,
 full-state fallbacks, ``SyncProtocolError``\\s, native-parse fallback
-reasons, wire-loop stalls — stamped with monotonic time and, where one
+reasons, wire-loop stalls — stamped with BOTH clocks (``wall_ts`` for
+display and fleet-merge ordering, ``mono_ts`` for skew-immune duration
+math) and, where one
 exists, the :class:`~crdt_tpu.sync.session.SyncSession` session ID, so
 a failed session's whole trajectory can be read back from ``/events``
 (or :func:`snapshot` in a debugger) after the fact.
@@ -43,11 +45,19 @@ class FlightRecorder:
                **fields) -> None:
         """Append one event.  ``kind`` is a dotted event family
         (``sync.phase``, ``wireloop.stall``); ``session`` threads a sync
-        session ID through; ``fields`` is free-form JSON-ready detail."""
+        session ID through; ``fields`` is free-form JSON-ready detail.
+
+        Two timestamps by design: ``wall_ts`` (``time.time()``) is for
+        human display and the fleet-merge ordering key; ``mono_ts``
+        (``time.monotonic()``) is for cross-event DURATION math
+        (``regrow_timeline``, the latency profiler) — immune to
+        wall-clock skew and NTP steps, and deliberately kept OUT of the
+        fleet-merge key, since monotonic clocks from different
+        processes share no epoch."""
         ev = {
             "seq": 0,  # patched under the lock
-            "ts": time.monotonic(),
-            "wall": time.time(),
+            "mono_ts": time.monotonic(),
+            "wall_ts": time.time(),
             "kind": kind,
         }
         if session is not None:

@@ -229,9 +229,35 @@ class FleetSnapshot:
                 acc["max"] = ETA_NOT_GROWING
         return out
 
+    def fleet_lag(self) -> Dict[str, dict]:
+        """The write-to-visible lag gauges (``sync.peer.<peer>.lag_*``,
+        :mod:`crdt_tpu.obs.latency`) reduced fleet-wide: per leaf
+        (``lag_p50_s`` / ``lag_p99_s`` / ``lag_outstanding`` /
+        ``lag_current_s``), the MAX over every (node, origin-peer)
+        series plus the series count.  The LWW fleet-gauge read answers
+        "some pair's lag"; an operator asks "the WORST write-to-visible
+        lag anywhere in the fleet" — that is the max, and a fleet that
+        quiesced reads 0 on ``lag_current_s`` here exactly when every
+        pair does."""
+        out: Dict[str, dict] = {}
+        for sl in self.slices.values():
+            for name, entry in sl.get("gauges", {}).items():
+                parts = name.split(".")
+                if len(parts) != 4 or parts[:2] != ["sync", "peer"] \
+                        or not parts[3].startswith("lag_"):
+                    continue
+                v = float(entry[2])
+                acc = out.setdefault(parts[3], {"max": 0.0, "series": 0})
+                acc["max"] = max(acc["max"], v)
+                acc["series"] += 1
+        return out
+
     def events(self, node: Optional[str] = None) -> List[dict]:
         """Retained flight-recorder events, each annotated with its
-        ``node``, ordered by wall-clock then per-process seq."""
+        ``node``, ordered by wall-clock then per-process seq.  The
+        ordering key is ``wall_ts`` deliberately — the per-process
+        ``mono_ts`` (duration math) shares no epoch across nodes, so
+        it stays out of the merge/ordering key."""
         out = []
         for nid, sl in self.slices.items():
             if node is not None and nid != node:
@@ -240,7 +266,8 @@ class FleetSnapshot:
                 ev = dict(ev)
                 ev["node"] = nid
                 out.append(ev)
-        out.sort(key=lambda e: (e.get("wall", 0.0), e.get("seq", 0)))
+        out.sort(key=lambda e: (e.get("wall_ts", e.get("wall", 0.0)),
+                                e.get("seq", 0)))
         return out
 
     def to_json(self) -> dict:
@@ -255,6 +282,7 @@ class FleetSnapshot:
                 "gauges": self.fleet_gauges(),
                 "histograms": self.fleet_histograms(),
                 "capacity": self.fleet_capacity(),
+                "lag": self.fleet_lag(),
             },
         }
 
@@ -478,6 +506,17 @@ def fleet_prometheus_text(snap: FleetSnapshot,
             rendered = str(int(v)) if v.is_integer() else repr(v)
             lines.append(f"# TYPE {base}_{reduction} gauge")
             lines.append(f"{base}_{reduction} {rendered}")
+    # write-to-visible lag gets the worst-pair reduction (fleet_lag):
+    # one scrape answers "the worst replication lag anywhere", and the
+    # quiescence pin — lag_current_s_max == 0 — holds fleet-wide
+    # exactly when it holds for every (node, origin) pair
+    lag = snap.fleet_lag()
+    for leaf in sorted(lag):
+        base = f"{prefix}_sync_{_sanitize(leaf)}_max"
+        v = float(lag[leaf]["max"])
+        rendered = str(int(v)) if v.is_integer() else repr(v)
+        lines.append(f"# TYPE {base} gauge")
+        lines.append(f"{base} {rendered}")
     hists = snap.fleet_histograms()
     import math
 

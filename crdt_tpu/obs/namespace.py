@@ -140,6 +140,46 @@ NAMESPACE: tuple[NameSpec, ...] = (
     NameSpec("sync.peer.*.diverged_subtrees", "gauge",
              "widest diverged internal frontier the last tree descent "
              "saw (0 = converged or flat-mode peer); urgency tiebreak"),
+    # -- latency observatory (obs/latency.py, sync/session.py,
+    # cluster/transport.py) ---------------------------------------------------
+    NameSpec("sync.peer.*.network_wait_frac", "gauge",
+             "fraction of the last session's wall spent blocked on the "
+             "wire (~1 = RTT-bound, pipelining wins)"),
+    NameSpec("sync.peer.*.unaccounted_frac", "gauge",
+             "fraction of the last session's wall the profiler could "
+             "not attribute — large values are a profiler finding"),
+    NameSpec("sync.profile.*", "histogram",
+             "per-session critical-path decomposition, seconds "
+             "(wall/serialize/network_wait/kernel/other/unaccounted)"),
+    NameSpec("sync.peer.*.lag_p50_s", "gauge",
+             "median write-to-visible replication lag from this origin "
+             "peer, over the bounded sample window"),
+    NameSpec("sync.peer.*.lag_p99_s", "gauge",
+             "p99 write-to-visible replication lag from this origin peer"),
+    NameSpec("sync.peer.*.lag_outstanding", "gauge",
+             "sidecar-stamped peer writes not yet visible locally"),
+    NameSpec("sync.peer.*.lag_current_s", "gauge",
+             "age of the oldest shipped-but-not-yet-visible peer write "
+             "(0 = quiescent: everything stamped is visible)"),
+    NameSpec("sync.lag.samples", "counter",
+             "write-to-visible lag measurements taken (all peers)"),
+    NameSpec("sync.lag.fallback.*", "counter",
+             "lag sidecars degraded by reason (capability = peer too "
+             "old to speak the sidecar; clock_domain = cross-process "
+             "monotonic stamps, not comparable)"),
+    NameSpec("sync.slo.converged_frac", "gauge",
+             "fraction of recent gossip rounds that converged within "
+             "the SLO budget (obs/latency.py LagTracker.observe_round)"),
+    NameSpec("cluster.transport.*.rtt_srtt_s", "gauge",
+             "per-link Jacobson/Karels smoothed RTT over ARQ ack "
+             "round-trips (Karn-filtered)"),
+    NameSpec("cluster.transport.*.rtt_rttvar_s", "gauge",
+             "per-link RTT mean deviation"),
+    NameSpec("cluster.transport.*.rtt_rto_s", "gauge",
+             "per-link adaptive retransmit timer srtt + 4*rttvar, "
+             "clamped to [min_rto_s, max_backoff_s]"),
+    NameSpec("cluster.transport.*.rtt_samples", "gauge",
+             "per-link RTT samples folded into the estimator"),
     # -- cluster runtime (cluster/membership.py, cluster/gossip.py,
     # cluster/transport.py, cluster/faults.py) -------------------------------
     NameSpec("cluster.peers.*", "gauge",

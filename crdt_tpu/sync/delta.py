@@ -60,11 +60,12 @@ FRAME_HELLO = 0x04
 FRAME_FLEET = 0x05
 FRAME_OPS = 0x06
 FRAME_TREE = 0x07
+FRAME_LAG = 0x08
 
 _FRAME_NAMES = {FRAME_DIGEST: "digest", FRAME_DELTA: "delta",
                 FRAME_FULL: "full", FRAME_HELLO: "hello",
                 FRAME_FLEET: "fleet", FRAME_OPS: "ops",
-                FRAME_TREE: "tree"}
+                FRAME_TREE: "tree", FRAME_LAG: "lag"}
 _HEADER = struct.Struct("<BBIQ")
 
 
@@ -145,27 +146,31 @@ class HelloInfo(NamedTuple):
     oplog: bool
     ver: int
     digest_tree: bool
+    lag: bool = False
 
 
 def encode_hello_frame(trace: str, node: str, fleet_obs: bool,
                        oplog: bool = False, digest_tree: bool = False,
+                       lag: bool = False,
                        ver: int = PROTOCOL_VERSION) -> bytes:
     """A HELLO frame — the session-opening handshake: this side's
     trace-ID proposal (both peers adopt the lexicographic min, so the
     two halves of one session share ONE fleet-unique ID), its node
-    label, the protocol version it speaks, and three capability flags —
+    label, the protocol version it speaks, and four capability flags —
     piggybacked fleet-observability snapshots, piggybacked op batches,
-    and digest-tree descent (each only happens when BOTH peers
-    advertise it, which keeps the lock-step protocol symmetric; an
-    older peer simply never sees the key).  The hello itself ships at
-    ``BASELINE_VERSION`` — it precedes the negotiation every later
-    frame's version byte follows."""
+    digest-tree descent, and the write-to-visible lag sidecar (each
+    only happens when BOTH peers advertise it, which keeps the
+    lock-step protocol symmetric; an older peer simply never sees the
+    key).  The hello itself ships at ``BASELINE_VERSION`` — it
+    precedes the negotiation every later frame's version byte
+    follows."""
     import json
 
     payload = json.dumps(
         {"trace": str(trace), "node": str(node),
          "fleet_obs": bool(fleet_obs), "oplog": bool(oplog),
-         "ver": int(ver), "digest_tree": bool(digest_tree)},
+         "ver": int(ver), "digest_tree": bool(digest_tree),
+         "lag": bool(lag)},
         sort_keys=True, separators=(",", ":"),
     ).encode("utf-8")
     return _frame(FRAME_HELLO, payload, version=BASELINE_VERSION)
@@ -175,8 +180,8 @@ def decode_hello_payload(payload: bytes) -> HelloInfo:
     """The :class:`HelloInfo` of a HELLO payload.  Labels are bounded
     defensively — a garbage hello must yield a rejection, not an
     unbounded event field.  A hello without the ``oplog`` /
-    ``digest_tree`` / ``ver`` keys (an older peer) reads as "no
-    capability, v2", so mixed fleets degrade to flat state-only
+    ``digest_tree`` / ``lag`` / ``ver`` keys (an older peer) reads as
+    "no capability, v2", so mixed fleets degrade to flat state-only
     sessions instead of rejecting."""
     import json
 
@@ -188,11 +193,12 @@ def decode_hello_payload(payload: bytes) -> HelloInfo:
         oplog = bool(doc.get("oplog", False))
         ver = int(doc.get("ver", BASELINE_VERSION))
         digest_tree = bool(doc.get("digest_tree", False))
+        lag = bool(doc.get("lag", False))
     except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
         raise SyncProtocolError(f"malformed hello payload: {e}") from None
     if not trace:
         raise SyncProtocolError("hello payload carries an empty trace ID")
-    return HelloInfo(trace, node, fleet_obs, oplog, ver, digest_tree)
+    return HelloInfo(trace, node, fleet_obs, oplog, ver, digest_tree, lag)
 
 
 def encode_fleet_frame(snapshot_frame: bytes,
@@ -226,6 +232,49 @@ def decode_ops_sync_payload(payload: bytes) -> bytes:
     """The nested op-batch frame from an OPS payload (validated by the
     oplog codec's own decode, not here)."""
     return bytes(payload)
+
+
+def encode_lag_frame(entries, proc_tag: str,
+                     version: int | None = None) -> bytes:
+    """A LAG frame — the write-to-visible sidecar: this origin's
+    bounded ingest-stamp table as ``(actor, counter, mono_ns)``
+    triples, plus the origin's monotonic clock-domain tag (monotonic
+    stamps are only comparable within one process; the receiver drops
+    foreign-domain entries loudly instead of publishing a lie).  Rides
+    a converged session only when BOTH hellos advertised the ``lag``
+    capability — the 23 B/op op-frame wire format is untouched."""
+    proc = str(proc_tag).encode("utf-8")[:255]
+    parts = [struct.pack("<B", len(proc)), proc,
+             struct.pack("<I", len(entries))]
+    for actor, counter, mono_ns in entries:
+        parts.append(struct.pack("<HQq", int(actor), int(counter),
+                                 int(mono_ns)))
+    return _frame(FRAME_LAG, b"".join(parts), version=version)
+
+
+def decode_lag_payload(payload: bytes) -> tuple[str, list]:
+    """``(origin_proc_tag, [(actor, counter, mono_ns), ...])`` from a
+    LAG payload."""
+    try:
+        (plen,) = struct.unpack_from("<B", payload, 0)
+        off = 1
+        proc = payload[off:off + plen].decode("utf-8")
+        if len(payload[off:off + plen]) != plen:
+            raise ValueError("proc tag truncated")
+        off += plen
+        (n,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        entry = struct.Struct("<HQq")
+        if off + n * entry.size != len(payload):
+            raise ValueError(
+                f"expected {n} entries, payload holds "
+                f"{(len(payload) - off) // entry.size}"
+            )
+        entries = [entry.unpack_from(payload, off + i * entry.size)
+                   for i in range(n)]
+    except (struct.error, ValueError, UnicodeDecodeError) as e:
+        raise SyncProtocolError(f"malformed lag payload: {e}") from None
+    return proc, entries
 
 
 # ---- digest frames ---------------------------------------------------------

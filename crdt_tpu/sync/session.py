@@ -40,6 +40,12 @@ Phases::
                            observatory: each side ships its merged
                            fleet-telemetry snapshot and folds the
                            peer's in (:mod:`crdt_tpu.obs.fleet`)
+    5. lag sidecar       — only when BOTH hellos advertised the ``lag``
+                           capability: each side ships its bounded
+                           origin ingest-stamp table and measures every
+                           peer write the converged batch now witnesses
+                           (:mod:`crdt_tpu.obs.latency` — the
+                           write-to-visible replication-lag plane)
 
 Wire cost is O(divergence): an idempotent re-sync costs one digest
 exchange and zero delta bytes.  Every phase feeds the always-on
@@ -55,6 +61,14 @@ with that ID — read them back from ``GET /events?session=...`` or
 histograms when tracing is enabled, and per-peer divergence /
 rounds-to-converge / staleness gauges feed
 :mod:`crdt_tpu.obs.convergence` always.
+
+Every session additionally carries a critical-path profile
+(:class:`~crdt_tpu.obs.latency.SessionProfile`, on
+``SyncReport.profile``): integer-nanosecond accounting of the wall
+into serialize / network-wait / kernel / other, with the unaccounted
+residual published as its own ``sync.profile.unaccounted_s`` series
+and the per-peer ``sync.peer.<peer>.network_wait_frac`` gauge the
+gossip scheduler and the windowed-ARQ bench read.
 """
 
 from __future__ import annotations
@@ -67,6 +81,7 @@ import numpy as np
 from ..error import SyncProtocolError, TransportError
 from ..obs import convergence as obs_convergence
 from ..obs import events as obs_events
+from ..obs.latency import SessionProfile
 from ..utils import tracing
 from . import delta as delta_mod
 from . import digest as digest_mod
@@ -79,6 +94,7 @@ from .delta import (
     FRAME_FLEET,
     FRAME_FULL,
     FRAME_HELLO,
+    FRAME_LAG,
     FRAME_OPS,
     FRAME_TREE,
     PROTOCOL_VERSION,
@@ -89,6 +105,7 @@ from .delta import (
     decode_frame,
     decode_full_payload,
     decode_hello_payload,
+    decode_lag_payload,
     decode_ops_sync_payload,
     decode_tree_level_payload,
     decode_tree_root_payload,
@@ -98,6 +115,7 @@ from .delta import (
     encode_fleet_frame,
     encode_full_frame,
     encode_hello_frame,
+    encode_lag_frame,
     encode_ops_sync_frame,
     encode_tree_level_frame,
     encode_tree_root_frame,
@@ -132,13 +150,21 @@ class SyncReport:
     tree_frames_sent: int = 0
     tree_levels: int = 0           # descent level exchanges after the root
     subtrees_diverged: int = 0     # widest diverged internal frontier
+    lag_bytes_sent: int = 0        # write-to-visible sidecar frame
+    lag_entries_sent: int = 0      # origin stamps shipped in the sidecar
+    lag_entries_received: int = 0  # peer stamps accepted for measurement
+    #: the session's critical-path decomposition (integer-nanosecond
+    #: accounting: serialize / network-wait / kernel / other, plus the
+    #: unaccounted residual) — see :class:`crdt_tpu.obs.latency.
+    #: SessionProfile`; None only on reports not produced by ``sync``
+    profile: Optional[SessionProfile] = None
 
     @property
     def bytes_sent(self) -> int:
         return (self.digest_bytes_sent + self.delta_bytes_sent
                 + self.full_bytes_sent + self.hello_bytes_sent
                 + self.fleet_bytes_sent + self.ops_bytes_sent
-                + self.tree_bytes_sent)
+                + self.tree_bytes_sent + self.lag_bytes_sent)
 
     def delta_ratio(self, full_state_bytes: int) -> Optional[float]:
         """Payload bytes this side shipped (delta + any full-state
@@ -187,7 +213,8 @@ class SyncSession:
                  op_sink: Optional[Callable[[bytes], None]] = None,
                  capacity_tracker=None,
                  digest_tree: bool = False,
-                 protocol_version: Optional[int] = None):
+                 protocol_version: Optional[int] = None,
+                 lag_tracker=None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -254,9 +281,21 @@ class SyncSession:
         #: post-hello frame's version byte (None until the hello lands)
         self.negotiated_version: Optional[int] = None
         self._peer_digest_tree = False
+        #: a :class:`crdt_tpu.obs.latency.LagTracker`; when set AND the
+        #: peer's hello advertises the ``lag`` capability too, a
+        #: converged session closes with a LAG sidecar exchange (the
+        #: origin ingest-stamp tables, both ways) and measures every
+        #: newly visible peer write — the write-to-visible lag plane.
+        #: Mixed fleets degrade loudly (``sync.lag.fallback.*``) like
+        #: every other capability.
+        self.lag_tracker = lag_tracker
+        self._peer_lag = False
         self._user_digest_fn = digest_fn
         self._digest_fn = digest_fn or self._canonical_digest
         self._applier = OrswotDeltaApplier(universe)
+        #: per-sync critical-path profile; re-created by each
+        #: :meth:`sync` call and attached to its report
+        self._prof = SessionProfile()
 
     def _canonical_digest(self, batch) -> np.ndarray:
         """The salted canonical digest vector (memoized per batch
@@ -278,7 +317,13 @@ class SyncSession:
 
     def _send(self, send, frame: bytes, report: SyncReport, leg: str,
               objects: int) -> None:
-        send(frame)
+        # a blocking send IS network wait: over the ARQ transport it
+        # returns only when the peer acked, over a raw stream when the
+        # kernel took the bytes — either way the session is wire-bound
+        # for the duration
+        with self._prof.clock("network"):
+            send(frame)
+        self._prof.frames_sent += 1
         tracing.record_sync(leg, nbytes=len(frame), objects=objects)
         if leg == "digest":
             report.digest_bytes_sent += len(frame)
@@ -293,12 +338,15 @@ class SyncSession:
             report.tree_frames_sent += 1
         elif leg == "ops":
             report.ops_bytes_sent += len(frame)
+        elif leg == "lag":
+            report.lag_bytes_sent += len(frame)
         else:
             report.full_bytes_sent += len(frame)
 
     def _recv(self, recv, report: SyncReport) -> tuple[int, bytes]:
         try:
-            frame = recv()
+            with self._prof.clock("network"):
+                frame = recv()
         except (ConnectionError, EOFError) as e:
             # a peer hanging up mid-frame is a protocol-level fact of
             # this session, not a local I/O bug — surface it in the
@@ -312,8 +360,10 @@ class SyncSession:
                 f"transport returned {type(frame).__name__}, not bytes"
             )
         frame = bytes(frame)
+        self._prof.frames_received += 1
         report.bytes_received += len(frame)
-        return decode_frame(frame)
+        with self._prof.clock("serialize"):
+            return decode_frame(frame)
 
     # -- phase helpers -------------------------------------------------------
 
@@ -333,6 +383,7 @@ class SyncSession:
             send,
             encode_hello_frame(proposal, node, self.observatory is not None,
                                oplog=can_ops, digest_tree=self.digest_tree,
+                               lag=self.lag_tracker is not None,
                                ver=self.speaks_version),
             report, "hello", 0,
         )
@@ -346,6 +397,7 @@ class SyncSession:
         self._peer_fleet_obs = hello.fleet_obs
         self._peer_oplog = hello.oplog
         self._peer_digest_tree = hello.digest_tree
+        self._peer_lag = hello.lag
         # post-hello, every frame's version byte is the NEGOTIATED
         # version — the highest both peers speak — so a v2 peer's
         # decoder never sees a byte it would reject
@@ -356,6 +408,7 @@ class SyncSession:
                     peer_fleet_obs=self._peer_fleet_obs,
                     peer_oplog=self._peer_oplog,
                     peer_digest_tree=self._peer_digest_tree,
+                    peer_lag=self._peer_lag,
                     negotiated_version=self.negotiated_version)
 
     def _tree_session(self) -> bool:
@@ -388,7 +441,8 @@ class SyncSession:
         if self.observatory is None or not self._peer_fleet_obs:
             return
         with tracing.span("obs.fleet.exchange"):
-            mine = self.observatory.encode()
+            with self._prof.clock("other"):
+                mine = self.observatory.encode()
             self._send(send,
                        encode_fleet_frame(mine, version=self._wire_version),
                        report, "fleet", 0)
@@ -397,9 +451,10 @@ class SyncSession:
                 raise SyncProtocolError(
                     f"expected a fleet frame, peer sent type {ftype:#04x}"
                 )
-            merged = self.observatory.merge_frame(
-                decode_fleet_payload(payload)
-            )
+            with self._prof.clock("other"):
+                merged = self.observatory.merge_frame(
+                    decode_fleet_payload(payload)
+                )
         report.fleet_nodes = len(merged.slices)
         self._event("sync.fleet_snapshot", nodes=report.fleet_nodes,
                     bytes=len(mine))
@@ -420,15 +475,16 @@ class SyncSession:
         from ..oplog.wire import decode_ops_frame, frame_op_count
 
         with tracing.span("oplog.exchange"):
-            mine = self._op_outbox()
-            if not mine:
-                # the exchange is lock-step: an empty outbox still owes
-                # the peer a frame
-                from ..oplog.records import OpBatch
-                from ..oplog.wire import encode_ops_frame
+            with self._prof.clock("other"):
+                mine = self._op_outbox()
+                if not mine:
+                    # the exchange is lock-step: an empty outbox still
+                    # owes the peer a frame
+                    from ..oplog.records import OpBatch
+                    from ..oplog.wire import encode_ops_frame
 
-                mine = encode_ops_frame(OpBatch.empty())
-            n_ops = frame_op_count(mine)
+                    mine = encode_ops_frame(OpBatch.empty())
+                n_ops = frame_op_count(mine)
             report.ops_sent = n_ops
             self._send(send,
                        encode_ops_sync_frame(mine,
@@ -439,12 +495,55 @@ class SyncSession:
                 raise SyncProtocolError(
                     f"expected an ops frame, peer sent type {ftype:#04x}"
                 )
-            theirs = decode_ops_sync_payload(payload)
-            report.ops_received = len(decode_ops_frame(theirs))
-            self._op_sink(theirs)
+            with self._prof.clock("other"):
+                theirs = decode_ops_sync_payload(payload)
+                report.ops_received = len(decode_ops_frame(theirs))
+                self._op_sink(theirs)
         if report.ops_sent or report.ops_received:
             self._event("sync.ops_piggyback", sent=report.ops_sent,
                         received=report.ops_received)
+
+    def _lag_exchange(self, send, recv, report: SyncReport) -> None:
+        """Write-to-visible sidecar swap after the session converged —
+        only when BOTH hellos advertised the ``lag`` capability (shared
+        data, lock-step symmetric; a lag-capable session facing an
+        older peer degrades loudly).  Each side ships its bounded
+        origin ingest-stamp table; the receiver measures every entry
+        whose dot the CONVERGED batch already witnesses — the
+        digest-convergence event IS the visibility edge for
+        state-synced writes — and parks the rest for the next fold
+        (:meth:`~crdt_tpu.obs.latency.LagTracker.observe_visibility`).
+        """
+        if self.lag_tracker is None:
+            return
+        if not self._peer_lag:
+            tracing.count("sync.lag.fallback.capability")
+            self._event("sync.lag_fallback", reason="capability")
+            return
+        with self._prof.clock("other"):
+            entries = self.lag_tracker.export_entries()
+            report.lag_entries_sent = len(entries)
+            frame = encode_lag_frame(entries, self.lag_tracker.proc_tag,
+                                     version=self._wire_version)
+        self._send(send, frame, report, "lag", len(entries))
+        ftype, payload = self._recv(recv, report)
+        if ftype != FRAME_LAG:
+            raise SyncProtocolError(
+                f"expected a lag frame, peer sent type {ftype:#04x}"
+            )
+        with self._prof.clock("other"):
+            proc, theirs = decode_lag_payload(payload)
+            report.lag_entries_received = self.lag_tracker.ingest_sidecar(
+                self.peer, theirs, origin_proc=proc)
+            clock = getattr(self.batch, "clock", None)
+            if clock is not None:
+                visible = np.asarray(clock).max(axis=0)
+                self.lag_tracker.observe_visibility(visible,
+                                                    peer=self.peer)
+        if report.lag_entries_sent or report.lag_entries_received:
+            self._event("sync.lag_sidecar",
+                        sent=report.lag_entries_sent,
+                        received=report.lag_entries_received)
 
     def _n(self) -> int:
         import jax
@@ -455,18 +554,20 @@ class SyncSession:
     def _exchange_digests(self, send, recv, report: SyncReport,
                           digest_fn) -> tuple[np.ndarray, np.ndarray]:
         with tracing.span("sync.digest_exchange"):
-            mine = np.asarray(digest_fn(self.batch), dtype=np.uint64)
-            vv = digest_mod.version_vector(self.batch)
-            self._send(send,
-                       encode_digest_frame(mine, vv,
-                                           version=self._wire_version),
-                       report, "digest", mine.shape[0])
+            with self._prof.clock("kernel"):
+                mine = np.asarray(digest_fn(self.batch), dtype=np.uint64)
+                vv = digest_mod.version_vector(self.batch)
+            with self._prof.clock("serialize"):
+                frame = encode_digest_frame(mine, vv,
+                                            version=self._wire_version)
+            self._send(send, frame, report, "digest", mine.shape[0])
             ftype, payload = self._recv(recv, report)
             if ftype != FRAME_DIGEST:
                 raise SyncProtocolError(
                     f"expected a digest frame, peer sent type {ftype:#04x}"
                 )
-            theirs, peer_vv = decode_digest_payload(payload)
+            with self._prof.clock("serialize"):
+                theirs, peer_vv = decode_digest_payload(payload)
         if peer_vv.size:
             # cache the peer's version-vector summary: the fleet
             # low-watermark (crdt_tpu/gc) takes the element-wise min
@@ -485,20 +586,21 @@ class SyncSession:
         mismatch rejects before any descent frame flows; it also
         carries the version vector the flat digest frame would have
         (the GC watermark feeds off every exchange, tree or flat)."""
-        tree = digest_mod.digest_tree_of(self.batch, self.universe)
-        vv = digest_mod.version_vector(self.batch)
-        self._send(
-            send,
-            encode_tree_root_frame(tree, vv, version=self._wire_version),
-            report, "tree", 0,
-        )
+        with self._prof.clock("kernel"):
+            tree = digest_mod.digest_tree_of(self.batch, self.universe)
+            vv = digest_mod.version_vector(self.batch)
+        with self._prof.clock("serialize"):
+            frame = encode_tree_root_frame(tree, vv,
+                                           version=self._wire_version)
+        self._send(send, frame, report, "tree", 0)
         ftype, payload = self._recv(recv, report)
         if ftype != FRAME_TREE:
             raise SyncProtocolError(
                 f"expected a tree root frame, peer sent type {ftype:#04x}"
             )
-        k, n, levels, root, children, peer_vv = \
-            decode_tree_root_payload(payload)
+        with self._prof.clock("serialize"):
+            k, n, levels, root, children, peer_vv = \
+                decode_tree_root_payload(payload)
         if k != tree.k:
             raise SyncProtocolError(
                 f"digest-tree fan-out mismatch: peer k={k}, local "
@@ -550,13 +652,14 @@ class SyncSession:
             top = tree.num_levels - 2
             # the root frame ships the top level unpadded; compare
             # against the k-padded child block (zeros == zeros)
-            theirs_top = np.zeros(tree.k, dtype=np.uint32)
-            theirs_top[:peer_children.shape[0]] = peer_children
-            d = tree_mod.diverged_children(
-                np.zeros(1, dtype=np.int64),
-                tree.child_lanes(top, np.zeros(1, dtype=np.int64)),
-                theirs_top, tree.level_size(top),
-            )
+            with self._prof.clock("kernel"):
+                theirs_top = np.zeros(tree.k, dtype=np.uint32)
+                theirs_top[:peer_children.shape[0]] = peer_children
+                d = tree_mod.diverged_children(
+                    np.zeros(1, dtype=np.int64),
+                    tree.child_lanes(top, np.zeros(1, dtype=np.int64)),
+                    theirs_top, tree.level_size(top),
+                )
             # byte-exact mirror of tree.simulate_descent: the cutover
             # threshold compares the planner's cost formula against one
             # flat digest frame's lanes, on data both peers share
@@ -581,20 +684,21 @@ class SyncSession:
                     return None
                 shipped += ship
                 report.tree_levels += 1
-                mine = tree.child_lanes(level - 1, d)
-                self._send(
-                    send,
-                    encode_tree_level_frame(level - 1, d, mine,
-                                            version=self._wire_version),
-                    report, "tree", int(d.size),
-                )
+                with self._prof.clock("kernel"):
+                    mine = tree.child_lanes(level - 1, d)
+                with self._prof.clock("serialize"):
+                    frame = encode_tree_level_frame(
+                        level - 1, d, mine, version=self._wire_version)
+                self._send(send, frame, report, "tree", int(d.size))
                 ftype, payload = self._recv(recv, report)
                 if ftype != FRAME_TREE:
                     raise SyncProtocolError(
                         "expected a tree level frame, peer sent type "
                         f"{ftype:#04x}"
                     )
-                plevel, pparents, planes = decode_tree_level_payload(payload)
+                with self._prof.clock("serialize"):
+                    plevel, pparents, planes = \
+                        decode_tree_level_payload(payload)
                 if plevel != level - 1 or not np.array_equal(pparents, d):
                     raise SyncProtocolError(
                         "digest-tree descent out of lock-step: peer "
@@ -602,8 +706,9 @@ class SyncSession:
                         f"parents), expected level {level - 1} "
                         f"({d.shape[0]} parents)"
                     )
-                d = tree_mod.diverged_children(
-                    d, mine, planes, tree.level_size(level - 1))
+                with self._prof.clock("kernel"):
+                    d = tree_mod.diverged_children(
+                        d, mine, planes, tree.level_size(level - 1))
                 level -= 1
             if d.size == 0:
                 tracing.count("sync.tree.collision")
@@ -623,30 +728,36 @@ class SyncSession:
         return peer_root == tree.root
 
     def _send_full(self, send, report: SyncReport) -> None:
-        blobs = self.batch.to_wire(self.universe)
-        self._send(send, encode_full_frame(blobs, version=self._wire_version),
-                   report, "full", len(blobs))
+        with self._prof.clock("serialize"):
+            blobs = self.batch.to_wire(self.universe)
+            frame = encode_full_frame(blobs, version=self._wire_version)
+        self._send(send, frame, report, "full", len(blobs))
 
     def _apply_frame(self, ftype: int, payload: bytes) -> None:
         n = self._n()
         if ftype == FRAME_FULL:
-            blobs = decode_full_payload(payload)
+            with self._prof.clock("serialize"):
+                blobs = decode_full_payload(payload)
             if len(blobs) != n:
                 raise SyncProtocolError(
                     f"peer full state carries {len(blobs)} objects, "
                     f"local fleet holds {n}"
                 )
-            peer = type(self.batch).from_wire(blobs, self.universe)
-            self.batch = self.batch.merge(peer)
+            with self._prof.clock("kernel"):
+                peer = type(self.batch).from_wire(blobs, self.universe)
+                self.batch = self.batch.merge(peer)
         elif ftype == FRAME_DELTA:
-            fleet_n, ids, blobs = decode_delta_payload(payload)
+            with self._prof.clock("serialize"):
+                fleet_n, ids, blobs = decode_delta_payload(payload)
             if fleet_n != n:
                 raise SyncProtocolError(
                     f"peer fleet size {fleet_n} != local {n}"
                 )
-            self.batch = delta_mod.apply_delta_rows(
-                self.batch, ids, blobs, self.universe, applier=self._applier
-            )
+            with self._prof.clock("kernel"):
+                self.batch = delta_mod.apply_delta_rows(
+                    self.batch, ids, blobs, self.universe,
+                    applier=self._applier
+                )
         else:
             raise SyncProtocolError(
                 f"expected a delta/full frame, peer sent type {ftype:#04x}"
@@ -675,19 +786,27 @@ class SyncSession:
         if recv is None:
             transport = send
             send, recv = transport.send, transport.recv
+        self._prof = SessionProfile()
+        self._prof.start()
         try:
             report = self._sync(send, recv)
             # piggybacks AFTER convergence: a failed session must not
             # spend frames on telemetry or writes, and a converged one
             # has both hellos' capability flags to decide with; ops ride
             # after the fleet snapshot so telemetry cost stays bounded
-            # even when the op exchange carries a large burst
+            # even when the op exchange carries a large burst; the lag
+            # sidecar rides last — its visibility check wants the batch
+            # every earlier exchange produced
             self._fleet_exchange(send, recv, report)
             self._ops_exchange(send, recv, report)
+            self._lag_exchange(send, recv, report)
         except (SyncProtocolError, TransportError) as e:
             tracing.count("sync.errors")
             self._event("sync.error", error=str(e)[:200])
             raise
+        finally:
+            self._prof.finish()
+        report.profile = self._publish_profile(self._prof)
         # delta_ratio reference: the caller's hint when given, else the
         # exact full frame this session shipped on a fallback path (a
         # pure delta session without a hint leaves the ratio unknown —
@@ -710,6 +829,29 @@ class SyncSession:
             except TypeError:
                 pass  # no occupancy kernel for this batch type
         return report
+
+    def _publish_profile(self, prof: SessionProfile) -> SessionProfile:
+        """Fold one finished profile into the ``sync.profile.*`` log2
+        histograms and the per-peer critical-path gauges.  The
+        unaccounted residual gets its own histogram AND a fraction
+        gauge — a profiler losing track of time is a finding, not a
+        rounding error."""
+        from ..obs import metrics as obs_metrics
+
+        reg = obs_metrics.registry()
+        reg.observe("sync.profile.wall_s", prof.wall_ns / 1e9)
+        reg.observe("sync.profile.serialize_s", prof.serialize_ns / 1e9)
+        reg.observe("sync.profile.network_wait_s", prof.network_ns / 1e9)
+        reg.observe("sync.profile.kernel_s", prof.kernel_ns / 1e9)
+        reg.observe("sync.profile.other_s", prof.other_ns / 1e9)
+        reg.observe("sync.profile.unaccounted_s",
+                    max(0, prof.unaccounted_ns) / 1e9)
+        reg.gauge_set(f"sync.peer.{self.peer}.network_wait_frac",
+                      prof.network_wait_frac)
+        reg.gauge_set(
+            f"sync.peer.{self.peer}.unaccounted_frac",
+            prof.unaccounted_ns / prof.wall_ns if prof.wall_ns else 0.0)
+        return prof
 
     def _fallback(self, report: SyncReport, reason: str) -> None:
         report.full_state_fallback = True
@@ -795,13 +937,13 @@ class SyncSession:
                 self._event("sync.phase", phase="delta_exchange",
                             diverged=report.diverged)
                 with tracing.span("sync.delta_exchange"):
-                    blobs = gather_blobs(self.batch, diverged, self.universe)
+                    with self._prof.clock("serialize"):
+                        blobs = gather_blobs(self.batch, diverged,
+                                             self.universe)
+                        frame = encode_delta_frame(
+                            n, diverged, blobs, version=self._wire_version)
                     report.delta_objects_sent = len(blobs)
-                    self._send(send,
-                               encode_delta_frame(
-                                   n, diverged, blobs,
-                                   version=self._wire_version),
-                               report, "delta", len(blobs))
+                    self._send(send, frame, report, "delta", len(blobs))
                     self._apply_frame(*self._recv(recv, report))
         # else: a non-canonical phase-1 digest saw nothing to ship —
         # both peers skip straight to the canonical verify, whose

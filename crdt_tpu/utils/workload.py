@@ -16,6 +16,17 @@ same seed-replayable source.
   few k-ary subtrees).
 * ``burst_len`` — each drawn key repeats for a fixed burst before the
   next draw (sessions hammer an object, they don't sprinkle).
+* ``read_frac`` — the read/write mix (production traffic reads far
+  more than it writes): :meth:`WorkloadGen.draw_mixed` flags that
+  fraction of draws as reads, off an INDEPENDENT seeded stream so
+  turning the knob never shifts the key sequence write-only drivers
+  replay.  The latency observatory drives lag measurement under
+  read-heavy mixes with this; the batched read front-end benches on it
+  next.
+* :meth:`WorkloadGen.hot_object_members` — the member-axis growth
+  shape: one seed-stable hot OBJECT accumulating distinct members
+  across calls, the workload that forces a fleet-wide member-plane
+  regrow (capacity ladder, GC re-pack, and regrow-timeline drivers).
 
 Everything is host-side numpy off one ``RandomState``; no jax.
 """
@@ -34,17 +45,28 @@ class WorkloadGen:
 
     def __init__(self, n_objects: int, *, seed: int = 0,
                  zipf_s: float = 0.0, burst_len: int = 1,
-                 permute_ranks: bool = False):
+                 permute_ranks: bool = False,
+                 read_frac: float = 0.0):
         if n_objects < 1:
             raise ValueError(f"n_objects {n_objects} < 1")
         if zipf_s < 0.0:
             raise ValueError(f"zipf_s {zipf_s} < 0")
         if burst_len < 1:
             raise ValueError(f"burst_len {burst_len} < 1")
+        if not 0.0 <= read_frac <= 1.0:
+            raise ValueError(f"read_frac {read_frac} not in [0, 1]")
         self.n_objects = int(n_objects)
         self.zipf_s = float(zipf_s)
         self.burst_len = int(burst_len)
+        self.read_frac = float(read_frac)
         self._rng = np.random.RandomState(seed)
+        # independent streams (each seed-derived): the read/write coin
+        # and the hot-object pick must not perturb the key-draw
+        # sequence, so toggling either knob replays identical keys
+        self._read_rng = np.random.RandomState(seed ^ 0x0EAD)
+        self._hot_rng = np.random.RandomState(seed ^ 0x407)
+        self._hot_obj: int | None = None
+        self._next_member = 0
         if zipf_s == 0.0:
             self._cdf = None
         else:
@@ -91,6 +113,39 @@ class WorkloadGen:
             self._burst_left -= take
             i += take
         return out
+
+    def draw_mixed(self, count: int) -> tuple[np.ndarray, np.ndarray]:
+        """``(keys int64[count], is_read bool[count])`` — the same key
+        stream as :meth:`draw` (byte-identical for the same seed and
+        call sequence; the coin rides its own stream) with
+        ``read_frac`` of the draws flagged as reads.  Reads follow the
+        same skew as writes — a hot key is hot on both sides, which is
+        exactly what makes read-your-writes staleness measurable."""
+        keys = self.draw(count)
+        if self.read_frac == 0.0:
+            return keys, np.zeros(count, dtype=bool)
+        reads = self._read_rng.random_sample(count) < self.read_frac
+        return keys, reads
+
+    def hot_object_members(self, count: int) -> tuple[int, np.ndarray]:
+        """``(hot_object, members int64[count])`` — ``count`` DISTINCT
+        ascending member ids on ONE seed-stable hot object, continuing
+        across calls: the member-axis growth shape (a session that
+        keeps adding fresh members to one set), which is what drives an
+        object's live-slot count through the capacity ladder and forces
+        a fleet-wide member-plane regrow.  The hot object is drawn once
+        per generator from the skewed distribution (rank 0 under Zipf,
+        uniform otherwise) on its own stream."""
+        if self._hot_obj is None:
+            if self._cdf is None:
+                self._hot_obj = int(self._hot_rng.randint(0, self.n_objects))
+            else:
+                self._hot_obj = int(self._to_objects(
+                    np.zeros(1, dtype=np.int64))[0])
+        members = np.arange(self._next_member,
+                            self._next_member + int(count), dtype=np.int64)
+        self._next_member += int(count)
+        return self._hot_obj, members
 
     def sample_rows(self, k: int) -> np.ndarray:
         """``k`` DISTINCT object rows, sorted ascending, sampled by the

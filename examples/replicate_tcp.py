@@ -613,6 +613,46 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     print(f"fleet: final session trace={trace} "
           f"(both peers' /events carry it)", flush=True)
 
+    # the latency observatory's read of the run: the last session's
+    # critical-path split, the per-link SRTT the transports measured,
+    # and (on --ops runs) the write-to-visible lag each observer saw
+    last = next((n.last_report for n in reversed(nodes)
+                 if n is not None and n.last_report is not None), None)
+    if last is not None and last.profile is not None:
+        p = last.profile
+        print(
+            f"latency: last session wall {p.wall_ns / 1e6:.1f}ms = "
+            f"serialize {p.serialize_ns / 1e6:.1f} + network "
+            f"{p.network_ns / 1e6:.1f} + kernel {p.kernel_ns / 1e6:.1f} "
+            f"+ other {p.other_ns / 1e6:.1f} + unaccounted "
+            f"{p.unaccounted_ns / 1e6:.1f} "
+            f"(network_wait {p.network_wait_frac:.0%})", flush=True,
+        )
+    from crdt_tpu.obs import metrics as _obs_metrics
+
+    _gauges = _obs_metrics.registry().snapshot()["gauges"]
+    srtts = {k.split(".")[2]: v for k, v in _gauges.items()
+             if k.startswith("cluster.transport.") and
+             k.endswith(".rtt_srtt_s")}
+    if srtts:
+        worst = max(srtts, key=srtts.get)
+        print(f"latency: srtt over {len(srtts)} link(s), worst "
+              f"{worst}={srtts[worst] * 1e3:.1f}ms", flush=True)
+    if ops_rate:
+        for node in nodes:
+            if node is None:
+                continue
+            node.lag_tracker.refresh()
+            lag = node.lag_tracker.snapshot()
+            for origin, st in sorted(lag["peers"].items()):
+                print(
+                    f"latency: {node.node_id} sees {origin} "
+                    f"write-to-visible p50={st['p50_s'] * 1e3:.1f}ms "
+                    f"p99={st['p99_s'] * 1e3:.1f}ms "
+                    f"({st['samples']} samples, "
+                    f"{st['outstanding']} outstanding)", flush=True,
+                )
+
     if gc_enabled:
         # per-node reclamation story + the watermark clock GC last
         # collected under (the element-wise min over every peer's

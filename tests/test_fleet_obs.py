@@ -322,8 +322,12 @@ def test_stitch_trace_interleaves_both_peers():
     sessions = {e.get("session") for e in timeline if "session" in e}
     # both halves of the session appear in one ordered timeline
     assert {sa.session_id, sb.session_id} <= sessions
-    walls = [e["wall"] for e in timeline]
+    walls = [e["wall_ts"] for e in timeline]
     assert walls == sorted(walls)
+    # duration math uses the monotonic stamp (skew-immune), which every
+    # event carries NEXT TO the wall stamp — and per-process mono
+    # deltas are non-negative in recording order
+    assert all("mono_ts" in e for e in timeline)
 
 
 # ---- the 5-node lossy-gossip acceptance run --------------------------------

@@ -1901,6 +1901,122 @@ def bench_capacity_obs():
     return out
 
 
+def bench_kernel_obs():
+    """Runtime kernel-observatory cost gate + coverage tail (the PR 14
+    tentpole's bench satellite).  (1) Per-call wrapper overhead,
+    measured directly: the same warmed jitted kernel dispatched through
+    its ``observed_kernel`` wrapper vs bare, scaled by a generous
+    per-fleet kernel-call count for the e2e wire workload and gated
+    <1% of the measured ``bench_e2e_wire`` wall.  (2) Steady-state
+    invariant: the measurement loop itself must record ZERO compile
+    events after its warmup call (``storm_report`` over the loop's
+    window).  (3) Coverage tail: per-kernel compile counts and p50
+    wall for every kernel the bench run exercised, so a kernel family
+    going dark diffs round over round (``kernel`` family collapse in
+    benchkit/artifacts.py), plus one blocking-mode GB/s + XLA
+    cost-analysis capture for the fold kernel as the roofline anchor."""
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch import vclock_batch
+    from crdt_tpu.obs import kernels as obs_kernels
+
+    obs = obs_kernels.kernel_observatory()
+
+    plane = jnp.zeros((256, 8), dtype=jnp.uint32)
+    wrapped = vclock_batch._merge          # the observed wrapper
+    bare = wrapped._fn                     # the jitted target inside it
+    wrapped(plane, plane)                  # warm (compile outside the loop)
+    warm_seq = obs_kernels.last_event_seq()
+
+    iters = 2_000 if SMALL else 10_000
+
+    def per_call(fn):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn(plane, plane)
+        return (time.perf_counter() - t0) / iters
+
+    bare_s = per_call(bare)
+    wrapped_s = per_call(wrapped)
+    overhead_s = max(0.0, wrapped_s - bare_s)
+    out = {
+        "kernel_obs_call_ns_bare": round(bare_s * 1e9, 1),
+        "kernel_obs_call_ns_wrapped": round(wrapped_s * 1e9, 1),
+        "kernel_obs_overhead_ns": round(overhead_s * 1e9, 1),
+    }
+    log(f"kernel obs: dispatch {bare_s*1e6:.1f}us bare / "
+        f"{wrapped_s*1e6:.1f}us wrapped -> +{overhead_s*1e9:.0f}ns/call")
+
+    # steady state: the 2*iters same-shape dispatches above must not
+    # have produced a single compile event past the warmup boundary
+    storm = obs_kernels.storm_report(since_seq=warm_seq)
+    assert storm["compiles"] == 0, (
+        f"steady-state dispatch loop recompiled: {storm['kernels']} — "
+        "a wrapper or cache-key regression is churning the jit cache"
+    )
+
+    # one blocking-mode pass so the fold kernel owns a GB/s roofline
+    # coordinate + its XLA cost analysis in the artifact
+    obs_kernels.set_blocking(True)
+    try:
+        for _ in range(10):
+            wrapped(plane, plane)
+    finally:
+        obs_kernels.set_blocking(False)
+    prof = obs.profile("batch.vclock.merge")
+    cost = prof.capture_cost()
+    if cost is not None:
+        out["kernel_obs_fold_cost_flops"] = cost["flops"]
+        out["kernel_obs_fold_cost_bytes"] = cost["bytes_accessed"]
+    table = {
+        row["label"]: {
+            "compiles": row["compiles"],
+            "wall_p50_s": row["wall_p50_s"],
+        }
+        for row in obs.table() if row["calls"] or row["compiles"]
+    }
+    out["kernel_obs_exercised"] = len(table)
+    out["kernel_obs_compiles_total"] = sum(
+        r["compiles"] for r in table.values())
+    out["kernel_obs_table"] = table
+    dm = obs_kernels.sample_device_memory()
+    if dm is not None:
+        out["kernel_obs_devicemem_mb"] = round(dm["live_bytes"] / 1e6, 3)
+    log(f"kernel obs: {len(table)} kernels exercised this run, "
+        f"{out['kernel_obs_compiles_total']} compiles total")
+
+    e2e_s = _JSON_STATE.get("e2e_wire_s")
+    if e2e_s:
+        # the e2e loop's kernel-call volume, shaped like
+        # bench_obs_overhead's estimate: one fold call per chunk per
+        # fleet is the real rate; 16x is deliberate headroom
+        if SMALL:
+            n, chunk, r = 2_000, 1_000, 4
+        else:
+            n, chunk, r = 1_250_000, 62_500, 8
+        n_chunks = max(2, n // chunk)
+        if _downshift():
+            n_chunks = min(n_chunks, 2)
+        calls = n_chunks * r * 16
+        frac = calls * overhead_s / e2e_s
+        out["kernel_obs_overhead_frac"] = round(frac, 6)
+        log(f"kernel obs: {calls} calls x {overhead_s*1e9:.0f}ns = "
+            f"{calls*overhead_s*1e3:.2f}ms vs e2e_wire {e2e_s:.2f}s "
+            f"-> {frac:.4%} (bar: <1%)")
+        if e2e_s >= 0.5:
+            assert frac < 0.01, (
+                f"always-on kernel observatory costs {frac:.2%} of "
+                "bench_e2e_wire wall (bar: <1%) — did the per-call path "
+                "start blocking or tracing eagerly?"
+            )
+        else:
+            log("kernel obs: e2e_wire too small to gate against "
+                "(smoke shape); per-call costs recorded")
+    else:
+        log("kernel obs: e2e_wire did not run; per-call costs only")
+    return out
+
+
 def bench_gc():
     """Causal-GC cost + reclamation gauge (the `crdt_tpu.gc` stage):
     tombstone settling and plane re-packing wall at 1k/64k/1M objects
@@ -2792,6 +2908,14 @@ def main():
     cap_res = run_stage("capacity_obs", 20, bench_capacity_obs)
     if cap_res is not None:
         emit(**cap_res)
+    # budget-skippable: the runtime kernel observatory — per-call
+    # wrapper overhead gated <1% of bench_e2e_wire wall, the
+    # zero-recompile steady-state assertion, and the per-kernel
+    # compile/p50 coverage tail (the `kernel` family collapse in
+    # benchkit/artifacts.py warns when a kernel goes dark)
+    kobs_res = run_stage("kernel_obs", 20, bench_kernel_obs)
+    if kobs_res is not None:
+        emit(**kobs_res)
     # budget-skippable: causal-GC settle/re-pack wall + bytes reclaimed
     # over a burst-over-provisioned fleet, parity-gated (digest vectors
     # byte-identical vs the untruncated twin); the `gc` counter family

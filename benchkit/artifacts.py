@@ -150,6 +150,18 @@ def counter_family(name: str) -> str:
         # tears a WAL or falls back a generation — only the durability
         # layer disappearing wholesale is the signal
         return "durable"
+    if parts[0] == "kernel" and len(parts) >= 3:
+        # the runtime kernel observatory's per-kernel counters
+        # (kernel.<label>.{calls,compiles,bytes,errors}) collapse into
+        # one family per kernel: errors legitimately stay zero and a
+        # warm process legitimately stops compiling — only a KERNEL
+        # going dark (its family vanishing wholesale: the call path
+        # stopped running or lost its instrumentation) is the signal
+        return ".".join(parts[:2])
+    if parts[0] == "devicemem":
+        # per-dtype byte gauges come and go with workload shape; only
+        # device-memory sampling vanishing wholesale is the signal
+        return "devicemem"
     if "fallback_reason" in parts:
         return ".".join(parts[:parts.index("fallback_reason")])
     if "rejected" in parts[:-1]:

@@ -23,7 +23,19 @@ size.  This module is the single source of truth those checks hang off:
   a jit site is a ``jax.jit``/``functools.partial(jax.jit, ...)``
   decorator or a direct ``jax.jit(fn)`` call, named by the enclosing
   def/class chain (``_scatter_adds_kernel.kernel``,
-  ``PipelinedWireLoop._merge_jnp.<jit>``).
+  ``_fold_merge_kernel.<jit>``).
+
+The manifest is also the RUNTIME observatory's identity table
+(:mod:`crdt_tpu.obs.kernels`): every row's jitted callable wears an
+``observed_kernel(<row name>)`` wrapper publishing live compile counts
+(KC04's budget as the ``kernel.<name>.compile_budget_frac`` gauge),
+per-call wall histograms and device-memory accounting; the runtime
+registry refuses names without a row here, and the manifest↔runtime
+cross-check (``tests/test_kernel_obs.py``) walks every ``build``
+closure to pin that each traceable row is instrumented.  ``build``
+closures therefore double as instrumentation warm-ups: they must reach
+each kernel through its public factory (``_derive_kernel()``,
+``_fold_merge_kernel(...)``) rather than re-deriving the callable.
 
 Import contract: importing this module must stay stdlib-only (the AST
 rule gates tier-1 CI on jax-free boxes).  Everything jax-flavoured
@@ -526,16 +538,14 @@ def _b_gc_repack():
 
 def _b_wireloop_merge():
     def build():
-        import functools
-
-        from ..ops import orswot_ops
+        from ..batch import wireloop
 
         cases = []
         for (a, m, d) in LADDER:
             planes = _orswot_planes(a, m, d)
             cases.append(TraceCase(
                 rung=f"A{a}.M{m}.D{d}",
-                fn=functools.partial(orswot_ops.merge, m_cap=m, d_cap=d),
+                fn=_unjit(wireloop._fold_merge_kernel(m, d)),
                 args=planes + planes, key=(m, d),
             ))
         return cases
@@ -547,7 +557,7 @@ def _b_derive_ctx():
     def build():
         from ..oplog import records
 
-        fn = records._derive_kernel_host
+        fn = _unjit(records._derive_kernel())
         cases = []
         for a in ACTOR_LADDER:
             cases.append(TraceCase(
@@ -587,11 +597,11 @@ def _b_scatter_adds():
     return build
 
 
-def _b_oplog_counter(kernel_attr: str, pn: bool):
+def _b_oplog_counter(factory_attr: str, pn: bool):
     def build():
         from ..oplog import apply as ap
 
-        fn = getattr(ap, kernel_attr)
+        fn = _unjit(getattr(ap, factory_attr)())
         dt = _clock_dt()
         cases = []
         for a in ACTOR_LADDER:
@@ -897,7 +907,7 @@ MANIFEST: tuple = (
                build=_b_gc_repack()),
     # batch/wireloop.py ------------------------------------------------------
     KernelSpec("batch.wireloop.fold_merge", "crdt_tpu/batch/wireloop.py",
-               "PipelinedWireLoop._merge_jnp.<jit>",
+               "_fold_merge_kernel.<jit>",
                build=_b_wireloop_merge()),
     # oplog ------------------------------------------------------------------
     KernelSpec("oplog.derive_add_ctx", "crdt_tpu/oplog/records.py",
@@ -908,13 +918,13 @@ MANIFEST: tuple = (
                compile_budget=len(LADDER) + 1,
                build=_b_scatter_adds()),
     KernelSpec("oplog.gcounter_scatter", _AP,
-               "apply_gcounter_ops._counter_scatter",
+               "_counter_scatter_kernel._counter_scatter",
                determinism="integer-lattice",
-               build=_b_oplog_counter("_counter_scatter", pn=False)),
+               build=_b_oplog_counter("_counter_scatter_kernel", pn=False)),
     KernelSpec("oplog.pncounter_scatter", _AP,
-               "apply_pncounter_ops._pn_scatter",
+               "_pn_scatter_kernel._pn_scatter",
                determinism="integer-lattice",
-               build=_b_oplog_counter("_pn_scatter", pn=True)),
+               build=_b_oplog_counter("_pn_scatter_kernel", pn=True)),
     # sync/digest.py ---------------------------------------------------------
     KernelSpec("sync.digest.orswot", "crdt_tpu/sync/digest.py", "_jit.fn",
                compile_budget=len(LADDER) + 1,  # +1: salt-table variant

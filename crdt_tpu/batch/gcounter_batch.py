@@ -15,6 +15,7 @@ from ..ops import clock_ops, counter_ops
 from ..scalar.gcounter import GCounter
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
+from ..obs.kernels import observed_kernel
 from ..config import counter_dtype
 from .vclock_batch import VClockBatch
 
@@ -88,6 +89,7 @@ class GCounterBatch:
         return counter_ops.gcounter_value(self.clocks)
 
 
+@observed_kernel("batch.gcounter.merge")
 @jax.jit
 def _merge(a, b):
     return counter_ops.gcounter_merge(a, b)

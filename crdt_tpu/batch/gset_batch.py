@@ -18,6 +18,7 @@ from ..error import WireFormatError
 from ..scalar.gset import GSet
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
+from ..obs.kernels import observed_kernel
 
 
 @struct.dataclass
@@ -195,6 +196,7 @@ class GSetBatch:
         return jnp.take_along_axis(self.bits, ids[..., None], axis=-1)[..., 0]
 
 
+@observed_kernel("batch.gset.merge")
 @jax.jit
 def _merge(a, b):
     return a | b

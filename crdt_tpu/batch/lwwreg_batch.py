@@ -21,6 +21,7 @@ from ..error import ConflictingMarker
 from ..ops import lww_ops
 from ..scalar.lwwreg import LWWReg
 from ..utils.interning import Universe
+from ..obs.kernels import observed_kernel
 from ..utils.hostmem import gc_paused
 
 
@@ -166,6 +167,7 @@ class LWWRegBatch:
         return LWWRegBatch(vals=vals, markers=markers)
 
 
+@observed_kernel("batch.lwwreg.merge")
 @jax.jit
 def _merge(va, ma, vb, mb):
     return lww_ops.merge(va, ma, vb, mb)

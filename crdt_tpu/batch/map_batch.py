@@ -31,6 +31,7 @@ from ..scalar.map import Entry, Map
 from ..scalar.vclock import VClock
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
+from ..obs.kernels import observed_kernel
 from .val_kernels import MapKernel
 from .vclock_batch import row_to_vclock
 
@@ -477,21 +478,25 @@ class MapBatch:
         return jnp.any(self.keys == key_id[..., None], axis=-1)
 
 
+@observed_kernel("batch.map.merge")
 @functools.partial(jax.jit, static_argnums=(2,))
 def _merge(state_a, state_b, kernel: MapKernel):
     return kernel.merge(state_a, state_b)
 
 
+@observed_kernel("batch.map.truncate")
 @functools.partial(jax.jit, static_argnums=(2,))
 def _truncate(state, clock, kernel: MapKernel):
     return kernel.truncate(state, clock)
 
 
+@observed_kernel("batch.map.apply_rm")
 @functools.partial(jax.jit, static_argnums=(3,))
 def _apply_rm(state, rm_clock, key_id, kernel: MapKernel):
     return map_ops.apply_rm(state, rm_clock, key_id, kernel.val_kernel)
 
 
+@observed_kernel("batch.map.apply_up")
 @functools.partial(jax.jit, static_argnums=(5, 6))
 def _apply_up(state, actor_idx, counter, key_id, nested_args, nested_op, kernel):
     vk = kernel.val_kernel

@@ -21,6 +21,7 @@ from ..ops import clock_ops, mvreg_ops
 from ..scalar.mvreg import MVReg
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
+from ..obs.kernels import observed_kernel
 from .vclock_batch import VClockBatch
 
 
@@ -249,18 +250,21 @@ class MVRegBatch:
         return MVRegBatch(clocks=clocks, vals=vals)
 
 
+@observed_kernel("batch.mvreg.merge")
 @functools.partial(jax.jit, static_argnums=(4,))
 def _merge(ca, va, cb, vb, k_cap):
     clocks, vals, keep = mvreg_ops.merge(ca, va, cb, vb)
     return mvreg_ops.compact(clocks, vals, keep, k_cap)
 
 
+@observed_kernel("batch.mvreg.apply_put")
 @functools.partial(jax.jit, static_argnums=(4,))
 def _apply_put(clocks, vals, op_clock, op_val, k_cap):
     clocks2, vals2, keep = mvreg_ops.apply_put(clocks, vals, op_clock, op_val)
     return mvreg_ops.compact(clocks2, vals2, keep, k_cap)
 
 
+@observed_kernel("batch.mvreg.truncate")
 @jax.jit
 def _truncate(clocks, vals, t_clock):
     """Delegates to the nested-protocol kernel (`MVRegKernel.truncate`) —

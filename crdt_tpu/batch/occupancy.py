@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs.capacity import Occupancy
+from ..obs.kernels import observed_kernel
 from ..ops.orswot_ops import EMPTY
 
 #: key/deferred-slot sentinel in MapBatch planes (-1 = empty)
@@ -47,6 +48,7 @@ def _tree_nbytes(*planes) -> int:
 # ---------------------------------------------------------------------------
 
 
+@observed_kernel("batch.occupancy.orswot")
 @jax.jit
 def _orswot_occupancy(clock, ids, dots, d_ids, d_clocks):
     """ORSWOT plane occupancy as one int64[6] fetch: live member slots
@@ -66,6 +68,7 @@ def _orswot_occupancy(clock, ids, dots, d_ids, d_clocks):
     ).astype(jnp.int64)
 
 
+@observed_kernel("batch.occupancy.clock")
 @jax.jit
 def _clock_occupancy(plane):
     """``[N, A]`` clock/counter plane occupancy as one int64[4] fetch:
@@ -82,6 +85,7 @@ def _clock_occupancy(plane):
     ).astype(jnp.int64)
 
 
+@observed_kernel("batch.occupancy.pncounter")
 @jax.jit
 def _pn_occupancy(planes):
     """``[N, 2, A]`` PN-counter plane occupancy as one int64[4] fetch:
@@ -100,6 +104,7 @@ def _pn_occupancy(planes):
     ).astype(jnp.int64)
 
 
+@observed_kernel("batch.occupancy.map")
 @jax.jit
 def _map_occupancy(clock, keys, entry_clocks, d_keys, d_clocks):
     """Map plane occupancy as one int64[6] fetch: live key slots

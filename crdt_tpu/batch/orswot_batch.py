@@ -23,6 +23,7 @@ from ..scalar.orswot import Orswot
 from ..scalar.vclock import VClock
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
+from ..obs.kernels import observed_kernel
 from .vclock_batch import VClockBatch
 
 
@@ -73,6 +74,7 @@ def _on_accelerator(x) -> bool:
         return False
 
 
+@observed_kernel("batch.orswot.device_nnz")
 @jax.jit
 def _device_nnz(clock, ids, dots, d_ids, d_clocks):
     """Populated-cell counts for the five planes, as one tiny fetch."""
@@ -87,6 +89,7 @@ def _device_nnz(clock, ids, dots, d_ids, d_clocks):
     ).astype(jnp.int64)
 
 
+@observed_kernel("batch.orswot.device_compact")
 @functools.partial(jax.jit, static_argnames=("sizes", "with_entries"))
 def _device_compact(clock, ids, dots, d_ids, d_clocks, sizes,
                     with_entries=True):
@@ -143,6 +146,7 @@ def _pad_cols(cols, k, id_fill=False):
     return tuple(out)
 
 
+@observed_kernel("batch.orswot.device_expand")
 @functools.partial(jax.jit, static_argnames=("n", "a", "m", "d"))
 def _device_expand(cells, n, a, m, d):
     """Inverse of :func:`_device_compact`: max-scatter compact columns
@@ -962,6 +966,7 @@ class OrswotBatch:
         ]
 
 
+@observed_kernel("batch.orswot.merge")
 @functools.partial(jax.jit, static_argnums=(10, 11, 12))
 def _merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap, impl):
     return orswot_ops.merge(
@@ -969,6 +974,7 @@ def _merge(ca, ia, da, dia, dca, cb, ib, db, dib, dcb, m_cap, d_cap, impl):
     )
 
 
+@observed_kernel("batch.orswot.fold_tree")
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
 def _fold_tree(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger, impl):
     return orswot_ops.fold_merge_tree(
@@ -977,16 +983,19 @@ def _fold_tree(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap, plunger, impl):
     )
 
 
+@observed_kernel("batch.orswot.apply_add")
 @jax.jit
 def _apply_add(clock, ids, dots, d_ids, d_clocks, actor_idx, counter, member_id):
     return orswot_ops.apply_add(clock, ids, dots, d_ids, d_clocks, actor_idx, counter, member_id)
 
 
+@observed_kernel("batch.orswot.apply_remove")
 @jax.jit
 def _apply_remove(clock, ids, dots, d_ids, d_clocks, rm_clock, member_id):
     return orswot_ops.apply_remove(clock, ids, dots, d_ids, d_clocks, rm_clock, member_id)
 
 
+@observed_kernel("batch.orswot.truncate")
 @functools.partial(jax.jit, static_argnums=(6, 7))
 def _truncate(clock, ids, dots, d_ids, d_clocks, t_clock, m_cap, d_cap):
     """One semantics, one home: delegates to the nested-protocol kernel

@@ -16,6 +16,7 @@ from ..ops import clock_ops, counter_ops
 from ..scalar.pncounter import PNCounter
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
+from ..obs.kernels import observed_kernel
 from ..config import counter_dtype
 from .vclock_batch import VClockBatch
 
@@ -101,6 +102,7 @@ class PNCounterBatch:
         return counter_ops.pncounter_value(self.planes)
 
 
+@observed_kernel("batch.pncounter.merge")
 @jax.jit
 def _merge(a, b):
     return counter_ops.pncounter_merge(a, b)

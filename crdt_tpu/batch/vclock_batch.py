@@ -17,6 +17,7 @@ from ..ops import clock_ops
 from ..scalar.vclock import VClock
 from ..utils.interning import Universe
 from ..utils.hostmem import gc_paused
+from ..obs.kernels import observed_kernel
 
 
 def row_to_vclock(row, universe: Universe) -> VClock:
@@ -121,6 +122,7 @@ class VClockBatch:
         return clock_ops.is_empty(self.clocks)
 
 
+@observed_kernel("batch.vclock.merge")
 @jax.jit
 def _merge(a, b):
     return clock_ops.merge(a, b)

@@ -58,6 +58,30 @@ import numpy as np
 
 from ..config import counter_dtype
 from ..utils import tracing
+
+
+def _fold_merge_kernel(m_cap: int, d_cap: int):
+    """The loop's jitted pairwise fold merge, shared across loop
+    instances per (m_cap, d_cap) via the jit cache of ONE function
+    object — and registered with the runtime kernel observatory
+    (``batch.wireloop.fold_merge``)."""
+    import functools
+
+    import jax
+
+    from ..obs.kernels import observed_kernel
+    from ..ops import orswot_ops
+
+    key = (m_cap, d_cap)
+    fn = _FOLD_MERGE_CACHE.get(key)
+    if fn is None:
+        fn = observed_kernel("batch.wireloop.fold_merge")(jax.jit(
+            functools.partial(orswot_ops.merge, m_cap=m_cap, d_cap=d_cap)))
+        _FOLD_MERGE_CACHE[key] = fn
+    return fn
+
+
+_FOLD_MERGE_CACHE: dict = {}
 from ..utils.interning import Universe
 
 _SENTINEL = object()
@@ -179,18 +203,10 @@ class PipelinedWireLoop:
         """One async-dispatched device merge; overflow flags accumulate
         in ``self._overflow`` (checked once per round, at the egress
         sync, so no host round-trip lands mid-fold)."""
-        import functools
-
-        import jax
-
         if self._jit_merge is None:
-            from ..ops import orswot_ops
-
             cfg = self.cfg
-            self._jit_merge = jax.jit(functools.partial(
-                orswot_ops.merge,
-                m_cap=cfg.member_capacity, d_cap=cfg.deferred_capacity,
-            ))
+            self._jit_merge = _fold_merge_kernel(
+                cfg.member_capacity, cfg.deferred_capacity)
         out = self._jit_merge(*acc, *rhs)
         ov = out[5].reshape(-1, 2).any(axis=0)
         self._overflow = ov if self._overflow is None else \

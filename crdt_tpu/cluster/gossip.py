@@ -515,6 +515,9 @@ class ClusterNode:
             occs.append(trk.sample_oplog(self._oplog))
         if self._applier is not None:
             occs.append(trk.sample_gap_buffer(self._applier))
+        # the device-memory gauges ride the same cadence: what the
+        # device actually holds next to the plane bytes by construction
+        trk.sample_device_memory()
         return occs
 
     def sync_with(self, peer_id: str, transport: Transport) -> SyncReport:

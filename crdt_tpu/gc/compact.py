@@ -45,8 +45,10 @@ import numpy as np
 
 from ..ops import orswot_ops
 from ..ops.orswot_ops import EMPTY
+from ..obs.kernels import observed_kernel
 
 
+@observed_kernel("gc.settle")
 @jax.jit
 def _settle(clock, ids, dots, d_ids, d_clocks):
     """Standalone defer plunger: dedup + replay dominated deferred rows

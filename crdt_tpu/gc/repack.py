@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from ..obs import events as obs_events
 from ..obs import metrics as obs_metrics
+from ..obs.kernels import note_ladder_transition, observed_kernel
 from ..ops import orswot_ops
 from ..utils import tracing
 
@@ -50,6 +51,7 @@ def _next_pow2(c: int) -> int:
     return 1 if c <= 0 else 1 << (c - 1).bit_length()
 
 
+@observed_kernel("gc.repack")
 @functools.partial(jax.jit, static_argnames=("m_cap", "d_cap"))
 def _repack(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap):
     """Pack live member slots (ascending id — the canonical order) and
@@ -128,6 +130,9 @@ def repack_orswot(batch, member_capacity: Optional[int] = None,
     reclaimed = bytes_before - sum(
         x.nbytes for x in (out.clock, out.ids, out.dots, out.d_ids,
                            out.d_clocks))
+    # stamp the ladder transition BEFORE the event: the next compile
+    # any kernel pays on the shrunk shapes is ladder-attributed
+    note_ladder_transition("shrink")
     obs_events.record("executor.shrink", schedule="gc",
                       member_capacity_before=m_before,
                       deferred_capacity_before=d_before,

@@ -36,6 +36,13 @@ first-class answer, in five parts:
   gauges, EWMA growth rates, time-to-overflow ETAs against the
   executor's regrow ceiling, and the ok/warn/critical watermark
   ``/healthz`` reports.
+* :mod:`crdt_tpu.obs.kernels` — the kernel plane: the runtime kernel
+  observatory (dynamic companion to kernelcheck, keyed on the SAME
+  :data:`crdt_tpu.analysis.kernels.MANIFEST` rows) — per-kernel
+  compile/recompile tracking with ladder-vs-shape-churn
+  classification, always-cheap wall histograms, lazy XLA
+  ``cost_analysis`` capture, device-memory gauges, and the
+  ``/kernels`` table.
 
 Import-light by design: nothing here imports JAX or numpy, so the
 scalar engine (and any process that only wants a counter) pays nothing
@@ -43,7 +50,7 @@ for it.  PERF.md "Observability" documents naming conventions and how
 to read the flight recorder after a failed sync.
 """
 
-from . import capacity, convergence, events, fleet, latency, metrics  # noqa: F401
+from . import capacity, convergence, events, fleet, kernels, latency, metrics  # noqa: F401
 from .capacity import CapacityTracker, Occupancy, capacity_tracker  # noqa: F401
 from .convergence import ConvergenceTracker, tracker  # noqa: F401
 from .events import FlightRecorder, new_session_id, record, recorder  # noqa: F401
@@ -52,6 +59,14 @@ from .fleet import (  # noqa: F401
     FleetSnapshot,
     observatory,
     stitch_trace,
+)
+from .kernels import (  # noqa: F401
+    KernelObservatory,
+    KernelProfile,
+    kernel_observatory,
+    observed_kernel,
+    sample_device_memory,
+    storm_report,
 )
 from .latency import (  # noqa: F401
     LagTracker,
@@ -76,13 +91,19 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "KernelObservatory",
+    "KernelProfile",
     "LagTracker",
     "MetricsRegistry",
     "Occupancy",
     "RttEstimator",
     "SessionProfile",
     "capacity_tracker",
+    "kernel_observatory",
     "lag_tracker",
+    "observed_kernel",
+    "sample_device_memory",
+    "storm_report",
     "new_session_id",
     "observatory",
     "record",

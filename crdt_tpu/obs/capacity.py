@@ -178,6 +178,19 @@ class CapacityTracker:
         return self.observe(_buffer_occupancy("oplog_gap", o), label=label,
                             ceiling=o["capacity"])
 
+    def sample_device_memory(self):
+        """Fold ``jax.live_arrays()`` into the ``devicemem.*`` gauges
+        (total + per-dtype live bytes, and the tracked-vs-live
+        fraction against this tracker's plane bytes) — the
+        construction-vs-device gap, on the same cadence as the plane
+        samples.  Delegates to :func:`crdt_tpu.obs.kernels.
+        sample_device_memory`; a no-op returning None when jax was
+        never imported."""
+        from . import kernels as kernels_mod
+
+        return kernels_mod.sample_device_memory(
+            registry=self._reg(), tracker=self)
+
     def observe(self, occ, label: Optional[str] = None, *,
                 ceiling: Optional[int] = None):
         """Fold one pre-computed occupancy sample in and publish its

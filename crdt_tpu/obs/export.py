@@ -16,7 +16,11 @@ Three consumers, three shapes:
   ``GET /fleet`` (the CRDT-merged cross-process snapshot from
   :mod:`crdt_tpu.obs.fleet` — Prom text by default, ``?format=json``
   for per-node slices, ``?trace=<id>`` for a stitched cross-peer
-  session timeline) and ``GET /healthz``.  Daemon threads throughout:
+  session timeline), ``GET /kernels`` (the runtime kernel observatory:
+  per-kernel compile counts, budget fracs, wall quantiles and
+  device-memory gauges — ``?format=json`` for the table +
+  recompile-storm report, ``?cost=1`` to capture XLA cost analysis)
+  and ``GET /healthz``.  Daemon threads throughout:
   an exporter must never
   keep a replica process alive or take it down — handler errors are
   swallowed into 500s and ``stop()`` is idempotent.
@@ -44,19 +48,28 @@ def _fmt(v: float) -> str:
 
 def prometheus_text(registry: Optional[metrics.MetricsRegistry] = None,
                     prefix: str = PROM_PREFIX,
-                    tracker: Optional[convergence.ConvergenceTracker] = None
-                    ) -> str:
+                    tracker: Optional[convergence.ConvergenceTracker] = None,
+                    name_prefixes: Optional[tuple] = None) -> str:
     """The registry as Prometheus text exposition.  Refreshes the
     read-time convergence gauges (staleness ages) first so a scrape
     sees live ages — the default tracker when rendering the default
     registry, else only a caller-supplied ``tracker`` (the one whose
     gauges land in ``registry``): scraping a private registry must not
-    write the global tracker's gauges into the process-global one."""
+    write the global tracker's gauges into the process-global one.
+    ``name_prefixes`` restricts the rendered families to internal names
+    starting with one of the given dotted prefixes (what ``/kernels``
+    uses to serve just the ``kernel.``/``devicemem.`` plane)."""
     if tracker is None and registry is None:
         tracker = convergence.tracker()
     if tracker is not None:
         tracker.refresh()
     if registry is None:
+        # read boundary: drain the kernel observatory's pending
+        # per-call aggregates so the scrape sees fresh kernel.* rows
+        # (default registry only, same discipline as the gauge below)
+        from . import kernels as kernels_mod
+
+        kernels_mod.publish()
         # scrape-time refresh of the flight recorder's eviction count:
         # `dropped` is a Python property, and an alert on "the ring is
         # overflowing faster than anyone reads it" needs it as a gauge.
@@ -67,6 +80,12 @@ def prometheus_text(registry: Optional[metrics.MetricsRegistry] = None,
         )
     reg = registry if registry is not None else metrics.registry()
     snap = reg.snapshot()
+    if name_prefixes is not None:
+        def _keep(table):
+            return {k: v for k, v in table.items()
+                    if k.startswith(name_prefixes)}
+
+        snap = {kind: _keep(table) for kind, table in snap.items()}
     lines = []
     for name in sorted(snap["counters"]):
         mname = f"{prefix}_{_sanitize(name)}_total"
@@ -99,6 +118,10 @@ def prometheus_text(registry: Optional[metrics.MetricsRegistry] = None,
 def json_snapshot(registry: Optional[metrics.MetricsRegistry] = None) -> dict:
     """One JSON-ready dict: metrics + flight-recorder events + per-peer
     convergence state (what ``/events`` and the bench artifact embed)."""
+    if registry is None:
+        from . import kernels as kernels_mod
+
+        kernels_mod.publish()
     reg = registry if registry is not None else metrics.registry()
     rec = events.recorder()
     return {
@@ -114,7 +137,7 @@ def json_snapshot(registry: Optional[metrics.MetricsRegistry] = None) -> dict:
 
 class MetricsServer:
     """A daemon HTTP thread serving ``/metrics``, ``/events``,
-    ``/healthz`` on localhost.  Construct via
+    ``/fleet``, ``/kernels``, ``/healthz`` on localhost.  Construct via
     :func:`start_metrics_server`; ``port`` is the bound port (useful
     with ``port=0``), ``scrapes`` counts GETs per path (a peer that
     wants to linger "until someone scraped me" — the TCP example's
@@ -205,6 +228,36 @@ class MetricsServer:
             text = fleet_mod.fleet_prometheus_text(snap)
             return (text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8", 200)
+        if route == "/kernels":
+            # the runtime kernel observatory (crdt_tpu/obs/kernels.py):
+            # prom text of the kernel./devicemem. plane by default,
+            # ?format=json for the per-kernel table (compiles, budget
+            # frac, wall quantiles, GB/s, cost analysis) + the
+            # recompile-storm classification.  ?cost=1 triggers the
+            # lazy XLA cost_analysis capture first (one extra
+            # lower+compile per kernel signature — deliberate, so the
+            # default scrape stays cheap).  Device-memory gauges
+            # refresh per scrape on the default registry (same
+            # discipline as obs.events.dropped above).
+            from . import kernels as kernels_mod
+
+            q = parse_qs(parsed.query)
+            obs = kernels_mod.kernel_observatory()
+            if self._registry is None:
+                kernels_mod.sample_device_memory(tracker=self._capacity)
+            if q.get("cost", [None])[0]:
+                obs.capture_costs()
+            if q.get("format", [None])[0] == "json":
+                body = json.dumps({
+                    "kernels": obs.table(),
+                    "storm": kernels_mod.storm_report(),
+                }).encode()
+                return body, "application/json", 200
+            text = prometheus_text(
+                self._registry, tracker=self._tracker,
+                name_prefixes=("kernel.", "devicemem."))
+            return (text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8", 200)
         if route == "/healthz":
             # liveness + the capacity watermark: `status` mirrors the
             # tracker's overall watermark state (ok/warn/critical; "ok"
@@ -225,7 +278,7 @@ class MetricsServer:
                 "capacity": wm,
             }).encode()
             return body, "application/json", 200
-        return b"not found (try /metrics, /events, /fleet, /healthz)\n", \
+        return b"not found (try /metrics, /events, /fleet, /kernels, /healthz)\n", \
             "text/plain; charset=utf-8", 404
 
     def scrape_counts(self) -> dict:

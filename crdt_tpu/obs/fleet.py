@@ -323,6 +323,13 @@ def capture_slice(node_id: str, *,
     registry snapshot re-shaped into the lattice (stamped with this
     capture's ``(wall_ts, seq)``), the convergence tracker state, the
     events-dropped count and a bounded flight-recorder tail."""
+    if registry is None:
+        # read boundary: drain the kernel observatory's pending
+        # per-call aggregates so fleet slices carry fresh kernel.*
+        # rows (default registry only — same discipline as export.py)
+        from . import kernels as kernels_mod
+
+        kernels_mod.publish()
     reg = registry if registry is not None else metrics_mod.registry()
     trk = tracker if tracker is not None else convergence_mod.tracker()
     rec = recorder if recorder is not None else events_mod.recorder()

@@ -81,6 +81,22 @@ class Gauge:
         self.value += float(n)
 
 
+def log2_bucket(v: float) -> int:
+    """The log2 bucket exponent for one observation: ``frexp`` puts
+    ``v = m * 2**e`` with ``0.5 <= m < 1`` in ``[2**(e-1), 2**e)``;
+    pulling exact powers of two (``m == 0.5``) down one exponent makes
+    bucket ``e`` hold ``(2**(e-1), 2**e]``, so 4.0 exports under
+    ``le="4"``, not ``le="8"`` (Prometheus ``le`` bounds are
+    inclusive).  Non-positive values land in the floor bucket.
+    Exposed so always-on instruments (the kernel observatory's
+    per-call path) can bucket locally and merge via
+    :meth:`MetricsRegistry.observe_aggregate`."""
+    if v > 0.0:
+        m, e = math.frexp(v)
+        return e - 1 if m == 0.5 else e
+    return Histogram.ZERO_BUCKET
+
+
 class Histogram:
     """Log2-bucketed distribution: bucket ``e`` counts observations in
     ``(2**(e-1), 2**e]``.  Non-positive observations land in a floor
@@ -107,17 +123,7 @@ class Histogram:
 
     def observe(self, v: float) -> None:
         v = float(v)
-        if v > 0.0:
-            # frexp: v = m * 2**e with 0.5 <= m < 1 puts v in
-            # [2**(e-1), 2**e); pulling exact powers of two (m == 0.5)
-            # down one exponent makes bucket e hold (2**(e-1), 2**e],
-            # so 4.0 exports under le="4", not le="8" (Prometheus le
-            # bounds are inclusive)
-            m, e = math.frexp(v)
-            if m == 0.5:
-                e -= 1
-        else:
-            e = self.ZERO_BUCKET
+        e = log2_bucket(v)
         self.buckets[e] = self.buckets.get(e, 0) + 1
         self.count += 1
         self.sum += v
@@ -214,6 +220,30 @@ class MetricsRegistry:
                 self._claim(name, "histogram")
                 h = self._histograms[name] = Histogram(name)
             h.observe(v)
+
+    def observe_aggregate(self, name: str, buckets: Dict[int, int],
+                          count: int, total: float,
+                          vmin: float, vmax: float) -> None:
+        """Merge a locally-aggregated log2 distribution (buckets keyed
+        by :func:`log2_bucket` exponent) into ``name`` in one lock
+        acquisition — how deferred instruments (the kernel
+        observatory's per-call wall accounting) publish without paying
+        a registry round-trip per observation."""
+        if count <= 0:
+            return
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                self._claim(name, "histogram")
+                h = self._histograms[name] = Histogram(name)
+            for e, c in buckets.items():
+                h.buckets[e] = h.buckets.get(e, 0) + c
+            h.count += count
+            h.sum += total
+            if vmin < h.min:
+                h.min = vmin
+            if vmax > h.max:
+                h.max = vmax
 
     # -- snapshots ------------------------------------------------------------
 

@@ -379,9 +379,63 @@ NAMESPACE: tuple[NameSpec, ...] = (
     NameSpec("executor.shrink", "histogram",
              "capacity shrink (GC re-pack) span — the regrow path in "
              "reverse (crdt_tpu/gc/repack.py)"),
-    # -- kernels (utils/tracing.timed_kernel) --------------------------------
+    # -- kernels (utils/tracing.timed_kernel, obs/kernels.py) ----------------
     NameSpec("kernel.*.errors", "counter",
-             "raising calls per timed kernel label"),
+             "raising calls per timed/observed kernel label"),
+    NameSpec("kernel.*.calls", "counter",
+             "invocations per observed kernel label (manifest name with "
+             "dots flattened to underscores)"),
+    NameSpec("kernel.*.compiles", "counter",
+             "jit cache misses (lowering+compile) per observed kernel"),
+    NameSpec("kernel.*.bytes", "counter",
+             "array bytes moved through an observed kernel (inputs + "
+             "outputs; an HBM-traffic lower bound)"),
+    NameSpec("kernel.*.wall", "histogram",
+             "per-call wall per observed kernel (dispatch wall by "
+             "default; device time under CRDT_TRACE=1/set_blocking; "
+             "compiling calls excluded — they ride kernel.compile "
+             "events)"),
+    NameSpec("kernel.*.gbps", "gauge",
+             "bytes-moved throughput per observed kernel (blocking-mode "
+             "samples only — the bandwidth-roofline coordinate)"),
+    NameSpec("kernel.*.compile_budget_frac", "gauge",
+             "runtime compiles over the kernelcheck KC04 compile_budget "
+             "— KC04's static bound as a live watermark (>1 sustained "
+             "in steady state = shape churn)"),
+    NameSpec("kernel.*.cost_flops", "gauge",
+             "XLA cost_analysis flops for the last captured lowering"),
+    NameSpec("kernel.*.cost_bytes", "gauge",
+             "XLA cost_analysis bytes-accessed for the last captured "
+             "lowering"),
+    NameSpec("kernel.compiles", "counter",
+             "process-wide jit compiles across all observed kernels "
+             "(zero growth after warmup = the steady-state invariant)"),
+    NameSpec("kernel.budget.watermark", "gauge",
+             "worst per-kernel compile-budget state (0 ok / 1 warn / 2 "
+             "critical), like capacity.watermark"),
+    NameSpec("kernel.cost.unavailable", "counter",
+             "cost_analysis captures the backend declined"),
+    # -- device memory (obs/kernels.sample_device_memory, capacity
+    # cadence) ----------------------------------------------------------------
+    NameSpec("devicemem.samples", "counter",
+             "device-memory sampling passes (jax.live_arrays walks)"),
+    NameSpec("devicemem.live_bytes", "gauge",
+             "bytes held by live jax arrays process-wide — what the "
+             "device actually holds vs plane bytes by construction"),
+    NameSpec("devicemem.arrays", "gauge", "live jax array count"),
+    NameSpec("devicemem.dtype.*.bytes", "gauge",
+             "live array bytes by dtype family (a freed family reads "
+             "0, never a stale level)"),
+    NameSpec("devicemem.tracked_bytes", "gauge",
+             "plane bytes the capacity tracker accounts for"),
+    NameSpec("devicemem.tracked_frac", "gauge",
+             "tracked_bytes over live_bytes — how much of device "
+             "memory the capacity observatory explains"),
+    # -- profiler capture (utils/tracing.profile) ----------------------------
+    NameSpec("obs.profiler_unavailable", "counter",
+             "XLA profiler trace setups that failed (exception class "
+             "in the one-time obs.profiler_unavailable event) — why "
+             "the trace directory is empty"),
     # -- bench probes (bench.py bench_obs_overhead) --------------------------
     NameSpec("obs.overhead.count_probe", "counter",
              "bench_obs_overhead per-op counter cost probe"),

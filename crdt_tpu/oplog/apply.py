@@ -44,6 +44,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..error import ConflictingMarker, OpLogOverflowError
+from ..obs.kernels import observed_kernel
 from ..utils import tracing
 from .records import NO_MEMBER, OP_ADD, OP_DEC, OP_INC, OP_RM, OP_SET, OpBatch
 
@@ -98,8 +99,8 @@ def _scatter_adds_kernel():
                 new_clock, new_ids, new_dots, d_ids, d_clocks)
             return new_clock, i2, d2, di2, dc2
 
-        _scatter_adds = jax.jit(
-            kernel, static_argnames=("replay",))
+        _scatter_adds = observed_kernel("oplog.scatter_adds")(
+            jax.jit(kernel, static_argnames=("replay",)))
     return _scatter_adds
 
 
@@ -441,6 +442,31 @@ def _pn_scatter(planes, obj, plane, actor, counter):
     return planes.at[obj, plane, actor].max(counter.astype(planes.dtype))
 
 
+def _counter_scatter_kernel():
+    """The jitted G-Counter scatter-max, built once (mirrors
+    :func:`_scatter_adds_kernel` so the kernel observatory's
+    ``warm_manifest`` can instantiate it without folding ops)."""
+    global _counter_scatter_jit
+    if _counter_scatter_jit is None:
+        import jax
+
+        _counter_scatter_jit = observed_kernel("oplog.gcounter_scatter")(
+            jax.jit(_counter_scatter))
+    return _counter_scatter_jit
+
+
+def _pn_scatter_kernel():
+    """The jitted PN-Counter scatter-max, built once (see
+    :func:`_counter_scatter_kernel`)."""
+    global _pn_scatter_jit
+    if _pn_scatter_jit is None:
+        import jax
+
+        _pn_scatter_jit = observed_kernel("oplog.pncounter_scatter")(
+            jax.jit(_pn_scatter))
+    return _pn_scatter_jit
+
+
 def apply_gcounter_ops(batch, ops: OpBatch):
     """Fold ``inc`` dots into a :class:`~crdt_tpu.batch.gcounter_batch.
     GCounterBatch` — one jitted scatter-max (`gcounter.rs:71-73`: the
@@ -450,15 +476,12 @@ def apply_gcounter_ops(batch, ops: OpBatch):
     import jax
     import jax.numpy as jnp
 
-    global _counter_scatter_jit
     if bool((ops.kind != OP_INC).any()):
         raise ValueError("apply_gcounter_ops folds inc ops only "
                          "(a GCounter cannot decrement, gcounter.rs:14)")
     if len(ops) == 0:
         return batch
-    if _counter_scatter_jit is None:
-        _counter_scatter_jit = jax.jit(_counter_scatter)
-    clocks = _counter_scatter_jit(
+    clocks = _counter_scatter_kernel()(
         batch.clocks, jnp.asarray(ops.obj), jnp.asarray(ops.actor),
         jnp.asarray(ops.counter))
     return type(batch)(clocks=clocks)
@@ -471,16 +494,13 @@ def apply_pncounter_ops(batch, ops: OpBatch):
     import jax
     import jax.numpy as jnp
 
-    global _pn_scatter_jit
     ok = np.isin(ops.kind, np.asarray([OP_INC, OP_DEC], np.uint8))
     if not bool(ok.all()):
         raise ValueError("apply_pncounter_ops folds inc/dec ops only")
     if len(ops) == 0:
         return batch
-    if _pn_scatter_jit is None:
-        _pn_scatter_jit = jax.jit(_pn_scatter)
     plane = (ops.kind == OP_DEC).astype(np.int32)
-    planes = _pn_scatter_jit(
+    planes = _pn_scatter_kernel()(
         batch.planes, jnp.asarray(ops.obj), jnp.asarray(plane),
         jnp.asarray(ops.actor), jnp.asarray(ops.counter))
     return type(batch)(planes=planes)

@@ -377,7 +377,10 @@ def _derive_kernel():
     if _derive_jit is None:
         import jax
 
-        _derive_jit = jax.jit(_derive_kernel_host)
+        from ..obs.kernels import observed_kernel
+
+        _derive_jit = observed_kernel("oplog.derive_add_ctx")(
+            jax.jit(_derive_kernel_host))
     return _derive_jit
 
 

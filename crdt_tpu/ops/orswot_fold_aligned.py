@@ -63,6 +63,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import x64_disabled
+from ..obs.kernels import observed_kernel
 
 # jax 0.4.x spells pltpu.CompilerParams `TPUCompilerParams`
 _compiler_params = getattr(pltpu, "CompilerParams", None) \
@@ -275,6 +276,7 @@ def pad_to_tile(state, m_cap: int, d_cap: int, n_states: int, u_cap: int | None 
     )
 
 
+@observed_kernel("ops.fold_aligned.fold_merge")
 @functools.partial(jax.jit, static_argnames=(
     "m_cap", "d_cap", "u_cap", "interpret", "plunger", "prebiased"))
 def fold_merge(

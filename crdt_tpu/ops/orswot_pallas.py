@@ -73,6 +73,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs.kernels import observed_kernel
+
 from ..config import x64_disabled
 
 # jax 0.4.x spells pltpu.CompilerParams `TPUCompilerParams`
@@ -447,6 +449,7 @@ def _gate_interpret(interpret: bool) -> None:
         raise UnsupportedBackendError(skew)
 
 
+@observed_kernel("ops.pallas.merge")
 @functools.partial(jax.jit, static_argnames=("m_cap", "d_cap", "interpret"))
 def merge(
     clock_a, ids_a, dots_a, dids_a, dclocks_a,
@@ -547,6 +550,7 @@ def from_kernel_domain(x, dtype):
     return _from_kernel_dtype(x, dtype)
 
 
+@observed_kernel("ops.pallas.fold_merge")
 @functools.partial(jax.jit, static_argnames=(
     "m_cap", "d_cap", "interpret", "plunger", "prebiased"))
 def fold_merge(

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ._compat import shard_map
 
 from ..error import CapacityOverflowError, raise_for_overflow
+from ..obs.kernels import observed_kernel
 from ..ops import orswot_ops
 
 
@@ -74,7 +75,7 @@ def _clock_join_fn(mesh: Mesh, axis: str, ndim: int):
         local_join = jnp.max(local, axis=0, keepdims=True)
         return jax.lax.pmax(local_join, axis_name=axis)
 
-    return _join
+    return observed_kernel("parallel.clock_join")(_join)
 
 
 # -- generic tree reduction over a replica axis ------------------------------
@@ -159,7 +160,7 @@ def shard_local_merge_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int,
     def _local(sa, sb):
         return _orswot_pair_merge(sa, sb, m_cap, d_cap, impl)
 
-    return _local
+    return observed_kernel("parallel.shard_local_merge")(_local)
 
 
 def shard_local_pairwise_merge(a, b, mesh: Mesh, axis: str = "objects",
@@ -285,7 +286,7 @@ def _orswot_join_fn(mesh: Mesh, axis: str, m_cap: int, d_cap: int,
             over = jnp.broadcast_to(flags.astype(jnp.bool_), over.shape)
         return tuple(x[None] for x in acc), over
 
-    return _join
+    return observed_kernel("parallel.orswot_join")(_join)
 
 
 def _fold_map_stack(stack_state, kernel):
@@ -335,7 +336,7 @@ def _map_join_fn(mesh: Mesh, axis: str, kernel, flat_specs, spec_tree):
             jnp.any(overflow)[None],
         )
 
-    return _join
+    return observed_kernel("parallel.map_join")(_join)
 
 
 def allgather_join_map(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
@@ -411,7 +412,7 @@ def _lww_join_fn(mesh: Mesh, axis: str, ndim: int):
         v, m, conflict = _fold_lww_stack(vg, mg)
         return v[None], m[None], conflict[None]
 
-    return _join
+    return observed_kernel("parallel.lww_join")(_join)
 
 
 def allgather_join_lww(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
@@ -480,7 +481,7 @@ def _mvreg_join_fn(mesh: Mesh, axis: str, k_cap: int, c_ndim: int, v_ndim: int):
         c, v, overflow = _fold_mvreg_stack(cg, vg, k_cap)
         return c[None], v[None], overflow[None]
 
-    return _join
+    return observed_kernel("parallel.mvreg_join")(_join)
 
 
 def allgather_join_mvreg(batch, mesh: Mesh, axis: str = "replicas", check: bool = True):
@@ -595,7 +596,8 @@ def _anti_entropy_kernels(m_cap: int, d_cap: int, impl: str | None = None):
             same &= jnp.array_equal(x, y)
         return nxt, same, jnp.any(over, axis=0)
 
-    return _fold, _plunge
+    return (observed_kernel("parallel.anti_entropy_fold")(_fold),
+            observed_kernel("parallel.anti_entropy_plunge")(_plunge))
 
 
 def anti_entropy(stack, max_rounds: int = 3, check: bool = True,

@@ -34,6 +34,7 @@ from typing import Any, Optional, Sequence
 
 from ..error import CapacityOverflowError
 from ..obs import events as obs_events
+from ..obs import kernels as obs_kernels
 from ..utils import tracing
 
 
@@ -49,6 +50,12 @@ def _record_recovery(kind: str, **fields) -> None:
     a histogram of the same name.
     """
     tracing.count(f"executor.recovery.{kind}")
+    if kind == "regrow":
+        # stamp the capacity-ladder transition for the kernel
+        # observatory: the next compile each kernel pays on the regrown
+        # shapes is ladder-attributed, not shape churn
+        # (crdt_tpu/obs/kernels.py storm_report)
+        obs_kernels.note_ladder_transition(kind)
     obs_events.record(f"executor.{kind}", **fields)
 
 

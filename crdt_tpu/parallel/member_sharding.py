@@ -47,6 +47,7 @@ from ._compat import shard_map
 
 from ..ops import orswot_ops
 from ..error import raise_for_overflow
+from ..obs.kernels import observed_kernel
 
 EMPTY = orswot_ops.EMPTY
 
@@ -181,7 +182,7 @@ def _clock_join_fn(mesh: Mesh, axis: str):
         joined = jax.lax.pmax(local, axis)
         return jnp.broadcast_to(joined, local_clock.shape)
 
-    return _join
+    return observed_kernel("parallel.member_clock_join")(_join)
 
 
 def rebroadcast_clock(state, mesh: Mesh, axis: str = "members"):
@@ -234,4 +235,4 @@ def _apply_add_fn(mesh: Mesh, axis: str, n_shards: int):
         *new_state, over = orswot_ops.apply_add(*s, tile(a_idx), eff_cnt, tile(mid))
         return tuple(new_state), over
 
-    return _local
+    return observed_kernel("parallel.member_apply_add")(_local)

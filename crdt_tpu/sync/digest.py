@@ -50,6 +50,8 @@ import weakref
 
 import numpy as np
 
+from ..obs.kernels import observed_kernel
+
 # plane tags keep the per-plane lane families disjoint: a clock dot
 # (a, c) and a member dot (m, a, c) with colliding coordinates must not
 # cancel under XOR
@@ -289,7 +291,7 @@ def _orswot_kernel(use_table: bool = False):
         )
         return out
 
-    return _jit(kernel)
+    return observed_kernel("sync.digest.orswot")(_jit(kernel))
 
 
 @functools.lru_cache(maxsize=None)
@@ -306,7 +308,7 @@ def _counter_kernel():
             jnp.where(flat != 0, h, dt(0)), axis=-1
         )
 
-    return _jit(kernel)
+    return observed_kernel("sync.digest.counter")(_jit(kernel))
 
 
 @functools.lru_cache(maxsize=None)
@@ -320,7 +322,7 @@ def _lww_kernel(use_table: bool = False):
             markers.astype(dt) ^ _mix(vkey + _const(_T_LWW, dt), dt), dt
         )
 
-    return _jit(kernel)
+    return observed_kernel("sync.digest.lww")(_jit(kernel))
 
 
 def _host_u64(x) -> np.ndarray:

@@ -53,6 +53,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs.kernels import observed_kernel
+
 #: the protocol fan-out — baked into the descent frame grammar (a peer
 #: advertising a different k rejects at the root frame, loudly)
 TREE_K = 16
@@ -85,7 +87,7 @@ def _leaf_kernel():
                    + _const(_T_LEAF, dt), dt)
         return _mix(lanes ^ pos, dt)
 
-    return jax.jit(kernel)
+    return observed_kernel("sync.tree.leaf_mix")(jax.jit(kernel))
 
 
 @functools.lru_cache(maxsize=None)
@@ -100,7 +102,7 @@ def _fold_kernel():
     def kernel(lanes):
         return jnp.bitwise_xor.reduce(lanes.reshape(-1, TREE_K), axis=-1)
 
-    return jax.jit(kernel)
+    return observed_kernel("sync.tree.fold")(jax.jit(kernel))
 
 
 def _fold_level(lanes: np.ndarray) -> np.ndarray:

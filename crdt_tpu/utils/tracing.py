@@ -369,19 +369,49 @@ def timed_kernel(name: Optional[str] = None, count_bytes: bool = False) -> Calla
     return deco
 
 
+# profiler-setup failures already flight-recorded, one event per
+# exception class (the counter keeps counting every failure)
+_PROFILER_UNAVAILABLE_SEEN: set = set()
+
+
+def _profiler_unavailable(exc: BaseException, log_dir: str) -> None:
+    """Profiler setup failed: count it always, flight-record it once
+    per exception class — so "the trace directory is empty" is
+    diagnosable from ``/events`` instead of silently shrugged off."""
+    count("obs.profiler_unavailable")
+    cls = type(exc).__name__
+    if cls in _PROFILER_UNAVAILABLE_SEEN:
+        return
+    _PROFILER_UNAVAILABLE_SEEN.add(cls)
+    try:
+        from ..obs import events as obs_events
+
+        obs_events.record(
+            "obs.profiler_unavailable", error=cls,
+            detail=str(exc)[:200], log_dir=log_dir,
+        )
+    except Exception:  # diagnostics must never fail the traced caller
+        pass
+
+
 @contextlib.contextmanager
 def profile(log_dir: str) -> Iterator[None]:
     """Capture an XLA profiler trace into ``log_dir`` (TensorBoard format).
 
     Swallows backend "profiling unsupported" errors (e.g. remote-TPU
     tunnels) so callers can leave this on unconditionally — caller
-    exceptions still propagate."""
+    exceptions still propagate.  A swallowed setup failure is no longer
+    silent: it increments ``obs.profiler_unavailable`` and leaves a
+    one-time-per-exception-class flight-recorder event naming the
+    exception, so an empty trace directory is diagnosable from
+    ``/events``."""
     import jax
 
     try:
         ctx = jax.profiler.trace(log_dir)
         ctx.__enter__()
-    except Exception:
+    except Exception as e:
+        _profiler_unavailable(e, log_dir)
         ctx = None
     try:
         yield

@@ -212,3 +212,38 @@ def test_bare_tracer_does_not_forward():
     snap = obs_metrics.registry().snapshot()
     assert "bare_tracer_probe.counter" not in snap["counters"]
     assert "bare_tracer_probe.span" not in snap["histograms"]
+
+
+def test_profile_setup_failure_is_counted_and_flight_recorded(
+        tmp_path, monkeypatch):
+    """A swallowed profiler-setup failure must leave a diagnosable
+    trail: the obs.profiler_unavailable counter counts every failure,
+    the flight-recorder event fires ONCE per exception class — so "the
+    trace directory is empty" is answerable from /events."""
+    import jax
+
+    from crdt_tpu.obs import events as obs_events
+    from crdt_tpu.obs import metrics as obs_metrics
+
+    class ProfilerBroken(RuntimeError):
+        pass
+
+    def boom(log_dir):
+        raise ProfilerBroken("no profiler on this backend")
+
+    monkeypatch.setattr(jax.profiler, "trace", boom)
+    tracing._PROFILER_UNAVAILABLE_SEEN.discard("ProfilerBroken")
+    before = obs_metrics.registry().counters_snapshot()
+    for _ in range(2):  # caller body still runs, failures still count
+        ran = False
+        with tracing.profile(str(tmp_path / "trace")):
+            ran = True
+        assert ran
+    after = obs_metrics.registry().counters_snapshot()
+    assert after.get("obs.profiler_unavailable", 0) - \
+        before.get("obs.profiler_unavailable", 0) == 2
+    evs = [e for e in obs_events.recorder().snapshot(
+               kind="obs.profiler_unavailable")
+           if e["fields"]["error"] == "ProfilerBroken"]
+    assert len(evs) == 1  # one event per exception class, not per failure
+    assert "no profiler" in evs[0]["fields"]["detail"]

@@ -2240,6 +2240,100 @@ def bench_durable():
     return out
 
 
+def bench_stability():
+    """Convergence-observatory cost gate (the obs/stability stage):
+    (1) the jitted frontier fold (``clock[N, A] -> vv[S, A]``) wall at
+    1k/64k/1M objects — it runs once per converged session (memoized
+    per batch, so idle rounds pay zero); (2) one full lattice-audit
+    pass (sampled self-merge through the wire codec + digest
+    re-check + frontier soundness cross-checks) at each size — it runs
+    once per gossip round, so its cost is gated <1% of the measured
+    ``bench_e2e_wire`` wall; (3) zero violations asserted across every
+    healthy audit (the ``stability.audit.violations`` counter must not
+    move — a mover here is a lattice-stack bug, not a perf story)."""
+    import jax.numpy as jnp
+
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.obs import stability as stability_mod
+    from crdt_tpu.utils import tracing as _tracing
+    from crdt_tpu.utils.interning import Universe
+
+    cfg = CrdtConfig(num_actors=8, member_capacity=8, deferred_capacity=4,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+    sizes = (1_000, 16_000, 64_000) if SMALL else (1_000, 64_000, 1_000_000)
+    out = {}
+    worst_audit_s = 0.0
+    violations_before = _tracing.counters().get(
+        "stability.audit.violations", 0)
+    for n in sizes:
+        batch = OrswotBatch.zeros(n, uni)
+        col = np.zeros(n, np.int32)
+        for j in range(3):
+            batch = batch.apply_add(
+                col, np.full(n, j + 1, np.uint32),
+                np.full(n, j, np.int32))
+        subtrees, span = stability_mod.subtree_layout(n)
+        clock = np.asarray(batch.clock)
+        pad = subtrees * span - n
+        if pad:
+            clock = np.concatenate(
+                [clock, np.zeros((pad, clock.shape[1]), clock.dtype)])
+        dev = jnp.asarray(clock)
+        kern = stability_mod._frontier_kernel(subtrees)
+        np.asarray(kern(dev))  # compile + warm
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            np.asarray(kern(dev))
+        fold_s = (time.perf_counter() - t0) / iters
+        out[f"stability_frontier_fold_ms_{n}"] = round(fold_s * 1e3, 4)
+
+        trk = stability_mod.StabilityTracker(seed=n)
+        rep = trk.audit(batch, uni, sample=8)  # warm the sampled path
+        assert rep.ok, f"healthy audit reported violations: {rep.violations}"
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            rep = trk.audit(batch, uni, sample=8)
+            assert rep.ok, \
+                f"healthy audit reported violations: {rep.violations}"
+        audit_s = (time.perf_counter() - t0) / iters
+        out[f"stability_audit_ms_{n}"] = round(audit_s * 1e3, 4)
+        worst_audit_s = max(worst_audit_s, audit_s)
+        log(f"stability: N={n}  frontier fold {fold_s*1e3:.3f}ms "
+            f"({subtrees} subtrees)  audit {audit_s*1e3:.3f}ms "
+            f"({rep.checks} checks, 0 violations)")
+        del batch, dev
+
+    assert _tracing.counters().get(
+        "stability.audit.violations", 0) == violations_before, (
+        "the healthy bench run moved stability.audit.violations — the "
+        "lattice auditor found a real bug; read the "
+        "stability.audit_violation flight events"
+    )
+    e2e_s = _JSON_STATE.get("e2e_wire_s")
+    if e2e_s:
+        # one audit per gossip round: the per-round observatory cost
+        frac = worst_audit_s / e2e_s
+        out["stability_audit_frac"] = round(frac, 6)
+        log(f"stability: worst audit {worst_audit_s*1e3:.2f}ms vs "
+            f"e2e_wire {e2e_s:.2f}s -> {frac:.4%} (bar: <1%)")
+        if e2e_s >= 0.5:
+            assert frac < 0.01, (
+                f"one lattice audit costs {frac:.2%} of bench_e2e_wire "
+                "wall (bar: <1%) — did the sample stop being "
+                "budget-bounded?"
+            )
+        else:
+            log("stability: e2e_wire too small to gate against (smoke "
+                "shape); per-pass costs recorded")
+    else:
+        log("stability: e2e_wire did not run; per-pass costs only")
+    return out
+
+
 def bench_bandwidth_floor():
     """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
     elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
@@ -2931,6 +3025,14 @@ def main():
     durable_res = run_stage("durable", 30, bench_durable)
     if durable_res is not None:
         emit(**durable_res)
+    # budget-skippable: convergence-observatory costs — frontier fold +
+    # lattice-audit wall at 1k/64k/1M objects, audit gated <1% of
+    # bench_e2e_wire wall, zero violations asserted on the healthy run;
+    # the `stability` counter family in the obs tail warns if the
+    # auditor stops running
+    stability_res = run_stage("stability", 20, bench_stability)
+    if stability_res is not None:
+        emit(**stability_res)
     # budget-skippable: kernelcheck coverage gauge (analyzer wall time +
     # kernels-covered counts, so a kernel module escaping the manifest
     # shows in the artifact tail as a coverage count that stopped moving)

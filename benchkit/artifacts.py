@@ -132,6 +132,17 @@ def counter_family(name: str) -> str:
         # hit and miss are one family: an all-hit round (every fleet
         # idle) is an improvement, not a vanished code path
         return "sync.digest.cache"
+    if parts[:2] == ["sync", "stability"]:
+        # the divergence-aging counters (resolved) collapse into ONE
+        # family: a fully quiescent round legitimately resolves nothing
+        # — only divergence aging vanishing wholesale is the signal
+        return "sync.stability"
+    if parts[0] == "stability":
+        # the lattice-auditor counters (audit.checks / audit.violations)
+        # collapse like gc/durable: violations legitimately stay zero
+        # forever — only the auditor disappearing wholesale is the
+        # signal
+        return "stability"
     if parts[:2] == ["sync", "lag"]:
         # the lag-sidecar counters (samples + fallback.<reason>)
         # collapse into ONE family: a same-version in-process run

@@ -685,6 +685,29 @@ def _b_tree_fold(which: str):
     return build
 
 
+def _b_frontier_fold():
+    """The convergence observatory's per-subtree version-vector fold
+    (obs/stability.py): ``clock[S*span, W] -> vv[S, W]``, one reshape +
+    max-reduce.  Traced across the subtree/span/actor ladder a real
+    fleet walks (S is the factory's static; ≤ TREE_K by the digest-tree
+    coverage rule) — one legitimate lowering per case."""
+
+    def build():
+        from ..obs import stability as stability_mod
+
+        dt = _clock_dt()
+        cases = []
+        for (s, span, a) in ((16, 1, 8), (16, 16, 8), (16, 256, 16),
+                             (8, 1, 8)):
+            fn = _unjit(stability_mod._frontier_kernel(s))
+            cases.append(TraceCase(
+                rung=f"S{s}.P{span}.A{a}", fn=fn,
+                args=(_mat((s * span, a), dt),), key=(s,)))
+        return cases
+
+    return build
+
+
 def _b_collective(which: str):
     def build():
         import functools
@@ -944,6 +967,11 @@ MANIFEST: tuple = (
                "_leaf_kernel.kernel",
                compile_budget=3,
                build=_b_tree_fold("leaf")),
+    # obs/stability.py (the convergence observatory's frontier fold) ---------
+    KernelSpec("obs.stability.frontier_fold", "crdt_tpu/obs/stability.py",
+               "_frontier_kernel.kernel",
+               compile_budget=4,  # one lowering per traced (S, span, A)
+               build=_b_frontier_fold()),
     # parallel/collective.py -------------------------------------------------
     KernelSpec("parallel.clock_join", _CO, "_clock_join_fn._join",
                build=_b_collective("clock")),

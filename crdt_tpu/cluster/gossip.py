@@ -106,8 +106,10 @@ class ClusterNode:
                  digest_tree: bool = False,
                  durability=None,
                  applier=None,
-                 lag_tracker=None):
+                 lag_tracker=None,
+                 stability_tracker=None):
         from ..obs import latency as obs_latency
+        from ..obs import stability as obs_stability
 
         self.node_id = node_id
         self.universe = universe
@@ -122,6 +124,17 @@ class ClusterNode:
         #: differently.
         self.lag_tracker = lag_tracker if lag_tracker is not None \
             else obs_latency.LagTracker()
+        #: the node's :class:`crdt_tpu.obs.stability.StabilityTracker`
+        #: — the convergence observatory: every session this node runs
+        #: feeds its divergence aging and frontier planes, the gossip
+        #: scheduler recomputes the frontier + runs the lattice auditor
+        #: per round, and checkpoints persist the frontier clocks.
+        #: Private per node by default (like the lag tracker) so
+        #: in-process fleets keep their observers apart; pass the one a
+        #: durable recovery restored (after ``.restore(frontier)``) to
+        #: resume instead of regrowing from zero.
+        self.stability = stability_tracker if stability_tracker \
+            is not None else obs_stability.StabilityTracker()
         #: a :class:`crdt_tpu.durable.Durability`; when set, every
         #: ingested op batch is WAL-appended BEFORE the in-memory fold
         #: (a write acknowledged to the caller survives kill -9), and
@@ -398,6 +411,7 @@ class ClusterNode:
                 observatory=self.observatory,
                 digest_tree=self.digest_tree,
                 lag_tracker=self.lag_tracker,
+                stability=self.stability,
                 **op_hooks,
             )
             report = session.sync(transport)
@@ -486,13 +500,38 @@ class ClusterNode:
             watermark = None
             if gc_report is not None and gc_report.watermark is not None:
                 watermark = gc_report.watermark.clock
+            # the stability frontier rides the snapshot so a kill -9
+            # rejoin restores it as a monotone floor — the same
+            # discipline as the GC watermark above
+            frontier = self.stability.frontier_clock() \
+                if self.stability is not None else None
             faults_mod.crash_point(f"durable.checkpoint.{self.node_id}")
             return self.durability.checkpoint(
                 batch, self.universe, wal_seq=wal_seq,
-                watermark=watermark, parked=parked,
+                watermark=watermark, parked=parked, frontier=frontier,
                 node_id=self.node_id)
         finally:
             self._busy.release()
+
+    def observe_stability(self, peers=None):
+        """Refresh this node's stability plane: recompute + publish the
+        fleet frontier against ``peers`` (the full roster incl. DEAD
+        peers — quarantine, not membership state, decides exclusion,
+        exactly the GC watermark rule) and run the sampled lattice
+        auditor on its cadence.  Reads an immutable batch snapshot, so
+        it never needs the busy lock.  Returns the
+        :class:`~crdt_tpu.obs.stability.FrontierReport` (None for
+        clockless batch types)."""
+        if self.stability is None:
+            return None
+        with self._lock:
+            batch = self._batch
+        try:
+            report = self.stability.frontier(batch, peers=peers)
+        except TypeError:
+            return None  # no clock plane for this batch type
+        self.stability.maybe_audit(batch, self.universe, peers=peers)
+        return report
 
     def sample_capacity(self) -> list:
         """Sample this node's dense planes + op buffers into the
@@ -694,17 +733,23 @@ class GossipScheduler:
         # in peer members (plane growth) or drained queued ops, so the
         # occupancy gauges / growth ETAs refresh on the post-round state
         self.node.sample_capacity()
+        # stability plane per round: the frontier recomputes against
+        # the FULL roster (incl. DEAD peers — quarantine, not the
+        # membership state, decides when a silent peer stops pinning
+        # it) and the sampled lattice auditor re-checks merge
+        # idempotence + frontier soundness on the post-round state
+        roster = [
+            p.peer_id for p in self.membership.peers(
+                membership_mod.ALIVE, membership_mod.SUSPECT,
+                membership_mod.DEAD)
+        ]
+        self.node.observe_stability(peers=roster)
         # causal GC between sessions: the engine decides cadence (every
         # Nth round, or early on a capacity-watermark trigger); the
         # roster includes DEAD peers — the watermark's quarantine, not
         # the membership state, decides when a silent peer stops
         # freezing the fleet's memory
         if self.node.gc is not None and self.node.gc.due(round_no):
-            roster = [
-                p.peer_id for p in self.membership.peers(
-                    membership_mod.ALIVE, membership_mod.SUSPECT,
-                    membership_mod.DEAD)
-            ]
             if self.node.collect_garbage(peers=roster) is not None:
                 # a shrink/settle changed the planes: refresh the
                 # occupancy gauges on the post-GC state (and re-seed

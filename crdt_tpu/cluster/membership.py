@@ -68,7 +68,8 @@ class Membership:
     """
 
     def __init__(self, *, suspect_after: int = 2, dead_after: int = 5,
-                 registry: Optional[metrics.MetricsRegistry] = None):
+                 registry: Optional[metrics.MetricsRegistry] = None,
+                 tracker=None):
         if not 1 <= suspect_after <= dead_after:
             raise ValueError(
                 f"need 1 <= suspect_after ({suspect_after}) <= "
@@ -77,6 +78,10 @@ class Membership:
         self.suspect_after = suspect_after
         self.dead_after = dead_after
         self._registry = registry
+        #: the :class:`crdt_tpu.obs.convergence.ConvergenceTracker`
+        #: whose per-peer gauges roster admission seeds (None = the
+        #: process-global one every session feeds)
+        self._tracker = tracker
         self._lock = threading.Lock()
         self._peers: Dict[str, PeerInfo] = {}
 
@@ -88,14 +93,27 @@ class Membership:
 
     def add(self, peer_id: str, address: object = None) -> PeerInfo:
         """Register ``peer_id`` (idempotent — re-adding refreshes the
-        address but keeps observed health)."""
+        address but keeps observed health).  Admission seeds the peer's
+        convergence gauges with the never-exchanged sentinels
+        (staleness ``+Inf``, divergence ``-1`` — :meth:`crdt_tpu.obs.
+        convergence.ConvergenceTracker.register_peer`), so a roster
+        peer that never completes a session is a visible ``/metrics``
+        series from its first sighting, not a dashboard hole."""
         with self._lock:
             info = self._peers.get(peer_id)
-            if info is None:
+            created = info is None
+            if created:
                 info = self._peers[peer_id] = PeerInfo(peer_id, address)
             elif address is not None:
                 info.address = address
             snapshot = dataclasses.replace(info)
+        if created:
+            tracker = self._tracker
+            if tracker is None:
+                from ..obs import convergence as obs_convergence
+
+                tracker = obs_convergence.tracker()
+            tracker.register_peer(peer_id)
         self._mirror()
         return snapshot
 

@@ -108,7 +108,7 @@ class Durability:
     # -- checkpoint ----------------------------------------------------------
 
     def checkpoint(self, batch, universe, *, wal_seq: Optional[int] = None,
-                   watermark=None, parked=None,
+                   watermark=None, parked=None, frontier=None,
                    node_id: str = "") -> Snapshot:
         """One checkpoint pass: write the next snapshot generation
         atomically, roll the WAL, truncate segments the snapshot
@@ -125,7 +125,7 @@ class Durability:
                 wal_seq = self.wal.head_seq
             snap = self.store.write(
                 batch, universe, wal_seq=wal_seq, watermark=watermark,
-                parked=parked, node_id=node_id)
+                parked=parked, frontier=frontier, node_id=node_id)
             # roll so truncation operates on closed files only, then
             # truncate below the OLDEST retained generation's sequence
             # — not this snapshot's: if this one turns out torn on

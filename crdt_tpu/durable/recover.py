@@ -70,7 +70,8 @@ class RecoveryReport:
 class RecoveredReplica:
     """A restored replica, ready to rejoin: the verified batch, its
     universe, the op applier carrying any still-parked ops, the
-    persisted version vector and GC watermark, and the audit report."""
+    persisted version vector, GC watermark and stability-frontier
+    clocks, and the audit report."""
 
     batch: object
     universe: object
@@ -78,6 +79,12 @@ class RecoveredReplica:
     vv: np.ndarray
     watermark: Optional[np.ndarray]
     report: RecoveryReport
+    #: the convergence observatory's fleet-min frontier clock at
+    #: checkpoint time — seed a fresh tracker with
+    #: ``StabilityTracker.restore(frontier)`` so the rejoined node's
+    #: published frontier never regresses (a monotone floor, the
+    #: ``GcEngine.restore_watermark`` discipline)
+    frontier: Optional[np.ndarray] = None
 
 
 def recover(dirpath) -> Optional[RecoveredReplica]:
@@ -152,4 +159,5 @@ def recover(dirpath) -> Optional[RecoveredReplica]:
         wall_s=round(report.wall_s, 6))
     return RecoveredReplica(
         batch=batch, universe=snap.universe, applier=applier,
-        vv=snap.vv, watermark=snap.watermark, report=report)
+        vv=snap.vv, watermark=snap.watermark, report=report,
+        frontier=snap.frontier)

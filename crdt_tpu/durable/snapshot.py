@@ -116,6 +116,10 @@ class Snapshot:
     vv: np.ndarray                     # fleet version vector (uint64, flat)
     watermark: Optional[np.ndarray]    # GC watermark clock, if one existed
     parked: Optional[object]           # causally-parked OpBatch, if any
+    #: the fleet-min stability-frontier clock last published before the
+    #: checkpoint — restored as a monotone floor
+    #: (crdt_tpu/obs/stability.py), the GC-watermark discipline
+    frontier: Optional[np.ndarray] = None
     node_id: str = ""
     nbytes: int = 0                    # file size on disk
 
@@ -197,7 +201,8 @@ class SnapshotStore:
     # -- write ---------------------------------------------------------------
 
     def write(self, batch, universe, *, wal_seq: int = 0,
-              watermark=None, parked=None, node_id: str = "") -> Snapshot:
+              watermark=None, parked=None, frontier=None,
+              node_id: str = "") -> Snapshot:
         """Write the next generation atomically and prune old ones.
 
         ``wal_seq`` is the WAL frame sequence this state is current
@@ -206,7 +211,9 @@ class SnapshotStore:
         low-watermark clock to persist (restores GC's stability
         frontier across the restart); ``parked`` is the op applier's
         causally-parked batch — state that lives nowhere else until
-        its causal gap closes.
+        its causal gap closes; ``frontier`` is the convergence
+        observatory's fleet-min stability-frontier clock — restored as
+        a monotone floor on rejoin.
         """
         from ..sync import digest as digest_mod
 
@@ -221,6 +228,10 @@ class SnapshotStore:
             from ..oplog.wire import encode_ops_frame
 
             parked_frame = encode_ops_frame(parked)
+        if frontier is not None:
+            frontier = np.asarray(frontier, np.uint64)
+            if frontier.ndim == 1:
+                frontier = frontier.reshape(1, -1)
         payload = serde.to_binary({
             "generation": generation,
             "wal_seq": int(wal_seq),
@@ -229,6 +240,8 @@ class SnapshotStore:
             "watermark": (None if watermark is None
                           else [int(x) for x in np.asarray(
                               watermark, np.uint64).reshape(-1)]),
+            "frontier": (None if frontier is None
+                         else [[int(x) for x in row] for row in frontier]),
             "parked": parked_frame,
             "node": str(node_id),
             "checkpoint": checkpoint_mod.save_bytes(batch, universe),
@@ -264,6 +277,7 @@ class SnapshotStore:
             wal_seq=int(wal_seq), root=root, vv=vv,
             watermark=(None if watermark is None
                        else np.asarray(watermark, np.uint64).reshape(-1)),
+            frontier=frontier,
             parked=parked, node_id=node_id, nbytes=len(frame),
         )
 
@@ -408,6 +422,9 @@ def decode_snapshot(data: bytes) -> Snapshot:
                 f"snapshot parked-ops frame rejected: {e}") from None
     vv = np.asarray(meta.get("vv", []), dtype=np.uint64).reshape(-1)
     wm = meta.get("watermark")
+    # absent on pre-PR 15 snapshots: additive optional key, so old
+    # generations keep restoring (the frontier then regrows from zero)
+    fr = meta.get("frontier")
     tracing.count("durable.snapshot.decoded")
     return Snapshot(
         batch=batch, universe=universe,
@@ -415,6 +432,8 @@ def decode_snapshot(data: bytes) -> Snapshot:
         wal_seq=int(meta.get("wal_seq", 0)), root=root, vv=vv,
         watermark=(None if wm is None
                    else np.asarray(wm, dtype=np.uint64).reshape(-1)),
+        frontier=(None if fr is None
+                  else np.asarray(fr, dtype=np.uint64)),
         parked=parked, node_id=str(meta.get("node", "")),
         nbytes=len(data),
     )

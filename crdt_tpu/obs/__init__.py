@@ -36,6 +36,14 @@ first-class answer, in five parts:
   gauges, EWMA growth rates, time-to-overflow ETAs against the
   executor's regrow ceiling, and the ok/warn/critical watermark
   ``/healthz`` reports.
+* :mod:`crdt_tpu.obs.stability` — the agreement plane: divergence
+  aging (birth→resolution tracking of diverged digest subtrees), the
+  fleet stability frontier (the per-subtree clock below which every
+  non-quarantined peer has provably converged — what coordinated
+  truncation will consume, min-joined across the fleet lattice and
+  served at ``/stability``), and the runtime lattice auditor (sampled
+  merge-idempotence + frontier-soundness self-checks, the online
+  tripwire for the whole lattice stack).
 * :mod:`crdt_tpu.obs.kernels` — the kernel plane: the runtime kernel
   observatory (dynamic companion to kernelcheck, keyed on the SAME
   :data:`crdt_tpu.analysis.kernels.MANIFEST` rows) — per-kernel
@@ -50,7 +58,16 @@ for it.  PERF.md "Observability" documents naming conventions and how
 to read the flight recorder after a failed sync.
 """
 
-from . import capacity, convergence, events, fleet, kernels, latency, metrics  # noqa: F401
+from . import (  # noqa: F401
+    capacity,
+    convergence,
+    events,
+    fleet,
+    kernels,
+    latency,
+    metrics,
+    stability,
+)
 from .capacity import CapacityTracker, Occupancy, capacity_tracker  # noqa: F401
 from .convergence import ConvergenceTracker, tracker  # noqa: F401
 from .events import FlightRecorder, new_session_id, record, recorder  # noqa: F401
@@ -81,11 +98,21 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     registry,
 )
+from .stability import (  # noqa: F401
+    AuditReport,
+    FrontierReport,
+    StabilityTracker,
+    stability_tracker,
+)
 
 __all__ = [
+    "AuditReport",
     "CapacityTracker",
     "ConvergenceTracker",
     "Counter",
+    "FrontierReport",
+    "StabilityTracker",
+    "stability_tracker",
     "FleetObservatory",
     "FleetSnapshot",
     "FlightRecorder",

@@ -39,6 +39,14 @@ from . import metrics
 
 _HISTORY = 64  # delta_ratio observations retained per peer
 
+#: gauge sentinels a roster peer is SEEDED with at membership admission
+#: (:meth:`ConvergenceTracker.register_peer`), before any digest
+#: exchange: staleness is infinite (never converged — worse than any
+#: finite age, so alerts and the gossip urgency ranking both fire) and
+#: divergence is UNKNOWN, which must read as -1, never as a reassuring 0
+NEVER_SYNCED_STALENESS = float("inf")
+UNKNOWN_DIVERGENCE = -1
+
 
 class _PeerState:
     __slots__ = (
@@ -90,6 +98,25 @@ class ConvergenceTracker:
         if st is None:
             st = self._peers[peer] = _PeerState()
         return st
+
+    def register_peer(self, peer: str) -> None:
+        """Seed the per-peer gauges for a roster peer admitted BEFORE
+        any digest exchange (:meth:`crdt_tpu.cluster.membership.
+        Membership.add` calls this): without the seed, a peer that
+        never completes a session is simply absent from ``/metrics`` —
+        a dashboard cannot tell "silent peer" from "no such peer".
+        Idempotent, and a peer with observed state is left untouched
+        (the sentinels must never clobber real measurements)."""
+        with self._lock:
+            if peer in self._peers:
+                return
+            self._state(peer)
+        reg = self._reg()
+        reg.gauge_set(f"sync.peer.{peer}.staleness_s",
+                      NEVER_SYNCED_STALENESS)
+        reg.gauge_set(f"sync.peer.{peer}.divergence", UNKNOWN_DIVERGENCE)
+        reg.gauge_set(f"sync.peer.{peer}.divergence_frac",
+                      UNKNOWN_DIVERGENCE)
 
     def observe_divergence(self, peer: str, diverged: int,
                            objects: int) -> None:

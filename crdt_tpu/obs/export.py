@@ -38,9 +38,17 @@ from .namespace import PROM_PREFIX, sanitize as _sanitize
 
 
 def _fmt(v: float) -> str:
-    """Prometheus sample value: integers render bare, floats via repr."""
+    """Prometheus sample value: integers render bare, floats via repr,
+    non-finite values in the exposition format's canonical spelling
+    (the never-synced staleness sentinel is ``+Inf``)."""
+    import math
+
     if isinstance(v, bool):
         return "1" if v else "0"
+    if isinstance(v, float) and not math.isfinite(v):
+        if math.isnan(v):
+            return "NaN"
+        return "+Inf" if v > 0 else "-Inf"
     if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
         return str(int(v))
     return repr(float(v))
@@ -146,13 +154,14 @@ class MetricsServer:
     def __init__(self, host: str, port: int,
                  registry: Optional[metrics.MetricsRegistry] = None,
                  tracker: Optional[convergence.ConvergenceTracker] = None,
-                 observatory=None, capacity=None):
+                 observatory=None, capacity=None, stability=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self._registry = registry
         self._tracker = tracker
         self._observatory = observatory
         self._capacity = capacity
+        self._stability = stability
         self._t0 = time.monotonic()
         self.scrapes: dict = {}
         self._scrape_lock = threading.Lock()
@@ -258,6 +267,20 @@ class MetricsServer:
                 name_prefixes=("kernel.", "devicemem."))
             return (text.encode(),
                     "text/plain; version=0.0.4; charset=utf-8", 200)
+        if route == "/stability":
+            # the convergence observatory (crdt_tpu/obs/stability.py):
+            # the published frontier (per-subtree + fleet-min clocks —
+            # what the future truncate-epoch proposer consumes), the
+            # divergence-aging view (which subtrees are stuck diverged,
+            # and for how long) and the lattice-audit totals.  JSON
+            # only: the clock VECTORS are the payload, and the scalar
+            # gauges already ride /metrics as crdt_tpu_stability_*.
+            from . import stability as stability_mod
+
+            trk = self._stability if self._stability is not None \
+                else stability_mod.tracker()
+            body = json.dumps(trk.snapshot()).encode()
+            return body, "application/json", 200
         if route == "/healthz":
             # liveness + the capacity watermark: `status` mirrors the
             # tracker's overall watermark state (ok/warn/critical; "ok"
@@ -278,7 +301,8 @@ class MetricsServer:
                 "capacity": wm,
             }).encode()
             return body, "application/json", 200
-        return b"not found (try /metrics, /events, /fleet, /kernels, /healthz)\n", \
+        return (b"not found (try /metrics, /events, /fleet, /kernels, "
+                b"/stability, /healthz)\n"), \
             "text/plain; charset=utf-8", 404
 
     def scrape_counts(self) -> dict:
@@ -312,7 +336,7 @@ def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
                          registry: Optional[metrics.MetricsRegistry] = None,
                          tracker: Optional[convergence.ConvergenceTracker]
                          = None, observatory=None,
-                         capacity=None) -> MetricsServer:
+                         capacity=None, stability=None) -> MetricsServer:
     """Start the opt-in background exporter; ``port=0`` picks a free
     port (read it back from ``server.port``).  ``tracker`` pairs a
     custom ``registry`` with the convergence tracker writing into it
@@ -320,6 +344,9 @@ def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
     :class:`~crdt_tpu.obs.fleet.FleetObservatory` behind ``/fleet``
     (default: the process-global one); ``capacity`` is the
     :class:`~crdt_tpu.obs.capacity.CapacityTracker` whose watermark
-    ``/healthz`` reports (default: the process-global one)."""
+    ``/healthz`` reports (default: the process-global one);
+    ``stability`` is the :class:`~crdt_tpu.obs.stability.
+    StabilityTracker` behind ``/stability`` (default: the
+    process-global one)."""
     return MetricsServer(host, port, registry, tracker, observatory,
-                         capacity)
+                         capacity, stability)

@@ -229,6 +229,29 @@ class FleetSnapshot:
                 acc["max"] = ETA_NOT_GROWING
         return out
 
+    def fleet_stability(self) -> Dict[str, dict]:
+        """The stability-frontier clock gauges
+        (``stability.frontier.{max_counter,subtree.<i>.max_counter}``,
+        :mod:`crdt_tpu.obs.stability`) reduced fleet-wide by MIN — the
+        per-subtree min-join: a clock is FLEET-stable only if every
+        observer's frontier has passed it, so the fleet read is the
+        minimum over nodes, never LWW ("some node's frontier") and
+        never a sum.  Count/diagnostic gauges (peers/stale/unheard/...)
+        stay per-node.  Returns ``{name: {"min", "nodes"}}``."""
+        out: Dict[str, dict] = {}
+        for sl in self.slices.values():
+            for name, entry in sl.get("gauges", {}).items():
+                if not name.startswith("stability.frontier.") \
+                        or not name.endswith("max_counter"):
+                    continue
+                v = float(entry[2])
+                acc = out.get(name)
+                if acc is None:
+                    acc = out[name] = {"min": v, "nodes": 0}
+                acc["min"] = min(acc["min"], v)
+                acc["nodes"] += 1
+        return out
+
     def fleet_lag(self) -> Dict[str, dict]:
         """The write-to-visible lag gauges (``sync.peer.<peer>.lag_*``,
         :mod:`crdt_tpu.obs.latency`) reduced fleet-wide: per leaf
@@ -283,6 +306,7 @@ class FleetSnapshot:
                 "histograms": self.fleet_histograms(),
                 "capacity": self.fleet_capacity(),
                 "lag": self.fleet_lag(),
+                "stability": self.fleet_stability(),
             },
         }
 
@@ -513,6 +537,17 @@ def fleet_prometheus_text(snap: FleetSnapshot,
             rendered = str(int(v)) if v.is_integer() else repr(v)
             lines.append(f"# TYPE {base}_{reduction} gauge")
             lines.append(f"{base}_{reduction} {rendered}")
+    # stability-frontier clocks get the MIN-join reduction
+    # (fleet_stability): a clock is fleet-stable only when EVERY
+    # observer's frontier passed it — the per-subtree min-join the
+    # truncate-epoch proposer will read
+    stab = snap.fleet_stability()
+    for name in sorted(stab):
+        base = f"{prefix}_{_sanitize(name)}_min"
+        v = float(stab[name]["min"])
+        rendered = str(int(v)) if v.is_integer() else repr(v)
+        lines.append(f"# TYPE {base} gauge")
+        lines.append(f"{base} {rendered}")
     # write-to-visible lag gets the worst-pair reduction (fleet_lag):
     # one scrape answers "the worst replication lag anywhere", and the
     # quiescence pin — lag_current_s_max == 0 — holds fleet-wide

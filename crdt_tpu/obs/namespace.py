@@ -128,18 +128,59 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "full-state exchange wall time (span)"),
     # -- per-peer convergence gauges (obs/convergence.py) --------------------
     NameSpec("sync.peer.*.divergence", "gauge",
-             "objects diverged at the last digest exchange"),
+             "objects diverged at the last digest exchange (-1 = roster "
+             "peer admitted but never exchanged — unknown, not zero)"),
     NameSpec("sync.peer.*.divergence_frac", "gauge",
-             "diverged fraction of the fleet"),
+             "diverged fraction of the fleet (-1 = never exchanged)"),
     NameSpec("sync.peer.*.rounds_to_converge", "gauge",
              "digest exchanges the last session needed"),
     NameSpec("sync.peer.*.staleness_s", "gauge",
-             "seconds since the last converged sync (refreshed at scrape)"),
+             "seconds since the last converged sync (refreshed at "
+             "scrape; +Inf = roster peer that has NEVER converged — "
+             "seeded at membership admission so silent peers alert)"),
     NameSpec("sync.peer.*.delta_ratio", "gauge",
              "last session's payload bytes over the full-state reference"),
     NameSpec("sync.peer.*.diverged_subtrees", "gauge",
              "widest diverged internal frontier the last tree descent "
              "saw (0 = converged or flat-mode peer); urgency tiebreak"),
+    # -- convergence observatory (obs/stability.py) ---------------------------
+    NameSpec("sync.peer.*.divergence_age_s", "gauge",
+             "age of this peer's OLDEST still-diverged subtree (0 = "
+             "nothing outstanding) — a subtree stuck diverged across "
+             "rounds shows up here, not as invisible churn"),
+    NameSpec("sync.stability.divergence_age_s", "histogram",
+             "birth-to-resolution age of diverged subtrees, per "
+             "(peer, subtree) episode"),
+    NameSpec("sync.stability.divergence_age_p50_s", "gauge",
+             "median resolved divergence age over the bounded window "
+             "(-1 = nothing resolved yet)"),
+    NameSpec("sync.stability.divergence_age_max_s", "gauge",
+             "worst resolved divergence age over the bounded window "
+             "(-1 = nothing resolved yet)"),
+    NameSpec("sync.stability.outstanding", "gauge",
+             "(peer, subtree) pairs currently diverged at this observer"),
+    NameSpec("sync.stability.resolved", "counter",
+             "divergence episodes resolved (a later exchange found the "
+             "subtree clean again)"),
+    NameSpec("stability.frontier.*", "gauge",
+             "fleet stability frontier state (peers/stale/unheard/"
+             "excluded contributing counts, subtrees, age_s, "
+             "max_counter of the fleet-min clock, lag behind the local "
+             "frontier) — the clock below which every non-quarantined "
+             "peer has provably converged"),
+    NameSpec("stability.frontier.subtree.*.max_counter", "gauge",
+             "per-subtree frontier clock (max over actors) — the "
+             "structure the truncate-epoch proposer and op-log "
+             "stability compaction will consume"),
+    NameSpec("stability.audit.checks", "counter",
+             "lattice-auditor checks performed (sampled self-merge "
+             "idempotence + frontier soundness cross-checks)"),
+    NameSpec("stability.audit.violations", "counter",
+             "lattice-auditor violations — ANY nonzero value is a "
+             "lattice-stack bug (loud stability.audit_violation event "
+             "carries the plane that lied)"),
+    NameSpec("stability.audit", "histogram",
+             "one lattice-audit pass (span)"),
     # -- latency observatory (obs/latency.py, sync/session.py,
     # cluster/transport.py) ---------------------------------------------------
     NameSpec("sync.peer.*.network_wait_frac", "gauge",

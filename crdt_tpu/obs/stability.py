@@ -1,0 +1,879 @@
+"""Convergence observatory — divergence aging, the fleet stability
+frontier, and the runtime lattice auditor.
+
+The reference's ``Causal::truncate`` (`traits.rs:44-47`) is only safe
+at clocks the whole fleet has provably converged past, and the batched
+read front-end's session guarantees (``ReadCtx``, `ctx.rs:12-21`) are
+only honest if staleness is measurable — yet until this module nothing
+in the repo knew *how old* any divergence was or *which* clocks the
+fleet had durably agreed on.  Three measurement planes, in the
+observatory-before-subsystem pattern of PRs 9/13/14:
+
+* **Divergence aging** — every digest exchange (flat or tree descent)
+  names the diverged rows; :class:`StabilityTracker.observe_descent`
+  maps them onto the digest tree's TOP-LEVEL subtrees (the same
+  node-coverage ranges the descent's first comparison uses — at most
+  :data:`~crdt_tpu.sync.tree.TREE_K` of them, the root's children) and
+  tracks each ``(peer, subtree)`` from its first diverged sighting
+  (*birth*) to the first exchange that finds it clean again
+  (*resolution*).  Resolution ages feed the
+  ``sync.stability.divergence_age_s`` log2 histogram plus p50/max
+  gauges; still-diverged subtrees feed ``sync.stability.outstanding``
+  and the per-peer ``sync.peer.<peer>.divergence_age_s`` oldest-age
+  gauge — a subtree that stays diverged across rounds is an alertable
+  series, not invisible churn.
+
+* **Fleet stability frontier** — a CLEAN converged exchange (digest-
+  tree root equality, or flat digest-vector equality, with ZERO
+  divergence found) proves the peer's COMMITTED state byte-identical
+  to ours: both digests folded state each node already held before
+  the session, so "the peer witnessed every dot in our per-subtree
+  version vectors" survives anything that happens afterwards — a
+  session that shipped deltas defers its evidence to the next idle
+  re-sync instead, because the peer could still discard the
+  un-committed merge on a late failure.
+  :class:`StabilityTracker.observe_converged` records those
+  per-subtree clocks per peer (one jitted frontier fold —
+  :func:`subtree_version_vectors`, memoized beside the digest vector);
+  :meth:`StabilityTracker.frontier` takes the element-wise MIN over
+  every non-quarantined peer — per subtree, plus the fleet-min clock —
+  under the same liveness rules as the GC watermark
+  (:mod:`crdt_tpu.gc.watermark`): unheard roster peers pin zero, stale
+  peers freeze their last contribution, silence past ``quarantine_s``
+  excludes a dead peer.  Published as ``crdt_tpu_stability_frontier_*``
+  gauges, min-joined across the PR 6 fleet lattice
+  (:meth:`~crdt_tpu.obs.fleet.FleetSnapshot.fleet_stability`), served
+  at ``GET /stability``, persisted in durable snapshots and restored
+  as a monotone floor on rejoin (same discipline as
+  ``GcEngine.restore_watermark``: stability is monotone — counters at
+  or below a previously fleet-stable frontier were converged past by
+  every peer THEN, and counters only grow).  This is the exact
+  structure the future truncate-epoch proposer and op-log stability
+  compaction consume.
+
+* **Runtime lattice auditor** — :meth:`StabilityTracker.audit` is the
+  online tripwire for the whole lattice stack: per gossip round it
+  re-merges a seeded random sample of objects against their own state
+  through the real wire codec (``gather_blobs`` → ``from_wire`` →
+  ``merge``) and re-digests them — idempotence means the digest must
+  be bit-stable against the live fleet's rows — and cross-checks the
+  published frontier against the local per-subtree version vectors and
+  every freshly-advertised peer version vector.  Checks and violations
+  count under ``stability.audit.{checks,violations}``; ANY violation
+  additionally lands a loud ``stability.audit_violation`` flight-
+  recorder event naming the plane that lied.
+
+Frontier semantics caveat (documented, not hidden): a peer that
+crashes and restores from a snapshot OLDER than its last converged
+session can briefly lag the frontier until its rejoin delta sync
+completes — the same at-least-once window the GC watermark's restore
+already accepts; drive checkpoints at round end (the scheduler's
+default) to keep the window one round wide.
+
+Stdlib-only at module scope (the obs-package discipline): numpy and
+jax import lazily inside the fold/audit paths, so a scraper box can
+import this module for :meth:`StabilityTracker.snapshot` shapes
+without the device runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import convergence as convergence_mod
+from . import events as events_mod
+from . import metrics as metrics_mod
+
+#: resolved divergence ages retained for the p50/max gauges
+RESOLVED_WINDOW = 512
+
+#: gauge sentinel: no divergence has ever been observed/resolved
+AGE_UNKNOWN = -1.0
+
+
+def subtree_layout(n: int) -> Tuple[int, int]:
+    """``(subtrees, span)`` of the digest tree's top children level for
+    an ``n``-object fleet: subtree ``s`` covers objects ``[s*span,
+    (s+1)*span)`` — the node-coverage rule of :mod:`crdt_tpu.sync.tree`
+    (node ``i`` at level ``l`` covers leaves ``[i*k**l, (i+1)*k**l)``),
+    evaluated at the level just below the root.  At most ``TREE_K``
+    subtrees by construction (they are the root's children), so every
+    per-subtree table here is bounded independent of fleet size."""
+    from ..sync.tree import TREE_K
+
+    if n <= 0:
+        return 0, 1
+    levels, size = 1, n
+    while size > 1:
+        size = -(-size // TREE_K)
+        levels += 1
+    if levels < 2:  # a one-object fleet folds straight to the root
+        return 1, 1
+    span = TREE_K ** (levels - 2)
+    return -(-n // span), span
+
+
+@functools.lru_cache(maxsize=None)
+def _frontier_kernel(subtrees: int):
+    """ONE jitted frontier fold: ``clock[S*span, W] -> vv[S, W]`` — the
+    per-subtree version-vector summary (pointwise max over each
+    subtree's object rows), the per-subtree analogue of
+    :func:`crdt_tpu.sync.digest.version_vector`.  ``subtrees`` is
+    static (the factory closes over it), so the lowering count walks
+    the same bounded ladder as every other manifest row."""
+    import jax
+    import jax.numpy as jnp
+
+    from .kernels import observed_kernel
+
+    def kernel(clock):
+        return jnp.max(
+            clock.reshape(subtrees, -1, clock.shape[-1]), axis=1)
+
+    return observed_kernel("obs.stability.frontier_fold")(jax.jit(kernel))
+
+
+def _clock_plane(batch):
+    """The batch's clock plane flattened to ``[N, W]`` (PNCounter's
+    ``[N, 2, A]`` flattens to ``[N, 2A]`` — same convention as its
+    version vector), or None for clockless types (LWW)."""
+    import numpy as np
+
+    from ..batch.gcounter_batch import GCounterBatch
+    from ..batch.lwwreg_batch import LWWRegBatch
+    from ..batch.orswot_batch import OrswotBatch
+    from ..batch.pncounter_batch import PNCounterBatch
+    from ..batch.vclock_batch import VClockBatch
+
+    if isinstance(batch, OrswotBatch):
+        clocks = batch.clock
+    elif isinstance(batch, PNCounterBatch):
+        clocks = batch.planes
+    elif isinstance(batch, (GCounterBatch, VClockBatch)):
+        clocks = batch.clocks
+    elif isinstance(batch, LWWRegBatch):
+        return None
+    else:
+        raise TypeError(
+            f"no clock plane for {type(batch).__name__} "
+            "(supported: Orswot/PNCounter/GCounter/VClock batches)"
+        )
+    host = np.asarray(clocks)
+    return host.reshape(host.shape[0], -1)
+
+
+def subtree_version_vectors(batch):
+    """``uint64[S, W]`` per-subtree version vectors of ``batch``
+    (:func:`subtree_layout` rows), or None for clockless types.
+    Memoized on the batch object beside the digest vector
+    (:class:`crdt_tpu.sync.digest.DigestCache` — mutating paths always
+    produce a new batch, so a hit can never serve stale clocks; idle
+    converged rounds therefore run ZERO frontier folds)."""
+    import numpy as np
+
+    from ..sync import digest as digest_mod
+
+    cache = digest_mod.digest_cache()
+    cached = cache.get(batch, None, "subtree_vv")
+    if cached is not None:
+        return cached
+    host = _clock_plane(batch)
+    if host is None:
+        return None
+    n = int(host.shape[0])
+    subtrees, span = subtree_layout(n)
+    if subtrees == 0:
+        out = np.zeros((0, host.shape[1]), dtype=np.uint64)
+    else:
+        import jax.numpy as jnp
+
+        pad = subtrees * span - n
+        if pad:
+            host = np.concatenate(
+                [host, np.zeros((pad,) + host.shape[1:], host.dtype)])
+        out = np.asarray(
+            _frontier_kernel(subtrees)(jnp.asarray(host))
+        ).astype(np.uint64)
+    cache.put(batch, None, "subtree_vv", out)
+    return out
+
+
+def _align_rows(rows: List, width: int) -> List:
+    """Zero-pad clock rows to a common actor width (implied-0 counters,
+    the `vclock.rs:206-210` rule — conservative, never unsafe)."""
+    import numpy as np
+
+    out = []
+    for r in rows:
+        r = np.asarray(r, dtype=np.uint64).reshape(-1)
+        if r.size < width:
+            r = np.concatenate(
+                [r, np.zeros(width - r.size, dtype=np.uint64)])
+        out.append(r[:width] if r.size > width else r)
+    return out
+
+
+@dataclasses.dataclass
+class FrontierReport:
+    """One frontier computation's outcome.
+
+    ``clock`` is the fleet-min frontier (``uint64[W]``): the
+    element-wise min over every contributing peer's WHOLE-FLEET version
+    vector at its last converged session — a peer that converged with
+    our whole state witnessed every dot at or below that vector (dots
+    mint monotonically per actor), so counters at or below ``clock``
+    are witnessed by every non-quarantined peer on EVERY object.
+    ``subtree_clocks`` is ``uint64[S, W]`` — the per-subtree min-join,
+    never below ``clock`` (the fleet-wide claim covers every subtree).
+    All-zero whenever any included roster peer is unheard."""
+
+    clock: object                 # numpy uint64[W]
+    subtree_clocks: object        # numpy uint64[S, W]
+    subtrees: int = 0
+    peers: int = 0                # peers contributing converged clocks
+    stale: int = 0                # contributing but past stale_after_s
+    unheard: int = 0              # roster peers never converged with
+    excluded: int = 0             # quarantined out of the minimum
+    age_s: float = 0.0            # oldest contributing observation's age
+
+    @property
+    def frozen(self) -> bool:
+        return self.stale > 0 or self.unheard > 0
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """One lattice-audit pass's outcome.  ``violations`` entries name
+    the plane that lied (``merge_idempotence`` / ``frontier_local`` /
+    ``frontier_peer_vv``) with enough detail to reproduce."""
+
+    checks: int = 0
+    sampled: int = 0
+    violations: List[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class _PeerStability:
+    __slots__ = ("outstanding", "clocks", "converged_ts")
+
+    def __init__(self):
+        # subtree -> birth timestamp of the CURRENT divergence episode
+        # (monotonic seconds); absent = currently believed converged
+        self.outstanding: Dict[int, float] = {}
+        # per-subtree converged clocks: tuple of row-tuples (stdlib —
+        # numpy only enters at fold/min time), element-wise-max merged
+        # so the evidence is monotone per (peer, subtree)
+        self.clocks: Optional[Tuple[Tuple[int, ...], ...]] = None
+        self.converged_ts: Optional[float] = None
+
+
+class StabilityTracker:
+    """Divergence aging + stability frontier + lattice auditor for one
+    observer (a :class:`~crdt_tpu.cluster.gossip.ClusterNode` owns a
+    private one, like its lag tracker; standalone sessions feed the
+    process-global :func:`tracker`).
+
+    ``stale_after_s`` / ``quarantine_s`` mirror the GC watermark's
+    liveness knobs; ``tracker`` is the
+    :class:`~crdt_tpu.obs.convergence.ConvergenceTracker` whose cached
+    peer version vectors the auditor cross-checks (the process-global
+    one by default); ``audit_sample`` / ``audit_every`` bound the
+    auditor's per-round budget (0 disables it); ``clock`` is
+    injectable for tests (monotonic seconds).
+    """
+
+    def __init__(self, *,
+                 registry: Optional[metrics_mod.MetricsRegistry] = None,
+                 tracker: Optional[convergence_mod.ConvergenceTracker]
+                 = None,
+                 stale_after_s: float = 30.0,
+                 quarantine_s: float = 300.0,
+                 audit_sample: int = 8,
+                 audit_every: int = 1,
+                 seed: int = 0,
+                 clock=time.monotonic):
+        if not 0.0 < stale_after_s <= quarantine_s:
+            raise ValueError(
+                f"need 0 < stale_after_s <= quarantine_s, got "
+                f"{stale_after_s}/{quarantine_s}"
+            )
+        self._registry = registry
+        self._tracker = tracker
+        self.stale_after_s = stale_after_s
+        self.quarantine_s = quarantine_s
+        self.audit_sample = int(audit_sample)
+        self.audit_every = int(audit_every)
+        self._clock = clock
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._peers: Dict[str, _PeerStability] = {}
+        # resolved divergence ages, bounded (the p50/max gauge window)
+        self._resolved: deque = deque(maxlen=RESOLVED_WINDOW)
+        self._resolved_total = 0
+        # roster peers never converged with quarantine off their first
+        # sighting (there is no observation to age them by)
+        self._first_seen: Dict[str, float] = {}
+        # a fleet-min clock persisted by a snapshot and restored across
+        # a restart — a safe monotone floor, for every subtree (module
+        # docstring: the fleet-wide claim covers every object)
+        self._floor: Optional[Tuple[int, ...]] = None
+        # the last PUBLISHED clocks: the per-observer monotone floors
+        # ("the frontier never regresses per observer")
+        self._published: Optional[tuple] = None           # [S][W]
+        self._published_global: Optional[Tuple[int, ...]] = None
+        self._audit_rounds = 0
+        self._audit_checks = 0
+        self._audit_violations = 0
+        self._last_violation: Optional[dict] = None
+
+    def _reg(self) -> metrics_mod.MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else metrics_mod.registry()
+
+    def _conv(self) -> convergence_mod.ConvergenceTracker:
+        return self._tracker if self._tracker is not None \
+            else convergence_mod.tracker()
+
+    def _state(self, peer: str) -> _PeerStability:
+        st = self._peers.get(peer)
+        if st is None:
+            st = self._peers[peer] = _PeerStability()
+        return st
+
+    # -- plane 1: divergence aging -------------------------------------------
+
+    def observe_descent(self, peer: str, diverged_ids, objects: int,
+                        at: Optional[float] = None) -> None:
+        """Fold one digest exchange's diverged row set vs ``peer`` into
+        the birth→resolution tracker: rows map onto top-level subtrees
+        (:func:`subtree_layout`), newly-diverged subtrees are born at
+        this observation, and tracked subtrees ABSENT from the set are
+        resolved — their digests match again, so the episode's age is
+        measured and published.  An episode that spans many exchanges
+        keeps its original birth (the age grows, which is the point)."""
+        subtrees, span = subtree_layout(int(objects))
+        now = self._clock() if at is None else at
+        current = {int(i) // span for i in diverged_ids}
+        resolved: List[Tuple[int, float]] = []
+        with self._lock:
+            st = self._state(peer)
+            for s in list(st.outstanding):
+                if s not in current:
+                    resolved.append((s, max(0.0, now - st.outstanding.pop(s))))
+            for s in current:
+                st.outstanding.setdefault(s, now)
+            for _, age in resolved:
+                self._resolved.append(age)
+            self._resolved_total += len(resolved)
+        self._publish_aging(peer, resolved)
+
+    def resolve_all(self, peer: str, at: Optional[float] = None) -> None:
+        """Resolve every outstanding subtree vs ``peer`` — what a
+        converged session means (the digest oracle found NOTHING
+        diverged)."""
+        now = self._clock() if at is None else at
+        resolved: List[Tuple[int, float]] = []
+        with self._lock:
+            st = self._state(peer)
+            for s in list(st.outstanding):
+                resolved.append((s, max(0.0, now - st.outstanding.pop(s))))
+            for _, age in resolved:
+                self._resolved.append(age)
+            self._resolved_total += len(resolved)
+        self._publish_aging(peer, resolved)
+
+    def _publish_aging(self, peer: str,
+                       resolved: List[Tuple[int, float]]) -> None:
+        from ..utils import tracing
+
+        now = self._clock()
+        with self._lock:
+            outstanding = sum(
+                len(st.outstanding) for st in self._peers.values())
+            births = self._peers[peer].outstanding.values() \
+                if peer in self._peers else ()
+            oldest = (now - min(births)) if births else 0.0
+            window = sorted(self._resolved)
+        reg = self._reg()
+        for _, age in resolved:
+            reg.observe("sync.stability.divergence_age_s", age)
+        if resolved:
+            tracing.count("sync.stability.resolved", len(resolved))
+            ages = [age for _, age in resolved]
+            events_mod.record(
+                "stability.resolved", peer=peer, subtrees=len(resolved),
+                max_age_s=round(max(ages), 6))
+        reg.gauge_set("sync.stability.outstanding", outstanding)
+        reg.gauge_set(f"sync.peer.{peer}.divergence_age_s",
+                      round(max(0.0, oldest), 6))
+        if window:
+            mid = window[min(len(window) - 1,
+                             max(0, int(round(0.5 * (len(window) - 1)))))]
+            reg.gauge_set("sync.stability.divergence_age_p50_s",
+                          round(mid, 6))
+            reg.gauge_set("sync.stability.divergence_age_max_s",
+                          round(window[-1], 6))
+        else:
+            reg.gauge_set("sync.stability.divergence_age_p50_s", AGE_UNKNOWN)
+            reg.gauge_set("sync.stability.divergence_age_max_s", AGE_UNKNOWN)
+
+    def oldest_divergence_age_s(self) -> float:
+        """Age of the oldest still-diverged subtree across every peer
+        (0 = nothing outstanding) — what the demo prints at
+        convergence."""
+        now = self._clock()
+        with self._lock:
+            births = [b for st in self._peers.values()
+                      for b in st.outstanding.values()]
+        return max(0.0, now - min(births)) if births else 0.0
+
+    # -- plane 2: the fleet stability frontier -------------------------------
+
+    def observe_converged(self, peer: str, batch,
+                          at: Optional[float] = None) -> None:
+        """One CLEAN converged exchange vs ``peer``: the digest oracle
+        proved the peer's committed state byte-identical to ``batch``
+        (zero divergence — no uncommitted merge involved), so the peer
+        has witnessed every dot in the batch's per-subtree version
+        vectors.  Records those clocks (element-wise-max merged —
+        evidence is monotone) and resolves all outstanding divergence
+        aging.  Callers must only feed sessions that shipped NO deltas
+        (:mod:`crdt_tpu.sync.session` enforces this); a delta session's
+        evidence lands on the next idle re-sync."""
+        self.resolve_all(peer, at=at)
+        svv = subtree_version_vectors(batch)
+        if svv is None:
+            return  # clockless type: aging only, no frontier plane
+        now = self._clock() if at is None else at
+        fresh = tuple(tuple(int(c) for c in row) for row in svv)
+        with self._lock:
+            st = self._state(peer)
+            old = st.clocks
+            if old is None or len(old) != len(fresh):
+                st.clocks = fresh
+            else:
+                st.clocks = tuple(
+                    tuple(max(a, b) for a, b in
+                          _zip_pad(old_row, new_row))
+                    for old_row, new_row in zip(old, fresh))
+            st.converged_ts = now
+            self._first_seen.pop(peer, None)
+
+    def frontier(self, batch, peers=None,
+                 at: Optional[float] = None) -> Optional[FrontierReport]:
+        """Compute (and publish) the stability frontier given the local
+        ``batch`` and an optional peer roster.
+
+        Without a roster, every peer with recorded converged clocks
+        contributes (subject to quarantine).  With one, roster peers
+        WITHOUT recorded clocks pin the frontier at zero until their
+        quarantine expires — "I have never converged with n3" made
+        explicit, exactly the GC watermark's membership rule.  The
+        local node always contributes its own subtree clocks (a
+        peer-less fleet's frontier is its own frontier).  The restored
+        floor and the last published value apply as element-wise
+        maxima, so the published series is monotone per observer.
+        Returns None (publishing nothing) for clockless batch types."""
+        import numpy as np
+
+        svv = subtree_version_vectors(batch)
+        if svv is None:
+            return None
+        subtrees = int(svv.shape[0])
+        width = int(svv.shape[1]) if svv.ndim == 2 else 0
+        now = self._clock() if at is None else at
+        report = FrontierReport(
+            clock=np.zeros(width, np.uint64),
+            subtree_clocks=np.zeros((subtrees, width), np.uint64),
+            subtrees=subtrees)
+
+        contributing: List[tuple] = []
+        with self._lock:
+            known = {p for p, st in self._peers.items()
+                     if st.clocks is not None}
+            roster = set(peers) if peers is not None else set(known)
+            for peer in sorted(roster | known):
+                st = self._peers.get(peer)
+                if st is None or st.clocks is None:
+                    if peer not in roster:
+                        continue
+                    first = self._first_seen.setdefault(peer, now)
+                    if now - first > self.quarantine_s:
+                        report.excluded += 1
+                    else:
+                        report.unheard += 1
+                    continue
+                self._first_seen.pop(peer, None)
+                age = max(0.0, now - st.converged_ts)
+                if age > self.quarantine_s:
+                    report.excluded += 1
+                    continue
+                report.peers += 1
+                report.age_s = max(report.age_s, age)
+                if age > self.stale_after_s:
+                    report.stale += 1
+                contributing.append(st.clocks)
+            floor = self._floor
+            published = self._published
+            published_global = self._published_global
+
+        local_vv = svv.max(axis=0).astype(np.uint64) if subtrees else \
+            np.zeros(width, np.uint64)
+        if report.unheard:
+            clocks = np.zeros((subtrees, width), np.uint64)
+            fleet_min = np.zeros(width, np.uint64)
+        else:
+            clocks = svv.astype(np.uint64).copy()
+            fleet_min = local_vv.copy()
+            for peer_clocks in contributing:
+                # the peer's whole-fleet clock at convergence: the max
+                # over its subtree rows (all recorded at one converged
+                # session) — every dot at or below it was in the state
+                # the peer proved byte-identical, so it bounds the
+                # fleet-min clock
+                rows = _align_rows(list(peer_clocks), width)
+                peer_global = np.zeros(width, np.uint64)
+                for r in rows:
+                    peer_global = np.maximum(peer_global, r)
+                fleet_min = np.minimum(fleet_min, peer_global)
+                for s in range(min(subtrees, len(rows))):
+                    clocks[s] = np.minimum(clocks[s], rows[s])
+                # a peer whose table is SHORTER than the local subtree
+                # count has no per-subtree evidence for the missing
+                # rows: pin them 0 (the fleet-min floor below re-raises
+                # what the fleet-wide claim still covers)
+                for s in range(len(peer_clocks), subtrees):
+                    clocks[s] = 0
+        # monotone floors, element-wise max (stability is monotone —
+        # module docstring): the restored snapshot clock and the last
+        # published values may only ever RAISE the minimum.  The
+        # fleet-min clock floors every subtree row too — its
+        # justification is fleet-wide, covering every object.
+        if floor is not None:
+            fl = _align_rows([floor], width)[0]
+            fleet_min = np.maximum(fleet_min, fl)
+        if published_global is not None:
+            fleet_min = np.maximum(
+                fleet_min, _align_rows([published_global], width)[0])
+        for s in range(subtrees):
+            clocks[s] = np.maximum(clocks[s], fleet_min)
+            if published is not None and s < len(published):
+                clocks[s] = np.maximum(
+                    clocks[s], _align_rows([published[s]], width)[0])
+        report.subtree_clocks = clocks
+        report.clock = fleet_min
+        with self._lock:
+            self._published = tuple(
+                tuple(int(c) for c in row) for row in clocks)
+            self._published_global = tuple(int(c) for c in fleet_min)
+
+        lag = int((local_vv - np.minimum(local_vv, report.clock))
+                  .max(initial=0))
+        reg = self._reg()
+        reg.gauge_set("stability.frontier.peers", report.peers)
+        reg.gauge_set("stability.frontier.stale", report.stale)
+        reg.gauge_set("stability.frontier.unheard", report.unheard)
+        reg.gauge_set("stability.frontier.excluded", report.excluded)
+        reg.gauge_set("stability.frontier.subtrees", subtrees)
+        reg.gauge_set("stability.frontier.age_s", round(report.age_s, 3))
+        reg.gauge_set("stability.frontier.max_counter",
+                      int(report.clock.max(initial=0)))
+        reg.gauge_set("stability.frontier.lag", lag)
+        for s in range(subtrees):
+            reg.gauge_set(f"stability.frontier.subtree.{s}.max_counter",
+                          int(clocks[s].max(initial=0)))
+        return report
+
+    def frontier_clock(self):
+        """The last published fleet-min frontier clock as
+        ``uint64[W]`` (None until :meth:`frontier` ran) — what a
+        durable checkpoint persists and :meth:`restore` floors a
+        rejoined observer with."""
+        import numpy as np
+
+        with self._lock:
+            published = self._published_global
+        if published is None:
+            return None
+        return np.asarray(published, dtype=np.uint64)
+
+    def subtree_frontier_clocks(self):
+        """The last published per-subtree frontier clocks as
+        ``uint64[S, W]`` (None until :meth:`frontier` ran)."""
+        import numpy as np
+
+        with self._lock:
+            published = self._published
+        if published is None:
+            return None
+        return np.asarray(published, dtype=np.uint64)
+
+    def restore(self, clock) -> None:
+        """Seed the frontier with a fleet-min clock persisted by a
+        snapshot (:mod:`crdt_tpu.durable`): counters at or below it
+        were fleet-converged when the snapshot was taken, and stability
+        is monotone, so the restored value is a safe floor under every
+        future minimum — a restarted observer's frontier resumes
+        instead of regressing to zero until its peers re-converge.
+        Accepts one flat clock (a 2-D array floors at its row-wise
+        minimum — the conservative read of a per-subtree table)."""
+        import numpy as np
+
+        arr = np.asarray(clock, dtype=np.uint64)
+        if arr.ndim > 1:
+            arr = arr.min(axis=0)
+        with self._lock:
+            self._floor = tuple(int(c) for c in arr.reshape(-1))
+
+    def forget(self, peer: str) -> None:
+        """Drop a peer's frontier/aging bookkeeping (it left the
+        roster)."""
+        with self._lock:
+            self._peers.pop(peer, None)
+            self._first_seen.pop(peer, None)
+
+    # -- plane 3: the runtime lattice auditor --------------------------------
+
+    def maybe_audit(self, batch, universe=None, peers=None
+                    ) -> Optional[AuditReport]:
+        """The per-round cadence hook: runs :meth:`audit` every
+        ``audit_every``-th call (0 disables the auditor)."""
+        if self.audit_every <= 0:
+            return None
+        with self._lock:
+            self._audit_rounds += 1
+            due = self._audit_rounds % self.audit_every == 0
+        if not due:
+            return None
+        return self.audit(batch, universe, peers=peers)
+
+    def audit(self, batch, universe=None, peers=None,
+              sample: Optional[int] = None) -> AuditReport:
+        """One budget-bounded lattice self-check (module docstring):
+        sampled merge idempotence through the real wire codec, frontier
+        vs local subtree version vectors, frontier vs freshly-advertised
+        peer version vectors.  Violations are loud: counter + a
+        ``stability.audit_violation`` flight-recorder event each."""
+        from ..utils import tracing
+
+        report = AuditReport()
+        with tracing.span("stability.audit"):
+            self._audit_merge_idempotence(
+                batch, universe, report,
+                self.audit_sample if sample is None else int(sample))
+            self._audit_frontier(batch, report)
+        with self._lock:
+            self._audit_checks += report.checks
+            self._audit_violations += len(report.violations)
+            if report.violations:
+                self._last_violation = dict(report.violations[-1])
+        tracing.count("stability.audit.checks", report.checks)
+        if report.violations:
+            tracing.count("stability.audit.violations",
+                          len(report.violations))
+            for v in report.violations:
+                events_mod.record("stability.audit_violation", **{
+                    k: (vv if isinstance(vv, (int, float, str, bool))
+                        else str(vv)[:200])
+                    for k, vv in v.items()})
+        return report
+
+    def _audit_merge_idempotence(self, batch, universe, report,
+                                 sample: int) -> None:
+        """Sampled self-merge: gather N random rows through the wire
+        codec, merge the sub-fleet with ITSELF, and require the merged
+        digests bit-equal to the live fleet's rows — one check covers
+        wire-roundtrip fidelity, merge idempotence (the ACI contract's
+        I) and digest stability at once."""
+        import numpy as np
+
+        from ..sync import digest as digest_mod
+
+        try:
+            ref = np.asarray(digest_mod.digest_of(batch, universe),
+                             dtype=np.uint64)
+        except TypeError:
+            return  # no digest kernel for this batch type
+        n = int(ref.shape[0])
+        k = min(int(sample), n)
+        if k <= 0:
+            return
+        with self._lock:
+            ids = np.asarray(
+                sorted(self._rng.sample(range(n), k)), dtype=np.int64)
+        try:
+            from ..sync.delta import gather_blobs
+
+            blobs = gather_blobs(batch, ids, universe)
+            sub = type(batch).from_wire(blobs, universe)
+            merged = sub.merge(sub)
+        except (TypeError, AttributeError):
+            return  # batch type without the wire/merge surface
+        got = np.asarray(digest_mod.digest_of(merged, universe),
+                         dtype=np.uint64)
+        report.checks += k
+        report.sampled += k
+        bad = ids[got != ref[ids]]
+        if bad.size:
+            report.violations.append({
+                "plane": "merge_idempotence",
+                "objects": ",".join(str(int(b)) for b in bad[:16]),
+                "count": int(bad.size),
+            })
+
+    def _audit_frontier(self, batch, report) -> None:
+        """Frontier soundness: the published frontier must never exceed
+        the local per-subtree version vectors (we claim the fleet
+        converged past clocks we ourselves hold), and the fleet-min
+        clock must never exceed any FRESHLY-advertised peer version
+        vector (a peer that just told us its applied clock cannot be
+        behind what we published as fleet-stable)."""
+        import numpy as np
+
+        with self._lock:
+            published_global = self._published_global
+        if published_global is None:
+            return
+        svv = subtree_version_vectors(batch)
+        if svv is not None and svv.shape[0]:
+            # the fleet-min clock claims every peer witnessed every dot
+            # at or below it — dots WE hold included, so it can never
+            # exceed the local whole-fleet version vector
+            report.checks += 1
+            local_vv = svv.max(axis=0).astype(np.uint64)
+            width = max(int(local_vv.shape[0]), len(published_global))
+            fr, local = _align_rows([published_global, local_vv], width)
+            if (fr > local).any():
+                report.violations.append({
+                    "plane": "frontier_local",
+                    "frontier_max": int(fr.max(initial=0)),
+                    "local_max": int(local.max(initial=0)),
+                })
+        fleet_min = np.asarray(published_global, dtype=np.uint64)
+        now = self._clock()
+        with self._lock:
+            # cross-check only peers THIS observer holds frontier
+            # evidence for: the minimum ran over their clocks, so their
+            # advertised VVs are the exact soundness bound (a foreign
+            # fleet's labels in the shared convergence tracker are not)
+            tracked = {p for p, st in self._peers.items()
+                       if st.clocks is not None}
+        for peer, (vv, seen_ts) in sorted(
+                self._conv().version_vectors().items()):
+            if peer not in tracked:
+                continue
+            if seen_ts is None or now - seen_ts > self.stale_after_s:
+                continue  # stale advertisement: not comparable evidence
+            report.checks += 1
+            width = max(len(vv), int(fleet_min.shape[0]))
+            fr, theirs = _align_rows([fleet_min, vv], width)
+            if (fr > theirs).any():
+                report.violations.append({
+                    "plane": "frontier_peer_vv",
+                    "peer": peer,
+                    "frontier_max": int(fr.max(initial=0)),
+                    "peer_vv_max": int(theirs.max(initial=0)),
+                })
+
+    # -- snapshots ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready state — what ``GET /stability`` serves: the
+        published frontier (per-subtree and fleet-min clocks), the
+        divergence-aging view (per-peer outstanding subtrees with live
+        ages, resolved stats), and the audit totals."""
+        now = self._clock()
+        with self._lock:
+            published = self._published
+            published_global = self._published_global
+            aging = {
+                peer: {
+                    "outstanding": {
+                        str(s): round(max(0.0, now - born), 6)
+                        for s, born in st.outstanding.items()
+                    },
+                    "converged_age_s": (
+                        None if st.converged_ts is None
+                        else round(max(0.0, now - st.converged_ts), 6)),
+                }
+                for peer, st in self._peers.items()
+            }
+            window = sorted(self._resolved)
+            resolved_total = self._resolved_total
+            audit = {
+                "checks": self._audit_checks,
+                "violations": self._audit_violations,
+                "last_violation": self._last_violation,
+            }
+        clocks = [list(row) for row in published] \
+            if published is not None else None
+        fleet_min = list(published_global) \
+            if published_global is not None else None
+        return {
+            "frontier": {
+                "subtree_clocks": clocks,
+                "fleet_min": fleet_min,
+                "subtrees": len(clocks) if clocks is not None else 0,
+            },
+            "aging": {
+                "peers": aging,
+                "resolved_total": resolved_total,
+                "resolved_age_p50_s": (
+                    window[len(window) // 2] if window else None),
+                "resolved_age_max_s": window[-1] if window else None,
+            },
+            "audit": audit,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._peers.clear()
+            self._resolved.clear()
+            self._resolved_total = 0
+            self._first_seen.clear()
+            self._floor = None
+            self._published = None
+            self._published_global = None
+            self._audit_rounds = 0
+            self._audit_checks = 0
+            self._audit_violations = 0
+            self._last_violation = None
+
+
+def _zip_pad(a: tuple, b: tuple):
+    """zip two counter rows, implied-0 past either end."""
+    width = max(len(a), len(b))
+    for i in range(width):
+        yield (a[i] if i < len(a) else 0), (b[i] if i < len(b) else 0)
+
+
+# -- the default (process-global) tracker -------------------------------------
+
+_DEFAULT: Optional[StabilityTracker] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def tracker() -> StabilityTracker:
+    """The process-global stability tracker — what standalone sessions
+    feed and ``GET /stability`` serves by default (cluster nodes own
+    private ones so in-process fleets keep their observers apart)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = StabilityTracker()
+    return _DEFAULT
+
+
+#: package-level alias (``crdt_tpu.obs.stability_tracker``) — the
+#: un-shadowed name next to ``convergence.tracker`` / ``lag_tracker``
+stability_tracker = tracker

@@ -81,6 +81,7 @@ import numpy as np
 from ..error import SyncProtocolError, TransportError
 from ..obs import convergence as obs_convergence
 from ..obs import events as obs_events
+from ..obs import stability as obs_stability
 from ..obs.latency import SessionProfile
 from ..utils import tracing
 from . import delta as delta_mod
@@ -214,7 +215,8 @@ class SyncSession:
                  capacity_tracker=None,
                  digest_tree: bool = False,
                  protocol_version: Optional[int] = None,
-                 lag_tracker=None):
+                 lag_tracker=None,
+                 stability=None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -290,6 +292,13 @@ class SyncSession:
         #: every other capability.
         self.lag_tracker = lag_tracker
         self._peer_lag = False
+        #: a :class:`crdt_tpu.obs.stability.StabilityTracker` — the
+        #: convergence observatory this session feeds: every digest
+        #: exchange's diverged set enters the divergence-aging tracker,
+        #: and a converged session records the per-subtree clocks the
+        #: stability frontier minimizes over.  None = the process-global
+        #: tracker (cluster nodes pass their private one).
+        self.stability = stability
         self._user_digest_fn = digest_fn
         self._digest_fn = digest_fn or self._canonical_digest
         self._applier = OrswotDeltaApplier(universe)
@@ -301,6 +310,10 @@ class SyncSession:
         """The salted canonical digest vector (memoized per batch
         object — see :class:`crdt_tpu.sync.digest.DigestCache`)."""
         return digest_mod.digest_of(batch, self.universe)
+
+    def _stability(self) -> obs_stability.StabilityTracker:
+        return self.stability if self.stability is not None \
+            else obs_stability.tracker()
 
     @property
     def _wire_version(self) -> int:
@@ -818,6 +831,25 @@ class SyncSession:
             payload_bytes=report.delta_bytes_sent + report.full_bytes_sent,
             full_state_bytes=self.full_state_bytes or report.full_bytes_sent,
         )
+        if report.converged and report.diverged == 0 \
+                and not report.full_state_fallback:
+            # the stability frontier's evidence — a CLEAN phase-1
+            # exchange: zero divergence found means both digests were
+            # computed over state each node already COMMITTED before
+            # the session, so "the peer witnessed every dot in these
+            # subtree clocks" survives anything that happens after
+            # (a piggyback failure discarding the session, a kill -9
+            # before the peer's next checkpoint).  A session that
+            # shipped deltas converged on state the peer has NOT
+            # committed yet — its evidence lands on the next idle
+            # re-sync, one round later (one memoized jitted fold;
+            # idle rounds recompute nothing).
+            self._stability().observe_converged(self.peer, self.batch)
+        elif report.converged:
+            # converged after a delta/full exchange: resolve the
+            # divergence aging (the episode ended) without claiming
+            # frontier evidence the peer may still discard
+            self._stability().resolve_all(self.peer)
         self._event(
             "sync.phase", phase="converged", rounds=report.digest_rounds,
             diverged=report.diverged,
@@ -909,6 +941,12 @@ class SyncSession:
         obs_convergence.tracker().observe_divergence(
             self.peer, report.diverged, report.objects
         )
+        # divergence aging (obs/stability.py): the exchange's diverged
+        # rows map onto top-level digest subtrees; a subtree absent from
+        # the set is resolved (its episode's age is measured), one still
+        # present keeps its original birth — churn becomes an age series
+        self._stability().observe_descent(
+            self.peer, diverged.tolist(), report.objects)
         if report.tree_mode:
             obs_convergence.tracker().observe_tree(
                 self.peer, report.subtrees_diverged)

@@ -491,6 +491,7 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     def restart_victim():
         nonlocal rejoin_baseline, recovery
         from crdt_tpu.durable import recover
+        from crdt_tpu.obs.stability import StabilityTracker
         from crdt_tpu.utils import tracing as _tracing
 
         c = _tracing.counters()
@@ -504,6 +505,12 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         if gc_engine is not None and recovery.watermark is not None:
             # resume GC's stability frontier from the persisted clock
             gc_engine.restore_watermark(recovery.watermark)
+        # the convergence observatory's frontier resumes the same way:
+        # the persisted fleet-min clock is a monotone floor, so the
+        # rejoined observer's published frontier never regresses
+        stability = StabilityTracker()
+        if recovery.frontier is not None:
+            stability.restore(recovery.frontier)
         nodes[victim] = ClusterNode(
             f"n{victim}", recovery.batch, recovery.universe,
             busy_timeout_s=30.0,
@@ -513,6 +520,7 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             gc=gc_engine,
             digest_tree=digest_tree,
             durability=make_durability(f"n{victim}"),
+            stability_tracker=stability,
         )
         start_listener(victim)
         scheds[victim] = make_sched(victim)
@@ -524,8 +532,49 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
               f"{rep.parked_ops} re-parked; rejoining via delta sync",
               flush=True)
 
+    def roster_for(i):
+        return [f"n{j}" for j in range(n_peers) if j != i]
+
+    def fleet_vv_min(live):
+        """Element-wise min over the live nodes' version vectors — what
+        the stability frontier must equal once the fleet quiesced AND
+        every observer re-converged with every peer."""
+        from crdt_tpu.sync import digest as digest_mod
+
+        vvs = [np.asarray(digest_mod.version_vector(n.batch), np.uint64)
+               for n in live]
+        width = max(v.size for v in vvs)
+        out = None
+        for v in vvs:
+            if v.size < width:
+                v = np.concatenate(
+                    [v, np.zeros(width - v.size, np.uint64)])
+            out = v if out is None else np.minimum(out, v)
+        return out
+
+    def frontier_settled(live):
+        """Every live node's published fleet-min frontier clock equals
+        the fleet VV min — needs each observer to have converged with
+        each peer AFTER the last write, which the staleness-ranked
+        scheduler reaches within a few post-quiescence sweeps."""
+        target = fleet_vv_min(live)
+        for n in live:
+            rep = n.stability.frontier(
+                n.batch, peers=roster_for(int(n.node_id[1:])))
+            if rep is None:
+                return False
+            clock = np.asarray(rep.clock, np.uint64)
+            w = max(clock.size, target.size)
+            c = np.concatenate([clock, np.zeros(w - clock.size, np.uint64)])
+            t = np.concatenate([target,
+                                np.zeros(w - target.size, np.uint64)])
+            if not np.array_equal(c, t):
+                return False
+        return True
+
     sweeps = 0
     converged = False
+    settled = False
     try:
         for sweeps in range(1, max_sweeps + 1):
             if victim is not None and killed_at is None \
@@ -559,9 +608,14 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 state += f" (ops submitted so far: {total_ops})"
             print(f"sweep {sweeps}: {state}", flush=True)
             # while writes flow, convergence is a moving target — only
-            # the post-write sweeps decide the verdict
+            # the post-write sweeps decide the verdict; the stability
+            # frontier additionally has to SETTLE (every observer
+            # re-converged with every peer), so the final state's
+            # frontier == fleet-VV-min identity below is assertable
             if converged and not writing:
-                break
+                settled = frontier_settled(live)
+                if settled:
+                    break
     finally:
         stop.set()
         for srv in servers:
@@ -652,6 +706,42 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                     f"({st['samples']} samples, "
                     f"{st['outstanding']} outstanding)", flush=True,
                 )
+
+    # the convergence observatory's read of the run: the fleet
+    # stability frontier (the clock the future truncate-epoch proposer
+    # consumes), how old the worst divergence got, and the lattice
+    # auditor's verdict.  At quiescence, with every observer settled,
+    # the frontier IS the fleet VV min — asserted, not just printed.
+    live = [n for n in nodes if n is not None]
+    if converged and live:
+        target = fleet_vv_min(live)
+        worst_age = 0.0
+        checks = violations = 0
+        for node in live:
+            rep = node.stability.frontier(
+                node.batch, peers=roster_for(int(node.node_id[1:])))
+            assert rep is not None, "frontier unavailable on a clocked fleet"
+            assert np.array_equal(
+                np.asarray(rep.clock, np.uint64), target), (
+                f"{node.node_id}: frontier {rep.clock.tolist()} != "
+                f"fleet VV min {target.tolist()} at quiescence"
+            )
+            snap = node.stability.snapshot()
+            worst_age = max(worst_age, snap["aging"]["resolved_age_max_s"]
+                            or 0.0)
+            checks += snap["audit"]["checks"]
+            violations += snap["audit"]["violations"]
+        print(
+            f"stability: frontier == fleet VV min "
+            f"(max_counter={int(target.max(initial=0))}, "
+            f"{live[0].stability.snapshot()['frontier']['subtrees']} "
+            f"subtree(s)); oldest divergence age "
+            f"{max(n.stability.oldest_divergence_age_s() for n in live) * 1e3:.1f}ms "
+            f"outstanding / {worst_age * 1e3:.1f}ms worst resolved; "
+            f"audit checks={checks} violations={violations}", flush=True,
+        )
+        assert violations == 0, \
+            "lattice auditor recorded violations on a healthy run"
 
     if gc_enabled:
         # per-node reclamation story + the watermark clock GC last

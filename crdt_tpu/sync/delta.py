@@ -43,7 +43,14 @@ from ..error import SyncProtocolError
 #: ``BASELINE_VERSION`` (they precede negotiation), every later frame
 #: at the negotiated version, and a v2 peer never sees a TREE frame
 #: because the capability defaults off for hellos without the key.
-PROTOCOL_VERSION = 3
+#: v4: hello carries a ``window`` advertisement (the transport's ARQ
+#: in-flight window); sessions whose negotiated version AND window
+#: both allow it stream — diverged rows ship as pipelined DELTA_CHUNK
+#: frames and tree descents go speculative (TREE/spec subframes cover
+#: whole levels ahead of the lock-step answer).  Same discipline as
+#: v3: a v2/v3 peer never sees a CHUNK or spec frame because the
+#: window key defaults to 0 (stop-and-wait) for hellos without it.
+PROTOCOL_VERSION = 4
 
 #: the version hello frames ship at, and the version assumed for a
 #: peer whose hello predates the ``ver`` key
@@ -51,7 +58,7 @@ BASELINE_VERSION = 2
 
 #: envelope versions this build parses (the grammar is shared; frame
 #: TYPES gate on the hello-negotiated version instead)
-COMPAT_VERSIONS = frozenset({2, 3})
+COMPAT_VERSIONS = frozenset({2, 3, 4})
 
 FRAME_DIGEST = 0x01
 FRAME_DELTA = 0x02
@@ -61,11 +68,13 @@ FRAME_FLEET = 0x05
 FRAME_OPS = 0x06
 FRAME_TREE = 0x07
 FRAME_LAG = 0x08
+FRAME_DELTA_CHUNK = 0x09
 
 _FRAME_NAMES = {FRAME_DIGEST: "digest", FRAME_DELTA: "delta",
                 FRAME_FULL: "full", FRAME_HELLO: "hello",
                 FRAME_FLEET: "fleet", FRAME_OPS: "ops",
-                FRAME_TREE: "tree", FRAME_LAG: "lag"}
+                FRAME_TREE: "tree", FRAME_LAG: "lag",
+                FRAME_DELTA_CHUNK: "delta_chunk"}
 _HEADER = struct.Struct("<BBIQ")
 
 
@@ -138,7 +147,10 @@ def decode_frame(frame: bytes) -> tuple[int, bytes]:
 class HelloInfo(NamedTuple):
     """One peer's decoded hello: trace proposal, node label, the
     capability flags, and the protocol version it speaks (``ver``
-    absent = a v2 peer — both sides then run the v2 flat protocol)."""
+    absent = a v2 peer — both sides then run the v2 flat protocol).
+    ``window`` is the peer's advertised ARQ in-flight window (absent or
+    0 = a stop-and-wait peer; sessions stream only when both sides
+    advertise >= 2 at v4+)."""
 
     trace: str
     node: str
@@ -147,30 +159,33 @@ class HelloInfo(NamedTuple):
     ver: int
     digest_tree: bool
     lag: bool = False
+    window: int = 0
 
 
 def encode_hello_frame(trace: str, node: str, fleet_obs: bool,
                        oplog: bool = False, digest_tree: bool = False,
-                       lag: bool = False,
+                       lag: bool = False, window: int = 0,
                        ver: int = PROTOCOL_VERSION) -> bytes:
     """A HELLO frame — the session-opening handshake: this side's
     trace-ID proposal (both peers adopt the lexicographic min, so the
     two halves of one session share ONE fleet-unique ID), its node
-    label, the protocol version it speaks, and four capability flags —
+    label, the protocol version it speaks, four capability flags —
     piggybacked fleet-observability snapshots, piggybacked op batches,
     digest-tree descent, and the write-to-visible lag sidecar (each
     only happens when BOTH peers advertise it, which keeps the
     lock-step protocol symmetric; an older peer simply never sees the
-    key).  The hello itself ships at ``BASELINE_VERSION`` — it
-    precedes the negotiation every later frame's version byte
-    follows."""
+    key) — and the transport's ARQ window advertisement (v4: both
+    peers clamp to the minimum; 0 means stop-and-wait and disables
+    streaming for the session).  The hello itself ships at
+    ``BASELINE_VERSION`` — it precedes the negotiation every later
+    frame's version byte follows."""
     import json
 
     payload = json.dumps(
         {"trace": str(trace), "node": str(node),
          "fleet_obs": bool(fleet_obs), "oplog": bool(oplog),
          "ver": int(ver), "digest_tree": bool(digest_tree),
-         "lag": bool(lag)},
+         "lag": bool(lag), "window": int(window)},
         sort_keys=True, separators=(",", ":"),
     ).encode("utf-8")
     return _frame(FRAME_HELLO, payload, version=BASELINE_VERSION)
@@ -180,9 +195,10 @@ def decode_hello_payload(payload: bytes) -> HelloInfo:
     """The :class:`HelloInfo` of a HELLO payload.  Labels are bounded
     defensively — a garbage hello must yield a rejection, not an
     unbounded event field.  A hello without the ``oplog`` /
-    ``digest_tree`` / ``lag`` / ``ver`` keys (an older peer) reads as
-    "no capability, v2", so mixed fleets degrade to flat state-only
-    sessions instead of rejecting."""
+    ``digest_tree`` / ``lag`` / ``ver`` / ``window`` keys (an older
+    peer) reads as "no capability, v2, stop-and-wait", so mixed fleets
+    degrade to flat state-only lock-step sessions instead of
+    rejecting."""
     import json
 
     try:
@@ -194,11 +210,13 @@ def decode_hello_payload(payload: bytes) -> HelloInfo:
         ver = int(doc.get("ver", BASELINE_VERSION))
         digest_tree = bool(doc.get("digest_tree", False))
         lag = bool(doc.get("lag", False))
+        window = max(0, int(doc.get("window", 0)))
     except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
         raise SyncProtocolError(f"malformed hello payload: {e}") from None
     if not trace:
         raise SyncProtocolError("hello payload carries an empty trace ID")
-    return HelloInfo(trace, node, fleet_obs, oplog, ver, digest_tree, lag)
+    return HelloInfo(trace, node, fleet_obs, oplog, ver, digest_tree, lag,
+                     window)
 
 
 def encode_fleet_frame(snapshot_frame: bytes,
@@ -318,6 +336,16 @@ def decode_digest_payload(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
 
 TREE_SUB_ROOT = 0x01
 TREE_SUB_LEVEL = 0x02
+TREE_SUB_SPEC = 0x03
+
+
+def tree_subframe_kind(payload: bytes) -> int:
+    """The subframe tag of a TREE payload (ROOT/LEVEL/SPEC) — the
+    dispatch byte a streaming receiver looks at before picking a
+    decoder."""
+    if not payload:
+        raise SyncProtocolError("empty tree payload")
+    return payload[0]
 
 
 def encode_tree_root_frame(tree, version_vec: np.ndarray | None = None,
@@ -370,13 +398,9 @@ def decode_tree_root_payload(payload: bytes
             children.astype(np.uint32), vv.astype(np.uint64))
 
 
-def encode_tree_level_frame(level: int, parents: np.ndarray,
-                            lanes: np.ndarray,
-                            version: int | None = None) -> bytes:
-    """A TREE/level frame: one descent step — the diverged parent node
-    ids (level ``level + 1``; both peers computed the same set, they
-    travel for lock-step validation) and the u32 wire lanes of their k
-    children each, parent-major."""
+def _encode_tree_sublevel(sub: int, level: int, parents: np.ndarray,
+                          lanes: np.ndarray,
+                          version: int | None = None) -> bytes:
     from .tree import TREE_K, wire_lanes
 
     parents = np.ascontiguousarray(parents, dtype="<u8")
@@ -387,22 +411,20 @@ def encode_tree_level_frame(level: int, parents: np.ndarray,
             f"{parents.shape[0] * TREE_K} child lanes, got {lw.shape[0]}"
         )
     payload = (
-        struct.pack("<BBI", TREE_SUB_LEVEL, level, parents.shape[0])
+        struct.pack("<BBI", sub, level, parents.shape[0])
         + parents.tobytes() + lw.tobytes()
     )
     return _frame(FRAME_TREE, payload, version=version)
 
 
-def decode_tree_level_payload(payload: bytes
-                              ) -> tuple[int, np.ndarray, np.ndarray]:
-    """``(level, parents int64[p], lanes u32[p*k])`` from a TREE/level
-    payload."""
+def _decode_tree_sublevel(sub: int, kind: str, payload: bytes
+                          ) -> tuple[int, np.ndarray, np.ndarray]:
     from .tree import TREE_K
 
     try:
-        sub, level, p = struct.unpack_from("<BBI", payload, 0)
-        if sub != TREE_SUB_LEVEL:
-            raise ValueError(f"expected a tree LEVEL subframe, got {sub}")
+        got, level, p = struct.unpack_from("<BBI", payload, 0)
+        if got != sub:
+            raise ValueError(f"expected a tree {kind} subframe, got {got}")
         off = struct.calcsize("<BBI")
         parents = np.frombuffer(payload, dtype="<u8", count=p, offset=off)
         off += 8 * p
@@ -412,8 +434,50 @@ def decode_tree_level_payload(payload: bytes
             raise ValueError("trailing bytes")
     except (struct.error, ValueError) as e:
         raise SyncProtocolError(
-            f"malformed tree level payload: {e}") from None
+            f"malformed tree {kind.lower()} payload: {e}") from None
     return int(level), parents.astype(np.int64), lanes.astype(np.uint32)
+
+
+def encode_tree_level_frame(level: int, parents: np.ndarray,
+                            lanes: np.ndarray,
+                            version: int | None = None) -> bytes:
+    """A TREE/level frame: one descent step — the diverged parent node
+    ids (level ``level + 1``; both peers computed the same set, they
+    travel for lock-step validation) and the u32 wire lanes of their k
+    children each, parent-major."""
+    return _encode_tree_sublevel(TREE_SUB_LEVEL, level, parents, lanes,
+                                 version)
+
+
+def decode_tree_level_payload(payload: bytes
+                              ) -> tuple[int, np.ndarray, np.ndarray]:
+    """``(level, parents int64[p], lanes u32[p*k])`` from a TREE/level
+    payload."""
+    return _decode_tree_sublevel(TREE_SUB_LEVEL, "LEVEL", payload)
+
+
+def encode_tree_spec_frame(level: int, parents: np.ndarray,
+                           lanes: np.ndarray,
+                           version: int | None = None) -> bytes:
+    """A TREE/spec frame — one SPECULATIVE descent level (v4 streaming
+    sessions): the full k-ary expansion under the top diverged
+    children, shipped before the peer's answer to the previous level
+    so the whole descent completes in ~1 extra RTT.  Same wire grammar
+    as a LEVEL frame; the tag tells the receiver these parents are the
+    sender's GUESS (a pure function of the shared root exchange, so
+    both peers ship identical expansions) — the receiver reads the
+    blocks its true diverged set needs (``sync.tree.speculate.hit``)
+    and discards the rest (``.miss``), bounded by the dense-cutover
+    byte budget."""
+    return _encode_tree_sublevel(TREE_SUB_SPEC, level, parents, lanes,
+                                 version)
+
+
+def decode_tree_spec_payload(payload: bytes
+                             ) -> tuple[int, np.ndarray, np.ndarray]:
+    """``(level, parents int64[p], lanes u32[p*k])`` from a TREE/spec
+    payload."""
+    return _decode_tree_sublevel(TREE_SUB_SPEC, "SPEC", payload)
 
 
 # ---- delta / full-state frames ---------------------------------------------
@@ -476,6 +540,53 @@ def decode_delta_payload(payload: bytes) -> tuple[int, np.ndarray, list[bytes]]:
         raise SyncProtocolError(f"malformed delta payload: {e}") from None
     blobs = _unpack_blobs(payload, 16 + 8 * k, k)
     return int(fleet_n), ids.astype(np.int64), blobs
+
+
+#: rows per streamed DELTA_CHUNK frame.  Fixed (not adaptive) on
+#: purpose: the apply side's warm staging planes are sized to the
+#: largest chunk seen (power-of-two rows), so a fixed chunk size means
+#: ONE buffer rung for the life of an endpoint — the wireloop
+#: staging-pool discipline applied to the sync path.  256 rows at the
+#: default config is a few hundred KB of blobs: big enough to amortize
+#: the frame header, small enough that apply overlaps the wire.
+DELTA_CHUNK_ROWS = 256
+
+
+def encode_delta_chunk_frame(fleet_n: int, chunk_idx: int, chunk_count: int,
+                             ids: np.ndarray, blobs,
+                             version: int | None = None) -> bytes:
+    """A DELTA_CHUNK frame (v4 streaming sessions): one fixed-size
+    slice of the diverged rows, shipped while earlier chunks are still
+    unacked so encode/apply overlap the wire.  ``chunk_idx`` /
+    ``chunk_count`` pin the stream's shape — the ARQ delivers in
+    order, so a receiver seeing idx != expected is a protocol error,
+    not a reordering."""
+    ids = np.ascontiguousarray(ids, dtype="<u8")
+    if ids.shape[0] != len(blobs):
+        raise ValueError(
+            f"delta chunk frame: {ids.shape[0]} ids vs {len(blobs)} blobs"
+        )
+    payload = (
+        struct.pack("<QIIQ", fleet_n, chunk_idx, chunk_count, ids.shape[0])
+        + ids.tobytes() + _pack_blobs(blobs)
+    )
+    return _frame(FRAME_DELTA_CHUNK, payload, version=version)
+
+
+def decode_delta_chunk_payload(payload: bytes
+                               ) -> tuple[int, int, int, np.ndarray,
+                                          list[bytes]]:
+    """``(fleet_n, chunk_idx, chunk_count, ids int64[k], blobs)`` from
+    a DELTA_CHUNK payload."""
+    try:
+        fleet_n, idx, total, k = struct.unpack_from("<QIIQ", payload, 0)
+        off = struct.calcsize("<QIIQ")
+        ids = np.frombuffer(payload, dtype="<u8", count=k, offset=off)
+    except (struct.error, ValueError) as e:
+        raise SyncProtocolError(
+            f"malformed delta chunk payload: {e}") from None
+    blobs = _unpack_blobs(payload, off + 8 * k, k)
+    return int(fleet_n), int(idx), int(total), ids.astype(np.int64), blobs
 
 
 def encode_full_frame(blobs, version: int | None = None) -> bytes:

@@ -90,7 +90,9 @@ from . import tree as tree_mod
 from .delta import (
     BASELINE_VERSION,
     COMPAT_VERSIONS,
+    DELTA_CHUNK_ROWS,
     FRAME_DELTA,
+    FRAME_DELTA_CHUNK,
     FRAME_DIGEST,
     FRAME_FLEET,
     FRAME_FULL,
@@ -100,6 +102,7 @@ from .delta import (
     FRAME_TREE,
     PROTOCOL_VERSION,
     OrswotDeltaApplier,
+    decode_delta_chunk_payload,
     decode_delta_payload,
     decode_digest_payload,
     decode_fleet_payload,
@@ -110,7 +113,9 @@ from .delta import (
     decode_ops_sync_payload,
     decode_tree_level_payload,
     decode_tree_root_payload,
+    decode_tree_spec_payload,
     diverged_indices,
+    encode_delta_chunk_frame,
     encode_delta_frame,
     encode_digest_frame,
     encode_fleet_frame,
@@ -120,6 +125,7 @@ from .delta import (
     encode_ops_sync_frame,
     encode_tree_level_frame,
     encode_tree_root_frame,
+    encode_tree_spec_frame,
     gather_blobs,
 )
 
@@ -154,6 +160,16 @@ class SyncReport:
     lag_bytes_sent: int = 0        # write-to-visible sidecar frame
     lag_entries_sent: int = 0      # origin stamps shipped in the sidecar
     lag_entries_received: int = 0  # peer stamps accepted for measurement
+    streaming: bool = False        # v4 windowed streaming negotiated
+    window: int = 0                # negotiated ARQ window (0 = no ARQ)
+    #: phase-1 round-trip equivalents: the root exchange plus one per
+    #: lock-step level exchange — a speculative blast (all remaining
+    #: levels pipelined through the window) counts as ONE, which is
+    #: the whole point (the ≤2-RTT descent the bench gates on)
+    tree_round_trips: int = 0
+    spec_hits: int = 0             # speculated subtree blocks the walk used
+    spec_misses: int = 0           # speculated blocks shipped but discarded
+    delta_chunks_sent: int = 0     # pipelined DELTA_CHUNK frames shipped
     #: the session's critical-path decomposition (integer-nanosecond
     #: accounting: serialize / network-wait / kernel / other, plus the
     #: unaccounted residual) — see :class:`crdt_tpu.obs.latency.
@@ -302,6 +318,20 @@ class SyncSession:
         self._user_digest_fn = digest_fn
         self._digest_fn = digest_fn or self._canonical_digest
         self._applier = OrswotDeltaApplier(universe)
+        #: the windowed ARQ transport this sync rides, captured by
+        #: :meth:`sync` when the caller passes a transport object that
+        #: supports window negotiation (``negotiate_window``); None on
+        #: the legacy callable-pair path, which always advertises
+        #: window 0 and never streams
+        self._transport = None
+        #: hello-negotiated per sync: v4 on both sides AND an effective
+        #: window ≥ 2 (a window-1 peer IS stop-and-wait; streaming
+        #: against it would just re-serialize the lock-step protocol)
+        self._streaming = False
+        #: the phase-1 digest vector this sync shipped EAGERLY (inside
+        #: the hello flight, before the peer's hello landed); consumed
+        #: by the first _exchange_digests call, which then only receives
+        self._eager_digest: Optional[np.ndarray] = None
         #: per-sync critical-path profile; re-created by each
         #: :meth:`sync` call and attached to its report
         self._prof = SessionProfile()
@@ -392,14 +422,44 @@ class SyncSession:
             else f"proc-{obs_events._PROC_TAG}"
         proposal = self.session_id
         can_ops = self._op_outbox is not None and self._op_sink is not None
+        # advertise the transport's ARQ window (v4): callable-pair
+        # sessions and pre-v4 speakers ship 0, which reads as
+        # stop-and-wait on the peer and keeps every legacy path
+        # byte-identical
+        advertised_window = 0
+        if self._transport is not None and self.speaks_version >= 4:
+            advertised_window = int(self._transport.window)
         self._send(
             send,
             encode_hello_frame(proposal, node, self.observatory is not None,
                                oplog=can_ops, digest_tree=self.digest_tree,
                                lag=self.lag_tracker is not None,
+                               window=advertised_window,
                                ver=self.speaks_version),
             report, "hello", 0,
         )
+        # eager phase 1: a flat, non-full-state session's first two
+        # outgoing frames are [hello, digest] no matter what the peer's
+        # hello says (digest_tree=False here forces the flat exchange on
+        # BOTH sides, and the envelope decoder accepts any compat
+        # version byte) — so ship the digest NOW, while the hello is in
+        # flight.  The wire sequence is byte-identical to the lazy
+        # order; only the timing moves.  Over a pipelined (windowed)
+        # transport this collapses the hello and digest waits into ONE
+        # flight; over stop-and-wait it is RTT-neutral (same frame
+        # count, same order).
+        if not self.full_state and not self.digest_tree:
+            with tracing.span("sync.digest_exchange"):
+                with self._prof.clock("kernel"):
+                    mine = np.asarray(self._digest_fn(self.batch),
+                                      dtype=np.uint64)
+                    vv = digest_mod.version_vector(self.batch)
+                with self._prof.clock("serialize"):
+                    frame = encode_digest_frame(mine, vv,
+                                                version=self._wire_version)
+            self._send(send, frame, report, "digest", mine.shape[0])
+            self._eager_digest = mine
+            tracing.count("sync.digest.eager")
         ftype, payload = self._recv(recv, report)
         if ftype != FRAME_HELLO:
             raise SyncProtocolError(
@@ -417,12 +477,31 @@ class SyncSession:
         self.negotiated_version = report.protocol_version = \
             min(self.speaks_version, hello.ver)
         self.trace_id = report.trace_id = min(proposal, hello.trace)
+        # window negotiation: clamp the transport to min(ours, peer's).
+        # A pre-v4 peer's hello has no window key (reads 0), so a
+        # windowed transport facing one degrades to stop-and-wait —
+        # loudly (``cluster.transport.fallback.window`` fires inside
+        # negotiate_window), never a protocol error.  Both peers
+        # compute the same min, so the streaming decision below is
+        # shared data and the pipelined phases stay symmetric.
+        peer_window = hello.window if self.negotiated_version >= 4 else 0
+        self._streaming = False
+        negotiated_window = 0
+        if self._transport is not None:
+            negotiated_window = self._transport.negotiate_window(peer_window)
+            self._streaming = (self.negotiated_version >= 4
+                               and advertised_window >= 2
+                               and peer_window >= 2)
+        report.streaming = self._streaming
+        report.window = negotiated_window
         self._event("sync.hello", proposed=proposal, peer_node=hello.node,
                     peer_fleet_obs=self._peer_fleet_obs,
                     peer_oplog=self._peer_oplog,
                     peer_digest_tree=self._peer_digest_tree,
                     peer_lag=self._peer_lag,
-                    negotiated_version=self.negotiated_version)
+                    negotiated_version=self.negotiated_version,
+                    peer_window=hello.window, window=negotiated_window,
+                    streaming=self._streaming)
 
     def _tree_session(self) -> bool:
         """Whether this session runs the subtree descent — a pure
@@ -567,13 +646,20 @@ class SyncSession:
     def _exchange_digests(self, send, recv, report: SyncReport,
                           digest_fn) -> tuple[np.ndarray, np.ndarray]:
         with tracing.span("sync.digest_exchange"):
-            with self._prof.clock("kernel"):
-                mine = np.asarray(digest_fn(self.batch), dtype=np.uint64)
-                vv = digest_mod.version_vector(self.batch)
-            with self._prof.clock("serialize"):
-                frame = encode_digest_frame(mine, vv,
-                                            version=self._wire_version)
-            self._send(send, frame, report, "digest", mine.shape[0])
+            eager, self._eager_digest = self._eager_digest, None
+            if eager is not None:
+                # phase 1 already went out inside the hello flight
+                # (same digest_fn, same frame) — just receive
+                mine = eager
+            else:
+                with self._prof.clock("kernel"):
+                    mine = np.asarray(digest_fn(self.batch),
+                                      dtype=np.uint64)
+                    vv = digest_mod.version_vector(self.batch)
+                with self._prof.clock("serialize"):
+                    frame = encode_digest_frame(mine, vv,
+                                                version=self._wire_version)
+                self._send(send, frame, report, "digest", mine.shape[0])
             ftype, payload = self._recv(recv, report)
             if ftype != FRAME_DIGEST:
                 raise SyncProtocolError(
@@ -658,6 +744,7 @@ class SyncSession:
             tree, peer_root, peer_children = \
                 self._tree_root_exchange(send, recv, report)
             report.tree_mode = True
+            report.tree_round_trips += 1
             if peer_root == tree.root:
                 return np.zeros(0, dtype=np.int64)
             if tree.num_levels < 2:
@@ -679,6 +766,9 @@ class SyncSession:
             flat_bytes = 8 * tree.n
             shipped = 8 + tree_mod.LANE_WIRE_BYTES * (
                 tree_mod.root_frame_lanes(tree) - 1)
+            if self._streaming and top > 0:
+                return self._tree_descend_speculative(
+                    send, recv, report, tree, d, top, flat_bytes, shipped)
             level = top
             while level > 0:
                 if d.size == 0:
@@ -697,6 +787,7 @@ class SyncSession:
                     return None
                 shipped += ship
                 report.tree_levels += 1
+                report.tree_round_trips += 1
                 with self._prof.clock("kernel"):
                     mine = tree.child_lanes(level - 1, d)
                 with self._prof.clock("serialize"):
@@ -731,6 +822,168 @@ class SyncSession:
                 report.subtrees_diverged, int(d.size))
             return np.sort(d).astype(np.int64)
 
+    def _tree_descend_speculative(self, send, recv, report: SyncReport,
+                                  tree, d: np.ndarray, top: int,
+                                  flat_bytes: int, shipped: int
+                                  ) -> Optional[np.ndarray]:
+        """The v4 streaming descent: instead of lock-stepping one RTT
+        per level, blast SPEC frames for the full k-ary expansion of
+        the shared top-level diverged set — every level down to the
+        leaves, pipelined through the ARQ window — then walk the peer's
+        blast with the true diverged frontier.  The expansion is a pure
+        function of data both peers already share (the root exchange's
+        diverged children plus the tree shape), so both sides ship the
+        same deterministic frame sequence and the protocol cannot
+        deadlock; a full-fan-out expansion costs ~4.8 bytes/object
+        against the flat exchange's 8, so the dense-cutover budget that
+        bounds the lock-step descent bounds the speculation too.
+        Mis-speculated blocks (an expansion child whose parent turned
+        out converged) are discarded by the walk and tallied on
+        ``sync.tree.speculate.miss``; used blocks count as hits.
+        Returns diverged leaf ids, or None on the shared
+        collision/cutover fallback — same contract as the lock-step
+        path."""
+        # plan the blast: (child_level, parents) per level, budgeted
+        # against the flat frame exactly like the lock-step cutover —
+        # on the EXPANSION size (>= the true frontier both peers will
+        # walk), so the plan is shared data
+        plan: list = []
+        parents = d
+        level = top
+        budget = shipped
+        while level > 0:
+            ship = (parents.size * tree.k * tree_mod.LANE_WIRE_BYTES
+                    + parents.size * 8)
+            if budget + ship > flat_bytes:
+                break
+            budget += ship
+            plan.append((level - 1, parents))
+            kids = (parents[:, None] * tree.k
+                    + np.arange(tree.k, dtype=np.int64)[None, :]).reshape(-1)
+            parents = kids[kids < tree.level_size(level - 1)]
+            level -= 1
+        if not plan:
+            # even one speculative level out-costs the flat frame —
+            # the dense-divergence cutover, shared decision
+            tracing.count("sync.tree.cutover")
+            self._event("sync.tree_fallback", reason="cutover",
+                        level=top, subtrees=int(d.size))
+            return None
+        # one RTT-equivalent: every spec frame is in flight before the
+        # first response frame is awaited
+        report.tree_round_trips += 1
+        tracing.count("sync.tree.spec_blasts")
+        for child_level, spec_parents in plan:
+            with self._prof.clock("kernel"):
+                lanes = tree.child_lanes(child_level, spec_parents)
+            with self._prof.clock("serialize"):
+                frame = encode_tree_spec_frame(
+                    child_level, spec_parents, lanes,
+                    version=self._wire_version)
+            report.tree_levels += 1
+            self._send(send, frame, report, "tree", int(spec_parents.size))
+        collided_at: Optional[int] = None
+        for child_level, spec_parents in plan:
+            ftype, payload = self._recv(recv, report)
+            if ftype != FRAME_TREE:
+                raise SyncProtocolError(
+                    "expected a tree spec frame, peer sent type "
+                    f"{ftype:#04x}"
+                )
+            with self._prof.clock("serialize"):
+                plevel, pparents, planes = decode_tree_spec_payload(payload)
+            if plevel != child_level \
+                    or not np.array_equal(pparents, spec_parents):
+                raise SyncProtocolError(
+                    "speculative descent out of lock-step: peer shipped "
+                    f"spec level {plevel} ({pparents.shape[0]} parents), "
+                    f"expected level {child_level} "
+                    f"({spec_parents.shape[0]} parents)"
+                )
+            if collided_at is not None:
+                # already collided — keep consuming the peer's
+                # deterministic blast so the stream stays aligned; every
+                # remaining block is a discard
+                report.spec_misses += int(spec_parents.size)
+                tracing.count("sync.tree.speculate.miss",
+                              int(spec_parents.size))
+                continue
+            # the true diverged frontier d (level child_level+1) is a
+            # subset of the speculated expansion; pull its lane blocks
+            # out of the blast and discard the rest
+            pos = np.searchsorted(spec_parents, d)
+            hits = int(d.size)
+            misses = int(spec_parents.size) - hits
+            report.spec_hits += hits
+            report.spec_misses += misses
+            if hits:
+                tracing.count("sync.tree.speculate.hit", hits)
+            if misses:
+                tracing.count("sync.tree.speculate.miss", misses)
+            theirs = planes.reshape(-1, tree.k)[pos].reshape(-1)
+            with self._prof.clock("kernel"):
+                mine = tree.child_lanes(child_level, d)
+                d = tree_mod.diverged_children(
+                    d, mine, theirs, tree.level_size(child_level))
+            if d.size == 0:
+                collided_at = child_level
+            else:
+                report.subtrees_diverged = max(
+                    report.subtrees_diverged, int(d.size))
+        if collided_at is not None:
+            # a truncated-lane collision hid every diverged child —
+            # symmetric (the comparison is), so both peers fall back to
+            # the flat exchange together, exactly like lock-step
+            tracing.count("sync.tree.collision")
+            self._event("sync.tree_fallback", reason="collision",
+                        level=collided_at)
+            return None
+        # residual lock-step levels when the budget cut the blast short
+        # (a shared decision: both peers broke the plan at the same
+        # level and hold the same true frontier d)
+        level = plan[-1][0]
+        while level > 0:
+            if d.size == 0:
+                tracing.count("sync.tree.collision")
+                self._event("sync.tree_fallback", reason="collision",
+                            level=level)
+                return None
+            report.tree_levels += 1
+            report.tree_round_trips += 1
+            with self._prof.clock("kernel"):
+                mine = tree.child_lanes(level - 1, d)
+            with self._prof.clock("serialize"):
+                frame = encode_tree_level_frame(
+                    level - 1, d, mine, version=self._wire_version)
+            self._send(send, frame, report, "tree", int(d.size))
+            ftype, payload = self._recv(recv, report)
+            if ftype != FRAME_TREE:
+                raise SyncProtocolError(
+                    "expected a tree level frame, peer sent type "
+                    f"{ftype:#04x}"
+                )
+            with self._prof.clock("serialize"):
+                plevel, pparents, planes = \
+                    decode_tree_level_payload(payload)
+            if plevel != level - 1 or not np.array_equal(pparents, d):
+                raise SyncProtocolError(
+                    "digest-tree descent out of lock-step: peer "
+                    f"shipped level {plevel} ({pparents.shape[0]} "
+                    f"parents), expected level {level - 1} "
+                    f"({d.shape[0]} parents)"
+                )
+            with self._prof.clock("kernel"):
+                d = tree_mod.diverged_children(
+                    d, mine, planes, tree.level_size(level - 1))
+            level -= 1
+        if d.size == 0:
+            tracing.count("sync.tree.collision")
+            self._event("sync.tree_fallback", reason="collision", level=0)
+            return None
+        report.subtrees_diverged = max(
+            report.subtrees_diverged, int(d.size))
+        return np.sort(d).astype(np.int64)
+
     def _tree_converged_check(self, send, recv, report: SyncReport) -> bool:
         """Tree-mode converged check: one root-frame exchange, u64 root
         comparison — O(1) bytes where the flat check re-ships O(N).
@@ -739,6 +992,60 @@ class SyncSession:
         here and routes to the full-state retry."""
         tree, peer_root, _ = self._tree_root_exchange(send, recv, report)
         return peer_root == tree.root
+
+    def _delta_exchange_streaming(self, send, recv, report: SyncReport,
+                                  diverged: np.ndarray) -> None:
+        """The v4 streaming delta phase: the shared diverged set splits
+        into fixed :data:`~crdt_tpu.sync.delta.DELTA_CHUNK_ROWS`-row
+        chunks, all shipped before the first peer chunk is awaited —
+        chunk i+1 encodes while chunk i is on the wire (the wireloop
+        staging discipline: fixed-size chunks keep the delta applier's
+        pow2 staging planes warm at one rung), and the windowed ARQ
+        keeps up to a window of chunks in flight.  Both peers chunk the
+        SAME shared set, so the chunk count is shared data and the
+        exchange stays symmetric; the receive loop validates the
+        (idx, count, ids) bookkeeping against its own chunking and
+        applies each chunk as it lands, overlapping the scatter-merge
+        with the remaining wire time."""
+        n = report.objects
+        rows = DELTA_CHUNK_ROWS
+        count = (diverged.size + rows - 1) // rows
+        tracing.count("sync.delta.chunked_exchanges")
+        for i in range(count):
+            ids = diverged[i * rows:(i + 1) * rows]
+            with self._prof.clock("serialize"):
+                blobs = gather_blobs(self.batch, ids, self.universe)
+                frame = encode_delta_chunk_frame(
+                    n, i, count, ids, blobs, version=self._wire_version)
+            report.delta_objects_sent += len(blobs)
+            report.delta_chunks_sent += 1
+            self._send(send, frame, report, "delta", len(blobs))
+        for i in range(count):
+            ftype, payload = self._recv(recv, report)
+            if ftype != FRAME_DELTA_CHUNK:
+                raise SyncProtocolError(
+                    "expected a delta chunk frame, peer sent type "
+                    f"{ftype:#04x}"
+                )
+            with self._prof.clock("serialize"):
+                fleet_n, idx, total, ids, blobs = \
+                    decode_delta_chunk_payload(payload)
+            if fleet_n != n:
+                raise SyncProtocolError(
+                    f"peer fleet size {fleet_n} != local {n}"
+                )
+            if idx != i or total != count \
+                    or not np.array_equal(ids,
+                                          diverged[i * rows:(i + 1) * rows]):
+                raise SyncProtocolError(
+                    f"delta chunk stream out of lock-step: peer shipped "
+                    f"chunk {idx}/{total}, expected {i}/{count}"
+                )
+            with self._prof.clock("kernel"):
+                self.batch = delta_mod.apply_delta_rows(
+                    self.batch, ids, blobs, self.universe,
+                    applier=self._applier
+                )
 
     def _send_full(self, send, report: SyncReport) -> None:
         with self._prof.clock("serialize"):
@@ -796,9 +1103,17 @@ class SyncSession:
         ``sync.error``, stamped with this session's ID) before they
         propagate, so a failed session's last event explains the raise.
         """
+        self._transport = None
+        self._streaming = False
+        self._eager_digest = None
         if recv is None:
             transport = send
             send, recv = transport.send, transport.recv
+            # window-capable transports (the ARQ path) negotiate their
+            # in-flight window in the hello and unlock the v4 streaming
+            # phases; anything else stays on the lock-step protocol
+            if hasattr(transport, "negotiate_window"):
+                self._transport = transport
         self._prof = SessionProfile()
         self._prof.start()
         try:
@@ -971,6 +1286,12 @@ class SyncSession:
                 with tracing.span("sync.full_state_exchange"):
                     self._send_full(send, report)
                     self._apply_frame(*self._recv(recv, report))
+            elif self._streaming:
+                self._event("sync.phase", phase="delta_exchange",
+                            diverged=report.diverged, streaming=True)
+                with tracing.span("sync.delta_exchange"):
+                    self._delta_exchange_streaming(send, recv, report,
+                                                   diverged)
             else:
                 self._event("sync.phase", phase="delta_exchange",
                             diverged=report.diverged)

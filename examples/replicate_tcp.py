@@ -34,6 +34,18 @@ Run it:
     python examples/replicate_tcp.py --full-state       # legacy full state
     python examples/replicate_tcp.py --objects 1000 --divergence 0.01
     python examples/replicate_tcp.py --gossip 5         # N-peer fleet mode
+    python examples/replicate_tcp.py --window 16        # windowed ARQ session
+    python examples/replicate_tcp.py --gossip 3 --window 0   # stop-and-wait
+
+``--window N`` runs the session over the hardened windowed transport
+(``crdt_tpu.cluster.ResilientTransport``): seq-numbered CRC-guarded
+envelopes with up to N DATA frames in flight, selective acks, and (at
+N >= 2 on both peers) the v4 streaming delta/descent protocol.  ``0``
+pins a 1-frame window — stop-and-wait — as the A/B control; at
+convergence the peers print frames-in-flight high-water, retransmit
+counts and the descent round-trip count, and ``--gossip`` mode prints a
+fleet digest fingerprint so a windowed fleet can be asserted
+byte-identical to a stop-and-wait control fleet.
 
 ``--gossip N`` runs the cluster runtime instead of a single session: N
 replicas (in-process nodes over real loopback TCP sockets), each with a
@@ -126,7 +138,8 @@ def _build_fleet(n_objects: int, actor: int, divergence: float, seed: int):
 
 def peer(role: str, port: int, n_objects: int, platform: str | None,
          full_state: bool = False, divergence: float = 0.05,
-         metrics_port: int | None = None, linger_s: float = 0.0) -> str:
+         metrics_port: int | None = None, linger_s: float = 0.0,
+         window: int | None = None) -> str:
     import jax
 
     if platform:
@@ -190,11 +203,35 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
     full_ref = sum(len(b) for b in mine.to_wire(uni))
     session = SyncSession(mine, uni, full_state=full_state, peer=other,
                           full_state_bytes=full_ref)
+    transport = None
     with sock:
-        report = session.sync(
-            lambda frame: _send_frame(sock, frame),
-            lambda: _recv_frame(sock),
-        )
+        if window is None:
+            # legacy raw length-prefixed framing, no ARQ envelope
+            report = session.sync(
+                lambda frame: _send_frame(sock, frame),
+                lambda: _recv_frame(sock),
+            )
+        else:
+            # the hardened windowed transport: frames ride seq-numbered
+            # CRC-guarded envelopes with up to `window` in flight
+            # (window 0 = stop-and-wait = a 1-frame window); both peers
+            # must run with --window for the envelopes to parse
+            import dataclasses
+
+            from crdt_tpu.cluster import (
+                ResilientTransport, RetryPolicy, TcpTransport,
+            )
+
+            policy = dataclasses.replace(RetryPolicy(),
+                                         window=max(1, window))
+            transport = ResilientTransport(
+                TcpTransport(sock, default_timeout=60.0), policy,
+                name=role,
+            )
+            try:
+                report = session.sync(transport)
+            finally:
+                transport.close()  # drains the window of stragglers
 
     status = "CONVERGED" if report.converged else "DIVERGED"
     mode = "full-state" if full_state else "delta"
@@ -206,6 +243,17 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
         f"{report.delta_bytes_sent}B full={report.full_bytes_sent}B  {status}",
         flush=True,
     )
+    if transport is not None:
+        print(
+            f"{role}: transport window={report.window} "
+            f"streaming={report.streaming}  "
+            f"inflight_hw={transport.window_hw}  "
+            f"retransmits={transport.retransmits}  "
+            f"sacks={transport.sacks_sent}  "
+            f"delta_chunks={report.delta_chunks_sent}  "
+            f"descent_rtts={report.tree_round_trips}",
+            flush=True,
+        )
     if metrics_server is not None and linger_s > 0:
         # hold the exporter up until someone has read the FINAL state
         # (or the linger budget runs out) — a sync finishing in
@@ -233,7 +281,7 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 gc_interval: int = 1, gc_hysteresis: float = 0.5,
                 digest_tree: bool = False, zipf_s: float = 0.0,
                 burst_len: int = 1, durable_dir: str | None = None,
-                kill_sweep: int = 2) -> int:
+                kill_sweep: int = 2, window: int | None = None) -> int:
     """N in-process replicas over real loopback TCP, reconciled by the
     cluster runtime (``crdt_tpu/cluster``): each node owns a listener
     (accepted sessions run through the same hardened transport stack),
@@ -295,6 +343,12 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     policy = RetryPolicy(send_deadline_s=20.0, recv_deadline_s=20.0,
                          ack_timeout_s=0.25, max_backoff_s=2.0,
                          retry_budget=64)
+    if window is not None:
+        # --window 0 = stop-and-wait (a 1-frame window); any N >= 2
+        # lets sessions pipeline DATA frames and stream v4 descents
+        import dataclasses
+
+        policy = dataclasses.replace(policy, window=max(1, window))
 
     from crdt_tpu.oplog import OpLog
 
@@ -776,6 +830,37 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             f"{c.get('wire.sync.digest.bytes', 0)})", flush=True,
         )
 
+    # the windowed-ARQ story of the run: fleet-wide recovery tallies,
+    # the deepest any link pipelined, and the last session's descent
+    # round-trip count — the numbers PERF.md "Windowed transport" tracks
+    from crdt_tpu.utils import tracing as _tracing
+
+    c = _tracing.counters()
+    hw = max(
+        [int(v) for k, v in _gauges.items()
+         if k.startswith("cluster.transport.")
+         and k.endswith(".window_inflight_hw")] or [0],
+    )
+    print(
+        f"transport: window={policy.window}  inflight_hw={hw}  "
+        f"retransmits={c.get('cluster.transport.retransmits', 0)}  "
+        f"frames_sacked={c.get('cluster.transport.window.sacked', 0)}  "
+        f"window_fallbacks={c.get('cluster.transport.fallback.window', 0)}  "
+        f"descent_rtts="
+        f"{last.tree_round_trips if last is not None else 0}  "
+        f"streaming_last={last.streaming if last is not None else False}",
+        flush=True,
+    )
+
+    if converged and live:
+        # a transport-independent fingerprint of the converged state,
+        # so an A/B harness can assert a windowed fleet landed on the
+        # byte-identical lattice point a stop-and-wait fleet did
+        import hashlib
+
+        sha = hashlib.sha256(live[0].digest().tobytes()).hexdigest()[:16]
+        print(f"gossip: fleet digest sha256={sha}", flush=True)
+
     verdict = "CONVERGED" if converged else "DIVERGED"
     print(f"gossip: {n_peers} peers x {n_objects} objects  "
           f"sweeps={sweeps}  {verdict}", flush=True)
@@ -851,6 +936,15 @@ def main() -> int:
     ap.add_argument("--kill-sweep", type=int, default=2, metavar="K",
                     help="with --durable: kill n1 at sweep K and "
                          "restart it one sweep later (default 2)")
+    ap.add_argument("--window", type=int, default=None, metavar="N",
+                    help="ARQ window: run the session over the hardened "
+                         "windowed transport with up to N frames in "
+                         "flight (0 = stop-and-wait). Single-session "
+                         "roles print frames-in-flight high-water, "
+                         "retransmit and descent round-trip counts; "
+                         "--gossip mode sets the fleet's transport "
+                         "window and prints the fleet-wide tallies plus "
+                         "a digest fingerprint at convergence")
     ap.add_argument("--gc-hysteresis", type=float, default=0.5,
                     help="with --gc: shrink only when the fitted "
                          "capacity rung is at most this fraction of the "
@@ -865,6 +959,8 @@ def main() -> int:
             ap.error("--ops needs R >= 0")
         if args.kill_sweep < 1:
             ap.error("--kill-sweep needs K >= 1")
+        if args.window is not None and args.window < 0:
+            ap.error("--window needs N >= 0")
         return gossip_demo(args.gossip, args.objects, args.platform,
                            divergence=args.divergence,
                            fleet_port=args.fleet_port,
@@ -874,14 +970,19 @@ def main() -> int:
                            digest_tree=args.digest_tree,
                            zipf_s=args.zipf, burst_len=args.burst,
                            durable_dir=args.durable,
-                           kill_sweep=args.kill_sweep)
+                           kill_sweep=args.kill_sweep,
+                           window=args.window)
+
+    if args.window is not None and args.window < 0:
+        ap.error("--window needs N >= 0")
 
     if args.role != "demo":
         if not args.port:
             ap.error("server/client roles need --port")
         status = peer(args.role, args.port, args.objects, args.platform,
                       full_state=args.full_state, divergence=args.divergence,
-                      metrics_port=args.metrics_port, linger_s=args.linger)
+                      metrics_port=args.metrics_port, linger_s=args.linger,
+                      window=args.window)
         return 0 if status == "CONVERGED" else 1
 
     # demo: spawn both peers as real OS processes
@@ -898,6 +999,8 @@ def main() -> int:
         extra += ["--full-state"]
     if args.platform:
         extra += ["--platform", args.platform]
+    if args.window is not None:
+        extra += ["--window", str(args.window)]
     srv_extra = list(extra)
     if args.metrics_port is not None:
         # one exporter per process; in demo mode the server peer gets it

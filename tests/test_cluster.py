@@ -15,6 +15,7 @@ locks.
 import itertools
 import threading
 import time
+from dataclasses import replace as policy_replace
 
 import numpy as np
 import pytest
@@ -126,7 +127,10 @@ def test_decode_envelope_rejects_malformed():
 
 def _pump_frames(ra, rb, n, payload=b"frame-%04d"):
     """Ship ``n`` frames a→b through two resilient endpoints, driving
-    the receive side in a thread (the ack path needs it live)."""
+    the receive side in a thread (the ack path needs it live).  The
+    sender flushes at the end: a windowed ``send`` only guarantees
+    window admission, and the retransmit timers for any lost tail
+    frames are serviced by the flush pump."""
     got = []
     err = []
 
@@ -141,6 +145,7 @@ def _pump_frames(ra, rb, n, payload=b"frame-%04d"):
     t.start()
     for i in range(n):
         ra.send(payload % i)
+    ra.flush(timeout=30.0)
     t.join(timeout=30.0)
     assert not t.is_alive(), "receiver hung"
     if err:
@@ -175,19 +180,22 @@ def test_resilient_delivers_exactly_once_under_faults(plan):
     got = _pump_frames(ra, rb, 24)
     assert got == [b"frame-%04d" % i for i in range(24)]
     assert sum(fa.injected.values()) > 0, "plan injected nothing"
-    # dropped/truncated frames force retransmits; duplicates/delays are
-    # suppressed or reordered through — some recovery path must fire
+    # dropped/truncated frames force retransmits; duplicates are
+    # suppressed; a delay-reordered frame lands in the out-of-order
+    # buffer and is selectively acked — some recovery path must fire
     recovered = (ra.retransmits + rb.duplicates + rb.corrupt
-                 + ra.transient_errors)
+                 + ra.transient_errors + rb.ooo_buffered)
     assert recovered > 0
 
 
 def test_resilient_send_deadline_and_budget_are_bounded():
-    # a peer that never acks: the send leg must fail in bounded time
+    # a peer that never acks: the send leg must fail in bounded time.
+    # window=1 keeps the classic blocking-send shape — the error
+    # surfaces from send() itself, not a later flush
     ta, _tb = queue_pair(default_timeout=5.0)
     policy = RetryPolicy(send_deadline_s=0.3, recv_deadline_s=0.3,
                          ack_timeout_s=0.02, max_backoff_s=0.05,
-                         retry_budget=1000)
+                         retry_budget=1000, window=1)
     ra = ResilientTransport(ta, policy, name="deadline", seed=13)
     t0 = time.monotonic()
     with pytest.raises(SyncTimeoutError):
@@ -197,11 +205,22 @@ def test_resilient_send_deadline_and_budget_are_bounded():
     ta2, _tb2 = queue_pair(default_timeout=5.0)
     tight = RetryPolicy(send_deadline_s=30.0, recv_deadline_s=30.0,
                         ack_timeout_s=0.01, max_backoff_s=0.02,
-                        retry_budget=3)
+                        retry_budget=3, window=1)
     ra2 = ResilientTransport(ta2, tight, name="budget", seed=14)
     with pytest.raises(PeerUnavailableError):
         ra2.send(b"into the void")
     assert ra2.retransmits <= 4  # budget bounds the spin, not the clock
+    # the windowed shape of the same bound: send() admits the frame
+    # (the window has room), flush() is the delivery barrier that
+    # surfaces the deadline
+    ta3, _tb3 = queue_pair(default_timeout=5.0)
+    ra3 = ResilientTransport(ta3, policy_replace(policy, window=8),
+                             name="deadline-w8", seed=15)
+    ra3.send(b"into the void")
+    t0 = time.monotonic()
+    with pytest.raises(SyncTimeoutError):
+        ra3.flush()
+    assert time.monotonic() - t0 < 5.0
 
 
 def test_resilient_recv_deadline():
@@ -212,6 +231,221 @@ def test_resilient_recv_deadline():
     with pytest.raises(SyncTimeoutError):
         ra.recv()
     assert time.monotonic() - t0 < 5.0
+
+
+# ---- windowed ARQ ----------------------------------------------------------
+
+
+class _DropSeq(transport_mod.Transport):
+    """Inner transport that drops the DATA envelope with one chosen seq
+    exactly once — deterministic loss, so the selective-ack pin can say
+    WHICH frame died (FaultyTransport's coin flips cannot)."""
+
+    def __init__(self, inner, seq):
+        self._inner = inner
+        self._seq = seq
+        self.dropped = 0
+
+    def send(self, frame):
+        if self.dropped == 0 and len(frame) >= transport_mod._ENV.size:
+            kind, seq, _crc, _plen = transport_mod._ENV.unpack_from(frame)
+            if kind == transport_mod._DATA and seq == self._seq:
+                self.dropped += 1
+                return
+        self._inner.send(frame)
+
+    def recv(self, timeout=None):
+        return self._inner.recv(timeout)
+
+    def close(self):
+        self._inner.close()
+
+
+def test_windowed_selective_ack_retransmits_only_lost_frames():
+    """Drop exactly one DATA frame out of eight: the frames behind the
+    hole are buffered out-of-order and selectively acked, so the sender
+    retransmits ONE frame — the lost one — not the whole window."""
+    before = tracing.counters()
+    ta, tb = queue_pair(default_timeout=5.0)
+    drop = _DropSeq(ta, seq=2)
+    # a generous ack timeout so the seq-2 retransmit timer fires ONCE,
+    # well after the SACKs for seqs 3..7 have landed
+    ra = ResilientTransport(drop, policy_replace(FAST, ack_timeout_s=0.3),
+                            name="a", seed=31)
+    rb = ResilientTransport(tb, FAST, name="b", seed=32)
+    got = _pump_frames(ra, rb, 8)
+    assert got == [b"frame-%04d" % i for i in range(8)]
+    assert drop.dropped == 1
+    # the selective-repeat pin: exactly the one lost frame went again
+    assert ra.retransmits == 1
+    assert rb.ooo_buffered >= 1       # frames behind the hole were held
+    assert rb.sacks_sent >= 1         # ...and advertised to the sender
+    assert ra.frames_sacked >= 1      # ...which excluded them from timers
+    assert ra.window_hw >= 2          # the window genuinely pipelined
+    deltas = tracing.counters_since(before)
+    assert deltas.get("cluster.transport.window.sacked", 0) >= 1
+    assert deltas.get("cluster.transport.window.ooo", 0) >= 1
+    ra.close()
+    rb.close()
+
+
+def test_windowed_close_drains_whole_window():
+    """Regression pin: close() with SEVERAL unacked frames in flight
+    drains the whole window over a lossy link — not just the classic
+    stop-and-wait single straggler — and stays inside the documented
+    drain cap (6 quiet periods, quiet ≤ 1s)."""
+    ta, tb = queue_pair(default_timeout=5.0)
+    fa = FaultyTransport(ta, FaultPlan(seed=41, drop=0.3), name="lossy")
+    ra = ResilientTransport(fa, FAST, name="a", seed=42)
+    rb = ResilientTransport(tb, FAST, name="b", seed=43)
+    got, err = [], []
+
+    def consume():
+        try:
+            for _ in range(6):
+                got.append(rb.recv(timeout=10.0))
+        except BaseException as e:
+            err.append(e)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    for i in range(6):
+        ra.send(b"drain-%04d" % i)
+    # no flush: close() itself must be the delivery barrier
+    t0 = time.monotonic()
+    ra.close()
+    elapsed = time.monotonic() - t0
+    t.join(timeout=30.0)
+    assert not t.is_alive(), "receiver hung"
+    if err:
+        raise err[0]
+    assert got == [b"drain-%04d" % i for i in range(6)]
+    assert elapsed < 8.0, f"close drained for {elapsed:.2f}s"
+
+
+def _sync_sessions_over(a, b, uni, ta, tb, *, timeout_s=120.0, **session_kw):
+    """Run one SyncSession pair over a pair of connected transports,
+    peer side in a thread; returns ``(sa, sb, rep_a, rep_b)``."""
+    sa = SyncSession(a, uni, peer="b", **session_kw)
+    sb = SyncSession(b, uni, peer="a", **session_kw)
+    res, err = {}, []
+    a_done = threading.Event()
+
+    def serve(tr, until):
+        # a returned session stops pumping its transport, so over a
+        # lossy link the peer's final in-flight frame (its ack lost)
+        # can strand past the close-drain window — whichever side
+        # finishes first keeps servicing acks until the other is done
+        deadline = time.monotonic() + timeout_s
+        while not until() and time.monotonic() < deadline:
+            try:
+                tr.recv(timeout=0.05)
+            except SyncTimeoutError:
+                continue
+            except TransportError:
+                return
+
+    def run_b():
+        try:
+            res["b"] = sb.sync(tb)
+            serve(tb, a_done.is_set)
+        except BaseException as e:
+            err.append(e)
+
+    t = threading.Thread(target=run_b, daemon=True)
+    t.start()
+    try:
+        res["a"] = sa.sync(ta)
+    finally:
+        a_done.set()
+        serve(ta, lambda: not t.is_alive())
+        ta.close()
+        tb.close()
+    t.join(timeout=timeout_s)
+    assert not t.is_alive(), "peer session hung"
+    if err:
+        raise err[0]
+    return sa, sb, res["a"], res["b"]
+
+
+#: WAN-shaped retry policy: the initial RTO must sit near the injected
+#: RTT or every first flight spuriously retransmits and burns budget
+_WAN = RetryPolicy(send_deadline_s=20.0, recv_deadline_s=20.0,
+                   ack_timeout_s=0.25, max_backoff_s=0.5,
+                   retry_budget=2000)
+
+
+@pytest.mark.parametrize("one_way_s", [0.025, 0.1],
+                         ids=["rtt50ms", "rtt200ms"])
+def test_windowed_sync_byte_identical_under_wan_faults(one_way_s):
+    """The ISSUE acceptance rung: windowed sessions over 50–200ms RTT
+    links with 20% loss and frame reordering converge byte-identical to
+    a stop-and-wait control pair on the same histories."""
+    from crdt_tpu.cluster import latency_pair
+
+    uni = _uni()
+    seed = int(one_way_s * 1000)
+    rows_a = list(range(0, 64, 3))
+    rows_b = list(range(1, 64, 5))
+    a = OrswotBatch.from_scalar(
+        _orswot_fleet(64, seed=61, actor=1, extra_on=rows_a), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(64, seed=61, actor=2, extra_on=rows_b), uni)
+    ref = a.merge(b).to_wire(uni)
+
+    def wan_link(s):
+        la, lb = latency_pair(one_way_s, seed=s, default_timeout=30.0)
+        fa = FaultyTransport(la, FaultPlan(seed=s + 10, drop=0.2,
+                                           delay=0.25), name=f"wan-a{s}")
+        fb = FaultyTransport(lb, FaultPlan(seed=s + 11, drop=0.2,
+                                           delay=0.25), name=f"wan-b{s}")
+        return fa, fb
+
+    # windowed run
+    fa, fb = wan_link(seed)
+    ra = ResilientTransport(fa, _WAN, name="w-a", seed=seed + 1)
+    rb = ResilientTransport(fb, _WAN, name="w-b", seed=seed + 2)
+    sa, sb, rep_a, rep_b = _sync_sessions_over(a, b, uni, ra, rb)
+    assert rep_a.converged and rep_b.converged
+    assert rep_a.window > 1 and rep_b.window > 1
+    assert sum(fa.injected.values()) + sum(fb.injected.values()) > 0
+    assert sa.batch.to_wire(uni) == ref == sb.batch.to_wire(uni)
+
+    # stop-and-wait control on the same histories
+    fa2, fb2 = wan_link(seed + 100)
+    ra2 = ResilientTransport(fa2, policy_replace(_WAN, window=1),
+                             name="sw-a", seed=seed + 3)
+    rb2 = ResilientTransport(fb2, policy_replace(_WAN, window=1),
+                             name="sw-b", seed=seed + 4)
+    sa2, sb2, rep2a, rep2b = _sync_sessions_over(a, b, uni, ra2, rb2)
+    assert rep2a.converged and rep2b.converged
+    assert rep2a.window == 1 and not rep2a.streaming
+    # byte-identical across ARQ modes — the ISSUE's equivalence bar
+    assert sa2.batch.to_wire(uni) == ref == sb2.batch.to_wire(uni)
+
+
+def test_mixed_window_fleet_falls_back_to_stop_and_wait():
+    """A window-16 node syncing with a window-1 node: the hello clamps
+    both to stop-and-wait, the fallback counter fires, streaming stays
+    off, and the result is still byte-identical to the merge."""
+    before = tracing.counters()
+    uni = _uni()
+    a = OrswotBatch.from_scalar(
+        _orswot_fleet(48, seed=91, actor=1, extra_on=[3, 9]), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(48, seed=91, actor=2, extra_on=[17]), uni)
+    ref = a.merge(b).to_wire(uni)
+    ta, tb = queue_pair(default_timeout=10.0)
+    ra = ResilientTransport(ta, FAST, name="a", seed=92)
+    rb = ResilientTransport(tb, policy_replace(FAST, window=1),
+                            name="b", seed=93)
+    sa, sb, rep_a, rep_b = _sync_sessions_over(a, b, uni, ra, rb)
+    assert rep_a.converged and rep_b.converged
+    assert rep_a.window == 1 and rep_b.window == 1
+    assert not rep_a.streaming and not rep_b.streaming
+    deltas = tracing.counters_since(before)
+    assert deltas.get("cluster.transport.fallback.window", 0) >= 1
+    assert sa.batch.to_wire(uni) == ref == sb.batch.to_wire(uni)
 
 
 def test_session_accepts_transport_directly():
@@ -471,9 +705,14 @@ def test_acceptance_five_replicas_20pct_loss_flapping_peer():
 
     # the flight recorder is a 2048-event ring and a lossy fleet is
     # chatty — harvest new events every sweep so early peer-state
-    # transitions can't be evicted before the assertions read them
+    # transitions can't be evicted before the assertions read them.
+    # Start past whatever is already in the ring: earlier tests in this
+    # process leave their own transport.retry events behind (with their
+    # own policies' backoffs), and this test's assertions must read only
+    # this fleet's story.
     events = []
-    last_seq = 0
+    last_seq = max((e["seq"] for e in obs_events.recorder().snapshot()),
+                   default=0)
 
     def harvest():
         nonlocal last_seq
@@ -566,3 +805,34 @@ def test_gossip_example_mode_converges():
     assert proc.returncode == 0, (proc.stdout[-400:], proc.stderr[-800:])
     assert "gossip: 3 peers" in proc.stdout
     assert "CONVERGED" in proc.stdout
+
+
+def test_gossip_example_windowed_matches_stop_and_wait_control():
+    """The example's --window smoke: a windowed gossip fleet must land
+    on the byte-identical lattice point a stop-and-wait control fleet
+    does — asserted via the digest fingerprint both runs print."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shas = {}
+    for label, window in (("windowed", "16"), ("stopwait", "0")):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "examples", "replicate_tcp.py"),
+                "--gossip", "3", "--objects", "24", "--platform", "cpu",
+                "--window", window,
+            ],
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, \
+            (label, proc.stdout[-400:], proc.stderr[-800:])
+        m = re.search(r"fleet digest sha256=([0-9a-f]+)", proc.stdout)
+        assert m, (label, proc.stdout[-400:])
+        shas[label] = m.group(1)
+        assert f"transport: window={'16' if window == '16' else '1'}" \
+            in proc.stdout
+    assert shas["windowed"] == shas["stopwait"]

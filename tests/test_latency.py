@@ -118,6 +118,7 @@ def test_transport_samples_rtt_over_shaped_link():
     t.start()
     for i in range(8):
         ra.send(b"frame-%d" % i)
+    ra.flush(timeout=30.0)  # the delivery barrier processes the acks
     t.join(timeout=30.0)
     assert len(got) == 8 and ra.retransmits == 0
     snap = ra.rtt.snapshot()
@@ -197,6 +198,7 @@ def test_loopback_adaptive_rto_tighter_than_static():
     t.start()
     for i in range(8):
         ra.send(b"x%d" % i)
+    ra.flush(timeout=10.0)  # process the tail acks into the estimator
     t.join(timeout=10.0)
     assert len(got) == 8
     assert ra.current_rto() < pol.ack_timeout_s

@@ -376,6 +376,35 @@ def test_idempotent_resync_ships_zero_deltas():
         assert r.digest_rounds == 1
 
 
+def test_flat_session_ships_phase1_digest_eagerly():
+    """A flat (non-tree, non-full-state) session ships its phase-1
+    digest inside the hello flight — same wire sequence, one wait
+    instead of two — and the counter pins the path; a digest-tree
+    session must NOT take it (phase 1 there is the root frame)."""
+    from crdt_tpu.utils import tracing
+
+    uni = _uni()
+    a = OrswotBatch.from_scalar(
+        _orswot_fleet(40, seed=13, actor=1, extra_on=[2]), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(40, seed=13, actor=2, extra_on=[7]), uni)
+    before = tracing.counters()
+    sa, sb = SyncSession(a, uni), SyncSession(b, uni)
+    ra, rb = sync_pair(sa, sb)
+    assert ra.converged and rb.converged
+    deltas = tracing.counters_since(before)
+    assert deltas.get("sync.digest.eager", 0) == 2  # both peers
+    assert sa.batch.to_wire(uni) == a.merge(b).to_wire(uni)
+
+    before = tracing.counters()
+    st_a = SyncSession(sa.batch, uni, digest_tree=True)
+    st_b = SyncSession(sb.batch, uni, digest_tree=True)
+    rt_a, _ = sync_pair(st_a, st_b)
+    assert rt_a.converged and rt_a.tree_mode
+    deltas = tracing.counters_since(before)
+    assert deltas.get("sync.digest.eager", 0) == 0
+
+
 def test_forced_digest_collision_falls_back_to_full_state():
     """Phase-1 digests that collide on diverged rows ship nothing for
     them; the canonical verify catches it and the full-state retry must

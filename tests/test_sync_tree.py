@@ -291,7 +291,7 @@ def test_envelope_accepts_both_compat_versions():
         frame = sync_delta.encode_digest_frame(d, version=ver)
         assert frame[0] == ver
         decode_frame(frame)
-    for bad in (1, 4):
+    for bad in (1, 5):
         frame = sync_delta.encode_digest_frame(d, version=bad)
         with pytest.raises(SyncProtocolError):
             decode_frame(frame)
@@ -494,6 +494,77 @@ def test_descent_under_20pct_loss_converges_byte_identical():
         results[mode] = digests[0]
     # descent-mode fleet == flat-mode fleet, byte for byte
     assert np.array_equal(results[True], results[False])
+
+
+def test_speculative_descent_pins_hits_misses_and_round_trips():
+    """The v4 streaming descent over a windowed transport: the whole
+    multi-level walk completes in TWO round-trip equivalents (root
+    exchange + one speculative blast), the blast both hits (the true
+    frontier's blocks are consumed) and misses (the k-ary expansion of
+    a sparse frontier over-ships, and the surplus is discarded cleanly
+    — ``sync.tree.speculate.{hit,miss}`` fire), and the result is
+    byte-identical to the lock-step control on the same histories."""
+    from crdt_tpu.cluster import ResilientTransport, RetryPolicy, queue_pair
+    from crdt_tpu.utils import tracing
+
+    uni = _uni()
+    n = 600  # levels [600, 38, 3, 1]: a two-level speculative blast
+    rows_a = [5, 300]
+    rows_b = [450]
+    a = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=71, actor=1, extra_on=rows_a), uni)
+    b = OrswotBatch.from_scalar(
+        _orswot_fleet(n, seed=71, actor=2, extra_on=rows_b), uni)
+    ref = a.merge(b).to_wire(uni)
+
+    before = tracing.counters()
+    fast = RetryPolicy(send_deadline_s=5.0, recv_deadline_s=5.0,
+                       ack_timeout_s=0.05, max_backoff_s=0.3,
+                       retry_budget=400)
+    ta, tb = queue_pair(default_timeout=10.0)
+    ra = ResilientTransport(ta, fast, name="spec-a", seed=81)
+    rb = ResilientTransport(tb, fast, name="spec-b", seed=82)
+    sa = SyncSession(a, uni, digest_tree=True)
+    sb = SyncSession(b, uni, digest_tree=True)
+    res = {}
+
+    def run_b():
+        res["b"] = sb.sync(rb)
+
+    t = threading.Thread(target=run_b, daemon=True)
+    t.start()
+    res["a"] = sa.sync(ra)
+    t.join(timeout=60.0)
+    assert not t.is_alive()
+    rep_a, rep_b = res["a"], res["b"]
+    for rep in (rep_a, rep_b):
+        assert rep.converged and rep.tree_mode and rep.streaming
+        # the ISSUE's latency bar: root exchange + ONE blast, however
+        # many levels deep the tree is
+        assert rep.tree_round_trips == 2
+        assert rep.spec_hits > 0
+        # 3 diverged leaves in a fan-out-16 expansion: most speculated
+        # blocks are surplus and must be discarded, not applied
+        assert rep.spec_misses > rep.spec_hits
+    assert sa.batch.to_wire(uni) == ref == sb.batch.to_wire(uni)
+    deltas = tracing.counters_since(before)
+    assert deltas.get("sync.tree.spec_blasts", 0) == 2
+    assert deltas.get("sync.tree.speculate.hit", 0) > 0
+    assert deltas.get("sync.tree.speculate.miss", 0) > 0
+
+    # lock-step control (no transport → no streaming): same bytes,
+    # strictly more round trips
+    sa2 = SyncSession(a, uni, digest_tree=True)
+    sb2 = SyncSession(b, uni, digest_tree=True)
+    rc_a, rc_b = sync_pair(sa2, sb2)
+    assert rc_a.converged and rc_a.tree_mode and not rc_a.streaming
+    assert rc_a.spec_hits == rc_a.spec_misses == 0
+    assert rc_a.tree_round_trips > rep_a.tree_round_trips
+    assert sa2.batch.to_wire(uni) == ref == sb2.batch.to_wire(uni)
+    # both modes located the identical diverged leaf set
+    assert rc_a.diverged == rep_a.diverged
+    ra.close()
+    rb.close()
 
 
 # ---- digest memoization ----------------------------------------------------

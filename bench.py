@@ -1419,6 +1419,137 @@ def bench_oplog():
     return out
 
 
+def bench_reads():
+    """Batched read front-end (the `crdt_tpu.serve` subsystem): client
+    reads resolved straight from the dense planes by ONE jitted gather
+    per batch, instead of cloning objects back to the scalar engine.
+
+    Reports reads/s at 1k/16k/64k-object fleets under the Zipf mixed
+    read/write workload (``WorkloadGen.draw_mixed`` — the same key
+    stream drives both sides), with ops/s through the scatter-fold
+    alongside so the artifact shows the read and write front-ends from
+    the same round.  Parity gate: a ≥4k-read batch (mixed ``contains``
+    and ``value()`` reads) must come back byte-identical — val,
+    add-clock and rm-clock rows — to the scalar ``ReadCtx`` loop
+    (`orswot.rs:60-83` read semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu import serve
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.oplog import OpApplier, derive_add_ctx
+    from crdt_tpu.utils.interning import Universe
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+    from crdt_tpu.utils.workload import WorkloadGen
+
+    rng = np.random.RandomState(23)
+    if SMALL:
+        a, m, ladder, batch, reps = 16, 16, (1_024, 4_096), 2_048, 3
+    else:
+        a, m, ladder, batch, reps = 64, 16, (1_024, 16_384, 65_536), \
+            8_192, 5
+    cfg = CrdtConfig(num_actors=a, member_capacity=m, deferred_capacity=2,
+                     counter_bits=32)
+    uni = Universe.identity(cfg)
+
+    # -- parity gate vs the scalar ReadCtx loop (always runs) -----------
+    # a 256-object head with real history, read 4096 times (the
+    # acceptance bar: one gather step resolving a >=4k batch)
+    head_n, preads = 256, 4_096
+    head_planes = anti_entropy_fleets(
+        rng, head_n, a, m, 2, 1, base=min(10, m - 4), novel=0,
+    )[0]
+    head = OrswotBatch(*(jnp.asarray(x) for x in head_planes))
+    head = head.merge(head)  # canonicalize, as bench_sync/bench_oplog
+    scal = head.to_scalar(uni)
+    pobj = rng.randint(0, head_n, preads)
+    # half contains() on plausible members, half value() reads
+    pmember = rng.randint(0, 2 * m, preads).astype(np.int32)
+    pmember[rng.random_sample(preads) < 0.5] = serve.NO_MEMBER
+    frame = serve.gather(head, pobj, member=pmember)
+
+    def _row(vc) -> np.ndarray:
+        r = np.zeros(a, np.uint64)
+        for actor, cnt in vc.dots.items():
+            r[int(actor)] = cnt
+        return r
+
+    bad = 0
+    for i in range(preads):
+        o = scal[int(pobj[i])]
+        if pmember[i] == serve.NO_MEMBER:
+            rc = o.value()
+            want_val = len(rc.val)
+        else:
+            rc = o.contains(int(pmember[i]))
+            want_val = int(bool(rc.val))
+        if int(frame.val[i]) != want_val or \
+                not np.array_equal(frame.add_clock[i], _row(rc.add_clock)) \
+                or not np.array_equal(frame.rm_clock[i],
+                                      _row(rc.rm_clock)):
+            bad += 1
+    assert bad == 0, \
+        f"serve parity: {bad}/{preads} gathered reads != scalar ReadCtx"
+
+    # -- throughput: mixed reads/s + ops/s per fleet size ---------------
+    out = {"serve_parity_rows": preads}
+    read_rates, op_rates = {}, {}
+    for n in ladder:
+        planes = anti_entropy_fleets(
+            rng, n, a, m, 2, 1, base=min(10, m - 4), novel=0,
+        )[0]
+        fleet = OrswotBatch(*(jnp.asarray(x) for x in planes))
+        fleet = fleet.merge(fleet)
+        clock_host = np.asarray(fleet.clock)
+        gen = WorkloadGen(n, seed=29, zipf_s=1.1, burst_len=4,
+                          read_frac=0.5)
+        keys, is_read = gen.draw_mixed(batch * reps)
+        rkeys, wkeys = keys[is_read], keys[~is_read]
+        rmember = rng.randint(0, 2 * m, rkeys.size).astype(np.int32)
+        rmember[rng.random_sample(rkeys.size) < 0.25] = serve.NO_MEMBER
+        ops, _ = derive_add_ctx(
+            clock_host, wkeys,
+            rng.randint(0, a, wkeys.size).astype(np.int32),
+            member=rng.randint(1 << 16, (1 << 16) + 4,
+                               wkeys.size).astype(np.int32),
+        )
+        applier = OpApplier(uni)
+
+        def _read_pass():
+            done = 0
+            while done < rkeys.size:
+                f = serve.gather(fleet, rkeys[done:done + batch],
+                                 member=rmember[done:done + batch])
+                done += min(batch, rkeys.size - done)
+            return f
+
+        # warm/compile both legs off the clock (the tail gather pads to
+        # a second pow2 shape, so a full pass is the honest warm-up)
+        f = _read_pass()
+        folded, _ = applier.apply_ops(fleet, ops)
+        jax.block_until_ready((f.val, folded.clock))
+        t0 = time.perf_counter()
+        f = _read_pass()
+        jax.block_until_ready(f.val)
+        read_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        folded, _ = applier.apply_ops(fleet, ops)
+        jax.block_until_ready(folded.clock)
+        op_wall = time.perf_counter() - t0
+        read_rates[n] = rkeys.size / read_wall
+        op_rates[n] = wkeys.size / op_wall
+        log(f"serve: {n} objects -> {read_rates[n]:,.0f} reads/s "
+            f"({rkeys.size} reads in {batch}-row gathers), "
+            f"{op_rates[n]:,.0f} ops/s alongside")
+    out["serve_objects"] = max(ladder)
+    out["serve_reads_per_sec"] = round(max(read_rates.values()))
+    out["serve_reads_per_sec_small"] = round(read_rates[ladder[0]])
+    out["serve_mixed_ops_per_sec"] = round(max(op_rates.values()))
+    out["serve_read_batch"] = batch
+    return out
+
+
 def bench_obs_overhead():
     """Always-on observability cost gate (the obs subsystem's bench
     satellite): the counters/gauges/events added across the wire and
@@ -3188,6 +3319,13 @@ def main():
     oplog_res = run_stage("oplog", 45, bench_oplog)
     if oplog_res is not None:
         emit(**oplog_res)
+    # budget-skippable: the batched read front-end (reads/s through the
+    # jitted gather at 1k/16k/64k-object fleets under the Zipf mixed
+    # read/write workload, ops/s through the scatter-fold alongside;
+    # parity-gated against the scalar ReadCtx loop inside the stage)
+    reads_res = run_stage("reads", 45, bench_reads)
+    if reads_res is not None:
+        emit(**reads_res)
     # budget-skippable: the <1% always-on metrics gate (needs e2e_wire's
     # wall time above to have something to be a fraction OF)
     obs_res = run_stage("obs_overhead", 15, bench_obs_overhead)

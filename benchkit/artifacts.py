@@ -177,6 +177,14 @@ def counter_family(name: str) -> str:
         # tears a WAL or falls back a generation — only the durability
         # layer disappearing wholesale is the signal
         return "durable"
+    if parts[0] == "serve":
+        # the read front-end counters (reads/batches/admit.*/park.*/
+        # reject.*/not_stable_rows/stalls/frames.*) collapse into ONE
+        # family: a write-only round legitimately serves nothing, and
+        # parks/rejects legitimately stay zero on a quiescent
+        # same-node workload — only the serve path disappearing
+        # wholesale is the signal
+        return "serve"
     if parts[0] == "kernel" and len(parts) >= 3:
         # the runtime kernel observatory's per-kernel counters
         # (kernel.<label>.{calls,compiles,bytes,errors}) collapse into

@@ -708,6 +708,63 @@ def _b_frontier_fold():
     return build
 
 
+def _b_serve_gather(which: str):
+    """The read front-end's gather kernels (serve/query.py): pure
+    gathers from the dense planes into columnar result frames.  Read
+    batches pad to the power-of-two ladder (serve.query.PAD_FLOOR), so
+    the traced rungs walk capacity x padded-batch — one legitimate
+    lowering per rung."""
+
+    def build():
+        from ..serve import query as serve_query
+
+        dt = _clock_dt()
+        idt = "int64" if dt == "uint64" else "int32"
+        cases = []
+        if which == "orswot":
+            fn = _unjit(serve_query._orswot_kernel())
+            for (a, m, _d) in LADDER:
+                for b in (8, 64):
+                    cases.append(TraceCase(
+                        rung=f"A{a}.M{m}.B{b}", fn=fn,
+                        args=(_mat((LADDER_N, a), dt),
+                              _mat((LADDER_N, m), "int32"),
+                              _mat((LADDER_N, m, a), dt),
+                              _vec(b, idt), _vec(b, "int32"))))
+        elif which == "counter":
+            fn = _unjit(serve_query._counter_kernel())
+            for a in ACTOR_LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}.B8", fn=fn,
+                    args=(_mat((LADDER_N, a), dt), _vec(8, idt))))
+        elif which == "lww":
+            fn = _unjit(serve_query._lww_kernel())
+            for b in (8, 64):
+                cases.append(TraceCase(
+                    rung=f"B{b}", fn=fn,
+                    args=(_vec(LADDER_N, dt), _vec(LADDER_N, dt),
+                          _vec(b, idt))))
+        elif which == "mvreg":
+            fn = _unjit(serve_query._mvreg_kernel())
+            for a in ACTOR_LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}.V4.B8", fn=fn,
+                    args=(_mat((LADDER_N, 4, a), dt),
+                          _mat((LADDER_N, 4), dt), _vec(8, idt))))
+        else:  # map
+            fn = _unjit(serve_query._map_kernel())
+            for (a, _m, _d) in LADDER:
+                cases.append(TraceCase(
+                    rung=f"A{a}.K4.B8", fn=fn,
+                    args=(_mat((LADDER_N, a), dt),
+                          _mat((LADDER_N, 4), "int32"),
+                          _mat((LADDER_N, 4, a), dt),
+                          _vec(8, idt), _vec(8, "int32"))))
+        return cases
+
+    return build
+
+
 def _b_collective(which: str):
     def build():
         import functools
@@ -972,6 +1029,26 @@ MANIFEST: tuple = (
                "_frontier_kernel.kernel",
                compile_budget=4,  # one lowering per traced (S, span, A)
                build=_b_frontier_fold()),
+    # serve/query.py (the read front-end's gather kernels) -------------------
+    KernelSpec("serve.gather.orswot", "crdt_tpu/serve/query.py",
+               "_orswot_kernel.kernel",
+               compile_budget=2 * len(LADDER),  # capacity x padded batch
+               build=_b_serve_gather("orswot")),
+    KernelSpec("serve.gather.counter", "crdt_tpu/serve/query.py",
+               "_counter_kernel.kernel",
+               compile_budget=len(ACTOR_LADDER),
+               build=_b_serve_gather("counter")),
+    KernelSpec("serve.gather.lww", "crdt_tpu/serve/query.py",
+               "_lww_kernel.kernel",
+               build=_b_serve_gather("lww")),
+    KernelSpec("serve.gather.mvreg", "crdt_tpu/serve/query.py",
+               "_mvreg_kernel.kernel",
+               compile_budget=len(ACTOR_LADDER),
+               build=_b_serve_gather("mvreg")),
+    KernelSpec("serve.gather.map", "crdt_tpu/serve/query.py",
+               "_map_kernel.kernel",
+               compile_budget=len(LADDER),
+               build=_b_serve_gather("map")),
     # parallel/collective.py -------------------------------------------------
     KernelSpec("parallel.clock_join", _CO, "_clock_join_fn._join",
                build=_b_collective("clock")),

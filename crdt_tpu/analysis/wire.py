@@ -53,6 +53,12 @@ WIRE_MODULES = (
     # shape the wire contract exists for: CheckpointFormatError (a
     # CrdtError), never a bare zipfile/struct/ValueError leak
     "crdt_tpu/durable/",
+    # the read front-end's request/result codec (serve/wire.py) rides
+    # the same versioned+CRC envelope discipline; its decode paths must
+    # reject with SyncProtocolError/WireFormatError, and its
+    # consistency rejections speak the typed
+    # ConsistencyUnavailableError — never bare stdlib errors
+    "crdt_tpu/serve/",
     # the seed-level checkpoint loader doubles as the state-replication
     # receive path AND the snapshot store's payload decoder
     "crdt_tpu/utils/checkpoint.py",
@@ -89,6 +95,7 @@ _CRDT_ERRORS = {
     "PeerUnavailableError", "TransportClosedError", "TransportFrameError",
     "OpLogOverflowError", "UnsupportedBackendError",
     "DurabilityError", "CheckpointFormatError",
+    "ConsistencyUnavailableError",
 }
 
 

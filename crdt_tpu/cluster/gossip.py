@@ -184,6 +184,9 @@ class ClusterNode:
         self._batch = batch
         self._last_report: Optional[SyncReport] = None
         self._last_gc_report = None
+        # the read front-end (crdt_tpu/serve): built lazily on the
+        # first serve_reads call so write-only nodes pay nothing
+        self._serve_loop = None
 
     @property
     def batch(self):
@@ -318,6 +321,54 @@ class ClusterNode:
             ops, _ = derive_add_ctx(self.write_clock(), obj, actor,
                                     member=member)
             return self.submit_ops(ops)
+
+    def write_vv(self) -> "np.ndarray":
+        """The writer's ACK version vector (``uint64[A]``): the
+        pointwise max of :meth:`write_clock` over objects.  This is
+        the floor a client hands a read-your-writes request
+        (:mod:`crdt_tpu.serve.consistency`) — once a node's visible
+        clock covers it, every write acknowledged before the call is
+        in the serving snapshot."""
+        import numpy as np
+
+        return np.asarray(self.write_clock(), np.uint64).max(axis=0)
+
+    def read_token(self):
+        """The node's current monotonic-reads token (the visible
+        version vector) — what a fresh client starts a monotonic
+        session with."""
+        from ..serve.loop import visible_vv
+
+        return visible_vv(self.batch)
+
+    def try_drain(self) -> bool:
+        """One NON-BLOCKING op-drain attempt: fold pending ops if the
+        busy lock is free, else return False immediately (the same
+        acquire discipline :meth:`submit_ops` uses).  The serve loop's
+        consistency park calls this so a read-your-writes read waiting
+        on its own write nudges visibility instead of spinning on a
+        clock that nothing advances."""
+        if not self._busy.acquire(blocking=False):
+            return False
+        try:
+            self._drain_ops_locked()
+        finally:
+            self._busy.release()
+        return True
+
+    def serve_reads(self, request):
+        """Answer one batched read request
+        (:class:`crdt_tpu.serve.ReadRequest`) under its
+        session-consistency mode — reads run OUTSIDE the busy lock
+        against a consistent batch snapshot, so gossip, writes, and
+        reads coexist.  Raises :class:`~crdt_tpu.error.
+        ConsistencyUnavailableError` on a terminal admission
+        rejection.  Returns the :class:`crdt_tpu.serve.ResultFrame`."""
+        if self._serve_loop is None:
+            from ..serve.loop import ServeLoop
+
+            self._serve_loop = ServeLoop(self)
+        return self._serve_loop.serve(request)
 
     def _drain_ops_locked(self) -> None:
         """Fold every queued op batch into the fleet — caller holds

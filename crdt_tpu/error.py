@@ -227,3 +227,20 @@ class TransportFrameError(TransportError):
     mismatch, unknown kind.  The receiver treats it exactly like frame
     loss (drop it; the sender's retransmit covers it), so this rarely
     escapes the transport."""
+
+
+class ConsistencyUnavailableError(CrdtError):
+    """A session-consistency admission could not be satisfied: a
+    read-your-writes / monotonic read parked past its deadline without
+    the node's visible clock covering the request's floor, or a
+    frontier-stable read arrived at a node with no stability frontier
+    yet (:mod:`crdt_tpu.serve.consistency`).  Typed so a client can
+    distinguish "retry / downgrade the mode" from a protocol fault —
+    the serve loop rejects loudly rather than silently serving a
+    weaker read."""
+
+    def __init__(self, message: str, *, mode: str = "",
+                 reason: str = ""):
+        super().__init__(message)
+        self.mode = mode
+        self.reason = reason

@@ -295,10 +295,31 @@ class MetricsServer:
             cap = self._capacity if self._capacity is not None \
                 else capacity_mod.capacity_tracker()
             wm = cap.watermark()
+            # the read front-end's vitals ride liveness too: an operator
+            # diagnosing "reads are failing" wants the admit/park/reject
+            # split from the same curl that answers "is it up".  Totals
+            # only — the per-mode breakdown stays on /metrics.
+            reg = self._registry if self._registry is not None \
+                else metrics.registry()
+            counters = reg.counters_snapshot()
+
+            def _fam(prefix: str) -> int:
+                return sum(v for k, v in counters.items()
+                           if k.startswith(prefix))
+
             body = json.dumps({
                 "status": wm["state"],
                 "uptime_s": round(time.monotonic() - self._t0, 3),
                 "capacity": wm,
+                "serve": {
+                    "reads": counters.get("serve.reads", 0),
+                    "batches": counters.get("serve.batches", 0),
+                    "admitted": _fam("serve.admit."),
+                    "parked": _fam("serve.park."),
+                    "rejected": _fam("serve.reject."),
+                    "not_stable_rows": counters.get(
+                        "serve.not_stable_rows", 0),
+                },
             }).encode()
             return body, "application/json", 200
         return (b"not found (try /metrics, /events, /fleet, /kernels, "

@@ -431,6 +431,41 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "native kernel invocations per entry point"),
     NameSpec("native.engine.*.objects", "counter",
              "objects processed per native entry point"),
+    # -- the read front-end (crdt_tpu/serve) ---------------------------------
+    NameSpec("serve.reads", "counter",
+             "rows resolved by the gather engine (one per read row)"),
+    NameSpec("serve.batches", "counter", "read batches gathered"),
+    NameSpec("serve.batch_depth", "gauge",
+             "decoded read batches staged ahead of the gather "
+             "(the serve loop's bounded decode queue)"),
+    NameSpec("serve.admit.*", "counter",
+             "admitted read batches by consistency mode "
+             "(eventual/ryw/monotonic/frontier)"),
+    NameSpec("serve.park.*", "counter",
+             "read batches that parked awaiting visibility, by mode"),
+    NameSpec("serve.reject.*", "counter",
+             "read batches terminally rejected by admission, by mode "
+             "(the typed ConsistencyUnavailableError)"),
+    NameSpec("serve.not_stable_rows", "counter",
+             "frontier-mode rows above the stability frontier "
+             "(stamped ST_NOT_STABLE instead of served as stable)"),
+    NameSpec("serve.stalls", "counter",
+             "serve-loop gather waits past the stall threshold "
+             "(decode thread behind)"),
+    NameSpec("serve.reads_per_s", "gauge",
+             "rows/s of the most recent served batch"),
+    NameSpec("serve.read_latency", "histogram",
+             "per-batch serve wall (admission park included)"),
+    NameSpec("serve.park_wait", "histogram",
+             "admission park wall per parked batch"),
+    NameSpec("serve.frames.decoded", "counter", "accepted serve frames"),
+    NameSpec("serve.frames.rejected.*", "counter",
+             "rejected serve frames by reason (truncated/"
+             "version_mismatch/bad_kind/...)"),
+    NameSpec("wire.serve.*.ops", "counter",
+             "read rows per serve wire direction (encode/decode)"),
+    NameSpec("wire.serve.*.bytes", "counter",
+             "serve frame bytes per direction"),
     # -- pipelined wire loop (batch/wireloop.py) -----------------------------
     NameSpec("wireloop.stalls", "counter",
              "folds that waited on the parse thread past the threshold"),

@@ -1,0 +1,65 @@
+"""The batched read front-end: ``ReadCtx`` at serve scale.
+
+The write half of the reference's client protocol lives in
+:mod:`crdt_tpu.oplog`; this package is the read half — jitted gather
+kernels resolving thousands of ``(object, kind)`` reads per step
+straight from the dense planes (:mod:`~crdt_tpu.serve.query`),
+session-consistency modes as admission predicates
+(:mod:`~crdt_tpu.serve.consistency`), a versioned+CRC frame codec
+(:mod:`~crdt_tpu.serve.wire`), and a pipelined serve loop wired into
+:class:`~crdt_tpu.cluster.gossip.ClusterNode`
+(:mod:`~crdt_tpu.serve.loop`).
+"""
+
+from .consistency import (
+    MODE_EVENTUAL,
+    MODE_FRONTIER,
+    MODE_MONOTONIC,
+    MODE_RYW,
+    MODES,
+    Admission,
+    admit,
+    covers,
+    stability_statuses,
+)
+from .loop import ServeLoop, visible_vv
+from .query import (
+    K_GCOUNTER,
+    K_LWW,
+    K_MAP,
+    K_MVREG,
+    K_ORSWOT,
+    K_PNCOUNTER,
+    KIND_NAMES,
+    NO_MEMBER,
+    ST_NOT_STABLE,
+    ST_OK,
+    QueryEngine,
+    ReadRequest,
+    ResultFrame,
+    gather,
+    infer_kind,
+    row_to_vclock,
+)
+from .wire import (
+    FRAME_READ,
+    FRAME_RESULT,
+    SERVE_PROTOCOL_VERSION,
+    decode_read_request,
+    decode_result_frame,
+    encode_read_request,
+    encode_result_frame,
+)
+
+__all__ = [
+    "MODE_EVENTUAL", "MODE_FRONTIER", "MODE_MONOTONIC", "MODE_RYW",
+    "MODES", "Admission", "admit", "covers", "stability_statuses",
+    "ServeLoop", "visible_vv",
+    "K_GCOUNTER", "K_LWW", "K_MAP", "K_MVREG", "K_ORSWOT", "K_PNCOUNTER",
+    "KIND_NAMES", "NO_MEMBER", "ST_NOT_STABLE", "ST_OK",
+    "QueryEngine", "ReadRequest", "ResultFrame", "gather", "infer_kind",
+    "row_to_vclock",
+    "FRAME_READ", "FRAME_RESULT", "SERVE_PROTOCOL_VERSION",
+    "decode_read_request", "decode_result_frame",
+    "encode_read_request", "encode_result_frame",
+]

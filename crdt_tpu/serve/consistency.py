@@ -1,0 +1,126 @@
+"""Session-consistency modes as admission predicates over clock floors.
+
+A serve path without consistency choices silently serves the weakest
+read everywhere; this module makes each mode an explicit, *cheap*
+predicate over version vectors — no locks, no coordination, exactly
+the quantities the observatories already publish:
+
+============== ====================================================
+``eventual``    always admitted — whatever the snapshot holds.
+``ryw``         read-your-writes: the request carries the writer's
+                ack version vector (``ClusterNode.write_vv`` after
+                ``submit_writes``); admitted once the node's visible
+                clock covers it.  A not-yet-visible request parks
+                briefly (the serve loop re-polls while nudging the op
+                drain) and then rejects loudly with
+                :class:`~crdt_tpu.error.ConsistencyUnavailableError`.
+``monotonic``   monotonic reads: the request carries the token of the
+                client's last result frame; admitted once visible ≥
+                token, so a client hopping replicas can never watch a
+                clock regress.
+``frontier``    frontier-stable: keyed on the PR 15 stability
+                frontier (:mod:`crdt_tpu.obs.stability`).  A
+                frontier-covered row is provably converged on every
+                peer that contributed evidence — it can never change
+                under any future merge — so it is served LOCK-FREE
+                from any replica with zero coordination.  Rows whose
+                add clock exceeds their subtree's frontier are
+                stamped ``ST_NOT_STABLE`` instead of lying.
+============== ====================================================
+
+Version vectors compare zero-padded (implied-0 counters, the
+`vclock.rs:206-210` rule), so a narrow client floor never spuriously
+blocks against a wider plane.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from .query import ST_NOT_STABLE, ST_OK
+
+MODE_EVENTUAL = "eventual"
+MODE_RYW = "ryw"
+MODE_MONOTONIC = "monotonic"
+MODE_FRONTIER = "frontier"
+
+MODES = (MODE_EVENTUAL, MODE_RYW, MODE_MONOTONIC, MODE_FRONTIER)
+
+#: wire code per mode (and back) — the request frame's ``mode`` byte
+MODE_CODES = {m: i for i, m in enumerate(MODES)}
+CODE_MODES = {i: m for i, m in enumerate(MODES)}
+
+
+def _pad(v: np.ndarray, width: int) -> np.ndarray:
+    v = np.asarray(v, np.uint64).reshape(-1)
+    if v.size < width:
+        v = np.concatenate([v, np.zeros(width - v.size, np.uint64)])
+    return v
+
+
+def covers(visible, require) -> bool:
+    """``visible >= require`` pointwise after zero-padding — the one
+    comparison every admission rides."""
+    if require is None:
+        return True
+    require = np.asarray(require, np.uint64).reshape(-1)
+    if require.size == 0:
+        return True
+    visible = np.asarray(visible, np.uint64).reshape(-1)
+    w = max(visible.size, require.size)
+    return bool((_pad(visible, w) >= _pad(require, w)).all())
+
+
+class Admission(NamedTuple):
+    """One admission ruling: admitted, or why not (``not_visible`` —
+    park-eligible; ``no_frontier`` — terminal)."""
+
+    admitted: bool
+    reason: Optional[str] = None
+
+
+def admit(mode: str, require, visible_vv, frontier_vv=None) -> Admission:
+    """Rule on one read batch.  Pure — the serve loop owns parking,
+    counters, and the typed raise."""
+    if mode not in MODES:
+        raise ValueError(f"unknown consistency mode {mode!r} "
+                         f"(modes: {MODES})")
+    if mode == MODE_EVENTUAL:
+        return Admission(True)
+    if mode == MODE_FRONTIER:
+        if frontier_vv is None:
+            return Admission(False, "no_frontier")
+        return Admission(True)
+    # ryw / monotonic: one VV comparison
+    if covers(visible_vv, require):
+        return Admission(True)
+    return Admission(False, "not_visible")
+
+
+def stability_statuses(frame, subtree_clocks, span: int) -> np.ndarray:
+    """Per-row frontier coverage for a gathered frame: rows whose add
+    clock is at-or-below their subtree's frontier clock are ``ST_OK``
+    (provably converged — `obs/stability.py`); the rest are
+    ``ST_NOT_STABLE``.  Returns the uint8 status column (the caller
+    stamps it into the frame)."""
+    b = len(frame)
+    if b == 0 or subtree_clocks is None:
+        return np.zeros(b, np.uint8)
+    subtree_clocks = np.asarray(subtree_clocks, np.uint64)
+    span = max(int(span), 1)
+    sub = np.minimum(frame.obj // span, subtree_clocks.shape[0] - 1)
+    floor = subtree_clocks[sub]                       # [B, Wf]
+    add = np.asarray(frame.add_clock, np.uint64)      # [B, W]
+    w = max(add.shape[1], floor.shape[1])
+
+    def widen(m):
+        if m.shape[1] < w:
+            m = np.concatenate(
+                [m, np.zeros((m.shape[0], w - m.shape[1]), np.uint64)],
+                axis=1)
+        return m
+
+    ok = (widen(add) <= widen(floor)).all(axis=1)
+    return np.where(ok, ST_OK, ST_NOT_STABLE).astype(np.uint8)

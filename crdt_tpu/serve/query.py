@@ -1,0 +1,517 @@
+"""Batched reads: jitted gather kernels over the dense planes (L2).
+
+The reference's client protocol is a read-modify-write loop anchored on
+``ReadCtx { add_clock, rm_clock, val }`` (`ctx.rs:12-21`): every read
+returns the causal metadata a client needs to derive its next
+:class:`~crdt_tpu.scalar.ctx.AddCtx` / :class:`~crdt_tpu.scalar.ctx.
+RmCtx`.  The scalar module does this one object at a time with dict
+clones; at serve scale a read batch is thousands of ``(object, kind)``
+rows per step, so this module resolves whole batches with ONE jitted
+gather per CRDT kind, straight from the dense planes:
+
+* ORSWOT — ``contains(member)`` (rm clock = the member's witnessing
+  dots row, `orswot.rs:214-224`) and ``value()`` (rm clock = the set
+  clock, `orswot.rs:227-233`; ``member = NO_MEMBER`` selects it),
+* G/PN counters — row sums with the count plane as both clocks (the
+  plane IS the AddCtx base the op path derives against),
+* LWW registers — value + marker, clockless,
+* MV registers — per-slot values + the folded register clock
+  (`mvreg.rs:201-222`),
+* Maps — ``get(key)`` / ``len()`` (`map.rs:282-302`).
+
+Results land in a columnar :class:`ResultFrame`, every row stamped
+with the add/rm clocks — parity-pinned row-for-row against the scalar
+``ReadCtx`` loop (tests/test_serve.py), so a remove derived from a
+gathered row is byte-identical to one derived from a scalar clone.
+
+Batch sizes pad to the next power of two (floor :data:`PAD_FLOOR`) so
+the jit cache walks a log-bounded ladder, the same discipline as the
+op-path scatter (`oplog/apply.py`).  Every jit site here has a
+manifest row (``serve.gather.*``, `analysis/kernels.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils import tracing
+
+#: read kinds — the ``kind`` column of a read batch.  Disjoint small
+#: ints so mixed-kind batches stay columnar on the wire.
+K_ORSWOT = 0
+K_GCOUNTER = 1
+K_PNCOUNTER = 2
+K_LWW = 3
+K_MVREG = 4
+K_MAP = 5
+
+KIND_NAMES = {
+    K_ORSWOT: "orswot", K_GCOUNTER: "gcounter", K_PNCOUNTER: "pncounter",
+    K_LWW: "lww", K_MVREG: "mvreg", K_MAP: "map",
+}
+READ_KINDS = tuple(sorted(KIND_NAMES))
+
+#: ``member`` column sentinel: a whole-object read — ORSWOT ``value()``
+#: / map ``len()`` — instead of a membership/key probe.
+NO_MEMBER = -1
+
+#: per-row result statuses (consistency post-filters write these)
+ST_OK = 0
+ST_NOT_STABLE = 1
+STATUSES = (ST_OK, ST_NOT_STABLE)
+
+#: smallest padded gather batch — below this every batch shares one
+#: lowering
+PAD_FLOOR = 8
+
+
+def _next_pow2(b: int) -> int:
+    n = PAD_FLOOR
+    while n < b:
+        n <<= 1
+    return n
+
+
+def _pad_rows(obj: np.ndarray, member: Optional[np.ndarray] = None):
+    """Pad a read batch to the power-of-two ladder: object 0 /
+    ``NO_MEMBER`` filler rows (harmless gathers, sliced off after)."""
+    b = obj.shape[0]
+    bp = _next_pow2(b)
+    if bp != b:
+        obj = np.concatenate([obj, np.zeros(bp - b, obj.dtype)])
+        if member is not None:
+            member = np.concatenate(
+                [member, np.full(bp - b, NO_MEMBER, member.dtype)])
+    return obj, member, b
+
+
+@functools.lru_cache(maxsize=None)
+def _orswot_kernel():
+    """ONE jitted ORSWOT read gather: ``(clock[N,A], ids[N,M],
+    dots[N,M,A], obj[B], member[B])`` → per-row val, add clock row, rm
+    clock row, member-id row, and live-member count.  ``member >= 0``
+    rows are ``contains`` probes (rm = the matched slot's witnessing
+    dots, zeros when absent — the empty ``VClock()`` of
+    `orswot.rs:214-224`); ``NO_MEMBER`` rows are ``value()`` reads
+    (rm = the set clock)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.kernels import observed_kernel
+    from ..ops import orswot_ops
+
+    def kernel(clock, ids, dots, obj, member):
+        crow = jnp.take(clock, obj, axis=0)               # [B, A]
+        idrow = jnp.take(ids, obj, axis=0)                # [B, M]
+        dotrow = jnp.take(dots, obj, axis=0)              # [B, M, A]
+        want = member[:, None]
+        hit = (idrow == want) & (want >= 0) \
+            & (idrow != orswot_ops.EMPTY)                 # [B, M]
+        has = jnp.any(hit, axis=1)
+        # at most one slot matches (ids are unique per row), so a
+        # masked sum IS the member's witnessing clock
+        mclock = jnp.sum(
+            jnp.where(hit[:, :, None], dotrow, jnp.zeros_like(dotrow)),
+            axis=1)
+        value_read = member < jnp.int32(0)
+        rm = jnp.where(value_read[:, None], crow, mclock)
+        count = jnp.sum(idrow != orswot_ops.EMPTY, axis=1) \
+            .astype(jnp.uint64)
+        val = jnp.where(value_read, count, has.astype(jnp.uint64))
+        return val, crow, rm, idrow, count
+
+    return observed_kernel("serve.gather.orswot")(jax.jit(kernel))
+
+
+@functools.lru_cache(maxsize=None)
+def _counter_kernel():
+    """ONE jitted counter gather shared by G- and PN-counters:
+    ``(plane[N,W], obj[B])`` → row sums + the gathered rows (the
+    count plane is both the value and the AddCtx base,
+    `gcounter.rs:26-28`).  PN calls it once per sign plane."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.kernels import observed_kernel
+
+    def kernel(plane, obj):
+        row = jnp.take(plane, obj, axis=0)
+        return jnp.sum(row, axis=1), row
+
+    return observed_kernel("serve.gather.counter")(jax.jit(kernel))
+
+
+@functools.lru_cache(maxsize=None)
+def _lww_kernel():
+    """ONE jitted LWW gather: values + conflict markers (LWW carries
+    no causal clock — `lwwreg.rs` reads are marker-ordered)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.kernels import observed_kernel
+
+    def kernel(vals, markers, obj):
+        return jnp.take(vals, obj, axis=0), jnp.take(markers, obj, axis=0)
+
+    return observed_kernel("serve.gather.lww")(jax.jit(kernel))
+
+
+@functools.lru_cache(maxsize=None)
+def _mvreg_kernel():
+    """ONE jitted MV-register gather: per-slot values + slot clocks +
+    the folded register clock (`mvreg.rs:201-222` — read returns every
+    concurrent value under the join of their clocks)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.kernels import observed_kernel
+
+    def kernel(clocks, vals, obj):
+        c = jnp.take(clocks, obj, axis=0)                 # [B, K, A]
+        v = jnp.take(vals, obj, axis=0)                   # [B, K]
+        fold = jnp.max(c, axis=1)                         # [B, A]
+        live = jnp.any(c != 0, axis=2)                    # [B, K]
+        count = jnp.sum(live, axis=1).astype(jnp.uint64)
+        return v, c, fold, live, count
+
+    return observed_kernel("serve.gather.mvreg")(jax.jit(kernel))
+
+
+@functools.lru_cache(maxsize=None)
+def _map_kernel():
+    """ONE jitted map gather: ``get(key)`` rows (rm = the entry's
+    clock, zeros when absent — `map.rs:291-302`) and ``len()`` rows
+    (``NO_MEMBER``; add = rm = the map clock, `map.rs:282-288`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs.kernels import observed_kernel
+
+    def kernel(clock, keys, eclocks, obj, key):
+        crow = jnp.take(clock, obj, axis=0)               # [B, A]
+        krow = jnp.take(keys, obj, axis=0)                # [B, K]
+        erow = jnp.take(eclocks, obj, axis=0)             # [B, K, A]
+        want = key[:, None]
+        hit = (krow == want) & (want >= 0)
+        has = jnp.any(hit, axis=1)
+        eclk = jnp.sum(
+            jnp.where(hit[:, :, None], erow, jnp.zeros_like(erow)),
+            axis=1)
+        len_read = key < jnp.int32(0)
+        count = jnp.sum(krow >= 0, axis=1).astype(jnp.uint64)
+        rm = jnp.where(len_read[:, None], crow, eclk)
+        val = jnp.where(len_read, count, has.astype(jnp.uint64))
+        return val, crow, rm, count
+
+    return observed_kernel("serve.gather.map")(jax.jit(kernel))
+
+
+@dataclasses.dataclass
+class ReadRequest:
+    """One columnar read batch: ``(object, kind)`` rows plus an
+    optional member/key probe column and a session-consistency mode
+    (:mod:`crdt_tpu.serve.consistency`).  ``require`` is the mode's
+    clock floor — a writer's ack version vector for read-your-writes,
+    the client's held token for monotonic reads."""
+
+    obj: np.ndarray                     # int64[B]
+    kind: np.ndarray                    # uint8[B] (READ_KINDS)
+    member: np.ndarray                  # int32[B]; NO_MEMBER = whole-object
+    mode: str = "eventual"
+    require: Optional[np.ndarray] = None  # uint64[W] version-vector floor
+
+    def __post_init__(self):
+        self.obj = np.asarray(self.obj, np.int64).reshape(-1)
+        self.kind = np.broadcast_to(
+            np.asarray(self.kind, np.uint8), self.obj.shape).copy()
+        self.member = np.broadcast_to(
+            np.asarray(self.member, np.int32), self.obj.shape).copy()
+        if self.require is not None:
+            self.require = np.asarray(self.require, np.uint64).reshape(-1)
+
+    def __len__(self) -> int:
+        return int(self.obj.shape[0])
+
+    @classmethod
+    def reads(cls, obj, *, kind: int = K_ORSWOT, member=NO_MEMBER,
+              mode: str = "eventual", require=None) -> "ReadRequest":
+        return cls(obj=np.asarray(obj, np.int64).reshape(-1), kind=kind,
+                   member=member, mode=mode, require=require)
+
+
+@dataclasses.dataclass
+class ResultFrame:
+    """The columnar answer to a :class:`ReadRequest`: echoed keys, a
+    per-row status, the value column, and the add/rm clock rows —
+    exactly the scalar ``ReadCtx`` triple, batched.  ``token`` is the
+    monotonic-reads clock token (the version vector of the snapshot
+    every row was gathered from); a client hands it back as the next
+    request's ``require``.  ``extras`` carries per-kind columns that
+    never ride the wire (ORSWOT member rows, MV slot values/clocks)."""
+
+    obj: np.ndarray                     # int64[B]
+    kind: np.ndarray                    # uint8[B]
+    member: np.ndarray                  # int32[B]
+    status: np.ndarray                  # uint8[B] (ST_*)
+    val: np.ndarray                     # uint64[B]
+    add_clock: np.ndarray               # uint64[B, W]
+    rm_clock: np.ndarray                # uint64[B, W]
+    token: np.ndarray                   # uint64[W]
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __len__(self) -> int:
+        return int(self.obj.shape[0])
+
+    def read_ctx(self, i: int, universe=None):
+        """Row ``i`` as a scalar :class:`~crdt_tpu.scalar.ctx.ReadCtx`
+        — the bridge back into the reference's clone-derive-apply loop
+        (``derive_add_ctx`` / ``derive_rm_ctx`` work unchanged)."""
+        from ..scalar.ctx import ReadCtx
+
+        return ReadCtx(
+            add_clock=row_to_vclock(self.add_clock[i], universe),
+            rm_clock=row_to_vclock(self.rm_clock[i], universe),
+            val=int(self.val[i]),
+        )
+
+
+def row_to_vclock(row, universe=None):
+    """A dense clock row as a scalar :class:`~crdt_tpu.scalar.vclock.
+    VClock` (actor names resolved through ``universe.actors`` when
+    given, dense column indices otherwise — the identity-universe
+    convention every test fleet uses)."""
+    from ..scalar.vclock import VClock
+
+    row = np.asarray(row, np.uint64).reshape(-1)
+    vc = VClock()
+    for i in np.nonzero(row)[0]:
+        name = universe.actors.lookup(int(i)) if universe is not None \
+            else int(i)
+        vc.dots[name] = int(row[i])
+    return vc
+
+
+def _gather_orswot(batch, obj, member):
+    import jax.numpy as jnp
+
+    obj_p, mem_p, b = _pad_rows(obj, member)
+    val, add, rm, ids, count = _orswot_kernel()(
+        batch.clock, batch.ids, batch.dots,
+        jnp.asarray(obj_p), jnp.asarray(mem_p))
+    return (np.asarray(val, np.uint64)[:b],
+            np.asarray(add, np.uint64)[:b],
+            np.asarray(rm, np.uint64)[:b],
+            {"members": np.asarray(ids, np.int32)[:b],
+             "count": np.asarray(count, np.uint64)[:b]})
+
+
+def _gather_gcounter(batch, obj, member):
+    import jax.numpy as jnp
+
+    obj_p, _, b = _pad_rows(obj)
+    val, row = _counter_kernel()(batch.clocks, jnp.asarray(obj_p))
+    row = np.asarray(row, np.uint64)[:b]
+    return np.asarray(val, np.uint64)[:b], row, row.copy(), {}
+
+
+def _gather_pncounter(batch, obj, member):
+    import jax.numpy as jnp
+
+    obj_p, _, b = _pad_rows(obj)
+    kern = _counter_kernel()
+    jobj = jnp.asarray(obj_p)
+    p_sum, p_row = kern(batch.planes[:, 0, :], jobj)
+    n_sum, n_row = kern(batch.planes[:, 1, :], jobj)
+    p_sum = np.asarray(p_sum, np.uint64)[:b]
+    n_sum = np.asarray(n_sum, np.uint64)[:b]
+    # P − N in two's complement (`pncounter.rs:117-119`; reinterpret as
+    # int64 for the signed value)
+    val = p_sum - n_sum
+    clock = np.concatenate(
+        [np.asarray(p_row, np.uint64)[:b], np.asarray(n_row, np.uint64)[:b]],
+        axis=1)  # [B, 2A] — the _clock_plane flattening convention
+    return val, clock, clock.copy(), {"p": p_sum, "n": n_sum}
+
+
+def _gather_lww(batch, obj, member):
+    import jax.numpy as jnp
+
+    obj_p, _, b = _pad_rows(obj)
+    vals, markers = _lww_kernel()(batch.vals, batch.markers,
+                                  jnp.asarray(obj_p))
+    zeros = np.zeros((b, 0), np.uint64)  # clockless
+    return (np.asarray(vals, np.uint64)[:b], zeros, zeros.copy(),
+            {"marker": np.asarray(markers, np.uint64)[:b]})
+
+
+def _gather_mvreg(batch, obj, member):
+    import jax.numpy as jnp
+
+    obj_p, _, b = _pad_rows(obj)
+    vals, clocks, fold, live, count = _mvreg_kernel()(
+        batch.clocks, batch.vals, jnp.asarray(obj_p))
+    fold = np.asarray(fold, np.uint64)[:b]
+    return (np.asarray(count, np.uint64)[:b], fold, fold.copy(),
+            {"mv_vals": np.asarray(vals)[:b],
+             "mv_clocks": np.asarray(clocks, np.uint64)[:b],
+             "mv_live": np.asarray(live, bool)[:b]})
+
+
+def _gather_map(batch, obj, member):
+    import jax.numpy as jnp
+
+    obj_p, key_p, b = _pad_rows(obj, member)
+    val, add, rm, count = _map_kernel()(
+        batch.clock, batch.keys, batch.entry_clocks,
+        jnp.asarray(obj_p), jnp.asarray(key_p))
+    return (np.asarray(val, np.uint64)[:b],
+            np.asarray(add, np.uint64)[:b],
+            np.asarray(rm, np.uint64)[:b],
+            {"count": np.asarray(count, np.uint64)[:b]})
+
+
+_GATHERS = {
+    K_ORSWOT: _gather_orswot,
+    K_GCOUNTER: _gather_gcounter,
+    K_PNCOUNTER: _gather_pncounter,
+    K_LWW: _gather_lww,
+    K_MVREG: _gather_mvreg,
+    K_MAP: _gather_map,
+}
+
+
+def infer_kind(batch) -> int:
+    """The read kind of a dense batch by type."""
+    from ..batch.gcounter_batch import GCounterBatch
+    from ..batch.lwwreg_batch import LWWRegBatch
+    from ..batch.map_batch import MapBatch
+    from ..batch.mvreg_batch import MVRegBatch
+    from ..batch.orswot_batch import OrswotBatch
+    from ..batch.pncounter_batch import PNCounterBatch
+
+    for cls, kind in ((OrswotBatch, K_ORSWOT), (GCounterBatch, K_GCOUNTER),
+                      (PNCounterBatch, K_PNCOUNTER), (LWWRegBatch, K_LWW),
+                      (MVRegBatch, K_MVREG), (MapBatch, K_MAP)):
+        if isinstance(batch, cls):
+            return kind
+    raise TypeError(
+        f"no serve gather for {type(batch).__name__} "
+        f"(served kinds: {sorted(KIND_NAMES.values())})"
+    )
+
+
+def gather(batch, obj, *, member=None, kind: Optional[int] = None
+           ) -> ResultFrame:
+    """Resolve one single-kind read batch against ``batch`` — one
+    jitted gather regardless of batch size.  ``member`` probes
+    membership (ORSWOT) / keys (map); ``NO_MEMBER`` rows read the
+    whole object.  The frame's ``token`` is left empty — the serve
+    loop stamps it from the snapshot's version vector."""
+    obj = np.asarray(obj, np.int64).reshape(-1)
+    if kind is None:
+        kind = infer_kind(batch)
+    if kind not in _GATHERS:
+        raise ValueError(f"unknown read kind {kind}")
+    member = np.full(obj.shape, NO_MEMBER, np.int32) if member is None \
+        else np.broadcast_to(np.asarray(member, np.int32), obj.shape).copy()
+    b = obj.shape[0]
+    n = _plane_rows(batch, kind)
+    if b and (obj.min() < 0 or obj.max() >= n):
+        raise IndexError(
+            f"read object {int(obj.min()) if obj.min() < 0 else int(obj.max())} "
+            f"outside the fleet's dense axis [0, {n})"
+        )
+    if b == 0:
+        val = np.zeros(0, np.uint64)
+        add = rm = np.zeros((0, 0), np.uint64)
+        extras = {}
+    else:
+        val, add, rm, extras = _GATHERS[kind](batch, obj, member)
+    tracing.count("serve.reads", b)
+    tracing.count("serve.batches")
+    return ResultFrame(
+        obj=obj, kind=np.full(b, kind, np.uint8), member=member,
+        status=np.zeros(b, np.uint8), val=val,
+        add_clock=add, rm_clock=rm,
+        token=np.zeros(0, np.uint64), extras=extras,
+    )
+
+
+def _plane_rows(batch, kind: int) -> int:
+    plane = {K_ORSWOT: "clock", K_GCOUNTER: "clocks", K_PNCOUNTER: "planes",
+             K_LWW: "vals", K_MVREG: "vals", K_MAP: "clock"}[kind]
+    return int(getattr(batch, plane).shape[0])
+
+
+class QueryEngine:
+    """Mixed-kind read batches over a set of dense batches — one
+    gather per kind present, scattered back into one frame (the
+    columnar ``(object, kind)`` dispatch of the serve path).  Holds
+    ``{kind: batch}``; a bare batch serves its own kind only."""
+
+    def __init__(self, batches):
+        if not isinstance(batches, dict):
+            batches = {infer_kind(batches): batches}
+        for k in batches:
+            if k not in _GATHERS:
+                raise ValueError(f"unknown read kind {k}")
+        self.batches = dict(batches)
+
+    def width(self) -> int:
+        """The widest clock row any served kind produces."""
+        w = 0
+        for kind, batch in self.batches.items():
+            if kind == K_ORSWOT or kind == K_MAP:
+                w = max(w, int(batch.clock.shape[1]))
+            elif kind == K_GCOUNTER:
+                w = max(w, int(batch.clocks.shape[1]))
+            elif kind == K_PNCOUNTER:
+                w = max(w, int(batch.planes.shape[1] * batch.planes.shape[2]))
+            elif kind == K_MVREG:
+                w = max(w, int(batch.clocks.shape[2]))
+        return w
+
+    def gather(self, obj, kind=None, member=None) -> ResultFrame:
+        obj = np.asarray(obj, np.int64).reshape(-1)
+        b = obj.shape[0]
+        if kind is None:
+            if len(self.batches) != 1:
+                raise ValueError(
+                    "a mixed-kind engine needs an explicit kind column")
+            kind = next(iter(self.batches))
+        kind = np.broadcast_to(np.asarray(kind, np.uint8), obj.shape).copy()
+        member = np.full(obj.shape, NO_MEMBER, np.int32) if member is None \
+            else np.broadcast_to(np.asarray(member, np.int32),
+                                 obj.shape).copy()
+        present = np.unique(kind)
+        missing = [int(k) for k in present if int(k) not in self.batches]
+        if missing:
+            raise ValueError(
+                f"read batch names unserved kinds {missing} "
+                f"(served: {sorted(self.batches)})"
+            )
+        w = self.width()
+        val = np.zeros(b, np.uint64)
+        add = np.zeros((b, w), np.uint64)
+        rm = np.zeros((b, w), np.uint64)
+        extras: Dict[str, Any] = {}
+        for k in present:
+            idx = np.nonzero(kind == k)[0]
+            sub = gather(self.batches[int(k)], obj[idx],
+                         member=member[idx], kind=int(k))
+            val[idx] = sub.val
+            wk = sub.add_clock.shape[1]
+            add[idx, :wk] = sub.add_clock
+            rm[idx, :wk] = sub.rm_clock
+            for name, col in sub.extras.items():
+                extras.setdefault(name, {})[int(k)] = (idx, col)
+        return ResultFrame(
+            obj=obj, kind=kind, member=member,
+            status=np.zeros(b, np.uint8), val=val,
+            add_clock=add, rm_clock=rm,
+            token=np.zeros(0, np.uint64), extras=extras,
+        )

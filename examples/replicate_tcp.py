@@ -277,7 +277,8 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
 def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 divergence: float, max_sweeps: int = 20,
                 fleet_port: int | None = None, ops_rate: int = 0,
-                ops_sweeps: int = 3, gc_enabled: bool = False,
+                ops_sweeps: int = 3, reads_rate: int = 0,
+                gc_enabled: bool = False,
                 gc_interval: int = 1, gc_hysteresis: float = 0.5,
                 digest_tree: bool = False, zipf_s: float = 0.0,
                 burst_len: int = 1, durable_dir: str | None = None,
@@ -307,6 +308,17 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
     writes stop, the fleet must still converge to byte-identical digest
     vectors — the mixed op+state acceptance shape (PERF.md "Op-based
     replication").
+
+    ``--reads R`` adds the READ half of the client protocol
+    (:mod:`crdt_tpu.serve`): R live reads per sweep land on random
+    nodes WHILE gossip reconciles — each injection writes a probe
+    member through ``submit_writes``, takes the ack floor
+    (``write_vv``), and reads it straight back under read-your-writes
+    (a violation is an assertion, not a statistic), plus monotonic
+    reads whose returned tokens must never regress per node and
+    frontier-stable reads tallying per-row stability against the PR 15
+    frontier.  At quiescence a final frontier-mode read on every node
+    must come back all-rows-stable (PERF.md "Read front-end").
 
     ``--durable DIR`` arms every node with a :class:`crdt_tpu.durable.
     Durability` manager (WAL-ahead ingest + a checkpoint at every
@@ -388,7 +400,8 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             # op front-end armed up front so sessions advertise the
             # piggyback capability from the first hello (always armed
             # in durable mode — the WAL rides the op ingest path)
-            oplog=OpLog(uni) if (ops_rate or durable_dir) else None,
+            oplog=OpLog(uni) if (ops_rate or reads_rate or durable_dir)
+            else None,
             gc=gc_engine,
             # sync protocol v3: sessions compare digest-tree roots and
             # descend into diverged subtrees instead of shipping the
@@ -526,6 +539,87 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             )
             total_ops += cnt
 
+    # the read front-end (crdt_tpu.serve): its own key stream (so
+    # toggling --reads never perturbs the write keys) and a per-node
+    # ServeLoop with a generous park — a gossip session can hold the
+    # node's fold lock for a while, and a parked RYW read waiting it
+    # out is the designed behaviour, not a failure
+    from crdt_tpu.error import ConsistencyUnavailableError
+    from crdt_tpu.serve import ST_OK, ReadRequest, ServeLoop
+
+    read_rng = np.random.RandomState(2424)
+    read_gen = WorkloadGen(n_objects, seed=2424, zipf_s=zipf_s)
+    serve_loops: dict = {}  # keyed by node OBJECT: restarts get fresh
+    mono_tokens: dict = {}
+    read_stats = {"reads": 0, "ryw": 0, "ryw_parked_out": 0, "mono": 0,
+                  "frontier_ok": 0, "frontier_not_stable": 0,
+                  "frontier_unformed": 0}
+
+    def serve_on(i, req):
+        node = nodes[i]
+        loop = serve_loops.get(node)
+        if loop is None:
+            loop = ServeLoop(node, park_timeout_s=5.0)
+            serve_loops[node] = loop
+        return loop.serve(req)
+
+    def inject_reads(r):
+        """R live reads into random nodes, mid-round, one batch per
+        mode.  Read-your-writes is probed end-to-end: write a marker
+        member through the node's own front-end, take the ack floor
+        (``write_vv`` — batch clock + everything parked in the log),
+        and require the read to be admitted at or above it; an admitted
+        read that misses the member is a protocol violation and dies
+        loudly right here."""
+        nonlocal total_ops
+        per_node = np.bincount(
+            read_rng.randint(0, n_peers, r), minlength=n_peers)
+        for i, cnt in enumerate(per_node):
+            node = nodes[i]
+            if not cnt or node is None:
+                continue
+            # (1) read-your-writes on a fresh acknowledged write
+            key = read_gen.draw(1)
+            member = np.array([180 + i], np.int32)
+            node.submit_writes(key, member, actor=i + 1)
+            total_ops += 1
+            ack = node.write_vv()
+            try:
+                frame = serve_on(i, ReadRequest.reads(
+                    key, member=member, mode="ryw", require=ack))
+                assert int(frame.val[0]) == 1, (
+                    f"{node.node_id}: read-your-writes VIOLATED — "
+                    f"admitted ryw read of obj {int(key[0])} does not "
+                    f"see acknowledged member {int(member[0])}"
+                )
+                read_stats["ryw"] += 1
+            except ConsistencyUnavailableError:
+                # parked past the timeout behind a long fold lock —
+                # loud and typed, never a silent stale read
+                read_stats["ryw_parked_out"] += 1
+            # (2) monotonic reads: the returned token may never regress
+            keys = read_gen.draw(int(cnt))
+            tok = mono_tokens.get(node)
+            frame = serve_on(i, ReadRequest.reads(
+                keys, mode="monotonic", require=tok))
+            if tok is not None:
+                assert np.all(frame.token >= tok), (
+                    f"{node.node_id}: monotonic token REGRESSED "
+                    f"{tok.tolist()} -> {frame.token.tolist()}"
+                )
+            mono_tokens[node] = frame.token
+            read_stats["mono"] += int(cnt)
+            # (3) frontier-stable reads: tally per-row stability
+            try:
+                frame = serve_on(i, ReadRequest.reads(
+                    read_gen.draw(int(cnt)), mode="frontier"))
+                ok = int((frame.status == ST_OK).sum())
+                read_stats["frontier_ok"] += ok
+                read_stats["frontier_not_stable"] += len(frame) - ok
+            except ConsistencyUnavailableError:
+                read_stats["frontier_unformed"] += int(cnt)
+            read_stats["reads"] += 1 + 2 * int(cnt)
+
     victim = 1 if (durable_dir is not None and n_peers >= 2) else None
     killed_at = None
     rejoin_baseline = None
@@ -639,8 +733,11 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                     and sweeps == killed_at + 1:
                 restart_victim()
             writing = ops_rate and sweeps <= ops_sweeps
+            reading = reads_rate and sweeps <= ops_sweeps
             if writing:
                 inject_writes(ops_rate)
+            if reading:
+                inject_reads(reads_rate)
             for sched in scheds:
                 if sched is None:
                     continue  # the victim is down this sweep
@@ -648,6 +745,10 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                     # writes land between (and during) rounds, not just
                     # at sweep boundaries — the live-traffic shape
                     inject_writes(max(1, ops_rate // n_peers))
+                if reading:
+                    # reads interleave with the gossip rounds too, so
+                    # admission races real fold-lock contention
+                    inject_reads(max(1, reads_rate // n_peers))
                 sched.run_round()
             live = [n for n in nodes if n is not None]
             digests = [n.digest() for n in live]
@@ -666,7 +767,7 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
             # frontier additionally has to SETTLE (every observer
             # re-converged with every peer), so the final state's
             # frontier == fleet-VV-min identity below is assertable
-            if converged and not writing:
+            if converged and not writing and not reading:
                 settled = frontier_settled(live)
                 if settled:
                     break
@@ -701,6 +802,35 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         assert not converged or all(
             len(n._oplog) == 0 for n in nodes if n._oplog is not None
         ), "converged with undrained op logs"
+
+    if reads_rate:
+        print(
+            f"reads: {read_stats['reads']} live reads served while "
+            f"gossip ran — ryw probes {read_stats['ryw']} "
+            f"(0 violations; {read_stats['ryw_parked_out']} parked out "
+            f"behind the fold lock), monotonic {read_stats['mono']} "
+            f"(0 token regressions), frontier-stable rows "
+            f"{read_stats['frontier_ok']} ok / "
+            f"{read_stats['frontier_not_stable']} not-yet-stable / "
+            f"{read_stats['frontier_unformed']} before a frontier "
+            "formed", flush=True,
+        )
+        assert read_stats["ryw"] > 0, \
+            "--reads ran but no read-your-writes probe was admitted"
+        if converged and settled:
+            # at quiescence the frontier IS the fleet VV min, so a
+            # frontier-mode read of ANY row must come back stable
+            for i, node in enumerate(nodes):
+                if node is None:
+                    continue
+                frame = serve_on(i, ReadRequest.reads(
+                    np.arange(min(64, n_objects)), mode="frontier"))
+                assert bool((frame.status == ST_OK).all()), (
+                    f"{node.node_id}: rows still not-stable under a "
+                    "settled frontier"
+                )
+            print("reads: quiescent frontier-mode sweep all-rows-stable "
+                  "on every node", flush=True)
 
     # ONE merged fleet snapshot (every node's slice reached node 0 on
     # the gossip itself — no scraper, no federation) instead of N
@@ -903,6 +1033,14 @@ def main() -> int:
                          "front-end (crdt_tpu.oplog / submit_ops) WHILE "
                          "gossip runs, then assert the fleet still "
                          "converges after writes stop")
+    ap.add_argument("--reads", type=int, default=0, metavar="R",
+                    help="with --gossip: drive R live reads per sweep "
+                         "into random nodes through the batched read "
+                         "front-end (crdt_tpu.serve) WHILE gossip runs "
+                         "— read-your-writes asserted for every "
+                         "acknowledged probe write, monotonic tokens "
+                         "asserted never to regress, frontier-stable "
+                         "rows tallied against the stability frontier")
     ap.add_argument("--gc", action="store_true",
                     help="with --gossip: enable causal GC (crdt_tpu.gc) — "
                          "each node starts with burst-over-provisioned "
@@ -957,6 +1095,8 @@ def main() -> int:
             ap.error("--gossip needs N >= 2 peers")
         if args.ops < 0:
             ap.error("--ops needs R >= 0")
+        if args.reads < 0:
+            ap.error("--reads needs R >= 0")
         if args.kill_sweep < 1:
             ap.error("--kill-sweep needs K >= 1")
         if args.window is not None and args.window < 0:
@@ -964,7 +1104,8 @@ def main() -> int:
         return gossip_demo(args.gossip, args.objects, args.platform,
                            divergence=args.divergence,
                            fleet_port=args.fleet_port,
-                           ops_rate=args.ops, gc_enabled=args.gc,
+                           ops_rate=args.ops, reads_rate=args.reads,
+                           gc_enabled=args.gc,
                            gc_interval=args.gc_interval,
                            gc_hysteresis=args.gc_hysteresis,
                            digest_tree=args.digest_tree,

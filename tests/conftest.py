@@ -78,6 +78,12 @@ def pytest_configure(config):
         "stability — divergence aging, the fleet stability frontier, "
         "the runtime lattice auditor); tier-1 like `sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve: batched read front-end tests (crdt_tpu.serve — gather "
+        "kernels, session-consistency admission, read frame codec, "
+        "serve loop); tier-1 like `sync`",
+    )
 
 
 # -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------

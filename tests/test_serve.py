@@ -1,0 +1,489 @@
+"""Batched read front-end tests — gather kernels, session-consistency
+admission, the read frame codec, the serve loop (crdt_tpu/serve,
+ISSUE 17).
+
+The acceptance pins: (1) ONE jitted gather step resolves a ≥4k-read
+batch byte-identical — val, add-clock and rm-clock rows — to the
+scalar ``ReadCtx`` loop (`orswot.rs:60-83` read semantics); (2) the
+batched ReadCtx clocks feed ``derive_rm_ctx`` into removes
+byte-identical to the scalar clone-read-remove loop; (3) the
+consistency modes behave as admission predicates — read-your-writes
+parks/admits against the log-inclusive write clock, monotonic tokens
+never regress, frontier-stable reads stamp per-row stability against
+the PR 15 frontier and reject loudly (typed) when no frontier exists.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu import serve
+from crdt_tpu.batch import (
+    GCounterBatch,
+    LWWRegBatch,
+    MapBatch,
+    MVRegBatch,
+    OrswotBatch,
+    PNCounterBatch,
+)
+from crdt_tpu.cluster import ClusterNode
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.error import (
+    ConsistencyUnavailableError,
+    SyncProtocolError,
+    WireFormatError,
+)
+from crdt_tpu.obs import metrics as obs_metrics
+from crdt_tpu.oplog import OpApplier, OpLog, derive_rm_ctx
+from crdt_tpu.scalar.gcounter import GCounter
+from crdt_tpu.scalar.lwwreg import LWWReg
+from crdt_tpu.scalar.map import Map
+from crdt_tpu.scalar.mvreg import MVReg
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.scalar.pncounter import PNCounter
+from crdt_tpu.sync import digest as sync_digest
+from crdt_tpu.utils import tracing
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = pytest.mark.serve
+
+
+def _uni(num_actors=8, member_capacity=16, deferred_capacity=4):
+    return Universe.identity(CrdtConfig(
+        num_actors=num_actors, member_capacity=member_capacity,
+        deferred_capacity=deferred_capacity, counter_bits=32))
+
+
+def _orswot_fleet(n, seed, actors=4, members=24, removes=True):
+    """N scalar sets with real history: multi-actor adds and (opt)
+    removes, so witnessing clocks genuinely differ per member."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        s = Orswot()
+        for _ in range(rng.randint(2, 7)):
+            s.apply(s.add(int(rng.randint(0, members)),
+                          s.value().derive_add_ctx(int(
+                              rng.randint(0, actors)))))
+        if removes and rng.rand() < 0.5 and s.value().val:
+            m = sorted(s.value().val)[0]
+            s.apply(s.remove(m, s.value().derive_rm_ctx()))
+        out.append(s)
+    return out
+
+
+def _row(vc, width):
+    """Dense clock row from a scalar VClock under an identity universe
+    (actor names ARE dense columns)."""
+    r = np.zeros(width, np.uint64)
+    for actor, cnt in vc.dots.items():
+        r[int(actor)] = cnt
+    return r
+
+
+# ---------------------------------------------------------------------------
+# gather parity: the jitted kernels vs the scalar ReadCtx loop
+# ---------------------------------------------------------------------------
+
+
+def test_orswot_gather_4k_parity_one_step():
+    """THE acceptance bar: one gather resolves a 4096-read mixed
+    contains/value batch byte-identical to the scalar ReadCtx loop,
+    through ONE jitted kernel call."""
+    uni = _uni()
+    n, b = 64, 4096
+    sets = _orswot_fleet(n, seed=11)
+    batch = OrswotBatch.from_scalar(sets, uni)
+    rng = np.random.RandomState(12)
+    obj = rng.randint(0, n, b)
+    member = rng.randint(0, 30, b).astype(np.int32)
+    member[rng.rand(b) < 0.5] = serve.NO_MEMBER  # value() reads
+
+    before = obs_metrics.registry().counters_snapshot().get(
+        "kernel.serve_gather_orswot.calls", 0)
+    frame = serve.gather(batch, obj, member=member)
+    after = obs_metrics.registry().counters_snapshot().get(
+        "kernel.serve_gather_orswot.calls", 0)
+    assert after - before == 1, "a 4k batch must be ONE gather step"
+
+    assert len(frame) == b and frame.add_clock.shape == (b, 8)
+    a = frame.add_clock.shape[1]
+    for i in range(b):
+        s = sets[int(obj[i])]
+        if member[i] == serve.NO_MEMBER:
+            rc = s.value()
+            want = len(rc.val)
+        else:
+            rc = s.contains(int(member[i]))
+            want = int(bool(rc.val))
+        assert int(frame.val[i]) == want, i
+        assert np.array_equal(frame.add_clock[i], _row(rc.add_clock, a)), i
+        assert np.array_equal(frame.rm_clock[i], _row(rc.rm_clock, a)), i
+
+
+def test_counter_gather_parity():
+    uni = _uni()
+    rng = np.random.RandomState(3)
+    gcs = [GCounter() for _ in range(12)]
+    pns = [PNCounter() for _ in range(12)]
+    for g, p in zip(gcs, pns):
+        for _ in range(rng.randint(1, 8)):
+            a = int(rng.randint(0, 4))
+            g.apply(g.inc(a))
+            p.apply(p.inc(a))
+            if rng.rand() < 0.5:
+                p.apply(p.dec(a))
+    gb = GCounterBatch.from_scalar(gcs, uni)
+    pb = PNCounterBatch.from_scalar(pns, uni)
+    obj = rng.randint(0, 12, 64)
+    gf = serve.gather(gb, obj)
+    pf = serve.gather(pb, obj)
+    assert gf.kind[0] == serve.K_GCOUNTER
+    assert pf.kind[0] == serve.K_PNCOUNTER
+    for i, o in enumerate(obj):
+        assert int(gf.val[i]) == gcs[int(o)].value()
+        # PN value rides u64 wrap-around arithmetic: p - n mod 2^64
+        want = (pns[int(o)].value()) % (1 << 64)
+        assert int(pf.val[i]) == want
+    # the counter clock plane IS the AddCtx base: add == rm == row
+    assert np.array_equal(gf.add_clock, gf.rm_clock)
+
+
+def test_lww_mvreg_map_gather_parity():
+    from crdt_tpu.batch import MVRegKernel
+
+    uni = _uni()
+    rng = np.random.RandomState(5)
+    lws = [LWWReg() for _ in range(8)]
+    mvs = [MVReg() for _ in range(8)]
+    mps = [Map(MVReg) for _ in range(8)]
+    for i, (lw, mv, mp) in enumerate(zip(lws, mvs, mps)):
+        lw.update(int(rng.randint(0, 99)), i + 1)
+        mv.apply(mv.set(int(rng.randint(0, 99)),
+                        mv.read().derive_add_ctx(int(rng.randint(0, 4)))))
+        for _ in range(rng.randint(0, 3)):
+            v = int(rng.randint(0, 99))
+            mp.apply(mp.update(
+                int(rng.randint(0, 6)),
+                mp.len().derive_add_ctx(int(rng.randint(0, 4))),
+                lambda r, c, v=v: r.set(v, c)))
+    obj = rng.randint(0, 8, 32)
+    lf = serve.gather(LWWRegBatch.from_scalar(lws, uni), obj)
+    assert lf.add_clock.shape == (32, 0)  # clockless kind
+    mf = serve.gather(MVRegBatch.from_scalar(mvs, uni), obj)
+    key = rng.randint(-1, 6, 32).astype(np.int32)  # -1 = len() reads
+    pf = serve.gather(
+        MapBatch.from_scalar(mps, uni, MVRegKernel.from_config(uni.config)),
+        obj, member=key)
+    a = mf.add_clock.shape[1]
+    for i, o in enumerate(obj):
+        assert int(lf.val[i]) == lws[int(o)].val
+        rc = mvs[int(o)].read()
+        assert int(mf.val[i]) == len(rc.val)  # live concurrent slots
+        assert np.array_equal(mf.add_clock[i], _row(rc.add_clock, a)), i
+        m = mps[int(o)]
+        if key[i] < 0:
+            rc = m.len()
+            assert int(pf.val[i]) == rc.val
+            assert np.array_equal(pf.rm_clock[i], _row(rc.rm_clock, a))
+        else:
+            rc = m.get(int(key[i]))
+            assert int(pf.val[i]) == int(rc.val is not None)
+            assert np.array_equal(pf.add_clock[i], _row(rc.add_clock, a))
+            assert np.array_equal(pf.rm_clock[i], _row(rc.rm_clock, a))
+
+
+def test_gather_validates_object_range_and_counts():
+    uni = _uni()
+    batch = OrswotBatch.from_scalar(_orswot_fleet(4, seed=2), uni)
+    before = tracing.counters().get("serve.reads", 0)
+    serve.gather(batch, np.array([0, 3]))
+    assert tracing.counters().get("serve.reads", 0) - before == 2
+    with pytest.raises(IndexError):
+        serve.gather(batch, np.array([4]))  # out of range
+    with pytest.raises(IndexError):
+        serve.gather(batch, np.array([-1]))
+
+
+def test_query_engine_mixed_kinds():
+    uni = _uni()
+    sets = _orswot_fleet(6, seed=7)
+    gcs = [GCounter() for _ in range(6)]
+    for i, g in enumerate(gcs):
+        for _ in range(i + 1):
+            g.apply(g.inc(0))
+    eng = serve.QueryEngine({
+        serve.K_ORSWOT: OrswotBatch.from_scalar(sets, uni),
+        serve.K_GCOUNTER: GCounterBatch.from_scalar(gcs, uni),
+    })
+    obj = np.array([0, 1, 2, 3])
+    kind = np.array([serve.K_GCOUNTER, serve.K_ORSWOT,
+                     serve.K_GCOUNTER, serve.K_ORSWOT], np.uint8)
+    frame = eng.gather(obj, kind)
+    assert int(frame.val[0]) == 1 and int(frame.val[2]) == 3
+    assert int(frame.val[1]) == len(sets[1].value().val)
+    assert int(frame.val[3]) == len(sets[3].value().val)
+    assert np.array_equal(frame.kind, kind)
+
+
+# ---------------------------------------------------------------------------
+# the parity PIN: gathered ReadCtx clocks drive removes (ISSUE 17 (e))
+# ---------------------------------------------------------------------------
+
+
+def test_gathered_rm_ctx_drives_removes_byte_identical():
+    """Property (seeded trials): value-read rm-clock rows from the
+    batched gather, fed through ``derive_rm_ctx`` and the scatter-fold,
+    produce removes byte-identical to the scalar clone-read-remove
+    loop (`ctx.rs:56-60` + `orswot.rs:80-83`)."""
+    for seed in (21, 22, 23, 24, 25):
+        uni = _uni()
+        n = 16
+        sets = _orswot_fleet(n, seed=seed, removes=False)
+        batch = OrswotBatch.from_scalar(sets, uni)
+        rng = np.random.RandomState(seed * 100)
+        # remove one live member from each object that has any
+        obj, member = [], []
+        for i, s in enumerate(sets):
+            live = sorted(s.value().val)
+            if live:
+                obj.append(i)
+                member.append(live[int(rng.randint(0, len(live)))])
+        obj = np.array(obj, np.int64)
+        member = np.array(member, np.int32)
+
+        # batched: gather value() reads, scatter their rm-clock rows
+        # back into an [N, A] base, and let derive_rm_ctx clone them
+        frame = serve.gather(batch, obj, member=None)
+        base = np.zeros((n, frame.rm_clock.shape[1]), np.uint64)
+        base[obj] = frame.rm_clock
+        ops = derive_rm_ctx(base, obj, member)
+        folded, rep = OpApplier(uni).apply_ops(batch, ops)
+        assert rep.still_parked == 0
+
+        # scalar: the clone-read-remove loop on twin objects
+        for i in range(obj.size):
+            s = sets[int(obj[i])]
+            s.apply(s.remove(int(member[i]), s.value().derive_rm_ctx()))
+        ref = OrswotBatch.from_scalar(sets, uni)
+
+        assert np.array_equal(
+            np.asarray(sync_digest.digest_of(folded)),
+            np.asarray(sync_digest.digest_of(ref)),
+        ), f"seed {seed}: batched rm-ctx remove != scalar loop"
+        for i in range(obj.size):
+            assert sets[int(obj[i])].contains(int(member[i])).val is False
+
+
+# ---------------------------------------------------------------------------
+# consistency: the admission predicates
+# ---------------------------------------------------------------------------
+
+
+def test_covers_zero_pads_widths():
+    assert serve.covers(np.array([2, 1], np.uint64),
+                        np.array([2], np.uint64))
+    assert not serve.covers(np.array([2], np.uint64),
+                            np.array([2, 1], np.uint64))
+    assert serve.covers(np.array([], np.uint64), None)
+    assert serve.covers(np.array([], np.uint64),
+                        np.array([], np.uint64))
+
+
+def test_admit_modes():
+    vis = np.array([3, 2], np.uint64)
+    ok = serve.admit(serve.MODE_EVENTUAL, np.array([9], np.uint64), vis)
+    assert ok.admitted  # eventual ignores the floor
+    assert serve.admit(serve.MODE_RYW, np.array([3, 2], np.uint64),
+                       vis).admitted
+    parked = serve.admit(serve.MODE_RYW, np.array([4], np.uint64), vis)
+    assert not parked.admitted and parked.reason == "not_visible"
+    assert serve.admit(serve.MODE_MONOTONIC, None, vis).admitted
+    no_f = serve.admit(serve.MODE_FRONTIER, None, vis, frontier_vv=None)
+    assert not no_f.admitted and no_f.reason == "no_frontier"
+    assert serve.admit(serve.MODE_FRONTIER, None, vis,
+                       frontier_vv=np.array([1], np.uint64)).admitted
+    with pytest.raises(ValueError):
+        serve.admit("linearizable", None, vis)
+
+
+def test_stability_statuses_per_row():
+    frame = serve.ResultFrame(
+        obj=np.array([0, 1, 2, 3]),
+        kind=np.full(4, serve.K_ORSWOT, np.uint8),
+        member=np.full(4, serve.NO_MEMBER, np.int32),
+        status=np.zeros(4, np.uint8),
+        val=np.zeros(4, np.uint64),
+        add_clock=np.array([[1, 0], [2, 0], [0, 3], [1, 1]], np.uint64),
+        rm_clock=np.zeros((4, 2), np.uint64),
+        token=np.zeros(2, np.uint64),
+    )
+    # two subtrees of span 2 with different frontier clocks
+    subtrees = np.array([[1, 0], [1, 1]], np.uint64)
+    st = serve.stability_statuses(frame, subtrees, span=2)
+    assert st.tolist() == [serve.ST_OK, serve.ST_NOT_STABLE,
+                           serve.ST_NOT_STABLE, serve.ST_OK]
+
+
+# ---------------------------------------------------------------------------
+# wire: the versioned + CRC'd read/result codec
+# ---------------------------------------------------------------------------
+
+
+def _req(b=5, w=4, mode=serve.MODE_RYW, seed=9):
+    rng = np.random.RandomState(seed)
+    return serve.ReadRequest(
+        obj=rng.randint(0, 50, b),
+        kind=np.full(b, serve.K_ORSWOT, np.uint8),
+        member=rng.randint(-1, 9, b).astype(np.int32),
+        mode=mode,
+        require=rng.randint(0, 9, w).astype(np.uint64),
+    )
+
+
+def test_read_request_roundtrip():
+    req = _req()
+    back = serve.decode_read_request(serve.encode_read_request(req))
+    assert np.array_equal(back.obj, req.obj)
+    assert np.array_equal(back.kind, req.kind)
+    assert np.array_equal(back.member, req.member)
+    assert back.mode == req.mode
+    assert np.array_equal(back.require, req.require)
+
+
+def test_result_frame_roundtrip():
+    uni = _uni()
+    batch = OrswotBatch.from_scalar(_orswot_fleet(8, seed=31), uni)
+    frame = serve.gather(batch, np.arange(8))
+    frame.token = np.array([7, 0, 3, 0, 0, 0, 0, 1], np.uint64)
+    back = serve.decode_result_frame(serve.encode_result_frame(frame))
+    for field in ("obj", "kind", "member", "status", "val",
+                  "add_clock", "rm_clock", "token"):
+        assert np.array_equal(getattr(back, field),
+                              getattr(frame, field)), field
+
+
+def test_wire_rejects_are_typed_and_counted():
+    frame = serve.encode_read_request(_req())
+    with pytest.raises(SyncProtocolError):
+        serve.decode_read_request(frame[:6])  # truncated
+    bad_ver = bytearray(frame)
+    bad_ver[0] = 99
+    with pytest.raises(SyncProtocolError):
+        serve.decode_read_request(bytes(bad_ver))
+    with pytest.raises(SyncProtocolError):  # wrong frame type
+        serve.decode_result_frame(frame)
+    corrupt = bytearray(frame)
+    corrupt[-1] ^= 0xFF
+    before = tracing.counters().get("serve.frames.rejected.crc_mismatch", 0)
+    with pytest.raises(SyncProtocolError):
+        serve.decode_read_request(bytes(corrupt))
+    assert tracing.counters().get(
+        "serve.frames.rejected.crc_mismatch", 0) == before + 1
+    with pytest.raises(WireFormatError):  # grammar: object out of range
+        serve.decode_read_request(frame, num_objects=3)
+    with pytest.raises(SyncProtocolError):  # trailing bytes
+        serve.decode_read_request(frame + b"\x00")
+
+
+def test_wire_rejects_bad_columns():
+    req = _req()
+    req.kind = np.full(len(req), 99, np.uint8)
+    with pytest.raises(WireFormatError):
+        serve.decode_read_request(serve.encode_read_request(req))
+    req = _req()
+    enc = serve.encode_read_request(req)
+    # corrupt the mode byte inside the payload, re-CRC honestly — the
+    # grammar check must fire, not CRC luck
+    import struct
+    import zlib
+
+    hdr = struct.Struct("<BBIQ")
+    payload = bytearray(enc[hdr.size:])
+    payload[struct.calcsize("<IH")] = 250  # mode code out of range
+    new = bytes(payload)
+    reframed = hdr.pack(serve.SERVE_PROTOCOL_VERSION, serve.FRAME_READ,
+                        zlib.crc32(new), len(new)) + new
+    with pytest.raises(WireFormatError):
+        serve.decode_read_request(reframed)
+
+
+# ---------------------------------------------------------------------------
+# the serve loop on a live ClusterNode
+# ---------------------------------------------------------------------------
+
+
+def _node(n=16, seed=41, uni=None):
+    uni = uni or _uni()
+    batch = OrswotBatch.from_scalar(_orswot_fleet(n, seed=seed), uni)
+    return ClusterNode("n0", batch, uni, oplog=OpLog(uni))
+
+
+def test_serve_ryw_sees_every_acknowledged_write():
+    node = _node()
+    for k in range(6):
+        node.submit_writes(np.array([k], np.int64),
+                           np.array([200 + k], np.int32), actor=2)
+        ack = node.write_vv()
+        frame = node.serve_reads(serve.ReadRequest.reads(
+            [k], member=200 + k, mode="ryw", require=ack))
+        assert int(frame.val[0]) == 1, f"RYW violated on write {k}"
+        assert serve.covers(frame.token, ack)
+
+
+def test_serve_monotonic_token_never_regresses():
+    node = _node()
+    tok = node.read_token()
+    for k in range(5):
+        node.submit_writes(np.array([k], np.int64),
+                           np.array([210], np.int32), actor=1)
+        frame = node.serve_reads(serve.ReadRequest.reads(
+            np.arange(4), mode="monotonic", require=tok))
+        assert np.all(frame.token >= tok)
+        tok = frame.token
+
+
+def test_serve_ryw_unreachable_floor_parks_then_rejects():
+    node = _node()
+    node.serve_reads(serve.ReadRequest.reads([0]))  # build the loop
+    node._serve_loop.park_timeout_s = 0.02
+    with pytest.raises(ConsistencyUnavailableError) as ei:
+        node.serve_reads(serve.ReadRequest.reads(
+            [0], mode="ryw", require=np.full(8, 99, np.uint64)))
+    assert ei.value.mode == serve.MODE_RYW
+    assert ei.value.reason == "not_visible"
+
+
+def test_serve_frontier_rejects_without_a_frontier():
+    node = _node()
+    with pytest.raises(ConsistencyUnavailableError) as ei:
+        node.serve_reads(serve.ReadRequest.reads([0], mode="frontier"))
+    assert ei.value.reason == "no_frontier"
+
+
+def test_serve_frames_pipeline_and_rejects():
+    node = _node()
+    good = [serve.encode_read_request(serve.ReadRequest.reads(
+        np.arange(8))) for _ in range(4)]
+    bad = serve.encode_read_request(serve.ReadRequest.reads(
+        [0], mode="ryw", require=np.full(8, 99, np.uint64)))
+    node.serve_reads(serve.ReadRequest.reads([0]))
+    node._serve_loop.park_timeout_s = 0.02
+    out, stats = node._serve_loop.serve_frames(good + [bad])
+    assert stats["frames"] == 5 and stats["rejected"] == 1
+    assert out[-1] is None and all(o is not None for o in out[:4])
+    decoded = serve.decode_result_frame(out[0])
+    assert len(decoded) == 8
+    assert set(stats["stage_s"]) == {"decode", "serve", "encode"}
+    with pytest.raises(ValueError):
+        serve.ServeLoop(node, depth=1)
+
+
+def test_serve_read_ctx_bridges_to_scalar():
+    uni = _uni()
+    sets = _orswot_fleet(4, seed=51)
+    batch = OrswotBatch.from_scalar(sets, uni)
+    frame = serve.gather(batch, np.array([1]))
+    rc = frame.read_ctx(0, uni)
+    ref = sets[1].value()
+    assert rc.add_clock == ref.add_clock
+    assert rc.rm_clock == ref.rm_clock

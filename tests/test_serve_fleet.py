@@ -1,0 +1,254 @@
+"""ISSUE 17 acceptance — live mixed reads+writes on a lossy gossip
+fleet.
+
+A 3-node queue-pair gossip mesh under 20% frame loss + delay-reorder
+serves reads through :mod:`crdt_tpu.serve` WHILE writes land and
+anti-entropy runs.  The pins:
+
+* read-your-writes is NEVER violated for an acknowledged write — every
+  admitted ryw read at the writer's ack floor (``write_vv``) sees the
+  written member;
+* monotonic-read tokens never regress per node, across the whole run;
+* every frontier-stable row is ≤ the PR 15 stability frontier —
+  audited EXTERNALLY against the tracker's subtree clocks, not trusted
+  from the serve path's own stamp — and at quiescence (frontier ==
+  fleet VV min) a frontier-mode read returns every row stable;
+* the always-on lattice auditor records zero violations.
+"""
+
+import itertools
+import threading
+
+import numpy as np
+import pytest
+
+from crdt_tpu import serve
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.cluster import (
+    ClusterNode,
+    FaultPlan,
+    FaultyTransport,
+    GossipScheduler,
+    Membership,
+    ResilientTransport,
+    RetryPolicy,
+    queue_pair,
+)
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.error import ConsistencyUnavailableError, PeerUnavailableError
+from crdt_tpu.obs.stability import subtree_layout
+from crdt_tpu.oplog import OpLog
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.sync import digest as sync_digest
+from crdt_tpu.utils import tracing
+from crdt_tpu.utils.interning import Universe
+
+pytestmark = pytest.mark.serve
+
+FAST = RetryPolicy(send_deadline_s=3.0, recv_deadline_s=3.0,
+                   ack_timeout_s=0.05, max_backoff_s=0.3,
+                   retry_budget=400)
+
+
+def _uni():
+    return Universe.identity(CrdtConfig(
+        num_actors=8, member_capacity=24, deferred_capacity=4,
+        counter_bits=32))
+
+
+def _base_fleet(n, seed):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        s = Orswot()
+        for _ in range(rng.randint(1, 4)):
+            s.apply(s.add(int(rng.randint(0, 50)),
+                          s.value().derive_add_ctx(0)))
+        out.append(s)
+    return out
+
+
+def _pad(v, width):
+    v = np.asarray(v, np.uint64).reshape(-1)
+    if v.size < width:
+        v = np.concatenate([v, np.zeros(width - v.size, np.uint64)])
+    return v
+
+
+def _dominates(a, b):
+    width = max(len(a), len(b))
+    return bool((_pad(a, width) >= _pad(b, width)).all())
+
+
+def _faulty_mesh(nodes, loss=0.20, delay=0.15):
+    """The test_stability queue-pair mesh: seeded loss + delay-reorder
+    on every link."""
+    seeds = itertools.count(7000)
+
+    def make_dialer(i):
+        def dial(peer):
+            j = int(peer.peer_id[1:])
+            if nodes[j] is None:
+                raise PeerUnavailableError(f"n{j} is down")
+            s = next(seeds)
+            ta, tb = queue_pair(default_timeout=10.0)
+            fa = FaultyTransport(
+                ta, FaultPlan(seed=s, drop=loss, delay=delay),
+                name=f"n{i}->n{j}")
+            fb = FaultyTransport(
+                tb, FaultPlan(seed=s + 1, drop=loss, delay=delay),
+                name=f"n{j}->n{i}")
+            ra = ResilientTransport(fa, FAST, name=f"n{i}->n{j}",
+                                    seed=s + 2)
+            rb = ResilientTransport(fb, FAST, name=f"n{j}->n{i}",
+                                    seed=s + 3)
+
+            def serve_peer(target=nodes[j], label=f"n{i}"):
+                try:
+                    target.accept(rb, peer_id=label)
+                except Exception:
+                    pass
+                finally:
+                    rb.close()
+
+            threading.Thread(target=serve_peer, daemon=True).start()
+            return ra
+        return dial
+
+    scheds = []
+    for i, node in enumerate(nodes):
+        m = Membership(suspect_after=2, dead_after=5)
+        for j in range(len(nodes)):
+            if j != i:
+                m.add(f"n{j}")
+        scheds.append(GossipScheduler(
+            node, m, make_dialer(i), fanout=2,
+            session_timeout_s=60.0, seed=i))
+    return scheds
+
+
+def _audit_frontier_rows(node, frame):
+    """External ≤-frontier audit: every row the serve path stamped
+    ST_OK must be dominated by its subtree's frontier clock as the
+    STABILITY TRACKER publishes it (clocks only grow, so auditing
+    after the fact can only be stricter)."""
+    subs = node.stability.subtree_frontier_clocks()
+    assert subs is not None, \
+        "frontier rows stamped OK with no published subtree clocks"
+    n = int(node.batch.clock.shape[0])
+    _, span = subtree_layout(n)
+    audited = 0
+    for i in range(len(frame)):
+        if int(frame.status[i]) != serve.ST_OK:
+            continue
+        sub = min(int(frame.obj[i]) // span, subs.shape[0] - 1)
+        assert _dominates(subs[sub], frame.add_clock[i]), (
+            f"{node.node_id}: frontier-stable row obj={int(frame.obj[i])} "
+            f"clock {frame.add_clock[i].tolist()} exceeds subtree {sub} "
+            f"frontier {np.asarray(subs[sub]).tolist()}"
+        )
+        audited += 1
+    return audited
+
+
+def test_acceptance_live_reads_on_lossy_fleet():
+    audit_before = tracing.counters().get("stability.audit.violations", 0)
+    uni = _uni()
+    n_nodes, n_objects = 3, 32
+    base = _base_fleet(n_objects, seed=171)
+    nodes = [
+        ClusterNode(f"n{i}", OrswotBatch.from_scalar(base, uni), uni,
+                    busy_timeout_s=5.0, oplog=OpLog(uni))
+        for i in range(n_nodes)
+    ]
+    scheds = _faulty_mesh(nodes)
+    loops = [serve.ServeLoop(node, park_timeout_s=10.0) for node in nodes]
+    rosters = [[f"n{j}" for j in range(n_nodes) if j != i]
+               for i in range(n_nodes)]
+    rng = np.random.RandomState(1717)
+
+    tokens = [loops[i].token() for i in range(n_nodes)]
+    ryw_checked = frontier_rows_audited = 0
+
+    for sweep in range(5):
+        for i, node in enumerate(nodes):
+            # live writes, then the ryw probe at the ack floor
+            node.submit_writes(
+                rng.randint(0, n_objects, 3),
+                rng.randint(200, 212, 3).astype(np.int32), actor=i + 1)
+            probe_obj = np.array([int(rng.randint(0, n_objects))])
+            probe_member = np.array([220 + i], np.int32)
+            node.submit_writes(probe_obj, probe_member, actor=i + 1)
+            ack = node.write_vv()
+            frame = loops[i].serve(serve.ReadRequest.reads(
+                probe_obj, member=probe_member, mode="ryw", require=ack))
+            assert int(frame.val[0]) == 1, (
+                f"{node.node_id} sweep {sweep}: read-your-writes "
+                f"VIOLATED for acknowledged member {int(probe_member[0])}"
+            )
+            assert serve.covers(frame.token, ack)
+            ryw_checked += 1
+
+            # monotonic: the returned token may never regress
+            frame = loops[i].serve(serve.ReadRequest.reads(
+                rng.randint(0, n_objects, 8), mode="monotonic",
+                require=tokens[i]))
+            assert np.all(frame.token >= tokens[i]), (
+                f"{node.node_id} sweep {sweep}: monotonic token "
+                f"REGRESSED {tokens[i].tolist()} -> "
+                f"{frame.token.tolist()}"
+            )
+            tokens[i] = frame.token
+
+            # frontier-stable: externally audited row-for-row
+            node.stability.frontier(node.batch, peers=rosters[i])
+            try:
+                frame = loops[i].serve(serve.ReadRequest.reads(
+                    rng.randint(0, n_objects, 8), mode="frontier"))
+                frontier_rows_audited += _audit_frontier_rows(node, frame)
+            except ConsistencyUnavailableError as e:
+                assert e.reason == "no_frontier"
+
+        for sched in scheds:
+            sched.run_round()
+
+    # writes stopped: gossip to byte-identical digests
+    converged = False
+    for _ in range(25):
+        for sched in scheds:
+            sched.run_round()
+        digests = [np.asarray(n.digest()) for n in nodes]
+        if all(np.array_equal(digests[0], d) for d in digests[1:]):
+            converged = True
+            break
+    assert converged, "fleet failed to converge after reads+writes"
+
+    # publish settled frontiers; at quiescence frontier == fleet VV min
+    target = np.asarray(sync_digest.version_vector(nodes[0].batch),
+                        np.uint64)
+    settled = False
+    for _ in range(10):
+        reps = [nodes[i].stability.frontier(nodes[i].batch,
+                                            peers=rosters[i])
+                for i in range(n_nodes)]
+        if all(r is not None and np.array_equal(
+                np.asarray(r.clock, np.uint64), target) for r in reps):
+            settled = True
+            break
+        for sched in scheds:
+            sched.run_round()
+    assert settled, "stability frontier never settled at quiescence"
+
+    # ... and a frontier-mode read now returns EVERY row stable
+    for i, node in enumerate(nodes):
+        frame = loops[i].serve(serve.ReadRequest.reads(
+            np.arange(n_objects), mode="frontier"))
+        assert bool((frame.status == serve.ST_OK).all()), (
+            f"{node.node_id}: unstable rows under a settled frontier"
+        )
+        frontier_rows_audited += _audit_frontier_rows(node, frame)
+
+    assert ryw_checked == 5 * n_nodes
+    assert frontier_rows_audited > 0
+    assert tracing.counters().get("stability.audit.violations", 0) \
+        == audit_before, "lattice auditor recorded violations"

@@ -185,6 +185,13 @@ def counter_family(name: str) -> str:
         # same-node workload — only the serve path disappearing
         # wholesale is the signal
         return "serve"
+    if parts[0] == "heat":
+        # the heat observatory's counters (heat.subtree.<i>.{reads,
+        # writes,repair} / heat.reads.<mode> / heat.updates) collapse
+        # into ONE family: a read-only round attributes no write or
+        # repair heat and an idle fleet repairs nothing — only traffic
+        # attribution vanishing wholesale is the signal
+        return "heat"
     if parts[0] == "kernel" and len(parts) >= 3:
         # the runtime kernel observatory's per-kernel counters
         # (kernel.<label>.{calls,compiles,bytes,errors}) collapse into

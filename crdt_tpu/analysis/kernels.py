@@ -708,6 +708,52 @@ def _b_frontier_fold():
     return build
 
 
+def _b_heat_fold():
+    """The heat observatory's per-subtree scatter-add
+    (obs/heat.py): ``(ids[B], weights[B]) -> heat[S]`` with
+    ``segment = id // span``.  Traced across the (subtrees, span)
+    ladder subtree_layout walks plus the pow2 batch rungs record
+    batches pad to — integer lattice, order-free by construction."""
+
+    def build():
+        from ..obs import heat as heat_mod
+
+        idt = "int64" if _clock_dt() == "uint64" else "int32"
+        cases = []
+        for (s, span, b) in ((16, 1, 8), (16, 16, 64), (16, 256, 512),
+                             (8, 1, 8)):
+            fn = _unjit(heat_mod._fold_kernel(s, span))
+            cases.append(TraceCase(
+                rung=f"S{s}.P{span}.B{b}", fn=fn,
+                args=(_vec(b, idt), _vec(b, idt)), key=(s, span)))
+        return cases
+
+    return build
+
+
+def _b_heat_sketch():
+    """The heat observatory's batched Space-Saving update
+    (obs/heat.py): ``(table[3xC], ids[B], w[B]) -> table[3xC]`` —
+    in-batch segment-sum aggregation, matched scatter-add, candidates
+    entering at table-min with their error recorded, one top_k.
+    Integer lattice: counts only grow, padding rows carry weight 0."""
+
+    def build():
+        from ..obs import heat as heat_mod
+
+        idt = "int64" if _clock_dt() == "uint64" else "int32"
+        cases = []
+        for (c, b) in ((128, 8), (128, 256), (128, 1024), (64, 64)):
+            fn = _unjit(heat_mod._sketch_kernel(c))
+            cases.append(TraceCase(
+                rung=f"C{c}.B{b}", fn=fn,
+                args=(_vec(c, idt), _vec(c, idt), _vec(c, idt),
+                      _vec(b, idt), _vec(b, idt)), key=(c,)))
+        return cases
+
+    return build
+
+
 def _b_serve_gather(which: str):
     """The read front-end's gather kernels (serve/query.py): pure
     gathers from the dense planes into columnar result frames.  Read
@@ -1029,6 +1075,17 @@ MANIFEST: tuple = (
                "_frontier_kernel.kernel",
                compile_budget=4,  # one lowering per traced (S, span, A)
                build=_b_frontier_fold()),
+    # obs/heat.py (the heat & placement observatory) -------------------------
+    KernelSpec("obs.heat.subtree_fold", "crdt_tpu/obs/heat.py",
+               "_fold_kernel.kernel",
+               determinism="integer-lattice",
+               compile_budget=8,  # (S, span) statics x pow2 batch rungs
+               build=_b_heat_fold()),
+    KernelSpec("obs.heat.sketch_update", "crdt_tpu/obs/heat.py",
+               "_sketch_kernel.kernel",
+               determinism="integer-lattice",
+               compile_budget=8,  # capacity static x pow2 batch rungs
+               build=_b_heat_sketch()),
     # serve/query.py (the read front-end's gather kernels) -------------------
     KernelSpec("serve.gather.orswot", "crdt_tpu/serve/query.py",
                "_orswot_kernel.kernel",

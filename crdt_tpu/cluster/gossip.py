@@ -107,7 +107,9 @@ class ClusterNode:
                  durability=None,
                  applier=None,
                  lag_tracker=None,
-                 stability_tracker=None):
+                 stability_tracker=None,
+                 heat_tracker=None):
+        from ..obs import heat as obs_heat
         from ..obs import latency as obs_latency
         from ..obs import stability as obs_stability
 
@@ -135,6 +137,14 @@ class ClusterNode:
         #: resume instead of regrowing from zero.
         self.stability = stability_tracker if stability_tracker \
             is not None else obs_stability.StabilityTracker()
+        #: the node's :class:`crdt_tpu.obs.heat.HeatTracker` — the
+        #: placement plane: serve gathers record read heat, the op
+        #: drain records write heat, sync sessions record repair heat,
+        #: and the gossip scheduler publishes the EWMA/top-k gauge
+        #: surface per round.  Private per node by default (same
+        #: discipline as the lag/stability observers).
+        self.heat = heat_tracker if heat_tracker is not None \
+            else obs_heat.HeatTracker()
         #: a :class:`crdt_tpu.durable.Durability`; when set, every
         #: ingested op batch is WAL-appended BEFORE the in-memory fold
         #: (a write acknowledged to the caller survives kill -9), and
@@ -393,6 +403,13 @@ class ClusterNode:
         faults_mod.crash_point(f"oplog.fold.{self.node_id}")
         with self._lock:
             batch = self._batch
+        if len(ops):
+            # write heat: every drained op row, before the fold (the
+            # attribution is per submitted row — duplicates the fold
+            # drops still landed on this node's ingest path)
+            clock = getattr(batch, "clock", None)
+            if clock is not None:
+                self.heat.record_writes(ops.obj, int(clock.shape[0]))
         batch, report = self._applier.apply_ops(batch, ops)
         with self._lock:
             self._batch = batch
@@ -463,6 +480,7 @@ class ClusterNode:
                 digest_tree=self.digest_tree,
                 lag_tracker=self.lag_tracker,
                 stability=self.stability,
+                heat=self.heat,
                 **op_hooks,
             )
             report = session.sync(transport)
@@ -784,6 +802,10 @@ class GossipScheduler:
         # in peer members (plane growth) or drained queued ops, so the
         # occupancy gauges / growth ETAs refresh on the post-round state
         self.node.sample_capacity()
+        # heat plane per round: refresh the EWMA *_per_s windows, the
+        # top-k hot-object gauges, and the fitted Zipf exponent from
+        # whatever the serve/drain/repair paths attributed this round
+        self.node.heat.publish()
         # stability plane per round: the frontier recomputes against
         # the FULL roster (incl. DEAD peers — quarantine, not the
         # membership state, decides when a silent peer stops pinning

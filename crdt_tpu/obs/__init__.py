@@ -44,6 +44,12 @@ first-class answer, in five parts:
   served at ``/stability``), and the runtime lattice auditor (sampled
   merge-idempotence + frontier-soundness self-checks, the online
   tripwire for the whole lattice stack).
+* :mod:`crdt_tpu.obs.heat` — the placement plane: per-subtree traffic
+  attribution (read/write/repair heat folded by jitted scatter-add
+  kernels onto the PR 15 ``subtree_layout``), an on-device
+  Space-Saving top-k sketch with a Zipf-exponent estimator, and the
+  shard/ring placement planner behind ``GET /heat`` — the measurement
+  half of the mesh-sharding and partial-replication items.
 * :mod:`crdt_tpu.obs.kernels` — the kernel plane: the runtime kernel
   observatory (dynamic companion to kernelcheck, keyed on the SAME
   :data:`crdt_tpu.analysis.kernels.MANIFEST` rows) — per-kernel
@@ -63,6 +69,7 @@ from . import (  # noqa: F401
     convergence,
     events,
     fleet,
+    heat,
     kernels,
     latency,
     metrics,
@@ -77,6 +84,7 @@ from .fleet import (  # noqa: F401
     observatory,
     stitch_trace,
 )
+from .heat import HeatTracker, heat_tracker  # noqa: F401
 from .kernels import (  # noqa: F401
     KernelObservatory,
     KernelProfile,
@@ -111,6 +119,8 @@ __all__ = [
     "ConvergenceTracker",
     "Counter",
     "FrontierReport",
+    "HeatTracker",
+    "heat_tracker",
     "StabilityTracker",
     "stability_tracker",
     "FleetObservatory",

@@ -154,7 +154,8 @@ class MetricsServer:
     def __init__(self, host: str, port: int,
                  registry: Optional[metrics.MetricsRegistry] = None,
                  tracker: Optional[convergence.ConvergenceTracker] = None,
-                 observatory=None, capacity=None, stability=None):
+                 observatory=None, capacity=None, stability=None,
+                 heat=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self._registry = registry
@@ -162,6 +163,7 @@ class MetricsServer:
         self._observatory = observatory
         self._capacity = capacity
         self._stability = stability
+        self._heat = heat
         self._t0 = time.monotonic()
         self.scrapes: dict = {}
         self._scrape_lock = threading.Lock()
@@ -281,6 +283,42 @@ class MetricsServer:
                 else stability_mod.tracker()
             body = json.dumps(trk.snapshot()).encode()
             return body, "application/json", 200
+        if route == "/heat":
+            # the heat & placement observatory (crdt_tpu/obs/heat.py):
+            # prom text of the heat. plane by default (counters,
+            # EWMA rates, top-k gauges — publish() refreshes them
+            # first so a scrape never reads a stale window),
+            # ?format=json for the full attribution snapshot (layout,
+            # per-subtree split, decoded hot list with error bounds,
+            # Zipf fit), ?plan=mesh:8 / ?plan=ring:5,k=3 for a scored
+            # placement report against the measured heat.
+            from . import heat as heat_mod
+
+            trk = self._heat if self._heat is not None \
+                else heat_mod.tracker()
+            trk.publish()
+            q = parse_qs(parsed.query)
+            plan = q.get("plan", [None])[0]
+            if plan is not None:
+                try:
+                    report = trk.plan_report(plan)
+                except ValueError as e:
+                    return (f"{e}\n".encode(),
+                            "text/plain; charset=utf-8", 400)
+                body = json.dumps({"heat": trk.snapshot(),
+                                   "report": report}).encode()
+                return body, "application/json", 200
+            if q.get("format", [None])[0] == "json":
+                return (json.dumps(trk.snapshot()).encode(),
+                        "application/json", 200)
+            # render from the TRACKER's registry: a node-private heat
+            # tracker publishes its counters there, not into the
+            # server-wide registry
+            text = prometheus_text(
+                trk.registry(), tracker=self._tracker,
+                name_prefixes=("heat.",))
+            return (text.encode(),
+                    "text/plain; version=0.0.4; charset=utf-8", 200)
         if route == "/healthz":
             # liveness + the capacity watermark: `status` mirrors the
             # tracker's overall watermark state (ok/warn/critical; "ok"
@@ -301,11 +339,29 @@ class MetricsServer:
             # only — the per-mode breakdown stays on /metrics.
             reg = self._registry if self._registry is not None \
                 else metrics.registry()
-            counters = reg.counters_snapshot()
+            snap = reg.snapshot()
+            counters = snap["counters"]
+            hists = snap["histograms"]
 
             def _fam(prefix: str) -> int:
                 return sum(v for k, v in counters.items()
                            if k.startswith(prefix))
+
+            def _wall(name: str) -> Optional[dict]:
+                h = hists.get(name)
+                if not h or not h.get("count"):
+                    return None
+                return {"count": h["count"],
+                        "mean_s": round(h["sum"] / h["count"], 6),
+                        "max_s": round(h["max"], 6)}
+
+            # duration, not just counts (the PR 17 gap): per-mode
+            # serve walls + how long admission parks actually held
+            latency = {}
+            for mode in ("eventual", "ryw", "monotonic", "frontier"):
+                w = _wall("serve.latency." + mode)
+                if w is not None:
+                    latency[mode] = w
 
             body = json.dumps({
                 "status": wm["state"],
@@ -319,11 +375,13 @@ class MetricsServer:
                     "rejected": _fam("serve.reject."),
                     "not_stable_rows": counters.get(
                         "serve.not_stable_rows", 0),
+                    "latency": latency,
+                    "park_wait": _wall("serve.park_wait_s"),
                 },
             }).encode()
             return body, "application/json", 200
         return (b"not found (try /metrics, /events, /fleet, /kernels, "
-                b"/stability, /healthz)\n"), \
+                b"/stability, /heat, /healthz)\n"), \
             "text/plain; charset=utf-8", 404
 
     def scrape_counts(self) -> dict:
@@ -357,7 +415,8 @@ def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
                          registry: Optional[metrics.MetricsRegistry] = None,
                          tracker: Optional[convergence.ConvergenceTracker]
                          = None, observatory=None,
-                         capacity=None, stability=None) -> MetricsServer:
+                         capacity=None, stability=None,
+                         heat=None) -> MetricsServer:
     """Start the opt-in background exporter; ``port=0`` picks a free
     port (read it back from ``server.port``).  ``tracker`` pairs a
     custom ``registry`` with the convergence tracker writing into it
@@ -368,6 +427,8 @@ def start_metrics_server(port: int = 0, host: str = "127.0.0.1",
     ``/healthz`` reports (default: the process-global one);
     ``stability`` is the :class:`~crdt_tpu.obs.stability.
     StabilityTracker` behind ``/stability`` (default: the
-    process-global one)."""
+    process-global one); ``heat`` is the
+    :class:`~crdt_tpu.obs.heat.HeatTracker` behind ``/heat``
+    (default: the process-global one)."""
     return MetricsServer(host, port, registry, tracker, observatory,
-                         capacity, stability)
+                         capacity, stability, heat)

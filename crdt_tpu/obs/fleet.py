@@ -252,6 +252,40 @@ class FleetSnapshot:
                 acc["nodes"] += 1
         return out
 
+    def fleet_heat(self) -> dict:
+        """The heat observatory reduced fleet-wide
+        (:mod:`crdt_tpu.obs.heat`): per-subtree attribution counters
+        ride the normal G-Counter read (each node's latest value
+        summed once — re-delivered slices max-merge per node, so they
+        never double-count), and the per-node top-k hot-object gauges
+        (``heat.hot.<rank>.{obj,count}``) get the sketch's semilattice
+        join host-side: same-object counts SUM across nodes, then
+        re-rank.  Returns ``{"subtree": {name: total}, "hot":
+        [{"obj", "count", "nodes"}, ...]}``."""
+        subtree = {
+            name: int(v) for name, v in self.fleet_counters().items()
+            if name.startswith("heat.subtree.")
+        }
+        acc: Dict[int, int] = {}
+        seen: Dict[int, int] = {}
+        for sl in self.slices.values():
+            ranks: Dict[str, dict] = {}
+            for name, entry in sl.get("gauges", {}).items():
+                parts = name.split(".")
+                if len(parts) != 4 or parts[0] != "heat" \
+                        or parts[1] != "hot":
+                    continue
+                ranks.setdefault(parts[2], {})[parts[3]] = float(entry[2])
+            for r in ranks.values():
+                if "obj" in r and r.get("count", 0) > 0:
+                    obj = int(r["obj"])
+                    acc[obj] = acc.get(obj, 0) + int(r["count"])
+                    seen[obj] = seen.get(obj, 0) + 1
+        hot = [{"obj": o, "count": c, "nodes": seen[o]}
+               for o, c in sorted(acc.items(),
+                                  key=lambda kv: (-kv[1], kv[0]))]
+        return {"subtree": subtree, "hot": hot}
+
     def fleet_lag(self) -> Dict[str, dict]:
         """The write-to-visible lag gauges (``sync.peer.<peer>.lag_*``,
         :mod:`crdt_tpu.obs.latency`) reduced fleet-wide: per leaf
@@ -307,6 +341,7 @@ class FleetSnapshot:
                 "capacity": self.fleet_capacity(),
                 "lag": self.fleet_lag(),
                 "stability": self.fleet_stability(),
+                "heat": self.fleet_heat(),
             },
         }
 
@@ -559,6 +594,16 @@ def fleet_prometheus_text(snap: FleetSnapshot,
         rendered = str(int(v)) if v.is_integer() else repr(v)
         lines.append(f"# TYPE {base} gauge")
         lines.append(f"{base} {rendered}")
+    # the fleet-merged hot-object list (fleet_heat): the per-node
+    # Space-Saving sketches' semilattice join, re-ranked — bounded to
+    # the same top ranks each node publishes
+    heat = snap.fleet_heat()
+    for rank, h in enumerate(heat["hot"][:8]):
+        base = f"{prefix}_heat_hot_{rank}"
+        lines.append(f"# TYPE {base}_obj gauge")
+        lines.append(f"{base}_obj {h['obj']}")
+        lines.append(f"# TYPE {base}_count gauge")
+        lines.append(f"{base}_count {h['count']}")
     hists = snap.fleet_histograms()
     import math
 

@@ -1,0 +1,666 @@
+"""Heat & placement observatory — per-subtree traffic attribution,
+on-device top-k/Zipf sketches, and a shard/ring placement planner.
+
+Both remaining ROADMAP tentpoles — mesh-sharded fleets and partial
+replication — are *placement decisions over the object axis*, and the
+reference's own heritage (ported from Basho's ``riak_dt``, `lib.rs:1-2`;
+Riak places objects on a consistent-hash ring with replication factor
+k << N) says the hard part is balancing k-owner load under skew.
+Nothing before this module measured *where* traffic lands: PR 17's
+serve path and the oplog write path count volume, not per-object heat.
+In the observatory-before-subsystem pattern of PRs 9/13/14/15, three
+measurement planes land the numbers first:
+
+* **Per-subtree heat accumulation** — every serve gather batch (read
+  heat, split by consistency mode), every oplog fold batch (write
+  heat), and every sync delta row-set (repair heat: which objects
+  churn over the wire) folds through one jitted scatter-add kernel
+  into per-subtree counters aligned to the PR 15
+  :func:`~crdt_tpu.obs.stability.subtree_layout` — the digest tree's
+  top-children ranges, i.e. the shard sync unit the mesh and
+  partial-replication items will shard on.  Lifetime totals publish as
+  ``heat.subtree.<i>.{reads,writes,repair}`` counters (they ride the
+  PR 6 fleet lattice's G-Counter read, so ``/fleet`` sums them across
+  nodes); half-life-decayed EWMA windows publish as
+  ``heat.subtree.<i>.{reads,writes,repair}_per_s`` gauges.
+
+* **Hot-object identification** — a batched Space-Saving top-k sketch
+  updated entirely on device (:func:`_sketch_kernel`: in-batch
+  aggregation by sort + segment-sum, matched entries scatter-add,
+  unmatched candidates enter at ``total + table_min`` with their
+  per-entry overestimate recorded in an error column, one
+  ``lax.top_k`` keeps the table).  Decoded counts are OVERestimates by
+  at most each entry's ``err``; ``count - err`` is the classic
+  guaranteed lower bound, and that is what the Zipf rank-frequency fit
+  (:func:`zipf_fit`) consumes so tail churn does not flatten the
+  estimated exponent.  The fitted ``heat.zipf.s_hat`` is checkable
+  against :class:`~crdt_tpu.utils.workload.WorkloadGen`'s ``zipf_s``
+  ground truth.  Sketches are join-semilattices (same-object counts
+  SUM across nodes, :func:`merge_hot`), so per-node top-k gauges merge
+  into a fleet-wide hot list on ``/fleet``.
+
+* **Placement planner** — :func:`score_plan` prices hypothetical
+  placements against measured heat at subtree granularity: ``mesh:S``
+  scores S-way contiguous object-range shardings (per-shard load,
+  ``imbalance = max/mean`` — the ``shard_map`` balance bill), and
+  ``ring:N,k=K`` scores hash-ring k-owner layouts (per-owner load
+  ``skew`` plus ``movement_frac``: the heat-weighted fraction of
+  replica assignments that differ from the same ring before its newest
+  owner joined — the consistent-hash stability bill, ~1/N for a sane
+  ring vs ~1 for mod-N).  Served at ``GET /heat`` (``?format=json``,
+  ``?plan=mesh:8``, ``?plan=ring:5,k=3``).
+
+Cluster nodes own private trackers (same discipline as the lag and
+stability observers) so in-process fleets keep their attribution
+apart; standalone serve loops and sync sessions fall back to the
+process-global :func:`tracker`.  All registry writes go through an
+injectable :class:`~crdt_tpu.obs.metrics.MetricsRegistry` so fleet
+tests can capture genuinely per-node slices.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import metrics as metrics_mod
+
+#: traffic classes, in publication order
+CLASSES = ("reads", "writes", "repair")
+
+#: Space-Saving table width — error bound is ~(untracked mass / capacity)
+DEFAULT_CAPACITY = 128
+
+#: EWMA half-life for the *_per_s gauges
+DEFAULT_HALFLIFE_S = 30.0
+
+#: top-k ranks exported as heat.hot.<rank>.{obj,count} gauges
+HOT_GAUGE_RANKS = 8
+
+#: decoded ranks offered to the Zipf rank-frequency fit
+ZIPF_FIT_RANKS = 32
+
+#: minimum positive ranks before a fit is attempted
+MIN_FIT_RANKS = 6
+
+#: update batches pad to pow2 with this floor (same ladder discipline
+#: as the serve gathers, so the jit cache stays a short rung list)
+PAD_FLOOR = 8
+
+#: virtual points per owner on the scored hash ring
+RING_VNODES = 64
+
+
+def _host_int():
+    """host id/weight dtype matching the jit default (int64 under x64,
+    int32 otherwise) so trace-ladder dtypes and runtime dtypes agree."""
+    import numpy as np
+    from ..config import enable_x64
+    return np.int64 if enable_x64() else np.int32
+
+
+def _pad_pow2(ids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """pad ids to a pow2 batch (floor 8); padding rows carry weight 0
+    so the kernels never count them."""
+    import numpy as np
+    b = max(PAD_FLOOR, 1 << max(0, int(ids.size) - 1).bit_length())
+    out = np.zeros(b, dtype=ids.dtype)
+    out[:ids.size] = ids
+    w = np.zeros(b, dtype=ids.dtype)
+    w[:ids.size] = 1
+    return out, w
+
+
+# -- jitted heat kernels -------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_kernel(subtrees: int, span: int):
+    """ids → per-subtree scatter-add (``segment = id // span``), the
+    attribution half of every record call.  Integer lattice: the fold
+    is order-free, so batches may arrive in any interleaving."""
+    import jax
+    import jax.numpy as jnp
+    from .kernels import observed_kernel
+
+    def kernel(ids, weights):
+        sub = jnp.clip(ids // span, 0, subtrees - 1)
+        return jnp.zeros((subtrees,), weights.dtype).at[sub].add(weights)
+
+    return observed_kernel("obs.heat.subtree_fold")(jax.jit(kernel))
+
+
+@functools.lru_cache(maxsize=None)
+def _sketch_kernel(capacity: int):
+    """One batched Space-Saving update, entirely on device.
+
+    In-batch duplicates aggregate first (sort by id, change-flag
+    cumsum segment ids, segment-sum), matched table entries scatter-add
+    their group totals, unmatched groups become candidates entering at
+    ``total + min(table)`` with that floor recorded as their ``err``
+    (the per-entry overestimate Space-Saving guarantees), and one
+    ``top_k`` over the ``capacity + batch`` pool keeps the table.
+    Padding rows (weight 0) are never live, and candidate count ``-1``
+    rows can never displace the table's always-``>= 0`` entries."""
+    import jax
+    import jax.numpy as jnp
+    from .kernels import observed_kernel
+
+    def kernel(tab_ids, tab_counts, tab_errs, ids, weights):
+        b = ids.shape[0]
+        order = jnp.argsort(ids)
+        sid = ids[order]
+        sw = weights[order]
+        starts = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sid[1:] != sid[:-1]])
+        seg = jnp.cumsum(starts) - 1
+        totals = jax.ops.segment_sum(sw, seg, num_segments=b)
+        first = jax.ops.segment_min(jnp.arange(b), seg, num_segments=b)
+        gid = sid[jnp.clip(first, 0, b - 1)]
+        live = totals > 0
+        hit = (tab_ids[:, None] == gid[None, :]) & live[None, :]
+        grown = tab_counts + jnp.sum(
+            jnp.where(hit, totals[None, :], 0), axis=1)
+        floor = jnp.min(grown)
+        fresh = live & ~jnp.any(hit, axis=0)
+        cand_counts = jnp.where(fresh, totals + floor, -1)
+        cand_errs = jnp.where(fresh, floor, 0)
+        top, idx = jax.lax.top_k(
+            jnp.concatenate([grown, cand_counts]), capacity)
+        all_ids = jnp.concatenate([tab_ids, gid])
+        all_errs = jnp.concatenate([tab_errs, cand_errs])
+        return all_ids[idx], jnp.maximum(top, 0), all_errs[idx]
+
+    return observed_kernel("obs.heat.sketch_update")(jax.jit(kernel))
+
+
+# -- Zipf rank-frequency fit ---------------------------------------------------
+
+
+def zipf_fit(counts: Sequence[float]) -> Tuple[Optional[float],
+                                               Optional[float]]:
+    """Least-squares fit of ``log(count)`` vs ``log(rank)`` over the
+    positive counts (sorted descending, rank 1-based): a Zipf(s) law
+    is a line of slope ``-s``.  Returns ``(s_hat, r2)``, or
+    ``(None, None)`` below :data:`MIN_FIT_RANKS` usable ranks."""
+    import numpy as np
+    c = np.asarray([v for v in counts if v > 0], dtype=np.float64)
+    if c.size < MIN_FIT_RANKS:
+        return None, None
+    c = np.sort(c)[::-1]
+    x = np.log(np.arange(1, c.size + 1, dtype=np.float64))
+    y = np.log(c)
+    slope, intercept = np.polyfit(x, y, 1)
+    fitted = slope * x + intercept
+    ss_res = float(np.sum((y - fitted) ** 2))
+    ss_tot = float(np.sum((y - np.mean(y)) ** 2))
+    r2 = 1.0 if ss_tot <= 0 else 1.0 - ss_res / ss_tot
+    return float(-slope), float(r2)
+
+
+def merge_hot(hot_lists: Sequence[Sequence[dict]]) -> List[dict]:
+    """Join decoded per-node sketches host-side: counts (and error
+    bounds) for the same object SUM — the sketch's semilattice join —
+    then re-rank.  Input rows are :meth:`HeatTracker.snapshot`'s
+    ``hot`` entries (``{"obj", "count", "err"}``)."""
+    acc: Dict[int, int] = {}
+    err: Dict[int, int] = {}
+    for hot in hot_lists:
+        for h in hot:
+            obj = int(h["obj"])
+            acc[obj] = acc.get(obj, 0) + int(h["count"])
+            err[obj] = err.get(obj, 0) + int(h.get("err", 0))
+    ranked = sorted(acc.items(), key=lambda kv: (-kv[1], kv[0]))
+    return [{"obj": o, "count": c, "err": err[o]} for o, c in ranked]
+
+
+# -- the placement planner -----------------------------------------------------
+
+
+def parse_plan(spec: str) -> Tuple[str, Dict[str, int]]:
+    """``"mesh:8"`` → ``("mesh", {"shards": 8})``;
+    ``"ring:5,k=3"`` → ``("ring", {"owners": 5, "k": 3})``.
+    ValueError on anything else (the ``/heat`` route surfaces it)."""
+    kind, sep, rest = spec.partition(":")
+    kind = kind.strip().lower()
+    try:
+        if kind == "mesh" and sep:
+            shards = int(rest.strip())
+            if shards < 1:
+                raise ValueError
+            return "mesh", {"shards": shards}
+        if kind == "ring" and sep:
+            head, _, tail = rest.partition(",")
+            owners = int(head.strip())
+            k = 2
+            if tail:
+                kk, _, kv = tail.partition("=")
+                if kk.strip() != "k":
+                    raise ValueError
+                k = int(kv.strip())
+            if owners < 1 or k < 1:
+                raise ValueError
+            return "ring", {"owners": owners, "k": k}
+    except ValueError:
+        pass
+    raise ValueError(
+        "bad plan spec %r (want mesh:<shards> or ring:<owners>[,k=<k>])"
+        % (spec,))
+
+
+def _ring_hash(key: str) -> int:
+    # stable across processes (python's hash() is salted per run)
+    return int.from_bytes(
+        hashlib.blake2b(key.encode(), digest_size=8).digest(), "big")
+
+
+def _ring_owners(names: Sequence[str], subtrees: int,
+                 k: int) -> List[Tuple[str, ...]]:
+    """subtree → k-owner preference list on a blake2b ring with
+    :data:`RING_VNODES` virtual points per owner (distinct successor
+    owners clockwise from the subtree's point — Riak's preference
+    list, at subtree granularity)."""
+    points = sorted(
+        (_ring_hash("%s#%d" % (name, v)), name)
+        for name in names for v in range(RING_VNODES))
+    hashes = [p[0] for p in points]
+    owners: List[Tuple[str, ...]] = []
+    import bisect
+    for s in range(subtrees):
+        at = bisect.bisect_right(hashes, _ring_hash("subtree-%d" % s))
+        chosen: List[str] = []
+        for off in range(len(points)):
+            name = points[(at + off) % len(points)][1]
+            if name not in chosen:
+                chosen.append(name)
+                if len(chosen) == k:
+                    break
+        owners.append(tuple(chosen))
+    return owners
+
+
+def _imbalance(loads: np.ndarray) -> float:
+    import numpy as np
+    mean = float(np.mean(loads))
+    return 1.0 if mean <= 0 else float(np.max(loads)) / mean
+
+
+def score_plan(spec: str, heat: np.ndarray, *, n: int,
+               span: int) -> dict:
+    """Score one placement spec against a measured per-subtree heat
+    vector (any non-negative weights; the tracker passes
+    reads+writes+repair totals).  Pure host arithmetic — the planner
+    prices layouts, it does not move data."""
+    import numpy as np
+    kind, params = parse_plan(spec)
+    heat = np.asarray(heat, dtype=np.float64)
+    subtrees = int(heat.size)
+    total = float(np.sum(heat))
+    out = {"plan": spec, "kind": kind, "heat_total": round(total, 3),
+           "granularity": {"subtrees": subtrees, "span": int(span),
+                           "objects": int(n)}}
+    if kind == "mesh":
+        shards = params["shards"]
+        bounds = [int(round(s * n / shards)) for s in range(shards + 1)]
+        loads = np.zeros(shards, dtype=np.float64)
+        for i in range(subtrees):
+            lo, hi = i * span, min((i + 1) * span, n)
+            width = max(hi - lo, 1)
+            for s in range(shards):
+                ov = min(hi, bounds[s + 1]) - max(lo, bounds[s])
+                if ov > 0:
+                    # subtree heat spread uniformly over its object
+                    # range — subtree granularity is all we measured
+                    loads[s] += heat[i] * ov / width
+        out.update(
+            shards=shards,
+            loads=[round(float(v), 3) for v in loads],
+            max_load=round(float(np.max(loads)) if shards else 0.0, 3),
+            mean_load=round(float(np.mean(loads)) if shards else 0.0, 3),
+            imbalance=round(_imbalance(loads), 4))
+        return out
+    owners = params["owners"]
+    k = min(params["k"], owners)
+    names = ["node-%d" % i for i in range(owners)]
+    assign = _ring_owners(names, subtrees, k)
+    loads = {name: 0.0 for name in names}
+    for i, chosen in enumerate(assign):
+        for name in chosen:
+            loads[name] += float(heat[i]) / k
+    load_vec = np.asarray(list(loads.values()), dtype=np.float64)
+    # movement bill: replica assignments that differ from the same
+    # ring before its newest owner joined (~1/N for a sane ring; a
+    # naive mod-N placement would move ~everything)
+    moved = 0.0
+    if owners > 1:
+        prev = _ring_owners(names[:-1], subtrees, min(k, owners - 1))
+        for i, chosen in enumerate(assign):
+            gained = set(chosen) - set(prev[i])
+            moved += float(heat[i]) * len(gained) / k
+    out.update(
+        owners=owners, k=k, vnodes=RING_VNODES,
+        loads={name: round(v, 3) for name, v in loads.items()},
+        skew=round(_imbalance(load_vec), 4),
+        movement_frac=round(moved / total, 4) if total > 0 else 0.0)
+    return out
+
+
+# -- the tracker ---------------------------------------------------------------
+
+
+class HeatTracker:
+    """Per-node heat attribution: serve loops call
+    :meth:`record_reads`, the gossip drain calls :meth:`record_writes`,
+    sync sessions call :meth:`record_repair`; the gossip round cadence
+    calls :meth:`publish`.  ``registry=`` injects a private
+    :class:`~crdt_tpu.obs.metrics.MetricsRegistry` (fleet tests);
+    ``clock=`` injects time for deterministic EWMA tests."""
+
+    def __init__(self, *, capacity: int = DEFAULT_CAPACITY,
+                 halflife_s: float = DEFAULT_HALFLIFE_S,
+                 registry=None, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._clock = clock
+        self._capacity = int(capacity)
+        self._halflife_s = float(halflife_s)
+        self._t0 = clock()
+        self._n = 0
+        self._subtrees = 0
+        self._span = 1
+        self._totals: Dict[str, np.ndarray] = {}
+        self._ewma: Dict[str, np.ndarray] = {}
+        self._rows = {cls: 0 for cls in CLASSES}
+        self._mode_reads: Dict[str, int] = {}
+        self._sketch = None  # (ids, counts, errs) device arrays
+        self._updates = 0
+        self._last_publish = None  # (t, {cls: totals copy})
+
+    # -- recording -------------------------------------------------------------
+
+    def record_reads(self, obj_ids, n: int, mode: str = "eventual"):
+        """Fold one serve gather batch (row object ids) as read heat,
+        attributed to ``mode``'s admission class."""
+        self._record("reads", obj_ids, n, mode=mode)
+
+    def record_writes(self, obj_ids, n: int):
+        """Fold one oplog drain batch (``OpBatch.obj``) as write heat."""
+        self._record("writes", obj_ids, n)
+
+    def record_repair(self, obj_ids, n: int):
+        """Fold one applied sync delta row-set as repair heat — the
+        objects that actually churned over the wire."""
+        self._record("repair", obj_ids, n)
+
+    def _record(self, cls: str, obj_ids, n: int, mode=None):
+        import numpy as np
+        ids = np.asarray(obj_ids).reshape(-1)
+        if ids.size == 0 or n <= 0:
+            return
+        ids = ids.astype(_host_int(), copy=False)
+        with self._lock:
+            # helpers compute, this lexically-locked frame assigns —
+            # the lock-discipline lint's calling convention
+            if int(n) > self._n:
+                (self._n, self._subtrees, self._span, self._totals,
+                 self._ewma, self._last_publish) = self._grow_layout(int(n))
+            per = self._fold_locked(ids)
+            self._totals[cls] += per
+            self._rows[cls] += int(ids.size)
+            if mode is not None:
+                self._mode_reads[mode] = (
+                    self._mode_reads.get(mode, 0) + int(ids.size))
+            self._sketch = self._sketch_fold(ids)
+            self._updates += 1
+            reg = self._reg()
+            for i in np.flatnonzero(per):
+                self._inc_subtree(reg, cls, int(i), int(per[i]))
+            if mode is not None:
+                reg.counter_inc(f"heat.reads.{mode}", int(ids.size))
+            reg.counter_inc("heat.updates")
+
+    @staticmethod
+    def _inc_subtree(reg, cls: str, i: int, v: int):
+        # literal name tails per class — the telemetry lint reads these
+        # call sites, and heat.subtree.*.<class> rows must stay
+        # distinct from the *_per_s gauge rows
+        if cls == "reads":
+            reg.counter_inc(f"heat.subtree.{i}.reads", v)
+        elif cls == "writes":
+            reg.counter_inc(f"heat.subtree.{i}.writes", v)
+        else:
+            reg.counter_inc(f"heat.subtree.{i}.repair", v)
+
+    def _reg(self):
+        return self._registry if self._registry is not None \
+            else metrics_mod.registry()
+
+    def registry(self):
+        """The :class:`~crdt_tpu.obs.metrics.MetricsRegistry` this
+        tracker publishes into — the injected private one, else the
+        process default (what the ``/heat`` prom scrape renders)."""
+        return self._reg()
+
+    def _grow_layout(self, n: int) -> tuple:
+        """Compute the post-growth layout state for ``n > self._n``
+        WITHOUT touching self (caller holds the lock and assigns):
+        ``(n, subtrees, span, totals, ewma, last_publish)``."""
+        import numpy as np
+        from . import stability as stability_mod
+        subtrees, span = stability_mod.subtree_layout(n)
+        if self._n == 0:
+            totals = {cls: np.zeros(subtrees, np.int64)
+                      for cls in CLASSES}
+            ewma = {cls: np.zeros(subtrees, np.float64)
+                    for cls in CLASSES}
+            return n, subtrees, span, totals, ewma, self._last_publish
+        if (subtrees, span) == (self._subtrees, self._span):
+            return (n, subtrees, span, self._totals, self._ewma,
+                    self._last_publish)
+
+        # the fleet regrew past a span boundary: old spans divide the
+        # new span (both TREE_K powers), so old subtree ranges nest
+        # whole inside new ones — re-bin exactly
+        def rebin(old, dtype):
+            new = np.zeros(subtrees, dtype)
+            for i in range(self._subtrees):
+                new[min(i * self._span // span, subtrees - 1)] += old[i]
+            return new
+
+        totals = {cls: rebin(self._totals[cls], np.int64)
+                  for cls in CLASSES}
+        ewma = {cls: rebin(self._ewma[cls], np.float64)
+                for cls in CLASSES}
+        last = self._last_publish
+        if last is not None:
+            t, prev = last
+            last = (t, {cls: rebin(prev[cls], np.int64)
+                        for cls in CLASSES})
+        return n, subtrees, span, totals, ewma, last
+
+    def _fold_locked(self, ids: np.ndarray) -> np.ndarray:
+        import numpy as np
+        pad_ids, w = _pad_pow2(ids)
+        out = _fold_kernel(self._subtrees, self._span)(pad_ids, w)
+        return np.asarray(out).astype(np.int64)
+
+    def _sketch_fold(self, ids: np.ndarray) -> tuple:
+        """One device sketch update — returns the new table (caller
+        holds the lock and assigns ``self._sketch``)."""
+        import numpy as np
+        table = self._sketch
+        if table is None:
+            z = np.zeros(self._capacity, dtype=ids.dtype)
+            table = (np.full(self._capacity, -1, ids.dtype),
+                     z, z.copy())
+        pad_ids, w = _pad_pow2(ids)
+        return _sketch_kernel(self._capacity)(*table, pad_ids, w)
+
+    # -- decoding / publication ------------------------------------------------
+
+    def _decode_hot_locked(self) -> List[dict]:
+        import numpy as np
+        if self._sketch is None:
+            return []
+        ids = np.asarray(self._sketch[0])
+        counts = np.asarray(self._sketch[1])
+        errs = np.asarray(self._sketch[2])
+        keep = np.flatnonzero((ids >= 0) & (counts > 0))
+        order = keep[np.argsort(-counts[keep], kind="stable")]
+        return [{"obj": int(ids[i]), "count": int(counts[i]),
+                 "err": int(errs[i])} for i in order]
+
+    @staticmethod
+    def _zipf(hot: List[dict]) -> Tuple[Optional[float],
+                                        Optional[float]]:
+        # fit on the GUARANTEED counts (count - err): tail entries that
+        # rode in on churn carry err ~ count, drop out of the fit, and
+        # stop flattening the slope
+        return zipf_fit(
+            [h["count"] - h["err"] for h in hot[:ZIPF_FIT_RANKS]])
+
+    def publish(self):
+        """Refresh the gauge surface: EWMA ``*_per_s`` rates (half-life
+        :attr:`halflife_s`; the first publish seeds the window with the
+        lifetime mean rate), top-:data:`HOT_GAUGE_RANKS` hot-object
+        gauges, and the fitted Zipf exponent."""
+        import numpy as np
+        with self._lock:
+            if self._n == 0:
+                return
+            now = self._clock()
+            reg = self._reg()
+            totals = {cls: self._totals[cls].copy() for cls in CLASSES}
+            if self._last_publish is None:
+                dt = max(now - self._t0, 1e-9)
+                for cls in CLASSES:
+                    self._ewma[cls] = totals[cls] / dt
+            else:
+                t0, prev = self._last_publish
+                dt = max(now - t0, 1e-9)
+                alpha = 1.0 - 0.5 ** (dt / self._halflife_s)
+                for cls in CLASSES:
+                    rate = (totals[cls] - prev[cls]) / dt
+                    self._ewma[cls] = (alpha * rate
+                                       + (1.0 - alpha) * self._ewma[cls])
+            self._last_publish = (now, totals)
+            for i in range(self._subtrees):
+                reg.gauge_set(f"heat.subtree.{i}.reads_per_s",
+                              float(self._ewma["reads"][i]))
+                reg.gauge_set(f"heat.subtree.{i}.writes_per_s",
+                              float(self._ewma["writes"][i]))
+                reg.gauge_set(f"heat.subtree.{i}.repair_per_s",
+                              float(self._ewma["repair"][i]))
+            hot = self._decode_hot_locked()
+            for rank in range(min(HOT_GAUGE_RANKS, len(hot))):
+                reg.gauge_set(f"heat.hot.{rank}.obj",
+                              float(hot[rank]["obj"]))
+                reg.gauge_set(f"heat.hot.{rank}.count",
+                              float(hot[rank]["count"]))
+            s_hat, r2 = self._zipf(hot)
+            if s_hat is not None:
+                reg.gauge_set("heat.zipf.s_hat", s_hat)
+                reg.gauge_set("heat.zipf.fit_r2", r2)
+
+    def hot(self, k: int = HOT_GAUGE_RANKS) -> List[dict]:
+        """decoded top-k ``{"obj", "count", "err"}`` rows, hottest first."""
+        with self._lock:
+            return self._decode_hot_locked()[:k]
+
+    def snapshot(self) -> dict:
+        """The JSON the ``/heat`` route serves."""
+        with self._lock:
+            hot = self._decode_hot_locked()
+            s_hat, r2 = self._zipf(hot)
+            sub = []
+            for i in range(self._subtrees):
+                sub.append({
+                    "reads": int(self._totals["reads"][i]),
+                    "writes": int(self._totals["writes"][i]),
+                    "repair": int(self._totals["repair"][i]),
+                    "reads_per_s": round(float(self._ewma["reads"][i]), 3),
+                    "writes_per_s": round(float(self._ewma["writes"][i]), 3),
+                    "repair_per_s": round(float(self._ewma["repair"][i]), 3),
+                })
+            return {
+                "layout": {"objects": self._n,
+                           "subtrees": self._subtrees,
+                           "span": self._span},
+                "rows": dict(self._rows),
+                "updates": self._updates,
+                "reads_by_mode": dict(self._mode_reads),
+                "subtree": sub,
+                "hot": hot[:ZIPF_FIT_RANKS],
+                "sketch": {
+                    "capacity": self._capacity,
+                    # worst per-entry overestimate among kept entries
+                    "error_bound": max([h["err"] for h in hot], default=0),
+                },
+                "zipf": {"s_hat": s_hat, "r2": r2},
+            }
+
+    # -- planning --------------------------------------------------------------
+
+    def heat_vector(self) -> np.ndarray:
+        """reads+writes+repair per subtree — what the planner scores."""
+        import numpy as np
+        with self._lock:
+            if self._subtrees == 0:
+                return np.zeros(0, np.float64)
+            out = np.zeros(self._subtrees, np.float64)
+            for cls in CLASSES:
+                out += self._totals[cls]
+            return out
+
+    def plan_report(self, spec: str) -> dict:
+        """Score one ``mesh:<S>`` / ``ring:<N>[,k=<K>]`` placement spec
+        against this node's measured heat (:func:`score_plan`)."""
+        import numpy as np
+        with self._lock:
+            heat = np.zeros(max(self._subtrees, 1), np.float64)
+            for cls in CLASSES:
+                if cls in self._totals:
+                    heat[:self._subtrees] += self._totals[cls]
+            return score_plan(spec, heat, n=max(self._n, 1),
+                              span=self._span)
+
+    def reset(self):
+        with self._lock:
+            self._n = 0
+            self._subtrees = 0
+            self._span = 1
+            self._totals = {}
+            self._ewma = {}
+            self._rows = {cls: 0 for cls in CLASSES}
+            self._mode_reads = {}
+            self._sketch = None
+            self._updates = 0
+            self._last_publish = None
+            self._t0 = self._clock()
+
+
+# -- the default (process-global) tracker -------------------------------------
+
+_DEFAULT: Optional[HeatTracker] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def tracker() -> HeatTracker:
+    """The process-global heat tracker — what standalone serve loops
+    and sync sessions feed and ``GET /heat`` serves by default
+    (cluster nodes own private ones so in-process fleets keep their
+    attribution apart)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = HeatTracker()
+    return _DEFAULT
+
+
+#: package-level alias (``crdt_tpu.obs.heat_tracker``) — the
+#: un-shadowed name next to ``convergence.tracker`` / ``stability_tracker``
+heat_tracker = tracker

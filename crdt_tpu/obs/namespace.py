@@ -458,6 +458,13 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "per-batch serve wall (admission park included)"),
     NameSpec("serve.park_wait", "histogram",
              "admission park wall per parked batch"),
+    NameSpec("serve.latency.*", "histogram",
+             "per-batch serve wall by consistency mode "
+             "(eventual/ryw/monotonic/frontier) — the PR 17 gap: "
+             "serve.read_latency aggregated, nothing split by mode"),
+    NameSpec("serve.park_wait_s", "histogram",
+             "admission park duration in seconds per parked batch "
+             "(what /healthz's serve section reports as wall)"),
     NameSpec("serve.frames.decoded", "counter", "accepted serve frames"),
     NameSpec("serve.frames.rejected.*", "counter",
              "rejected serve frames by reason (truncated/"
@@ -541,6 +548,38 @@ NAMESPACE: tuple[NameSpec, ...] = (
              "XLA profiler trace setups that failed (exception class "
              "in the one-time obs.profiler_unavailable event) — why "
              "the trace directory is empty"),
+    # -- heat & placement observatory (obs/heat.py) --------------------------
+    NameSpec("heat.subtree.*.reads", "counter",
+             "read rows attributed to digest-tree subtree <i> "
+             "(serve gather batches folded by obs.heat.subtree_fold)"),
+    NameSpec("heat.subtree.*.writes", "counter",
+             "write rows attributed to subtree <i> (oplog drain "
+             "batches)"),
+    NameSpec("heat.subtree.*.repair", "counter",
+             "sync delta rows applied in subtree <i> — anti-entropy "
+             "churn, the objects that actually moved over the wire"),
+    NameSpec("heat.subtree.*.reads_per_s", "gauge",
+             "half-life-decayed read rate for subtree <i>"),
+    NameSpec("heat.subtree.*.writes_per_s", "gauge",
+             "half-life-decayed write rate for subtree <i>"),
+    NameSpec("heat.subtree.*.repair_per_s", "gauge",
+             "half-life-decayed repair rate for subtree <i>"),
+    NameSpec("heat.reads.*", "counter",
+             "read rows attributed per consistency mode "
+             "(eventual/ryw/monotonic/frontier)"),
+    NameSpec("heat.updates", "counter",
+             "heat record batches folded (sketch + subtree kernels)"),
+    NameSpec("heat.hot.*.obj", "gauge",
+             "object id at hot rank <r> from the Space-Saving sketch"),
+    NameSpec("heat.hot.*.count", "gauge",
+             "sketch count at hot rank <r> (overestimate by at most "
+             "the entry's recorded error)"),
+    NameSpec("heat.zipf.s_hat", "gauge",
+             "Zipf exponent fitted from the sketch's guaranteed "
+             "rank-frequency counts (checkable vs WorkloadGen.zipf_s)"),
+    NameSpec("heat.zipf.fit_r2", "gauge",
+             "goodness of the Zipf rank-frequency fit (1 = a clean "
+             "power law)"),
     # -- bench probes (bench.py bench_obs_overhead) --------------------------
     NameSpec("obs.overhead.count_probe", "counter",
              "bench_obs_overhead per-op counter cost probe"),

@@ -35,7 +35,8 @@ import numpy as np
 from ..error import ConsistencyUnavailableError
 from ..utils import tracing
 from . import consistency as cons
-from .query import ReadRequest, ResultFrame, gather, infer_kind
+from .query import (ReadRequest, ResultFrame, _plane_rows, gather,
+                    infer_kind)
 
 _SENTINEL = object()
 
@@ -146,7 +147,9 @@ class ServeLoop:
             )
         tracing.count(f"serve.admit.{req.mode}")
         if parked:
-            reg.observe("serve.park_wait", time.perf_counter() - t0)
+            park_wall = time.perf_counter() - t0
+            reg.observe("serve.park_wait", park_wall)
+            reg.observe("serve.park_wait_s", park_wall)
         # node serving is single-kind (the node holds one dense batch);
         # a request naming a different kind is a caller error, not wire
         node_kind = infer_kind(snapshot)
@@ -159,6 +162,16 @@ class ServeLoop:
         frame = gather(snapshot, req.obj, member=req.member,
                        kind=node_kind)
         frame.token = vv
+        if len(req):
+            # read heat: this gather batch's rows, attributed to the
+            # admission mode (node-private tracker when the node has
+            # one; the process-global otherwise)
+            heat = getattr(self.node, "heat", None)
+            if heat is None:
+                from ..obs import heat as obs_heat
+                heat = obs_heat.tracker()
+            heat.record_reads(req.obj, _plane_rows(snapshot, node_kind),
+                              mode=req.mode)
         if req.mode == cons.MODE_FRONTIER:
             frame.status = cons.stability_statuses(
                 frame, subtree_clocks, span)
@@ -167,6 +180,7 @@ class ServeLoop:
                 tracing.count("serve.not_stable_rows", bad)
         wall = time.perf_counter() - t0
         reg.observe("serve.read_latency", wall)
+        reg.observe(f"serve.latency.{req.mode}", wall)
         if wall > 0 and len(frame):
             reg.gauge_set("serve.reads_per_s", len(frame) / wall)
         return frame

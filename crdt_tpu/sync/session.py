@@ -232,7 +232,8 @@ class SyncSession:
                  digest_tree: bool = False,
                  protocol_version: Optional[int] = None,
                  lag_tracker=None,
-                 stability=None):
+                 stability=None,
+                 heat=None):
         if not 0.0 <= full_state_threshold <= 1.0:
             raise ValueError(
                 f"full_state_threshold {full_state_threshold} not in [0, 1]"
@@ -315,6 +316,12 @@ class SyncSession:
         #: stability frontier minimizes over.  None = the process-global
         #: tracker (cluster nodes pass their private one).
         self.stability = stability
+        #: a :class:`crdt_tpu.obs.heat.HeatTracker` — the placement
+        #: observatory's repair plane: every applied delta row-set
+        #: (streamed chunks and lock-step frames alike) records which
+        #: objects churned over the wire.  None = the process-global
+        #: tracker (cluster nodes pass their private one).
+        self.heat = heat
         self._user_digest_fn = digest_fn
         self._digest_fn = digest_fn or self._canonical_digest
         self._applier = OrswotDeltaApplier(universe)
@@ -344,6 +351,12 @@ class SyncSession:
     def _stability(self) -> obs_stability.StabilityTracker:
         return self.stability if self.stability is not None \
             else obs_stability.tracker()
+
+    def _heat(self):
+        if self.heat is not None:
+            return self.heat
+        from ..obs import heat as obs_heat
+        return obs_heat.tracker()
 
     @property
     def _wire_version(self) -> int:
@@ -1046,6 +1059,7 @@ class SyncSession:
                     self.batch, ids, blobs, self.universe,
                     applier=self._applier
                 )
+            self._heat().record_repair(ids, self._n())
 
     def _send_full(self, send, report: SyncReport) -> None:
         with self._prof.clock("serialize"):
@@ -1078,6 +1092,7 @@ class SyncSession:
                     self.batch, ids, blobs, self.universe,
                     applier=self._applier
                 )
+            self._heat().record_repair(ids, n)
         else:
             raise SyncProtocolError(
                 f"expected a delta/full frame, peer sent type {ftype:#04x}"

@@ -282,7 +282,8 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 gc_interval: int = 1, gc_hysteresis: float = 0.5,
                 digest_tree: bool = False, zipf_s: float = 0.0,
                 burst_len: int = 1, durable_dir: str | None = None,
-                kill_sweep: int = 2, window: int | None = None) -> int:
+                kill_sweep: int = 2, window: int | None = None,
+                heat: bool = False) -> int:
     """N in-process replicas over real loopback TCP, reconciled by the
     cluster runtime (``crdt_tpu/cluster``): each node owns a listener
     (accepted sessions run through the same hardened transport stack),
@@ -927,6 +928,62 @@ def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
         assert violations == 0, \
             "lattice auditor recorded violations on a healthy run"
 
+    if heat and live:
+        # the heat observatory's read of the run: every node carries a
+        # private HeatTracker fed by its own serve loop (reads), op
+        # drain (writes), and sync sessions (repair); here the per-node
+        # views are joined host-side — the same reduction /fleet serves
+        from crdt_tpu.obs import heat as heat_mod
+
+        for node in live:
+            node.heat.publish()
+        vecs = [node.heat.heat_vector() for node in live]
+        width = max((v.size for v in vecs), default=0)
+        fleet_heat = np.zeros(max(width, 1), np.float64)
+        for v in vecs:
+            fleet_heat[:v.size] += v
+        merged_hot = heat_mod.merge_hot([node.heat.hot(16) for node in live])
+        layout = live[0].heat.snapshot()["layout"]
+        rows = {cls: sum(n.heat.snapshot()["rows"][cls] for n in live)
+                for cls in heat_mod.CLASSES}
+        print(
+            f"heat: {int(fleet_heat.sum())} attributed rows across "
+            f"{width} subtree(s) (span={layout['span']}) — "
+            f"reads={rows['reads']} writes={rows['writes']} "
+            f"repair={rows['repair']}", flush=True)
+        if merged_hot:
+            top = ", ".join(f"#{h['obj']}x{h['count']}"
+                            for h in merged_hot[:8])
+            print(f"heat: top-k (fleet-merged, +-err<="
+                  f"{max(h['err'] for h in merged_hot)}): {top}",
+                  flush=True)
+        for spec in (f"mesh:{n_peers}", f"ring:{n_peers},k=2"):
+            rep = heat_mod.score_plan(
+                spec, fleet_heat, n=n_objects, span=layout["span"])
+            if rep["kind"] == "mesh":
+                print(f"heat: plan {spec}: imbalance="
+                      f"{rep['imbalance']} (max={rep['max_load']} "
+                      f"mean={rep['mean_load']})", flush=True)
+            else:
+                print(f"heat: plan {spec}: skew={rep['skew']} "
+                      f"movement_frac={rep['movement_frac']}",
+                      flush=True)
+        if zipf_s and len(merged_hot) >= heat_mod.MIN_FIT_RANKS:
+            s_hat, r2 = heat_mod.zipf_fit(
+                [h["count"] - h["err"]
+                 for h in merged_hot[:heat_mod.ZIPF_FIT_RANKS]])
+            if s_hat is not None and rows["writes"] >= 2_000:
+                print(f"heat: zipf s_hat={s_hat:.3f} (r2={r2:.3f}) vs "
+                      f"driver s={zipf_s}", flush=True)
+                # loose bar: the demo's write volume is tiny next to
+                # the bench's, and repair heat rides the same sketch
+                assert abs(s_hat - zipf_s) <= 0.4, (
+                    f"sketch-fitted Zipf exponent {s_hat:.3f} far from "
+                    f"the driver's {zipf_s}")
+            elif s_hat is not None:
+                print(f"heat: zipf s_hat={s_hat:.3f} (r2={r2:.3f}; "
+                      f"too few writes to assert)", flush=True)
+
     if gc_enabled:
         # per-node reclamation story + the watermark clock GC last
         # collected under (the element-wise min over every peer's
@@ -1064,6 +1121,13 @@ def main() -> int:
     ap.add_argument("--burst", type=int, default=1, metavar="B",
                     help="with --ops: each drawn key repeats for B "
                          "consecutive writes (bursty sessions)")
+    ap.add_argument("--heat", action="store_true",
+                    help="with --gossip: print the heat observatory's "
+                         "read of the run at convergence — fleet-merged "
+                         "top-k hot objects, per-subtree read/write/"
+                         "repair split, and scored mesh:N + ring:N,k=2 "
+                         "placement plans (with --zipf: asserts the "
+                         "sketch's fitted exponent against the driver's)")
     ap.add_argument("--durable", default=None, metavar="DIR",
                     help="with --gossip: arm every node with a durable "
                          "snapshot store + op-log WAL under DIR/n<i> "
@@ -1112,7 +1176,7 @@ def main() -> int:
                            zipf_s=args.zipf, burst_len=args.burst,
                            durable_dir=args.durable,
                            kill_sweep=args.kill_sweep,
-                           window=args.window)
+                           window=args.window, heat=args.heat)
 
     if args.window is not None and args.window < 0:
         ap.error("--window needs N >= 0")

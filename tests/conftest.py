@@ -84,6 +84,12 @@ def pytest_configure(config):
         "kernels, session-consistency admission, read frame codec, "
         "serve loop); tier-1 like `sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "heat: heat & placement observatory tests (crdt_tpu.obs.heat — "
+        "subtree traffic attribution, the top-k/Zipf sketch, the "
+        "placement planner, the /heat route); tier-1 like `sync`",
+    )
 
 
 # -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------

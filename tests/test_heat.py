@@ -1,0 +1,466 @@
+"""Heat & placement observatory tests — per-subtree traffic
+attribution, the on-device top-k/Zipf sketch, the shard/ring placement
+planner, and the ``/heat`` route (crdt_tpu/obs/heat.py, ISSUE 18).
+
+The acceptance pins: (1) per-subtree attribution lands in exactly the
+bins PR 15's ``subtree_layout`` defines (one scatter-add, checked
+against a host ``np.bincount``); (2) on a seeded
+``WorkloadGen(zipf_s=1.2)`` mixed run the sketch's top-16 recall is
+>= 0.9 against exact counts and the fitted Zipf exponent is within
++-0.15 of ground truth; (3) heat rides the PR 6 fleet lattice with its
+ACI guarantees — re-delivered slices never double-count, and the
+fleet-merged per-subtree heat of a live 3-node gossip fleet equals the
+sum of the per-node trackers; (4) ``GET /heat?plan=mesh:8`` returns a
+scored placement report while the fleet is gossiping.
+"""
+
+import itertools
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from crdt_tpu.batch import OrswotBatch
+from crdt_tpu.cluster import (
+    ClusterNode,
+    GossipScheduler,
+    Membership,
+    RetryPolicy,
+    queue_pair,
+)
+from crdt_tpu.config import CrdtConfig
+from crdt_tpu.obs import export as obs_export
+from crdt_tpu.obs import fleet as obs_fleet
+from crdt_tpu.obs import heat as obs_heat
+from crdt_tpu.obs import metrics as obs_metrics
+from crdt_tpu.obs.stability import subtree_layout
+from crdt_tpu.scalar.orswot import Orswot
+from crdt_tpu.utils.interning import Universe
+from crdt_tpu.utils.workload import WorkloadGen
+
+pytestmark = pytest.mark.heat
+
+FAST = RetryPolicy(send_deadline_s=3.0, recv_deadline_s=3.0,
+                   ack_timeout_s=0.05, max_backoff_s=0.3,
+                   retry_budget=400)
+
+
+def _http_get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _tracker(**kw):
+    kw.setdefault("registry", obs_metrics.MetricsRegistry())
+    return obs_heat.HeatTracker(**kw)
+
+
+# ---- subtree attribution ---------------------------------------------------
+
+
+def test_fold_alignment_matches_subtree_layout():
+    """The scatter-add lands every object row in exactly the bin
+    ``subtree_layout`` assigns it — checked against a host bincount
+    over ids // span, per traffic class."""
+    n = 1_000
+    subtrees, span = subtree_layout(n)
+    rng = np.random.RandomState(7)
+    trk = _tracker()
+    reads = rng.randint(0, n, 3_000).astype(np.int64)
+    writes = rng.randint(0, n, 1_500).astype(np.int64)
+    repair = rng.randint(0, n, 700).astype(np.int64)
+    trk.record_reads(reads, n)
+    trk.record_writes(writes, n)
+    trk.record_repair(repair, n)
+    snap = trk.snapshot()
+    assert snap["layout"] == {"objects": n, "subtrees": subtrees,
+                              "span": span}
+    for cls, ids in (("reads", reads), ("writes", writes),
+                     ("repair", repair)):
+        want = np.bincount(ids // span, minlength=subtrees)
+        got = np.array([row[cls] for row in snap["subtree"]])
+        assert np.array_equal(got, want), f"{cls} mis-binned"
+    assert snap["rows"] == {"reads": 3_000, "writes": 1_500,
+                            "repair": 700}
+
+
+def test_layout_regrowth_rebins_exactly():
+    """Growing the object space re-bins accumulated heat onto the new
+    span without losing a row: old spans divide new spans (TREE_K
+    powers), so the re-bin is exact, and post-growth attribution equals
+    a tracker that saw the large layout from the start."""
+    small_n, big_n = 100, 10_000
+    ids = np.arange(small_n, dtype=np.int64)
+    late = np.random.RandomState(3).randint(
+        0, big_n, 2_000).astype(np.int64)
+    grown = _tracker()
+    grown.record_reads(ids, small_n)
+    grown.record_reads(late, big_n)
+    fresh = _tracker()
+    fresh.record_reads(ids, big_n)
+    fresh.record_reads(late, big_n)
+    gs, fs = grown.snapshot(), fresh.snapshot()
+    assert gs["layout"] == fs["layout"]
+    assert [r["reads"] for r in gs["subtree"]] == \
+        [r["reads"] for r in fs["subtree"]]
+    assert int(sum(r["reads"] for r in gs["subtree"])) == \
+        small_n + 2_000
+
+
+# ---- the top-k / Zipf sketch -----------------------------------------------
+
+
+def test_sketch_topk_recall_and_zipf_estimate():
+    """ISSUE 18 acceptance on the sketch: seeded
+    ``WorkloadGen(zipf_s=1.2)`` mixed traffic at N=1000 — top-16
+    recall >= 0.9 vs exact counts, fitted exponent within +-0.15."""
+    n, batch, total = 1_000, 4_096, 40_960
+    gen = WorkloadGen(n, seed=29, zipf_s=1.2, read_frac=0.5)
+    trk = _tracker()
+    exact = np.zeros(n, np.int64)
+    for _ in range(total // batch):
+        keys, is_read = gen.draw_mixed(batch)
+        np.add.at(exact, keys, 1)
+        reads, writes = keys[is_read], keys[~is_read]
+        if reads.size:
+            trk.record_reads(reads, n)
+        if writes.size:
+            trk.record_writes(writes, n)
+    hot = trk.hot(16)
+    true_top = set(np.argsort(-exact, kind="stable")[:16].tolist())
+    recall = len({h["obj"] for h in hot} & true_top) / 16
+    assert recall >= 0.9, f"top-16 recall {recall}"
+    snap = trk.snapshot()
+    s_hat = snap["zipf"]["s_hat"]
+    assert s_hat is not None and abs(s_hat - 1.2) <= 0.15, \
+        f"zipf estimate {s_hat} vs ground truth 1.2"
+    # Space-Saving guarantee: count overestimates by at most err, and
+    # count - err never exceeds the exact frequency
+    for h in hot:
+        assert h["count"] >= exact[h["obj"]] >= h["count"] - h["err"]
+    assert snap["sketch"]["error_bound"] >= 0
+
+
+def test_merge_hot_is_a_join():
+    """Cross-node hot-list merging is a commutative, obj-keyed sum —
+    the host-side half of the sketch's semilattice join."""
+    a = [{"obj": 1, "count": 10, "err": 1},
+         {"obj": 2, "count": 5, "err": 0}]
+    b = [{"obj": 2, "count": 7, "err": 2},
+         {"obj": 3, "count": 6, "err": 0}]
+    ab, ba = obs_heat.merge_hot([a, b]), obs_heat.merge_hot([b, a])
+    assert ab == ba
+    assert ab[0] == {"obj": 2, "count": 12, "err": 2}
+    assert {h["obj"]: h["count"] for h in ab} == {1: 10, 2: 12, 3: 6}
+
+
+# ---- the placement planner -------------------------------------------------
+
+
+def test_plan_parse_and_scores():
+    heat = np.array([100.0, 10.0, 10.0, 10.0])
+    n, span = 64, 16
+    mesh = obs_heat.score_plan("mesh:2", heat, n=n, span=span)
+    assert mesh["kind"] == "mesh" and mesh["shards"] == 2
+    # shard 0 carries the hot half: subtrees 0+1 = 110 of 130
+    assert mesh["loads"] == [110.0, 20.0]
+    assert mesh["imbalance"] == pytest.approx(110.0 / 65.0, abs=1e-3)
+    ring = obs_heat.score_plan("ring:5,k=3", heat, n=n, span=span)
+    assert ring["kind"] == "ring" and ring["owners"] == 5
+    assert ring["k"] == 3
+    # every unit of heat is replicated onto exactly k owners at 1/k
+    # weight, so the ring conserves total heat
+    assert sum(ring["loads"].values()) == pytest.approx(130.0)
+    assert ring["skew"] >= 1.0 and 0.0 <= ring["movement_frac"] <= 1.0
+    for bad in ("mesh:0", "ring:3,k=0", "tree:4", "mesh:x", ""):
+        with pytest.raises(ValueError):
+            obs_heat.parse_plan(bad)
+
+
+def test_plan_report_prefers_balanced_split():
+    """A deliberately lopsided heat vector scores worse (higher
+    imbalance) under fewer shards than under subtree-granular shards —
+    the signal an operator reads off the report."""
+    n = 256
+    trk = _tracker()
+    hot = np.zeros(4_000, np.int64)  # all heat in subtree 0
+    trk.record_reads(hot, n)
+    one = trk.plan_report("mesh:1")
+    sixteen = trk.plan_report("mesh:16")
+    assert one["imbalance"] == 1.0  # one shard is trivially "balanced"
+    assert sixteen["imbalance"] > 1.0
+    assert sixteen["max_load"] == pytest.approx(4_000.0)
+
+
+# ---- the fleet lattice ride ------------------------------------------------
+
+
+def test_fleet_merge_never_double_counts():
+    """ACI sweep: per-node heat counters ride the fleet G-Counter read
+    — merging a re-delivered slice (idempotence), merging in any order
+    (commutativity), and bracketed groupings (associativity) all
+    produce the same fleet heat."""
+    slices = []
+    per_node = []
+    for i in range(3):
+        reg = obs_metrics.MetricsRegistry()
+        trk = _tracker(registry=reg)
+        ids = np.arange(0, 1_000, i + 1, dtype=np.int64)
+        trk.record_reads(ids, 1_000)
+        trk.record_writes(ids[: ids.size // 2], 1_000)
+        trk.publish()
+        per_node.append(trk)
+        slices.append(obs_fleet.capture_slice(f"n{i}", registry=reg))
+
+    def heat_of(snap):
+        return snap.fleet_heat()
+
+    merged = slices[0].merge(slices[1]).merge(slices[2])
+    want = heat_of(merged)
+    # idempotence: re-delivering n1's slice changes nothing
+    assert heat_of(merged.merge(slices[1])) == want
+    # commutativity + associativity
+    assert heat_of(slices[2].merge(slices[0]).merge(slices[1])) == want
+    assert heat_of(slices[0].merge(slices[1].merge(slices[2]))) == want
+    # and the fleet value IS the sum of the per-node trackers
+    vecs = [t.heat_vector() for t in per_node]
+    for i in range(max(v.size for v in vecs)):
+        fleet_total = sum(
+            v for name, v in want["subtree"].items()
+            if name.startswith(f"heat.subtree.{i}."))
+        assert fleet_total == sum(
+            int(v[i]) for v in vecs if i < v.size)
+
+
+# ---- the live 3-node fleet + /heat route -----------------------------------
+
+
+def _uni(num_actors=8, member_capacity=24, deferred_capacity=4):
+    return Universe.identity(CrdtConfig(
+        num_actors=num_actors, member_capacity=member_capacity,
+        deferred_capacity=deferred_capacity, counter_bits=32))
+
+
+def _orswot_fleet(n, seed, actor=1, extra_on=()):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        s = Orswot()
+        for _ in range(rng.randint(1, 5)):
+            s.apply(s.add(int(rng.randint(0, 50)),
+                          s.value().derive_add_ctx(0)))
+        out.append(s)
+    for i in extra_on:
+        s = out[i]
+        s.apply(s.add(900 + actor, s.value().derive_add_ctx(actor)))
+    return out
+
+
+def _mesh(n_nodes, n_objects):
+    """Clean 3-way queue-pair gossip mesh; every node carries a PRIVATE
+    HeatTracker + MetricsRegistry so per-node attribution stays apart
+    in one process (what distinct hosts get for free)."""
+    uni = _uni(num_actors=max(8, n_nodes + 2))
+    nodes, regs = [], []
+    for i in range(n_nodes):
+        batch = OrswotBatch.from_scalar(
+            _orswot_fleet(n_objects, seed=41, actor=i + 1,
+                          extra_on=[(3 * i + k) % n_objects
+                                    for k in range(3)]), uni)
+        reg = obs_metrics.MetricsRegistry()
+        regs.append(reg)
+        nodes.append(ClusterNode(
+            f"n{i}", batch, uni, busy_timeout_s=5.0,
+            heat_tracker=obs_heat.HeatTracker(registry=reg)))
+
+    seeds = itertools.count(9_000)
+
+    def make_dialer(i):
+        def dial(peer):
+            j = int(peer.peer_id[1:])
+            s = next(seeds)
+            ta, tb = queue_pair(default_timeout=10.0)
+            from crdt_tpu.cluster import ResilientTransport
+            ra = ResilientTransport(ta, FAST, name=f"n{i}->n{j}",
+                                    seed=s)
+            rb = ResilientTransport(tb, FAST, name=f"n{j}->n{i}",
+                                    seed=s + 1)
+
+            def serve():
+                try:
+                    nodes[j].accept(rb, peer_id=f"n{i}")
+                except Exception:
+                    pass
+                finally:
+                    rb.close()
+
+            threading.Thread(target=serve, daemon=True).start()
+            return ra
+        return dial
+
+    scheds = []
+    for i in range(n_nodes):
+        m = Membership(suspect_after=3, dead_after=6)
+        for j in range(n_nodes):
+            if j != i:
+                m.add(f"n{j}")
+        scheds.append(GossipScheduler(
+            nodes[i], m, make_dialer(i), fanout=n_nodes - 1,
+            session_timeout_s=60.0, seed=i))
+    return nodes, regs, scheds
+
+
+def test_acceptance_fleet_heat_on_live_gossip():
+    """ISSUE 18 acceptance: a live 3-node gossip fleet with writes,
+    serve reads and sync repair — the fleet-merged per-subtree heat
+    equals the sum of the per-node trackers, and ``GET /heat`` answers
+    (prom text, JSON, and a scored ``?plan=mesh:8`` report) while the
+    fleet is still gossiping."""
+    n_objects = 96
+    nodes, regs, scheds = _mesh(3, n_objects)
+    gen = WorkloadGen(n_objects, seed=17, zipf_s=1.1)
+    rng = np.random.RandomState(17)
+    srv = obs_export.start_metrics_server(port=0, heat=nodes[0].heat)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        for rnd in range(4):
+            for i, node in enumerate(nodes):
+                if rnd < 2:
+                    node.submit_writes(
+                        gen.draw(40),
+                        rng.randint(200, 216, 40).astype(np.int32),
+                        actor=i + 1)
+                scheds[i].run_round()
+            if rnd == 1:
+                # scrape mid-run: the observatory answers while sync
+                # sessions are in flight
+                status, text = _http_get(f"{base}/heat")
+                assert status == 200
+                assert "crdt_tpu_heat_updates_total" in text
+        from crdt_tpu.serve import ReadRequest
+        for i, node in enumerate(nodes):
+            node.serve_reads(ReadRequest.reads(gen.draw(64) % n_objects))
+
+        status, body = _http_get(f"{base}/heat?format=json")
+        snap = json.loads(body)
+        assert status == 200 and snap["updates"] > 0
+        assert sum(snap["rows"].values()) > 0
+
+        status, body = _http_get(f"{base}/heat?plan=mesh:8")
+        rep = json.loads(body)["report"]
+        assert status == 200 and rep["kind"] == "mesh"
+        assert rep["shards"] == 8 and len(rep["loads"]) == 8
+        assert rep["imbalance"] >= 1.0
+
+        status, body = _http_get(f"{base}/heat?plan=ring:5,k=3")
+        rep = json.loads(body)["report"]
+        assert rep["kind"] == "ring" and rep["k"] == 3
+
+        try:
+            _http_get(f"{base}/heat?plan=tree:9")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        else:
+            raise AssertionError("bogus plan spec did not 400")
+
+        # the fleet reduction: merged per-subtree heat == sum of the
+        # per-node trackers (each node published, so hot gauges ride
+        # along too)
+        for node in nodes:
+            node.heat.publish()
+        merged = obs_fleet.capture_slice("n0", registry=regs[0])
+        for i in range(1, 3):
+            merged = merged.merge(
+                obs_fleet.capture_slice(f"n{i}", registry=regs[i]))
+        fh = merged.fleet_heat()
+        vecs = [node.heat.heat_vector() for node in nodes]
+        width = max(v.size for v in vecs)
+        assert width > 0, "no heat attributed on a live fleet"
+        for i in range(width):
+            fleet_total = sum(
+                v for name, v in fh["subtree"].items()
+                if name.startswith(f"heat.subtree.{i}."))
+            assert fleet_total == sum(
+                int(v[i]) for v in vecs if i < v.size), \
+                f"fleet heat != sum of per-node heat in subtree {i}"
+        # all three planes fired: writes on every node, repair on any
+        # node that applied a delta, reads on every node
+        rows = [node.heat.snapshot()["rows"] for node in nodes]
+        assert all(r["writes"] > 0 for r in rows)
+        assert all(r["reads"] > 0 for r in rows)
+        assert any(r["repair"] > 0 for r in rows)
+        assert srv.scraped("/heat")
+    finally:
+        srv.stop()
+
+
+# ---- serve latency satellites ----------------------------------------------
+
+
+def test_serve_latency_histograms_and_healthz_durations():
+    """Satellite (a): the serve loop publishes per-mode
+    ``serve.latency.<mode>`` histograms plus ``serve.park_wait_s``,
+    and ``/healthz`` reports durations (count/mean/max), not just
+    counts."""
+    uni = _uni()
+    batch = OrswotBatch.from_scalar(_orswot_fleet(16, seed=5), uni)
+    from crdt_tpu.oplog import OpLog
+    node = ClusterNode("nh", batch, uni, oplog=OpLog(uni))
+    from crdt_tpu.serve import ReadRequest
+    before = obs_metrics.registry().snapshot()["histograms"]
+    n_ev = before.get("serve.latency.eventual", {}).get("count", 0)
+    node.serve_reads(ReadRequest.reads(np.arange(8)))
+    node.submit_writes(np.array([1], np.int64),
+                       np.array([201], np.int32), actor=2)
+    node.serve_reads(ReadRequest.reads(
+        [1], member=201, mode="ryw", require=node.write_vv()))
+    hists = obs_metrics.registry().snapshot()["histograms"]
+    assert hists["serve.latency.eventual"]["count"] == n_ev + 1
+    assert hists["serve.latency.ryw"]["count"] >= 1
+    assert hists["serve.latency.eventual"]["sum"] > 0
+
+    srv = obs_export.start_metrics_server(port=0)
+    try:
+        status, body = _http_get(
+            f"http://127.0.0.1:{srv.port}/healthz")
+        serve_sec = json.loads(body)["serve"]
+        assert serve_sec["latency"]["eventual"]["count"] >= 1
+        assert serve_sec["latency"]["eventual"]["mean_s"] >= 0.0
+        assert "max_s" in serve_sec["latency"]["eventual"]
+        assert "park_wait" in serve_sec
+    finally:
+        srv.stop()
+
+
+def test_park_wait_duration_histogram():
+    """A parked-then-admitted RYW read records its wait as a duration
+    (``serve.park_wait_s``), so /healthz can answer "how long do reads
+    wait behind the fold lock" in seconds — staged here by holding the
+    node's fold lock while the write sits queued, then releasing it
+    mid-park."""
+    uni = _uni()
+    batch = OrswotBatch.from_scalar(_orswot_fleet(8, seed=6), uni)
+    from crdt_tpu.oplog import OpLog
+    node = ClusterNode("np", batch, uni, oplog=OpLog(uni))
+    from crdt_tpu.serve import ReadRequest
+    node.serve_reads(ReadRequest.reads([0]))  # build the loop
+    node._serve_loop.park_timeout_s = 5.0
+    before = obs_metrics.registry().snapshot()["histograms"]
+    n0 = before.get("serve.park_wait_s", {}).get("count", 0)
+    assert node._busy.acquire(timeout=5.0)  # a "gossip session"
+    try:
+        node.submit_writes(np.array([0], np.int64),
+                           np.array([205], np.int32), actor=2)
+        ack = node.write_vv()  # log-inclusive: covers the queued op
+    finally:
+        t = threading.Timer(0.05, node._busy.release)
+        t.start()
+    frame = node.serve_reads(ReadRequest.reads(
+        [0], member=205, mode="ryw", require=ack))
+    assert int(frame.val[0]) == 1
+    h = obs_metrics.registry().snapshot()["histograms"]
+    assert h["serve.park_wait_s"]["count"] == n0 + 1
+    assert h["serve.park_wait_s"]["max"] >= 0.02

@@ -3120,6 +3120,47 @@ def bench_kernelcheck():
     }
 
 
+def bench_shardcheck():
+    """Shardcheck coverage gauge: runs the sharding-contract tier
+    exactly as ``scripts/ci.sh`` does — a CPU-pinned subprocess of
+    ``python -m crdt_tpu.analysis --shard --json`` — and reports
+    analyzer wall plus contract-coverage counts.  As with kernelcheck,
+    the trend is the point: every manifested kernel must carry a
+    ShardContract (the manifest refuses undeclared rows, so coverage is
+    structurally 100% — the count that matters here is kernels/cases
+    growing WITH the tree), and a wall-time blowup means the mesh-case
+    ladder is threatening the <60 s CI budget."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "crdt_tpu.analysis", "--shard", "--json"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    out = json.loads(proc.stdout)
+    sc = out["shardcheck"]
+    contracts = " ".join(
+        f"{k}={v}" for k, v in sorted(sc["contracts"].items()))
+    log(
+        f"shardcheck: rc={proc.returncode}  {sc['kernels']} kernels "
+        f"({contracts}; {sc['traced']} traced, {sc['cases']} cases incl "
+        f"{sc['mesh_cases']} mesh-shaped), "
+        f"{len(out['findings'])} finding(s), {sc['elapsed_s']}s"
+    )
+    return {
+        "shardcheck_rc": proc.returncode,
+        "shardcheck_kernels": sc["kernels"],
+        "shardcheck_traced": sc["traced"],
+        "shardcheck_cases": sc["cases"],
+        "shardcheck_mesh_cases": sc["mesh_cases"],
+        "shardcheck_contracts": sc["contracts"],
+        "shardcheck_findings": len(out["findings"]),
+        "shardcheck_trace_errors": len(sc["trace_errors"]),
+        "shardcheck_wall_s": sc["elapsed_s"],
+    }
+
+
 def bench_tpu_validation():
     """On a real TPU backend: compiled-Pallas parity + timing and
     accel-vs-CPU merge parity, in a killable subprocess (a Mosaic hang
@@ -3476,6 +3517,13 @@ def main():
     kc_res = run_stage("kernelcheck", 40, bench_kernelcheck)
     if kc_res is not None:
         emit(**kc_res)
+    # budget-skippable: shardcheck coverage gauge — the sharding-contract
+    # tier's wall time plus per-class contract counts (pointwise /
+    # reduction / replicated / host_only), so the artifact tail shows
+    # contract coverage growing with the kernel manifest
+    sc_res = run_stage("shardcheck", 60, bench_shardcheck)
+    if sc_res is not None:
+        emit(**sc_res)
     # provisional regression tail first: a watchdog kill inside the
     # required validation stage below must not cost the field entirely
     _emit_obs_snapshot()

@@ -11,7 +11,9 @@ class (and three more like it) from "runtime surprise" to "CI failure":
   (:mod:`crdt_tpu.obs.namespace`).
 * :mod:`~crdt_tpu.analysis.locks` — Eraser-style lockset discipline for
   the threaded modules: attributes written both inside and outside
-  ``with self._lock``, and unlocked read-modify-writes.
+  ``with self._lock``, unlocked read-modify-writes, acquisition-order
+  deadlock cycles in the lexical lock-order graph, and blocking
+  syscalls (fsync, sleep, socket I/O) made under a held lock.
 * :mod:`~crdt_tpu.analysis.tracer` — jax tracer hygiene: host coercion
   of traced values inside jit-decorated functions, int64 flowing into
   the Pallas modules (the jax-0.4.x Mosaic-skew class), dict-iteration
@@ -28,6 +30,21 @@ finding in ``crdt_tpu/analysis/baseline.json`` with a justification.
 Stdlib-only by hard contract: the lint never imports jax, numpy, or any
 module that does (``tests/test_analysis.py`` pins this), so it runs in
 <5 s on a box with no accelerator stack at all.
+
+Two deeper tiers share the pragma/baseline/exit-code machinery but DO
+import jax (CPU-pinned, abstract tracing only):
+
+* kernelcheck (``--kernels``, rules KC01-KC05,
+  :mod:`~crdt_tpu.analysis.jaxpr_rules`) — traces every manifested
+  kernel and lints the jaxprs: Mosaic dtype lowering, scatter
+  determinism, baked consts, recompile budgets, hidden callbacks.
+* shardcheck (``--shard``, rules SC01-SC05,
+  :mod:`~crdt_tpu.analysis.shard_rules`) — verifies each kernel's
+  declared object-axis :class:`~crdt_tpu.analysis.kernels.
+  ShardContract` by re-tracing under abstract object meshes: no
+  cross-object data flow in pointwise kernels, collectives lowered
+  exactly as declared, no host round-trips on the mesh hot path, even
+  shard divisibility, per-shard compile budgets.
 """
 
 from .core import (  # noqa: F401

@@ -6,13 +6,24 @@ machine-readable result on stdout (what ``tests/test_analysis.py`` and
 CI consume); the default human output is one ``path:line:col: rule:
 message`` line per finding, grep- and editor-jumpable.
 
-The default (AST) tier never imports jax/numpy — it must run (fast) on
-boxes with no accelerator stack, and tier-1 budgets the whole run under
-5 seconds.  ``--kernels`` runs the SECOND tier instead: kernelcheck
-(:mod:`crdt_tpu.analysis.jaxpr_rules`) imports jax under
-``JAX_PLATFORMS=cpu``, traces every manifested kernel abstractly and
-lints the jaxprs (KC01-KC05); same exit codes, same ``--json`` shape
-plus a ``kernelcheck`` stats block, same baseline file.
+Three tiers, one rule-id range each:
+
+* default (AST) tier — crdtlint proper: stdlib-only by hard contract,
+  never imports jax/numpy, runs in <5 s on a box with no accelerator
+  stack (rules by name: ``telemetry-*``, ``lock-*``, ``tracer-*``,
+  ``wire-*``, ``kernel-manifest``, ...).
+* ``--kernels`` — kernelcheck (:mod:`crdt_tpu.analysis.jaxpr_rules`,
+  **KC01-KC05**): imports jax under ``JAX_PLATFORMS=cpu``, traces every
+  manifested kernel abstractly and lints the jaxprs.
+* ``--shard`` — shardcheck (:mod:`crdt_tpu.analysis.shard_rules`,
+  **SC01-SC05**): checks every manifested kernel against its declared
+  sharding contract (object-axis provenance, collective contracts, host
+  round-trips in mesh hot paths, shard divisibility, per-mesh-size
+  compile budgets), including mesh-shaped trace cases at sizes
+  {1,2,4,8}.
+
+All tiers share exit codes, the ``--json`` shape (plus a per-tier stats
+block), the pragma syntax, and the baseline file.
 """
 
 from __future__ import annotations
@@ -58,14 +69,23 @@ def main(argv=None) -> int:
                         help="run the jaxpr tier (kernelcheck, KC01-KC05) "
                              "instead of the AST lint; imports jax under "
                              "JAX_PLATFORMS=cpu")
+    parser.add_argument("--shard", action="store_true",
+                        help="run the sharding-contract tier (shardcheck, "
+                             "SC01-SC05) instead of the AST lint; imports "
+                             "jax under JAX_PLATFORMS=cpu")
     args = parser.parse_args(argv)
 
-    if args.kernels:
+    if args.kernels and args.shard:
+        print("crdtlint: --kernels and --shard are separate tiers; pick "
+              "one", file=sys.stderr)
+        return 2
+    if args.kernels or args.shard:
         if args.paths or args.rules:
-            print("crdtlint: --kernels takes no paths/--rule (the kernel "
+            flag = "--kernels" if args.kernels else "--shard"
+            print(f"crdtlint: {flag} takes no paths/--rule (the kernel "
                   "manifest defines the scan set)", file=sys.stderr)
             return 2
-        return _main_kernels(args)
+        return _main_kernels(args) if args.kernels else _main_shard(args)
 
     if args.list_rules:
         for name in rule_names():
@@ -175,6 +195,57 @@ def _main_kernels(args) -> int:
         f"{report.cases} trace cases, {len(report.skipped)} declared "
         f"no-trace), {len(result.findings)} finding(s), "
         f"{len(result.suppressed)} pragma-suppressed, "
+        f"{len(result.baselined)} baselined, {report.elapsed_s:.2f}s"
+    )
+    print(("OK: " if result.ok else "FAIL: ") + tallies, file=sys.stderr)
+    return 0 if result.ok else 1
+
+
+def _main_shard(args) -> int:
+    """The --shard tier: trace the manifest against sharding contracts."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    baseline = None
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            baseline = Baseline.load(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"crdtlint: bad baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    from .shard_rules import run_shardcheck
+
+    result, report = run_shardcheck(baseline=baseline)
+
+    if args.as_json:
+        out = result.to_json()
+        out["shardcheck"] = report.to_json()
+        out["elapsed_s"] = report.elapsed_s
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return 0 if result.ok else 1
+
+    for f in result.findings:
+        print(f.render())
+    for err in result.parse_errors:
+        print(f"{err} [trace-error]")
+    for sk in report.skipped:
+        print(f"shardcheck: not traced: {sk['kernel']} ({sk['reason']})",
+              file=sys.stderr)
+    if report.unknown_prims:
+        print("shardcheck: provenance dropped at primitive(s): "
+              + ", ".join(report.unknown_prims), file=sys.stderr)
+    if result.stale_baseline:
+        print(f"shardcheck: {len(result.stale_baseline)} stale baseline "
+              "entr(ies) matched nothing — delete them", file=sys.stderr)
+    contracts = ", ".join(f"{k}={v}"
+                          for k, v in sorted(report.contracts.items()))
+    tallies = (
+        f"{report.kernels} kernels ({contracts}; {report.traced} traced, "
+        f"{report.cases} cases incl {report.mesh_cases} mesh-shaped, "
+        f"{len(report.skipped)} untraced), {len(result.findings)} "
+        f"finding(s), {len(result.suppressed)} pragma-suppressed, "
         f"{len(result.baselined)} baselined, {report.elapsed_s:.2f}s"
     )
     print(("OK: " if result.ok else "FAIL: ") + tallies, file=sys.stderr)

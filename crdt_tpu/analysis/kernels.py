@@ -195,6 +195,121 @@ class KernelSpec:
     hot_path: bool = True         # KC05: host callbacks forbidden
     build: Optional[Callable[[], List[TraceCase]]] = None
     notrace_reason: str = ""
+    sharding: Optional["ShardContract"] = None  # SC01-SC05 (shardcheck)
+
+
+# ---------------------------------------------------------------------------
+# sharding contracts (the third tier: shardcheck, SC01-SC05)
+# ---------------------------------------------------------------------------
+
+#: the declared object-axis shard counts every mesh-shaped kernel must
+#: divide across — the {1,2,4,8} ladder the ROADMAP mesh item plans
+#: shard_map over (SC04 checks every capacity rung against them)
+MESH_SIZES = (1, 2, 4, 8)
+
+SHARD_CLASSES = ("pointwise", "reduction", "replicated", "host_only")
+
+#: collective primitive names a ``reduction`` contract may declare
+#: (SC02: the jaxpr must lower EXACTLY the declared set)
+COLLECTIVE_PRIMS = (
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "reduce_scatter",
+)
+
+#: sentinel leaf index: "every array leaf of the flattened args"
+ALL_LEAVES = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardContract:
+    """Declared object-axis sharding contract for one kernel.
+
+    The mesh PR (ROADMAP: mesh-sharded fleets) shards the *object axis*
+    of the dense planes: local kernels per shard + ICI collectives for
+    the global lattice join.  That decomposition is provably safe only
+    for kernels whose jaxprs respect the object axis — which is exactly
+    what this contract declares and :mod:`shard_rules` verifies:
+
+    ``sclass``
+        * ``"pointwise"`` — every output row depends only on its own
+          object's rows: shard-local execution IS the global answer
+          (``out_specs`` keep the object axis, no collective).  SC01
+          flags any cross-object data flow in the traced jaxpr.
+        * ``"reduction"`` — legitimately folds the object axis (digest
+          tree levels, occupancy totals, frontier folds) or joins
+          across a mesh axis; the global answer needs the declared
+          ``collectives`` (SC02: the jaxpr must lower exactly them —
+          today only the parallel/ joins lower any).
+        * ``"replicated"`` — no object-axis operand at all; runs
+          identically (or shard-locally on routed values) on every
+          shard and must lower no collective.
+        * ``"host_only"`` — off the mesh hot path (snapshot
+          compact/expand, bench scaffolding); never mesh-traced.
+
+    ``obj`` — ``((leaf, axis), ...)``: which flattened arg leaves carry
+    the object axis and at which dim (``(ALL_LEAVES, axis)`` = every
+    leaf).  Leaf order is ``jax.tree_util.tree_leaves`` over the
+    TraceCase args, stable across the ladder.
+
+    ``routed`` — flattened leaf indices whose *values* are object ids
+    (op/read batches): the mesh layer rebases them per shard, so
+    gathers/scatters indexing the object axis through them are
+    sanctioned cross-shard-safe (SC01 exempts routed indexing).
+
+    ``mesh_sizes`` — shard counts this kernel must divide across
+    (default :data:`MESH_SIZES`); restrict with a ``reason`` when the
+    kernel is structurally pinned (e.g. an already-shard-local body).
+
+    ``granule`` — object-axis alignment unit per shard (the digest
+    tree folds in TREE_K=16 blocks); SC04 requires ``size % S == 0``
+    and ``(size // S) % granule == 0`` for every rung with
+    ``size >= S * granule`` (smaller rungs stay dense/replicated).
+    """
+
+    sclass: str
+    obj: tuple = ()           # ((leaf, axis), ...) or ((ALL_LEAVES, axis),)
+    routed: tuple = ()        # leaf indices carrying object-id values
+    collectives: tuple = ()   # reduction: exact collective prims lowered
+    mesh_sizes: tuple = MESH_SIZES
+    granule: int = 1
+    reason: str = ""
+
+
+def _obj_axes(leaves: tuple, axis: int) -> tuple:
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, (int, str)):
+            out.append((leaf, axis))
+        else:
+            out.append(tuple(leaf))
+    return tuple(out)
+
+
+def pointwise(*leaves, axis: int = 0, routed=(), mesh_sizes=MESH_SIZES,
+              granule: int = 1, reason: str = "") -> ShardContract:
+    """Pointwise over objects; no ``leaves`` means every arg leaf
+    carries the object axis at ``axis``."""
+    obj = _obj_axes(leaves or (ALL_LEAVES,), axis)
+    return ShardContract("pointwise", obj, tuple(routed), (),
+                         tuple(mesh_sizes), granule, reason)
+
+
+def reduction(*leaves, axis: int = 0, collectives=(), routed=(),
+              mesh_sizes=MESH_SIZES, granule: int = 1,
+              reason: str = "") -> ShardContract:
+    """Folds the object axis (or joins a mesh axis with the declared
+    collectives); ``leaves`` may be empty for pure mesh-axis joins."""
+    return ShardContract("reduction", _obj_axes(leaves, axis),
+                         tuple(routed), tuple(collectives),
+                         tuple(mesh_sizes), granule, reason)
+
+
+def replicated(reason: str, routed=()) -> ShardContract:
+    return ShardContract("replicated", (), tuple(routed), (), (), 1, reason)
+
+
+def host_only(reason: str) -> ShardContract:
+    return ShardContract("host_only", (), (), (), (), 1, reason)
 
 
 # -- builder helpers (jax/numpy imported lazily, never at module scope) ------
@@ -952,8 +1067,16 @@ _AP = "crdt_tpu/oplog/apply.py"
 MANIFEST: tuple = (
     # batch/orswot_batch.py ---------------------------------------------------
     KernelSpec("batch.orswot.device_nnz", _OB, "_device_nnz",
+               sharding=reduction(
+                   ALL_LEAVES,
+                   reason="global occupancy totals for compact sizing; "
+                          "shard-local counts psum-join"),
                build=_b_orswot_batch("_device_nnz")),
     KernelSpec("batch.orswot.device_compact", _OB, "_device_compact",
+               sharding=host_only(
+                   "snapshot/export path: gathers every object's live "
+                   "cells into flat columns with global-size statics; "
+                   "per-shard snapshots rebind the sizes per shard"),
                build=_b_orswot_batch(
                    "_device_compact",
                    statics=lambda a, m, d: {
@@ -962,189 +1085,310 @@ MANIFEST: tuple = (
                        "with_entries": True})),
     KernelSpec("batch.orswot.device_expand", _OB, "_device_expand",
                determinism="integer-lattice",
+               sharding=host_only(
+                   "snapshot/import inverse of device_compact; the "
+                   "object count is a baked static"),
                build=lambda: _build_device_expand()),
     KernelSpec("batch.orswot.merge", _OB, "_merge",
+               sharding=pointwise(),
                build=_b_orswot_merge()),
     KernelSpec("batch.orswot.fold_tree", _OB, "_fold_tree",
+               sharding=pointwise(axis=1),  # axis 0 is the replica stack
                build=_b_orswot_batch(
                    "_fold_tree", stacked=True,
                    statics=lambda a, m, d: {
                        "m_cap": m, "d_cap": d, "plunger": True,
                        "impl": "rank"})),
     KernelSpec("batch.orswot.apply_add", _OB, "_apply_add",
+               sharding=pointwise(),  # op rows align with object rows
                build=_b_orswot_batch(
                    "_apply_add",
                    extra=lambda a, m, d: (
                        _vec(LADDER_N, "int32"), _vec(LADDER_N, _clock_dt()),
                        _vec(LADDER_N, "int32")))),
     KernelSpec("batch.orswot.apply_remove", _OB, "_apply_remove",
+               sharding=pointwise(),
                build=_b_orswot_batch(
                    "_apply_remove",
                    extra=lambda a, m, d: (
                        _mat((LADDER_N, a), _clock_dt()),
                        _vec(LADDER_N, "int32")))),
     KernelSpec("batch.orswot.truncate", _OB, "_truncate",
+               sharding=pointwise(),
                build=_b_orswot_batch(
                    "_truncate",
                    statics=lambda a, m, d: {"m_cap": m, "d_cap": d},
                    extra=lambda a, m, d: (_mat((LADDER_N, a), _clock_dt()),))),
     # the scalar-plane batch merges ------------------------------------------
     KernelSpec("batch.vclock.merge", "crdt_tpu/batch/vclock_batch.py",
-               "_merge", build=_b_counter_merge(
+               "_merge", sharding=pointwise(),
+               build=_b_counter_merge(
                    "vclock_batch", lambda a: (LADDER_N, a))),
     KernelSpec("batch.gcounter.merge", "crdt_tpu/batch/gcounter_batch.py",
-               "_merge", build=_b_counter_merge(
+               "_merge", sharding=pointwise(),
+               build=_b_counter_merge(
                    "gcounter_batch", lambda a: (LADDER_N, a))),
     KernelSpec("batch.pncounter.merge", "crdt_tpu/batch/pncounter_batch.py",
-               "_merge", build=_b_counter_merge(
+               "_merge", sharding=pointwise(),
+               build=_b_counter_merge(
                    "pncounter_batch", lambda a: (LADDER_N, 2, a))),
     KernelSpec("batch.gset.merge", "crdt_tpu/batch/gset_batch.py",
-               "_merge", build=_b_gset_merge()),
+               "_merge", sharding=pointwise(), build=_b_gset_merge()),
     KernelSpec("batch.lwwreg.merge", "crdt_tpu/batch/lwwreg_batch.py",
-               "_merge", build=_b_lww_merge()),
+               "_merge", sharding=pointwise(), build=_b_lww_merge()),
     KernelSpec("batch.mvreg.merge", "crdt_tpu/batch/mvreg_batch.py",
-               "_merge", build=_b_mvreg("_merge")),
+               "_merge", sharding=pointwise(), build=_b_mvreg("_merge")),
     KernelSpec("batch.mvreg.apply_put", "crdt_tpu/batch/mvreg_batch.py",
-               "_apply_put", build=_b_mvreg("_apply_put")),
+               "_apply_put", sharding=pointwise(),
+               build=_b_mvreg("_apply_put")),
     KernelSpec("batch.mvreg.truncate", "crdt_tpu/batch/mvreg_batch.py",
-               "_truncate", build=_b_mvreg("_truncate", k_static=False)),
+               "_truncate", sharding=pointwise(),
+               build=_b_mvreg("_truncate", k_static=False)),
     # batch/map_batch.py -----------------------------------------------------
     KernelSpec("batch.map.merge", "crdt_tpu/batch/map_batch.py", "_merge",
-               build=_b_map("_merge")),
+               sharding=pointwise(), build=_b_map("_merge")),
     KernelSpec("batch.map.truncate", "crdt_tpu/batch/map_batch.py",
-               "_truncate", build=_b_map("_truncate")),
+               "_truncate", sharding=pointwise(), build=_b_map("_truncate")),
     KernelSpec("batch.map.apply_rm", "crdt_tpu/batch/map_batch.py",
-               "_apply_rm", build=_b_map("_apply_rm")),
+               "_apply_rm", sharding=pointwise(), build=_b_map("_apply_rm")),
     KernelSpec("batch.map.apply_up", "crdt_tpu/batch/map_batch.py",
-               "_apply_up", build=_b_map("_apply_up")),
+               "_apply_up", sharding=pointwise(), build=_b_map("_apply_up")),
     # batch/occupancy.py (the capacity observatory's reductions) -------------
     KernelSpec("batch.occupancy.orswot", "crdt_tpu/batch/occupancy.py",
-               "_orswot_occupancy", build=_b_occupancy("orswot")),
+               "_orswot_occupancy",
+               sharding=reduction(
+                   ALL_LEAVES,
+                   reason="fleet occupancy totals; per-shard counts "
+                          "psum-join"),
+               build=_b_occupancy("orswot")),
     KernelSpec("batch.occupancy.clock", "crdt_tpu/batch/occupancy.py",
-               "_clock_occupancy", build=_b_occupancy("clock")),
+               "_clock_occupancy",
+               sharding=reduction(
+                   ALL_LEAVES,
+                   reason="fleet occupancy totals; per-shard counts "
+                          "psum-join"),
+               build=_b_occupancy("clock")),
     KernelSpec("batch.occupancy.pncounter", "crdt_tpu/batch/occupancy.py",
-               "_pn_occupancy", build=_b_occupancy("pn")),
+               "_pn_occupancy",
+               sharding=reduction(
+                   ALL_LEAVES,
+                   reason="fleet occupancy totals; per-shard counts "
+                          "psum-join"),
+               build=_b_occupancy("pn")),
     KernelSpec("batch.occupancy.map", "crdt_tpu/batch/occupancy.py",
-               "_map_occupancy", build=_b_occupancy("map")),
+               "_map_occupancy",
+               sharding=reduction(
+                   ALL_LEAVES,
+                   reason="fleet occupancy totals; per-shard counts "
+                          "psum-join"),
+               build=_b_occupancy("map")),
     # gc/ (causal garbage collection) ----------------------------------------
     KernelSpec("gc.settle", "crdt_tpu/gc/compact.py", "_settle",
-               build=_b_gc_settle()),
+               sharding=pointwise(), build=_b_gc_settle()),
     KernelSpec("gc.repack", "crdt_tpu/gc/repack.py", "_repack",
-               build=_b_gc_repack()),
+               sharding=pointwise(), build=_b_gc_repack()),
     # batch/wireloop.py ------------------------------------------------------
     KernelSpec("batch.wireloop.fold_merge", "crdt_tpu/batch/wireloop.py",
                "_fold_merge_kernel.<jit>",
+               sharding=pointwise(),
                build=_b_wireloop_merge()),
     # oplog ------------------------------------------------------------------
     KernelSpec("oplog.derive_add_ctx", "crdt_tpu/oplog/records.py",
                "_derive_kernel._derive_kernel_host",
+               sharding=pointwise(0, routed=(1,)),  # clock rows by op obj id
                build=_b_derive_ctx()),
     KernelSpec("oplog.scatter_adds", _AP, "_scatter_adds_kernel.kernel",
                determinism="integer-lattice",
                compile_budget=len(LADDER) + 1,
+               # planes carry the object axis; oo/po are the routed
+               # object-id columns of the op batch
+               sharding=pointwise(0, 1, 2, 3, 4, routed=(5, 9)),
                build=_b_scatter_adds()),
     KernelSpec("oplog.gcounter_scatter", _AP,
                "_counter_scatter_kernel._counter_scatter",
                determinism="integer-lattice",
+               sharding=pointwise(0, routed=(1,)),
                build=_b_oplog_counter("_counter_scatter_kernel", pn=False)),
     KernelSpec("oplog.pncounter_scatter", _AP,
                "_pn_scatter_kernel._pn_scatter",
                determinism="integer-lattice",
+               sharding=pointwise(0, routed=(1,)),
                build=_b_oplog_counter("_pn_scatter_kernel", pn=True)),
     # sync/digest.py ---------------------------------------------------------
     KernelSpec("sync.digest.orswot", "crdt_tpu/sync/digest.py", "_jit.fn",
                compile_budget=len(LADDER) + 1,  # +1: salt-table variant
+               sharding=pointwise(0, 1, 2, 3, 4),  # salt/table leaves ride
                build=_b_digest("orswot")),
     KernelSpec("sync.digest.counter", "crdt_tpu/sync/digest.py", "_jit.fn",
                compile_budget=len(ACTOR_LADDER) + 1,
+               sharding=pointwise(0),
                build=_b_digest("counter")),
     KernelSpec("sync.digest.lww", "crdt_tpu/sync/digest.py", "_jit.fn",
                compile_budget=4,  # 3 sizes + the salt-table variant
+               sharding=pointwise(0, 1),
                build=_b_digest("lww")),
     # sync/tree.py -----------------------------------------------------------
     KernelSpec("sync.tree.fold", "crdt_tpu/sync/tree.py",
                "_fold_kernel.kernel",
                compile_budget=3,  # one lowering per traced level length
+               sharding=reduction(
+                   0, granule=16,  # TREE_K-block folds
+                   reason="k=16 XOR fold over the leaf/level axis; a "
+                          "shard folds its own subtree range, the cut "
+                          "level all_gathers at the root"),
                build=_b_tree_fold("fold")),
     KernelSpec("sync.tree.leaf_mix", "crdt_tpu/sync/tree.py",
                "_leaf_kernel.kernel",
                compile_budget=3,
+               sharding=pointwise(0),  # position mix is per leaf digest
                build=_b_tree_fold("leaf")),
     # obs/stability.py (the convergence observatory's frontier fold) ---------
     KernelSpec("obs.stability.frontier_fold", "crdt_tpu/obs/stability.py",
                "_frontier_kernel.kernel",
                compile_budget=4,  # one lowering per traced (S, span, A)
+               sharding=reduction(
+                   0,
+                   reason="per-subtree VV max-fold over the leaf range; "
+                          "shard-local frontiers pmax-join; the factory "
+                          "rebinds its subtree-count static per shard"),
                build=_b_frontier_fold()),
     # obs/heat.py (the heat & placement observatory) -------------------------
     KernelSpec("obs.heat.subtree_fold", "crdt_tpu/obs/heat.py",
                "_fold_kernel.kernel",
                determinism="integer-lattice",
                compile_budget=8,  # (S, span) statics x pow2 batch rungs
+               sharding=reduction(
+                   routed=(0,),
+                   reason="per-subtree heat accumulated from routed op "
+                          "ids; shard-local heat vectors psum-join"),
                build=_b_heat_fold()),
     KernelSpec("obs.heat.sketch_update", "crdt_tpu/obs/heat.py",
                "_sketch_kernel.kernel",
                determinism="integer-lattice",
                compile_budget=8,  # capacity static x pow2 batch rungs
+               sharding=replicated(
+                   "fleet-global top-k sketch over routed op ids; each "
+                   "shard keeps a local sketch, merged at read time",
+                   routed=(3,)),
                build=_b_heat_sketch()),
     # serve/query.py (the read front-end's gather kernels) -------------------
     KernelSpec("serve.gather.orswot", "crdt_tpu/serve/query.py",
                "_orswot_kernel.kernel",
                compile_budget=2 * len(LADDER),  # capacity x padded batch
+               sharding=pointwise(0, 1, 2, routed=(3,)),
                build=_b_serve_gather("orswot")),
     KernelSpec("serve.gather.counter", "crdt_tpu/serve/query.py",
                "_counter_kernel.kernel",
                compile_budget=len(ACTOR_LADDER),
+               sharding=pointwise(0, routed=(1,)),
                build=_b_serve_gather("counter")),
     KernelSpec("serve.gather.lww", "crdt_tpu/serve/query.py",
                "_lww_kernel.kernel",
+               sharding=pointwise(0, 1, routed=(2,)),
                build=_b_serve_gather("lww")),
     KernelSpec("serve.gather.mvreg", "crdt_tpu/serve/query.py",
                "_mvreg_kernel.kernel",
                compile_budget=len(ACTOR_LADDER),
+               sharding=pointwise(0, 1, routed=(2,)),
                build=_b_serve_gather("mvreg")),
     KernelSpec("serve.gather.map", "crdt_tpu/serve/query.py",
                "_map_kernel.kernel",
                compile_budget=len(LADDER),
+               sharding=pointwise(0, 1, 2, routed=(3,)),
                build=_b_serve_gather("map")),
-    # parallel/collective.py -------------------------------------------------
+    # parallel/collective.py (shard_map joins: the only kernels that
+    # lower collectives TODAY — their contracts declare the exact set) -------
     KernelSpec("parallel.clock_join", _CO, "_clock_join_fn._join",
+               sharding=reduction(
+                   collectives=("pmax",),
+                   reason="fleet-wide clock join over the replica mesh "
+                          "axis; no object axis in the operand"),
                build=_b_collective("clock")),
     KernelSpec("parallel.lww_join", _CO, "_lww_join_fn._join",
+               sharding=reduction(
+                   0, 1, collectives=("all_gather",),
+                   reason="register-wise (ts, mark) join over the "
+                          "replica mesh axis: gathers both replicas' "
+                          "registers and picks the max-ts lane"),
                build=_b_collective("lww")),
     KernelSpec("parallel.mvreg_join", _CO, "_mvreg_join_fn._join",
+               sharding=reduction(
+                   collectives=("all_gather",),
+                   reason="antichain join gathers every replica's "
+                          "candidates before the dominance filter"),
                build=_b_collective("mvreg")),
     KernelSpec("parallel.orswot_join", _CO, "_orswot_join_fn._join",
+               sharding=reduction(
+                   ALL_LEAVES, axis=1,  # axis 0 is the replica shard
+                   collectives=("all_gather",),
+                   reason="plane join gathers replica shards then folds "
+                          "the lattice merge; object axis rides through"),
                build=_b_collective("orswot")),
     KernelSpec("parallel.shard_local_merge", _CO,
                "shard_local_merge_fn._local",
+               sharding=pointwise(
+                   mesh_sizes=(1,),
+                   reason="already the per-shard body of the objects-"
+                          "mesh merge: the object axis arrives pre-"
+                          "sliced to this shard"),
                build=lambda: _build_shard_local_merge()),
     KernelSpec("parallel.map_join", _CO, "_map_join_fn._join",
+               sharding=reduction(
+                   ALL_LEAVES, axis=1,
+                   collectives=("all_gather",),
+                   reason="map-state join gathers replica shards then "
+                          "folds the nested-kernel merge"),
                build=_b_collective("map")),
     KernelSpec("parallel.anti_entropy_fold", _CO,
                "_anti_entropy_kernels._fold",
+               sharding=pointwise(axis=1),  # folds the replica stack
                build=_b_collective("ae_fold")),
     KernelSpec("parallel.anti_entropy_plunge", _CO,
                "_anti_entropy_kernels._plunge",
+               sharding=pointwise(),
                build=_b_collective("ae_plunge")),
     # parallel/member_sharding.py --------------------------------------------
     KernelSpec("parallel.member_clock_join",
                "crdt_tpu/parallel/member_sharding.py",
                "_clock_join_fn._join",
+               sharding=reduction(
+                   (0, 1), collectives=("pmax",),
+                   reason="clock join across the member-shard mesh "
+                          "axis; object axis rides through at dim 1"),
                build=_b_member_sharding("clock")),
     KernelSpec("parallel.member_apply_add",
                "crdt_tpu/parallel/member_sharding.py",
                "_apply_add_fn._local",
+               sharding=pointwise(
+                   (0, 1), (1, 1), (2, 1), (3, 1), (4, 1),
+                   (6, 0), (7, 0), (8, 0),
+                   reason="member-routed add: every shard sees the op, "
+                          "only the owner applies it — shard-local (no "
+                          "collective; the clock rebroadcast is "
+                          "member_clock_join's pmax)"),
                build=_b_member_sharding("apply_add")),
     # ops: the Mosaic-destined Pallas kernels --------------------------------
     KernelSpec("ops.pallas.merge", "crdt_tpu/ops/orswot_pallas.py",
                "merge", mosaic=True,
+               sharding=pointwise(
+                   reason="per-object-row Mosaic merge; SC01 cannot see "
+                          "through the pallas_call region (opaque refs) "
+                          "but the grid partitions the object axis"),
                build=_b_pallas("orswot_pallas", "merge", fold=False)),
     KernelSpec("ops.pallas.fold_merge", "crdt_tpu/ops/orswot_pallas.py",
                "fold_merge", mosaic=True,
+               sharding=pointwise(
+                   axis=1,
+                   reason="replica-stack fold, per object row; pallas "
+                          "region opaque to SC01"),
                build=_b_pallas("orswot_pallas", "fold_merge", fold=True)),
     KernelSpec("ops.fold_aligned.fold_merge",
                "crdt_tpu/ops/orswot_fold_aligned.py",
                "fold_merge", mosaic=True,
+               sharding=pointwise(
+                   axis=1,
+                   reason="replica-stack fold, per object row; pallas "
+                          "region opaque to SC01"),
                build=_b_pallas("orswot_fold_aligned", "fold_merge",
                                fold=True)),
     # utils/benchtime.py: bench-harness scaffolding, manifest-covered but
@@ -1154,10 +1398,14 @@ MANIFEST: tuple = (
     # harness, host sync is their job.
     KernelSpec("utils.benchtime.sync_probe", "crdt_tpu/utils/benchtime.py",
                "sync_overhead.<lambda>", hot_path=False,
+               sharding=host_only("bench-harness warmup probe; host "
+                                  "sync is its whole job"),
                notrace_reason="warmup lambda; shapes fixed at call site, "
                               "no CRDT contract"),
     KernelSpec("utils.benchtime.chain_timer", "crdt_tpu/utils/benchtime.py",
                "chain_timer.run", hot_path=False,
+               sharding=host_only("bench-harness chain timer; host sync "
+                                  "is its whole job"),
                notrace_reason="closure over the caller-supplied step fn; "
                               "shapes are caller-defined"),
 )
@@ -1253,4 +1501,36 @@ def _kernel_manifest_rule(files: List[ParsedFile]):
                 f"stale manifest row {spec.name!r}: no jit site named "
                 f"{spec.jit_name!r} in {spec.path} — the kernel moved or "
                 "was deleted; update the row",
+            )
+    # sharding contracts: 100% coverage, pinned at the source tier so
+    # an un-declared kernel fails CI before shardcheck ever traces it
+    for spec in MANIFEST:
+        c = spec.sharding
+        if c is None:
+            yield Finding(
+                "kernel-manifest", "crdt_tpu/analysis/kernels.py", 1, 0,
+                f"manifest row {spec.name!r} declares no sharding "
+                "contract — every kernel pins its object-axis class "
+                "(pointwise | reduction | replicated | host_only) before "
+                "the mesh PR lands; shardcheck (--shard) cannot verify "
+                "an undeclared row",
+            )
+            continue
+        bad = ""
+        if c.sclass not in SHARD_CLASSES:
+            bad = f"unknown sharding class {c.sclass!r}"
+        elif c.sclass == "pointwise" and not c.obj:
+            bad = "pointwise contracts must name their object-axis leaves"
+        elif any(p not in COLLECTIVE_PRIMS for p in c.collectives):
+            bad = f"unknown collective(s) {c.collectives!r}"
+        elif c.collectives and c.sclass != "reduction":
+            bad = "only reduction contracts declare collectives"
+        elif spec.build is None and c.sclass != "host_only":
+            bad = (f"a build=None row cannot carry a {c.sclass!r} "
+                   "contract (nothing to verify it against) — host_only")
+        if bad:
+            yield Finding(
+                "kernel-manifest", "crdt_tpu/analysis/kernels.py", 1, 0,
+                f"manifest row {spec.name!r}: malformed sharding "
+                f"contract: {bad}",
             )

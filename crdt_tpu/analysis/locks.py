@@ -15,6 +15,18 @@ an AST pass can police:
 * ``unlocked-rmw`` — a read-modify-write (``self.x += n``) outside any
   lock block in a lock-owning class: increments are lost under
   concurrent writers no matter how "atomic" they look.
+* ``lock-order-cycle`` — the lexical lock-order graph (nested ``with
+  self.A: ... with self.B:`` records an A→B acquisition edge per
+  class) contains a cycle: two threads interleaving the two orders
+  deadlock.  Re-acquiring a held non-reentrant ``threading.Lock`` is
+  the one-node case and deadlocks on first execution.
+* ``hold-and-block`` — a blocking call (``fsync``, ``time.sleep``,
+  socket ``send``/``sendall``/``sendto``/``recv``/``recvfrom``/
+  ``accept``/``connect``) made while a lock is lexically held: every
+  thread contending for that lock stalls behind one syscall (an fsync
+  can take tens of milliseconds).  The WAL-append fsync is the
+  canonical deliberate case — its pragma documents that seq
+  assignment and disk order must agree under the same lock.
 
 Classes that own no lock are skipped entirely — single-threaded state
 machines (the wire loop's fold accumulators) and by-contract
@@ -94,26 +106,70 @@ def _self_attr_target(node: ast.AST) -> Optional[str]:
     return None
 
 
-def _lock_attrs(cls: ast.ClassDef) -> set[str]:
-    """Instance attributes of ``cls`` holding locks: ``self.X =
+def _lock_kind(node: ast.AST) -> Optional[str]:
+    """The factory name behind a lock-minting expression (``"Lock"`` /
+    ``"RLock"`` / ``"Condition"``), or None."""
+    if not isinstance(node, ast.Call):
+        return None
+    tail = dotted_name(node.func).rsplit(".", 1)[-1]
+    if tail in _LOCK_FACTORIES:
+        return tail
+    if tail == "field":
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                inner = dotted_name(kw.value).rsplit(".", 1)[-1]
+                if inner in _LOCK_FACTORIES:
+                    return inner
+    return None
+
+
+def _lock_kinds(cls: ast.ClassDef) -> dict[str, str]:
+    """Instance attributes of ``cls`` holding locks (``self.X =
     threading.Lock()`` in any method, or a dataclass field whose
-    default_factory is a lock."""
-    out: set[str] = set()
+    default_factory is a lock) → the factory kind."""
+    out: dict[str, str] = {}
     for node in ast.walk(cls):
-        if isinstance(node, ast.Assign) and _lock_factory_call(node.value):
+        if isinstance(node, ast.Assign):
+            kind = _lock_kind(node.value)
+            if kind is None:
+                continue
             for tgt in node.targets:
                 attr = _self_attr_target(tgt)
                 if attr is not None:
-                    out.add(attr)
-        elif isinstance(node, ast.AnnAssign) and node.value is not None \
-                and _lock_factory_call(node.value):
+                    out[attr] = kind
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            kind = _lock_kind(node.value)
+            if kind is None:
+                continue
             if isinstance(node.target, ast.Name):
-                out.add(node.target.id)  # dataclass field
+                out[node.target.id] = kind  # dataclass field
             else:
                 attr = _self_attr_target(node.target)
                 if attr is not None:
-                    out.add(attr)
+                    out[attr] = kind
     return out
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    return set(_lock_kinds(cls))
+
+
+def _lock_ctx_attr(item: ast.withitem,
+                   lock_attrs: set[str]) -> Optional[str]:
+    """The lock attr a ``with`` item acquires — both ``with
+    self._lock:`` and ``with self._lock.acquire_timeout():`` — or
+    None."""
+    expr = item.context_expr
+    attr = None
+    if isinstance(expr, ast.Attribute):
+        attr = _self_attr_target(expr)
+        if attr is None and isinstance(expr.value, ast.Attribute):
+            attr = _self_attr_target(expr.value)
+    elif isinstance(expr, ast.Call):
+        attr = _self_attr_target(expr.func)
+        if attr is None and isinstance(expr.func, ast.Attribute):
+            attr = _self_attr_target(expr.func.value)
+    return attr if attr in lock_attrs else None
 
 
 class _MethodScan(ast.NodeVisitor):
@@ -127,18 +183,7 @@ class _MethodScan(ast.NodeVisitor):
         self.writes: List[tuple[ast.AST, str, bool, bool]] = []
 
     def _is_lock_ctx(self, item: ast.withitem) -> bool:
-        expr = item.context_expr
-        # both `with self._lock:` and `with self._lock.acquire_timeout()`
-        attr = None
-        if isinstance(expr, ast.Attribute):
-            attr = _self_attr_target(expr)
-            if attr is None and isinstance(expr.value, ast.Attribute):
-                attr = _self_attr_target(expr.value)
-        elif isinstance(expr, ast.Call):
-            attr = _self_attr_target(expr.func)
-            if attr is None and isinstance(expr.func, ast.Attribute):
-                attr = _self_attr_target(expr.func.value)
-        return attr in self.lock_attrs
+        return _lock_ctx_attr(item, self.lock_attrs) is not None
 
     def visit_With(self, node: ast.With) -> None:
         holds = any(self._is_lock_ctx(item) for item in node.items)
@@ -172,6 +217,87 @@ class _MethodScan(ast.NodeVisitor):
         pass
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+
+#: call-name tails that park the calling thread in a syscall (or a
+#: timer) — holding a lock across one of these serializes every
+#: contending thread behind it.  Condition ``.wait`` and thread
+#: ``.join`` are deliberately absent: wait RELEASES the lock, and join
+#: under a lock is a lock-order problem, not a syscall-latency one.
+_BLOCKING_TAILS = {
+    "fsync", "sleep",
+    "send", "sendall", "sendto", "recv", "recvfrom", "accept", "connect",
+}
+
+
+class _OrderScan(ast.NodeVisitor):
+    """Lock-acquisition structure within one method: the stack of held
+    ``self.<lock>`` attrs, the nesting edges between them, and any
+    blocking call made while the stack is non-empty."""
+
+    def __init__(self, lock_attrs: set[str]):
+        self.lock_attrs = lock_attrs
+        self.stack: List[str] = []
+        # (outer_attr, inner_attr, with_node) — outer held when inner
+        # is acquired; outer == inner is a re-acquire
+        self.edges: List[tuple[str, str, ast.AST]] = []
+        # (call_node, dotted_callee, innermost_held_attr)
+        self.blocked: List[tuple[ast.AST, str, str]] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            attr = _lock_ctx_attr(item, self.lock_attrs)
+            if attr is not None:
+                for held in self.stack + acquired:
+                    self.edges.append((held, attr, node))
+                acquired.append(attr)
+        self.stack.extend(acquired)
+        self.generic_visit(node)
+        del self.stack[len(self.stack) - len(acquired):]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.stack:
+            name = dotted_name(node.func)
+            if name.rsplit(".", 1)[-1] in _BLOCKING_TAILS:
+                self.blocked.append((node, name, self.stack[-1]))
+        self.generic_visit(node)
+
+    # nested defs get their own scan via the class walk; don't descend
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _order_scan_class(cls: ast.ClassDef, lock_attrs: set[str]):
+    """Per-method :class:`_OrderScan` results for ``cls``: a list of
+    ``(method_name, scan)``."""
+    out = []
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        scan = _OrderScan(lock_attrs)
+        for stmt in item.body:
+            scan.visit(stmt)
+        out.append((item.name, scan))
+    return out
+
+
+def _reaches(graph: dict[str, set[str]], src: str, dst: str) -> bool:
+    seen: set[str] = set()
+    work = [src]
+    while work:
+        n = work.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        work.extend(graph.get(n, ()))
+    return False
 
 
 def _scan_class(pf: ParsedFile, cls: ast.ClassDef):
@@ -252,4 +378,86 @@ def check_unlocked_rmw(files: List[ParsedFile]) -> Iterable[Finding]:
                         f"{method}() without holding self.{locks} — "
                         "concurrent writers lose increments (the Counter "
                         "contract this registry documents)",
+                    )
+
+
+@rule("lock-order-cycle")
+def check_lock_order_cycle(files: List[ParsedFile]) -> Iterable[Finding]:
+    """Cycles in the lexical per-class lock-order graph (nested ``with
+    self.A: ... with self.B:`` is an A→B edge): two threads taking the
+    two orders deadlock.  Re-acquiring a held non-reentrant ``Lock`` is
+    the one-node cycle."""
+    for pf in files:
+        if not in_scope(pf):
+            continue
+        for cls in ast.walk(pf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            kinds = _lock_kinds(cls)
+            if len(kinds) == 0:
+                continue
+            edges: List[tuple[str, str, ast.AST]] = []
+            for _method, scan in _order_scan_class(cls, set(kinds)):
+                edges.extend(scan.edges)
+            graph: dict[str, set[str]] = {}
+            for outer, inner, node in edges:
+                if outer == inner:
+                    if kinds.get(inner) == "Lock":
+                        yield Finding(
+                            "lock-order-cycle", pf.rel,
+                            node.lineno, node.col_offset,
+                            f"{cls.name} re-acquires self.{inner} while "
+                            "already holding it — threading.Lock is not "
+                            "reentrant, so this deadlocks on first "
+                            "execution; use RLock or drop the inner "
+                            "acquire",
+                        )
+                else:
+                    graph.setdefault(outer, set()).add(inner)
+            reported: set[frozenset] = set()
+            for outer, inner, node in edges:
+                if outer == inner:
+                    continue
+                if _reaches(graph, inner, outer):
+                    key = frozenset((outer, inner))
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    yield Finding(
+                        "lock-order-cycle", pf.rel,
+                        node.lineno, node.col_offset,
+                        f"{cls.name} acquires self.{inner} while holding "
+                        f"self.{outer}, but another path acquires them in "
+                        "the opposite order — two threads interleaving "
+                        "the two orders deadlock; pick one global "
+                        "acquisition order (document it on the class) or "
+                        "collapse to one lock",
+                    )
+
+
+@rule("hold-and-block")
+def check_hold_and_block(files: List[ParsedFile]) -> Iterable[Finding]:
+    """Blocking calls (fsync, sleep, socket send/recv family) made
+    while a ``with self.<lock>`` block is lexically open — one syscall
+    stalls every thread contending for the lock."""
+    for pf in files:
+        if not in_scope(pf):
+            continue
+        for cls in ast.walk(pf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs = _lock_attrs(cls)
+            if not lock_attrs:
+                continue
+            for method, scan in _order_scan_class(cls, lock_attrs):
+                for node, name, held in scan.blocked:
+                    yield Finding(
+                        "hold-and-block", pf.rel,
+                        node.lineno, node.col_offset,
+                        f"{cls.name}.{method}() calls {name}() while "
+                        f"holding self.{held} — a blocking syscall under "
+                        "a lock stalls every contending thread behind "
+                        "one I/O wait; move it outside the critical "
+                        "section, or pragma the deliberate serialization "
+                        "with its reason",
                     )

@@ -379,7 +379,7 @@ class MapBatch:
             )
             return a.merge(b, check)
         state, overflow = _merge(self.state, other.state, self.kernel)
-        if check and bool(np.any(np.asarray(overflow))):
+        if check and bool(np.any(np.asarray(overflow))):  # crdtlint: disable=SC03 — overflow host-raise contract, one bool per batch call
             raise CapacityOverflowError(
                 "MapBatch merge overflow: raise key/deferred/value capacities",
                 member=True, deferred=True,
@@ -433,7 +433,7 @@ class MapBatch:
     def truncate(self, clock: jax.Array, check: bool = True) -> "MapBatch":
         """``Causal::truncate`` (`map.rs:131-158`); ``clock``: u64[N, A]."""
         state, overflow = _truncate(self.state, clock, self.kernel)
-        if check and bool(np.any(np.asarray(overflow))):
+        if check and bool(np.any(np.asarray(overflow))):  # crdtlint: disable=SC03 — overflow host-raise contract, one bool per batch call
             raise ValueError("MapBatch truncate overflow")
         return MapBatch.from_state(state, self.kernel)
 
@@ -442,7 +442,7 @@ class MapBatch:
     def apply_rm(self, rm_clock, key_id, check: bool = True) -> "MapBatch":
         """Batched ``Op::Rm`` (`map.rs:166-168`)."""
         state, overflow = _apply_rm(self.state, rm_clock, key_id, self.kernel)
-        if check and bool(np.any(np.asarray(overflow))):
+        if check and bool(np.any(np.asarray(overflow))):  # crdtlint: disable=SC03 — overflow host-raise contract, one bool per batch call
             raise ValueError("MapBatch apply_rm overflow: raise deferred_capacity")
         return MapBatch.from_state(state, self.kernel)
 
@@ -459,7 +459,7 @@ class MapBatch:
         state, overflow = _apply_up(
             self.state, actor_idx, counter, key_id, nested_args, nested_op, self.kernel
         )
-        if check and bool(np.any(np.asarray(overflow))):
+        if check and bool(np.any(np.asarray(overflow))):  # crdtlint: disable=SC03 — overflow host-raise contract, one bool per batch call
             raise ValueError("MapBatch apply_up overflow: raise key_capacity")
         return MapBatch.from_state(state, self.kernel)
 

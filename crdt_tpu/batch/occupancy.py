@@ -140,7 +140,7 @@ def occupancy_of(batch) -> Occupancy:
     types without dense planes to measure.
     """
     if hasattr(batch, "d_ids") and hasattr(batch, "ids"):
-        stats = np.asarray(_orswot_occupancy(
+        stats = np.asarray(_orswot_occupancy(  # crdtlint: disable=SC03 — occupancy observatory sample point, six ints per gauge cadence
             batch.clock, batch.ids, batch.dots, batch.d_ids, batch.d_clocks
         ))
         n, m = batch.ids.shape
@@ -155,7 +155,7 @@ def occupancy_of(batch) -> Occupancy:
             actors=int(batch.clock.shape[1]), actors_live=int(stats[5]),
         )
     if hasattr(batch, "d_keys") and hasattr(batch, "keys"):
-        stats = np.asarray(_map_occupancy(
+        stats = np.asarray(_map_occupancy(  # crdtlint: disable=SC03 — occupancy observatory sample point, six ints per gauge cadence
             batch.clock, batch.keys, batch.entry_clocks,
             batch.d_keys, batch.d_clocks
         ))
@@ -170,7 +170,7 @@ def occupancy_of(batch) -> Occupancy:
             actors=int(batch.clock.shape[1]), actors_live=int(stats[5]),
         )
     if hasattr(batch, "planes"):
-        stats = np.asarray(_pn_occupancy(batch.planes))
+        stats = np.asarray(_pn_occupancy(batch.planes))  # crdtlint: disable=SC03 — occupancy observatory sample point, six ints per gauge cadence
         n, _, a = batch.planes.shape
         return Occupancy(
             kind="pncounter", objects=n, bytes=_tree_nbytes(batch.planes),
@@ -179,7 +179,7 @@ def occupancy_of(batch) -> Occupancy:
             actors=a, actors_live=int(stats[3]),
         )
     if hasattr(batch, "clocks"):
-        stats = np.asarray(_clock_occupancy(batch.clocks))
+        stats = np.asarray(_clock_occupancy(batch.clocks))  # crdtlint: disable=SC03 — occupancy observatory sample point, six ints per gauge cadence
         n, a = batch.clocks.shape
         kind = type(batch).__name__.removesuffix("Batch").lower()
         return Occupancy(

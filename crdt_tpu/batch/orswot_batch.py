@@ -615,7 +615,7 @@ class OrswotBatch:
             via_device = _on_accelerator(self.clock)
         planes = (self.clock, self.ids, self.dots, self.d_ids, self.d_clocks)
         if via_device:
-            counts = [int(c) for c in np.asarray(_device_nnz(*planes))]
+            counts = [int(c) for c in np.asarray(_device_nnz(*planes))]  # crdtlint: disable=SC03 — snapshot sparsify sizes become statics, host fetch is the point
             if not want_entries:
                 counts[1] = 0
             sizes = tuple(_next_pow2(c) for c in counts)

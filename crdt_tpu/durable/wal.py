@@ -189,7 +189,10 @@ class WalWriter:
             self._fh.write(frame)
             self._fh.flush()
             if self.fsync:
-                os.fsync(self._fh.fileno())
+                # fsync-before-ack inside the lock IS the durability
+                # contract: seq assignment and disk order must agree,
+                # so appends serialize behind the sync by design
+                os.fsync(self._fh.fileno())  # crdtlint: disable=hold-and-block — fsync-before-ack: seq order must match disk order
             self._open_bytes += len(frame)
             seq = self._head_seq
             self._head_seq += 1

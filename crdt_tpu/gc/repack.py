@@ -60,8 +60,11 @@ def _repack(clock, ids, dots, d_ids, d_clocks, m_cap, d_cap):
     flags (the host refuses the shrink rather than dropping state)."""
     ids2, dots2, m_over = orswot_ops.compact_by_id(ids, dots, m_cap)
     d_ids2, d_clocks2, d_over = orswot_ops.compact(d_ids, d_clocks, d_cap)
+    # the scalar overflow flags fold all objects by design: they are
+    # the host's refuse-the-shrink diagnostics; per shard they become
+    # shard-local any bits the host ORs
     return (clock, ids2, dots2, d_ids2, d_clocks2,
-            jnp.any(m_over), jnp.any(d_over))
+            jnp.any(m_over), jnp.any(d_over))  # crdtlint: disable=SC01 — scalar overflow flags, shard-local any + host OR
 
 
 def shrink_plan(occ, *, member_floor: int, deferred_floor: int,

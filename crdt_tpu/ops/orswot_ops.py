@@ -371,7 +371,10 @@ def merge(
     from jax import lax
 
     clock = clock_ops.merge(clock_a, clock_b)
-    any_deferred = jnp.any(dids_a != EMPTY) | jnp.any(dids_b != EMPTY)
+    # the whole-batch cond dispatch reads every object, but both branches
+    # compute the same lattice join — per-shard the predicate just picks
+    # the shard's own fast path, so the fold is a dispatch hint, not data
+    any_deferred = jnp.any(dids_a != EMPTY) | jnp.any(dids_b != EMPTY)  # crdtlint: disable=SC01 — fast-path dispatch, branches agree
     operands = (
         clock, clock_a, ids_a, dots_a, dids_a, dclocks_a,
         clock_b, ids_b, dots_b, dids_b, dclocks_b,

@@ -403,7 +403,10 @@ def _pad_to(x, t, axis=0, fill=0):
         return x
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
-    return jnp.pad(x, widths, constant_values=fill)
+    # tile-alignment tail pad: the phantom rows are EMPTY-filled, sliced
+    # back off after the pallas_call, and under a mesh each shard pads
+    # its own slice — no real object ever crosses a shard boundary here
+    return jnp.pad(x, widths, constant_values=fill)  # crdtlint: disable=SC01 — per-shard tile-alignment pad, sliced off after
 
 
 _ZERO = np.int32(0)  # index-map constants must be 32-bit: under

@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
 # The one CI gate: crdtlint (exit-code gated), kernelcheck (the jaxpr
-# tier, exit-code gated), then the tier-1 pytest line from ROADMAP.md —
+# tier, exit-code gated), shardcheck (the sharding-contract tier,
+# exit-code gated), then the tier-1 pytest line from ROADMAP.md —
 # builder and CI invoke the SAME entrypoint, so "it passed locally" and
 # "it passed in CI" mean the same command.
 #
-#   scripts/ci.sh            # lint + kernelcheck + tier-1
+#   scripts/ci.sh            # lint + kernelcheck + shardcheck + tier-1
 #   scripts/ci.sh --lint     # AST lint only (seconds, jax-free)
 #
 # The tier-1 line mirrors ROADMAP.md "Tier-1 verify" verbatim: CPU
@@ -37,6 +38,27 @@ kc = json.load(open("/tmp/kernelcheck.json"))["kernelcheck"]
 print(f"kernelcheck OK: {kc['kernels']} kernels, {kc['traced']} traced, "
       f"{kc['cases']} cases, {len(kc['skipped'])} declared no-trace, "
       f"{kc['elapsed_s']}s (artifact: /tmp/kernelcheck.json)")
+EOF
+
+echo "== shardcheck =="
+# the sharding-contract tier: re-traces every manifested kernel under
+# abstract object-axis meshes and checks each kernel's declared
+# ShardContract (SC01-SC05).  Same artifact pattern as kernelcheck —
+# the contract-class counts stay diffable from the CI log.
+JAX_PLATFORMS=cpu python -m crdt_tpu.analysis --shard --json \
+    > /tmp/shardcheck.json || {
+    cat /tmp/shardcheck.json
+    echo "shardcheck FAILED (see findings above)" >&2
+    exit 1
+}
+python - <<'EOF'
+import json
+sc = json.load(open("/tmp/shardcheck.json"))["shardcheck"]
+contracts = " ".join(f"{k}={v}" for k, v in sorted(sc["contracts"].items()))
+print(f"shardcheck OK: {sc['kernels']} kernels ({contracts}), "
+      f"{sc['traced']} traced, {sc['cases']} cases incl "
+      f"{sc['mesh_cases']} mesh-shaped, {len(sc['skipped'])} declared "
+      f"no-trace, {sc['elapsed_s']}s (artifact: /tmp/shardcheck.json)")
 EOF
 
 echo "== tier-1 pytest =="

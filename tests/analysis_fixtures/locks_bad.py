@@ -2,9 +2,14 @@
 
 A lock-owning class that writes the same attribute under the lock in
 one method and bare in another, plus an unlocked read-modify-write —
-the lost-increment shape the Counter contract forbids.
+the lost-increment shape the Counter contract forbids.  The two
+deadlock shapes ride along: an acquisition-order cycle between two
+locks (plus a non-reentrant re-acquire), and a blocking syscall made
+while a lock is held.
 """
 
+import os
+import time
 import threading
 
 
@@ -20,7 +25,47 @@ class RacyAccumulator:
             self.last = n
 
     def sneak(self, n):
-        self.last = n                        # line 23: ... unlocked write
+        self.last = n                        # line 29: ... unlocked write
 
     def bump(self):
-        self.total += 1                      # line 26: unlocked RMW
+        self.total += 1                      # line 32: unlocked RMW
+
+
+class DeadlockProne:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:                    # a -> b ...
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:                    # ... and b -> a: cycle
+                pass
+
+    def reenter(self):
+        with self._a:
+            with self._a:                    # non-reentrant re-acquire
+                pass
+
+
+class SyncUnderLock:
+    def __init__(self, fh, sock):
+        self._lock = threading.Lock()
+        self._fh = fh
+        self._sock = sock
+
+    def flush(self):
+        with self._lock:
+            os.fsync(self._fh.fileno())      # fsync under the lock
+
+    def push(self, payload):
+        with self._lock:
+            self._sock.sendall(payload)      # socket send under the lock
+
+    def throttle(self):
+        with self._lock:
+            time.sleep(0.01)                 # timer under the lock

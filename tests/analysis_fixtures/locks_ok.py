@@ -2,8 +2,13 @@
 
 The same shapes, either properly locked or pragma'd with the reason the
 discipline is deliberately waived (the Gauge last-write-wins contract).
+The deadlock twins: both methods take the locks in ONE global order, a
+re-acquire uses RLock, and blocking calls either move outside the
+critical section or carry a pragma naming the serialization contract.
 """
 
+import os
+import time
 import threading
 
 
@@ -25,3 +30,46 @@ class DisciplinedAccumulator:
     def bump(self):
         with self._lock:
             self.total += 1
+
+
+class OrderedLocks:
+    """Lock order is a -> b, everywhere — no cycle, no finding."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.RLock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def reenter(self):
+        with self._b:
+            with self._b:  # RLock IS reentrant — not a finding
+                pass
+
+
+class SyncOutsideLock:
+    def __init__(self, fh, sock):
+        self._lock = threading.Lock()
+        self._fh = fh
+        self._sock = sock
+
+    def flush(self):
+        with self._lock:
+            fileno = self._fh.fileno()
+        os.fsync(fileno)                     # sync outside the lock
+
+    def push(self, payload):
+        # fsync-before-ack shape: the serialization is the contract
+        with self._lock:
+            self._sock.sendall(payload)  # crdtlint: disable=hold-and-block
+
+    def throttle(self):
+        time.sleep(0.01)                     # sleep outside any lock

@@ -1,0 +1,114 @@
+"""Fixture: sharding-contract violations (MUST trigger SC01-SC05).
+
+Like ``kernels_bad.py``, this module is imported AND traced by
+``tests/test_shardcheck.py`` — the contract tier needs real traceable
+kernels.  Each ``fixture_shard.*`` spec commits exactly one sin:
+
+* ``fixture_shard.cross_object``    — pointwise-declared kernel that
+  folds the object axis (SC01)
+* ``fixture_shard.undeclared_psum`` — reduction lowering a psum it
+  never declared (SC02 extra)
+* ``fixture_shard.phantom_pmax``    — reduction declaring a pmax the
+  jaxpr never lowers (SC02 missing)
+* ``fixture_shard.ragged_rung``     — object extent 6 over mesh size 4
+  (SC04)
+* ``fixture_shard.budget_blowout``  — 2 distinct lowerings per mesh
+  size against compile_budget=1 (SC05)
+
+SC03 is lexical (the hot-path AST scan), so its sin ships as source
+text (:data:`SC03_BAD_SRC`) the test mounts at a ``crdt_tpu/batch/``
+rel path.  jax imports live inside the builders; tests/ is outside the
+default scan set, so the repo-wide gates never see these.
+"""
+
+from crdt_tpu.analysis.kernels import (
+    KernelSpec, TraceCase, pointwise, reduction,
+)
+
+HERE = "tests/analysis_fixtures/shard_bad.py"
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, getattr(jnp, dtype))
+
+
+def _b_cross_object():
+    import jax.numpy as jnp
+
+    def center(x):
+        # folds the object axis, then broadcasts it back over every
+        # object: each output row depends on ALL rows
+        return x - jnp.sum(x, axis=0)
+
+    return [TraceCase("r0", center, (_sds((8, 4), "float32"),))]
+
+
+def _b_undeclared_psum():
+    import jax
+
+    def norm(x):
+        return jax.vmap(lambda r: r + jax.lax.psum(r, "i"),
+                        axis_name="i")(x)
+
+    return [TraceCase("r0", norm, (_sds((8, 4), "float32"),))]
+
+
+def _b_phantom_pmax():
+    def bump(x):
+        return x + 1  # lowers nothing collective
+
+    return [TraceCase("r0", bump, (_sds((8, 4), "float32"),))]
+
+
+def _b_ragged_rung():
+    def scale(x):
+        return x * 2
+
+    return [TraceCase("r6", scale, (_sds((6, 4), "float32"),))]
+
+
+def _b_budget_blowout():
+    def scale(x):
+        return x * 2
+
+    return [
+        TraceCase("r8", scale, (_sds((8, 4), "float32"),), key=(8,)),
+        TraceCase("r16", scale, (_sds((16, 4), "float32"),), key=(16,)),
+    ]
+
+
+SPECS = (
+    KernelSpec("fixture_shard.cross_object", HERE, "center",
+               build=_b_cross_object, sharding=pointwise()),
+    KernelSpec("fixture_shard.undeclared_psum", HERE, "norm",
+               build=_b_undeclared_psum,
+               sharding=reduction(0, collectives=())),
+    KernelSpec("fixture_shard.phantom_pmax", HERE, "bump",
+               build=_b_phantom_pmax,
+               sharding=reduction(0, collectives=("pmax",))),
+    KernelSpec("fixture_shard.ragged_rung", HERE, "scale",
+               build=_b_ragged_rung, sharding=pointwise()),
+    KernelSpec("fixture_shard.budget_blowout", HERE, "scale",
+               compile_budget=1, build=_b_budget_blowout,
+               sharding=pointwise()),
+)
+
+
+#: SC03 sin as source text: a local bound from a jitted kernel call
+#: round-trips through int() inside a (mounted) mesh hot-path module
+SC03_BAD_SRC = """\
+import jax
+
+
+@jax.jit
+def _fold(x):
+    return x.sum()
+
+
+def sample(x):
+    total = _fold(x)
+    return int(total)
+"""

@@ -1,0 +1,114 @@
+"""Fixture: the sharding-contract twins (MUST NOT trigger live).
+
+The same shapes as ``shard_bad.py``, each either contract-satisfying
+or pragma-suppressed — never unanalyzed:
+
+* ``fixture_shard.pointwise_clean`` — honestly shard-local pointwise
+* ``fixture_shard.routed_gather``   — gathers the object axis through
+  a leaf DECLARED routed (the mesh layer rebases ids per shard), so
+  SC01's exemption applies
+* ``fixture_shard.declared_psum``   — the psum kernel with the psum on
+  its reduction contract (SC02 clean)
+* ``fixture_shard.pragma_sum``      — the SC01 sin with a pragma on
+  the offending line: the finding FIRES and is suppressed, proving the
+  twin is analyzed rather than inert
+* ``fixture_shard.even_rungs``      — extents that divide every
+  declared mesh size (SC04/SC05 clean across two rungs)
+
+:data:`SC03_OK_SRC` is the lexical twin: the kernel output stays on
+device in one function and carries a cadence pragma in the other.
+"""
+
+from crdt_tpu.analysis.kernels import (
+    KernelSpec, TraceCase, pointwise, reduction,
+)
+
+HERE = "tests/analysis_fixtures/shard_ok.py"
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, getattr(jnp, dtype))
+
+
+def _b_pointwise_clean():
+    def scale(x):
+        return x * 2 + 1
+
+    return [TraceCase("r0", scale, (_sds((8, 4), "float32"),))]
+
+
+def _b_routed_gather():
+    def route(x, idx):
+        return x[idx]  # idx carries object IDS: declared routed
+
+    return [TraceCase("r0", route,
+                      (_sds((8, 4), "float32"), _sds((3,), "int32")))]
+
+
+def _b_declared_psum():
+    import jax
+
+    def norm(x):
+        return jax.vmap(lambda r: r + jax.lax.psum(r, "i"),
+                        axis_name="i")(x)
+
+    return [TraceCase("r0", norm, (_sds((8, 4), "float32"),))]
+
+
+def _b_pragma_sum():
+    import jax.numpy as jnp
+
+    def center(x):
+        return x - jnp.sum(x, axis=0)  # crdtlint: disable=SC01 — fixture: demonstrates pragma suppression on the anchor line
+
+    return [TraceCase("r0", center, (_sds((8, 4), "float32"),))]
+
+
+def _b_even_rungs():
+    def scale(x):
+        return x * 2
+
+    return [
+        TraceCase("r8", scale, (_sds((8, 4), "float32"),), key=(8,)),
+        TraceCase("r16", scale, (_sds((16, 4), "float32"),), key=(16,)),
+    ]
+
+
+SPECS = (
+    KernelSpec("fixture_shard.pointwise_clean", HERE, "scale",
+               build=_b_pointwise_clean, sharding=pointwise()),
+    KernelSpec("fixture_shard.routed_gather", HERE, "route",
+               build=_b_routed_gather,
+               sharding=pointwise((0, 0), routed=(1,))),
+    KernelSpec("fixture_shard.declared_psum", HERE, "norm",
+               build=_b_declared_psum,
+               sharding=reduction(0, collectives=("psum",))),
+    KernelSpec("fixture_shard.pragma_sum", HERE, "center",
+               build=_b_pragma_sum, sharding=pointwise()),
+    KernelSpec("fixture_shard.even_rungs", HERE, "scale",
+               build=_b_even_rungs, sharding=pointwise()),
+)
+
+
+#: SC03 twins: on-device return, and a pragma'd deliberate sample point
+SC03_OK_SRC = """\
+import jax
+
+
+@jax.jit
+def _fold(x):
+    return x.sum()
+
+
+def on_device(x):
+    total = _fold(x)
+    return total
+
+
+def sample_point(x):
+    total = _fold(x)
+    return int(total)  # crdtlint: disable=SC03 — fixture: one-int gauge fetch, once per cadence
+"""

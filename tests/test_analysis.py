@@ -95,7 +95,9 @@ def test_fixture_bad_files_trigger(fixture_result):
              for name, fs in by_file.items()}
     assert rules["telemetry_bad.py"] == [
         "metric-namespace", "metric-type-collision"]
-    assert rules["locks_bad.py"] == ["lock-discipline", "unlocked-rmw"]
+    assert rules["locks_bad.py"] == [
+        "hold-and-block", "lock-discipline", "lock-order-cycle",
+        "unlocked-rmw"]
     assert rules["tracer_bad.py"] == [
         "jit-dict-order", "jit-host-coercion", "pallas-int64"]
     assert rules["wire_bad.py"] == [
@@ -105,6 +107,14 @@ def test_fixture_bad_files_trigger(fixture_result):
     coercions = [f for f in by_file["tracer_bad.py"]
                  if f.rule == "jit-host-coercion"]
     assert len(coercions) == 3
+    # the order rule saw both deadlock shapes (a<->b cycle, re-acquire)
+    cycles = [f for f in by_file["locks_bad.py"]
+              if f.rule == "lock-order-cycle"]
+    assert len(cycles) == 2
+    # hold-and-block saw all three blocking families (fsync/send/sleep)
+    blocked = [f for f in by_file["locks_bad.py"]
+               if f.rule == "hold-and-block"]
+    assert len(blocked) == 3
 
 
 def test_fixture_ok_twins_are_suppressed_not_clean(fixture_result):

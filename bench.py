@@ -2756,6 +2756,78 @@ def bench_heat():
     return out
 
 
+def bench_mesh():
+    """Mesh-sharded fleet stage (crdt_tpu.mesh): the whole anti-entropy
+    round as ONE pjit'd step over the object mesh, at 1k/64k/1M objects
+    across mesh sizes {1,2,4,8} (clamped to visible devices) — step
+    wall per rung plus the digest all_gather's byte bill, parity-gated
+    byte-identical to the unsharded merge+digest control at every
+    (size, mesh) point."""
+    import jax
+
+    from crdt_tpu import mesh as mesh_mod
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.sync import digest as digest_mod
+    from crdt_tpu.utils.interning import Universe
+    from crdt_tpu.utils.testdata import anti_entropy_fleets
+
+    n_dev = len(jax.devices())
+    sizes = [s for s in mesh_mod.MESH_SIZES if s <= n_dev]
+    if len(sizes) < len(mesh_mod.MESH_SIZES):
+        log(f"mesh: {n_dev} visible device(s) — running mesh {sizes} "
+            "only (XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "unlocks the full ladder)")
+    a, m, d = 8, 8, 2
+    uni = Universe.identity(CrdtConfig(num_actors=a, member_capacity=m,
+                                       deferred_capacity=d,
+                                       counter_bits=32))
+    rng = np.random.RandomState(23)
+    fleet_sizes = (1_000, 16_000) if SMALL else (1_000, 64_000, 1_000_000)
+    template_rows = 65_536
+    out = {}
+    for n in fleet_sizes:
+        if remaining_budget() < 15:
+            log(f"mesh: budget low, stopping before N={n}")
+            break
+        # host-side generation stays bounded: fleets above the template
+        # size tile a 64k template (content repetition does not change
+        # the kernels' work — dense data-oblivious planes)
+        rows = min(n, template_rows)
+        reps = anti_entropy_fleets(rng, rows, a, m, d, 2, base=3,
+                                   novel=1, deferred_frac=0.25)
+        planes = []
+        for rep in reps:
+            if n > rows:
+                tiles = -(-n // rows)
+                rep = tuple(np.concatenate([p] * tiles, axis=0)[:n]
+                            for p in rep)
+            planes.append(rep)
+        A = OrswotBatch(*planes[0])
+        B = OrswotBatch(*planes[1])
+        control = np.asarray(digest_mod.digest_of(A.merge(B), uni),
+                             dtype=np.uint64)
+        for S in sizes:
+            sa = mesh_mod.ShardedBatch.shard(A, uni, shards=S)
+            sb = mesh_mod.ShardedBatch.shard(B, uni, shards=S)
+            res = mesh_mod.anti_entropy_step(sa, sb)  # warm + parity
+            assert np.array_equal(res.digests, control), (
+                f"mesh step digests diverged from the unsharded "
+                f"control at N={n}, mesh={S}"
+            )
+            iters = 3 if n >= 64_000 else 10
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                mesh_mod.anti_entropy_step(sa, sb, check=False)
+            step_s = (time.perf_counter() - t0) / iters
+            gather_bytes = sa.layout.padded * res.digests.dtype.itemsize
+            out[f"mesh_step_ms_{n}_s{S}"] = round(step_s * 1e3, 3)
+            out[f"mesh_gather_bytes_{n}_s{S}"] = int(gather_bytes)
+            log(f"mesh: N={n} S={S} step {step_s*1e3:.2f}ms  digest "
+                f"all_gather {gather_bytes}B  parity OK")
+    return out
+
+
 def bench_bandwidth_floor():
     """Same-window HBM bandwidth floor (VERDICT r3 item 1): a chained
     elementwise ``jnp.maximum`` over the north-star chunk's 256 MB dots
@@ -3511,6 +3583,12 @@ def main():
     heat_res = run_stage("heat", 25, bench_heat)
     if heat_res is not None:
         emit(**heat_res)
+    # budget-skippable: mesh-sharded fleets — one pjit'd anti-entropy
+    # step per rung at 1k/64k/1M objects across mesh {1,2,4,8}, digest
+    # vectors parity-gated byte-identical to the unsharded control
+    mesh_res = run_stage("mesh", 90, bench_mesh)
+    if mesh_res is not None:
+        emit(**mesh_res)
     # budget-skippable: kernelcheck coverage gauge (analyzer wall time +
     # kernels-covered counts, so a kernel module escaping the manifest
     # shows in the artifact tail as a coverage count that stopped moving)

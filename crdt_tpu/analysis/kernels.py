@@ -779,6 +779,36 @@ def _b_digest(which: str):
     return build
 
 
+def _b_mesh_step():
+    def build():
+        from ..mesh import step as mesh_step
+        from ..sync import digest
+
+        mesh = _cpu_mesh("objects")
+        dt = digest._digest_dtype().__name__ \
+            if hasattr(digest._digest_dtype(), "__name__") else "uint64"
+        cases = []
+        for (a, m, d) in LADDER:
+            planes = _orswot_planes(a, m, d)
+            fn = _unjit(mesh_step._step_fn(mesh, "objects", m, d, False,
+                                           "rank"))
+            cases.append(TraceCase(
+                rung=f"A{a}.M{m}.D{d}", fn=fn,
+                args=(planes, planes, _vec(a, dt)),
+                key=(m, d, "rank")))
+        a, m, d = LADDER[0]
+        planes = _orswot_planes(a, m, d)
+        cases.append(TraceCase(
+            rung=f"A{a}.M{m}.D{d}.table",
+            fn=_unjit(mesh_step._step_fn(mesh, "objects", m, d, True,
+                                         "rank")),
+            args=(planes, planes, _vec(a, dt), _vec(64, dt)),
+            key=(m, d, "rank", "table")))
+        return cases
+
+    return build
+
+
 def _b_tree_fold(which: str):
     def build():
         import jax.numpy as jnp
@@ -1367,6 +1397,20 @@ MANIFEST: tuple = (
                           "collective; the clock rebroadcast is "
                           "member_clock_join's pmax)"),
                build=_b_member_sharding("apply_add")),
+    # mesh/step.py (the fused whole-round anti-entropy step) -----------------
+    KernelSpec("mesh.step.anti_entropy", "crdt_tpu/mesh/step.py",
+               "_step_fn._step",
+               determinism="integer-lattice",
+               compile_budget=len(LADDER) + 1,  # +1: salt-table variant
+               sharding=reduction(
+                   0, 1, 2, 3, 4, 5, 6, 7, 8, 9,  # both state 5-tuples
+                   collectives=("all_gather", "pmax", "psum"),
+                   reason="whole anti-entropy round fused over the "
+                          "objects mesh: shard-local pair merge + "
+                          "digest slice, ONE all_gather for the fleet "
+                          "digest vector, pmax clock join, psum member "
+                          "fold; salt operands ride replicated"),
+               build=_b_mesh_step()),
     # ops: the Mosaic-destined Pallas kernels --------------------------------
     KernelSpec("ops.pallas.merge", "crdt_tpu/ops/orswot_pallas.py",
                "merge", mosaic=True,

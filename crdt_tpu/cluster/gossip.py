@@ -639,6 +639,54 @@ class ClusterNode:
         the split exists for listeners' readability and telemetry."""
         return self._run_session(peer_id, transport)
 
+    def sync_shard_subset(self, peer: "ClusterNode", layout):
+        """Repair ONLY the diverged shards of a mesh-sharded fleet
+        against an in-process peer replica: per-shard root compare,
+        then the digest-tree descent scoped to each diverged shard's
+        leaf range (:func:`crdt_tpu.mesh.sync.shard_subset_sync`),
+        pulling exactly those shards' diverged rows from ``peer``'s
+        batch.  ``layout`` is the fleet's shard→leaf-range map
+        (:class:`~crdt_tpu.mesh.state.MeshLayout`).
+
+        Both busy locks are taken (initiator first, timeout-bounded —
+        a cross-pair would raise :class:`PeerUnavailableError` rather
+        than deadlock, the session discipline), so neither side's
+        batch moves mid-repair.  Repaired rows feed this node's heat
+        tracker exactly like a flat session's deltas.  Returns the
+        :class:`~crdt_tpu.mesh.sync.ShardSyncStats`."""
+        from ..mesh import sync as mesh_sync
+
+        if not self._busy.acquire(timeout=self.busy_timeout_s):
+            raise PeerUnavailableError(
+                f"node {self.node_id}: busy with another session for "
+                f">{self.busy_timeout_s:.1f}s, refusing shard-subset "
+                f"sync with {peer.node_id}"
+            )
+        try:
+            if not peer._busy.acquire(timeout=peer.busy_timeout_s):
+                raise PeerUnavailableError(
+                    f"peer {peer.node_id}: busy with another session "
+                    f"for >{peer.busy_timeout_s:.1f}s, refusing "
+                    f"shard-subset sync from {self.node_id}"
+                )
+            try:
+                with self._lock:
+                    mine = self._batch
+                with peer._lock:
+                    theirs = peer._batch
+                merged, stats = mesh_sync.shard_subset_sync(
+                    mine, theirs, layout, self.universe,
+                    applier=self._applier)
+                with self._lock:
+                    self._batch = merged
+                if stats.objects and self.heat is not None:
+                    self.heat.record_repair(stats.object_ids, layout.n)
+                return stats
+            finally:
+                peer._busy.release()
+        finally:
+            self._busy.release()
+
 
 @dataclasses.dataclass
 class RoundReport:

@@ -229,6 +229,30 @@ class TransportFrameError(TransportError):
     escapes the transport."""
 
 
+class MeshContractError(CrdtError, TypeError):
+    """A kernel was dispatched onto a device mesh its declared
+    :class:`~crdt_tpu.analysis.kernels.ShardContract` forbids: a
+    ``host_only`` or ``replicated`` kernel asked to run sharded, a
+    mesh size outside the contract's verified ladder, or a kernel with
+    no contract row at all.
+
+    No reference counterpart — the reference has no device mesh; this
+    is the runtime half of shardcheck's static guarantee
+    (:mod:`crdt_tpu.analysis.shard_rules`): the mesh layer consults the
+    SAME manifest the static checker proves, so "it shardchecks" and
+    "it dispatches" can never drift apart silently.  Subclasses
+    ``TypeError`` because the caller passed a kernel of the wrong
+    *kind* for the mesh — a programming error at the dispatch site,
+    not a data fault.
+    """
+
+    def __init__(self, message: str, *, kernel: str = "",
+                 sclass: str = ""):
+        super().__init__(message)
+        self.kernel = kernel
+        self.sclass = sclass
+
+
 class ConsistencyUnavailableError(CrdtError):
     """A session-consistency admission could not be satisfied: a
     read-your-writes / monotonic read parked past its deadline without

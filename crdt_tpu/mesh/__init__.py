@@ -1,0 +1,55 @@
+"""Mesh-sharded fleets: one logical replica across a device mesh.
+
+The fleet's object axis shards row-wise over ``parallel/mesh.py``'s
+``objects`` mesh axis; this package owns everything above the raw
+placement helpers:
+
+* :mod:`~crdt_tpu.mesh.state` — :class:`MeshLayout` (subtree-granule
+  shard boundaries, planner-priced) and :class:`ShardedBatch` (padded,
+  NamedSharding-placed plane pytrees).
+* :mod:`~crdt_tpu.mesh.contracts` — the runtime half of the static
+  ShardContract manifest: dispatch-time refusal of host_only /
+  replicated kernels, with the consumed-contract set pinned against
+  the shardcheck manifest by tests.
+* :mod:`~crdt_tpu.mesh.step` — the whole anti-entropy round as ONE
+  pjit'd ``shard_map`` program (shard-local joins, one digest
+  ``all_gather``, the declared pmax/psum fleet summaries).
+* :mod:`~crdt_tpu.mesh.sync` — shard-subset repair: per-shard root
+  compare, subtree descent scoped to the diverged shard's leaf range.
+* :mod:`~crdt_tpu.mesh.durable` — per-shard snapshot generations tied
+  by a fleet manifest; restore re-verifies every shard's subtree root.
+
+Unlike the package root, importing :mod:`crdt_tpu.mesh` MAY touch jax
+(it needs the x64 flip and device mesh machinery) — keep it out of
+host-only import paths, exactly like :mod:`crdt_tpu.parallel`.
+"""
+
+from .contracts import (SHARDABLE_CLASSES, consumed_contracts,
+                        contract_map, require_shardable)
+from .durable import MeshSnapshotStore, shard_root_of
+from .state import (MESH_AXIS, MESH_SIZES, MeshLayout, ShardedBatch,
+                    choose_layout, shard_loads)
+from .step import MeshStepResult, anti_entropy_step
+from .sync import (ShardSyncStats, diverged_shards, shard_roots,
+                   shard_subset_sync)
+
+__all__ = [
+    "MESH_AXIS",
+    "MESH_SIZES",
+    "MeshLayout",
+    "MeshSnapshotStore",
+    "MeshStepResult",
+    "ShardSyncStats",
+    "SHARDABLE_CLASSES",
+    "ShardedBatch",
+    "anti_entropy_step",
+    "choose_layout",
+    "consumed_contracts",
+    "contract_map",
+    "diverged_shards",
+    "require_shardable",
+    "shard_loads",
+    "shard_root_of",
+    "shard_roots",
+    "shard_subset_sync",
+]

@@ -1,0 +1,89 @@
+"""Runtime↔static sharding-contract cross-check (the dispatch gate).
+
+shardcheck (:mod:`crdt_tpu.analysis.shard_rules`) statically proves
+every manifested kernel against its declared
+:class:`~crdt_tpu.analysis.kernels.ShardContract` on every CI run.
+This module is the RUNTIME half of that guarantee: the mesh layer
+consults the SAME manifest at dispatch time, so a kernel whose
+contract says ``host_only`` or ``replicated`` can never be placed on
+the object mesh — a typed :class:`~crdt_tpu.error.MeshContractError`,
+not a silently-wrong collective program.
+
+Single-source discipline (the :mod:`crdt_tpu.obs.namespace` pattern,
+dynamically): :func:`contract_map` is derived from
+:data:`~crdt_tpu.analysis.kernels.MANIFEST` — there is no second table
+to drift.  ``tests/test_mesh.py`` pins that the runtime-consumed
+contract set equals shardcheck's manifest exactly.
+
+Import contract: stdlib-only (the manifest module keeps jax out of its
+import path), so consulting a contract never drags the device runtime
+into a host-side caller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, FrozenSet
+
+from ..analysis.kernels import MANIFEST, ShardContract
+from ..error import MeshContractError
+
+#: shard classes the mesh layer may dispatch (host_only/replicated are
+#: refused — the typed-error satellite)
+SHARDABLE_CLASSES = ("pointwise", "reduction")
+
+_LOCK = threading.Lock()
+_CONSUMED: set = set()
+
+
+def contract_map() -> Dict[str, ShardContract]:
+    """Every manifested kernel's declared sharding contract, by kernel
+    name — exactly the rows shardcheck verifies (kernels with no
+    ``sharding=`` declaration have no contract and are refused at
+    dispatch like host_only ones)."""
+    return {spec.name: spec.sharding for spec in MANIFEST
+            if spec.sharding is not None}
+
+
+def require_shardable(name: str, mesh_size: int) -> ShardContract:
+    """The dispatch gate: look up ``name``'s contract and refuse — with
+    a typed :class:`~crdt_tpu.error.MeshContractError` — anything the
+    static checker would not sanction on an object mesh of
+    ``mesh_size`` devices.  Returns the contract on success and records
+    the name so tests can pin the runtime-consumed set against the
+    manifest."""
+    from ..utils import tracing
+
+    contracts = contract_map()
+    contract = contracts.get(name)
+    if contract is None:
+        tracing.count("mesh.contract.refused")
+        raise MeshContractError(
+            f"kernel {name!r} has no ShardContract row in the kernel "
+            "manifest — shardcheck never proved it, so the mesh layer "
+            "refuses to dispatch it",
+            kernel=name, sclass="")
+    if contract.sclass not in SHARDABLE_CLASSES:
+        tracing.count("mesh.contract.refused")
+        raise MeshContractError(
+            f"kernel {name!r} is declared {contract.sclass!r} "
+            f"({contract.reason or 'no reason recorded'}) — it cannot "
+            "run sharded over the object mesh",
+            kernel=name, sclass=contract.sclass)
+    if int(mesh_size) not in tuple(contract.mesh_sizes):
+        tracing.count("mesh.contract.refused")
+        raise MeshContractError(
+            f"kernel {name!r} is contracted for mesh sizes "
+            f"{tuple(contract.mesh_sizes)}, not {mesh_size} — "
+            "shardcheck only verified the declared ladder",
+            kernel=name, sclass=contract.sclass)
+    with _LOCK:
+        _CONSUMED.add(name)
+    return contract
+
+
+def consumed_contracts() -> FrozenSet[str]:
+    """Kernel names the runtime gate has approved so far this process —
+    the set ``tests/test_mesh.py`` cross-checks against the manifest."""
+    with _LOCK:
+        return frozenset(_CONSUMED)

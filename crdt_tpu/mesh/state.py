@@ -1,0 +1,280 @@
+"""Sharded fleet state: one logical replica across a device mesh.
+
+The object axis is the data-parallel axis (SURVEY.md §2.3): a fleet of
+N independent CRDT objects shards row-wise over ``parallel/mesh.py``'s
+``objects`` mesh with NO cross-device traffic for pointwise kernels.
+This module owns the two halves of that placement:
+
+* :class:`MeshLayout` — the shard→leaf-range map.  Boundaries are
+  chosen on **pow2 subtree granules** (the spans
+  :func:`crdt_tpu.obs.stability.subtree_layout` hands out), so a shard
+  always owns whole digest-tree subtrees and the PR 11 subtree descent
+  can be pointed at exactly one shard's leaf range.  With a measured
+  heat vector the granule is picked by the PR 18 placement planner
+  (the ``plan=mesh:S`` imbalance score, granule-snapped via
+  :func:`crdt_tpu.obs.heat.mesh_bounds` — the SAME formula ``GET
+  /heat?plan=mesh:S&granule=G`` prices, so a scored layout is always a
+  buildable one).
+* :class:`ShardedBatch` — a batch pytree padded to ``shards *
+  per_shard`` rows (zero rows digest to the XOR identity, so padding
+  is invisible to every digest/tree comparison) and placed via
+  ``NamedSharding`` over the object axis.
+
+Object-id rebasing (the SC01 routed-leaf exemption, now actually
+implemented): operands that carry object ids by VALUE — op batches,
+read batches, delta row indices — index the GLOBAL object axis; on a
+mesh each shard's planes start at ``s * per_shard``, so
+:meth:`MeshLayout.rebase` splits global ids into ``(shard,
+local_row)`` pairs and :meth:`MeshLayout.unbase` inverts it.
+shardcheck sanctions gathers through routed leaves statically;
+``tests/test_mesh.py`` cross-checks the runtime rebasing round-trips
+against the declared routed contracts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: the data-parallel mesh axis every plane shards over
+MESH_AXIS = "objects"
+
+#: the shard-count ladder shardcheck verifies statically and the
+#: runtime tests exercise (analysis.kernels.MESH_SIZES, re-exported so
+#: host-side callers need no jax-adjacent import)
+MESH_SIZES = (1, 2, 4, 8)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshLayout:
+    """The shard→leaf-range map of one sharded fleet.
+
+    ``per_shard`` rows live on every device (a multiple of
+    ``granule``); rows past ``n`` are zero padding on the tail
+    device(s).  Logical shard ``s`` owns global rows
+    ``[bounds[s], bounds[s+1])`` — padded rows digest to 0, so every
+    digest/tree statement about the logical fleet survives sharding
+    byte-identically."""
+
+    n: int           # logical (unpadded) fleet rows
+    shards: int      # mesh size over the object axis
+    granule: int     # pow2 subtree span the boundaries snap to
+    per_shard: int   # padded rows per device (multiple of granule)
+    imbalance: float = 1.0  # planner-predicted max/mean shard load
+
+    @property
+    def padded(self) -> int:
+        return self.shards * self.per_shard
+
+    @property
+    def bounds(self) -> tuple:
+        """Logical boundaries, ``shards + 1`` entries clipped to n."""
+        return tuple(min(s * self.per_shard, self.n)
+                     for s in range(self.shards + 1))
+
+    def ranges(self) -> tuple:
+        """Per-shard logical ``(lo, hi)`` ranges."""
+        b = self.bounds
+        return tuple((b[s], b[s + 1]) for s in range(self.shards))
+
+    def objects_of(self, shard: int) -> int:
+        lo, hi = self.ranges()[shard]
+        return hi - lo
+
+    def shard_of(self, obj: int) -> int:
+        if not 0 <= obj < self.n:
+            raise IndexError(f"object {obj} outside fleet [0, {self.n})")
+        return min(obj // self.per_shard, self.shards - 1)
+
+    def rebase(self, ids) -> tuple:
+        """Global object ids → ``(shard, local_row)`` — the routed-leaf
+        rebasing every op/read/delta operand takes before it may index
+        a shard's planes."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.n):
+            raise IndexError(
+                f"object ids outside fleet [0, {self.n}): "
+                f"[{ids.min()}, {ids.max()}]")
+        return ids // self.per_shard, ids % self.per_shard
+
+    def unbase(self, shard, local) -> np.ndarray:
+        """Inverse of :meth:`rebase`."""
+        return (np.asarray(shard, dtype=np.int64) * self.per_shard
+                + np.asarray(local, dtype=np.int64))
+
+    def to_json(self) -> dict:
+        return {"n": self.n, "shards": self.shards,
+                "granule": self.granule, "per_shard": self.per_shard,
+                "imbalance": self.imbalance}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "MeshLayout":
+        return cls(n=int(obj["n"]), shards=int(obj["shards"]),
+                   granule=int(obj["granule"]),
+                   per_shard=int(obj["per_shard"]),
+                   imbalance=float(obj.get("imbalance", 1.0)))
+
+
+def choose_layout(n: int, shards: int, *,
+                  heat: Optional[Sequence] = None,
+                  span: Optional[int] = None,
+                  granule: Optional[int] = None) -> MeshLayout:
+    """Pick the shard→leaf-range map for ``n`` objects over ``shards``
+    devices.
+
+    The granule defaults to the digest tree's subtree span for this
+    fleet size (:func:`~crdt_tpu.obs.stability.subtree_layout` — a
+    power of 16, so always pow2).  With a measured per-subtree ``heat``
+    vector, candidate granules (the span and its next two doublings)
+    are priced through the placement planner's ``mesh:S`` score and
+    the lowest predicted imbalance wins (ties to the smaller granule —
+    finer boundaries repack cheaper).  An explicit ``granule`` skips
+    the search but is still validated pow2."""
+    from ..obs import heat as heat_mod
+    from ..obs import stability as stability_mod
+
+    if n < 1:
+        raise ValueError(f"fleet size {n} < 1")
+    if shards < 1:
+        raise ValueError(f"shards {shards} < 1")
+    if span is None:
+        _subtrees, span = stability_mod.subtree_layout(n)
+    span = max(1, int(span))
+    imbalance = 1.0
+    if granule is None:
+        if heat is None:
+            granule = span
+        else:
+            heat = np.asarray(heat, dtype=np.float64)
+            best = None
+            for cand in (span, span * 2, span * 4):
+                report = heat_mod.score_plan(
+                    f"mesh:{shards}", heat, n=n, span=span,
+                    granule=cand)
+                score = float(report["imbalance"])
+                if best is None or score < best[0]:
+                    best = (score, cand)
+            imbalance, granule = best
+    bounds = heat_mod.mesh_bounds(n, shards, granule)
+    per_shard = -(-(-(-n // shards)) // int(granule)) * int(granule)
+    layout = MeshLayout(n=int(n), shards=int(shards),
+                        granule=int(granule), per_shard=per_shard,
+                        imbalance=float(imbalance))
+    assert list(layout.bounds) == list(bounds)  # one formula, two homes
+    return layout
+
+
+def _pad_batch(batch, pad: int, universe):
+    """Append ``pad`` empty rows (zero/EMPTY planes — the states that
+    digest to the XOR identity) to every leaf of a batch pytree."""
+    import jax
+    import jax.numpy as jnp
+
+    if pad == 0:
+        return batch
+    z = type(batch).zeros(pad, universe)
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), batch, z)
+
+
+class ShardedBatch:
+    """A fleet batch living sharded over the object axis of a device
+    mesh — the one logical replica, in S pieces.
+
+    ``device`` is the padded batch pytree placed via ``NamedSharding``
+    (each array's leading axis splits ``per_shard`` rows per device);
+    ``layout`` is the shard→leaf-range map; ``universe`` is carried for
+    digest salts and padding.  Construct with :meth:`shard`."""
+
+    def __init__(self, device_batch, layout: MeshLayout, mesh,
+                 universe=None):
+        self.device = device_batch
+        self.layout = layout
+        self.mesh = mesh
+        self.universe = universe
+
+    @classmethod
+    def shard(cls, batch, universe, *, shards: Optional[int] = None,
+              mesh=None, heat=None, span: Optional[int] = None,
+              granule: Optional[int] = None) -> "ShardedBatch":
+        """Place ``batch`` on an object mesh: choose the layout
+        (:func:`choose_layout`), pad the tail shard with
+        digest-invisible empty rows, and ``device_put`` every plane
+        with the object-axis ``NamedSharding``."""
+        import jax
+
+        from ..parallel import mesh as mesh_mod
+
+        if mesh is None:
+            if shards is None:
+                raise ValueError("ShardedBatch.shard needs shards= or mesh=")
+            devices = jax.devices()
+            if shards > len(devices):
+                raise ValueError(
+                    f"shards {shards} > visible devices {len(devices)}")
+            mesh = mesh_mod.make_mesh({MESH_AXIS: shards},
+                                      devices[:shards])
+        n = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+        layout = choose_layout(n, int(mesh.shape[MESH_AXIS]),
+                               heat=heat, span=span, granule=granule)
+        padded = _pad_batch(batch, layout.padded - n, universe)
+        dev = mesh_mod.shard_batch(padded, mesh, MESH_AXIS)
+        return cls(dev, layout, mesh, universe)
+
+    def logical(self):
+        """The unpadded logical batch (rows ``[0, n)``), host-addressable
+        — what digests, trees, snapshots and the scalar oracle compare
+        against."""
+        import jax
+
+        lay = self.layout
+        if lay.padded == lay.n:
+            return self.device
+        return jax.tree_util.tree_map(lambda x: x[:lay.n], self.device)
+
+    def replace(self, device_batch) -> "ShardedBatch":
+        """A new ShardedBatch around updated planes (same layout/mesh)."""
+        return ShardedBatch(device_batch, self.layout, self.mesh,
+                            self.universe)
+
+    def publish_gauges(self, registry=None, heat_vector=None,
+                       span: int = 1) -> None:
+        """Publish the per-shard placement surface: ``mesh.layout.*``
+        and ``mesh.shard.<s>.objects`` gauges, plus
+        ``mesh.shard.<s>.load`` when a per-subtree heat vector is
+        supplied (spread uniformly within subtrees, exactly like the
+        planner's pricing)."""
+        from ..obs import metrics
+
+        reg = registry if registry is not None else metrics.registry()
+        lay = self.layout
+        reg.gauge_set("mesh.layout.shards", lay.shards)
+        reg.gauge_set("mesh.layout.granule", lay.granule)
+        reg.gauge_set("mesh.layout.imbalance", lay.imbalance)
+        loads = shard_loads(lay, heat_vector, span) \
+            if heat_vector is not None else None
+        for s, (lo, hi) in enumerate(lay.ranges()):
+            reg.gauge_set(f"mesh.shard.{s}.objects", hi - lo)
+            if loads is not None:
+                reg.gauge_set(f"mesh.shard.{s}.load", float(loads[s]))
+
+
+def shard_loads(layout: MeshLayout, heat_vector, span: int) -> np.ndarray:
+    """Measured per-subtree heat attributed to each shard's leaf range
+    — the runtime counterpart of the planner's predicted ``loads`` (the
+    same uniform within-subtree spread), so demo/tests can print
+    measured vs predicted per shard."""
+    heat = np.asarray(heat_vector, dtype=np.float64)
+    span = max(1, int(span))
+    loads = np.zeros(layout.shards, dtype=np.float64)
+    bounds = layout.bounds
+    for i in range(heat.size):
+        lo, hi = i * span, min((i + 1) * span, layout.n)
+        width = max(hi - lo, 1)
+        for s in range(layout.shards):
+            ov = min(hi, bounds[s + 1]) - max(lo, bounds[s])
+            if ov > 0:
+                loads[s] += heat[i] * ov / width
+    return loads

@@ -1,0 +1,142 @@
+"""Shard-subset sync: repair one diverged shard, leave its neighbors home.
+
+The flat session ships O(N) digest lanes before knowing WHERE the
+divergence lives; the tree descent narrows that to subtrees.  On a
+mesh the shard→leaf-range map (:class:`~crdt_tpu.mesh.state.
+MeshLayout`, subtree-aligned by construction) adds the missing level:
+compare one 8-byte root per shard first, then point the PR 11 subtree
+descent at ONLY the diverged shard's leaf range — a fleet with one hot
+shard syncs that shard's subtree bytes and nothing else
+(counter-pinned: ``mesh.sync.shards_skipped`` shards contribute zero
+descent or delta bytes).
+
+Everything here is host-side orchestration over the existing digest /
+tree / delta machinery — no new jitted kernel, no new wire format: the
+delta rows ride :func:`crdt_tpu.sync.delta.gather_blobs` /
+:func:`~crdt_tpu.sync.delta.apply_delta_rows` exactly like a flat
+session's, with the row ids rebased per shard
+(:meth:`~crdt_tpu.mesh.state.MeshLayout.rebase` — the routed-leaf
+exemption's runtime half).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from .state import MeshLayout
+
+
+def shard_roots(digests, layout: MeshLayout) -> np.ndarray:
+    """Digest-tree root of each shard's logical digest slice —
+    ``uint64[S]`` shard roots (8 bytes per shard on the wire), the
+    same roots the per-shard snapshot manifest records
+    (:func:`crdt_tpu.mesh.durable.shard_root_of`).  NOT a raw XOR
+    fold: the tree's position-mixed leaves keep two rows that took
+    IDENTICAL updates from cancelling each other out of the root
+    (a raw XOR of per-row digest deltas would), so equal roots really
+    mean an undiverged shard.  Empty shards root to the empty tree."""
+    from ..sync import tree as tree_mod
+
+    d = np.asarray(digests, dtype=np.uint64)
+    if d.size != layout.n:
+        raise ValueError(
+            f"digest vector has {d.size} lanes, layout has {layout.n}")
+    out = np.zeros(layout.shards, dtype=np.uint64)
+    for s, (lo, hi) in enumerate(layout.ranges()):
+        if hi > lo:
+            out[s] = tree_mod.build_tree(d[lo:hi]).root
+    return out
+
+
+def diverged_shards(mine, theirs, layout: MeshLayout) -> np.ndarray:
+    """Shard indices whose roots disagree, ascending — the shards a
+    subset sync must descend into; everything else stays home."""
+    a, b = shard_roots(mine, layout), shard_roots(theirs, layout)
+    return np.nonzero(a != b)[0].astype(np.int64)
+
+
+@dataclasses.dataclass
+class ShardSyncStats:
+    """One shard-subset sync pass's accounting (what the counters pin):
+    which shards moved, the descent's wire-byte bill per diverged
+    shard, and the delta payload that actually shipped."""
+
+    shards_synced: int = 0
+    shards_skipped: int = 0
+    objects: int = 0
+    root_bytes: int = 0        # the per-shard root compare (8B * S)
+    descent_bytes: int = 0     # subtree-descent lanes, diverged shards only
+    delta_bytes: int = 0       # delta row payloads, diverged shards only
+    per_shard: dict = dataclasses.field(default_factory=dict)
+    #: global ids of every repaired row — what the caller feeds the heat
+    #: tracker (``record_repair``), exactly like a flat session's deltas
+    object_ids: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, dtype=np.int64))
+
+
+def shard_subset_sync(dst_batch, src_batch, layout: MeshLayout,
+                      universe=None, *, applier=None,
+                      dst_digests=None, src_digests=None):
+    """Pull every diverged shard's rows from ``src`` into ``dst``:
+    per-shard root compare, shard-scoped digest-tree descent for the
+    byte bill, then gather/apply of exactly the diverged rows.
+
+    Returns ``(merged_dst_batch, ShardSyncStats)``.  Pure host
+    orchestration — both batches must be logical (unpadded) fleets of
+    ``layout.n`` rows; digests may be passed in when the caller already
+    holds them (the step result, the memo) to keep a converged pass at
+    zero kernel launches."""
+    from ..sync import delta as delta_mod
+    from ..sync import digest as digest_mod
+    from ..sync import tree as tree_mod
+    from ..utils import tracing
+
+    mine = np.asarray(
+        dst_digests if dst_digests is not None
+        else digest_mod.digest_of(dst_batch, universe), dtype=np.uint64)
+    theirs = np.asarray(
+        src_digests if src_digests is not None
+        else digest_mod.digest_of(src_batch, universe), dtype=np.uint64)
+    stats = ShardSyncStats(root_bytes=8 * layout.shards)
+    diverged = diverged_shards(mine, theirs, layout)
+    stats.shards_skipped = layout.shards - int(diverged.size)
+    out = dst_batch
+    all_ids = []
+    for s in diverged:
+        lo, hi = layout.ranges()[int(s)]
+        # the PR 11 subtree descent, pointed at ONE shard's leaf range:
+        # the lane bill below is what a tree-capable session would ship
+        # for this shard and no other
+        ta = tree_mod.build_tree(mine[lo:hi])
+        tb = tree_mod.build_tree(theirs[lo:hi])
+        _leaves, descent = tree_mod.simulate_descent(ta, tb)
+        ids = lo + delta_mod.diverged_indices(mine[lo:hi], theirs[lo:hi])
+        blobs = delta_mod.gather_blobs(src_batch, ids, universe)
+        nbytes = sum(len(b) for b in blobs)
+        out = delta_mod.apply_delta_rows(out, ids, blobs, universe,
+                                         applier=applier)
+        stats.shards_synced += 1
+        stats.objects += int(ids.size)
+        stats.descent_bytes += int(descent.payload_bytes)
+        stats.delta_bytes += nbytes
+        # rebased view of the rows this shard repaired (the routed-leaf
+        # rebasing, observable per shard)
+        shard_idx, local = layout.rebase(ids)
+        assert set(shard_idx.tolist()) <= {int(s)}
+        stats.per_shard[int(s)] = {
+            "objects": int(ids.size), "delta_bytes": nbytes,
+            "descent_bytes": int(descent.payload_bytes),
+            "local_rows": local.tolist() if ids.size <= 64 else None,
+        }
+        all_ids.append(ids)
+    if all_ids:
+        stats.object_ids = np.concatenate(all_ids)
+    tracing.count("mesh.sync.rounds")
+    tracing.count("mesh.sync.shards_synced", stats.shards_synced)
+    tracing.count("mesh.sync.shards_skipped", stats.shards_skipped)
+    tracing.count("mesh.sync.objects", stats.objects)
+    tracing.count("mesh.sync.delta_bytes", stats.delta_bytes)
+    return out, stats

@@ -300,8 +300,15 @@ class MetricsServer:
             q = parse_qs(parsed.query)
             plan = q.get("plan", [None])[0]
             if plan is not None:
+                # ?granule=G (mesh plans): subtree-aligned shard
+                # boundaries, so the report prices exactly the layout
+                # crdt_tpu.mesh.state.choose_layout would build
+                granule = q.get("granule", [None])[0]
                 try:
-                    report = trk.plan_report(plan)
+                    report = trk.plan_report(
+                        plan,
+                        granule=int(granule) if granule is not None
+                        else None)
                 except ValueError as e:
                     return (f"{e}\n".encode(),
                             "text/plain; charset=utf-8", 400)

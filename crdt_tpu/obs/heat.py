@@ -288,12 +288,37 @@ def _imbalance(loads: np.ndarray) -> float:
     return 1.0 if mean <= 0 else float(np.max(loads)) / mean
 
 
+def mesh_bounds(n: int, shards: int, granule: int | None = None) -> list:
+    """Logical shard boundaries for ``mesh:<shards>`` over ``n``
+    objects — the ONE formula the planner scores and
+    :func:`crdt_tpu.mesh.state.choose_layout` instantiates, so a scored
+    layout is always a buildable one.
+
+    Without a granule: the historical even split.  With one (a
+    positive power of two — the pow2 subtree spans ``subtree_layout``
+    hands out), every shard owns ``ceil(ceil(n/shards)/granule) *
+    granule`` padded rows and the logical boundaries are the padded
+    ones clipped to ``n`` — subtree-aligned by construction."""
+    if granule is None:
+        return [int(round(s * n / shards)) for s in range(shards + 1)]
+    g = int(granule)
+    if g < 1 or (g & (g - 1)) != 0:
+        raise ValueError(
+            f"granule {granule!r} must be a positive power of two "
+            "(a subtree span)")
+    rows = -(-int(n) // int(shards))      # ceil(n / shards)
+    per = -(-rows // g) * g               # snapped up to the granule
+    return [min(s * per, int(n)) for s in range(int(shards) + 1)]
+
+
 def score_plan(spec: str, heat: np.ndarray, *, n: int,
-               span: int) -> dict:
+               span: int, granule: int | None = None) -> dict:
     """Score one placement spec against a measured per-subtree heat
     vector (any non-negative weights; the tracker passes
     reads+writes+repair totals).  Pure host arithmetic — the planner
-    prices layouts, it does not move data."""
+    prices layouts, it does not move data.  ``granule`` (mesh plans
+    only) snaps shard boundaries to subtree-aligned multiples, pricing
+    exactly the layouts the mesh runtime can instantiate."""
     import numpy as np
     kind, params = parse_plan(spec)
     heat = np.asarray(heat, dtype=np.float64)
@@ -302,9 +327,11 @@ def score_plan(spec: str, heat: np.ndarray, *, n: int,
     out = {"plan": spec, "kind": kind, "heat_total": round(total, 3),
            "granularity": {"subtrees": subtrees, "span": int(span),
                            "objects": int(n)}}
+    if granule is not None and kind != "mesh":
+        raise ValueError("granule= only applies to mesh:<shards> plans")
     if kind == "mesh":
         shards = params["shards"]
-        bounds = [int(round(s * n / shards)) for s in range(shards + 1)]
+        bounds = mesh_bounds(n, shards, granule)
         loads = np.zeros(shards, dtype=np.float64)
         for i in range(subtrees):
             lo, hi = i * span, min((i + 1) * span, n)
@@ -321,6 +348,9 @@ def score_plan(spec: str, heat: np.ndarray, *, n: int,
             max_load=round(float(np.max(loads)) if shards else 0.0, 3),
             mean_load=round(float(np.mean(loads)) if shards else 0.0, 3),
             imbalance=round(_imbalance(loads), 4))
+        if granule is not None:
+            out["granule"] = int(granule)
+            out["bounds"] = [int(b) for b in bounds]
         return out
     owners = params["owners"]
     k = min(params["k"], owners)
@@ -615,9 +645,12 @@ class HeatTracker:
                 out += self._totals[cls]
             return out
 
-    def plan_report(self, spec: str) -> dict:
+    def plan_report(self, spec: str,
+                    granule: int | None = None) -> dict:
         """Score one ``mesh:<S>`` / ``ring:<N>[,k=<K>]`` placement spec
-        against this node's measured heat (:func:`score_plan`)."""
+        against this node's measured heat (:func:`score_plan`);
+        ``granule`` snaps mesh-plan boundaries subtree-aligned (the
+        ``?granule=`` query parameter of ``GET /heat``)."""
         import numpy as np
         with self._lock:
             heat = np.zeros(max(self._subtrees, 1), np.float64)
@@ -625,7 +658,7 @@ class HeatTracker:
                 if cls in self._totals:
                     heat[:self._subtrees] += self._totals[cls]
             return score_plan(spec, heat, n=max(self._n, 1),
-                              span=self._span)
+                              span=self._span, granule=granule)
 
     def reset(self):
         with self._lock:

@@ -580,6 +580,55 @@ NAMESPACE: tuple[NameSpec, ...] = (
     NameSpec("heat.zipf.fit_r2", "gauge",
              "goodness of the Zipf rank-frequency fit (1 = a clean "
              "power law)"),
+    # -- mesh-sharded fleets (crdt_tpu/mesh/) --------------------------------
+    NameSpec("mesh.layout.shards", "gauge",
+             "shard count of the active mesh layout"),
+    NameSpec("mesh.layout.granule", "gauge",
+             "shard-boundary granule (a pow2 subtree span) the layout "
+             "snapped to — every boundary is a multiple of this"),
+    NameSpec("mesh.layout.imbalance", "gauge",
+             "planner-predicted max/mean shard load for the active "
+             "layout (1.0 = perfectly balanced; matches "
+             "/heat?plan=mesh:S&granule=G)"),
+    NameSpec("mesh.shard.*.objects", "gauge",
+             "logical (unpadded) object rows owned by shard <s>"),
+    NameSpec("mesh.shard.*.load", "gauge",
+             "measured heat (reads+writes+repair) attributed to shard "
+             "<s>'s leaf range — compare against the planner's "
+             "predicted loads"),
+    NameSpec("mesh.step.rounds", "counter",
+             "pjit'd anti-entropy steps executed (ONE kernel launch "
+             "per round, all shards)"),
+    NameSpec("mesh.step.digest_bytes", "counter",
+             "bytes moved by the step's digest all_gather (the whole "
+             "collective bill of a converged round)"),
+    NameSpec("mesh.sync.rounds", "counter",
+             "shard-subset sync passes (digest compare + per-shard "
+             "descent)"),
+    NameSpec("mesh.sync.shards_synced", "counter",
+             "diverged shards repaired by a shard-scoped descent"),
+    NameSpec("mesh.sync.shards_skipped", "counter",
+             "converged shards a sync pass never touched (their "
+             "subtree bytes stayed home)"),
+    NameSpec("mesh.sync.delta_bytes", "counter",
+             "delta payload bytes shipped by shard-subset sync "
+             "(diverged shards only)"),
+    NameSpec("mesh.sync.objects", "counter",
+             "diverged object rows repaired by shard-subset sync"),
+    NameSpec("mesh.durable.snapshots", "counter",
+             "fleet checkpoint passes (S per-shard generations + one "
+             "manifest)"),
+    NameSpec("mesh.durable.restores", "counter",
+             "fleet restores that re-verified every shard's subtree "
+             "root against the manifest"),
+    NameSpec("mesh.durable.rejected.*", "counter",
+             "fleet restore rejections by reason (manifest_missing/"
+             "manifest_corrupt/shard_missing/root_mismatch/"
+             "layout_mismatch)"),
+    NameSpec("mesh.contract.refused", "counter",
+             "kernel dispatches the runtime contract gate refused "
+             "(host_only/replicated/mesh-size outside the contract "
+             "ladder) — the typed MeshContractError path"),
     # -- bench probes (bench.py bench_obs_overhead) --------------------------
     NameSpec("obs.overhead.count_probe", "counter",
              "bench_obs_overhead per-op counter cost probe"),

@@ -248,8 +248,12 @@ def _member_salts(ids, mtable, dt):
     return mtable[safe]
 
 
-@functools.lru_cache(maxsize=None)
-def _orswot_kernel(use_table: bool = False):
+def orswot_digest_body(use_table: bool = False):
+    """The pure ORSWOT digest computation, un-jitted — traceable inside
+    a larger kernel (the mesh anti-entropy step traces it per shard
+    inside its own ``shard_map``; :mod:`crdt_tpu.mesh.step`).  The
+    standalone :func:`_orswot_kernel` jits exactly this body, so the
+    sharded and unsharded digests agree bit-for-bit by construction."""
     import jax.numpy as jnp
 
     from ..ops import orswot_ops
@@ -291,7 +295,13 @@ def _orswot_kernel(use_table: bool = False):
         )
         return out
 
-    return observed_kernel("sync.digest.orswot")(_jit(kernel))
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _orswot_kernel(use_table: bool = False):
+    return observed_kernel("sync.digest.orswot")(
+        _jit(orswot_digest_body(use_table)))
 
 
 @functools.lru_cache(maxsize=None)

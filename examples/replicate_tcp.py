@@ -274,6 +274,97 @@ def peer(role: str, port: int, n_objects: int, platform: str | None,
     return status
 
 
+def mesh_demo(shards: int, n_objects: int, platform: str | None,
+              divergence: float = 0.05, zipf_s: float = 1.1) -> int:
+    """``--mesh S``: one logical replica sharded over an S-device
+    object mesh (``crdt_tpu.mesh``), demonstrated on forced host
+    devices.  Drives a Zipf-skewed write history through the heat
+    observatory, lets the placement planner pick the subtree granule
+    (the ``plan=mesh:S`` score), runs the whole anti-entropy round as
+    ONE pjit'd step, and prints per-shard planner-predicted vs
+    measured load plus the digest parity against the unsharded
+    control."""
+    # the mesh ladder needs 8 visible devices; force them BEFORE the
+    # first jax import (a no-op on a real multi-device backend)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+
+    import numpy as np
+
+    from crdt_tpu import mesh as mesh_mod
+    from crdt_tpu.batch import OrswotBatch
+    from crdt_tpu.config import CrdtConfig
+    from crdt_tpu.obs import heat as heat_mod
+    from crdt_tpu.obs import stability as stability_mod
+    from crdt_tpu.sync import digest as digest_mod
+    from crdt_tpu.utils.interning import Universe
+
+    uni = Universe.identity(CrdtConfig(num_actors=8, member_capacity=32,
+                                       deferred_capacity=8,
+                                       counter_bits=32))
+    a = OrswotBatch.from_scalar(
+        _build_fleet(n_objects, actor=1, divergence=divergence, seed=17),
+        uni)
+    b = OrswotBatch.from_scalar(
+        _build_fleet(n_objects, actor=2, divergence=divergence, seed=17),
+        uni)
+
+    # a Zipf-skewed write history feeds the heat observatory — the
+    # planner prices shard boundaries against THIS, not a uniform guess
+    _subtrees, span = stability_mod.subtree_layout(n_objects)
+    trk = heat_mod.HeatTracker()
+    rng = np.random.RandomState(7)
+    ranks = np.arange(1, n_objects + 1, dtype=np.float64)
+    probs = ranks ** -max(zipf_s, 1e-9)
+    probs /= probs.sum()
+    writes = rng.choice(n_objects, size=4096, p=probs)
+    trk.record_writes(writes, n_objects)
+    heat = trk.heat_vector()
+
+    layout = mesh_mod.choose_layout(n_objects, shards, heat=heat,
+                                    span=span)
+    predicted = heat_mod.score_plan(f"mesh:{shards}", heat, n=n_objects,
+                                    span=span, granule=layout.granule)
+    print(f"mesh: {shards} shards over {n_objects} objects, planner "
+          f"granule {layout.granule} (predicted imbalance "
+          f"{predicted['imbalance']})")
+
+    sa = mesh_mod.ShardedBatch.shard(a, uni, shards=shards, heat=heat,
+                                     span=span)
+    sb = mesh_mod.ShardedBatch.shard(b, uni, shards=shards, heat=heat,
+                                     span=span)
+    res = mesh_mod.anti_entropy_step(sa, sb)
+
+    # unsharded control: same merge + digest, no mesh
+    control = np.asarray(digest_mod.digest_of(a.merge(b), uni),
+                         dtype=np.uint64)
+    parity = bool(np.array_equal(res.digests, control))
+
+    # measured load: the heat vector AFTER attributing the rows that
+    # actually churned this round (the diverged digests) as repair heat
+    pre = digest_mod.digest_of(a, uni)
+    post = digest_mod.digest_of(b, uni)
+    churned = np.nonzero(np.asarray(pre) != np.asarray(post))[0]
+    if churned.size:
+        trk.record_repair(churned, n_objects)
+    measured = mesh_mod.shard_loads(layout, trk.heat_vector(), span)
+    predicted_loads = predicted["loads"]
+    print(f"{'shard':>5} {'objects':>8} {'predicted':>10} {'measured':>10}")
+    for s, (lo, hi) in enumerate(layout.ranges()):
+        print(f"{s:>5} {hi - lo:>8} {predicted_loads[s]:>10.1f} "
+              f"{measured[s]:>10.1f}")
+    sa.publish_gauges(heat_vector=trk.heat_vector(), span=span)
+
+    print(f"digest parity vs unsharded control: "
+          f"{'BYTE-IDENTICAL' if parity else 'DIVERGED'} "
+          f"({res.digests.size} lanes, {res.live_members} live members)")
+    return 0 if parity else 1
+
+
 def gossip_demo(n_peers: int, n_objects: int, platform: str | None,
                 divergence: float, max_sweeps: int = 20,
                 fleet_port: int | None = None, ops_rate: int = 0,
@@ -1147,12 +1238,27 @@ def main() -> int:
                          "--gossip mode sets the fleet's transport "
                          "window and prints the fleet-wide tallies plus "
                          "a digest fingerprint at convergence")
+    ap.add_argument("--mesh", type=int, default=0, metavar="S",
+                    help="mesh-sharded fleet demo: shard ONE logical "
+                         "replica over an S-device object mesh "
+                         "(crdt_tpu.mesh; S in {1,2,4,8}, forced host "
+                         "devices), run the whole anti-entropy round "
+                         "as one pjit'd step, and print per-shard "
+                         "planner-predicted vs measured load plus "
+                         "digest parity against the unsharded control")
     ap.add_argument("--gc-hysteresis", type=float, default=0.5,
                     help="with --gc: shrink only when the fitted "
                          "capacity rung is at most this fraction of the "
                          "current one (GcPolicy.shrink_hysteresis; "
                          "default 0.5)")
     args = ap.parse_args()
+
+    if args.mesh:
+        if args.mesh not in (1, 2, 4, 8):
+            ap.error("--mesh needs S in {1, 2, 4, 8}")
+        zipf = args.zipf if args.zipf > 0 else 1.1
+        return mesh_demo(args.mesh, args.objects, args.platform,
+                         divergence=args.divergence, zipf_s=zipf)
 
     if args.gossip:
         if args.gossip < 2:

@@ -61,6 +61,18 @@ print(f"shardcheck OK: {sc['kernels']} kernels ({contracts}), "
       f"no-trace, {sc['elapsed_s']}s (artifact: /tmp/shardcheck.json)")
 EOF
 
+echo "== mesh suite (8-way forced-host-device mesh) =="
+# the mesh-sharded fleet suite gets its own visible stage: conftest
+# already forces the 8-device CPU mesh for tier-1, but the explicit
+# XLA_FLAGS here makes the {1,2,4,8} runtime ladder's precondition part
+# of the CI contract (not a conftest implementation detail), and the
+# separate invocation keeps mesh-size-invariance regressions diffable
+# from the log before the full tier-1 run buries them.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/test_mesh.py -q -m mesh \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
 echo "== tier-1 pytest =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

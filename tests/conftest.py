@@ -90,6 +90,13 @@ def pytest_configure(config):
         "subtree traffic attribution, the top-k/Zipf sketch, the "
         "placement planner, the /heat route); tier-1 like `sync`",
     )
+    config.addinivalue_line(
+        "markers",
+        "mesh: mesh-sharded fleet tests (crdt_tpu.mesh — shard layout, "
+        "the one-step pjit'd anti-entropy round, shard-subset sync, "
+        "per-shard snapshots, the runtime contract gate); tier-1 like "
+        "`sync`, runs on the forced 8-device CPU mesh",
+    )
 
 
 # -- jax 0.4.x Pallas/Mosaic version gate ------------------------------------

@@ -180,6 +180,63 @@ def test_plan_parse_and_scores():
             obs_heat.parse_plan(bad)
 
 
+def test_mesh_bounds_granule_snapping():
+    """``mesh_bounds`` is the ONE boundary formula: no granule keeps
+    the historical even split; with one, every boundary is a granule
+    multiple clipped to n — and junk granules (zero, non-pow2,
+    negative) are typed errors, not silent misalignment."""
+    assert obs_heat.mesh_bounds(64, 4) == [0, 16, 32, 48, 64]
+    assert obs_heat.mesh_bounds(64, 4, granule=16) == [0, 16, 32, 48, 64]
+    # n=100 over 4 shards snaps ceil(25/16)*16 = 32 rows/shard, clipped
+    assert obs_heat.mesh_bounds(100, 4, granule=16) == [0, 32, 64, 96, 100]
+    for bad in (0, 3, 24, -16):
+        with pytest.raises(ValueError):
+            obs_heat.mesh_bounds(64, 4, granule=bad)
+
+
+def test_score_plan_granule_prices_buildable_layouts():
+    """A granule-scored mesh plan reports the exact bounds
+    ``crdt_tpu.mesh.state.choose_layout`` instantiates — the planner
+    prices only buildable layouts; granule on a ring plan is a typed
+    error."""
+    heat = np.array([100.0, 10.0, 10.0, 10.0])
+    rep = obs_heat.score_plan("mesh:2", heat, n=64, span=16, granule=16)
+    assert rep["granule"] == 16 and rep["bounds"] == [0, 32, 64]
+    assert rep["loads"] == [110.0, 20.0]
+    from crdt_tpu.mesh.state import choose_layout
+    lay = choose_layout(64, 2, granule=16)
+    assert list(lay.bounds) == rep["bounds"]
+    with pytest.raises(ValueError):
+        obs_heat.score_plan("ring:5,k=3", heat, n=64, span=16,
+                            granule=16)
+
+
+def test_heat_route_accepts_granule():
+    """``GET /heat?plan=mesh:S&granule=G``: subtree-aligned boundaries
+    ride the scored report; a non-pow2 (or non-numeric) granule 400s
+    like any bogus plan spec."""
+    trk = _tracker()
+    trk.record_reads(np.zeros(64, np.int64), 64)
+    rep = trk.plan_report("mesh:4", granule=16)
+    assert rep["granule"] == 16 and rep["bounds"][-1] == 64
+    srv = obs_export.start_metrics_server(port=0, heat=trk)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _http_get(f"{base}/heat?plan=mesh:4&granule=16")
+        rep2 = json.loads(body)["report"]
+        assert status == 200 and rep2["granule"] == 16
+        assert rep2["bounds"] == rep["bounds"]
+        for junk in ("12", "abc"):
+            try:
+                _http_get(f"{base}/heat?plan=mesh:4&granule={junk}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            else:
+                raise AssertionError(f"granule={junk} did not 400")
+    finally:
+        srv.stop()
+
+
 def test_plan_report_prefers_balanced_split():
     """A deliberately lopsided heat vector scores worse (higher
     imbalance) under fewer shards than under subtree-granular shards —
